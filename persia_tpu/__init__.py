@@ -1,0 +1,25 @@
+"""persia_tpu — a TPU-native hybrid-parallel recommender training framework.
+
+Capability parity target: openssl-sg-insights/PERSIA (100-trillion-parameter
+hybrid-parallel recommendation training). The sparse half (huge embedding
+tables keyed by u64 "signs") lives in sharded, LRU-evicting hash-map
+parameter servers on CPU hosts (C++ core, `native/ps.cpp`) and is updated
+asynchronously under a bounded-staleness semaphore; the dense half is a
+JAX/flax model trained synchronously data-parallel on a TPU mesh with XLA
+collectives (`persia_tpu/parallel`), fed by a pipelined host feeder
+(`persia_tpu/data_loader.py`).
+
+Layer map (TPU-first redesign of reference SURVEY.md §1):
+
+  user API       persia_tpu.ctx / persia_tpu.data_loader / persia_tpu.embedding.optim
+  dense engine   persia_tpu.parallel (mesh + pjit train step) + persia_tpu.models
+  host feeder    persia_tpu.data_loader (prefetch pipeline, staleness, reorder)
+  emb worker     persia_tpu.embedding.worker (dedup, routing, pooling, grad path)
+  param server   persia_tpu.embedding.store (+ native C++ core)
+  services       persia_tpu.service (RPC worker/PS processes, discovery)
+  foundation     persia_tpu.config / persia_tpu.data / persia_tpu.storage / metrics
+"""
+
+from persia_tpu.version import __version__
+
+__all__ = ["__version__"]

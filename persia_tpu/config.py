@@ -1,0 +1,249 @@
+"""Configuration layer.
+
+Parity target: the reference's two-file YAML config system
+(`/root/reference/rust/persia-embedding-config/src/lib.rs:461-650`):
+``global_config.yml`` (job type, server capacities, pipeline knobs) and
+``embedding_config.yml`` (per-slot embedding schema + feature groups).
+
+TPU-first differences: no OnceCell singletons — configs are plain frozen
+dataclasses passed explicitly; the dense-side options (mixed precision, mesh
+shape) live here too because the dense engine is JAX, not torch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+MAX_BATCH_SIZE = 65535  # u16 sample indices on the wire (ref: persia/embedding/data.py:14)
+
+
+class JobType(enum.Enum):
+    """Job type selects pipeline behavior (ref: persia-embedding-config/src/lib.rs:171-177)."""
+
+    TRAIN = "train"
+    EVAL = "eval"
+    INFER = "infer"
+
+
+@dataclass(frozen=True)
+class HashStackConfig:
+    """Multi-round hashing vocabulary compression ("hash stack").
+
+    Each id is hashed ``hash_stack_rounds`` times into ``[round * embedding_size,
+    (round+1) * embedding_size)``; the resulting rows are summed. Compresses an
+    unbounded vocabulary into ``rounds * size`` rows
+    (ref: embedding_worker_service/mod.rs:348-400).
+    """
+
+    hash_stack_rounds: int = 0
+    embedding_size: int = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.hash_stack_rounds > 0 and self.embedding_size > 0
+
+
+@dataclass(frozen=True)
+class SlotConfig:
+    """Per-feature-slot embedding schema (ref: persia-embedding-config/src/lib.rs:528-598).
+
+    - ``embedding_summation``: True → sum-pool ids per sample into one (dim,)
+      vector; False → "raw" slot returning distinct-id rows plus an index
+      layout (sequence features).
+    - ``sample_fixed_size``: raw slots pad/truncate each sample's id list to
+      this length on the device side.
+    - ``sqrt_scaling``: scale pooled output by 1/sqrt(n_ids) (and gradients
+      symmetrically).
+    - ``index_prefix``: per-slot prefix OR-ed into the top bits of every sign
+      so one global key space is partitioned across slots.
+    """
+
+    dim: int
+    name: str = ""
+    embedding_summation: bool = True
+    sqrt_scaling: bool = False
+    sample_fixed_size: int = 10
+    hash_stack_config: HashStackConfig = field(default_factory=HashStackConfig)
+    index_prefix: int = 0
+
+
+@dataclass(frozen=True)
+class EmbeddingConfig:
+    """Embedding schema: slot map + feature groups + prefix assignment
+    (ref: persia-embedding-config/src/lib.rs:528-650).
+
+    ``feature_groups`` partition slots; each group gets a distinct index
+    prefix in the top ``feature_index_prefix_bit`` bits of the u64 sign, and
+    optimizers may keep per-group state (Adam group beta powers). Slots not
+    mentioned in any group form singleton groups, in slot order.
+    """
+
+    slots_config: Dict[str, SlotConfig] = field(default_factory=dict)
+    feature_index_prefix_bit: int = 0
+    feature_groups: Dict[str, List[str]] = field(default_factory=dict)
+
+    def __post_init__(self):
+        # Fill slot names and auto-assign group index prefixes.
+        slots = {}
+        for name, slot in self.slots_config.items():
+            if slot.name != name:
+                slot = dataclasses.replace(slot, name=name)
+            slots[name] = slot
+
+        groups = dict(self.feature_groups)
+        grouped = {s for members in groups.values() for s in members}
+        for members in groups.values():
+            for member in members:
+                if member not in slots:
+                    raise ValueError(f"feature group member {member!r} not a slot")
+        for name in slots:
+            if name not in grouped:
+                if name in groups:
+                    raise ValueError(
+                        f"slot {name!r} collides with a feature group of the same "
+                        f"name but is not a member of it"
+                    )
+                groups[name] = [name]
+
+        if self.feature_index_prefix_bit > 0:
+            shift = 64 - self.feature_index_prefix_bit
+            if len(groups) >= (1 << self.feature_index_prefix_bit):
+                raise ValueError(
+                    f"{len(groups)} feature groups do not fit in "
+                    f"{self.feature_index_prefix_bit} prefix bits"
+                )
+            for group_idx, members in enumerate(groups.values()):
+                prefix = (group_idx + 1) << shift
+                for member in members:
+                    if slots[member].index_prefix == 0:
+                        slots[member] = dataclasses.replace(slots[member], index_prefix=prefix)
+
+        object.__setattr__(self, "slots_config", slots)
+        object.__setattr__(self, "feature_groups", groups)
+
+    @property
+    def slot_names(self) -> List[str]:
+        return list(self.slots_config.keys())
+
+    def slot(self, name: str) -> SlotConfig:
+        return self.slots_config[name]
+
+    def group_of(self, slot_name: str) -> int:
+        for idx, members in enumerate(self.feature_groups.values()):
+            if slot_name in members:
+                return idx
+        raise KeyError(slot_name)
+
+
+@dataclass(frozen=True)
+class HyperParameters:
+    """Runtime-pushed embedding hyperparameters
+    (ref: persia-embedding-config/src/lib.rs:99-105, persia/embedding/__init__.py:4-26)."""
+
+    emb_initialization: Tuple[float, float] = (-0.01, 0.01)
+    admit_probability: float = 1.0
+    weight_bound: float = 10.0
+
+
+@dataclass(frozen=True)
+class EmbeddingWorkerConfig:
+    """(ref: PersiaEmbeddingWorkerConfig, persia-embedding-config/src/lib.rs:461-526)"""
+
+    forward_buffer_size: int = 1000
+    buffered_data_expired_sec: int = 3600
+
+
+@dataclass(frozen=True)
+class ParameterServerConfig:
+    """(ref: PersiaEmbeddingParameterServerConfig)"""
+
+    capacity: int = 1 << 20
+    num_hashmap_internal_shards: int = 16
+    enable_incremental_update: bool = False
+    incremental_buffer_size: int = 1_000_000
+    incremental_dir: str = "/tmp/persia_tpu_inc"
+    full_amount_manager_buffer_size: int = 1000
+
+
+@dataclass(frozen=True)
+class CommonConfig:
+    job_type: JobType = JobType.TRAIN
+    checkpointing_config: Dict[str, Any] = field(default_factory=dict)
+    metrics_config: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class GlobalConfig:
+    """The ``global_config.yml`` equivalent
+    (ref: PersiaGlobalConfig, persia-embedding-config/src/lib.rs:461-526)."""
+
+    common: CommonConfig = field(default_factory=CommonConfig)
+    embedding_worker: EmbeddingWorkerConfig = field(default_factory=EmbeddingWorkerConfig)
+    parameter_server: ParameterServerConfig = field(default_factory=ParameterServerConfig)
+
+
+def _slot_from_dict(name: str, d: Dict[str, Any]) -> SlotConfig:
+    hs = d.get("hash_stack_config") or {}
+    return SlotConfig(
+        name=name,
+        dim=int(d["dim"]),
+        embedding_summation=bool(d.get("embedding_summation", True)),
+        sqrt_scaling=bool(d.get("sqrt_scaling", False)),
+        sample_fixed_size=int(d.get("sample_fixed_size", 10)),
+        hash_stack_config=HashStackConfig(
+            hash_stack_rounds=int(hs.get("hash_stack_rounds", 0)),
+            embedding_size=int(hs.get("embedding_size", 0)),
+        ),
+        index_prefix=int(d.get("index_prefix", 0)),
+    )
+
+
+def load_embedding_config(path: str) -> EmbeddingConfig:
+    """Parse an ``embedding_config.yml`` (same schema family as the reference's
+    `parse_embedding_config`, persia-embedding-config/src/lib.rs:600-650)."""
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    slots = {
+        name: _slot_from_dict(name, d) for name, d in (raw.get("slots_config") or {}).items()
+    }
+    return EmbeddingConfig(
+        slots_config=slots,
+        feature_index_prefix_bit=int(raw.get("feature_index_prefix_bit", 0)),
+        feature_groups={k: list(v) for k, v in (raw.get("feature_groups") or {}).items()},
+    )
+
+
+def load_global_config(path: str) -> GlobalConfig:
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    common = raw.get("common") or {}
+    worker = raw.get("embedding_worker") or {}
+    ps = raw.get("embedding_parameter_server") or raw.get("parameter_server") or {}
+    return GlobalConfig(
+        common=CommonConfig(
+            job_type=JobType(str(common.get("job_type", "train")).lower()),
+            checkpointing_config=common.get("checkpointing_config") or {},
+            metrics_config=common.get("metrics_config") or {},
+        ),
+        embedding_worker=EmbeddingWorkerConfig(
+            forward_buffer_size=int(worker.get("forward_buffer_size", 1000)),
+            buffered_data_expired_sec=int(worker.get("buffered_data_expired_sec", 3600)),
+        ),
+        parameter_server=ParameterServerConfig(
+            capacity=int(ps.get("capacity", 1 << 20)),
+            num_hashmap_internal_shards=int(ps.get("num_hashmap_internal_shards", 16)),
+            enable_incremental_update=bool(ps.get("enable_incremental_update", False)),
+            incremental_buffer_size=int(ps.get("incremental_buffer_size", 1_000_000)),
+            incremental_dir=str(ps.get("incremental_dir", "/tmp/persia_tpu_inc")),
+            full_amount_manager_buffer_size=int(
+                ps.get("full_amount_manager_buffer_size", 1000)
+            ),
+        ),
+    )
+
+

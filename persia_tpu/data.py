@@ -1,0 +1,272 @@
+"""Batch datatypes + binary wire format.
+
+Parity target: ``persia/embedding/data.py`` (numpy-side batch construction and
+validation; LIL sparse id lists; ``PersiaBatch.to_bytes``) and the Rust wire
+types in ``rust/persia-common/src/lib.rs:30-155``. The wire format here is a
+custom little-endian binary layout shared by Python and the C++ services
+(replacing the reference's speedy serialization).
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from persia_tpu.config import MAX_BATCH_SIZE
+
+_MAGIC = b"PTB1"
+
+_DTYPE_CODES: Dict[str, int] = {
+    "float32": 0,
+    "float64": 1,
+    "float16": 2,
+    "int8": 3,
+    "int16": 4,
+    "int32": 5,
+    "int64": 6,
+    "uint8": 7,
+    "uint16": 8,
+    "uint32": 9,
+    "uint64": 10,
+    "bool": 11,
+}
+_CODE_DTYPES = {v: np.dtype(k) for k, v in _DTYPE_CODES.items()}
+
+
+def _check_dtype(array: np.ndarray, who: str) -> None:
+    if array.dtype.name not in _DTYPE_CODES:
+        raise TypeError(f"{who}: unsupported dtype {array.dtype}")
+
+
+class IDTypeFeature:
+    """One sparse slot: a list-of-lists of u64 signs, one variable-length list
+    per sample (ref: persia/embedding/data.py:69-114)."""
+
+    def __init__(self, name: str, data: Sequence[np.ndarray]):
+        self.name = name
+        data = list(data)
+        if len(data) > MAX_BATCH_SIZE:
+            raise ValueError(f"batch_size {len(data)} exceeds MAX_BATCH_SIZE {MAX_BATCH_SIZE}")
+        for sample in data:
+            if not isinstance(sample, np.ndarray) or sample.dtype != np.uint64:
+                raise TypeError(
+                    f"IDTypeFeature {name!r}: every sample must be a np.uint64 ndarray"
+                )
+            if sample.ndim != 1:
+                raise TypeError(f"IDTypeFeature {name!r}: samples must be 1-D")
+        self.data = data
+
+    @property
+    def batch_size(self) -> int:
+        return len(self.data)
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+
+class IDTypeFeatureWithSingleID:
+    """One sparse slot where each sample has exactly one id
+    (ref: persia/embedding/data.py:116-157). Converts to the LIL form."""
+
+    def __init__(self, name: str, data: np.ndarray):
+        if not isinstance(data, np.ndarray) or data.dtype != np.uint64 or data.ndim != 1:
+            raise TypeError(
+                f"IDTypeFeatureWithSingleID {name!r}: data must be a 1-D np.uint64 ndarray"
+            )
+        if len(data) > MAX_BATCH_SIZE:
+            raise ValueError(f"batch_size {len(data)} exceeds MAX_BATCH_SIZE {MAX_BATCH_SIZE}")
+        self.name = name
+        self.data = data
+
+    @property
+    def batch_size(self) -> int:
+        return len(self.data)
+
+    def to_lil(self) -> IDTypeFeature:
+        return IDTypeFeature(self.name, [self.data[i : i + 1] for i in range(len(self.data))])
+
+
+class NdarrayDataBase:
+    """Dense ndarray payload with name + dtype validation
+    (ref: persia/embedding/data.py:160-276)."""
+
+    DEFAULT_NAME = "ndarray_base"
+
+    def __init__(self, data: np.ndarray, name: Optional[str] = None):
+        if not isinstance(data, np.ndarray):
+            raise TypeError(f"{self.DEFAULT_NAME}: data must be an ndarray")
+        _check_dtype(data, self.DEFAULT_NAME)
+        if data.ndim < 1:
+            raise TypeError(f"{self.DEFAULT_NAME}: data must have at least 1 dim")
+        if len(data) > MAX_BATCH_SIZE:
+            raise ValueError(f"batch_size {len(data)} exceeds MAX_BATCH_SIZE {MAX_BATCH_SIZE}")
+        self.data = np.ascontiguousarray(data)
+        self._name = name
+
+    @property
+    def name(self) -> str:
+        return self._name if self._name is not None else self.DEFAULT_NAME
+
+    @property
+    def batch_size(self) -> int:
+        return len(self.data)
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+
+class NonIDTypeFeature(NdarrayDataBase):
+    DEFAULT_NAME = "non_id_type_feature"
+
+
+class Label(NdarrayDataBase):
+    DEFAULT_NAME = "label"
+
+
+def _write_ndarray(buf: io.BytesIO, name: str, arr: np.ndarray) -> None:
+    name_b = name.encode()
+    buf.write(struct.pack("<H", len(name_b)))
+    buf.write(name_b)
+    buf.write(struct.pack("<BB", _DTYPE_CODES[arr.dtype.name], arr.ndim))
+    buf.write(struct.pack(f"<{arr.ndim}q", *arr.shape))
+    buf.write(arr.tobytes())
+
+
+def _read_ndarray(buf: io.BytesIO) -> Tuple[str, np.ndarray]:
+    (name_len,) = struct.unpack("<H", buf.read(2))
+    name = buf.read(name_len).decode()
+    code, ndim = struct.unpack("<BB", buf.read(2))
+    shape = struct.unpack(f"<{ndim}q", buf.read(8 * ndim))
+    dtype = _CODE_DTYPES[code]
+    n = int(np.prod(shape)) if shape else 1
+    arr = np.frombuffer(buf.read(n * dtype.itemsize), dtype=dtype).reshape(shape)
+    return name, arr
+
+
+class PersiaBatch:
+    """One training batch: sparse id slots + dense features + labels + meta
+    (ref: persia/embedding/data.py:279-411, rust/persia-core/src/data.rs:34-52).
+
+    ``requires_grad=True`` batches must carry labels (the training path needs
+    them on the NN worker; ref data.rs:228-248).
+    """
+
+    def __init__(
+        self,
+        id_type_features: Sequence[IDTypeFeature | IDTypeFeatureWithSingleID],
+        non_id_type_features: Optional[Sequence[NonIDTypeFeature]] = None,
+        labels: Optional[Sequence[Label]] = None,
+        requires_grad: bool = True,
+        batch_id: Optional[int] = None,
+        meta: Optional[bytes] = None,
+    ):
+        if len(id_type_features) == 0:
+            raise ValueError("id_type_features must be non-empty")
+        converted: List[IDTypeFeature] = []
+        for f in id_type_features:
+            if isinstance(f, IDTypeFeatureWithSingleID):
+                f = f.to_lil()
+            elif not isinstance(f, IDTypeFeature):
+                raise TypeError(f"unsupported id feature type {type(f)}")
+            converted.append(f)
+        batch_size = converted[0].batch_size
+        for f in converted:
+            if f.batch_size != batch_size:
+                raise ValueError(
+                    f"id feature {f.name!r} batch_size {f.batch_size} != {batch_size}"
+                )
+        non_id_type_features = list(non_id_type_features or [])
+        labels_list = list(labels or [])
+        for x in non_id_type_features + labels_list:
+            if x.batch_size != batch_size:
+                raise ValueError(f"{x.name!r} batch_size {x.batch_size} != {batch_size}")
+        if requires_grad and not labels_list:
+            raise ValueError("requires_grad=True batch must carry labels")
+        if batch_id is not None and batch_id < 0:
+            raise ValueError("batch_id must be non-negative")
+
+        self.id_type_features = converted
+        self.non_id_type_features = non_id_type_features
+        self.labels = labels_list
+        self.requires_grad = requires_grad
+        self.batch_id = batch_id
+        self.meta = meta
+
+    @property
+    def batch_size(self) -> int:
+        return self.id_type_features[0].batch_size
+
+    def to_bytes(self) -> bytes:
+        """Serialize to the shared wire format (ref: data.py:409-411 / data.rs:256)."""
+        buf = io.BytesIO()
+        buf.write(_MAGIC)
+        flags = 1 if self.requires_grad else 0
+        batch_id = self.batch_id if self.batch_id is not None else -1
+        meta = self.meta or b""
+        buf.write(
+            struct.pack(
+                "<BqIHHH",
+                flags,
+                batch_id,
+                len(meta),
+                len(self.id_type_features),
+                len(self.non_id_type_features),
+                len(self.labels),
+            )
+        )
+        buf.write(meta)
+        for f in self.id_type_features:
+            name_b = f.name.encode()
+            buf.write(struct.pack("<H", len(name_b)))
+            buf.write(name_b)
+            offsets = np.zeros(len(f.data) + 1, dtype=np.uint32)
+            for i, sample in enumerate(f.data):
+                offsets[i + 1] = offsets[i] + len(sample)
+            buf.write(struct.pack("<I", len(f.data)))
+            buf.write(offsets.tobytes())
+            if len(f.data):
+                values = np.concatenate(f.data) if offsets[-1] else np.empty(0, np.uint64)
+                buf.write(values.astype(np.uint64, copy=False).tobytes())
+        for x in self.non_id_type_features:
+            _write_ndarray(buf, x.name, x.data)
+        for x in self.labels:
+            _write_ndarray(buf, x.name, x.data)
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "PersiaBatch":
+        buf = io.BytesIO(raw)
+        if buf.read(4) != _MAGIC:
+            raise ValueError("bad magic: not a PersiaBatch payload")
+        flags, batch_id, meta_len, n_id, n_dense, n_label = struct.unpack(
+            "<BqIHHH", buf.read(struct.calcsize("<BqIHHH"))
+        )
+        meta = buf.read(meta_len) or None
+        id_feats = []
+        for _ in range(n_id):
+            (name_len,) = struct.unpack("<H", buf.read(2))
+            name = buf.read(name_len).decode()
+            (bs,) = struct.unpack("<I", buf.read(4))
+            offsets = np.frombuffer(buf.read(4 * (bs + 1)), dtype=np.uint32)
+            values = np.frombuffer(buf.read(8 * int(offsets[-1])), dtype=np.uint64)
+            samples = [values[offsets[i] : offsets[i + 1]] for i in range(bs)]
+            id_feats.append(IDTypeFeature(name, samples))
+        dense = []
+        for _ in range(n_dense):
+            name, arr = _read_ndarray(buf)
+            dense.append(NonIDTypeFeature(arr, name=name))
+        labels = []
+        for _ in range(n_label):
+            name, arr = _read_ndarray(buf)
+            labels.append(Label(arr, name=name))
+        return cls(
+            id_feats,
+            non_id_type_features=dense,
+            labels=labels,
+            requires_grad=bool(flags & 1),
+            batch_id=None if batch_id == -1 else batch_id,
+            meta=meta,
+        )

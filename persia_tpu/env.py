@@ -1,0 +1,56 @@
+"""Rank/replica environment parsing (ref: persia/env.py:25-132).
+
+NN workers use ``RANK/LOCAL_RANK/WORLD_SIZE``; the other roles (data-loader,
+embedding-worker, parameter-server) use ``REPLICA_INDEX/REPLICA_SIZE``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+PERSIA_SKIP_CHECK_DATA = os.environ.get("PERSIA_SKIP_CHECK_DATA", "0") == "1"
+
+
+def _get_int(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v is not None else None
+
+
+def get_rank() -> int:
+    v = _get_int("RANK")
+    if v is None:
+        raise EnvironmentError("RANK is not set")
+    return v
+
+
+def get_local_rank() -> int:
+    v = _get_int("LOCAL_RANK")
+    if v is None:
+        raise EnvironmentError("LOCAL_RANK is not set")
+    return v
+
+
+def get_world_size() -> int:
+    v = _get_int("WORLD_SIZE")
+    if v is None:
+        raise EnvironmentError("WORLD_SIZE is not set")
+    return v
+
+
+def get_replica_index() -> int:
+    v = _get_int("REPLICA_INDEX")
+    if v is None:
+        v = _get_int("RANK")
+    if v is None:
+        raise EnvironmentError("REPLICA_INDEX is not set")
+    return v
+
+
+def get_replica_size() -> int:
+    v = _get_int("REPLICA_SIZE")
+    if v is None:
+        v = _get_int("WORLD_SIZE")
+    if v is None:
+        raise EnvironmentError("REPLICA_SIZE is not set")
+    return v

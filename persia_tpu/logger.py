@@ -1,0 +1,33 @@
+"""Default logger (ref: persia/logger.py:55-93, without the colorlog dependency)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Optional
+
+_FORMAT = "%(asctime)s [%(levelname)s] %(name)s: %(message)s"
+
+
+def get_default_logger(name: str = "persia_tpu", level: Optional[str] = None) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        logger.addHandler(handler)
+        logger.setLevel((level or os.environ.get("LOG_LEVEL", "INFO")).upper())
+        logger.propagate = False
+    return logger
+
+
+def get_file_logger(name: str, path: str) -> logging.Logger:
+    logger = get_default_logger(name)
+    abspath = os.path.abspath(path)
+    for h in logger.handlers:
+        if isinstance(h, logging.FileHandler) and h.baseFilename == abspath:
+            return logger
+    handler = logging.FileHandler(path)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    logger.addHandler(handler)
+    return logger

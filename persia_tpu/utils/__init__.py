@@ -1,0 +1,44 @@
+"""Misc utilities (ref: persia/utils.py)."""
+
+from __future__ import annotations
+
+import random
+import socket
+import subprocess
+from typing import Any, Dict, List
+
+import numpy as np
+import yaml
+
+
+def setup_seed(seed: int) -> None:
+    """Seed every RNG the framework touches (ref: persia/utils.py:13-32).
+
+    JAX is functional: pass explicit ``jax.random.PRNGKey(seed)`` into model
+    init; this seeds the host-side numpy/python RNGs used by data generation
+    and admit-probability sampling.
+    """
+    random.seed(seed)
+    np.random.seed(seed)
+
+
+def load_yaml(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return yaml.safe_load(f) or {}
+
+
+def dump_yaml(content: Dict[str, Any], path: str) -> None:
+    with open(path, "w") as f:
+        yaml.safe_dump(content, f)
+
+
+def run_command(cmd: List[str], **kwargs) -> None:
+    subprocess.check_call(cmd, **kwargs)
+
+
+def find_free_port() -> int:
+    """(ref: persia/utils.py:83-91)"""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("", 0))
+        return s.getsockname()[1]

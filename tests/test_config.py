@@ -1,0 +1,88 @@
+import textwrap
+
+from persia_tpu.config import (
+    EmbeddingConfig,
+    GlobalConfig,
+    HashStackConfig,
+    JobType,
+    SlotConfig,
+    load_embedding_config,
+    load_global_config,
+)
+
+
+def test_slot_defaults():
+    cfg = EmbeddingConfig(slots_config={"age": SlotConfig(dim=8)})
+    slot = cfg.slot("age")
+    assert slot.name == "age"
+    assert slot.embedding_summation and not slot.sqrt_scaling
+    assert slot.sample_fixed_size == 10
+    assert not slot.hash_stack_config.enabled
+
+
+def test_feature_group_prefix_assignment():
+    # Two explicit groups + one implicit singleton; prefixes land in the top 8 bits
+    # and are distinct per group (ref behavior: persia-embedding-config/src/lib.rs:600-650).
+    cfg = EmbeddingConfig(
+        slots_config={
+            "a": SlotConfig(dim=4),
+            "b": SlotConfig(dim=4),
+            "c": SlotConfig(dim=4),
+        },
+        feature_index_prefix_bit=8,
+        feature_groups={"g0": ["a", "b"]},
+    )
+    pa, pb, pc = (cfg.slot(s).index_prefix for s in "abc")
+    assert pa == pb != pc
+    assert pa != 0 and pc != 0
+    assert pa >> 56 != 0 and pa & ((1 << 56) - 1) == 0
+    assert cfg.group_of("a") == cfg.group_of("b") != cfg.group_of("c")
+
+
+def test_yaml_roundtrip(tmp_path):
+    emb_yaml = tmp_path / "embedding_config.yml"
+    emb_yaml.write_text(
+        textwrap.dedent(
+            """
+            feature_index_prefix_bit: 8
+            slots_config:
+              user_id:
+                dim: 16
+              item_ids:
+                dim: 16
+                embedding_summation: false
+                sample_fixed_size: 20
+                sqrt_scaling: true
+                hash_stack_config:
+                  hash_stack_rounds: 2
+                  embedding_size: 1000
+            feature_groups:
+              ids: [user_id, item_ids]
+            """
+        )
+    )
+    cfg = load_embedding_config(str(emb_yaml))
+    assert cfg.slot("user_id").dim == 16
+    assert not cfg.slot("item_ids").embedding_summation
+    assert cfg.slot("item_ids").hash_stack_config == HashStackConfig(2, 1000)
+    assert cfg.slot("user_id").index_prefix == cfg.slot("item_ids").index_prefix != 0
+
+    glob_yaml = tmp_path / "global_config.yml"
+    glob_yaml.write_text(
+        textwrap.dedent(
+            """
+            common:
+              job_type: Train
+            embedding_worker:
+              forward_buffer_size: 123
+            embedding_parameter_server:
+              capacity: 4096
+              num_hashmap_internal_shards: 4
+            """
+        )
+    )
+    g = load_global_config(str(glob_yaml))
+    assert g.common.job_type is JobType.TRAIN
+    assert g.embedding_worker.forward_buffer_size == 123
+    assert g.parameter_server.capacity == 4096
+    assert isinstance(g, GlobalConfig)

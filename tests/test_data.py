@@ -1,0 +1,80 @@
+import numpy as np
+import pytest
+
+from persia_tpu.data import (
+    IDTypeFeature,
+    IDTypeFeatureWithSingleID,
+    Label,
+    NonIDTypeFeature,
+    PersiaBatch,
+)
+
+
+def _mk_batch(batch_size=4, requires_grad=True):
+    rng = np.random.default_rng(0)
+    ids = IDTypeFeature(
+        "clicks",
+        [rng.integers(0, 1 << 40, size=rng.integers(0, 5), dtype=np.uint64) for _ in range(batch_size)],
+    )
+    single = IDTypeFeatureWithSingleID(
+        "user", rng.integers(0, 1 << 40, size=batch_size, dtype=np.uint64)
+    )
+    dense = NonIDTypeFeature(rng.normal(size=(batch_size, 5)).astype(np.float32))
+    label = Label(rng.integers(0, 2, size=(batch_size, 1)).astype(np.float32))
+    return PersiaBatch(
+        [ids, single],
+        non_id_type_features=[dense],
+        labels=[label],
+        requires_grad=requires_grad,
+        batch_id=7,
+        meta=b"hello",
+    )
+
+
+def test_dtype_validation():
+    with pytest.raises(TypeError):
+        IDTypeFeature("x", [np.array([1, 2], dtype=np.int64)])
+    with pytest.raises(TypeError):
+        IDTypeFeatureWithSingleID("x", np.array([[1]], dtype=np.uint64))
+
+
+def test_requires_grad_needs_label():
+    ids = IDTypeFeature("f", [np.array([1], dtype=np.uint64)])
+    with pytest.raises(ValueError):
+        PersiaBatch([ids], requires_grad=True)
+    PersiaBatch([ids], requires_grad=False)  # fine
+
+
+def test_batch_size_mismatch():
+    a = IDTypeFeature("a", [np.array([1], dtype=np.uint64)] * 3)
+    b = IDTypeFeature("b", [np.array([1], dtype=np.uint64)] * 4)
+    with pytest.raises(ValueError):
+        PersiaBatch([a, b], requires_grad=False)
+
+
+def test_wire_roundtrip():
+    batch = _mk_batch()
+    raw = batch.to_bytes()
+    back = PersiaBatch.from_bytes(raw)
+    assert back.batch_id == 7
+    assert back.meta == b"hello"
+    assert back.requires_grad
+    assert [f.name for f in back.id_type_features] == ["clicks", "user"]
+    for f0, f1 in zip(batch.id_type_features, back.id_type_features):
+        assert len(f0.data) == len(f1.data)
+        for s0, s1 in zip(f0.data, f1.data):
+            np.testing.assert_array_equal(s0, s1)
+    np.testing.assert_array_equal(
+        batch.non_id_type_features[0].data, back.non_id_type_features[0].data
+    )
+    np.testing.assert_array_equal(batch.labels[0].data, back.labels[0].data)
+    # stable: serialize again → identical bytes
+    assert back.to_bytes() == raw
+
+
+def test_empty_id_lists_roundtrip():
+    ids = IDTypeFeature("empty", [np.empty(0, dtype=np.uint64)] * 2)
+    batch = PersiaBatch([ids], requires_grad=False)
+    back = PersiaBatch.from_bytes(batch.to_bytes())
+    assert back.id_type_features[0].batch_size == 2
+    assert all(len(s) == 0 for s in back.id_type_features[0].data)

@@ -1,0 +1,30 @@
+import pytest
+
+from persia_tpu import env
+
+
+def test_nn_worker_flavor(monkeypatch):
+    monkeypatch.setenv("RANK", "3")
+    monkeypatch.setenv("LOCAL_RANK", "1")
+    monkeypatch.setenv("WORLD_SIZE", "8")
+    assert env.get_rank() == 3
+    assert env.get_local_rank() == 1
+    assert env.get_world_size() == 8
+
+
+def test_replica_flavor(monkeypatch):
+    monkeypatch.delenv("RANK", raising=False)
+    monkeypatch.delenv("WORLD_SIZE", raising=False)
+    monkeypatch.setenv("REPLICA_INDEX", "2")
+    monkeypatch.setenv("REPLICA_SIZE", "4")
+    assert env.get_replica_index() == 2
+    assert env.get_replica_size() == 4
+
+
+def test_missing_raises(monkeypatch):
+    for k in ("RANK", "LOCAL_RANK", "WORLD_SIZE", "REPLICA_INDEX", "REPLICA_SIZE"):
+        monkeypatch.delenv(k, raising=False)
+    with pytest.raises(EnvironmentError):
+        env.get_rank()
+    with pytest.raises(EnvironmentError):
+        env.get_replica_index()
