@@ -17,7 +17,7 @@ import enum
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
-import yaml
+from persia_tpu.utils import load_yaml
 
 MAX_BATCH_SIZE = 65535  # u16 sample indices on the wire (ref: persia/embedding/data.py:14)
 
@@ -96,11 +96,17 @@ class EmbeddingConfig:
             slots[name] = slot
 
         groups = dict(self.feature_groups)
-        grouped = {s for members in groups.values() for s in members}
+        grouped: set = set()
         for members in groups.values():
             for member in members:
                 if member not in slots:
                     raise ValueError(f"feature group member {member!r} not a slot")
+                if member in grouped:
+                    raise ValueError(
+                        f"slot {member!r} appears in multiple feature groups; "
+                        f"groups must partition the slots"
+                    )
+                grouped.add(member)
         for name in slots:
             if name not in grouped:
                 if name in groups:
@@ -206,8 +212,7 @@ def _slot_from_dict(name: str, d: Dict[str, Any]) -> SlotConfig:
 def load_embedding_config(path: str) -> EmbeddingConfig:
     """Parse an ``embedding_config.yml`` (same schema family as the reference's
     `parse_embedding_config`, persia-embedding-config/src/lib.rs:600-650)."""
-    with open(path) as f:
-        raw = yaml.safe_load(f) or {}
+    raw = load_yaml(path)
     slots = {
         name: _slot_from_dict(name, d) for name, d in (raw.get("slots_config") or {}).items()
     }
@@ -219,8 +224,7 @@ def load_embedding_config(path: str) -> EmbeddingConfig:
 
 
 def load_global_config(path: str) -> GlobalConfig:
-    with open(path) as f:
-        raw = yaml.safe_load(f) or {}
+    raw = load_yaml(path)
     common = raw.get("common") or {}
     worker = raw.get("embedding_worker") or {}
     ps = raw.get("embedding_parameter_server") or raw.get("parameter_server") or {}

@@ -16,6 +16,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from persia_tpu.config import MAX_BATCH_SIZE
+from persia_tpu.env import skip_check_data
 
 _MAGIC = b"PTB1"
 
@@ -50,13 +51,14 @@ class IDTypeFeature:
         data = list(data)
         if len(data) > MAX_BATCH_SIZE:
             raise ValueError(f"batch_size {len(data)} exceeds MAX_BATCH_SIZE {MAX_BATCH_SIZE}")
-        for sample in data:
-            if not isinstance(sample, np.ndarray) or sample.dtype != np.uint64:
-                raise TypeError(
-                    f"IDTypeFeature {name!r}: every sample must be a np.uint64 ndarray"
-                )
-            if sample.ndim != 1:
-                raise TypeError(f"IDTypeFeature {name!r}: samples must be 1-D")
+        if not skip_check_data():
+            for sample in data:
+                if not isinstance(sample, np.ndarray) or sample.dtype != np.uint64:
+                    raise TypeError(
+                        f"IDTypeFeature {name!r}: every sample must be a np.uint64 ndarray"
+                    )
+                if sample.ndim != 1:
+                    raise TypeError(f"IDTypeFeature {name!r}: samples must be 1-D")
         self.data = data
 
     @property
@@ -142,7 +144,9 @@ def _read_ndarray(buf: io.BytesIO) -> Tuple[str, np.ndarray]:
     shape = struct.unpack(f"<{ndim}q", buf.read(8 * ndim))
     dtype = _CODE_DTYPES[code]
     n = int(np.prod(shape)) if shape else 1
-    arr = np.frombuffer(buf.read(n * dtype.itemsize), dtype=dtype).reshape(shape)
+    # copy: frombuffer views are read-only; deserialized batches must behave
+    # like locally-constructed (writable) ones
+    arr = np.frombuffer(buf.read(n * dtype.itemsize), dtype=dtype).reshape(shape).copy()
     return name, arr
 
 
@@ -204,6 +208,8 @@ class PersiaBatch:
         buf = io.BytesIO()
         buf.write(_MAGIC)
         flags = 1 if self.requires_grad else 0
+        if self.meta is not None:
+            flags |= 2
         batch_id = self.batch_id if self.batch_id is not None else -1
         meta = self.meta or b""
         buf.write(
@@ -222,11 +228,16 @@ class PersiaBatch:
             name_b = f.name.encode()
             buf.write(struct.pack("<H", len(name_b)))
             buf.write(name_b)
-            offsets = np.zeros(len(f.data) + 1, dtype=np.uint32)
+            offsets = np.zeros(len(f.data) + 1, dtype=np.int64)
             for i, sample in enumerate(f.data):
                 offsets[i + 1] = offsets[i] + len(sample)
+            if offsets[-1] > 0xFFFFFFFF:
+                raise ValueError(
+                    f"id feature {f.name!r}: {offsets[-1]} total ids exceeds the "
+                    f"u32 wire offset limit"
+                )
             buf.write(struct.pack("<I", len(f.data)))
-            buf.write(offsets.tobytes())
+            buf.write(offsets.astype(np.uint32).tobytes())
             if len(f.data):
                 values = np.concatenate(f.data) if offsets[-1] else np.empty(0, np.uint64)
                 buf.write(values.astype(np.uint64, copy=False).tobytes())
@@ -244,14 +255,15 @@ class PersiaBatch:
         flags, batch_id, meta_len, n_id, n_dense, n_label = struct.unpack(
             "<BqIHHH", buf.read(struct.calcsize("<BqIHHH"))
         )
-        meta = buf.read(meta_len) or None
+        meta = buf.read(meta_len) if flags & 2 else None
         id_feats = []
         for _ in range(n_id):
             (name_len,) = struct.unpack("<H", buf.read(2))
             name = buf.read(name_len).decode()
             (bs,) = struct.unpack("<I", buf.read(4))
             offsets = np.frombuffer(buf.read(4 * (bs + 1)), dtype=np.uint32)
-            values = np.frombuffer(buf.read(8 * int(offsets[-1])), dtype=np.uint64)
+            # copy once → per-sample slices are writable views of writable memory
+            values = np.frombuffer(buf.read(8 * int(offsets[-1])), dtype=np.uint64).copy()
             samples = [values[offsets[i] : offsets[i + 1]] for i in range(bs)]
             id_feats.append(IDTypeFeature(name, samples))
         dense = []

@@ -1,0 +1,74 @@
+"""Sign hashing, shard routing, hash-stack, and index-prefix math.
+
+Parity target: the reference's id-preprocessing hot loops
+(`embedding_worker_service/mod.rs:341-484`): ``sign_to_shard_modulo``
+(farmhash64 % replica_size), ``indices_to_hashstack_indices`` (multi-round
+vocabulary compression) and ``indices_add_prefix`` (per-slot key-space
+partitioning).
+
+Design difference: we use the splitmix64 finalizer instead of farmhash — it is
+4 instructions, has excellent avalanche behavior, and is trivially identical
+in vectorized numpy (here) and C++ (`native/ps.cpp`). All math is wrapping
+u64.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_C1 = np.uint64(0x9E3779B97F4A7C15)
+_C2 = np.uint64(0xBF58476D1CE4E5B9)
+_C3 = np.uint64(0x94D049BB133111EB)
+
+# Per-round xor seeds for the hash stack (arbitrary odd constants).
+_ROUND_SEEDS = np.array(
+    [(0x243F6A8885A308D3 + 0x9E3779B97F4A7C15 * r) & 0xFFFFFFFFFFFFFFFF for r in range(16)],
+    dtype=np.uint64,
+)
+
+
+def splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer over a u64 array (wrapping arithmetic)."""
+    x = x.astype(np.uint64, copy=True)
+    x += _C1
+    x ^= x >> np.uint64(30)
+    x *= _C2
+    x ^= x >> np.uint64(27)
+    x *= _C3
+    x ^= x >> np.uint64(31)
+    return x
+
+
+def sign_to_shard(signs: np.ndarray, num_shards: int) -> np.ndarray:
+    """Route each sign to a PS replica (ref: mod.rs:342-345)."""
+    return (splitmix64(signs) % np.uint64(num_shards)).astype(np.int64)
+
+
+def hash_stack(signs: np.ndarray, rounds: int, embedding_size: int) -> np.ndarray:
+    """Expand each sign into ``rounds`` compressed table keys.
+
+    Round ``r`` maps a sign into ``[r * embedding_size, (r+1) * embedding_size)``;
+    the caller sums the rows of all rounds (ref: mod.rs:348-400). Returns shape
+    ``(len(signs), rounds)``.
+    """
+    out = np.empty((len(signs), rounds), dtype=np.uint64)
+    for r in range(rounds):
+        h = splitmix64(signs ^ _ROUND_SEEDS[r])
+        out[:, r] = h % np.uint64(embedding_size) + np.uint64(r * embedding_size)
+    return out
+
+
+def add_index_prefix(signs: np.ndarray, prefix: int, prefix_bit: int) -> np.ndarray:
+    """Partition one global key space across slots by OR-ing a per-slot prefix
+    into the top ``prefix_bit`` bits (ref: mod.rs:403-429)."""
+    if prefix == 0 or prefix_bit == 0:
+        return signs.astype(np.uint64, copy=False)
+    mask = np.uint64((1 << (64 - prefix_bit)) - 1)
+    return (signs.astype(np.uint64) & mask) | np.uint64(prefix)
+
+
+def seed_for_sign(sign: int, base_seed: int = 0) -> int:
+    """Deterministic per-sign RNG seed for reproducible embedding init
+    (ref: emb_entry.rs:28-60 seeds the entry RNG by sign)."""
+    arr = np.array([np.uint64(sign) ^ np.uint64(base_seed)], dtype=np.uint64)
+    return int(splitmix64(arr)[0])

@@ -1,0 +1,175 @@
+"""Sparse (embedding) optimizers.
+
+Two halves:
+
+- User-facing config classes ``SGD`` / ``Adagrad`` / ``Adam`` mirroring
+  ``persia/embedding/optim.py`` — these are declarative descriptions shipped to
+  the parameter servers at context entry.
+- The ``Optimizable`` implementations used by the numpy reference store
+  (`persia_tpu/embedding/store.py`), mirroring the reference trait
+  ``Optimizable {update, require_space, state_initialization,
+  get_batch_level_state}`` (`rust/persia-common/src/optim.rs:66-92`) and its
+  SIMD kernels (`rust/persia-simd/src/lib.rs`). The C++ core implements the
+  same math; tests assert parity against these.
+
+All state lives *inside the embedding entry* as a trailing f32 block
+(``[emb | state]``), exactly like the reference's ``HashMapEmbeddingEntry``
+(`persia-embedding-holder/src/emb_entry.rs:16-76`), so LRU eviction and
+checkpointing move optimizer state together with the weights for free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+import numpy as np
+
+OPTIMIZER_SGD = 0
+OPTIMIZER_ADAGRAD = 1
+OPTIMIZER_ADAM = 2
+
+
+@dataclass(frozen=True)
+class OptimizerConfig:
+    """Wire-level optimizer description registered to every PS
+    (ref: rust/persia-core/src/optim.rs:61-66)."""
+
+    kind: int
+    lr: float = 0.01
+    weight_decay: float = 0.0
+    # adagrad
+    initialization: float = 0.01
+    g_square_momentum: float = 1.0
+    eps: float = 1e-10
+    vectorwise_shared: bool = False
+    # adam
+    beta1: float = 0.9
+    beta2: float = 0.999
+
+    def state_dim(self, dim: int) -> int:
+        if self.kind == OPTIMIZER_SGD:
+            return 0
+        if self.kind == OPTIMIZER_ADAGRAD:
+            return 1 if self.vectorwise_shared else dim
+        if self.kind == OPTIMIZER_ADAM:
+            return 2 * dim
+        raise ValueError(f"unknown optimizer kind {self.kind}")
+
+    def init_state(self, dim: int) -> np.ndarray:
+        n = self.state_dim(dim)
+        if self.kind == OPTIMIZER_ADAGRAD:
+            return np.full(n, self.initialization, dtype=np.float32)
+        return np.zeros(n, dtype=np.float32)
+
+    def update_dense(
+        self,
+        emb: np.ndarray,
+        state: np.ndarray,
+        grad: np.ndarray,
+        batch_state: Tuple[float, float],
+    ) -> None:
+        """In-place update of one entry. ``batch_state`` = accumulated
+        (beta1^t, beta2^t) for Adam (ref: optim.rs:99-221 keeps these per
+        feature group, advanced once per batch)."""
+        if self.kind == OPTIMIZER_SGD:
+            # ref: NaiveSGD (optim.rs:223-244) / decayed_sgd_avx2 (simd:124)
+            if self.weight_decay:
+                grad = grad + self.weight_decay * emb
+            emb -= self.lr * grad
+        elif self.kind == OPTIMIZER_ADAGRAD:
+            # ref: Adagrad incl. vectorwise shared (optim.rs:246-307),
+            # decayed_adagrad_avx2 (simd:21-122)
+            if self.weight_decay:
+                grad = grad + self.weight_decay * emb
+            if self.vectorwise_shared:
+                g2 = float(np.mean(grad * grad))
+                state[0] = state[0] * self.g_square_momentum + g2
+                emb -= self.lr * grad / np.sqrt(state[0] + self.eps)
+            else:
+                state *= self.g_square_momentum
+                state += (grad * grad).astype(np.float32)
+                emb -= self.lr * grad / np.sqrt(state + self.eps)
+        elif self.kind == OPTIMIZER_ADAM:
+            # ref: Adam with per-group accumulated beta powers (optim.rs:99-221),
+            # adam_avx2 (simd:147)
+            dim = emb.shape[0]
+            m = state[:dim]
+            v = state[dim:]
+            m *= self.beta1
+            m += (1.0 - self.beta1) * grad
+            v *= self.beta2
+            v += (1.0 - self.beta2) * grad * grad
+            beta1_pow, beta2_pow = batch_state
+            m_hat = m / (1.0 - beta1_pow)
+            v_hat = v / (1.0 - beta2_pow)
+            emb -= self.lr * m_hat / (np.sqrt(v_hat) + self.eps)
+        else:
+            raise ValueError(f"unknown optimizer kind {self.kind}")
+
+    def advance_batch_state(self, prev: Tuple[float, float]) -> Tuple[float, float]:
+        if self.kind != OPTIMIZER_ADAM:
+            return prev
+        return (prev[0] * self.beta1, prev[1] * self.beta2)
+
+    def initial_batch_state(self) -> Tuple[float, float]:
+        return (1.0, 1.0)
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "OptimizerConfig":
+        return cls(**d)
+
+
+class SGD:
+    """User-facing sparse SGD (ref: persia/embedding/optim.py:19-41)."""
+
+    def __init__(self, lr: float = 0.01, weight_decay: float = 0.0):
+        self.config = OptimizerConfig(OPTIMIZER_SGD, lr=lr, weight_decay=weight_decay)
+
+
+class Adagrad:
+    """User-facing sparse Adagrad (ref: persia/embedding/optim.py:60-96;
+    ``vectorwise_shared`` shares one accumulator per embedding vector)."""
+
+    def __init__(
+        self,
+        lr: float = 0.01,
+        weight_decay: float = 0.0,
+        initialization: float = 0.01,
+        g_square_momentum: float = 1.0,
+        eps: float = 1e-10,
+        vectorwise_shared: bool = False,
+    ):
+        self.config = OptimizerConfig(
+            OPTIMIZER_ADAGRAD,
+            lr=lr,
+            weight_decay=weight_decay,
+            initialization=initialization,
+            g_square_momentum=g_square_momentum,
+            eps=eps,
+            vectorwise_shared=vectorwise_shared,
+        )
+
+
+class Adam:
+    """User-facing sparse Adam (ref: persia/embedding/optim.py:43-58)."""
+
+    def __init__(
+        self,
+        lr: float = 0.001,
+        betas: Tuple[float, float] = (0.9, 0.999),
+        weight_decay: float = 0.0,
+        eps: float = 1e-8,
+    ):
+        self.config = OptimizerConfig(
+            OPTIMIZER_ADAM,
+            lr=lr,
+            beta1=betas[0],
+            beta2=betas[1],
+            weight_decay=weight_decay,
+            eps=eps,
+        )

@@ -1,0 +1,239 @@
+"""Embedding parameter store — numpy reference implementation.
+
+Parity target: the reference's embedding-parameter-server core:
+
+- sharded LRU store ``PersiaEmbeddingHolder = Sharded<EvictionMap>``
+  (`persia-embedding-holder/src/{sharded.rs,eviction_map.rs,array_linked_list.rs}`)
+- entry layout ``[emb | optimizer state]`` in one flat f32 vector with
+  seeded-by-sign init (`emb_entry.rs:16-76`)
+- lookup semantics: train → LRU touch, miss → admit-probability gate + init;
+  dim mismatch → re-init; infer → zeros on miss
+  (`embedding_parameter_service/mod.rs:162-262`)
+- gradient path: optimizer update + weight-bound clamp
+  (`embedding_parameter_service/mod.rs:359-427`)
+
+This Python implementation is the *golden model*: slow but obviously correct.
+The C++ core (`native/ps.cpp`, wrapped by
+``persia_tpu.embedding.native_store``) implements identical math and is
+asserted equal in ``tests/test_native_store.py``.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from persia_tpu.config import HyperParameters
+from persia_tpu.embedding.hashing import splitmix64
+from persia_tpu.embedding.optim import OptimizerConfig
+
+
+class _Shard:
+    """One internal shard: an insertion-ordered dict used as an O(1) LRU
+    (Python-dict equivalent of the reference's hashmap + array-linked-list
+    ``EvictionMap``, eviction_map.rs:11-107)."""
+
+    __slots__ = ("entries", "capacity")
+
+    def __init__(self, capacity: int):
+        self.entries: Dict[int, np.ndarray] = {}
+        self.capacity = capacity
+
+    def get_refresh(self, sign: int) -> Optional[np.ndarray]:
+        e = self.entries.pop(sign, None)
+        if e is not None:
+            self.entries[sign] = e  # reinsert → most-recently-used
+        return e
+
+    def get(self, sign: int) -> Optional[np.ndarray]:
+        return self.entries.get(sign)
+
+    def insert(self, sign: int, entry: np.ndarray) -> None:
+        if sign in self.entries:
+            self.entries.pop(sign)
+        elif len(self.entries) >= self.capacity:
+            self.entries.pop(next(iter(self.entries)))  # evict LRU
+        self.entries[sign] = entry
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+
+class EmbeddingStore:
+    """One parameter-server replica's store (numpy golden model).
+
+    ``lookup``/``update_gradients`` operate on one slot's worth of signs at a
+    time (single dim); the worker tier groups requests per slot and per
+    replica before calling.
+    """
+
+    def __init__(
+        self,
+        capacity: int = 1 << 20,
+        num_internal_shards: int = 8,
+        hyperparams: HyperParameters = HyperParameters(),
+        optimizer: Optional[OptimizerConfig] = None,
+        seed: int = 0,
+    ):
+        if num_internal_shards <= 0 or capacity <= 0:
+            raise ValueError("capacity and num_internal_shards must be positive")
+        per_shard = max(1, capacity // num_internal_shards)
+        self._shards = [_Shard(per_shard) for _ in range(num_internal_shards)]
+        self._num_shards = num_internal_shards
+        self.hyperparams = hyperparams
+        self.optimizer = optimizer
+        self.seed = seed
+        # Adam per-feature-group accumulated beta powers (ref: optim.rs:99-221).
+        self._batch_state: Dict[int, Tuple[float, float]] = {}
+
+    # ------------------------------------------------------------------ util
+
+    def configure(self, hyperparams: HyperParameters) -> None:
+        self.hyperparams = hyperparams
+
+    def register_optimizer(self, optimizer: OptimizerConfig) -> None:
+        self.optimizer = optimizer
+        self._batch_state.clear()
+
+    def _shard_of(self, sign: int) -> _Shard:
+        h = int(splitmix64(np.array([sign ^ 0xA5A5A5A5], dtype=np.uint64))[0])
+        return self._shards[h % self._num_shards]
+
+    def _init_entry(self, sign: int, dim: int) -> np.ndarray:
+        lo, hi = self.hyperparams.emb_initialization
+        rng = np.random.default_rng(
+            int(splitmix64(np.array([sign], dtype=np.uint64) ^ np.uint64(self.seed))[0])
+        )
+        entry = np.empty(dim + self._state_dim(dim), dtype=np.float32)
+        entry[:dim] = rng.uniform(lo, hi, size=dim).astype(np.float32)
+        if self.optimizer is not None:
+            entry[dim:] = self.optimizer.init_state(dim)
+        return entry
+
+    def _state_dim(self, dim: int) -> int:
+        return self.optimizer.state_dim(dim) if self.optimizer is not None else 0
+
+    def _admit(self, sign: int) -> bool:
+        p = self.hyperparams.admit_probability
+        if p >= 1.0:
+            return True
+        if p <= 0.0:
+            return False
+        h = int(splitmix64(np.array([sign ^ 0xC0FFEE], dtype=np.uint64))[0])
+        return (h % (1 << 24)) / float(1 << 24) < p
+
+    # ---------------------------------------------------------------- lookup
+
+    def lookup(self, signs: np.ndarray, dim: int, train: bool) -> np.ndarray:
+        """Fetch ``(len(signs), dim)`` embedding rows.
+
+        Train: LRU-touch hits; misses pass the admit gate then get a seeded
+        init (or zeros if rejected). Infer: zeros on miss, no touch, no admit
+        (ref: embedding_parameter_service/mod.rs:162-262).
+        """
+        out = np.zeros((len(signs), dim), dtype=np.float32)
+        entry_len = dim + self._state_dim(dim)
+        for i, s in enumerate(signs.tolist()):
+            shard = self._shard_of(s)
+            if train:
+                entry = shard.get_refresh(s)
+                if entry is None or len(entry) != entry_len:
+                    if entry is None and not self._admit(s):
+                        continue
+                    entry = self._init_entry(s, dim)
+                    shard.insert(s, entry)
+                out[i] = entry[:dim]
+            else:
+                entry = shard.get(s)
+                if entry is not None and len(entry) >= dim:
+                    out[i] = entry[:dim]
+        return out
+
+    # -------------------------------------------------------------- gradient
+
+    def advance_batch_state(self, group: int) -> None:
+        """Advance Adam's per-group beta powers once per gradient batch."""
+        if self.optimizer is None:
+            return
+        prev = self._batch_state.get(group, self.optimizer.initial_batch_state())
+        self._batch_state[group] = self.optimizer.advance_batch_state(prev)
+
+    def update_gradients(self, signs: np.ndarray, grads: np.ndarray, group: int = 0) -> None:
+        """Apply the registered sparse optimizer to each sign's entry, then
+        clamp to ±weight_bound (ref: embedding_parameter_service/mod.rs:359-427).
+        Signs never seen (evicted or never admitted) are skipped
+        (``gradient_id_miss_count`` in the reference)."""
+        if self.optimizer is None:
+            raise RuntimeError("no optimizer registered")
+        if grads.shape[0] != len(signs):
+            raise ValueError("signs/grads length mismatch")
+        dim = grads.shape[1]
+        entry_len = dim + self._state_dim(dim)
+        batch_state = self._batch_state.get(group, self.optimizer.advance_batch_state(
+            self.optimizer.initial_batch_state()
+        ))
+        bound = self.hyperparams.weight_bound
+        for i, s in enumerate(signs.tolist()):
+            shard = self._shard_of(s)
+            entry = shard.get_refresh(s)
+            if entry is None or len(entry) != entry_len:
+                continue
+            self.optimizer.update_dense(entry[:dim], entry[dim:], grads[i], batch_state)
+            if bound > 0:
+                np.clip(entry[:dim], -bound, bound, out=entry[:dim])
+
+    # ------------------------------------------------------------ management
+
+    def set_embedding(self, signs: np.ndarray, values: np.ndarray) -> None:
+        """Insert raw entries (checkpoint re-shard path; ref mod.rs set_embedding).
+        ``values`` rows are full entries ``[emb | state]``."""
+        for i, s in enumerate(signs.tolist()):
+            self._shard_of(s).insert(s, values[i].astype(np.float32).copy())
+
+    def get_embedding_entry(self, sign: int) -> Optional[np.ndarray]:
+        return self._shard_of(sign).get(sign)
+
+    def clear(self) -> None:
+        for shard in self._shards:
+            shard.entries.clear()
+        self._batch_state.clear()
+
+    def size(self) -> int:
+        return sum(len(s) for s in self._shards)
+
+    @property
+    def num_internal_shards(self) -> int:
+        return self._num_shards
+
+    # ---------------------------------------------------------- serialization
+
+    def dump_shard(self, shard_idx: int) -> bytes:
+        """Serialize one internal shard (checkpoint unit, ref:
+        model-manager:242-343 dumps per internal shard)."""
+        shard = self._shards[shard_idx]
+        buf = io.BytesIO()
+        buf.write(struct.pack("<I", len(shard.entries)))
+        for sign, entry in shard.entries.items():
+            buf.write(struct.pack("<QI", sign, len(entry)))
+            buf.write(entry.tobytes())
+        return buf.getvalue()
+
+    def load_shard_bytes(self, raw: bytes) -> int:
+        """Load entries (routed by sign, so files from any shard layout work —
+        the re-shard-on-load path, ref: emb_worker:1150-1259)."""
+        buf = io.BytesIO(raw)
+        (n,) = struct.unpack("<I", buf.read(4))
+        for _ in range(n):
+            sign, ln = struct.unpack("<QI", buf.read(12))
+            entry = np.frombuffer(buf.read(4 * ln), dtype=np.float32).copy()
+            self._shard_of(sign).insert(sign, entry)
+        return n
+
+    def state_dict(self) -> Dict:
+        return {
+            "num_internal_shards": self._num_shards,
+            "batch_state": dict(self._batch_state),
+        }

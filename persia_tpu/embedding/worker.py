@@ -1,0 +1,352 @@
+"""Embedding-worker tier: id preprocessing, sharded lookup, pooling
+postprocess, and the gradient-return path.
+
+Parity target: ``rust/persia-embedding-server/src/embedding_worker_service/``:
+
+- preprocess: hashstack + index prefix + dedup + shard-by-sign
+  (`mod.rs:341-484`, `persia-common/src/lib.rs:30-83`)
+- postprocess: sum-pooling with optional sqrt scaling, or "raw" distinct-row
+  layout for sequence slots (`mod.rs:486-629`)
+- gradient path: NaN skip, AMP scale-factor division, sqrt scaling, per-sign
+  accumulation, shard-by-sign update fan-out (`mod.rs:703-872`)
+- train buffers + bounded staleness (`mod.rs:632-701,991-1129`)
+
+TPU-first differences: everything is vectorized numpy on the worker host (the
+C++ service wraps the same routines); "raw" slots ship distinct rows plus an
+index matrix so the TPU gathers/scatters with static shapes, and the gradient
+for raw slots arrives already reduced per distinct row (the device's autodiff
+does the scatter-add via XLA, replacing torch ``index_add_``).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from persia_tpu.config import EmbeddingConfig, HyperParameters, SlotConfig
+from persia_tpu.data import IDTypeFeature, PersiaBatch
+from persia_tpu.embedding.hashing import add_index_prefix, hash_stack, sign_to_shard
+from persia_tpu.embedding.store import EmbeddingStore
+
+
+@dataclass
+class ProcessedSlot:
+    """One slot after preprocessing: table keys + dedup layout."""
+
+    config: SlotConfig
+    batch_size: int
+    counts: np.ndarray  # (B,) ids per sample (pre-truncation for pooled; truncated for raw)
+    sample_of_id: np.ndarray  # (n_ids,) sample index of each id
+    distinct: np.ndarray  # (D,) distinct original signs (prefix applied, pre-hashstack)
+    inverse: np.ndarray  # (n_ids,) position of each id in ``distinct``
+    keys: np.ndarray  # (D * rounds,) actual table keys (post-hashstack), row-major per distinct id
+    rounds: int  # hash-stack rounds (1 = disabled)
+
+    @property
+    def name(self) -> str:
+        return self.config.name
+
+    @property
+    def num_distinct(self) -> int:
+        return len(self.distinct)
+
+
+@dataclass
+class ProcessedBatch:
+    slots: List[ProcessedSlot]
+    batch_size: int
+    batch_id: Optional[int] = None
+    created_at: float = field(default_factory=time.time)
+
+
+@dataclass
+class SumEmbeddingBatch:
+    """Pooled slot output: one (B, dim) array (ref: FeatureEmbeddingBatch::Sum,
+    persia-common/src/lib.rs:85-113)."""
+
+    name: str
+    pooled: np.ndarray  # (B, dim) f32
+
+
+@dataclass
+class RawEmbeddingBatch:
+    """Sequence slot output (ref: FeatureEmbeddingBatch::Raw).
+
+    ``index`` holds positions into ``distinct`` padded with ``len(distinct)``;
+    the device side appends one zero row to ``distinct`` so gathers of padding
+    produce zeros and autodiff sends padding gradients to the throwaway row.
+    """
+
+    name: str
+    distinct: np.ndarray  # (D, dim) f32
+    index: np.ndarray  # (B, sample_fixed_size) int32, pad value == D
+    sample_id_num: np.ndarray  # (B,) int32
+
+
+FeatureEmbeddingBatch = Union[SumEmbeddingBatch, RawEmbeddingBatch]
+
+
+def preprocess_slot(
+    feature: IDTypeFeature, config: SlotConfig, prefix_bit: int
+) -> ProcessedSlot:
+    """Dedup + prefix + hashstack for one slot (ref: mod.rs:341-484,
+    lib.rs:30-83). Dedup runs on original (prefixed) signs; hashstack expands
+    each *distinct* sign into ``rounds`` table keys whose rows are summed."""
+    counts = np.fromiter((len(s) for s in feature.data), count=len(feature.data), dtype=np.int64)
+    flat = (
+        np.concatenate(feature.data).astype(np.uint64)
+        if counts.sum()
+        else np.empty(0, np.uint64)
+    )
+    flat = add_index_prefix(flat, config.index_prefix, prefix_bit)
+    sample_of_id = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
+    distinct, inverse = np.unique(flat, return_inverse=True)
+    hs = config.hash_stack_config
+    if hs.enabled:
+        rounds = hs.hash_stack_rounds
+        keys = hash_stack(distinct, rounds, hs.embedding_size).reshape(-1)
+        keys = add_index_prefix(keys, config.index_prefix, prefix_bit)
+    else:
+        rounds = 1
+        keys = distinct
+    return ProcessedSlot(
+        config=config,
+        batch_size=len(counts),
+        counts=counts,
+        sample_of_id=sample_of_id,
+        distinct=distinct,
+        inverse=inverse.astype(np.int64),
+        keys=keys,
+        rounds=rounds,
+    )
+
+
+def preprocess_batch(
+    id_type_features: Sequence[IDTypeFeature],
+    embedding_config: EmbeddingConfig,
+    batch_id: Optional[int] = None,
+) -> ProcessedBatch:
+    slots = []
+    for f in id_type_features:
+        cfg = embedding_config.slot(f.name)
+        slots.append(preprocess_slot(f, cfg, embedding_config.feature_index_prefix_bit))
+    bs = slots[0].batch_size if slots else 0
+    return ProcessedBatch(slots=slots, batch_size=bs, batch_id=batch_id)
+
+
+class ShardedLookup:
+    """Routes table keys across PS replicas and reassembles responses
+    (ref: AllEmbeddingServerClient + lookup_batched_all_slots, mod.rs:139-339,
+    448-629). ``replicas`` are store-like objects (in-process stores or RPC
+    clients exposing the same methods)."""
+
+    def __init__(self, replicas: Sequence):
+        if not replicas:
+            raise ValueError("need at least one PS replica")
+        self.replicas = list(replicas)
+
+    def lookup(self, keys: np.ndarray, dim: int, train: bool) -> np.ndarray:
+        n = len(self.replicas)
+        if n == 1:
+            return self.replicas[0].lookup(keys, dim, train)
+        shard = sign_to_shard(keys, n)
+        out = np.zeros((len(keys), dim), dtype=np.float32)
+        for r in range(n):
+            mask = shard == r
+            if mask.any():
+                out[mask] = self.replicas[r].lookup(keys[mask], dim, train)
+        return out
+
+    def update(self, keys: np.ndarray, grads: np.ndarray, group: int) -> None:
+        n = len(self.replicas)
+        for r in range(n):
+            self.replicas[r].advance_batch_state(group)
+        if n == 1:
+            self.replicas[0].update_gradients(keys, grads, group)
+            return
+        shard = sign_to_shard(keys, n)
+        for r in range(n):
+            mask = shard == r
+            if mask.any():
+                self.replicas[r].update_gradients(keys[mask], grads[mask], group)
+
+
+def _distinct_rows(
+    slot: ProcessedSlot, lookup: ShardedLookup, train: bool
+) -> np.ndarray:
+    """Fetch (D, dim) rows for a slot's distinct signs, summing hash-stack
+    rounds (ref: mod.rs:348-400)."""
+    dim = slot.config.dim
+    rows = lookup.lookup(slot.keys, dim, train)
+    if slot.rounds > 1:
+        rows = rows.reshape(slot.num_distinct, slot.rounds, dim).sum(axis=1)
+    return rows
+
+
+def lookup_slot(
+    slot: ProcessedSlot, lookup: ShardedLookup, train: bool
+) -> FeatureEmbeddingBatch:
+    """Lookup + postprocess one slot (ref: mod.rs:486-629)."""
+    dim = slot.config.dim
+    rows = _distinct_rows(slot, lookup, train)
+    if slot.config.embedding_summation:
+        pooled = np.zeros((slot.batch_size, dim), dtype=np.float32)
+        if len(slot.sample_of_id):
+            np.add.at(pooled, slot.sample_of_id, rows[slot.inverse])
+        if slot.config.sqrt_scaling:
+            scale = 1.0 / np.sqrt(np.maximum(slot.counts, 1)).astype(np.float32)
+            pooled *= scale[:, None]
+        return SumEmbeddingBatch(slot.name, pooled)
+
+    L = slot.config.sample_fixed_size
+    D = slot.num_distinct
+    index = np.full((slot.batch_size, L), D, dtype=np.int32)
+    sample_id_num = np.minimum(slot.counts, L).astype(np.int32)
+    pos = 0
+    for b, c in enumerate(slot.counts.tolist()):
+        take = min(c, L)
+        index[b, :take] = slot.inverse[pos : pos + take]
+        pos += c
+    if slot.config.sqrt_scaling:
+        rows = rows / np.sqrt(np.maximum(D, 1)).astype(np.float32)
+    return RawEmbeddingBatch(slot.name, rows, index, sample_id_num)
+
+
+def slot_gradient_to_keys(
+    slot: ProcessedSlot, grad: np.ndarray, scale_factor: float = 1.0
+) -> Optional[np.ndarray]:
+    """Convert a slot's device gradient into per-table-key gradients
+    (ref: update_all_batched_gradients, mod.rs:703-872).
+
+    Pooled slots: ``grad`` is (B, dim) — every id in sample b receives
+    ``grad[b]`` (sum-pool distributes), accumulated per distinct sign.
+    Raw slots: ``grad`` is (D, dim), already reduced per distinct row by the
+    device's autodiff scatter. Hash-stack keys each receive the distinct id's
+    gradient (sum of rows distributes). Non-finite gradients skip the whole
+    slot (NaN-skip, mod.rs:716-744). Returns (len(keys), dim) or None if
+    skipped.
+    """
+    if not np.isfinite(grad).all():
+        return None
+    grad = grad.astype(np.float32)
+    if scale_factor != 1.0:
+        grad = grad / np.float32(scale_factor)
+    dim = slot.config.dim
+    if slot.config.embedding_summation:
+        if slot.config.sqrt_scaling:
+            scale = 1.0 / np.sqrt(np.maximum(slot.counts, 1)).astype(np.float32)
+            grad = grad * scale[:, None]
+        per_distinct = np.zeros((slot.num_distinct, dim), dtype=np.float32)
+        if len(slot.sample_of_id):
+            np.add.at(per_distinct, slot.inverse, grad[slot.sample_of_id])
+    else:
+        if grad.shape[0] != slot.num_distinct:
+            raise ValueError(
+                f"raw slot {slot.name!r}: grad rows {grad.shape[0]} != distinct {slot.num_distinct}"
+            )
+        per_distinct = grad
+        if slot.config.sqrt_scaling:
+            per_distinct = per_distinct / np.sqrt(
+                np.maximum(slot.num_distinct, 1)
+            ).astype(np.float32)
+    if slot.rounds > 1:
+        per_key = np.repeat(per_distinct, slot.rounds, axis=0)
+    else:
+        per_key = per_distinct
+    return per_key
+
+
+class EmbeddingWorker:
+    """Stateful worker tier: train-path buffers + bounded staleness accounting
+    (ref: EmbeddingWorkerInner, mod.rs:632-701,991-1129).
+
+    The *staleness semaphore itself* lives in the NN-worker feeder
+    (``persia_tpu/data_loader.py``); this counter mirrors the reference's
+    server-side gauge.
+    """
+
+    def __init__(
+        self,
+        embedding_config: EmbeddingConfig,
+        replicas: Sequence,
+        hyperparams: HyperParameters = HyperParameters(),
+        forward_buffer_size: int = 1000,
+        buffered_data_expired_sec: int = 3600,
+    ):
+        self.embedding_config = embedding_config
+        self.lookup_router = ShardedLookup(replicas)
+        self.hyperparams = hyperparams
+        self.forward_buffer_size = forward_buffer_size
+        self.buffered_data_expired_sec = buffered_data_expired_sec
+        self.forward_id_buffer: Dict[int, ProcessedBatch] = {}
+        self.post_forward_buffer: Dict[int, ProcessedBatch] = {}
+        self.staleness = 0
+        self._ref_id = 0
+
+    # -------------------------------------------------- data-loader side API
+
+    def can_forward_batched(self) -> bool:
+        """Backpressure + expiry of stale buffered batches (ref: mod.rs:991-1029)."""
+        now = time.time()
+        expired = [
+            k
+            for k, v in self.forward_id_buffer.items()
+            if now - v.created_at > self.buffered_data_expired_sec
+        ]
+        for k in expired:
+            del self.forward_id_buffer[k]
+        return len(self.forward_id_buffer) < self.forward_buffer_size
+
+    def put_forward_ids(self, batch: PersiaBatch) -> int:
+        """Buffer a batch's preprocessed ids, return the remote ref id
+        (ref: forward_batched NATS entry, mod.rs:1512-1530)."""
+        self._ref_id += 1
+        ref = self._ref_id
+        processed = preprocess_batch(
+            batch.id_type_features, self.embedding_config, batch_id=batch.batch_id
+        )
+        self.forward_id_buffer[ref] = processed
+        return ref
+
+    # ----------------------------------------------------- nn-worker side API
+
+    def forward_batch_id(self, ref: int, train: bool = True) -> List[FeatureEmbeddingBatch]:
+        """Train path: take buffered ids, lookup, stash for the gradient
+        round-trip (ref: mod.rs:1031-1074)."""
+        processed = self.forward_id_buffer.pop(ref)
+        out = [lookup_slot(s, self.lookup_router, train) for s in processed.slots]
+        if train:
+            self.post_forward_buffer[ref] = processed
+            self.staleness += 1
+        return out
+
+    def forward_directly(
+        self, batch: PersiaBatch, train: bool = False
+    ) -> List[FeatureEmbeddingBatch]:
+        """Lookup-direct path for eval/infer (ref: mod.rs:1076-1107)."""
+        processed = preprocess_batch(batch.id_type_features, self.embedding_config)
+        return [lookup_slot(s, self.lookup_router, train) for s in processed.slots]
+
+    def update_gradient_batched(
+        self, ref: int, slot_grads: Dict[str, np.ndarray], scale_factor: float = 1.0
+    ) -> Dict[str, int]:
+        """Gradient return: pop the stashed layout, convert device grads to
+        per-key grads, fan out to PS replicas (ref: mod.rs:1109-1129,703-872).
+        Returns per-slot skip info for metrics."""
+        processed = self.post_forward_buffer.pop(ref)
+        self.staleness = max(0, self.staleness - 1)
+        skipped = {}
+        for slot in processed.slots:
+            grad = slot_grads.get(slot.name)
+            if grad is None:
+                continue
+            per_key = slot_gradient_to_keys(slot, grad, scale_factor)
+            if per_key is None:
+                skipped[slot.name] = 1
+                continue
+            group = self.embedding_config.group_of(slot.name)
+            self.lookup_router.update(slot.keys, per_key, group)
+        return skipped

@@ -9,7 +9,10 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-PERSIA_SKIP_CHECK_DATA = os.environ.get("PERSIA_SKIP_CHECK_DATA", "0") == "1"
+def skip_check_data() -> bool:
+    """When set, batch datatypes skip per-sample validation on the hot ingest
+    path (ref: persia/env.py:13)."""
+    return os.environ.get("PERSIA_SKIP_CHECK_DATA", "0") == "1"
 
 
 def _get_int(name: str) -> Optional[int]:

@@ -18,6 +18,8 @@ def get_default_logger(name: str = "persia_tpu", level: Optional[str] = None) ->
         logger.addHandler(handler)
         logger.setLevel((level or os.environ.get("LOG_LEVEL", "INFO")).upper())
         logger.propagate = False
+    elif level is not None:
+        logger.setLevel(level.upper())
     return logger
 
 

@@ -1,0 +1,62 @@
+import numpy as np
+
+from persia_tpu.embedding.hashing import (
+    add_index_prefix,
+    hash_stack,
+    seed_for_sign,
+    sign_to_shard,
+    splitmix64,
+)
+
+
+def _splitmix64_scalar(x: int) -> int:
+    """Scalar reference (canonical splitmix64 next())."""
+    mask = 0xFFFFFFFFFFFFFFFF
+    x = (x + 0x9E3779B97F4A7C15) & mask
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & mask
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & mask
+    return x ^ (x >> 31)
+
+
+def test_splitmix64_golden():
+    # Canonical first output of splitmix64 seeded with 0.
+    out = splitmix64(np.array([0, 1, 0xDEADBEEF], dtype=np.uint64))
+    assert out[0] == np.uint64(0xE220A8397B1DCDAF)
+    # Vectorized impl must match the scalar reference everywhere.
+    for i, x in enumerate([0, 1, 0xDEADBEEF]):
+        assert int(out[i]) == _splitmix64_scalar(x)
+
+
+def test_shard_routing_uniform_and_stable():
+    rng = np.random.default_rng(0)
+    signs = rng.integers(0, 1 << 63, size=20000, dtype=np.uint64)
+    shards = sign_to_shard(signs, 8)
+    assert shards.min() >= 0 and shards.max() < 8
+    counts = np.bincount(shards, minlength=8)
+    assert counts.min() > 20000 / 8 * 0.9  # roughly uniform
+    np.testing.assert_array_equal(shards, sign_to_shard(signs, 8))
+
+
+def test_hash_stack_ranges():
+    signs = np.arange(100, dtype=np.uint64)
+    keys = hash_stack(signs, rounds=3, embedding_size=1000)
+    assert keys.shape == (100, 3)
+    for r in range(3):
+        assert (keys[:, r] >= r * 1000).all() and (keys[:, r] < (r + 1) * 1000).all()
+    # rounds differ from each other (vocabulary is multi-hashed)
+    assert (keys[:, 0] % 1000 != keys[:, 1] % 1000).any()
+
+
+def test_index_prefix_partitions():
+    signs = np.array([0, 1, (1 << 60) + 5], dtype=np.uint64)
+    prefix = 3 << 56
+    out = add_index_prefix(signs, prefix, 8)
+    assert (out >> np.uint64(56) == 3).all()
+    # lower bits preserved
+    assert out[1] & np.uint64((1 << 56) - 1) == 1
+
+
+def test_seed_for_sign_deterministic():
+    assert seed_for_sign(42, 7) == seed_for_sign(42, 7)
+    assert seed_for_sign(42, 7) != seed_for_sign(43, 7)
+    assert seed_for_sign(42, 7) != seed_for_sign(42, 8)

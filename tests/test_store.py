@@ -1,0 +1,153 @@
+import numpy as np
+import pytest
+
+from persia_tpu.config import HyperParameters
+from persia_tpu.embedding.optim import Adagrad, Adam, OptimizerConfig, SGD
+from persia_tpu.embedding.store import EmbeddingStore
+
+
+def _store(optimizer=None, **kw):
+    defaults = dict(capacity=1024, num_internal_shards=4, seed=5)
+    defaults.update(kw)
+    return EmbeddingStore(optimizer=optimizer or SGD(lr=0.1).config, **defaults)
+
+
+def test_seeded_init_deterministic():
+    s1, s2 = _store(), _store()
+    signs = np.array([1, 2, 3], dtype=np.uint64)
+    a = s1.lookup(signs, 8, train=True)
+    b = s2.lookup(signs, 8, train=True)
+    np.testing.assert_array_equal(a, b)
+    lo, hi = HyperParameters().emb_initialization
+    assert (a >= lo).all() and (a <= hi).all()
+    # different signs get different rows
+    assert not np.array_equal(a[0], a[1])
+
+
+def test_infer_zeros_on_miss_and_no_admit():
+    s = _store()
+    signs = np.array([10, 11], dtype=np.uint64)
+    out = s.lookup(signs, 4, train=False)
+    np.testing.assert_array_equal(out, np.zeros((2, 4)))
+    assert s.size() == 0  # infer lookups never insert
+    s.lookup(signs, 4, train=True)
+    assert s.size() == 2
+    out2 = s.lookup(signs, 4, train=False)
+    assert (out2 != 0).any()
+
+
+def test_lru_eviction():
+    s = EmbeddingStore(capacity=4, num_internal_shards=1, optimizer=SGD().config)
+    signs = np.arange(4, dtype=np.uint64)
+    s.lookup(signs, 2, train=True)
+    # touch sign 0 so it is most-recently-used
+    s.lookup(np.array([0], dtype=np.uint64), 2, train=True)
+    # inserting 2 more evicts signs 1 and 2 (LRU order), not 0
+    s.lookup(np.array([100, 101], dtype=np.uint64), 2, train=True)
+    assert s.size() == 4
+    assert s.get_embedding_entry(0) is not None
+    assert s.get_embedding_entry(1) is None
+    assert s.get_embedding_entry(2) is None
+
+
+def test_dim_mismatch_reinit():
+    s = _store()
+    signs = np.array([7], dtype=np.uint64)
+    s.lookup(signs, 4, train=True)
+    out = s.lookup(signs, 8, train=True)  # dim change → re-init
+    assert out.shape == (1, 8)
+    assert len(s.get_embedding_entry(7)) == 8  # SGD: no state
+
+
+def test_admit_probability_gate():
+    hp0 = HyperParameters(admit_probability=0.0)
+    s = _store(hyperparams=hp0)
+    out = s.lookup(np.arange(50, dtype=np.uint64), 4, train=True)
+    np.testing.assert_array_equal(out, 0)
+    assert s.size() == 0
+    hp_half = HyperParameters(admit_probability=0.5)
+    s2 = _store(hyperparams=hp_half)
+    s2.lookup(np.arange(2000, dtype=np.uint64), 4, train=True)
+    assert 800 < s2.size() < 1025  # ~half admitted (capped by capacity 1024)
+
+
+def test_sgd_update_golden():
+    s = _store(optimizer=SGD(lr=0.5, weight_decay=0.0).config)
+    signs = np.array([3], dtype=np.uint64)
+    w0 = s.lookup(signs, 4, train=True).copy()
+    g = np.ones((1, 4), dtype=np.float32)
+    s.update_gradients(signs, g)
+    w1 = s.lookup(signs, 4, train=True)
+    np.testing.assert_allclose(w1, w0 - 0.5 * g, rtol=1e-6)
+
+
+def test_adagrad_update_golden():
+    opt = Adagrad(lr=1.0, initialization=0.0, g_square_momentum=1.0, eps=0.0).config
+    s = _store(optimizer=opt)
+    signs = np.array([3], dtype=np.uint64)
+    w0 = s.lookup(signs, 4, train=True).copy()
+    g = np.full((1, 4), 2.0, dtype=np.float32)
+    s.update_gradients(signs, g)
+    # accum = 4; step = lr * g / sqrt(accum) = 1*2/2 = 1
+    w1 = s.lookup(signs, 4, train=True)
+    np.testing.assert_allclose(w1, w0 - 1.0, rtol=1e-5)
+
+
+def test_adagrad_vectorwise_shared_state():
+    opt = Adagrad(lr=1.0, initialization=0.0, vectorwise_shared=True, eps=0.0).config
+    s = _store(optimizer=opt)
+    signs = np.array([9], dtype=np.uint64)
+    s.lookup(signs, 4, train=True)
+    entry = s.get_embedding_entry(9)
+    assert len(entry) == 5  # 4 emb + 1 shared accumulator
+    g = np.array([[1.0, 2.0, 3.0, 4.0]], dtype=np.float32)
+    s.update_gradients(signs, g)
+    # shared accum = mean(g^2) = (1+4+9+16)/4 = 7.5
+    np.testing.assert_allclose(s.get_embedding_entry(9)[4], 7.5, rtol=1e-6)
+
+
+def test_adam_matches_reference_formula():
+    opt = Adam(lr=0.1, betas=(0.9, 0.999), eps=1e-8).config
+    s = _store(optimizer=opt)
+    signs = np.array([11], dtype=np.uint64)
+    w0 = s.lookup(signs, 2, train=True).copy()
+    g = np.array([[0.5, -0.5]], dtype=np.float32)
+    s.advance_batch_state(0)
+    s.update_gradients(signs, g, group=0)
+    m = 0.1 * g  # (1-b1)*g
+    v = 0.001 * g * g
+    m_hat = m / (1 - 0.9)
+    v_hat = v / (1 - 0.999)
+    expect = w0 - 0.1 * m_hat / (np.sqrt(v_hat) + 1e-8)
+    np.testing.assert_allclose(s.lookup(signs, 2, train=True), expect, rtol=1e-5)
+
+
+def test_weight_bound_clamp():
+    hp = HyperParameters(weight_bound=0.05)
+    s = _store(optimizer=SGD(lr=10.0).config, hyperparams=hp)
+    signs = np.array([4], dtype=np.uint64)
+    s.lookup(signs, 4, train=True)
+    s.update_gradients(signs, np.ones((1, 4), dtype=np.float32))
+    w = s.lookup(signs, 4, train=True)
+    assert (np.abs(w) <= 0.05 + 1e-7).all()
+
+
+def test_update_skips_missing_signs():
+    s = _store()
+    # never looked up → no entry → update silently skipped
+    s.update_gradients(np.array([999], dtype=np.uint64), np.ones((1, 4), np.float32))
+    assert s.size() == 0
+
+
+def test_dump_load_roundtrip_and_reshard():
+    s = _store()
+    signs = np.arange(100, dtype=np.uint64)
+    w = s.lookup(signs, 4, train=True)
+    blobs = [s.dump_shard(i) for i in range(s.num_internal_shards)]
+    # load into a store with a different internal shard count (re-shard path)
+    s2 = EmbeddingStore(
+        capacity=1024, num_internal_shards=7, optimizer=SGD().config, seed=5
+    )
+    total = sum(s2.load_shard_bytes(b) for b in blobs)
+    assert total == 100
+    np.testing.assert_array_equal(s2.lookup(signs, 4, train=False), w)

@@ -1,0 +1,218 @@
+import numpy as np
+import pytest
+
+from persia_tpu.config import EmbeddingConfig, HashStackConfig, SlotConfig
+from persia_tpu.data import IDTypeFeature, Label, PersiaBatch
+from persia_tpu.embedding.optim import SGD
+from persia_tpu.embedding.store import EmbeddingStore
+from persia_tpu.embedding.worker import (
+    EmbeddingWorker,
+    RawEmbeddingBatch,
+    ShardedLookup,
+    SumEmbeddingBatch,
+    preprocess_batch,
+    preprocess_slot,
+)
+
+
+def _cfg(**slot_kw):
+    slots = {
+        "pooled": SlotConfig(dim=4, **slot_kw),
+        "seq": SlotConfig(dim=4, embedding_summation=False, sample_fixed_size=3),
+    }
+    return EmbeddingConfig(slots_config=slots)
+
+
+def _ids(name, lists):
+    return IDTypeFeature(name, [np.array(l, dtype=np.uint64) for l in lists])
+
+
+def _stores(n=1, **kw):
+    return [
+        EmbeddingStore(capacity=4096, num_internal_shards=2, optimizer=SGD(lr=0.5).config, seed=3, **kw)
+        for _ in range(n)
+    ]
+
+
+def test_preprocess_dedup():
+    cfg = _cfg()
+    f = _ids("pooled", [[1, 2, 2], [2, 3], []])
+    slot = preprocess_slot(f, cfg.slot("pooled"), 0)
+    assert slot.num_distinct == 3  # {1,2,3}
+    np.testing.assert_array_equal(slot.counts, [3, 2, 0])
+    np.testing.assert_array_equal(slot.sample_of_id, [0, 0, 0, 1, 1])
+    # inverse maps flat ids back to distinct
+    np.testing.assert_array_equal(slot.distinct[slot.inverse], [1, 2, 2, 2, 3])
+
+
+def test_pooled_lookup_matches_bruteforce():
+    cfg = _cfg()
+    stores = _stores()
+    router = ShardedLookup(stores)
+    f = _ids("pooled", [[1, 2, 2], [3], []])
+    slot = preprocess_slot(f, cfg.slot("pooled"), 0)
+    from persia_tpu.embedding.worker import lookup_slot
+
+    out = lookup_slot(slot, router, train=True)
+    assert isinstance(out, SumEmbeddingBatch)
+    # brute force: lookup each id's row and sum
+    def row(s):
+        return stores[0].lookup(np.array([s], dtype=np.uint64), 4, train=False)[0]
+
+    np.testing.assert_allclose(out.pooled[0], row(1) + 2 * row(2), rtol=1e-6)
+    np.testing.assert_allclose(out.pooled[1], row(3), rtol=1e-6)
+    np.testing.assert_array_equal(out.pooled[2], 0)
+
+
+def test_sqrt_scaling():
+    cfg = _cfg(sqrt_scaling=True)
+    stores = _stores()
+    router = ShardedLookup(stores)
+    f = _ids("pooled", [[1, 2, 3, 4]])
+    slot = preprocess_slot(f, cfg.slot("pooled"), 0)
+    from persia_tpu.embedding.worker import lookup_slot
+
+    out = lookup_slot(slot, router, train=True)
+    raw_sum = sum(
+        stores[0].lookup(np.array([s], dtype=np.uint64), 4, train=False)[0]
+        for s in (1, 2, 3, 4)
+    )
+    np.testing.assert_allclose(out.pooled[0], raw_sum / 2.0, rtol=1e-6)
+
+
+def test_raw_slot_layout():
+    cfg = _cfg()
+    stores = _stores()
+    router = ShardedLookup(stores)
+    f = _ids("seq", [[5, 6], [7, 5, 6, 9], []])  # sample 1 truncated to 3
+    slot = preprocess_slot(f, cfg.slot("seq"), 0)
+    from persia_tpu.embedding.worker import lookup_slot
+
+    out = lookup_slot(slot, router, train=True)
+    assert isinstance(out, RawEmbeddingBatch)
+    D = out.distinct.shape[0]
+    assert D == 4  # {5,6,7,9}
+    np.testing.assert_array_equal(out.sample_id_num, [2, 3, 0])
+    assert out.index.shape == (3, 3)
+    # padding points at D (device appends zero row there)
+    assert out.index[0, 2] == D and (out.index[2] == D).all()
+    # gather reproduces per-id rows
+    np.testing.assert_allclose(
+        out.distinct[out.index[0, 0]],
+        stores[0].lookup(np.array([5], dtype=np.uint64), 4, train=False)[0],
+    )
+
+
+def test_sharded_routing_invariant():
+    """Lookup through 3 replicas must agree with 1 replica (same seed)."""
+    cfg = _cfg()
+    f = _ids("pooled", [[11, 22, 33, 44, 55]])
+    slot = preprocess_slot(f, cfg.slot("pooled"), 0)
+    from persia_tpu.embedding.worker import lookup_slot
+
+    one = lookup_slot(slot, ShardedLookup(_stores(1)), train=True)
+    three = lookup_slot(slot, ShardedLookup(_stores(3)), train=True)
+    np.testing.assert_allclose(one.pooled, three.pooled, rtol=1e-6)
+
+
+def test_hashstack_compresses_vocab():
+    slots = {
+        "hs": SlotConfig(
+            dim=4, hash_stack_config=HashStackConfig(hash_stack_rounds=2, embedding_size=10)
+        )
+    }
+    cfg = EmbeddingConfig(slots_config=slots)
+    stores = _stores()
+    f = _ids("hs", [[123456789, 987654321]])
+    slot = preprocess_slot(f, cfg.slot("hs"), 0)
+    assert slot.rounds == 2
+    assert len(slot.keys) == 4  # 2 distinct × 2 rounds
+    assert (slot.keys < 20).all()  # keys live in the compressed range
+    from persia_tpu.embedding.worker import lookup_slot
+
+    out = lookup_slot(slot, ShardedLookup(stores), train=True)
+    # pooled row = sum of both rounds' rows for both ids
+    rows = stores[0].lookup(slot.keys, 4, train=False)
+    np.testing.assert_allclose(out.pooled[0], rows.sum(axis=0), rtol=1e-6, atol=1e-7)
+
+
+def test_end_to_end_gradient_path():
+    """forward_batch_id → update_gradient_batched moves weights the SGD way."""
+    cfg = _cfg()
+    stores = _stores()
+    worker = EmbeddingWorker(cfg, stores)
+    batch = PersiaBatch(
+        [_ids("pooled", [[1, 2], [2]]), _ids("seq", [[5], [6, 7]])],
+        labels=[Label(np.zeros((2, 1), dtype=np.float32))],
+        requires_grad=True,
+    )
+    ref = worker.put_forward_ids(batch)
+    assert worker.can_forward_batched()
+    out = worker.forward_batch_id(ref, train=True)
+    assert worker.staleness == 1
+    pooled_before = dict(
+        (s, stores[0].lookup(np.array([s], dtype=np.uint64), 4, False)[0].copy())
+        for s in (1, 2, 5, 6, 7)
+    )
+    # pooled grad (B, dim); raw grad (D, dim)
+    raw = next(o for o in out if isinstance(o, RawEmbeddingBatch))
+    g_pooled = np.ones((2, 4), dtype=np.float32)
+    g_raw = np.ones((raw.distinct.shape[0], 4), dtype=np.float32)
+    skipped = worker.update_gradient_batched(ref, {"pooled": g_pooled, "seq": g_raw})
+    assert skipped == {} and worker.staleness == 0
+    # sign 2 appears in both samples → grad 2, lr 0.5 → moved by 1.0
+    after2 = stores[0].lookup(np.array([2], dtype=np.uint64), 4, False)[0]
+    np.testing.assert_allclose(after2, pooled_before[2] - 0.5 * 2.0, rtol=1e-5)
+    after1 = stores[0].lookup(np.array([1], dtype=np.uint64), 4, False)[0]
+    np.testing.assert_allclose(after1, pooled_before[1] - 0.5, rtol=1e-5)
+    # raw slot signs each moved by lr*1
+    after5 = stores[0].lookup(np.array([5], dtype=np.uint64), 4, False)[0]
+    np.testing.assert_allclose(after5, pooled_before[5] - 0.5, rtol=1e-5)
+
+
+def test_nan_grad_skips_slot():
+    cfg = _cfg()
+    stores = _stores()
+    worker = EmbeddingWorker(cfg, stores)
+    batch = PersiaBatch(
+        [_ids("pooled", [[1]]), _ids("seq", [[5]])],
+        labels=[Label(np.zeros((1, 1), dtype=np.float32))],
+        requires_grad=True,
+    )
+    ref = worker.put_forward_ids(batch)
+    worker.forward_batch_id(ref)
+    before = stores[0].lookup(np.array([1], dtype=np.uint64), 4, False)[0].copy()
+    g = np.full((1, 4), np.nan, dtype=np.float32)
+    skipped = worker.update_gradient_batched(ref, {"pooled": g})
+    assert skipped == {"pooled": 1}
+    np.testing.assert_array_equal(
+        stores[0].lookup(np.array([1], dtype=np.uint64), 4, False)[0], before
+    )
+
+
+def test_backpressure():
+    cfg = _cfg()
+    worker = EmbeddingWorker(cfg, _stores(), forward_buffer_size=2)
+    batch = PersiaBatch([_ids("pooled", [[1]]), _ids("seq", [[2]])], requires_grad=False)
+    worker.put_forward_ids(batch)
+    assert worker.can_forward_batched()
+    worker.put_forward_ids(batch)
+    assert not worker.can_forward_batched()
+
+
+def test_scale_factor_division():
+    cfg = _cfg()
+    stores = _stores()
+    worker = EmbeddingWorker(cfg, stores)
+    batch = PersiaBatch(
+        [_ids("pooled", [[1]]), _ids("seq", [[5]])],
+        labels=[Label(np.zeros((1, 1), dtype=np.float32))],
+        requires_grad=True,
+    )
+    ref = worker.put_forward_ids(batch)
+    worker.forward_batch_id(ref)
+    before = stores[0].lookup(np.array([1], dtype=np.uint64), 4, False)[0].copy()
+    g = np.full((1, 4), 8.0, dtype=np.float32)
+    worker.update_gradient_batched(ref, {"pooled": g}, scale_factor=8.0)
+    after = stores[0].lookup(np.array([1], dtype=np.uint64), 4, False)[0]
+    np.testing.assert_allclose(after, before - 0.5 * 1.0, rtol=1e-5)
