@@ -1,0 +1,564 @@
+// persia_tpu native parameter-server core.
+//
+// Capability parity with the reference's Rust embedding-parameter-server stack:
+//   - sharded LRU embedding holder  (persia-embedding-holder/src/{sharded,eviction_map,
+//     array_linked_list}.rs): here an open-addressing hash table per internal shard
+//     with backward-shift deletion + an intrusive doubly-linked LRU over an entry slab.
+//   - entry layout [emb | optimizer state] in one flat float vector with
+//     seeded-by-sign deterministic init (emb_entry.rs:16-76).
+//   - lookup/update semantics (embedding_parameter_service/mod.rs:162-262,359-427):
+//     train lookup LRU-touches, admits misses behind a probability gate, re-inits on
+//     dim mismatch; infer lookup returns zeros on miss; gradient update applies the
+//     registered sparse optimizer then clamps to ±weight_bound.
+//   - sparse optimizers SGD / Adagrad(+vectorwise shared) / Adam(+per-group beta
+//     powers) (persia-common/src/optim.rs, persia-simd/src/lib.rs). The inner loops
+//     are written to auto-vectorize under -O3 -mavx2 -mfma.
+//
+// Exact numeric contract with the Python golden model
+// (persia_tpu/embedding/store.py): identical splitmix64 shard routing, admit gate,
+// counter-mode uniform init, and per-element update formulas. Parity is asserted in
+// tests/test_native_store.py.
+//
+// C ABI only (ctypes-friendly); no Python headers needed.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+#include <map>
+#include <mutex>
+#include <new>
+#include <vector>
+
+namespace {
+
+// ----------------------------------------------------------------- hashing
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+// ---------------------------------------------------------------- optimizer
+
+enum OptKind { OPT_NONE = -1, OPT_SGD = 0, OPT_ADAGRAD = 1, OPT_ADAM = 2 };
+
+struct OptimizerConfig {
+  int kind = OPT_NONE;
+  float lr = 0.01f;
+  float weight_decay = 0.f;
+  float initialization = 0.01f;  // adagrad accumulator init
+  float g_square_momentum = 1.f;
+  float eps = 1e-10f;
+  int vectorwise_shared = 0;
+  float beta1 = 0.9f;
+  float beta2 = 0.999f;
+
+  uint32_t state_dim(uint32_t dim) const {
+    switch (kind) {
+      case OPT_SGD: return 0;
+      case OPT_ADAGRAD: return vectorwise_shared ? 1 : dim;
+      case OPT_ADAM: return 2 * dim;
+      default: return 0;
+    }
+  }
+};
+
+// ------------------------------------------------------------------- shard
+
+struct Entry {
+  uint64_t sign;
+  float* data;     // [emb | state], heap-owned
+  uint32_t len;
+  int32_t prev, next;  // LRU list links (entry slab indices)
+};
+
+struct Shard {
+  // open-addressing table: table_sign/table_slot parallel arrays, pow2 size
+  std::vector<uint64_t> table_sign;
+  std::vector<int32_t> table_slot;  // -1 = empty, else index into entries
+  std::vector<Entry> entries;
+  std::vector<int32_t> free_list;
+  int32_t lru_head = -1;  // most recently used
+  int32_t lru_tail = -1;  // least recently used
+  size_t count = 0;
+  size_t max_entries = 0;
+  size_t mask = 0;
+  std::mutex mu;
+
+  void init(size_t cap) {
+    max_entries = cap ? cap : 1;
+    size_t tsize = 4;
+    while (tsize < max_entries * 2) tsize <<= 1;
+    table_sign.assign(tsize, 0);
+    table_slot.assign(tsize, -1);
+    mask = tsize - 1;
+    entries.reserve(max_entries);
+  }
+
+  inline size_t home(uint64_t sign) const { return splitmix64(sign) & mask; }
+
+  // returns table position of sign or SIZE_MAX
+  size_t find_pos(uint64_t sign) const {
+    size_t i = home(sign);
+    while (table_slot[i] >= 0) {
+      if (table_sign[i] == sign) return i;
+      i = (i + 1) & mask;
+    }
+    return SIZE_MAX;
+  }
+
+  void lru_unlink(int32_t e) {
+    Entry& en = entries[e];
+    if (en.prev >= 0) entries[en.prev].next = en.next; else lru_head = en.next;
+    if (en.next >= 0) entries[en.next].prev = en.prev; else lru_tail = en.prev;
+    en.prev = en.next = -1;
+  }
+
+  void lru_push_front(int32_t e) {
+    Entry& en = entries[e];
+    en.prev = -1;
+    en.next = lru_head;
+    if (lru_head >= 0) entries[lru_head].prev = e;
+    lru_head = e;
+    if (lru_tail < 0) lru_tail = e;
+  }
+
+  void touch(int32_t e) {
+    if (lru_head == e) return;
+    lru_unlink(e);
+    lru_push_front(e);
+  }
+
+  // backward-shift deletion at table position pos (linear probing invariant kept)
+  void erase_table_pos(size_t i) {
+    size_t j = i;
+    for (;;) {
+      table_slot[i] = -1;
+      size_t k;
+      for (;;) {
+        j = (j + 1) & mask;
+        if (table_slot[j] < 0) return;
+        k = home(table_sign[j]);
+        // move j back to i unless j's home lies cyclically in (i, j]
+        bool home_in_range = (i <= j) ? (i < k && k <= j) : (i < k || k <= j);
+        if (!home_in_range) break;
+      }
+      table_sign[i] = table_sign[j];
+      table_slot[i] = table_slot[j];
+      i = j;
+    }
+  }
+
+  void remove_entry(int32_t e) {
+    size_t pos = find_pos(entries[e].sign);
+    if (pos != SIZE_MAX) erase_table_pos(pos);
+    lru_unlink(e);
+    std::free(entries[e].data);
+    entries[e].data = nullptr;
+    free_list.push_back(e);
+    --count;
+  }
+
+  void evict_lru() {
+    if (lru_tail >= 0) remove_entry(lru_tail);
+  }
+
+  // insert new sign (must not exist); returns entry index with uninit data ptr
+  int32_t insert(uint64_t sign, uint32_t len) {
+    if (count >= max_entries) evict_lru();
+    int32_t e;
+    if (!free_list.empty()) {
+      e = free_list.back();
+      free_list.pop_back();
+    } else {
+      entries.push_back(Entry{});
+      e = (int32_t)entries.size() - 1;
+    }
+    Entry& en = entries[e];
+    en.sign = sign;
+    en.len = len;
+    en.data = (float*)std::malloc(sizeof(float) * len);
+    en.prev = en.next = -1;
+    size_t i = home(sign);
+    while (table_slot[i] >= 0) i = (i + 1) & mask;
+    table_sign[i] = sign;
+    table_slot[i] = e;
+    lru_push_front(e);
+    ++count;
+    return e;
+  }
+
+  ~Shard() {
+    for (auto& en : entries)
+      if (en.data) std::free(en.data);
+  }
+};
+
+// ------------------------------------------------------------------- store
+
+struct Store {
+  std::vector<Shard> shards;
+  uint32_t num_shards;
+  uint64_t seed;
+  // hyperparameters (configure())
+  double init_lo = -0.01, init_hi = 0.01;
+  double admit_prob = 1.0;
+  float weight_bound = 10.f;
+  OptimizerConfig opt;
+  std::map<int, std::pair<double, double>> batch_state;  // group -> (b1^t, b2^t)
+  std::mutex batch_mu;
+
+  Store(uint64_t capacity, uint32_t n_shards, uint64_t seed_) : shards(n_shards) {
+    num_shards = n_shards;
+    seed = seed_;
+    size_t per = capacity / n_shards;
+    if (per < 1) per = 1;
+    for (auto& s : shards) s.init(per);
+  }
+
+  inline Shard& shard_of(uint64_t sign) {
+    // identical to the Python golden model: splitmix64(sign ^ 0xA5A5A5A5) % n
+    return shards[splitmix64(sign ^ 0xA5A5A5A5ULL) % num_shards];
+  }
+
+  inline bool admit(uint64_t sign) const {
+    if (admit_prob >= 1.0) return true;
+    if (admit_prob <= 0.0) return false;
+    uint64_t h = splitmix64(sign ^ 0xC0FFEEULL);
+    return (double)(h % (1ULL << 24)) / (double)(1ULL << 24) < admit_prob;
+  }
+
+  // counter-mode uniform init, bit-identical to hashing.uniform_init_for_sign
+  void init_embedding(uint64_t sign, uint32_t dim, float* out) const {
+    uint64_t base = splitmix64(sign ^ seed);
+    double range = init_hi - init_lo;
+    for (uint32_t i = 0; i < dim; ++i) {
+      uint64_t s = splitmix64(base + i);
+      double u = (double)(s >> 11) * (1.0 / 9007199254740992.0);  // 2^53
+      out[i] = (float)(init_lo + u * range);
+    }
+  }
+
+  void init_state(uint32_t dim, float* state) const {
+    uint32_t sd = opt.state_dim(dim);
+    if (opt.kind == OPT_ADAGRAD) {
+      for (uint32_t i = 0; i < sd; ++i) state[i] = opt.initialization;
+    } else {
+      std::memset(state, 0, sizeof(float) * sd);
+    }
+  }
+
+  std::pair<double, double> get_batch_state(int group) {
+    std::lock_guard<std::mutex> g(batch_mu);
+    auto it = batch_state.find(group);
+    if (it != batch_state.end()) return it->second;
+    // default: one advance from (1,1) — matches the Python store
+    return {(double)opt.beta1, (double)opt.beta2};
+  }
+
+  void advance_batch_state(int group) {
+    if (opt.kind != OPT_ADAM) return;
+    std::lock_guard<std::mutex> g(batch_mu);
+    auto it = batch_state.find(group);
+    if (it == batch_state.end()) {
+      batch_state[group] = {(double)opt.beta1, (double)opt.beta2};
+    } else {
+      it->second.first *= opt.beta1;
+      it->second.second *= opt.beta2;
+    }
+  }
+
+  void update_entry(float* emb, float* state, const float* grad_in, uint32_t dim,
+                    std::pair<double, double> bs) {
+    switch (opt.kind) {
+      case OPT_SGD: {
+        const float lr = opt.lr, wd = opt.weight_decay;
+        if (wd != 0.f) {
+          for (uint32_t i = 0; i < dim; ++i) emb[i] -= lr * (grad_in[i] + wd * emb[i]);
+        } else {
+          for (uint32_t i = 0; i < dim; ++i) emb[i] -= lr * grad_in[i];
+        }
+        break;
+      }
+      case OPT_ADAGRAD: {
+        const float lr = opt.lr, wd = opt.weight_decay, mom = opt.g_square_momentum,
+                    eps = opt.eps;
+        if (opt.vectorwise_shared) {
+          // shared accumulator = mean(g^2); double accumulation like numpy
+          double g2 = 0.0;
+          for (uint32_t i = 0; i < dim; ++i) {
+            float g = grad_in[i] + (wd != 0.f ? wd * emb[i] : 0.f);
+            g2 += (double)g * (double)g;
+          }
+          g2 /= (double)dim;
+          state[0] = state[0] * mom + (float)g2;
+          float denom = std::sqrt(state[0] + eps);
+          for (uint32_t i = 0; i < dim; ++i) {
+            float g = grad_in[i] + (wd != 0.f ? wd * emb[i] : 0.f);
+            emb[i] -= lr * g / denom;
+          }
+        } else {
+          for (uint32_t i = 0; i < dim; ++i) {
+            float g = grad_in[i] + (wd != 0.f ? wd * emb[i] : 0.f);
+            state[i] = state[i] * mom + g * g;
+            emb[i] -= lr * g / std::sqrt(state[i] + eps);
+          }
+        }
+        break;
+      }
+      case OPT_ADAM: {
+        const float lr = opt.lr, wd = opt.weight_decay, b1 = opt.beta1, b2 = opt.beta2,
+                    eps = opt.eps;
+        float* m = state;
+        float* v = state + dim;
+        const float bc1 = (float)(1.0 - bs.first);
+        const float bc2 = (float)(1.0 - bs.second);
+        for (uint32_t i = 0; i < dim; ++i) {
+          float g = grad_in[i] + (wd != 0.f ? wd * emb[i] : 0.f);
+          m[i] = b1 * m[i] + (1.f - b1) * g;
+          v[i] = b2 * v[i] + (1.f - b2) * g * g;
+          float m_hat = m[i] / bc1;
+          float v_hat = v[i] / bc2;
+          emb[i] -= lr * m_hat / (std::sqrt(v_hat) + eps);
+        }
+        break;
+      }
+      default:
+        break;
+    }
+    if (weight_bound > 0.f) {
+      const float b = weight_bound;
+      for (uint32_t i = 0; i < dim; ++i) {
+        if (emb[i] > b) emb[i] = b;
+        else if (emb[i] < -b) emb[i] = -b;
+      }
+    }
+  }
+};
+
+}  // namespace
+
+// ------------------------------------------------------------------- C API
+
+extern "C" {
+
+void* ps_create(uint64_t capacity, uint32_t num_shards, uint64_t seed) {
+  if (capacity == 0 || num_shards == 0) return nullptr;
+  return new (std::nothrow) Store(capacity, num_shards, seed);
+}
+
+void ps_destroy(void* h) { delete (Store*)h; }
+
+void ps_configure(void* h, double init_lo, double init_hi, double admit_prob,
+                  float weight_bound) {
+  Store* s = (Store*)h;
+  s->init_lo = init_lo;
+  s->init_hi = init_hi;
+  s->admit_prob = admit_prob;
+  s->weight_bound = weight_bound;
+}
+
+void ps_register_optimizer(void* h, int kind, float lr, float weight_decay,
+                           float initialization, float g_square_momentum, float eps,
+                           int vectorwise_shared, float beta1, float beta2) {
+  Store* s = (Store*)h;
+  s->opt = OptimizerConfig{kind, lr, weight_decay, initialization, g_square_momentum,
+                           eps, vectorwise_shared, beta1, beta2};
+  std::lock_guard<std::mutex> g(s->batch_mu);
+  s->batch_state.clear();
+}
+
+uint32_t ps_num_shards(void* h) { return ((Store*)h)->num_shards; }
+
+// out: (n, dim) row-major f32
+void ps_lookup(void* h, const uint64_t* signs, int64_t n, uint32_t dim, int train,
+               float* out) {
+  Store* s = (Store*)h;
+  const uint32_t entry_len = dim + s->opt.state_dim(dim);
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t sign = signs[i];
+    Shard& sh = s->shard_of(sign);
+    std::lock_guard<std::mutex> g(sh.mu);
+    size_t pos = sh.find_pos(sign);
+    float* row = out + (size_t)i * dim;
+    if (train) {
+      int32_t e = (pos == SIZE_MAX) ? -1 : sh.table_slot[pos];
+      if (e >= 0 && sh.entries[e].len == entry_len) {
+        sh.touch(e);
+        std::memcpy(row, sh.entries[e].data, sizeof(float) * dim);
+      } else {
+        if (e >= 0) {
+          sh.remove_entry(e);  // dim mismatch → re-init
+        } else if (!s->admit(sign)) {
+          std::memset(row, 0, sizeof(float) * dim);
+          continue;
+        }
+        int32_t ne = sh.insert(sign, entry_len);
+        float* data = sh.entries[ne].data;
+        s->init_embedding(sign, dim, data);
+        s->init_state(dim, data + dim);
+        std::memcpy(row, data, sizeof(float) * dim);
+      }
+    } else {
+      int32_t e = (pos == SIZE_MAX) ? -1 : sh.table_slot[pos];
+      if (e >= 0 && sh.entries[e].len >= dim) {
+        std::memcpy(row, sh.entries[e].data, sizeof(float) * dim);
+      } else {
+        std::memset(row, 0, sizeof(float) * dim);
+      }
+    }
+  }
+}
+
+void ps_advance_batch_state(void* h, int group) { ((Store*)h)->advance_batch_state(group); }
+
+// grads: (n, dim) row-major
+int ps_update_gradients(void* h, const uint64_t* signs, int64_t n, uint32_t dim,
+                        const float* grads, int group) {
+  Store* s = (Store*)h;
+  if (s->opt.kind == OPT_NONE) return -1;
+  const uint32_t entry_len = dim + s->opt.state_dim(dim);
+  auto bs = s->get_batch_state(group);
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t sign = signs[i];
+    Shard& sh = s->shard_of(sign);
+    std::lock_guard<std::mutex> g(sh.mu);
+    size_t pos = sh.find_pos(sign);
+    if (pos == SIZE_MAX) continue;  // evicted / never admitted → skip
+    int32_t e = sh.table_slot[pos];
+    if (sh.entries[e].len != entry_len) continue;
+    sh.touch(e);
+    float* data = sh.entries[e].data;
+    s->update_entry(data, data + dim, grads + (size_t)i * dim, dim, bs);
+  }
+  return 0;
+}
+
+// values: (n, entry_len) full entries [emb | state]
+void ps_set_embedding(void* h, const uint64_t* signs, int64_t n, uint32_t entry_len,
+                      const float* values) {
+  Store* s = (Store*)h;
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t sign = signs[i];
+    Shard& sh = s->shard_of(sign);
+    std::lock_guard<std::mutex> g(sh.mu);
+    size_t pos = sh.find_pos(sign);
+    if (pos != SIZE_MAX) sh.remove_entry(sh.table_slot[pos]);
+    int32_t e = sh.insert(sign, entry_len);
+    std::memcpy(sh.entries[e].data, values + (size_t)i * entry_len,
+                sizeof(float) * entry_len);
+  }
+}
+
+// returns entry length, or -1 if absent; copies min(len, cap) floats into out
+int32_t ps_get_entry(void* h, uint64_t sign, float* out, int32_t cap) {
+  Store* s = (Store*)h;
+  Shard& sh = s->shard_of(sign);
+  std::lock_guard<std::mutex> g(sh.mu);
+  size_t pos = sh.find_pos(sign);
+  if (pos == SIZE_MAX) return -1;
+  const Entry& en = sh.entries[sh.table_slot[pos]];
+  int32_t ncopy = (int32_t)en.len < cap ? (int32_t)en.len : cap;
+  if (out && ncopy > 0) std::memcpy(out, en.data, sizeof(float) * ncopy);
+  return (int32_t)en.len;
+}
+
+int64_t ps_size(void* h) {
+  Store* s = (Store*)h;
+  int64_t total = 0;
+  for (auto& sh : s->shards) {
+    std::lock_guard<std::mutex> g(sh.mu);
+    total += (int64_t)sh.count;
+  }
+  return total;
+}
+
+void ps_clear(void* h) {
+  Store* s = (Store*)h;
+  for (auto& sh : s->shards) {
+    std::lock_guard<std::mutex> g(sh.mu);
+    for (auto& en : sh.entries)
+      if (en.data) {
+        std::free(en.data);
+        en.data = nullptr;
+      }
+    sh.entries.clear();
+    sh.free_list.clear();
+    std::fill(sh.table_slot.begin(), sh.table_slot.end(), -1);
+    sh.lru_head = sh.lru_tail = -1;
+    sh.count = 0;
+  }
+  std::lock_guard<std::mutex> g(s->batch_mu);
+  s->batch_state.clear();
+}
+
+// Checkpoint wire format shared with the Python store:
+//   u32 entry_count, then per entry: u64 sign, u32 len, len * f32.
+// Entries are emitted in LRU order from least- to most-recently-used so that a
+// dump→load roundtrip preserves relative recency.
+int64_t ps_dump_shard_size(void* h, uint32_t shard) {
+  Store* s = (Store*)h;
+  if (shard >= s->num_shards) return -1;
+  Shard& sh = s->shards[shard];
+  std::lock_guard<std::mutex> g(sh.mu);
+  int64_t bytes = 4;
+  for (int32_t e = sh.lru_tail; e >= 0; e = sh.entries[e].prev)
+    bytes += 12 + (int64_t)sh.entries[e].len * 4;
+  return bytes;
+}
+
+int64_t ps_dump_shard(void* h, uint32_t shard, uint8_t* out, int64_t cap) {
+  Store* s = (Store*)h;
+  if (shard >= s->num_shards) return -1;
+  Shard& sh = s->shards[shard];
+  std::lock_guard<std::mutex> g(sh.mu);
+  uint8_t* p = out;
+  uint8_t* end = out + cap;
+  if (p + 4 > end) return -1;
+  uint32_t cnt = (uint32_t)sh.count;
+  std::memcpy(p, &cnt, 4);
+  p += 4;
+  for (int32_t e = sh.lru_tail; e >= 0; e = sh.entries[e].prev) {
+    const Entry& en = sh.entries[e];
+    int64_t need = 12 + (int64_t)en.len * 4;
+    if (p + need > end) return -1;
+    std::memcpy(p, &en.sign, 8);
+    std::memcpy(p + 8, &en.len, 4);
+    std::memcpy(p + 12, en.data, (size_t)en.len * 4);
+    p += need;
+  }
+  return p - out;
+}
+
+int64_t ps_load_shard(void* h, const uint8_t* data, int64_t len) {
+  Store* s = (Store*)h;
+  if (len < 4) return -1;
+  uint32_t cnt;
+  std::memcpy(&cnt, data, 4);
+  const uint8_t* p = data + 4;
+  const uint8_t* end = data + len;
+  for (uint32_t i = 0; i < cnt; ++i) {
+    if (p + 12 > end) return -1;
+    uint64_t sign;
+    uint32_t elen;
+    std::memcpy(&sign, p, 8);
+    std::memcpy(&elen, p + 8, 4);
+    p += 12;
+    if (p + (int64_t)elen * 4 > end) return -1;
+    Shard& sh = s->shard_of(sign);
+    {
+      std::lock_guard<std::mutex> g(sh.mu);
+      size_t pos = sh.find_pos(sign);
+      if (pos != SIZE_MAX) sh.remove_entry(sh.table_slot[pos]);
+      int32_t e = sh.insert(sign, elen);
+      std::memcpy(sh.entries[e].data, p, (size_t)elen * 4);
+    }
+    p += (int64_t)elen * 4;
+  }
+  return (int64_t)cnt;
+}
+
+}  // extern "C"

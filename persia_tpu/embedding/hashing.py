@@ -67,6 +67,21 @@ def add_index_prefix(signs: np.ndarray, prefix: int, prefix_bit: int) -> np.ndar
     return (signs.astype(np.uint64) & mask) | np.uint64(prefix)
 
 
+def uniform_init_for_sign(
+    sign: int, seed: int, n: int, lo: float, hi: float
+) -> np.ndarray:
+    """Deterministic per-sign embedding init, identical bit-for-bit between
+    this numpy golden model and the C++ core (`native/ps.cpp`).
+
+    Counter-mode splitmix64: ``u_i = splitmix64(splitmix64(sign ^ seed) + i)``
+    mapped to [lo, hi) via the top 53 bits (ref concept: seeded-by-sign entry
+    init, emb_entry.rs:28-60)."""
+    base = np.uint64(seed_for_sign(sign, seed))
+    states = splitmix64(base + np.arange(n, dtype=np.uint64))
+    u = (states >> np.uint64(11)).astype(np.float64) * (1.0 / (1 << 53))
+    return (lo + u * (hi - lo)).astype(np.float32)
+
+
 def seed_for_sign(sign: int, base_seed: int = 0) -> int:
     """Deterministic per-sign RNG seed for reproducible embedding init
     (ref: emb_entry.rs:28-60 seeds the entry RNG by sign)."""

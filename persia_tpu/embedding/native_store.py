@@ -1,0 +1,243 @@
+"""ctypes bindings for the native C++ parameter-server core (`native/ps.cpp`).
+
+``NativeEmbeddingStore`` exposes the exact same API as the numpy golden model
+``persia_tpu.embedding.store.EmbeddingStore`` and is numerically parity-tested
+against it (tests/test_native_store.py). ``create_store(backend="auto")``
+prefers the native core and falls back to numpy if the toolchain is missing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from persia_tpu.config import HyperParameters
+from persia_tpu.embedding.optim import OptimizerConfig
+from persia_tpu.embedding.store import EmbeddingStore
+from persia_tpu.logger import get_default_logger
+
+logger = get_default_logger("persia_tpu.native")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "ps.cpp")
+_SO = os.path.join(_REPO_ROOT, "native", "libpersia_ps.so")
+_BUILD_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+
+
+def build_native(force: bool = False) -> str:
+    """Compile the native core if missing or stale. Returns the .so path."""
+    with _BUILD_LOCK:
+        if (
+            not force
+            and os.path.exists(_SO)
+            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+        ):
+            return _SO
+        cmd = [
+            "g++", "-O3", "-mavx2", "-mfma", "-std=c++17", "-fPIC", "-shared",
+            "-Wall", "-o", _SO, _SRC,
+        ]
+        logger.info("building native PS core: %s", " ".join(cmd))
+        subprocess.check_call(cmd)
+        return _SO
+
+
+def _load_lib() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    build_native()
+    lib = ctypes.CDLL(_SO)
+    u64, u32, i64, i32, f32 = (
+        ctypes.c_uint64, ctypes.c_uint32, ctypes.c_int64, ctypes.c_int32, ctypes.c_float,
+    )
+    p = ctypes.c_void_p
+    u64p = ctypes.POINTER(u64)
+    f32p = ctypes.POINTER(f32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.ps_create.restype = p
+    lib.ps_create.argtypes = [u64, u32, u64]
+    lib.ps_destroy.argtypes = [p]
+    lib.ps_configure.argtypes = [p, ctypes.c_double, ctypes.c_double, ctypes.c_double, f32]
+    lib.ps_register_optimizer.argtypes = [p, i32, f32, f32, f32, f32, f32, i32, f32, f32]
+    lib.ps_num_shards.restype = u32
+    lib.ps_num_shards.argtypes = [p]
+    lib.ps_lookup.argtypes = [p, u64p, i64, u32, i32, f32p]
+    lib.ps_advance_batch_state.argtypes = [p, i32]
+    lib.ps_update_gradients.restype = i32
+    lib.ps_update_gradients.argtypes = [p, u64p, i64, u32, f32p, i32]
+    lib.ps_set_embedding.argtypes = [p, u64p, i64, u32, f32p]
+    lib.ps_get_entry.restype = i32
+    lib.ps_get_entry.argtypes = [p, u64, f32p, i32]
+    lib.ps_size.restype = i64
+    lib.ps_size.argtypes = [p]
+    lib.ps_clear.argtypes = [p]
+    lib.ps_dump_shard_size.restype = i64
+    lib.ps_dump_shard_size.argtypes = [p, u32]
+    lib.ps_dump_shard.restype = i64
+    lib.ps_dump_shard.argtypes = [p, u32, u8p, i64]
+    lib.ps_load_shard.restype = i64
+    lib.ps_load_shard.argtypes = [p, u8p, i64]
+    _LIB = lib
+    return lib
+
+
+def _u64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class NativeEmbeddingStore:
+    """Drop-in replacement for the numpy ``EmbeddingStore`` backed by the C++
+    core. See `native/ps.cpp` for semantics/citations."""
+
+    def __init__(
+        self,
+        capacity: int = 1 << 20,
+        num_internal_shards: int = 8,
+        hyperparams: HyperParameters = HyperParameters(),
+        optimizer: Optional[OptimizerConfig] = None,
+        seed: int = 0,
+    ):
+        if num_internal_shards <= 0 or capacity <= 0:
+            raise ValueError("capacity and num_internal_shards must be positive")
+        self._lib = _load_lib()
+        self._h = self._lib.ps_create(capacity, num_internal_shards, seed)
+        if not self._h:
+            raise MemoryError("ps_create failed")
+        self.seed = seed
+        self._num_shards = num_internal_shards
+        self.optimizer: Optional[OptimizerConfig] = None
+        self.configure(hyperparams)
+        if optimizer is not None:
+            self.register_optimizer(optimizer)
+
+    # lifecycle ------------------------------------------------------------
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.ps_destroy(h)
+            self._h = None
+
+    # config ---------------------------------------------------------------
+
+    def configure(self, hyperparams: HyperParameters) -> None:
+        self.hyperparams = hyperparams
+        lo, hi = hyperparams.emb_initialization
+        self._lib.ps_configure(
+            self._h, lo, hi, hyperparams.admit_probability, hyperparams.weight_bound
+        )
+
+    def register_optimizer(self, optimizer: OptimizerConfig) -> None:
+        self.optimizer = optimizer
+        o = optimizer
+        self._lib.ps_register_optimizer(
+            self._h, o.kind, o.lr, o.weight_decay, o.initialization,
+            o.g_square_momentum, o.eps, int(o.vectorwise_shared), o.beta1, o.beta2,
+        )
+
+    # data plane -----------------------------------------------------------
+
+    def lookup(self, signs: np.ndarray, dim: int, train: bool) -> np.ndarray:
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        out = np.empty((len(signs), dim), dtype=np.float32)
+        self._lib.ps_lookup(self._h, _u64p(signs), len(signs), dim, int(train), _f32p(out))
+        return out
+
+    def advance_batch_state(self, group: int) -> None:
+        self._lib.ps_advance_batch_state(self._h, group)
+
+    def update_gradients(self, signs: np.ndarray, grads: np.ndarray, group: int = 0) -> None:
+        if grads.shape[0] != len(signs):
+            raise ValueError("signs/grads length mismatch")
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        rc = self._lib.ps_update_gradients(
+            self._h, _u64p(signs), len(signs), grads.shape[1], _f32p(grads), group
+        )
+        if rc != 0:
+            raise RuntimeError("no optimizer registered")
+
+    # management -----------------------------------------------------------
+
+    def set_embedding(self, signs: np.ndarray, values: np.ndarray) -> None:
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        values = np.ascontiguousarray(values, dtype=np.float32)
+        self._lib.ps_set_embedding(
+            self._h, _u64p(signs), len(signs), values.shape[1], _f32p(values)
+        )
+
+    def get_embedding_entry(self, sign: int) -> Optional[np.ndarray]:
+        ln = self._lib.ps_get_entry(self._h, sign, None, 0)
+        if ln < 0:
+            return None
+        out = np.empty(ln, dtype=np.float32)
+        self._lib.ps_get_entry(self._h, sign, _f32p(out), ln)
+        return out
+
+    def clear(self) -> None:
+        self._lib.ps_clear(self._h)
+
+    def size(self) -> int:
+        return int(self._lib.ps_size(self._h))
+
+    @property
+    def num_internal_shards(self) -> int:
+        return self._num_shards
+
+    # checkpoint -----------------------------------------------------------
+
+    def dump_shard(self, shard_idx: int) -> bytes:
+        n = self._lib.ps_dump_shard_size(self._h, shard_idx)
+        if n < 0:
+            raise IndexError(f"shard {shard_idx} out of range")
+        buf = np.empty(n, dtype=np.uint8)
+        written = self._lib.ps_dump_shard(
+            self._h, shard_idx, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n
+        )
+        if written < 0:
+            raise RuntimeError("dump_shard failed")
+        return buf[:written].tobytes()
+
+    def load_shard_bytes(self, raw: bytes) -> int:
+        buf = np.frombuffer(raw, dtype=np.uint8)
+        n = self._lib.ps_load_shard(
+            self._h, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(buf)
+        )
+        if n < 0:
+            raise ValueError("corrupt shard payload")
+        return int(n)
+
+
+def native_available() -> bool:
+    try:
+        _load_lib()
+        return True
+    except Exception as e:  # toolchain missing / compile error
+        logger.warning("native PS core unavailable, falling back to numpy: %s", e)
+        return False
+
+
+def create_store(backend: str = "auto", **kwargs):
+    """Factory: ``auto`` prefers the C++ core, ``native`` requires it,
+    ``numpy`` forces the golden model."""
+    if backend == "numpy":
+        return EmbeddingStore(**kwargs)
+    if backend == "native":
+        _load_lib()
+        return NativeEmbeddingStore(**kwargs)
+    if backend == "auto":
+        if native_available():
+            return NativeEmbeddingStore(**kwargs)
+        return EmbeddingStore(**kwargs)
+    raise ValueError(f"unknown store backend {backend!r}")

@@ -27,7 +27,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from persia_tpu.config import HyperParameters
-from persia_tpu.embedding.hashing import splitmix64
+from persia_tpu.embedding.hashing import splitmix64, uniform_init_for_sign
 from persia_tpu.embedding.optim import OptimizerConfig
 
 
@@ -104,11 +104,8 @@ class EmbeddingStore:
 
     def _init_entry(self, sign: int, dim: int) -> np.ndarray:
         lo, hi = self.hyperparams.emb_initialization
-        rng = np.random.default_rng(
-            int(splitmix64(np.array([sign], dtype=np.uint64) ^ np.uint64(self.seed))[0])
-        )
         entry = np.empty(dim + self._state_dim(dim), dtype=np.float32)
-        entry[:dim] = rng.uniform(lo, hi, size=dim).astype(np.float32)
+        entry[:dim] = uniform_init_for_sign(sign, self.seed, dim, lo, hi)
         if self.optimizer is not None:
             entry[dim:] = self.optimizer.init_state(dim)
         return entry

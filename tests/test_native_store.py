@@ -1,0 +1,170 @@
+"""Parity tests: the C++ native store must match the numpy golden model."""
+
+import numpy as np
+import pytest
+
+from persia_tpu.config import HyperParameters
+from persia_tpu.embedding.optim import Adagrad, Adam, SGD
+from persia_tpu.embedding.store import EmbeddingStore
+
+native = pytest.importorskip("persia_tpu.embedding.native_store")
+if not native.native_available():
+    pytest.skip("native core unavailable", allow_module_level=True)
+
+NativeEmbeddingStore = native.NativeEmbeddingStore
+
+
+def _pair(optimizer, **kw):
+    defaults = dict(capacity=2048, num_internal_shards=4, seed=9)
+    defaults.update(kw)
+    return (
+        EmbeddingStore(optimizer=optimizer, **defaults),
+        NativeEmbeddingStore(optimizer=optimizer, **defaults),
+    )
+
+
+def test_init_parity_bitexact():
+    py, cc = _pair(SGD(lr=0.1).config)
+    signs = np.array([1, 2, 3, 1 << 50, 0xFFFFFFFFFFFFFFFF], dtype=np.uint64)
+    a = py.lookup(signs, 16, train=True)
+    b = cc.lookup(signs, 16, train=True)
+    np.testing.assert_array_equal(a, b)  # bit-identical seeded init
+
+
+def test_infer_miss_parity():
+    py, cc = _pair(SGD().config)
+    signs = np.array([42], dtype=np.uint64)
+    np.testing.assert_array_equal(
+        py.lookup(signs, 8, False), cc.lookup(signs, 8, False)
+    )
+    assert cc.size() == 0
+
+
+@pytest.mark.parametrize(
+    "opt",
+    [
+        SGD(lr=0.05, weight_decay=0.01).config,
+        Adagrad(lr=0.1, initialization=0.02, g_square_momentum=0.95).config,
+        Adagrad(lr=0.1, vectorwise_shared=True).config,
+        Adam(lr=0.01).config,
+    ],
+    ids=["sgd", "adagrad", "adagrad_vw", "adam"],
+)
+def test_training_trajectory_parity(opt):
+    """Many lookup/update rounds with overlapping sign sets stay numerically
+    aligned between numpy and C++ (tiny float divergence tolerated)."""
+    py, cc = _pair(opt)
+    rng = np.random.default_rng(0)
+    for step in range(20):
+        signs = rng.integers(0, 200, size=64, dtype=np.uint64)
+        a = py.lookup(signs, 8, train=True)
+        b = cc.lookup(signs, 8, train=True)
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+        g = rng.normal(size=(64, 8)).astype(np.float32)
+        py.advance_batch_state(0)
+        cc.advance_batch_state(0)
+        py.update_gradients(signs, g, 0)
+        cc.update_gradients(signs, g, 0)
+    assert py.size() == cc.size()
+    final_signs = np.arange(200, dtype=np.uint64)
+    np.testing.assert_allclose(
+        py.lookup(final_signs, 8, False), cc.lookup(final_signs, 8, False),
+        rtol=2e-5, atol=1e-6,
+    )
+
+
+def test_lru_eviction_parity():
+    py = EmbeddingStore(capacity=8, num_internal_shards=1, optimizer=SGD().config, seed=1)
+    cc = NativeEmbeddingStore(capacity=8, num_internal_shards=1, optimizer=SGD().config, seed=1)
+    rng = np.random.default_rng(2)
+    for _ in range(30):
+        signs = rng.integers(0, 40, size=5, dtype=np.uint64)
+        py.lookup(signs, 4, True)
+        cc.lookup(signs, 4, True)
+    assert py.size() == cc.size() == 8
+    # identical survivor sets
+    for s in range(40):
+        assert (py.get_embedding_entry(s) is None) == (cc.get_embedding_entry(s) is None)
+
+
+def test_dim_mismatch_reinit_parity():
+    py, cc = _pair(SGD().config)
+    signs = np.array([7], dtype=np.uint64)
+    py.lookup(signs, 4, True)
+    cc.lookup(signs, 4, True)
+    a = py.lookup(signs, 8, True)
+    b = cc.lookup(signs, 8, True)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_admit_probability_parity():
+    hp = HyperParameters(admit_probability=0.5)
+    py, cc = _pair(SGD().config, hyperparams=hp)
+    signs = np.arange(500, dtype=np.uint64)
+    py.lookup(signs, 4, True)
+    cc.lookup(signs, 4, True)
+    assert py.size() == cc.size()  # identical admit decisions
+    for s in range(0, 500, 7):
+        assert (py.get_embedding_entry(s) is None) == (cc.get_embedding_entry(s) is None)
+
+
+def test_weight_bound_parity():
+    hp = HyperParameters(weight_bound=0.02)
+    py, cc = _pair(SGD(lr=5.0).config, hyperparams=hp)
+    signs = np.array([3], dtype=np.uint64)
+    py.lookup(signs, 4, True)
+    cc.lookup(signs, 4, True)
+    g = np.ones((1, 4), dtype=np.float32)
+    py.update_gradients(signs, g)
+    cc.update_gradients(signs, g)
+    np.testing.assert_allclose(py.lookup(signs, 4, False), cc.lookup(signs, 4, False))
+    assert np.abs(cc.lookup(signs, 4, False)).max() <= 0.02 + 1e-7
+
+
+def test_cross_dump_load():
+    """Checkpoint files are interchangeable between backends (shared format),
+    including across different internal shard counts (re-shard on load)."""
+    py, cc = _pair(Adagrad(lr=0.1).config)
+    signs = np.arange(300, dtype=np.uint64)
+    py.lookup(signs, 8, True)
+    cc.lookup(signs, 8, True)
+    # native dump → numpy load (different shard count)
+    py2 = EmbeddingStore(capacity=2048, num_internal_shards=3, optimizer=Adagrad(lr=0.1).config, seed=9)
+    total = sum(py2.load_shard_bytes(cc.dump_shard(i)) for i in range(4))
+    assert total == 300
+    np.testing.assert_array_equal(py2.lookup(signs, 8, False), cc.lookup(signs, 8, False))
+    # numpy dump → native load
+    cc2 = NativeEmbeddingStore(capacity=2048, num_internal_shards=5, optimizer=Adagrad(lr=0.1).config, seed=9)
+    total = sum(cc2.load_shard_bytes(py.dump_shard(i)) for i in range(4))
+    assert total == 300
+    np.testing.assert_array_equal(cc2.lookup(signs, 8, False), py.lookup(signs, 8, False))
+
+
+def test_set_get_entry():
+    _, cc = _pair(SGD().config)
+    signs = np.array([5, 6], dtype=np.uint64)
+    vals = np.arange(8, dtype=np.float32).reshape(2, 4)
+    cc.set_embedding(signs, vals)
+    np.testing.assert_array_equal(cc.get_embedding_entry(5), [0, 1, 2, 3])
+    np.testing.assert_array_equal(cc.lookup(signs, 4, False), vals)
+    assert cc.get_embedding_entry(999) is None
+
+
+def test_clear():
+    _, cc = _pair(SGD().config)
+    cc.lookup(np.arange(10, dtype=np.uint64), 4, True)
+    assert cc.size() == 10
+    cc.clear()
+    assert cc.size() == 0
+
+
+def test_corrupt_shard_payload_rejected():
+    _, cc = _pair(SGD().config)
+    with pytest.raises(ValueError):
+        cc.load_shard_bytes(b"\xff\xff\xff\xff" + b"junk")
+
+
+def test_update_before_optimizer_registration_errors():
+    cc = NativeEmbeddingStore(capacity=64, num_internal_shards=1)
+    with pytest.raises(RuntimeError):
+        cc.update_gradients(np.array([1], np.uint64), np.ones((1, 4), np.float32))
