@@ -71,7 +71,8 @@ struct OptimizerConfig {
 struct Entry {
   uint64_t sign;
   float* data;     // [emb | state], heap-owned
-  uint32_t len;
+  uint32_t dim;    // embedding dim (first `dim` floats of data are the emb)
+  uint32_t len;    // total floats = dim + optimizer state
   int32_t prev, next;  // LRU list links (entry slab indices)
 };
 
@@ -167,7 +168,7 @@ struct Shard {
   }
 
   // insert new sign (must not exist); returns entry index with uninit data ptr
-  int32_t insert(uint64_t sign, uint32_t len) {
+  int32_t insert(uint64_t sign, uint32_t dim, uint32_t len) {
     if (count >= max_entries) evict_lru();
     int32_t e;
     if (!free_list.empty()) {
@@ -179,6 +180,7 @@ struct Shard {
     }
     Entry& en = entries[e];
     en.sign = sign;
+    en.dim = dim;
     en.len = len;
     en.data = (float*)std::malloc(sizeof(float) * len);
     en.prev = en.next = -1;
@@ -386,7 +388,7 @@ void ps_lookup(void* h, const uint64_t* signs, int64_t n, uint32_t dim, int trai
     float* row = out + (size_t)i * dim;
     if (train) {
       int32_t e = (pos == SIZE_MAX) ? -1 : sh.table_slot[pos];
-      if (e >= 0 && sh.entries[e].len == entry_len) {
+      if (e >= 0 && sh.entries[e].dim == dim && sh.entries[e].len == entry_len) {
         sh.touch(e);
         std::memcpy(row, sh.entries[e].data, sizeof(float) * dim);
       } else {
@@ -396,15 +398,17 @@ void ps_lookup(void* h, const uint64_t* signs, int64_t n, uint32_t dim, int trai
           std::memset(row, 0, sizeof(float) * dim);
           continue;
         }
-        int32_t ne = sh.insert(sign, entry_len);
+        int32_t ne = sh.insert(sign, dim, entry_len);
         float* data = sh.entries[ne].data;
         s->init_embedding(sign, dim, data);
         s->init_state(dim, data + dim);
         std::memcpy(row, data, sizeof(float) * dim);
       }
     } else {
+      // infer: the entry's own recorded dim must match — never read optimizer
+      // state bytes as embedding values (zeros on miss/mismatch)
       int32_t e = (pos == SIZE_MAX) ? -1 : sh.table_slot[pos];
-      if (e >= 0 && sh.entries[e].len >= dim) {
+      if (e >= 0 && sh.entries[e].dim == dim) {
         std::memcpy(row, sh.entries[e].data, sizeof(float) * dim);
       } else {
         std::memset(row, 0, sizeof(float) * dim);
@@ -429,7 +433,7 @@ int ps_update_gradients(void* h, const uint64_t* signs, int64_t n, uint32_t dim,
     size_t pos = sh.find_pos(sign);
     if (pos == SIZE_MAX) continue;  // evicted / never admitted → skip
     int32_t e = sh.table_slot[pos];
-    if (sh.entries[e].len != entry_len) continue;
+    if (sh.entries[e].dim != dim || sh.entries[e].len != entry_len) continue;
     sh.touch(e);
     float* data = sh.entries[e].data;
     s->update_entry(data, data + dim, grads + (size_t)i * dim, dim, bs);
@@ -437,9 +441,9 @@ int ps_update_gradients(void* h, const uint64_t* signs, int64_t n, uint32_t dim,
   return 0;
 }
 
-// values: (n, entry_len) full entries [emb | state]
-void ps_set_embedding(void* h, const uint64_t* signs, int64_t n, uint32_t entry_len,
-                      const float* values) {
+// values: (n, entry_len) full entries [emb | state]; dim = embedding dim
+void ps_set_embedding(void* h, const uint64_t* signs, int64_t n, uint32_t dim,
+                      uint32_t entry_len, const float* values) {
   Store* s = (Store*)h;
   for (int64_t i = 0; i < n; ++i) {
     uint64_t sign = signs[i];
@@ -447,7 +451,7 @@ void ps_set_embedding(void* h, const uint64_t* signs, int64_t n, uint32_t entry_
     std::lock_guard<std::mutex> g(sh.mu);
     size_t pos = sh.find_pos(sign);
     if (pos != SIZE_MAX) sh.remove_entry(sh.table_slot[pos]);
-    int32_t e = sh.insert(sign, entry_len);
+    int32_t e = sh.insert(sign, dim, entry_len);
     std::memcpy(sh.entries[e].data, values + (size_t)i * entry_len,
                 sizeof(float) * entry_len);
   }
@@ -496,7 +500,7 @@ void ps_clear(void* h) {
 }
 
 // Checkpoint wire format shared with the Python store:
-//   u32 entry_count, then per entry: u64 sign, u32 len, len * f32.
+//   u32 entry_count, then per entry: u64 sign, u32 dim, u32 len, len * f32.
 // Entries are emitted in LRU order from least- to most-recently-used so that a
 // dump→load roundtrip preserves relative recency.
 int64_t ps_dump_shard_size(void* h, uint32_t shard) {
@@ -506,7 +510,7 @@ int64_t ps_dump_shard_size(void* h, uint32_t shard) {
   std::lock_guard<std::mutex> g(sh.mu);
   int64_t bytes = 4;
   for (int32_t e = sh.lru_tail; e >= 0; e = sh.entries[e].prev)
-    bytes += 12 + (int64_t)sh.entries[e].len * 4;
+    bytes += 16 + (int64_t)sh.entries[e].len * 4;
   return bytes;
 }
 
@@ -523,11 +527,12 @@ int64_t ps_dump_shard(void* h, uint32_t shard, uint8_t* out, int64_t cap) {
   p += 4;
   for (int32_t e = sh.lru_tail; e >= 0; e = sh.entries[e].prev) {
     const Entry& en = sh.entries[e];
-    int64_t need = 12 + (int64_t)en.len * 4;
+    int64_t need = 16 + (int64_t)en.len * 4;
     if (p + need > end) return -1;
     std::memcpy(p, &en.sign, 8);
-    std::memcpy(p + 8, &en.len, 4);
-    std::memcpy(p + 12, en.data, (size_t)en.len * 4);
+    std::memcpy(p + 8, &en.dim, 4);
+    std::memcpy(p + 12, &en.len, 4);
+    std::memcpy(p + 16, en.data, (size_t)en.len * 4);
     p += need;
   }
   return p - out;
@@ -541,19 +546,20 @@ int64_t ps_load_shard(void* h, const uint8_t* data, int64_t len) {
   const uint8_t* p = data + 4;
   const uint8_t* end = data + len;
   for (uint32_t i = 0; i < cnt; ++i) {
-    if (p + 12 > end) return -1;
+    if (p + 16 > end) return -1;
     uint64_t sign;
-    uint32_t elen;
+    uint32_t edim, elen;
     std::memcpy(&sign, p, 8);
-    std::memcpy(&elen, p + 8, 4);
-    p += 12;
+    std::memcpy(&edim, p + 8, 4);
+    std::memcpy(&elen, p + 12, 4);
+    p += 16;
     if (p + (int64_t)elen * 4 > end) return -1;
     Shard& sh = s->shard_of(sign);
     {
       std::lock_guard<std::mutex> g(sh.mu);
       size_t pos = sh.find_pos(sign);
       if (pos != SIZE_MAX) sh.remove_entry(sh.table_slot[pos]);
-      int32_t e = sh.insert(sign, elen);
+      int32_t e = sh.insert(sign, edim, elen);
       std::memcpy(sh.entries[e].data, p, (size_t)elen * 4);
     }
     p += (int64_t)elen * 4;

@@ -1,0 +1,236 @@
+"""User-facing training/eval/inference contexts.
+
+Parity target: ``persia/ctx.py`` — ``BaseCtx`` (common context wiring),
+``DataCtx`` (data-loader side), ``EmbeddingCtx`` (feature prep + checkpoint),
+``TrainCtx`` (training state machine), ``eval_ctx``/``InferCtx``.
+
+TPU-first shape: instead of DLPack handoffs into torch autograd
+(ref ctx.py:40-55), ``prepare_features`` stages numpy worker outputs into a
+sharded device batch; the whole train step (forward, loss, backward, dense
+update, embedding grads) is one jitted XLA program from
+``persia_tpu.parallel.train_step``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+import optax
+
+from persia_tpu.config import EmbeddingConfig, HyperParameters, JobType
+from persia_tpu.data import PersiaBatch
+from persia_tpu.embedding.optim import SGD as SparseSGD
+from persia_tpu.embedding.worker import (
+    EmbeddingWorker,
+    FeatureEmbeddingBatch,
+    RawEmbeddingBatch,
+    SumEmbeddingBatch,
+)
+from persia_tpu.logger import get_default_logger
+from persia_tpu.parallel.train_step import (
+    TrainState,
+    build_eval_step,
+    build_train_step,
+    init_train_state,
+    replicate_state,
+    shard_device_batch,
+)
+
+logger = get_default_logger("persia_tpu.ctx")
+
+
+def _round_up_pow2(n: int, floor: int = 8) -> int:
+    p = floor
+    while p < n:
+        p <<= 1
+    return p
+
+
+def stage_embeddings(
+    emb_batches: Sequence[FeatureEmbeddingBatch],
+) -> Tuple[List[Dict], List[Optional[int]]]:
+    """Convert worker outputs into the device batch's ``emb`` entries.
+
+    Raw slots: distinct rows are padded to a power-of-two bucket (static
+    shapes for jit — a bounded set of compiled programs instead of one per
+    distinct-count) with one extra zero row absorbing padded index entries.
+    Returns (emb_entries, true_distinct_counts) — counts are None for pooled
+    slots and are used to slice padding off the returned gradients.
+    """
+    entries: List[Dict] = []
+    counts: List[Optional[int]] = []
+    for eb in emb_batches:
+        if isinstance(eb, SumEmbeddingBatch):
+            entries.append({"pooled": eb.pooled})
+            counts.append(None)
+        else:
+            d, dim = eb.distinct.shape
+            p = _round_up_pow2(d + 1)
+            padded = np.zeros((p, dim), dtype=eb.distinct.dtype)
+            padded[:d] = eb.distinct
+            index = np.where(eb.index == d, p - 1, eb.index).astype(np.int32)
+            mask = eb.index != d
+            entries.append({"distinct": padded, "index": index, "mask": mask})
+            counts.append(d)
+    return entries, counts
+
+
+class BaseCtx:
+    """Common wiring (ref: persia/ctx.py:208-243). ``worker`` is the embedding
+    -worker tier handle: in-process ``EmbeddingWorker`` or an RPC client with
+    the same surface."""
+
+    def __init__(self, worker: EmbeddingWorker, embedding_config: EmbeddingConfig):
+        self.worker = worker
+        self.embedding_config = embedding_config
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class EmbeddingCtx(BaseCtx):
+    """Feature preparation + checkpoint plumbing (ref: persia/ctx.py:345-652)."""
+
+    def __init__(
+        self,
+        worker: EmbeddingWorker,
+        embedding_config: EmbeddingConfig,
+        mesh=None,
+    ):
+        super().__init__(worker, embedding_config)
+        self.mesh = mesh
+
+    def prepare_features(
+        self, batch: PersiaBatch, emb_batches: Sequence[FeatureEmbeddingBatch]
+    ) -> Tuple[Dict, List[Optional[int]]]:
+        """Build the sharded device batch from a ``PersiaBatch`` + worker
+        lookup results (ref: _prepare_feature, ctx.py:75-199)."""
+        entries, counts = stage_embeddings(emb_batches)
+        device_batch = {
+            "dense": [f.data.astype(np.float32) for f in batch.non_id_type_features],
+            "labels": [l.data.astype(np.float32) for l in batch.labels],
+            "emb": entries,
+        }
+        return shard_device_batch(device_batch, self.mesh), counts
+
+    def emb_grads_to_slot_grads(
+        self,
+        emb_batches: Sequence[FeatureEmbeddingBatch],
+        emb_grads: Sequence,
+        counts: Sequence[Optional[int]],
+    ) -> Dict[str, np.ndarray]:
+        """Strip padding and key device gradients by slot name for the
+        worker's gradient path."""
+        out = {}
+        for eb, g, d in zip(emb_batches, emb_grads, counts):
+            g = np.asarray(g, dtype=np.float32)
+            out[eb.name] = g if d is None else g[:d]
+        return out
+
+
+class DataCtx(BaseCtx):
+    """Data-loader role: push batches into the dataflow
+    (ref: persia/ctx.py:274-342). In-process mode forwards straight to the
+    worker's id buffer; the service mode sends over RPC (persia_tpu.service)."""
+
+    def send_data(self, batch: PersiaBatch) -> int:
+        if not self.worker.can_forward_batched():
+            raise RuntimeError("embedding worker forward buffer full")
+        return self.worker.put_forward_ids(batch)
+
+
+class TrainCtx(EmbeddingCtx):
+    """Synchronous training context — the M1 slice (lookup-direct path,
+    ref forward_directly, forward.rs:782-831). The pipelined/bounded-staleness
+    path lives in ``persia_tpu.data_loader.DataLoader``.
+
+    Responsibilities (ref: persia/ctx.py:655-1064): hold the jitted train
+    step + TrainState, register the sparse optimizer on the PS tier, convert
+    device grads into worker gradient updates.
+    """
+
+    def __init__(
+        self,
+        model,
+        dense_optimizer: optax.GradientTransformation,
+        embedding_optimizer,
+        worker: EmbeddingWorker,
+        embedding_config: EmbeddingConfig,
+        mesh=None,
+        grad_scale: float = 1.0,
+        loss_fn=None,
+    ):
+        super().__init__(worker, embedding_config, mesh=mesh)
+        self.model = model
+        self.dense_optimizer = dense_optimizer
+        self.embedding_optimizer = embedding_optimizer
+        self.grad_scale = grad_scale
+        kwargs = {} if loss_fn is None else {"loss_fn": loss_fn}
+        self._train_step = build_train_step(model, dense_optimizer, **kwargs)
+        self._eval_step = build_eval_step(model)
+        self.state: Optional[TrainState] = None
+
+    def __enter__(self):
+        # register the sparse optimizer on every PS replica
+        # (ref: embedding_optimizer.apply(), persia/ctx.py:854-858)
+        for replica in self.worker.lookup_router.replicas:
+            replica.register_optimizer(self.embedding_optimizer.config)
+        return self
+
+    def init_state(self, rng, sample_batch: Dict) -> TrainState:
+        state = init_train_state(self.model, rng, sample_batch, self.dense_optimizer)
+        if self.mesh is not None:
+            state = replicate_state(state, self.mesh)
+        self.state = state
+        return state
+
+    def train_step(self, batch: PersiaBatch) -> Dict:
+        """One synchronous hybrid step: lookup → jitted step → gradient
+        return. Returns host metrics {loss, preds}."""
+        ref = self.worker.put_forward_ids(batch)
+        emb_batches = self.worker.forward_batch_id(ref, train=True)
+        try:
+            device_batch, counts = self.prepare_features(batch, emb_batches)
+            if self.state is None:
+                self.init_state(jax.random.PRNGKey(0), device_batch)
+            self.state, metrics, emb_grads = self._train_step(self.state, device_batch)
+            slot_grads = self.emb_grads_to_slot_grads(emb_batches, emb_grads, counts)
+        except Exception:
+            # release the staleness slot + stashed layout (no silent buffer leak)
+            self.worker.abort_gradient(ref)
+            raise
+        self.worker.update_gradient_batched(ref, slot_grads, scale_factor=self.grad_scale)
+        return {
+            "loss": float(metrics["loss"]),
+            "preds": np.asarray(metrics["preds"]),
+        }
+
+    def eval_batch(self, batch: PersiaBatch) -> np.ndarray:
+        emb_batches = self.worker.forward_directly(batch, train=False)
+        device_batch, _ = self.prepare_features(batch, emb_batches)
+        return np.asarray(self._eval_step(self.state, device_batch))
+
+
+class InferCtx(EmbeddingCtx):
+    """Inference: lookup-direct, zeros-on-miss, no buffers
+    (ref: persia/ctx.py:1077-1133)."""
+
+    def __init__(self, model, state: TrainState, worker, embedding_config, mesh=None):
+        super().__init__(worker, embedding_config, mesh=mesh)
+        self.model = model
+        self.state = state
+        self._eval_step = build_eval_step(model)
+
+    def predict(self, batch: PersiaBatch) -> np.ndarray:
+        emb_batches = self.worker.forward_directly(batch, train=False)
+        device_batch, _ = self.prepare_features(batch, emb_batches)
+        return np.asarray(self._eval_step(self.state, device_batch))
+
+    def predict_from_bytes(self, raw: bytes) -> np.ndarray:
+        """(ref: get_embedding_from_bytes, persia/ctx.py:637-652)"""
+        return self.predict(PersiaBatch.from_bytes(raw))

@@ -9,6 +9,7 @@ prefers the native core and falls back to numpy if the toolchain is missing.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -30,21 +31,29 @@ _BUILD_LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 
 
+def _src_hash() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
 def build_native(force: bool = False) -> str:
-    """Compile the native core if missing or stale. Returns the .so path."""
+    """Compile the native core if missing or stale (gated on a source hash,
+    not mtimes — git checkouts do not preserve mtimes). Returns the .so path."""
+    stamp = _SO + ".srchash"
     with _BUILD_LOCK:
-        if (
-            not force
-            and os.path.exists(_SO)
-            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
-        ):
-            return _SO
+        h = _src_hash()
+        if not force and os.path.exists(_SO) and os.path.exists(stamp):
+            with open(stamp) as f:
+                if f.read().strip() == h:
+                    return _SO
         cmd = [
             "g++", "-O3", "-mavx2", "-mfma", "-std=c++17", "-fPIC", "-shared",
             "-Wall", "-o", _SO, _SRC,
         ]
         logger.info("building native PS core: %s", " ".join(cmd))
         subprocess.check_call(cmd)
+        with open(stamp, "w") as f:
+            f.write(h)
         return _SO
 
 
@@ -72,7 +81,7 @@ def _load_lib() -> ctypes.CDLL:
     lib.ps_advance_batch_state.argtypes = [p, i32]
     lib.ps_update_gradients.restype = i32
     lib.ps_update_gradients.argtypes = [p, u64p, i64, u32, f32p, i32]
-    lib.ps_set_embedding.argtypes = [p, u64p, i64, u32, f32p]
+    lib.ps_set_embedding.argtypes = [p, u64p, i64, u32, u32, f32p]
     lib.ps_get_entry.restype = i32
     lib.ps_get_entry.argtypes = [p, u64, f32p, i32]
     lib.ps_size.restype = i64
@@ -170,20 +179,31 @@ class NativeEmbeddingStore:
 
     # management -----------------------------------------------------------
 
-    def set_embedding(self, signs: np.ndarray, values: np.ndarray) -> None:
+    def set_embedding(
+        self, signs: np.ndarray, values: np.ndarray, dim: Optional[int] = None
+    ) -> None:
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
         values = np.ascontiguousarray(values, dtype=np.float32)
+        if dim is None:
+            dim = values.shape[1]
         self._lib.ps_set_embedding(
-            self._h, _u64p(signs), len(signs), values.shape[1], _f32p(values)
+            self._h, _u64p(signs), len(signs), dim, values.shape[1], _f32p(values)
         )
 
     def get_embedding_entry(self, sign: int) -> Optional[np.ndarray]:
-        ln = self._lib.ps_get_entry(self._h, sign, None, 0)
-        if ln < 0:
-            return None
-        out = np.empty(ln, dtype=np.float32)
-        self._lib.ps_get_entry(self._h, sign, _f32p(out), ln)
-        return out
+        # two locked calls (size, then copy): retry if a concurrent eviction
+        # or re-init changes the entry in between
+        for _ in range(8):
+            ln = self._lib.ps_get_entry(self._h, sign, None, 0)
+            if ln < 0:
+                return None
+            out = np.empty(ln, dtype=np.float32)
+            ln2 = self._lib.ps_get_entry(self._h, sign, _f32p(out), ln)
+            if ln2 == ln:
+                return out
+            if ln2 < 0:
+                return None
+        raise RuntimeError(f"entry for sign {sign} kept changing concurrently")
 
     def clear(self) -> None:
         self._lib.ps_clear(self._h)

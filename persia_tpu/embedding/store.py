@@ -34,29 +34,32 @@ from persia_tpu.embedding.optim import OptimizerConfig
 class _Shard:
     """One internal shard: an insertion-ordered dict used as an O(1) LRU
     (Python-dict equivalent of the reference's hashmap + array-linked-list
-    ``EvictionMap``, eviction_map.rs:11-107)."""
+    ``EvictionMap``, eviction_map.rs:11-107). Entries are ``(emb_dim, vec)``
+    — each entry records its own embedding dim, like the reference's
+    ``HashMapEmbeddingEntry`` (emb_entry.rs:16-76), so inference can never
+    misread optimizer state as embedding values."""
 
     __slots__ = ("entries", "capacity")
 
     def __init__(self, capacity: int):
-        self.entries: Dict[int, np.ndarray] = {}
+        self.entries: Dict[int, Tuple[int, np.ndarray]] = {}
         self.capacity = capacity
 
-    def get_refresh(self, sign: int) -> Optional[np.ndarray]:
+    def get_refresh(self, sign: int) -> Optional[Tuple[int, np.ndarray]]:
         e = self.entries.pop(sign, None)
         if e is not None:
             self.entries[sign] = e  # reinsert → most-recently-used
         return e
 
-    def get(self, sign: int) -> Optional[np.ndarray]:
+    def get(self, sign: int) -> Optional[Tuple[int, np.ndarray]]:
         return self.entries.get(sign)
 
-    def insert(self, sign: int, entry: np.ndarray) -> None:
+    def insert(self, sign: int, dim: int, vec: np.ndarray) -> None:
         if sign in self.entries:
             self.entries.pop(sign)
         elif len(self.entries) >= self.capacity:
             self.entries.pop(next(iter(self.entries)))  # evict LRU
-        self.entries[sign] = entry
+        self.entries[sign] = (dim, vec)
 
     def __len__(self) -> int:
         return len(self.entries)
@@ -137,16 +140,18 @@ class EmbeddingStore:
             shard = self._shard_of(s)
             if train:
                 entry = shard.get_refresh(s)
-                if entry is None or len(entry) != entry_len:
+                if entry is None or entry[0] != dim or len(entry[1]) != entry_len:
                     if entry is None and not self._admit(s):
                         continue
-                    entry = self._init_entry(s, dim)
-                    shard.insert(s, entry)
-                out[i] = entry[:dim]
+                    vec = self._init_entry(s, dim)
+                    shard.insert(s, dim, vec)
+                    out[i] = vec[:dim]
+                else:
+                    out[i] = entry[1][:dim]
             else:
                 entry = shard.get(s)
-                if entry is not None and len(entry) >= dim:
-                    out[i] = entry[:dim]
+                if entry is not None and entry[0] == dim:
+                    out[i] = entry[1][:dim]
         return out
 
     # -------------------------------------------------------------- gradient
@@ -176,22 +181,33 @@ class EmbeddingStore:
         for i, s in enumerate(signs.tolist()):
             shard = self._shard_of(s)
             entry = shard.get_refresh(s)
-            if entry is None or len(entry) != entry_len:
+            if entry is None or entry[0] != dim or len(entry[1]) != entry_len:
                 continue
-            self.optimizer.update_dense(entry[:dim], entry[dim:], grads[i], batch_state)
+            vec = entry[1]
+            self.optimizer.update_dense(vec[:dim], vec[dim:], grads[i], batch_state)
             if bound > 0:
-                np.clip(entry[:dim], -bound, bound, out=entry[:dim])
+                np.clip(vec[:dim], -bound, bound, out=vec[:dim])
 
     # ------------------------------------------------------------ management
 
-    def set_embedding(self, signs: np.ndarray, values: np.ndarray) -> None:
+    def set_embedding(
+        self, signs: np.ndarray, values: np.ndarray, dim: Optional[int] = None
+    ) -> None:
         """Insert raw entries (checkpoint re-shard path; ref mod.rs set_embedding).
-        ``values`` rows are full entries ``[emb | state]``."""
+        ``values`` rows are full entries ``[emb | state]``; ``dim`` is the
+        embedding dim (defaults to the full row = stateless entries)."""
+        if dim is None:
+            dim = values.shape[1]
         for i, s in enumerate(signs.tolist()):
-            self._shard_of(s).insert(s, values[i].astype(np.float32).copy())
+            self._shard_of(s).insert(s, dim, values[i].astype(np.float32).copy())
 
     def get_embedding_entry(self, sign: int) -> Optional[np.ndarray]:
-        return self._shard_of(sign).get(sign)
+        e = self._shard_of(sign).get(sign)
+        return None if e is None else e[1]
+
+    def get_entry_dim(self, sign: int) -> Optional[int]:
+        e = self._shard_of(sign).get(sign)
+        return None if e is None else e[0]
 
     def clear(self) -> None:
         for shard in self._shards:
@@ -213,9 +229,9 @@ class EmbeddingStore:
         shard = self._shards[shard_idx]
         buf = io.BytesIO()
         buf.write(struct.pack("<I", len(shard.entries)))
-        for sign, entry in shard.entries.items():
-            buf.write(struct.pack("<QI", sign, len(entry)))
-            buf.write(entry.tobytes())
+        for sign, (dim, vec) in shard.entries.items():
+            buf.write(struct.pack("<QII", sign, dim, len(vec)))
+            buf.write(vec.tobytes())
         return buf.getvalue()
 
     def load_shard_bytes(self, raw: bytes) -> int:
@@ -224,9 +240,9 @@ class EmbeddingStore:
         buf = io.BytesIO(raw)
         (n,) = struct.unpack("<I", buf.read(4))
         for _ in range(n):
-            sign, ln = struct.unpack("<QI", buf.read(12))
-            entry = np.frombuffer(buf.read(4 * ln), dtype=np.float32).copy()
-            self._shard_of(sign).insert(sign, entry)
+            sign, dim, ln = struct.unpack("<QII", buf.read(16))
+            vec = np.frombuffer(buf.read(4 * ln), dtype=np.float32).copy()
+            self._shard_of(sign).insert(sign, dim, vec)
         return n
 
     def state_dict(self) -> Dict:

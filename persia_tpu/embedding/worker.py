@@ -330,6 +330,13 @@ class EmbeddingWorker:
         processed = preprocess_batch(batch.id_type_features, self.embedding_config)
         return [lookup_slot(s, self.lookup_router, train) for s in processed.slots]
 
+    def abort_gradient(self, ref: int) -> None:
+        """Drop a stashed post-forward batch without applying gradients (the
+        NN worker's step failed); releases the staleness slot so the pipeline
+        and buffers cannot leak."""
+        if self.post_forward_buffer.pop(ref, None) is not None:
+            self.staleness = max(0, self.staleness - 1)
+
     def update_gradient_batched(
         self, ref: int, slot_grads: Dict[str, np.ndarray], scale_factor: float = 1.0
     ) -> Dict[str, int]:

@@ -1,0 +1,15 @@
+"""Dense-model zoo (flax). Every model takes the framework's standard inputs:
+
+    model.apply(variables, non_id_features, embeddings, train=...)
+
+where ``non_id_features`` is a list of (B, F) arrays and ``embeddings`` is a
+list aligned with the batch's slot order: pooled slots contribute a (B, dim)
+array; raw (sequence) slots contribute a ``(gathered, mask)`` pair with
+``gathered`` (B, L, dim) and boolean ``mask`` (B, L). Models return logits
+(loss applies the sigmoid — unlike the reference models which bake
+``nn.Sigmoid`` into ``forward``, e.g.
+`/root/reference/examples/src/adult-income/model.py:40`).
+"""
+
+from persia_tpu.models.dnn import DNN  # noqa: F401
+from persia_tpu.models.dlrm import DLRM  # noqa: F401

@@ -1,0 +1,47 @@
+"""Adult-income-style DNN: the e2e determinism-oracle model.
+
+Capability parity with the reference example model
+(`/root/reference/examples/src/adult-income/model.py:8-40`): a dense-feature
+MLP + batch-norm, a sparse (concatenated pooled embeddings) MLP + batch-norm,
+and a 3-layer head. Rebuilt in flax with bf16 compute / f32 params.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class DNN(nn.Module):
+    dense_mlp_size: int = 16
+    sparse_mlp_size: int = 128
+    hidden_sizes: Sequence[int] = (256, 128)
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, non_id_features: List, embeddings: List, train: bool = True):
+        dt = self.compute_dtype
+        dense_x = non_id_features[0].astype(dt)
+
+        parts = []
+        for emb in embeddings:
+            if isinstance(emb, tuple):  # raw slot: (gathered (B,L,D), mask (B,L))
+                gathered, mask = emb
+                pooled = (gathered * mask[..., None].astype(gathered.dtype)).sum(axis=1)
+                parts.append(pooled.astype(dt))
+            else:
+                parts.append(emb.astype(dt))
+        sparse = jnp.concatenate(parts, axis=1)
+
+        sparse = nn.Dense(self.sparse_mlp_size, dtype=dt)(sparse)
+        sparse = nn.BatchNorm(use_running_average=not train, dtype=dt)(sparse)
+        dense_x = nn.Dense(self.dense_mlp_size, dtype=dt)(dense_x)
+        dense_x = nn.BatchNorm(use_running_average=not train, dtype=dt)(dense_x)
+
+        x = jnp.concatenate([sparse, dense_x], axis=1)
+        for h in self.hidden_sizes:
+            x = nn.relu(nn.Dense(h, dtype=dt)(x))
+        logits = nn.Dense(1, dtype=jnp.float32)(x)
+        return logits

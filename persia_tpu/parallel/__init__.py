@@ -1,0 +1,16 @@
+"""Parallelism layer: device meshes, sharded train/eval steps.
+
+Replaces the reference's dense-distributed stack (`persia/distributed.py`
+DDP/Bagua + NCCL, `rust/persia-core/src/nats.rs:22-100` master discovery)
+with JAX-native SPMD: a `jax.sharding.Mesh` + `jax.jit` with
+`NamedSharding`s; XLA inserts the ICI collectives (psum of dense grads)
+that DDP performed explicitly.
+"""
+
+from persia_tpu.parallel.mesh import data_parallel_mesh, batch_sharding, replicated  # noqa: F401
+from persia_tpu.parallel.train_step import (  # noqa: F401
+    TrainState,
+    build_eval_step,
+    build_train_step,
+    init_train_state,
+)

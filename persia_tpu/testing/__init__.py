@@ -1,0 +1,3 @@
+"""Test/bench utilities: deterministic synthetic datasets + metrics."""
+
+from persia_tpu.testing.synthetic import SyntheticClickDataset, roc_auc  # noqa: F401

@@ -7,7 +7,17 @@ before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: the machine env pins JAX_PLATFORMS=axon (the real TPU chip)
+# and sitecustomize pre-imports jax._src, so both the env var and the already-
+# imported config must be set before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.device_count() == 8, (
+    f"test harness expected 8 virtual CPU devices, got {jax.devices()}"
+)

@@ -1,0 +1,71 @@
+"""End-to-end determinism oracle (ref: adult-income CI oracle,
+`examples/src/adult-income/train.py:146-150` asserts an exact AUC with
+REPRODUCIBLE=1, staleness=1, world_size=1).
+
+Here: seeded synthetic CTR data, DNN model, hybrid sparse(Adagrad)/dense(Adam)
+training. Assertions: (a) test AUC clears a quality bar, (b) two fresh runs
+produce bit-identical AUC (full-pipeline determinism)."""
+
+import jax
+import numpy as np
+import optax
+
+from persia_tpu.config import EmbeddingConfig, HyperParameters, SlotConfig
+from persia_tpu.ctx import TrainCtx
+from persia_tpu.embedding.optim import Adagrad
+from persia_tpu.embedding.store import EmbeddingStore
+from persia_tpu.embedding.worker import EmbeddingWorker
+from persia_tpu.models import DNN
+from persia_tpu.testing import SyntheticClickDataset, roc_auc
+
+VOCABS = (64, 32, 16, 100, 50, 8)
+
+
+def _run_once(num_replicas=1) -> float:
+    cfg = EmbeddingConfig(
+        slots_config={f"cat_{i}": SlotConfig(dim=8) for i in range(len(VOCABS))},
+        feature_index_prefix_bit=8,
+    )
+    stores = [
+        EmbeddingStore(
+            capacity=1 << 18,
+            num_internal_shards=4,
+            optimizer=Adagrad(lr=0.1).config,
+            seed=7,
+        )
+        for _ in range(num_replicas)
+    ]
+    worker = EmbeddingWorker(cfg, stores)
+    train = SyntheticClickDataset(num_samples=4096, vocab_sizes=VOCABS, seed=42)
+    test = SyntheticClickDataset(num_samples=1024, vocab_sizes=VOCABS, seed=43)
+
+    with TrainCtx(
+        model=DNN(dense_mlp_size=16, sparse_mlp_size=64, hidden_sizes=(64, 32)),
+        dense_optimizer=optax.adam(3e-3),
+        embedding_optimizer=Adagrad(lr=0.1),
+        worker=worker,
+        embedding_config=cfg,
+    ) as ctx:
+        for epoch in range(4):
+            for batch in train.batches(batch_size=128):
+                ctx.train_step(batch)
+        preds, labels = [], []
+        for batch in test.batches(batch_size=128, requires_grad=False):
+            preds.append(ctx.eval_batch(batch))
+            labels.append(batch.labels[0].data)
+    return roc_auc(np.concatenate(labels), np.concatenate(preds))
+
+
+def test_e2e_auc_and_determinism():
+    auc1 = _run_once()
+    assert auc1 > 0.82, f"test AUC too low: {auc1}"
+    auc2 = _run_once()
+    assert auc1 == auc2, f"non-deterministic: {auc1} vs {auc2}"
+
+
+def test_e2e_sharded_ps_same_quality():
+    """3-replica sharded PS reaches the same AUC as single-replica (routing
+    must not change learned values — same stores, same seeds)."""
+    auc3 = _run_once(num_replicas=3)
+    assert auc3 > 0.82, f"sharded AUC too low: {auc3}"
+    assert auc3 == _run_once(num_replicas=1)

@@ -87,6 +87,18 @@ def test_lru_eviction_parity():
         assert (py.get_embedding_entry(s) is None) == (cc.get_embedding_entry(s) is None)
 
 
+def test_infer_dim_mismatch_parity():
+    """Entry's own dim gates infer reads in both backends (no optimizer-state
+    bytes served as embeddings)."""
+    py, cc = _pair(Adam(lr=0.1).config)
+    signs = np.array([21], dtype=np.uint64)
+    py.lookup(signs, 4, True)
+    cc.lookup(signs, 4, True)
+    np.testing.assert_array_equal(py.lookup(signs, 8, False), np.zeros((1, 8)))
+    np.testing.assert_array_equal(cc.lookup(signs, 8, False), np.zeros((1, 8)))
+    np.testing.assert_array_equal(py.lookup(signs, 4, False), cc.lookup(signs, 4, False))
+
+
 def test_dim_mismatch_reinit_parity():
     py, cc = _pair(SGD().config)
     signs = np.array([7], dtype=np.uint64)

@@ -59,6 +59,19 @@ def test_dim_mismatch_reinit():
     assert len(s.get_embedding_entry(7)) == 8  # SGD: no state
 
 
+def test_infer_never_reads_optimizer_state_as_embedding():
+    """Regression: entry trained at dim 4 with Adam (entry len 12) must NOT
+    satisfy an infer lookup at dim 8 by handing back [emb | adam state]."""
+    s = _store(optimizer=Adam(lr=0.1).config)
+    signs = np.array([21], dtype=np.uint64)
+    s.lookup(signs, 4, train=True)
+    assert len(s.get_embedding_entry(21)) == 12  # 4 emb + 8 adam state
+    out = s.lookup(signs, 8, train=False)
+    np.testing.assert_array_equal(out, np.zeros((1, 8)))
+    # matching dim still serves the embedding
+    assert (s.lookup(signs, 4, train=False) != 0).any()
+
+
 def test_admit_probability_gate():
     hp0 = HyperParameters(admit_probability=0.0)
     s = _store(hyperparams=hp0)
