@@ -1,0 +1,107 @@
+"""Benchmark: hybrid DLRM training throughput on the real TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config mirrors the Criteo-DLRM shape (BASELINE.json): 13 dense features,
+26 single-id categorical slots (dim 16), batch 4096, C++ parameter-server
+core on the host CPU feeding a jitted bf16 DLRM step on the TPU.
+
+``vs_baseline`` is measured samples/sec divided by REF_SAMPLES_PER_SEC — a
+fixed placeholder for per-A100 DLRM throughput with remote embedding servers
+(order of magnitude from public MLPerf DLRM-dcnv2 single-GPU results; the
+reference repo publishes no absolute throughput numbers, see BASELINE.md).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REF_SAMPLES_PER_SEC = 100_000.0
+
+BATCH_SIZE = 4096
+N_DENSE = 13
+N_SLOTS = 26
+EMB_DIM = 16
+VOCAB = 1_000_000
+WARMUP_STEPS = 3
+MEASURE_STEPS = 20
+
+
+def main():
+    import optax
+
+    from persia_tpu.config import EmbeddingConfig, SlotConfig
+    from persia_tpu.ctx import TrainCtx
+    from persia_tpu.data import IDTypeFeature, Label, NonIDTypeFeature, PersiaBatch
+    from persia_tpu.embedding.native_store import create_store
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.embedding.worker import EmbeddingWorker
+    from persia_tpu.models import DLRM
+
+    cfg = EmbeddingConfig(
+        slots_config={f"cat_{i}": SlotConfig(dim=EMB_DIM) for i in range(N_SLOTS)},
+        feature_index_prefix_bit=8,
+    )
+    store = create_store(
+        "auto",
+        capacity=1 << 24,
+        num_internal_shards=32,
+        optimizer=Adagrad(lr=0.05).config,
+        seed=1,
+    )
+    worker = EmbeddingWorker(cfg, [store], num_threads=16)
+    model = DLRM(embedding_dim=EMB_DIM, bottom_mlp=(256, 64, EMB_DIM), top_mlp=(512, 256))
+    ctx = TrainCtx(
+        model=model,
+        dense_optimizer=optax.adam(1e-3),
+        embedding_optimizer=Adagrad(lr=0.05),
+        worker=worker,
+        embedding_config=cfg,
+    ).__enter__()
+
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        ids = [
+            IDTypeFeature(
+                f"cat_{i}",
+                list(rng.integers(0, VOCAB, (BATCH_SIZE, 1), dtype=np.uint64)),
+            )
+            for i in range(N_SLOTS)
+        ]
+        return PersiaBatch(
+            ids,
+            non_id_type_features=[
+                NonIDTypeFeature(rng.normal(size=(BATCH_SIZE, N_DENSE)).astype(np.float32))
+            ],
+            labels=[Label(rng.integers(0, 2, (BATCH_SIZE, 1)).astype(np.float32))],
+            requires_grad=True,
+        )
+
+    batches = [make_batch() for _ in range(8)]
+    for i in range(WARMUP_STEPS):
+        ctx.train_step(batches[i % len(batches)])
+
+    t0 = time.perf_counter()
+    for i in range(MEASURE_STEPS):
+        ctx.train_step(batches[i % len(batches)])
+    elapsed = time.perf_counter() - t0
+
+    samples_per_sec = MEASURE_STEPS * BATCH_SIZE / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "dlrm_criteo_shape_samples_per_sec_per_chip",
+                "value": round(samples_per_sec, 1),
+                "unit": "samples/sec",
+                "vs_baseline": round(samples_per_sec / REF_SAMPLES_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -210,6 +210,23 @@ class TrainCtx(EmbeddingCtx):
             "preds": np.asarray(metrics["preds"]),
         }
 
+    def train_step_prepared(self, training_batch, loader) -> Dict:
+        """Pipelined step: consume a ``PersiaTrainingBatch`` from a
+        ``DataLoader``; the embedding gradients return asynchronously through
+        the loader's BackwardEngine (bounded staleness). The TPU step of batch
+        N overlaps the lookup of batch N+k (ref: forward.rs pipeline +
+        backward.rs)."""
+        device_batch = training_batch.device_batch
+        if self.state is None:
+            self.init_state(jax.random.PRNGKey(0), device_batch)
+        try:
+            self.state, metrics, emb_grads = self._train_step(self.state, device_batch)
+        except Exception:
+            loader.mark_consumed(training_batch)
+            raise
+        loader.backward(training_batch, emb_grads, scale_factor=self.grad_scale)
+        return {"loss": float(metrics["loss"]), "preds": np.asarray(metrics["preds"])}
+
     def eval_batch(self, batch: PersiaBatch) -> np.ndarray:
         emb_batches = self.worker.forward_directly(batch, train=False)
         device_batch, _ = self.prepare_features(batch, emb_batches)

@@ -1,0 +1,307 @@
+"""The pipelined host feeder: prefetch, bounded staleness, reorder, and the
+background gradient-return engine.
+
+Parity target: the reference's Forward engine
+(`rust/persia-core/src/forward.rs`): an input channel, an optional reorder
+worker (min-heap on batch_id for reproducibility, forward.rs:396-468), N
+lookup workers gated by the ``embedding_staleness`` semaphore
+(forward.rs:509-511,686-701), and a postprocess/staging worker; plus the
+Backward engine (`backward.rs`): a 2-stage pipeline returning gradients and
+releasing staleness permits (backward.rs:304-354).
+
+TPU-first shape: workers are Python threads (the hot work — C++ PS calls and
+numpy staging — releases the GIL); "copy to device" is ``device_put`` with
+mesh shardings instead of pinned-pool cudaMemcpyAsync; the staleness
+semaphore bounds how many batches may run ahead of their gradient return,
+exactly the reference's bounded-async knob. The asynchrony argument
+(README.md:56): embedding lookup for batch N+k overlaps the TPU step of
+batch N.
+"""
+
+from __future__ import annotations
+
+import heapq
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+
+from persia_tpu.data import PersiaBatch
+from persia_tpu.logger import get_default_logger
+
+logger = get_default_logger("persia_tpu.data_loader")
+
+_SENTINEL = object()
+
+
+@dataclass
+class PersiaTrainingBatch:
+    """What the loader yields: a fully staged step input
+    (ref: PersiaTrainingBatch, forward.rs:38-99 + embedding2tensor)."""
+
+    ref: int
+    batch: PersiaBatch
+    emb_batches: List
+    device_batch: Dict
+    counts: List
+    batch_id: Optional[int] = None
+
+
+class _WorkerError:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class BackwardEngine:
+    """Asynchronous gradient return (ref: backward.rs).
+
+    ``push`` enqueues (ref, slot_grads); worker threads apply
+    ``worker.update_gradient_batched`` and release the staleness permit.
+    ``flush`` blocks until every pushed gradient has been applied (used at
+    eval/checkpoint boundaries)."""
+
+    def __init__(
+        self,
+        emb_worker,
+        release_permit: Callable[[], None],
+        num_workers: int = 2,
+        queue_size: int = 32,
+    ):
+        self._worker = emb_worker
+        self._release = release_permit
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self._pending = 0
+        self._lock = threading.Lock()
+        self._done = threading.Condition(self._lock)
+        self._error: Optional[BaseException] = None
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True, name=f"backward-{i}")
+            for i in range(num_workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def push(self, ref: int, slot_grads: Dict, scale_factor: float = 1.0) -> None:
+        with self._lock:
+            if self._error is not None:
+                raise RuntimeError("backward engine failed") from self._error
+            self._pending += 1
+        self._q.put((ref, slot_grads, scale_factor))
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is _SENTINEL:
+                return
+            ref, slot_grads, scale = item
+            try:
+                self._worker.update_gradient_batched(ref, slot_grads, scale_factor=scale)
+            except BaseException as e:  # noqa: BLE001 — propagate to trainer
+                self._worker.abort_gradient(ref)
+                with self._lock:
+                    self._error = e
+            finally:
+                self._release()
+                with self._lock:
+                    self._pending -= 1
+                    self._done.notify_all()
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        with self._lock:
+            ok = self._done.wait_for(lambda: self._pending == 0, timeout=timeout)
+            if not ok:
+                raise TimeoutError("backward engine flush timed out")
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise RuntimeError("backward engine failed") from err
+
+    def shutdown(self):
+        for _ in self._threads:
+            self._q.put(_SENTINEL)
+        for t in self._threads:
+            t.join(timeout=5)
+
+
+class DataLoader:
+    """Pipelined iterator over a ``PersiaBatch`` source
+    (ref: persia/data.py:228-271 DataLoader owning the Rust Forward engine).
+
+    - ``staleness``: max batches allowed past lookup before their gradients
+      return (Semaphore; ref forward.rs:509-511). The permit is released by
+      the ``BackwardEngine`` after the update lands, or by ``mark_consumed``
+      for requires_grad=False streams.
+    - ``reproducible``: process + yield strictly in batch_id order
+      (ref: PerisaDataOrderManager min-heap, forward.rs:396-468).
+    - ``num_workers``: concurrent lookup workers (ref: forward_worker count).
+    """
+
+    def __init__(
+        self,
+        dataset: Iterable[PersiaBatch],
+        ctx,
+        num_workers: int = 3,
+        staleness: int = 4,
+        reproducible: bool = False,
+        buffer_size: int = 8,
+        timeout_s: float = 120.0,
+    ):
+        if staleness < 1:
+            raise ValueError("staleness must be >= 1")
+        self.dataset = dataset
+        self.ctx = ctx
+        self.num_workers = 1 if reproducible else max(1, num_workers)
+        self.reproducible = reproducible
+        self.buffer_size = buffer_size
+        self.timeout_s = timeout_s
+        self.staleness_sem = threading.Semaphore(staleness)
+        self.backward_engine = BackwardEngine(
+            ctx.worker, release_permit=self.staleness_sem.release
+        )
+        self._threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------------- pipeline
+
+    def _feed(self, in_q: "queue.Queue"):
+        try:
+            next_id = 0
+            for batch in self.dataset:
+                if batch.batch_id is None:
+                    batch.batch_id = next_id
+                next_id = batch.batch_id + 1
+                in_q.put(batch)
+        except BaseException as e:  # noqa: BLE001
+            in_q.put(_WorkerError(e))
+        finally:
+            in_q.put(_SENTINEL)
+
+    def _reorder(self, in_q: "queue.Queue", out_q: "queue.Queue"):
+        """Strict batch_id-order emitter (ref: forward.rs:396-468)."""
+        heap: List = []
+        expect: Optional[int] = None
+        seq = 0  # tiebreak: duplicate batch_ids must not compare PersiaBatch
+        try:
+            while True:
+                item = in_q.get()
+                if item is _SENTINEL or isinstance(item, _WorkerError):
+                    for _, _, b in sorted(heap):
+                        out_q.put(b)
+                    out_q.put(item)
+                    return
+                heapq.heappush(heap, (item.batch_id, seq, item))
+                seq += 1
+                if expect is None:
+                    expect = heap[0][0]
+                while heap and heap[0][0] <= expect:
+                    bid, _, b = heapq.heappop(heap)
+                    out_q.put(b)
+                    expect = bid + 1
+        except BaseException as e:  # noqa: BLE001
+            out_q.put(_WorkerError(e))
+
+    def _lookup_worker(self, in_q: "queue.Queue", out_q: "queue.Queue"):
+        while True:
+            item = in_q.get()
+            if item is _SENTINEL or isinstance(item, _WorkerError):
+                in_q.put(item)  # let sibling workers see the sentinel too
+                out_q.put(item)
+                return
+            batch = item
+            self.staleness_sem.acquire()  # bounded async (forward.rs:686-690)
+            try:
+                train = batch.requires_grad
+                ref = self.ctx.worker.put_forward_ids(batch)
+                emb_batches = self.ctx.worker.forward_batch_id(ref, train=train)
+                device_batch, counts = self.ctx.prepare_features(batch, emb_batches)
+                out_q.put(
+                    PersiaTrainingBatch(
+                        ref=ref,
+                        batch=batch,
+                        emb_batches=emb_batches,
+                        device_batch=device_batch,
+                        counts=counts,
+                        batch_id=batch.batch_id,
+                    )
+                )
+            except BaseException as e:  # noqa: BLE001
+                self.staleness_sem.release()
+                out_q.put(_WorkerError(e))
+                return
+
+    # ------------------------------------------------------------- consumer
+
+    def __iter__(self) -> Iterator[PersiaTrainingBatch]:
+        in_q: "queue.Queue" = queue.Queue(maxsize=self.buffer_size)
+        staged_q: "queue.Queue" = queue.Queue(maxsize=self.buffer_size)
+        self._threads = [threading.Thread(target=self._feed, args=(in_q,), daemon=True)]
+        if self.reproducible:
+            mid_q: "queue.Queue" = queue.Queue(maxsize=self.buffer_size)
+            self._threads.append(
+                threading.Thread(target=self._reorder, args=(in_q, mid_q), daemon=True)
+            )
+            lookup_in = mid_q
+        else:
+            lookup_in = in_q
+        for _ in range(self.num_workers):
+            self._threads.append(
+                threading.Thread(
+                    target=self._lookup_worker, args=(lookup_in, staged_q), daemon=True
+                )
+            )
+        for t in self._threads:
+            t.start()
+
+        finished_workers = 0
+        emit_heap: List = []
+        expect: Optional[int] = None
+        try:
+            while True:
+                try:
+                    item = staged_q.get(timeout=self.timeout_s)
+                except queue.Empty:
+                    raise TimeoutError(
+                        f"no staged batch within {self.timeout_s}s "
+                        f"(staleness deadlock? forgot to call backward()/mark_consumed()?)"
+                    ) from None
+                if isinstance(item, _WorkerError):
+                    raise RuntimeError("data pipeline worker failed") from item.exc
+                if item is _SENTINEL:
+                    finished_workers += 1
+                    if finished_workers >= self.num_workers:
+                        for _, _, tb in sorted(emit_heap):
+                            yield tb
+                        return
+                    continue
+                if self.reproducible:
+                    heapq.heappush(emit_heap, (item.batch_id, item.ref, item))
+                    if expect is None:
+                        expect = emit_heap[0][0]
+                    while emit_heap and emit_heap[0][0] == expect:
+                        yield heapq.heappop(emit_heap)[2]
+                        expect += 1
+                else:
+                    yield item
+        finally:
+            self.backward_engine.flush(timeout=self.timeout_s)
+
+    # --------------------------------------------------------------- grads
+
+    def backward(
+        self, training_batch: PersiaTrainingBatch, emb_grads, scale_factor: float = 1.0
+    ) -> None:
+        """Queue this batch's embedding gradients for asynchronous return."""
+        slot_grads = self.ctx.emb_grads_to_slot_grads(
+            training_batch.emb_batches, emb_grads, training_batch.counts
+        )
+        self.backward_engine.push(training_batch.ref, slot_grads, scale_factor)
+
+    def mark_consumed(self, training_batch: PersiaTrainingBatch) -> None:
+        """Release the staleness permit for a no-gradient batch (eval)."""
+        if training_batch.batch.requires_grad:
+            self.ctx.worker.abort_gradient(training_batch.ref)
+        self.staleness_sem.release()
+
+    def flush(self):
+        self.backward_engine.flush(timeout=self.timeout_s)
+
+    def shutdown(self):
+        self.backward_engine.shutdown()

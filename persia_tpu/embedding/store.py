@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import io
 import struct
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -86,6 +87,10 @@ class EmbeddingStore:
         per_shard = max(1, capacity // num_internal_shards)
         self._shards = [_Shard(per_shard) for _ in range(num_internal_shards)]
         self._num_shards = num_internal_shards
+        # one coarse lock: this numpy store is the correctness golden model,
+        # called concurrently by the DataLoader's lookup/backward threads
+        # (the C++ core has fine-grained per-shard mutexes instead)
+        self._lock = threading.RLock()
         self.hyperparams = hyperparams
         self.optimizer = optimizer
         self.seed = seed
@@ -134,6 +139,10 @@ class EmbeddingStore:
         init (or zeros if rejected). Infer: zeros on miss, no touch, no admit
         (ref: embedding_parameter_service/mod.rs:162-262).
         """
+        with self._lock:
+            return self._lookup_locked(signs, dim, train)
+
+    def _lookup_locked(self, signs: np.ndarray, dim: int, train: bool) -> np.ndarray:
         out = np.zeros((len(signs), dim), dtype=np.float32)
         entry_len = dim + self._state_dim(dim)
         for i, s in enumerate(signs.tolist()):
@@ -160,8 +169,9 @@ class EmbeddingStore:
         """Advance Adam's per-group beta powers once per gradient batch."""
         if self.optimizer is None:
             return
-        prev = self._batch_state.get(group, self.optimizer.initial_batch_state())
-        self._batch_state[group] = self.optimizer.advance_batch_state(prev)
+        with self._lock:
+            prev = self._batch_state.get(group, self.optimizer.initial_batch_state())
+            self._batch_state[group] = self.optimizer.advance_batch_state(prev)
 
     def update_gradients(self, signs: np.ndarray, grads: np.ndarray, group: int = 0) -> None:
         """Apply the registered sparse optimizer to each sign's entry, then
@@ -172,6 +182,12 @@ class EmbeddingStore:
             raise RuntimeError("no optimizer registered")
         if grads.shape[0] != len(signs):
             raise ValueError("signs/grads length mismatch")
+        dim = grads.shape[1]
+        entry_len = dim + self._state_dim(dim)
+        with self._lock:
+            self._update_locked(signs, grads, group)
+
+    def _update_locked(self, signs: np.ndarray, grads: np.ndarray, group: int) -> None:
         dim = grads.shape[1]
         entry_len = dim + self._state_dim(dim)
         batch_state = self._batch_state.get(group, self.optimizer.advance_batch_state(
@@ -198,24 +214,29 @@ class EmbeddingStore:
         embedding dim (defaults to the full row = stateless entries)."""
         if dim is None:
             dim = values.shape[1]
-        for i, s in enumerate(signs.tolist()):
-            self._shard_of(s).insert(s, dim, values[i].astype(np.float32).copy())
+        with self._lock:
+            for i, s in enumerate(signs.tolist()):
+                self._shard_of(s).insert(s, dim, values[i].astype(np.float32).copy())
 
     def get_embedding_entry(self, sign: int) -> Optional[np.ndarray]:
-        e = self._shard_of(sign).get(sign)
-        return None if e is None else e[1]
+        with self._lock:
+            e = self._shard_of(sign).get(sign)
+            return None if e is None else e[1]
 
     def get_entry_dim(self, sign: int) -> Optional[int]:
-        e = self._shard_of(sign).get(sign)
-        return None if e is None else e[0]
+        with self._lock:
+            e = self._shard_of(sign).get(sign)
+            return None if e is None else e[0]
 
     def clear(self) -> None:
-        for shard in self._shards:
-            shard.entries.clear()
-        self._batch_state.clear()
+        with self._lock:
+            for shard in self._shards:
+                shard.entries.clear()
+            self._batch_state.clear()
 
     def size(self) -> int:
-        return sum(len(s) for s in self._shards)
+        with self._lock:
+            return sum(len(s) for s in self._shards)
 
     @property
     def num_internal_shards(self) -> int:
@@ -226,9 +247,10 @@ class EmbeddingStore:
     def dump_shard(self, shard_idx: int) -> bytes:
         """Serialize one internal shard (checkpoint unit, ref:
         model-manager:242-343 dumps per internal shard)."""
-        shard = self._shards[shard_idx]
         buf = io.BytesIO()
-        buf.write(struct.pack("<I", len(shard.entries)))
+        with self._lock:
+            shard = self._shards[shard_idx]
+            buf.write(struct.pack("<I", len(shard.entries)))
         for sign, (dim, vec) in shard.entries.items():
             buf.write(struct.pack("<QII", sign, dim, len(vec)))
             buf.write(vec.tobytes())
@@ -239,10 +261,11 @@ class EmbeddingStore:
         the re-shard-on-load path, ref: emb_worker:1150-1259)."""
         buf = io.BytesIO(raw)
         (n,) = struct.unpack("<I", buf.read(4))
-        for _ in range(n):
-            sign, dim, ln = struct.unpack("<QII", buf.read(16))
-            vec = np.frombuffer(buf.read(4 * ln), dtype=np.float32).copy()
-            self._shard_of(sign).insert(sign, dim, vec)
+        with self._lock:
+            for _ in range(n):
+                sign, dim, ln = struct.unpack("<QII", buf.read(16))
+                vec = np.frombuffer(buf.read(4 * ln), dtype=np.float32).copy()
+                self._shard_of(sign).insert(sign, dim, vec)
         return n
 
     def state_dict(self) -> Dict:

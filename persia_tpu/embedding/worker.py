@@ -20,7 +20,9 @@ does the scatter-add via XLA, replacing torch ``index_add_``).
 
 from __future__ import annotations
 
+import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Union
 
@@ -160,10 +162,15 @@ class ShardedLookup:
                 out[mask] = self.replicas[r].lookup(keys[mask], dim, train)
         return out
 
+    def advance_batch_state(self, group: int) -> None:
+        for r in self.replicas:
+            r.advance_batch_state(group)
+
     def update(self, keys: np.ndarray, grads: np.ndarray, group: int) -> None:
+        """Fan one slot's keyed gradients out to the owning replicas. The
+        caller advances Adam batch state once per gradient batch (not per
+        slot — matches the reference's batch-level beta powers)."""
         n = len(self.replicas)
-        for r in range(n):
-            self.replicas[r].advance_batch_state(group)
         if n == 1:
             self.replicas[0].update_gradients(keys, grads, group)
             return
@@ -275,6 +282,7 @@ class EmbeddingWorker:
         hyperparams: HyperParameters = HyperParameters(),
         forward_buffer_size: int = 1000,
         buffered_data_expired_sec: int = 3600,
+        num_threads: int = 8,
     ):
         self.embedding_config = embedding_config
         self.lookup_router = ShardedLookup(replicas)
@@ -285,30 +293,42 @@ class EmbeddingWorker:
         self.post_forward_buffer: Dict[int, ProcessedBatch] = {}
         self.staleness = 0
         self._ref_id = 0
+        # guards buffers + staleness gauge + ref counter against the
+        # DataLoader's concurrent lookup/backward threads
+        self._buf_lock = threading.Lock()
+        # serializes gradient batches so Adam batch-state advance + apply is
+        # atomic per batch (slots within a batch still fan out in parallel)
+        self._grad_lock = threading.Lock()
+        # per-slot parallelism: the native store's ctypes calls release the
+        # GIL, so slot fan-out gets true CPU parallelism (the reference fans
+        # lookups out across tokio tasks, mod.rs:874-942)
+        self._pool = ThreadPoolExecutor(max_workers=max(1, num_threads))
 
     # -------------------------------------------------- data-loader side API
 
     def can_forward_batched(self) -> bool:
         """Backpressure + expiry of stale buffered batches (ref: mod.rs:991-1029)."""
         now = time.time()
-        expired = [
-            k
-            for k, v in self.forward_id_buffer.items()
-            if now - v.created_at > self.buffered_data_expired_sec
-        ]
-        for k in expired:
-            del self.forward_id_buffer[k]
-        return len(self.forward_id_buffer) < self.forward_buffer_size
+        with self._buf_lock:
+            expired = [
+                k
+                for k, v in self.forward_id_buffer.items()
+                if now - v.created_at > self.buffered_data_expired_sec
+            ]
+            for k in expired:
+                del self.forward_id_buffer[k]
+            return len(self.forward_id_buffer) < self.forward_buffer_size
 
     def put_forward_ids(self, batch: PersiaBatch) -> int:
         """Buffer a batch's preprocessed ids, return the remote ref id
         (ref: forward_batched NATS entry, mod.rs:1512-1530)."""
-        self._ref_id += 1
-        ref = self._ref_id
         processed = preprocess_batch(
             batch.id_type_features, self.embedding_config, batch_id=batch.batch_id
         )
-        self.forward_id_buffer[ref] = processed
+        with self._buf_lock:
+            self._ref_id += 1
+            ref = self._ref_id
+            self.forward_id_buffer[ref] = processed
         return ref
 
     # ----------------------------------------------------- nn-worker side API
@@ -316,11 +336,15 @@ class EmbeddingWorker:
     def forward_batch_id(self, ref: int, train: bool = True) -> List[FeatureEmbeddingBatch]:
         """Train path: take buffered ids, lookup, stash for the gradient
         round-trip (ref: mod.rs:1031-1074)."""
-        processed = self.forward_id_buffer.pop(ref)
-        out = [lookup_slot(s, self.lookup_router, train) for s in processed.slots]
+        with self._buf_lock:
+            processed = self.forward_id_buffer.pop(ref)
+        out = list(
+            self._pool.map(lambda s: lookup_slot(s, self.lookup_router, train), processed.slots)
+        )
         if train:
-            self.post_forward_buffer[ref] = processed
-            self.staleness += 1
+            with self._buf_lock:
+                self.post_forward_buffer[ref] = processed
+                self.staleness += 1
         return out
 
     def forward_directly(
@@ -328,14 +352,17 @@ class EmbeddingWorker:
     ) -> List[FeatureEmbeddingBatch]:
         """Lookup-direct path for eval/infer (ref: mod.rs:1076-1107)."""
         processed = preprocess_batch(batch.id_type_features, self.embedding_config)
-        return [lookup_slot(s, self.lookup_router, train) for s in processed.slots]
+        return list(
+            self._pool.map(lambda s: lookup_slot(s, self.lookup_router, train), processed.slots)
+        )
 
     def abort_gradient(self, ref: int) -> None:
         """Drop a stashed post-forward batch without applying gradients (the
         NN worker's step failed); releases the staleness slot so the pipeline
         and buffers cannot leak."""
-        if self.post_forward_buffer.pop(ref, None) is not None:
-            self.staleness = max(0, self.staleness - 1)
+        with self._buf_lock:
+            if self.post_forward_buffer.pop(ref, None) is not None:
+                self.staleness = max(0, self.staleness - 1)
 
     def update_gradient_batched(
         self, ref: int, slot_grads: Dict[str, np.ndarray], scale_factor: float = 1.0
@@ -343,17 +370,34 @@ class EmbeddingWorker:
         """Gradient return: pop the stashed layout, convert device grads to
         per-key grads, fan out to PS replicas (ref: mod.rs:1109-1129,703-872).
         Returns per-slot skip info for metrics."""
-        processed = self.post_forward_buffer.pop(ref)
-        self.staleness = max(0, self.staleness - 1)
+        with self._buf_lock:
+            processed = self.post_forward_buffer.pop(ref)
+            self.staleness = max(0, self.staleness - 1)
         skipped = {}
-        for slot in processed.slots:
+
+        def one_slot(slot):
             grad = slot_grads.get(slot.name)
             if grad is None:
-                continue
+                return None
             per_key = slot_gradient_to_keys(slot, grad, scale_factor)
             if per_key is None:
-                skipped[slot.name] = 1
-                continue
+                return slot.name
             group = self.embedding_config.group_of(slot.name)
             self.lookup_router.update(slot.keys, per_key, group)
+            return None
+
+        # gradient batches are serialized so the Adam batch-state advance is
+        # atomic with its batch's updates (ref: batch-level beta powers,
+        # optim.rs:99-221); slots within the batch still fan out in parallel
+        with self._grad_lock:
+            groups = {
+                self.embedding_config.group_of(s.name)
+                for s in processed.slots
+                if s.name in slot_grads
+            }
+            for g in sorted(groups):
+                self.lookup_router.advance_batch_state(g)
+            for name in self._pool.map(one_slot, processed.slots):
+                if name is not None:
+                    skipped[name] = 1
         return skipped

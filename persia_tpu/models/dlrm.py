@@ -32,7 +32,7 @@ class DLRM(nn.Module):
     @nn.compact
     def __call__(self, non_id_features: List, embeddings: List, train: bool = True):
         dt = self.compute_dtype
-        dense = non_id_features[0].astype(dt)
+        dense = jnp.concatenate([f.astype(dt) for f in non_id_features], axis=1)
         bottom = _mlp(dense, self.bottom_mlp, dt)  # (B, d)
 
         embs = []

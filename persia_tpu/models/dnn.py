@@ -23,7 +23,7 @@ class DNN(nn.Module):
     @nn.compact
     def __call__(self, non_id_features: List, embeddings: List, train: bool = True):
         dt = self.compute_dtype
-        dense_x = non_id_features[0].astype(dt)
+        dense_x = jnp.concatenate([f.astype(dt) for f in non_id_features], axis=1)
 
         parts = []
         for emb in embeddings:
