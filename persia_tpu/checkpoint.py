@@ -1,0 +1,262 @@
+"""Sparse checkpoint subsystem: per-shard files, done markers, re-shard on
+load, async status machine.
+
+Parity target: `rust/persia-model-manager/src/lib.rs`:
+- status machine {Dumping(progress), Loading(progress), Idle, Failed}
+  (lib.rs:63-69)
+- per-internal-shard files ``replica_{r}_shard_{i}.emb`` (lib.rs:242-343)
+- done-marker file ``embedding_dump_done`` with model info (lib.rs:152-198);
+  master waits for all replicas (lib.rs:200-240)
+- load = parallel file reads → insert (lib.rs:375-425); replica-count change
+  re-shards by sign routing (ref: emb_worker:1150-1259)
+
+File payloads use the store's shard wire format (u32 count, then per entry
+u64 sign / u32 dim / u32 len / f32 data) — identical for the numpy and C++
+backends."""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from persia_tpu.embedding.hashing import sign_to_shard
+from persia_tpu.logger import get_default_logger
+
+logger = get_default_logger("persia_tpu.checkpoint")
+
+DONE_MARKER = "embedding_dump_done"
+
+
+class ModelManagerStatus:
+    """Thread-safe status machine (ref: lib.rs:63-69)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = "idle"
+        self._progress = 0.0
+        self._error: Optional[str] = None
+
+    def set(self, state: str, progress: float = 0.0, error: Optional[str] = None):
+        with self._lock:
+            self._state, self._progress, self._error = state, progress, error
+
+    def get(self) -> Dict:
+        with self._lock:
+            return {"status": self._state, "progress": self._progress, "error": self._error}
+
+
+def _shard_file(dst_dir: str, replica: int, shard: int) -> str:
+    return os.path.join(dst_dir, f"replica_{replica}_shard_{shard}.emb")
+
+
+def _replica_marker(dst_dir: str, replica: int) -> str:
+    return os.path.join(dst_dir, f"replica_{replica}_done")
+
+
+def _read_json(path: str) -> Optional[Dict]:
+    try:
+        with open(path) as f:
+            return json.loads(f.read())
+    except (OSError, ValueError):
+        return None
+
+
+def dump_store(
+    store,
+    dst_dir: str,
+    replica_index: int = 0,
+    replica_size: int = 1,
+    status: Optional[ModelManagerStatus] = None,
+    num_io_threads: int = 4,
+    session: Optional[str] = None,
+) -> None:
+    """Dump one PS replica's shards in parallel + markers. The last replica to
+    finish writes the master done-marker (ref: lib.rs:200-240).
+
+    ``session`` tags this dump across replicas: stale markers from a previous
+    dump into the same directory cannot prematurely complete this one. The
+    caller fanning out to replicas passes one shared session id; a lone
+    replica can leave it None (a fresh one is derived from the start time).
+    """
+    status = status or ModelManagerStatus()
+    status.set("dumping", 0.0)
+    session = session or f"s{time.time_ns()}"
+    try:
+        os.makedirs(dst_dir, exist_ok=True)
+        # invalidate any previous dump in this directory before writing
+        done_path = os.path.join(dst_dir, DONE_MARKER)
+        if os.path.exists(done_path):
+            os.remove(done_path)
+        my_marker = _replica_marker(dst_dir, replica_index)
+        if os.path.exists(my_marker):
+            os.remove(my_marker)
+        n = store.num_internal_shards
+        for old in os.listdir(dst_dir):
+            if old.startswith(f"replica_{replica_index}_shard_"):
+                idx = old.split("_shard_")[1].split(".")[0]
+                if idx.isdigit() and int(idx) >= n:
+                    os.remove(os.path.join(dst_dir, old))
+        done = 0
+        lock = threading.Lock()
+
+        def dump_one(i: int):
+            nonlocal done
+            blob = store.dump_shard(i)
+            tmp = _shard_file(dst_dir, replica_index, i) + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, _shard_file(dst_dir, replica_index, i))
+            with lock:
+                done += 1
+                status.set("dumping", done / n)
+
+        with ThreadPoolExecutor(max_workers=num_io_threads) as pool:
+            list(pool.map(dump_one, range(n)))
+
+        with open(my_marker + ".tmp", "w") as f:
+            f.write(
+                json.dumps(
+                    {"num_internal_shards": n, "session": session, "time": time.time()}
+                )
+            )
+        os.replace(my_marker + ".tmp", my_marker)
+
+        # master marker once every replica's marker exists FOR THIS SESSION
+        markers = [
+            _read_json(_replica_marker(dst_dir, r)) for r in range(replica_size)
+        ]
+        if all(m is not None and m.get("session") == session for m in markers):
+            info = {
+                "num_replicas": replica_size,
+                "session": session,
+                "datetime": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            }
+            with open(done_path + ".tmp", "w") as f:
+                f.write(json.dumps(info))
+            os.replace(done_path + ".tmp", done_path)
+        status.set("idle", 1.0)
+    except Exception as e:
+        status.set("failed", error=repr(e))
+        raise
+
+
+def checkpoint_info(src_dir: str) -> Dict:
+    with open(os.path.join(src_dir, DONE_MARKER)) as f:
+        return json.loads(f.read())
+
+
+def _iter_entries(blob: bytes):
+    buf = io.BytesIO(blob)
+    (n,) = struct.unpack("<I", buf.read(4))
+    for _ in range(n):
+        header = buf.read(16)
+        sign, dim, ln = struct.unpack("<QII", header)
+        data = buf.read(4 * ln)
+        yield sign, header, data
+
+
+def _filter_blob_for_replica(blob: bytes, replica_index: int, replica_size: int) -> bytes:
+    """Keep only entries this replica owns under the current sign routing
+    (the cross-replica re-shard path, ref: emb_worker:1192-1259)."""
+    if replica_size <= 1:
+        return blob
+    kept: List[bytes] = []
+    count = 0
+    signs: List[int] = []
+    parts: List[bytes] = []
+    for sign, header, data in _iter_entries(blob):
+        signs.append(sign)
+        parts.append(header + data)
+    if not signs:
+        return struct.pack("<I", 0)
+    owner = sign_to_shard(np.array(signs, dtype=np.uint64), replica_size)
+    for i, own in enumerate(owner.tolist()):
+        if own == replica_index:
+            kept.append(parts[i])
+            count += 1
+    return struct.pack("<I", count) + b"".join(kept)
+
+
+def load_store(
+    store,
+    src_dir: str,
+    replica_index: int = 0,
+    replica_size: int = 1,
+    status: Optional[ModelManagerStatus] = None,
+    num_io_threads: int = 4,
+    require_marker: bool = True,
+) -> int:
+    """Load every shard file in the checkpoint into this replica, filtering by
+    current sign routing (works across replica- AND internal-shard-count
+    changes — entries re-route on insert). Returns entries loaded."""
+    status = status or ModelManagerStatus()
+    status.set("loading", 0.0)
+    try:
+        info = _read_json(os.path.join(src_dir, DONE_MARKER))
+        if info is None:
+            if require_marker:
+                raise FileNotFoundError(
+                    f"no valid {DONE_MARKER} in {src_dir} (incomplete dump?)"
+                )
+            # markerless fallback: load every .emb file, filtered
+            files = sorted(f for f in os.listdir(src_dir) if f.endswith(".emb"))
+            need_filter = replica_size > 1
+        else:
+            # marker-driven: only files the recorded topology actually wrote
+            dumped_replicas = int(info["num_replicas"])
+            files = []
+            for r in range(dumped_replicas):
+                if dumped_replicas == replica_size and r != replica_index:
+                    continue  # same topology → only our own replica's files
+                marker = _read_json(_replica_marker(src_dir, r))
+                shards = int(marker["num_internal_shards"]) if marker else 0
+                files += [
+                    os.path.basename(_shard_file(src_dir, r, i)) for i in range(shards)
+                ]
+            # same topology: our own files hold exactly our signs — no filter
+            need_filter = dumped_replicas != replica_size
+        total = len(files)
+        loaded = 0
+        done = 0
+        lock = threading.Lock()
+
+        def load_one(fname: str) -> int:
+            nonlocal done
+            with open(os.path.join(src_dir, fname), "rb") as f:
+                blob = f.read()
+            if need_filter:
+                blob = _filter_blob_for_replica(blob, replica_index, replica_size)
+            n = store.load_shard_bytes(blob)
+            with lock:
+                done += 1
+                status.set("loading", done / max(total, 1))
+            return n
+
+        with ThreadPoolExecutor(max_workers=num_io_threads) as pool:
+            loaded = sum(pool.map(load_one, files))
+        status.set("idle", 1.0)
+        return loaded
+    except Exception as e:
+        status.set("failed", error=repr(e))
+        raise
+
+
+def dump_dense(state_bytes: bytes, dst_dir: str, name: str = "dense.ckpt") -> None:
+    os.makedirs(dst_dir, exist_ok=True)
+    tmp = os.path.join(dst_dir, name + ".tmp")
+    with open(tmp, "wb") as f:
+        f.write(state_bytes)
+    os.replace(tmp, os.path.join(dst_dir, name))
+
+
+def load_dense(src_dir: str, name: str = "dense.ckpt") -> bytes:
+    with open(os.path.join(src_dir, name), "rb") as f:
+        return f.read()

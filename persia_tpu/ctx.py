@@ -178,8 +178,7 @@ class TrainCtx(EmbeddingCtx):
     def __enter__(self):
         # register the sparse optimizer on every PS replica
         # (ref: embedding_optimizer.apply(), persia/ctx.py:854-858)
-        for replica in self.worker.lookup_router.replicas:
-            replica.register_optimizer(self.embedding_optimizer.config)
+        self.worker.register_optimizer(self.embedding_optimizer.config)
         return self
 
     def init_state(self, rng, sample_batch: Dict) -> TrainState:

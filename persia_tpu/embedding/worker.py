@@ -304,6 +304,51 @@ class EmbeddingWorker:
         # lookups out across tokio tasks, mod.rs:874-942)
         self._pool = ThreadPoolExecutor(max_workers=max(1, num_threads))
 
+    def dump(self, path: str, blocking: bool = True) -> None:
+        """Checkpoint fan-out to all PS replicas (ref: emb_worker dump,
+        mod.rs:1131-1148). Works with both RPC StoreClients (the server dumps
+        its own shards) and in-process stores. One shared session id ties the
+        replicas' markers together so stale markers from an earlier dump into
+        the same directory cannot complete this one."""
+        import time as _time
+
+        from persia_tpu.checkpoint import dump_store
+
+        session = f"s{_time.time_ns()}"
+        n = len(self.lookup_router.replicas)
+        for i, r in enumerate(self.lookup_router.replicas):
+            if hasattr(r, "dump_to_dir"):
+                r.dump_to_dir(path, blocking=blocking, session=session)
+            else:
+                dump_store(r, path, replica_index=i, replica_size=n, session=session)
+
+    def load(self, path: str) -> int:
+        """Checkpoint load fan-out; entries re-route by sign so replica/shard
+        count changes re-shard transparently (ref: emb_worker:1150-1259)."""
+        from persia_tpu.checkpoint import load_store
+
+        n = len(self.lookup_router.replicas)
+        total = 0
+        for i, r in enumerate(self.lookup_router.replicas):
+            if hasattr(r, "load_from_dir"):
+                total += r.load_from_dir(path)
+            else:
+                total += load_store(r, path, replica_index=i, replica_size=n)
+        return total
+
+    def register_optimizer(self, optimizer) -> None:
+        """Fan the sparse-optimizer registration to every PS replica
+        (ref: register_optimizer fan-out, emb_worker:1286-1307)."""
+        for r in self.lookup_router.replicas:
+            r.register_optimizer(optimizer)
+
+    def configure(self, hyperparams: HyperParameters) -> None:
+        """Push runtime hyperparameters to every PS replica
+        (ref: configure_embedding_parameter_servers)."""
+        self.hyperparams = hyperparams
+        for r in self.lookup_router.replicas:
+            r.configure(hyperparams)
+
     # -------------------------------------------------- data-loader side API
 
     def can_forward_batched(self) -> bool:

@@ -1,0 +1,171 @@
+"""ServiceCtx: single-machine fake cluster for tests and quick starts.
+
+Parity target: `persia/helper.py:125-331` — spawns nats-server + embedding
+workers + parameter servers as local subprocesses with random ports so
+integration tests exercise the real multi-process topology without a
+cluster; includes a crash watchdog (helper.py:296-315).
+
+Here: an in-process Coordinator + N parameter-server subprocesses + M
+embedding-worker subprocesses; `worker_clients()` hands back RPC clients
+with the EmbeddingWorker surface for TrainCtx/DataLoader.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import List, Optional
+
+from persia_tpu.config import EmbeddingConfig
+from persia_tpu.logger import get_default_logger
+from persia_tpu.service.clients import StoreClient, WorkerClient
+from persia_tpu.service.discovery import Coordinator, CoordinatorClient
+
+logger = get_default_logger("persia_tpu.helper")
+
+
+class ServiceCtx:
+    def __init__(
+        self,
+        num_parameter_servers: int = 1,
+        num_embedding_workers: int = 1,
+        embedding_config_path: Optional[str] = None,
+        global_config_path: Optional[str] = None,
+        capacity: int = 1 << 18,
+        num_internal_shards: int = 4,
+        backend: str = "auto",
+        seed: int = 0,
+        startup_timeout_s: float = 60.0,
+    ):
+        self.n_ps = num_parameter_servers
+        self.n_workers = num_embedding_workers
+        self.embedding_config_path = embedding_config_path
+        self.global_config_path = global_config_path
+        self.capacity = capacity
+        self.num_internal_shards = num_internal_shards
+        self.backend = backend
+        self.seed = seed
+        self.startup_timeout_s = startup_timeout_s
+        self.procs: List[subprocess.Popen] = []
+        self.coordinator: Optional[Coordinator] = None
+        self._watchdog_stop = threading.Event()
+        self._crashed: Optional[str] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def __enter__(self) -> "ServiceCtx":
+        try:
+            return self._enter_impl()
+        except BaseException:
+            # __exit__ never runs if __enter__ raises: reap spawned services
+            self._teardown()
+            raise
+
+    def _enter_impl(self) -> "ServiceCtx":
+        self.coordinator = Coordinator(port=0).start()
+        coord_addr = f"127.0.0.1:{self.coordinator.port}"
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            + os.pathsep
+            + env.get("PYTHONPATH", "")
+        )
+        # services never need a TPU; keep them off the chip
+        env["JAX_PLATFORMS"] = "cpu"
+
+        for i in range(self.n_ps):
+            cmd = [
+                sys.executable, "-m", "persia_tpu.service.ps_server",
+                "--replica-index", str(i), "--replica-size", str(self.n_ps),
+                "--coordinator", coord_addr,
+                "--capacity", str(self.capacity),
+                "--num-internal-shards", str(self.num_internal_shards),
+                "--backend", self.backend, "--seed", str(self.seed),
+            ]
+            if self.global_config_path:
+                cmd += ["--global-config", self.global_config_path]
+            self.procs.append(subprocess.Popen(cmd, env=env))
+
+        for i in range(self.n_workers):
+            cmd = [
+                sys.executable, "-m", "persia_tpu.service.worker_server",
+                "--replica-index", str(i), "--replica-size", str(self.n_workers),
+                "--coordinator", coord_addr,
+                "--num-parameter-servers", str(self.n_ps),
+            ]
+            if self.embedding_config_path:
+                cmd += ["--embedding-config", self.embedding_config_path]
+            if self.global_config_path:
+                cmd += ["--global-config", self.global_config_path]
+            self.procs.append(subprocess.Popen(cmd, env=env))
+
+        self.coord_client = CoordinatorClient(coord_addr)
+        self.coord_client.wait_for(
+            "embedding_worker", self.n_workers, timeout_s=self.startup_timeout_s
+        )
+        self._watchdog = threading.Thread(target=self._watch, daemon=True)
+        self._watchdog.start()
+        return self
+
+    def _watch(self):
+        """Crash watchdog (ref: helper.py:296-315): if any service process
+        dies, record it so clients fail fast instead of hanging."""
+        while not self._watchdog_stop.wait(0.5):
+            for p in self.procs:
+                rc = p.poll()
+                if rc is not None and rc != 0:
+                    self._crashed = f"service pid {p.pid} exited with {rc}"
+                    logger.error(self._crashed)
+                    return
+
+    def check_healthy(self):
+        if self._crashed:
+            raise RuntimeError(self._crashed)
+
+    def __exit__(self, *exc):
+        self._watchdog_stop.set()
+        try:
+            for client in self.worker_clients():
+                try:
+                    client.shutdown(shutdown_servers=True)
+                except Exception:
+                    pass
+        except Exception:
+            pass
+        self._teardown()
+        return False
+
+    def _teardown(self):
+        deadline = time.time() + 5
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.terminate()
+        for p in self.procs:
+            if p.poll() is None:
+                p.kill()
+        for p in self.procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+        if self.coordinator:
+            self.coordinator.stop()
+
+    # -------------------------------------------------------------- clients
+
+    def worker_addrs(self) -> List[str]:
+        return self.coord_client.list("embedding_worker")
+
+    def ps_addrs(self) -> List[str]:
+        return self.coord_client.list("parameter_server")
+
+    def worker_clients(self) -> List[WorkerClient]:
+        return [WorkerClient(a) for a in self.worker_addrs()]
+
+    def ps_clients(self) -> List[StoreClient]:
+        return [StoreClient(a) for a in self.ps_addrs()]

@@ -1,0 +1,111 @@
+"""`persia-tpu-launcher` CLI.
+
+Parity target: `persia/launcher.py` (click CLI with subcommands nn-worker /
+data-loader / embedding-worker / embedding-parameter-server, env-var entry
+fallbacks `PERSIA_NN_WORKER_ENTRY` etc). Here argparse (no click dependency);
+server roles exec this package's service modules; trainer/data-loader roles
+exec user scripts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from typing import List, Optional
+
+
+def _user_entry(args_entry: Optional[str], env_key: str, default: str) -> str:
+    return args_entry or os.environ.get(env_key, default)
+
+
+def _run(cmd: List[str], extra_env: dict) -> int:
+    env = dict(os.environ)
+    env.update({k: str(v) for k, v in extra_env.items() if v is not None})
+    return subprocess.call(cmd, env=env)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser("persia-tpu-launcher")
+    sub = ap.add_subparsers(dest="role", required=True)
+
+    nn = sub.add_parser("nn-worker", help="launch the TPU training script")
+    nn.add_argument("entry", nargs="?", default=None)
+    nn.add_argument("--nproc-per-node", type=int, default=1)
+    nn.add_argument("--node-rank", type=int, default=0)
+    nn.add_argument("--nnodes", type=int, default=1)
+
+    dl = sub.add_parser("data-loader", help="launch the data-loader script")
+    dl.add_argument("entry", nargs="?", default=None)
+    dl.add_argument("--replica-index", type=int, default=0)
+    dl.add_argument("--replica-size", type=int, default=1)
+
+    for name in ("embedding-worker", "embedding-parameter-server"):
+        p = sub.add_parser(name, help=f"launch the {name} service")
+        p.add_argument("--port", type=int, default=0)
+        p.add_argument("--replica-index", type=int, default=0)
+        p.add_argument("--replica-size", type=int, default=1)
+        p.add_argument("--coordinator", type=str, default=os.environ.get("PERSIA_COORDINATOR_ADDR"))
+        p.add_argument("--global-config", type=str, default=None)
+        p.add_argument("--embedding-config", type=str, default=None)
+        if name == "embedding-worker":
+            p.add_argument("--num-parameter-servers", type=int, required=False,
+                           default=int(os.environ.get("PERSIA_NUM_PS", "1")))
+
+    coord = sub.add_parser("coordinator", help="run the discovery/control service")
+    coord.add_argument("--port", type=int, default=int(os.environ.get("PERSIA_COORDINATOR_PORT", "7799")))
+
+    args = ap.parse_args(argv)
+    py = sys.executable
+
+    if args.role == "nn-worker":
+        entry = _user_entry(args.entry, "PERSIA_NN_WORKER_ENTRY", "train.py")
+        # one TPU process per host: JAX owns all local chips (no
+        # torch.distributed.launch equivalent needed; multi-host uses
+        # jax.distributed.initialize via env)
+        return _run([py, entry], {"WORLD_SIZE": args.nnodes * args.nproc_per_node,
+                                  "RANK": args.node_rank, "LOCAL_RANK": 0})
+
+    if args.role == "data-loader":
+        entry = _user_entry(args.entry, "PERSIA_DATALOADER_ENTRY", "data_loader.py")
+        return _run([py, entry], {"REPLICA_INDEX": args.replica_index,
+                                  "REPLICA_SIZE": args.replica_size})
+
+    if args.role == "embedding-worker":
+        cmd = [py, "-m", "persia_tpu.service.worker_server",
+               "--port", str(args.port),
+               "--replica-index", str(args.replica_index),
+               "--replica-size", str(args.replica_size),
+               "--coordinator", args.coordinator or "127.0.0.1:7799",
+               "--num-parameter-servers", str(args.num_parameter_servers)]
+        if args.global_config:
+            cmd += ["--global-config", args.global_config]
+        if args.embedding_config:
+            cmd += ["--embedding-config", args.embedding_config]
+        return subprocess.call(cmd)
+
+    if args.role == "embedding-parameter-server":
+        cmd = [py, "-m", "persia_tpu.service.ps_server",
+               "--port", str(args.port),
+               "--replica-index", str(args.replica_index),
+               "--replica-size", str(args.replica_size)]
+        if args.coordinator:
+            cmd += ["--coordinator", args.coordinator]
+        if args.global_config:
+            cmd += ["--global-config", args.global_config]
+        return subprocess.call(cmd)
+
+    if args.role == "coordinator":
+        from persia_tpu.service.discovery import Coordinator
+
+        c = Coordinator(port=args.port).start()
+        print(f"coordinator on port {c.port}", flush=True)
+        c.server._thread.join()
+        return 0
+
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

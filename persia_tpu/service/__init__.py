@@ -1,0 +1,17 @@
+"""Multi-process service layer.
+
+Replaces the reference's three communication planes (§2.6 of SURVEY.md):
+
+- bulk tensors: custom HTTP/speedy/lz4 RPC (`rust/others/persia-rpc`) → here a
+  length-prefixed binary TCP RPC (`persia_tpu/service/rpc.py`) carrying the
+  framework's own wire formats;
+- control/discovery: NATS request-reply (`rust/others/persia-nats-client`) →
+  here a single lightweight coordinator service
+  (`persia_tpu/service/discovery.py`) with registration + waiting + backoff;
+- dense gradients: NCCL/DDP → XLA collectives over the TPU mesh (no service
+  needed; see persia_tpu/parallel).
+"""
+
+from persia_tpu.service.rpc import RpcClient, RpcError, RpcServer  # noqa: F401
+from persia_tpu.service.discovery import Coordinator, CoordinatorClient  # noqa: F401
+from persia_tpu.service.clients import StoreClient, WorkerClient  # noqa: F401

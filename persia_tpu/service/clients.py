@@ -1,0 +1,192 @@
+"""RPC client shims exposing the in-process store/worker surfaces.
+
+``StoreClient`` quacks like an ``EmbeddingStore`` (used by an embedding
+worker to reach remote parameter servers; ref: `EmbeddingParameterServiceClient`,
+embedding_parameter_service/mod.rs:498-593). ``WorkerClient`` quacks like an
+``EmbeddingWorker`` (used by TrainCtx/DataLoader on the NN worker; ref:
+`EmbeddingWorkerClient`, embedding_worker_service/mod.rs:1379-1491)."""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Optional
+
+import numpy as np
+
+from persia_tpu.config import HyperParameters
+from persia_tpu.data import PersiaBatch
+from persia_tpu.embedding.optim import OptimizerConfig
+from persia_tpu.service import proto
+from persia_tpu.service.rpc import RpcClient
+
+
+class StoreClient:
+    """Parameter-server RPC client with the EmbeddingStore surface."""
+
+    def __init__(self, addr: str, timeout_s: float = 120.0):
+        self.addr = addr
+        self._rpc = RpcClient(addr, timeout_s=timeout_s)
+
+    def wait_ready(self, timeout_s: float = 60.0) -> None:
+        self._rpc.wait_ready(timeout_s)
+
+    def lookup(self, signs: np.ndarray, dim: int, train: bool) -> np.ndarray:
+        # train lookups mutate (LRU/admit) but are retry-safe: re-running a
+        # lookup converges to the same entries, so idempotent for RPC purposes
+        raw = self._rpc.call(
+            "lookup", proto.pack_lookup_request(signs, dim, train), idempotent=True
+        )
+        return np.frombuffer(raw, dtype=np.float32).reshape(len(signs), dim).copy()
+
+    def update_gradients(self, signs: np.ndarray, grads: np.ndarray, group: int = 0) -> None:
+        self._rpc.call("update_gradients", proto.pack_update_request(signs, grads, group))
+
+    def advance_batch_state(self, group: int) -> None:
+        self._rpc.call("advance_batch_state", struct.pack("<i", group))
+
+    def register_optimizer(self, optimizer: OptimizerConfig) -> None:
+        self._rpc.call("register_optimizer", proto.pack_json(optimizer.to_dict()))
+
+    def configure(self, hyperparams: HyperParameters) -> None:
+        self._rpc.call(
+            "configure",
+            proto.pack_json(
+                {
+                    "emb_initialization": list(hyperparams.emb_initialization),
+                    "admit_probability": hyperparams.admit_probability,
+                    "weight_bound": hyperparams.weight_bound,
+                }
+            ),
+        )
+
+    def set_embedding(
+        self, signs: np.ndarray, values: np.ndarray, dim: Optional[int] = None
+    ) -> None:
+        if dim is None:
+            dim = values.shape[1]
+        self._rpc.call("set_embedding", proto.pack_set_embedding(signs, values, dim))
+
+    def get_embedding_entry(self, sign: int) -> Optional[np.ndarray]:
+        raw = self._rpc.call("get_entry", struct.pack("<Q", sign), idempotent=True)
+        if not raw:
+            return None
+        return np.frombuffer(raw, dtype=np.float32).copy()
+
+    def size(self) -> int:
+        return struct.unpack("<q", self._rpc.call("size", idempotent=True))[0]
+
+    def clear(self) -> None:
+        self._rpc.call("clear")
+
+    def dump_shard(self, shard_idx: int) -> bytes:
+        return self._rpc.call(
+            "dump_shard", struct.pack("<I", shard_idx), idempotent=True, timeout_s=600.0
+        )
+
+    def load_shard_bytes(self, raw: bytes) -> int:
+        return struct.unpack("<q", self._rpc.call("load_shard", raw))[0]
+
+    @property
+    def num_internal_shards(self) -> int:
+        return struct.unpack("<I", self._rpc.call("num_shards"))[0]
+
+    def dump_to_dir(
+        self, path: str, blocking: bool = True, session: Optional[str] = None
+    ) -> None:
+        self._rpc.call(
+            "dump_to_dir",
+            proto.pack_json({"path": path, "blocking": blocking, "session": session}),
+            timeout_s=3600.0,
+        )
+
+    def load_from_dir(self, path: str) -> int:
+        return struct.unpack(
+            "<q", self._rpc.call("load_from_dir", path.encode(), timeout_s=3600.0)
+        )[0]
+
+    def model_manager_status(self) -> Dict:
+        return proto.unpack_json(self._rpc.call("model_manager_status", idempotent=True))
+
+    def shutdown(self) -> None:
+        try:
+            self._rpc.call("shutdown")
+        except Exception:
+            pass
+        self._rpc.close()
+
+
+class WorkerClient:
+    """Embedding-worker RPC client with the EmbeddingWorker surface used by
+    TrainCtx / DataLoader / DataCtx."""
+
+    def __init__(self, addr: str, timeout_s: float = 120.0):
+        self.addr = addr
+        self._rpc = RpcClient(addr, timeout_s=timeout_s)
+
+    def wait_ready(self, timeout_s: float = 60.0) -> None:
+        self._rpc.wait_ready(timeout_s)
+
+    def can_forward_batched(self) -> bool:
+        return self._rpc.call("can_forward_batched", idempotent=True) == b"1"
+
+    def put_forward_ids(self, batch: PersiaBatch) -> int:
+        return struct.unpack("<q", self._rpc.call("forward_batched", batch.to_bytes()))[0]
+
+    def forward_batch_id(self, ref: int, train: bool = True):
+        raw = self._rpc.call("forward_batch_id", struct.pack("<qB", ref, int(train)))  # takes the buffer entry: NOT retryable
+        return proto.unpack_emb_batches(raw)
+
+    def forward_directly(self, batch: PersiaBatch, train: bool = False):
+        raw = self._rpc.call(
+            "forward_directly", struct.pack("<B", int(train)) + batch.to_bytes()
+        )
+        return proto.unpack_emb_batches(raw)
+
+    def update_gradient_batched(
+        self, ref: int, slot_grads: Dict[str, np.ndarray], scale_factor: float = 1.0
+    ) -> Dict[str, int]:
+        raw = self._rpc.call(
+            "update_gradient_batched",
+            struct.pack("<q", ref) + proto.pack_slot_grads(slot_grads, scale_factor),
+        )
+        return proto.unpack_json(raw)
+
+    def abort_gradient(self, ref: int) -> None:
+        self._rpc.call("abort_gradient", struct.pack("<q", ref))
+
+    def register_optimizer(self, optimizer: OptimizerConfig) -> None:
+        self._rpc.call("register_optimizer", proto.pack_json(optimizer.to_dict()))
+
+    def configure(self, hyperparams: HyperParameters) -> None:
+        self._rpc.call(
+            "configure",
+            proto.pack_json(
+                {
+                    "emb_initialization": list(hyperparams.emb_initialization),
+                    "admit_probability": hyperparams.admit_probability,
+                    "weight_bound": hyperparams.weight_bound,
+                }
+            ),
+        )
+
+    @property
+    def staleness(self) -> int:
+        return struct.unpack("<q", self._rpc.call("staleness", idempotent=True))[0]
+
+    def dump(self, path: str, blocking: bool = True) -> None:
+        self._rpc.call(
+            "dump", proto.pack_json({"path": path, "blocking": blocking}),
+            timeout_s=3600.0,
+        )
+
+    def load(self, path: str) -> int:
+        return struct.unpack("<q", self._rpc.call("load", path.encode(), timeout_s=3600.0))[0]
+
+    def shutdown(self, shutdown_servers: bool = False) -> None:
+        try:
+            if shutdown_servers:
+                self._rpc.call("shutdown_servers")
+            self._rpc.call("shutdown")
+        except Exception:
+            pass
+        self._rpc.close()

@@ -1,0 +1,105 @@
+"""Coordinator: the control plane replacing NATS.
+
+The reference discovers services through NATS subjects with exponential
+backoff (`rust/persia-core/src/nats.rs:156-216`, `others/persia-nats-client`)
+and publishes the DDP master address through `MasterDiscoveryService`
+(nats.rs:22-100). Here one tiny RPC service does registration, listing,
+readiness barriers, and small key-value payloads (e.g. the optimizer config
+pushed at context entry, replacing `publish_register_optimizer`)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from persia_tpu.service import proto
+from persia_tpu.service.rpc import RpcClient, RpcServer
+
+
+class Coordinator:
+    """In-process coordinator service (run it inside any long-lived process,
+    typically the launcher or rank-0 trainer)."""
+
+    def __init__(self, port: int = 0):
+        self._registry: Dict[str, Dict[int, str]] = {}  # role -> index -> addr
+        self._kv: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+        self.server = RpcServer(port=port)
+        self.server.register("register", self._register)
+        self.server.register("list", self._list)
+        self.server.register("kv_put", self._kv_put)
+        self.server.register("kv_get", self._kv_get)
+        self.port = self.server.port
+
+    def start(self) -> "Coordinator":
+        self.server.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.stop()
+
+    def _register(self, payload: bytes) -> bytes:
+        req = proto.unpack_json(payload)
+        with self._lock:
+            self._registry.setdefault(req["role"], {})[int(req["index"])] = req["addr"]
+        return b"ok"
+
+    def _list(self, payload: bytes) -> bytes:
+        role = payload.decode()
+        with self._lock:
+            members = self._registry.get(role, {})
+            return proto.pack_json(
+                [members[i] for i in sorted(members)]
+            )
+
+    def _kv_put(self, payload: bytes) -> bytes:
+        req = proto.unpack_json(payload[: payload.index(b"\x00")])
+        value = payload[payload.index(b"\x00") + 1 :]
+        with self._lock:
+            self._kv[req["key"]] = value
+        return b"ok"
+
+    def _kv_get(self, payload: bytes) -> bytes:
+        with self._lock:
+            return self._kv.get(payload.decode(), b"")
+
+
+class CoordinatorClient:
+    def __init__(self, addr: str, timeout_s: float = 30.0):
+        self._client = RpcClient(addr, timeout_s=timeout_s)
+
+    def register(self, role: str, index: int, addr: str) -> None:
+        # registration is a keyed upsert → safe to retry
+        self._client.call(
+            "register",
+            proto.pack_json({"role": role, "index": index, "addr": addr}),
+            idempotent=True,
+        )
+
+    def list(self, role: str) -> List[str]:
+        return proto.unpack_json(self._client.call("list", role.encode(), idempotent=True))
+
+    def wait_for(self, role: str, count: int, timeout_s: float = 120.0) -> List[str]:
+        """Readiness barrier with backoff (ref: nats.rs:162-216)."""
+        deadline = time.time() + timeout_s
+        delay = 0.1
+        while True:
+            addrs = self.list(role)
+            if len(addrs) >= count:
+                return addrs
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"waited {timeout_s}s for {count} {role!r}, have {len(addrs)}"
+                )
+            time.sleep(delay)
+            delay = min(delay * 2, 2.0)
+
+    def kv_put(self, key: str, value: bytes) -> None:
+        self._client.call("kv_put", proto.pack_json({"key": key}) + b"\x00" + value)
+
+    def kv_get(self, key: str) -> bytes:
+        return self._client.call("kv_get", key.encode(), idempotent=True)
+
+    def close(self):
+        self._client.close()

@@ -1,0 +1,137 @@
+"""Payload codecs for service RPC (the framework's speedy replacement).
+
+Small tag-free formats per message family: ndarrays travel as
+(dtype code, ndim, shape, raw bytes) like persia_tpu.data's wire helpers;
+structured configs travel as JSON (control plane only — never on the hot
+path)."""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from persia_tpu.embedding.worker import RawEmbeddingBatch, SumEmbeddingBatch
+
+
+def pack_ndarray(a: np.ndarray) -> bytes:
+    a = np.ascontiguousarray(a)
+    header = struct.pack("<10sB", a.dtype.str.encode().ljust(10), a.ndim)
+    return header + struct.pack(f"<{a.ndim}q", *a.shape) + a.tobytes()
+
+
+def unpack_ndarray(buf: io.BytesIO) -> np.ndarray:
+    dtype_s, ndim = struct.unpack("<10sB", buf.read(11))
+    shape = struct.unpack(f"<{ndim}q", buf.read(8 * ndim))
+    dtype = np.dtype(dtype_s.rstrip(b"\x00").rstrip().decode())
+    n = int(np.prod(shape)) if shape else 1
+    return np.frombuffer(buf.read(n * dtype.itemsize), dtype=dtype).reshape(shape).copy()
+
+
+def pack_ndarrays(arrays: Sequence[np.ndarray]) -> bytes:
+    out = struct.pack("<H", len(arrays))
+    return out + b"".join(pack_ndarray(a) for a in arrays)
+
+
+def unpack_ndarrays(buf: io.BytesIO) -> List[np.ndarray]:
+    (n,) = struct.unpack("<H", buf.read(2))
+    return [unpack_ndarray(buf) for _ in range(n)]
+
+
+def pack_json(obj) -> bytes:
+    return json.dumps(obj).encode()
+
+
+def unpack_json(raw: bytes):
+    return json.loads(raw.decode())
+
+
+# ---------------------------------------------------------- lookup/update
+
+
+def pack_lookup_request(signs: np.ndarray, dim: int, train: bool) -> bytes:
+    return struct.pack("<IB", dim, int(train)) + pack_ndarray(signs)
+
+
+def unpack_lookup_request(raw: bytes) -> Tuple[np.ndarray, int, bool]:
+    dim, train = struct.unpack("<IB", raw[:5])
+    signs = unpack_ndarray(io.BytesIO(raw[5:]))
+    return signs, dim, bool(train)
+
+
+def pack_update_request(signs: np.ndarray, grads: np.ndarray, group: int) -> bytes:
+    return struct.pack("<i", group) + pack_ndarrays([signs, grads])
+
+
+def unpack_update_request(raw: bytes) -> Tuple[np.ndarray, np.ndarray, int]:
+    (group,) = struct.unpack("<i", raw[:4])
+    signs, grads = unpack_ndarrays(io.BytesIO(raw[4:]))
+    return signs, grads, group
+
+
+def pack_set_embedding(signs: np.ndarray, values: np.ndarray, dim: int) -> bytes:
+    return struct.pack("<I", dim) + pack_ndarrays([signs, values])
+
+
+def unpack_set_embedding(raw: bytes) -> Tuple[np.ndarray, np.ndarray, int]:
+    (dim,) = struct.unpack("<I", raw[:4])
+    signs, values = unpack_ndarrays(io.BytesIO(raw[4:]))
+    return signs, values, dim
+
+
+# ------------------------------------------------- embedding batch results
+
+
+def pack_emb_batches(batches: Sequence) -> bytes:
+    out = [struct.pack("<H", len(batches))]
+    for b in batches:
+        name = b.name.encode()
+        if isinstance(b, SumEmbeddingBatch):
+            out.append(struct.pack("<BH", 0, len(name)) + name)
+            out.append(pack_ndarray(b.pooled))
+        elif isinstance(b, RawEmbeddingBatch):
+            out.append(struct.pack("<BH", 1, len(name)) + name)
+            out.append(pack_ndarrays([b.distinct, b.index, b.sample_id_num]))
+        else:
+            raise TypeError(type(b))
+    return b"".join(out)
+
+
+def unpack_emb_batches(raw: bytes) -> List:
+    buf = io.BytesIO(raw)
+    (n,) = struct.unpack("<H", buf.read(2))
+    out: List = []
+    for _ in range(n):
+        kind, nlen = struct.unpack("<BH", buf.read(3))
+        name = buf.read(nlen).decode()
+        if kind == 0:
+            out.append(SumEmbeddingBatch(name, unpack_ndarray(buf)))
+        else:
+            distinct, index, sample_id_num = unpack_ndarrays(buf)
+            out.append(RawEmbeddingBatch(name, distinct, index, sample_id_num))
+    return out
+
+
+# --------------------------------------------------------- gradient batches
+
+
+def pack_slot_grads(slot_grads: Dict[str, np.ndarray], scale_factor: float) -> bytes:
+    out = [struct.pack("<fH", scale_factor, len(slot_grads))]
+    for name, g in slot_grads.items():
+        nb = name.encode()
+        out.append(struct.pack("<H", len(nb)) + nb + pack_ndarray(g))
+    return b"".join(out)
+
+
+def unpack_slot_grads(raw: bytes) -> Tuple[Dict[str, np.ndarray], float]:
+    buf = io.BytesIO(raw)
+    scale, n = struct.unpack("<fH", buf.read(6))
+    grads = {}
+    for _ in range(n):
+        (nlen,) = struct.unpack("<H", buf.read(2))
+        name = buf.read(nlen).decode()
+        grads[name] = unpack_ndarray(buf)
+    return grads, scale

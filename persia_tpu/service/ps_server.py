@@ -1,0 +1,204 @@
+"""Embedding parameter-server process.
+
+Parity target: `rust/persia-embedding-server/src/bin/
+persia-embedding-parameter-server.rs` (structopt CLI {port, replica_index,
+replica_size, configs}, hyper server with graceful shutdown, Infer mode loads
+a checkpoint at boot) and the RPC surface of
+`embedding_parameter_service/mod.rs:492-646`: ready_for_serving,
+model_manager_status, set_embedding, lookup, update_gradient, configure,
+register_optimizer, dump, load, size, clear, shutdown."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import struct
+import threading
+from typing import Optional
+
+import numpy as np
+
+from persia_tpu.checkpoint import ModelManagerStatus, dump_store, load_store
+from persia_tpu.config import HyperParameters
+from persia_tpu.embedding.optim import OptimizerConfig
+from persia_tpu.logger import get_default_logger
+from persia_tpu.service import proto
+from persia_tpu.service.discovery import CoordinatorClient
+from persia_tpu.service.rpc import RpcServer
+
+logger = get_default_logger("persia_tpu.ps_server")
+
+
+class ParameterServerService:
+    def __init__(
+        self,
+        store,
+        replica_index: int = 0,
+        replica_size: int = 1,
+        port: int = 0,
+    ):
+        self.store = store
+        self.replica_index = replica_index
+        self.replica_size = replica_size
+        self.status = ModelManagerStatus()
+        self.server = RpcServer(port=port)
+        s = self.server
+        s.register("lookup", self._lookup)
+        s.register("update_gradients", self._update)
+        s.register("advance_batch_state", self._advance)
+        s.register("register_optimizer", self._register_optimizer)
+        s.register("configure", self._configure)
+        s.register("set_embedding", self._set_embedding)
+        s.register("get_entry", self._get_entry)
+        s.register("size", lambda p: struct.pack("<q", self.store.size()))
+        s.register("clear", lambda p: (self.store.clear(), b"ok")[1])
+        s.register("num_shards", lambda p: struct.pack("<I", self.store.num_internal_shards))
+        s.register("dump_shard", self._dump_shard)
+        s.register("load_shard", self._load_shard)
+        s.register("dump_to_dir", self._dump_to_dir)
+        s.register("load_from_dir", self._load_from_dir)
+        s.register("model_manager_status", lambda p: proto.pack_json(self.status.get()))
+        s.register("replica_info", lambda p: proto.pack_json(
+            {"replica_index": self.replica_index, "replica_size": self.replica_size}
+        ))
+        self.port = s.port
+
+    # handlers -------------------------------------------------------------
+
+    def _lookup(self, payload: bytes) -> bytes:
+        signs, dim, train = proto.unpack_lookup_request(payload)
+        return self.store.lookup(signs, dim, train).tobytes()
+
+    def _update(self, payload: bytes) -> bytes:
+        signs, grads, group = proto.unpack_update_request(payload)
+        self.store.update_gradients(signs, grads, group)
+        return b"ok"
+
+    def _advance(self, payload: bytes) -> bytes:
+        (group,) = struct.unpack("<i", payload)
+        self.store.advance_batch_state(group)
+        return b"ok"
+
+    def _register_optimizer(self, payload: bytes) -> bytes:
+        self.store.register_optimizer(OptimizerConfig.from_dict(proto.unpack_json(payload)))
+        return b"ok"
+
+    def _configure(self, payload: bytes) -> bytes:
+        d = proto.unpack_json(payload)
+        self.store.configure(
+            HyperParameters(
+                emb_initialization=tuple(d["emb_initialization"]),
+                admit_probability=d["admit_probability"],
+                weight_bound=d["weight_bound"],
+            )
+        )
+        return b"ok"
+
+    def _set_embedding(self, payload: bytes) -> bytes:
+        signs, values, dim = proto.unpack_set_embedding(payload)
+        self.store.set_embedding(signs, values, dim)
+        return b"ok"
+
+    def _get_entry(self, payload: bytes) -> bytes:
+        (sign,) = struct.unpack("<Q", payload)
+        entry = self.store.get_embedding_entry(sign)
+        return b"" if entry is None else entry.astype(np.float32).tobytes()
+
+    def _dump_shard(self, payload: bytes) -> bytes:
+        (idx,) = struct.unpack("<I", payload)
+        return self.store.dump_shard(idx)
+
+    def _load_shard(self, payload: bytes) -> bytes:
+        return struct.pack("<q", self.store.load_shard_bytes(payload))
+
+    def _dump_to_dir(self, payload: bytes) -> bytes:
+        req = proto.unpack_json(payload)
+        kwargs = {"status": self.status, "session": req.get("session")}
+        if req.get("blocking", True):
+            dump_store(
+                self.store, req["path"], self.replica_index, self.replica_size, **kwargs
+            )
+        else:
+            threading.Thread(
+                target=dump_store,
+                args=(self.store, req["path"], self.replica_index, self.replica_size),
+                kwargs=kwargs,
+                daemon=True,
+            ).start()
+        return b"ok"
+
+    def _load_from_dir(self, payload: bytes) -> bytes:
+        n = load_store(
+            self.store, payload.decode(), self.replica_index, self.replica_size,
+            status=self.status,
+        )
+        return struct.pack("<q", n)
+
+    # lifecycle ------------------------------------------------------------
+
+    def start(self) -> "ParameterServerService":
+        self.server.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self.server.serve_forever()
+
+
+def main(argv: Optional[list] = None) -> None:
+    ap = argparse.ArgumentParser("persia-tpu-embedding-parameter-server")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--replica-index", type=int, default=None)
+    ap.add_argument("--replica-size", type=int, default=None)
+    ap.add_argument("--coordinator", type=str, default=None, help="host:port")
+    ap.add_argument("--advertise-host", type=str,
+                    default=os.environ.get("PERSIA_ADVERTISE_HOST", "127.0.0.1"),
+                    help="address other hosts use to reach this service")
+    ap.add_argument("--capacity", type=int, default=1 << 20)
+    ap.add_argument("--num-internal-shards", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--backend", type=str, default="auto",
+                    choices=["auto", "native", "numpy"])
+    ap.add_argument("--global-config", type=str, default=None)
+    ap.add_argument("--load-checkpoint", type=str, default=None,
+                    help="Infer-mode boot checkpoint (ref: ps bin :109-117)")
+    args = ap.parse_args(argv)
+
+    from persia_tpu import env
+    from persia_tpu.embedding.native_store import create_store
+
+    replica_index = (
+        args.replica_index if args.replica_index is not None else env.get_replica_index()
+    )
+    replica_size = (
+        args.replica_size if args.replica_size is not None else env.get_replica_size()
+    )
+
+    capacity, shards = args.capacity, args.num_internal_shards
+    if args.global_config:
+        from persia_tpu.config import load_global_config
+
+        g = load_global_config(args.global_config)
+        capacity = g.parameter_server.capacity
+        shards = g.parameter_server.num_hashmap_internal_shards
+
+    store = create_store(
+        args.backend, capacity=capacity, num_internal_shards=shards, seed=args.seed
+    )
+    svc = ParameterServerService(store, replica_index, replica_size, port=args.port)
+    svc.start()
+    logger.info(
+        "parameter server %d/%d on port %d", replica_index, replica_size, svc.port
+    )
+    if args.load_checkpoint:
+        load_store(store, args.load_checkpoint, replica_index, replica_size,
+                   status=svc.status)
+    if args.coordinator:
+        CoordinatorClient(args.coordinator).register(
+            "parameter_server", replica_index, f"{args.advertise_host}:{svc.port}"
+        )
+    # server runs in its background thread; park until the 'shutdown' RPC
+    svc.server._thread.join()
+
+
+if __name__ == "__main__":
+    main()

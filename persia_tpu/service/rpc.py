@@ -1,0 +1,214 @@
+"""Minimal binary RPC over TCP.
+
+Parity target: the reference's bespoke RPC crate (`others/persia-rpc/src/
+lib.rs:68-145` — hyper HTTP POST + speedy bodies + optional lz4) and its
+proc-macro-generated clients (`others/persia-rpc-macro`). Here: a
+length-prefixed framed protocol over raw TCP with optional zlib compression,
+a threaded server, and a reconnecting client. Python implementation is the
+round-1 shell; the C++ data-plane equivalent slots under the same framing.
+
+Frame:  u32 total_len | u8 flags | u16 method_len | method | payload
+Reply:  u32 total_len | u8 status (0 ok, 1 app error) | payload
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+import time
+import zlib
+from typing import Callable, Dict, Optional
+
+from persia_tpu.logger import get_default_logger
+
+logger = get_default_logger("persia_tpu.rpc")
+
+_FLAG_COMPRESSED = 1
+_MAX_FRAME = 1 << 31  # 2 GiB sanity bound
+
+
+class RpcError(RuntimeError):
+    pass
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        server: "RpcServer" = self.server.rpc_server  # type: ignore[attr-defined]
+        try:
+            while True:
+                header = _recv_exact(sock, 4)
+                (total,) = struct.unpack("<I", header)
+                if total > _MAX_FRAME:
+                    raise ConnectionError(f"oversized frame {total}")
+                frame = _recv_exact(sock, total)
+                flags = frame[0]
+                (mlen,) = struct.unpack("<H", frame[1:3])
+                method = frame[3 : 3 + mlen].decode()
+                payload = frame[3 + mlen :]
+                if flags & _FLAG_COMPRESSED:
+                    payload = zlib.decompress(payload)
+                fn = server.handlers.get(method)
+                if fn is None:
+                    reply, status = f"unknown method {method!r}".encode(), 1
+                else:
+                    try:
+                        reply, status = fn(payload) or b"", 0
+                    except Exception as e:  # noqa: BLE001 — app error crosses the wire
+                        logger.exception("handler %s failed", method)
+                        reply, status = repr(e).encode(), 1
+                sock.sendall(struct.pack("<IB", len(reply) + 1, status) + reply)
+                if method == "shutdown":
+                    server.stop()
+                    return
+        except (ConnectionError, OSError):
+            return
+
+
+class _ThreadedTCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class RpcServer:
+    """Threaded RPC server: ``handlers[name] = fn(payload: bytes) -> bytes``.
+    A built-in ``ping`` answers readiness probes; ``shutdown`` stops the
+    server after replying (graceful shutdown, ref: hyper servers in
+    bin/persia-embedding-worker.rs:70-78)."""
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0"):
+        self.handlers: Dict[str, Callable[[bytes], bytes]] = {
+            "ping": lambda p: b"pong",
+            "shutdown": lambda p: b"ok",  # framing layer stops after replying
+        }
+        self._server = _ThreadedTCPServer((host, port), _Handler)
+        self._server.rpc_server = self  # type: ignore[attr-defined]
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, name: str, fn: Callable[[bytes], bytes]) -> None:
+        self.handlers[name] = fn
+
+    def start(self) -> "RpcServer":
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        threading.Thread(target=self._server.shutdown, daemon=True).start()
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()
+
+
+class RpcClient:
+    """Reconnecting client with a per-connection lock (one in-flight call per
+    client; callers needing parallelism hold a client pool)."""
+
+    def __init__(
+        self,
+        addr: str,
+        timeout_s: float = 60.0,
+        compress_threshold: int = 1 << 20,
+        retries: int = 3,
+    ):
+        host, port = addr.rsplit(":", 1)
+        self.addr = (host, int(port))
+        self.timeout_s = timeout_s
+        self.compress_threshold = compress_threshold
+        self.retries = retries
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(self.addr, timeout=self.timeout_s)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+
+    def call(
+        self,
+        method: str,
+        payload: bytes = b"",
+        idempotent: bool = False,
+        timeout_s: Optional[float] = None,
+    ) -> bytes:
+        """Invoke ``method``. Transport errors retry with exponential backoff
+        ONLY for idempotent calls (ref concept: backoff-retry on NATS ops,
+        core/nats.rs:162-180) — retrying a gradient update or dump after a
+        dropped reply would double-apply it. ``timeout_s`` overrides the
+        client default for long blocking operations (dump/load)."""
+        last: Optional[Exception] = None
+        attempts = self.retries if idempotent else 1
+        for attempt in range(attempts):
+            try:
+                return self._call_once(method, payload, timeout_s)
+            except (ConnectionError, OSError, socket.timeout) as e:
+                last = e
+                self.close()
+                time.sleep(min(0.1 * 2**attempt, 2.0))
+        raise RpcError(
+            f"rpc {method} to {self.addr} failed"
+            + (" after retries" if attempts > 1 else "")
+        ) from last
+
+    def _call_once(
+        self, method: str, payload: bytes, timeout_s: Optional[float] = None
+    ) -> bytes:
+        flags = 0
+        if len(payload) >= self.compress_threshold:
+            payload = zlib.compress(payload, level=1)
+            flags |= _FLAG_COMPRESSED
+        m = method.encode()
+        frame = struct.pack("<BH", flags, len(m)) + m + payload
+        with self._lock:
+            sock = self._connect()
+            if timeout_s is not None:
+                sock.settimeout(timeout_s)
+            try:
+                sock.sendall(struct.pack("<I", len(frame)) + frame)
+                (total,) = struct.unpack("<I", _recv_exact(sock, 4))
+                body = _recv_exact(sock, total)
+            finally:
+                if timeout_s is not None:
+                    sock.settimeout(self.timeout_s)
+        status = body[0]
+        reply = body[1:]
+        if status != 0:
+            raise RpcError(f"rpc {method}: remote error: {reply.decode(errors='replace')}")
+        return reply
+
+    def wait_ready(self, timeout_s: float = 60.0) -> None:
+        deadline = time.time() + timeout_s
+        while True:
+            try:
+                if self.call("ping") == b"pong":
+                    return
+            except RpcError:
+                pass
+            if time.time() > deadline:
+                raise TimeoutError(f"service at {self.addr} not ready")
+            time.sleep(0.2)

@@ -1,0 +1,176 @@
+"""Embedding-worker process.
+
+Parity target: `rust/persia-embedding-server/src/bin/persia-embedding-worker.rs`
++ the worker RPC surface (`embedding_worker_service/mod.rs:1379-1561`):
+forward_batched (buffer ids, return remote ref), can_forward_batched,
+forward_batch_id, forward_directly, update_gradient_batched,
+register_optimizer, configure, dump/load fan-out to all PSs, shutdown(_server).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import struct
+import threading
+from typing import Optional
+
+from persia_tpu.data import PersiaBatch
+from persia_tpu.embedding.optim import OptimizerConfig
+from persia_tpu.embedding.worker import EmbeddingWorker
+from persia_tpu.config import HyperParameters
+from persia_tpu.logger import get_default_logger
+from persia_tpu.service import proto
+from persia_tpu.service.clients import StoreClient
+from persia_tpu.service.discovery import CoordinatorClient
+from persia_tpu.service.rpc import RpcServer
+
+logger = get_default_logger("persia_tpu.worker_server")
+
+
+class EmbeddingWorkerService:
+    def __init__(self, worker: EmbeddingWorker, port: int = 0):
+        self.worker = worker
+        self.server = RpcServer(port=port)
+        s = self.server
+        s.register("can_forward_batched", self._can_forward)
+        s.register("forward_batched", self._forward_batched)
+        s.register("forward_batch_id", self._forward_batch_id)
+        s.register("forward_directly", self._forward_directly)
+        s.register("update_gradient_batched", self._update_gradient)
+        s.register("abort_gradient", self._abort_gradient)
+        s.register("register_optimizer", self._register_optimizer)
+        s.register("configure", self._configure)
+        s.register("staleness", lambda p: struct.pack("<q", self.worker.staleness))
+        s.register("dump", self._dump)
+        s.register("load", self._load)
+        s.register("model_manager_status", self._status)
+        s.register("shutdown_servers", self._shutdown_servers)
+        self.port = s.port
+
+    def _can_forward(self, payload: bytes) -> bytes:
+        return b"1" if self.worker.can_forward_batched() else b"0"
+
+    def _forward_batched(self, payload: bytes) -> bytes:
+        batch = PersiaBatch.from_bytes(payload)
+        if not self.worker.can_forward_batched():
+            raise RuntimeError("forward buffer full")  # backpressure to sender
+        ref = self.worker.put_forward_ids(batch)
+        return struct.pack("<q", ref)
+
+    def _forward_batch_id(self, payload: bytes) -> bytes:
+        ref, train = struct.unpack("<qB", payload)
+        out = self.worker.forward_batch_id(ref, train=bool(train))
+        return proto.pack_emb_batches(out)
+
+    def _forward_directly(self, payload: bytes) -> bytes:
+        train = bool(payload[0])
+        batch = PersiaBatch.from_bytes(payload[1:])
+        return proto.pack_emb_batches(self.worker.forward_directly(batch, train=train))
+
+    def _update_gradient(self, payload: bytes) -> bytes:
+        (ref,) = struct.unpack("<q", payload[:8])
+        slot_grads, scale = proto.unpack_slot_grads(payload[8:])
+        skipped = self.worker.update_gradient_batched(ref, slot_grads, scale_factor=scale)
+        return proto.pack_json(skipped)
+
+    def _abort_gradient(self, payload: bytes) -> bytes:
+        (ref,) = struct.unpack("<q", payload)
+        self.worker.abort_gradient(ref)
+        return b"ok"
+
+    def _register_optimizer(self, payload: bytes) -> bytes:
+        cfg = OptimizerConfig.from_dict(proto.unpack_json(payload))
+        self.worker.register_optimizer(cfg)
+        return b"ok"
+
+    def _configure(self, payload: bytes) -> bytes:
+        d = proto.unpack_json(payload)
+        hp = HyperParameters(
+            emb_initialization=tuple(d["emb_initialization"]),
+            admit_probability=d["admit_probability"],
+            weight_bound=d["weight_bound"],
+        )
+        self.worker.configure(hp)
+        return b"ok"
+
+    def _dump(self, payload: bytes) -> bytes:
+        """Fan out to every PS (ref: emb_worker dump, mod.rs:1131-1148)."""
+        req = proto.unpack_json(payload)
+        self.worker.dump(req["path"], blocking=req.get("blocking", True))
+        return b"ok"
+
+    def _load(self, payload: bytes) -> bytes:
+        return struct.pack("<q", self.worker.load(payload.decode()))
+
+    def _status(self, payload: bytes) -> bytes:
+        sts = [r.model_manager_status() for r in self.worker.lookup_router.replicas]
+        return proto.pack_json(sts)
+
+    def _shutdown_servers(self, payload: bytes) -> bytes:
+        for r in self.worker.lookup_router.replicas:
+            r.shutdown()
+        return b"ok"
+
+    def start(self) -> "EmbeddingWorkerService":
+        self.server.start()
+        return self
+
+
+def main(argv: Optional[list] = None) -> None:
+    ap = argparse.ArgumentParser("persia-tpu-embedding-worker")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--replica-index", type=int, default=None)
+    ap.add_argument("--replica-size", type=int, default=None)
+    ap.add_argument("--coordinator", type=str, required=True)
+    ap.add_argument("--advertise-host", type=str,
+                    default=os.environ.get("PERSIA_ADVERTISE_HOST", "127.0.0.1"))
+    ap.add_argument("--num-parameter-servers", type=int, required=True)
+    ap.add_argument("--embedding-config", type=str, default=None)
+    ap.add_argument("--global-config", type=str, default=None)
+    ap.add_argument("--num-threads", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    from persia_tpu import env
+    from persia_tpu.config import EmbeddingConfig, load_embedding_config, load_global_config
+
+    replica_index = (
+        args.replica_index if args.replica_index is not None else env.get_replica_index()
+    )
+    replica_size = (
+        args.replica_size if args.replica_size is not None else env.get_replica_size()
+    )
+
+    emb_cfg = (
+        load_embedding_config(args.embedding_config)
+        if args.embedding_config
+        else EmbeddingConfig()
+    )
+    worker_kwargs = {}
+    if args.global_config:
+        g = load_global_config(args.global_config)
+        worker_kwargs = dict(
+            forward_buffer_size=g.embedding_worker.forward_buffer_size,
+            buffered_data_expired_sec=g.embedding_worker.buffered_data_expired_sec,
+        )
+
+    coord = CoordinatorClient(args.coordinator)
+    ps_addrs = coord.wait_for("parameter_server", args.num_parameter_servers)
+    replicas = [StoreClient(a) for a in ps_addrs]
+    for r in replicas:
+        r.wait_ready()
+
+    worker = EmbeddingWorker(
+        emb_cfg, replicas, num_threads=args.num_threads, **worker_kwargs
+    )
+    svc = EmbeddingWorkerService(worker, port=args.port).start()
+    logger.info(
+        "embedding worker %d/%d on port %d (%d parameter servers)",
+        replica_index, replica_size, svc.port, len(ps_addrs),
+    )
+    coord.register("embedding_worker", replica_index, f"{args.advertise_host}:{svc.port}")
+    svc.server._thread.join()
+
+
+if __name__ == "__main__":
+    main()

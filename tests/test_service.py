@@ -1,0 +1,75 @@
+"""Service-layer tests: RPC framing, discovery, and the multi-process fake
+cluster (ref test strategy: test/test_ctx.py + persia/helper.py — every role a
+local subprocess, discovery through the real control plane)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from persia_tpu.service import proto
+from persia_tpu.service.discovery import Coordinator, CoordinatorClient
+from persia_tpu.service.rpc import RpcClient, RpcError, RpcServer
+
+
+def test_rpc_roundtrip_and_errors():
+    server = RpcServer().start()
+    server.register("echo", lambda p: p[::-1])
+
+    def boom(p):
+        raise ValueError("nope")
+
+    server.register("boom", boom)
+    client = RpcClient(f"127.0.0.1:{server.port}")
+    client.wait_ready(5)
+    assert client.call("echo", b"abc") == b"cba"
+    with pytest.raises(RpcError, match="nope"):
+        client.call("boom")
+    with pytest.raises(RpcError, match="unknown method"):
+        client.call("nosuch")
+    # big payload crosses the compression threshold
+    big = bytes(np.random.default_rng(0).integers(0, 255, 3 << 20, dtype=np.uint8))
+    assert client.call("echo", big) == big[::-1]
+    client.close()
+    server.stop()
+
+
+def test_proto_roundtrips():
+    from persia_tpu.embedding.worker import RawEmbeddingBatch, SumEmbeddingBatch
+
+    signs = np.arange(5, dtype=np.uint64)
+    req = proto.pack_lookup_request(signs, 8, True)
+    s2, dim, train = proto.unpack_lookup_request(req)
+    np.testing.assert_array_equal(signs, s2)
+    assert dim == 8 and train
+
+    batches = [
+        SumEmbeddingBatch("a", np.ones((2, 4), np.float32)),
+        RawEmbeddingBatch(
+            "b", np.zeros((3, 4), np.float32),
+            np.zeros((2, 5), np.int32), np.array([1, 0], np.int32),
+        ),
+    ]
+    back = proto.unpack_emb_batches(proto.pack_emb_batches(batches))
+    assert back[0].name == "a" and back[1].name == "b"
+    np.testing.assert_array_equal(back[1].index, batches[1].index)
+
+    grads = {"x": np.ones((2, 3), np.float32)}
+    g2, scale = proto.unpack_slot_grads(proto.pack_slot_grads(grads, 2.0))
+    assert scale == 2.0
+    np.testing.assert_array_equal(g2["x"], grads["x"])
+
+
+def test_coordinator():
+    coord = Coordinator().start()
+    c = CoordinatorClient(f"127.0.0.1:{coord.port}")
+    c.register("ps", 1, "addr-b")
+    c.register("ps", 0, "addr-a")
+    assert c.list("ps") == ["addr-a", "addr-b"]  # index-sorted
+    assert c.wait_for("ps", 2, timeout_s=2) == ["addr-a", "addr-b"]
+    with pytest.raises(TimeoutError):
+        c.wait_for("ps", 3, timeout_s=0.5)
+    c.kv_put("optimizer", b"\x01\x02")
+    assert c.kv_get("optimizer") == b"\x01\x02"
+    assert c.kv_get("missing") == b""
+    coord.stop()
