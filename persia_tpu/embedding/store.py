@@ -30,6 +30,7 @@ import numpy as np
 from persia_tpu.config import HyperParameters
 from persia_tpu.embedding.hashing import splitmix64, uniform_init_for_sign
 from persia_tpu.embedding.optim import OptimizerConfig
+from persia_tpu.metrics import get_metrics
 
 
 class _Shard:
@@ -96,6 +97,21 @@ class EmbeddingStore:
         self.seed = seed
         # Adam per-feature-group accumulated beta powers (ref: optim.rs:99-221).
         self._batch_state: Dict[int, Tuple[float, float]] = {}
+        # PS-tier observability (ref: emb_param metrics, mod.rs:27-79)
+        m = get_metrics()
+        self._m_miss = m.counter(
+            "persia_tpu_index_miss_count", "train lookups that missed the store"
+        )
+        self._m_lookups = m.counter(
+            "persia_tpu_index_count", "total train lookups against the store"
+        )
+        self._m_miss_ratio = m.gauge(
+            "persia_tpu_index_miss_ratio", "miss ratio of the last train lookup"
+        )
+        self._m_grad_miss = m.counter(
+            "persia_tpu_gradient_id_miss_count",
+            "gradient updates whose sign was evicted or never admitted",
+        )
 
     # ------------------------------------------------------------------ util
 
@@ -145,11 +161,13 @@ class EmbeddingStore:
     def _lookup_locked(self, signs: np.ndarray, dim: int, train: bool) -> np.ndarray:
         out = np.zeros((len(signs), dim), dtype=np.float32)
         entry_len = dim + self._state_dim(dim)
+        misses = 0
         for i, s in enumerate(signs.tolist()):
             shard = self._shard_of(s)
             if train:
                 entry = shard.get_refresh(s)
                 if entry is None or entry[0] != dim or len(entry[1]) != entry_len:
+                    misses += 1
                     if entry is None and not self._admit(s):
                         continue
                     vec = self._init_entry(s, dim)
@@ -161,6 +179,10 @@ class EmbeddingStore:
                 entry = shard.get(s)
                 if entry is not None and entry[0] == dim:
                     out[i] = entry[1][:dim]
+        if train and len(signs):
+            self._m_miss.inc(misses)
+            self._m_lookups.inc(len(signs))
+            self._m_miss_ratio.set(misses / len(signs))
         return out
 
     # -------------------------------------------------------------- gradient
@@ -194,15 +216,19 @@ class EmbeddingStore:
             self.optimizer.initial_batch_state()
         ))
         bound = self.hyperparams.weight_bound
+        grad_misses = 0
         for i, s in enumerate(signs.tolist()):
             shard = self._shard_of(s)
             entry = shard.get_refresh(s)
             if entry is None or entry[0] != dim or len(entry[1]) != entry_len:
+                grad_misses += 1
                 continue
             vec = entry[1]
             self.optimizer.update_dense(vec[:dim], vec[dim:], grads[i], batch_state)
             if bound > 0:
                 np.clip(vec[:dim], -bound, bound, out=vec[:dim])
+        if grad_misses:
+            self._m_grad_miss.inc(grad_misses)
 
     # ------------------------------------------------------------ management
 

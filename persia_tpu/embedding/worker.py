@@ -32,6 +32,8 @@ from persia_tpu.config import EmbeddingConfig, HyperParameters, SlotConfig
 from persia_tpu.data import IDTypeFeature, PersiaBatch
 from persia_tpu.embedding.hashing import add_index_prefix, hash_stack, sign_to_shard
 from persia_tpu.embedding.store import EmbeddingStore
+from persia_tpu.metrics import get_metrics
+from persia_tpu.monitor import EmbeddingMonitor
 
 
 @dataclass
@@ -303,6 +305,28 @@ class EmbeddingWorker:
         # GIL, so slot fan-out gets true CPU parallelism (the reference fans
         # lookups out across tokio tasks, mod.rs:874-942)
         self._pool = ThreadPoolExecutor(max_workers=max(1, num_threads))
+        # worker-tier observability (ref: emb_worker metrics, mod.rs:49-105,
+        # + distinct-id monitor, monitor.rs:29-114)
+        m = get_metrics()
+        self.monitor = EmbeddingMonitor()
+        self._m_staleness = m.gauge(
+            "persia_tpu_staleness", "batches looked up but not yet gradient-updated"
+        )
+        self._m_pending = m.gauge(
+            "persia_tpu_num_pending_batches", "batches buffered awaiting forward"
+        )
+        self._m_unique_rate = m.gauge(
+            "persia_tpu_batch_unique_indices_rate", "distinct ids / total ids per batch"
+        )
+        self._m_nan_skipped = m.counter(
+            "persia_tpu_nan_grad_skipped", "slot gradients skipped for non-finite values"
+        )
+        self._m_lookup_time = m.histogram(
+            "persia_tpu_lookup_total_time_cost_sec", "worker-side lookup latency"
+        )
+        self._m_update_time = m.histogram(
+            "persia_tpu_update_gradient_time_cost_sec", "worker-side gradient-update latency"
+        )
 
     def dump(self, path: str, blocking: bool = True) -> None:
         """Checkpoint fan-out to all PS replicas (ref: emb_worker dump,
@@ -362,6 +386,7 @@ class EmbeddingWorker:
             ]
             for k in expired:
                 del self.forward_id_buffer[k]
+            self._m_pending.set(len(self.forward_id_buffer))
             return len(self.forward_id_buffer) < self.forward_buffer_size
 
     def put_forward_ids(self, batch: PersiaBatch) -> int:
@@ -370,10 +395,18 @@ class EmbeddingWorker:
         processed = preprocess_batch(
             batch.id_type_features, self.embedding_config, batch_id=batch.batch_id
         )
+        total = distinct = 0
+        for slot in processed.slots:
+            self.monitor.observe(slot.name, slot.distinct)
+            total += len(slot.sample_of_id)
+            distinct += slot.num_distinct
+        if total:
+            self._m_unique_rate.set(distinct / total)
         with self._buf_lock:
             self._ref_id += 1
             ref = self._ref_id
             self.forward_id_buffer[ref] = processed
+            self._m_pending.set(len(self.forward_id_buffer))
         return ref
 
     # ----------------------------------------------------- nn-worker side API
@@ -383,13 +416,18 @@ class EmbeddingWorker:
         round-trip (ref: mod.rs:1031-1074)."""
         with self._buf_lock:
             processed = self.forward_id_buffer.pop(ref)
-        out = list(
-            self._pool.map(lambda s: lookup_slot(s, self.lookup_router, train), processed.slots)
-        )
+            self._m_pending.set(len(self.forward_id_buffer))
+        with self._m_lookup_time.time():
+            out = list(
+                self._pool.map(
+                    lambda s: lookup_slot(s, self.lookup_router, train), processed.slots
+                )
+            )
         if train:
             with self._buf_lock:
                 self.post_forward_buffer[ref] = processed
                 self.staleness += 1
+                self._m_staleness.set(self.staleness)
         return out
 
     def forward_directly(
@@ -408,6 +446,7 @@ class EmbeddingWorker:
         with self._buf_lock:
             if self.post_forward_buffer.pop(ref, None) is not None:
                 self.staleness = max(0, self.staleness - 1)
+                self._m_staleness.set(self.staleness)
 
     def update_gradient_batched(
         self, ref: int, slot_grads: Dict[str, np.ndarray], scale_factor: float = 1.0
@@ -418,6 +457,7 @@ class EmbeddingWorker:
         with self._buf_lock:
             processed = self.post_forward_buffer.pop(ref)
             self.staleness = max(0, self.staleness - 1)
+            self._m_staleness.set(self.staleness)
         skipped = {}
 
         def one_slot(slot):
@@ -434,7 +474,7 @@ class EmbeddingWorker:
         # gradient batches are serialized so the Adam batch-state advance is
         # atomic with its batch's updates (ref: batch-level beta powers,
         # optim.rs:99-221); slots within the batch still fan out in parallel
-        with self._grad_lock:
+        with self._m_update_time.time(), self._grad_lock:
             groups = {
                 self.embedding_config.group_of(s.name)
                 for s in processed.slots
@@ -445,4 +485,6 @@ class EmbeddingWorker:
             for name in self._pool.map(one_slot, processed.slots):
                 if name is not None:
                     skipped[name] = 1
+        if skipped:
+            self._m_nan_skipped.inc(len(skipped))
         return skipped

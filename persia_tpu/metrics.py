@@ -1,0 +1,311 @@
+"""Metrics subsystem: Prometheus-compatible registry with push and pull.
+
+Parity target: ``persia-metrics`` (`/root/reference/rust/persia-metrics/src/lib.rs`):
+singleton ``PersiaMetricsManager`` with ``create_{counter,gauge,histogram}(_vec)``,
+const labels ``{instance, ip_addr}``, and a scheduled push to a Prometheus
+pushgateway (`lib.rs:169-201`).
+
+TPU-first differences: pure stdlib (no prometheus client dep). Besides the
+reference's push model we also expose a pull endpoint (``serve_http``) because
+TPU-host jobs usually sit behind a scrape config rather than a gateway.
+Everything is thread-safe; the hot-path cost of a counter bump is one dict
+lookup + float add under a small lock.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, const_labels: Dict[str, str]):
+        self.name = name
+        self.help = help_
+        self.const_labels = const_labels
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_, const_labels):
+        super().__init__(name, help_, const_labels)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def get(self, **labels) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            items = list(self._values.items()) or [((), 0.0)]
+        for key, v in items:
+            labels = dict(self.const_labels)
+            labels.update(dict(key))
+            out.append(f"{self.name}{_fmt_labels(labels)} {v}")
+        return out
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help_, const_labels):
+        super().__init__(name, help_, const_labels)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = float(value)
+
+    def add(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def get(self, **labels) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._lock:
+            items = list(self._values.items()) or [((), 0.0)]
+        for key, v in items:
+            labels = dict(self.const_labels)
+            labels.update(dict(key))
+            out.append(f"{self.name}{_fmt_labels(labels)} {v}")
+        return out
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_, const_labels, buckets: Sequence[float] = _DEFAULT_BUCKETS):
+        super().__init__(name, help_, const_labels)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._totals: Dict[Tuple, int] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def time(self, **labels):
+        """Context manager observing elapsed seconds."""
+        return _Timer(self, labels)
+
+    def get_count(self, **labels) -> int:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._totals.get(key, 0)
+
+    def get_sum(self, **labels) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._sums.get(key, 0.0)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            keys = list(self._counts.keys())
+            for key in keys:
+                counts, total, s = self._counts[key], self._totals[key], self._sums[key]
+                base = dict(self.const_labels)
+                base.update(dict(key))
+                for b, c in zip(self.buckets, counts):
+                    lbl = dict(base, le=repr(float(b)))
+                    out.append(f"{self.name}_bucket{_fmt_labels(lbl)} {c}")
+                lbl = dict(base, le="+Inf")
+                out.append(f"{self.name}_bucket{_fmt_labels(lbl)} {total}")
+                out.append(f"{self.name}_sum{_fmt_labels(base)} {s}")
+                out.append(f"{self.name}_count{_fmt_labels(base)} {total}")
+        return out
+
+
+class _Timer:
+    def __init__(self, hist: Histogram, labels: Dict[str, str]):
+        self._hist = hist
+        self._labels = labels
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._hist.observe(time.perf_counter() - self._t0, **self._labels)
+        return False
+
+
+def _local_ip() -> str:
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
+class MetricsRegistry:
+    """Per-process metric registry (ref: PersiaMetricsManager singleton,
+    persia-metrics/src/lib.rs:108-167). ``job``/``instance`` become const
+    labels on every series."""
+
+    def __init__(self, job: str = "persia_tpu", instance: Optional[str] = None):
+        self.job = job
+        self.const_labels = {
+            "instance": instance or f"rep_{os.environ.get('REPLICA_INDEX', '0')}",
+            "ip_addr": _local_ip(),
+        }
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+        self._push_thread: Optional[threading.Thread] = None
+        self._push_stop = threading.Event()
+        self._server: Optional[ThreadingHTTPServer] = None
+
+    def _get_or_create(self, cls, name: str, help_: str, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_, self.const_labels, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name!r} already registered as {m.kind}")
+            return m
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help_)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help_)
+
+    def histogram(self, name: str, help_: str = "", buckets: Sequence[float] = _DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help_, buckets=buckets)
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        lines: List[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+    # ------------------------------------------------------------------ push
+
+    def start_push(self, gateway_addr: Optional[str] = None, interval_sec: float = 10.0) -> bool:
+        """Push to a Prometheus pushgateway every ``interval_sec``
+        (ref: lib.rs:169-201 spawns the same loop against
+        ``PERSIA_METRICS_GATEWAY_ADDR``). Returns False if no gateway is
+        configured."""
+        addr = gateway_addr or os.environ.get("PERSIA_TPU_METRICS_GATEWAY") or os.environ.get(
+            "PERSIA_METRICS_GATEWAY_ADDR"
+        )
+        if not addr or self._push_thread is not None:
+            return False
+        host, _, port = addr.replace("http://", "").partition(":")
+
+        def loop():
+            import http.client
+
+            while not self._push_stop.wait(interval_sec):
+                try:
+                    conn = http.client.HTTPConnection(host, int(port or 9091), timeout=5)
+                    path = f"/metrics/job/{self.job}/instance/{self.const_labels['instance']}"
+                    conn.request("PUT", path, body=self.render().encode(),
+                                 headers={"Content-Type": "text/plain"})
+                    conn.getresponse().read()
+                    conn.close()
+                except OSError:
+                    pass  # gateway transiently unreachable; next tick retries
+
+        self._push_thread = threading.Thread(target=loop, daemon=True, name="metrics-push")
+        self._push_thread.start()
+        return True
+
+    def stop_push(self) -> None:
+        if self._push_thread is not None:
+            self._push_stop.set()
+            self._push_thread.join(timeout=2)
+            self._push_thread = None
+            self._push_stop.clear()
+
+    # ------------------------------------------------------------------ pull
+
+    def serve_http(self, port: int = 0) -> int:
+        """Expose ``/metrics`` for scraping; returns the bound port."""
+        registry = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.rstrip("/") in ("", "/metrics".rstrip("/")):
+                    body = registry.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self._server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        threading.Thread(target=self._server.serve_forever, daemon=True, name="metrics-http").start()
+        return self._server.server_address[1]
+
+    def shutdown(self) -> None:
+        self.stop_push()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+
+_REGISTRY: Optional[MetricsRegistry] = None
+_REGISTRY_LOCK = threading.Lock()
+
+
+def get_metrics() -> MetricsRegistry:
+    """Process-wide default registry (lazy)."""
+    global _REGISTRY
+    with _REGISTRY_LOCK:
+        if _REGISTRY is None:
+            _REGISTRY = MetricsRegistry()
+        return _REGISTRY

@@ -1,0 +1,132 @@
+"""Distinct-id monitoring via HyperLogLog.
+
+Parity target: the reference's ``EmbeddingMonitorInner``
+(`/root/reference/rust/persia-embedding-server/src/monitor.rs:29-114`): a
+HyperLogLog++ estimator of distinct ids per feature slot, sampled by
+background threads and exported as the ``estimated_distinct_id`` gauge.
+
+TPU-first differences: the estimator is vectorized numpy (one
+``np.maximum.at`` per batch instead of a per-id loop), and instead of a
+channel + sampler thread the worker calls ``observe`` inline — the cost is
+O(n_ids) bit math, negligible next to the lookup itself.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+import numpy as np
+
+from persia_tpu.metrics import get_metrics
+
+
+class HyperLogLog:
+    """Classic HLL with the standard small/large-range corrections.
+
+    ``precision`` p → 2^p one-byte registers; relative error ≈ 1.04/sqrt(2^p)
+    (p=14 → ~0.8%).
+    """
+
+    def __init__(self, precision: int = 14):
+        if not 4 <= precision <= 18:
+            raise ValueError("precision must be in [4, 18]")
+        self.p = precision
+        self.m = 1 << precision
+        self.registers = np.zeros(self.m, dtype=np.uint8)
+        if self.m >= 128:
+            self.alpha = 0.7213 / (1.0 + 1.079 / self.m)
+        elif self.m == 64:
+            self.alpha = 0.709
+        elif self.m == 32:
+            self.alpha = 0.697
+        else:
+            self.alpha = 0.673
+
+    def add(self, signs: np.ndarray) -> None:
+        """Fold a u64 sign array into the registers (vectorized)."""
+        if len(signs) == 0:
+            return
+        # imported lazily: embedding.worker imports this module at package
+        # init, so a top-level import of embedding.hashing would be circular
+        from persia_tpu.embedding.hashing import splitmix64
+
+        h = splitmix64(np.asarray(signs, dtype=np.uint64))
+        idx = (h >> np.uint64(64 - self.p)).astype(np.int64)
+        rest = h << np.uint64(self.p)  # top (64-p) hash bits, left-aligned
+        # rank = leading zeros of `rest` + 1, capped at 64-p+1 (rest == 0)
+        rank = np.full(len(h), 64 - self.p + 1, dtype=np.uint8)
+        nz = rest != 0
+        if nz.any():
+            # leading zeros via float64 exponent trick is lossy; use bit scan
+            r = rest[nz]
+            lz = np.zeros(len(r), dtype=np.uint8)
+            for shift in (32, 16, 8, 4, 2, 1):
+                mask = r < (np.uint64(1) << np.uint64(64 - shift))
+                lz[mask] += shift
+                r[mask] = r[mask] << np.uint64(shift)
+            rank[nz] = lz + 1
+        np.maximum.at(self.registers, idx, rank)
+
+    def estimate(self) -> float:
+        regs = self.registers.astype(np.float64)
+        est = self.alpha * self.m * self.m / np.sum(np.exp2(-regs))
+        if est <= 2.5 * self.m:
+            zeros = int(np.count_nonzero(self.registers == 0))
+            if zeros:
+                return self.m * np.log(self.m / zeros)  # linear counting
+        return float(est)
+
+    def merge(self, other: "HyperLogLog") -> None:
+        if other.p != self.p:
+            raise ValueError("precision mismatch")
+        np.maximum(self.registers, other.registers, out=self.registers)
+
+    def to_bytes(self) -> bytes:
+        return bytes([self.p]) + self.registers.tobytes()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "HyperLogLog":
+        hll = cls(precision=raw[0])
+        hll.registers = np.frombuffer(raw[1:], dtype=np.uint8).copy()
+        return hll
+
+
+class EmbeddingMonitor:
+    """Per-slot distinct-id estimation (ref: monitor.rs:29-114). The
+    ``estimated_distinct_id`` gauge is labeled by slot name."""
+
+    # estimate() sweeps all 2^p registers; refresh the gauge only every
+    # N observes so the hot path stays O(batch ids) (the reference keeps the
+    # estimate off the hot path with a sampler thread, monitor.rs:56-87)
+    _GAUGE_REFRESH_EVERY = 64
+
+    def __init__(self, precision: int = 14):
+        self.precision = precision
+        self._hlls: Dict[str, HyperLogLog] = {}
+        self._observes: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._gauge = get_metrics().gauge(
+            "persia_tpu_estimated_distinct_id",
+            "HyperLogLog estimate of distinct ids seen per feature slot",
+        )
+
+    def observe(self, slot_name: str, signs: np.ndarray) -> None:
+        with self._lock:
+            hll = self._hlls.get(slot_name)
+            if hll is None:
+                hll = self._hlls[slot_name] = HyperLogLog(self.precision)
+            hll.add(signs)
+            n = self._observes.get(slot_name, 0)
+            self._observes[slot_name] = n + 1
+            if n % self._GAUGE_REFRESH_EVERY == 0:
+                self._gauge.set(hll.estimate(), feature=slot_name)
+
+    def estimated_distinct_id(self, slot_name: str) -> float:
+        with self._lock:
+            hll = self._hlls.get(slot_name)
+            if hll is None:
+                return 0.0
+            est = hll.estimate()
+            self._gauge.set(est, feature=slot_name)
+            return est

@@ -1,0 +1,134 @@
+"""Metrics registry + HyperLogLog monitor tests (ref test model: SURVEY §4 —
+golden-value unit tests for every infra crate)."""
+
+import http.client
+
+import numpy as np
+import pytest
+
+from persia_tpu.metrics import MetricsRegistry, get_metrics
+from persia_tpu.monitor import EmbeddingMonitor, HyperLogLog
+
+
+def test_counter_gauge_histogram_roundtrip():
+    reg = MetricsRegistry(job="t")
+    c = reg.counter("t_requests", "total requests")
+    c.inc()
+    c.inc(2.0, route="a")
+    assert c.get() == 1.0
+    assert c.get(route="a") == 2.0
+
+    g = reg.gauge("t_staleness")
+    g.set(3)
+    g.add(2)
+    assert g.get() == 5.0
+
+    h = reg.histogram("t_latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    assert h.get_count() == 3
+    assert h.get_sum() == pytest.approx(5.55)
+
+
+def test_render_prometheus_format():
+    reg = MetricsRegistry(job="t", instance="rep_7")
+    reg.counter("t_total", "help text").inc(4, kind="x")
+    reg.gauge("t_g").set(1.5)
+    text = reg.render()
+    assert "# TYPE t_total counter" in text
+    assert 'instance="rep_7"' in text
+    assert 'kind="x"' in text
+    assert "} 4.0" in text
+    assert "# TYPE t_g gauge" in text
+
+
+def test_metric_kind_collision_raises():
+    reg = MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+
+
+def test_serve_http_scrape():
+    reg = MetricsRegistry(job="t")
+    reg.counter("scraped_total").inc(9)
+    port = reg.serve_http(0)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        assert resp.status == 200
+        assert "scraped_total" in body and "9.0" in body
+    finally:
+        reg.shutdown()
+
+
+def test_hll_accuracy():
+    hll = HyperLogLog(precision=14)
+    rng = np.random.default_rng(0)
+    true_n = 100_000
+    ids = rng.integers(0, 1 << 62, size=true_n, dtype=np.uint64)
+    distinct = len(np.unique(ids))
+    # feed in chunks with duplicates interleaved
+    hll.add(ids)
+    hll.add(ids[: true_n // 2])
+    est = hll.estimate()
+    assert abs(est - distinct) / distinct < 0.03
+
+
+def test_hll_small_range_exact_ish():
+    hll = HyperLogLog(precision=12)
+    hll.add(np.arange(100, dtype=np.uint64))
+    assert abs(hll.estimate() - 100) < 10
+
+
+def test_hll_merge_and_serde():
+    a, b = HyperLogLog(10), HyperLogLog(10)
+    a.add(np.arange(0, 5000, dtype=np.uint64))
+    b.add(np.arange(2500, 7500, dtype=np.uint64))
+    a.merge(b)
+    est = a.estimate()
+    assert abs(est - 7500) / 7500 < 0.1
+    back = HyperLogLog.from_bytes(a.to_bytes())
+    assert back.estimate() == est
+
+
+def test_embedding_monitor_gauge():
+    mon = EmbeddingMonitor(precision=12)
+    mon.observe("clicks", np.arange(1000, dtype=np.uint64))
+    mon.observe("clicks", np.arange(500, dtype=np.uint64))  # dup half
+    est = mon.estimated_distinct_id("clicks")
+    assert abs(est - 1000) / 1000 < 0.1
+    assert mon.estimated_distinct_id("unknown") == 0.0
+    # the default-registry gauge carries the per-feature label
+    g = get_metrics().gauge("persia_tpu_estimated_distinct_id")
+    assert g.get(feature="clicks") == est
+
+
+def test_worker_metrics_wired():
+    """Staleness/pending gauges move with the worker's buffers."""
+    from persia_tpu.config import EmbeddingConfig, SlotConfig
+    from persia_tpu.data import IDTypeFeature, PersiaBatch
+    from persia_tpu.embedding.optim import SGD
+    from persia_tpu.embedding.store import EmbeddingStore
+    from persia_tpu.embedding.worker import EmbeddingWorker
+
+    cfg = EmbeddingConfig(slots_config={"f": SlotConfig(dim=4)})
+    store = EmbeddingStore(capacity=1024, num_internal_shards=2, optimizer=SGD(lr=0.1).config)
+    w = EmbeddingWorker(cfg, [store])
+    from persia_tpu.data import Label
+
+    batch = PersiaBatch(
+        id_type_features=[IDTypeFeature("f", [np.array([1, 2, 2], dtype=np.uint64)])],
+        labels=[Label(np.zeros((1, 1), dtype=np.float32))],
+    )
+    ref = w.put_forward_ids(batch)
+    assert w._m_pending.get() == 1.0
+    assert w._m_unique_rate.get() == pytest.approx(2 / 3)
+    w.forward_batch_id(ref, train=True)
+    assert w._m_staleness.get() == 1.0
+    assert w.monitor.estimated_distinct_id("f") > 0
+    w.update_gradient_batched(ref, {"f": np.ones((1, 4), dtype=np.float32)})
+    assert w._m_staleness.get() == 0.0
