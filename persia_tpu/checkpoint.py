@@ -10,6 +10,10 @@ Parity target: `rust/persia-model-manager/src/lib.rs`:
 - load = parallel file reads → insert (lib.rs:375-425); replica-count change
   re-shards by sign routing (ref: emb_worker:1150-1259)
 
+All IO goes through :mod:`persia_tpu.storage` (the ``persia-storage``
+equivalent), so checkpoint directories can live on local disk, ``hdfs://``
+or ``gs://`` transparently.
+
 File payloads use the store's shard wire format (u32 count, then per entry
 u64 sign / u32 dim / u32 len / f32 data) — identical for the numpy and C++
 backends."""
@@ -18,17 +22,17 @@ from __future__ import annotations
 
 import io
 import json
-import os
 import struct
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, Dict, List, Optional
+from typing import Dict, List, Optional, Union
 
 import numpy as np
 
 from persia_tpu.embedding.hashing import sign_to_shard
 from persia_tpu.logger import get_default_logger
+from persia_tpu.storage import StoragePath, storage_path
 
 logger = get_default_logger("persia_tpu.checkpoint")
 
@@ -53,25 +57,26 @@ class ModelManagerStatus:
             return {"status": self._state, "progress": self._progress, "error": self._error}
 
 
-def _shard_file(dst_dir: str, replica: int, shard: int) -> str:
-    return os.path.join(dst_dir, f"replica_{replica}_shard_{shard}.emb")
+def _shard_name(replica: int, shard: int) -> str:
+    return f"replica_{replica}_shard_{shard}.emb"
 
 
-def _replica_marker(dst_dir: str, replica: int) -> str:
-    return os.path.join(dst_dir, f"replica_{replica}_done")
+def _marker_name(replica: int) -> str:
+    return f"replica_{replica}_done"
 
 
-def _read_json(path: str) -> Optional[Dict]:
+def _read_json(path: StoragePath) -> Optional[Dict]:
+    from persia_tpu.storage import StorageError
+
     try:
-        with open(path) as f:
-            return json.loads(f.read())
-    except (OSError, ValueError):
+        return json.loads(path.read_text())
+    except (OSError, ValueError, StorageError):
         return None
 
 
 def dump_store(
     store,
-    dst_dir: str,
+    dst_dir: Union[str, StoragePath],
     replica_index: int = 0,
     replica_size: int = 1,
     status: Optional[ModelManagerStatus] = None,
@@ -89,31 +94,29 @@ def dump_store(
     status = status or ModelManagerStatus()
     status.set("dumping", 0.0)
     session = session or f"s{time.time_ns()}"
+    root = storage_path(dst_dir)
     try:
-        os.makedirs(dst_dir, exist_ok=True)
+        root.makedirs()
         # invalidate any previous dump in this directory before writing
-        done_path = os.path.join(dst_dir, DONE_MARKER)
-        if os.path.exists(done_path):
-            os.remove(done_path)
-        my_marker = _replica_marker(dst_dir, replica_index)
-        if os.path.exists(my_marker):
-            os.remove(my_marker)
+        done_path = root.join(DONE_MARKER)
+        if done_path.exists():
+            done_path.remove()
+        my_marker = root.join(_marker_name(replica_index))
+        if my_marker.exists():
+            my_marker.remove()
         n = store.num_internal_shards
-        for old in os.listdir(dst_dir):
+        for old in root.list():
             if old.startswith(f"replica_{replica_index}_shard_"):
                 idx = old.split("_shard_")[1].split(".")[0]
                 if idx.isdigit() and int(idx) >= n:
-                    os.remove(os.path.join(dst_dir, old))
+                    root.join(old).remove()
         done = 0
         lock = threading.Lock()
 
         def dump_one(i: int):
             nonlocal done
             blob = store.dump_shard(i)
-            tmp = _shard_file(dst_dir, replica_index, i) + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(blob)
-            os.replace(tmp, _shard_file(dst_dir, replica_index, i))
+            root.join(_shard_name(replica_index, i)).write_bytes(blob)
             with lock:
                 done += 1
                 status.set("dumping", done / n)
@@ -121,17 +124,13 @@ def dump_store(
         with ThreadPoolExecutor(max_workers=num_io_threads) as pool:
             list(pool.map(dump_one, range(n)))
 
-        with open(my_marker + ".tmp", "w") as f:
-            f.write(
-                json.dumps(
-                    {"num_internal_shards": n, "session": session, "time": time.time()}
-                )
-            )
-        os.replace(my_marker + ".tmp", my_marker)
+        my_marker.write_text(
+            json.dumps({"num_internal_shards": n, "session": session, "time": time.time()})
+        )
 
         # master marker once every replica's marker exists FOR THIS SESSION
         markers = [
-            _read_json(_replica_marker(dst_dir, r)) for r in range(replica_size)
+            _read_json(root.join(_marker_name(r))) for r in range(replica_size)
         ]
         if all(m is not None and m.get("session") == session for m in markers):
             info = {
@@ -139,18 +138,15 @@ def dump_store(
                 "session": session,
                 "datetime": time.strftime("%Y-%m-%dT%H:%M:%S"),
             }
-            with open(done_path + ".tmp", "w") as f:
-                f.write(json.dumps(info))
-            os.replace(done_path + ".tmp", done_path)
+            done_path.write_text(json.dumps(info))
         status.set("idle", 1.0)
     except Exception as e:
         status.set("failed", error=repr(e))
         raise
 
 
-def checkpoint_info(src_dir: str) -> Dict:
-    with open(os.path.join(src_dir, DONE_MARKER)) as f:
-        return json.loads(f.read())
+def checkpoint_info(src_dir: Union[str, StoragePath]) -> Dict:
+    return json.loads(storage_path(src_dir).join(DONE_MARKER).read_text())
 
 
 def _iter_entries(blob: bytes):
@@ -187,7 +183,7 @@ def _filter_blob_for_replica(blob: bytes, replica_index: int, replica_size: int)
 
 def load_store(
     store,
-    src_dir: str,
+    src_dir: Union[str, StoragePath],
     replica_index: int = 0,
     replica_size: int = 1,
     status: Optional[ModelManagerStatus] = None,
@@ -199,15 +195,16 @@ def load_store(
     changes — entries re-route on insert). Returns entries loaded."""
     status = status or ModelManagerStatus()
     status.set("loading", 0.0)
+    root = storage_path(src_dir)
     try:
-        info = _read_json(os.path.join(src_dir, DONE_MARKER))
+        info = _read_json(root.join(DONE_MARKER))
         if info is None:
             if require_marker:
                 raise FileNotFoundError(
-                    f"no valid {DONE_MARKER} in {src_dir} (incomplete dump?)"
+                    f"no valid {DONE_MARKER} in {root} (incomplete dump?)"
                 )
             # markerless fallback: load every .emb file, filtered
-            files = sorted(f for f in os.listdir(src_dir) if f.endswith(".emb"))
+            files = sorted(f for f in root.list() if f.endswith(".emb"))
             need_filter = replica_size > 1
         else:
             # marker-driven: only files the recorded topology actually wrote
@@ -216,11 +213,9 @@ def load_store(
             for r in range(dumped_replicas):
                 if dumped_replicas == replica_size and r != replica_index:
                     continue  # same topology → only our own replica's files
-                marker = _read_json(_replica_marker(src_dir, r))
+                marker = _read_json(root.join(_marker_name(r)))
                 shards = int(marker["num_internal_shards"]) if marker else 0
-                files += [
-                    os.path.basename(_shard_file(src_dir, r, i)) for i in range(shards)
-                ]
+                files += [_shard_name(r, i) for i in range(shards)]
             # same topology: our own files hold exactly our signs — no filter
             need_filter = dumped_replicas != replica_size
         total = len(files)
@@ -230,8 +225,7 @@ def load_store(
 
         def load_one(fname: str) -> int:
             nonlocal done
-            with open(os.path.join(src_dir, fname), "rb") as f:
-                blob = f.read()
+            blob = root.join(fname).read_bytes()
             if need_filter:
                 blob = _filter_blob_for_replica(blob, replica_index, replica_size)
             n = store.load_shard_bytes(blob)
@@ -249,14 +243,11 @@ def load_store(
         raise
 
 
-def dump_dense(state_bytes: bytes, dst_dir: str, name: str = "dense.ckpt") -> None:
-    os.makedirs(dst_dir, exist_ok=True)
-    tmp = os.path.join(dst_dir, name + ".tmp")
-    with open(tmp, "wb") as f:
-        f.write(state_bytes)
-    os.replace(tmp, os.path.join(dst_dir, name))
+def dump_dense(state_bytes: bytes, dst_dir: Union[str, StoragePath], name: str = "dense.ckpt") -> None:
+    root = storage_path(dst_dir)
+    root.makedirs()
+    root.join(name).write_bytes(state_bytes)
 
 
-def load_dense(src_dir: str, name: str = "dense.ckpt") -> bytes:
-    with open(os.path.join(src_dir, name), "rb") as f:
-        return f.read()
+def load_dense(src_dir: Union[str, StoragePath], name: str = "dense.ckpt") -> bytes:
+    return storage_path(src_dir).join(name).read_bytes()
