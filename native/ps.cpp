@@ -470,6 +470,16 @@ int32_t ps_get_entry(void* h, uint64_t sign, float* out, int32_t cap) {
   return (int32_t)en.len;
 }
 
+// returns the entry's embedding dim, or -1 if absent
+int32_t ps_get_entry_dim(void* h, uint64_t sign) {
+  Store* s = (Store*)h;
+  Shard& sh = s->shard_of(sign);
+  std::lock_guard<std::mutex> g(sh.mu);
+  size_t pos = sh.find_pos(sign);
+  if (pos == SIZE_MAX) return -1;
+  return (int32_t)sh.entries[sh.table_slot[pos]].dim;
+}
+
 int64_t ps_size(void* h) {
   Store* s = (Store*)h;
   int64_t total = 0;

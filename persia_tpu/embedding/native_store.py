@@ -84,6 +84,8 @@ def _load_lib() -> ctypes.CDLL:
     lib.ps_set_embedding.argtypes = [p, u64p, i64, u32, u32, f32p]
     lib.ps_get_entry.restype = i32
     lib.ps_get_entry.argtypes = [p, u64, f32p, i32]
+    lib.ps_get_entry_dim.restype = i32
+    lib.ps_get_entry_dim.argtypes = [p, u64]
     lib.ps_size.restype = i64
     lib.ps_size.argtypes = [p]
     lib.ps_clear.argtypes = [p]
@@ -126,6 +128,7 @@ class NativeEmbeddingStore:
         self.seed = seed
         self._num_shards = num_internal_shards
         self.optimizer: Optional[OptimizerConfig] = None
+        self.inc_manager = None  # set by persia_tpu.incremental.attach_incremental
         self.configure(hyperparams)
         if optimizer is not None:
             self.register_optimizer(optimizer)
@@ -176,6 +179,8 @@ class NativeEmbeddingStore:
         )
         if rc != 0:
             raise RuntimeError("no optimizer registered")
+        if self.inc_manager is not None:
+            self.inc_manager.commit(signs)
 
     # management -----------------------------------------------------------
 
@@ -203,6 +208,24 @@ class NativeEmbeddingStore:
                 return out
             if ln2 < 0:
                 return None
+        raise RuntimeError(f"entry for sign {sign} kept changing concurrently")
+
+    def get_entry_dim(self, sign: int) -> Optional[int]:
+        d = self._lib.ps_get_entry_dim(self._h, sign)
+        return None if d < 0 else int(d)
+
+    def get_entry_record(self, sign: int):
+        """(dim, full entry) snapshot; dim is re-read after the copy and the
+        pair is retried if a concurrent re-init changed it in between."""
+        for _ in range(8):
+            d = self._lib.ps_get_entry_dim(self._h, sign)
+            if d < 0:
+                return None
+            vec = self.get_embedding_entry(sign)
+            if vec is None:
+                return None
+            if self._lib.ps_get_entry_dim(self._h, sign) == d and d <= len(vec):
+                return int(d), vec
         raise RuntimeError(f"entry for sign {sign} kept changing concurrently")
 
     def clear(self) -> None:

@@ -95,6 +95,7 @@ class EmbeddingStore:
         self.hyperparams = hyperparams
         self.optimizer = optimizer
         self.seed = seed
+        self.inc_manager = None  # set by persia_tpu.incremental.attach_incremental
         # Adam per-feature-group accumulated beta powers (ref: optim.rs:99-221).
         self._batch_state: Dict[int, Tuple[float, float]] = {}
         # PS-tier observability (ref: emb_param metrics, mod.rs:27-79)
@@ -208,6 +209,10 @@ class EmbeddingStore:
         entry_len = dim + self._state_dim(dim)
         with self._lock:
             self._update_locked(signs, grads, group)
+        if self.inc_manager is not None:
+            # commit outside the store lock (the manager's flush reads entries
+            # back through the locked accessors)
+            self.inc_manager.commit(signs)
 
     def _update_locked(self, signs: np.ndarray, grads: np.ndarray, group: int) -> None:
         dim = grads.shape[1]
@@ -253,6 +258,13 @@ class EmbeddingStore:
         with self._lock:
             e = self._shard_of(sign).get(sign)
             return None if e is None else e[0]
+
+    def get_entry_record(self, sign: int) -> Optional[Tuple[int, np.ndarray]]:
+        """Atomic (dim, full entry) snapshot — concurrent eviction/re-init
+        cannot tear the pair (the incremental flusher depends on this)."""
+        with self._lock:
+            e = self._shard_of(sign).get(sign)
+            return None if e is None else (e[0], e[1].copy())
 
     def clear(self) -> None:
         with self._lock:

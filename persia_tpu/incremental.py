@@ -1,0 +1,277 @@
+"""Incremental model updates: stream trained-embedding deltas to serving.
+
+Parity target: ``persia-incremental-update-manager``
+(`/root/reference/rust/persia-incremental-update-manager/src/lib.rs`):
+
+- train side collects the signs touched by gradient updates into a dedup
+  buffer; when it exceeds ``incremental_buffer_size`` it dumps a
+  ``PerisaIncrementalPacket{content, timestamps}`` chunk as
+  ``{replica}_{seq}.inc`` plus an ``inc_update_done`` marker (`lib.rs:178-312`)
+- infer side scans ``incremental_dir`` every 10 s, loads packets it has not
+  seen, and exports the ``inc_update_delay_sec`` gauge (`lib.rs:314-364`)
+
+TPU-first differences: packets reuse the checkpoint shard wire format
+(u32 count, then u64 sign / u32 dim / u32 len / f32 entry data) so the loader
+is just ``store.load_shard_bytes`` — entries re-route by sign, which also
+makes packets topology-independent. All IO goes through
+:mod:`persia_tpu.storage` (disk / hdfs:// / gs://).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Union
+
+import numpy as np
+
+from persia_tpu.logger import get_default_logger
+from persia_tpu.metrics import get_metrics
+from persia_tpu.storage import StorageError, StoragePath, storage_path
+
+logger = get_default_logger("persia_tpu.incremental")
+
+DONE_MARKER = "inc_update_done"
+_PACKET_RE = re.compile(r"^(\d+)_(\d+)\.inc$")
+
+_HEADER = struct.Struct("<4sIQ")  # magic, version, timestamp_us
+_MAGIC = b"PINC"
+
+
+def _pack_packet(entries: List[tuple], timestamp_us: int) -> bytes:
+    """entries: [(sign, dim, entry_vec)] with entry_vec = [emb | opt state]."""
+    parts = [_HEADER.pack(_MAGIC, 1, timestamp_us), struct.pack("<I", len(entries))]
+    for sign, dim, vec in entries:
+        parts.append(struct.pack("<QII", sign, dim, len(vec)))
+        parts.append(vec.astype(np.float32).tobytes())
+    return b"".join(parts)
+
+
+def unpack_packet(blob: bytes):
+    """Returns (timestamp_us, shard_format_blob) — the body is exactly the
+    checkpoint shard wire format, ready for ``store.load_shard_bytes``."""
+    magic, version, ts = _HEADER.unpack_from(blob, 0)
+    if magic != _MAGIC:
+        raise ValueError("not an incremental packet")
+    if version != 1:
+        raise ValueError(f"unsupported packet version {version}")
+    return ts, blob[_HEADER.size :]
+
+
+class IncrementalUpdateManager:
+    """Train-side: buffer touched signs, flush packets (ref: lib.rs:178-312).
+
+    Attach with :func:`attach_incremental`; the store calls :meth:`commit`
+    after each gradient batch. Flushing happens on a background thread when
+    the dedup buffer crosses ``buffer_size`` (and at ``flush_interval_sec``
+    heartbeats), never on the gradient hot path.
+    """
+
+    def __init__(
+        self,
+        store,
+        inc_dir: Union[str, StoragePath],
+        replica_index: int = 0,
+        buffer_size: int = 1_000_000,
+        flush_interval_sec: float = 10.0,
+        retain_packets: int = 64,
+    ):
+        self.store = store
+        self.root = storage_path(inc_dir)
+        self.replica_index = replica_index
+        self.buffer_size = buffer_size
+        self.flush_interval_sec = flush_interval_sec
+        self.retain_packets = retain_packets
+        self._pending: List[np.ndarray] = []
+        self._pending_count = 0
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._m_flushed = get_metrics().counter(
+            "persia_tpu_inc_entries_flushed", "embedding entries shipped incrementally"
+        )
+
+    # ------------------------------------------------------------- train side
+
+    def commit(self, signs: np.ndarray) -> None:
+        """Record signs touched by a gradient batch (dedup happens at flush)."""
+        with self._lock:
+            self._pending.append(np.asarray(signs, dtype=np.uint64).copy())
+            self._pending_count += len(signs)
+            if self._pending_count >= self.buffer_size:
+                self._wake.set()
+
+    def start(self) -> "IncrementalUpdateManager":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="inc-update-flusher"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, final_flush: bool = True) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if final_flush:
+            self.flush()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.flush_interval_sec)
+            self._wake.clear()
+            if self._stop.is_set():
+                break
+            try:
+                self.flush()
+            except Exception as e:  # flusher must survive any transient error
+                logger.warning("incremental flush failed (will retry): %s", e)
+
+    def flush(self) -> int:
+        """Dedup pending signs, snapshot their entries, write one packet.
+        Returns entries written (0 = nothing pending)."""
+        with self._lock:
+            if not self._pending_count:
+                return 0
+            arrays, self._pending, self._pending_count = self._pending, [], 0
+        signs = np.unique(np.concatenate(arrays))
+        entries = []
+        for s in signs.tolist():
+            rec = self.store.get_entry_record(s)  # atomic (dim, vec) snapshot
+            if rec is None:
+                continue  # evicted since the update — nothing to ship
+            dim, vec = rec
+            entries.append((s, dim, vec))
+        if not entries:
+            return 0
+        ts = time.time_ns() // 1000
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+        self.root.makedirs()
+        self.root.join(f"{self.replica_index}_{seq}.inc").write_bytes(
+            _pack_packet(entries, ts)
+        )
+        # informational marker for operators/external tooling: last shipped
+        # seq + flush time per replica (ref: inc_update_done, lib.rs:283-300).
+        # The loader itself discovers packets by listing, not via this marker.
+        self.root.join(DONE_MARKER + f".{self.replica_index}").write_text(
+            json.dumps({"replica": self.replica_index, "last_seq": seq, "time_us": ts})
+        )
+        # retention: a serving replica that boots from the latest full
+        # checkpoint only needs recent deltas; prune the tail so the dir and
+        # every scanner's listing stay bounded
+        stale = seq - self.retain_packets
+        if stale >= 0:
+            try:
+                self.root.join(f"{self.replica_index}_{stale}.inc").remove()
+            except StorageError as e:
+                logger.warning("could not prune old packet %d: %s", stale, e)
+        self._m_flushed.inc(len(entries))
+        logger.debug("incremental packet %d_%d.inc: %d entries", self.replica_index, seq, len(entries))
+        return len(entries)
+
+
+class IncrementalLoader:
+    """Infer-side: scan the incremental dir, load unseen packets
+    (ref: lib.rs:314-364). Entries re-route by sign on insert, so the serving
+    topology is independent of the training topology."""
+
+    def __init__(
+        self,
+        store,
+        inc_dir: Union[str, StoragePath],
+        scan_interval_sec: float = 10.0,
+    ):
+        self.store = store
+        self.root = storage_path(inc_dir)
+        self.scan_interval_sec = scan_interval_sec
+        # per-replica high-water seq: bounded state (a name set would grow
+        # with every packet ever shipped) and makes restarts replay only the
+        # retained tail
+        self._hwm: Dict[int, int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        m = get_metrics()
+        self._m_delay = m.gauge(
+            "persia_tpu_inc_update_delay_sec",
+            "age of the newest applied incremental packet at apply time",
+        )
+        self._m_loaded = m.counter(
+            "persia_tpu_inc_entries_loaded", "embedding entries applied from packets"
+        )
+
+    def poll_once(self) -> int:
+        """Scan + apply all unseen packets in (replica, seq) order. Returns
+        entries applied."""
+        try:
+            names = self.root.list() if self.root.exists() else []
+        except StorageError:
+            return 0
+        todo = []
+        for name in names:
+            m = _PACKET_RE.match(name)
+            if m:
+                replica, seq = int(m.group(1)), int(m.group(2))
+                if seq > self._hwm.get(replica, -1):
+                    todo.append((replica, seq, name))
+        todo.sort()
+        applied = 0
+        for replica, seq, name in todo:
+            try:
+                ts, body = unpack_packet(self.root.join(name).read_bytes())
+            except (StorageError, ValueError, struct.error) as e:
+                logger.warning("skipping bad incremental packet %s: %s", name, e)
+                self._hwm[replica] = seq  # don't retry a corrupt packet forever
+                continue
+            n = self.store.load_shard_bytes(body)
+            self._hwm[replica] = seq
+            applied += n
+            self._m_delay.set(max(0.0, time.time() - ts / 1e6))
+        if applied:
+            self._m_loaded.inc(applied)
+        return applied
+
+    def start(self) -> "IncrementalLoader":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="inc-update-loader"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.scan_interval_sec):
+            try:
+                self.poll_once()
+            except Exception as e:  # scanner must survive transient errors
+                logger.warning("incremental scan failed (will retry): %s", e)
+
+
+def attach_incremental(
+    store,
+    inc_dir: Union[str, StoragePath],
+    replica_index: int = 0,
+    buffer_size: int = 1_000_000,
+    flush_interval_sec: float = 10.0,
+) -> IncrementalUpdateManager:
+    """Hook a manager onto a store's gradient path: every
+    ``update_gradients`` commits its signs to the manager's buffer."""
+    mgr = IncrementalUpdateManager(
+        store, inc_dir, replica_index, buffer_size, flush_interval_sec
+    ).start()
+    store.inc_manager = mgr
+    return mgr

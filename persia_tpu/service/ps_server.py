@@ -174,6 +174,7 @@ def main(argv: Optional[list] = None) -> None:
     )
 
     capacity, shards = args.capacity, args.num_internal_shards
+    g = None
     if args.global_config:
         from persia_tpu.config import load_global_config
 
@@ -184,6 +185,22 @@ def main(argv: Optional[list] = None) -> None:
     store = create_store(
         args.backend, capacity=capacity, num_internal_shards=shards, seed=args.seed
     )
+    inc_loader = None
+    if g is not None and g.parameter_server.enable_incremental_update:
+        # train side ships deltas; infer side consumes them
+        # (ref: persia-incremental-update-manager/src/lib.rs:178-364)
+        from persia_tpu.config import JobType
+        from persia_tpu.incremental import IncrementalLoader, attach_incremental
+
+        psc = g.parameter_server
+        if g.common.job_type == JobType.INFER:
+            # started only after the boot checkpoint loads below — packets are
+            # newer than the checkpoint and must not be overwritten by it
+            inc_loader = IncrementalLoader(store, psc.incremental_dir)
+        else:
+            attach_incremental(
+                store, psc.incremental_dir, replica_index, psc.incremental_buffer_size
+            )
     svc = ParameterServerService(store, replica_index, replica_size, port=args.port)
     svc.start()
     logger.info(
@@ -192,6 +209,8 @@ def main(argv: Optional[list] = None) -> None:
     if args.load_checkpoint:
         load_store(store, args.load_checkpoint, replica_index, replica_size,
                    status=svc.status)
+    if inc_loader is not None:
+        inc_loader.start()
     if args.coordinator:
         CoordinatorClient(args.coordinator).register(
             "parameter_server", replica_index, f"{args.advertise_host}:{svc.port}"

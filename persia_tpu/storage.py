@@ -18,7 +18,13 @@ import posixpath
 import shutil
 import subprocess
 import tempfile
+import uuid
 from typing import List, Optional, Union
+
+# sampled once at import: os.umask() is process-wide and briefly setting it to
+# 0 per write would race concurrent writers (checkpoint IO is multithreaded)
+_UMASK = os.umask(0)
+os.umask(_UMASK)
 
 
 class StorageError(RuntimeError):
@@ -119,9 +125,7 @@ class DiskPath(StoragePath):
         try:
             # mkstemp creates 0600; restore normal umask-derived permissions so
             # checkpoint dirs stay readable by other users/jobs
-            umask = os.umask(0)
-            os.umask(umask)
-            os.fchmod(fd, 0o666 & ~umask)
+            os.fchmod(fd, 0o666 & ~_UMASK)
             with os.fdopen(fd, "wb") as f:
                 f.write(data)
             os.replace(tmp, self.uri)
@@ -185,7 +189,9 @@ class HdfsPath(StoragePath):
         # destination. HDFS `-mv` refuses to overwrite, so replacing an
         # existing file needs rm+mv; that window is unavoidable through the
         # CLI and is only entered when the destination verifiably exists.
-        tmp_remote = self.uri + ".tmp_put"
+        # unique per writer: replicas publishing the same path (e.g. the
+        # shared done-marker) must not collide on the staging name
+        tmp_remote = f"{self.uri}.tmp_put.{os.getpid()}_{uuid.uuid4().hex[:8]}"
         with tempfile.NamedTemporaryFile() as f:
             f.write(data)
             f.flush()

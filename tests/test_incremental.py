@@ -1,0 +1,165 @@
+"""Incremental update manager/loader tests (ref:
+persia-incremental-update-manager/src/lib.rs — train-side packet dumps,
+infer-side scanning, delay gauge)."""
+
+import numpy as np
+import pytest
+
+from persia_tpu.embedding.optim import Adagrad, SGD
+from persia_tpu.embedding.store import EmbeddingStore
+from persia_tpu.incremental import (
+    IncrementalLoader,
+    IncrementalUpdateManager,
+    attach_incremental,
+    unpack_packet,
+)
+from persia_tpu.metrics import get_metrics
+
+
+def _train_store(**kw):
+    return EmbeddingStore(
+        capacity=4096, num_internal_shards=4, optimizer=Adagrad(lr=0.1).config, seed=3, **kw
+    )
+
+
+def _touch(store, signs, dim=8):
+    signs = np.asarray(signs, dtype=np.uint64)
+    store.lookup(signs, dim, train=True)
+    store.update_gradients(signs, np.ones((len(signs), dim), dtype=np.float32))
+
+
+def test_flush_packet_and_load(tmp_path):
+    src = _train_store()
+    mgr = attach_incremental(src, str(tmp_path), buffer_size=10_000)
+    _touch(src, np.arange(1, 200))
+    assert mgr.flush() == 199
+
+    # serving store: no optimizer (infer replica), different shard count
+    dst = EmbeddingStore(capacity=4096, num_internal_shards=2)
+    loader = IncrementalLoader(dst, str(tmp_path))
+    assert loader.poll_once() == 199
+    probe = np.arange(1, 200, dtype=np.uint64)
+    np.testing.assert_array_equal(
+        dst.lookup(probe, 8, train=False), src.lookup(probe, 8, train=False)
+    )
+    # nothing new → no reload
+    assert loader.poll_once() == 0
+    mgr.stop(final_flush=False)
+
+
+def test_multiple_packets_applied_in_order(tmp_path):
+    src = _train_store()
+    mgr = attach_incremental(src, str(tmp_path), buffer_size=10_000)
+    _touch(src, [1, 2, 3])
+    mgr.flush()
+    _touch(src, [2, 3, 4])  # sign 2/3 get a second update
+    mgr.flush()
+
+    dst = EmbeddingStore(capacity=4096, num_internal_shards=4)
+    loader = IncrementalLoader(dst, str(tmp_path))
+    n = loader.poll_once()
+    assert n == 3 + 3
+    probe = np.array([1, 2, 3, 4], dtype=np.uint64)
+    np.testing.assert_array_equal(
+        dst.lookup(probe, 8, train=False), src.lookup(probe, 8, train=False)
+    )
+    mgr.stop(final_flush=False)
+
+
+def test_buffer_size_triggers_background_flush(tmp_path):
+    import time
+
+    src = _train_store()
+    mgr = attach_incremental(src, str(tmp_path), buffer_size=50, flush_interval_sec=60)
+    _touch(src, np.arange(1, 100))  # 99 signs > buffer_size
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        names = [n for n in mgr.root.list()] if mgr.root.exists() else []
+        if any(n.endswith(".inc") for n in names):
+            break
+        time.sleep(0.05)
+    assert any(n.endswith(".inc") for n in mgr.root.list())
+    mgr.stop(final_flush=False)
+
+
+def test_dedup_across_commits(tmp_path):
+    src = _train_store()
+    mgr = IncrementalUpdateManager(src, str(tmp_path))
+    _touch(src, [5, 6])
+    _touch(src, [6, 7])
+    assert mgr._pending_count == 0  # not attached — commits go through attach only
+    mgr.commit(np.array([5, 6], dtype=np.uint64))
+    mgr.commit(np.array([6, 7], dtype=np.uint64))
+    assert mgr.flush() == 3  # 5, 6, 7 deduped
+
+    ts, body = unpack_packet(mgr.root.join("0_0.inc").read_bytes())
+    assert ts > 0
+    dst = EmbeddingStore(capacity=64, num_internal_shards=1)
+    assert dst.load_shard_bytes(body) == 3
+
+
+def test_evicted_signs_skipped_at_flush(tmp_path):
+    src = _train_store()
+    mgr = attach_incremental(src, str(tmp_path), buffer_size=10_000)
+    _touch(src, [1, 2, 3])
+    src.clear()  # everything evicted before the flush
+    assert mgr.flush() == 0
+    mgr.stop(final_flush=False)
+
+
+def test_bad_packet_skipped(tmp_path):
+    from persia_tpu.storage import storage_path
+
+    root = storage_path(str(tmp_path))
+    root.join("0_0.inc").write_bytes(b"garbage-not-a-packet")
+    dst = EmbeddingStore(capacity=64, num_internal_shards=1)
+    loader = IncrementalLoader(dst, str(tmp_path))
+    assert loader.poll_once() == 0
+    assert loader._hwm[0] == 0  # not retried forever
+
+
+def test_retention_prunes_old_packets(tmp_path):
+    src = _train_store()
+    mgr = IncrementalUpdateManager(src, str(tmp_path), retain_packets=2)
+    for round_ in range(5):
+        _touch(src, [100 + round_])
+        mgr.commit(np.array([100 + round_], dtype=np.uint64))
+        mgr.flush()
+    packets = sorted(n for n in mgr.root.list() if n.endswith(".inc"))
+    assert packets == ["0_3.inc", "0_4.inc"]  # only the retained tail remains
+
+
+def test_delay_gauge_set(tmp_path):
+    src = _train_store()
+    mgr = attach_incremental(src, str(tmp_path), buffer_size=10_000)
+    _touch(src, [11, 12])
+    mgr.flush()
+    dst = EmbeddingStore(capacity=64, num_internal_shards=1)
+    IncrementalLoader(dst, str(tmp_path)).poll_once()
+    delay = get_metrics().gauge("persia_tpu_inc_update_delay_sec").get()
+    assert 0 <= delay < 30
+    mgr.stop(final_flush=False)
+
+
+def test_native_store_incremental(tmp_path):
+    """Native C++ store ships identical packets (get_entry_dim parity)."""
+    from persia_tpu.embedding.native_store import create_store, native_available
+
+    if not native_available():
+        pytest.skip("native core unavailable")
+    src = create_store("native", capacity=4096, num_internal_shards=4,
+                       optimizer=SGD(lr=0.5).config, seed=3)
+    mgr = attach_incremental(src, str(tmp_path), buffer_size=10_000)
+    signs = np.arange(1, 64, dtype=np.uint64)
+    src.lookup(signs, 8, train=True)
+    src.update_gradients(signs, np.ones((len(signs), 8), dtype=np.float32))
+    assert src.get_entry_dim(1) == 8
+    assert src.get_entry_dim(999999) is None
+    assert mgr.flush() == 63
+
+    dst = EmbeddingStore(capacity=4096, num_internal_shards=2)
+    assert IncrementalLoader(dst, str(tmp_path)).poll_once() == 63
+    np.testing.assert_array_equal(
+        dst.lookup(signs, 8, train=False), src.lookup(signs, 8, train=False)
+    )
+    mgr.stop(final_flush=False)
