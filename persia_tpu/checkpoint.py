@@ -137,6 +137,9 @@ def dump_store(
                 "num_replicas": replica_size,
                 "session": session,
                 "datetime": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                # serving replicas use this to skip incremental packets that
+                # predate the checkpoint (persia_tpu.incremental)
+                "time_us": time.time_ns() // 1000,
             }
             done_path.write_text(json.dumps(info))
         status.set("idle", 1.0)

@@ -205,8 +205,6 @@ class EmbeddingStore:
             raise RuntimeError("no optimizer registered")
         if grads.shape[0] != len(signs):
             raise ValueError("signs/grads length mismatch")
-        dim = grads.shape[1]
-        entry_len = dim + self._state_dim(dim)
         with self._lock:
             self._update_locked(signs, grads, group)
         if self.inc_manager is not None:

@@ -155,10 +155,20 @@ class IncrementalUpdateManager:
         with self._lock:
             seq = self._seq
             self._seq += 1
-        self.root.makedirs()
-        self.root.join(f"{self.replica_index}_{seq}.inc").write_bytes(
-            _pack_packet(entries, ts)
-        )
+        try:
+            self.root.makedirs()
+            self.root.join(f"{self.replica_index}_{seq}.inc").write_bytes(
+                _pack_packet(entries, ts)
+            )
+        except Exception:
+            # requeue so the retry actually retries these signs (otherwise a
+            # transient storage outage silently desyncs serving replicas)
+            with self._lock:
+                self._pending.append(signs)
+                self._pending_count += len(signs)
+                # the taken seq stays burned: reusing it could overwrite a
+                # packet a concurrent flush shipped in the meantime
+            raise
         # informational marker for operators/external tooling: last shipped
         # seq + flush time per replica (ref: inc_update_done, lib.rs:283-300).
         # The loader itself discovers packets by listing, not via this marker.
@@ -189,10 +199,15 @@ class IncrementalLoader:
         store,
         inc_dir: Union[str, StoragePath],
         scan_interval_sec: float = 10.0,
+        skip_before_us: int = 0,
     ):
         self.store = store
         self.root = storage_path(inc_dir)
         self.scan_interval_sec = scan_interval_sec
+        # packets older than this are marked seen but NOT applied — a serving
+        # replica booting from a full checkpoint must not regress entries to
+        # retained packets that predate it
+        self.skip_before_us = skip_before_us
         # per-replica high-water seq: bounded state (a name set would grow
         # with every packet ever shipped) and makes restarts replay only the
         # retained tail
@@ -230,6 +245,9 @@ class IncrementalLoader:
             except (StorageError, ValueError, struct.error) as e:
                 logger.warning("skipping bad incremental packet %s: %s", name, e)
                 self._hwm[replica] = seq  # don't retry a corrupt packet forever
+                continue
+            if ts < self.skip_before_us:
+                self._hwm[replica] = seq  # predates our boot checkpoint
                 continue
             n = self.store.load_shard_bytes(body)
             self._hwm[replica] = seq

@@ -13,3 +13,6 @@ array; raw (sequence) slots contribute a ``(gathered, mask)`` pair with
 
 from persia_tpu.models.dnn import DNN  # noqa: F401
 from persia_tpu.models.dlrm import DLRM  # noqa: F401
+from persia_tpu.models.deepfm import DeepFM  # noqa: F401
+from persia_tpu.models.dcn import DCNv2  # noqa: F401
+from persia_tpu.models.din import DIN  # noqa: F401

@@ -1,0 +1,61 @@
+"""DCN-v2 — deep & cross network v2 (BASELINE.json: DCN-v2 on Avazu).
+
+Cross layers use the v2 formulation ``x_{l+1} = x0 ⊙ (W x_l + b) + x_l``
+(optionally low-rank ``W = U Vᵀ``), run in parallel with a deep tower and
+concatenated for the output head — each cross layer is one (or two, when
+low-rank) MXU matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from persia_tpu.models.deepfm import field_matrix
+
+
+class CrossLayerV2(nn.Module):
+    """One DCN-v2 cross layer; ``rank`` enables the low-rank factorization."""
+
+    rank: Optional[int] = None
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x0, xl):
+        dt = self.compute_dtype
+        if self.rank is None:
+            wx = nn.Dense(x0.shape[-1], dtype=dt)(xl)
+        else:
+            wx = nn.Dense(self.rank, use_bias=False, dtype=dt)(xl)
+            wx = nn.Dense(x0.shape[-1], dtype=dt)(wx)
+        return x0 * wx + xl
+
+
+class DCNv2(nn.Module):
+    embedding_dim: int = 16
+    num_cross_layers: int = 3
+    cross_rank: Optional[int] = None  # None = full-rank W
+    deep_mlp: Sequence[int] = (256, 128)
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, non_id_features: List, embeddings: List, train: bool = True):
+        dt = self.compute_dtype
+        dense = jnp.concatenate([f.astype(dt) for f in non_id_features], axis=1)
+        fields = field_matrix(embeddings, dt)  # (B, n, d)
+        x0 = jnp.concatenate([dense, fields.reshape(fields.shape[0], -1)], axis=1)
+
+        # cross tower
+        xl = x0
+        for _ in range(self.num_cross_layers):
+            xl = CrossLayerV2(rank=self.cross_rank, compute_dtype=dt)(x0, xl)
+
+        # deep tower (parallel structure)
+        deep = x0
+        for h in self.deep_mlp:
+            deep = nn.relu(nn.Dense(h, dtype=dt)(deep))
+
+        out = jnp.concatenate([xl, deep], axis=1)
+        return nn.Dense(1, dtype=jnp.float32)(out)

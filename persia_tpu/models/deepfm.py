@@ -1,0 +1,69 @@
+"""DeepFM — FM + deep tower benchmark model (BASELINE.json: DeepFM on Avazu).
+
+The reference framework ships the dense half as a user-defined torch module
+(`/root/reference/persia/ctx.py:447` just calls ``model(...)``); this is the
+equivalent first-party model for the TPU engine's batch convention.
+
+TPU-first: the FM second-order term uses the square-of-sum minus
+sum-of-squares identity — two elementwise ops and a reduction, no pairwise
+loop — and the deep tower runs bf16 on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _pool_raw(emb, dt):
+    """Mean-pool a raw (sequence) slot ``(gathered, mask)`` to (B, d)."""
+    gathered, mask = emb
+    m = mask[..., None].astype(gathered.dtype)
+    denom = jnp.maximum(m.sum(axis=1), 1.0)
+    return ((gathered * m).sum(axis=1) / denom).astype(dt)
+
+
+def field_matrix(embeddings: List, dt) -> jnp.ndarray:
+    """Stack per-slot embeddings into (B, n_fields, d); raw slots mean-pool."""
+    fields = [
+        _pool_raw(e, dt) if isinstance(e, tuple) else e.astype(dt) for e in embeddings
+    ]
+    return jnp.stack(fields, axis=1)
+
+
+class DeepFM(nn.Module):
+    embedding_dim: int = 16
+    deep_mlp: Sequence[int] = (256, 128)
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, non_id_features: List, embeddings: List, train: bool = True):
+        dt = self.compute_dtype
+        dense = jnp.concatenate([f.astype(dt) for f in non_id_features], axis=1)
+        fields = field_matrix(embeddings, dt)  # (B, n, d)
+        B = fields.shape[0]
+
+        # first-order terms: a learned scalar per field + linear over dense
+        first = nn.Dense(1, dtype=jnp.float32, name="dense_linear")(dense)
+        field_w = self.param(
+            "field_weight", nn.initializers.zeros, (fields.shape[1],), jnp.float32
+        )
+        first = first + (fields.astype(jnp.float32).sum(-1) * field_w).sum(
+            axis=1, keepdims=True
+        )
+
+        # second-order FM: 0.5 * ((Σv)² − Σv²), summed over the dim axis
+        sum_v = fields.sum(axis=1)
+        fm = 0.5 * (sum_v * sum_v - (fields * fields).sum(axis=1)).sum(
+            axis=1, keepdims=True
+        ).astype(jnp.float32)
+
+        # deep tower over [dense | flattened fields]
+        deep = jnp.concatenate([dense, fields.reshape(B, -1)], axis=1)
+        for h in self.deep_mlp:
+            deep = nn.relu(nn.Dense(h, dtype=dt)(deep))
+        deep = nn.Dense(1, dtype=jnp.float32, name="deep_out")(deep)
+
+        return first + fm + deep

@@ -1,0 +1,78 @@
+"""DIN — deep interest network (BASELINE.json: DIN on Taobao).
+
+Raw (sequence) slots are the user's behavior history; instead of mean-pooling
+them, DIN scores each history item against the candidate item with a small
+"attention unit" MLP over ``[item, target, item − target, item · target]``
+and pools with the resulting weights.
+
+Batch convention: pooled slots are regular field embeddings; the FIRST pooled
+slot is the candidate/target item (configurable via ``target_slot``); every
+raw slot is attention-pooled against it. Padded history positions are masked
+with −inf before the softmax, so autodiff sends them exactly zero gradient.
+
+TPU-first: the attention unit runs over the whole (B, L, 4d) tensor in one
+bf16 matmul batch; no per-position loops, static shapes throughout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class AttentionUnit(nn.Module):
+    """DIN activation unit → one logit per history position."""
+
+    hidden: Sequence[int] = (36,)
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, items, target):
+        # items (B, L, d), target (B, d)
+        t = jnp.broadcast_to(target[:, None, :], items.shape)
+        x = jnp.concatenate([items, t, items - t, items * t], axis=-1)
+        for h in self.hidden:
+            x = nn.Dense(h, dtype=self.compute_dtype)(x)
+            # Dice in the paper; PReLU-family — sigmoid-gated works fine on MXU
+            x = x * nn.sigmoid(x)
+        return nn.Dense(1, dtype=jnp.float32)(x)[..., 0]  # (B, L)
+
+
+class DIN(nn.Module):
+    embedding_dim: int = 16
+    attention_hidden: Sequence[int] = (36,)
+    top_mlp: Sequence[int] = (200, 80)
+    target_slot: int = 0  # index among the POOLED slots that is the candidate
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, non_id_features: List, embeddings: List, train: bool = True):
+        dt = self.compute_dtype
+        dense = jnp.concatenate([f.astype(dt) for f in non_id_features], axis=1)
+
+        pooled = [e.astype(dt) for e in embeddings if not isinstance(e, tuple)]
+        raws = [e for e in embeddings if isinstance(e, tuple)]
+        if not pooled:
+            raise ValueError("DIN needs at least one pooled slot as the target item")
+        target = pooled[self.target_slot]
+
+        interests = []
+        for i, (hist, mask) in enumerate(raws):
+            hist = hist.astype(dt)
+            logits = AttentionUnit(
+                hidden=self.attention_hidden, compute_dtype=dt, name=f"att_{i}"
+            )(hist, target)
+            logits = jnp.where(mask, logits, -jnp.inf)
+            # all-padding rows would softmax to NaN; give them weight 0
+            any_valid = mask.any(axis=1, keepdims=True)
+            w = nn.softmax(jnp.where(any_valid, logits, 0.0), axis=1)
+            w = jnp.where(mask, w, 0.0).astype(dt)
+            interests.append(jnp.einsum("bl,bld->bd", w, hist))
+
+        x = jnp.concatenate([dense] + pooled + interests, axis=1)
+        for h in self.top_mlp:
+            x = nn.Dense(h, dtype=dt)(x)
+            x = x * nn.sigmoid(x)
+        return nn.Dense(1, dtype=jnp.float32)(x)

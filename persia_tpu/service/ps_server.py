@@ -185,20 +185,19 @@ def main(argv: Optional[list] = None) -> None:
     store = create_store(
         args.backend, capacity=capacity, num_internal_shards=shards, seed=args.seed
     )
-    inc_loader = None
+    inc_mgr = None
+    inc_infer = False
     if g is not None and g.parameter_server.enable_incremental_update:
         # train side ships deltas; infer side consumes them
         # (ref: persia-incremental-update-manager/src/lib.rs:178-364)
         from persia_tpu.config import JobType
-        from persia_tpu.incremental import IncrementalLoader, attach_incremental
+        from persia_tpu.incremental import attach_incremental
 
         psc = g.parameter_server
         if g.common.job_type == JobType.INFER:
-            # started only after the boot checkpoint loads below — packets are
-            # newer than the checkpoint and must not be overwritten by it
-            inc_loader = IncrementalLoader(store, psc.incremental_dir)
+            inc_infer = True  # loader starts after the boot checkpoint below
         else:
-            attach_incremental(
+            inc_mgr = attach_incremental(
                 store, psc.incremental_dir, replica_index, psc.incremental_buffer_size
             )
     svc = ParameterServerService(store, replica_index, replica_size, port=args.port)
@@ -206,17 +205,34 @@ def main(argv: Optional[list] = None) -> None:
     logger.info(
         "parameter server %d/%d on port %d", replica_index, replica_size, svc.port
     )
+    skip_before_us = 0
     if args.load_checkpoint:
         load_store(store, args.load_checkpoint, replica_index, replica_size,
                    status=svc.status)
-    if inc_loader is not None:
-        inc_loader.start()
+        try:
+            from persia_tpu.checkpoint import checkpoint_info
+
+            skip_before_us = int(checkpoint_info(args.load_checkpoint).get("time_us", 0))
+        except Exception:
+            pass  # markerless/legacy checkpoint — apply all retained packets
+    if inc_infer:
+        # started only after the boot checkpoint: applies only packets newer
+        # than it, so stale retained deltas can't regress loaded entries
+        from persia_tpu.incremental import IncrementalLoader
+
+        IncrementalLoader(
+            store, g.parameter_server.incremental_dir, skip_before_us=skip_before_us
+        ).start()
     if args.coordinator:
         CoordinatorClient(args.coordinator).register(
             "parameter_server", replica_index, f"{args.advertise_host}:{svc.port}"
         )
     # server runs in its background thread; park until the 'shutdown' RPC
     svc.server._thread.join()
+    if inc_mgr is not None:
+        # ship the final flush window before exit (the reference flushes on
+        # drop); without this the last seconds of updates never reach serving
+        inc_mgr.stop(final_flush=True)
 
 
 if __name__ == "__main__":

@@ -192,25 +192,35 @@ class HdfsPath(StoragePath):
         # unique per writer: replicas publishing the same path (e.g. the
         # shared done-marker) must not collide on the staging name
         tmp_remote = f"{self.uri}.tmp_put.{os.getpid()}_{uuid.uuid4().hex[:8]}"
-        with tempfile.NamedTemporaryFile() as f:
-            f.write(data)
-            f.flush()
-            _run(self.cli() + ["-put", "-f", f.name, tmp_remote])
-        proc = subprocess.run(
-            self.cli() + ["-mv", tmp_remote, self.uri],
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.PIPE,
-        )
-        if proc.returncode != 0:
-            if not self.exists():
-                # transient failure, not an overwrite refusal — don't touch
-                # the destination
-                raise StorageError(
-                    f"hdfs mv {tmp_remote} -> {self.uri} failed: "
-                    f"{proc.stderr.decode(errors='replace')[:500]}"
-                )
-            _run(self.cli() + ["-rm", "-f", self.uri])
-            _run(self.cli() + ["-mv", tmp_remote, self.uri])
+        try:
+            with tempfile.NamedTemporaryFile() as f:
+                f.write(data)
+                f.flush()
+                _run(self.cli() + ["-put", "-f", f.name, tmp_remote])
+            proc = subprocess.run(
+                self.cli() + ["-mv", tmp_remote, self.uri],
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE,
+            )
+            if proc.returncode != 0:
+                if not self.exists():
+                    # transient failure, not an overwrite refusal — don't
+                    # touch the destination
+                    raise StorageError(
+                        f"hdfs mv {tmp_remote} -> {self.uri} failed: "
+                        f"{proc.stderr.decode(errors='replace')[:500]}"
+                    )
+                _run(self.cli() + ["-rm", "-f", self.uri])
+                _run(self.cli() + ["-mv", tmp_remote, self.uri])
+        except BaseException:
+            # the unique staging name is never reclaimed by later writes —
+            # sweep it so retry loops can't litter the directory
+            subprocess.run(
+                self.cli() + ["-rm", "-f", tmp_remote],
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+            raise
 
     def append_bytes(self, data: bytes) -> None:
         _run(self.cli() + ["-appendToFile", "-", self.uri], input_bytes=data)

@@ -141,6 +141,57 @@ def test_delay_gauge_set(tmp_path):
     mgr.stop(final_flush=False)
 
 
+def test_loader_skips_packets_older_than_checkpoint(tmp_path):
+    import time
+
+    src = _train_store()
+    mgr = attach_incremental(src, str(tmp_path), buffer_size=10_000)
+    _touch(src, [1, 2, 3])
+    mgr.flush()
+    cutoff = time.time_ns() // 1000  # "checkpoint" taken now
+    _touch(src, [4, 5])
+    mgr.flush()
+
+    dst = EmbeddingStore(capacity=4096, num_internal_shards=1)
+    loader = IncrementalLoader(dst, str(tmp_path), skip_before_us=cutoff)
+    n = loader.poll_once()
+    assert n == 2  # only the post-cutoff packet applied
+    assert dst.size() == 2
+    assert loader._hwm[0] == 1  # but both packets are marked seen
+    mgr.stop(final_flush=False)
+
+
+def test_flush_requeues_on_write_failure(tmp_path):
+    src = _train_store()
+    mgr = IncrementalUpdateManager(src, str(tmp_path))
+    _touch(src, [1, 2, 3])
+    mgr.commit(np.array([1, 2, 3], dtype=np.uint64))
+
+    real_join = mgr.root.join
+    calls = {"n": 0}
+
+    class Boom(Exception):
+        pass
+
+    def flaky_join(*parts):
+        p = real_join(*parts)
+        if parts and parts[0].endswith(".inc") and calls["n"] == 0:
+            calls["n"] += 1
+
+            class FailingPath:
+                def write_bytes(self, data):
+                    raise Boom("storage down")
+
+            return FailingPath()
+        return p
+
+    mgr.root.join = flaky_join
+    with pytest.raises(Boom):
+        mgr.flush()
+    assert mgr._pending_count == 3  # requeued, not dropped
+    assert mgr.flush() == 3  # retry ships them
+
+
 def test_native_store_incremental(tmp_path):
     """Native C++ store ships identical packets (get_entry_dim parity)."""
     from persia_tpu.embedding.native_store import create_store, native_available
