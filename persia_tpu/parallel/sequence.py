@@ -1,0 +1,186 @@
+"""Sequence/context parallelism: ring attention and all-to-all (Ulysses).
+
+The reference has no sequence parallelism (SURVEY.md §2.6 / §5 — its
+"sequences" are variable-length ID lists). A TPU-native framework treats
+long-context as first-class: the sequence axis of attention is sharded over a
+mesh axis and the KV blocks ride ICI.
+
+Two strategies, both built on ``jax.shard_map`` so XLA sees a static SPMD
+program:
+
+- **Ring attention** (`ring_attention`): each device holds a [B, L/n, H, D]
+  shard of Q/K/V. K/V blocks rotate around the ring with ``lax.ppermute``
+  while each device accumulates its queries' attention with the
+  online-softmax (flash) recurrence — peak memory O(L/n), full overlap of
+  compute with ICI transfer. Supports causal masking via global position ids.
+- **Ulysses / all-to-all** (`ulysses_attention`): two ``lax.all_to_all``
+  collectives re-shard [B, L/n, H, D] → [B, L, H/n, D] so every device runs
+  dense attention over the full sequence for a head subset, then shards back.
+  Requires num_heads % n == 0; cheaper collectives for moderate L.
+
+Both return bit-identical results to single-device attention (see
+tests/test_sequence_parallel.py) and compose with the ``data`` axis of the
+training mesh (mesh axes ("data", "sp")).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_BIG = -1e30
+
+
+def _attn_block(q, k, v, mask, m_prev, l_prev, o_prev, scale):
+    """One online-softmax (flash) accumulation step.
+
+    q: [B, Lq, H, D]; k, v: [B, Lk, H, D]; mask: [Lq, Lk] bool or None.
+    m, l: [B, Lq, H]; o: [B, Lq, H, D].
+    """
+    s = jnp.einsum("bqhd,bkhd->bqhk", q, k) * scale
+    if mask is not None:
+        ms = jnp.where(mask[None, :, None, :], s, _NEG_BIG)
+    else:
+        ms = s
+    m_cur = jnp.max(ms, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(ms - m_new[..., None])
+    if mask is not None:
+        p = jnp.where(mask[None, :, None, :], p, 0.0)
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_prev * corr + jnp.sum(p, axis=-1)
+    o_new = o_prev * corr[..., None] + jnp.einsum("bqhk,bkhd->bqhd", p, v)
+    return m_new, l_new, o_new
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale: float):
+    """Per-shard body: rotate K/V around the ring, accumulate online softmax."""
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, l_loc, h, d = q.shape
+    q_pos = idx * l_loc + jnp.arange(l_loc)
+
+    m0 = jnp.full((b, l_loc, h), _NEG_BIG, dtype=jnp.float32)
+    l0 = jnp.zeros((b, l_loc, h), dtype=jnp.float32)
+    o0 = jnp.zeros((b, l_loc, h, d), dtype=jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(step, carry):
+        m, l, o, k_cur, v_cur = carry
+        src = (idx - step) % n  # which global block this device holds now
+        if causal:
+            k_pos = src * l_loc + jnp.arange(l_loc)
+            mask = k_pos[None, :] <= q_pos[:, None]
+        else:
+            mask = None
+        m, l, o = _attn_block(
+            q.astype(jnp.float32), k_cur.astype(jnp.float32),
+            v_cur.astype(jnp.float32), mask, m, l, o, scale,
+        )
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return m, l, o, k_nxt, v_nxt
+
+    m, l, o, _, _ = lax.fori_loop(0, n, body, (m0, l0, o0, k, v))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Ring attention over sequence shards.
+
+    q, k, v: [B, L, H, D] with L sharded over ``axis_name`` of ``mesh``.
+    Returns [B, L, H, D] sharded the same way. Peak per-device memory is
+    O(L/n); the K/V ring rides ICI via ``ppermute``.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(
+            _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def _dense_attention(q, k, v, causal: bool, scale: float):
+    """Plain softmax attention: q,k,v [B, L, H, D] (fp32 accumulation)."""
+    s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32), k.astype(jnp.float32))
+    s = s * scale
+    if causal:
+        lq, lk = s.shape[1], s.shape[3]
+        mask = jnp.arange(lk)[None, :] <= jnp.arange(lq)[:, None]
+        s = jnp.where(mask[None, :, None, :], s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqhk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _ulysses_local(q, k, v, axis_name: str, causal: bool, scale: float):
+    # [B, L/n, H, D] → all-to-all → [B, L, H/n, D]
+    def gather_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def scatter_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qg, kg, vg = gather_seq(q), gather_seq(k), gather_seq(v)
+    out = _dense_attention(qg, kg, vg, causal, scale)
+    return scatter_seq(out)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """All-to-all (DeepSpeed-Ulysses-style) sequence-parallel attention.
+
+    Re-shards sequence↔heads with two ``all_to_all`` collectives and runs
+    dense attention per head subset. Requires H % mesh.shape[axis_name] == 0.
+    """
+    n = mesh.shape[axis_name]
+    h = q.shape[2]
+    if h % n != 0:
+        raise ValueError(f"num_heads={h} not divisible by mesh axis {axis_name}={n}")
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(
+            _ulysses_local, axis_name=axis_name, causal=causal, scale=scale
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = False, scale: Optional[float] = None):
+    """Single-device oracle used by tests and by models off-mesh."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _dense_attention(q, k, v, causal, scale)
