@@ -1,0 +1,174 @@
+"""On-TPU sharded embedding tables (the HBM fast path).
+
+The reference keeps ALL embedding tables on CPU parameter servers
+(`rust/persia-embedding-server/src/embedding_parameter_service/mod.rs`) because
+GPU memory can't hold them. A TPU pod has a different sweet spot: tables up to
+a few hundred GB fit in pooled HBM when sharded over a mesh axis, and lookups
+become on-device gathers + an ICI ``psum`` — no host round-trip, no staleness,
+trained synchronously by the same optimizer step as the dense half.
+
+persia_tpu therefore has two embedding tiers:
+
+- **Host PS tier** (`persia_tpu.embedding.store` / `native_store`): unbounded
+  vocab, LRU eviction, async bounded-staleness updates — parity with the
+  reference, for the 100T-scale tail.
+- **This module**: medium tables resident in HBM, rows sharded over the ``ep``
+  mesh axis, lookup = local gather masked to the shard's row range + ``psum``
+  over ``ep``. Gradients flow through plain autodiff: the local gather's
+  transpose is a scatter-add into the local shard, so the update is exact and
+  synchronous.
+
+Everything is functional: tables are pytree leaves you put in the optax param
+tree. ``EmbeddingSpec``/``create_tables``/``embedding_lookup``/``embedding_bag``.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class EmbeddingSpec:
+    """Declares one HBM-resident table (ref capability: SlotConfig dim/init,
+    `rust/persia-embedding-config/src/lib.rs:528-560`, minus LRU)."""
+
+    vocab: int
+    dim: int
+    init_scale: float = 0.01
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def table_sharding(mesh: Mesh, axis: str = "ep") -> NamedSharding:
+    """Rows over ``axis``, embedding dim replicated."""
+    return NamedSharding(mesh, P(axis, None))
+
+
+def create_table(
+    key: jax.Array,
+    spec: EmbeddingSpec,
+    mesh: Mesh,
+    axis: str = "ep",
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Uniform(-init_scale, init_scale) table, padded to the shard count
+    (padding rows zeroed) and placed with rows sharded over ``axis``."""
+    n = mesh.shape[axis]
+    vpad = _round_up(spec.vocab, n)
+    tbl = jax.random.uniform(
+        key, (vpad, spec.dim), dtype=dtype, minval=-spec.init_scale, maxval=spec.init_scale
+    )
+    if vpad > spec.vocab:
+        tbl = tbl.at[spec.vocab :].set(0.0)
+    return jax.device_put(tbl, table_sharding(mesh, axis))
+
+
+def create_tables(
+    key: jax.Array,
+    specs: Dict[str, EmbeddingSpec],
+    mesh: Mesh,
+    axis: str = "ep",
+    dtype=jnp.float32,
+) -> Dict[str, jax.Array]:
+    keys = jax.random.split(key, len(specs))
+    return {
+        name: create_table(k, spec, mesh, axis, dtype)
+        for k, (name, spec) in zip(keys, sorted(specs.items()))
+    }
+
+
+def _local_lookup(tbl, ids, axis: str):
+    """Per-shard gather: rows outside this shard contribute zeros; psum over
+    ``axis`` assembles the full embedding. ids may be any integer shape."""
+    rows = tbl.shape[0]
+    start = lax.axis_index(axis) * rows
+    loc = ids.astype(jnp.int32) - start
+    valid = (loc >= 0) & (loc < rows)
+    emb = jnp.take(tbl, jnp.clip(loc, 0, rows - 1), axis=0)
+    emb = jnp.where(valid[..., None], emb, jnp.zeros((), emb.dtype))
+    return lax.psum(emb, axis)
+
+
+def embedding_lookup(
+    table: jax.Array,
+    ids: jax.Array,
+    mesh: Mesh,
+    axis: str = "ep",
+    data_axis: Optional[str] = None,
+) -> jax.Array:
+    """ids [...] int → embeddings [..., dim].
+
+    ``data_axis``: if given, the leading axis of ``ids`` is sharded over that
+    mesh axis (composing DP with embedding parallelism); output is sharded the
+    same way. Ids must lie in [0, vocab); ids in [vocab, padded_rows) hit the
+    zero-initialized padding rows, ids >= padded_rows return zeros.
+    """
+    ids_spec = P(data_axis) if data_axis else P()
+    fn = jax.shard_map(
+        functools.partial(_local_lookup, axis=axis),
+        mesh=mesh,
+        in_specs=(P(axis, None), ids_spec),
+        out_specs=ids_spec,
+        check_vma=False,
+    )
+    return fn(table, ids)
+
+
+def embedding_bag(
+    table: jax.Array,
+    ids: jax.Array,
+    mesh: Mesh,
+    axis: str = "ep",
+    data_axis: Optional[str] = None,
+    mode: str = "sum",
+    sqrt_scaling: bool = False,
+) -> jax.Array:
+    """Pooled lookup over the last ids axis (ref: sum-pooling postprocess,
+    `embedding_worker_service/mod.rs:537-584`).
+
+    ids [..., L] with negative entries masked out (padding). mode: "sum" |
+    "mean". ``sqrt_scaling`` divides the sum by sqrt(count) like the
+    reference's optional scaling (sum mode only).
+    """
+    if mode not in ("sum", "mean"):
+        raise ValueError(f"mode must be sum|mean, got {mode}")
+    if mode == "mean" and sqrt_scaling:
+        raise ValueError("sqrt_scaling only applies to mode='sum'")
+    mask = ids >= 0
+    safe_ids = jnp.where(mask, ids, 0)
+    emb = embedding_lookup(table, safe_ids, mesh, axis, data_axis)
+    emb = emb * mask[..., None].astype(emb.dtype)
+    pooled = jnp.sum(emb, axis=-2)
+    count = jnp.maximum(jnp.sum(mask, axis=-1), 1).astype(pooled.dtype)
+    if mode == "mean":
+        pooled = pooled / count[..., None]
+    elif sqrt_scaling:
+        pooled = pooled / jnp.sqrt(count)[..., None]
+    return pooled
+
+
+def lookup_all(
+    tables: Dict[str, jax.Array],
+    ids: Dict[str, jax.Array],
+    mesh: Mesh,
+    axis: str = "ep",
+    data_axis: Optional[str] = None,
+) -> Dict[str, jax.Array]:
+    """Batched convenience: per-slot pooled (2-D ids) or single-id lookup."""
+    out = {}
+    for name, tbl in tables.items():
+        i = ids[name]
+        if i.ndim >= 2:
+            out[name] = embedding_bag(tbl, i, mesh, axis, data_axis)
+        else:
+            out[name] = embedding_lookup(tbl, i, mesh, axis, data_axis)
+    return out
