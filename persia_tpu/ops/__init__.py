@@ -1,0 +1,9 @@
+"""Pallas TPU kernels for the hot ops (VMEM-tiled, MXU-shaped).
+
+The reference's hand-written hot loops are CPU AVX2 kernels
+(`rust/persia-simd/src/lib.rs`) — those stay on the host-PS side (see
+``native/ps.cpp``). This package is the device-side counterpart: Pallas
+kernels for ops where XLA's default fusion leaves performance on the table.
+"""
+
+from persia_tpu.ops.flash_attention import flash_attention  # noqa: F401
