@@ -56,6 +56,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     coord = sub.add_parser("coordinator", help="run the discovery/control service")
     coord.add_argument("--port", type=int, default=int(os.environ.get("PERSIA_COORDINATOR_PORT", "7799")))
 
+    # k8s sub-CLI (ref: persia/k8s_utils.py gencrd/operator/server)
+    k8s = sub.add_parser("k8s", help="generate/apply k8s manifests")
+    k8s.add_argument("action", choices=["gen", "gencrd", "apply", "delete"])
+    k8s.add_argument("--job-yaml", type=str, default=None,
+                     help="PersiaTpuJob CR or bare spec yaml file")
+    k8s.add_argument("--name", type=str, default=None, help="job name (delete)")
+    k8s.add_argument("--namespace", type=str, default=None,
+                     help="override the spec/CR namespace")
+
     args = ap.parse_args(argv)
     py = sys.executable
 
@@ -104,8 +113,44 @@ def main(argv: Optional[List[str]] = None) -> int:
         c.server._thread.join()
         return 0
 
+    if args.role == "k8s":
+        from persia_tpu import k8s as k8s_mod
+        from persia_tpu.utils import dump_yaml_str
+
+        if args.action == "gencrd":
+            print(dump_yaml_str(k8s_mod.generate_crd()))
+            return 0
+        if args.action == "delete":
+            if not args.name:
+                print("k8s delete requires --name", file=sys.stderr)
+                return 2
+            return k8s_mod.delete(args.name, args.namespace or "default")
+        if not args.job_yaml:
+            print(f"k8s {args.action} requires --job-yaml", file=sys.stderr)
+            return 2
+        with open(args.job_yaml) as f:
+            spec = k8s_mod.load_job_yaml(f.read())
+        if args.namespace:
+            spec.namespace = args.namespace
+        if args.action == "gen":
+            print(k8s_mod.manifests_yaml(spec))
+            return 0
+        return k8s_mod.apply(spec)
+
     return 2
 
 
+def _cli() -> None:
+    try:
+        rc = main()
+    except BrokenPipeError:  # e.g. `... k8s gen | head`
+        # Redirect stdout to devnull so the interpreter-shutdown flush of the
+        # closed pipe can't raise again (python docs SIGPIPE recipe).
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+        rc = 0
+    sys.exit(rc)
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    _cli()

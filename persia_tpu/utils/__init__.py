@@ -32,6 +32,14 @@ def dump_yaml(content: Dict[str, Any], path: str) -> None:
         yaml.safe_dump(content, f)
 
 
+def load_yaml_str(text: str) -> Dict[str, Any]:
+    return yaml.safe_load(text) or {}
+
+
+def dump_yaml_str(content: Dict[str, Any]) -> str:
+    return yaml.safe_dump(content, sort_keys=False)
+
+
 def run_command(cmd: List[str], **kwargs) -> None:
     subprocess.check_call(cmd, **kwargs)
 

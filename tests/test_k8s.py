@@ -1,0 +1,171 @@
+"""k8s manifest generation: replica envs, services, CRD, CR round-trip."""
+
+import pytest
+
+from persia_tpu.k8s import (
+    JOB_LABEL,
+    KIND,
+    JobSpec,
+    RoleSpec,
+    TpuSpec,
+    generate_crd,
+    generate_manifests,
+    job_from_custom_resource,
+    load_job_yaml,
+    manifests_yaml,
+)
+from persia_tpu.utils import load_yaml_str
+
+
+def _spec(**kw):
+    defaults = dict(
+        name="demo",
+        image="gcr.io/x/persia-tpu:latest",
+        parameter_server=RoleSpec(replicas=2),
+        embedding_worker=RoleSpec(replicas=2),
+        trainer=RoleSpec(replicas=1, args=["train.py"]),
+        data_loader=RoleSpec(replicas=1, args=["loader.py"]),
+        tpu=TpuSpec(accelerator="tpu-v5-lite-podslice", topology="2x4",
+                    chips_per_host=4, num_hosts=2),
+    )
+    defaults.update(kw)
+    return JobSpec(**defaults)
+
+
+def _by_role(manifests, role, kind="Pod"):
+    return [m for m in manifests
+            if m["kind"] == kind and m["metadata"].get("labels", {}).get(
+                "persia-tpu-role") == role]
+
+
+def test_replica_envs_and_counts():
+    ms = generate_manifests(_spec())
+    ps = _by_role(ms, "parameter-server")
+    assert len(ps) == 2
+    env = {e["name"]: e["value"] for e in ps[1]["spec"]["containers"][0]["env"]}
+    assert env["REPLICA_INDEX"] == "1"
+    assert env["REPLICA_SIZE"] == "2"
+    assert "demo-coordinator" in env["PERSIA_COORDINATOR_ADDR"]
+
+
+def test_worker_knows_ps_count():
+    ms = generate_manifests(_spec())
+    ew = _by_role(ms, "embedding-worker")[0]
+    cmd = ew["spec"]["containers"][0]["command"]
+    assert "--num-parameter-servers" in cmd
+    assert cmd[cmd.index("--num-parameter-servers") + 1] == "2"
+
+
+def test_trainer_tpu_pods():
+    ms = generate_manifests(_spec())
+    tr = _by_role(ms, "trainer")
+    assert len(tr) == 2  # 1 replica x 2 hosts
+    pod = tr[0]
+    sel = pod["spec"]["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+    assert sel["cloud.google.com/gke-tpu-topology"] == "2x4"
+    res = pod["spec"]["containers"][0]["resources"]["limits"]
+    assert res["google.com/tpu"] == 4
+    env = {e["name"]: e["value"] for e in pod["spec"]["containers"][0]["env"]}
+    assert env["JAX_NUM_PROCESSES"] == "2"
+    assert env["JAX_PROCESS_ID"] == "0"
+    env1 = {e["name"]: e["value"] for e in tr[1]["spec"]["containers"][0]["env"]}
+    assert env1["JAX_PROCESS_ID"] == "1"
+
+
+def test_all_objects_carry_job_label():
+    ms = generate_manifests(_spec(enable_metrics=True))
+    assert all(m["metadata"]["labels"][JOB_LABEL] == "demo" for m in ms)
+    kinds = {m["kind"] for m in ms}
+    assert kinds == {"Pod", "Service", "Deployment"}
+
+
+def test_metrics_gateway_optional():
+    no_metrics = generate_manifests(_spec())
+    assert not [m for m in no_metrics if m["kind"] == "Deployment"]
+    with_metrics = generate_manifests(_spec(enable_metrics=True))
+    gw = [m for m in with_metrics if m["kind"] == "Deployment"]
+    assert len(gw) == 1
+    env = {e["name"]: e["value"]
+           for e in _by_role(with_metrics, "parameter-server")[0]
+           ["spec"]["containers"][0]["env"]}
+    assert "metrics-gateway" in env["PERSIA_METRICS_GATEWAY_ADDR"]
+
+
+def test_crd_schema_names():
+    crd = generate_crd()
+    assert crd["metadata"]["name"] == "persiatpujobs.persia-tpu.dev"
+    schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    assert "trainer" in schema["properties"]["spec"]["properties"]
+    assert schema["properties"]["spec"]["required"] == ["image"]
+
+
+def test_cr_round_trip():
+    cr = {
+        "apiVersion": "persia-tpu.dev/v1",
+        "kind": KIND,
+        "metadata": {"name": "job1", "namespace": "ml"},
+        "spec": {
+            "image": "img:1",
+            "parameterServer": {"replicas": 3, "env": {"A": "1"}},
+            "trainer": {"replicas": 2, "args": ["t.py"]},
+            "tpu": {"topology": "4x4", "numHosts": 4, "chipsPerHost": 4},
+            "enableMetrics": True,
+        },
+    }
+    spec = job_from_custom_resource(cr)
+    assert spec.name == "job1" and spec.namespace == "ml"
+    assert spec.parameter_server.replicas == 3
+    assert spec.parameter_server.env == {"A": "1"}
+    assert spec.tpu.topology == "4x4"
+    ms = generate_manifests(spec)
+    assert len(_by_role(ms, "trainer")) == 8  # 2 replicas x 4 hosts
+    assert ms[0]["metadata"]["namespace"] == "ml"
+
+
+def test_cr_wrong_kind_rejected():
+    with pytest.raises(ValueError):
+        job_from_custom_resource({"kind": "Nope", "metadata": {"name": "x"},
+                                  "spec": {"image": "i"}})
+
+
+def test_yaml_round_trip_and_bare_spec():
+    text = """
+name: bare
+image: img:2
+parameterServer:
+  replicas: 1
+trainer:
+  replicas: 1
+"""
+    spec = load_job_yaml(text)
+    assert spec.name == "bare"
+    docs = manifests_yaml(spec).split("\n---\n")
+    parsed = [load_yaml_str(d) for d in docs]
+    assert any(p["kind"] == "Service" for p in parsed)
+    assert all(p["metadata"]["labels"][JOB_LABEL] == "bare" for p in parsed)
+
+
+def test_null_valued_yaml_keys_tolerated():
+    """Empty `env:` / `args:` / `resources:` keys (common YAML idiom)."""
+    spec = load_job_yaml("""
+name: nully
+image: img:3
+parameterServer:
+  replicas: 1
+  env:
+  args:
+  resources:
+trainer:
+  replicas: 1
+  resources:
+""")
+    ms = generate_manifests(spec)
+    assert _by_role(ms, "parameter-server")
+
+
+def test_missing_name_and_image_rejected():
+    with pytest.raises(ValueError):
+        load_job_yaml("image: img:4")
+    with pytest.raises(ValueError):
+        load_job_yaml("name: x")
