@@ -26,8 +26,10 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
 
+from persia_tpu import diagnostics
 from persia_tpu.data import PersiaBatch
 from persia_tpu.logger import get_default_logger
+from persia_tpu.tracing import span
 
 logger = get_default_logger("persia_tpu.data_loader")
 
@@ -199,6 +201,13 @@ class DataLoader:
             out_q.put(_WorkerError(e))
 
     def _lookup_worker(self, in_q: "queue.Queue", out_q: "queue.Queue"):
+        beat_key = f"data_loader.lookup_worker.{threading.current_thread().name}"
+        try:
+            self._lookup_loop(in_q, out_q, beat_key)
+        finally:
+            diagnostics.unregister(beat_key)
+
+    def _lookup_loop(self, in_q: "queue.Queue", out_q: "queue.Queue", beat_key: str):
         while True:
             item = in_q.get()
             if item is _SENTINEL or isinstance(item, _WorkerError):
@@ -207,11 +216,14 @@ class DataLoader:
                 return
             batch = item
             self.staleness_sem.acquire()  # bounded async (forward.rs:686-690)
+            diagnostics.heartbeat(beat_key)
             try:
                 train = batch.requires_grad
-                ref = self.ctx.worker.put_forward_ids(batch)
-                emb_batches = self.ctx.worker.forward_batch_id(ref, train=train)
-                device_batch, counts = self.ctx.prepare_features(batch, emb_batches)
+                with span("lookup", batch_id=batch.batch_id):
+                    ref = self.ctx.worker.put_forward_ids(batch)
+                    emb_batches = self.ctx.worker.forward_batch_id(ref, train=train)
+                with span("stage", batch_id=batch.batch_id):
+                    device_batch, counts = self.ctx.prepare_features(batch, emb_batches)
                 out_q.put(
                     PersiaTrainingBatch(
                         ref=ref,
@@ -230,6 +242,7 @@ class DataLoader:
     # ------------------------------------------------------------- consumer
 
     def __iter__(self) -> Iterator[PersiaTrainingBatch]:
+        diagnostics.maybe_start_from_env()  # detector lives where beats are
         in_q: "queue.Queue" = queue.Queue(maxsize=self.buffer_size)
         staged_q: "queue.Queue" = queue.Queue(maxsize=self.buffer_size)
         self._threads = [threading.Thread(target=self._feed, args=(in_q,), daemon=True)]

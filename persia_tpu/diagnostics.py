@@ -1,0 +1,132 @@
+"""Stall/deadlock detection.
+
+Parity target: the reference's opt-in deadlock detector — a background
+thread scanning parking_lot lock graphs every 60 s, enabled by
+`PERSIA_DEADLOCK_DETECTION` (`rust/persia-common/src/utils.rs:21-48`),
+started by every binary and the Python extension
+(`rust/persia-core/src/lib.rs:494`).
+
+Python threads can't introspect a lock graph, so the TPU-native equivalent
+watches *progress*: components register heartbeats
+(``heartbeat("forward_worker")``); if any registered component goes silent
+longer than the threshold, the detector logs every thread's stack (the
+information a deadlocked pipeline actually needs). Enabled by
+``PERSIA_DEADLOCK_DETECTION=1`` like the reference, or explicitly via
+``start_stall_detector``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from contextlib import contextmanager
+from typing import Dict, Optional, Tuple
+
+from persia_tpu.logger import get_default_logger
+
+logger = get_default_logger("persia_tpu.diagnostics")
+
+_lock = threading.Lock()
+_beats: Dict[str, float] = {}
+_inflight: Dict[int, Tuple[str, float]] = {}
+_inflight_seq = 0
+_detector: Optional["StallDetector"] = None
+
+
+def heartbeat(component: str) -> None:
+    """Mark ``component`` as alive now. Cheap; call from loop bodies."""
+    with _lock:
+        _beats[component] = time.monotonic()
+
+
+def unregister(component: str) -> None:
+    with _lock:
+        _beats.pop(component, None)
+
+
+@contextmanager
+def inflight(task: str):
+    """Track one in-flight operation (e.g. an RPC handler). The detector
+    flags operations still running past the threshold — the server-side
+    analog of a heartbeat, since a healthy server may be idle but a request
+    must finish."""
+    global _inflight_seq
+    with _lock:
+        _inflight_seq += 1
+        key = _inflight_seq
+        _inflight[key] = (task, time.monotonic())
+    try:
+        yield
+    finally:
+        with _lock:
+            _inflight.pop(key, None)
+
+
+def dump_all_stacks(reason: str = "") -> str:
+    """All thread stacks as one string (also logged at warning level)."""
+    lines = [f"=== thread dump{': ' + reason if reason else ''} ==="]
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for ident, frame in sys._current_frames().items():
+        lines.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        lines.extend(l.rstrip() for l in traceback.format_stack(frame))
+    text = "\n".join(lines)
+    logger.warning("%s", text)
+    return text
+
+
+class StallDetector:
+    """Background scanner (ref cadence: every 60 s)."""
+
+    def __init__(self, stall_after_s: float = 60.0, interval_s: float = 10.0):
+        self.stall_after_s = stall_after_s
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stall_count = 0
+
+    def start(self) -> "StallDetector":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="persia-stall-detector")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def check_once(self) -> list:
+        """One scan; returns stalled component/operation names."""
+        now = time.monotonic()
+        with _lock:
+            stalled = [c for c, t in _beats.items()
+                       if now - t > self.stall_after_s]
+            stalled += [f"inflight:{task}" for task, t in _inflight.values()
+                        if now - t > self.stall_after_s]
+        if stalled:
+            self.stall_count += 1
+            dump_all_stacks(f"components stalled >{self.stall_after_s}s: {stalled}")
+        return stalled
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.check_once()
+
+
+def start_stall_detector(stall_after_s: float = 60.0,
+                         interval_s: float = 10.0) -> StallDetector:
+    global _detector
+    if _detector is None:
+        _detector = StallDetector(stall_after_s, interval_s).start()
+    return _detector
+
+
+def maybe_start_from_env() -> Optional[StallDetector]:
+    """Opt-in via env, like the reference's PERSIA_DEADLOCK_DETECTION."""
+    if os.environ.get("PERSIA_DEADLOCK_DETECTION", "0") in ("1", "true"):
+        return start_stall_detector(
+            stall_after_s=float(os.environ.get("PERSIA_STALL_AFTER_SEC", "60")))
+    return None

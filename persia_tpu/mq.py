@@ -1,0 +1,100 @@
+"""Standalone persistent message queue over HTTP.
+
+Parity target: `PersiaMessageQueueServer/Client`
+(`rust/persia-core/src/utils.rs:9-79`) — a hyper HTTP queue utility where
+PUT enqueues a byte payload and GET blocks until one is available.
+
+Implemented on the framework's framed-TCP RPC layer
+(`persia_tpu/service/rpc.py`) rather than raw HTTP: same wire stack as every
+other service, optional compression for large payloads for free.
+"""
+
+from __future__ import annotations
+
+import queue
+import struct
+import time
+from typing import Optional
+
+from persia_tpu.service.rpc import RpcClient, RpcServer
+
+
+class MessageQueueServer:
+    """Bounded byte-payload queue served over the RPC layer."""
+
+    def __init__(self, port: int = 0, capacity: int = 1 << 14):
+        self._q: "queue.Queue[bytes]" = queue.Queue(maxsize=capacity)
+        self.server = RpcServer(port=port)
+        self.server.register("mq_put", self._put)
+        self.server.register("mq_get", self._get)
+        self.server.register("mq_size", self._size)
+        self.port: Optional[int] = None
+
+    def start(self) -> "MessageQueueServer":
+        self.server.start()
+        self.port = self.server.port
+        return self
+
+    def stop(self) -> None:
+        self.server.stop()
+
+    # handlers (bytes in, bytes out). Server-side waits are bounded to
+    # _MAX_WAIT_S so they always finish inside the RPC client's socket
+    # timeout; "wait forever" is the client's long-poll loop.
+    _MAX_WAIT_S = 10.0
+
+    def _put(self, payload: bytes) -> bytes:
+        try:
+            self._q.put(payload, timeout=self._MAX_WAIT_S)
+            return b"\x01"
+        except queue.Full:
+            return b"\x00"
+
+    def _get(self, payload: bytes) -> bytes:
+        (timeout_ms,) = struct.unpack("<I", payload)
+        wait = min(timeout_ms / 1e3, self._MAX_WAIT_S) if timeout_ms else self._MAX_WAIT_S
+        try:
+            return b"\x01" + self._q.get(timeout=wait)
+        except queue.Empty:
+            return b"\x00"
+
+    def _size(self, payload: bytes) -> bytes:
+        return struct.pack("<I", self._q.qsize())
+
+
+class MessageQueueClient:
+    def __init__(self, addr: str):
+        self.client = RpcClient(addr)
+
+    def put(self, payload: bytes, timeout_s: Optional[float] = None) -> None:
+        """Enqueue; blocks (long-polling) while the queue is full."""
+        deadline = None if timeout_s is None else time.time() + timeout_s
+        while True:
+            if self.client.call("mq_put", payload) == b"\x01":
+                return
+            if deadline is not None and time.time() >= deadline:
+                raise TimeoutError("message queue full")
+
+    def get(self, timeout_ms: int = 0) -> Optional[bytes]:
+        """Dequeue; ``timeout_ms`` 0 = wait forever (client long-polls in
+        bounded server-side waits); returns None on timeout."""
+        deadline = None if timeout_ms == 0 else time.time() + timeout_ms / 1e3
+        while True:
+            remaining_ms = 0
+            if deadline is not None:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return None
+                remaining_ms = max(int(remaining * 1e3), 1)
+            resp = self.client.call("mq_get", struct.pack("<I", remaining_ms),
+                                    idempotent=False)
+            if resp[:1] == b"\x01":
+                return resp[1:]
+            if deadline is not None and time.time() >= deadline:
+                return None
+
+    def size(self) -> int:
+        return struct.unpack("<I", self.client.call("mq_size", b""))[0]
+
+    def close(self) -> None:
+        self.client.close()

@@ -205,6 +205,9 @@ def main(argv: Optional[list] = None) -> None:
     logger.info(
         "parameter server %d/%d on port %d", replica_index, replica_size, svc.port
     )
+    from persia_tpu.diagnostics import maybe_start_from_env
+
+    maybe_start_from_env()  # opt-in deadlock/stall detector (ref: lib.rs:494)
     skip_before_us = 0
     if args.load_checkpoint:
         load_store(store, args.load_checkpoint, replica_index, replica_size,

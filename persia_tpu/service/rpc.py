@@ -21,6 +21,7 @@ import time
 import zlib
 from typing import Callable, Dict, Optional
 
+from persia_tpu import diagnostics
 from persia_tpu.logger import get_default_logger
 
 logger = get_default_logger("persia_tpu.rpc")
@@ -67,7 +68,9 @@ class _Handler(socketserver.BaseRequestHandler):
                     reply, status = f"unknown method {method!r}".encode(), 1
                 else:
                     try:
-                        reply, status = fn(payload) or b"", 0
+                        # stuck handlers show up in the stall detector's scan
+                        with diagnostics.inflight(f"rpc:{method}"):
+                            reply, status = fn(payload) or b"", 0
                     except Exception as e:  # noqa: BLE001 — app error crosses the wire
                         logger.exception("handler %s failed", method)
                         reply, status = repr(e).encode(), 1

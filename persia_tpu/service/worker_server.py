@@ -169,6 +169,9 @@ def main(argv: Optional[list] = None) -> None:
         replica_index, replica_size, svc.port, len(ps_addrs),
     )
     coord.register("embedding_worker", replica_index, f"{args.advertise_host}:{svc.port}")
+    from persia_tpu.diagnostics import maybe_start_from_env
+
+    maybe_start_from_env()  # opt-in deadlock/stall detector (ref: lib.rs:494)
     svc.server._thread.join()
 
 

@@ -1,0 +1,137 @@
+"""Stage-latency tracing.
+
+Parity target: the reference's pervasive `tracing::debug!` stage timers
+around every pipeline hop (`rust/persia-core/src/forward.rs:591-593,665-669`,
+`embedding_worker_service/mod.rs:909-938`) with the `LOG_LEVEL` env filter
+(`rust/persia-core/src/lib.rs:463-465`).
+
+Adds what the reference lacks: an in-memory ring of completed spans that can
+be exported as a **chrome://tracing / Perfetto JSON** file, so a training-run
+timeline (lookup → stage → device step → grad return) is viewable alongside
+JAX's own profiler traces.
+
+Usage::
+
+    from persia_tpu.tracing import span, trace_export
+
+    tracing.enable()          # or PERSIA_TRACE=1; off by default
+    with span("lookup", slot="cat_0"):
+        ...
+    trace_export("/tmp/trace.json")
+
+Spans nest via a thread-local stack; duration is also pushed to the metrics
+Histogram ``persia_stage_duration_seconds`` when metrics are enabled.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Deque, Dict, Optional
+
+from persia_tpu.logger import get_default_logger
+
+logger = get_default_logger("persia_tpu.tracing")
+
+_MAX_SPANS = int(os.environ.get("PERSIA_TRACE_BUFFER", "20000"))
+_lock = threading.Lock()
+_spans: Deque[Dict[str, Any]] = deque(maxlen=_MAX_SPANS)
+_tls = threading.local()
+# Opt-in, like the reference's LOG_LEVEL-gated stage timers: a span on a
+# disabled tracer is a no-op, so hot paths pay ~nothing by default.
+_enabled = os.environ.get("PERSIA_TRACE", "0") in ("1", "true")
+_histogram = None
+
+
+def _depth() -> int:
+    return getattr(_tls, "depth", 0)
+
+
+def enable(on: bool = True) -> None:
+    global _enabled
+    _enabled = on
+
+
+def _get_histogram():
+    global _histogram
+    if _histogram is None:
+        try:
+            from persia_tpu.metrics import get_metrics
+
+            _histogram = get_metrics().histogram(
+                "persia_stage_duration_seconds", "per-stage latency"
+            )
+        except Exception:
+            _histogram = False
+    return _histogram
+
+
+@contextmanager
+def span(name: str, **attrs):
+    """Time a pipeline stage; logs at debug level, records for export."""
+    if not _enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    ts_us = time.time() * 1e6
+    _tls.depth = _depth() + 1
+    try:
+        yield
+    finally:
+        _tls.depth -= 1
+        dur = time.perf_counter() - t0
+        logger.debug("%s%s took %.3f ms %s", "  " * _depth(), name, dur * 1e3,
+                     attrs if attrs else "")
+        with _lock:
+            _spans.append({
+                "name": name,
+                "ph": "X",
+                "ts": ts_us,
+                "dur": dur * 1e6,
+                "pid": os.getpid(),
+                "tid": threading.get_ident() % 2**31,
+                "args": {k: str(v) for k, v in attrs.items()},
+            })
+        h = _get_histogram()
+        if h:
+            h.observe(dur, stage=name)
+
+
+def timed(name: Optional[str] = None):
+    """Decorator flavor of :func:`span`."""
+
+    def deco(fn):
+        label = name or fn.__qualname__
+
+        def wrapper(*a, **kw):
+            with span(label):
+                return fn(*a, **kw)
+
+        wrapper.__name__ = fn.__name__
+        wrapper.__qualname__ = fn.__qualname__
+        return wrapper
+
+    return deco
+
+
+def spans_snapshot() -> list:
+    with _lock:
+        return list(_spans)
+
+
+def clear() -> None:
+    with _lock:
+        _spans.clear()
+
+
+def trace_export(path: str) -> int:
+    """Write the span ring as chrome://tracing JSON; returns span count."""
+    events = spans_snapshot()
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    logger.info("exported %d trace events to %s", len(events), path)
+    return len(events)
