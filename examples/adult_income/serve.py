@@ -1,0 +1,57 @@
+"""Serve the trained model over HTTP.
+
+Parity target: `examples/src/adult-income/serve_handler.py` (TorchServe
+handler: InferCtx over worker addresses, batch-bytes in → scores out).
+
+Run after train.py --ckpt-dir wrote a checkpoint:
+
+    python examples/adult_income/serve.py --ckpt-dir /tmp/ckpt --port 8501
+"""
+
+import argparse
+import sys
+
+import jax
+
+from persia_tpu.ctx import InferCtx
+from persia_tpu.serving import InferenceServer
+from persia_tpu.testing import SyntheticClickDataset
+
+from train import VOCABS, build_ctx  # noqa: E402 — sibling example module
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--port", type=int, default=8501)
+    args = ap.parse_args()
+
+    train_ctx, cfg = build_ctx()
+    # initialize dense shapes with one sample batch, then restore weights
+    sample = next(iter(
+        SyntheticClickDataset(num_samples=8, vocab_sizes=VOCABS, seed=0)
+        .batches(batch_size=8, requires_grad=False)
+    ))
+    emb = train_ctx.worker.forward_directly(sample, train=False)
+    device_batch, _ = train_ctx.prepare_features(sample, emb)
+    train_ctx.init_state(jax.random.PRNGKey(0), device_batch)
+    train_ctx.load_checkpoint(args.ckpt_dir)
+
+    ctx = InferCtx(
+        model=train_ctx.model,
+        state=train_ctx.state,
+        worker=train_ctx.worker,
+        embedding_config=cfg,
+    )
+    srv = InferenceServer(ctx, port=args.port).start()
+    print(f"serving on :{srv.port} (POST /predict, GET /healthz /metrics)",
+          flush=True)
+    try:
+        srv._thread.join()
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

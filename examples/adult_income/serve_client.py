@@ -1,0 +1,42 @@
+"""Query the inference server and check quality.
+
+Parity target: `examples/src/adult-income/serve_client.py` (posts
+PersiaBatch bytes, asserts infer_auc > 0.8927).
+
+    python examples/adult_income/serve_client.py --addr 127.0.0.1:8501
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+from persia_tpu.serving import InferenceClient
+from persia_tpu.testing import SyntheticClickDataset, roc_auc
+
+from train import VOCABS  # noqa: E402 — sibling example module
+
+INFER_AUC_BAR = 0.80
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--addr", default="127.0.0.1:8501")
+    args = ap.parse_args()
+
+    cli = InferenceClient(args.addr)
+    print("health:", cli.health())
+    test = SyntheticClickDataset(num_samples=1024, vocab_sizes=VOCABS, seed=43)
+    preds, labels = [], []
+    for batch in test.batches(batch_size=128, requires_grad=False):
+        preds.append(cli.predict(batch))
+        labels.append(batch.labels[0].data)
+    auc = roc_auc(np.concatenate(labels), np.concatenate(preds))
+    print(f"infer_auc={auc:.6f}")
+    assert auc > INFER_AUC_BAR, f"infer AUC {auc} below bar {INFER_AUC_BAR}"
+    print("PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -252,5 +252,14 @@ def dump_dense(state_bytes: bytes, dst_dir: Union[str, StoragePath], name: str =
     root.join(name).write_bytes(state_bytes)
 
 
-def load_dense(src_dir: Union[str, StoragePath], name: str = "dense.ckpt") -> bytes:
-    return storage_path(src_dir).join(name).read_bytes()
+def load_dense(
+    src_dir: Union[str, StoragePath], name: str = "dense.ckpt",
+    missing_ok: bool = False,
+):
+    """Read the dense blob; ``missing_ok`` returns None instead of raising
+    when the checkpoint has no dense half (works on every storage backend —
+    remote backends raise StorageError, not FileNotFoundError)."""
+    p = storage_path(src_dir).join(name)
+    if missing_ok and not p.exists():
+        return None
+    return p.read_bytes()

@@ -132,6 +132,31 @@ class EmbeddingCtx(BaseCtx):
             out[eb.name] = g if d is None else g[:d]
         return out
 
+    def dump_checkpoint(self, dst: str, blocking: bool = True) -> None:
+        """Dense state + sharded embedding checkpoint under ``dst``
+        (ref: ctx.dump_checkpoint, persia/ctx.py:1007-1034)."""
+        import flax.serialization
+
+        from persia_tpu.checkpoint import dump_dense
+
+        if getattr(self, "state", None) is not None:
+            dump_dense(flax.serialization.to_bytes(self.state), dst)
+        self.worker.dump(dst, blocking=blocking)
+
+    def load_checkpoint(self, src: str) -> None:
+        """Restore dense state (requires ``self.state`` initialized with the
+        right shapes) + embedding tables (ref: ctx.load_checkpoint,
+        persia/ctx.py:1036-1064)."""
+        import flax.serialization
+
+        from persia_tpu.checkpoint import load_dense
+
+        if getattr(self, "state", None) is not None:
+            raw = load_dense(src, missing_ok=True)
+            if raw is not None:
+                self.state = flax.serialization.from_bytes(self.state, raw)
+        self.worker.load(src)
+
 
 class DataCtx(BaseCtx):
     """Data-loader role: push batches into the dataflow

@@ -209,12 +209,15 @@ class DataLoader:
 
     def _lookup_loop(self, in_q: "queue.Queue", out_q: "queue.Queue", beat_key: str):
         while True:
+            # not registered while idle: waiting for input isn't a stall
+            diagnostics.unregister(beat_key)
             item = in_q.get()
             if item is _SENTINEL or isinstance(item, _WorkerError):
                 in_q.put(item)  # let sibling workers see the sentinel too
                 out_q.put(item)
                 return
             batch = item
+            diagnostics.heartbeat(beat_key)
             self.staleness_sem.acquire()  # bounded async (forward.rs:686-690)
             diagnostics.heartbeat(beat_key)
             try:

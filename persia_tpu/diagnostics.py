@@ -31,7 +31,7 @@ logger = get_default_logger("persia_tpu.diagnostics")
 
 _lock = threading.Lock()
 _beats: Dict[str, float] = {}
-_inflight: Dict[int, Tuple[str, float]] = {}
+_inflight: Dict[int, Tuple[str, float, Optional[float]]] = {}
 _inflight_seq = 0
 _detector: Optional["StallDetector"] = None
 
@@ -48,16 +48,17 @@ def unregister(component: str) -> None:
 
 
 @contextmanager
-def inflight(task: str):
+def inflight(task: str, stall_after_s: Optional[float] = None):
     """Track one in-flight operation (e.g. an RPC handler). The detector
     flags operations still running past the threshold — the server-side
     analog of a heartbeat, since a healthy server may be idle but a request
-    must finish."""
+    must finish. ``stall_after_s`` overrides the detector's default for
+    legitimately slow operations (checkpoint dump/load)."""
     global _inflight_seq
     with _lock:
         _inflight_seq += 1
         key = _inflight_seq
-        _inflight[key] = (task, time.monotonic())
+        _inflight[key] = (task, time.monotonic(), stall_after_s)
     try:
         yield
     finally:
@@ -104,8 +105,10 @@ class StallDetector:
         with _lock:
             stalled = [c for c, t in _beats.items()
                        if now - t > self.stall_after_s]
-            stalled += [f"inflight:{task}" for task, t in _inflight.values()
-                        if now - t > self.stall_after_s]
+            stalled += [
+                f"inflight:{task}" for task, t, limit in _inflight.values()
+                if now - t > (limit if limit is not None else self.stall_after_s)
+            ]
         if stalled:
             self.stall_count += 1
             dump_all_stacks(f"components stalled >{self.stall_after_s}s: {stalled}")

@@ -28,11 +28,13 @@ class MessageQueueServer:
         self.server.register("mq_put", self._put)
         self.server.register("mq_get", self._get)
         self.server.register("mq_size", self._size)
-        self.port: Optional[int] = None
+
+    @property
+    def port(self) -> int:
+        return self.server.port
 
     def start(self) -> "MessageQueueServer":
         self.server.start()
-        self.port = self.server.port
         return self
 
     def stop(self) -> None:
@@ -44,8 +46,10 @@ class MessageQueueServer:
     _MAX_WAIT_S = 10.0
 
     def _put(self, payload: bytes) -> bytes:
+        (timeout_ms,) = struct.unpack("<I", payload[:4])
+        wait = min(timeout_ms / 1e3, self._MAX_WAIT_S) if timeout_ms else self._MAX_WAIT_S
         try:
-            self._q.put(payload, timeout=self._MAX_WAIT_S)
+            self._q.put(payload[4:], timeout=wait)
             return b"\x01"
         except queue.Full:
             return b"\x00"
@@ -70,7 +74,14 @@ class MessageQueueClient:
         """Enqueue; blocks (long-polling) while the queue is full."""
         deadline = None if timeout_s is None else time.time() + timeout_s
         while True:
-            if self.client.call("mq_put", payload) == b"\x01":
+            remaining_ms = 0
+            if deadline is not None:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError("message queue full")
+                remaining_ms = max(int(remaining * 1e3), 1)
+            frame = struct.pack("<I", remaining_ms) + payload
+            if self.client.call("mq_put", frame) == b"\x01":
                 return
             if deadline is not None and time.time() >= deadline:
                 raise TimeoutError("message queue full")

@@ -27,6 +27,8 @@ from persia_tpu.logger import get_default_logger
 logger = get_default_logger("persia_tpu.rpc")
 
 _FLAG_COMPRESSED = 1
+_SLOW_METHODS = frozenset({"dump", "load"})
+
 _MAX_FRAME = 1 << 31  # 2 GiB sanity bound
 
 
@@ -68,8 +70,11 @@ class _Handler(socketserver.BaseRequestHandler):
                     reply, status = f"unknown method {method!r}".encode(), 1
                 else:
                     try:
-                        # stuck handlers show up in the stall detector's scan
-                        with diagnostics.inflight(f"rpc:{method}"):
+                        # stuck handlers show up in the stall detector's scan;
+                        # checkpoint ops are legitimately slow (clients allow
+                        # 3600s) so they get a matching threshold
+                        slow = 3600.0 if method in _SLOW_METHODS else None
+                        with diagnostics.inflight(f"rpc:{method}", stall_after_s=slow):
                             reply, status = fn(payload) or b"", 0
                     except Exception as e:  # noqa: BLE001 — app error crosses the wire
                         logger.exception("handler %s failed", method)

@@ -1,0 +1,128 @@
+"""HTTP model serving.
+
+Parity target: the reference's TorchServe deployment
+(`examples/src/adult-income/serve_handler.py` — handler builds an InferCtx
+over embedding-worker RPC addresses, `serve_client.py` — posts
+``PersiaBatch.to_bytes()`` payloads and checks AUC > 0.8927).
+
+Here the model server is part of the framework: ``InferenceServer`` wraps an
+``InferCtx`` (jitted eval step on the TPU/host + embedding lookups with
+zeros-on-miss) behind a thin HTTP API:
+
+- ``POST /predict``  body = ``PersiaBatch.to_bytes()`` → ``.npy`` scores
+- ``GET  /healthz``  liveness + model metadata
+- ``GET  /metrics``  Prometheus text (the process registry)
+
+``InferenceClient`` is the matching urllib client. Incremental updates reach
+the PS tier independently (persia_tpu/incremental.py), so a long-running
+server picks up online deltas without restarts.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib import request as urlrequest
+
+import numpy as np
+
+from persia_tpu.data import PersiaBatch
+from persia_tpu.logger import get_default_logger
+
+logger = get_default_logger("persia_tpu.serving")
+
+
+class InferenceServer:
+    """Serve an ``InferCtx`` over HTTP. ``port=0`` picks a free port."""
+
+    def __init__(self, infer_ctx, port: int = 0, host: str = "0.0.0.0"):
+        self.ctx = infer_ctx
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    meta = {
+                        "status": "ok",
+                        "model": type(outer.ctx.model).__name__,
+                        "requests": outer.request_count,
+                    }
+                    self._send(200, json.dumps(meta).encode(), "application/json")
+                elif self.path == "/metrics":
+                    from persia_tpu.metrics import get_metrics
+
+                    self._send(200, get_metrics().render().encode(), "text/plain")
+                else:
+                    self._send(404, b"not found", "text/plain")
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._send(404, b"not found", "text/plain")
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    raw = self.rfile.read(n)
+                    scores = outer.ctx.predict_from_bytes(raw)
+                    outer.request_count += 1
+                    buf = io.BytesIO()
+                    np.save(buf, np.asarray(scores, dtype=np.float32))
+                    self._send(200, buf.getvalue(), "application/octet-stream")
+                except Exception as e:  # noqa: BLE001 — app error crosses the wire
+                    logger.exception("predict failed")
+                    self._send(400, repr(e).encode(), "text/plain")
+
+            def log_message(self, *a):
+                pass
+
+        self.request_count = 0
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "InferenceServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="persia-infer-http")
+        self._thread.start()
+        logger.info("inference server on port %d", self.port)
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class InferenceClient:
+    """Blocking HTTP client for :class:`InferenceServer`."""
+
+    def __init__(self, addr: str, timeout_s: float = 30.0):
+        self.base = addr if addr.startswith("http") else f"http://{addr}"
+        self.timeout_s = timeout_s
+
+    def predict(self, batch: PersiaBatch) -> np.ndarray:
+        return self.predict_bytes(batch.to_bytes())
+
+    def predict_bytes(self, raw: bytes) -> np.ndarray:
+        req = urlrequest.Request(f"{self.base}/predict", data=raw, method="POST",
+                                 headers={"Content-Type": "application/octet-stream"})
+        with urlrequest.urlopen(req, timeout=self.timeout_s) as resp:
+            return np.load(io.BytesIO(resp.read()))
+
+    def health(self) -> dict:
+        with urlrequest.urlopen(f"{self.base}/healthz", timeout=self.timeout_s) as resp:
+            return json.loads(resp.read())
+
+    def metrics_text(self) -> str:
+        with urlrequest.urlopen(f"{self.base}/metrics", timeout=self.timeout_s) as resp:
+            return resp.read().decode()
