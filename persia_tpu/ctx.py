@@ -36,6 +36,7 @@ from persia_tpu.parallel.train_step import (
     init_train_state,
     replicate_state,
     shard_device_batch,
+    unpack_step_output,
 )
 
 logger = get_default_logger("persia_tpu.ctx")
@@ -50,6 +51,7 @@ def _round_up_pow2(n: int, floor: int = 8) -> int:
 
 def stage_embeddings(
     emb_batches: Sequence[FeatureEmbeddingBatch],
+    dtype=None,
 ) -> Tuple[List[Dict], List[Optional[int]]]:
     """Convert worker outputs into the device batch's ``emb`` entries.
 
@@ -63,12 +65,14 @@ def stage_embeddings(
     counts: List[Optional[int]] = []
     for eb in emb_batches:
         if isinstance(eb, SumEmbeddingBatch):
-            entries.append({"pooled": eb.pooled})
+            pooled = eb.pooled if dtype is None else eb.pooled.astype(dtype)
+            entries.append({"pooled": pooled})
             counts.append(None)
         else:
             d, dim = eb.distinct.shape
             p = _round_up_pow2(d + 1)
-            padded = np.zeros((p, dim), dtype=eb.distinct.dtype)
+            padded = np.zeros((p, dim),
+                              dtype=eb.distinct.dtype if dtype is None else dtype)
             padded[:d] = eb.distinct
             index = np.where(eb.index == d, p - 1, eb.index).astype(np.int32)
             mask = eb.index != d
@@ -101,16 +105,21 @@ class EmbeddingCtx(BaseCtx):
         worker: EmbeddingWorker,
         embedding_config: EmbeddingConfig,
         mesh=None,
+        wire_dtype: Optional[str] = None,
     ):
         super().__init__(worker, embedding_config)
         self.mesh = mesh
+        # host↔device embedding/gradient dtype; "bfloat16" halves transfer
+        # bytes (ref capability: f16 wire format with f32 master weights,
+        # common/lib.rs:157-180 + backward.rs EmbeddingGradientBatch)
+        self.wire_dtype = None if wire_dtype in (None, "float32") else np.dtype(wire_dtype)
 
     def prepare_features(
         self, batch: PersiaBatch, emb_batches: Sequence[FeatureEmbeddingBatch]
     ) -> Tuple[Dict, List[Optional[int]]]:
         """Build the sharded device batch from a ``PersiaBatch`` + worker
         lookup results (ref: _prepare_feature, ctx.py:75-199)."""
-        entries, counts = stage_embeddings(emb_batches)
+        entries, counts = stage_embeddings(emb_batches, dtype=self.wire_dtype)
         device_batch = {
             "dense": [f.data.astype(np.float32) for f in batch.non_id_type_features],
             "labels": [l.data.astype(np.float32) for l in batch.labels],
@@ -189,16 +198,24 @@ class TrainCtx(EmbeddingCtx):
         mesh=None,
         grad_scale: float = 1.0,
         loss_fn=None,
+        wire_dtype: Optional[str] = None,
     ):
-        super().__init__(worker, embedding_config, mesh=mesh)
+        super().__init__(worker, embedding_config, mesh=mesh, wire_dtype=wire_dtype)
         self.model = model
         self.dense_optimizer = dense_optimizer
         self.embedding_optimizer = embedding_optimizer
         self.grad_scale = grad_scale
         kwargs = {} if loss_fn is None else {"loss_fn": loss_fn}
-        self._train_step = build_train_step(model, dense_optimizer, **kwargs)
+        self._train_step_jit = build_train_step(model, dense_optimizer, **kwargs)
         self._eval_step = build_eval_step(model)
         self.state: Optional[TrainState] = None
+
+    def _train_step(self, state, device_batch):
+        """Run the jitted step and unpack its single-transfer output into the
+        (state, metrics, emb_grads) host view."""
+        state, packed = self._train_step_jit(state, device_batch)
+        loss, preds, emb_grads = unpack_step_output(np.asarray(packed), device_batch)
+        return state, {"loss": loss, "preds": preds}, emb_grads
 
     def __enter__(self):
         # register the sparse optimizer on every PS replica

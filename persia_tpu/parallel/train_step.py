@@ -34,6 +34,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import flax.struct
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from persia_tpu.parallel.mesh import batch_sharding, replicated
@@ -102,11 +103,16 @@ def build_train_step(
     optimizer: optax.GradientTransformation,
     loss_fn: Callable = default_loss_fn,
 ):
-    """Returns jitted ``step(state, batch) -> (state, metrics, emb_grads)``.
+    """Returns jitted ``step(state, batch) -> (state, packed)``.
 
-    ``emb_grads`` is a list aligned with ``batch['emb']``: (B, dim) for pooled
-    slots, (P, dim) for raw slots (rows past the true distinct count are zero
-    — the host slices them off before shipping to the worker).
+    ``packed`` is ONE flat f32 array: [loss | preds | emb_grad_0 | ...] —
+    everything the host needs from the step in a single device→host transfer
+    (per-array fetches pay a full round-trip each; on a remote-attached TPU
+    that latency dominated the step). ``unpack_step_output`` splits it using
+    shapes derived from the batch. Emb grads align with ``batch['emb']``:
+    (B, dim) for pooled slots, (P, dim) for raw slots (rows past the true
+    distinct count are zero — the host slices them off before shipping to
+    the worker).
     """
 
     def step(state: TrainState, batch: Dict):
@@ -142,10 +148,57 @@ def build_train_step(
             opt_state=new_opt_state,
             step=state.step + 1,
         )
-        metrics = {"loss": loss, "preds": jax.nn.sigmoid(logits)}
-        return new_state, metrics, emb_grads
+        preds = jax.nn.sigmoid(logits)
+        # Header (loss|preds) stays exact f32; only emb grads ride the wire
+        # dtype (bf16 halves device→host bytes, matching the reference's f16
+        # gradient wire format). With a bf16 wire the f32 header is bitcast
+        # to uint16 pairs so everything still leaves in ONE transfer.
+        header = jnp.concatenate([jnp.reshape(loss, (1,)).astype(jnp.float32),
+                                  jnp.reshape(preds, (-1,)).astype(jnp.float32)])
+        gflat = [jnp.reshape(g, (-1,)) for g in emb_grads]
+        pack_dt = gflat[0].dtype if gflat else jnp.float32
+        if pack_dt == jnp.float32:
+            packed = jnp.concatenate([header] + gflat)
+        else:
+            h16 = jax.lax.bitcast_convert_type(header, jnp.uint16).reshape(-1)
+            g16 = [jax.lax.bitcast_convert_type(g.astype(jnp.bfloat16), jnp.uint16)
+                   for g in gflat]
+            packed = jnp.concatenate([h16] + g16)
+        return new_state, packed
 
     return jax.jit(step)
+
+
+def unpack_step_output(packed: np.ndarray, batch: Dict):
+    """Split the step's packed output → (loss, preds, emb_grads) on host.
+
+    ``packed`` must already be host memory (``np.asarray`` — the single
+    transfer); shapes come from the same ``batch`` the step consumed. A
+    uint16 payload is the bf16-wire layout: an f32 header bitcast to uint16
+    pairs followed by bf16 gradients."""
+    import ml_dtypes
+
+    labels = batch["labels"][0]
+    n = int(np.prod(labels.shape))
+    if packed.dtype == np.uint16:
+        hn = 2 * (1 + n)
+        header = np.ascontiguousarray(packed[:hn]).view(np.float32)
+        body = packed[hn:]
+        grad_dt = np.dtype(ml_dtypes.bfloat16)
+    else:
+        header = packed[: 1 + n]
+        body = packed[1 + n:]
+        grad_dt = packed.dtype
+    loss = float(header[0])
+    preds = header[1:].reshape(labels.shape)
+    grads = []
+    off = 0
+    for e in batch["emb"]:
+        shape = e["pooled"].shape if "pooled" in e else e["distinct"].shape
+        k = int(np.prod(shape))
+        grads.append(np.ascontiguousarray(body[off:off + k]).view(grad_dt).reshape(shape))
+        off += k
+    return loss, preds, grads
 
 
 def build_eval_step(model):
@@ -164,12 +217,49 @@ def build_eval_step(model):
     return jax.jit(eval_step)
 
 
+def _packed_put(batch: Dict) -> Dict:
+    """Single-chip fast path: ship every float embedding leaf in ONE
+    device_put (host-side concat, device-side lazy slices). Per-leaf puts pay
+    a full host→device round-trip each — on a remote-attached chip that
+    latency dominated staging."""
+    out: Dict = {
+        "dense": [jnp.asarray(x) for x in batch["dense"]],
+        "labels": [jnp.asarray(x) for x in batch["labels"]],
+        "emb": [],
+    }
+    def _is_float(a) -> bool:
+        d = np.asarray(a).dtype
+        return np.issubdtype(d, np.floating) or d.name == "bfloat16"
+
+    float_leaves = []  # (entry_idx, key, shape, size)
+    entries: List[Dict] = [dict() for _ in batch["emb"]]
+    for i, e in enumerate(batch["emb"]):
+        for key, val in e.items():
+            if _is_float(val):
+                float_leaves.append((i, key, val.shape, val.size))
+            else:
+                entries[i][key] = jnp.asarray(val)
+    if float_leaves:
+        dt = batch["emb"][float_leaves[0][0]][float_leaves[0][1]].dtype
+        flat = np.concatenate(
+            [np.ascontiguousarray(batch["emb"][i][k]).reshape(-1)
+             for i, k, _, _ in float_leaves]
+        ).astype(dt, copy=False)
+        dev = jax.device_put(flat)
+        off = 0
+        for i, k, shape, size in float_leaves:
+            entries[i][k] = jax.lax.slice(dev, (off,), (off + size,)).reshape(shape)
+            off += size
+    out["emb"] = entries
+    return out
+
+
 def shard_device_batch(batch: Dict, mesh=None) -> Dict:
     """device_put the batch with DP shardings: batch-dim leaves over ``data``,
     raw-slot distinct rows replicated. Computation follows data: the jitted
     step picks these shardings up without explicit in_shardings."""
     if mesh is None:
-        return jax.tree.map(jnp.asarray, batch)
+        return _packed_put(batch)
     bsh = batch_sharding(mesh)
     rep = replicated(mesh)
     out: Dict = {

@@ -145,3 +145,54 @@ def test_mesh_requires_divisible_batch():
         # and the ctx still works with a good batch afterwards
         m = ctx.train_step(_batch(bs=16))
         assert np.isfinite(m["loss"])
+
+
+def test_bfloat16_wire_keeps_exact_metrics():
+    """bf16 wire compresses only emb grads; loss/preds stay exact f32."""
+    import numpy as np
+
+    from persia_tpu.config import EmbeddingConfig, SlotConfig
+    from persia_tpu.ctx import TrainCtx
+    from persia_tpu.data import IDTypeFeature, Label, NonIDTypeFeature, PersiaBatch
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.embedding.store import EmbeddingStore
+    from persia_tpu.embedding.worker import EmbeddingWorker
+    from persia_tpu.models import DNN
+
+    import optax
+
+    cfg = EmbeddingConfig(
+        slots_config={
+            "a": SlotConfig(dim=8),
+            "h": SlotConfig(dim=8, embedding_summation=False, sample_fixed_size=4),
+        },
+        feature_index_prefix_bit=8,
+    )
+    rng = np.random.default_rng(0)
+    batch = PersiaBatch(
+        [
+            IDTypeFeature("a", list(rng.integers(0, 50, (16, 1), dtype=np.uint64))),
+            IDTypeFeature(
+                "h",
+                [rng.integers(0, 20, rng.integers(0, 4), dtype=np.uint64)
+                 for _ in range(16)],
+            ),
+        ],
+        non_id_type_features=[NonIDTypeFeature(rng.normal(size=(16, 4)).astype(np.float32))],
+        labels=[Label(rng.integers(0, 2, (16, 1)).astype(np.float32))],
+        requires_grad=True,
+    )
+    store = EmbeddingStore(capacity=1 << 12, num_internal_shards=2,
+                           optimizer=Adagrad(lr=0.1).config, seed=7)
+    ctx = TrainCtx(
+        model=DNN(dense_mlp_size=8, sparse_mlp_size=16, hidden_sizes=(16,)),
+        dense_optimizer=optax.adam(1e-3),
+        embedding_optimizer=Adagrad(lr=0.1),
+        worker=EmbeddingWorker(cfg, [store]),
+        embedding_config=cfg,
+        wire_dtype="bfloat16",
+    ).__enter__()
+    m = ctx.train_step(batch)
+    assert isinstance(m["loss"], float)
+    assert np.asarray(m["preds"]).dtype == np.float32
+    assert store.size() > 0  # gradients landed
