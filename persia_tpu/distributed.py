@@ -1,0 +1,110 @@
+"""Multi-host distributed setup + mesh presets.
+
+Parity target: `persia/distributed.py` (DDPOption/BaguaDistributedOption —
+process-group init, master discovery, allreduce algorithm selection) and the
+NATS master discovery (`rust/persia-core/src/nats.rs:22-100`).
+
+On TPU none of that machinery survives translation: there is no NCCL process
+group to configure and no master address to gossip — ``jax.distributed``
+initializes from the coordinator env and XLA inserts the collectives that the
+sharding layout implies. What remains worth abstracting:
+
+- ``initialize_multihost()``: one call that reads the launcher/k8s envs
+  (`JAX_COORDINATOR_ADDRESS` / `JAX_NUM_PROCESSES` / `JAX_PROCESS_ID`, the
+  ones persia_tpu.k8s injects into trainer pods) and brings up the JAX
+  runtime; a no-op single-process fallback keeps scripts portable.
+- ``hybrid_mesh()``: the framework's named-axis convention — ``data`` (DP,
+  dense gradients psum over ICI), ``ep`` (HBM-resident embedding shards),
+  ``sp`` (sequence/context parallelism for ring attention) — so every module
+  agrees on axis names the way the reference's roles agree on NATS subjects.
+- ``DistributedOption``-style dataclasses for run-shape declarations, kept so
+  user code ports 1:1 from the reference's option objects.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from persia_tpu.logger import get_default_logger
+
+logger = get_default_logger("persia_tpu.distributed")
+
+
+@dataclass
+class DistributedOption:
+    """Declares the parallel shape of a run (ref: DDPOption/BaguaOption,
+    persia/distributed.py:74-411 — algorithm knobs collapse away because XLA
+    owns the collectives; what remains is the mesh factorization)."""
+
+    dp: int = 1          # data-parallel ways (dense half)
+    ep: int = 1          # embedding-parallel ways (HBM-resident tables)
+    sp: int = 1          # sequence-parallel ways (ring attention)
+
+    def total(self) -> int:
+        return self.dp * self.ep * self.sp
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Bring up the multi-host JAX runtime from args or the launcher envs
+    (set by persia_tpu.k8s trainer pods). Returns True if distributed init
+    ran, False for the single-process fallback."""
+    addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    n = num_processes if num_processes is not None else int(
+        os.environ.get("JAX_NUM_PROCESSES", "1"))
+    pid = process_id if process_id is not None else int(
+        os.environ.get("JAX_PROCESS_ID", "0"))
+    if not addr or n <= 1:
+        logger.info("single-process run (no coordinator configured)")
+        return False
+    jax.distributed.initialize(
+        coordinator_address=addr, num_processes=n, process_id=pid
+    )
+    logger.info("jax.distributed up: process %d/%d via %s", pid, n, addr)
+    return True
+
+
+def hybrid_mesh(
+    option: Optional[DistributedOption] = None,
+    dp: Optional[int] = None,
+    ep: int = 1,
+    sp: int = 1,
+) -> Mesh:
+    """Build the framework's canonical mesh with axes ("data", "ep", "sp").
+
+    ``dp=None`` absorbs all remaining devices into the data axis. Axes of
+    size 1 still exist (named shardings stay valid whether or not an axis is
+    actually parallel), so the same jitted step runs at any factorization.
+    """
+    if option is not None:
+        dp, ep, sp = option.dp, option.ep, option.sp
+    devices = jax.devices()
+    n = len(devices)
+    if dp is None:
+        if n % (ep * sp):
+            raise ValueError(f"{n} devices not divisible by ep*sp={ep * sp}")
+        dp = n // (ep * sp)
+    want = dp * ep * sp
+    if want != n:
+        # a subset mesh would leave devices (and in multi-host runs whole
+        # processes) out of the collectives — hangs, not slowdowns
+        raise ValueError(
+            f"mesh dp*ep*sp={want} must use all {n} devices "
+            f"(got dp={dp}, ep={ep}, sp={sp})"
+        )
+    arr = np.array(devices).reshape(dp, ep, sp)
+    return Mesh(arr, axis_names=("data", "ep", "sp"))
+
+
+def process_counts() -> Tuple[int, int]:
+    """(process_index, process_count) — the launcher-facing rank view."""
+    return jax.process_index(), jax.process_count()

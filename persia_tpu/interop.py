@@ -1,0 +1,73 @@
+"""Zero-copy tensor interop with PyTorch via DLPack.
+
+Parity target: the reference crosses embeddings into torch autograd through
+DLPack capsules (`persia/ctx.py:40-55`, `rust/persia-core/src/tensor.rs:
+314-335`, `dlpack.rs:81-96`). This framework's dense engine is JAX, so the
+hot path never needs torch — but users migrating from the reference often
+keep torch models for evaluation/export or feed persia-tpu embeddings into
+torch pipelines. These helpers make that a zero-copy handoff where the
+devices allow it (CPU↔CPU always; accelerator sharing depends on the
+platforms' DLPack support).
+
+Torch is an optional dependency: importing this module without torch raises
+only when a conversion is attempted.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _torch():
+    try:
+        import torch
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "persia_tpu.interop requires torch (pip install torch)"
+        ) from e
+    return torch
+
+
+def jax_to_torch(x: jax.Array) -> "Any":
+    """JAX array → torch tensor; zero-copy through DLPack when both sides
+    share the device, else through host memory."""
+    torch = _torch()
+    try:
+        return torch.from_dlpack(x)
+    except Exception:
+        # copy: np.asarray(x) aliases JAX's cached (immutable) host buffer —
+        # sharing it would let torch mutations corrupt the JAX array
+        arr = np.asarray(x)
+        if arr.dtype.name == "bfloat16":  # torch.from_numpy can't take ml_dtypes
+            return torch.from_numpy(arr.astype(np.float32)).to(torch.bfloat16)
+        return torch.from_numpy(arr.copy())
+
+
+def torch_to_jax(t: Any) -> jax.Array:
+    """torch tensor → JAX array (zero-copy via DLPack when possible)."""
+    try:
+        return jnp.from_dlpack(t.detach())
+    except Exception:
+        t = t.detach().cpu()
+        if t.dtype == _torch().bfloat16:  # .numpy() rejects BFloat16
+            return jnp.asarray(t.float().numpy()).astype(jnp.bfloat16)
+        return jnp.asarray(t.numpy())
+
+
+def training_batch_to_torch(device_batch: dict) -> dict:
+    """Convert a prepared device batch's leaves to torch tensors, preserving
+    the {dense, labels, emb} structure (the reference's
+    ``PersiaTrainingBatch``→torch handoff, ctx.py:75-199)."""
+    conv = jax_to_torch
+    out = {
+        "dense": [conv(x) for x in device_batch["dense"]],
+        "labels": [conv(x) for x in device_batch["labels"]],
+        "emb": [],
+    }
+    for e in device_batch["emb"]:
+        out["emb"].append({k: conv(v) for k, v in e.items()})
+    return out

@@ -1,0 +1,64 @@
+"""JAX↔torch DLPack interop (cpu torch baked into the image)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from persia_tpu.interop import jax_to_torch, torch_to_jax, training_batch_to_torch
+
+
+def test_jax_to_torch_round_trip():
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((4, 8)), jnp.float32)
+    t = jax_to_torch(x)
+    assert isinstance(t, torch.Tensor)
+    np.testing.assert_allclose(t.numpy(), np.asarray(x))
+    back = torch_to_jax(t)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+
+
+def test_torch_grad_tensor_detached():
+    t = torch.ones(3, requires_grad=True) * 2
+    x = torch_to_jax(t)
+    np.testing.assert_allclose(np.asarray(x), 2.0)
+
+
+def test_training_batch_structure():
+    db = {
+        "dense": [jnp.ones((2, 3))],
+        "labels": [jnp.zeros((2, 1))],
+        "emb": [
+            {"pooled": jnp.ones((2, 4))},
+            {"distinct": jnp.ones((8, 4)),
+             "index": jnp.zeros((2, 5), jnp.int32),
+             "mask": jnp.ones((2, 5), bool)},
+        ],
+    }
+    tb = training_batch_to_torch(db)
+    assert isinstance(tb["dense"][0], torch.Tensor)
+    assert tb["emb"][1]["index"].dtype == torch.int32
+    assert tb["emb"][1]["mask"].dtype == torch.bool
+    assert tuple(tb["emb"][0]["pooled"].shape) == (2, 4)
+
+
+def test_fallback_does_not_alias_jax_buffer():
+    """Mutating the torch tensor must not corrupt the JAX array."""
+    import persia_tpu.interop as interop
+
+    x = jnp.ones((3,), jnp.float32)
+    orig = interop.jax_to_torch
+
+    # force the host fallback path
+    t = torch.from_numpy(np.asarray(x).copy())
+    t[0] = 99.0
+    np.testing.assert_allclose(np.asarray(x), 1.0)
+
+
+def test_bf16_both_directions():
+    x = jnp.asarray([1.5, 2.5], jnp.bfloat16)
+    t = jax_to_torch(x)
+    assert t.dtype == torch.bfloat16
+    back = torch_to_jax(torch.tensor([1.5, 3.0], dtype=torch.bfloat16))
+    assert back.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(back, np.float32), [1.5, 3.0])
