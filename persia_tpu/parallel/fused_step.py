@@ -1,0 +1,300 @@
+"""Fully-fused hybrid train step: embedding tables resident in HBM.
+
+The reference's hot loop crosses process boundaries four times per step
+(lookup RPC → h2d → step → d2h → gradient RPC, §3.2/3.3 of SURVEY.md)
+because GPU memory cannot hold the tables. On TPU, Criteo-class tables fit
+in (pooled) HBM, so the idiomatic fast path keeps them on device and fuses
+the ENTIRE hybrid step into one XLA program:
+
+    ids → gather → dense fwd/bwd → optax dense update → duplicate-safe
+    sparse optimizer update (persia_tpu.ops.sparse_update)
+
+Host↔device traffic per step collapses to the raw batch (int32 ids + dense
+features + labels) in, one scalar loss out — no embedding or gradient ever
+crosses the PCIe/tunnel boundary. The host C++ PS tier
+(`persia_tpu.embedding.native_store`) remains the capacity tier for vocab
+that exceeds HBM; `persia_tpu.interop` moves rows between the two tiers.
+
+Sharding: tables are row-sharded over the mesh "data" axis (GSPMD turns the
+gathers/scatters into ICI collectives); batch leaves are sharded over "data";
+dense params replicated (psum grads). Single-device jit needs no mesh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from persia_tpu.embedding.optim import OptimizerConfig
+from persia_tpu.ops.sparse_update import (
+    init_sparse_state,
+    masked_flat_ids_grads,
+    sparse_update,
+)
+from persia_tpu.parallel.train_step import default_loss_fn
+
+
+@dataclass(frozen=True)
+class FusedSlotSpec:
+    """One HBM-resident slot (ref: SlotConfig,
+    `persia-embedding-config/src/lib.rs:528-560`; LRU/eviction is the host
+    tier's job — HBM slots are dense [0, vocab) keyed)."""
+
+    vocab: int
+    dim: int
+    pooled: bool = True  # embedding_summation; False → raw (B, L, D) + mask
+    sqrt_scaling: bool = False
+    init_bounds: Tuple[float, float] = (-0.01, 0.01)
+
+
+@flax.struct.dataclass
+class FusedTrainState:
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    tables: Dict[str, jnp.ndarray]
+    emb_state: Dict[str, Dict[str, jnp.ndarray]]
+    emb_batch_state: jnp.ndarray  # (beta1^t, beta2^t) for Adam
+    step: jnp.ndarray
+
+
+def create_fused_tables(
+    rng,
+    specs: Dict[str, FusedSlotSpec],
+    sparse_cfg: OptimizerConfig,
+    dtype=jnp.float32,
+):
+    """Seeded uniform tables + optimizer state (ref init:
+    `emb_entry.rs:28-60` uniform from EmbeddingConfig.emb_initialization)."""
+    tables, emb_state = {}, {}
+    names = sorted(specs)
+    keys = jax.random.split(rng, max(len(names), 1))
+    for key, name in zip(keys, names):
+        s = specs[name]
+        lo, hi = s.init_bounds
+        tables[name] = jax.random.uniform(
+            key, (s.vocab, s.dim), dtype=dtype, minval=lo, maxval=hi
+        )
+        emb_state[name] = init_sparse_state(sparse_cfg, s.vocab, s.dim)
+    return tables, emb_state
+
+
+def _model_inputs(
+    specs: Dict[str, FusedSlotSpec],
+    slot_order: Sequence[str],
+    gathered: Dict[str, jnp.ndarray],
+    ids: Dict[str, jnp.ndarray],
+) -> List:
+    """Build the per-slot model input list from gathered embeddings —
+    pooling happens INSIDE the differentiated function so autodiff routes
+    grads back to per-position rows."""
+    out = []
+    for name in slot_order:
+        g = gathered[name]
+        if g.ndim == 2:  # single-id slot; -1 padding → zero embedding
+            i = ids[name]
+            out.append(g * (i >= 0)[..., None].astype(g.dtype))
+            continue
+        i = ids[name]
+        mask = i >= 0
+        if specs[name].pooled:
+            m = mask[..., None].astype(g.dtype)
+            pooled = (g * m).sum(axis=1)
+            if specs[name].sqrt_scaling:
+                cnt = jnp.maximum(mask.sum(axis=1), 1).astype(pooled.dtype)
+                pooled = pooled / jnp.sqrt(cnt)[..., None]
+            out.append(pooled)
+        else:
+            out.append((g, mask))
+    return out
+
+
+def _gather_all(
+    tables: Dict[str, jnp.ndarray], ids: Dict[str, jnp.ndarray]
+) -> Dict[str, jnp.ndarray]:
+    out = {}
+    for name, i in ids.items():
+        safe = jnp.where(i >= 0, i, 0).astype(jnp.int32)
+        out[name] = jnp.take(tables[name], safe, axis=0)
+    return out
+
+
+def init_fused_state(
+    model,
+    rng,
+    specs: Dict[str, FusedSlotSpec],
+    sample_batch: Dict,
+    dense_optimizer: optax.GradientTransformation,
+    sparse_cfg: OptimizerConfig,
+    slot_order: Optional[Sequence[str]] = None,
+) -> FusedTrainState:
+    slot_order = list(slot_order or sorted(specs))
+    rng_tbl, rng_model = jax.random.split(rng)
+    tables, emb_state = create_fused_tables(rng_tbl, specs, sparse_cfg)
+    ids = sample_batch["ids"]
+    gathered = _gather_all(tables, ids)
+    model_emb = _model_inputs(specs, slot_order, gathered, ids)
+    variables = model.init(rng_model, sample_batch["dense"], model_emb, train=False)
+    params = variables["params"]
+    return FusedTrainState(
+        params=params,
+        batch_stats=variables.get("batch_stats", {}),
+        opt_state=dense_optimizer.init(params),
+        tables=tables,
+        emb_state=emb_state,
+        emb_batch_state=jnp.ones((2,), dtype=jnp.float32),
+        step=jnp.zeros((), dtype=jnp.int32),
+    )
+
+
+def build_fused_train_step(
+    model,
+    dense_optimizer: optax.GradientTransformation,
+    sparse_cfg: OptimizerConfig,
+    specs: Dict[str, FusedSlotSpec],
+    slot_order: Optional[Sequence[str]] = None,
+    loss_fn=default_loss_fn,
+    donate: bool = True,
+    jit: bool = True,
+):
+    """Returns jitted ``step(state, batch) -> (state, (loss, preds))``.
+
+    batch = {"dense": [(B,F) f32...], "labels": [(B,1) f32...],
+             "ids": {slot: (B,) or (B,L) int32, -1 = padding}}.
+    ``donate=True`` donates the state buffers so multi-GB tables update
+    in place instead of being copied each step. ``jit=False`` returns the
+    raw traceable step for callers that wrap it (packed-I/O benches,
+    shard_map composition).
+    """
+    slot_order = list(slot_order or sorted(specs))
+
+    def step(state: FusedTrainState, batch: Dict):
+        ids = batch["ids"]
+        gathered = _gather_all(state.tables, ids)
+
+        def loss_wrapper(params, gathered):
+            model_emb = _model_inputs(specs, slot_order, gathered, ids)
+            variables = {"params": params}
+            if state.batch_stats:
+                variables["batch_stats"] = state.batch_stats
+                logits, updates = model.apply(
+                    variables, batch["dense"], model_emb, train=True,
+                    mutable=["batch_stats"],
+                )
+                new_stats = updates["batch_stats"]
+            else:
+                logits = model.apply(variables, batch["dense"], model_emb, train=True)
+                new_stats = state.batch_stats
+            loss = loss_fn(logits, batch["labels"][0])
+            return loss, (logits, new_stats)
+
+        (loss, (logits, new_stats)), (param_grads, emb_grads) = jax.value_and_grad(
+            loss_wrapper, argnums=(0, 1), has_aux=True
+        )(state.params, gathered)
+
+        updates, new_opt_state = dense_optimizer.update(
+            param_grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+
+        batch_state = state.emb_batch_state * jnp.array(
+            [sparse_cfg.beta1, sparse_cfg.beta2], dtype=jnp.float32
+        )
+        new_tables, new_emb_state = {}, {}
+        for name in slot_order:
+            g = emb_grads[name].astype(jnp.float32)
+            flat_ids, flat_g, flat_mask = masked_flat_ids_grads(ids[name], g)
+            new_tables[name], new_emb_state[name] = sparse_update(
+                sparse_cfg,
+                state.tables[name],
+                state.emb_state[name],
+                flat_ids,
+                flat_g,
+                batch_state,
+                mask=flat_mask,
+            )
+
+        new_state = FusedTrainState(
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt_state,
+            tables=new_tables,
+            emb_state=new_emb_state,
+            emb_batch_state=batch_state,
+            step=state.step + 1,
+        )
+        return new_state, (loss, jax.nn.sigmoid(logits))
+
+    if not jit:
+        return step
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def build_fused_eval_step(model, specs, slot_order=None):
+    slot_order = list(slot_order or sorted(specs))
+
+    def eval_step(state: FusedTrainState, batch: Dict):
+        ids = batch["ids"]
+        gathered = _gather_all(state.tables, ids)
+        model_emb = _model_inputs(specs, slot_order, gathered, ids)
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        logits = model.apply(variables, batch["dense"], model_emb, train=False)
+        return jax.nn.sigmoid(logits)
+
+    return jax.jit(eval_step)
+
+
+def shard_fused_state(state: FusedTrainState, mesh, table_axis: str = "data"):
+    """Place tables row-sharded over ``table_axis`` and everything else
+    replicated; GSPMD then partitions the step's gathers/scatters into ICI
+    collectives (the TPU analogue of the reference's farmhash row sharding
+    across PS replicas, `embedding_worker_service/mod.rs:342-345`)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P(table_axis, None))
+
+    def place_tbl(x):
+        return jax.device_put(x, row if x.shape[0] % mesh.shape[table_axis] == 0 else rep)
+
+    return FusedTrainState(
+        params=jax.tree.map(lambda x: jax.device_put(x, rep), state.params),
+        batch_stats=jax.tree.map(lambda x: jax.device_put(x, rep), state.batch_stats),
+        opt_state=jax.tree.map(lambda x: jax.device_put(x, rep), state.opt_state),
+        tables={k: place_tbl(v) for k, v in state.tables.items()},
+        emb_state={
+            k: {sk: place_tbl(sv) for sk, sv in st.items()}
+            for k, st in state.emb_state.items()
+        },
+        emb_batch_state=jax.device_put(state.emb_batch_state, rep),
+        step=jax.device_put(state.step, rep),
+    )
+
+
+def pack_ids(ids_np: Dict[str, np.ndarray], slot_order: Sequence[str]):
+    """Host-side helper: one contiguous int32 buffer for all slots' ids so
+    staging is a single host→device transfer (per-leaf puts pay a full
+    round-trip each on a remote-attached chip)."""
+    flat = np.concatenate(
+        [np.ascontiguousarray(ids_np[n], dtype=np.int32).reshape(-1) for n in slot_order]
+    )
+    shapes = [ids_np[n].shape for n in slot_order]
+    return flat, shapes
+
+
+def unpack_ids(flat_dev: jnp.ndarray, slot_order: Sequence[str], shapes) -> Dict[str, jnp.ndarray]:
+    out = {}
+    off = 0
+    for name, shape in zip(slot_order, shapes):
+        k = int(np.prod(shape))
+        out[name] = jax.lax.slice(flat_dev, (off,), (off + k,)).reshape(shape)
+        off += k
+    return out
