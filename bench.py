@@ -49,6 +49,7 @@ def bench_fused():
         FusedSlotSpec,
         build_fused_train_step,
         init_fused_state,
+        pack_ids,
         unpack_ids,
     )
 
@@ -61,7 +62,13 @@ def bench_fused():
     rng = np.random.default_rng(0)
 
     def make_host_batch():
-        ids = rng.integers(0, VOCAB, (N_SLOTS, BATCH_SIZE), dtype=np.int32).reshape(-1)
+        ids, _ = pack_ids(
+            {
+                n: rng.integers(0, VOCAB, BATCH_SIZE, dtype=np.int32)
+                for n in slot_order
+            },
+            slot_order,
+        )
         densel = np.concatenate(
             [
                 rng.normal(size=(BATCH_SIZE, N_DENSE)).astype(np.float32),
@@ -187,6 +194,8 @@ def bench_hybrid():
 
 def main():
     mode = os.environ.get("BENCH_MODE", "fused")
+    if mode not in ("fused", "hybrid"):
+        raise SystemExit(f"BENCH_MODE must be 'fused' or 'hybrid', got {mode!r}")
     samples_per_sec = bench_hybrid() if mode == "hybrid" else bench_fused()
     print(
         json.dumps(

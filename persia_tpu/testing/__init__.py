@@ -1,3 +1,12 @@
 """Test/bench utilities: deterministic synthetic datasets + metrics."""
 
 from persia_tpu.testing.synthetic import SyntheticClickDataset, roc_auc  # noqa: F401
+from persia_tpu.testing.datasets import (  # noqa: F401
+    AvazuSynthetic,
+    CriteoSynthetic,
+    Synthetic100T,
+    TaobaoSynthetic,
+    CRITEO_KAGGLE_VOCABS,
+    CRITEO_1TB_VOCABS,
+    AVAZU_VOCABS,
+)
