@@ -53,6 +53,7 @@ def bench_fused():
         unpack_ids,
     )
 
+    stack = os.environ.get("BENCH_STACK", "1") == "1"
     specs = {f"cat_{i}": FusedSlotSpec(vocab=VOCAB, dim=EMB_DIM) for i in range(N_SLOTS)}
     slot_order = sorted(specs)
     model = DLRM(embedding_dim=EMB_DIM, bottom_mlp=(256, 64, EMB_DIM), top_mlp=(512, 256))
@@ -81,7 +82,7 @@ def bench_fused():
     id_shapes = [(BATCH_SIZE,)] * N_SLOTS
 
     raw_step = build_fused_train_step(
-        model, dense_opt, sparse_cfg, specs, slot_order, jit=False
+        model, dense_opt, sparse_cfg, specs, slot_order, jit=False, stack=stack
     )
 
     def packed_step(state, flat_ids, densel):
@@ -106,7 +107,8 @@ def bench_fused():
         },
     }
     state = init_fused_state(
-        model, jax.random.PRNGKey(0), specs, sample, dense_opt, sparse_cfg
+        model, jax.random.PRNGKey(0), specs, sample, dense_opt, sparse_cfg,
+        stack=stack,
     )
 
     host_batches = [make_host_batch() for _ in range(8)]

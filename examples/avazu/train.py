@@ -17,6 +17,7 @@ import optax
 
 from persia_tpu.config import EmbeddingConfig, SlotConfig
 from persia_tpu.ctx import TrainCtx
+from persia_tpu.data_loader import DataLoader
 from persia_tpu.embedding.optim import Adagrad
 from persia_tpu.embedding.store import EmbeddingStore
 from persia_tpu.embedding.worker import EmbeddingWorker
@@ -61,6 +62,10 @@ def main(argv=None) -> int:
     ap.add_argument("--steps", type=int, default=64)
     ap.add_argument("--eval-steps", type=int, default=8)
     ap.add_argument("--ps-replicas", type=int, default=2)
+    ap.add_argument(
+        "--deterministic", action="store_true",
+        help="reproducible mode: ordered batches, staleness=1 (ref: REPRODUCIBLE=1)",
+    )
     args = ap.parse_args(argv)
 
     train = AvazuSynthetic(num_samples=args.steps * args.batch_size, seed=42)
@@ -69,9 +74,16 @@ def main(argv=None) -> int:
     ctx = build_ctx(args.model, num_fields=len(AVAZU_VOCABS), ps_replicas=args.ps_replicas)
     with ctx:
         losses = []
+        loader = DataLoader(
+            train.batches(batch_size=args.batch_size), ctx,
+            num_workers=1 if args.deterministic else 4,
+            staleness=1 if args.deterministic else 4,
+            reproducible=args.deterministic,
+        )
         t0 = time.time()
-        for batch in train.batches(batch_size=args.batch_size):
-            losses.append(ctx.train_step(batch)["loss"])
+        for tb in loader:
+            losses.append(ctx.train_step_prepared(tb, loader)["loss"])
+        loader.flush()  # drain in-flight async gradient updates before eval/ckpt
         dt = time.time() - t0
         sps = args.steps * args.batch_size / dt
 

@@ -9,8 +9,9 @@ compression for the >1M-id slots (ref: hashstack,
 `embedding_worker_service/mod.rs:348-400`).
 
 No network access → data is the seeded Criteo-shaped synthetic stream
-(persia_tpu/testing/datasets.py) with a hidden ground-truth model, so AUC
-is learnable and reproducible.
+(persia_tpu/testing/datasets.py) with a hidden ground-truth model, so AUC is
+learnable; pass --deterministic for run-to-run reproducible results
+(ordered batches + staleness=1, the reference's REPRODUCIBLE=1 mode).
 
 Run:  python examples/criteo_dlrm/train.py [--scale kaggle|1tb] [--steps N]
 """
@@ -24,6 +25,7 @@ import optax
 
 from persia_tpu.config import EmbeddingConfig, HashStackConfig, SlotConfig
 from persia_tpu.ctx import TrainCtx
+from persia_tpu.data_loader import DataLoader
 from persia_tpu.embedding.optim import Adagrad
 from persia_tpu.embedding.store import EmbeddingStore
 from persia_tpu.embedding.worker import EmbeddingWorker
@@ -76,6 +78,10 @@ def main(argv=None) -> int:
     ap.add_argument("--eval-steps", type=int, default=8)
     ap.add_argument("--ps-replicas", type=int, default=2)
     ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument(
+        "--deterministic", action="store_true",
+        help="reproducible mode: ordered batches, staleness=1 (ref: REPRODUCIBLE=1)",
+    )
     args = ap.parse_args(argv)
 
     vocabs = CRITEO_KAGGLE_VOCABS if args.scale == "kaggle" else CRITEO_1TB_VOCABS
@@ -90,9 +96,16 @@ def main(argv=None) -> int:
     ctx = build_ctx(vocabs, ps_replicas=args.ps_replicas, hashstack_above=hashstack_above)
     with ctx:
         losses = []
+        loader = DataLoader(
+            train.batches(batch_size=args.batch_size), ctx,
+            num_workers=1 if args.deterministic else 4,
+            staleness=1 if args.deterministic else 4,
+            reproducible=args.deterministic,
+        )
         t0 = time.time()
-        for batch in train.batches(batch_size=args.batch_size):
-            losses.append(ctx.train_step(batch)["loss"])
+        for tb in loader:
+            losses.append(ctx.train_step_prepared(tb, loader)["loss"])
+        loader.flush()  # drain in-flight async gradient updates before eval/ckpt
         dt = time.time() - t0
         sps = args.steps * args.batch_size / dt
 

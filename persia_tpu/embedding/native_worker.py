@@ -1,0 +1,186 @@
+"""ctypes bindings for the native C++ embedding-worker hot loops
+(`native/worker.cpp`).
+
+Drop-in accelerators for the numpy golden routines in
+`persia_tpu.embedding.worker`: id dedup (np.unique), sum-pooling /
+per-sign gradient accumulation (np.add.at), raw-slot index construction,
+and shard partitioning. Bit-exact parity with the numpy path is asserted in
+tests/test_native_worker.py; `PERSIA_TPU_NATIVE_WORKER=0` disables the
+native path (the pure-numpy fallback stays the golden model).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from persia_tpu.logger import get_default_logger
+
+logger = get_default_logger("persia_tpu.native_worker")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "worker.cpp")
+_SO = os.path.join(_REPO_ROOT, "native", "libpersia_worker.so")
+_BUILD_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LOAD_FAILED = False
+
+_i64p = ctypes.POINTER(ctypes.c_int64)
+_u64p = ctypes.POINTER(ctypes.c_uint64)
+_f32p = ctypes.POINTER(ctypes.c_float)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+
+
+def _src_hash() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def build_native(force: bool = False) -> str:
+    """Compile the worker core if missing or stale (source-hash stamped, same
+    scheme as `persia_tpu.embedding.native_store.build_native`)."""
+    stamp = _SO + ".srchash"
+    with _BUILD_LOCK:
+        h = _src_hash()
+        if not force and os.path.exists(_SO) and os.path.exists(stamp):
+            with open(stamp) as f:
+                if f.read().strip() == h:
+                    return _SO
+        cmd = [
+            "g++", "-O3", "-mavx2", "-mfma", "-std=c++17", "-fPIC", "-shared",
+            "-Wall", "-o", _SO, _SRC,
+        ]
+        logger.info("building native worker core: %s", " ".join(cmd))
+        subprocess.check_call(cmd)
+        with open(stamp, "w") as f:
+            f.write(h)
+        return _SO
+
+
+def _load_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _LOAD_FAILED
+    if _LIB is not None or _LOAD_FAILED:
+        return _LIB
+    if os.environ.get("PERSIA_TPU_NATIVE_WORKER", "1") != "1":
+        _LOAD_FAILED = True
+        return None
+    try:
+        build_native()
+        lib = ctypes.CDLL(_SO)
+    except Exception as e:  # toolchain missing → numpy fallback
+        logger.warning("native worker core unavailable (%s); using numpy", e)
+        _LOAD_FAILED = True
+        return None
+    i64, u32, i32 = ctypes.c_int64, ctypes.c_uint32, ctypes.c_int32
+    lib.wk_dedup.restype = i64
+    lib.wk_dedup.argtypes = [_u64p, i64, _u64p, _i64p]
+    lib.wk_sum_pool.argtypes = [_f32p, _i64p, _i64p, i64, i64, _f32p]
+    lib.wk_grad_accum.argtypes = [_f32p, _i64p, _i64p, i64, i64, _f32p]
+    lib.wk_raw_index.argtypes = [_i64p, _i64p, i64, i64, i32, _i32p]
+    lib.wk_shard_partition.argtypes = [_u64p, i64, u32, _i64p, _i64p]
+    _LIB = lib
+    return _LIB
+
+
+def available() -> bool:
+    return _load_lib() is not None
+
+
+def _ptr(a: np.ndarray, typ):
+    return a.ctypes.data_as(typ)
+
+
+def dedup(ids: np.ndarray) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """(distinct, inverse) with distinct in first-seen order (np.unique
+    returns sorted order instead — interchangeable since every consumer
+    pairs distinct with inverse). None if the native core is unavailable."""
+    lib = _load_lib()
+    if lib is None:
+        return None
+    ids = np.ascontiguousarray(ids, dtype=np.uint64)
+    n = len(ids)
+    distinct = np.empty(n, dtype=np.uint64)
+    inverse = np.empty(n, dtype=np.int64)
+    m = lib.wk_dedup(_ptr(ids, _u64p), n, _ptr(distinct, _u64p), _ptr(inverse, _i64p))
+    return distinct[:m].copy(), inverse
+
+
+def sum_pool(
+    rows: np.ndarray, inverse: np.ndarray, sample_of_id: np.ndarray, batch_size: int
+) -> Optional[np.ndarray]:
+    """pooled[sample_of_id[i]] += rows[inverse[i]] (np.add.at order)."""
+    lib = _load_lib()
+    if lib is None:
+        return None
+    rows = np.ascontiguousarray(rows, dtype=np.float32)
+    inverse = np.ascontiguousarray(inverse, dtype=np.int64)
+    sample_of_id = np.ascontiguousarray(sample_of_id, dtype=np.int64)
+    dim = rows.shape[1] if rows.ndim == 2 else 0
+    pooled = np.zeros((batch_size, dim), dtype=np.float32)
+    lib.wk_sum_pool(
+        _ptr(rows, _f32p), _ptr(inverse, _i64p), _ptr(sample_of_id, _i64p),
+        len(inverse), dim, _ptr(pooled, _f32p),
+    )
+    return pooled
+
+
+def grad_accum(
+    grad: np.ndarray, inverse: np.ndarray, sample_of_id: np.ndarray, num_distinct: int
+) -> Optional[np.ndarray]:
+    """per_distinct[inverse[i]] += grad[sample_of_id[i]] (np.add.at order)."""
+    lib = _load_lib()
+    if lib is None:
+        return None
+    grad = np.ascontiguousarray(grad, dtype=np.float32)
+    inverse = np.ascontiguousarray(inverse, dtype=np.int64)
+    sample_of_id = np.ascontiguousarray(sample_of_id, dtype=np.int64)
+    dim = grad.shape[1]
+    out = np.zeros((num_distinct, dim), dtype=np.float32)
+    lib.wk_grad_accum(
+        _ptr(grad, _f32p), _ptr(inverse, _i64p), _ptr(sample_of_id, _i64p),
+        len(inverse), dim, _ptr(out, _f32p),
+    )
+    return out
+
+
+def raw_index(
+    counts: np.ndarray, inverse: np.ndarray, sample_fixed_size: int, pad: int
+) -> Optional[np.ndarray]:
+    """(B, L) int32 index matrix for raw slots (pad value = num_distinct)."""
+    lib = _load_lib()
+    if lib is None:
+        return None
+    counts = np.ascontiguousarray(counts, dtype=np.int64)
+    inverse = np.ascontiguousarray(inverse, dtype=np.int64)
+    B = len(counts)
+    out = np.empty((B, sample_fixed_size), dtype=np.int32)
+    lib.wk_raw_index(
+        _ptr(counts, _i64p), _ptr(inverse, _i64p), B, sample_fixed_size,
+        pad, _ptr(out, _i32p),
+    )
+    return out
+
+
+def shard_partition(
+    signs: np.ndarray, num_shards: int
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Returns (positions grouped by shard in stable order, per-shard counts).
+
+    ``positions[start[s]:start[s]+counts[s]]`` are the indices of shard s,
+    where start = cumsum-exclusive of counts — one pass instead of the numpy
+    router's per-shard boolean masks."""
+    lib = _load_lib()
+    if lib is None:
+        return None
+    signs = np.ascontiguousarray(signs, dtype=np.uint64)
+    n = len(signs)
+    pos = np.empty(n, dtype=np.int64)
+    counts = np.empty(num_shards, dtype=np.int64)
+    lib.wk_shard_partition(_ptr(signs, _u64p), n, num_shards, _ptr(pos, _i64p), _ptr(counts, _i64p))
+    return pos, counts

@@ -30,6 +30,7 @@ import numpy as np
 
 from persia_tpu.config import EmbeddingConfig, HyperParameters, SlotConfig
 from persia_tpu.data import IDTypeFeature, PersiaBatch
+from persia_tpu.embedding import native_worker
 from persia_tpu.embedding.hashing import add_index_prefix, hash_stack, sign_to_shard
 from persia_tpu.embedding.store import EmbeddingStore
 from persia_tpu.metrics import get_metrics
@@ -107,7 +108,11 @@ def preprocess_slot(
     )
     flat = add_index_prefix(flat, config.index_prefix, prefix_bit)
     sample_of_id = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
-    distinct, inverse = np.unique(flat, return_inverse=True)
+    native = native_worker.dedup(flat)
+    if native is not None:
+        distinct, inverse = native
+    else:
+        distinct, inverse = np.unique(flat, return_inverse=True)
     hs = config.hash_stack_config
     if hs.enabled:
         rounds = hs.hash_stack_rounds
@@ -156,8 +161,19 @@ class ShardedLookup:
         n = len(self.replicas)
         if n == 1:
             return self.replicas[0].lookup(keys, dim, train)
-        shard = sign_to_shard(keys, n)
+        part = native_worker.shard_partition(keys, n)
         out = np.zeros((len(keys), dim), dtype=np.float32)
+        if part is not None:
+            pos, counts = part
+            start = 0
+            for r in range(n):
+                c = int(counts[r])
+                if c:
+                    p = pos[start:start + c]
+                    out[p] = self.replicas[r].lookup(keys[p], dim, train)
+                start += c
+            return out
+        shard = sign_to_shard(keys, n)
         for r in range(n):
             mask = shard == r
             if mask.any():
@@ -175,6 +191,17 @@ class ShardedLookup:
         n = len(self.replicas)
         if n == 1:
             self.replicas[0].update_gradients(keys, grads, group)
+            return
+        part = native_worker.shard_partition(keys, n)
+        if part is not None:
+            pos, counts = part
+            start = 0
+            for r in range(n):
+                c = int(counts[r])
+                if c:
+                    p = pos[start:start + c]
+                    self.replicas[r].update_gradients(keys[p], grads[p], group)
+                start += c
             return
         shard = sign_to_shard(keys, n)
         for r in range(n):
@@ -202,9 +229,15 @@ def lookup_slot(
     dim = slot.config.dim
     rows = _distinct_rows(slot, lookup, train)
     if slot.config.embedding_summation:
-        pooled = np.zeros((slot.batch_size, dim), dtype=np.float32)
         if len(slot.sample_of_id):
-            np.add.at(pooled, slot.sample_of_id, rows[slot.inverse])
+            pooled = native_worker.sum_pool(
+                rows, slot.inverse, slot.sample_of_id, slot.batch_size
+            )
+            if pooled is None:
+                pooled = np.zeros((slot.batch_size, dim), dtype=np.float32)
+                np.add.at(pooled, slot.sample_of_id, rows[slot.inverse])
+        else:
+            pooled = np.zeros((slot.batch_size, dim), dtype=np.float32)
         if slot.config.sqrt_scaling:
             scale = 1.0 / np.sqrt(np.maximum(slot.counts, 1)).astype(np.float32)
             pooled *= scale[:, None]
@@ -212,13 +245,15 @@ def lookup_slot(
 
     L = slot.config.sample_fixed_size
     D = slot.num_distinct
-    index = np.full((slot.batch_size, L), D, dtype=np.int32)
     sample_id_num = np.minimum(slot.counts, L).astype(np.int32)
-    pos = 0
-    for b, c in enumerate(slot.counts.tolist()):
-        take = min(c, L)
-        index[b, :take] = slot.inverse[pos : pos + take]
-        pos += c
+    index = native_worker.raw_index(slot.counts, slot.inverse, L, D)
+    if index is None:
+        index = np.full((slot.batch_size, L), D, dtype=np.int32)
+        pos = 0
+        for b, c in enumerate(slot.counts.tolist()):
+            take = min(c, L)
+            index[b, :take] = slot.inverse[pos : pos + take]
+            pos += c
     if slot.config.sqrt_scaling:
         rows = rows / np.sqrt(np.maximum(D, 1)).astype(np.float32)
     return RawEmbeddingBatch(slot.name, rows, index, sample_id_num)
@@ -248,9 +283,15 @@ def slot_gradient_to_keys(
         if slot.config.sqrt_scaling:
             scale = 1.0 / np.sqrt(np.maximum(slot.counts, 1)).astype(np.float32)
             grad = grad * scale[:, None]
-        per_distinct = np.zeros((slot.num_distinct, dim), dtype=np.float32)
         if len(slot.sample_of_id):
-            np.add.at(per_distinct, slot.inverse, grad[slot.sample_of_id])
+            per_distinct = native_worker.grad_accum(
+                grad, slot.inverse, slot.sample_of_id, slot.num_distinct
+            )
+            if per_distinct is None:
+                per_distinct = np.zeros((slot.num_distinct, dim), dtype=np.float32)
+                np.add.at(per_distinct, slot.inverse, grad[slot.sample_of_id])
+        else:
+            per_distinct = np.zeros((slot.num_distinct, dim), dtype=np.float32)
     else:
         if grad.shape[0] != slot.num_distinct:
             raise ValueError(

@@ -23,6 +23,7 @@ dense params replicated (psum grads). Single-device jit needs no mesh.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import flax.struct
@@ -125,6 +126,137 @@ def _gather_all(
     return out
 
 
+# ---------------------------------------------------------------------------
+# Stacked tables: all same-dim slots share one physical (sum(vocab), dim)
+# table with per-slot row offsets, so the step issues ONE gather and ONE
+# sparse-update scatter per dim-group instead of one per slot. This is the
+# HBM analogue of the reference's single global key space partitioned by
+# per-slot index prefixes (`embedding_worker_service/mod.rs:403-429`,
+# `persia-embedding-config/src/lib.rs:600-650`) — offsets play the role of
+# index prefixes.
+# ---------------------------------------------------------------------------
+
+_INT32_MAX = np.iinfo(np.int32).max
+
+
+@dataclass(frozen=True)
+class StackGroup:
+    """One physical stacked table covering several same-dim slots."""
+
+    name: str
+    slots: Tuple[str, ...]
+    offsets: Tuple[int, ...]  # row offset of each slot, aligned with ``slots``
+    vocab: int
+    dim: int
+
+
+def group_stacked_specs(
+    specs: Dict[str, FusedSlotSpec], slot_order: Sequence[str]
+) -> List[StackGroup]:
+    """Deterministically group slots by dim into stacked tables (splitting a
+    group if its total rows would overflow int32 ids)."""
+    by_dim: Dict[int, List[str]] = {}
+    for name in slot_order:
+        by_dim.setdefault(specs[name].dim, []).append(name)
+    groups = []
+    for dim in sorted(by_dim):
+        names, offsets, total = [], [], 0
+        part = 0
+        for name in by_dim[dim]:
+            v = specs[name].vocab
+            if total + v > _INT32_MAX and names:
+                groups.append(
+                    StackGroup(f"__stack_d{dim}_{part}", tuple(names), tuple(offsets), total, dim)
+                )
+                names, offsets, total = [], [], 0
+                part += 1
+            names.append(name)
+            offsets.append(total)
+            total += v
+        groups.append(
+            StackGroup(f"__stack_d{dim}_{part}", tuple(names), tuple(offsets), total, dim)
+        )
+    return groups
+
+
+def create_stacked_tables(
+    rng,
+    specs: Dict[str, FusedSlotSpec],
+    groups: Sequence[StackGroup],
+    sparse_cfg: OptimizerConfig,
+    dtype=jnp.float32,
+):
+    """Stacked tables with each slot's row range drawing from its own
+    init_bounds (ref init: `emb_entry.rs:28-60`).
+
+    Filled one slot at a time into a donated group table (peak HBM = full
+    table + one slot's rows, not 2x the table as a concat of parts would be
+    — stacking exists precisely for the multi-GB case)."""
+    tables, emb_state = {}, {}
+    # key assignment matches create_fused_tables (sorted slot name) so a
+    # given slot's seeded init is layout-independent
+    all_names = sorted(n for g in groups for n in g.slots)
+    keys = dict(zip(all_names, jax.random.split(rng, max(len(all_names), 1))))
+
+    @partial(jax.jit, static_argnames=("shape", "lo", "hi"), donate_argnums=(0,))
+    def _fill(tbl, key, off, shape, lo, hi):
+        part = jax.random.uniform(key, shape, dtype=tbl.dtype, minval=lo, maxval=hi)
+        return jax.lax.dynamic_update_slice(tbl, part, (off, 0))
+
+    for g in groups:
+        tbl = jnp.zeros((g.vocab, g.dim), dtype=dtype)
+        for name, off in zip(g.slots, g.offsets):
+            s = specs[name]
+            lo, hi = s.init_bounds
+            tbl = _fill(tbl, keys[name], jnp.int32(off), (s.vocab, s.dim), lo, hi)
+        tables[g.name] = tbl
+        emb_state[g.name] = init_sparse_state(sparse_cfg, g.vocab, g.dim)
+    return tables, emb_state
+
+
+def _gather_all_stacked(
+    tables: Dict[str, jnp.ndarray],
+    ids: Dict[str, jnp.ndarray],
+    groups: Sequence[StackGroup],
+) -> Dict[str, jnp.ndarray]:
+    """One ``take`` per dim-group; per-slot views are cheap slices.
+
+    Ids are clamped to the slot's own [0, vocab) range before the offset is
+    applied, matching the unstacked path's XLA gather-clamp semantics — an
+    out-of-range id must never read a neighboring slot's rows."""
+    out = {}
+    for g in groups:
+        parts = []
+        ends = list(g.offsets[1:]) + [g.vocab]
+        for name, off, end in zip(g.slots, g.offsets, ends):
+            i = ids[name]
+            clamped = jnp.minimum(i, end - off - 1)
+            parts.append(jnp.where(i >= 0, clamped + off, 0).reshape(-1).astype(jnp.int32))
+        flat = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        rows = jnp.take(tables[g.name], flat, axis=0)  # (sum(B·L), dim)
+        pos = 0
+        for name in g.slots:
+            shape = ids[name].shape
+            k = int(np.prod(shape))
+            out[name] = jax.lax.slice(rows, (pos, 0), (pos + k, g.dim)).reshape(
+                shape + (g.dim,)
+            )
+            pos += k
+    return out
+
+
+def stacked_slot_table(
+    tables: Dict[str, jnp.ndarray], groups: Sequence[StackGroup], name: str
+) -> jnp.ndarray:
+    """Per-slot (vocab, dim) view of a stacked table (for checkpoints/tests)."""
+    for g in groups:
+        if name in g.slots:
+            i = g.slots.index(name)
+            end = g.offsets[i + 1] if i + 1 < len(g.slots) else g.vocab
+            return tables[g.name][g.offsets[i]:end]
+    raise KeyError(name)
+
+
 def init_fused_state(
     model,
     rng,
@@ -133,13 +265,23 @@ def init_fused_state(
     dense_optimizer: optax.GradientTransformation,
     sparse_cfg: OptimizerConfig,
     slot_order: Optional[Sequence[str]] = None,
+    stack: bool = False,
+    table_dtype=jnp.float32,
 ) -> FusedTrainState:
     slot_order = list(slot_order or sorted(specs))
     rng_tbl, rng_model = jax.random.split(rng)
-    tables, emb_state = create_fused_tables(rng_tbl, specs, sparse_cfg)
+    if stack:
+        groups = group_stacked_specs(specs, slot_order)
+        tables, emb_state = create_stacked_tables(
+            rng_tbl, specs, groups, sparse_cfg, dtype=table_dtype
+        )
+        gathered = _gather_all_stacked(tables, sample_batch["ids"], groups)
+    else:
+        tables, emb_state = create_fused_tables(rng_tbl, specs, sparse_cfg, dtype=table_dtype)
+        gathered = _gather_all(tables, sample_batch["ids"])
     ids = sample_batch["ids"]
-    gathered = _gather_all(tables, ids)
     model_emb = _model_inputs(specs, slot_order, gathered, ids)
+    del gathered
     variables = model.init(rng_model, sample_batch["dense"], model_emb, train=False)
     params = variables["params"]
     return FusedTrainState(
@@ -162,6 +304,7 @@ def build_fused_train_step(
     loss_fn=default_loss_fn,
     donate: bool = True,
     jit: bool = True,
+    stack: bool = False,
 ):
     """Returns jitted ``step(state, batch) -> (state, (loss, preds))``.
 
@@ -170,13 +313,21 @@ def build_fused_train_step(
     ``donate=True`` donates the state buffers so multi-GB tables update
     in place instead of being copied each step. ``jit=False`` returns the
     raw traceable step for callers that wrap it (packed-I/O benches,
-    shard_map composition).
+    shard_map composition). ``stack=True`` expects state built with
+    ``init_fused_state(stack=True)``: same-dim slots share one physical
+    table, so the step runs one gather + one sparse-update per dim-group
+    instead of one per slot.
     """
     slot_order = list(slot_order or sorted(specs))
+    groups = group_stacked_specs(specs, slot_order) if stack else None
 
     def step(state: FusedTrainState, batch: Dict):
         ids = batch["ids"]
-        gathered = _gather_all(state.tables, ids)
+        gathered = (
+            _gather_all_stacked(state.tables, ids, groups)
+            if stack
+            else _gather_all(state.tables, ids)
+        )
 
         def loss_wrapper(params, gathered):
             model_emb = _model_inputs(specs, slot_order, gathered, ids)
@@ -207,18 +358,44 @@ def build_fused_train_step(
             [sparse_cfg.beta1, sparse_cfg.beta2], dtype=jnp.float32
         )
         new_tables, new_emb_state = {}, {}
-        for name in slot_order:
-            g = emb_grads[name].astype(jnp.float32)
-            flat_ids, flat_g, flat_mask = masked_flat_ids_grads(ids[name], g)
-            new_tables[name], new_emb_state[name] = sparse_update(
-                sparse_cfg,
-                state.tables[name],
-                state.emb_state[name],
-                flat_ids,
-                flat_g,
-                batch_state,
-                mask=flat_mask,
-            )
+        if stack:
+            for grp in groups:
+                idp, gp, mp = [], [], []
+                for name, off in zip(grp.slots, grp.offsets):
+                    i = ids[name]
+                    # ids outside the slot's own [0, vocab) are masked out,
+                    # matching the unstacked scatter's mode="drop" — they
+                    # must not write a neighboring slot's rows
+                    in_range = (i >= 0) & (i < specs[name].vocab)
+                    fi, fg, fm = masked_flat_ids_grads(
+                        jnp.where(in_range, i + off, -1),
+                        emb_grads[name].astype(jnp.float32),
+                    )
+                    idp.append(fi)
+                    gp.append(fg)
+                    mp.append(fm)
+                new_tables[grp.name], new_emb_state[grp.name] = sparse_update(
+                    sparse_cfg,
+                    state.tables[grp.name],
+                    state.emb_state[grp.name],
+                    jnp.concatenate(idp) if len(idp) > 1 else idp[0],
+                    jnp.concatenate(gp) if len(gp) > 1 else gp[0],
+                    batch_state,
+                    mask=jnp.concatenate(mp) if len(mp) > 1 else mp[0],
+                )
+        else:
+            for name in slot_order:
+                g = emb_grads[name].astype(jnp.float32)
+                flat_ids, flat_g, flat_mask = masked_flat_ids_grads(ids[name], g)
+                new_tables[name], new_emb_state[name] = sparse_update(
+                    sparse_cfg,
+                    state.tables[name],
+                    state.emb_state[name],
+                    flat_ids,
+                    flat_g,
+                    batch_state,
+                    mask=flat_mask,
+                )
 
         new_state = FusedTrainState(
             params=new_params,
@@ -236,12 +413,17 @@ def build_fused_train_step(
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
-def build_fused_eval_step(model, specs, slot_order=None):
+def build_fused_eval_step(model, specs, slot_order=None, stack: bool = False):
     slot_order = list(slot_order or sorted(specs))
+    groups = group_stacked_specs(specs, slot_order) if stack else None
 
     def eval_step(state: FusedTrainState, batch: Dict):
         ids = batch["ids"]
-        gathered = _gather_all(state.tables, ids)
+        gathered = (
+            _gather_all_stacked(state.tables, ids, groups)
+            if stack
+            else _gather_all(state.tables, ids)
+        )
         model_emb = _model_inputs(specs, slot_order, gathered, ids)
         variables = {"params": state.params}
         if state.batch_stats:

@@ -21,28 +21,22 @@ from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
-from persia_tpu.data import IDTypeFeature, Label, NonIDTypeFeature, PersiaBatch
+from persia_tpu.data import (
+    IDTypeFeature,
+    IDTypeFeatureWithSingleID,
+    Label,
+    NonIDTypeFeature,
+    PersiaBatch,
+)
+from persia_tpu.embedding.hashing import splitmix64
 
-_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
-_MIX1 = np.uint64(0xBF58476D1CE4E5B9)
-_MIX2 = np.uint64(0x94D049BB133111EB)
-
-
-def splitmix64(x: np.ndarray) -> np.ndarray:
-    """Vectorized splitmix64 finalizer (public-domain mixing constants)."""
-    x = np.asarray(x, dtype=np.uint64)
-    with np.errstate(over="ignore"):
-        z = x + _GOLDEN
-        z = (z ^ (z >> np.uint64(30))) * _MIX1
-        z = (z ^ (z >> np.uint64(27))) * _MIX2
-        return z ^ (z >> np.uint64(31))
 
 
 def hash_to_unit(ids: np.ndarray, salt: int) -> np.ndarray:
     """Deterministic per-id weight in [-1, 1) — a 2^64-entry virtual weight
     table that never gets materialized."""
     with np.errstate(over="ignore"):
-        h = splitmix64(np.asarray(ids, np.uint64) ^ splitmix64(np.uint64(salt)))
+        h = splitmix64(np.asarray(ids, np.uint64) ^ splitmix64(np.full(1, salt, np.uint64))[0])
     return (h >> np.uint64(11)).astype(np.float64) * (2.0 / (1 << 53)) - 1.0
 
 
@@ -131,7 +125,7 @@ class CriteoSynthetic(_StreamingBase):
             u = rng.random(n)
             ids = np.minimum((u ** 3 * v).astype(np.uint64), np.uint64(v - 1))
             logit = logit + 1.5 * hash_to_unit(ids, self.task_seed * 131 + k)
-            id_feats.append(IDTypeFeature(name, [ids[i : i + 1] for i in range(n)]))
+            id_feats.append(IDTypeFeatureWithSingleID(name, ids))
 
         p = 1.0 / (1.0 + np.exp(-logit / max(self.noise, 1e-6)))
         labels = (rng.random(n) < p).astype(np.float32).reshape(-1, 1)
@@ -181,7 +175,7 @@ class AvazuSynthetic(_StreamingBase):
             u = rng.random(n)
             ids = np.minimum((u ** 2.5 * v).astype(np.uint64), np.uint64(v - 1))
             logit = logit + 1.3 * hash_to_unit(ids, self.task_seed * 131 + k)
-            id_feats.append(IDTypeFeature(name, [ids[i : i + 1] for i in range(n)]))
+            id_feats.append(IDTypeFeatureWithSingleID(name, ids))
 
         p = 1.0 / (1.0 + np.exp(-logit / max(self.noise, 1e-6)))
         labels = (rng.random(n) < p).astype(np.float32).reshape(-1, 1)
@@ -226,7 +220,8 @@ class TaobaoSynthetic(_StreamingBase):
 
     def _cate_of(self, items: np.ndarray) -> np.ndarray:
         # category is a deterministic function of the item, like a catalog
-        return splitmix64(items) % np.uint64(self.cate_vocab)
+        with np.errstate(over="ignore"):
+            return splitmix64(items) % np.uint64(self.cate_vocab)
 
     def _make(self, rng, n, batch_id):
         L = self.max_hist
@@ -264,10 +259,8 @@ class TaobaoSynthetic(_StreamingBase):
         recency = (np.minimum(hist_len, L) / L).astype(np.float32).reshape(-1, 1)
         return dict(
             id_type_features=[
-                IDTypeFeature("item", [cand[i : i + 1] for i in range(n)]),
-                IDTypeFeature(
-                    "cate", [self._cate_of(cand[i : i + 1]) for i in range(n)]
-                ),
+                IDTypeFeatureWithSingleID("item", cand),
+                IDTypeFeatureWithSingleID("cate", self._cate_of(cand)),
                 IDTypeFeature("hist_item", hist_items),
                 IDTypeFeature("hist_cate", hist_cates),
             ],
@@ -277,7 +270,7 @@ class TaobaoSynthetic(_StreamingBase):
 
 
 class Synthetic100T(_StreamingBase):
-    """Uniform-random u64 signs over the FULL 2^64 key space — the access
+    """Uniform-random u64 signs over a 2^63 key space — the access
     pattern of the reference's 100-trillion-parameter regime
     (`/root/reference/README.md:29`): effectively infinite vocabulary, LRU
     working set, every batch mostly cold ids. No labels needed beyond a

@@ -275,3 +275,108 @@ def test_fused_step_sharded_multidevice():
     assert shard.is_equivalent_to(
         NamedSharding(mesh, P("data", None)), state.tables["a"].ndim
     )
+
+
+def test_stacked_step_matches_unstacked():
+    """stack=True (one physical table per dim-group, one gather + one
+    scatter-update) must be numerically equivalent to the per-slot path."""
+    from persia_tpu.parallel.fused_step import (
+        group_stacked_specs,
+        stacked_slot_table,
+    )
+
+    B, D = 32, 8
+    specs = {
+        "a": FusedSlotSpec(vocab=50, dim=D),
+        "b": FusedSlotSpec(vocab=30, dim=D, sqrt_scaling=True),
+        "c": FusedSlotSpec(vocab=20, dim=4),  # different dim → own group
+        "seq": FusedSlotSpec(vocab=40, dim=D, pooled=False),
+    }
+    slot_order = sorted(specs)
+    rng = np.random.default_rng(7)
+    batch = {
+        "dense": [rng.normal(size=(B, 4)).astype(np.float32)],
+        "labels": [rng.integers(0, 2, (B, 1)).astype(np.float32)],
+        "ids": {
+            "a": jnp.asarray(rng.integers(0, 50, (B,)), jnp.int32),
+            "b": jnp.asarray(
+                np.where(rng.random((B, 3)) < 0.3, -1, rng.integers(0, 30, (B, 3))),
+                jnp.int32,
+            ),
+            "c": jnp.asarray(rng.integers(0, 20, (B, 2)), jnp.int32),
+            "seq": jnp.asarray(
+                np.where(rng.random((B, 4)) < 0.4, -1, rng.integers(0, 40, (B, 4))),
+                jnp.int32,
+            ),
+        },
+    }
+    from persia_tpu.models import DNN
+
+    model = DNN(hidden_sizes=(32,))  # handles mixed embedding dims
+    cfg = Adagrad(lr=0.1).config
+
+    flat = init_fused_state(
+        model, jax.random.PRNGKey(0), specs, batch, optax.adam(1e-2), cfg,
+        slot_order=slot_order,
+    )
+    stacked = init_fused_state(
+        model, jax.random.PRNGKey(0), specs, batch, optax.adam(1e-2), cfg,
+        slot_order=slot_order, stack=True,
+    )
+    groups = group_stacked_specs(specs, slot_order)
+    assert sorted(len(g.slots) for g in groups) == [1, 3]
+
+    # same seeded init per slot regardless of layout
+    for name in slot_order:
+        np.testing.assert_array_equal(
+            np.asarray(stacked_slot_table(stacked.tables, groups, name)),
+            np.asarray(flat.tables[name]),
+        )
+
+    # copy the flat model params/opt state into the stacked state so the
+    # dense halves start identical
+    stacked = stacked.replace(params=flat.params, opt_state=flat.opt_state)
+
+    step_flat = build_fused_train_step(
+        model, optax.adam(1e-2), cfg, specs, slot_order, donate=False
+    )
+    step_stk = build_fused_train_step(
+        model, optax.adam(1e-2), cfg, specs, slot_order, donate=False, stack=True
+    )
+    for _ in range(3):
+        flat, (loss_f, _) = step_flat(flat, batch)
+        stacked, (loss_s, _) = step_stk(stacked, batch)
+        np.testing.assert_allclose(float(loss_f), float(loss_s), rtol=1e-5)
+    for name in slot_order:
+        np.testing.assert_allclose(
+            np.asarray(stacked_slot_table(stacked.tables, groups, name)),
+            np.asarray(flat.tables[name]),
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+def test_stacked_eval_matches_unstacked():
+    from persia_tpu.parallel.fused_step import build_fused_eval_step
+
+    state, step, batch, specs, model = _toy_setup()
+    stacked = init_fused_state(
+        model, jax.random.PRNGKey(0), specs, batch, optax.adam(1e-2),
+        Adagrad(lr=0.1).config, stack=True,
+    )
+    stacked = stacked.replace(params=state.params)
+    ev_flat = build_fused_eval_step(model, specs)
+    ev_stk = build_fused_eval_step(model, specs, stack=True)
+    np.testing.assert_allclose(
+        np.asarray(ev_flat(state, batch)), np.asarray(ev_stk(stacked, batch)),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_group_stacked_specs_int32_split():
+    from persia_tpu.parallel.fused_step import group_stacked_specs
+
+    big = 1 << 30
+    specs = {f"s{i}": FusedSlotSpec(vocab=big, dim=8) for i in range(4)}
+    groups = group_stacked_specs(specs, sorted(specs))
+    assert all(g.vocab <= np.iinfo(np.int32).max for g in groups)
+    assert sum(len(g.slots) for g in groups) == 4
