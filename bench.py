@@ -147,7 +147,7 @@ def bench_hybrid():
         feature_index_prefix_bit=8,
     )
     store = create_store(
-        "auto", capacity=1 << 24, num_internal_shards=32,
+        "auto", capacity=1 << 25, num_internal_shards=64,
         optimizer=Adagrad(lr=0.05).config, seed=1,
     )
     worker = EmbeddingWorker(cfg, [store], num_threads=16)

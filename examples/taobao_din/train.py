@@ -100,7 +100,6 @@ def main(argv=None) -> int:
         t0 = time.time()
         for tb in loader:
             losses.append(ctx.train_step_prepared(tb, loader)["loss"])
-        loader.flush()  # drain in-flight async gradient updates before eval/ckpt
         dt = time.time() - t0
         sps = args.steps * args.batch_size / dt
 

@@ -36,6 +36,7 @@ from persia_tpu.parallel.train_step import (
     init_train_state,
     replicate_state,
     shard_device_batch,
+    unpack_step_header,
     unpack_step_output,
 )
 
@@ -213,8 +214,10 @@ class TrainCtx(EmbeddingCtx):
     def _train_step(self, state, device_batch):
         """Run the jitted step and unpack its single-transfer output into the
         (state, metrics, emb_grads) host view."""
-        state, packed = self._train_step_jit(state, device_batch)
-        loss, preds, emb_grads = unpack_step_output(np.asarray(packed), device_batch)
+        state, (header, gpacked) = self._train_step_jit(state, device_batch)
+        loss, preds, emb_grads = unpack_step_output(
+            np.asarray(header), np.asarray(gpacked), device_batch
+        )
         return state, {"loss": loss, "preds": preds}, emb_grads
 
     def __enter__(self):
@@ -261,12 +264,20 @@ class TrainCtx(EmbeddingCtx):
         if self.state is None:
             self.init_state(jax.random.PRNGKey(0), device_batch)
         try:
-            self.state, metrics, emb_grads = self._train_step(self.state, device_batch)
+            self.state, (header, gpacked) = self._train_step_jit(self.state, device_batch)
+            # start the bulk gradient download without blocking; the
+            # BackwardEngine thread materializes it, so the device→host
+            # transfer overlaps the next step instead of serializing with it
+            try:
+                gpacked.copy_to_host_async()
+            except AttributeError:
+                pass
+            loss, preds = unpack_step_header(np.asarray(header), device_batch)
         except Exception:
             loader.mark_consumed(training_batch)
             raise
-        loader.backward(training_batch, emb_grads, scale_factor=self.grad_scale)
-        return {"loss": float(metrics["loss"]), "preds": np.asarray(metrics["preds"])}
+        loader.backward_packed(training_batch, gpacked, scale_factor=self.grad_scale)
+        return {"loss": loss, "preds": np.asarray(preds)}
 
     def eval_batch(self, batch: PersiaBatch) -> np.ndarray:
         emb_batches = self.worker.forward_directly(batch, train=False)

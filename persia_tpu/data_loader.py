@@ -26,6 +26,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
 
+import numpy as np
+
 from persia_tpu import diagnostics
 from persia_tpu.data import PersiaBatch
 from persia_tpu.logger import get_default_logger
@@ -83,7 +85,11 @@ class BackwardEngine:
         for t in self._threads:
             t.start()
 
-    def push(self, ref: int, slot_grads: Dict, scale_factor: float = 1.0) -> None:
+    def push(self, ref: int, slot_grads, scale_factor: float = 1.0) -> None:
+        """``slot_grads`` is either the per-slot gradient dict or a zero-arg
+        callable producing it — the callable form defers the device→host
+        gradient fetch into this engine's thread so it overlaps the next
+        step."""
         with self._lock:
             if self._error is not None:
                 raise RuntimeError("backward engine failed") from self._error
@@ -97,6 +103,8 @@ class BackwardEngine:
                 return
             ref, slot_grads, scale = item
             try:
+                if callable(slot_grads):
+                    slot_grads = slot_grads()
                 self._worker.update_gradient_batched(ref, slot_grads, scale_factor=scale)
             except BaseException as e:  # noqa: BLE001 — propagate to trainer
                 self._worker.abort_gradient(ref)
@@ -309,6 +317,25 @@ class DataLoader:
             training_batch.emb_batches, emb_grads, training_batch.counts
         )
         self.backward_engine.push(training_batch.ref, slot_grads, scale_factor)
+
+    def backward_packed(
+        self, training_batch: PersiaTrainingBatch, gpacked, scale_factor: float = 1.0
+    ) -> None:
+        """Queue the step's still-on-device packed gradient buffer; the
+        engine thread materializes it (np.asarray = the bulk device→host
+        transfer) and splits it per slot, keeping the transfer off the
+        training loop's critical path."""
+        from persia_tpu.parallel.train_step import unpack_step_grads
+
+        def _materialize():
+            emb_grads = unpack_step_grads(
+                np.asarray(gpacked), training_batch.device_batch
+            )
+            return self.ctx.emb_grads_to_slot_grads(
+                training_batch.emb_batches, emb_grads, training_batch.counts
+            )
+
+        self.backward_engine.push(training_batch.ref, _materialize, scale_factor)
 
     def mark_consumed(self, training_batch: PersiaTrainingBatch) -> None:
         """Release the staleness permit for a no-gradient batch (eval)."""

@@ -103,16 +103,18 @@ def build_train_step(
     optimizer: optax.GradientTransformation,
     loss_fn: Callable = default_loss_fn,
 ):
-    """Returns jitted ``step(state, batch) -> (state, packed)``.
+    """Returns jitted ``step(state, batch) -> (state, (header, gpacked))``.
 
-    ``packed`` is ONE flat f32 array: [loss | preds | emb_grad_0 | ...] —
-    everything the host needs from the step in a single device→host transfer
-    (per-array fetches pay a full round-trip each; on a remote-attached TPU
-    that latency dominated the step). ``unpack_step_output`` splits it using
-    shapes derived from the batch. Emb grads align with ``batch['emb']``:
-    (B, dim) for pooled slots, (P, dim) for raw slots (rows past the true
-    distinct count are zero — the host slices them off before shipping to
-    the worker).
+    ``header`` is a small f32 array [loss | preds] — the cheap synchronous
+    fetch. ``gpacked`` is ONE flat array [emb_grad_0 | ...] in the embedding
+    wire dtype (bf16 halves device→host bytes, matching the reference's f16
+    gradient wire) — the bulk transfer, fetched asynchronously by the
+    BackwardEngine so it overlaps the next step (per-array fetches pay a
+    full round-trip each; on a remote-attached TPU that latency dominated
+    the step). ``unpack_step_output`` splits them using shapes derived from
+    the batch. Emb grads align with ``batch['emb']``: (B, dim) for pooled
+    slots, (P, dim) for raw slots (rows past the true distinct count are
+    zero — the host slices them off before shipping to the worker).
     """
 
     def step(state: TrainState, batch: Dict):
@@ -149,56 +151,44 @@ def build_train_step(
             step=state.step + 1,
         )
         preds = jax.nn.sigmoid(logits)
-        # Header (loss|preds) stays exact f32; only emb grads ride the wire
-        # dtype (bf16 halves device→host bytes, matching the reference's f16
-        # gradient wire format). With a bf16 wire the f32 header is bitcast
-        # to uint16 pairs so everything still leaves in ONE transfer.
+        # Header (loss|preds) stays exact f32 — the cheap sync fetch; emb
+        # grads ride the wire dtype in their own buffer so the bulk transfer
+        # can be fetched asynchronously off the critical path.
         header = jnp.concatenate([jnp.reshape(loss, (1,)).astype(jnp.float32),
                                   jnp.reshape(preds, (-1,)).astype(jnp.float32)])
         gflat = [jnp.reshape(g, (-1,)) for g in emb_grads]
-        pack_dt = gflat[0].dtype if gflat else jnp.float32
-        if pack_dt == jnp.float32:
-            packed = jnp.concatenate([header] + gflat)
-        else:
-            h16 = jax.lax.bitcast_convert_type(header, jnp.uint16).reshape(-1)
-            g16 = [jax.lax.bitcast_convert_type(g.astype(jnp.bfloat16), jnp.uint16)
-                   for g in gflat]
-            packed = jnp.concatenate([h16] + g16)
-        return new_state, packed
+        gpacked = jnp.concatenate(gflat) if gflat else jnp.zeros((0,), jnp.float32)
+        return new_state, (header, gpacked)
 
     return jax.jit(step)
 
 
-def unpack_step_output(packed: np.ndarray, batch: Dict):
-    """Split the step's packed output → (loss, preds, emb_grads) on host.
-
-    ``packed`` must already be host memory (``np.asarray`` — the single
-    transfer); shapes come from the same ``batch`` the step consumed. A
-    uint16 payload is the bf16-wire layout: an f32 header bitcast to uint16
-    pairs followed by bf16 gradients."""
-    import ml_dtypes
-
+def unpack_step_header(header: np.ndarray, batch: Dict):
+    """Host view of the step's small output: (loss, preds)."""
     labels = batch["labels"][0]
-    n = int(np.prod(labels.shape))
-    if packed.dtype == np.uint16:
-        hn = 2 * (1 + n)
-        header = np.ascontiguousarray(packed[:hn]).view(np.float32)
-        body = packed[hn:]
-        grad_dt = np.dtype(ml_dtypes.bfloat16)
-    else:
-        header = packed[: 1 + n]
-        body = packed[1 + n:]
-        grad_dt = packed.dtype
     loss = float(header[0])
     preds = header[1:].reshape(labels.shape)
+    return loss, preds
+
+
+def unpack_step_grads(gpacked: np.ndarray, batch: Dict) -> List[np.ndarray]:
+    """Split the bulk gradient buffer into per-slot arrays (shapes come from
+    the same ``batch`` the step consumed; ``gpacked`` must already be host
+    memory)."""
     grads = []
     off = 0
     for e in batch["emb"]:
         shape = e["pooled"].shape if "pooled" in e else e["distinct"].shape
         k = int(np.prod(shape))
-        grads.append(np.ascontiguousarray(body[off:off + k]).view(grad_dt).reshape(shape))
+        grads.append(np.ascontiguousarray(gpacked[off:off + k]).reshape(shape))
         off += k
-    return loss, preds, grads
+    return grads
+
+
+def unpack_step_output(header: np.ndarray, gpacked: np.ndarray, batch: Dict):
+    """(loss, preds, emb_grads) from the step's two output buffers."""
+    loss, preds = unpack_step_header(header, batch)
+    return loss, preds, unpack_step_grads(gpacked, batch)
 
 
 def build_eval_step(model):
