@@ -1,0 +1,225 @@
+// persia_tpu native HBM-cache directory.
+//
+// Host-side bookkeeping for the write-back HBM embedding cache
+// (persia_tpu/embedding/hbm_cache.py): a fixed-capacity LRU map from
+// embedding sign -> device cache row. The device holds the actual rows
+// ([emb | optimizer state] in HBM); this directory decides, per batch of
+// deduplicated signs, which rows hit, which signs miss (and which cache row
+// each miss is assigned), and which resident signs get evicted to make room
+// (their rows are read back from the device and written to the host PS —
+// the write-back).
+//
+// This plays the role the reference's embedding-worker forward buffers and
+// PS LRU jointly play (rust/persia-embedding-server/.../eviction_map.rs
+// O(1) LRU over a slab), re-targeted at a device-resident row pool:
+// row index == slab slot, intrusive doubly-linked LRU, open-addressing
+// hash with backward-shift deletion (same scheme as native/ps.cpp).
+//
+// C ABI only (ctypes-friendly).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <vector>
+
+namespace {
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+struct Cache {
+  int64_t capacity = 0;
+  int64_t count = 0;
+  // per-row metadata (row index == slab slot)
+  std::vector<uint64_t> row_sign;
+  std::vector<int64_t> prev, next;  // intrusive LRU list
+  int64_t lru_head = -1, lru_tail = -1;
+  std::vector<int64_t> free_rows;
+  // open addressing sign -> row
+  std::vector<uint64_t> table_sign;
+  std::vector<int64_t> table_row;  // -1 = empty
+  uint64_t mask = 0;
+
+  explicit Cache(int64_t cap) : capacity(cap) {
+    row_sign.assign(cap, 0);
+    prev.assign(cap, -1);
+    next.assign(cap, -1);
+    free_rows.reserve(cap);
+    for (int64_t r = cap - 1; r >= 0; --r) free_rows.push_back(r);
+    uint64_t tsize = 16;
+    while (tsize < (uint64_t)cap * 2) tsize <<= 1;
+    table_sign.assign(tsize, 0);
+    table_row.assign(tsize, -1);
+    mask = tsize - 1;
+  }
+
+  inline uint64_t home(uint64_t sign) const { return splitmix64(sign) & mask; }
+
+  int64_t find_pos(uint64_t sign) const {
+    uint64_t i = home(sign);
+    while (table_row[i] >= 0) {
+      if (table_sign[i] == sign) return (int64_t)i;
+      i = (i + 1) & mask;
+    }
+    return -1;
+  }
+
+  void lru_unlink(int64_t r) {
+    if (prev[r] >= 0) next[prev[r]] = next[r]; else lru_head = next[r];
+    if (next[r] >= 0) prev[next[r]] = prev[r]; else lru_tail = prev[r];
+    prev[r] = next[r] = -1;
+  }
+
+  void lru_push_front(int64_t r) {
+    prev[r] = -1;
+    next[r] = lru_head;
+    if (lru_head >= 0) prev[lru_head] = r;
+    lru_head = r;
+    if (lru_tail < 0) lru_tail = r;
+  }
+
+  void touch(int64_t r) {
+    if (lru_head == r) return;
+    lru_unlink(r);
+    lru_push_front(r);
+  }
+
+  void erase_table_pos(uint64_t i) {
+    uint64_t j = i;
+    for (;;) {
+      table_row[i] = -1;
+      uint64_t k;
+      for (;;) {
+        j = (j + 1) & mask;
+        if (table_row[j] < 0) return;
+        k = home(table_sign[j]);
+        bool home_in_range = (i <= j) ? (i < k && k <= j) : (i < k || k <= j);
+        if (!home_in_range) break;
+      }
+      table_sign[i] = table_sign[j];
+      table_row[i] = table_row[j];
+      i = j;
+    }
+  }
+
+  // evict the LRU row; returns (row) and writes its sign to *sign_out
+  int64_t evict_lru(uint64_t* sign_out) {
+    const int64_t r = lru_tail;
+    *sign_out = row_sign[r];
+    const int64_t pos = find_pos(row_sign[r]);
+    if (pos >= 0) erase_table_pos((uint64_t)pos);
+    lru_unlink(r);
+    --count;
+    return r;
+  }
+
+  int64_t insert(uint64_t sign) {  // caller guarantees a free row exists
+    const int64_t r = free_rows.back();
+    free_rows.pop_back();
+    row_sign[r] = sign;
+    uint64_t i = home(sign);
+    while (table_row[i] >= 0) i = (i + 1) & mask;
+    table_sign[i] = sign;
+    table_row[i] = r;
+    lru_push_front(r);
+    ++count;
+    return r;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* cache_create(int64_t capacity) { return new Cache(capacity); }
+
+void cache_destroy(void* h) { delete static_cast<Cache*>(h); }
+
+int64_t cache_len(void* h) { return static_cast<Cache*>(h)->count; }
+
+int64_t cache_capacity(void* h) { return static_cast<Cache*>(h)->capacity; }
+
+// Admit a batch of DEDUPLICATED signs. Two passes:
+//   pass 1: every resident sign is LRU-touched (so no member of THIS batch
+//           can be chosen as an eviction victim in pass 2 — a victim evicted
+//           and re-missed in the same batch would check stale data out of
+//           the PS while its fresh row is still riding the step's
+//           write-back output);
+//   pass 2: each miss evicts the LRU row if full, takes a row, and is
+//           recorded in miss_idx_out; evictions are reported in
+//           evict_*_out (evicted row == the reused row).
+// All output arrays sized n by the caller. Returns n_miss (or -1 if
+// n > capacity, which would force a batch member to evict another);
+// *n_evict_out is the eviction count (n_evict <= n_miss). Signs must be
+// distinct within one call (duplicates would double-admit).
+int64_t cache_admit(void* h, const uint64_t* signs, int64_t n,
+                    int64_t* rows_out, int64_t* miss_idx_out,
+                    uint64_t* evict_signs_out, int64_t* evict_rows_out,
+                    int64_t* n_evict_out) {
+  Cache& c = *static_cast<Cache*>(h);
+  *n_evict_out = 0;
+  if (n > c.capacity) return -1;
+  int64_t n_miss = 0, n_evict = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t pos = c.find_pos(signs[i]);
+    if (pos >= 0) {
+      const int64_t r = c.table_row[pos];
+      c.touch(r);
+      rows_out[i] = r;
+    } else {
+      rows_out[i] = -1;
+      miss_idx_out[n_miss++] = i;
+    }
+  }
+  for (int64_t m = 0; m < n_miss; ++m) {
+    const int64_t i = miss_idx_out[m];
+    if (c.count >= c.capacity) {
+      uint64_t ev_sign;
+      const int64_t ev_row = c.evict_lru(&ev_sign);
+      evict_signs_out[n_evict] = ev_sign;
+      evict_rows_out[n_evict] = ev_row;
+      ++n_evict;
+      c.free_rows.push_back(ev_row);
+    }
+    rows_out[i] = c.insert(signs[i]);
+  }
+  *n_evict_out = n_evict;
+  return n_miss;
+}
+
+// Read-only probe (no admit, no LRU touch): rows_out[i] = row or -1.
+void cache_probe(void* h, const uint64_t* signs, int64_t n, int64_t* rows_out) {
+  Cache& c = *static_cast<Cache*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t pos = c.find_pos(signs[i]);
+    rows_out[i] = pos >= 0 ? c.table_row[pos] : -1;
+  }
+}
+
+// Drain every resident entry (for flush-all at checkpoint/eval boundaries):
+// writes all (sign, row) pairs in LRU order (MRU first) and empties the
+// directory. Returns the number drained.
+int64_t cache_drain(void* h, uint64_t* signs_out, int64_t* rows_out) {
+  Cache& c = *static_cast<Cache*>(h);
+  int64_t k = 0;
+  for (int64_t r = c.lru_head; r >= 0; r = c.next[r]) {
+    signs_out[k] = c.row_sign[r];
+    rows_out[k] = r;
+    ++k;
+  }
+  // reset
+  std::fill(c.table_row.begin(), c.table_row.end(), (int64_t)-1);
+  std::fill(c.prev.begin(), c.prev.end(), (int64_t)-1);
+  std::fill(c.next.begin(), c.next.end(), (int64_t)-1);
+  c.lru_head = c.lru_tail = -1;
+  c.count = 0;
+  c.free_rows.clear();
+  for (int64_t r = c.capacity - 1; r >= 0; --r) c.free_rows.push_back(r);
+  return k;
+}
+
+}  // extern "C"

@@ -417,6 +417,40 @@ void ps_lookup(void* h, const uint64_t* signs, int64_t n, uint32_t dim, int trai
   }
 }
 
+// Batched full-entry checkout for the HBM cache tier
+// (persia_tpu/embedding/hbm_cache.py): like a train lookup, but copies the
+// whole [emb | optimizer state] row so the device-side sparse optimizer
+// continues from the PS's accumulated state. Misses are admitted
+// unconditionally (the cache tier owns admission; write-back re-inserts on
+// eviction either way) with the same seeded init as ps_lookup. Entries with
+// a mismatched dim are re-initialized, matching lookup. `out` is
+// (n, dim + state_dim) row-major. Returns the entry length.
+int64_t ps_checkout(void* h, const uint64_t* signs, int64_t n, uint32_t dim,
+                    float* out) {
+  Store* s = (Store*)h;
+  const uint32_t entry_len = dim + s->opt.state_dim(dim);
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t sign = signs[i];
+    Shard& sh = s->shard_of(sign);
+    std::lock_guard<std::mutex> g(sh.mu);
+    size_t pos = sh.find_pos(sign);
+    float* row = out + (size_t)i * entry_len;
+    int32_t e = (pos == SIZE_MAX) ? -1 : sh.table_slot[pos];
+    if (e >= 0 && sh.entries[e].dim == dim && sh.entries[e].len == entry_len) {
+      sh.touch(e);
+      std::memcpy(row, sh.entries[e].data, sizeof(float) * entry_len);
+    } else {
+      if (e >= 0) sh.remove_entry(e);  // dim mismatch → re-init
+      int32_t ne = sh.insert(sign, dim, entry_len);
+      float* data = sh.entries[ne].data;
+      s->init_embedding(sign, dim, data);
+      s->init_state(dim, data + dim);
+      std::memcpy(row, data, sizeof(float) * entry_len);
+    }
+  }
+  return entry_len;
+}
+
 void ps_advance_batch_state(void* h, int group) { ((Store*)h)->advance_batch_state(group); }
 
 // grads: (n, dim) row-major

@@ -78,6 +78,8 @@ def _load_lib() -> ctypes.CDLL:
     lib.ps_num_shards.restype = u32
     lib.ps_num_shards.argtypes = [p]
     lib.ps_lookup.argtypes = [p, u64p, i64, u32, i32, f32p]
+    lib.ps_checkout.restype = i64
+    lib.ps_checkout.argtypes = [p, u64p, i64, u32, f32p]
     lib.ps_advance_batch_state.argtypes = [p, i32]
     lib.ps_update_gradients.restype = i32
     lib.ps_update_gradients.argtypes = [p, u64p, i64, u32, f32p, i32]
@@ -164,6 +166,17 @@ class NativeEmbeddingStore:
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
         out = np.empty((len(signs), dim), dtype=np.float32)
         self._lib.ps_lookup(self._h, _u64p(signs), len(signs), dim, int(train), _f32p(out))
+        return out
+
+    def checkout_entries(self, signs: np.ndarray, dim: int) -> np.ndarray:
+        """Batched [emb | optimizer state] fetch for the HBM cache tier —
+        same semantics as the numpy golden model's ``checkout_entries``."""
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        entry_len = dim + (self.optimizer.state_dim(dim) if self.optimizer else 0)
+        out = np.empty((len(signs), entry_len), dtype=np.float32)
+        got = self._lib.ps_checkout(self._h, _u64p(signs), len(signs), dim, _f32p(out))
+        if got != entry_len:
+            raise RuntimeError(f"ps_checkout entry_len {got} != expected {entry_len}")
         return out
 
     def advance_batch_state(self, group: int) -> None:

@@ -186,6 +186,28 @@ class EmbeddingStore:
             self._m_miss_ratio.set(misses / len(signs))
         return out
 
+    def checkout_entries(self, signs: np.ndarray, dim: int) -> np.ndarray:
+        """Batched full-entry fetch for the HBM cache tier: ``(n, dim +
+        state_dim)`` rows of ``[emb | optimizer state]`` so the device-side
+        sparse optimizer continues from the PS's accumulated state. Misses
+        are admitted unconditionally (the cache tier owns admission — its
+        write-back re-inserts on eviction regardless) with the same seeded
+        init as ``lookup``; dim-mismatched entries re-init, matching
+        ``lookup``."""
+        entry_len = dim + self._state_dim(dim)
+        out = np.empty((len(signs), entry_len), dtype=np.float32)
+        with self._lock:
+            for i, s in enumerate(signs.tolist()):
+                shard = self._shard_of(s)
+                entry = shard.get_refresh(s)
+                if entry is not None and entry[0] == dim and len(entry[1]) == entry_len:
+                    out[i] = entry[1]
+                else:
+                    vec = self._init_entry(s, dim)
+                    shard.insert(s, dim, vec)
+                    out[i] = vec
+        return out
+
     # -------------------------------------------------------------- gradient
 
     def advance_batch_state(self, group: int) -> None:
