@@ -127,6 +127,87 @@ def bench_fused():
     return MEASURE_STEPS * BATCH_SIZE / elapsed
 
 
+def _zipf_ids(rng, n, vocab, offset, a=1.2):
+    """Rank-skewed ids (production-like): zipf ranks clipped into [0, vocab).
+    ``offset`` is a FIXED per-slot shift so each slot has its own stable hot
+    set (stable across batches — that is what a cache can exploit) while
+    slots stay decorrelated from each other."""
+    raw = rng.zipf(a, n).astype(np.uint64)
+    return (raw + np.uint64(offset)) % vocab
+
+
+def bench_cached():
+    """The capacity tier with the HBM write-back cache: vocabulary lives on
+    the host C++ PS (beyond-HBM regime, reference README.md:29), the working
+    set lives in HBM, the sparse optimizer runs on device, and the previous
+    step's eviction write-back overlaps the current step
+    (persia_tpu/embedding/hbm_cache.py)."""
+    import optax
+
+    from persia_tpu.config import EmbeddingConfig, SlotConfig
+    from persia_tpu.data import (
+        IDTypeFeatureWithSingleID,
+        Label,
+        NonIDTypeFeature,
+        PersiaBatch,
+    )
+    from persia_tpu.embedding.hbm_cache import CachedTrainCtx
+    from persia_tpu.embedding.native_store import create_store
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.embedding.worker import EmbeddingWorker
+    from persia_tpu.models import DLRM
+
+    steps = int(os.environ.get("BENCH_CACHED_STEPS", "100"))
+    cache_rows = 1 << 21  # 2M rows in HBM vs 26M-sign PS vocabulary
+    cfg = EmbeddingConfig(
+        slots_config={f"cat_{i}": SlotConfig(dim=EMB_DIM) for i in range(N_SLOTS)},
+        feature_index_prefix_bit=8,
+    )
+    store = create_store(
+        "auto", capacity=1 << 25, num_internal_shards=64,
+        optimizer=Adagrad(lr=0.05).config, seed=1,
+    )
+    worker = EmbeddingWorker(cfg, [store], num_threads=16)
+    model = DLRM(embedding_dim=EMB_DIM, bottom_mlp=(256, 64, EMB_DIM), top_mlp=(512, 256))
+    ctx = CachedTrainCtx(
+        model=model, dense_optimizer=optax.adam(1e-3),
+        embedding_optimizer=Adagrad(lr=0.05), worker=worker,
+        embedding_config=cfg, cache_rows=cache_rows,
+    ).__enter__()
+
+    rng = np.random.default_rng(0)
+    slot_offsets = rng.integers(0, VOCAB, N_SLOTS, dtype=np.uint64)
+
+    def make_batch():
+        ids = [
+            IDTypeFeatureWithSingleID(
+                f"cat_{i}", _zipf_ids(rng, BATCH_SIZE, VOCAB, slot_offsets[i])
+            )
+            for i in range(N_SLOTS)
+        ]
+        return PersiaBatch(
+            ids,
+            non_id_type_features=[
+                NonIDTypeFeature(rng.normal(size=(BATCH_SIZE, N_DENSE)).astype(np.float32))
+            ],
+            labels=[Label(rng.integers(0, 2, (BATCH_SIZE, 1)).astype(np.float32))],
+            requires_grad=True,
+        )
+
+    # distinct batches (not a short cycle): hit rate comes from the zipf
+    # skew + warm cache, not from replaying identical batches
+    warmup = max(WARMUP_STEPS, 8)
+    batches = [make_batch() for _ in range(warmup + steps)]
+
+    ctx.train_stream(batches[:warmup])
+
+    t0 = time.perf_counter()
+    m = ctx.train_stream(batches[warmup:])
+    elapsed = time.perf_counter() - t0
+    assert m is not None and np.isfinite(m["loss"])
+    return steps * BATCH_SIZE / elapsed
+
+
 def bench_hybrid():
     """The host C++ PS tier (capacity tier): pipelined bounded-staleness
     lookups/updates overlapping the device step."""
@@ -194,18 +275,29 @@ def bench_hybrid():
     return steps * BATCH_SIZE / elapsed
 
 
+_BENCHES = {"fused": bench_fused, "hybrid": bench_hybrid, "cached": bench_cached}
+
+
 def main():
-    mode = os.environ.get("BENCH_MODE", "fused")
-    if mode not in ("fused", "hybrid"):
-        raise SystemExit(f"BENCH_MODE must be 'fused' or 'hybrid', got {mode!r}")
-    samples_per_sec = bench_hybrid() if mode == "hybrid" else bench_fused()
+    mode = os.environ.get("BENCH_MODE", "all")
+    if mode not in ("all", *_BENCHES):
+        raise SystemExit(f"BENCH_MODE must be one of all/fused/hybrid/cached, got {mode!r}")
+    modes = list(_BENCHES) if mode == "all" else [mode]
+    results = {}
+    for m in modes:
+        results[m] = round(_BENCHES[m](), 1)
+    # headline = the capacity tier (PS-resident vocab ≫ HBM) when measured:
+    # that is the regime the reference exists for (100T params, README.md:29);
+    # "fused" (all-in-HBM) rides along as the in-memory ceiling
+    headline = results.get("cached", next(iter(results.values())))
     print(
         json.dumps(
             {
                 "metric": "dlrm_criteo_shape_samples_per_sec_per_chip",
-                "value": round(samples_per_sec, 1),
+                "value": headline,
                 "unit": "samples/sec",
-                "vs_baseline": round(samples_per_sec / REF_SAMPLES_PER_SEC, 4),
+                "vs_baseline": round(headline / REF_SAMPLES_PER_SEC, 4),
+                "modes": results,
             }
         )
     )

@@ -29,9 +29,13 @@ from persia_tpu.testing import SyntheticClickDataset, roc_auc
 
 VOCABS = (64, 32, 16, 100, 50, 8)
 EPOCHS = 4
-# Pinned by the deterministic pipeline (staleness=1 path, seeded init);
-# equivalent of the reference's 0.8928645493226243 CPU oracle (train.py:23).
-REPRODUCIBLE_AUC_BAR = 0.82
+# Exact-equality determinism oracle (equivalent of the reference's
+# 0.8928645493226243 CPU constant, train.py:23-24,146-150): the seeded
+# synthetic data + seeded-by-sign init + synchronous train_step reproduce
+# this AUC bit-for-bit on the CPU backend. Regenerate deliberately (run with
+# REPRODUCIBLE=1 and copy the printed value) when an intentional change
+# lands; any unintentional drift fails CI.
+REPRODUCIBLE_AUC = 0.8264691791759821
 
 
 def build_ctx():
@@ -80,8 +84,11 @@ def main() -> int:
             print(f"checkpoint written to {args.ckpt_dir}", flush=True)
 
     if os.environ.get("REPRODUCIBLE") == "1":
-        assert auc > REPRODUCIBLE_AUC_BAR, f"AUC {auc} below oracle bar"
-        print(f"REPRODUCIBLE oracle passed: {auc:.6f} > {REPRODUCIBLE_AUC_BAR}")
+        print(f"final auc: {auc!r}")
+        assert auc == REPRODUCIBLE_AUC, (
+            f"AUC {auc!r} != pinned oracle {REPRODUCIBLE_AUC!r}"
+        )
+        print(f"REPRODUCIBLE oracle passed: {auc!r}")
     return 0
 
 

@@ -451,6 +451,33 @@ int64_t ps_checkout(void* h, const uint64_t* signs, int64_t n, uint32_t dim,
   return entry_len;
 }
 
+// Warm/cold split for the HBM cache tier: rows whose sign exists
+// (dim-matched) copy their full [emb | state] entry into `out` with an LRU
+// touch and set warm_out[i]=1; cold signs are NOT admitted (the cache owns
+// them until its eviction write-back re-inserts) and leave out untouched.
+// Returns the entry length.
+int64_t ps_probe_entries(void* h, const uint64_t* signs, int64_t n, uint32_t dim,
+                         float* out, uint8_t* warm_out) {
+  Store* s = (Store*)h;
+  const uint32_t entry_len = dim + s->opt.state_dim(dim);
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t sign = signs[i];
+    Shard& sh = s->shard_of(sign);
+    std::lock_guard<std::mutex> g(sh.mu);
+    size_t pos = sh.find_pos(sign);
+    int32_t e = (pos == SIZE_MAX) ? -1 : sh.table_slot[pos];
+    if (e >= 0 && sh.entries[e].dim == dim && sh.entries[e].len == entry_len) {
+      sh.touch(e);
+      std::memcpy(out + (size_t)i * entry_len, sh.entries[e].data,
+                  sizeof(float) * entry_len);
+      warm_out[i] = 1;
+    } else {
+      warm_out[i] = 0;
+    }
+  }
+  return entry_len;
+}
+
 void ps_advance_batch_state(void* h, int group) { ((Store*)h)->advance_batch_state(group); }
 
 // grads: (n, dim) row-major

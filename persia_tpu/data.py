@@ -44,10 +44,21 @@ def _check_dtype(array: np.ndarray, who: str) -> None:
 
 class IDTypeFeature:
     """One sparse slot: a list-of-lists of u64 signs, one variable-length list
-    per sample (ref: persia/embedding/data.py:69-114)."""
+    per sample (ref: persia/embedding/data.py:69-114).
 
-    def __init__(self, name: str, data: Sequence[np.ndarray]):
+    Internally the canonical form is CSR (``flat`` ids + per-sample
+    ``counts``) because every downstream consumer — preprocessing dedup,
+    wire serialization — wants it flat; per-sample Python iteration over
+    65k-element lists was the round-1 hot-loop cost. The list-of-arrays
+    ``data`` view is materialized lazily."""
+
+    def __init__(self, name: str, data: Optional[Sequence[np.ndarray]]):
         self.name = name
+        self._flat: Optional[np.ndarray] = None
+        self._counts: Optional[np.ndarray] = None
+        if data is None:  # from_flat path fills _flat/_counts
+            self._data: Optional[List[np.ndarray]] = None
+            return
         data = list(data)
         if len(data) > MAX_BATCH_SIZE:
             raise ValueError(f"batch_size {len(data)} exceeds MAX_BATCH_SIZE {MAX_BATCH_SIZE}")
@@ -59,14 +70,55 @@ class IDTypeFeature:
                     )
                 if sample.ndim != 1:
                     raise TypeError(f"IDTypeFeature {name!r}: samples must be 1-D")
-        self.data = data
+        self._data = data
+
+    @classmethod
+    def from_flat(
+        cls, name: str, flat: np.ndarray, counts: np.ndarray
+    ) -> "IDTypeFeature":
+        """Construct directly from the CSR form (no per-sample Python lists).
+        ``flat``: all ids concatenated (u64); ``counts``: ids per sample."""
+        if flat.dtype != np.uint64 or flat.ndim != 1:
+            raise TypeError(f"IDTypeFeature {name!r}: flat must be 1-D np.uint64")
+        counts = np.ascontiguousarray(counts, dtype=np.int64)
+        if len(counts) > MAX_BATCH_SIZE:
+            raise ValueError(
+                f"batch_size {len(counts)} exceeds MAX_BATCH_SIZE {MAX_BATCH_SIZE}"
+            )
+        if int(counts.sum()) != len(flat):
+            raise ValueError(f"IDTypeFeature {name!r}: counts sum != len(flat)")
+        f = cls(name, None)
+        f._flat = np.ascontiguousarray(flat)
+        f._counts = counts
+        return f
+
+    def flat_counts(self) -> "tuple[np.ndarray, np.ndarray]":
+        """(flat ids (n,), counts (B,)) — computed once and cached."""
+        if self._flat is None:
+            data = self._data
+            self._counts = np.fromiter(
+                (len(s) for s in data), count=len(data), dtype=np.int64
+            )
+            self._flat = (
+                np.concatenate(data) if self._counts.sum() else np.empty(0, np.uint64)
+            )
+        return self._flat, self._counts
+
+    @property
+    def data(self) -> List[np.ndarray]:
+        if self._data is None:
+            if len(self._counts) == 0:
+                self._data = []
+            else:
+                self._data = np.split(self._flat, np.cumsum(self._counts[:-1]))
+        return self._data
 
     @property
     def batch_size(self) -> int:
-        return len(self.data)
+        return len(self._counts) if self._counts is not None else len(self._data)
 
     def __len__(self) -> int:
-        return len(self.data)
+        return self.batch_size
 
 
 class IDTypeFeatureWithSingleID:
@@ -88,7 +140,9 @@ class IDTypeFeatureWithSingleID:
         return len(self.data)
 
     def to_lil(self) -> IDTypeFeature:
-        return IDTypeFeature(self.name, [self.data[i : i + 1] for i in range(len(self.data))])
+        return IDTypeFeature.from_flat(
+            self.name, self.data, np.ones(len(self.data), dtype=np.int64)
+        )
 
 
 class NdarrayDataBase:
@@ -228,18 +282,17 @@ class PersiaBatch:
             name_b = f.name.encode()
             buf.write(struct.pack("<H", len(name_b)))
             buf.write(name_b)
-            offsets = np.zeros(len(f.data) + 1, dtype=np.int64)
-            for i, sample in enumerate(f.data):
-                offsets[i + 1] = offsets[i] + len(sample)
+            values, counts = f.flat_counts()
+            offsets = np.zeros(len(counts) + 1, dtype=np.int64)
+            np.cumsum(counts, out=offsets[1:])
             if offsets[-1] > 0xFFFFFFFF:
                 raise ValueError(
                     f"id feature {f.name!r}: {offsets[-1]} total ids exceeds the "
                     f"u32 wire offset limit"
                 )
-            buf.write(struct.pack("<I", len(f.data)))
+            buf.write(struct.pack("<I", len(counts)))
             buf.write(offsets.astype(np.uint32).tobytes())
-            if len(f.data):
-                values = np.concatenate(f.data) if offsets[-1] else np.empty(0, np.uint64)
+            if len(counts):
                 buf.write(values.astype(np.uint64, copy=False).tobytes())
         for x in self.non_id_type_features:
             _write_ndarray(buf, x.name, x.data)
@@ -264,8 +317,8 @@ class PersiaBatch:
             offsets = np.frombuffer(buf.read(4 * (bs + 1)), dtype=np.uint32)
             # copy once → per-sample slices are writable views of writable memory
             values = np.frombuffer(buf.read(8 * int(offsets[-1])), dtype=np.uint64).copy()
-            samples = [values[offsets[i] : offsets[i + 1]] for i in range(bs)]
-            id_feats.append(IDTypeFeature(name, samples))
+            counts = np.diff(offsets.astype(np.int64))
+            id_feats.append(IDTypeFeature.from_flat(name, values, counts))
         dense = []
         for _ in range(n_dense):
             name, arr = _read_ndarray(buf)

@@ -80,6 +80,8 @@ def _load_lib() -> ctypes.CDLL:
     lib.ps_lookup.argtypes = [p, u64p, i64, u32, i32, f32p]
     lib.ps_checkout.restype = i64
     lib.ps_checkout.argtypes = [p, u64p, i64, u32, f32p]
+    lib.ps_probe_entries.restype = i64
+    lib.ps_probe_entries.argtypes = [p, u64p, i64, u32, f32p, u8p]
     lib.ps_advance_batch_state.argtypes = [p, i32]
     lib.ps_update_gradients.restype = i32
     lib.ps_update_gradients.argtypes = [p, u64p, i64, u32, f32p, i32]
@@ -179,6 +181,21 @@ class NativeEmbeddingStore:
             raise RuntimeError(f"ps_checkout entry_len {got} != expected {entry_len}")
         return out
 
+    def probe_entries(self, signs: np.ndarray, dim: int):
+        """Warm/cold split (no admission) — see the golden model's
+        ``probe_entries``. Returns (warm (n,) bool, vals (n, entry_len))."""
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        entry_len = dim + (self.optimizer.state_dim(dim) if self.optimizer else 0)
+        vals = np.zeros((len(signs), entry_len), dtype=np.float32)
+        warm = np.zeros(len(signs), dtype=np.uint8)
+        got = self._lib.ps_probe_entries(
+            self._h, _u64p(signs), len(signs), dim, _f32p(vals),
+            warm.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        if got != entry_len:
+            raise RuntimeError(f"ps_probe_entries entry_len {got} != {entry_len}")
+        return warm.astype(bool), vals
+
     def advance_batch_state(self, group: int) -> None:
         self._lib.ps_advance_batch_state(self._h, group)
 
@@ -257,13 +274,20 @@ class NativeEmbeddingStore:
         n = self._lib.ps_dump_shard_size(self._h, shard_idx)
         if n < 0:
             raise IndexError(f"shard {shard_idx} out of range")
-        buf = np.empty(n, dtype=np.uint8)
-        written = self._lib.ps_dump_shard(
-            self._h, shard_idx, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n
-        )
-        if written < 0:
-            raise RuntimeError("dump_shard failed")
-        return buf[:written].tobytes()
+        # the size and dump calls take the shard mutex separately, so a
+        # non-blocking checkpoint racing with training can see the shard grow
+        # in between (ps_dump_shard returns -1 on overflow) — re-measure with
+        # headroom and retry; growth is bounded by the shard's LRU capacity
+        for _ in range(8):
+            buf = np.empty(max(n, 4), dtype=np.uint8)
+            written = self._lib.ps_dump_shard(
+                self._h, shard_idx,
+                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(buf),
+            )
+            if written >= 0:
+                return buf[:written].tobytes()
+            n = max(self._lib.ps_dump_shard_size(self._h, shard_idx), n * 2)
+        raise RuntimeError("dump_shard failed: shard kept growing concurrently")
 
     def load_shard_bytes(self, raw: bytes) -> int:
         buf = np.frombuffer(raw, dtype=np.uint8)

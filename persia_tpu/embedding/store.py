@@ -208,6 +208,23 @@ class EmbeddingStore:
                     out[i] = vec
         return out
 
+    def probe_entries(self, signs: np.ndarray, dim: int):
+        """Warm/cold split for the HBM cache tier: rows whose sign exists
+        (dim-matched) return their full ``[emb | state]`` entry with an LRU
+        touch; missing signs are **not** admitted — the cache owns them
+        until its eviction write-back re-inserts. Returns (warm (n,) bool,
+        vals (n, dim + state_dim) — zeros on cold rows)."""
+        entry_len = dim + self._state_dim(dim)
+        warm = np.zeros(len(signs), dtype=bool)
+        vals = np.zeros((len(signs), entry_len), dtype=np.float32)
+        with self._lock:
+            for i, s in enumerate(signs.tolist()):
+                entry = self._shard_of(s).get_refresh(s)
+                if entry is not None and entry[0] == dim and len(entry[1]) == entry_len:
+                    warm[i] = True
+                    vals[i] = entry[1]
+        return warm, vals
+
     # -------------------------------------------------------------- gradient
 
     def advance_batch_state(self, group: int) -> None:
@@ -305,11 +322,15 @@ class EmbeddingStore:
     def dump_shard(self, shard_idx: int) -> bytes:
         """Serialize one internal shard (checkpoint unit, ref:
         model-manager:242-343 dumps per internal shard)."""
-        buf = io.BytesIO()
+        # snapshot under the lock (a non-blocking checkpoint dumps from a
+        # thread while training mutates the shard — "dictionary changed size
+        # during iteration" otherwise); serialize outside it so lookups and
+        # updates aren't stalled for the whole struct/tobytes pass
         with self._lock:
-            shard = self._shards[shard_idx]
-            buf.write(struct.pack("<I", len(shard.entries)))
-        for sign, (dim, vec) in shard.entries.items():
+            items = list(self._shards[shard_idx].entries.items())
+        buf = io.BytesIO()
+        buf.write(struct.pack("<I", len(items)))
+        for sign, (dim, vec) in items:
             buf.write(struct.pack("<QII", sign, dim, len(vec)))
             buf.write(vec.tobytes())
         return buf.getvalue()

@@ -37,6 +37,14 @@ from persia_tpu.metrics import get_metrics
 from persia_tpu.monitor import EmbeddingMonitor
 
 
+class ForwardIdNotFound(RuntimeError):
+    """A forward ref that expired (``buffered_data_expired_sec``), was already
+    consumed, or never existed (typed reply, ref: "forward id not found",
+    embedding_worker_service/mod.rs:1031-1074). RPC clients can match on the
+    class name in the error string and drop/rebuild the batch instead of
+    killing the pipeline."""
+
+
 @dataclass
 class ProcessedSlot:
     """One slot after preprocessing: table keys + dedup layout."""
@@ -100,13 +108,8 @@ def preprocess_slot(
     """Dedup + prefix + hashstack for one slot (ref: mod.rs:341-484,
     lib.rs:30-83). Dedup runs on original (prefixed) signs; hashstack expands
     each *distinct* sign into ``rounds`` table keys whose rows are summed."""
-    counts = np.fromiter((len(s) for s in feature.data), count=len(feature.data), dtype=np.int64)
-    flat = (
-        np.concatenate(feature.data).astype(np.uint64)
-        if counts.sum()
-        else np.empty(0, np.uint64)
-    )
-    flat = add_index_prefix(flat, config.index_prefix, prefix_bit)
+    flat, counts = feature.flat_counts()
+    flat = add_index_prefix(flat.astype(np.uint64, copy=False), config.index_prefix, prefix_bit)
     sample_of_id = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
     native = native_worker.dedup(flat)
     if native is not None:
@@ -179,6 +182,102 @@ class ShardedLookup:
             if mask.any():
                 out[mask] = self.replicas[r].lookup(keys[mask], dim, train)
         return out
+
+    def checkout_entries(self, signs: np.ndarray, dim: int) -> np.ndarray:
+        """Sign-routed full-entry checkout for the HBM cache tier: each sign
+        reaches its owning PS replica (same partition as lookup/update);
+        returns (n, dim + state_dim) ``[emb | state]`` rows."""
+        n = len(self.replicas)
+        if n == 1:
+            return self.replicas[0].checkout_entries(signs, dim)
+        out: Optional[np.ndarray] = None
+        part = native_worker.shard_partition(signs, n)
+        if part is not None:
+            pos, counts = part
+            start = 0
+            for r in range(n):
+                c = int(counts[r])
+                if c:
+                    p = pos[start:start + c]
+                    vals = self.replicas[r].checkout_entries(signs[p], dim)
+                    if out is None:
+                        out = np.empty((len(signs), vals.shape[1]), np.float32)
+                    out[p] = vals
+                start += c
+        else:
+            shard = sign_to_shard(signs, n)
+            for r in range(n):
+                mask = shard == r
+                if mask.any():
+                    vals = self.replicas[r].checkout_entries(signs[mask], dim)
+                    if out is None:
+                        out = np.empty((len(signs), vals.shape[1]), np.float32)
+                    out[mask] = vals
+        if out is None:  # empty request
+            out = np.empty((0, dim), np.float32)
+        return out
+
+    def probe_entries(self, signs: np.ndarray, dim: int):
+        """Sign-routed warm/cold split (no admission) for the HBM cache
+        tier. Returns (warm (n,) bool, vals (n, dim + state_dim))."""
+        n = len(self.replicas)
+        if n == 1:
+            return self.replicas[0].probe_entries(signs, dim)
+        warm = np.zeros(len(signs), dtype=bool)
+        vals: Optional[np.ndarray] = None
+        part = native_worker.shard_partition(signs, n)
+        if part is not None:
+            pos, counts = part
+            start = 0
+            for r in range(n):
+                c = int(counts[r])
+                if c:
+                    p = pos[start:start + c]
+                    w, v = self.replicas[r].probe_entries(signs[p], dim)
+                    if vals is None:
+                        vals = np.zeros((len(signs), v.shape[1]), np.float32)
+                    warm[p] = w
+                    vals[p] = v
+                start += c
+        else:
+            shard = sign_to_shard(signs, n)
+            for r in range(n):
+                mask = shard == r
+                if mask.any():
+                    w, v = self.replicas[r].probe_entries(signs[mask], dim)
+                    if vals is None:
+                        vals = np.zeros((len(signs), v.shape[1]), np.float32)
+                    warm[mask] = w
+                    vals[mask] = v
+        if vals is None:
+            vals = np.zeros((0, dim), np.float32)
+        return warm, vals
+
+    def set_embedding(
+        self, signs: np.ndarray, values: np.ndarray, dim: Optional[int] = None
+    ) -> None:
+        """Sign-routed raw-entry insert (cache write-back + checkpoint
+        re-shard path, ref: set_embedding chunking, core/rpc.rs:77-106)."""
+        n = len(self.replicas)
+        if n == 1:
+            self.replicas[0].set_embedding(signs, values, dim)
+            return
+        part = native_worker.shard_partition(signs, n)
+        if part is not None:
+            pos, counts = part
+            start = 0
+            for r in range(n):
+                c = int(counts[r])
+                if c:
+                    p = pos[start:start + c]
+                    self.replicas[r].set_embedding(signs[p], values[p], dim)
+                start += c
+            return
+        shard = sign_to_shard(signs, n)
+        for r in range(n):
+            mask = shard == r
+            if mask.any():
+                self.replicas[r].set_embedding(signs[mask], values[mask], dim)
 
     def advance_batch_state(self, group: int) -> None:
         for r in self.replicas:
@@ -456,8 +555,12 @@ class EmbeddingWorker:
         """Train path: take buffered ids, lookup, stash for the gradient
         round-trip (ref: mod.rs:1031-1074)."""
         with self._buf_lock:
-            processed = self.forward_id_buffer.pop(ref)
+            processed = self.forward_id_buffer.pop(ref, None)
             self._m_pending.set(len(self.forward_id_buffer))
+        if processed is None:
+            raise ForwardIdNotFound(
+                f"forward id {ref} not found (expired or already consumed)"
+            )
         with self._m_lookup_time.time():
             out = list(
                 self._pool.map(
@@ -496,9 +599,15 @@ class EmbeddingWorker:
         per-key grads, fan out to PS replicas (ref: mod.rs:1109-1129,703-872).
         Returns per-slot skip info for metrics."""
         with self._buf_lock:
-            processed = self.post_forward_buffer.pop(ref)
-            self.staleness = max(0, self.staleness - 1)
-            self._m_staleness.set(self.staleness)
+            processed = self.post_forward_buffer.pop(ref, None)
+            if processed is not None:
+                self.staleness = max(0, self.staleness - 1)
+                self._m_staleness.set(self.staleness)
+        if processed is None:
+            raise ForwardIdNotFound(
+                f"forward id {ref} not found in post-forward buffer "
+                "(already updated, aborted, or never forwarded)"
+            )
         skipped = {}
 
         def one_slot(slot):

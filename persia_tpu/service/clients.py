@@ -38,6 +38,31 @@ class StoreClient:
         )
         return np.frombuffer(raw, dtype=np.float32).reshape(len(signs), dim).copy()
 
+    def checkout_entries(self, signs: np.ndarray, dim: int) -> np.ndarray:
+        """Full [emb | state] rows for the HBM cache tier. Misses are admitted
+        with seeded init (retry-safe: re-running converges to the same rows)."""
+        raw = self._rpc.call(
+            "checkout_entries",
+            proto.pack_lookup_request(signs, dim, True),
+            idempotent=True,
+        )
+        n = max(len(signs), 1)
+        width = len(raw) // (4 * n) if len(signs) else dim
+        return np.frombuffer(raw, dtype=np.float32).reshape(len(signs), width).copy()
+
+    def probe_entries(self, signs: np.ndarray, dim: int):
+        """Warm/cold split (no admission) for the HBM cache tier."""
+        raw = self._rpc.call(
+            "probe_entries",
+            proto.pack_lookup_request(signs, dim, True),
+            idempotent=True,
+        )
+        n = len(signs)
+        warm = np.frombuffer(raw[:n], dtype=np.uint8).astype(bool)
+        vals = np.frombuffer(raw[n:], dtype=np.float32)
+        width = vals.size // n if n else dim
+        return warm, vals.reshape(n, width).copy()
+
     def update_gradients(self, signs: np.ndarray, grads: np.ndarray, group: int = 0) -> None:
         self._rpc.call("update_gradients", proto.pack_update_request(signs, grads, group))
 

@@ -44,6 +44,8 @@ class ParameterServerService:
         self.server = RpcServer(port=port)
         s = self.server
         s.register("lookup", self._lookup)
+        s.register("checkout_entries", self._checkout)
+        s.register("probe_entries", self._probe_entries)
         s.register("update_gradients", self._update)
         s.register("advance_batch_state", self._advance)
         s.register("register_optimizer", self._register_optimizer)
@@ -68,6 +70,15 @@ class ParameterServerService:
     def _lookup(self, payload: bytes) -> bytes:
         signs, dim, train = proto.unpack_lookup_request(payload)
         return self.store.lookup(signs, dim, train).tobytes()
+
+    def _checkout(self, payload: bytes) -> bytes:
+        signs, dim, _ = proto.unpack_lookup_request(payload)
+        return self.store.checkout_entries(signs, dim).tobytes()
+
+    def _probe_entries(self, payload: bytes) -> bytes:
+        signs, dim, _ = proto.unpack_lookup_request(payload)
+        warm, vals = self.store.probe_entries(signs, dim)
+        return warm.astype(np.uint8).tobytes() + vals.tobytes()
 
     def _update(self, payload: bytes) -> bytes:
         signs, grads, group = proto.unpack_update_request(payload)

@@ -78,3 +78,14 @@ def test_empty_id_lists_roundtrip():
     back = PersiaBatch.from_bytes(batch.to_bytes())
     assert back.id_type_features[0].batch_size == 2
     assert all(len(s) == 0 for s in back.id_type_features[0].data)
+
+
+def test_id_feature_zero_samples_roundtrip():
+    """A zero-sample feature's lazy .data must be [] (np.split would give a
+    phantom sample), and the CSR fast paths must round-trip it."""
+    from persia_tpu.data import IDTypeFeature
+
+    f = IDTypeFeature.from_flat("empty", np.empty(0, np.uint64), np.empty(0, np.int64))
+    assert f.batch_size == 0 and f.data == []
+    flat, counts = f.flat_counts()
+    assert len(flat) == 0 and len(counts) == 0

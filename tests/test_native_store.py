@@ -180,3 +180,37 @@ def test_update_before_optimizer_registration_errors():
     cc = NativeEmbeddingStore(capacity=64, num_internal_shards=1)
     with pytest.raises(RuntimeError):
         cc.update_gradients(np.array([1], np.uint64), np.ones((1, 4), np.float32))
+
+
+def test_native_dump_while_training_no_race():
+    """The size→dump native-call pair must tolerate the shard growing in
+    between (non-blocking checkpoint racing with training admits)."""
+    import threading
+
+    s = native.NativeEmbeddingStore(
+        capacity=1 << 16, num_internal_shards=2, optimizer=SGD(lr=0.1).config, seed=5
+    )
+    s.lookup(np.arange(2000, dtype=np.uint64), 4, train=True)
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        rng = np.random.default_rng(0)
+        while not stop.is_set():
+            signs = rng.integers(0, 1 << 20, 512, dtype=np.uint64)
+            try:
+                s.lookup(signs, 4, train=True)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    t = threading.Thread(target=churn)
+    t.start()
+    try:
+        for _ in range(50):
+            for i in range(s.num_internal_shards):
+                assert len(s.dump_shard(i)) >= 4
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not errors, f"training thread crashed during dump: {errors[0]!r}"

@@ -164,3 +164,39 @@ def test_dump_load_roundtrip_and_reshard():
     total = sum(s2.load_shard_bytes(b) for b in blobs)
     assert total == 100
     np.testing.assert_array_equal(s2.lookup(signs, 4, train=False), w)
+
+
+def test_dump_while_training_no_race():
+    """Non-blocking checkpoint dumps a shard while training mutates it
+    (the ps_server blocking=False path): serialization must snapshot under
+    the store lock or iteration explodes mid-dump."""
+    import threading
+
+    s = EmbeddingStore(capacity=1 << 16, num_internal_shards=2,
+                       optimizer=SGD(lr=0.1).config, seed=5)
+    s.lookup(np.arange(5000, dtype=np.uint64), 4, train=True)
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        rng = np.random.default_rng(0)
+        while not stop.is_set():
+            signs = rng.integers(0, 1 << 20, 512, dtype=np.uint64)
+            try:
+                s.lookup(signs, 4, train=True)
+                s.update_gradients(signs, np.ones((512, 4), np.float32))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    t = threading.Thread(target=churn)
+    t.start()
+    try:
+        for _ in range(30):
+            for i in range(s.num_internal_shards):
+                blob = s.dump_shard(i)
+                assert len(blob) >= 4
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not errors, f"training thread crashed during dump: {errors[0]!r}"

@@ -216,3 +216,30 @@ def test_scale_factor_division():
     worker.update_gradient_batched(ref, {"pooled": g}, scale_factor=8.0)
     after = stores[0].lookup(np.array([1], dtype=np.uint64), 4, False)[0]
     np.testing.assert_allclose(after, before - 0.5 * 1.0, rtol=1e-5)
+
+
+def test_forward_id_not_found_is_typed():
+    """Expired/duplicate refs raise the typed ForwardIdNotFound, not a bare
+    KeyError that kills the lookup worker (ref: 'forward id not found',
+    embedding_worker_service/mod.rs:1031-1074)."""
+    from persia_tpu.embedding.worker import ForwardIdNotFound
+
+    cfg = _cfg()
+    worker = EmbeddingWorker(cfg, _stores())
+    batch = PersiaBatch(
+        [_ids("pooled", [[1]]), _ids("seq", [[5]])],
+        labels=[Label(np.zeros((1, 1), dtype=np.float32))],
+        requires_grad=True,
+    )
+    with pytest.raises(ForwardIdNotFound):
+        worker.forward_batch_id(12345)
+    ref = worker.put_forward_ids(batch)
+    worker.forward_batch_id(ref)
+    with pytest.raises(ForwardIdNotFound):
+        worker.forward_batch_id(ref)  # duplicate fetch: buffer entry consumed
+    g = {"pooled": np.zeros((1, 4), np.float32)}
+    worker.update_gradient_batched(ref, g)
+    assert worker.staleness == 0
+    with pytest.raises(ForwardIdNotFound):
+        worker.update_gradient_batched(ref, g)  # duplicate update
+    assert worker.staleness == 0  # failed pop must not corrupt the gauge
