@@ -351,17 +351,31 @@ def _read_rows_payload(table, state: Dict[str, jnp.ndarray], ev_rows):
 from functools import partial as _partial
 
 
+def _scatter_entry_block(table, state: Dict[str, jnp.ndarray], rows, entries):
+    """Shared body: scatter ``[emb | state]`` rows into the cache pools
+    (out-of-range pad rows drop)."""
+    dim = table.shape[1]
+    table = table.at[rows].set(entries[:, :dim].astype(table.dtype), mode="drop")
+    out_state = dict(state)
+    cols = _entry_to_state_cols(out_state, entries[:, dim:])
+    for key, vals in cols.items():
+        out_state[key] = out_state[key].at[rows].set(vals, mode="drop")
+    return table, out_state
+
+
 @_partial(jax.jit, donate_argnums=(0, 1))
 def _scatter_entries(table, state: Dict[str, jnp.ndarray], m_rows, m_entries):
     """Scatter checked-out PS entries into the cache pools (pad rows drop)."""
-    dim = table.shape[1]
-    emb = m_entries[:, :dim].astype(table.dtype)
-    table = table.at[m_rows].set(emb, mode="drop")
-    out_state = dict(state)
-    cols = _entry_to_state_cols(out_state, m_entries[:, dim:])
-    for key, vals in cols.items():
-        out_state[key] = out_state[key].at[m_rows].set(vals, mode="drop")
-    return table, out_state
+    return _scatter_entry_block(table, state, m_rows, m_entries)
+
+
+@_partial(jax.jit, donate_argnums=(0, 1))
+def _restore_rows(table, state: Dict[str, jnp.ndarray], payload, src_idx, dst_rows):
+    """Re-admit rows whose write-back is still in flight straight from the
+    DEVICE-resident eviction payload (device→host transfers on a
+    remote-attached chip cost ~60 ms latency each — the hazard path must
+    never wait on one)."""
+    return _scatter_entry_block(table, state, dst_rows, payload[src_idx])
 
 
 @_partial(jax.jit, donate_argnums=(0, 1), static_argnums=(4,))
@@ -717,18 +731,21 @@ class CachedEmbeddingTier:
     ):
         """Admit the batch's distinct signs, check misses out of the PS, and
         build the device step inputs. Returns (device_inputs, layout,
-        miss_aux, evict_aux, evict_meta) where evict_meta = {group:
-        (evict_signs, true_K)} for the write-back after the step.
+        miss_aux, cold_aux, restore_aux, evict_aux, evict_meta) where
+        miss_aux/cold_aux hold warm/cold miss scatters, restore_aux holds
+        device-side re-admissions resolved by the hazard gate, and
+        evict_meta = {group: (evict_signs, true_K)} describes the write-back
+        due after the step.
 
         ``hazard_gate(group_name, miss_signs)``: called before each group's
         PS probe. When a pipelined caller has eviction write-backs still in
         flight, a fresh miss on one of those signs would read stale data
-        from the PS. The gate returns ``(idx, entries)`` — positions into
-        ``miss_signs`` and their full ``[emb | state]`` rows — for every
-        overlapping sign (sourced from the pending write-back payload, or
-        after blocking until it materializes); those signs are treated as
-        warm with the returned values instead of the PS's. ``None`` means no
-        overlap."""
+        from the PS. The gate returns a list of ``(payload, src_idx,
+        positions)`` restore descriptors — ``payload`` a DEVICE-resident
+        eviction payload array, ``src_idx`` rows within it, ``positions``
+        the resolved indices into ``miss_signs`` — and those signs are
+        re-admitted by an on-device row restore instead of a host checkout.
+        ``None`` means no overlap."""
         pb = preprocess_batch(batch.id_type_features, self.cfg)
         slots_by_group = self._group_slots(pb)
 
@@ -738,6 +755,7 @@ class CachedEmbeddingTier:
         raw_rows: Dict[str, np.ndarray] = {}
         miss_aux: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
         cold_aux: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        restore_aux: Dict[str, List] = {}
         evict_aux: Dict[str, np.ndarray] = {}
         evict_meta: Dict[str, Tuple[np.ndarray, int]] = {}
         any_scale = False
@@ -768,14 +786,25 @@ class CachedEmbeddingTier:
             if m:
                 from persia_tpu.embedding.hashing import uniform_init_for_signs
 
-                warm, vals = self._probe(miss_signs, g.dim)
-                if resolved is not None:
-                    r_idx, r_entries = resolved
-                    warm[r_idx] = True
-                    vals[r_idx] = r_entries
                 rows_miss = rows_u[miss_idx]
-                widx = np.nonzero(warm)[0]
-                cidx = np.nonzero(~warm)[0]
+                handled = np.zeros(m, dtype=bool)
+                if resolved:
+                    for payload, src_idx, pos in resolved:
+                        handled[pos] = True
+                        # pow2-bucketed; src pad reads row 0 harmlessly, dst
+                        # pad C+1 is dropped by the scatter
+                        S = len(pos)
+                        sp = _round_up_pow2(S)
+                        src = np.zeros(sp, dtype=np.int64)
+                        dst = np.full(sp, C + 1, dtype=np.int32)
+                        src[:S] = src_idx
+                        dst[:S] = rows_miss[pos]
+                        restore_aux.setdefault(g.name, []).append(
+                            (payload, src, dst)
+                        )
+                warm, vals = self._probe(miss_signs, g.dim)
+                widx = np.nonzero(warm & ~handled)[0]
+                cidx = np.nonzero(~warm & ~handled)[0]
                 if len(widx):
                     entry_len = g.dim + g.state_dim
                     wp = _bucket(len(widx))
@@ -841,7 +870,10 @@ class CachedEmbeddingTier:
         if any_scale:
             device_inputs["stacked_scale"] = stacked_scale
         layout = CacheLayout(stacked=tuple(layout_stacked))
-        return device_inputs, layout, miss_aux, cold_aux, evict_aux, evict_meta
+        return (
+            device_inputs, layout, miss_aux, cold_aux, restore_aux,
+            evict_aux, evict_meta,
+        )
 
     # ------------------------------------------------------------- eval path
 
@@ -1073,16 +1105,19 @@ class CachedTrainCtx:
             self._land_pending()  # after landing, the PS probe sees them warm
         return None
 
-    def _dispatch(self, device_inputs, layout, miss_aux, cold_aux, evict_aux):
+    def _dispatch(
+        self, device_inputs, layout, miss_aux, cold_aux, restore_aux, evict_aux
+    ):
         """Dispatch the per-step device programs in order: evict read →
-        warm/cold scatters → main step. Inputs must already be device arrays."""
+        warm/cold scatters + in-flight restores → main step. Inputs must
+        already be device arrays."""
         evict_payload = {
             gname: _read_rows_payload(
                 self.state.tables[gname], self.state.emb_state[gname], e_rows
             )
             for gname, e_rows in evict_aux.items()
         }
-        if miss_aux or cold_aux:
+        if miss_aux or cold_aux or restore_aux:
             tables = dict(self.state.tables)
             emb_state = dict(self.state.emb_state)
             for gname, (m_rows, m_entries) in miss_aux.items():
@@ -1094,12 +1129,18 @@ class CachedTrainCtx:
                     tables[gname], emb_state[gname], c_rows, c_emb,
                     self._state_consts,
                 )
+            for gname, restores in restore_aux.items():
+                for payload, src_idx, dst_rows in restores:
+                    tables[gname], emb_state[gname] = _restore_rows(
+                        tables[gname], emb_state[gname], payload,
+                        src_idx, dst_rows,
+                    )
             self.state = self.state.replace(tables=tables, emb_state=emb_state)
         self.state, header = self._step(self.state, device_inputs, layout)
         return header, evict_payload
 
     def train_step(self, batch: PersiaBatch, fetch_metrics: bool = True):
-        (device_inputs, layout, miss_aux, cold_aux, evict_aux,
+        (device_inputs, layout, miss_aux, cold_aux, restore_aux, evict_aux,
          evict_meta) = self.tier.prepare_batch(
             batch, hazard_gate=self._sync_hazard_gate
         )
@@ -1113,7 +1154,7 @@ class CachedTrainCtx:
         cold_aux = jax.device_put(cold_aux)
         evict_aux = jax.device_put(evict_aux)
         header, evict_payload = self._dispatch(
-            device_inputs, layout, miss_aux, cold_aux, evict_aux
+            device_inputs, layout, miss_aux, cold_aux, restore_aux, evict_aux
         )
         prev = self._pending
         self._pending = (
@@ -1170,6 +1211,7 @@ class CachedTrainCtx:
         batches,
         prefetch: int = 3,
         on_metrics: Optional[Callable[[Dict], None]] = None,
+        wb_flush_steps: int = 8,
     ) -> Optional[Dict]:
         """Fully-pipelined training over an iterable of ``PersiaBatch``.
 
@@ -1195,46 +1237,60 @@ class CachedTrainCtx:
 
         self._land_pending()  # do not mix with a sync-path deferred step
         # pending eviction write-backs, seq → per-group record:
-        #   {"signs": {g: u64 (K,)}, "by_sign": None | {g: {sign: row}}}
-        # "by_sign" is None until the write-back thread materializes the
-        # payload; the record is deleted once the PS write lands.
+        #   {"sorted": {g: sorted u64 signs}, "order": {g: payload row of
+        #    each sorted sign}, "payload": None | {g: DEVICE (Kp, entry_len)}}
+        # "payload" is filled by the main thread at dispatch; the record is
+        # deleted once the batched write-back lands it in the PS.
         pending: Dict[int, Dict] = {}
         cv = threading.Condition()
         stop = threading.Event()
         staged_q: "_queue.Queue" = _queue.Queue(maxsize=prefetch)
-        wb_q: "_queue.Queue" = _queue.Queue(maxsize=prefetch + 1)
+        # bounds device-memory retention: at most ~(queue + one flush batch)
+        # steps of eviction payloads stay pinned in HBM while the PS lags
+        wb_q: "_queue.Queue" = _queue.Queue(maxsize=max(1, wb_flush_steps) + prefetch)
         SENTINEL = object()
         errors: List[BaseException] = []
 
         def gate(gname: str, miss_signs: np.ndarray):
-            """Resolve re-missed pending-evicted signs from the in-flight
-            write-back payloads (blocking only until the payload
-            materializes — never for the PS write itself)."""
-            out: Dict[int, np.ndarray] = {}
+            """Resolve re-missed pending-evicted signs against the in-flight
+            DEVICE payloads: returns restore descriptors, never waits for a
+            device→host transfer (only, rarely, for the main thread to
+            dispatch the step that produces a just-evicted payload)."""
+            out = []
             with cv:
                 while not (stop.is_set() or errors):
+                    out.clear()
                     waiting = False
+                    picks: Dict[int, Tuple[int, int]] = {}  # pos → (seq, src)
                     for seq in sorted(pending):  # later steps override earlier
                         rec = pending[seq]
-                        signs_g = rec["signs"].get(gname)
-                        if signs_g is None:
+                        sg = rec["sorted"].get(gname)
+                        if sg is None:
                             continue
-                        mask = np.isin(miss_signs, signs_g)
+                        loc = np.searchsorted(sg, miss_signs)
+                        loc_c = np.minimum(loc, len(sg) - 1)
+                        mask = sg[loc_c] == miss_signs
                         if not mask.any():
                             continue
-                        if rec["by_sign"] is None:
-                            waiting = True  # payload not yet host-side
+                        if rec["payload"] is None:
+                            waiting = True  # step not yet dispatched
                             continue
-                        by = rec["by_sign"][gname]
+                        order = rec["order"][gname]
                         for i in np.nonzero(mask)[0].tolist():
-                            out[i] = by[int(miss_signs[i])]
+                            picks[i] = (seq, int(order[loc_c[i]]))
                     if not waiting:
+                        by_seq: Dict[int, List] = {}
+                        for i, (seq, j) in picks.items():
+                            by_seq.setdefault(seq, []).append((i, j))
+                        for seq, ij in by_seq.items():
+                            pos = np.array([i for i, _ in ij], dtype=np.int64)
+                            src = np.array([j for _, j in ij], dtype=np.int64)
+                            out.append(
+                                (pending[seq]["payload"][gname], src, pos)
+                            )
                         break
                     cv.wait(timeout=1.0)
-            if not out:
-                return None
-            idx = np.fromiter(out.keys(), dtype=np.int64, count=len(out))
-            return idx, np.stack([out[int(i)] for i in idx])
+            return out or None
 
         prep_q: "_queue.Queue" = _queue.Queue(maxsize=prefetch)
 
@@ -1255,19 +1311,18 @@ class CachedTrainCtx:
                     if stop.is_set() or errors:
                         break
                     item = self.tier.prepare_batch(batch, hazard_gate=gate)
-                    evict_meta = item[5]
+                    evict_meta = item[6]
                     # evicted signs become hazard-gated HERE (admit time): a
                     # later batch's probe must not trust the PS for them
-                    # until the write-back thread lands their payload
+                    # until the write-back lands their payload
                     if evict_meta:
+                        rec = {"sorted": {}, "order": {}, "payload": None}
+                        for gn, (ev, k) in evict_meta.items():
+                            order = np.argsort(ev[:k])
+                            rec["sorted"][gn] = ev[:k][order]
+                            rec["order"][gn] = order
                         with cv:
-                            pending[seq] = {
-                                "signs": {
-                                    gn: ev[:k]
-                                    for gn, (ev, k) in evict_meta.items()
-                                },
-                                "by_sign": None,
-                            }
+                            pending[seq] = rec
                     if not _put(prep_q, (seq, item)):
                         return
                     seq += 1
@@ -1287,14 +1342,17 @@ class CachedTrainCtx:
                     if got is SENTINEL:
                         break
                     seq, item = got
-                    di, layout, miss_aux, cold_aux, evict_aux, evict_meta = item
+                    (di, layout, miss_aux, cold_aux, restore_aux, evict_aux,
+                     evict_meta) = item
                     di = jax.device_put(di)
                     miss_aux = jax.device_put(miss_aux)
                     cold_aux = jax.device_put(cold_aux)
+                    restore_aux = jax.device_put(restore_aux)
                     evict_aux = jax.device_put(evict_aux)
                     if not _put(
                         staged_q,
-                        (seq, di, layout, miss_aux, cold_aux, evict_aux, evict_meta),
+                        (seq, di, layout, miss_aux, cold_aux, restore_aux,
+                         evict_aux, evict_meta),
                     ):
                         return
             except BaseException as e:  # noqa: BLE001
@@ -1304,41 +1362,54 @@ class CachedTrainCtx:
             finally:
                 staged_q.put(SENTINEL)  # main's shutdown drain guarantees room
 
+        # device→host transfers cost ~60 ms latency each regardless of size,
+        # so the write-back batches many steps' payloads and fetches them
+        # CONCURRENTLY (parallel transfers share the latency), then persists
+        # to the PS. The gate never needs host data (device-side restore).
+        FLUSH_STEPS = max(1, wb_flush_steps)
+
+        def _flush_acc(acc) -> None:
+            if not acc:
+                return
+            pool = getattr(self.tier.worker, "_pool", None)
+            fetches = []  # (seq, gname, k, device payload)
+            for seq, evict_meta, evict_payload in acc:
+                for gn, (ev, k) in evict_meta.items():
+                    fetches.append((seq, gn, ev, k, evict_payload[gn]))
+
+            def fetch(f):
+                return np.asarray(f[4], dtype=np.float32)
+
+            hosts = list(pool.map(fetch, fetches)) if pool else [fetch(f) for f in fetches]
+            for (seq, gn, ev, k, _p), host in zip(fetches, hosts):
+                g = next(gr for gr in self.tier.groups if gr.name == gn)
+                self.tier._set_embedding(ev[:k], host[:k], dim=g.dim)
+            with cv:
+                for seq, _m, _p in acc:
+                    pending.pop(seq, None)
+                cv.notify_all()
+            acc.clear()
+
         def writeback():
+            acc: List = []
             while True:
                 item = wb_q.get()
-                if item is SENTINEL:
-                    return
-                seq, evict_meta, evict_payload = item
                 try:
-                    # phase 1: materialize the payload (device→host) and
-                    # publish it so the feeder's gate can resolve re-misses
-                    # without waiting for the PS write
-                    host = {
-                        gn: np.asarray(p, dtype=np.float32)
-                        for gn, p in evict_payload.items()
-                    }
-                    by_sign = {
-                        gn: {
-                            int(s): host[gn][i]
-                            for i, s in enumerate(ev[:k].tolist())
-                        }
-                        for gn, (ev, k) in evict_meta.items()
-                    }
-                    with cv:
-                        if seq in pending:
-                            pending[seq]["by_sign"] = by_sign
-                        cv.notify_all()
-                    # phase 2: persist to the PS
-                    for gn, (ev, k) in evict_meta.items():
-                        g = next(gr for gr in self.tier.groups if gr.name == gn)
-                        self.tier._set_embedding(ev[:k], host[gn][:k], dim=g.dim)
+                    if item is SENTINEL:
+                        _flush_acc(acc)
+                        return
+                    acc.append(item)
+                    if len(acc) >= FLUSH_STEPS:
+                        _flush_acc(acc)
                 except BaseException as e:  # noqa: BLE001
                     errors.append(e)
-                finally:
                     with cv:
-                        pending.pop(seq, None)
+                        for seq, _m, _p in acc:
+                            pending.pop(seq, None)
+                        acc.clear()
                         cv.notify_all()
+                    if item is SENTINEL:
+                        return
 
         feeder_t = threading.Thread(target=feeder_prep, daemon=True, name="cache-feeder")
         dp_t = threading.Thread(target=feeder_dp, daemon=True, name="cache-stager")
@@ -1355,14 +1426,21 @@ class CachedTrainCtx:
                     break
                 if errors:
                     break
-                seq, di, layout, miss_aux, cold_aux, evict_aux, evict_meta = item
+                (seq, di, layout, miss_aux, cold_aux, restore_aux, evict_aux,
+                 evict_meta) = item
                 if self.state is None:
                     self.init_state(jax.random.PRNGKey(0), di, layout)
                 header, evict_payload = self._dispatch(
-                    di, layout, miss_aux, cold_aux, evict_aux
+                    di, layout, miss_aux, cold_aux, restore_aux, evict_aux
                 )
                 label_shape = di["labels"][0].shape
                 if evict_meta:
+                    # publish the DEVICE payload so the feeder's gate can
+                    # build restores for re-missed signs without any d2h
+                    with cv:
+                        if seq in pending:
+                            pending[seq]["payload"] = evict_payload
+                        cv.notify_all()
                     wb_q.put((seq, evict_meta, evict_payload))
                 if self.sparse_cfg.kind == OPTIMIZER_ADAM:
                     # mirror the device's beta-power advance on the PS every
