@@ -36,7 +36,9 @@ from persia_tpu.parallel.train_step import (
     init_train_state,
     replicate_state,
     shard_device_batch,
+    unpack_step_grads,
     unpack_step_header,
+    unpack_step_header_dynamic,
     unpack_step_output,
 )
 
@@ -200,14 +202,29 @@ class TrainCtx(EmbeddingCtx):
         grad_scale: float = 1.0,
         loss_fn=None,
         wire_dtype: Optional[str] = None,
+        dynamic_loss_scale: bool = False,
+        loss_scale_init: float = float(2 ** 15),
+        loss_scale_growth_interval: int = 2000,
+        loss_scale_max: float = float(2 ** 24),
     ):
         super().__init__(worker, embedding_config, mesh=mesh, wire_dtype=wire_dtype)
         self.model = model
         self.dense_optimizer = dense_optimizer
         self.embedding_optimizer = embedding_optimizer
         self.grad_scale = grad_scale
+        # dynamic mixed-precision loss scaling (ref: GradScaler management,
+        # persia/ctx.py:926-1005): on-device finite check every step,
+        # skip-step + scale backoff on overflow, periodic growth
+        self.dynamic_loss_scale = dynamic_loss_scale
+        self._loss_scale_init = loss_scale_init if dynamic_loss_scale else None
         kwargs = {} if loss_fn is None else {"loss_fn": loss_fn}
-        self._train_step_jit = build_train_step(model, dense_optimizer, **kwargs)
+        self._train_step_jit = build_train_step(
+            model, dense_optimizer,
+            dynamic_loss_scale=dynamic_loss_scale,
+            growth_interval=loss_scale_growth_interval,
+            max_scale=loss_scale_max,
+            **kwargs,
+        )
         self._eval_step = build_eval_step(model)
         self.state: Optional[TrainState] = None
 
@@ -215,10 +232,19 @@ class TrainCtx(EmbeddingCtx):
         """Run the jitted step and unpack its single-transfer output into the
         (state, metrics, emb_grads) host view."""
         state, (header, gpacked) = self._train_step_jit(state, device_batch)
-        loss, preds, emb_grads = unpack_step_output(
-            np.asarray(header), np.asarray(gpacked), device_batch
-        )
-        return state, {"loss": loss, "preds": preds}, emb_grads
+        if self.dynamic_loss_scale:
+            loss, preds, scale, finite = unpack_step_header_dynamic(
+                np.asarray(header), device_batch
+            )
+            emb_grads = unpack_step_grads(np.asarray(gpacked), device_batch)
+            metrics = {"loss": loss, "preds": preds,
+                       "loss_scale": scale, "grads_finite": finite}
+        else:
+            loss, preds, emb_grads = unpack_step_output(
+                np.asarray(header), np.asarray(gpacked), device_batch
+            )
+            metrics = {"loss": loss, "preds": preds}
+        return state, metrics, emb_grads
 
     def __enter__(self):
         # register the sparse optimizer on every PS replica
@@ -227,7 +253,10 @@ class TrainCtx(EmbeddingCtx):
         return self
 
     def init_state(self, rng, sample_batch: Dict) -> TrainState:
-        state = init_train_state(self.model, rng, sample_batch, self.dense_optimizer)
+        state = init_train_state(
+            self.model, rng, sample_batch, self.dense_optimizer,
+            loss_scale_init=self._loss_scale_init,
+        )
         if self.mesh is not None:
             state = replicate_state(state, self.mesh)
         self.state = state
@@ -248,11 +277,18 @@ class TrainCtx(EmbeddingCtx):
             # release the staleness slot + stashed layout (no silent buffer leak)
             self.worker.abort_gradient(ref)
             raise
-        self.worker.update_gradient_batched(ref, slot_grads, scale_factor=self.grad_scale)
-        return {
+        # emb grads ship scaled; the worker's scale_factor division unscales
+        # (non-finite slots are NaN-skipped there, mod.rs:716-744)
+        scale = metrics.get("loss_scale", self.grad_scale)
+        self.worker.update_gradient_batched(ref, slot_grads, scale_factor=scale)
+        out = {
             "loss": float(metrics["loss"]),
             "preds": np.asarray(metrics["preds"]),
         }
+        for k in ("loss_scale", "grads_finite"):
+            if k in metrics:
+                out[k] = metrics[k]
+        return out
 
     def train_step_prepared(self, training_batch, loader) -> Dict:
         """Pipelined step: consume a ``PersiaTrainingBatch`` from a
@@ -272,12 +308,22 @@ class TrainCtx(EmbeddingCtx):
                 gpacked.copy_to_host_async()
             except AttributeError:
                 pass
-            loss, preds = unpack_step_header(np.asarray(header), device_batch)
+            if self.dynamic_loss_scale:
+                loss, preds, scale, finite = unpack_step_header_dynamic(
+                    np.asarray(header), device_batch
+                )
+            else:
+                loss, preds = unpack_step_header(np.asarray(header), device_batch)
+                scale, finite = self.grad_scale, None
         except Exception:
             loader.mark_consumed(training_batch)
             raise
-        loader.backward_packed(training_batch, gpacked, scale_factor=self.grad_scale)
-        return {"loss": loss, "preds": np.asarray(preds)}
+        loader.backward_packed(training_batch, gpacked, scale_factor=scale)
+        out = {"loss": loss, "preds": np.asarray(preds)}
+        if finite is not None:
+            out["loss_scale"] = scale
+            out["grads_finite"] = finite
+        return out
 
     def eval_batch(self, batch: PersiaBatch) -> np.ndarray:
         emb_batches = self.worker.forward_directly(batch, train=False)

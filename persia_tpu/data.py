@@ -252,6 +252,11 @@ class PersiaBatch:
         self.requires_grad = requires_grad
         self.batch_id = batch_id
         self.meta = meta
+        # set by the dataflow tier when the id features were already buffered
+        # at an embedding worker: (worker_index, forward ref) — the trainer's
+        # lookup uses the ref instead of re-sending ids (ref:
+        # IDTypeFeatureRemoteRef, persia-common/src/lib.rs:115-155)
+        self.remote_ref: Optional[Tuple[int, int]] = None
 
     @property
     def batch_size(self) -> int:

@@ -49,11 +49,41 @@ class PersiaTrainingBatch:
     device_batch: Dict
     counts: List
     batch_id: Optional[int] = None
+    worker_idx: int = 0  # which embedding worker holds the ref (dataflow)
+    ticket: Optional[int] = None  # reorder emit sequence (reproducible mode)
 
 
 class _WorkerError:
     def __init__(self, exc: BaseException):
         self.exc = exc
+
+
+def wait_for_serving(worker, timeout_s: float = 60.0) -> None:
+    """Block until the embedding worker (and, for an in-process worker, its
+    PS replicas) answer readiness probes again (ref: forward workers block
+    on wait_for_serving after an RPC error, forward.rs:708-716,748-761)."""
+    if hasattr(worker, "wait_serving"):  # remote worker: probes its PS tier
+        worker.wait_serving(timeout_s=timeout_s)
+        return
+    targets = []
+    if hasattr(worker, "wait_ready"):
+        targets.append(worker)
+    for r in getattr(getattr(worker, "lookup_router", None), "replicas", []):
+        if hasattr(r, "wait_ready"):
+            targets.append(r)
+    for t in targets:
+        t.wait_ready(timeout_s=timeout_s)
+
+
+def _is_rpc_error(e: BaseException) -> bool:
+    """TRANSPORT failures only — direct, or relayed by a server whose own
+    downstream died (the "unavailable:" marker). An ``RpcError`` carrying a
+    plain "remote error:" is an application error — retrying/dropping those
+    would silently mask real bugs (they stay fatal; the typed
+    ``ForwardIdNotFound`` has its own handling at the call sites)."""
+    from persia_tpu.service.rpc import _is_transportish
+
+    return _is_transportish(e)
 
 
 class BackwardEngine:
@@ -62,7 +92,15 @@ class BackwardEngine:
     ``push`` enqueues (ref, slot_grads); worker threads apply
     ``worker.update_gradient_batched`` and release the staleness permit.
     ``flush`` blocks until every pushed gradient has been applied (used at
-    eval/checkpoint boundaries)."""
+    eval/checkpoint boundaries).
+
+    Failure policy (ref: the reference's backward tasks log RPC errors and
+    keep the pipeline alive — bounded-async tolerates a dropped gradient
+    batch): transport errors wait for the servers to report ready, then
+    retry ONCE; a ``ForwardIdNotFound`` reply on the retry means the first
+    attempt actually applied (the buffer entry was consumed) and counts as
+    success. Anything still failing drops the batch's sparse gradients with
+    a warning + metric. Non-transport errors stay fatal."""
 
     def __init__(
         self,
@@ -71,6 +109,8 @@ class BackwardEngine:
         num_workers: int = 2,
         queue_size: int = 32,
     ):
+        from persia_tpu.metrics import get_metrics
+
         self._worker = emb_worker
         self._release = release_permit
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
@@ -78,6 +118,10 @@ class BackwardEngine:
         self._lock = threading.Lock()
         self._done = threading.Condition(self._lock)
         self._error: Optional[BaseException] = None
+        self._m_dropped = get_metrics().counter(
+            "persia_tpu_gradient_batches_dropped",
+            "gradient batches dropped after RPC failure + failed retry",
+        )
         self._threads = [
             threading.Thread(target=self._run, daemon=True, name=f"backward-{i}")
             for i in range(num_workers)
@@ -85,29 +129,59 @@ class BackwardEngine:
         for t in self._threads:
             t.start()
 
-    def push(self, ref: int, slot_grads, scale_factor: float = 1.0) -> None:
+    def push(
+        self, ref: int, slot_grads, scale_factor: float = 1.0, worker=None
+    ) -> None:
         """``slot_grads`` is either the per-slot gradient dict or a zero-arg
         callable producing it — the callable form defers the device→host
         gradient fetch into this engine's thread so it overlaps the next
-        step."""
+        step. ``worker`` overrides the engine's default target (multi-worker
+        dataflow routes each ref back to the worker that holds it)."""
         with self._lock:
             if self._error is not None:
                 raise RuntimeError("backward engine failed") from self._error
             self._pending += 1
-        self._q.put((ref, slot_grads, scale_factor))
+        self._q.put((ref, slot_grads, scale_factor, worker))
+
+    def _apply(self, worker, ref: int, slot_grads, scale: float) -> None:
+        try:
+            worker.update_gradient_batched(ref, slot_grads, scale_factor=scale)
+            return
+        except BaseException as e:  # noqa: BLE001
+            if not _is_rpc_error(e):
+                raise
+            logger.warning("gradient update for ref %d hit %r; waiting for serving", ref, e)
+        wait_for_serving(worker)
+        try:
+            worker.update_gradient_batched(ref, slot_grads, scale_factor=scale)
+        except BaseException as e:  # noqa: BLE001
+            if "ForwardIdNotFound" in repr(e):
+                return  # first attempt consumed the buffer entry → applied
+            if not _is_rpc_error(e):
+                raise
+            logger.error("dropping gradient batch ref %d after retry: %r", ref, e)
+            self._m_dropped.inc()
+            try:
+                worker.abort_gradient(ref)
+            except Exception:  # noqa: BLE001 — best-effort staleness release
+                pass
 
     def _run(self):
         while True:
             item = self._q.get()
             if item is _SENTINEL:
                 return
-            ref, slot_grads, scale = item
+            ref, slot_grads, scale, worker = item
+            worker = worker if worker is not None else self._worker
             try:
                 if callable(slot_grads):
                     slot_grads = slot_grads()
-                self._worker.update_gradient_batched(ref, slot_grads, scale_factor=scale)
+                self._apply(worker, ref, slot_grads, scale)
             except BaseException as e:  # noqa: BLE001 — propagate to trainer
-                self._worker.abort_gradient(ref)
+                try:
+                    worker.abort_gradient(ref)
+                except Exception:  # noqa: BLE001
+                    pass
                 with self._lock:
                     self._error = e
             finally:
@@ -132,6 +206,35 @@ class BackwardEngine:
             t.join(timeout=5)
 
 
+class _OrderedSemaphore:
+    """Staleness semaphore whose acquires are granted in TICKET order.
+
+    Reproducible mode keeps all N lookup workers (the round-1 build clamped
+    to 1) but must make the PS see lookups in batch order — otherwise which
+    worker wins the permit race decides which updates a lookup observes.
+    With tickets, N workers still pipeline preprocessing/staging while the
+    lookup sequence is bit-deterministic (ref: the reorder manager + permit
+    discipline, forward.rs:396-468,686-701)."""
+
+    def __init__(self, permits: int):
+        self._cv = threading.Condition()
+        self._permits = permits
+        self._next = 0
+
+    def acquire(self, ticket: int) -> None:
+        with self._cv:
+            while ticket != self._next or self._permits <= 0:
+                self._cv.wait()
+            self._permits -= 1
+            self._next += 1
+            self._cv.notify_all()
+
+    def release(self) -> None:
+        with self._cv:
+            self._permits += 1
+            self._cv.notify_all()
+
+
 class DataLoader:
     """Pipelined iterator over a ``PersiaBatch`` source
     (ref: persia/data.py:228-271 DataLoader owning the Rust Forward engine).
@@ -140,8 +243,10 @@ class DataLoader:
       return (Semaphore; ref forward.rs:509-511). The permit is released by
       the ``BackwardEngine`` after the update lands, or by ``mark_consumed``
       for requires_grad=False streams.
-    - ``reproducible``: process + yield strictly in batch_id order
-      (ref: PerisaDataOrderManager min-heap, forward.rs:396-468).
+    - ``reproducible``: process + yield strictly in batch_id order with
+      lookups granted in ticket order (ref: PerisaDataOrderManager min-heap,
+      forward.rs:396-468); with ``staleness=1`` results are bit-identical
+      for any ``num_workers``.
     - ``num_workers``: concurrent lookup workers (ref: forward_worker count).
     """
 
@@ -154,16 +259,26 @@ class DataLoader:
         reproducible: bool = False,
         buffer_size: int = 8,
         timeout_s: float = 120.0,
+        recovery_retries: int = 3,
+        emb_workers: Optional[List] = None,
     ):
         if staleness < 1:
             raise ValueError("staleness must be >= 1")
         self.dataset = dataset
         self.ctx = ctx
-        self.num_workers = 1 if reproducible else max(1, num_workers)
+        # embedding-worker handles addressable by a dataflow batch's
+        # remote_ref worker index (defaults to the ctx's single worker)
+        self.emb_workers = list(emb_workers) if emb_workers else [ctx.worker]
+        self.num_workers = max(1, num_workers)
         self.reproducible = reproducible
         self.buffer_size = buffer_size
         self.timeout_s = timeout_s
-        self.staleness_sem = threading.Semaphore(staleness)
+        self.recovery_retries = recovery_retries
+        self.staleness_sem = (
+            _OrderedSemaphore(staleness)
+            if reproducible
+            else threading.Semaphore(staleness)
+        )
         self.backward_engine = BackwardEngine(
             ctx.worker, release_permit=self.staleness_sem.release
         )
@@ -185,25 +300,39 @@ class DataLoader:
             in_q.put(_SENTINEL)
 
     def _reorder(self, in_q: "queue.Queue", out_q: "queue.Queue"):
-        """Strict batch_id-order emitter (ref: forward.rs:396-468)."""
+        """Ascending-batch_id emitter (ref: forward.rs:396-468). Emits
+        ``(ticket, batch)`` — the ticket sequences the ordered staleness
+        gate AND the consumer's yield order, so N lookup workers acquire in
+        emit order.
+
+        Contiguous ids emit immediately; gapped ids (a multi-trainer
+        dataflow delivers every world_size-th id) emit through a bounded
+        look-ahead window of ``buffer_size`` batches — a deterministic
+        function of the dataset's arrival order either way. Loader skew
+        beyond the window is the only thing that can still reorder."""
         heap: List = []
         expect: Optional[int] = None
         seq = 0  # tiebreak: duplicate batch_ids must not compare PersiaBatch
+        ticket = 0
         try:
             while True:
                 item = in_q.get()
                 if item is _SENTINEL or isinstance(item, _WorkerError):
                     for _, _, b in sorted(heap):
-                        out_q.put(b)
+                        out_q.put((ticket, b))
+                        ticket += 1
                     out_q.put(item)
                     return
                 heapq.heappush(heap, (item.batch_id, seq, item))
                 seq += 1
                 if expect is None:
                     expect = heap[0][0]
-                while heap and heap[0][0] <= expect:
+                while heap and (
+                    heap[0][0] <= expect or len(heap) > self.buffer_size
+                ):
                     bid, _, b = heapq.heappop(heap)
-                    out_q.put(b)
+                    out_q.put((ticket, b))
+                    ticket += 1
                     expect = bid + 1
         except BaseException as e:  # noqa: BLE001
             out_q.put(_WorkerError(e))
@@ -224,15 +353,23 @@ class DataLoader:
                 in_q.put(item)  # let sibling workers see the sentinel too
                 out_q.put(item)
                 return
-            batch = item
+            if self.reproducible:
+                ticket, batch = item
+            else:
+                ticket, batch = None, item
             diagnostics.heartbeat(beat_key)
-            self.staleness_sem.acquire()  # bounded async (forward.rs:686-690)
+            # bounded async (forward.rs:686-690); reproducible mode grants
+            # permits in ticket order so the PS sees a deterministic
+            # lookup sequence regardless of worker count
+            if self.reproducible:
+                self.staleness_sem.acquire(ticket)
+            else:
+                self.staleness_sem.acquire()
             diagnostics.heartbeat(beat_key)
             try:
                 train = batch.requires_grad
                 with span("lookup", batch_id=batch.batch_id):
-                    ref = self.ctx.worker.put_forward_ids(batch)
-                    emb_batches = self.ctx.worker.forward_batch_id(ref, train=train)
+                    widx, ref, emb_batches = self._lookup_with_recovery(batch, train)
                 with span("stage", batch_id=batch.batch_id):
                     device_batch, counts = self.ctx.prepare_features(batch, emb_batches)
                 out_q.put(
@@ -243,12 +380,69 @@ class DataLoader:
                         device_batch=device_batch,
                         counts=counts,
                         batch_id=batch.batch_id,
+                        worker_idx=widx,
+                        ticket=ticket,
                     )
                 )
             except BaseException as e:  # noqa: BLE001
                 self.staleness_sem.release()
                 out_q.put(_WorkerError(e))
                 return
+
+    def _lookup_with_recovery(self, batch, train: bool):
+        """One batch's id-buffer + lookup round-trip with transient-failure
+        recovery: on an RPC error, block until the worker/PS tier reports
+        ready again and re-submit the whole batch (a consumed-but-failed
+        ref cannot be replayed — the buffer entry is gone), bounded by
+        ``recovery_retries`` (ref: forward.rs:708-716,748-761 catches lookup
+        errors, waits for serving, and continues).
+
+        A dataflow batch arrives with ``remote_ref`` — ids already buffered
+        at embedding worker ``widx`` — so the first attempt skips the
+        re-send; a lost ref (expired/worker restart) falls back to
+        re-submitting the ids carried in the batch."""
+        remote = getattr(batch, "remote_ref", None)
+        widx = remote[0] if remote else 0
+        if widx >= len(self.emb_workers):
+            raise RuntimeError(
+                f"dataflow batch references embedding worker {widx} but this "
+                f"DataLoader only knows {len(self.emb_workers)} — pass "
+                f"emb_workers= matching the DataflowSender's worker list"
+            )
+        worker = self.emb_workers[widx]
+        last: Optional[BaseException] = None
+        for attempt in range(self.recovery_retries + 1):
+            ref: Optional[int] = None
+            try:
+                if remote is not None:
+                    ref = remote[1]
+                    remote = None  # any retry re-submits the ids
+                else:
+                    ref = worker.put_forward_ids(batch)
+                return widx, ref, worker.forward_batch_id(ref, train=train)
+            except BaseException as e:  # noqa: BLE001
+                lost_ref = "ForwardIdNotFound" in repr(e)
+                if (not (_is_rpc_error(e) or lost_ref)
+                        or attempt == self.recovery_retries):
+                    raise
+                if ref is not None and not lost_ref:
+                    # a lost forward_batch_id REPLY may have succeeded
+                    # server-side (entry stashed, staleness++) — abort the
+                    # orphan ref so the retry's fresh ref cannot leak the
+                    # post-forward buffer entry + staleness slot forever
+                    try:
+                        worker.abort_gradient(ref)
+                    except Exception:  # noqa: BLE001 — best effort
+                        pass
+                last = e
+                logger.warning(
+                    "lookup for batch %s failed (%r); waiting for serving "
+                    "(attempt %d/%d)", batch.batch_id, e, attempt + 1,
+                    self.recovery_retries,
+                )
+                if not lost_ref:
+                    wait_for_serving(worker, timeout_s=self.timeout_s)
+        raise RuntimeError("unreachable") from last
 
     # ------------------------------------------------------------- consumer
 
@@ -276,7 +470,7 @@ class DataLoader:
 
         finished_workers = 0
         emit_heap: List = []
-        expect: Optional[int] = None
+        expect = 0  # next ticket to yield (reproducible mode)
         try:
             while True:
                 try:
@@ -296,9 +490,10 @@ class DataLoader:
                         return
                     continue
                 if self.reproducible:
-                    heapq.heappush(emit_heap, (item.batch_id, item.ref, item))
-                    if expect is None:
-                        expect = emit_heap[0][0]
+                    # yield in TICKET order (the reorder thread's emit
+                    # sequence — contiguous by construction, unlike
+                    # batch_ids which a multi-trainer dataflow strides)
+                    heapq.heappush(emit_heap, (item.ticket, item.ref, item))
                     while emit_heap and emit_heap[0][0] == expect:
                         yield heapq.heappop(emit_heap)[2]
                         expect += 1
@@ -316,7 +511,10 @@ class DataLoader:
         slot_grads = self.ctx.emb_grads_to_slot_grads(
             training_batch.emb_batches, emb_grads, training_batch.counts
         )
-        self.backward_engine.push(training_batch.ref, slot_grads, scale_factor)
+        self.backward_engine.push(
+            training_batch.ref, slot_grads, scale_factor,
+            worker=self.emb_workers[training_batch.worker_idx],
+        )
 
     def backward_packed(
         self, training_batch: PersiaTrainingBatch, gpacked, scale_factor: float = 1.0
@@ -335,12 +533,17 @@ class DataLoader:
                 training_batch.emb_batches, emb_grads, training_batch.counts
             )
 
-        self.backward_engine.push(training_batch.ref, _materialize, scale_factor)
+        self.backward_engine.push(
+            training_batch.ref, _materialize, scale_factor,
+            worker=self.emb_workers[training_batch.worker_idx],
+        )
 
     def mark_consumed(self, training_batch: PersiaTrainingBatch) -> None:
         """Release the staleness permit for a no-gradient batch (eval)."""
         if training_batch.batch.requires_grad:
-            self.ctx.worker.abort_gradient(training_batch.ref)
+            self.emb_workers[training_batch.worker_idx].abort_gradient(
+                training_batch.ref
+            )
         self.staleness_sem.release()
 
     def flush(self):

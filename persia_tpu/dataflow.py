@@ -1,0 +1,159 @@
+"""Multi-loader → multi-trainer dataflow routing.
+
+Parity target: ``rust/persia-core/src/nats.rs:145-407``
+(``PersiaDataFlowComponent``): each data-loader replica assigns GLOBAL batch
+ids ``batch_id = local_idx * replica_size + replica_index`` so ids are
+unique and interleave deterministically across loaders; id features
+round-robin across embedding workers (with ``can_forward_batched``
+backpressure + retry, nats.rs:250-312) and the dense half routes to trainer
+``rank = batch_id % world_size`` (nats.rs:314-353).
+
+TPU-first differences: the trainer-side receiver is a bounded
+``MessageQueueServer`` on the framework's framed RPC layer (replacing the
+NATS DataflowService channel); the wire batch carries BOTH the remote
+forward ref AND the id features, so a trainer can recover from a lost ref
+(worker restart) by re-submitting the ids — the reference would drop the
+batch there.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from typing import Iterable, Iterator, List, Optional, Sequence
+
+from persia_tpu.data import PersiaBatch
+from persia_tpu.logger import get_default_logger
+from persia_tpu.mq import MessageQueueClient, MessageQueueServer
+
+logger = get_default_logger("persia_tpu.dataflow")
+
+_REF_MAGIC = b"PREF"
+_DONE = b"PDONE"
+
+
+def _pack_meta(worker_idx: int, ref: int, user_meta: Optional[bytes]) -> bytes:
+    return _REF_MAGIC + struct.pack("<iq", worker_idx, ref) + (user_meta or b"")
+
+
+def _unpack_meta(meta: Optional[bytes]):
+    """Returns ((worker_idx, ref) | None, user_meta)."""
+    if meta is None or not meta.startswith(_REF_MAGIC):
+        return None, meta
+    worker_idx, ref = struct.unpack_from("<iq", meta, len(_REF_MAGIC))
+    rest = meta[len(_REF_MAGIC) + 12:]
+    return (worker_idx, ref), (rest or None)
+
+
+class TrainerDataflow:
+    """Trainer-side dense-batch receiver (ref: DataflowService,
+    nats.rs:102-140): a bounded MQ the loaders push serialized batches into.
+
+    ``dataset(num_loaders)`` yields ``PersiaBatch`` (with ``remote_ref`` and
+    global ``batch_id`` restored) until every loader has sent its
+    end-of-stream marker — feed it straight into ``DataLoader``
+    (reproducible mode restores global batch order via its reorder heap).
+    """
+
+    def __init__(self, port: int = 0, capacity: int = 64):
+        self._mq = MessageQueueServer(port=port, capacity=capacity).start()
+
+    @property
+    def port(self) -> int:
+        return self._mq.port
+
+    def stop(self) -> None:
+        self._mq.stop()
+
+    def dataset(
+        self, num_loaders: int, timeout_s: float = 300.0
+    ) -> Iterator[PersiaBatch]:
+        from persia_tpu.mq import MessageQueueClient as _C
+
+        client = _C(f"127.0.0.1:{self.port}")
+        done = 0
+        deadline = time.time() + timeout_s
+        while done < num_loaders:
+            raw = client.get(timeout_ms=2000)
+            if raw is None:
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"dataflow: only {done}/{num_loaders} loaders finished "
+                        f"within {timeout_s}s"
+                    )
+                continue
+            deadline = time.time() + timeout_s
+            if raw == _DONE:
+                done += 1
+                continue
+            batch = PersiaBatch.from_bytes(raw)
+            batch.remote_ref, batch.meta = _unpack_meta(batch.meta)
+            yield batch
+        client.close()
+
+
+class DataflowSender:
+    """Data-loader side (ref: PersiaDataFlowComponent, nats.rs:145-407).
+
+    ``workers``: embedding-worker handles (``WorkerClient`` or in-process
+    ``EmbeddingWorker``); ``trainer_addrs``: every trainer's
+    ``TrainerDataflow`` MQ address, indexed by rank.
+    """
+
+    def __init__(
+        self,
+        workers: Sequence,
+        trainer_addrs: Sequence[str],
+        replica_index: int = 0,
+        replica_size: int = 1,
+        backpressure_timeout_s: float = 120.0,
+    ):
+        if replica_size < 1 or not (0 <= replica_index < replica_size):
+            raise ValueError("bad replica_index/replica_size")
+        self.workers = list(workers)
+        self.trainers = [MessageQueueClient(a) for a in trainer_addrs]
+        self.replica_index = replica_index
+        self.replica_size = replica_size
+        self.backpressure_timeout_s = backpressure_timeout_s
+        self._local = 0
+
+    def send(self, batch: PersiaBatch) -> int:
+        """Assign the global batch id, buffer ids at the owning embedding
+        worker (backpressure-aware), and route the batch to its trainer.
+        Returns the global batch id."""
+        bid = self._local * self.replica_size + self.replica_index
+        self._local += 1
+        batch.batch_id = bid
+        widx = bid % len(self.workers)
+        worker = self.workers[widx]
+        deadline = time.time() + self.backpressure_timeout_s
+        while not worker.can_forward_batched():  # ref: nats.rs:250-312
+            if time.time() > deadline:
+                raise TimeoutError("embedding worker forward buffer full")
+            time.sleep(0.05)
+        ref = worker.put_forward_ids(batch)
+        user_meta = batch.meta
+        batch.meta = _pack_meta(widx, ref, user_meta)
+        try:
+            rank = bid % len(self.trainers)  # ref: nats.rs:314-353
+            self.trainers[rank].put(batch.to_bytes())
+        finally:
+            batch.meta = user_meta
+        return bid
+
+    def send_all(self, batches: Iterable[PersiaBatch]) -> int:
+        n = 0
+        for b in batches:
+            self.send(b)
+            n += 1
+        self.finish()
+        return n
+
+    def finish(self) -> None:
+        """Signal end-of-stream to every trainer."""
+        for t in self.trainers:
+            t.put(_DONE)
+
+    def close(self) -> None:
+        for t in self.trainers:
+            t.close()

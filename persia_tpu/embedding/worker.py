@@ -155,10 +155,24 @@ class ShardedLookup:
     448-629). ``replicas`` are store-like objects (in-process stores or RPC
     clients exposing the same methods)."""
 
-    def __init__(self, replicas: Sequence):
+    def __init__(self, replicas: Sequence, recover=None):
         if not replicas:
             raise ValueError("need at least one PS replica")
         self.replicas = list(replicas)
+        # callable(replica) -> None: re-push optimizer + hyperparams to a
+        # replica that lost its runtime config (restarted PS; ref: the
+        # worker rebuilds its PS client pool on RpcError,
+        # embedding_worker_service/mod.rs:1320-1333)
+        self.recover = recover
+
+    def _with_recovery(self, replica, fn):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — match the typed remote error
+            if self.recover is not None and "no optimizer registered" in repr(e):
+                self.recover(replica)
+                return fn()
+            raise
 
     def lookup(self, keys: np.ndarray, dim: int, train: bool) -> np.ndarray:
         n = len(self.replicas)
@@ -289,7 +303,8 @@ class ShardedLookup:
         slot — matches the reference's batch-level beta powers)."""
         n = len(self.replicas)
         if n == 1:
-            self.replicas[0].update_gradients(keys, grads, group)
+            r0 = self.replicas[0]
+            self._with_recovery(r0, lambda: r0.update_gradients(keys, grads, group))
             return
         part = native_worker.shard_partition(keys, n)
         if part is not None:
@@ -299,14 +314,20 @@ class ShardedLookup:
                 c = int(counts[r])
                 if c:
                     p = pos[start:start + c]
-                    self.replicas[r].update_gradients(keys[p], grads[p], group)
+                    rep = self.replicas[r]
+                    self._with_recovery(
+                        rep, lambda: rep.update_gradients(keys[p], grads[p], group)
+                    )
                 start += c
             return
         shard = sign_to_shard(keys, n)
         for r in range(n):
             mask = shard == r
             if mask.any():
-                self.replicas[r].update_gradients(keys[mask], grads[mask], group)
+                rep = self.replicas[r]
+                self._with_recovery(
+                    rep, lambda: rep.update_gradients(keys[mask], grads[mask], group)
+                )
 
 
 def _distinct_rows(
@@ -427,8 +448,9 @@ class EmbeddingWorker:
         num_threads: int = 8,
     ):
         self.embedding_config = embedding_config
-        self.lookup_router = ShardedLookup(replicas)
+        self.lookup_router = ShardedLookup(replicas, recover=self._recover_replica)
         self.hyperparams = hyperparams
+        self._optimizer = None  # cached for replica recovery
         self.forward_buffer_size = forward_buffer_size
         self.buffered_data_expired_sec = buffered_data_expired_sec
         self.forward_id_buffer: Dict[int, ProcessedBatch] = {}
@@ -503,8 +525,41 @@ class EmbeddingWorker:
     def register_optimizer(self, optimizer) -> None:
         """Fan the sparse-optimizer registration to every PS replica
         (ref: register_optimizer fan-out, emb_worker:1286-1307)."""
+        self._optimizer = optimizer
         for r in self.lookup_router.replicas:
             r.register_optimizer(optimizer)
+
+    def _recover_replica(self, replica) -> None:
+        """Re-push runtime config to a replica that lost it (restarted PS):
+        the typed 'no optimizer registered' reply triggers this, after which
+        the failed call is retried (ref: rebuild-on-error,
+        embedding_worker_service/mod.rs:1320-1333). A worker that never
+        registered the optimizer itself (multi-worker topologies register
+        through one worker) sources the config from a healthy sibling
+        replica."""
+        import persia_tpu.logger as _log
+
+        _log.get_default_logger("persia_tpu.worker").warning(
+            "re-pushing optimizer/hyperparams to a restarted PS replica"
+        )
+        opt = self._optimizer
+        if opt is None:
+            for sib in self.lookup_router.replicas:
+                if sib is replica:
+                    continue
+                try:
+                    if hasattr(sib, "get_optimizer"):
+                        opt = sib.get_optimizer()
+                    else:
+                        opt = getattr(sib, "optimizer", None)
+                except Exception:  # noqa: BLE001 — sibling may be down too
+                    continue
+                if opt is not None:
+                    break
+        if opt is not None:
+            self._optimizer = opt
+            replica.register_optimizer(opt)
+        replica.configure(self.hyperparams)
 
     def configure(self, hyperparams: HyperParameters) -> None:
         """Push runtime hyperparameters to every PS replica

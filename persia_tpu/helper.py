@@ -53,6 +53,7 @@ class ServiceCtx:
         self.coordinator: Optional[Coordinator] = None
         self._watchdog_stop = threading.Event()
         self._crashed: Optional[str] = None
+        self._expected_dead: set = set()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -76,18 +77,13 @@ class ServiceCtx:
         # services never need a TPU; keep them off the chip
         env["JAX_PLATFORMS"] = "cpu"
 
+        self._env = env
+        self._coord_addr = coord_addr
+        self._ps_procs: List[subprocess.Popen] = []
         for i in range(self.n_ps):
-            cmd = [
-                sys.executable, "-m", "persia_tpu.service.ps_server",
-                "--replica-index", str(i), "--replica-size", str(self.n_ps),
-                "--coordinator", coord_addr,
-                "--capacity", str(self.capacity),
-                "--num-internal-shards", str(self.num_internal_shards),
-                "--backend", self.backend, "--seed", str(self.seed),
-            ]
-            if self.global_config_path:
-                cmd += ["--global-config", self.global_config_path]
-            self.procs.append(subprocess.Popen(cmd, env=env))
+            p = subprocess.Popen(self._ps_cmd(i), env=env)
+            self._ps_procs.append(p)
+            self.procs.append(p)
 
         for i in range(self.n_workers):
             cmd = [
@@ -110,13 +106,49 @@ class ServiceCtx:
         self._watchdog.start()
         return self
 
+    def _ps_cmd(self, i: int, port: int = 0) -> List[str]:
+        cmd = [
+            sys.executable, "-m", "persia_tpu.service.ps_server",
+            "--replica-index", str(i), "--replica-size", str(self.n_ps),
+            "--coordinator", self._coord_addr,
+            "--capacity", str(self.capacity),
+            "--num-internal-shards", str(self.num_internal_shards),
+            "--backend", self.backend, "--seed", str(self.seed),
+        ]
+        if port:
+            cmd += ["--port", str(port)]
+        if self.global_config_path:
+            cmd += ["--global-config", self.global_config_path]
+        return cmd
+
+    # ---------------------------------------------------- failure injection
+
+    def kill_ps(self, i: int) -> None:
+        """SIGKILL parameter server ``i`` (fault injection for recovery
+        tests; the watchdog ignores PSs killed through this API)."""
+        p = self._ps_procs[i]
+        self._expected_dead.add(p.pid)
+        p.kill()
+        p.wait(timeout=10)
+
+    def restart_ps(self, i: int) -> None:
+        """Respawn parameter server ``i`` on its ORIGINAL port so existing
+        clients reconnect transparently (fresh store, like a k8s pod
+        restart without a boot checkpoint)."""
+        addr = self.ps_addrs()[i]
+        port = int(addr.rsplit(":", 1)[1])
+        p = subprocess.Popen(self._ps_cmd(i, port=port), env=self._env)
+        self.procs.append(p)
+        self._ps_procs[i] = p
+        StoreClient(addr).wait_ready(timeout_s=self.startup_timeout_s)
+
     def _watch(self):
         """Crash watchdog (ref: helper.py:296-315): if any service process
         dies, record it so clients fail fast instead of hanging."""
         while not self._watchdog_stop.wait(0.5):
             for p in self.procs:
                 rc = p.poll()
-                if rc is not None and rc != 0:
+                if rc is not None and rc != 0 and p.pid not in self._expected_dead:
                     self._crashed = f"service pid {p.pid} exited with {rc}"
                     logger.error(self._crashed)
                     return

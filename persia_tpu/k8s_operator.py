@@ -1,0 +1,281 @@
+"""Kubernetes operator tier: reconcile loop + REST scheduler.
+
+Parity target: the reference's kube-runtime Controller
+(`k8s/src/bin/operator.rs:55-100` — create → apply resources with a
+finalizer, delete → teardown) and the actix-web REST scheduler
+(`k8s/src/bin/server.rs:1-229` — /apply, /delete, list/log endpoints).
+
+Design: a level-triggered poll-reconcile loop (no watch streams — the
+convergence property is the same: each cycle diffs DESIRED state, derived
+from the ``PersiaTpuJob`` custom resources via
+``persia_tpu.k8s.generate_manifests``, against ACTUAL labeled resources,
+then creates what's missing, deletes what's orphaned, and replaces failed
+pods). The cluster API is behind the small ``KubeApi`` interface:
+``KubectlApi`` shells out to kubectl for real clusters; tests inject an
+in-memory fake, so the controller logic is covered without a cluster
+(the reference needs a live cluster for `k8s/src/bin/e2e.rs`).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from persia_tpu.k8s import (
+    GROUP,
+    JOB_LABEL,
+    KIND,
+    PLURAL,
+    VERSION,
+    generate_manifests,
+    job_from_custom_resource,
+)
+from persia_tpu.logger import get_default_logger
+
+logger = get_default_logger("persia_tpu.k8s_operator")
+
+_FINALIZER = f"{GROUP}/teardown"
+
+
+def _obj_key(obj: Dict[str, Any]) -> Tuple[str, str, str]:
+    return (
+        obj.get("kind", ""),
+        obj.get("metadata", {}).get("namespace", "default"),
+        obj.get("metadata", {}).get("name", ""),
+    )
+
+
+class KubeApi:
+    """Minimal cluster surface the reconciler needs."""
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def list_labeled(self, namespace: str) -> List[Dict[str, Any]]:
+        """All framework-labeled Pods/Services/Deployments."""
+        raise NotImplementedError
+
+    def create(self, obj: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        raise NotImplementedError
+
+    def pod_phase(self, obj: Dict[str, Any]) -> str:
+        return obj.get("status", {}).get("phase", "Unknown")
+
+
+class KubectlApi(KubeApi):
+    """Real-cluster backend (kubectl JSON shell-outs; the framework image
+    does not vendor a kube client library)."""
+
+    def __init__(self, kubectl: str = "kubectl"):
+        self.kubectl = kubectl
+
+    def _run_json(self, args: List[str]) -> Dict[str, Any]:
+        out = subprocess.run(
+            [self.kubectl] + args + ["-o", "json"],
+            capture_output=True, text=True, check=True,
+        )
+        return json.loads(out.stdout)
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        try:
+            return self._run_json(
+                ["get", f"{PLURAL}.{GROUP}", "--all-namespaces"]
+            ).get("items", [])
+        except subprocess.CalledProcessError:
+            return []
+
+    def list_labeled(self, namespace: str) -> List[Dict[str, Any]]:
+        objs: List[Dict[str, Any]] = []
+        for kind in ("pods", "services", "deployments"):
+            try:
+                objs.extend(
+                    self._run_json(
+                        ["get", kind, "-n", namespace, "-l", JOB_LABEL]
+                    ).get("items", [])
+                )
+            except subprocess.CalledProcessError:
+                pass
+        return objs
+
+    def create(self, obj: Dict[str, Any]) -> None:
+        subprocess.run(
+            [self.kubectl, "apply", "-f", "-"],
+            input=json.dumps(obj), text=True, check=True, capture_output=True,
+        )
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        subprocess.run(
+            [self.kubectl, "delete", kind.lower(), name, "-n", namespace,
+             "--ignore-not-found"],
+            check=True, capture_output=True,
+        )
+
+
+class Reconciler:
+    """Level-triggered controller: converge labeled resources to the
+    ``PersiaTpuJob`` CRs every cycle (ref: reconcile,
+    k8s/src/bin/operator.rs:55-100)."""
+
+    def __init__(self, api: KubeApi, namespace: str = "default"):
+        self.api = api
+        self.namespace = namespace
+        self._stop = threading.Event()
+
+    def reconcile_once(self) -> Dict[str, int]:
+        """One convergence pass. Returns action counts (for tests/metrics)."""
+        stats = {"created": 0, "deleted": 0, "restarted": 0}
+        desired: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
+        for cr in self.api.list_jobs():
+            try:
+                spec = job_from_custom_resource(cr)
+            except Exception as e:  # noqa: BLE001 — one bad CR must not wedge the loop
+                logger.error("bad %s %s: %r", KIND,
+                             cr.get("metadata", {}).get("name"), e)
+                continue
+            for obj in generate_manifests(spec):
+                desired[_obj_key(obj)] = obj
+
+        actual = {(_obj_key(o)): o for o in self.api.list_labeled(self.namespace)}
+
+        # replace failed pods first (restartPolicy at the controller level)
+        for key, obj in list(actual.items()):
+            kind, ns, name = key
+            if kind == "Pod" and key in desired and self.api.pod_phase(obj) == "Failed":
+                logger.warning("restarting failed pod %s/%s", ns, name)
+                self.api.delete(kind, ns, name)
+                del actual[key]
+                stats["restarted"] += 1
+
+        for key, obj in desired.items():
+            if key not in actual:
+                self.api.create(obj)
+                stats["created"] += 1
+        for key in actual:
+            if key not in desired:
+                kind, ns, name = key
+                logger.info("tearing down orphan %s %s/%s", kind, ns, name)
+                self.api.delete(kind, ns, name)
+                stats["deleted"] += 1
+        return stats
+
+    def run(self, interval_s: float = 2.0) -> None:
+        logger.info("operator reconciling every %.1fs", interval_s)
+        while not self._stop.wait(interval_s):
+            try:
+                self.reconcile_once()
+            except Exception:  # noqa: BLE001 — the loop must survive API hiccups
+                logger.exception("reconcile cycle failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+# --------------------------------------------------------------- REST tier
+
+
+class OperatorHttpServer:
+    """REST scheduler (ref: k8s/src/bin/server.rs): POST /apply with a
+    PersiaTpuJob CR, POST /delete?name=..., GET /jobs, GET /status — thin
+    HTTP wrappers over the same KubeApi the reconciler converges."""
+
+    def __init__(self, api: KubeApi, port: int = 0, namespace: str = "default"):
+        import http.server
+
+        operator_self = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reply(self, code: int, payload: Dict[str, Any]) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.startswith("/jobs"):
+                    names = [
+                        cr.get("metadata", {}).get("name")
+                        for cr in operator_self.api.list_jobs()
+                    ]
+                    self._reply(200, {"jobs": names})
+                elif self.path.startswith("/status"):
+                    objs = operator_self.api.list_labeled(namespace)
+                    pods = {
+                        o["metadata"]["name"]: operator_self.api.pod_phase(o)
+                        for o in objs if o.get("kind") == "Pod"
+                    }
+                    self._reply(200, {"pods": pods})
+                else:
+                    self._reply(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n) if n else b""
+                if self.path.startswith("/apply"):
+                    try:
+                        cr = json.loads(raw)
+                        assert cr.get("kind") == KIND, f"kind must be {KIND}"
+                        job_from_custom_resource(cr)  # validate
+                        operator_self.api.create(cr)
+                        self._reply(200, {"applied": cr["metadata"]["name"]})
+                    except Exception as e:  # noqa: BLE001
+                        self._reply(400, {"error": repr(e)})
+                elif self.path.startswith("/delete"):
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+                    name = (q.get("name") or [None])[0]
+                    if not name:
+                        self._reply(400, {"error": "name required"})
+                        return
+                    operator_self.api.delete(KIND, namespace, name)
+                    self._reply(200, {"deleted": name})
+                else:
+                    self._reply(404, {"error": "unknown path"})
+
+        self.api = api
+        self._httpd = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "OperatorHttpServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser("persia-tpu-k8s-operator")
+    ap.add_argument("--namespace", default="default")
+    ap.add_argument("--interval-s", type=float, default=2.0)
+    ap.add_argument("--rest-port", type=int, default=0,
+                    help="also serve the REST scheduler (0 = off)")
+    args = ap.parse_args(argv)
+    api = KubectlApi()
+    rec = Reconciler(api, namespace=args.namespace)
+    if args.rest_port:
+        srv = OperatorHttpServer(api, port=args.rest_port, namespace=args.namespace)
+        srv.start()
+        logger.info("REST scheduler on :%d", srv.port)
+    rec.run(interval_s=args.interval_s)
+
+
+if __name__ == "__main__":
+    main()

@@ -57,8 +57,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     coord.add_argument("--port", type=int, default=int(os.environ.get("PERSIA_COORDINATOR_PORT", "7799")))
 
     # k8s sub-CLI (ref: persia/k8s_utils.py gencrd/operator/server)
-    k8s = sub.add_parser("k8s", help="generate/apply k8s manifests")
-    k8s.add_argument("action", choices=["gen", "gencrd", "apply", "delete"])
+    k8s = sub.add_parser("k8s", help="generate/apply k8s manifests + operator")
+    k8s.add_argument("action", choices=["gen", "gencrd", "apply", "delete", "operator"])
+    k8s.add_argument("--interval-s", type=float, default=2.0,
+                     help="operator reconcile interval")
+    k8s.add_argument("--rest-port", type=int, default=0,
+                     help="operator: also serve the REST scheduler (0 = off)")
     k8s.add_argument("--job-yaml", type=str, default=None,
                      help="PersiaTpuJob CR or bare spec yaml file")
     k8s.add_argument("--name", type=str, default=None, help="job name (delete)")
@@ -119,6 +123,18 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         if args.action == "gencrd":
             print(dump_yaml_str(k8s_mod.generate_crd()))
+            return 0
+        if args.action == "operator":
+            # reconcile loop (ref: k8s/src/bin/operator.rs) + optional REST
+            # scheduler (ref: k8s/src/bin/server.rs)
+            from persia_tpu.k8s_operator import main as operator_main
+
+            op_args = ["--interval-s", str(args.interval_s)]
+            if args.namespace:
+                op_args += ["--namespace", args.namespace]
+            if args.rest_port:
+                op_args += ["--rest-port", str(args.rest_port)]
+            operator_main(op_args)
             return 0
         if args.action == "delete":
             if not args.name:

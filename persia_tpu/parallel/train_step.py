@@ -41,11 +41,23 @@ from persia_tpu.parallel.mesh import batch_sharding, replicated
 
 
 @flax.struct.dataclass
+class LossScaleState:
+    """Dynamic mixed-precision loss scaling (ref: the GradScaler management
+    in persia/ctx.py:926-1005 — finite checks, skip-step on overflow, scale
+    backoff/growth). On TPU the finite check is a fused on-device reduction,
+    so it runs every step instead of every Nth."""
+
+    scale: jnp.ndarray  # f32 scalar
+    good_steps: jnp.ndarray  # i32 scalar
+
+
+@flax.struct.dataclass
 class TrainState:
     params: Any
     batch_stats: Any
     opt_state: Any
     step: jnp.ndarray
+    loss_scale: Optional[LossScaleState] = None
 
 
 def _embedding_model_inputs(emb_diff: List, emb_static: List) -> List:
@@ -84,6 +96,7 @@ def init_train_state(
     rng,
     sample_batch: Dict,
     optimizer: optax.GradientTransformation,
+    loss_scale_init: Optional[float] = None,
 ) -> TrainState:
     emb_diff, emb_static = _split_emb(sample_batch["emb"])
     model_emb = _embedding_model_inputs(emb_diff, emb_static)
@@ -95,6 +108,14 @@ def init_train_state(
         batch_stats=batch_stats,
         opt_state=optimizer.init(params),
         step=jnp.zeros((), dtype=jnp.int32),
+        loss_scale=(
+            None
+            if loss_scale_init is None
+            else LossScaleState(
+                scale=jnp.asarray(loss_scale_init, dtype=jnp.float32),
+                good_steps=jnp.zeros((), dtype=jnp.int32),
+            )
+        ),
     )
 
 
@@ -102,23 +123,44 @@ def build_train_step(
     model,
     optimizer: optax.GradientTransformation,
     loss_fn: Callable = default_loss_fn,
+    dynamic_loss_scale: bool = False,
+    growth_interval: int = 2000,
+    growth_factor: float = 2.0,
+    backoff_factor: float = 0.5,
+    max_scale: float = float(2 ** 24),
 ):
     """Returns jitted ``step(state, batch) -> (state, (header, gpacked))``.
 
     ``header`` is a small f32 array [loss | preds] — the cheap synchronous
-    fetch. ``gpacked`` is ONE flat array [emb_grad_0 | ...] in the embedding
-    wire dtype (bf16 halves device→host bytes, matching the reference's f16
-    gradient wire) — the bulk transfer, fetched asynchronously by the
-    BackwardEngine so it overlaps the next step (per-array fetches pay a
-    full round-trip each; on a remote-attached TPU that latency dominated
-    the step). ``unpack_step_output`` splits them using shapes derived from
-    the batch. Emb grads align with ``batch['emb']``: (B, dim) for pooled
-    slots, (P, dim) for raw slots (rows past the true distinct count are
-    zero — the host slices them off before shipping to the worker).
+    fetch (with ``dynamic_loss_scale``: [loss | scale_used | finite |
+    preds]). ``gpacked`` is ONE flat array [emb_grad_0 | ...] in the
+    embedding wire dtype (bf16 halves device→host bytes, matching the
+    reference's f16 gradient wire) — the bulk transfer, fetched
+    asynchronously by the BackwardEngine so it overlaps the next step
+    (per-array fetches pay a full round-trip each; on a remote-attached TPU
+    that latency dominated the step). ``unpack_step_output`` splits them
+    using shapes derived from the batch. Emb grads align with
+    ``batch['emb']``: (B, dim) for pooled slots, (P, dim) for raw slots
+    (rows past the true distinct count are zero — the host slices them off
+    before shipping to the worker).
+
+    ``dynamic_loss_scale`` (ref: GradScaler management, persia/ctx.py:926-
+    1005): the loss is multiplied by the running scale before backward; an
+    on-device finite check over ALL gradients decides whether the dense
+    update applies (overflow → skip step, scale *= backoff) and the scale
+    grows by ``growth_factor`` after ``growth_interval`` consecutive finite
+    steps. Embedding gradients ship SCALED; the header carries the scale so
+    the worker's ``scale_factor`` division unscales them (non-finite slots
+    are NaN-skipped there, mod.rs:716-744).
     """
 
     def step(state: TrainState, batch: Dict):
         emb_diff, emb_static = _split_emb(batch["emb"])
+        scale = (
+            state.loss_scale.scale
+            if dynamic_loss_scale
+            else jnp.asarray(1.0, jnp.float32)
+        )
 
         def loss_wrapper(params, emb_diff):
             model_emb = _embedding_model_inputs(emb_diff, emb_static)
@@ -134,28 +176,71 @@ def build_train_step(
                 logits = model.apply(variables, batch["dense"], model_emb, train=True)
                 new_stats = state.batch_stats
             loss = loss_fn(logits, batch["labels"][0])
-            return loss, (logits, new_stats)
+            return loss * scale.astype(loss.dtype), (loss, logits, new_stats)
 
-        (loss, (logits, new_stats)), (param_grads, emb_grads) = jax.value_and_grad(
+        (_, (loss, logits, new_stats)), (param_grads, emb_grads) = jax.value_and_grad(
             loss_wrapper, argnums=(0, 1), has_aux=True
         )(state.params, emb_diff)
 
-        updates, new_opt_state = optimizer.update(
+        if dynamic_loss_scale:
+            leaves = jax.tree.leaves(param_grads) + jax.tree.leaves(emb_grads)
+            finite = jnp.all(
+                jnp.stack([jnp.all(jnp.isfinite(g)) for g in leaves])
+            )
+            inv = jnp.where(finite, 1.0 / scale, 0.0).astype(jnp.float32)
+            # unscale for the dense update; overflow zeros the grads and the
+            # select below keeps params/opt_state untouched (skip-step)
+            param_grads = jax.tree.map(
+                lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), param_grads
+            )
+        else:
+            finite = jnp.asarray(True)
+
+        updates, opt_state_candidate = optimizer.update(
             param_grads, state.opt_state, state.params
         )
-        new_params = optax.apply_updates(state.params, updates)
+        params_candidate = optax.apply_updates(state.params, updates)
+        if dynamic_loss_scale:
+            new_params = jax.tree.map(
+                lambda new, old: jnp.where(finite, new, old),
+                params_candidate, state.params,
+            )
+            new_opt_state = jax.tree.map(
+                lambda new, old: jnp.where(finite, new, old),
+                opt_state_candidate, state.opt_state,
+            )
+            good = jnp.where(finite, state.loss_scale.good_steps + 1, 0)
+            grown = good >= growth_interval
+            new_scale = jnp.where(
+                finite,
+                jnp.where(grown, scale * growth_factor, scale),
+                scale * backoff_factor,
+            )
+            new_scale = jnp.clip(new_scale, 1.0, max_scale)
+            new_ls = LossScaleState(
+                scale=new_scale, good_steps=jnp.where(grown, 0, good)
+            )
+        else:
+            new_params, new_opt_state, new_ls = (
+                params_candidate, opt_state_candidate, state.loss_scale,
+            )
         new_state = TrainState(
             params=new_params,
             batch_stats=new_stats,
             opt_state=new_opt_state,
             step=state.step + 1,
+            loss_scale=new_ls,
         )
         preds = jax.nn.sigmoid(logits)
         # Header (loss|preds) stays exact f32 — the cheap sync fetch; emb
         # grads ride the wire dtype in their own buffer so the bulk transfer
         # can be fetched asynchronously off the critical path.
-        header = jnp.concatenate([jnp.reshape(loss, (1,)).astype(jnp.float32),
-                                  jnp.reshape(preds, (-1,)).astype(jnp.float32)])
+        head = [jnp.reshape(loss, (1,)).astype(jnp.float32)]
+        if dynamic_loss_scale:
+            head.append(jnp.reshape(scale, (1,)).astype(jnp.float32))
+            head.append(jnp.reshape(finite, (1,)).astype(jnp.float32))
+        head.append(jnp.reshape(preds, (-1,)).astype(jnp.float32))
+        header = jnp.concatenate(head)
         gflat = [jnp.reshape(g, (-1,)) for g in emb_grads]
         gpacked = jnp.concatenate(gflat) if gflat else jnp.zeros((0,), jnp.float32)
         return new_state, (header, gpacked)
@@ -169,6 +254,17 @@ def unpack_step_header(header: np.ndarray, batch: Dict):
     loss = float(header[0])
     preds = header[1:].reshape(labels.shape)
     return loss, preds
+
+
+def unpack_step_header_dynamic(header: np.ndarray, batch: Dict):
+    """Header view for a ``dynamic_loss_scale`` step:
+    (loss, preds, scale_used, grads_finite)."""
+    labels = batch["labels"][0]
+    loss = float(header[0])
+    scale = float(header[1])
+    finite = bool(header[2] > 0.5)
+    preds = header[3:].reshape(labels.shape)
+    return loss, preds, scale, finite
 
 
 def unpack_step_grads(gpacked: np.ndarray, batch: Dict) -> List[np.ndarray]:
@@ -247,25 +343,81 @@ def _packed_put(batch: Dict) -> Dict:
 def shard_device_batch(batch: Dict, mesh=None) -> Dict:
     """device_put the batch with DP shardings: batch-dim leaves over ``data``,
     raw-slot distinct rows replicated. Computation follows data: the jitted
-    step picks these shardings up without explicit in_shardings."""
+    step picks these shardings up without explicit in_shardings.
+
+    Mesh staging is PACKED like the single-chip path (round-1 Weak #8: the
+    per-leaf device_put round-trips return on pods, where they matter most):
+    one transfer per (sharding, dtype) group — batch-dim floats concat along
+    axis 1 into (B, F_total), raw distinct rows concat along axis 0
+    (replicated), int32 index matrices concat along axis 1 — then sliced
+    back on device. Raw-slot masks are derived on device (``index != P-1``,
+    the pad row) instead of shipping a bool matrix."""
     if mesh is None:
         return _packed_put(batch)
     bsh = batch_sharding(mesh)
     rep = replicated(mesh)
+
+    # ---- group host leaves
+    bdim_float: List[Tuple[str, int, np.ndarray]] = []  # ("dense"/"labels"/i, …)
+    for j, x in enumerate(batch["dense"]):
+        bdim_float.append(("dense", j, np.asarray(x)))
+    for j, x in enumerate(batch["labels"]):
+        bdim_float.append(("labels", j, np.asarray(x)))
+    raw_distinct: List[Tuple[int, np.ndarray]] = []
+    index_mats: List[Tuple[int, np.ndarray]] = []
+    for i, e in enumerate(batch["emb"]):
+        if "pooled" in e:
+            bdim_float.append(("emb", i, np.asarray(e["pooled"])))
+        else:
+            raw_distinct.append((i, np.asarray(e["distinct"])))
+            index_mats.append((i, np.ascontiguousarray(e["index"], dtype=np.int32)))
+
+    def _packed_groups(leaves, axis, sharding):
+        """One device_put per (dtype, off-axis width) group of 2-D leaves;
+        other ranks ship individually (packing along one axis requires the
+        other to match — NdarrayDataBase allows any ndim >= 1, and raw
+        slots may carry different embedding dims)."""
+        views: Dict = {}
+        by_dtype: Dict = {}
+        for key, arr in leaves:
+            if arr.ndim != 2:
+                views[key] = jax.device_put(arr, sharding)
+                continue
+            gk = (arr.dtype.name, arr.shape[1 - axis])
+            by_dtype.setdefault(gk, []).append((key, arr))
+        for group in by_dtype.values():
+            packed = np.concatenate([a for _, a in group], axis=axis)
+            dev = jax.device_put(packed, sharding)
+            off = 0
+            for key, a in group:
+                w = a.shape[axis]
+                if axis == 1:
+                    views[key] = dev[:, off:off + w]
+                else:
+                    views[key] = dev[off:off + w]
+                off += w
+        return views
+
+    fviews = _packed_groups([((k, j), a) for k, j, a in bdim_float], 1, bsh)
+    dviews = _packed_groups(raw_distinct, 0, rep)
+    iviews = _packed_groups(index_mats, 1, bsh)
+
     out: Dict = {
-        "dense": [jax.device_put(x, bsh) for x in batch["dense"]],
-        "labels": [jax.device_put(x, bsh) for x in batch["labels"]],
+        "dense": [fviews[("dense", j)] for j in range(len(batch["dense"]))],
+        "labels": [fviews[("labels", j)] for j in range(len(batch["labels"]))],
         "emb": [],
     }
-    for e in batch["emb"]:
+    for i, e in enumerate(batch["emb"]):
         if "pooled" in e:
-            out["emb"].append({"pooled": jax.device_put(e["pooled"], bsh)})
+            out["emb"].append({"pooled": fviews[("emb", i)]})
         else:
+            idx = iviews[i]
+            p = e["distinct"].shape[0]
             out["emb"].append(
                 {
-                    "distinct": jax.device_put(e["distinct"], rep),
-                    "index": jax.device_put(e["index"], bsh),
-                    "mask": jax.device_put(e["mask"], bsh),
+                    "distinct": dviews[i],
+                    "index": idx,
+                    "mask": idx != (p - 1),  # pad row = P-1 (stage_embeddings)
                 }
             )
     return out

@@ -72,6 +72,10 @@ class StoreClient:
     def register_optimizer(self, optimizer: OptimizerConfig) -> None:
         self._rpc.call("register_optimizer", proto.pack_json(optimizer.to_dict()))
 
+    def get_optimizer(self) -> Optional[OptimizerConfig]:
+        d = proto.unpack_json(self._rpc.call("get_optimizer", idempotent=True))
+        return OptimizerConfig.from_dict(d) if d else None
+
     def configure(self, hyperparams: HyperParameters) -> None:
         self._rpc.call(
             "configure",
@@ -153,6 +157,22 @@ class WorkerClient:
 
     def can_forward_batched(self) -> bool:
         return self._rpc.call("can_forward_batched", idempotent=True) == b"1"
+
+    def wait_serving(self, timeout_s: float = 60.0) -> None:
+        """Block until the worker reports its whole PS tier ready (ref:
+        wait_for_serving polling, core/rpc.rs:118-241)."""
+        import time as _time
+
+        deadline = _time.time() + timeout_s
+        while True:
+            try:
+                if self._rpc.call("ready_for_serving", idempotent=True) == b"1":
+                    return
+            except Exception:  # noqa: BLE001
+                pass
+            if _time.time() > deadline:
+                raise TimeoutError("embedding worker's PS tier not serving")
+            _time.sleep(0.3)
 
     def put_forward_ids(self, batch: PersiaBatch) -> int:
         return struct.unpack("<q", self._rpc.call("forward_batched", batch.to_bytes()))[0]

@@ -55,6 +55,7 @@ class ParameterServerService:
         s.register("size", lambda p: struct.pack("<q", self.store.size()))
         s.register("clear", lambda p: (self.store.clear(), b"ok")[1])
         s.register("num_shards", lambda p: struct.pack("<I", self.store.num_internal_shards))
+        s.register("get_optimizer", self._get_optimizer)
         s.register("dump_shard", self._dump_shard)
         s.register("load_shard", self._load_shard)
         s.register("dump_to_dir", self._dump_to_dir)
@@ -89,6 +90,14 @@ class ParameterServerService:
         (group,) = struct.unpack("<i", payload)
         self.store.advance_batch_state(group)
         return b"ok"
+
+    def _get_optimizer(self, payload: bytes) -> bytes:
+        """The registered sparse-optimizer config (empty dict when none):
+        lets a worker recovering a RESTARTED replica source the config from
+        a healthy sibling even when it never registered the optimizer
+        itself (multi-worker topologies register through one worker)."""
+        opt = getattr(self.store, "optimizer", None)
+        return proto.pack_json(opt.to_dict() if opt is not None else {})
 
     def _register_optimizer(self, payload: bytes) -> bytes:
         self.store.register_optimizer(OptimizerConfig.from_dict(proto.unpack_json(payload)))

@@ -36,6 +36,14 @@ class RpcError(RuntimeError):
     pass
 
 
+def _is_transportish(e: BaseException) -> bool:
+    """Transport failure, directly or relayed from a tier below."""
+    if isinstance(e, RpcError):
+        msg = str(e)
+        return "remote error:" not in msg or "unavailable:" in msg
+    return isinstance(e, (ConnectionError, TimeoutError, OSError))
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     chunks = []
     while n:
@@ -78,7 +86,12 @@ class _Handler(socketserver.BaseRequestHandler):
                             reply, status = fn(payload) or b"", 0
                     except Exception as e:  # noqa: BLE001 — app error crosses the wire
                         logger.exception("handler %s failed", method)
-                        reply, status = repr(e).encode(), 1
+                        # a handler failing on a DOWNSTREAM transport error
+                        # (this worker's PS died) is retryable for the
+                        # caller — mark it so clients can classify, unlike
+                        # genuine application errors which stay fatal
+                        prefix = b"unavailable: " if _is_transportish(e) else b""
+                        reply, status = prefix + repr(e).encode(), 1
                 sock.sendall(struct.pack("<IB", len(reply) + 1, status) + reply)
                 if method == "shutdown":
                     server.stop()
@@ -124,8 +137,13 @@ class RpcServer:
 
 
 class RpcClient:
-    """Reconnecting client with a per-connection lock (one in-flight call per
-    client; callers needing parallelism hold a client pool)."""
+    """Pooled reconnecting client: up to ``pool_size`` concurrent in-flight
+    calls per client, each on its own connection (the reference runs 8-10
+    concurrent RPCs against each peer, forward.rs:640-779 — a single locked
+    socket would serialize the worker's slot fan-out and the DataLoader's
+    lookup workers into one in-flight request per server). Connections are
+    created on demand, parked when idle, and dropped on transport errors;
+    callers beyond ``pool_size`` wait for a free connection."""
 
     def __init__(
         self,
@@ -133,29 +151,68 @@ class RpcClient:
         timeout_s: float = 60.0,
         compress_threshold: int = 1 << 20,
         retries: int = 3,
+        pool_size: int = 8,
     ):
         host, port = addr.rsplit(":", 1)
         self.addr = (host, int(port))
         self.timeout_s = timeout_s
         self.compress_threshold = compress_threshold
         self.retries = retries
-        self._sock: Optional[socket.socket] = None
-        self._lock = threading.Lock()
+        self.pool_size = max(1, pool_size)
+        self._idle: list = []
+        self._total = 0
+        self._gen = 0  # close() bumps: stale in-flight sockets die at checkin
+        self._cond = threading.Condition()
 
-    def _connect(self) -> socket.socket:
-        if self._sock is None:
-            s = socket.create_connection(self.addr, timeout=self.timeout_s)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._sock = s
-        return self._sock
+    def _new_conn(self) -> socket.socket:
+        s = socket.create_connection(self.addr, timeout=self.timeout_s)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def _checkout(self):
+        with self._cond:
+            while True:
+                if self._idle:
+                    return self._idle.pop(), self._gen
+                if self._total < self.pool_size:
+                    self._total += 1
+                    gen = self._gen
+                    break
+                if not self._cond.wait(timeout=self.timeout_s):
+                    raise RpcError(
+                        f"no free connection to {self.addr} within {self.timeout_s}s"
+                    )
+        try:
+            return self._new_conn(), gen
+        except BaseException:
+            with self._cond:
+                self._total -= 1
+                self._cond.notify()
+            raise
+
+    def _checkin(self, sock: socket.socket, gen: int, broken: bool = False) -> None:
+        with self._cond:
+            if broken or gen != self._gen:  # stale generation: close()d since
+                self._total -= 1
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            else:
+                self._idle.append(sock)
+            self._cond.notify()
 
     def close(self) -> None:
-        with self._lock:
-            if self._sock is not None:
+        with self._cond:
+            self._gen += 1
+            for s in self._idle:
                 try:
-                    self._sock.close()
-                finally:
-                    self._sock = None
+                    s.close()
+                except OSError:
+                    pass
+            self._total -= len(self._idle)
+            self._idle.clear()
+            self._cond.notify_all()
 
     def call(
         self,
@@ -176,7 +233,6 @@ class RpcClient:
                 return self._call_once(method, payload, timeout_s)
             except (ConnectionError, OSError, socket.timeout) as e:
                 last = e
-                self.close()
                 time.sleep(min(0.1 * 2**attempt, 2.0))
         raise RpcError(
             f"rpc {method} to {self.addr} failed"
@@ -192,8 +248,8 @@ class RpcClient:
             flags |= _FLAG_COMPRESSED
         m = method.encode()
         frame = struct.pack("<BH", flags, len(m)) + m + payload
-        with self._lock:
-            sock = self._connect()
+        sock, gen = self._checkout()
+        try:
             if timeout_s is not None:
                 sock.settimeout(timeout_s)
             try:
@@ -203,6 +259,10 @@ class RpcClient:
             finally:
                 if timeout_s is not None:
                     sock.settimeout(self.timeout_s)
+        except BaseException:
+            self._checkin(sock, gen, broken=True)
+            raise
+        self._checkin(sock, gen)
         status = body[0]
         reply = body[1:]
         if status != 0:

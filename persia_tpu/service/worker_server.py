@@ -42,11 +42,22 @@ class EmbeddingWorkerService:
         s.register("register_optimizer", self._register_optimizer)
         s.register("configure", self._configure)
         s.register("staleness", lambda p: struct.pack("<q", self.worker.staleness))
+        s.register("ready_for_serving", self._ready_for_serving)
         s.register("dump", self._dump)
         s.register("load", self._load)
         s.register("model_manager_status", self._status)
         s.register("shutdown_servers", self._shutdown_servers)
         self.port = s.port
+
+    def _ready_for_serving(self, payload: bytes) -> bytes:
+        """b\"1\" only when every PS replica answers a probe (ref:
+        ready_for_serving, embedding_worker_service/mod.rs:1379-1491)."""
+        for r in self.worker.lookup_router.replicas:
+            try:
+                r.wait_ready(timeout_s=2.0)
+            except Exception:  # noqa: BLE001
+                return b"0"
+        return b"1"
 
     def _can_forward(self, payload: bytes) -> bytes:
         return b"1" if self.worker.can_forward_batched() else b"0"

@@ -1,0 +1,147 @@
+"""k8s reconciler + REST scheduler against an in-memory fake cluster API
+(ref: reconcile loop k8s/src/bin/operator.rs:55-100, REST server
+k8s/src/bin/server.rs)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from persia_tpu.k8s import JOB_LABEL, KIND
+from persia_tpu.k8s_operator import KubeApi, OperatorHttpServer, Reconciler
+
+
+class FakeKubeApi(KubeApi):
+    def __init__(self):
+        self.jobs = {}
+        self.objs = {}
+
+    def list_jobs(self):
+        return list(self.jobs.values())
+
+    def list_labeled(self, namespace):
+        return [
+            o for o in self.objs.values()
+            if o.get("metadata", {}).get("namespace", "default") == namespace
+            and JOB_LABEL in o.get("metadata", {}).get("labels", {})
+        ]
+
+    def create(self, obj):
+        name = obj["metadata"]["name"]
+        if obj.get("kind") == KIND:
+            self.jobs[name] = obj
+            return
+        key = (obj.get("kind"), obj["metadata"].get("namespace", "default"), name)
+        self.objs[key] = obj
+
+    def delete(self, kind, namespace, name):
+        if kind == KIND:
+            self.jobs.pop(name, None)
+            return
+        self.objs.pop((kind, namespace, name), None)
+
+    def set_pod_phase(self, name, phase, namespace="default"):
+        self.objs[("Pod", namespace, name)].setdefault("status", {})["phase"] = phase
+
+
+def _cr(name="job1", ps=2, ew=1, trainers=1):
+    return {
+        "apiVersion": "persia-tpu.dev/v1",
+        "kind": KIND,
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "image": "persia-tpu:test",
+            "parameterServer": {"replicas": ps},
+            "embeddingWorker": {"replicas": ew},
+            "trainer": {"replicas": trainers},
+        },
+    }
+
+
+def test_reconcile_creates_and_is_idempotent():
+    api = FakeKubeApi()
+    api.create(_cr(ps=2, ew=1, trainers=1))
+    rec = Reconciler(api)
+    stats = rec.reconcile_once()
+    assert stats["created"] > 5 and stats["deleted"] == 0
+    pods = [k for k in api.objs if k[0] == "Pod"]
+    # coordinator + 2 PS + 1 worker + 1 trainer host
+    assert len([p for p in pods if "parameter-server" in p[2]]) == 2
+    # second pass converged: no actions
+    assert rec.reconcile_once() == {"created": 0, "deleted": 0, "restarted": 0}
+
+
+def test_reconcile_scales_down_orphans():
+    api = FakeKubeApi()
+    api.create(_cr(ps=3))
+    rec = Reconciler(api)
+    rec.reconcile_once()
+    assert len([k for k in api.objs if "parameter-server" in k[2] and k[0] == "Pod"]) == 3
+    api.create(_cr(ps=1))  # CR updated: fewer replicas
+    stats = rec.reconcile_once()
+    assert stats["deleted"] == 2
+    assert len([k for k in api.objs if "parameter-server" in k[2] and k[0] == "Pod"]) == 1
+
+
+def test_reconcile_tears_down_on_cr_delete():
+    api = FakeKubeApi()
+    api.create(_cr())
+    rec = Reconciler(api)
+    rec.reconcile_once()
+    assert api.objs
+    api.delete(KIND, "default", "job1")
+    stats = rec.reconcile_once()
+    assert stats["deleted"] > 0
+    assert not api.objs  # label-selector teardown (ref: k8s/src/lib.rs)
+
+
+def test_reconcile_restarts_failed_pods():
+    api = FakeKubeApi()
+    api.create(_cr())
+    rec = Reconciler(api)
+    rec.reconcile_once()
+    pod_name = next(k[2] for k in api.objs if k[0] == "Pod")
+    api.set_pod_phase(pod_name, "Failed")
+    stats = rec.reconcile_once()
+    assert stats["restarted"] == 1 and stats["created"] == 1
+    assert ("Pod", "default", pod_name) in api.objs  # recreated fresh
+
+
+def test_bad_cr_does_not_wedge_loop():
+    api = FakeKubeApi()
+    api.jobs["broken"] = {"kind": KIND, "metadata": {"name": "broken"}, "spec": {}}
+    api.create(_cr("good"))
+    rec = Reconciler(api)
+    stats = rec.reconcile_once()
+    assert stats["created"] > 0  # the good job converged anyway
+
+
+def test_rest_scheduler_apply_list_delete():
+    api = FakeKubeApi()
+    srv = OperatorHttpServer(api, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        req = urllib.request.Request(
+            f"{base}/apply", data=json.dumps(_cr("restjob")).encode(),
+            method="POST", headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            assert json.load(r)["applied"] == "restjob"
+        with urllib.request.urlopen(f"{base}/jobs") as r:
+            assert json.load(r)["jobs"] == ["restjob"]
+        Reconciler(api).reconcile_once()
+        with urllib.request.urlopen(f"{base}/status") as r:
+            pods = json.load(r)["pods"]
+            assert any("parameter-server" in p for p in pods)
+        req = urllib.request.Request(f"{base}/delete?name=restjob", method="POST")
+        with urllib.request.urlopen(req) as r:
+            assert json.load(r)["deleted"] == "restjob"
+        assert api.jobs == {}
+        # invalid CR rejected
+        req = urllib.request.Request(
+            f"{base}/apply", data=b'{"kind": "Nope"}', method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(req)
+    finally:
+        srv.stop()

@@ -1,0 +1,143 @@
+"""Dynamic mixed-precision loss scaling (ref: GradScaler management,
+persia/ctx.py:926-1005): overflow → skip-step + scale backoff; finite
+streak → scale growth; embedding grads unscaled via the worker's
+scale_factor division."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from persia_tpu.config import EmbeddingConfig, SlotConfig
+from persia_tpu.ctx import TrainCtx
+from persia_tpu.data import IDTypeFeature, Label, NonIDTypeFeature, PersiaBatch
+from persia_tpu.embedding.optim import SGD, Adagrad
+from persia_tpu.embedding.store import EmbeddingStore
+from persia_tpu.embedding.worker import EmbeddingWorker
+from persia_tpu.models import DNN
+
+
+def _make_ctx(**kw):
+    cfg = EmbeddingConfig(
+        slots_config={"cat": SlotConfig(dim=8)}, feature_index_prefix_bit=4
+    )
+    store = EmbeddingStore(
+        capacity=1 << 12, num_internal_shards=2, optimizer=Adagrad(lr=0.1).config,
+        seed=3,
+    )
+    worker = EmbeddingWorker(cfg, [store])
+    ctx = TrainCtx(
+        model=DNN(dense_mlp_size=8, sparse_mlp_size=16, hidden_sizes=(16,)),
+        dense_optimizer=optax.sgd(1e-2),
+        embedding_optimizer=Adagrad(lr=0.1),
+        worker=worker,
+        embedding_config=cfg,
+        **kw,
+    ).__enter__()
+    return ctx, store
+
+
+def _batch(seed=0, scale=1.0, bs=16):
+    rng = np.random.default_rng(seed)
+    return PersiaBatch(
+        [IDTypeFeature("cat", list(rng.integers(0, 50, (bs, 1), dtype=np.uint64)))],
+        non_id_type_features=[
+            NonIDTypeFeature((scale * rng.normal(size=(bs, 4))).astype(np.float32))
+        ],
+        labels=[Label(rng.integers(0, 2, (bs, 1)).astype(np.float32))],
+        requires_grad=True,
+    )
+
+
+_HUGE = float(np.float32(3.0e38))  # near f32 max: any grad > ~1 overflows
+
+
+def test_overflow_skips_step_and_backs_off():
+    """A scale so large that scaled grads overflow f32 must: report
+    grads_finite=False, leave params finite (skip-step), and halve the
+    scale for the next batch."""
+    ctx, _ = _make_ctx(
+        dynamic_loss_scale=True, loss_scale_init=_HUGE, loss_scale_max=_HUGE
+    )
+    m0 = ctx.train_step(_batch(0, scale=100.0))
+    assert m0["grads_finite"] is False
+    assert m0["loss_scale"] == _HUGE
+    params_after = jax.tree.leaves(ctx.state.params)
+    m1 = ctx.train_step(_batch(1, scale=100.0))
+    assert m1["loss_scale"] == pytest.approx(_HUGE / 2, rel=1e-6)
+    assert all(np.isfinite(np.asarray(p)).all() for p in params_after)
+
+
+def test_overflow_keeps_params_unchanged():
+    ctx, _ = _make_ctx(
+        dynamic_loss_scale=True, loss_scale_init=_HUGE, loss_scale_max=_HUGE
+    )
+    ctx.train_step(_batch(0, scale=100.0))  # overflow
+    p_before = [np.asarray(x).copy() for x in jax.tree.leaves(ctx.state.params)]
+    m = ctx.train_step(_batch(1, scale=100.0))  # still overflowing at _HUGE/2
+    assert m["grads_finite"] is False
+    p_after = [np.asarray(x) for x in jax.tree.leaves(ctx.state.params)]
+    for a, b_ in zip(p_before, p_after):
+        np.testing.assert_array_equal(a, b_)
+
+
+def test_scale_grows_after_interval():
+    ctx, _ = _make_ctx(
+        dynamic_loss_scale=True, loss_scale_init=8.0,
+        loss_scale_growth_interval=3,
+    )
+    scales = [ctx.train_step(_batch(i))["loss_scale"] for i in range(7)]
+    assert scales[:3] == [8.0, 8.0, 8.0]
+    assert scales[3] == 16.0  # grew after 3 finite steps
+    assert scales[6] == 32.0
+
+
+def test_scaled_training_matches_unscaled():
+    """With a benign constant scale (no overflow), dynamic-scale training
+    must match unscaled training: the embedding updates divide by the same
+    scale the loss was multiplied by, and the dense update unscales grads."""
+    batches = [_batch(i) for i in range(6)]
+    ctx_a, store_a = _make_ctx()
+    ctx_b, store_b = _make_ctx(
+        dynamic_loss_scale=True, loss_scale_init=1024.0,
+        loss_scale_growth_interval=10 ** 6,
+    )
+    for b in batches:
+        ctx_a.train_step(b)
+        mb = ctx_b.train_step(b)
+        assert mb["grads_finite"] is True
+    from persia_tpu.embedding.hashing import add_index_prefix
+
+    cfg = ctx_a.embedding_config
+    signs = add_index_prefix(
+        np.arange(50, dtype=np.uint64), cfg.slot("cat").index_prefix, 4
+    )
+    checked = 0
+    for s in signs.tolist():
+        ea, eb = store_a.get_embedding_entry(s), store_b.get_embedding_entry(s)
+        assert (ea is None) == (eb is None)
+        if ea is not None:
+            np.testing.assert_allclose(ea, eb, rtol=2e-4, atol=1e-6)
+            checked += 1
+    assert checked > 10
+    pa = jax.tree.leaves(ctx_a.state.params)
+    pb = jax.tree.leaves(ctx_b.state.params)
+    for a, b_ in zip(pa, pb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=2e-4, atol=1e-6)
+
+
+def test_recovers_and_trains_after_overflow_window():
+    """Start with an overflowing scale: after enough backoffs the scale
+    re-enters range and training proceeds with finite steps."""
+    ctx, _ = _make_ctx(
+        dynamic_loss_scale=True, loss_scale_init=_HUGE, loss_scale_max=_HUGE
+    )
+    losses = []
+    finites = []
+    for i in range(30):
+        m = ctx.train_step(_batch(i, scale=100.0))
+        losses.append(m["loss"])
+        finites.append(m["grads_finite"])
+    assert not finites[0], "first step must overflow"
+    assert finites[-1], "scale never recovered into range"
+    assert np.isfinite(losses[-1])
