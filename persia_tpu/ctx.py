@@ -278,8 +278,10 @@ class TrainCtx(EmbeddingCtx):
             self.worker.abort_gradient(ref)
             raise
         # emb grads ship scaled; the worker's scale_factor division unscales
-        # (non-finite slots are NaN-skipped there, mod.rs:716-744)
-        scale = metrics.get("loss_scale", self.grad_scale)
+        # (non-finite slots are NaN-skipped there, mod.rs:716-744). A static
+        # grad_scale composes with the dynamic loss scale instead of being
+        # silently discarded by it.
+        scale = metrics.get("loss_scale", 1.0) * self.grad_scale
         self.worker.update_gradient_batched(ref, slot_grads, scale_factor=scale)
         out = {
             "loss": float(metrics["loss"]),
@@ -309,19 +311,21 @@ class TrainCtx(EmbeddingCtx):
             except AttributeError:
                 pass
             if self.dynamic_loss_scale:
-                loss, preds, scale, finite = unpack_step_header_dynamic(
+                loss, preds, dyn_scale, finite = unpack_step_header_dynamic(
                     np.asarray(header), device_batch
                 )
+                # static grad_scale composes with the dynamic loss scale
+                scale = dyn_scale * self.grad_scale
             else:
                 loss, preds = unpack_step_header(np.asarray(header), device_batch)
-                scale, finite = self.grad_scale, None
+                dyn_scale, scale, finite = None, self.grad_scale, None
         except Exception:
             loader.mark_consumed(training_batch)
             raise
         loader.backward_packed(training_batch, gpacked, scale_factor=scale)
         out = {"loss": loss, "preds": np.asarray(preds)}
         if finite is not None:
-            out["loss_scale"] = scale
+            out["loss_scale"] = dyn_scale
             out["grads_finite"] = finite
         return out
 

@@ -68,28 +68,28 @@ class TrainerDataflow:
     def dataset(
         self, num_loaders: int, timeout_s: float = 300.0
     ) -> Iterator[PersiaBatch]:
-        from persia_tpu.mq import MessageQueueClient as _C
-
-        client = _C(f"127.0.0.1:{self.port}")
-        done = 0
-        deadline = time.time() + timeout_s
-        while done < num_loaders:
-            raw = client.get(timeout_ms=2000)
-            if raw is None:
-                if time.time() > deadline:
-                    raise TimeoutError(
-                        f"dataflow: only {done}/{num_loaders} loaders finished "
-                        f"within {timeout_s}s"
-                    )
-                continue
+        client = MessageQueueClient(f"127.0.0.1:{self.port}")
+        try:  # close on TimeoutError and on an abandoned generator too
+            done = 0
             deadline = time.time() + timeout_s
-            if raw == _DONE:
-                done += 1
-                continue
-            batch = PersiaBatch.from_bytes(raw)
-            batch.remote_ref, batch.meta = _unpack_meta(batch.meta)
-            yield batch
-        client.close()
+            while done < num_loaders:
+                raw = client.get(timeout_ms=2000)
+                if raw is None:
+                    if time.time() > deadline:
+                        raise TimeoutError(
+                            f"dataflow: only {done}/{num_loaders} loaders "
+                            f"finished within {timeout_s}s"
+                        )
+                    continue
+                deadline = time.time() + timeout_s
+                if raw == _DONE:
+                    done += 1
+                    continue
+                batch = PersiaBatch.from_bytes(raw)
+                batch.remote_ref, batch.meta = _unpack_meta(batch.meta)
+                yield batch
+        finally:
+            client.close()
 
 
 class DataflowSender:

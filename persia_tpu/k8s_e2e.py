@@ -1,0 +1,166 @@
+"""Cluster e2e harness: apply a PersiaTpuJob, wait for trainers, tear down.
+
+Parity target: `k8s/src/bin/e2e.rs` (the reference's CI system test — builds
+a PersiaJob with 2 parameter servers / 2 embedding workers / 2 NN workers /
+1 data loader, applies it to a live cluster, polls the nn-worker pods until
+every one reports ``Succeeded`` within a 600 s deadline, then tears the job
+down and verifies nothing labeled is left behind).
+
+Differences by design: the reconcile loop can be driven INLINE (no separately
+deployed operator needed for a smoke test), and the harness runs against any
+``KubeApi`` — the in-memory fake in tests (`tests/test_k8s_e2e.py`) covers
+the full pass/timeout/teardown logic without a cluster; pointing it at
+``KubectlApi`` gives the reference's live-cluster behavior verbatim.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from persia_tpu.k8s import JOB_LABEL, KIND, ROLE_LABEL
+from persia_tpu.k8s_operator import KubeApi, KubectlApi, Reconciler
+from persia_tpu.logger import get_default_logger
+
+logger = get_default_logger("persia_tpu.k8s_e2e")
+
+API_VERSION = "persia-tpu.dev/v1"
+
+
+def default_e2e_job(
+    name: str = "persia-tpu-e2e", image: str = "persia-tpu:latest",
+    namespace: str = "default",
+) -> Dict[str, Any]:
+    """The reference e2e topology (e2e.rs: 2 PS, 2 embedding workers, 2 NN
+    workers, 1 data loader) as a PersiaTpuJob custom resource."""
+    return {
+        "apiVersion": API_VERSION,
+        "kind": KIND,
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "image": image,
+            "parameterServer": {"replicas": 2},
+            "embeddingWorker": {"replicas": 2},
+            "trainer": {"replicas": 2},
+            "dataLoader": {"replicas": 1},
+        },
+    }
+
+
+def _trainer_pods(api: KubeApi, namespace: str, job: str) -> List[Dict[str, Any]]:
+    out = []
+    for o in api.list_labeled(namespace):
+        meta = o.get("metadata", {})
+        labels = meta.get("labels", {})
+        if (
+            o.get("kind") == "Pod"
+            and labels.get(JOB_LABEL) == job
+            and labels.get(ROLE_LABEL) == "trainer"
+        ):
+            out.append(o)
+    return out
+
+
+def run_e2e(
+    api: KubeApi,
+    cr: Optional[Dict[str, Any]] = None,
+    namespace: str = "default",
+    timeout_s: float = 600.0,
+    poll_s: float = 2.0,
+    drive_reconciler: bool = True,
+    teardown: bool = True,
+) -> Dict[str, Any]:
+    """Apply ``cr``, wait for every trainer pod to reach ``Succeeded``
+    (ref deadline: 600 s, e2e.rs), then tear down and verify cleanup.
+
+    ``drive_reconciler=True`` runs the convergence loop inline each poll —
+    the harness is then self-contained; with ``False`` it only observes
+    (an operator deployment must be reconciling the cluster).
+
+    Returns a report dict: ``ok``, ``phase`` ("succeeded" / "timeout" /
+    "failed-cleanup"), ``elapsed_s``, ``pod_phases`` (last observation),
+    ``expected_trainers``.
+    """
+    cr = cr or default_e2e_job(namespace=namespace)
+    job_name = cr["metadata"]["name"]
+    spec = cr.get("spec", {})
+    n_trainers = int(spec.get("trainer", {}).get("replicas", 1)) * max(
+        int(spec.get("tpu", {}).get("numHosts", 1)), 1
+    )
+    rec = Reconciler(api, namespace=namespace)
+    api.create(cr)
+    logger.info("e2e: applied %s %s (expecting %d trainer pods)",
+                KIND, job_name, n_trainers)
+
+    deadline = time.monotonic() + timeout_s
+    t0 = time.monotonic()
+    phases: Dict[str, str] = {}
+    ok = False
+    while time.monotonic() < deadline:
+        if drive_reconciler:
+            rec.reconcile_once()
+        pods = _trainer_pods(api, namespace, job_name)
+        phases = {p["metadata"]["name"]: api.pod_phase(p) for p in pods}
+        if len(pods) >= n_trainers and all(
+            ph == "Succeeded" for ph in phases.values()
+        ):
+            ok = True
+            break
+        time.sleep(poll_s)
+    elapsed = time.monotonic() - t0
+    phase = "succeeded" if ok else "timeout"
+    if not ok:
+        logger.error("e2e: trainers not Succeeded within %.0fs: %s",
+                     timeout_s, phases)
+
+    if teardown:
+        api.delete(KIND, namespace, job_name)
+        if drive_reconciler:
+            rec.reconcile_once()
+        leftovers = [
+            o["metadata"]["name"]
+            for o in api.list_labeled(namespace)
+            if o.get("metadata", {}).get("labels", {}).get(JOB_LABEL) == job_name
+        ]
+        if leftovers:
+            logger.error("e2e: teardown left %s", leftovers)
+            if ok:
+                phase = "failed-cleanup"
+            ok = False
+
+    return {
+        "ok": ok,
+        "phase": phase,
+        "elapsed_s": elapsed,
+        "pod_phases": phases,
+        "expected_trainers": n_trainers,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser("persia-tpu-k8s-e2e")
+    ap.add_argument("--name", default="persia-tpu-e2e")
+    ap.add_argument("--image", default="persia-tpu:latest")
+    ap.add_argument("--namespace", default="default")
+    ap.add_argument("--timeout-s", type=float, default=600.0)
+    ap.add_argument("--observe-only", action="store_true",
+                    help="do not reconcile inline (an operator is deployed)")
+    ap.add_argument("--keep", action="store_true", help="skip teardown")
+    args = ap.parse_args(argv)
+    report = run_e2e(
+        KubectlApi(),
+        default_e2e_job(args.name, args.image, args.namespace),
+        namespace=args.namespace,
+        timeout_s=args.timeout_s,
+        drive_reconciler=not args.observe_only,
+        teardown=not args.keep,
+    )
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -141,7 +141,15 @@ class Reconciler:
             for obj in generate_manifests(spec):
                 desired[_obj_key(obj)] = obj
 
-        actual = {(_obj_key(o)): o for o in self.api.list_labeled(self.namespace)}
+        # observe every namespace the desired state touches (CRs are listed
+        # cluster-wide; a job in another namespace must still converge and
+        # its orphans must still be swept), plus the operator's own
+        namespaces = {self.namespace} | {ns for _, ns, _ in desired}
+        actual = {
+            _obj_key(o): o
+            for ns in sorted(namespaces)
+            for o in self.api.list_labeled(ns)
+        }
 
         # replace failed pods first (restartPolicy at the controller level)
         for key, obj in list(actual.items()):
@@ -156,8 +164,13 @@ class Reconciler:
             if key not in actual:
                 self.api.create(obj)
                 stats["created"] += 1
-        for key in actual:
+        for key, obj in actual.items():
             if key not in desired:
+                if obj.get("metadata", {}).get("ownerReferences"):
+                    # controller-managed child (e.g. a Deployment's
+                    # ReplicaSet pods): its owner is the desired object;
+                    # deleting it here would fight that controller forever
+                    continue
                 kind, ns, name = key
                 logger.info("tearing down orphan %s %s/%s", kind, ns, name)
                 self.api.delete(kind, ns, name)
@@ -224,7 +237,8 @@ class OperatorHttpServer:
                 if self.path.startswith("/apply"):
                     try:
                         cr = json.loads(raw)
-                        assert cr.get("kind") == KIND, f"kind must be {KIND}"
+                        if cr.get("kind") != KIND:  # not assert: must survive -O
+                            raise ValueError(f"kind must be {KIND}")
                         job_from_custom_resource(cr)  # validate
                         operator_self.api.create(cr)
                         self._reply(200, {"applied": cr["metadata"]["name"]})

@@ -58,7 +58,12 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     # k8s sub-CLI (ref: persia/k8s_utils.py gencrd/operator/server)
     k8s = sub.add_parser("k8s", help="generate/apply k8s manifests + operator")
-    k8s.add_argument("action", choices=["gen", "gencrd", "apply", "delete", "operator"])
+    k8s.add_argument("action",
+                     choices=["gen", "gencrd", "apply", "delete", "operator", "e2e"])
+    k8s.add_argument("--timeout-s", type=float, default=600.0,
+                     help="e2e: deadline for trainer pods to succeed")
+    k8s.add_argument("--image", type=str, default="persia-tpu:latest",
+                     help="e2e: job image")
     k8s.add_argument("--interval-s", type=float, default=2.0,
                      help="operator reconcile interval")
     k8s.add_argument("--rest-port", type=int, default=0,
@@ -136,6 +141,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                 op_args += ["--rest-port", str(args.rest_port)]
             operator_main(op_args)
             return 0
+        if args.action == "e2e":
+            # cluster system test (ref: k8s/src/bin/e2e.rs)
+            from persia_tpu.k8s_e2e import main as e2e_main
+
+            e2e_args = ["--timeout-s", str(args.timeout_s), "--image", args.image]
+            if args.name:
+                e2e_args += ["--name", args.name]
+            if args.namespace:
+                e2e_args += ["--namespace", args.namespace]
+            return e2e_main(e2e_args)
         if args.action == "delete":
             if not args.name:
                 print("k8s delete requires --name", file=sys.stderr)

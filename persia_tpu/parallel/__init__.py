@@ -14,3 +14,10 @@ from persia_tpu.parallel.train_step import (  # noqa: F401
     build_train_step,
     init_train_state,
 )
+from persia_tpu.parallel.grad_sync import (  # noqa: F401
+    ByteGradAllReduce,
+    Decentralized,
+    GradientAllReduce,
+    LocalSGD,
+    build_sync_train_step,
+)

@@ -1,0 +1,77 @@
+"""k8s e2e harness against the in-memory fake cluster (ref:
+k8s/src/bin/e2e.rs — apply job, wait for trainer pods Succeeded, teardown)."""
+
+import threading
+import time
+
+from persia_tpu.k8s import JOB_LABEL, ROLE_LABEL
+from persia_tpu.k8s_e2e import default_e2e_job, run_e2e
+
+from tests.test_k8s_operator import FakeKubeApi
+
+
+def _succeed_trainers_soon(api, job, delay_s=0.2):
+    """Background: once trainer pods exist, mark them Succeeded (the fake
+    cluster's 'kubelet')."""
+
+    def run():
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            trainers = [
+                key for key, o in api.objs.items()
+                if o.get("kind") == "Pod"
+                and o["metadata"].get("labels", {}).get(ROLE_LABEL) == "trainer"
+            ]
+            if trainers:
+                time.sleep(delay_s)
+                for key in trainers:
+                    api.objs[key].setdefault("status", {})["phase"] = "Succeeded"
+                return
+            time.sleep(0.05)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def test_e2e_succeeds_and_tears_down():
+    api = FakeKubeApi()
+    cr = default_e2e_job(name="e2e1", image="img:test")
+    _succeed_trainers_soon(api, "e2e1")
+    report = run_e2e(api, cr, timeout_s=10, poll_s=0.05)
+    assert report["ok"], report
+    assert report["phase"] == "succeeded"
+    assert report["expected_trainers"] == 2
+    assert len(report["pod_phases"]) == 2
+    assert all(ph == "Succeeded" for ph in report["pod_phases"].values())
+    # teardown removed the CR and every labeled object
+    assert api.jobs == {}
+    assert not [
+        o for o in api.objs.values()
+        if o["metadata"].get("labels", {}).get(JOB_LABEL) == "e2e1"
+    ]
+
+
+def test_e2e_times_out_when_trainers_never_finish():
+    api = FakeKubeApi()
+    cr = default_e2e_job(name="e2e2", image="img:test")
+    report = run_e2e(api, cr, timeout_s=0.5, poll_s=0.05)
+    assert not report["ok"]
+    assert report["phase"] == "timeout"
+    # pods were created by the inline reconciler (they just never finished)
+    assert report["pod_phases"]
+    # teardown still ran
+    assert api.jobs == {}
+
+
+def test_e2e_observe_only_needs_external_operator():
+    """Without inline reconciling and with no operator, nothing converges —
+    the harness reports a timeout instead of hanging."""
+    api = FakeKubeApi()
+    cr = default_e2e_job(name="e2e3", image="img:test")
+    report = run_e2e(api, cr, timeout_s=0.3, poll_s=0.05,
+                     drive_reconciler=False)
+    assert not report["ok"]
+    assert report["pod_phases"] == {}
+    # CR deleted on teardown even in observe mode
+    assert api.jobs == {}
