@@ -283,14 +283,34 @@ def bench_hybrid():
 _BENCHES = {"fused": bench_fused, "hybrid": bench_hybrid, "cached": bench_cached}
 
 
+def _run_mode_isolated(mode: str) -> float:
+    """Run one mode in a fresh subprocess. Modes that fetch device results
+    per step (hybrid) permanently degrade the runtime's dispatch latency on
+    a remote-attached chip (~200x, see bench_cached docstring) — a shared
+    process would poison every mode measured after them. The XLA compile
+    cache keeps the respawn cost to process startup."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ, BENCH_MODE=mode)
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, capture_output=True, text=True, check=True,
+    )
+    line = out.stdout.strip().splitlines()[-1]
+    return float(json.loads(line)["modes"][mode])
+
+
 def main():
     mode = os.environ.get("BENCH_MODE", "all")
     if mode not in ("all", *_BENCHES):
         raise SystemExit(f"BENCH_MODE must be one of all/fused/hybrid/cached, got {mode!r}")
-    modes = list(_BENCHES) if mode == "all" else [mode]
     results = {}
-    for m in modes:
-        results[m] = round(_BENCHES[m](), 1)
+    if mode == "all":
+        for m in _BENCHES:
+            results[m] = round(_run_mode_isolated(m), 1)
+    else:
+        results[mode] = round(_BENCHES[mode](), 1)
     # headline = the capacity tier (PS-resident vocab ≫ HBM) when measured:
     # that is the regime the reference exists for (100T params, README.md:29);
     # "fused" (all-in-HBM) rides along as the in-memory ceiling

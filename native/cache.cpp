@@ -222,4 +222,23 @@ int64_t cache_drain(void* h, uint64_t* signs_out, int64_t* rows_out) {
   return k;
 }
 
+// Seeded per-sign uniform embedding init, bit-identical to the Python
+// golden model (persia_tpu/embedding/hashing.py uniform_init_for_signs:
+// counter-mode splitmix64, top-53-bit mantissa, f64 affine then f32 cast).
+// The cached tier inits every cold miss per step; doing it here keeps the
+// single-core feeder off numpy's temporaries.
+void cache_uniform_init(const uint64_t* signs, int64_t m, int64_t dim,
+                        uint64_t seed, double lo, double hi, float* out) {
+  const double kScale = 1.0 / 9007199254740992.0;  // 2^-53
+  const double span = hi - lo;
+  for (int64_t i = 0; i < m; ++i) {
+    const uint64_t base = splitmix64(signs[i] ^ seed);
+    float* row = out + i * dim;
+    for (int64_t j = 0; j < dim; ++j) {
+      const uint64_t s = splitmix64(base + (uint64_t)j);
+      row[j] = (float)(lo + (double)(s >> 11) * kScale * span);
+    }
+  }
+}
+
 }  // extern "C"

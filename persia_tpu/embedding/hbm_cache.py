@@ -116,8 +116,32 @@ def _load_lib() -> ctypes.CDLL:
         lib.cache_probe.argtypes = [p, _u64p, i64, _i64p]
         lib.cache_drain.restype = i64
         lib.cache_drain.argtypes = [p, _u64p, _i64p]
+        lib.cache_uniform_init.argtypes = [
+            _u64p, i64, i64, ctypes.c_uint64, ctypes.c_double,
+            ctypes.c_double, ctypes.POINTER(ctypes.c_float),
+        ]
         _LIB = lib
     return _LIB
+
+
+def native_uniform_init(
+    signs: np.ndarray, seed: int, dim: int, lo: float, hi: float,
+    out: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Seeded cold-miss embedding init in C++ — bit-identical to
+    ``hashing.uniform_init_for_signs`` (tested). ``out`` (M, dim) f32
+    C-contiguous is filled in place when given."""
+    lib = _load_lib()
+    signs = np.ascontiguousarray(signs, dtype=np.uint64)
+    m = len(signs)
+    if out is None:
+        out = np.empty((m, dim), dtype=np.float32)
+    assert out.flags["C_CONTIGUOUS"] and out.dtype == np.float32
+    lib.cache_uniform_init(
+        signs.ctypes.data_as(_u64p), m, dim, ctypes.c_uint64(seed),
+        lo, hi, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    return out
 
 
 class CacheDirectory:
@@ -337,17 +361,6 @@ class CacheLayout:
 # fixed per (B, L, slot-layout) and compile exactly once.
 
 
-@jax.jit
-def _read_rows_payload(table, state: Dict[str, jnp.ndarray], ev_rows):
-    """(K, dim + state_dim) [emb | state] payload of the given rows — the
-    eviction write-back data, read BEFORE the miss scatter reuses the rows."""
-    parts = [table[ev_rows]]
-    for key in ("acc", "m", "v"):
-        if key in state:
-            parts.append(state[key][ev_rows])
-    return jnp.concatenate(parts, axis=1)
-
-
 from functools import partial as _partial
 
 
@@ -364,12 +377,6 @@ def _scatter_entry_block(table, state: Dict[str, jnp.ndarray], rows, entries):
 
 
 @_partial(jax.jit, donate_argnums=(0, 1))
-def _scatter_entries(table, state: Dict[str, jnp.ndarray], m_rows, m_entries):
-    """Scatter checked-out PS entries into the cache pools (pad rows drop)."""
-    return _scatter_entry_block(table, state, m_rows, m_entries)
-
-
-@_partial(jax.jit, donate_argnums=(0, 1))
 def _restore_rows(table, state: Dict[str, jnp.ndarray], payload, src_idx, dst_rows):
     """Re-admit rows whose write-back is still in flight straight from the
     DEVICE-resident eviction payload (device→host transfers on a
@@ -378,19 +385,35 @@ def _restore_rows(table, state: Dict[str, jnp.ndarray], payload, src_idx, dst_ro
     return _scatter_entry_block(table, state, dst_rows, payload[src_idx])
 
 
-@_partial(jax.jit, donate_argnums=(0, 1), static_argnums=(4,))
-def _scatter_cold(table, state: Dict[str, jnp.ndarray], c_rows, c_emb, state_consts):
-    """Scatter COLD misses (signs the PS has never seen): only the seeded
-    embedding ships from the host at ``dim`` width; the optimizer-state tail
-    is a per-optimizer constant synthesized here — cutting the dominant
-    per-step transfer 2× (Adagrad) / 3× (Adam)."""
+@_partial(jax.jit, donate_argnums=(0, 1), static_argnums=(7,))
+def _apply_aux(table, state: Dict[str, jnp.ndarray], ev_rows, m_rows,
+               m_entries, c_rows, c_emb, state_consts):
+    """Fused per-group per-step aux program: read the eviction payload (from
+    the PRE-scatter table — a missed row may reuse an evicted one), then
+    scatter warm entries and cold seeds. One dispatch instead of three:
+    after the first write-back d2h the runtime's per-dispatch latency
+    degrades ~200× (see ``train_stream``), so the steady-state eviction
+    regime pays per CALL, not per byte. Absent pieces ride as 0-row arrays.
+
+    Compile-cache tradeoff: fusing keys the jit on the COMBINATION of the
+    three piece-size buckets (worst case the cross-product, vs the per-piece
+    sum for split jits). In practice the regimes are disjoint — fill phase
+    is cold-only, steady state is (warm, evict) in one or two stable buckets
+    each with cold decaying — so observed combinations stay within a few
+    dozen tiny programs; the per-call dispatch saving dominates once the
+    runtime is in the degraded-dispatch mode."""
+    parts = [table[ev_rows]]
+    for key in ("acc", "m", "v"):
+        if key in state:
+            parts.append(state[key][ev_rows])
+    payload = jnp.concatenate(parts, axis=1)
+    table, out_state = _scatter_entry_block(table, state, m_rows, m_entries)
     table = table.at[c_rows].set(c_emb.astype(table.dtype), mode="drop")
-    out_state = dict(state)
     for key, val in state_consts:
         st = out_state[key]
         fill = jnp.full((c_rows.shape[0], st.shape[1]), val, dtype=st.dtype)
         out_state[key] = st.at[c_rows].set(fill, mode="drop")
-    return table, out_state
+    return table, out_state, payload
 
 
 def _state_init_consts(cfg: OptimizerConfig):
@@ -784,8 +807,6 @@ class CachedEmbeddingTier:
             # the PS is not touched until eviction writes the row back)
             m = len(miss_signs)
             if m:
-                from persia_tpu.embedding.hashing import uniform_init_for_signs
-
                 rows_miss = rows_u[miss_idx]
                 handled = np.zeros(m, dtype=bool)
                 if resolved:
@@ -819,8 +840,9 @@ class CachedEmbeddingTier:
                     c_rows = np.full(cp, C + 1, dtype=np.int32)
                     c_emb = np.zeros((cp, g.dim), dtype=np.float32)
                     c_rows[:len(cidx)] = rows_miss[cidx]
-                    c_emb[:len(cidx)] = uniform_init_for_signs(
-                        miss_signs[cidx], self.init_seed, g.dim, lo, hi
+                    native_uniform_init(
+                        miss_signs[cidx], self.init_seed, g.dim, lo, hi,
+                        out=c_emb[:len(cidx)],
                     )
                     cold_aux[g.name] = (c_rows, c_emb)
             # evictions: rows to read back (pad → zero row, host slices K)
@@ -1044,6 +1066,8 @@ class CachedTrainCtx:
         # (device header, label shape) of a fetch_final=False stream's last
         # step — materialized lazily by last_metrics()
         self._last_header_dev = None
+        # per-group 0-row stand-ins for absent aux pieces (_group_empties)
+        self._empties: Dict[str, Dict[str, jnp.ndarray]] = {}
 
     def __enter__(self):
         self.worker.register_optimizer(self.sparse_cfg)
@@ -1108,30 +1132,44 @@ class CachedTrainCtx:
             self._land_pending()  # after landing, the PS probe sees them warm
         return None
 
+    def _group_empties(self, gname: str):
+        """Cached 0-row device arrays standing in for absent aux pieces, so
+        the fused ``_apply_aux`` keeps ONE dispatch per touched group."""
+        em = self._empties.get(gname)
+        if em is None:
+            g = next(gr for gr in self.tier.groups if gr.name == gname)
+            em = self._empties[gname] = {
+                "rows": jax.device_put(np.empty(0, dtype=np.int32)),
+                "entries": jax.device_put(
+                    np.empty((0, g.dim + g.state_dim), dtype=np.float32)
+                ),
+                "emb": jax.device_put(np.empty((0, g.dim), dtype=np.float32)),
+            }
+        return em
+
     def _dispatch(
         self, device_inputs, layout, miss_aux, cold_aux, restore_aux, evict_aux
     ):
-        """Dispatch the per-step device programs in order: evict read →
-        warm/cold scatters + in-flight restores → main step. Inputs must
+        """Dispatch the per-step device programs: ONE fused aux program per
+        touched group (evict-payload read → warm scatter → cold scatter; see
+        ``_apply_aux``) + in-flight restores + the main step. Inputs must
         already be device arrays."""
-        evict_payload = {
-            gname: _read_rows_payload(
-                self.state.tables[gname], self.state.emb_state[gname], e_rows
-            )
-            for gname, e_rows in evict_aux.items()
-        }
-        if miss_aux or cold_aux or restore_aux:
+        evict_payload = {}
+        touched = set(miss_aux) | set(cold_aux) | set(evict_aux)
+        if touched or restore_aux:
             tables = dict(self.state.tables)
             emb_state = dict(self.state.emb_state)
-            for gname, (m_rows, m_entries) in miss_aux.items():
-                tables[gname], emb_state[gname] = _scatter_entries(
-                    tables[gname], emb_state[gname], m_rows, m_entries
+            for gname in sorted(touched):
+                em = self._group_empties(gname)
+                ev_rows = evict_aux.get(gname, em["rows"])
+                m_rows, m_entries = miss_aux.get(gname, (em["rows"], em["entries"]))
+                c_rows, c_emb = cold_aux.get(gname, (em["rows"], em["emb"]))
+                tables[gname], emb_state[gname], payload = _apply_aux(
+                    tables[gname], emb_state[gname], ev_rows,
+                    m_rows, m_entries, c_rows, c_emb, self._state_consts,
                 )
-            for gname, (c_rows, c_emb) in cold_aux.items():
-                tables[gname], emb_state[gname] = _scatter_cold(
-                    tables[gname], emb_state[gname], c_rows, c_emb,
-                    self._state_consts,
-                )
+                if gname in evict_aux:
+                    evict_payload[gname] = payload
             for gname, restores in restore_aux.items():
                 for payload, src_idx, dst_rows in restores:
                     tables[gname], emb_state[gname] = _restore_rows(
@@ -1202,11 +1240,13 @@ class CachedTrainCtx:
         return self._last_metrics
 
     def drain(self) -> Optional[Dict]:
-        """Land any deferred write-back and return the last step's metrics."""
+        """Land any deferred write-back and return the last step's metrics
+        (materializing a ``fetch_final=False`` stream's stashed header if
+        that is the freshest result)."""
         if self._pending is not None:
             self._fetch_metrics()
             self._land_pending()
-        return self._last_metrics
+        return self.last_metrics()
 
     # -------------------------------------------------------------- pipeline
 

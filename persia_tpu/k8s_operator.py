@@ -125,6 +125,9 @@ class Reconciler:
     def __init__(self, api: KubeApi, namespace: str = "default"):
         self.api = api
         self.namespace = namespace
+        # every namespace desired state has EVER touched: a deleted CR's
+        # namespace must stay observed or its orphans would never be swept
+        self._known_namespaces = {namespace}
         self._stop = threading.Event()
 
     def reconcile_once(self) -> Dict[str, int]:
@@ -141,13 +144,14 @@ class Reconciler:
             for obj in generate_manifests(spec):
                 desired[_obj_key(obj)] = obj
 
-        # observe every namespace the desired state touches (CRs are listed
-        # cluster-wide; a job in another namespace must still converge and
-        # its orphans must still be swept), plus the operator's own
-        namespaces = {self.namespace} | {ns for _, ns, _ in desired}
+        # observe every namespace desired state touches now OR ever touched
+        # before (CRs are listed cluster-wide; after a cross-namespace CR is
+        # deleted its namespace no longer appears in `desired`, but its
+        # leftover resources still must be swept)
+        self._known_namespaces |= {ns for _, ns, _ in desired}
         actual = {
             _obj_key(o): o
-            for ns in sorted(namespaces)
+            for ns in sorted(self._known_namespaces)
             for o in self.api.list_labeled(ns)
         }
 

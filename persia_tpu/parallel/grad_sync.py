@@ -169,18 +169,21 @@ def ring_neighbor_average(params, sync_idx, axis: str, n: int):
     (odd) — pass the per-sync ordinal, not the raw step, so alternation
     survives any sync period.
 
-    ``ppermute`` both ways and select — under jit the parity is traced, so
-    both permutes must exist; XLA dead-code-eliminates nothing here but a
-    param-sized ppermute is exactly the message decentralized SGD pays.
+    The direction is a ``lax.cond`` on the replicated ordinal, so exactly
+    ONE param-sized ppermute executes per sync — the message decentralized
+    SGD pays, not both directions.
     """
     fwd = [(i, (i + 1) % n) for i in range(n)]
     bwd = [(i, (i - 1) % n) for i in range(n)]
     use_fwd = (sync_idx % 2) == 0
 
     def one(p):
-        from_fwd = jax.lax.ppermute(p, axis, fwd)
-        from_bwd = jax.lax.ppermute(p, axis, bwd)
-        peer = jnp.where(use_fwd, from_fwd, from_bwd)
+        peer = jax.lax.cond(
+            use_fwd,
+            lambda q: jax.lax.ppermute(q, axis, fwd),
+            lambda q: jax.lax.ppermute(q, axis, bwd),
+            p,
+        )
         return (p + peer) * 0.5
 
     return jax.tree.map(one, params)
@@ -314,23 +317,32 @@ def build_sync_train_step(
         updates, new_opt_state = optimizer.update(param_grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
 
+        # collectives are gated by lax.cond on the (replicated) step counter,
+        # NOT computed-then-jnp.where-discarded: the whole point of these
+        # algorithms is paying the parameter-sized message only on sync
+        # steps, and every replica agrees on the predicate so conditional
+        # collectives are SPMD-safe
         step_no = state.step + 1
         if isinstance(algorithm, Decentralized):
             sync_now = (step_no % algorithm.period) == 0
             # direction alternates per SYNC (not per raw step): with an even
             # period a raw-step parity would pick the same neighbor forever
             sync_idx = step_no // algorithm.period
-            avged = ring_neighbor_average(new_params, sync_idx, "data", n)
-            new_params = jax.tree.map(
-                lambda a, p: jnp.where(sync_now, a, p), avged, new_params
+            new_params = jax.lax.cond(
+                sync_now,
+                lambda p: ring_neighbor_average(p, sync_idx, "data", n),
+                lambda p: p,
+                new_params,
             )
         elif isinstance(algorithm, LocalSGD):
             sync_now = (step_no % algorithm.period) == 0
-            meaned = jax.tree.map(
-                lambda p: jax.lax.pmean(p, "data"), new_params
-            )
-            new_params = jax.tree.map(
-                lambda m, p: jnp.where(sync_now, m, p), meaned, new_params
+            new_params = jax.lax.cond(
+                sync_now,
+                lambda p: jax.tree.map(
+                    lambda x: jax.lax.pmean(x, "data"), p
+                ),
+                lambda p: p,
+                new_params,
             )
 
         if local_params:

@@ -486,3 +486,22 @@ def test_train_stream_advances_adam_batch_state():
         cached.train_stream(batches)
     b1, b2 = cstore._batch_state[0]
     np.testing.assert_allclose(b1, Adam(lr=0.01).config.beta1 ** 3, rtol=1e-6)
+
+
+def test_native_uniform_init_matches_golden():
+    """C++ cold-miss init (native/cache.cpp cache_uniform_init) must be
+    bit-identical to the numpy golden model the PS seeds entries with."""
+    from persia_tpu.embedding.hashing import uniform_init_for_signs
+    from persia_tpu.embedding.hbm_cache import native_uniform_init
+
+    rng = np.random.default_rng(7)
+    signs = rng.integers(0, 1 << 63, 257, dtype=np.uint64)
+    for seed, dim, lo, hi in [(0, 8, -0.01, 0.01), (123, 16, -1.0, 0.5)]:
+        golden = uniform_init_for_signs(signs, seed, dim, lo, hi)
+        native = native_uniform_init(signs, seed, dim, lo, hi)
+        np.testing.assert_array_equal(golden, native)
+        # in-place fill into a padded buffer (the prepare_batch pattern)
+        out = np.zeros((300, dim), dtype=np.float32)
+        native_uniform_init(signs, seed, dim, lo, hi, out=out[: len(signs)])
+        np.testing.assert_array_equal(golden, out[: len(signs)])
+        np.testing.assert_array_equal(out[len(signs):], 0)
