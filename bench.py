@@ -295,10 +295,15 @@ def _run_mode_isolated(mode: str) -> float:
     env = dict(os.environ, BENCH_MODE=mode)
     out = subprocess.run(
         [sys.executable, os.path.abspath(__file__)],
-        env=env, capture_output=True, text=True, check=True,
+        env=env, capture_output=True, text=True,
     )
-    line = out.stdout.strip().splitlines()[-1]
-    return float(json.loads(line)["modes"][mode])
+    lines = out.stdout.strip().splitlines()
+    if out.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"bench mode {mode!r} failed (rc={out.returncode}); stderr tail:\n"
+            + "\n".join(out.stderr.strip().splitlines()[-15:])
+        )
+    return float(json.loads(lines[-1])["modes"][mode])
 
 
 def main():

@@ -54,8 +54,10 @@ class KubeApi:
     def list_jobs(self) -> List[Dict[str, Any]]:
         raise NotImplementedError
 
-    def list_labeled(self, namespace: str) -> List[Dict[str, Any]]:
-        """All framework-labeled Pods/Services/Deployments."""
+    def list_labeled(self, namespace: Optional[str]) -> List[Dict[str, Any]]:
+        """All framework-labeled Pods/Services/Deployments; ``None`` means
+        every namespace (the reconciler's observation scope — it must survive
+        restarts, so it cannot rely on remembering namespaces)."""
         raise NotImplementedError
 
     def create(self, obj: Dict[str, Any]) -> None:
@@ -90,13 +92,14 @@ class KubectlApi(KubeApi):
         except subprocess.CalledProcessError:
             return []
 
-    def list_labeled(self, namespace: str) -> List[Dict[str, Any]]:
+    def list_labeled(self, namespace: Optional[str]) -> List[Dict[str, Any]]:
+        scope = ["--all-namespaces"] if namespace is None else ["-n", namespace]
         objs: List[Dict[str, Any]] = []
         for kind in ("pods", "services", "deployments"):
             try:
                 objs.extend(
                     self._run_json(
-                        ["get", kind, "-n", namespace, "-l", JOB_LABEL]
+                        ["get", kind, *scope, "-l", JOB_LABEL]
                     ).get("items", [])
                 )
             except subprocess.CalledProcessError:
@@ -125,9 +128,6 @@ class Reconciler:
     def __init__(self, api: KubeApi, namespace: str = "default"):
         self.api = api
         self.namespace = namespace
-        # every namespace desired state has EVER touched: a deleted CR's
-        # namespace must stay observed or its orphans would never be swept
-        self._known_namespaces = {namespace}
         self._stop = threading.Event()
 
     def reconcile_once(self) -> Dict[str, int]:
@@ -144,16 +144,11 @@ class Reconciler:
             for obj in generate_manifests(spec):
                 desired[_obj_key(obj)] = obj
 
-        # observe every namespace desired state touches now OR ever touched
-        # before (CRs are listed cluster-wide; after a cross-namespace CR is
-        # deleted its namespace no longer appears in `desired`, but its
-        # leftover resources still must be swept)
-        self._known_namespaces |= {ns for _, ns, _ in desired}
-        actual = {
-            _obj_key(o): o
-            for ns in sorted(self._known_namespaces)
-            for o in self.api.list_labeled(ns)
-        }
+        # observe CLUSTER-WIDE, matching the cluster-wide CR listing: a
+        # deleted cross-namespace CR's leftovers must be swept even after an
+        # operator restart, so the observation scope cannot depend on any
+        # remembered state
+        actual = {_obj_key(o): o for o in self.api.list_labeled(None)}
 
         # replace failed pods first (restartPolicy at the controller level)
         for key, obj in list(actual.items()):

@@ -103,7 +103,7 @@ def _batches(n, batch_size=32, seed=0, multi=False):
     return out
 
 
-def _make_cached(optimizer, cache_rows, prefix_bit=8, seed=11):
+def _make_cached(optimizer, cache_rows, prefix_bit=8, seed=11, mesh=None):
     import optax
 
     from persia_tpu.models import DNN
@@ -120,6 +120,7 @@ def _make_cached(optimizer, cache_rows, prefix_bit=8, seed=11):
         worker=worker,
         embedding_config=cfg,
         cache_rows=cache_rows,
+        mesh=mesh,
     )
     return ctx, store
 
@@ -505,3 +506,71 @@ def test_native_uniform_init_matches_golden():
         native_uniform_init(signs, seed, dim, lo, hi, out=out[: len(signs)])
         np.testing.assert_array_equal(golden, out[: len(signs)])
         np.testing.assert_array_equal(out[len(signs):], 0)
+
+
+def test_cached_on_dp_mesh_matches_single_device():
+    """The cached tier on an 8-device DP mesh (batch sharded over ``data``,
+    cache pools replicated — XLA reduces the scatter deltas like replicated
+    dense grads) must track the meshless run, including through evictions
+    and the flush-to-PS path."""
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.parallel import data_parallel_mesh
+
+    mesh = data_parallel_mesh()
+    batches = _batches(6, batch_size=32)
+
+    ctx_m, store_m = _make_cached(Adagrad(lr=0.1), cache_rows=120, mesh=mesh)
+    ctx_s, store_s = _make_cached(Adagrad(lr=0.1), cache_rows=120)
+    with ctx_m, ctx_s:
+        for b in batches:
+            mm = ctx_m.train_step(b)
+            ms = ctx_s.train_step(b)
+            assert abs(mm["loss"] - ms["loss"]) < 1e-5
+            np.testing.assert_allclose(mm["preds"], ms["preds"], atol=1e-5)
+        # eval parity on the mesh
+        eb = _batches(1, batch_size=32, seed=99)[0]
+        # bf16 model compute: sharded-vs-replicated reduction order drifts
+        # batch-norm stats a few 1e-4 over the run
+        np.testing.assert_allclose(
+            ctx_m.eval_batch(eb), ctx_s.eval_batch(eb), atol=2e-3
+        )
+        ctx_m.flush()
+        ctx_s.flush()
+    # flushed PS contents agree entry-for-entry
+    assert store_m.size() == store_s.size() > 0
+    rng = np.random.default_rng(0)
+    probe = rng.integers(0, 64, 64, dtype=np.uint64)
+    from persia_tpu.embedding.hashing import add_index_prefix
+
+    keys = add_index_prefix(probe, ctx_m.embedding_config.slots_config["cat_a"].index_prefix, 8)
+    np.testing.assert_allclose(
+        store_m.lookup(keys, 8, train=False),
+        store_s.lookup(keys, 8, train=False),
+        atol=1e-4,
+    )
+
+
+def test_train_stream_on_mesh_matches_sync_path():
+    """The pipelined train_stream over the 8-device DP mesh — including the
+    hazard gate's device-side restore path (tiny cache → constant evictions
+    and re-misses) — must match the meshless synchronous run's final PS
+    state."""
+    from persia_tpu.parallel import data_parallel_mesh
+
+    batches = _batches(8, seed=23)
+
+    def run(mesh):
+        cached, cstore = _make_cached(Adagrad(lr=0.1), cache_rows=100, mesh=mesh)
+        with cached:
+            m = cached.train_stream(batches)
+            assert m is not None and np.isfinite(m["loss"])
+            cached.flush()
+        return _store_entries(cstore, _cfg())
+
+    sync_e = run(None)
+    mesh_e = run(data_parallel_mesh())
+    assert set(sync_e) == set(mesh_e)
+    for k in sync_e:
+        np.testing.assert_allclose(
+            mesh_e[k], sync_e[k], rtol=2e-4, atol=2e-6, err_msg=str(k)
+        )

@@ -22,7 +22,8 @@ class FakeKubeApi(KubeApi):
     def list_labeled(self, namespace):
         return [
             o for o in self.objs.values()
-            if o.get("metadata", {}).get("namespace", "default") == namespace
+            if (namespace is None
+                or o.get("metadata", {}).get("namespace", "default") == namespace)
             and JOB_LABEL in o.get("metadata", {}).get("labels", {})
         ]
 
