@@ -129,6 +129,23 @@ struct Cache {
     ++count;
     return r;
   }
+
+  // batch-local scratch for cache_admit_positions (reused across calls)
+  std::vector<uint64_t> scratch_sign;
+  std::vector<int64_t> scratch_val;  // -1 = empty
+  uint64_t scratch_mask = 0;
+
+  void scratch_reserve(int64_t n) {
+    uint64_t want = 16;
+    while (want < (uint64_t)n * 2) want <<= 1;
+    if (want > scratch_sign.size()) {
+      scratch_sign.assign(want, 0);
+      scratch_val.assign(want, -1);
+      scratch_mask = want - 1;
+    } else {
+      std::fill(scratch_val.begin(), scratch_val.end(), (int64_t)-1);
+    }
+  }
 };
 
 }  // namespace
@@ -187,6 +204,86 @@ int64_t cache_admit(void* h, const uint64_t* signs, int64_t n,
     }
     rows_out[i] = c.insert(signs[i]);
   }
+  *n_evict_out = n_evict;
+  return n_miss;
+}
+
+// Positions-level admit: like cache_admit but over a RAW (duplicated) sign
+// stream — e.g. the concatenated (slot, batch) single-id matrix — with the
+// dedup done here. One call replaces the per-slot dedup + cross-slot dedup +
+// admit + per-position row LUT the Python tier used to run (the 1-core
+// feeder's dominant prepare cost). Outputs:
+//   rows_out[i]        (n,)  int32 cache row of position i
+//   miss_signs_out     (<=n) first-seen-order distinct missing signs
+//   miss_rows_out      (<=n) the row each miss was assigned
+//   evict_*_out        (<=n) write-back victims
+//   n_unique_out       distinct signs in the batch
+//   n_evict_out        eviction count
+// Returns n_miss, or -1 if the batch's distinct count exceeds capacity
+// (outputs are then undefined; no rows were admitted or evicted, though
+// resident signs seen before the overflow was detected keep their LRU
+// touch — harmless, the caller raises).
+int64_t cache_admit_positions(void* h, const uint64_t* signs, int64_t n,
+                              int32_t* rows_out,
+                              uint64_t* miss_signs_out, int64_t* miss_rows_out,
+                              uint64_t* evict_signs_out, int64_t* evict_rows_out,
+                              int64_t* n_unique_out, int64_t* n_evict_out) {
+  Cache& c = *static_cast<Cache*>(h);
+  *n_evict_out = 0;
+  c.scratch_reserve(n);
+  // pass 1: dedup + touch residents; misses get ordinal placeholders.
+  // scratch_val holds: row (>=0, resident seen this batch) or
+  // -(miss_ordinal + 2) for a pending miss.
+  int64_t n_unique = 0, n_miss = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t s = signs[i];
+    uint64_t j = c.scratch_mask & splitmix64(s);
+    int64_t v;
+    for (;;) {
+      v = c.scratch_val[j];
+      if (v == -1 || c.scratch_sign[j] == s) break;
+      j = (j + 1) & c.scratch_mask;
+    }
+    if (v == -1) {  // first time this batch
+      ++n_unique;
+      const int64_t pos = c.find_pos(s);
+      if (pos >= 0) {
+        const int64_t r = c.table_row[pos];
+        c.touch(r);
+        v = r;
+      } else {
+        miss_signs_out[n_miss] = s;
+        v = -(n_miss + 2);
+        ++n_miss;
+      }
+      c.scratch_sign[j] = s;
+      c.scratch_val[j] = v;
+    }
+    rows_out[i] = (int32_t)v;  // miss placeholders fixed in pass 3
+  }
+  if (n_unique > c.capacity) {
+    // nothing admitted yet (only LRU touches happened) — safe to bail
+    return -1;
+  }
+  // pass 2: assign rows to misses (evicting LRU residents not in this batch)
+  int64_t n_evict = 0;
+  for (int64_t m = 0; m < n_miss; ++m) {
+    if (c.count >= c.capacity) {
+      uint64_t ev_sign;
+      const int64_t ev_row = c.evict_lru(&ev_sign);
+      evict_signs_out[n_evict] = ev_sign;
+      evict_rows_out[n_evict] = ev_row;
+      ++n_evict;
+      c.free_rows.push_back(ev_row);
+    }
+    miss_rows_out[m] = c.insert(miss_signs_out[m]);
+  }
+  // pass 3: resolve miss placeholders to their assigned rows
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t v = rows_out[i];
+    if (v < 0) rows_out[i] = (int32_t)miss_rows_out[-(int64_t)v - 2];
+  }
+  *n_unique_out = n_unique;
   *n_evict_out = n_evict;
   return n_miss;
 }

@@ -116,6 +116,12 @@ def _load_lib() -> ctypes.CDLL:
         lib.cache_probe.argtypes = [p, _u64p, i64, _i64p]
         lib.cache_drain.restype = i64
         lib.cache_drain.argtypes = [p, _u64p, _i64p]
+        _i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.cache_admit_positions.restype = i64
+        lib.cache_admit_positions.argtypes = [
+            p, _u64p, i64, _i32p, _u64p, _i64p, _u64p, _i64p,
+            ctypes.POINTER(i64), ctypes.POINTER(i64),
+        ]
         lib.cache_uniform_init.argtypes = [
             _u64p, i64, i64, ctypes.c_uint64, ctypes.c_double,
             ctypes.c_double, ctypes.POINTER(ctypes.c_float),
@@ -185,6 +191,40 @@ class CacheDirectory:
             )
         k = n_evict.value
         return rows, miss_idx[:n_miss].copy(), ev_signs[:k].copy(), ev_rows[:k].copy()
+
+    def admit_positions(self, signs: np.ndarray):
+        """Admit a RAW (duplicated) position-level sign stream — the dedup
+        happens natively. Returns (rows (n,) int32 per position,
+        miss_signs (M,), miss_rows (M,), evict_signs (K,), evict_rows (K,),
+        n_unique). One call replaces per-slot dedup + cross-slot dedup +
+        admit + row LUT for the single-id fast path."""
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        n = signs.size
+        rows = np.empty(n, dtype=np.int32)
+        miss_signs = np.empty(n, dtype=np.uint64)
+        miss_rows = np.empty(n, dtype=np.int64)
+        ev_signs = np.empty(n, dtype=np.uint64)
+        ev_rows = np.empty(n, dtype=np.int64)
+        n_unique = ctypes.c_int64(0)
+        n_evict = ctypes.c_int64(0)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        n_miss = self._lib.cache_admit_positions(
+            self._h, signs.ctypes.data_as(_u64p), n,
+            rows.ctypes.data_as(i32p),
+            miss_signs.ctypes.data_as(_u64p), miss_rows.ctypes.data_as(_i64p),
+            ev_signs.ctypes.data_as(_u64p), ev_rows.ctypes.data_as(_i64p),
+            ctypes.byref(n_unique), ctypes.byref(n_evict),
+        )
+        if n_miss < 0:
+            raise RuntimeError(
+                f"batch distinct-sign count exceeds cache capacity "
+                f"{self.capacity} — raise cache rows or shrink the batch"
+            )
+        k = n_evict.value
+        return (
+            rows, miss_signs[:n_miss].copy(), miss_rows[:n_miss].copy(),
+            ev_signs[:k].copy(), ev_rows[:k].copy(), n_unique.value,
+        )
 
     def probe(self, signs: np.ndarray) -> np.ndarray:
         """Read-only residency check: row per sign, -1 on miss. No admit, no
@@ -747,6 +787,113 @@ class CachedEmbeddingTier:
 
     # ------------------------------------------------------------ train path
 
+    def _admit_aux(
+        self, g: CacheGroup, miss_signs, rows_miss, ev_signs, ev_rows,
+        n_unique, hazard_gate, miss_aux, cold_aux, restore_aux, evict_aux,
+        evict_meta,
+    ) -> None:
+        """Post-admit bookkeeping shared by the general and single-id fast
+        paths: metrics, the cross-step write-back hazard gate, the
+        warm/cold miss split (WARM = PS holds trained state, full entry
+        ships; COLD = brand-new sign, host-seeded emb only, no PS touch
+        until eviction), and the eviction read-back bucket."""
+        C = g.rows
+        self._m_hit.inc(n_unique - len(miss_signs))
+        self._m_miss.inc(len(miss_signs))
+        self._m_evict.inc(len(ev_signs))
+
+        resolved = None
+        if hazard_gate is not None and len(miss_signs):
+            resolved = hazard_gate(g.name, miss_signs)
+
+        m = len(miss_signs)
+        if m:
+            handled = np.zeros(m, dtype=bool)
+            if resolved:
+                for payload, src_idx, pos in resolved:
+                    handled[pos] = True
+                    # pow2-bucketed; src pad reads row 0 harmlessly, dst
+                    # pad C+1 is dropped by the scatter
+                    S = len(pos)
+                    sp = _round_up_pow2(S)
+                    src = np.zeros(sp, dtype=np.int64)
+                    dst = np.full(sp, C + 1, dtype=np.int32)
+                    src[:S] = src_idx
+                    dst[:S] = rows_miss[pos]
+                    restore_aux.setdefault(g.name, []).append(
+                        (payload, src, dst)
+                    )
+            warm, vals = self._probe(miss_signs, g.dim)
+            widx = np.nonzero(warm & ~handled)[0]
+            cidx = np.nonzero(~warm & ~handled)[0]
+            if len(widx):
+                entry_len = g.dim + g.state_dim
+                wp = _bucket(len(widx))
+                w_rows = np.full(wp, C + 1, dtype=np.int32)
+                w_entries = np.zeros((wp, entry_len), dtype=np.float32)
+                w_rows[:len(widx)] = rows_miss[widx]
+                w_entries[:len(widx)] = vals[widx]
+                miss_aux[g.name] = (w_rows, w_entries)
+            if len(cidx):
+                lo, hi = self.init_bounds
+                cp = _bucket(len(cidx))
+                c_rows = np.full(cp, C + 1, dtype=np.int32)
+                c_emb = np.zeros((cp, g.dim), dtype=np.float32)
+                c_rows[:len(cidx)] = rows_miss[cidx]
+                native_uniform_init(
+                    miss_signs[cidx], self.init_seed, g.dim, lo, hi,
+                    out=c_emb[:len(cidx)],
+                )
+                cold_aux[g.name] = (c_rows, c_emb)
+        # evictions: rows to read back (pad → zero row, host slices K)
+        k = len(ev_rows)
+        if k:
+            kp = _bucket(k)
+            e_rows = np.full(kp, C, dtype=np.int32)
+            e_rows[:k] = ev_rows
+            evict_aux[g.name] = e_rows
+            evict_meta[g.name] = (ev_signs, k)
+
+    def _single_id_groups(self, batch: PersiaBatch):
+        """The fast-path precondition: EVERY group is pooled-only, no
+        hash-stack, no sqrt scaling, and every feature carries exactly one
+        id per sample. Returns [(group, slot_names, (S, B) prefixed sign
+        matrix), ...] or None (→ general path)."""
+        feats = {f.name: f for f in batch.id_type_features}
+        for name in feats:
+            if name not in self._slot_group:
+                # same loud failure the general path's preprocess raises
+                raise KeyError(f"unknown slot {name!r} (not in embedding config)")
+        from persia_tpu.embedding.hashing import add_index_prefix
+
+        out = []
+        for g in self.groups:
+            names = [n for n in g.pooled_slots if n in feats]
+            if any(n in feats for n in g.raw_slots):
+                return None
+            if not names:
+                continue
+            mat = None
+            for i, name in enumerate(names):
+                scfg = self.cfg.slot(name)
+                if scfg.sqrt_scaling or scfg.hash_stack_config.enabled:
+                    return None
+                flat, counts = feats[name].flat_counts()
+                # exactly one id per sample — a total that merely EQUALS the
+                # batch size (counts like [2, 0, 1, ...]) would misalign ids
+                # to samples
+                if len(flat) != len(counts) or not (counts == 1).all():
+                    return None
+                if mat is None:
+                    mat = np.empty((len(names), len(counts)), dtype=np.uint64)
+                mat[i] = add_index_prefix(
+                    flat.astype(np.uint64, copy=False),
+                    scfg.index_prefix,
+                    self.cfg.feature_index_prefix_bit,
+                )
+            out.append((g, tuple(names), mat))
+        return out
+
     def prepare_batch(
         self,
         batch: PersiaBatch,
@@ -769,6 +916,9 @@ class CachedEmbeddingTier:
         the resolved indices into ``miss_signs`` — and those signs are
         re-admitted by an on-device row restore instead of a host checkout.
         ``None`` means no overlap."""
+        fast = self._single_id_groups(batch)
+        if fast is not None:
+            return self._prepare_batch_single_id(batch, fast, hazard_gate)
         pb = preprocess_batch(batch.id_type_features, self.cfg)
         slots_by_group = self._group_slots(pb)
 
@@ -792,67 +942,11 @@ class CachedEmbeddingTier:
             rows_u, miss_idx, ev_signs, ev_rows = self.dirs[g.name].admit(uniq)
             rows = rows_u[inv]  # per original (slot-concatenated) position
             miss_signs = uniq[miss_idx]
-            self._m_hit.inc(len(uniq) - len(miss_idx))
-            self._m_miss.inc(len(miss_idx))
-            self._m_evict.inc(len(ev_signs))
-
-            # cross-step write-back hazard: a pending evicted sign re-missed
-            resolved = None
-            if hazard_gate is not None and len(miss_signs):
-                resolved = hazard_gate(g.name, miss_signs)
-
-            # split misses: WARM (the PS holds trained state — full entry
-            # ships) vs COLD (brand-new sign — only the host-seeded emb
-            # ships at dim width; state tail is a device-side constant and
-            # the PS is not touched until eviction writes the row back)
-            m = len(miss_signs)
-            if m:
-                rows_miss = rows_u[miss_idx]
-                handled = np.zeros(m, dtype=bool)
-                if resolved:
-                    for payload, src_idx, pos in resolved:
-                        handled[pos] = True
-                        # pow2-bucketed; src pad reads row 0 harmlessly, dst
-                        # pad C+1 is dropped by the scatter
-                        S = len(pos)
-                        sp = _round_up_pow2(S)
-                        src = np.zeros(sp, dtype=np.int64)
-                        dst = np.full(sp, C + 1, dtype=np.int32)
-                        src[:S] = src_idx
-                        dst[:S] = rows_miss[pos]
-                        restore_aux.setdefault(g.name, []).append(
-                            (payload, src, dst)
-                        )
-                warm, vals = self._probe(miss_signs, g.dim)
-                widx = np.nonzero(warm & ~handled)[0]
-                cidx = np.nonzero(~warm & ~handled)[0]
-                if len(widx):
-                    entry_len = g.dim + g.state_dim
-                    wp = _bucket(len(widx))
-                    w_rows = np.full(wp, C + 1, dtype=np.int32)
-                    w_entries = np.zeros((wp, entry_len), dtype=np.float32)
-                    w_rows[:len(widx)] = rows_miss[widx]
-                    w_entries[:len(widx)] = vals[widx]
-                    miss_aux[g.name] = (w_rows, w_entries)
-                if len(cidx):
-                    lo, hi = self.init_bounds
-                    cp = _bucket(len(cidx))
-                    c_rows = np.full(cp, C + 1, dtype=np.int32)
-                    c_emb = np.zeros((cp, g.dim), dtype=np.float32)
-                    c_rows[:len(cidx)] = rows_miss[cidx]
-                    native_uniform_init(
-                        miss_signs[cidx], self.init_seed, g.dim, lo, hi,
-                        out=c_emb[:len(cidx)],
-                    )
-                    cold_aux[g.name] = (c_rows, c_emb)
-            # evictions: rows to read back (pad → zero row, host slices K)
-            k = len(ev_rows)
-            if k:
-                kp = _bucket(k)
-                e_rows = np.full(kp, C, dtype=np.int32)
-                e_rows[:k] = ev_rows
-                evict_aux[g.name] = e_rows
-                evict_meta[g.name] = (ev_signs, k)
+            self._admit_aux(
+                g, miss_signs, rows_u[miss_idx], ev_signs, ev_rows,
+                len(uniq), hazard_gate,
+                miss_aux, cold_aux, restore_aux, evict_aux, evict_meta,
+            )
 
             # per-slot row matrices: pooled slots stack into (S, B, L)
             pooled, L = self._stack_layout(g, slots)
@@ -891,6 +985,44 @@ class CachedEmbeddingTier:
         }
         if any_scale:
             device_inputs["stacked_scale"] = stacked_scale
+        layout = CacheLayout(stacked=tuple(layout_stacked))
+        return (
+            device_inputs, layout, miss_aux, cold_aux, restore_aux,
+            evict_aux, evict_meta,
+        )
+
+    def _prepare_batch_single_id(self, batch: PersiaBatch, fast, hazard_gate):
+        """Single-id fast path: ONE native call per group
+        (``cache_admit_positions``: dedup + admit + per-position rows) and
+        the row matrix is its output reshaped — no per-slot dedup, no row
+        LUT, no stack copy. Dominates the 1-core feeder's budget on the
+        Criteo-style all-single-id shape."""
+        stacked_rows: Dict[str, np.ndarray] = {}
+        layout_stacked: List[Tuple[str, Tuple[str, ...]]] = []
+        miss_aux: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        cold_aux: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        restore_aux: Dict[str, List] = {}
+        evict_aux: Dict[str, np.ndarray] = {}
+        evict_meta: Dict[str, Tuple[np.ndarray, int]] = {}
+
+        for g, names, mat in fast:
+            S, B = mat.shape
+            (rows, miss_signs, miss_rows, ev_signs, ev_rows,
+             n_unique) = self.dirs[g.name].admit_positions(mat.reshape(-1))
+            self._admit_aux(
+                g, miss_signs, miss_rows, ev_signs, ev_rows, n_unique,
+                hazard_gate, miss_aux, cold_aux, restore_aux, evict_aux,
+                evict_meta,
+            )
+            stacked_rows[g.name] = rows.reshape(S, B, 1)
+            layout_stacked.append((g.name, names))
+
+        device_inputs = {
+            "dense": [f.data.astype(np.float32) for f in batch.non_id_type_features],
+            "labels": [l.data.astype(np.float32) for l in batch.labels],
+            "stacked_rows": stacked_rows,
+            "raw_rows": {},
+        }
         layout = CacheLayout(stacked=tuple(layout_stacked))
         return (
             device_inputs, layout, miss_aux, cold_aux, restore_aux,

@@ -102,8 +102,15 @@ class KubectlApi(KubeApi):
                         ["get", kind, *scope, "-l", JOB_LABEL]
                     ).get("items", [])
                 )
-            except subprocess.CalledProcessError:
-                pass
+            except subprocess.CalledProcessError as e:
+                # an empty view must never be SILENT: under namespace-scoped
+                # RBAC a cluster-wide list fails and the reconciler would
+                # otherwise re-apply everything forever without a trace
+                logger.warning(
+                    "kubectl get %s %s failed: %s", kind, " ".join(scope),
+                    (e.stderr or b"").strip() if isinstance(e.stderr, (bytes, str))
+                    else e,
+                )
         return objs
 
     def create(self, obj: Dict[str, Any]) -> None:
@@ -127,6 +134,8 @@ class Reconciler:
 
     def __init__(self, api: KubeApi, namespace: str = "default"):
         self.api = api
+        # observation is cluster-wide; this is only the RBAC fallback scope
+        # (see reconcile_once) and the REST tier's default
         self.namespace = namespace
         self._stop = threading.Event()
 
@@ -147,8 +156,13 @@ class Reconciler:
         # observe CLUSTER-WIDE, matching the cluster-wide CR listing: a
         # deleted cross-namespace CR's leftovers must be swept even after an
         # operator restart, so the observation scope cannot depend on any
-        # remembered state
-        actual = {_obj_key(o): o for o in self.api.list_labeled(None)}
+        # remembered state. Under namespace-scoped RBAC the cluster-wide
+        # list fails (and logs); fall back to the operator's own namespace
+        # so convergence still works within the granted scope.
+        listed = self.api.list_labeled(None)
+        if not listed:
+            listed = self.api.list_labeled(self.namespace)
+        actual = {_obj_key(o): o for o in listed}
 
         # replace failed pods first (restartPolicy at the controller level)
         for key, obj in list(actual.items()):

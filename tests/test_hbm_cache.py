@@ -574,3 +574,30 @@ def test_train_stream_on_mesh_matches_sync_path():
         np.testing.assert_allclose(
             mesh_e[k], sync_e[k], rtol=2e-4, atol=2e-6, err_msg=str(k)
         )
+
+
+def test_single_id_fast_path_matches_general_path():
+    """The native positions-level admit (fast path) must produce the same
+    trained PS state as the general per-slot-dedup path on the same
+    single-id stream (row assignment may differ; training results must
+    not)."""
+    batches = _batches(8, seed=31)  # single-id → fast path eligible
+
+    def run(disable_fast: bool):
+        cached, cstore = _make_cached(Adagrad(lr=0.1), cache_rows=100)
+        if disable_fast:
+            cached.tier._single_id_groups = lambda batch: None
+        with cached:
+            for b in batches:
+                cached.train_step(b, fetch_metrics=False)
+            cached.drain()
+            cached.flush()
+        return _store_entries(cstore, _cfg())
+
+    fast_e = run(False)
+    slow_e = run(True)
+    assert set(fast_e) == set(slow_e)
+    for k in fast_e:
+        np.testing.assert_allclose(
+            fast_e[k], slow_e[k], rtol=1e-5, atol=1e-7, err_msg=str(k)
+        )
