@@ -1,0 +1,98 @@
+"""Wire-format microbenchmark: PersiaBatch serialize/deserialize throughput.
+
+Parity target: the reference's criterion benches of the inference request
+path (`rust/others/persia-common-benchmark/benches/serialize_inf_request.rs`
+— speedy vs serde formats on an id-feature batch). Here the custom
+little-endian wire format (persia_tpu/data.py to_bytes/from_bytes, shared
+with the C++ services) is measured on the same two shapes the reference
+uses: a single-id inference request and a multi-id (LIL) training batch.
+
+Prints one JSON line per case:
+  {"case": ..., "bytes": N, "encode_us": ..., "decode_us": ...,
+   "encode_MBps": ..., "decode_MBps": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from persia_tpu.data import IDTypeFeature, IDTypeFeatureWithSingleID, Label, NonIDTypeFeature, PersiaBatch
+
+
+def _single_id_batch(batch_size=128, n_slots=16):
+    rng = np.random.default_rng(0)
+    return PersiaBatch(
+        [
+            IDTypeFeatureWithSingleID(
+                f"slot_{i}", rng.integers(0, 1 << 40, batch_size, dtype=np.uint64)
+            )
+            for i in range(n_slots)
+        ],
+        non_id_type_features=[
+            NonIDTypeFeature(rng.normal(size=(batch_size, 13)).astype(np.float32))
+        ],
+        labels=[Label(rng.integers(0, 2, (batch_size, 1)).astype(np.float32))],
+        requires_grad=False,
+    )
+
+
+def _lil_batch(batch_size=128, n_slots=8, max_len=24):
+    rng = np.random.default_rng(1)
+    return PersiaBatch(
+        [
+            IDTypeFeature(
+                f"slot_{i}",
+                [
+                    rng.integers(0, 1 << 40, rng.integers(0, max_len), dtype=np.uint64)
+                    for _ in range(batch_size)
+                ],
+            )
+            for i in range(n_slots)
+        ],
+        non_id_type_features=[
+            NonIDTypeFeature(rng.normal(size=(batch_size, 13)).astype(np.float32))
+        ],
+        labels=[Label(rng.integers(0, 2, (batch_size, 1)).astype(np.float32))],
+        requires_grad=True,
+    )
+
+
+def bench_case(name: str, batch: PersiaBatch, reps: int = 200) -> dict:
+    wire = batch.to_bytes()
+    nbytes = len(wire)
+    # warm
+    for _ in range(5):
+        batch.to_bytes()
+        PersiaBatch.from_bytes(wire)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        batch.to_bytes()
+    enc = (time.perf_counter() - t0) / reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        PersiaBatch.from_bytes(wire)
+    dec = (time.perf_counter() - t0) / reps
+    return {
+        "case": name,
+        "bytes": nbytes,
+        "encode_us": round(enc * 1e6, 1),
+        "decode_us": round(dec * 1e6, 1),
+        "encode_MBps": round(nbytes / enc / 1e6, 1),
+        "decode_MBps": round(nbytes / dec / 1e6, 1),
+    }
+
+
+def main() -> None:
+    for name, batch in (
+        ("infer_single_id_128x16", _single_id_batch()),
+        ("train_lil_128x8", _lil_batch()),
+        ("infer_single_id_4096x26", _single_id_batch(4096, 26)),
+    ):
+        print(json.dumps(bench_case(name, batch)))
+
+
+if __name__ == "__main__":
+    main()

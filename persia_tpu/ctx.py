@@ -212,6 +212,9 @@ class TrainCtx(EmbeddingCtx):
         self.dense_optimizer = dense_optimizer
         self.embedding_optimizer = embedding_optimizer
         self.grad_scale = grad_scale
+        # (device header, batch) of the latest fetch_metrics=False prepared
+        # step — materialized by last_prepared_metrics()
+        self._deferred_header = None
         # dynamic mixed-precision loss scaling (ref: GradScaler management,
         # persia/ctx.py:926-1005): on-device finite check every step,
         # skip-step + scale backoff on overflow, periodic growth
@@ -292,15 +295,27 @@ class TrainCtx(EmbeddingCtx):
                 out[k] = metrics[k]
         return out
 
-    def train_step_prepared(self, training_batch, loader) -> Dict:
+    def train_step_prepared(
+        self, training_batch, loader, fetch_metrics: bool = True
+    ) -> Optional[Dict]:
         """Pipelined step: consume a ``PersiaTrainingBatch`` from a
         ``DataLoader``; the embedding gradients return asynchronously through
         the loader's BackwardEngine (bounded staleness). The TPU step of batch
         N overlaps the lookup of batch N+k (ref: forward.rs pipeline +
-        backward.rs)."""
+        backward.rs).
+
+        ``fetch_metrics=False`` (static loss scale only — the dynamic scale
+        must be read every step) skips the per-step header fetch: on a
+        remote-attached chip that device→host read costs tens of ms and
+        permanently degrades dispatch latency, so metric-light loops fetch
+        once at the end via :meth:`last_prepared_metrics`. Returns ``None``
+        in that mode."""
         device_batch = training_batch.device_batch
         if self.state is None:
             self.init_state(jax.random.PRNGKey(0), device_batch)
+        defer = not fetch_metrics and not self.dynamic_loss_scale
+        if not defer:
+            self._deferred_header = None  # this step's metrics are fresher
         try:
             self.state, (header, gpacked) = self._train_step_jit(self.state, device_batch)
             # start the bulk gradient download without blocking; the
@@ -310,7 +325,15 @@ class TrainCtx(EmbeddingCtx):
                 gpacked.copy_to_host_async()
             except AttributeError:
                 pass
-            if self.dynamic_loss_scale:
+            if defer:
+                # stash only the labels SHAPE: keeping the device_batch
+                # would pin the whole batch's device buffers until the
+                # deferred fetch
+                self._deferred_header = (
+                    header, tuple(device_batch["labels"][0].shape)
+                )
+                dyn_scale, scale, finite = None, self.grad_scale, None
+            elif self.dynamic_loss_scale:
                 loss, preds, dyn_scale, finite = unpack_step_header_dynamic(
                     np.asarray(header), device_batch
                 )
@@ -323,11 +346,24 @@ class TrainCtx(EmbeddingCtx):
             loader.mark_consumed(training_batch)
             raise
         loader.backward_packed(training_batch, gpacked, scale_factor=scale)
+        if defer:
+            return None
         out = {"loss": loss, "preds": np.asarray(preds)}
         if finite is not None:
             out["loss_scale"] = dyn_scale
             out["grads_finite"] = finite
         return out
+
+    def last_prepared_metrics(self) -> Optional[Dict]:
+        """Materialize the most recent ``fetch_metrics=False`` step's
+        header (ONE device→host fetch, after the loop it was deferred out
+        of)."""
+        if self._deferred_header is None:
+            return None
+        header, label_shape = self._deferred_header
+        self._deferred_header = None
+        h = np.asarray(header)
+        return {"loss": float(h[0]), "preds": h[1:].reshape(label_shape)}
 
     def eval_batch(self, batch: PersiaBatch) -> np.ndarray:
         emb_batches = self.worker.forward_directly(batch, train=False)

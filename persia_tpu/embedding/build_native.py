@@ -1,0 +1,33 @@
+"""Build every native C++ core up front: ``python -m persia_tpu.embedding.build_native``.
+
+Each library also builds lazily on first use (content-hash stamped, so
+rebuilds only happen when the source changes); this entry point exists for
+images/CI that want the compile cost paid at build time, and as a quick
+toolchain check. Cores:
+
+- ``native/libpersia_ps.so`` — parameter-server store (sharded LRU +
+  sparse optimizers; ref: persia-embedding-holder + persia-simd)
+- ``native/libpersia_worker.so`` — embedding-worker hot loops (dedup,
+  shard partition, pooling; ref: embedding_worker_service preprocessing)
+- ``native/libpersia_cache.so`` — HBM write-back cache directory +
+  positions-level admit + seeded init
+"""
+
+from __future__ import annotations
+
+
+def main() -> int:
+    from persia_tpu.embedding import hbm_cache, native_store, native_worker
+
+    for name, builder in (
+        ("ps", native_store.build_native),
+        ("worker", native_worker.build_native),
+        ("cache", hbm_cache.build_native),
+    ):
+        path = builder()
+        print(f"{name}: {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -412,7 +412,9 @@ def _scatter_entry_block(table, state: Dict[str, jnp.ndarray], rows, entries):
     out_state = dict(state)
     cols = _entry_to_state_cols(out_state, entries[:, dim:])
     for key, vals in cols.items():
-        out_state[key] = out_state[key].at[rows].set(vals, mode="drop")
+        out_state[key] = out_state[key].at[rows].set(
+            vals.astype(out_state[key].dtype), mode="drop"
+        )
     return table, out_state
 
 
@@ -425,9 +427,9 @@ def _restore_rows(table, state: Dict[str, jnp.ndarray], payload, src_idx, dst_ro
     return _scatter_entry_block(table, state, dst_rows, payload[src_idx])
 
 
-@_partial(jax.jit, donate_argnums=(0, 1), static_argnums=(7,))
+@_partial(jax.jit, donate_argnums=(0, 1), static_argnums=(7, 8))
 def _apply_aux(table, state: Dict[str, jnp.ndarray], ev_rows, m_rows,
-               m_entries, c_rows, c_emb, state_consts):
+               m_entries, c_rows, c_emb, state_consts, wb_bf16=False):
     """Fused per-group per-step aux program: read the eviction payload (from
     the PRE-scatter table — a missed row may reuse an evicted one), then
     scatter warm entries and cold seeds. One dispatch instead of three:
@@ -447,6 +449,11 @@ def _apply_aux(table, state: Dict[str, jnp.ndarray], ev_rows, m_rows,
         if key in state:
             parts.append(state[key][ev_rows])
     payload = jnp.concatenate(parts, axis=1)
+    if wb_bf16:
+        # bf16 write-back wire (the reference ships f16 lookup/grad wires,
+        # lib.rs:157-180): halves the d2h bytes that bound the eviction
+        # steady state; opt-in because the default tier is bit-exact
+        payload = payload.astype(jnp.bfloat16)
     table, out_state = _scatter_entry_block(table, state, m_rows, m_entries)
     table = table.at[c_rows].set(c_emb.astype(table.dtype), mode="drop")
     for key, val in state_consts:
@@ -687,6 +694,18 @@ class CachedEmbeddingTier:
         self.groups = make_cache_groups(self.cfg, rows_per_group, sparse_cfg)
         self.dirs = {g.name: CacheDirectory(g.rows) for g in self.groups}
         self._slot_group = {s: g for g in self.groups for s in g.slots}
+        # static fast-path eligibility per slot (config is immutable): the
+        # per-batch check reduces to "every feature single-id" (the only
+        # data-dependent part)
+        self._fast_prefix: Dict[str, np.uint64] = {}
+        self._fast_eligible: Dict[str, bool] = {}
+        for name, slot in self.cfg.slots_config.items():
+            self._fast_eligible[name] = (
+                slot.embedding_summation
+                and not slot.sqrt_scaling
+                and not slot.hash_stack_config.enabled
+            )
+            self._fast_prefix[name] = slot.index_prefix
         m = get_metrics()
         self._m_hit = m.counter(
             "persia_tpu_cache_hit_count", "batch distinct signs resident in HBM"
@@ -859,25 +878,24 @@ class CachedEmbeddingTier:
         hash-stack, no sqrt scaling, and every feature carries exactly one
         id per sample. Returns [(group, slot_names, (S, B) prefixed sign
         matrix), ...] or None (→ general path)."""
+        from persia_tpu.embedding.hashing import add_index_prefix
+
         feats = {f.name: f for f in batch.id_type_features}
         for name in feats:
             if name not in self._slot_group:
                 # same loud failure the general path's preprocess raises
                 raise KeyError(f"unknown slot {name!r} (not in embedding config)")
-        from persia_tpu.embedding.hashing import add_index_prefix
+            if not self._fast_eligible[name]:  # static per-slot precompute
+                return None
 
         out = []
+        prefix_bit = self.cfg.feature_index_prefix_bit
         for g in self.groups:
             names = [n for n in g.pooled_slots if n in feats]
-            if any(n in feats for n in g.raw_slots):
-                return None
             if not names:
                 continue
             mat = None
             for i, name in enumerate(names):
-                scfg = self.cfg.slot(name)
-                if scfg.sqrt_scaling or scfg.hash_stack_config.enabled:
-                    return None
                 flat, counts = feats[name].flat_counts()
                 # exactly one id per sample — a total that merely EQUALS the
                 # batch size (counts like [2, 0, 1, ...]) would misalign ids
@@ -888,8 +906,7 @@ class CachedEmbeddingTier:
                     mat = np.empty((len(names), len(counts)), dtype=np.uint64)
                 mat[i] = add_index_prefix(
                     flat.astype(np.uint64, copy=False),
-                    scfg.index_prefix,
-                    self.cfg.feature_index_prefix_bit,
+                    self._fast_prefix[name], prefix_bit,
                 )
             out.append((g, tuple(names), mat))
         return out
@@ -1110,7 +1127,7 @@ class CachedEmbeddingTier:
             if not k:
                 continue
             g = next(gr for gr in self.groups if gr.name == gname)
-            payload = np.asarray(evict_payload[gname], dtype=np.float32)[:k]
+            payload = np.asarray(evict_payload[gname]).astype(np.float32)[:k]
             self._set_embedding(ev_signs[:k], payload, dim=g.dim)
 
     def flush(self, tables, emb_state) -> None:
@@ -1173,6 +1190,7 @@ class CachedTrainCtx:
         table_dtype=jnp.float32,
         init_seed: Optional[int] = None,
         mesh=None,
+        wb_wire_dtype: str = "float32",
     ):
         self.model = model
         self.dense_optimizer = dense_optimizer
@@ -1184,6 +1202,12 @@ class CachedTrainCtx:
         # replicas exactly like replicated dense params (the capacity tier's
         # multi-chip story — the PS side is already sharded host-side)
         self.mesh = mesh
+        if wb_wire_dtype not in ("float32", "bfloat16"):
+            raise ValueError(f"wb_wire_dtype must be float32/bfloat16, got {wb_wire_dtype!r}")
+        # bf16 eviction wire halves the d2h bytes that bound the eviction
+        # steady state (the reference ships f16 wires); default stays f32
+        # because the cached tier is otherwise bit-exact vs the pure-PS path
+        self._wb_bf16 = wb_wire_dtype == "bfloat16"
         self.tier = CachedEmbeddingTier(
             worker, self.sparse_cfg, cache_rows, embedding_config,
             init_seed=init_seed,
@@ -1361,6 +1385,7 @@ class CachedTrainCtx:
                 tables[gname], emb_state[gname], payload = _apply_aux(
                     tables[gname], emb_state[gname], ev_rows,
                     m_rows, m_entries, c_rows, c_emb, self._state_consts,
+                    self._wb_bf16,
                 )
                 if gname in evict_aux:
                     evict_payload[gname] = payload
@@ -1632,7 +1657,7 @@ class CachedTrainCtx:
                     fetches.append((seq, gn, ev, k, evict_payload[gn]))
 
             def fetch(f):
-                return np.asarray(f[4], dtype=np.float32)
+                return np.asarray(f[4]).astype(np.float32)
 
             hosts = list(pool.map(fetch, fetches)) if pool else [fetch(f) for f in fetches]
             for (seq, gn, ev, k, _p), host in zip(fetches, hosts):

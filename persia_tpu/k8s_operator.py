@@ -54,10 +54,12 @@ class KubeApi:
     def list_jobs(self) -> List[Dict[str, Any]]:
         raise NotImplementedError
 
-    def list_labeled(self, namespace: Optional[str]) -> List[Dict[str, Any]]:
-        """All framework-labeled Pods/Services/Deployments; ``None`` means
-        every namespace (the reconciler's observation scope — it must survive
-        restarts, so it cannot rely on remembering namespaces)."""
+    def list_labeled(self, namespace: Optional[str]) -> Optional[List[Dict[str, Any]]]:
+        """All framework-labeled Pods/Services/Deployments; ``namespace=None``
+        means every namespace (the reconciler's observation scope — it must
+        survive restarts, so it cannot rely on remembering namespaces). A
+        cluster-wide listing that FAILS (e.g. RBAC) returns ``None``, never
+        an empty-looking partial view."""
         raise NotImplementedError
 
     def create(self, obj: Dict[str, Any]) -> None:
@@ -92,9 +94,14 @@ class KubectlApi(KubeApi):
         except subprocess.CalledProcessError:
             return []
 
-    def list_labeled(self, namespace: Optional[str]) -> List[Dict[str, Any]]:
+    def list_labeled(self, namespace: Optional[str]) -> Optional[List[Dict[str, Any]]]:
+        """Per the KubeApi contract: returns ``None`` when the cluster-wide
+        listing FAILED (any kind) — the reconciler must distinguish 'access
+        denied' from 'no resources exist' or it would sweep/re-apply against
+        a partial view; namespaced listings stay best-effort."""
         scope = ["--all-namespaces"] if namespace is None else ["-n", namespace]
         objs: List[Dict[str, Any]] = []
+        failed = False
         for kind in ("pods", "services", "deployments"):
             try:
                 objs.extend(
@@ -103,14 +110,14 @@ class KubectlApi(KubeApi):
                     ).get("items", [])
                 )
             except subprocess.CalledProcessError as e:
-                # an empty view must never be SILENT: under namespace-scoped
-                # RBAC a cluster-wide list fails and the reconciler would
-                # otherwise re-apply everything forever without a trace
+                failed = True
                 logger.warning(
                     "kubectl get %s %s failed: %s", kind, " ".join(scope),
                     (e.stderr or b"").strip() if isinstance(e.stderr, (bytes, str))
                     else e,
                 )
+        if failed and namespace is None:
+            return None
         return objs
 
     def create(self, obj: Dict[str, Any]) -> None:
@@ -157,11 +164,12 @@ class Reconciler:
         # deleted cross-namespace CR's leftovers must be swept even after an
         # operator restart, so the observation scope cannot depend on any
         # remembered state. Under namespace-scoped RBAC the cluster-wide
-        # list fails (and logs); fall back to the operator's own namespace
-        # so convergence still works within the granted scope.
+        # list FAILS (None — distinct from 'no resources'); fall back to the
+        # operator's own namespace so convergence works within the granted
+        # scope.
         listed = self.api.list_labeled(None)
-        if not listed:
-            listed = self.api.list_labeled(self.namespace)
+        if listed is None:
+            listed = self.api.list_labeled(self.namespace) or []
         actual = {_obj_key(o): o for o in listed}
 
         # replace failed pods first (restartPolicy at the controller level)

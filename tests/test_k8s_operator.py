@@ -146,3 +146,21 @@ def test_rest_scheduler_apply_list_delete():
             urllib.request.urlopen(req)
     finally:
         srv.stop()
+
+
+def test_reconcile_rbac_fallback_to_namespace():
+    """A FAILED cluster-wide listing (None — RBAC) must fall back to the
+    operator's namespace, not be treated as an empty cluster."""
+    class ScopedApi(FakeKubeApi):
+        def list_labeled(self, namespace):
+            if namespace is None:
+                return None  # cluster-wide list denied
+            return super().list_labeled(namespace)
+
+    api = ScopedApi()
+    api.create(_cr(name="jobns"))
+    rec = Reconciler(api, namespace="default")
+    stats = rec.reconcile_once()
+    assert stats["created"] > 0
+    # idempotent: the fallback view sees what was created
+    assert rec.reconcile_once() == {"created": 0, "deleted": 0, "restarted": 0}
