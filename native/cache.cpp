@@ -297,6 +297,20 @@ void cache_probe(void* h, const uint64_t* signs, int64_t n, int64_t* rows_out) {
   }
 }
 
+// Non-destructive listing of every resident (sign, row) pair in LRU order
+// (MRU first): the serving-freshness publish path reads resident rows
+// without disturbing the directory.
+int64_t cache_snapshot(void* h, uint64_t* signs_out, int64_t* rows_out) {
+  Cache& c = *static_cast<Cache*>(h);
+  int64_t k = 0;
+  for (int64_t r = c.lru_head; r >= 0; r = c.next[r]) {
+    signs_out[k] = c.row_sign[r];
+    rows_out[k] = r;
+    ++k;
+  }
+  return k;
+}
+
 // Drain every resident entry (for flush-all at checkpoint/eval boundaries):
 // writes all (sign, row) pairs in LRU order (MRU first) and empties the
 // directory. Returns the number drained.

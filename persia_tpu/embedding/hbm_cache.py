@@ -116,6 +116,8 @@ def _load_lib() -> ctypes.CDLL:
         lib.cache_probe.argtypes = [p, _u64p, i64, _i64p]
         lib.cache_drain.restype = i64
         lib.cache_drain.argtypes = [p, _u64p, _i64p]
+        lib.cache_snapshot.restype = i64
+        lib.cache_snapshot.argtypes = [p, _u64p, _i64p]
         _i32p = ctypes.POINTER(ctypes.c_int32)
         lib.cache_admit_positions.restype = i64
         lib.cache_admit_positions.argtypes = [
@@ -242,6 +244,16 @@ class CacheDirectory:
         rows = np.empty(cap, dtype=np.int64)
         k = self._lib.cache_drain(self._h, signs.ctypes.data_as(_u64p),
                                   rows.ctypes.data_as(_i64p))
+        return signs[:k].copy(), rows[:k].copy()
+
+    def snapshot(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Non-destructive (signs, rows) of everything resident — no LRU
+        churn, no eviction, directory unchanged."""
+        cap = self.capacity
+        signs = np.empty(cap, dtype=np.uint64)
+        rows = np.empty(cap, dtype=np.int64)
+        k = self._lib.cache_snapshot(self._h, signs.ctypes.data_as(_u64p),
+                                     rows.ctypes.data_as(_i64p))
         return signs[:k].copy(), rows[:k].copy()
 
 
@@ -416,6 +428,17 @@ def _scatter_entry_block(table, state: Dict[str, jnp.ndarray], rows, entries):
             vals.astype(out_state[key].dtype), mode="drop"
         )
     return table, out_state
+
+
+@jax.jit
+def _gather_entry_rows(table, state: Dict[str, jnp.ndarray], rows):
+    """(K, dim + state_dim) ``[emb | state]`` of the given rows — the
+    flush/publish read path (device gather, then ONE bounded d2h)."""
+    parts = [table[rows]]
+    for key in ("acc", "m", "v"):
+        if key in state:
+            parts.append(state[key][rows])
+    return jnp.concatenate(parts, axis=1)
 
 
 @_partial(jax.jit, donate_argnums=(0, 1))
@@ -748,13 +771,16 @@ class CachedEmbeddingTier:
         n = len(signs)
         pool = getattr(self.worker, "_pool", None)
         if pool is None or n <= self._PAR_CHUNK:
-            self.router.set_embedding(signs, values, dim=dim)
+            self.router.set_embedding(
+                signs, values, dim=dim, commit_incremental=True
+            )
             return
         bounds = list(range(0, n, self._PAR_CHUNK)) + [n]
         list(
             pool.map(
                 lambda se: self.router.set_embedding(
-                    signs[se[0]:se[1]], values[se[0]:se[1]], dim=dim
+                    signs[se[0]:se[1]], values[se[0]:se[1]], dim=dim,
+                    commit_incremental=True,
                 ),
                 zip(bounds[:-1], bounds[1:]),
             )
@@ -1127,23 +1153,45 @@ class CachedEmbeddingTier:
             if not k:
                 continue
             g = next(gr for gr in self.groups if gr.name == gname)
-            payload = np.asarray(evict_payload[gname]).astype(np.float32)[:k]
+            payload = np.asarray(evict_payload[gname])[:k].astype(np.float32)
             self._set_embedding(ev_signs[:k], payload, dim=g.dim)
+
+    def _write_rows(self, g: CacheGroup, signs, rows, tables, emb_state) -> None:
+        """Shared flush/publish body: gather ``[emb | state]`` for the given
+        rows ON DEVICE (one d2h transfer of only those entries — fetching
+        the full pool arrays would cost the whole table per call on a
+        bandwidth-starved link) and persist to the PS as training updates."""
+        kp = _round_up_pow2(len(rows))
+        rpad = np.zeros(kp, dtype=np.int64)  # pad rows re-read row 0, sliced off
+        rpad[:len(rows)] = rows
+        payload = _gather_entry_rows(
+            tables[g.name], emb_state[g.name], jax.device_put(rpad)
+        )
+        host = np.asarray(payload)[:len(rows)].astype(np.float32)
+        self._set_embedding(signs, host, dim=g.dim)
 
     def flush(self, tables, emb_state) -> None:
         """Drain every cached row back to the PS (checkpoint/eval boundary).
         ``tables``/``emb_state`` are the CURRENT device arrays."""
         for g in self.groups:
             signs, rows = self.dirs[g.name].drain()
-            if not len(signs):
-                continue
-            tbl = np.asarray(tables[g.name], dtype=np.float32)
-            parts = [tbl[rows]]
-            st = emb_state[g.name]
-            for key in ("acc", "m", "v"):
-                if key in st:
-                    parts.append(np.asarray(st[key], dtype=np.float32)[rows])
-            self._set_embedding(signs, np.concatenate(parts, axis=1), dim=g.dim)
+            if len(signs):
+                self._write_rows(g, signs, rows, tables, emb_state)
+
+    def publish(self, tables, emb_state) -> int:
+        """Write every RESIDENT row to the PS without evicting anything —
+        the serving-freshness valve. Eviction write-backs only cover rows
+        that LEAVE the cache, so a hot sign trained every step would ship no
+        incremental update while it stays resident; publishing on the
+        serving cadence closes that gap (the reference needs no equivalent —
+        its PS sees every gradient). Returns the number of rows published."""
+        total = 0
+        for g in self.groups:
+            signs, rows = self.dirs[g.name].snapshot()  # no directory churn
+            if len(signs):
+                self._write_rows(g, signs, rows, tables, emb_state)
+                total += len(signs)
+        return total
 
 
 def _position_index(slot: ProcessedSlot, L: int) -> np.ndarray:
@@ -1657,7 +1705,7 @@ class CachedTrainCtx:
                     fetches.append((seq, gn, ev, k, evict_payload[gn]))
 
             def fetch(f):
-                return np.asarray(f[4]).astype(np.float32)
+                return np.asarray(f[4])[:f[3]].astype(np.float32)
 
             hosts = list(pool.map(fetch, fetches)) if pool else [fetch(f) for f in fetches]
             for (seq, gn, ev, k, _p), host in zip(fetches, hosts):
@@ -1793,6 +1841,17 @@ class CachedTrainCtx:
         return np.asarray(self._eval(self.state, inputs, layout))
 
     # ------------------------------------------------------------ checkpoint
+
+    def publish(self) -> int:
+        """Serving-freshness valve: write every resident row to the PS (and
+        its incremental-update manager) WITHOUT evicting — hot signs that
+        never leave the cache would otherwise ship no online-serving deltas
+        between checkpoints. Call on the serving cadence; costs one
+        device→host read of the resident rows. Returns rows published."""
+        self._land_pending()
+        if self.state is None:
+            return 0
+        return self.tier.publish(self.state.tables, self.state.emb_state)
 
     def flush(self) -> None:
         """Write every cached row back to the PS (checkpoint boundary); the

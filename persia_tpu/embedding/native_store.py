@@ -215,7 +215,8 @@ class NativeEmbeddingStore:
     # management -----------------------------------------------------------
 
     def set_embedding(
-        self, signs: np.ndarray, values: np.ndarray, dim: Optional[int] = None
+        self, signs: np.ndarray, values: np.ndarray, dim: Optional[int] = None,
+        commit_incremental: bool = False,
     ) -> None:
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
         values = np.ascontiguousarray(values, dtype=np.float32)
@@ -224,6 +225,10 @@ class NativeEmbeddingStore:
         self._lib.ps_set_embedding(
             self._h, _u64p(signs), len(signs), dim, values.shape[1], _f32p(values)
         )
+        if commit_incremental and self.inc_manager is not None:
+            # write-backs are the cached tier's gradient path (see
+            # EmbeddingStore.set_embedding)
+            self.inc_manager.commit(signs)
 
     def get_embedding_entry(self, sign: int) -> Optional[np.ndarray]:
         # two locked calls (size, then copy): retry if a concurrent eviction

@@ -275,16 +275,25 @@ class EmbeddingStore:
     # ------------------------------------------------------------ management
 
     def set_embedding(
-        self, signs: np.ndarray, values: np.ndarray, dim: Optional[int] = None
+        self, signs: np.ndarray, values: np.ndarray, dim: Optional[int] = None,
+        commit_incremental: bool = False,
     ) -> None:
         """Insert raw entries (checkpoint re-shard path; ref mod.rs set_embedding).
         ``values`` rows are full entries ``[emb | state]``; ``dim`` is the
-        embedding dim (defaults to the full row = stateless entries)."""
+        embedding dim (defaults to the full row = stateless entries).
+        ``commit_incremental=True`` marks the signs as TRAINING updates for
+        the incremental-update manager (cached-tier eviction write-backs and
+        publishes; a sign ships when its row LEAVES the cache or when the
+        caller ``publish()``es — hot resident signs rely on the publish
+        cadence for freshness). Checkpoint loads keep the default (a load is
+        not an update)."""
         if dim is None:
             dim = values.shape[1]
         with self._lock:
             for i, s in enumerate(signs.tolist()):
                 self._shard_of(s).insert(s, dim, values[i].astype(np.float32).copy())
+        if commit_incremental and self.inc_manager is not None:
+            self.inc_manager.commit(signs)
 
     def get_embedding_entry(self, sign: int) -> Optional[np.ndarray]:
         with self._lock:

@@ -277,13 +277,18 @@ class ShardedLookup:
         return warm, vals
 
     def set_embedding(
-        self, signs: np.ndarray, values: np.ndarray, dim: Optional[int] = None
+        self, signs: np.ndarray, values: np.ndarray, dim: Optional[int] = None,
+        commit_incremental: bool = False,
     ) -> None:
         """Sign-routed raw-entry insert (cache write-back + checkpoint
-        re-shard path, ref: set_embedding chunking, core/rpc.rs:77-106)."""
+        re-shard path, ref: set_embedding chunking, core/rpc.rs:77-106).
+        ``commit_incremental``: write-backs are training updates and must
+        feed the incremental-update manager; loads must not."""
         n = len(self.replicas)
         if n == 1:
-            self.replicas[0].set_embedding(signs, values, dim)
+            self.replicas[0].set_embedding(
+                signs, values, dim, commit_incremental=commit_incremental
+            )
             return
         part = native_worker.shard_partition(signs, n)
         if part is not None:
@@ -293,14 +298,20 @@ class ShardedLookup:
                 c = int(counts[r])
                 if c:
                     p = pos[start:start + c]
-                    self.replicas[r].set_embedding(signs[p], values[p], dim)
+                    self.replicas[r].set_embedding(
+                        signs[p], values[p], dim,
+                        commit_incremental=commit_incremental,
+                    )
                 start += c
             return
         shard = sign_to_shard(signs, n)
         for r in range(n):
             mask = shard == r
             if mask.any():
-                self.replicas[r].set_embedding(signs[mask], values[mask], dim)
+                self.replicas[r].set_embedding(
+                    signs[mask], values[mask], dim,
+                    commit_incremental=commit_incremental,
+                )
 
     def advance_batch_state(self, group: int) -> None:
         for r in self.replicas:

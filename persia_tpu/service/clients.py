@@ -89,11 +89,15 @@ class StoreClient:
         )
 
     def set_embedding(
-        self, signs: np.ndarray, values: np.ndarray, dim: Optional[int] = None
+        self, signs: np.ndarray, values: np.ndarray, dim: Optional[int] = None,
+        commit_incremental: bool = False,
     ) -> None:
         if dim is None:
             dim = values.shape[1]
-        self._rpc.call("set_embedding", proto.pack_set_embedding(signs, values, dim))
+        self._rpc.call(
+            "set_embedding",
+            proto.pack_set_embedding(signs, values, dim, commit_incremental),
+        )
 
     def get_embedding_entry(self, sign: int) -> Optional[np.ndarray]:
         raw = self._rpc.call("get_entry", struct.pack("<Q", sign), idempotent=True)

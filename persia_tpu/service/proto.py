@@ -72,14 +72,21 @@ def unpack_update_request(raw: bytes) -> Tuple[np.ndarray, np.ndarray, int]:
     return signs, grads, group
 
 
-def pack_set_embedding(signs: np.ndarray, values: np.ndarray, dim: int) -> bytes:
-    return struct.pack("<I", dim) + pack_ndarrays([signs, values])
+def pack_set_embedding(
+    signs: np.ndarray, values: np.ndarray, dim: int,
+    commit_incremental: bool = False,
+) -> bytes:
+    # header = dim | flags (bit 0: commit to the incremental-update manager
+    # — write-backs are training updates, checkpoint loads are not)
+    return struct.pack("<IB", dim, 1 if commit_incremental else 0) + pack_ndarrays(
+        [signs, values]
+    )
 
 
-def unpack_set_embedding(raw: bytes) -> Tuple[np.ndarray, np.ndarray, int]:
-    (dim,) = struct.unpack("<I", raw[:4])
-    signs, values = unpack_ndarrays(io.BytesIO(raw[4:]))
-    return signs, values, dim
+def unpack_set_embedding(raw: bytes) -> Tuple[np.ndarray, np.ndarray, int, bool]:
+    dim, flags = struct.unpack("<IB", raw[:5])
+    signs, values = unpack_ndarrays(io.BytesIO(raw[5:]))
+    return signs, values, dim, bool(flags & 1)
 
 
 # ------------------------------------------------- embedding batch results

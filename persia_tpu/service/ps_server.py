@@ -115,8 +115,10 @@ class ParameterServerService:
         return b"ok"
 
     def _set_embedding(self, payload: bytes) -> bytes:
-        signs, values, dim = proto.unpack_set_embedding(payload)
-        self.store.set_embedding(signs, values, dim)
+        signs, values, dim, commit_inc = proto.unpack_set_embedding(payload)
+        self.store.set_embedding(
+            signs, values, dim, commit_incremental=commit_inc
+        )
         return b"ok"
 
     def _get_entry(self, payload: bytes) -> bytes:

@@ -214,3 +214,120 @@ def test_native_store_incremental(tmp_path):
         dst.lookup(signs, 8, train=False), src.lookup(signs, 8, train=False)
     )
     mgr.stop(final_flush=False)
+
+
+def test_cached_tier_writebacks_ship_incremental_updates(tmp_path):
+    """The cached tier's gradient path is the eviction write-back
+    (set_embedding with commit_incremental=True) — online-serving deltas
+    must flow exactly as they do for update_gradients; checkpoint-style
+    plain set_embedding must NOT commit."""
+    import optax
+
+    from persia_tpu.config import EmbeddingConfig, SlotConfig
+    from persia_tpu.data import IDTypeFeature, Label, NonIDTypeFeature, PersiaBatch
+    from persia_tpu.embedding.hbm_cache import CachedTrainCtx
+    from persia_tpu.embedding.worker import EmbeddingWorker
+    from persia_tpu.models import DNN
+
+    store = _train_store()
+    mgr = attach_incremental(store, str(tmp_path), flush_interval_sec=3600)
+    try:
+        # plain (load-style) insert: no commit
+        store.set_embedding(
+            np.array([999], dtype=np.uint64), np.zeros((1, 16), np.float32), dim=8
+        )
+        assert mgr._pending_count == 0
+
+        cfg = EmbeddingConfig(
+            slots_config={"cat": SlotConfig(dim=8)}, feature_index_prefix_bit=4
+        )
+        worker = EmbeddingWorker(cfg, [store])
+        ctx = CachedTrainCtx(
+            model=DNN(dense_mlp_size=4, sparse_mlp_size=8, hidden_sizes=(8,)),
+            dense_optimizer=optax.sgd(1e-2),
+            embedding_optimizer=Adagrad(lr=0.1),
+            worker=worker,
+            embedding_config=cfg,
+            cache_rows=8,  # tiny: every batch evicts -> write-backs flow
+        )
+        rng = np.random.default_rng(0)
+        with ctx:
+            for step in range(4):
+                ids = [IDTypeFeature(
+                    "cat",
+                    [np.array([step * 8 + i], dtype=np.uint64) for i in range(8)],
+                )]
+                b = PersiaBatch(
+                    ids,
+                    non_id_type_features=[NonIDTypeFeature(
+                        rng.normal(size=(8, 4)).astype(np.float32))],
+                    labels=[Label(rng.integers(0, 2, (8, 1)).astype(np.float32))],
+                    requires_grad=True,
+                )
+                ctx.train_step(b, fetch_metrics=False)
+            ctx.drain()
+            ctx.flush()
+        assert mgr._pending_count > 0  # write-backs committed trained signs
+        mgr.flush()
+        files = list(tmp_path.rglob("*.inc"))
+        assert files, "no incremental packet written"
+    finally:
+        mgr.stop()
+
+
+def test_cached_tier_publish_ships_resident_signs(tmp_path):
+    """Hot resident signs never evict, so only publish() makes them reach
+    the incremental manager between checkpoints — and publishing must not
+    disturb the cache (training continues bit-identically)."""
+    import optax
+
+    from persia_tpu.config import EmbeddingConfig, SlotConfig
+    from persia_tpu.data import IDTypeFeature, Label, NonIDTypeFeature, PersiaBatch
+    from persia_tpu.embedding.hbm_cache import CachedTrainCtx
+    from persia_tpu.embedding.worker import EmbeddingWorker
+    from persia_tpu.models import DNN
+
+    store = _train_store()
+    mgr = attach_incremental(store, str(tmp_path), flush_interval_sec=3600)
+    try:
+        cfg = EmbeddingConfig(
+            slots_config={"cat": SlotConfig(dim=8)}, feature_index_prefix_bit=4
+        )
+        worker = EmbeddingWorker(cfg, [store])
+        ctx = CachedTrainCtx(
+            model=DNN(dense_mlp_size=4, sparse_mlp_size=8, hidden_sizes=(8,)),
+            dense_optimizer=optax.sgd(1e-2),
+            embedding_optimizer=Adagrad(lr=0.1),
+            worker=worker,
+            embedding_config=cfg,
+            cache_rows=64,  # roomy: nothing ever evicts
+        )
+        rng = np.random.default_rng(0)
+
+        def batch():
+            ids = [IDTypeFeature(
+                "cat", [np.array([i % 8], dtype=np.uint64) for i in range(8)],
+            )]
+            return PersiaBatch(
+                ids,
+                non_id_type_features=[NonIDTypeFeature(
+                    rng.normal(size=(8, 4)).astype(np.float32))],
+                labels=[Label(rng.integers(0, 2, (8, 1)).astype(np.float32))],
+                requires_grad=True,
+            )
+
+        with ctx:
+            for _ in range(3):
+                ctx.train_step(batch(), fetch_metrics=False)
+            ctx.drain()
+            assert mgr._pending_count == 0  # hot signs: no evictions, no deltas
+            published = ctx.publish()
+            assert published == 8
+            assert mgr._pending_count >= 8
+            loss_after_publish = []
+            for _ in range(2):  # training continues fine on the same cache
+                m = ctx.train_step(batch())
+                loss_after_publish.append(m["loss"])
+            assert all(np.isfinite(l) for l in loss_after_publish)
+    finally:
+        mgr.stop()
