@@ -295,19 +295,23 @@ def _round_up_pow2(n: int, floor: int = 8) -> int:
 
 
 def make_cache_groups(
-    cfg: EmbeddingConfig, rows_per_group: Dict[int, int], sparse_cfg: OptimizerConfig
-) -> List[CacheGroup]:
+    cfg: EmbeddingConfig, rows_per_group: Dict[int, int],
+    sparse_cfg: OptimizerConfig, exclude: Sequence[str] = (),
+) -> Tuple[List[CacheGroup], Tuple[str, ...]]:
     """Group slots by dim (all same-dim slots share one row pool; cross-slot
     sign collisions are handled by the group-level dedup in
     ``CachedEmbeddingTier.prepare_batch``, so a prefix-bit-0 config cannot
-    violate the directory's distinct-signs contract)."""
+    violate the directory's distinct-signs contract).
+
+    Returns ``(groups, ps_slots)``: hash-stack slots (many table keys per
+    id — uncacheable by construction) and any ``exclude``d names ride the
+    pure worker/PS path inside the same ctx (the mixed-tier arrangement)."""
     by_dim: Dict[int, Tuple[List[str], List[str]]] = {}
+    ps_slots: List[str] = []
     for name, slot in cfg.slots_config.items():
-        if slot.hash_stack_config.enabled:
-            raise ValueError(
-                f"slot {name!r}: hash-stack slots are not cacheable (many table "
-                "keys per id) — keep them on the pure PS path"
-            )
+        if slot.hash_stack_config.enabled or name in exclude:
+            ps_slots.append(name)
+            continue
         pooled, raw = by_dim.setdefault(slot.dim, ([], []))
         (pooled if slot.embedding_summation else raw).append(name)
     groups = []
@@ -323,7 +327,7 @@ def make_cache_groups(
                 raw_slots=tuple(sorted(raw)),
             )
         )
-    return groups
+    return groups, tuple(sorted(ps_slots))
 
 
 def init_cached_tables(
@@ -367,6 +371,7 @@ def _model_emb_from_gathered(
     stacked_gathered: Dict[str, jnp.ndarray],
     raw_gathered: Dict[str, jnp.ndarray],
     pad_row: Callable[[str], int],
+    ps_model_inputs: Optional[List] = None,
 ):
     """Build the per-slot model input list (global sorted slot order) from
     the per-group stacked gather and per-slot raw gathers. ``pad_row(gname)``
@@ -387,6 +392,11 @@ def _model_emb_from_gathered(
         gname = _slot_group_of(groups, name)
         rows = batch["raw_rows"][name]
         slot_emb[name] = (got, rows != pad_row(gname))
+    if ps_model_inputs is not None:
+        # mixed-tier: worker/PS-served slots join the cached ones in the
+        # same globally-sorted slot order the model expects
+        for name, emb in zip(layout.ps, ps_model_inputs):
+            slot_emb[name] = emb
     return [slot_emb[n] for n in sorted(slot_emb)]
 
 
@@ -405,6 +415,9 @@ class CacheLayout:
     (it changes at most a handful of times per run)."""
 
     stacked: Tuple[Tuple[str, Tuple[str, ...]], ...]
+    # mixed-tier: slot names served by the worker/PS path (hash-stack or
+    # explicitly excluded), in the order their entries ride batch["ps_emb"]
+    ps: Tuple[str, ...] = ()
 
 
 # Tiny per-group device ops kept OUT of the main train step so that the
@@ -521,11 +534,16 @@ def build_cached_train_step(
                        (the zero row)},
       "stacked_scale": {group: (S, B) f32} — omitted when no slot scales,
       "raw_rows": {slot: (B, L) int32} for sequence slots,
+      "ps_emb": [ {"pooled": (B,D)} | {"distinct","index","mask"} ... ] —
+                mixed-tier slots served by the worker/PS path
+                (layout.ps names them, in order),
     }
     Miss scatters and the evict-payload read run as a separate fused tiny
     jit (``_apply_aux``) dispatched by the ctx around this step, so this —
-    the expensive compile — sees only fixed-shape inputs. ``header`` =
-    [loss, preds...].
+    the expensive compile — sees only fixed-shape inputs. Returns
+    ``(state, header, ps_gpacked)``: header = [loss, preds...]; ps_gpacked
+    = flat f32 gradients of the ps_emb entries (empty when none) for the
+    worker's gradient return.
     """
     from functools import partial
 
@@ -550,11 +568,17 @@ def build_cached_train_step(
             name: tables[_slot_group_of(groups, name)][rows]
             for name, rows in batch["raw_rows"].items()
         }
+        from persia_tpu.parallel.train_step import (
+            _embedding_model_inputs, _split_emb,
+        )
 
-        def loss_wrapper(params, stacked_in, raw_in):
+        ps_diff, ps_static = _split_emb(batch.get("ps_emb", []))
+
+        def loss_wrapper(params, stacked_in, raw_in, ps_in):
             model_emb = _model_emb_from_gathered(
                 groups, batch, layout, stacked_in, raw_in,
                 pad_row=lambda gname: by_name[gname].rows,
+                ps_model_inputs=_embedding_model_inputs(ps_in, ps_static),
             )
             variables = {"params": params}
             if state.batch_stats:
@@ -570,9 +594,11 @@ def build_cached_train_step(
             loss = loss_fn(logits, batch["labels"][0])
             return loss, (logits, new_stats)
 
-        (loss, (logits, new_stats)), (param_grads, stacked_g, raw_g) = jax.value_and_grad(
-            loss_wrapper, argnums=(0, 1, 2), has_aux=True
-        )(state.params, stacked_gathered, raw_gathered)
+        (loss, (logits, new_stats)), (param_grads, stacked_g, raw_g, ps_g) = (
+            jax.value_and_grad(
+                loss_wrapper, argnums=(0, 1, 2, 3), has_aux=True
+            )(state.params, stacked_gathered, raw_gathered, ps_diff)
+        )
 
         import optax as _optax
 
@@ -626,7 +652,11 @@ def build_cached_train_step(
             [jnp.reshape(loss, (1,)).astype(jnp.float32),
              jnp.reshape(jax.nn.sigmoid(logits), (-1,)).astype(jnp.float32)]
         )
-        return new_state, header
+        ps_flat = [jnp.reshape(g, (-1,)).astype(jnp.float32) for g in ps_g]
+        ps_gpacked = (
+            jnp.concatenate(ps_flat) if ps_flat else jnp.zeros((0,), jnp.float32)
+        )
+        return new_state, header, ps_gpacked
 
     return step
 
@@ -666,9 +696,15 @@ def build_cached_eval_step(model, groups: Sequence[CacheGroup]):
             raw_gathered[name] = _gather_ext(
                 state.tables[gname], batch["miss_tables"][gname], rows, C
             )
+        from persia_tpu.parallel.train_step import (
+            _embedding_model_inputs, _split_emb,
+        )
+
+        ps_diff, ps_static = _split_emb(batch.get("ps_emb", []))
         model_emb = _model_emb_from_gathered(
             groups, batch, layout, stacked_gathered, raw_gathered,
             pad_row=lambda gname: by_name[gname].rows,
+            ps_model_inputs=_embedding_model_inputs(ps_diff, ps_static),
         )
         variables = {"params": state.params}
         if state.batch_stats:
@@ -696,6 +732,7 @@ class CachedEmbeddingTier:
         rows: "int | Dict[int, int]",
         embedding_config: Optional[EmbeddingConfig] = None,
         init_seed: Optional[int] = None,
+        ps_slots: Sequence[str] = (),
     ):
         self.worker = worker
         self.cfg = embedding_config or worker.embedding_config
@@ -712,9 +749,15 @@ class CachedEmbeddingTier:
                 )
         self.init_seed = int(init_seed)
         self.init_bounds = tuple(worker.hyperparams.emb_initialization)
-        dims = {slot.dim for slot in self.cfg.slots_config.values()}
+        dims = {
+            slot.dim
+            for name, slot in self.cfg.slots_config.items()
+            if not slot.hash_stack_config.enabled and name not in ps_slots
+        }
         rows_per_group = rows if isinstance(rows, dict) else {d: rows for d in dims}
-        self.groups = make_cache_groups(self.cfg, rows_per_group, sparse_cfg)
+        self.groups, self.ps_slots = make_cache_groups(
+            self.cfg, rows_per_group, sparse_cfg, exclude=ps_slots
+        )
         self.dirs = {g.name: CacheDirectory(g.rows) for g in self.groups}
         self._slot_group = {s: g for g in self.groups for s in g.slots}
         # static fast-path eligibility per slot (config is immutable): the
@@ -906,7 +949,10 @@ class CachedEmbeddingTier:
         matrix), ...] or None (→ general path)."""
         from persia_tpu.embedding.hashing import add_index_prefix
 
-        feats = {f.name: f for f in batch.id_type_features}
+        feats = {
+            f.name: f for f in batch.id_type_features
+            if f.name not in self.ps_slots  # mixed-tier: worker/PS path
+        }
         for name in feats:
             if name not in self._slot_group:
                 # same loud failure the general path's preprocess raises
@@ -962,7 +1008,10 @@ class CachedEmbeddingTier:
         fast = self._single_id_groups(batch)
         if fast is not None:
             return self._prepare_batch_single_id(batch, fast, hazard_gate)
-        pb = preprocess_batch(batch.id_type_features, self.cfg)
+        cached_feats = [
+            f for f in batch.id_type_features if f.name not in self.ps_slots
+        ]
+        pb = preprocess_batch(cached_feats, self.cfg)
         slots_by_group = self._group_slots(pb)
 
         stacked_rows: Dict[str, np.ndarray] = {}
@@ -1079,7 +1128,10 @@ class CachedEmbeddingTier:
         map to their cache rows via a read-only probe; misses get a plain
         infer PS lookup (zeros for never-trained signs, no admission) and
         ride as an appended miss table with rows C+1+j."""
-        pb = preprocess_batch(batch.id_type_features, self.cfg)
+        cached_feats = [
+            f for f in batch.id_type_features if f.name not in self.ps_slots
+        ]
+        pb = preprocess_batch(cached_feats, self.cfg)
         slots_by_group = self._group_slots(pb)
 
         stacked_rows: Dict[str, np.ndarray] = {}
@@ -1239,6 +1291,7 @@ class CachedTrainCtx:
         init_seed: Optional[int] = None,
         mesh=None,
         wb_wire_dtype: str = "float32",
+        ps_slots: Sequence[str] = (),
     ):
         self.model = model
         self.dense_optimizer = dense_optimizer
@@ -1258,7 +1311,7 @@ class CachedTrainCtx:
         self._wb_bf16 = wb_wire_dtype == "bfloat16"
         self.tier = CachedEmbeddingTier(
             worker, self.sparse_cfg, cache_rows, embedding_config,
-            init_seed=init_seed,
+            init_seed=init_seed, ps_slots=ps_slots,
         )
         self._state_consts = _state_init_consts(self.sparse_cfg)
         self._step = build_cached_train_step(
@@ -1304,6 +1357,16 @@ class CachedTrainCtx:
             name: tables[self.tier._slot_group[name].name][jnp.asarray(rows)]
             for name, rows in sample_inputs["raw_rows"].items()
         }
+        ps_model_inputs = None
+        if sample_inputs.get("ps_emb"):
+            from persia_tpu.parallel.train_step import (
+                _embedding_model_inputs, _split_emb,
+            )
+
+            ps_diff, ps_static = _split_emb(sample_inputs["ps_emb"])
+            ps_model_inputs = _embedding_model_inputs(
+                [jnp.asarray(d) for d in ps_diff], ps_static
+            )
         model_emb = _model_emb_from_gathered(
             self.tier.groups,
             {
@@ -1317,6 +1380,7 @@ class CachedTrainCtx:
             stacked_gathered,
             raw_gathered,
             pad_row=lambda gname: by_name[gname].rows,
+            ps_model_inputs=ps_model_inputs,
         )
         variables = self.model.init(
             rng, sample_inputs["dense"], model_emb, train=False
@@ -1386,6 +1450,18 @@ class CachedTrainCtx:
                 k: jax.device_put(v, mid)
                 for k, v in device_inputs["stacked_scale"].items()
             }
+        if "ps_emb" in device_inputs:
+            ps = []
+            for e in device_inputs["ps_emb"]:
+                if "pooled" in e:
+                    ps.append({"pooled": jax.device_put(e["pooled"], bsh)})
+                else:
+                    ps.append({
+                        "distinct": jax.device_put(e["distinct"], rep),
+                        "index": jax.device_put(e["index"], bsh),
+                        "mask": jax.device_put(e["mask"], bsh),
+                    })
+            di["ps_emb"] = ps
         return (
             di,
             jax.device_put(miss_aux, rep),
@@ -1444,25 +1520,76 @@ class CachedTrainCtx:
                         src_idx, dst_rows,
                     )
             self.state = self.state.replace(tables=tables, emb_state=emb_state)
-        self.state, header = self._step(self.state, device_inputs, layout)
-        return header, evict_payload
+        self.state, header, ps_gpacked = self._step(
+            self.state, device_inputs, layout
+        )
+        return header, evict_payload, ps_gpacked
 
     def train_step(self, batch: PersiaBatch, fetch_metrics: bool = True):
         (device_inputs, layout, miss_aux, cold_aux, restore_aux, evict_aux,
          evict_meta) = self.tier.prepare_batch(
             batch, hazard_gate=self._sync_hazard_gate
         )
-        if self.state is None:
-            self.init_state(jax.random.PRNGKey(0), device_inputs, layout)
-        # explicit async host→device staging: passing numpy leaves straight
-        # into jit makes the arg conversion a synchronous per-leaf round-trip
-        # on remote-attached chips (measured 84 ms vs 1 ms for the same data)
-        device_inputs, miss_aux, cold_aux, evict_aux = self._stage(
-            device_inputs, miss_aux, cold_aux, evict_aux
-        )
-        header, evict_payload = self._dispatch(
-            device_inputs, layout, miss_aux, cold_aux, restore_aux, evict_aux
-        )
+        # mixed-tier: worker/PS-served slots (hash-stack or excluded) flow
+        # through the same forward-ref machinery the hybrid ctx uses; their
+        # gradients come back as a step output
+        ps_ref = None
+        ps_emb_batches = ps_counts = None
+        try:
+            if self.tier.ps_slots:
+                ps_feats = [
+                    f for f in batch.id_type_features
+                    if f.name in self.tier.ps_slots
+                ]
+                if ps_feats:
+                    from persia_tpu.ctx import stage_embeddings
+
+                    ps_sub = PersiaBatch(ps_feats, requires_grad=False)
+                    ps_ref = self.worker.put_forward_ids(ps_sub)
+                    ps_emb_batches = self.worker.forward_batch_id(
+                        ps_ref, train=True
+                    )
+                    entries, ps_counts = stage_embeddings(ps_emb_batches)
+                    device_inputs["ps_emb"] = entries
+                    layout = CacheLayout(
+                        stacked=layout.stacked,
+                        ps=tuple(eb.name for eb in ps_emb_batches),
+                    )
+            if self.state is None:
+                self.init_state(jax.random.PRNGKey(0), device_inputs, layout)
+            # explicit async host→device staging: passing numpy leaves
+            # straight into jit makes the arg conversion a synchronous
+            # per-leaf round-trip on remote-attached chips (measured 84 ms
+            # vs 1 ms for the same data)
+            device_inputs, miss_aux, cold_aux, evict_aux = self._stage(
+                device_inputs, miss_aux, cold_aux, evict_aux
+            )
+            header, evict_payload, ps_gpacked = self._dispatch(
+                device_inputs, layout, miss_aux, cold_aux, restore_aux,
+                evict_aux,
+            )
+            if ps_ref is not None:
+                # the PS-tier gradient return is an inherent d2h (same as
+                # the hybrid path); reuse the packed-gradient layout helper
+                # + pad-strip so the convention lives in one place
+                from persia_tpu.parallel.train_step import unpack_step_grads
+
+                grads = unpack_step_grads(
+                    np.asarray(ps_gpacked), {"emb": device_inputs["ps_emb"]}
+                )
+                slot_grads = {
+                    eb.name: (g if d is None else g[:d])
+                    for eb, g, d in zip(ps_emb_batches, grads, ps_counts)
+                }
+                self.worker.update_gradient_batched(ps_ref, slot_grads)
+                ps_ref = None  # applied — no abort on later failures
+        except Exception:
+            # any failure after the forward must release the staleness slot
+            # + stashed layout, or the worker buffers leak (same contract as
+            # TrainCtx.train_step)
+            if ps_ref is not None:
+                self.worker.abort_gradient(ps_ref)
+            raise
         prev = self._pending
         self._pending = (
             evict_meta, evict_payload, header, device_inputs["labels"][0].shape
@@ -1555,6 +1682,13 @@ class CachedTrainCtx:
         """
         import queue as _queue
 
+        if self.tier.ps_slots:
+            raise NotImplementedError(
+                "train_stream does not support mixed-tier (worker/PS-served) "
+                f"slots yet: {self.tier.ps_slots} — use the per-step "
+                "train_step() path for configs with hash-stack or excluded "
+                "slots"
+            )
         self._land_pending()  # do not mix with a sync-path deferred step
         # pending eviction write-backs, seq → per-group record:
         #   {"sorted": {g: sorted u64 signs}, "order": {g: payload row of
@@ -1757,7 +1891,7 @@ class CachedTrainCtx:
                  evict_meta) = item
                 if self.state is None:
                     self.init_state(jax.random.PRNGKey(0), di, layout)
-                header, evict_payload = self._dispatch(
+                header, evict_payload, _ps_g = self._dispatch(
                     di, layout, miss_aux, cold_aux, restore_aux, evict_aux
                 )
                 label_shape = di["labels"][0].shape
@@ -1832,6 +1966,22 @@ class CachedTrainCtx:
         # eval misses consult the PS, so a deferred eviction must land first
         self._land_pending()
         inputs, layout = self.tier.prepare_eval_batch(batch)
+        if self.tier.ps_slots:
+            from persia_tpu.ctx import stage_embeddings
+
+            ps_feats = [
+                f for f in batch.id_type_features
+                if f.name in self.tier.ps_slots
+            ]
+            if ps_feats:
+                ps_sub = PersiaBatch(ps_feats, requires_grad=False)
+                emb_batches = self.worker.forward_directly(ps_sub, train=False)
+                entries, _ = stage_embeddings(emb_batches)
+                inputs["ps_emb"] = entries
+                layout = CacheLayout(
+                    stacked=layout.stacked,
+                    ps=tuple(eb.name for eb in emb_batches),
+                )
         if self.state is None:
             raise RuntimeError("eval before any train_step/init_state")
         # eval stays simple under a mesh: everything replicated is correct

@@ -94,10 +94,15 @@ class StoreClient:
     ) -> None:
         if dim is None:
             dim = values.shape[1]
-        self._rpc.call(
-            "set_embedding",
-            proto.pack_set_embedding(signs, values, dim, commit_incremental),
-        )
+        if commit_incremental:
+            self._rpc.call(
+                "set_embedding_v2",
+                proto.pack_set_embedding_v2(signs, values, dim, True),
+            )
+        else:  # legacy wire: interoperates with older servers
+            self._rpc.call(
+                "set_embedding", proto.pack_set_embedding(signs, values, dim)
+            )
 
     def get_embedding_entry(self, sign: int) -> Optional[np.ndarray]:
         raw = self._rpc.call("get_entry", struct.pack("<Q", sign), idempotent=True)

@@ -72,7 +72,21 @@ def unpack_update_request(raw: bytes) -> Tuple[np.ndarray, np.ndarray, int]:
     return signs, grads, group
 
 
-def pack_set_embedding(
+def pack_set_embedding(signs: np.ndarray, values: np.ndarray, dim: int) -> bytes:
+    """Legacy v1 wire (4-byte header, no flags) — kept verbatim so old and
+    new processes interoperate during rolling upgrades; the flagged variant
+    rides a NEW method name (``set_embedding_v2``) instead of changing this
+    format in place."""
+    return struct.pack("<I", dim) + pack_ndarrays([signs, values])
+
+
+def unpack_set_embedding(raw: bytes) -> Tuple[np.ndarray, np.ndarray, int]:
+    (dim,) = struct.unpack("<I", raw[:4])
+    signs, values = unpack_ndarrays(io.BytesIO(raw[4:]))
+    return signs, values, dim
+
+
+def pack_set_embedding_v2(
     signs: np.ndarray, values: np.ndarray, dim: int,
     commit_incremental: bool = False,
 ) -> bytes:
@@ -83,7 +97,7 @@ def pack_set_embedding(
     )
 
 
-def unpack_set_embedding(raw: bytes) -> Tuple[np.ndarray, np.ndarray, int, bool]:
+def unpack_set_embedding_v2(raw: bytes) -> Tuple[np.ndarray, np.ndarray, int, bool]:
     dim, flags = struct.unpack("<IB", raw[:5])
     signs, values = unpack_ndarrays(io.BytesIO(raw[5:]))
     return signs, values, dim, bool(flags & 1)

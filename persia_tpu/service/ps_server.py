@@ -51,6 +51,7 @@ class ParameterServerService:
         s.register("register_optimizer", self._register_optimizer)
         s.register("configure", self._configure)
         s.register("set_embedding", self._set_embedding)
+        s.register("set_embedding_v2", self._set_embedding_v2)
         s.register("get_entry", self._get_entry)
         s.register("size", lambda p: struct.pack("<q", self.store.size()))
         s.register("clear", lambda p: (self.store.clear(), b"ok")[1])
@@ -115,7 +116,13 @@ class ParameterServerService:
         return b"ok"
 
     def _set_embedding(self, payload: bytes) -> bytes:
-        signs, values, dim, commit_inc = proto.unpack_set_embedding(payload)
+        # legacy v1 (no flags): plain insert, never commits incrementals
+        signs, values, dim = proto.unpack_set_embedding(payload)
+        self.store.set_embedding(signs, values, dim)
+        return b"ok"
+
+    def _set_embedding_v2(self, payload: bytes) -> bytes:
+        signs, values, dim, commit_inc = proto.unpack_set_embedding_v2(payload)
         self.store.set_embedding(
             signs, values, dim, commit_incremental=commit_inc
         )

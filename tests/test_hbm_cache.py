@@ -432,7 +432,10 @@ def test_cached_ctx_with_sharded_ps_replicas():
     np.testing.assert_allclose(run(1), run(3), rtol=1e-5)
 
 
-def test_hash_stack_slots_rejected():
+def test_hash_stack_slots_route_to_ps_tier():
+    """Hash-stack slots are uncacheable by construction (many table keys per
+    id) — they ride the worker/PS path inside the mixed-tier arrangement
+    instead of rejecting the whole config."""
     from persia_tpu.config import HashStackConfig
 
     cfg = EmbeddingConfig(
@@ -442,11 +445,125 @@ def test_hash_stack_slots_rejected():
                 hash_stack_config=HashStackConfig(
                     hash_stack_rounds=2, embedding_size=100
                 ),
-            )
+            ),
+            "plain": SlotConfig(dim=4),
         },
     )
-    with pytest.raises(ValueError, match="not cacheable"):
-        hbm.make_cache_groups(cfg, {4: 64}, Adagrad(lr=0.1).config)
+    groups, ps = hbm.make_cache_groups(cfg, {4: 64}, Adagrad(lr=0.1).config)
+    assert ps == ("hs",)
+    assert [g.pooled_slots for g in groups] == [("plain",)]
+    # explicit exclusion joins the PS tier too
+    groups2, ps2 = hbm.make_cache_groups(
+        cfg, {4: 64}, Adagrad(lr=0.1).config, exclude=("plain",)
+    )
+    assert set(ps2) == {"hs", "plain"} and groups2 == []
+
+
+def test_mixed_tier_matches_pure_ps():
+    """A config mixing cached slots with a hash-stack (PS-tier) slot must
+    train to the same PS state as the pure-PS TrainCtx on the same stream,
+    and eval must agree."""
+    import optax
+
+    from persia_tpu.config import HashStackConfig
+    from persia_tpu.ctx import TrainCtx
+    from persia_tpu.models import DNN
+
+    def mixed_cfg():
+        return EmbeddingConfig(
+            slots_config={
+                "cat_a": SlotConfig(dim=8),
+                "cat_b": SlotConfig(dim=8),
+                "hs": SlotConfig(
+                    dim=8,
+                    hash_stack_config=HashStackConfig(
+                        hash_stack_rounds=2, embedding_size=50
+                    ),
+                ),
+            },
+            feature_index_prefix_bit=8,
+        )
+
+    rng = np.random.default_rng(17)
+
+    def batches(n):
+        r = np.random.default_rng(17)
+        out = []
+        for _ in range(n):
+            ids = [
+                IDTypeFeature("cat_a", list(r.integers(0, 64, (16, 1), dtype=np.uint64))),
+                IDTypeFeature("cat_b", list(r.integers(0, 32, (16, 1), dtype=np.uint64))),
+                IDTypeFeature("hs", list(r.integers(0, 1000, (16, 1), dtype=np.uint64))),
+            ]
+            out.append(PersiaBatch(
+                ids,
+                non_id_type_features=[NonIDTypeFeature(
+                    r.normal(size=(16, 4)).astype(np.float32))],
+                labels=[Label(r.integers(0, 2, (16, 1)).astype(np.float32))],
+                requires_grad=True,
+            ))
+        return out
+
+    def make(kind):
+        cfg = mixed_cfg()
+        store = EmbeddingStore(
+            capacity=1 << 16, num_internal_shards=2,
+            optimizer=SGD(lr=0.1).config, seed=11,
+        )
+        worker = EmbeddingWorker(cfg, [store])
+        model = DNN(dense_mlp_size=8, sparse_mlp_size=32, hidden_sizes=(32,))
+        if kind == "mixed":
+            ctx = hbm.CachedTrainCtx(
+                model=model, dense_optimizer=optax.sgd(1e-2),
+                embedding_optimizer=SGD(lr=0.1), worker=worker,
+                embedding_config=cfg, cache_rows=512,
+            )
+            assert ctx.tier.ps_slots == ("hs",)
+        else:
+            ctx = TrainCtx(
+                model=model, dense_optimizer=optax.sgd(1e-2),
+                embedding_optimizer=SGD(lr=0.1), worker=worker,
+                embedding_config=cfg,
+            )
+        return ctx, store
+
+    mixed, mstore = make("mixed")
+    pure, pstore = make("pure")
+    with mixed, pure:
+        for b in batches(6):
+            mm = mixed.train_step(b)
+            pm = pure.train_step(b)
+            assert abs(mm["loss"] - pm["loss"]) < 2e-4, (mm["loss"], pm["loss"])
+        assert mixed.worker.staleness == 0
+        # eval parity (ps slot rides forward_directly in both)
+        eb = batches(7)[-1]
+        np.testing.assert_allclose(
+            mixed.eval_batch(eb), pure.eval_batch(eb), atol=2e-3
+        )
+        mixed.flush()
+    # hash-stack table keys trained identically on both paths
+    from persia_tpu.embedding.hashing import add_index_prefix, hash_stack
+
+    cfg = mixed_cfg()
+    hs_slot = cfg.slot("hs")
+    signs = add_index_prefix(
+        np.arange(1000, dtype=np.uint64), hs_slot.index_prefix, 8
+    )
+    keys = hash_stack(signs, 2, 50).reshape(-1)
+    keys = add_index_prefix(keys, hs_slot.index_prefix, 8)
+    seen = 0
+    for k in np.unique(keys)[:200].tolist():
+        em = mstore.get_embedding_entry(int(k))
+        ep = pstore.get_embedding_entry(int(k))
+        assert (em is None) == (ep is None)
+        if em is not None:
+            np.testing.assert_allclose(em, ep, rtol=2e-4, atol=2e-6)
+            seen += 1
+    assert seen > 10
+    # the stream path refuses mixed configs loudly
+    mixed2, _ = make("mixed")
+    with mixed2, pytest.raises(NotImplementedError, match="mixed-tier"):
+        mixed2.train_stream(batches(1))
 
 
 def test_train_stream_matches_sync_path():
