@@ -315,31 +315,38 @@ def _run_mode_isolated(mode: str) -> float:
     return float(json.loads(lines[-1])["modes"][mode])
 
 
+def _result_line(results: dict) -> str:
+    # headline = the capacity tier (PS-resident vocab ≫ HBM) when measured:
+    # that is the regime the reference exists for (100T params, README.md:29);
+    # "fused" (all-in-HBM) rides along as the in-memory ceiling
+    headline = results.get("cached", next(iter(results.values())))
+    return json.dumps(
+        {
+            "metric": "dlrm_criteo_shape_samples_per_sec_per_chip",
+            "value": headline,
+            "unit": "samples/sec",
+            "vs_baseline": round(headline / REF_SAMPLES_PER_SEC, 4),
+            "modes": results,
+        }
+    )
+
+
 def main():
     mode = os.environ.get("BENCH_MODE", "all")
     if mode not in ("all", *_BENCHES):
         raise SystemExit(f"BENCH_MODE must be one of all/fused/hybrid/cached, got {mode!r}")
     results = {}
     if mode == "all":
-        for m in _BENCHES:
+        # headline mode FIRST, and a cumulative result line after EVERY
+        # mode: a harness that parses the last stdout line still gets a
+        # complete record if the run is cut off mid-suite
+        # headline (cached) first, then everything else in _BENCHES
+        for m in sorted(_BENCHES, key=lambda n: n != "cached"):
             results[m] = round(_run_mode_isolated(m), 1)
-    else:
-        results[mode] = round(_BENCHES[mode](), 1)
-    # headline = the capacity tier (PS-resident vocab ≫ HBM) when measured:
-    # that is the regime the reference exists for (100T params, README.md:29);
-    # "fused" (all-in-HBM) rides along as the in-memory ceiling
-    headline = results.get("cached", next(iter(results.values())))
-    print(
-        json.dumps(
-            {
-                "metric": "dlrm_criteo_shape_samples_per_sec_per_chip",
-                "value": headline,
-                "unit": "samples/sec",
-                "vs_baseline": round(headline / REF_SAMPLES_PER_SEC, 4),
-                "modes": results,
-            }
-        )
-    )
+            print(_result_line(results), flush=True)
+        return
+    results[mode] = round(_BENCHES[mode](), 1)
+    print(_result_line(results), flush=True)
 
 
 if __name__ == "__main__":

@@ -306,6 +306,11 @@ def make_cache_groups(
     Returns ``(groups, ps_slots)``: hash-stack slots (many table keys per
     id — uncacheable by construction) and any ``exclude``d names ride the
     pure worker/PS path inside the same ctx (the mixed-tier arrangement)."""
+    unknown = set(exclude) - set(cfg.slots_config)
+    if unknown:
+        raise KeyError(
+            f"exclude names not in embedding config: {sorted(unknown)}"
+        )
     by_dim: Dict[int, Tuple[List[str], List[str]]] = {}
     ps_slots: List[str] = []
     for name, slot in cfg.slots_config.items():
