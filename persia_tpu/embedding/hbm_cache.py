@@ -763,6 +763,20 @@ class CachedEmbeddingTier:
         self.groups, self.ps_slots = make_cache_groups(
             self.cfg, rows_per_group, sparse_cfg, exclude=ps_slots
         )
+        # a feature group is ONE shared key space (members share an index
+        # prefix): a cached slot and a ps-tier slot in the same group would
+        # be two incoherent writers to the same PS entries (cache copies go
+        # stale against direct PS updates) — reject the arrangement
+        cached_names = {s for g in self.groups for s in g.slots}
+        for fg_name, members in self.cfg.feature_groups.items():
+            ms = set(members)
+            if ms & cached_names and ms & set(self.ps_slots):
+                raise ValueError(
+                    f"feature group {fg_name!r} mixes cached slots "
+                    f"{sorted(ms & cached_names)} with PS-tier slots "
+                    f"{sorted(ms & set(self.ps_slots))}: one key space "
+                    "cannot span both tiers"
+                )
         self.dirs = {g.name: CacheDirectory(g.rows) for g in self.groups}
         self._slot_group = {s: g for g in self.groups for s in g.slots}
         # static fast-path eligibility per slot (config is immutable): the
@@ -1318,6 +1332,12 @@ class CachedTrainCtx:
             worker, self.sparse_cfg, cache_rows, embedding_config,
             init_seed=init_seed, ps_slots=ps_slots,
         )
+        # feature groups containing cached slots: the PS-side Adam beta
+        # powers of EVERY one of them mirror the device's per-step advance
+        self._cached_groups = tuple(sorted({
+            embedding_config.group_of(s)
+            for g in self.tier.groups for s in g.slots
+        }))
         self._state_consts = _state_init_consts(self.sparse_cfg)
         self._step = build_cached_train_step(
             model, dense_optimizer, self.sparse_cfg, self.tier.groups,
@@ -1606,9 +1626,14 @@ class CachedTrainCtx:
             self._write_back_only(prev)
         if self.sparse_cfg.kind == OPTIMIZER_ADAM:
             # PS-side Adam beta powers advance once per gradient batch,
-            # mirroring the device's emb_batch_state, so write-backs land in
-            # a store whose future updates use consistent powers
-            self.tier.router.advance_batch_state(0)
+            # mirroring the device's shared emb_batch_state for EVERY
+            # feature group holding cached slots, so write-backs land in a
+            # store whose future updates use consistent powers. PS-tier
+            # slots' groups advance inside the worker's gradient batch
+            # instead — the constructor guarantees the two tier's feature
+            # groups are disjoint, so no group can be advanced twice.
+            for grp in self._cached_groups:
+                self.tier.router.advance_batch_state(grp)
         if fetch_metrics:
             return self._fetch_metrics()
         return None
@@ -1911,7 +1936,8 @@ class CachedTrainCtx:
                 if self.sparse_cfg.kind == OPTIMIZER_ADAM:
                     # mirror the device's beta-power advance on the PS every
                     # gradient batch (same contract as the sync train_step)
-                    self.tier.router.advance_batch_state(0)
+                    for grp in self._cached_groups:
+                        self.tier.router.advance_batch_state(grp)
                 if on_metrics is not None:
                     h = np.asarray(header)
                     self._last_metrics = {

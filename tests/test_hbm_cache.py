@@ -566,6 +566,120 @@ def test_mixed_tier_matches_pure_ps():
         mixed2.train_stream(batches(1))
 
 
+def test_mixed_tier_adam_advances_beta_powers_once():
+    """Every feature group holding cached slots mirrors the device's
+    per-step Adam beta-power advance on the PS (not just group 0), ps-slot
+    groups advance via the worker's gradient batch, and a group can never
+    be advanced twice. A cached/ps-mixed FEATURE GROUP (one key space, two
+    tiers) is rejected outright."""
+    import optax
+
+    from persia_tpu.config import HashStackConfig
+    from persia_tpu.ctx import TrainCtx
+    from persia_tpu.models import DNN
+
+    def cfg():
+        # default per-slot feature groups: cat_a -> 0, cat_b -> 1, hs -> 2
+        return EmbeddingConfig(
+            slots_config={
+                "cat_a": SlotConfig(dim=8),
+                "cat_b": SlotConfig(dim=8),
+                "hs": SlotConfig(
+                    dim=8,
+                    hash_stack_config=HashStackConfig(
+                        hash_stack_rounds=2, embedding_size=40
+                    ),
+                ),
+            },
+            feature_index_prefix_bit=8,
+        )
+
+    def batches(n):
+        r = np.random.default_rng(29)
+        out = []
+        for _ in range(n):
+            ids = [
+                IDTypeFeature("cat_a", list(r.integers(0, 48, (16, 1), dtype=np.uint64))),
+                IDTypeFeature("cat_b", list(r.integers(0, 32, (16, 1), dtype=np.uint64))),
+                IDTypeFeature("hs", list(r.integers(0, 500, (16, 1), dtype=np.uint64))),
+            ]
+            out.append(PersiaBatch(
+                ids,
+                non_id_type_features=[NonIDTypeFeature(
+                    r.normal(size=(16, 4)).astype(np.float32))],
+                labels=[Label(r.integers(0, 2, (16, 1)).astype(np.float32))],
+                requires_grad=True,
+            ))
+        return out
+
+    def run(kind):
+        c = cfg()
+        store = EmbeddingStore(
+            capacity=1 << 16, num_internal_shards=2,
+            optimizer=Adam(lr=0.01).config, seed=11,
+        )
+        worker = EmbeddingWorker(c, [store])
+        model = DNN(dense_mlp_size=8, sparse_mlp_size=16, hidden_sizes=(16,))
+        if kind == "mixed":
+            ctx = hbm.CachedTrainCtx(
+                model=model, dense_optimizer=optax.sgd(1e-2),
+                embedding_optimizer=Adam(lr=0.01), worker=worker,
+                embedding_config=c, cache_rows=256,
+            )
+            assert ctx.tier.ps_slots == ("hs",)
+        else:
+            ctx = TrainCtx(
+                model=model, dense_optimizer=optax.sgd(1e-2),
+                embedding_optimizer=Adam(lr=0.01), worker=worker,
+                embedding_config=c,
+            )
+        with ctx:
+            for b in batches(6):
+                m = ctx.train_step(b)
+                assert np.isfinite(m["loss"])
+            if kind == "mixed":
+                ctx.flush()
+        return store
+
+    mstore = run("mixed")
+    pstore = run("pure")
+    c = cfg()
+    for name in ("cat_a", "cat_b", "hs"):
+        grp = c.group_of(name)
+        assert mstore._batch_state.get(grp) is not None, (name, grp)
+        np.testing.assert_allclose(
+            mstore._batch_state[grp], pstore._batch_state[grp], rtol=1e-12,
+            err_msg=f"{name} (group {grp}) beta powers diverged",
+        )
+
+    # one key space spanning both tiers is rejected at construction
+    bad = EmbeddingConfig(
+        slots_config={
+            "cat_a": SlotConfig(dim=8),
+            "hs": SlotConfig(
+                dim=8,
+                hash_stack_config=HashStackConfig(
+                    hash_stack_rounds=2, embedding_size=40
+                ),
+            ),
+        },
+        feature_index_prefix_bit=8,
+        feature_groups={"shared": ["cat_a", "hs"]},
+    )
+    store = EmbeddingStore(
+        capacity=1 << 12, num_internal_shards=2,
+        optimizer=Adam(lr=0.01).config, seed=11,
+    )
+    with pytest.raises(ValueError, match="cannot span both tiers"):
+        hbm.CachedTrainCtx(
+            model=DNN(dense_mlp_size=8, sparse_mlp_size=16, hidden_sizes=(16,)),
+            dense_optimizer=optax.sgd(1e-2),
+            embedding_optimizer=Adam(lr=0.01),
+            worker=EmbeddingWorker(bad, [store]),
+            embedding_config=bad, cache_rows=64,
+        )
+
+
 def test_train_stream_matches_sync_path():
     """The 3-thread pipelined train_stream must produce the same final PS
     state as the synchronous per-step path (tiny cache → constant evictions
