@@ -13,7 +13,16 @@ No network access → data is the seeded Criteo-shaped synthetic stream
 learnable; pass --deterministic for run-to-run reproducible results
 (ordered batches + staleness=1, the reference's REPRODUCIBLE=1 mode).
 
-Run:  python examples/criteo_dlrm/train.py [--scale kaggle|1tb] [--steps N]
+``--tier cached`` trains through the HBM write-back cache instead (the
+beyond-HBM capacity tier, persia_tpu/embedding/hbm_cache.py): the PS keeps
+the authoritative unbounded vocab, the working set trains in HBM with the
+sparse optimizer ON DEVICE, evictions write back in the pipelined
+train_stream, and ``publish()`` ships resident rows to the PS for serving
+freshness before eval. (--scale 1tb mixes tiers: its hash-stack slots ride
+the worker/PS path inside the same ctx.)
+
+Run:  python examples/criteo_dlrm/train.py [--scale kaggle|1tb]
+      [--tier hybrid|cached] [--steps N]
 """
 
 import argparse
@@ -40,7 +49,8 @@ from persia_tpu.testing import (
 EMB_DIM = 16
 
 
-def build_ctx(vocabs, ps_replicas=2, capacity=1 << 20, hashstack_above=None):
+def build_ctx(vocabs, ps_replicas=2, capacity=1 << 20, hashstack_above=None,
+              tier="hybrid"):
     slots = {}
     for i, v in enumerate(vocabs):
         hs = HashStackConfig()
@@ -61,6 +71,17 @@ def build_ctx(vocabs, ps_replicas=2, capacity=1 << 20, hashstack_above=None):
     ]
     worker = EmbeddingWorker(cfg, stores)
     model = DLRM(embedding_dim=EMB_DIM, bottom_mlp=(64, 32, EMB_DIM), top_mlp=(256, 128))
+    if tier == "cached":
+        from persia_tpu.embedding.hbm_cache import CachedTrainCtx
+
+        return CachedTrainCtx(
+            model=model,
+            dense_optimizer=optax.adam(1e-3),
+            embedding_optimizer=Adagrad(lr=0.05),
+            worker=worker,
+            embedding_config=cfg,
+            cache_rows=1 << 18,  # working set in HBM; vocab stays on the PS
+        )
     return TrainCtx(
         model=model,
         dense_optimizer=optax.adam(1e-3),
@@ -77,6 +98,11 @@ def main(argv=None) -> int:
     ap.add_argument("--steps", type=int, default=64, help="train batches")
     ap.add_argument("--eval-steps", type=int, default=8)
     ap.add_argument("--ps-replicas", type=int, default=2)
+    ap.add_argument(
+        "--tier", choices=("hybrid", "cached"), default="hybrid",
+        help="hybrid = host-PS lookups per step; cached = HBM write-back "
+        "cache with on-device sparse updates (capacity tier)",
+    )
     ap.add_argument("--ckpt-dir", default=None)
     ap.add_argument(
         "--deterministic", action="store_true",
@@ -93,19 +119,33 @@ def main(argv=None) -> int:
         num_samples=args.eval_steps * args.batch_size, vocab_sizes=vocabs, seed=4242
     )
 
-    ctx = build_ctx(vocabs, ps_replicas=args.ps_replicas, hashstack_above=hashstack_above)
+    ctx = build_ctx(vocabs, ps_replicas=args.ps_replicas,
+                    hashstack_above=hashstack_above, tier=args.tier)
     with ctx:
         losses = []
-        loader = DataLoader(
-            train.batches(batch_size=args.batch_size), ctx,
-            num_workers=1 if args.deterministic else 4,
-            staleness=1 if args.deterministic else 4,
-            reproducible=args.deterministic,
-        )
-        t0 = time.time()
-        for tb in loader:
-            losses.append(ctx.train_step_prepared(tb, loader)["loss"])
-        dt = time.time() - t0
+        if args.tier == "cached":
+            batches = list(train.batches(batch_size=args.batch_size))
+            t0 = time.time()
+            if ctx.tier.ps_slots:  # mixed-tier configs use the per-step path
+                for b in batches:
+                    losses.append(ctx.train_step(b)["loss"])
+                ctx.drain()
+            else:
+                ctx.train_stream(batches, on_metrics=lambda mm: losses.append(mm["loss"]))
+            dt = time.time() - t0
+            published = ctx.publish()  # serving-freshness valve before eval
+            print(f"published {published} resident rows to the PS", flush=True)
+        else:
+            loader = DataLoader(
+                train.batches(batch_size=args.batch_size), ctx,
+                num_workers=1 if args.deterministic else 4,
+                staleness=1 if args.deterministic else 4,
+                reproducible=args.deterministic,
+            )
+            t0 = time.time()
+            for tb in loader:
+                losses.append(ctx.train_step_prepared(tb, loader)["loss"])
+            dt = time.time() - t0
         sps = args.steps * args.batch_size / dt
 
         preds, labels = [], []

@@ -94,3 +94,21 @@ def test_datasets_auc_learnable():
     np.testing.assert_array_equal(w1, w2)
     assert np.all(np.abs(w1) <= 1.0)
     assert len(np.unique(hash_to_unit(np.arange(1000, dtype=np.uint64), 7))) == 1000
+
+
+def test_criteo_dlrm_cached_tier(capsys):
+    """--tier cached: the capacity tier (HBM write-back cache + publish)
+    drives the flagship example end to end; --scale 1tb additionally
+    exercises the mixed-tier path (hash-stack slots on the worker/PS side)."""
+    mod = _load("criteo_dlrm/train.py")
+    rc = mod.main(["--batch-size", "32", "--steps", "3", "--eval-steps", "1",
+                   "--ps-replicas", "2", "--tier", "cached"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "published" in out and "test_auc=" in out
+
+    rc = mod.main(["--batch-size", "32", "--steps", "3", "--eval-steps", "1",
+                   "--ps-replicas", "1", "--tier", "cached", "--scale", "1tb"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "criteo-dlrm[1tb]" in out and "test_auc=" in out
