@@ -19,7 +19,8 @@ the authoritative unbounded vocab, the working set trains in HBM with the
 sparse optimizer ON DEVICE, evictions write back in the pipelined
 train_stream, and ``publish()`` ships resident rows to the PS for serving
 freshness before eval. (--scale 1tb mixes tiers: its hash-stack slots ride
-the worker/PS path inside the same ctx.)
+the worker/PS path inside the same ctx, under bounded staleness in the
+stream.)
 
 Run:  python examples/criteo_dlrm/train.py [--scale kaggle|1tb]
       [--tier hybrid|cached] [--steps N]
@@ -126,12 +127,9 @@ def main(argv=None) -> int:
         if args.tier == "cached":
             batches = list(train.batches(batch_size=args.batch_size))
             t0 = time.time()
-            if ctx.tier.ps_slots:  # mixed-tier configs use the per-step path
-                for b in batches:
-                    losses.append(ctx.train_step(b)["loss"])
-                ctx.drain()
-            else:
-                ctx.train_stream(batches, on_metrics=lambda mm: losses.append(mm["loss"]))
+            # mixed-tier configs stream too (ps slots train under bounded
+            # staleness there, the reference's async mode)
+            ctx.train_stream(batches, on_metrics=lambda mm: losses.append(mm["loss"]))
             dt = time.time() - t0
             published = ctx.publish()  # serving-freshness valve before eval
             print(f"published {published} resident rows to the PS", flush=True)

@@ -1550,6 +1550,49 @@ class CachedTrainCtx:
         )
         return header, evict_payload, ps_gpacked
 
+    def _ps_forward(self, batch: PersiaBatch):
+        """Forward the PS-tier slot subset through the worker's forward-ref
+        machinery. Returns (ref, emb_batches, counts, entries) or None when
+        the batch carries no ps slots. The ref's staleness slot is ALWAYS
+        released on failure after the forward — any exception past
+        put_forward_ids aborts before propagating."""
+        if not self.tier.ps_slots:
+            return None
+        ps_feats = [
+            f for f in batch.id_type_features if f.name in self.tier.ps_slots
+        ]
+        if not ps_feats:
+            return None
+        from persia_tpu.ctx import stage_embeddings
+
+        ref = self.worker.put_forward_ids(PersiaBatch(ps_feats, requires_grad=False))
+        try:
+            embs = self.worker.forward_batch_id(ref, train=True)
+            entries, counts = stage_embeddings(embs)
+        except BaseException:
+            self.worker.abort_gradient(ref)
+            raise
+        return ref, embs, counts, entries
+
+    def _apply_ps_grads(self, ps_item, ps_gpacked) -> None:
+        """Unpack the step's packed ps-slot gradients (one layout
+        convention: unpack_step_grads) and return them to the worker; the
+        ref is released either by the update or by an abort on failure."""
+        from persia_tpu.parallel.train_step import unpack_step_grads
+
+        ref, embs, counts, entries = ps_item
+        try:
+            gp = np.asarray(ps_gpacked)
+            grads = unpack_step_grads(gp, {"emb": entries})
+            slot_grads = {
+                eb.name: (g if d is None else g[:d])
+                for eb, g, d in zip(embs, grads, counts)
+            }
+            self.worker.update_gradient_batched(ref, slot_grads)
+        except BaseException:
+            self.worker.abort_gradient(ref)
+            raise
+
     def train_step(self, batch: PersiaBatch, fetch_metrics: bool = True):
         (device_inputs, layout, miss_aux, cold_aux, restore_aux, evict_aux,
          evict_meta) = self.tier.prepare_batch(
@@ -1558,28 +1601,15 @@ class CachedTrainCtx:
         # mixed-tier: worker/PS-served slots (hash-stack or excluded) flow
         # through the same forward-ref machinery the hybrid ctx uses; their
         # gradients come back as a step output
-        ps_ref = None
-        ps_emb_batches = ps_counts = None
+        ps_item = self._ps_forward(batch)
         try:
-            if self.tier.ps_slots:
-                ps_feats = [
-                    f for f in batch.id_type_features
-                    if f.name in self.tier.ps_slots
-                ]
-                if ps_feats:
-                    from persia_tpu.ctx import stage_embeddings
-
-                    ps_sub = PersiaBatch(ps_feats, requires_grad=False)
-                    ps_ref = self.worker.put_forward_ids(ps_sub)
-                    ps_emb_batches = self.worker.forward_batch_id(
-                        ps_ref, train=True
-                    )
-                    entries, ps_counts = stage_embeddings(ps_emb_batches)
-                    device_inputs["ps_emb"] = entries
-                    layout = CacheLayout(
-                        stacked=layout.stacked,
-                        ps=tuple(eb.name for eb in ps_emb_batches),
-                    )
+            if ps_item is not None:
+                _ref, embs, _counts, entries = ps_item
+                device_inputs["ps_emb"] = entries
+                layout = CacheLayout(
+                    stacked=layout.stacked,
+                    ps=tuple(eb.name for eb in embs),
+                )
             if self.state is None:
                 self.init_state(jax.random.PRNGKey(0), device_inputs, layout)
             # explicit async host→device staging: passing numpy leaves
@@ -1593,28 +1623,17 @@ class CachedTrainCtx:
                 device_inputs, layout, miss_aux, cold_aux, restore_aux,
                 evict_aux,
             )
-            if ps_ref is not None:
-                # the PS-tier gradient return is an inherent d2h (same as
-                # the hybrid path); reuse the packed-gradient layout helper
-                # + pad-strip so the convention lives in one place
-                from persia_tpu.parallel.train_step import unpack_step_grads
-
-                grads = unpack_step_grads(
-                    np.asarray(ps_gpacked), {"emb": device_inputs["ps_emb"]}
-                )
-                slot_grads = {
-                    eb.name: (g if d is None else g[:d])
-                    for eb, g, d in zip(ps_emb_batches, grads, ps_counts)
-                }
-                self.worker.update_gradient_batched(ps_ref, slot_grads)
-                ps_ref = None  # applied — no abort on later failures
         except Exception:
             # any failure after the forward must release the staleness slot
             # + stashed layout, or the worker buffers leak (same contract as
             # TrainCtx.train_step)
-            if ps_ref is not None:
-                self.worker.abort_gradient(ps_ref)
+            if ps_item is not None:
+                self.worker.abort_gradient(ps_item[0])
             raise
+        if ps_item is not None:
+            # the PS-tier gradient return is an inherent d2h (same as the
+            # hybrid path); the helper aborts the ref itself on failure
+            self._apply_ps_grads(ps_item, ps_gpacked)
         prev = self._pending
         self._pending = (
             evict_meta, evict_payload, header, device_inputs["labels"][0].shape
@@ -1701,6 +1720,13 @@ class CachedTrainCtx:
         Returns the final step's metrics; ``on_metrics`` (if given) receives
         every step's metrics at the cost of a per-step device sync.
 
+        Mixed-tier configs stream too: PS-tier slots forward in the feeder
+        thread and their gradients return through the write-back thread, so
+        they train under BOUNDED staleness (a forward may read entries
+        whose previous-step gradients are in flight, the window set by the
+        prefetch depth) — the reference's async mode; cached slots stay
+        fully synchronous.
+
         ``fetch_final=False`` keeps the loop COMPLETELY free of
         device→host transfers: the final header is only
         ``block_until_ready``-synced (completion without a fetch) and
@@ -1712,13 +1738,6 @@ class CachedTrainCtx:
         """
         import queue as _queue
 
-        if self.tier.ps_slots:
-            raise NotImplementedError(
-                "train_stream does not support mixed-tier (worker/PS-served) "
-                f"slots yet: {self.tier.ps_slots} — use the per-step "
-                "train_step() path for configs with hash-stack or excluded "
-                "slots"
-            )
         self._land_pending()  # do not mix with a sync-path deferred step
         # pending eviction write-backs, seq → per-group record:
         #   {"sorted": {g: sorted u64 signs}, "order": {g: payload row of
@@ -1795,6 +1814,16 @@ class CachedTrainCtx:
                     if stop.is_set() or errors:
                         break
                     item = self.tier.prepare_batch(batch, hazard_gate=gate)
+                    ps_item = self._ps_forward(batch)
+                    if ps_item is not None:
+                        _ref, embs, _counts, entries = ps_item
+                        di0 = item[0]
+                        di0["ps_emb"] = entries
+                        layout0 = CacheLayout(
+                            stacked=item[1].stacked,
+                            ps=tuple(eb.name for eb in embs),
+                        )
+                        item = (di0, layout0) + item[2:]
                     evict_meta = item[6]
                     # evicted signs become hazard-gated HERE (admit time): a
                     # later batch's probe must not trust the PS for them
@@ -1807,7 +1836,9 @@ class CachedTrainCtx:
                             rec["order"][gn] = order
                         with cv:
                             pending[seq] = rec
-                    if not _put(prep_q, (seq, item)):
+                    if not _put(prep_q, (seq, item, ps_item)):
+                        if ps_item is not None:
+                            self.worker.abort_gradient(ps_item[0])
                         return
                     seq += 1
             except BaseException as e:  # noqa: BLE001 — propagate to caller
@@ -1825,7 +1856,7 @@ class CachedTrainCtx:
                     got = prep_q.get()
                     if got is SENTINEL:
                         break
-                    seq, item = got
+                    seq, item, ps_item = got
                     (di, layout, miss_aux, cold_aux, restore_aux, evict_aux,
                      evict_meta) = item
                     di, miss_aux, cold_aux, evict_aux = self._stage(
@@ -1843,8 +1874,10 @@ class CachedTrainCtx:
                     if not _put(
                         staged_q,
                         (seq, di, layout, miss_aux, cold_aux, restore_aux,
-                         evict_aux, evict_meta),
+                         evict_aux, evict_meta, ps_item),
                     ):
+                        if ps_item is not None:
+                            self.worker.abort_gradient(ps_item[0])
                         return
             except BaseException as e:  # noqa: BLE001
                 errors.append(e)
@@ -1889,6 +1922,18 @@ class CachedTrainCtx:
                     if item is SENTINEL:
                         _flush_acc(acc)
                         return
+                    if isinstance(item, tuple) and item[0] == "psgrad":
+                        # evictions queued BEFORE this step must land first:
+                        # the PS update may touch signs an earlier eviction
+                        # wrote back. If THAT flush fails, this step's ref
+                        # must still be released.
+                        try:
+                            _flush_acc(acc)
+                        except BaseException:
+                            self.worker.abort_gradient(item[1][0])
+                            raise
+                        self._apply_ps_grads(item[1], item[2])
+                        continue
                     acc.append(item)
                     if len(acc) >= FLUSH_STEPS:
                         _flush_acc(acc)
@@ -1910,20 +1955,40 @@ class CachedTrainCtx:
         wb_t.start()
         header = None
         label_shape = None
+
+        def _abort_drained(got) -> None:
+            # a drained-but-never-applied item may carry a PS-tier forward
+            # ref: release its staleness slot + stashed layout
+            if (
+                isinstance(got, tuple) and len(got) >= 3
+                and got[-1] is not None
+                and isinstance(got[-1], tuple) and len(got[-1]) == 4
+            ):
+                try:
+                    self.worker.abort_gradient(got[-1][0])
+                except Exception:  # noqa: BLE001 — shutdown best-effort
+                    pass
+
         try:
             while True:
                 item = staged_q.get()
                 if item is SENTINEL:
                     break
                 if errors:
+                    _abort_drained(item)
                     break
                 (seq, di, layout, miss_aux, cold_aux, restore_aux, evict_aux,
-                 evict_meta) = item
+                 evict_meta, ps_item) = item
                 if self.state is None:
                     self.init_state(jax.random.PRNGKey(0), di, layout)
-                header, evict_payload, _ps_g = self._dispatch(
+                header, evict_payload, ps_gpacked = self._dispatch(
                     di, layout, miss_aux, cold_aux, restore_aux, evict_aux
                 )
+                if ps_item is not None:
+                    # gradient return for PS-tier slots rides the write-back
+                    # thread (its d2h is off the dispatch path); FIFO order
+                    # keeps the worker's per-batch Adam advance in step order
+                    wb_q.put(("psgrad", ps_item, ps_gpacked))
                 label_shape = di["labels"][0].shape
                 if evict_meta:
                     # publish the DEVICE payload so the feeder's gate can
@@ -1949,16 +2014,26 @@ class CachedTrainCtx:
             stop.set()
             with cv:
                 cv.notify_all()
+
             # unblock stages stuck on full queues, then reap all threads
             while feeder_t.is_alive() or dp_t.is_alive():
                 try:
-                    prep_q.get_nowait()
+                    _abort_drained(prep_q.get_nowait())
                 except _queue.Empty:
                     pass
                 try:
-                    staged_q.get(timeout=0.1)
+                    _abort_drained(staged_q.get(timeout=0.1))
                 except _queue.Empty:
                     pass
+            # final sweep AFTER the feeders died: on an error shutdown they
+            # exit on their own, leaving queued items whose PS forward refs
+            # would otherwise leak staleness slots
+            for q in (prep_q, staged_q):
+                while True:
+                    try:
+                        _abort_drained(q.get_nowait())
+                    except _queue.Empty:
+                        break
             wb_q.put(SENTINEL)
             feeder_t.join(timeout=300)
             dp_t.join(timeout=300)

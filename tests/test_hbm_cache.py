@@ -560,10 +560,30 @@ def test_mixed_tier_matches_pure_ps():
             np.testing.assert_allclose(em, ep, rtol=2e-4, atol=2e-6)
             seen += 1
     assert seen > 10
-    # the stream path refuses mixed configs loudly
-    mixed2, _ = make("mixed")
-    with mixed2, pytest.raises(NotImplementedError, match="mixed-tier"):
-        mixed2.train_stream(batches(1))
+    # the pipelined stream drives the same mixed config: ps forwards run in
+    # the feeder, gradient returns ride the write-back thread in step order.
+    # ps slots train under BOUNDED STALENESS there (a forward can read
+    # entries whose previous-step gradients are still in flight — the
+    # reference's async mode), so the check is convergence-shaped, not
+    # bit parity.
+    mixed3, m3store = make("mixed")
+    with mixed3:
+        m = mixed3.train_stream(batches(6))
+        assert m is not None and np.isfinite(m["loss"])
+        assert mixed3.worker.staleness == 0  # every ref applied or aborted
+        mixed3.flush()
+    es_all, ep_all = [], []
+    for k in np.unique(keys)[:200].tolist():
+        es = m3store.get_embedding_entry(int(k))
+        ep = pstore.get_embedding_entry(int(k))
+        assert (es is None) == (ep is None)
+        if es is not None:
+            es_all.append(es)
+            ep_all.append(ep)
+    a, b = np.concatenate(es_all), np.concatenate(ep_all)
+    assert np.isfinite(a).all()
+    rel = np.linalg.norm(a - b) / max(np.linalg.norm(b), 1e-9)
+    assert rel < 0.5, f"stream mixed-tier drifted {rel:.3f} from sync"
 
 
 def test_mixed_tier_adam_advances_beta_powers_once():
