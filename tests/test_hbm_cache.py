@@ -898,3 +898,75 @@ def test_bf16_writeback_wire_trains_close_to_f32():
     b = np.concatenate([bf16_e[k].ravel() for k in sorted(bf16_e)])
     rel = np.linalg.norm(a - b) / max(np.linalg.norm(a), 1e-9)
     assert rel < 0.05, f"bf16-wire aggregate drift {rel:.4f}"
+
+
+def test_stream_error_shutdown_releases_ps_refs():
+    """A write-back failure mid-stream must abort every in-flight PS-tier
+    forward ref (queued or in hand): worker.staleness returns to 0 and the
+    post-forward buffer is empty — no permanent staleness leak after the
+    pipeline error propagates."""
+    import optax
+
+    from persia_tpu.config import HashStackConfig
+    from persia_tpu.models import DNN
+
+    cfg = EmbeddingConfig(
+        slots_config={
+            "cat_a": SlotConfig(dim=8),
+            "hs": SlotConfig(
+                dim=8,
+                hash_stack_config=HashStackConfig(
+                    hash_stack_rounds=2, embedding_size=40
+                ),
+            ),
+        },
+        feature_index_prefix_bit=8,
+    )
+    store = EmbeddingStore(
+        capacity=1 << 12, num_internal_shards=2,
+        optimizer=SGD(lr=0.1).config, seed=11,
+    )
+    worker = EmbeddingWorker(cfg, [store])
+    ctx = hbm.CachedTrainCtx(
+        model=DNN(dense_mlp_size=8, sparse_mlp_size=16, hidden_sizes=(16,)),
+        dense_optimizer=optax.sgd(1e-2),
+        embedding_optimizer=SGD(lr=0.1),
+        worker=worker,
+        embedding_config=cfg,
+        cache_rows=256,
+    )
+
+    # poison the ps gradient path after the first application
+    calls = {"n": 0}
+    orig = worker.update_gradient_batched
+
+    def failing(ref, slot_grads, scale_factor=1.0):
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            # raise WITHOUT releasing the ref: the release must come from
+            # _apply_ps_grads's own abort-on-failure contract
+            raise RuntimeError("injected ps gradient failure")
+        return orig(ref, slot_grads, scale_factor=scale_factor)
+
+    worker.update_gradient_batched = failing
+
+    rng = np.random.default_rng(31)
+
+    def batch():
+        ids = [
+            IDTypeFeature("cat_a", list(rng.integers(0, 48, (16, 1), dtype=np.uint64))),
+            IDTypeFeature("hs", list(rng.integers(0, 500, (16, 1), dtype=np.uint64))),
+        ]
+        return PersiaBatch(
+            ids,
+            non_id_type_features=[NonIDTypeFeature(
+                rng.normal(size=(16, 4)).astype(np.float32))],
+            labels=[Label(rng.integers(0, 2, (16, 1)).astype(np.float32))],
+            requires_grad=True,
+        )
+
+    with ctx, pytest.raises(RuntimeError, match="cached train pipeline failed"):
+        ctx.train_stream([batch() for _ in range(8)])
+    assert calls["n"] >= 2
+    assert worker.staleness == 0, "staleness slot leaked on error shutdown"
+    assert not worker.post_forward_buffer, "forward layout leaked"
