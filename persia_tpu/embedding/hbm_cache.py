@@ -66,6 +66,7 @@ from persia_tpu.embedding.worker import (
 from persia_tpu.logger import get_default_logger
 from persia_tpu.metrics import get_metrics
 from persia_tpu.ops.sparse_update import sparse_update
+from persia_tpu.tracing import span
 
 logger = get_default_logger("persia_tpu.hbm_cache")
 
@@ -930,7 +931,8 @@ class CachedEmbeddingTier:
                     restore_aux.setdefault(g.name, []).append(
                         (payload, src, dst)
                     )
-            warm, vals = self._probe(miss_signs, g.dim)
+            with span("cache.ps_probe", n=m):
+                warm, vals = self._probe(miss_signs, g.dim)
             widx = np.nonzero(warm & ~handled)[0]
             cidx = np.nonzero(~warm & ~handled)[0]
             if len(widx):
@@ -1118,13 +1120,15 @@ class CachedEmbeddingTier:
 
         for g, names, mat in fast:
             S, B = mat.shape
-            (rows, miss_signs, miss_rows, ev_signs, ev_rows,
-             n_unique) = self.dirs[g.name].admit_positions(mat.reshape(-1))
-            self._admit_aux(
-                g, miss_signs, miss_rows, ev_signs, ev_rows, n_unique,
-                hazard_gate, miss_aux, cold_aux, restore_aux, evict_aux,
-                evict_meta,
-            )
+            with span("cache.admit", group=g.name, n=mat.size):
+                (rows, miss_signs, miss_rows, ev_signs, ev_rows,
+                 n_unique) = self.dirs[g.name].admit_positions(mat.reshape(-1))
+            with span("cache.admit_aux", group=g.name, misses=len(miss_signs)):
+                self._admit_aux(
+                    g, miss_signs, miss_rows, ev_signs, ev_rows, n_unique,
+                    hazard_gate, miss_aux, cold_aux, restore_aux, evict_aux,
+                    evict_meta,
+                )
             stacked_rows[g.name] = rows.reshape(S, B, 1)
             layout_stacked.append((g.name, names))
 
@@ -1813,8 +1817,10 @@ class CachedTrainCtx:
                 for batch in batches:
                     if stop.is_set() or errors:
                         break
-                    item = self.tier.prepare_batch(batch, hazard_gate=gate)
-                    ps_item = self._ps_forward(batch)
+                    with span("stream.prep"):
+                        item = self.tier.prepare_batch(batch, hazard_gate=gate)
+                    with span("stream.ps_forward"):
+                        ps_item = self._ps_forward(batch)
                     if ps_item is not None:
                         _ref, embs, _counts, entries = ps_item
                         di0 = item[0]
@@ -1859,9 +1865,10 @@ class CachedTrainCtx:
                     seq, item, ps_item = got
                     (di, layout, miss_aux, cold_aux, restore_aux, evict_aux,
                      evict_meta) = item
-                    di, miss_aux, cold_aux, evict_aux = self._stage(
-                        di, miss_aux, cold_aux, evict_aux
-                    )
+                    with span("stream.stage"):
+                        di, miss_aux, cold_aux, evict_aux = self._stage(
+                            di, miss_aux, cold_aux, evict_aux
+                        )
                     # restore index arrays must commit like every other aux
                     # input: on a mesh an uncommitted put lands on one
                     # device and _restore_rows would see incompatible
@@ -1895,6 +1902,10 @@ class CachedTrainCtx:
         def _flush_acc(acc) -> None:
             if not acc:
                 return
+            with span("stream.wb_flush", steps=len(acc)):
+                _flush_acc_inner(acc)
+
+        def _flush_acc_inner(acc) -> None:
             pool = getattr(self.tier.worker, "_pool", None)
             fetches = []  # (seq, gname, k, device payload)
             for seq, evict_meta, evict_payload in acc:
@@ -1981,9 +1992,10 @@ class CachedTrainCtx:
                  evict_meta, ps_item) = item
                 if self.state is None:
                     self.init_state(jax.random.PRNGKey(0), di, layout)
-                header, evict_payload, ps_gpacked = self._dispatch(
-                    di, layout, miss_aux, cold_aux, restore_aux, evict_aux
-                )
+                with span("stream.dispatch"):
+                    header, evict_payload, ps_gpacked = self._dispatch(
+                        di, layout, miss_aux, cold_aux, restore_aux, evict_aux
+                    )
                 if ps_item is not None:
                     # gradient return for PS-tier slots rides the write-back
                     # thread (its d2h is off the dispatch path); FIFO order

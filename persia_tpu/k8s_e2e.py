@@ -49,7 +49,9 @@ def default_e2e_job(
 
 def _trainer_pods(api: KubeApi, namespace: str, job: str) -> List[Dict[str, Any]]:
     out = []
-    for o in api.list_labeled(namespace):
+    # None = listing failed (API hiccup): treat as nothing-visible-yet and
+    # let the poll loop retry next cycle
+    for o in api.list_labeled(namespace) or []:
         meta = o.get("metadata", {})
         labels = meta.get("labels", {})
         if (
@@ -116,10 +118,15 @@ def run_e2e(
     if teardown:
         api.delete(KIND, namespace, job_name)
         if drive_reconciler:
+            # two-phase finalizer teardown needs TWO cycles: one sweeps the
+            # children, the next observes them gone and releases the
+            # finalizer (KubectlApi.delete is --wait=false, so the parked CR
+            # does not block this thread)
+            rec.reconcile_once()
             rec.reconcile_once()
         leftovers = [
             o["metadata"]["name"]
-            for o in api.list_labeled(namespace)
+            for o in api.list_labeled(namespace) or []
             if o.get("metadata", {}).get("labels", {}).get(JOB_LABEL) == job_name
         ]
         if leftovers:

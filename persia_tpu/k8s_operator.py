@@ -71,6 +71,13 @@ class KubeApi:
     def pod_phase(self, obj: Dict[str, Any]) -> str:
         return obj.get("status", {}).get("phase", "Unknown")
 
+    def set_finalizers(self, namespace: str, name: str,
+                       finalizers: List[str]) -> None:
+        """Replace the finalizer list on a ``PersiaTpuJob`` CR (ref:
+        k8s/src/finalizer.rs — add on reconcile, remove once children are
+        confirmed gone, so the API server holds the CR until teardown is
+        ordered). Default no-op keeps finalizer-unaware backends working."""
+
 
 class KubectlApi(KubeApi):
     """Real-cluster backend (kubectl JSON shell-outs; the framework image
@@ -95,13 +102,14 @@ class KubectlApi(KubeApi):
             return []
 
     def list_labeled(self, namespace: Optional[str]) -> Optional[List[Dict[str, Any]]]:
-        """Per the KubeApi contract: returns ``None`` when the cluster-wide
-        listing FAILED (any kind) — the reconciler must distinguish 'access
-        denied' from 'no resources exist' or it would sweep/re-apply against
-        a partial view; namespaced listings stay best-effort."""
+        """Per the KubeApi contract: returns ``None`` when ANY of the
+        listings FAILED, cluster-wide or namespaced — the reconciler must
+        distinguish 'access denied / API down' from 'no resources exist' or
+        it would sweep/re-apply against a partial view (and, on the
+        namespaced fallback, re-issue create for every desired object each
+        tick against an empty view)."""
         scope = ["--all-namespaces"] if namespace is None else ["-n", namespace]
         objs: List[Dict[str, Any]] = []
-        failed = False
         for kind in ("pods", "services", "deployments"):
             try:
                 objs.extend(
@@ -110,14 +118,12 @@ class KubectlApi(KubeApi):
                     ).get("items", [])
                 )
             except subprocess.CalledProcessError as e:
-                failed = True
                 logger.warning(
                     "kubectl get %s %s failed: %s", kind, " ".join(scope),
                     (e.stderr or b"").strip() if isinstance(e.stderr, (bytes, str))
                     else e,
                 )
-        if failed and namespace is None:
-            return None
+                return None
         return objs
 
     def create(self, obj: Dict[str, Any]) -> None:
@@ -127,9 +133,21 @@ class KubectlApi(KubeApi):
         )
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
+        # --wait=false: a finalized CR parks on deletionTimestamp until a
+        # LATER reconcile cycle releases the finalizer — a blocking delete
+        # from the reconciler's own thread would deadlock on itself
         subprocess.run(
             [self.kubectl, "delete", kind.lower(), name, "-n", namespace,
-             "--ignore-not-found"],
+             "--ignore-not-found", "--wait=false"],
+            check=True, capture_output=True,
+        )
+
+    def set_finalizers(self, namespace: str, name: str,
+                       finalizers: List[str]) -> None:
+        subprocess.run(
+            [self.kubectl, "patch", f"{PLURAL}.{GROUP}", name, "-n", namespace,
+             "--type", "merge", "-p",
+             json.dumps({"metadata": {"finalizers": finalizers}})],
             check=True, capture_output=True,
         )
 
@@ -145,18 +163,66 @@ class Reconciler:
         # (see reconcile_once) and the REST tier's default
         self.namespace = namespace
         self._stop = threading.Event()
+        # consecutive cycles with NO usable observation (API unreachable):
+        # drives run()'s backoff and the alert counter — a chronically
+        # unreachable API must not degrade into silent pod leakage
+        self.observe_failures = 0
+        self._m_unreachable = None
+
+    def _observe_failed(self) -> None:
+        self.observe_failures += 1
+        if self._m_unreachable is None:
+            try:
+                from persia_tpu.metrics import get_metrics
+
+                self._m_unreachable = get_metrics().counter(
+                    "persia_operator_observe_failures_total",
+                    "reconcile cycles skipped: cluster API unreachable",
+                )
+            except Exception:  # noqa: BLE001
+                self._m_unreachable = False
+        if self._m_unreachable:
+            self._m_unreachable.inc()
+        logger.error(
+            "cluster observation unavailable (%d consecutive) — skipping "
+            "reconcile cycle, backing off", self.observe_failures,
+        )
 
     def reconcile_once(self) -> Dict[str, int]:
-        """One convergence pass. Returns action counts (for tests/metrics)."""
-        stats = {"created": 0, "deleted": 0, "restarted": 0}
+        """One convergence pass. Returns action counts (for tests/metrics).
+
+        Two-phase teardown via a finalizer (ref: k8s/src/finalizer.rs):
+        every live CR gets ``{GROUP}/teardown`` appended, so deleting the CR
+        — even while the operator is down — parks it with a
+        ``deletionTimestamp`` instead of vanishing. A deleting CR's children
+        leave the desired set (→ swept as orphans); only a cycle that
+        OBSERVES zero remaining children releases the finalizer, so the CR
+        cannot disappear before its resources do.
+        """
+        stats = {"created": 0, "deleted": 0, "restarted": 0, "skipped": 0,
+                 "finalized": 0, "released": 0}
         desired: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
+        deleting: List[Tuple[str, str, List[str]]] = []  # (ns, name, finalizers)
         for cr in self.api.list_jobs():
+            meta = cr.get("metadata", {})
+            ns = meta.get("namespace", "default")
+            name = meta.get("name", "")
+            finalizers = list(meta.get("finalizers", []))
+            if meta.get("deletionTimestamp"):
+                if _FINALIZER in finalizers:
+                    deleting.append((ns, name, finalizers))
+                continue  # children intentionally absent from desired
             try:
                 spec = job_from_custom_resource(cr)
             except Exception as e:  # noqa: BLE001 — one bad CR must not wedge the loop
-                logger.error("bad %s %s: %r", KIND,
-                             cr.get("metadata", {}).get("name"), e)
+                logger.error("bad %s %s: %r", KIND, name, e)
                 continue
+            if _FINALIZER not in finalizers:
+                try:
+                    self.api.set_finalizers(ns, name, finalizers + [_FINALIZER])
+                    stats["finalized"] += 1
+                except Exception:  # noqa: BLE001 — converge children anyway
+                    logger.exception("adding finalizer to %s/%s failed", ns, name)
             for obj in generate_manifests(spec):
                 desired[_obj_key(obj)] = obj
 
@@ -166,10 +232,18 @@ class Reconciler:
         # remembered state. Under namespace-scoped RBAC the cluster-wide
         # list FAILS (None — distinct from 'no resources'); fall back to the
         # operator's own namespace so convergence works within the granted
-        # scope.
+        # scope. If THAT also fails there is no usable view: skip the cycle
+        # (acting on a blind view would re-create everything / sweep
+        # nothing) and let run() back off.
         listed = self.api.list_labeled(None)
+        cluster_wide_view = listed is not None
         if listed is None:
-            listed = self.api.list_labeled(self.namespace) or []
+            listed = self.api.list_labeled(self.namespace)
+        if listed is None:
+            self._observe_failed()
+            stats["skipped"] = 1
+            return stats
+        self.observe_failures = 0
         actual = {_obj_key(o): o for o in listed}
 
         # replace failed pods first (restartPolicy at the controller level)
@@ -196,11 +270,43 @@ class Reconciler:
                 logger.info("tearing down orphan %s %s/%s", kind, ns, name)
                 self.api.delete(kind, ns, name)
                 stats["deleted"] += 1
+
+        # finalizer release: only when THIS cycle's observation shows no
+        # children left for the deleting CR (deletes just issued may be
+        # async — those CRs release on a later cycle, after the listing
+        # confirms the sweep landed)
+        for ns, name, finalizers in deleting:
+            if not cluster_wide_view and ns != self.namespace:
+                # the fallback view cannot see this CR's namespace —
+                # releasing on zero VISIBLE children would break the
+                # ordered-teardown guarantee; hold until a cycle with scope
+                continue
+            children = [
+                o for o in listed
+                if o.get("metadata", {}).get("labels", {}).get(JOB_LABEL) == name
+                and o.get("metadata", {}).get("namespace", "default") == ns
+            ]
+            if not children:
+                try:
+                    self.api.set_finalizers(
+                        ns, name, [f for f in finalizers if f != _FINALIZER]
+                    )
+                    stats["released"] += 1
+                    logger.info("released finalizer on %s/%s", ns, name)
+                except Exception:  # noqa: BLE001
+                    logger.exception("releasing finalizer on %s/%s failed", ns, name)
         return stats
+
+    def backoff_s(self, interval_s: float, max_s: float = 60.0) -> float:
+        """Next sleep: exponential in consecutive observation failures,
+        capped — an unreachable API is polled gently, not hammered."""
+        if not self.observe_failures:
+            return interval_s
+        return min(interval_s * (2.0 ** self.observe_failures), max_s)
 
     def run(self, interval_s: float = 2.0) -> None:
         logger.info("operator reconciling every %.1fs", interval_s)
-        while not self._stop.wait(interval_s):
+        while not self._stop.wait(self.backoff_s(interval_s)):
             try:
                 self.reconcile_once()
             except Exception:  # noqa: BLE001 — the loop must survive API hiccups

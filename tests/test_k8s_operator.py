@@ -19,6 +19,25 @@ class FakeKubeApi(KubeApi):
     def list_jobs(self):
         return list(self.jobs.values())
 
+    def set_finalizers(self, namespace, name, finalizers):
+        cr = self.jobs.get(name)
+        if cr is None:
+            return
+        cr.setdefault("metadata", {})["finalizers"] = list(finalizers)
+        # mirror the API server: a deleting CR with no finalizers left is
+        # actually removed
+        if not finalizers and cr["metadata"].get("deletionTimestamp"):
+            del self.jobs[name]
+
+    def mark_deleting(self, name):
+        """Simulate `kubectl delete` on a finalized CR: the API server sets
+        deletionTimestamp and waits for finalizers to clear."""
+        cr = self.jobs[name]
+        if cr.get("metadata", {}).get("finalizers"):
+            cr["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+        else:
+            del self.jobs[name]
+
     def list_labeled(self, namespace):
         return [
             o for o in self.objs.values()
@@ -68,8 +87,12 @@ def test_reconcile_creates_and_is_idempotent():
     pods = [k for k in api.objs if k[0] == "Pod"]
     # coordinator + 2 PS + 1 worker + 1 trainer host
     assert len([p for p in pods if "parameter-server" in p[2]]) == 2
+    # the CR was finalized on first contact (two-phase teardown armed)
+    assert api.jobs["job1"]["metadata"]["finalizers"]
     # second pass converged: no actions
-    assert rec.reconcile_once() == {"created": 0, "deleted": 0, "restarted": 0}
+    s2 = rec.reconcile_once()
+    assert (s2["created"], s2["deleted"], s2["restarted"], s2["finalized"]) \
+        == (0, 0, 0, 0)
 
 
 def test_reconcile_scales_down_orphans():
@@ -163,4 +186,75 @@ def test_reconcile_rbac_fallback_to_namespace():
     stats = rec.reconcile_once()
     assert stats["created"] > 0
     # idempotent: the fallback view sees what was created
-    assert rec.reconcile_once() == {"created": 0, "deleted": 0, "restarted": 0}
+    s2 = rec.reconcile_once()
+    assert (s2["created"], s2["deleted"], s2["restarted"]) == (0, 0, 0)
+
+
+def test_finalizer_two_phase_teardown():
+    """Deleting a finalized CR parks it (deletionTimestamp); the reconciler
+    sweeps children first and releases the finalizer only on a cycle that
+    OBSERVES zero children — the CR outlives its resources, never the
+    reverse (ref: k8s/src/finalizer.rs)."""
+    api = FakeKubeApi()
+    api.create(_cr())
+    rec = Reconciler(api)
+    rec.reconcile_once()  # creates children + adds finalizer
+    api.mark_deleting("job1")
+    assert "job1" in api.jobs  # parked, not gone
+
+    s = rec.reconcile_once()
+    assert s["deleted"] > 0  # children swept this cycle
+    # observation happened BEFORE the sweep → finalizer still held
+    assert s["released"] == 0 and "job1" in api.jobs
+
+    s = rec.reconcile_once()  # this cycle observes no children left
+    assert s["released"] == 1
+    assert "job1" not in api.jobs  # API server completed the deletion
+    assert not api.objs
+
+
+def test_finalizer_survives_operator_downtime():
+    """A CR deleted while the operator is down still tears down in order:
+    the finalizer parked it, and a FRESH reconciler (no in-memory state)
+    finishes the job."""
+    api = FakeKubeApi()
+    api.create(_cr())
+    Reconciler(api).reconcile_once()
+    api.mark_deleting("job1")  # operator 'down' — nobody reconciling
+
+    fresh = Reconciler(api)  # restart
+    fresh.reconcile_once()
+    fresh.reconcile_once()
+    assert "job1" not in api.jobs and not api.objs
+
+
+def test_no_view_skips_cycle_and_backs_off():
+    """When BOTH the cluster-wide and namespaced listings fail there is no
+    usable observation: the cycle must not create or delete anything, and
+    the loop's next sleep grows exponentially (capped)."""
+    class DownApi(FakeKubeApi):
+        down = True
+
+        def list_labeled(self, namespace):
+            if self.down:
+                return None
+            return super().list_labeled(namespace)
+
+    api = DownApi()
+    api.create(_cr())
+    rec = Reconciler(api)
+    s = rec.reconcile_once()
+    assert s["skipped"] == 1 and s["created"] == 0 and s["deleted"] == 0
+    assert not api.objs  # nothing was blindly created
+    assert rec.observe_failures == 1
+    rec.reconcile_once()
+    assert rec.observe_failures == 2
+    assert rec.backoff_s(2.0) == 8.0  # 2 * 2^2
+    for _ in range(10):
+        rec.reconcile_once()
+    assert rec.backoff_s(2.0) == 60.0  # capped
+
+    api.down = False  # API recovers → normal convergence + counter reset
+    s = rec.reconcile_once()
+    assert s["created"] > 0
+    assert rec.observe_failures == 0 and rec.backoff_s(2.0) == 2.0
