@@ -173,10 +173,18 @@ def bench_cached():
         model=model, dense_optimizer=optax.adam(1e-3),
         embedding_optimizer=Adagrad(lr=0.05), worker=worker,
         embedding_config=cfg, cache_rows=cache_rows,
-        # bf16 eviction wire (the reference ships f16 wires): halves the
-        # d2h bytes that bound the post-fill eviction steady state; the
-        # in-HBM training math and the checkpoint flush stay f32
+        # bf16 eviction + checkout wires (the reference ships f16 wires,
+        # lib.rs:157-180): halves the host↔device bytes that bound both the
+        # post-fill eviction steady state and the per-step miss checkouts;
+        # the in-HBM training math and the checkpoint flush stay f32
         wb_wire_dtype="bfloat16",
+        aux_wire_dtype=os.environ.get("BENCH_AUX_WIRE", "bfloat16"),
+        # touch-gated admission (the reference's admit_probability
+        # semantics: non-admitted signs read zeros, their gradients drop):
+        # one-hit-wonder signs in the zipf tail never enter the cache, so
+        # steady-state evictions/write-backs collapse to the genuinely
+        # recurring working set
+        admit_touches=int(os.environ.get("BENCH_ADMIT_TOUCHES", "2")),
     ).__enter__()
 
     rng = np.random.default_rng(0)

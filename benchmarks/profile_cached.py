@@ -71,6 +71,8 @@ def main():
         embedding_optimizer=Adagrad(lr=0.05), worker=worker,
         embedding_config=cfg, cache_rows=1 << 21,
         wb_wire_dtype="bfloat16",
+        aux_wire_dtype=os.environ.get("BENCH_AUX_WIRE", "bfloat16"),
+        admit_touches=int(os.environ.get("BENCH_ADMIT_TOUCHES", "2")),
     ).__enter__()
 
     rng = np.random.default_rng(0)

@@ -34,50 +34,82 @@ inline uint64_t splitmix64(uint64_t x) {
 struct Cache {
   int64_t capacity = 0;
   int64_t count = 0;
-  // per-row metadata (row index == slab slot)
+  // per-row metadata (row index == slab slot); prev/next interleaved in one
+  // 16-byte node so an LRU unlink touches one cache line, not two
   std::vector<uint64_t> row_sign;
-  std::vector<int64_t> prev, next;  // intrusive LRU list
+  struct Link { int64_t prev, next; };
+  std::vector<Link> lru;
   int64_t lru_head = -1, lru_tail = -1;
   std::vector<int64_t> free_rows;
-  // open addressing sign -> row
-  std::vector<uint64_t> table_sign;
-  std::vector<int64_t> table_row;  // -1 = empty
+  // open addressing sign -> row, sign and row interleaved in one 16-byte
+  // bucket so a probe costs ONE cache-line fetch (this directory is
+  // memory-latency-bound: the table spans tens of MB at production
+  // capacities and every probe is a random access)
+  struct Slot { uint64_t sign; int64_t row; };  // row -1 = empty
+  std::vector<Slot> table;
   uint64_t mask = 0;
+  // touch-gated admission (the reference's admit_probability analogue,
+  // persia-embedding-config HyperParameters): a sign is only ADMITTED on
+  // its admit_touches'th distinct-batch touch; earlier touches map to the
+  // pad row (forward contributes zero, gradient dropped — exactly the
+  // reference's non-admitted-sign semantics). Counters live in a compact
+  // counting-Bloom byte table (hash-indexed, no sign storage): collisions
+  // can only admit EARLY, never block admission. Slashes steady-state
+  // eviction write-backs under zipf traffic (one-hit wonders never enter).
+  int64_t admit_touches = 1;  // 1 = admit on first touch (exact parity)
+  std::vector<uint8_t> touch_counts;
+  uint64_t touch_mask = 0;
 
   explicit Cache(int64_t cap) : capacity(cap) {
     row_sign.assign(cap, 0);
-    prev.assign(cap, -1);
-    next.assign(cap, -1);
+    lru.assign(cap, Link{-1, -1});
     free_rows.reserve(cap);
     for (int64_t r = cap - 1; r >= 0; --r) free_rows.push_back(r);
     uint64_t tsize = 16;
     while (tsize < (uint64_t)cap * 2) tsize <<= 1;
-    table_sign.assign(tsize, 0);
-    table_row.assign(tsize, -1);
+    table.assign(tsize, Slot{0, -1});
     mask = tsize - 1;
+  }
+
+  void ensure_touch_table() {
+    if (touch_counts.empty()) {
+      uint64_t tsize = 16;
+      while (tsize < (uint64_t)capacity * 4) tsize <<= 1;
+      touch_counts.assign(tsize, 0);
+      touch_mask = tsize - 1;
+    }
+  }
+
+  // true -> admit now; false -> bypass this batch (counter bumped)
+  inline bool touch_admits(uint64_t sign) {
+    if (admit_touches <= 1) return true;
+    uint8_t& c = touch_counts[(splitmix64(sign ^ 0x5851F42D4C957F2DULL)) & touch_mask];
+    if (c + 1 >= admit_touches) { c = 0; return true; }
+    ++c;
+    return false;
   }
 
   inline uint64_t home(uint64_t sign) const { return splitmix64(sign) & mask; }
 
   int64_t find_pos(uint64_t sign) const {
     uint64_t i = home(sign);
-    while (table_row[i] >= 0) {
-      if (table_sign[i] == sign) return (int64_t)i;
+    while (table[i].row >= 0) {
+      if (table[i].sign == sign) return (int64_t)i;
       i = (i + 1) & mask;
     }
     return -1;
   }
 
   void lru_unlink(int64_t r) {
-    if (prev[r] >= 0) next[prev[r]] = next[r]; else lru_head = next[r];
-    if (next[r] >= 0) prev[next[r]] = prev[r]; else lru_tail = prev[r];
-    prev[r] = next[r] = -1;
+    const Link l = lru[r];
+    if (l.prev >= 0) lru[l.prev].next = l.next; else lru_head = l.next;
+    if (l.next >= 0) lru[l.next].prev = l.prev; else lru_tail = l.prev;
+    lru[r] = Link{-1, -1};
   }
 
   void lru_push_front(int64_t r) {
-    prev[r] = -1;
-    next[r] = lru_head;
-    if (lru_head >= 0) prev[lru_head] = r;
+    lru[r] = Link{-1, lru_head};
+    if (lru_head >= 0) lru[lru_head].prev = r;
     lru_head = r;
     if (lru_tail < 0) lru_tail = r;
   }
@@ -91,17 +123,16 @@ struct Cache {
   void erase_table_pos(uint64_t i) {
     uint64_t j = i;
     for (;;) {
-      table_row[i] = -1;
+      table[i].row = -1;
       uint64_t k;
       for (;;) {
         j = (j + 1) & mask;
-        if (table_row[j] < 0) return;
-        k = home(table_sign[j]);
+        if (table[j].row < 0) return;
+        k = home(table[j].sign);
         bool home_in_range = (i <= j) ? (i < k && k <= j) : (i < k || k <= j);
         if (!home_in_range) break;
       }
-      table_sign[i] = table_sign[j];
-      table_row[i] = table_row[j];
+      table[i] = table[j];
       i = j;
     }
   }
@@ -122,9 +153,8 @@ struct Cache {
     free_rows.pop_back();
     row_sign[r] = sign;
     uint64_t i = home(sign);
-    while (table_row[i] >= 0) i = (i + 1) & mask;
-    table_sign[i] = sign;
-    table_row[i] = r;
+    while (table[i].row >= 0) i = (i + 1) & mask;
+    table[i] = Slot{sign, r};
     lru_push_front(r);
     ++count;
     return r;
@@ -181,12 +211,16 @@ int64_t cache_admit(void* h, const uint64_t* signs, int64_t n,
   *n_evict_out = 0;
   if (n > c.capacity) return -1;
   int64_t n_miss = 0, n_evict = 0;
+  const int64_t PF = 16;  // software prefetch distance (latency-bound probes)
   for (int64_t i = 0; i < n; ++i) {
+    if (i + PF < n) __builtin_prefetch(&c.table[c.home(signs[i + PF])]);
     const int64_t pos = c.find_pos(signs[i]);
     if (pos >= 0) {
-      const int64_t r = c.table_row[pos];
+      const int64_t r = c.table[pos].row;
       c.touch(r);
       rows_out[i] = r;
+    } else if (!c.touch_admits(signs[i])) {
+      rows_out[i] = c.capacity;  // bypass: pad row — zero fwd, grad dropped
     } else {
       rows_out[i] = -1;
       miss_idx_out[n_miss++] = i;
@@ -232,10 +266,20 @@ int64_t cache_admit_positions(void* h, const uint64_t* signs, int64_t n,
   *n_evict_out = 0;
   c.scratch_reserve(n);
   // pass 1: dedup + touch residents; misses get ordinal placeholders.
-  // scratch_val holds: row (>=0, resident seen this batch) or
-  // -(miss_ordinal + 2) for a pending miss.
+  // scratch_val holds: row (>=0, resident seen this batch — or the pad row
+  // c.capacity for a touch-gated bypass) or -(miss_ordinal + 2) for a
+  // pending miss.
   int64_t n_unique = 0, n_miss = 0;
+  const int64_t PF = 16;  // software prefetch distance: the scratch and
+  // main tables span tens of MB, so every probe is a DRAM-latency random
+  // access — prefetching the home buckets of signs[i+16] overlaps ~16
+  // outstanding misses and is the main single-core speedup here
   for (int64_t i = 0; i < n; ++i) {
+    if (i + PF < n) {
+      const uint64_t sp = signs[i + PF];
+      __builtin_prefetch(&c.scratch_val[c.scratch_mask & splitmix64(sp)]);
+      __builtin_prefetch(&c.table[c.home(sp)]);
+    }
     const uint64_t s = signs[i];
     uint64_t j = c.scratch_mask & splitmix64(s);
     int64_t v;
@@ -248,9 +292,11 @@ int64_t cache_admit_positions(void* h, const uint64_t* signs, int64_t n,
       ++n_unique;
       const int64_t pos = c.find_pos(s);
       if (pos >= 0) {
-        const int64_t r = c.table_row[pos];
+        const int64_t r = c.table[pos].row;
         c.touch(r);
         v = r;
+      } else if (!c.touch_admits(s)) {
+        v = c.capacity;  // bypass: pad row — zero fwd, grad dropped
       } else {
         miss_signs_out[n_miss] = s;
         v = -(n_miss + 2);
@@ -292,9 +338,23 @@ int64_t cache_admit_positions(void* h, const uint64_t* signs, int64_t n,
 void cache_probe(void* h, const uint64_t* signs, int64_t n, int64_t* rows_out) {
   Cache& c = *static_cast<Cache*>(h);
   for (int64_t i = 0; i < n; ++i) {
+    if (i + 16 < n) __builtin_prefetch(&c.table[c.home(signs[i + 16])]);
     const int64_t pos = c.find_pos(signs[i]);
-    rows_out[i] = pos >= 0 ? c.table_row[pos] : -1;
+    rows_out[i] = pos >= 0 ? c.table[pos].row : -1;
   }
+}
+
+// Touch-gated admission knob (the reference's admit_probability analogue):
+// a non-resident sign is admitted only on its t'th distinct-batch touch;
+// earlier touches map to the pad row (zero forward, dropped gradient —
+// the reference's non-admitted-sign semantics). t=1 restores exact
+// admit-on-first-touch behavior.
+void cache_set_admit_touches(void* h, int64_t t) {
+  Cache& c = *static_cast<Cache*>(h);
+  // counters are uint8: clamp to 255 so a huge threshold degrades to
+  // "admit on the 255th touch" instead of wrapping and never admitting
+  c.admit_touches = t < 1 ? 1 : (t > 255 ? 255 : t);
+  if (c.admit_touches > 1) c.ensure_touch_table();
 }
 
 // Non-destructive listing of every resident (sign, row) pair in LRU order
@@ -303,7 +363,7 @@ void cache_probe(void* h, const uint64_t* signs, int64_t n, int64_t* rows_out) {
 int64_t cache_snapshot(void* h, uint64_t* signs_out, int64_t* rows_out) {
   Cache& c = *static_cast<Cache*>(h);
   int64_t k = 0;
-  for (int64_t r = c.lru_head; r >= 0; r = c.next[r]) {
+  for (int64_t r = c.lru_head; r >= 0; r = c.lru[r].next) {
     signs_out[k] = c.row_sign[r];
     rows_out[k] = r;
     ++k;
@@ -317,15 +377,14 @@ int64_t cache_snapshot(void* h, uint64_t* signs_out, int64_t* rows_out) {
 int64_t cache_drain(void* h, uint64_t* signs_out, int64_t* rows_out) {
   Cache& c = *static_cast<Cache*>(h);
   int64_t k = 0;
-  for (int64_t r = c.lru_head; r >= 0; r = c.next[r]) {
+  for (int64_t r = c.lru_head; r >= 0; r = c.lru[r].next) {
     signs_out[k] = c.row_sign[r];
     rows_out[k] = r;
     ++k;
   }
   // reset
-  std::fill(c.table_row.begin(), c.table_row.end(), (int64_t)-1);
-  std::fill(c.prev.begin(), c.prev.end(), (int64_t)-1);
-  std::fill(c.next.begin(), c.next.end(), (int64_t)-1);
+  std::fill(c.table.begin(), c.table.end(), Cache::Slot{0, -1});
+  std::fill(c.lru.begin(), c.lru.end(), Cache::Link{-1, -1});
   c.lru_head = c.lru_tail = -1;
   c.count = 0;
   c.free_rows.clear();

@@ -460,19 +460,51 @@ int64_t ps_probe_entries(void* h, const uint64_t* signs, int64_t n, uint32_t dim
                          float* out, uint8_t* warm_out) {
   Store* s = (Store*)h;
   const uint32_t entry_len = dim + s->opt.state_dim(dim);
+  // Group positions by shard (counting sort into thread-local scratch),
+  // then walk one shard at a time: ONE lock per touched shard instead of
+  // per sign, and the open-addressing probes run behind a software
+  // prefetch pipeline — the table spans hundreds of MB at production
+  // capacities, so each probe is a DRAM-latency random access otherwise.
+  const uint32_t ns = s->num_shards;
+  thread_local std::vector<uint32_t> cnt;
+  thread_local std::vector<uint32_t> shard_idx;
+  thread_local std::vector<int64_t> order;
+  cnt.assign(ns + 1, 0);
+  if ((int64_t)shard_idx.size() < n) { shard_idx.resize(n); order.resize(n); }
   for (int64_t i = 0; i < n; ++i) {
-    uint64_t sign = signs[i];
-    Shard& sh = s->shard_of(sign);
+    shard_idx[i] = (uint32_t)(splitmix64(signs[i] ^ 0xA5A5A5A5ULL) % ns);
+    cnt[shard_idx[i] + 1]++;
+  }
+  for (uint32_t r = 0; r < ns; ++r) cnt[r + 1] += cnt[r];
+  {
+    thread_local std::vector<uint32_t> ofs;
+    ofs.assign(cnt.begin(), cnt.end() - 1);
+    for (int64_t i = 0; i < n; ++i) order[ofs[shard_idx[i]]++] = i;
+  }
+  const int64_t PF = 8;
+  for (uint32_t r = 0; r < ns; ++r) {
+    const int64_t b = cnt[r], e_end = cnt[r + 1];
+    if (b == e_end) continue;
+    Shard& sh = s->shards[r];
     std::lock_guard<std::mutex> g(sh.mu);
-    size_t pos = sh.find_pos(sign);
-    int32_t e = (pos == SIZE_MAX) ? -1 : sh.table_slot[pos];
-    if (e >= 0 && sh.entries[e].dim == dim && sh.entries[e].len == entry_len) {
-      sh.touch(e);
-      std::memcpy(out + (size_t)i * entry_len, sh.entries[e].data,
-                  sizeof(float) * entry_len);
-      warm_out[i] = 1;
-    } else {
-      warm_out[i] = 0;
+    for (int64_t k = b; k < e_end; ++k) {
+      if (k + PF < e_end) {
+        const size_t hp = sh.home(signs[order[k + PF]]);
+        __builtin_prefetch(&sh.table_sign[hp]);
+        __builtin_prefetch(&sh.table_slot[hp]);
+      }
+      const int64_t i = order[k];
+      const uint64_t sign = signs[i];
+      size_t pos = sh.find_pos(sign);
+      int32_t e = (pos == SIZE_MAX) ? -1 : sh.table_slot[pos];
+      if (e >= 0 && sh.entries[e].dim == dim && sh.entries[e].len == entry_len) {
+        sh.touch(e);
+        std::memcpy(out + (size_t)i * entry_len, sh.entries[e].data,
+                    sizeof(float) * entry_len);
+        warm_out[i] = 1;
+      } else {
+        warm_out[i] = 0;
+      }
     }
   }
   return entry_len;

@@ -1,0 +1,64 @@
+"""Race-safe native-library builds shared by the ps/worker/cache cores.
+
+The .so is gated on a source hash (git checkouts do not preserve mtimes).
+Builds must be safe against CONCURRENT builders in other processes (pytest
+xdist workers, a bench subprocess, an editor-triggered rebuild): two g++
+invocations writing the same output path interleave their writes and produce
+a loadable-but-corrupt library — observed as silently wrong results, not a
+load error. So: compile to a per-pid temp file, ``os.replace`` it into place
+(atomic on POSIX — a concurrent ``dlopen`` sees the old or the new inode,
+never a mix), all under an ``flock``'d lockfile with a re-check so losers of
+the race reuse the winner's build instead of rebuilding.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import hashlib
+import os
+import subprocess
+import threading
+
+_PROC_LOCK = threading.Lock()
+
+
+def _hash_file(path: str) -> str:
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _is_fresh(so: str, stamp: str, h: str) -> bool:
+    if not (os.path.exists(so) and os.path.exists(stamp)):
+        return False
+    with open(stamp) as f:
+        return f.read().strip() == h
+
+
+def build_so(src: str, so: str, flags, logger, force: bool = False) -> str:
+    """Build ``src`` into ``so`` with g++ if stale; returns ``so``."""
+    stamp = so + ".srchash"
+    with _PROC_LOCK:
+        h = _hash_file(src)
+        if not force and _is_fresh(so, stamp, h):
+            return so
+        with open(so + ".lock", "w") as lf:
+            fcntl.flock(lf, fcntl.LOCK_EX)
+            try:
+                if not force and _is_fresh(so, stamp, h):
+                    return so  # another process just built it
+                tmp = f"{so}.tmp.{os.getpid()}"
+                cmd = ["g++", *flags, "-o", tmp, src]
+                logger.info("building %s: %s", os.path.basename(so), " ".join(cmd))
+                try:
+                    subprocess.check_call(cmd)
+                    os.replace(tmp, so)
+                finally:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
+                stamp_tmp = f"{stamp}.tmp.{os.getpid()}"
+                with open(stamp_tmp, "w") as f:
+                    f.write(h)
+                os.replace(stamp_tmp, stamp)
+                return so
+            finally:
+                fcntl.flock(lf, fcntl.LOCK_UN)

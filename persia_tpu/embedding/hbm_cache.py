@@ -42,9 +42,7 @@ can diverge slightly from a pure-PS run (Adagrad/SGD are exact).
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import os
-import subprocess
 import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
@@ -75,7 +73,6 @@ logger = get_default_logger("persia_tpu.hbm_cache")
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _SRC = os.path.join(_REPO_ROOT, "native", "cache.cpp")
 _SO = os.path.join(_REPO_ROOT, "native", "libpersia_cache.so")
-_BUILD_LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 
 _i64p = ctypes.POINTER(ctypes.c_int64)
@@ -83,20 +80,12 @@ _u64p = ctypes.POINTER(ctypes.c_uint64)
 
 
 def build_native(force: bool = False) -> str:
-    stamp = _SO + ".srchash"
-    with _BUILD_LOCK:
-        with open(_SRC, "rb") as f:
-            h = hashlib.sha256(f.read()).hexdigest()
-        if not force and os.path.exists(_SO) and os.path.exists(stamp):
-            with open(stamp) as f:
-                if f.read().strip() == h:
-                    return _SO
-        cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-Wall", "-o", _SO, _SRC]
-        logger.info("building native cache directory: %s", " ".join(cmd))
-        subprocess.check_call(cmd)
-        with open(stamp, "w") as f:
-            f.write(h)
-        return _SO
+    from persia_tpu.embedding._native_build import build_so
+
+    return build_so(
+        _SRC, _SO, ["-O3", "-std=c++17", "-fPIC", "-shared", "-Wall"],
+        logger, force=force,
+    )
 
 
 def _load_lib() -> ctypes.CDLL:
@@ -119,6 +108,7 @@ def _load_lib() -> ctypes.CDLL:
         lib.cache_drain.argtypes = [p, _u64p, _i64p]
         lib.cache_snapshot.restype = i64
         lib.cache_snapshot.argtypes = [p, _u64p, _i64p]
+        lib.cache_set_admit_touches.argtypes = [p, i64]
         _i32p = ctypes.POINTER(ctypes.c_int32)
         lib.cache_admit_positions.restype = i64
         lib.cache_admit_positions.argtypes = [
@@ -153,13 +143,76 @@ def native_uniform_init(
     return out
 
 
-class CacheDirectory:
-    """LRU map sign → device cache row (native C++, O(1) per op)."""
+class _BufRing:
+    """Reusable host staging buffers for the per-step hot path.
 
-    def __init__(self, capacity: int):
+    Fresh ``np.zeros``/``np.empty`` of ~0.5-1 MB per step cross the
+    allocator's mmap threshold, so every step pays mmap + first-touch page
+    faults + munmap TLB churn — profiled at ~20 ms/step of pure allocator
+    cost on a single-core host, dwarfing the actual compute. A ring of
+    ``depth`` buffers per call-site key amortizes that to zero while keeping
+    a buffer alive long enough for any in-flight async ``device_put`` to
+    finish serializing before the slot comes around again (depth must
+    exceed the stream's prefetch depth; 8 > 3)."""
+
+    def __init__(self, depth: int = 8):
+        self.depth = depth
+        self._slots: Dict = {}
+
+    def get(self, key, shape, dtype) -> np.ndarray:
+        arrs, idx = self._slots.get(key, ([], 0))
+        if len(arrs) < self.depth:
+            arr = np.empty(shape, dtype)
+            arrs.append(arr)
+            self._slots[key] = (arrs, 0)
+            return arr
+        arr = arrs[idx]
+        if arr.shape != shape or arr.dtype != np.dtype(dtype):
+            arr = np.empty(shape, dtype)
+            arrs[idx] = arr
+        self._slots[key] = (arrs, (idx + 1) % self.depth)
+        return arr
+
+    def full(self, key, shape, dtype, fill) -> np.ndarray:
+        arr = self.get(key, shape, dtype)
+        arr.fill(fill)
+        return arr
+
+
+class CacheDirectory:
+    """LRU map sign → device cache row (native C++, O(1) per op).
+
+    ``admit_touches`` — touch-gated admission (the reference's
+    ``admit_probability`` analogue, reference
+    `persia-embedding-config/src/lib.rs` HyperParameters): a non-resident
+    sign is admitted only on its Nth distinct-batch touch; earlier touches
+    map to the pad row ``capacity`` (zero forward contribution, gradient
+    dropped — the reference's non-admitted-sign semantics). Default 1 =
+    admit on first touch (exact parity with the ungated tier)."""
+
+    def __init__(self, capacity: int, admit_touches: int = 1):
         self._lib = _load_lib()
         self._h = self._lib.cache_create(capacity)
         self.capacity = capacity
+        self.admit_touches = int(admit_touches)
+        if self.admit_touches > 1:
+            self._lib.cache_set_admit_touches(self._h, self.admit_touches)
+        # reusable admit_positions outputs: 5 scratch arrays (miss/evict
+        # results are .copy()'d out, so a single reused buffer each is safe)
+        # plus a ring for the per-position rows (which ESCAPE to the async
+        # device staging path as views)
+        self._scratch_n = 0
+        self._rows_ring = _BufRing()
+
+    def _ensure_scratch(self, n: int) -> None:
+        if n <= self._scratch_n:
+            return
+        self._scratch_n = n
+        self._s_miss_signs = np.empty(n, dtype=np.uint64)
+        self._s_miss_rows = np.empty(n, dtype=np.int64)
+        self._s_ev_signs = np.empty(n, dtype=np.uint64)
+        self._s_ev_rows = np.empty(n, dtype=np.int64)
+        self._s_miss_idx = np.empty(n, dtype=np.int64)
 
     def __del__(self):
         if getattr(self, "_h", None) is not None:
@@ -176,10 +229,13 @@ class CacheDirectory:
         rows_out, so the outputs are uninitialized in that case)."""
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
         n = len(signs)
-        rows = np.empty(n, dtype=np.int64)
-        miss_idx = np.empty(n, dtype=np.int64)
-        ev_signs = np.empty(n, dtype=np.uint64)
-        ev_rows = np.empty(n, dtype=np.int64)
+        self._ensure_scratch(n)
+        # bucketed ring shape (n varies per batch; exact shapes would
+        # reallocate every call), result is the [:n] slice
+        rows = self._rows_ring.get("rows64", (_bucket(max(n, 1)),), np.int64)[:n]
+        miss_idx = self._s_miss_idx
+        ev_signs = self._s_ev_signs
+        ev_rows = self._s_ev_rows
         n_evict = ctypes.c_int64(0)
         n_miss = self._lib.cache_admit(
             self._h, signs.ctypes.data_as(_u64p), n,
@@ -203,11 +259,12 @@ class CacheDirectory:
         admit + row LUT for the single-id fast path."""
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
         n = signs.size
-        rows = np.empty(n, dtype=np.int32)
-        miss_signs = np.empty(n, dtype=np.uint64)
-        miss_rows = np.empty(n, dtype=np.int64)
-        ev_signs = np.empty(n, dtype=np.uint64)
-        ev_rows = np.empty(n, dtype=np.int64)
+        self._ensure_scratch(n)
+        rows = self._rows_ring.get("rows", (_bucket(max(n, 1)),), np.int32)[:n]
+        miss_signs = self._s_miss_signs
+        miss_rows = self._s_miss_rows
+        ev_signs = self._s_ev_signs
+        ev_rows = self._s_ev_rows
         n_unique = ctypes.c_int64(0)
         n_evict = ctypes.c_int64(0)
         i32p = ctypes.POINTER(ctypes.c_int32)
@@ -739,10 +796,27 @@ class CachedEmbeddingTier:
         embedding_config: Optional[EmbeddingConfig] = None,
         init_seed: Optional[int] = None,
         ps_slots: Sequence[str] = (),
+        admit_touches: int = 1,
+        aux_wire_dtype: str = "float32",
     ):
         self.worker = worker
         self.cfg = embedding_config or worker.embedding_config
         self.sparse_cfg = sparse_cfg
+        if aux_wire_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"aux_wire_dtype must be float32/bfloat16, got {aux_wire_dtype!r}"
+            )
+        # host→device wire dtype for the per-step miss/cold aux matrices
+        # (the largest per-step transfers). bf16 halves the bytes on a
+        # bandwidth-starved link; the device scatter casts to the table
+        # dtype, so only the checked-out entries/seeds are quantized (the
+        # reference ships f16 lookup wires the same way, lib.rs:157-180).
+        import ml_dtypes
+
+        self.aux_np_dtype = (
+            np.dtype(ml_dtypes.bfloat16)
+            if aux_wire_dtype == "bfloat16" else np.dtype(np.float32)
+        )
         # cold misses are seeded-init ON THE HOST (bit-identical to the PS's
         # init) and never touch the PS until eviction — the tier must know
         # the PS seed + init bounds (all replicas share them by convention)
@@ -778,7 +852,13 @@ class CachedEmbeddingTier:
                     f"{sorted(ms & set(self.ps_slots))}: one key space "
                     "cannot span both tiers"
                 )
-        self.dirs = {g.name: CacheDirectory(g.rows) for g in self.groups}
+        self.dirs = {
+            g.name: CacheDirectory(g.rows, admit_touches=admit_touches)
+            for g in self.groups
+        }
+        # host staging-buffer reuse (see _BufRing): all per-step aux pieces
+        # and probe results come from here instead of fresh mmap allocations
+        self._ring = _BufRing()
         self._slot_group = {s: g for g in self.groups for s in g.slots}
         # static fast-path eligibility per slot (config is immutable): the
         # per-batch check reduces to "every feature single-id" (the only
@@ -813,22 +893,33 @@ class CachedEmbeddingTier:
     _PAR_CHUNK = 8192
 
     def _probe(self, signs: np.ndarray, dim: int):
-        """Chunk-parallel warm/cold probe across the worker's thread pool."""
+        """Chunk-parallel warm/cold probe across the worker's thread pool.
+        Results land in ring-reused caller-owned buffers (chunks write
+        disjoint slices, so concurrent fills are safe)."""
         n = len(signs)
+        entry_len = dim + self.sparse_cfg.state_dim(dim)
+        # ring shapes are bucketed (n varies every step; an exact-shape ring
+        # would reallocate every call), results are the [:n] slices
+        nb = _bucket(max(n, 1))
+        vals = self._ring.get(
+            ("probe_vals", entry_len), (nb, entry_len), np.float32
+        )[:n]
+        warm8 = self._ring.get("probe_warm", (nb,), np.uint8)[:n]
         pool = getattr(self.worker, "_pool", None)
         if pool is None or n <= self._PAR_CHUNK:
-            return self.router.probe_entries(signs, dim)
-        bounds = list(range(0, n, self._PAR_CHUNK)) + [n]
-        parts = list(
-            pool.map(
-                lambda se: self.router.probe_entries(signs[se[0]:se[1]], dim),
-                zip(bounds[:-1], bounds[1:]),
+            return self.router.probe_entries(
+                signs, dim, vals_out=vals, warm_out=warm8
             )
-        )
-        return (
-            np.concatenate([w for w, _ in parts]),
-            np.concatenate([v for _, v in parts], axis=0),
-        )
+        bounds = list(range(0, n, self._PAR_CHUNK)) + [n]
+
+        def chunk(se):
+            s, e = se
+            self.router.probe_entries(
+                signs[s:e], dim, vals_out=vals[s:e], warm_out=warm8[s:e]
+            )
+
+        list(pool.map(chunk, zip(bounds[:-1], bounds[1:])))
+        return warm8.view(np.bool_), vals
 
     def _set_embedding(self, signs: np.ndarray, values: np.ndarray, dim: int) -> None:
         n = len(signs)
@@ -933,32 +1024,44 @@ class CachedEmbeddingTier:
                     )
             with span("cache.ps_probe", n=m):
                 warm, vals = self._probe(miss_signs, g.dim)
-            widx = np.nonzero(warm & ~handled)[0]
-            cidx = np.nonzero(~warm & ~handled)[0]
+            widx = np.nonzero(warm[:m] & ~handled)[0]
+            cidx = np.nonzero(~warm[:m] & ~handled)[0]
+            # aux buffers come from the reuse ring and escape to the async
+            # staging path; pad regions carry garbage values on purpose —
+            # pad rows are C+1, which the scatters drop
             if len(widx):
                 entry_len = g.dim + g.state_dim
                 wp = _bucket(len(widx))
-                w_rows = np.full(wp, C + 1, dtype=np.int32)
-                w_entries = np.zeros((wp, entry_len), dtype=np.float32)
+                w_rows = self._ring.full(("w_rows", g.name), (wp,), np.int32, C + 1)
+                w_entries = self._ring.get(
+                    ("w_entries", g.name), (wp, entry_len), self.aux_np_dtype
+                )
                 w_rows[:len(widx)] = rows_miss[widx]
-                w_entries[:len(widx)] = vals[widx]
+                w_entries[:len(widx)] = vals[widx]  # casts on a bf16 wire
                 miss_aux[g.name] = (w_rows, w_entries)
             if len(cidx):
                 lo, hi = self.init_bounds
                 cp = _bucket(len(cidx))
-                c_rows = np.full(cp, C + 1, dtype=np.int32)
-                c_emb = np.zeros((cp, g.dim), dtype=np.float32)
+                c_rows = self._ring.full(("c_rows", g.name), (cp,), np.int32, C + 1)
+                c_f32 = self._ring.get(("c_emb_f32", g.name), (cp, g.dim), np.float32)
                 c_rows[:len(cidx)] = rows_miss[cidx]
                 native_uniform_init(
                     miss_signs[cidx], self.init_seed, g.dim, lo, hi,
-                    out=c_emb[:len(cidx)],
+                    out=c_f32[:len(cidx)],
                 )
+                if self.aux_np_dtype == np.float32:
+                    c_emb = c_f32
+                else:
+                    c_emb = self._ring.get(
+                        ("c_emb", g.name), (cp, g.dim), self.aux_np_dtype
+                    )
+                    c_emb[:len(cidx)] = c_f32[:len(cidx)]
                 cold_aux[g.name] = (c_rows, c_emb)
         # evictions: rows to read back (pad → zero row, host slices K)
         k = len(ev_rows)
         if k:
             kp = _bucket(k)
-            e_rows = np.full(kp, C, dtype=np.int32)
+            e_rows = self._ring.full(("e_rows", g.name), (kp,), np.int32, C)
             e_rows[:k] = ev_rows
             evict_aux[g.name] = e_rows
             evict_meta[g.name] = (ev_signs, k)
@@ -996,7 +1099,10 @@ class CachedEmbeddingTier:
                 if len(flat) != len(counts) or not (counts == 1).all():
                     return None
                 if mat is None:
-                    mat = np.empty((len(names), len(counts)), dtype=np.uint64)
+                    mat = self._ring.get(
+                        ("sid_mat", g.name), (len(names), len(counts)),
+                        np.uint64,
+                    )
                 mat[i] = add_index_prefix(
                     flat.astype(np.uint64, copy=False),
                     self._fast_prefix[name], prefix_bit,
@@ -1091,8 +1197,8 @@ class CachedEmbeddingTier:
                 layout_stacked.append((g.name, tuple(stack_names)))
 
         device_inputs = {
-            "dense": [f.data.astype(np.float32) for f in batch.non_id_type_features],
-            "labels": [l.data.astype(np.float32) for l in batch.labels],
+            "dense": [np.asarray(f.data, dtype=np.float32) for f in batch.non_id_type_features],
+            "labels": [np.asarray(l.data, dtype=np.float32) for l in batch.labels],
             "stacked_rows": stacked_rows,
             "raw_rows": raw_rows,
         }
@@ -1133,8 +1239,8 @@ class CachedEmbeddingTier:
             layout_stacked.append((g.name, names))
 
         device_inputs = {
-            "dense": [f.data.astype(np.float32) for f in batch.non_id_type_features],
-            "labels": [l.data.astype(np.float32) for l in batch.labels],
+            "dense": [np.asarray(f.data, dtype=np.float32) for f in batch.non_id_type_features],
+            "labels": [np.asarray(l.data, dtype=np.float32) for l in batch.labels],
             "stacked_rows": stacked_rows,
             "raw_rows": {},
         }
@@ -1210,8 +1316,8 @@ class CachedEmbeddingTier:
                 layout_stacked.append((g.name, tuple(stack_names)))
 
         inputs = {
-            "dense": [f.data.astype(np.float32) for f in batch.non_id_type_features],
-            "labels": [l.data.astype(np.float32) for l in batch.labels],
+            "dense": [np.asarray(f.data, dtype=np.float32) for f in batch.non_id_type_features],
+            "labels": [np.asarray(l.data, dtype=np.float32) for l in batch.labels],
             "stacked_rows": stacked_rows,
             "raw_rows": raw_rows,
             "miss_tables": miss_tables,
@@ -1315,6 +1421,8 @@ class CachedTrainCtx:
         mesh=None,
         wb_wire_dtype: str = "float32",
         ps_slots: Sequence[str] = (),
+        admit_touches: int = 1,
+        aux_wire_dtype: str = "float32",
     ):
         self.model = model
         self.dense_optimizer = dense_optimizer
@@ -1335,6 +1443,7 @@ class CachedTrainCtx:
         self.tier = CachedEmbeddingTier(
             worker, self.sparse_cfg, cache_rows, embedding_config,
             init_seed=init_seed, ps_slots=ps_slots,
+            admit_touches=admit_touches, aux_wire_dtype=aux_wire_dtype,
         )
         # feature groups containing cached slots: the PS-side Adam beta
         # powers of EVERY one of them mirror the device's per-step advance
@@ -1509,12 +1618,13 @@ class CachedTrainCtx:
                 jax.device_put if rep is None
                 else (lambda a: jax.device_put(a, rep))
             )
+            aux_dt = self.tier.aux_np_dtype
             em = self._empties[gname] = {
                 "rows": put(np.empty(0, dtype=np.int32)),
                 "entries": put(
-                    np.empty((0, g.dim + g.state_dim), dtype=np.float32)
+                    np.empty((0, g.dim + g.state_dim), dtype=aux_dt)
                 ),
-                "emb": put(np.empty((0, g.dim), dtype=np.float32)),
+                "emb": put(np.empty((0, g.dim), dtype=aux_dt)),
             }
         return em
 

@@ -9,10 +9,7 @@ prefers the native core and falls back to numpy if the toolchain is missing.
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import os
-import subprocess
-import threading
 from typing import Optional
 
 import numpy as np
@@ -27,34 +24,19 @@ logger = get_default_logger("persia_tpu.native")
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _SRC = os.path.join(_REPO_ROOT, "native", "ps.cpp")
 _SO = os.path.join(_REPO_ROOT, "native", "libpersia_ps.so")
-_BUILD_LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 
 
-def _src_hash() -> str:
-    with open(_SRC, "rb") as f:
-        return hashlib.sha256(f.read()).hexdigest()
-
-
 def build_native(force: bool = False) -> str:
-    """Compile the native core if missing or stale (gated on a source hash,
-    not mtimes — git checkouts do not preserve mtimes). Returns the .so path."""
-    stamp = _SO + ".srchash"
-    with _BUILD_LOCK:
-        h = _src_hash()
-        if not force and os.path.exists(_SO) and os.path.exists(stamp):
-            with open(stamp) as f:
-                if f.read().strip() == h:
-                    return _SO
-        cmd = [
-            "g++", "-O3", "-mavx2", "-mfma", "-std=c++17", "-fPIC", "-shared",
-            "-Wall", "-o", _SO, _SRC,
-        ]
-        logger.info("building native PS core: %s", " ".join(cmd))
-        subprocess.check_call(cmd)
-        with open(stamp, "w") as f:
-            f.write(h)
-        return _SO
+    """Compile the native core if missing or stale (source-hash gated,
+    atomic + cross-process race-safe — see ``_native_build.build_so``)."""
+    from persia_tpu.embedding._native_build import build_so
+
+    return build_so(
+        _SRC, _SO,
+        ["-O3", "-mavx2", "-mfma", "-std=c++17", "-fPIC", "-shared", "-Wall"],
+        logger, force=force,
+    )
 
 
 def _load_lib() -> ctypes.CDLL:
@@ -181,20 +163,30 @@ class NativeEmbeddingStore:
             raise RuntimeError(f"ps_checkout entry_len {got} != expected {entry_len}")
         return out
 
-    def probe_entries(self, signs: np.ndarray, dim: int):
+    supports_probe_out = True
+
+    def probe_entries(self, signs: np.ndarray, dim: int,
+                      vals_out=None, warm_out=None):
         """Warm/cold split (no admission) — see the golden model's
-        ``probe_entries``. Returns (warm (n,) bool, vals (n, entry_len))."""
+        ``probe_entries``. Returns (warm (n,) bool, vals (n, entry_len)).
+        Cold rows of ``vals`` are UNSPECIFIED (callers read warm rows only);
+        caller-owned ``vals_out``/``warm_out`` avoid the per-call mmap
+        allocation on the cache tier's hot path. ``warm_out`` may be any
+        1-byte dtype; the native call writes every element."""
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
         entry_len = dim + (self.optimizer.state_dim(dim) if self.optimizer else 0)
-        vals = np.zeros((len(signs), entry_len), dtype=np.float32)
-        warm = np.zeros(len(signs), dtype=np.uint8)
+        n = len(signs)
+        vals = vals_out if vals_out is not None else np.empty(
+            (n, entry_len), dtype=np.float32
+        )
+        warm = warm_out if warm_out is not None else np.empty(n, dtype=np.uint8)
         got = self._lib.ps_probe_entries(
-            self._h, _u64p(signs), len(signs), dim, _f32p(vals),
+            self._h, _u64p(signs), n, dim, _f32p(vals),
             warm.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         )
         if got != entry_len:
             raise RuntimeError(f"ps_probe_entries entry_len {got} != {entry_len}")
-        return warm.astype(bool), vals
+        return warm.view(np.bool_)[:n] if warm_out is not None else warm.astype(bool), vals
 
     def advance_batch_state(self, group: int) -> None:
         self._lib.ps_advance_batch_state(self._h, group)

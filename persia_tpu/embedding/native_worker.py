@@ -12,10 +12,7 @@ native path (the pure-numpy fallback stays the golden model).
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import os
-import subprocess
-import threading
 from typing import Optional, Tuple
 
 import numpy as np
@@ -27,7 +24,6 @@ logger = get_default_logger("persia_tpu.native_worker")
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _SRC = os.path.join(_REPO_ROOT, "native", "worker.cpp")
 _SO = os.path.join(_REPO_ROOT, "native", "libpersia_worker.so")
-_BUILD_LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _LOAD_FAILED = False
 
@@ -37,30 +33,16 @@ _f32p = ctypes.POINTER(ctypes.c_float)
 _i32p = ctypes.POINTER(ctypes.c_int32)
 
 
-def _src_hash() -> str:
-    with open(_SRC, "rb") as f:
-        return hashlib.sha256(f.read()).hexdigest()
-
-
 def build_native(force: bool = False) -> str:
-    """Compile the worker core if missing or stale (source-hash stamped, same
-    scheme as `persia_tpu.embedding.native_store.build_native`)."""
-    stamp = _SO + ".srchash"
-    with _BUILD_LOCK:
-        h = _src_hash()
-        if not force and os.path.exists(_SO) and os.path.exists(stamp):
-            with open(stamp) as f:
-                if f.read().strip() == h:
-                    return _SO
-        cmd = [
-            "g++", "-O3", "-mavx2", "-mfma", "-std=c++17", "-fPIC", "-shared",
-            "-Wall", "-o", _SO, _SRC,
-        ]
-        logger.info("building native worker core: %s", " ".join(cmd))
-        subprocess.check_call(cmd)
-        with open(stamp, "w") as f:
-            f.write(h)
-        return _SO
+    """Compile the worker core if missing or stale (source-hash stamped,
+    atomic + cross-process race-safe — see ``_native_build.build_so``)."""
+    from persia_tpu.embedding._native_build import build_so
+
+    return build_so(
+        _SRC, _SO,
+        ["-O3", "-mavx2", "-mfma", "-std=c++17", "-fPIC", "-shared", "-Wall"],
+        logger, force=force,
+    )
 
 
 def _load_lib() -> Optional[ctypes.CDLL]:

@@ -240,14 +240,39 @@ class ShardedLookup:
             out = np.empty((0, dim), np.float32)
         return out
 
-    def probe_entries(self, signs: np.ndarray, dim: int):
+    def probe_entries(self, signs: np.ndarray, dim: int,
+                      vals_out: Optional[np.ndarray] = None,
+                      warm_out: Optional[np.ndarray] = None):
         """Sign-routed warm/cold split (no admission) for the HBM cache
-        tier. Returns (warm (n,) bool, vals (n, dim + state_dim))."""
+        tier. Returns (warm (n,) bool, vals (n, dim + state_dim)).
+
+        ``vals_out``/``warm_out``: optional caller-owned result buffers (the
+        cache tier's per-step probes would otherwise mmap-allocate ~1 MB
+        per call); replicas that support direct writes fill them natively,
+        others fall back to an extra copy."""
         n = len(self.replicas)
         if n == 1:
-            return self.replicas[0].probe_entries(signs, dim)
+            r = self.replicas[0]
+            if getattr(r, "supports_probe_out", False):
+                return r.probe_entries(
+                    signs, dim, vals_out=vals_out, warm_out=warm_out
+                )
+            warm, vals = r.probe_entries(signs, dim)
+            if vals_out is not None:
+                vals_out[:len(signs)] = vals
+                vals = vals_out
+            if warm_out is not None:
+                warm_out[:len(signs)] = warm
+            return warm, vals
+        # multi-replica assembly honors the out-buffers too: the cache
+        # tier's chunked _probe DISCARDS the return value and reads the
+        # buffers it passed in, so ignoring them here would hand it
+        # uninitialized memory
         warm = np.zeros(len(signs), dtype=bool)
         vals: Optional[np.ndarray] = None
+        if vals_out is not None:
+            vals = vals_out
+            vals[:len(signs)] = 0.0
         part = native_worker.shard_partition(signs, n)
         if part is not None:
             pos, counts = part
@@ -273,7 +298,12 @@ class ShardedLookup:
                     warm[mask] = w
                     vals[mask] = v
         if vals is None:
-            vals = np.zeros((0, dim), np.float32)
+            vals = (
+                vals_out if vals_out is not None
+                else np.zeros((0, dim), np.float32)
+            )
+        if warm_out is not None:
+            warm_out[:len(signs)] = warm
         return warm, vals
 
     def set_embedding(

@@ -133,12 +133,16 @@ class KubectlApi(KubeApi):
         )
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
-        # --wait=false: a finalized CR parks on deletionTimestamp until a
-        # LATER reconcile cycle releases the finalizer — a blocking delete
-        # from the reconciler's own thread would deadlock on itself
+        # --wait=false ONLY for the CR kind: a finalized CR parks on
+        # deletionTimestamp until a LATER reconcile cycle releases the
+        # finalizer, so a blocking delete from the reconciler's own thread
+        # would deadlock on itself. Child deletes stay synchronous — the
+        # finalizer-release check and the e2e leftovers check both rely on
+        # swept children actually being gone when the next listing runs.
+        wait = [] if kind != KIND else ["--wait=false"]
         subprocess.run(
             [self.kubectl, "delete", kind.lower(), name, "-n", namespace,
-             "--ignore-not-found", "--wait=false"],
+             "--ignore-not-found", *wait],
             check=True, capture_output=True,
         )
 
@@ -350,6 +354,9 @@ class OperatorHttpServer:
                     self._reply(200, {"jobs": names})
                 elif self.path.startswith("/status"):
                     objs = operator_self.api.list_labeled(namespace)
+                    if objs is None:  # listing failed — observation unavailable
+                        self._reply(503, {"error": "cluster API unavailable"})
+                        return
                     pods = {
                         o["metadata"]["name"]: operator_self.api.pod_phase(o)
                         for o in objs if o.get("kind") == "Pod"

@@ -970,3 +970,116 @@ def test_stream_error_shutdown_releases_ps_refs():
     assert calls["n"] >= 2
     assert worker.staleness == 0, "staleness slot leaked on error shutdown"
     assert not worker.post_forward_buffer, "forward layout leaked"
+
+
+# ------------------------------------------------------ touch-gated admission
+
+
+def test_directory_touch_gated_admission():
+    """admit_touches=2: a fresh sign's first batch maps to the pad row
+    (capacity) with NO miss recorded; its second batch admits it normally.
+    Residents keep hitting regardless."""
+    d = hbm.CacheDirectory(8, admit_touches=2)
+    s = np.array([40, 41], dtype=np.uint64)
+    rows, miss_s, miss_r, ev_s, ev_r, n_uniq = d.admit_positions(s)
+    assert (rows == 8).all()  # pad row = capacity
+    assert len(miss_s) == 0 and len(d) == 0 and n_uniq == 2
+    rows2, miss_s2, *_ = d.admit_positions(s)
+    assert sorted(miss_s2.tolist()) == [40, 41]
+    assert (rows2 < 8).all() and len(d) == 2
+    rows3, miss_s3, *_ = d.admit_positions(s)  # resident now: plain hits
+    assert len(miss_s3) == 0 and (rows3 == rows2).all()
+
+
+def test_directory_touch_gate_counts_batches_not_positions():
+    """Duplicate positions within one batch bump the touch counter ONCE —
+    a sign repeated 100x in its first batch still bypasses."""
+    d = hbm.CacheDirectory(8, admit_touches=2)
+    s = np.full(100, 7, dtype=np.uint64)
+    rows, miss_s, *_ = d.admit_positions(s)
+    assert (rows == 8).all() and len(miss_s) == 0 and len(d) == 0
+    rows2, miss_s2, *_ = d.admit_positions(s[:1])
+    assert miss_s2.tolist() == [7] and len(d) == 1
+
+
+def test_directory_touch_gate_general_path():
+    """The deduplicated admit() honors the gate too: bypassed signs come
+    back with the pad row and never appear in miss_idx."""
+    d = hbm.CacheDirectory(8, admit_touches=2)
+    rows, miss_idx, ev_s, ev_r = d.admit(np.array([70, 71], dtype=np.uint64))
+    assert (rows == 8).all() and len(miss_idx) == 0 and len(d) == 0
+    rows2, miss_idx2, *_ = d.admit(np.array([70, 71], dtype=np.uint64))
+    assert len(miss_idx2) == 2 and len(d) == 2
+
+
+def test_cached_touch_gated_trains_and_admits_recurring():
+    """End-to-end: admit_touches=2 trains (finite loss), never admits
+    one-batch signs, and a recurring stream converges the cache onto the
+    recurring working set — the steady-state eviction-collapse property the
+    reference gets from admit_probability."""
+    import optax
+
+    from persia_tpu.models import DNN
+
+    cfg = _cfg()
+    store = EmbeddingStore(
+        capacity=1 << 16, num_internal_shards=2,
+        optimizer=Adagrad(lr=0.05).config, seed=11,
+    )
+    worker = EmbeddingWorker(cfg, [store])
+    ctx = hbm.CachedTrainCtx(
+        model=DNN(dense_mlp_size=8, sparse_mlp_size=32, hidden_sizes=(32,)),
+        dense_optimizer=optax.sgd(1e-2),
+        embedding_optimizer=Adagrad(lr=0.05),
+        worker=worker,
+        embedding_config=cfg,
+        cache_rows=256,
+        admit_touches=2,
+    ).__enter__()
+    batches = _batches(8, seed=5)
+    m = ctx.train_stream(batches + batches)  # every sign recurs
+    assert m is not None and np.isfinite(m["loss"])
+    resident = sum(len(d) for d in ctx.tier.dirs.values())
+    assert resident > 0  # recurring signs were admitted on the second pass
+    # after flush, admitted signs' entries land in the PS like any other
+    ctx.flush()
+    entries = _store_entries(store, cfg)
+    assert len(entries) >= resident
+
+
+def test_bf16_aux_wire_trains_close_to_f32():
+    """bf16 checkout/cold-init wire: same stream as the f32 tier, loss stays
+    close and PS entries after flush agree to bf16 tolerance (the wire only
+    quantizes the h2d staging of entries, not the in-HBM training math)."""
+    batches = _batches(6, seed=9)
+
+    def run(aux):
+        import optax
+
+        from persia_tpu.models import DNN
+
+        cfg = _cfg()
+        store = EmbeddingStore(
+            capacity=1 << 16, num_internal_shards=2,
+            optimizer=Adagrad(lr=0.05).config, seed=11,
+        )
+        worker = EmbeddingWorker(cfg, [store])
+        ctx = hbm.CachedTrainCtx(
+            model=DNN(dense_mlp_size=8, sparse_mlp_size=32, hidden_sizes=(32,)),
+            dense_optimizer=optax.sgd(1e-2),
+            embedding_optimizer=Adagrad(lr=0.05),
+            worker=worker,
+            embedding_config=cfg,
+            cache_rows=128,  # smaller than the id space: evictions + re-checkouts
+            aux_wire_dtype=aux,
+        ).__enter__()
+        losses = [ctx.train_step(b)["loss"] for b in batches]
+        ctx.flush()
+        return losses, _store_entries(store, _cfg())
+
+    l32, e32 = run("float32")
+    l16, e16 = run("bfloat16")
+    assert np.allclose(l32, l16, rtol=0.05, atol=0.02)
+    assert set(e32) == set(e16)
+    for k in e32:
+        np.testing.assert_allclose(e32[k], e16[k], rtol=0.05, atol=0.02)

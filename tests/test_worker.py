@@ -243,3 +243,39 @@ def test_forward_id_not_found_is_typed():
     with pytest.raises(ForwardIdNotFound):
         worker.update_gradient_batched(ref, g)  # duplicate update
     assert worker.staleness == 0  # failed pop must not corrupt the gauge
+
+
+def test_sharded_probe_entries_fills_out_buffers():
+    """Multi-replica probe_entries must fill caller-owned vals_out/warm_out:
+    the cache tier's chunked probe discards the return value and reads the
+    buffers it passed in (garbage there scatters corrupt entries into HBM)."""
+    import numpy as np
+
+    from persia_tpu.config import EmbeddingConfig, SlotConfig
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.embedding.store import EmbeddingStore
+    from persia_tpu.embedding.worker import EmbeddingWorker
+
+    cfg = EmbeddingConfig(slots_config={"s": SlotConfig(dim=4)})
+    stores = [
+        EmbeddingStore(capacity=1 << 12, num_internal_shards=2,
+                       optimizer=Adagrad(lr=0.1).config, seed=7)
+        for _ in range(2)
+    ]
+    worker = EmbeddingWorker(cfg, stores)
+    router = worker.lookup_router
+    signs = np.arange(100, 200, dtype=np.uint64)
+    vals_in = np.random.default_rng(0).normal(size=(50, 4)).astype(np.float32)
+    entries = np.concatenate(
+        [vals_in, np.full((50, 4), 0.01, np.float32)], axis=1
+    )
+    router.set_embedding(signs[:50], entries, dim=4)
+
+    warm_ref, vals_ref = router.probe_entries(signs, 4)
+    entry_len = vals_ref.shape[1]
+    vals_out = np.full((100, entry_len), np.nan, dtype=np.float32)
+    warm_out = np.full(100, 7, dtype=np.uint8)
+    router.probe_entries(signs, 4, vals_out=vals_out, warm_out=warm_out)
+    np.testing.assert_array_equal(warm_out.astype(bool), warm_ref)
+    np.testing.assert_allclose(vals_out[warm_ref], vals_ref[warm_ref])
+    assert np.isfinite(vals_out[warm_ref]).all()
