@@ -225,21 +225,110 @@ def bench_cached():
     return steps * BATCH_SIZE / elapsed
 
 
+def bench_ps_stream():
+    """The PERSIA-parity fully-async regime: ALL slots PS-resident (no HBM
+    cache rows at all), driven through ``CachedTrainCtx.train_stream`` —
+    forwards run in the stream's feeder thread, gradients return as bf16
+    through the write-back thread's batched CONCURRENT d2h fetches, so the
+    pipeline trains under bounded staleness ≤ prefetch + psgrad_batch (the
+    reference's lookup-worker regime, forward.rs:640-779).
+
+    Ceiling note: this regime's throughput is bound by the device→host
+    gradient wire — samples/sec ≤ d2h_bandwidth / grad_bytes_per_sample.
+    On the remote-attached bench chip d2h measures ~5 MB/s (h2d ~1.4 GB/s),
+    so with bf16 sample-level grads (26·16·2 B/sample) the link caps the
+    mode at ~6k samples/sec REGARDLESS of host/device speed — which is the
+    architectural argument for the cached tier (gradients never leave the
+    chip). On PCIe-attached hardware (the reference's assumption, ~10 GB/s)
+    the same pipeline computes out to ~10M samples/sec of wire headroom.
+    """
+    import optax
+
+    from persia_tpu.config import EmbeddingConfig, SlotConfig
+    from persia_tpu.data import (
+        IDTypeFeatureWithSingleID,
+        Label,
+        NonIDTypeFeature,
+        PersiaBatch,
+    )
+    from persia_tpu.embedding.hbm_cache import CachedTrainCtx
+    from persia_tpu.embedding.native_store import create_store
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.embedding.worker import EmbeddingWorker
+    from persia_tpu.models import DLRM
+
+    steps = int(os.environ.get("BENCH_PS_STREAM_STEPS", "30"))
+    cfg = EmbeddingConfig(
+        slots_config={f"cat_{i}": SlotConfig(dim=EMB_DIM) for i in range(N_SLOTS)},
+        feature_index_prefix_bit=8,
+    )
+    store = create_store(
+        "auto", capacity=1 << 25, num_internal_shards=64,
+        optimizer=Adagrad(lr=0.05).config, seed=1,
+    )
+    worker = EmbeddingWorker(cfg, [store], num_threads=16)
+    model = DLRM(embedding_dim=EMB_DIM, bottom_mlp=(256, 64, EMB_DIM), top_mlp=(512, 256))
+    ctx = CachedTrainCtx(
+        model=model, dense_optimizer=optax.adam(1e-3),
+        embedding_optimizer=Adagrad(lr=0.05), worker=worker,
+        embedding_config=cfg, cache_rows=8,  # unused: every slot is PS-tier
+        ps_slots=[f"cat_{i}" for i in range(N_SLOTS)],
+        ps_wire_dtype="bfloat16",
+    ).__enter__()
+
+    rng = np.random.default_rng(0)
+    slot_offsets = rng.integers(0, VOCAB, N_SLOTS, dtype=np.uint64)
+
+    def make_batch():
+        ids = [
+            IDTypeFeatureWithSingleID(
+                f"cat_{i}", _zipf_ids(rng, BATCH_SIZE, VOCAB, slot_offsets[i])
+            )
+            for i in range(N_SLOTS)
+        ]
+        return PersiaBatch(
+            ids,
+            non_id_type_features=[
+                NonIDTypeFeature(rng.normal(size=(BATCH_SIZE, N_DENSE)).astype(np.float32))
+            ],
+            labels=[Label(rng.integers(0, 2, (BATCH_SIZE, 1)).astype(np.float32))],
+            requires_grad=True,
+        )
+
+    warmup = 4
+    batches = [make_batch() for _ in range(warmup + steps)]
+    ctx.train_stream(batches[:warmup], prefetch=4, psgrad_batch=16,
+                     fetch_final=False)
+    t0 = time.perf_counter()
+    ctx.train_stream(batches[warmup:], prefetch=4, psgrad_batch=16,
+                     fetch_final=False)
+    elapsed = time.perf_counter() - t0
+    m = ctx.last_metrics()
+    assert m is not None and np.isfinite(m["loss"])
+    return steps * BATCH_SIZE / elapsed
+
+
 def bench_hybrid():
-    """The host C++ PS tier (capacity tier): pipelined bounded-staleness
-    lookups/updates overlapping the device step."""
+    """The host C++ PS tier driven by the legacy per-step sync path with
+    the DataLoader's pipelined lookups (bounded staleness = loader
+    staleness); the fully-streamed async number is BENCH_MODE=ps-stream."""
     import optax
 
     from persia_tpu.config import EmbeddingConfig, SlotConfig
     from persia_tpu.ctx import TrainCtx
-    from persia_tpu.data import IDTypeFeature, Label, NonIDTypeFeature, PersiaBatch
+    from persia_tpu.data import (
+        IDTypeFeatureWithSingleID,
+        Label,
+        NonIDTypeFeature,
+        PersiaBatch,
+    )
     from persia_tpu.data_loader import DataLoader
     from persia_tpu.embedding.native_store import create_store
     from persia_tpu.embedding.optim import Adagrad
     from persia_tpu.embedding.worker import EmbeddingWorker
     from persia_tpu.models import DLRM
 
-    steps = 40
+    steps = int(os.environ.get("BENCH_HYBRID_STEPS", "100"))
     cfg = EmbeddingConfig(
         slots_config={f"cat_{i}": SlotConfig(dim=EMB_DIM) for i in range(N_SLOTS)},
         feature_index_prefix_bit=8,
@@ -257,12 +346,15 @@ def bench_hybrid():
     ).__enter__()
 
     rng = np.random.default_rng(0)
+    slot_offsets = rng.integers(0, VOCAB, N_SLOTS, dtype=np.uint64)
 
     def make_batch():
+        # single-id contiguous wire (the production shape; also what cached
+        # and ps-stream use) with per-slot zipf streams — distinct batches
+        # at 100+ steps would not fit in host RAM as per-sample array lists
         ids = [
-            IDTypeFeature(
-                f"cat_{i}",
-                list(rng.integers(0, VOCAB, (BATCH_SIZE, 1), dtype=np.uint64)),
+            IDTypeFeatureWithSingleID(
+                f"cat_{i}", _zipf_ids(rng, BATCH_SIZE, VOCAB, slot_offsets[i])
             )
             for i in range(N_SLOTS)
         ]
@@ -275,16 +367,16 @@ def bench_hybrid():
             requires_grad=True,
         )
 
-    batches = [make_batch() for _ in range(8)]
+    # distinct batches end to end (no short replay cycle: the PS LRU must
+    # see the real zipf stream, not a warmed 8-batch loop)
+    batches = [make_batch() for _ in range(WARMUP_STEPS + steps)]
 
     for i in range(WARMUP_STEPS):
-        ctx.train_step(batches[i % len(batches)])
+        ctx.train_step(batches[i])
 
-    def stream(n):
-        for i in range(n):
-            yield batches[i % len(batches)]
-
-    loader = DataLoader(stream(steps), ctx, num_workers=4, staleness=4)
+    loader = DataLoader(
+        iter(batches[WARMUP_STEPS:]), ctx, num_workers=4, staleness=4
+    )
     t0 = time.perf_counter()
     for tb in loader:
         # defer the header fetch out of the loop (the gradient d2h is
@@ -297,7 +389,12 @@ def bench_hybrid():
     return steps * BATCH_SIZE / elapsed
 
 
-_BENCHES = {"fused": bench_fused, "hybrid": bench_hybrid, "cached": bench_cached}
+_BENCHES = {
+    "fused": bench_fused,
+    "hybrid": bench_hybrid,
+    "cached": bench_cached,
+    "ps-stream": bench_ps_stream,
+}
 
 
 def _run_mode_isolated(mode: str) -> float:
@@ -342,7 +439,7 @@ def _result_line(results: dict) -> str:
 def main():
     mode = os.environ.get("BENCH_MODE", "all")
     if mode not in ("all", *_BENCHES):
-        raise SystemExit(f"BENCH_MODE must be one of all/fused/hybrid/cached, got {mode!r}")
+        raise SystemExit(f"BENCH_MODE must be one of all/{'/'.join(_BENCHES)}, got {mode!r}")
     results = {}
     if mode == "all":
         # headline mode FIRST, and a cumulative result line after EVERY

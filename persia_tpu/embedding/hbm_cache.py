@@ -587,6 +587,7 @@ def build_cached_train_step(
     groups: Sequence[CacheGroup],
     loss_fn=None,
     donate: bool = True,
+    ps_grad_dtype=jnp.float32,
 ):
     """Jitted ``step(state, batch, layout) -> (state, header)``.
 
@@ -715,9 +716,14 @@ def build_cached_train_step(
             [jnp.reshape(loss, (1,)).astype(jnp.float32),
              jnp.reshape(jax.nn.sigmoid(logits), (-1,)).astype(jnp.float32)]
         )
-        ps_flat = [jnp.reshape(g, (-1,)).astype(jnp.float32) for g in ps_g]
+        # ps-tier gradients are an inherent d2h; a bf16 wire halves the
+        # bytes on the return path (the reference ships scaled-f16 grad
+        # wires, lib.rs:157-180) — the host casts back to f32 before the
+        # worker's unscale/update
+        ps_flat = [jnp.reshape(g, (-1,)).astype(ps_grad_dtype) for g in ps_g]
         ps_gpacked = (
-            jnp.concatenate(ps_flat) if ps_flat else jnp.zeros((0,), jnp.float32)
+            jnp.concatenate(ps_flat) if ps_flat
+            else jnp.zeros((0,), ps_grad_dtype)
         )
         return new_state, header, ps_gpacked
 
@@ -1423,6 +1429,7 @@ class CachedTrainCtx:
         ps_slots: Sequence[str] = (),
         admit_touches: int = 1,
         aux_wire_dtype: str = "float32",
+        ps_wire_dtype: str = "float32",
     ):
         self.model = model
         self.dense_optimizer = dense_optimizer
@@ -1452,9 +1459,16 @@ class CachedTrainCtx:
             for g in self.tier.groups for s in g.slots
         }))
         self._state_consts = _state_init_consts(self.sparse_cfg)
+        if ps_wire_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"ps_wire_dtype must be float32/bfloat16, got {ps_wire_dtype!r}"
+            )
         self._step = build_cached_train_step(
             model, dense_optimizer, self.sparse_cfg, self.tier.groups,
             loss_fn=loss_fn,
+            ps_grad_dtype=(
+                jnp.bfloat16 if ps_wire_dtype == "bfloat16" else jnp.float32
+            ),
         )
         self._eval = build_cached_eval_step(model, self.tier.groups)
         self.table_dtype = table_dtype
@@ -1697,6 +1711,8 @@ class CachedTrainCtx:
         ref, embs, counts, entries = ps_item
         try:
             gp = np.asarray(ps_gpacked)
+            if gp.dtype != np.float32:  # bf16 ps-grad wire
+                gp = gp.astype(np.float32)
             grads = unpack_step_grads(gp, {"emb": entries})
             slot_grads = {
                 eb.name: (g if d is None else g[:d])
@@ -1746,7 +1762,12 @@ class CachedTrainCtx:
             raise
         if ps_item is not None:
             # the PS-tier gradient return is an inherent d2h (same as the
-            # hybrid path); the helper aborts the ref itself on failure
+            # hybrid path); the helper aborts the ref itself on failure.
+            # Ordering vs the deferred eviction write-back below is a
+            # non-issue: the constructor rejects feature groups spanning
+            # both tiers, so these gradients can never touch a sign an
+            # eviction wrote back (same invariant the stream path's
+            # _flush_ps documents).
             self._apply_ps_grads(ps_item, ps_gpacked)
         prev = self._pending
         self._pending = (
@@ -1813,6 +1834,7 @@ class CachedTrainCtx:
         on_metrics: Optional[Callable[[Dict], None]] = None,
         wb_flush_steps: int = 8,
         fetch_final: bool = True,
+        psgrad_batch: int = 8,
     ) -> Optional[Dict]:
         """Fully-pipelined training over an iterable of ``PersiaBatch``.
 
@@ -1841,6 +1863,16 @@ class CachedTrainCtx:
         prefetch depth) — the reference's async mode; cached slots stay
         fully synchronous.
 
+        ``psgrad_batch``: PS-tier gradient returns are device→host fetches;
+        on a high-latency link a serial per-step fetch caps the whole
+        pipeline at 1/latency. The write-back thread therefore accumulates
+        up to ``psgrad_batch`` consecutive steps' gradient outputs and
+        fetches them CONCURRENTLY (parallel transfers share the latency),
+        then applies them to the worker in step order — the staleness
+        window grows to ``prefetch + psgrad_batch`` steps, the same
+        throughput/staleness trade the reference's lookup-worker count
+        sets (forward.rs:640-779).
+
         ``fetch_final=False`` keeps the loop COMPLETELY free of
         device→host transfers: the final header is only
         ``block_until_ready``-synced (completion without a fetch) and
@@ -1863,8 +1895,11 @@ class CachedTrainCtx:
         stop = threading.Event()
         staged_q: "_queue.Queue" = _queue.Queue(maxsize=prefetch)
         # bounds device-memory retention: at most ~(queue + one flush batch)
-        # steps of eviction payloads stay pinned in HBM while the PS lags
-        wb_q: "_queue.Queue" = _queue.Queue(maxsize=max(1, wb_flush_steps) + prefetch)
+        # steps of eviction payloads (+ one psgrad batch) stay pinned in HBM
+        # while the PS lags
+        wb_q: "_queue.Queue" = _queue.Queue(
+            maxsize=max(1, wb_flush_steps) + prefetch + max(1, psgrad_batch)
+        )
         SENTINEL = object()
         errors: List[BaseException] = []
 
@@ -2035,31 +2070,75 @@ class CachedTrainCtx:
                 cv.notify_all()
             acc.clear()
 
+        PS_BATCH = max(1, psgrad_batch)
+
+        def _abort_ps_refs(items) -> None:
+            """Best-effort staleness-slot release for queued psgrad items
+            (shutdown paths): one place owns which tuple element holds the
+            ref and the swallow-exceptions policy."""
+            for it in items:
+                try:
+                    self.worker.abort_gradient(it[1][0])
+                except Exception:  # noqa: BLE001 — shutdown best-effort
+                    pass
+            if isinstance(items, list):
+                items.clear()
+
+        def _flush_ps(ps_acc) -> None:
+            """Fetch the accumulated steps' packed ps-grad outputs
+            CONCURRENTLY (d2h latency is shared), then apply to the worker
+            in step order. On an apply failure, not-yet-applied refs are
+            aborted (the failing apply aborts its own ref itself).
+
+            Ordering vs eviction write-backs: NONE needed — the constructor
+            rejects configs where a feature group spans both tiers, so a PS
+            gradient can never touch a sign an eviction wrote back; psgrad
+            batches and eviction flushes proceed independently, each keeping
+            its own concurrent-fetch batching."""
+            if not ps_acc:
+                return
+            pool = getattr(self.tier.worker, "_pool", None)
+
+            def fetch(it):
+                return np.asarray(it[2])
+
+            hosts = (
+                list(pool.map(fetch, ps_acc)) if pool
+                else [fetch(it) for it in ps_acc]
+            )
+            k = 0
+            try:
+                for k, ((_tag, ps_item, _g), host) in enumerate(
+                    zip(ps_acc, hosts)
+                ):
+                    self._apply_ps_grads(ps_item, host)
+            except BaseException:
+                _abort_ps_refs(ps_acc[k + 1:])
+                ps_acc.clear()
+                raise
+            ps_acc.clear()
+
         def writeback():
             acc: List = []
+            ps_acc: List = []
             while True:
                 item = wb_q.get()
                 try:
                     if item is SENTINEL:
                         _flush_acc(acc)
+                        _flush_ps(ps_acc)
                         return
                     if isinstance(item, tuple) and item[0] == "psgrad":
-                        # evictions queued BEFORE this step must land first:
-                        # the PS update may touch signs an earlier eviction
-                        # wrote back. If THAT flush fails, this step's ref
-                        # must still be released.
-                        try:
-                            _flush_acc(acc)
-                        except BaseException:
-                            self.worker.abort_gradient(item[1][0])
-                            raise
-                        self._apply_ps_grads(item[1], item[2])
+                        ps_acc.append(item)
+                        if len(ps_acc) >= PS_BATCH:
+                            _flush_ps(ps_acc)
                         continue
                     acc.append(item)
                     if len(acc) >= FLUSH_STEPS:
                         _flush_acc(acc)
                 except BaseException as e:  # noqa: BLE001
                     errors.append(e)
+                    _abort_ps_refs(ps_acc)
                     with cv:
                         for seq, _m, _p in acc:
                             pending.pop(seq, None)
@@ -2100,12 +2179,24 @@ class CachedTrainCtx:
                     break
                 (seq, di, layout, miss_aux, cold_aux, restore_aux, evict_aux,
                  evict_meta, ps_item) = item
-                if self.state is None:
-                    self.init_state(jax.random.PRNGKey(0), di, layout)
-                with span("stream.dispatch"):
-                    header, evict_payload, ps_gpacked = self._dispatch(
-                        di, layout, miss_aux, cold_aux, restore_aux, evict_aux
-                    )
+                try:
+                    if self.state is None:
+                        self.init_state(jax.random.PRNGKey(0), di, layout)
+                    with span("stream.dispatch"):
+                        header, evict_payload, ps_gpacked = self._dispatch(
+                            di, layout, miss_aux, cold_aux, restore_aux,
+                            evict_aux
+                        )
+                except BaseException:
+                    # the in-hand item is already off the queue: the
+                    # shutdown drain in finally can't see it, so its
+                    # staleness ref must be released HERE or it leaks
+                    if ps_item is not None:
+                        try:
+                            self.worker.abort_gradient(ps_item[0])
+                        except Exception:  # noqa: BLE001 — shutdown best-effort
+                            pass
+                    raise
                 if ps_item is not None:
                     # gradient return for PS-tier slots rides the write-back
                     # thread (its d2h is off the dispatch path); FIFO order

@@ -1083,3 +1083,81 @@ def test_bf16_aux_wire_trains_close_to_f32():
     assert set(e32) == set(e16)
     for k in e32:
         np.testing.assert_allclose(e32[k], e16[k], rtol=0.05, atol=0.02)
+
+
+def test_all_ps_stream_trains_and_releases_refs():
+    """Every slot PS-tier (zero cache groups): train_stream must run the
+    full async pipeline — forwards in the feeder, bf16 gradients batched
+    through the write-back thread — release every staleness ref, and leave
+    trained entries in the PS (the PERSIA-parity ps-stream bench regime)."""
+    import optax
+
+    from persia_tpu.models import DNN
+
+    cfg = _cfg()
+    store = EmbeddingStore(
+        capacity=1 << 16, num_internal_shards=2,
+        optimizer=Adagrad(lr=0.05).config, seed=11,
+    )
+    worker = EmbeddingWorker(cfg, [store])
+    ctx = hbm.CachedTrainCtx(
+        model=DNN(dense_mlp_size=8, sparse_mlp_size=32, hidden_sizes=(32,)),
+        dense_optimizer=optax.sgd(1e-2),
+        embedding_optimizer=Adagrad(lr=0.05),
+        worker=worker,
+        embedding_config=cfg,
+        cache_rows=8,  # unused: every slot rides the PS path
+        ps_slots=["cat_a", "cat_b", "cat_c"],
+        ps_wire_dtype="bfloat16",
+    ).__enter__()
+    batches = _batches(10, seed=4)
+    m = ctx.train_stream(batches, prefetch=3, psgrad_batch=4)
+    assert m is not None and np.isfinite(m["loss"])
+    assert worker.staleness == 0  # every forward ref got its grad (or abort)
+    entries = _store_entries(store, _cfg())
+    assert entries  # the PS actually trained
+    # gradient application is batched but must cover EVERY step: adagrad
+    # accumulators move away from their init for trained signs
+    accs = [e[8:] for e in entries.values()]
+    assert any((a > 0.0501).any() for a in accs)
+
+
+def test_stream_dispatch_failure_releases_in_hand_ps_ref():
+    """A _dispatch failure on the MAIN thread must release the in-hand
+    item's PS-tier forward ref: that item is already off staged_q, so the
+    shutdown drain can't see it — the main loop's own except must abort it
+    (regression: the leak left worker.staleness stuck >0 forever)."""
+    import optax
+
+    from persia_tpu.models import DNN
+
+    cfg = _cfg()
+    store = EmbeddingStore(
+        capacity=1 << 12, num_internal_shards=2,
+        optimizer=SGD(lr=0.1).config, seed=11,
+    )
+    worker = EmbeddingWorker(cfg, [store])
+    ctx = hbm.CachedTrainCtx(
+        model=DNN(dense_mlp_size=8, sparse_mlp_size=32, hidden_sizes=(16,)),
+        dense_optimizer=optax.sgd(1e-2),
+        embedding_optimizer=SGD(lr=0.1),
+        worker=worker,
+        embedding_config=cfg,
+        cache_rows=8,
+        ps_slots=["cat_a", "cat_b", "cat_c"],  # all-PS: every step has a ref
+    )
+    calls = {"n": 0}
+    orig = ctx._dispatch
+
+    def failing(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] >= 3:
+            raise RuntimeError("injected dispatch failure")
+        return orig(*a, **kw)
+
+    ctx._dispatch = failing
+    # the main thread's own exception propagates unwrapped
+    with pytest.raises(RuntimeError, match="injected dispatch failure"):
+        ctx.train_stream(_batches(10, seed=6), prefetch=3, psgrad_batch=4)
+    assert worker.staleness == 0
+    assert not worker.post_forward_buffer
