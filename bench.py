@@ -136,29 +136,27 @@ def _zipf_ids(rng, n, vocab, offset, a=1.2):
     return (raw + np.uint64(offset)) % vocab
 
 
-def bench_cached():
-    """The capacity tier with the HBM write-back cache: vocabulary lives on
-    the host C++ PS (beyond-HBM regime, reference README.md:29), the working
-    set lives in HBM, the sparse optimizer runs on device, and the previous
-    step's eviction write-back overlaps the current step
-    (persia_tpu/embedding/hbm_cache.py)."""
+def _cached_tier_ctx(ps_all: bool = False):
+    """THE bench configuration of the cached/ps tiers, shared by
+    bench_cached, bench_ps_stream and the quality gate — the quality
+    assertion prices exactly the configuration the throughput headline
+    runs, env knobs included (one builder, no copy to drift).
+
+    bf16 eviction + checkout wires (the reference ships f16 wires,
+    lib.rs:157-180) halve the host↔device bytes; the in-HBM training math
+    and the checkpoint flush stay f32. Touch-gated admission (the
+    reference's admit_probability semantics: non-admitted signs read
+    zeros, their gradients drop) keeps one-hit-wonder zipf-tail signs out,
+    collapsing steady-state evictions to the recurring working set."""
     import optax
 
     from persia_tpu.config import EmbeddingConfig, SlotConfig
-    from persia_tpu.data import (
-        IDTypeFeatureWithSingleID,
-        Label,
-        NonIDTypeFeature,
-        PersiaBatch,
-    )
     from persia_tpu.embedding.hbm_cache import CachedTrainCtx
     from persia_tpu.embedding.native_store import create_store
     from persia_tpu.embedding.optim import Adagrad
     from persia_tpu.embedding.worker import EmbeddingWorker
     from persia_tpu.models import DLRM
 
-    steps = int(os.environ.get("BENCH_CACHED_STEPS", "100"))
-    cache_rows = 1 << 21  # 2M rows in HBM vs 26M-sign PS vocabulary
     cfg = EmbeddingConfig(
         slots_config={f"cat_{i}": SlotConfig(dim=EMB_DIM) for i in range(N_SLOTS)},
         feature_index_prefix_bit=8,
@@ -169,23 +167,42 @@ def bench_cached():
     )
     worker = EmbeddingWorker(cfg, [store], num_threads=16)
     model = DLRM(embedding_dim=EMB_DIM, bottom_mlp=(256, 64, EMB_DIM), top_mlp=(512, 256))
-    ctx = CachedTrainCtx(
+    kw = dict(
         model=model, dense_optimizer=optax.adam(1e-3),
         embedding_optimizer=Adagrad(lr=0.05), worker=worker,
-        embedding_config=cfg, cache_rows=cache_rows,
-        # bf16 eviction + checkout wires (the reference ships f16 wires,
-        # lib.rs:157-180): halves the host↔device bytes that bound both the
-        # post-fill eviction steady state and the per-step miss checkouts;
-        # the in-HBM training math and the checkpoint flush stay f32
-        wb_wire_dtype="bfloat16",
-        aux_wire_dtype=os.environ.get("BENCH_AUX_WIRE", "bfloat16"),
-        # touch-gated admission (the reference's admit_probability
-        # semantics: non-admitted signs read zeros, their gradients drop):
-        # one-hit-wonder signs in the zipf tail never enter the cache, so
-        # steady-state evictions/write-backs collapse to the genuinely
-        # recurring working set
-        admit_touches=int(os.environ.get("BENCH_ADMIT_TOUCHES", "2")),
-    ).__enter__()
+        embedding_config=cfg,
+    )
+    if ps_all:
+        kw.update(
+            cache_rows=8,  # unused: every slot rides the PS path
+            ps_slots=[f"cat_{i}" for i in range(N_SLOTS)],
+            ps_wire_dtype="bfloat16",
+        )
+    else:
+        kw.update(
+            cache_rows=1 << 21,  # 2M rows in HBM vs 26M-sign PS vocabulary
+            wb_wire_dtype="bfloat16",
+            aux_wire_dtype=os.environ.get("BENCH_AUX_WIRE", "bfloat16"),
+            admit_touches=int(os.environ.get("BENCH_ADMIT_TOUCHES", "2")),
+        )
+    return CachedTrainCtx(**kw).__enter__()
+
+
+def bench_cached():
+    """The capacity tier with the HBM write-back cache: vocabulary lives on
+    the host C++ PS (beyond-HBM regime, reference README.md:29), the working
+    set lives in HBM, the sparse optimizer runs on device, and the previous
+    step's eviction write-back overlaps the current step
+    (persia_tpu/embedding/hbm_cache.py)."""
+    from persia_tpu.data import (
+        IDTypeFeatureWithSingleID,
+        Label,
+        NonIDTypeFeature,
+        PersiaBatch,
+    )
+
+    steps = int(os.environ.get("BENCH_CACHED_STEPS", "100"))
+    ctx = _cached_tier_ctx()
 
     rng = np.random.default_rng(0)
     slot_offsets = rng.integers(0, VOCAB, N_SLOTS, dtype=np.uint64)
@@ -242,39 +259,15 @@ def bench_ps_stream():
     chip). On PCIe-attached hardware (the reference's assumption, ~10 GB/s)
     the same pipeline computes out to ~10M samples/sec of wire headroom.
     """
-    import optax
-
-    from persia_tpu.config import EmbeddingConfig, SlotConfig
     from persia_tpu.data import (
         IDTypeFeatureWithSingleID,
         Label,
         NonIDTypeFeature,
         PersiaBatch,
     )
-    from persia_tpu.embedding.hbm_cache import CachedTrainCtx
-    from persia_tpu.embedding.native_store import create_store
-    from persia_tpu.embedding.optim import Adagrad
-    from persia_tpu.embedding.worker import EmbeddingWorker
-    from persia_tpu.models import DLRM
 
     steps = int(os.environ.get("BENCH_PS_STREAM_STEPS", "30"))
-    cfg = EmbeddingConfig(
-        slots_config={f"cat_{i}": SlotConfig(dim=EMB_DIM) for i in range(N_SLOTS)},
-        feature_index_prefix_bit=8,
-    )
-    store = create_store(
-        "auto", capacity=1 << 25, num_internal_shards=64,
-        optimizer=Adagrad(lr=0.05).config, seed=1,
-    )
-    worker = EmbeddingWorker(cfg, [store], num_threads=16)
-    model = DLRM(embedding_dim=EMB_DIM, bottom_mlp=(256, 64, EMB_DIM), top_mlp=(512, 256))
-    ctx = CachedTrainCtx(
-        model=model, dense_optimizer=optax.adam(1e-3),
-        embedding_optimizer=Adagrad(lr=0.05), worker=worker,
-        embedding_config=cfg, cache_rows=8,  # unused: every slot is PS-tier
-        ps_slots=[f"cat_{i}" for i in range(N_SLOTS)],
-        ps_wire_dtype="bfloat16",
-    ).__enter__()
+    ctx = _cached_tier_ctx(ps_all=True)
 
     rng = np.random.default_rng(0)
     slot_offsets = rng.integers(0, VOCAB, N_SLOTS, dtype=np.uint64)
@@ -389,6 +382,176 @@ def bench_hybrid():
     return steps * BATCH_SIZE / elapsed
 
 
+# -------------------------------------------------- quality-at-throughput
+
+
+def _quality_data(steps: int):
+    """Shared learnable stream (CriteoSynthetic: hidden ground-truth model,
+    deterministic per batch_id) split into one training epoch + a held-out
+    eval tail. Identical for every tier — same seed, same step budget."""
+    from persia_tpu.testing.datasets import CriteoSynthetic
+
+    eval_batches = 4
+    ds = CriteoSynthetic(
+        num_samples=(steps + eval_batches) * BATCH_SIZE,
+        vocab_sizes=[VOCAB] * N_SLOTS,
+        seed=5, task_seed=7,
+    )
+    all_b = list(ds.batches(BATCH_SIZE))
+    return all_b[:steps], all_b[steps:]
+
+
+def _auc_of(preds, labels) -> float:
+    from persia_tpu.testing.synthetic import roc_auc
+
+    return float(roc_auc(np.concatenate(labels), np.concatenate(preds)))
+
+
+def _quality_cached(steps, ps_all=False):
+    train_b, eval_b = _quality_data(steps)
+    # the SAME builder the throughput benches use (env knobs included):
+    # the quality number prices exactly the configuration of the headline
+    ctx = _cached_tier_ctx(ps_all=ps_all)
+    stream_kw = dict(fetch_final=False)
+    if ps_all:
+        stream_kw.update(prefetch=4, psgrad_batch=16)
+    # first two batches train UNTIMED (jit compilation happens there); the
+    # quality epoch still covers every batch exactly once
+    ctx.train_stream(train_b[:2], **stream_kw)
+    t0 = time.perf_counter()
+    ctx.train_stream(train_b[2:], **stream_kw)
+    elapsed = time.perf_counter() - t0
+    preds, labels = [], []
+    for b in eval_b:
+        preds.append(ctx.eval_batch(b).reshape(-1))
+        labels.append(np.asarray(b.labels[0].data).reshape(-1))
+    return {
+        "samples_per_sec": round((steps - 2) * BATCH_SIZE / elapsed, 1),
+        "auc": round(_auc_of(preds, labels), 6),
+    }
+
+
+def _quality_fused(steps):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.models import DLRM
+    from persia_tpu.parallel.fused_step import (
+        FusedSlotSpec,
+        build_fused_eval_step,
+        build_fused_train_step,
+        init_fused_state,
+    )
+
+    train_b, eval_b = _quality_data(steps)
+    specs = {f"cat_{i}": FusedSlotSpec(vocab=VOCAB, dim=EMB_DIM) for i in range(N_SLOTS)}
+    slot_order = sorted(specs)
+    model = DLRM(embedding_dim=EMB_DIM, bottom_mlp=(256, 64, EMB_DIM), top_mlp=(512, 256))
+    dense_opt = optax.adam(1e-3)
+    sparse_cfg = Adagrad(lr=0.05).config
+    step = build_fused_train_step(
+        model, dense_opt, sparse_cfg, specs, slot_order, stack=True
+    )
+    eval_step = build_fused_eval_step(model, specs, slot_order, stack=True)
+
+    def to_fused(b):
+        ids = {}
+        for f in b.id_type_features:
+            flat, counts = f.flat_counts()
+            assert len(flat) == len(counts), "quality stream is single-id"
+            ids[f.name] = flat.astype(np.int32)
+        return {
+            "dense": [np.asarray(b.non_id_type_features[0].data, np.float32)],
+            "labels": [np.asarray(b.labels[0].data, np.float32)],
+            "ids": ids,
+        }
+
+    fb = [to_fused(b) for b in train_b]
+    state = init_fused_state(
+        model, jax.random.PRNGKey(0), specs, fb[0], dense_opt, sparse_cfg,
+        stack=True,
+    )
+    state, (loss, _) = step(state, fb[0])  # compile outside the window
+    state = init_fused_state(
+        model, jax.random.PRNGKey(0), specs, fb[0], dense_opt, sparse_cfg,
+        stack=True,
+    )
+    t0 = time.perf_counter()
+    for b in fb:
+        state, (loss, _) = step(state, b)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+    preds, labels = [], []
+    for b in eval_b:
+        f = to_fused(b)
+        preds.append(np.asarray(eval_step(state, f)).reshape(-1))
+        labels.append(f["labels"][0].reshape(-1))
+    return {
+        "samples_per_sec": round(steps * BATCH_SIZE / elapsed, 1),
+        "auc": round(_auc_of(preds, labels), 6),
+    }
+
+
+def bench_quality():
+    """The north-star artifact (BASELINE.md): samples/sec AT matched model
+    quality. All three tiers train on the IDENTICAL learnable stream
+    (CriteoSynthetic, hidden ground truth) for the same step budget and are
+    scored by held-out AUC; each runs in its own subprocess (a d2h in one
+    tier's eval must not degrade the next tier's dispatch latency). The
+    spread assertion makes a throughput 'win' that trades away accuracy
+    (e.g. over-aggressive admission gating or wire quantization) fail the
+    bench instead of passing silently. Writes BENCH_QUALITY.json."""
+    import subprocess
+    import sys
+
+    steps = int(os.environ.get("BENCH_QUALITY_STEPS", "60"))
+    if steps < 3:
+        raise SystemExit(
+            "BENCH_QUALITY_STEPS must be >= 3 (the first 2 batches are the "
+            "untimed compile warmup)"
+        )
+    out = {}
+    for tier in ("cached", "ps-stream", "fused"):
+        env = dict(os.environ, BENCH_QUALITY_TIER=tier,
+                   BENCH_QUALITY_STEPS=str(steps))
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True,
+        )
+        lines = r.stdout.strip().splitlines()
+        if r.returncode != 0 or not lines:
+            raise RuntimeError(
+                f"quality tier {tier!r} failed (rc={r.returncode}):\n"
+                + "\n".join(r.stderr.strip().splitlines()[-15:])
+            )
+        out[tier] = json.loads(lines[-1])
+    aucs = [v["auc"] for v in out.values()]
+    out["auc_spread"] = round(max(aucs) - min(aucs), 6)
+    out["steps"] = steps
+    # the tiers must agree on quality: bf16 wires, touch gating and bounded
+    # staleness are allowed to cost at most this much AUC vs the exact
+    # all-in-HBM run on the same budget
+    assert out["auc_spread"] < 0.02, f"tier AUC spread too wide: {out}"
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_QUALITY.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    return out
+
+
+def _quality_tier_main(tier: str, steps: int):
+    if tier == "cached":
+        res = _quality_cached(steps)
+    elif tier == "ps-stream":
+        res = _quality_cached(steps, ps_all=True)
+    elif tier == "fused":
+        res = _quality_fused(steps)
+    else:
+        raise SystemExit(f"unknown quality tier {tier!r}")
+    print(json.dumps(res), flush=True)
+
+
 _BENCHES = {
     "fused": bench_fused,
     "hybrid": bench_hybrid,
@@ -437,9 +600,19 @@ def _result_line(results: dict) -> str:
 
 
 def main():
+    tier = os.environ.get("BENCH_QUALITY_TIER")
+    if tier:  # quality-tier subprocess
+        _quality_tier_main(tier, int(os.environ.get("BENCH_QUALITY_STEPS", "60")))
+        return
     mode = os.environ.get("BENCH_MODE", "all")
+    if mode == "quality":
+        out = bench_quality()
+        print(json.dumps({"metric": "quality_auc_at_throughput", **out}), flush=True)
+        return
     if mode not in ("all", *_BENCHES):
-        raise SystemExit(f"BENCH_MODE must be one of all/{'/'.join(_BENCHES)}, got {mode!r}")
+        raise SystemExit(
+            f"BENCH_MODE must be one of all/quality/{'/'.join(_BENCHES)}, got {mode!r}"
+        )
     results = {}
     if mode == "all":
         # headline mode FIRST, and a cumulative result line after EVERY
