@@ -155,8 +155,12 @@ class NativeEmbeddingStore:
     def checkout_entries(self, signs: np.ndarray, dim: int) -> np.ndarray:
         """Batched [emb | optimizer state] fetch for the HBM cache tier —
         same semantics as the numpy golden model's ``checkout_entries``."""
+        if self.optimizer is None:
+            # see EmbeddingStore.checkout_entries: a config-less store must
+            # not serve state-less rows to the cache tier
+            raise RuntimeError("no optimizer registered")
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
-        entry_len = dim + (self.optimizer.state_dim(dim) if self.optimizer else 0)
+        entry_len = dim + self.optimizer.state_dim(dim)
         out = np.empty((len(signs), entry_len), dtype=np.float32)
         got = self._lib.ps_checkout(self._h, _u64p(signs), len(signs), dim, _f32p(out))
         if got != entry_len:
@@ -173,8 +177,10 @@ class NativeEmbeddingStore:
         caller-owned ``vals_out``/``warm_out`` avoid the per-call mmap
         allocation on the cache tier's hot path. ``warm_out`` may be any
         1-byte dtype; the native call writes every element."""
+        if self.optimizer is None:
+            raise RuntimeError("no optimizer registered")  # see checkout_entries
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
-        entry_len = dim + (self.optimizer.state_dim(dim) if self.optimizer else 0)
+        entry_len = dim + self.optimizer.state_dim(dim)
         n = len(signs)
         vals = vals_out if vals_out is not None else np.empty(
             (n, entry_len), dtype=np.float32

@@ -194,6 +194,11 @@ class EmbeddingStore:
         write-back re-inserts on eviction regardless) with the same seeded
         init as ``lookup``; dim-mismatched entries re-init, matching
         ``lookup``."""
+        if self.optimizer is None:
+            # a restarted PS that lost its runtime config must NOT serve
+            # state-less entries (wrong width silently corrupts the cache
+            # tier); the typed error triggers the caller's re-register+retry
+            raise RuntimeError("no optimizer registered")
         entry_len = dim + self._state_dim(dim)
         out = np.empty((len(signs), entry_len), dtype=np.float32)
         with self._lock:
@@ -214,6 +219,8 @@ class EmbeddingStore:
         touch; missing signs are **not** admitted — the cache owns them
         until its eviction write-back re-inserts. Returns (warm (n,) bool,
         vals (n, dim + state_dim) — zeros on cold rows)."""
+        if self.optimizer is None:
+            raise RuntimeError("no optimizer registered")  # see checkout_entries
         entry_len = dim + self._state_dim(dim)
         warm = np.zeros(len(signs), dtype=bool)
         vals = np.zeros((len(signs), entry_len), dtype=np.float32)

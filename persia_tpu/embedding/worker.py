@@ -212,7 +212,10 @@ class ShardedLookup:
         returns (n, dim + state_dim) ``[emb | state]`` rows."""
         n = len(self.replicas)
         if n == 1:
-            return self.replicas[0].checkout_entries(signs, dim)
+            r0 = self.replicas[0]
+            return self._with_recovery(
+                r0, lambda: r0.checkout_entries(signs, dim)
+            )
         out: Optional[np.ndarray] = None
         part = native_worker.shard_partition(signs, n)
         if part is not None:
@@ -222,7 +225,10 @@ class ShardedLookup:
                 c = int(counts[r])
                 if c:
                     p = pos[start:start + c]
-                    vals = self.replicas[r].checkout_entries(signs[p], dim)
+                    rep = self.replicas[r]
+                    vals = self._with_recovery(
+                        rep, lambda rep=rep, p=p: rep.checkout_entries(signs[p], dim)
+                    )
                     if out is None:
                         out = np.empty((len(signs), vals.shape[1]), np.float32)
                     out[p] = vals
@@ -232,7 +238,11 @@ class ShardedLookup:
             for r in range(n):
                 mask = shard == r
                 if mask.any():
-                    vals = self.replicas[r].checkout_entries(signs[mask], dim)
+                    rep = self.replicas[r]
+                    vals = self._with_recovery(
+                        rep,
+                        lambda rep=rep, mask=mask: rep.checkout_entries(signs[mask], dim),
+                    )
                     if out is None:
                         out = np.empty((len(signs), vals.shape[1]), np.float32)
                     out[mask] = vals
@@ -254,10 +264,15 @@ class ShardedLookup:
         if n == 1:
             r = self.replicas[0]
             if getattr(r, "supports_probe_out", False):
-                return r.probe_entries(
-                    signs, dim, vals_out=vals_out, warm_out=warm_out
+                return self._with_recovery(
+                    r,
+                    lambda: r.probe_entries(
+                        signs, dim, vals_out=vals_out, warm_out=warm_out
+                    ),
                 )
-            warm, vals = r.probe_entries(signs, dim)
+            warm, vals = self._with_recovery(
+                r, lambda: r.probe_entries(signs, dim)
+            )
             if vals_out is not None:
                 vals_out[:len(signs)] = vals
                 vals = vals_out
@@ -281,7 +296,10 @@ class ShardedLookup:
                 c = int(counts[r])
                 if c:
                     p = pos[start:start + c]
-                    w, v = self.replicas[r].probe_entries(signs[p], dim)
+                    rep = self.replicas[r]
+                    w, v = self._with_recovery(
+                        rep, lambda rep=rep, p=p: rep.probe_entries(signs[p], dim)
+                    )
                     if vals is None:
                         vals = np.zeros((len(signs), v.shape[1]), np.float32)
                     warm[p] = w
@@ -292,7 +310,11 @@ class ShardedLookup:
             for r in range(n):
                 mask = shard == r
                 if mask.any():
-                    w, v = self.replicas[r].probe_entries(signs[mask], dim)
+                    rep = self.replicas[r]
+                    w, v = self._with_recovery(
+                        rep,
+                        lambda rep=rep, mask=mask: rep.probe_entries(signs[mask], dim),
+                    )
                     if vals is None:
                         vals = np.zeros((len(signs), v.shape[1]), np.float32)
                     warm[mask] = w

@@ -99,6 +99,12 @@ class ServiceCtx:
             self.procs.append(subprocess.Popen(cmd, env=env))
 
         self.coord_client = CoordinatorClient(coord_addr)
+        # wait for BOTH roles: a worker-less cluster (e.g. the cached tier's
+        # trainer-direct-to-PS shape) must still see its PS replicas
+        # registered before ps_clients() is usable
+        self.coord_client.wait_for(
+            "parameter_server", self.n_ps, timeout_s=self.startup_timeout_s
+        )
         self.coord_client.wait_for(
             "embedding_worker", self.n_workers, timeout_s=self.startup_timeout_s
         )

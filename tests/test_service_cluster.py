@@ -120,3 +120,127 @@ def test_cluster_checkpoint_and_infer(emb_cfg_path, tmp_path):
         after = worker2.forward_directly(probe, train=False)
         for b0, b1 in zip(before, after):
             np.testing.assert_array_equal(b0.pooled, b1.pooled)
+
+
+def test_cached_tier_over_remote_ps(emb_cfg_path, tmp_path):
+    """The flagship tier in its DEPLOYMENT shape: CachedTrainCtx.train_stream
+    with the PS replicas as real remote subprocesses reached over RPC —
+    parity with the fully in-process cached run on the same stream/seeds,
+    eviction write-backs landing over the wire, publish() freshness visible
+    from the PS side, surviving one PS kill+restart mid-training, and a
+    checkpoint round-trip through the remote dump path."""
+    from persia_tpu.embedding import hbm_cache as hbm
+    from persia_tpu.embedding.hashing import add_index_prefix
+
+    ds = SyntheticClickDataset(num_samples=768, vocab_sizes=VOCABS, seed=9)
+    cfg = load_embedding_config(emb_cfg_path)
+    ckpt = str(tmp_path / "cached_ckpt")
+
+    def make_ctx(worker):
+        return hbm.CachedTrainCtx(
+            model=DNN(dense_mlp_size=8, sparse_mlp_size=16, hidden_sizes=(32,)),
+            dense_optimizer=optax.adam(3e-3),
+            embedding_optimizer=Adagrad(lr=0.1),
+            worker=worker,
+            embedding_config=cfg,
+            # smaller than the 96-sign id space (batch-32 distinct counts
+            # stay under it): evictions + re-checkouts exercise the RPC
+            # write-back path
+            cache_rows=64,
+            init_seed=7,
+        ).__enter__()
+
+    batches = list(ds.batches(32))
+    with ServiceCtx(
+        num_parameter_servers=2, num_embedding_workers=0,
+        embedding_config_path=emb_cfg_path, backend="numpy", seed=7,
+    ) as svc:
+        ps = svc.ps_clients()
+        for c in ps:
+            c.wait_ready()
+        worker = EmbeddingWorker(cfg, ps)
+        ctx = make_ctx(worker)
+        losses = []
+        ctx.train_stream(batches[:12], on_metrics=lambda m: losses.append(m["loss"]))
+
+        # eviction write-backs actually landed on the REMOTE store
+        assert sum(c.size() for c in ps) > 0
+
+        # publish(): resident (never-evicted) hot rows become visible remotely
+        published = ctx.publish()
+        assert published > 0
+        slot = cfg.slot("cat_0")
+        hot = add_index_prefix(
+            np.arange(4, dtype=np.uint64), slot.index_prefix,
+            cfg.feature_index_prefix_bit,
+        )
+        assert any(
+            c.get_embedding_entry(int(s)) is not None for s in hot for c in ps
+        )
+
+        # one PS dies and comes back: idempotent probe/checkout RPCs retry
+        # through the reconnect and the stream finishes healthy
+        svc.kill_ps(0)
+        svc.restart_ps(0)
+        ctx.train_stream(batches[12:20], on_metrics=lambda m: losses.append(m["loss"]))
+        assert len(losses) == 20 and all(np.isfinite(losses))
+
+        # checkpoint through the remote dump path
+        ctx.dump_checkpoint(ckpt, blocking=True)
+        probe = next(ds.batches(32, requires_grad=False))
+        before = worker.forward_directly(probe, train=False)
+
+    # fresh cluster, different replica count: load + identical inference
+    with ServiceCtx(
+        num_parameter_servers=3, num_embedding_workers=0,
+        embedding_config_path=emb_cfg_path, backend="numpy", seed=7,
+    ) as svc2:
+        ps2 = svc2.ps_clients()
+        for c in ps2:
+            c.wait_ready()
+        worker2 = EmbeddingWorker(cfg, ps2)
+        loaded = worker2.load(ckpt)
+        assert loaded > 0
+        after = worker2.forward_directly(probe, train=False)
+        for b0, b1 in zip(before, after):
+            np.testing.assert_array_equal(b0.pooled, b1.pooled)
+
+
+def test_cached_tier_remote_matches_in_process(emb_cfg_path):
+    """Numeric parity: the remote-PS cached run must produce the same losses
+    as the fully in-process cached run on the identical stream and seeds
+    (the RPC layer is a transport, not a math change)."""
+    from persia_tpu.embedding import hbm_cache as hbm
+
+    ds = SyntheticClickDataset(num_samples=512, vocab_sizes=VOCABS, seed=3)
+    cfg = load_embedding_config(emb_cfg_path)
+
+    def run(worker):
+        ctx = hbm.CachedTrainCtx(
+            model=DNN(dense_mlp_size=8, sparse_mlp_size=16, hidden_sizes=(32,)),
+            dense_optimizer=optax.adam(3e-3),
+            embedding_optimizer=Adagrad(lr=0.1),
+            worker=worker,
+            embedding_config=cfg,
+            cache_rows=64,
+            init_seed=7,
+        ).__enter__()
+        out = []
+        ctx.train_stream(list(ds.batches(32)), on_metrics=lambda m: out.append(m["loss"]))
+        return out
+
+    with ServiceCtx(
+        num_parameter_servers=2, num_embedding_workers=0,
+        embedding_config_path=emb_cfg_path, backend="numpy", seed=7,
+    ) as svc:
+        ps = svc.ps_clients()
+        for c in ps:
+            c.wait_ready()
+        remote_losses = run(EmbeddingWorker(cfg, ps))
+
+    stores = [
+        EmbeddingStore(capacity=1 << 18, num_internal_shards=4, seed=7)
+        for _ in range(2)
+    ]
+    local_losses = run(EmbeddingWorker(cfg, stores))
+    np.testing.assert_allclose(remote_losses, local_losses, rtol=1e-5, atol=1e-6)
