@@ -1,0 +1,86 @@
+"""Remote-PS fan-out scaling microbench.
+
+Spawns N real PS subprocesses (ServiceCtx) and times sign-routed
+``checkout_entries``/``probe_entries``/``update`` through ShardedLookup for
+N = 1, 2, 4 replicas. With the concurrent fan-out the per-call wall time
+should stay ROUGHLY FLAT as replicas grow (each replica handles 1/N of the
+signs, all in flight at once) — the serial fan-out it replaces grew the
+wall time toward N x single-replica RTT. Prints one JSON line per N.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import textwrap
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from persia_tpu.config import load_embedding_config  # noqa: E402
+from persia_tpu.embedding.optim import Adagrad  # noqa: E402
+from persia_tpu.embedding.worker import EmbeddingWorker  # noqa: E402
+from persia_tpu.helper import ServiceCtx  # noqa: E402
+
+N_SIGNS = int(os.environ.get("FANOUT_SIGNS", "16384"))
+DIM = 16
+ROUNDS = int(os.environ.get("FANOUT_ROUNDS", "20"))
+
+
+def main():
+    with tempfile.TemporaryDirectory() as td:
+        cfg_path = os.path.join(td, "embedding_config.yml")
+        with open(cfg_path, "w") as f:
+            f.write(textwrap.dedent(
+                """
+                feature_index_prefix_bit: 8
+                slots_config:
+                  cat_0: {dim: 16}
+                """
+            ))
+        cfg = load_embedding_config(cfg_path)
+        rng = np.random.default_rng(0)
+        signs = rng.choice(1 << 30, N_SIGNS, replace=False).astype(np.uint64)
+        grads = rng.normal(size=(N_SIGNS, DIM)).astype(np.float32)
+
+        for n in (1, 2, 4):
+            with ServiceCtx(
+                num_parameter_servers=n, num_embedding_workers=0,
+                embedding_config_path=cfg_path, backend="auto", seed=3,
+            ) as svc:
+                ps = svc.ps_clients()
+                for c in ps:
+                    c.wait_ready()
+                worker = EmbeddingWorker(cfg, ps)
+                worker.register_optimizer(Adagrad(lr=0.05).config)
+                router = worker.lookup_router
+                router.checkout_entries(signs, DIM)  # admit + warm
+
+                t0 = time.perf_counter()
+                for _ in range(ROUNDS):
+                    router.checkout_entries(signs, DIM)
+                t_checkout = (time.perf_counter() - t0) / ROUNDS * 1e3
+
+                t0 = time.perf_counter()
+                for _ in range(ROUNDS):
+                    router.probe_entries(signs, DIM)
+                t_probe = (time.perf_counter() - t0) / ROUNDS * 1e3
+
+                t0 = time.perf_counter()
+                for _ in range(ROUNDS):
+                    router.update(signs, grads, 0)
+                t_update = (time.perf_counter() - t0) / ROUNDS * 1e3
+
+                print(json.dumps({
+                    "replicas": n,
+                    "signs": N_SIGNS,
+                    "checkout_ms": round(t_checkout, 2),
+                    "probe_ms": round(t_probe, 2),
+                    "update_ms": round(t_update, 2),
+                }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

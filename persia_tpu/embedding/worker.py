@@ -173,6 +173,19 @@ class ShardedLookup:
         # worker rebuilds its PS client pool on RpcError,
         # embedding_worker_service/mod.rs:1320-1333)
         self.recover = recover
+        # eager pool (lazy init would race: EmbeddingWorker's slot threads
+        # call the router concurrently): sized for replicas x concurrent
+        # slot callers — the transport below is the pooled RpcClient
+        # (8 in-flight per replica), so the executor must not be the funnel
+        if len(self.replicas) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._fan_pool = ThreadPoolExecutor(
+                max_workers=min(32, 8 * len(self.replicas)),
+                thread_name_prefix="ps-fanout",
+            )
+        else:
+            self._fan_pool = None
 
     def _with_recovery(self, replica, fn):
         try:
@@ -183,12 +196,24 @@ class ShardedLookup:
                 return fn()
             raise
 
+    def _concurrent(self, thunks):
+        """Run per-replica thunks CONCURRENTLY and return their results in
+        order. Against N remote replicas a serial fan-out costs N RTTs per
+        call — the reference issues all PS futures at once
+        (embedding_worker_service/mod.rs:886-907); this is that fan-out.
+        Single-thunk calls stay inline (no pool, no overhead)."""
+        if len(thunks) <= 1 or self._fan_pool is None:
+            return [t() for t in thunks]
+        return [f.result() for f in [self._fan_pool.submit(t) for t in thunks]]
+
     def lookup(self, keys: np.ndarray, dim: int, train: bool) -> np.ndarray:
         n = len(self.replicas)
         if n == 1:
-            return self.replicas[0].lookup(keys, dim, train)
+            r0 = self.replicas[0]
+            return self._with_recovery(r0, lambda: r0.lookup(keys, dim, train))
         part = native_worker.shard_partition(keys, n)
         out = np.zeros((len(keys), dim), dtype=np.float32)
+        sel = []  # (positions, thunk) per touched replica, issued at once
         if part is not None:
             pos, counts = part
             start = 0
@@ -196,14 +221,20 @@ class ShardedLookup:
                 c = int(counts[r])
                 if c:
                     p = pos[start:start + c]
-                    out[p] = self.replicas[r].lookup(keys[p], dim, train)
+                    rep = self.replicas[r]
+                    sel.append((p, lambda rep=rep, p=p: self._with_recovery(
+                        rep, lambda: rep.lookup(keys[p], dim, train))))
                 start += c
-            return out
-        shard = sign_to_shard(keys, n)
-        for r in range(n):
-            mask = shard == r
-            if mask.any():
-                out[mask] = self.replicas[r].lookup(keys[mask], dim, train)
+        else:
+            shard = sign_to_shard(keys, n)
+            for r in range(n):
+                mask = shard == r
+                if mask.any():
+                    rep = self.replicas[r]
+                    sel.append((mask, lambda rep=rep, m=mask: self._with_recovery(
+                        rep, lambda: rep.lookup(keys[m], dim, train))))
+        for (idx, _), vals in zip(sel, self._concurrent([t for _, t in sel])):
+            out[idx] = vals
         return out
 
     def checkout_entries(self, signs: np.ndarray, dim: int) -> np.ndarray:
@@ -217,6 +248,7 @@ class ShardedLookup:
                 r0, lambda: r0.checkout_entries(signs, dim)
             )
         out: Optional[np.ndarray] = None
+        sel = []
         part = native_worker.shard_partition(signs, n)
         if part is not None:
             pos, counts = part
@@ -226,12 +258,8 @@ class ShardedLookup:
                 if c:
                     p = pos[start:start + c]
                     rep = self.replicas[r]
-                    vals = self._with_recovery(
-                        rep, lambda rep=rep, p=p: rep.checkout_entries(signs[p], dim)
-                    )
-                    if out is None:
-                        out = np.empty((len(signs), vals.shape[1]), np.float32)
-                    out[p] = vals
+                    sel.append((p, lambda rep=rep, p=p: self._with_recovery(
+                        rep, lambda: rep.checkout_entries(signs[p], dim))))
                 start += c
         else:
             shard = sign_to_shard(signs, n)
@@ -239,13 +267,12 @@ class ShardedLookup:
                 mask = shard == r
                 if mask.any():
                     rep = self.replicas[r]
-                    vals = self._with_recovery(
-                        rep,
-                        lambda rep=rep, mask=mask: rep.checkout_entries(signs[mask], dim),
-                    )
-                    if out is None:
-                        out = np.empty((len(signs), vals.shape[1]), np.float32)
-                    out[mask] = vals
+                    sel.append((mask, lambda rep=rep, m=mask: self._with_recovery(
+                        rep, lambda: rep.checkout_entries(signs[m], dim))))
+        for (idx, _), vals in zip(sel, self._concurrent([t for _, t in sel])):
+            if out is None:
+                out = np.empty((len(signs), vals.shape[1]), np.float32)
+            out[idx] = vals
         if out is None:  # empty request
             out = np.empty((0, dim), np.float32)
         return out
@@ -288,6 +315,7 @@ class ShardedLookup:
         if vals_out is not None:
             vals = vals_out
             vals[:len(signs)] = 0.0
+        sel = []
         part = native_worker.shard_partition(signs, n)
         if part is not None:
             pos, counts = part
@@ -297,13 +325,8 @@ class ShardedLookup:
                 if c:
                     p = pos[start:start + c]
                     rep = self.replicas[r]
-                    w, v = self._with_recovery(
-                        rep, lambda rep=rep, p=p: rep.probe_entries(signs[p], dim)
-                    )
-                    if vals is None:
-                        vals = np.zeros((len(signs), v.shape[1]), np.float32)
-                    warm[p] = w
-                    vals[p] = v
+                    sel.append((p, lambda rep=rep, p=p: self._with_recovery(
+                        rep, lambda: rep.probe_entries(signs[p], dim))))
                 start += c
         else:
             shard = sign_to_shard(signs, n)
@@ -311,14 +334,13 @@ class ShardedLookup:
                 mask = shard == r
                 if mask.any():
                     rep = self.replicas[r]
-                    w, v = self._with_recovery(
-                        rep,
-                        lambda rep=rep, mask=mask: rep.probe_entries(signs[mask], dim),
-                    )
-                    if vals is None:
-                        vals = np.zeros((len(signs), v.shape[1]), np.float32)
-                    warm[mask] = w
-                    vals[mask] = v
+                    sel.append((mask, lambda rep=rep, m=mask: self._with_recovery(
+                        rep, lambda: rep.probe_entries(signs[m], dim))))
+        for (idx, _), (w, v) in zip(sel, self._concurrent([t for _, t in sel])):
+            if vals is None:
+                vals = np.zeros((len(signs), v.shape[1]), np.float32)
+            warm[idx] = w
+            vals[idx] = v
         if vals is None:
             vals = (
                 vals_out if vals_out is not None
@@ -342,6 +364,7 @@ class ShardedLookup:
                 signs, values, dim, commit_incremental=commit_incremental
             )
             return
+        thunks = []
         part = native_worker.shard_partition(signs, n)
         if part is not None:
             pos, counts = part
@@ -350,24 +373,28 @@ class ShardedLookup:
                 c = int(counts[r])
                 if c:
                     p = pos[start:start + c]
-                    self.replicas[r].set_embedding(
+                    rep = self.replicas[r]
+                    thunks.append(lambda rep=rep, p=p: rep.set_embedding(
                         signs[p], values[p], dim,
                         commit_incremental=commit_incremental,
-                    )
+                    ))
                 start += c
-            return
-        shard = sign_to_shard(signs, n)
-        for r in range(n):
-            mask = shard == r
-            if mask.any():
-                self.replicas[r].set_embedding(
-                    signs[mask], values[mask], dim,
-                    commit_incremental=commit_incremental,
-                )
+        else:
+            shard = sign_to_shard(signs, n)
+            for r in range(n):
+                mask = shard == r
+                if mask.any():
+                    rep = self.replicas[r]
+                    thunks.append(lambda rep=rep, m=mask: rep.set_embedding(
+                        signs[m], values[m], dim,
+                        commit_incremental=commit_incremental,
+                    ))
+        self._concurrent(thunks)
 
     def advance_batch_state(self, group: int) -> None:
-        for r in self.replicas:
-            r.advance_batch_state(group)
+        self._concurrent([
+            (lambda rep=r: rep.advance_batch_state(group)) for r in self.replicas
+        ])
 
     def update(self, keys: np.ndarray, grads: np.ndarray, group: int) -> None:
         """Fan one slot's keyed gradients out to the owning replicas. The
@@ -378,6 +405,7 @@ class ShardedLookup:
             r0 = self.replicas[0]
             self._with_recovery(r0, lambda: r0.update_gradients(keys, grads, group))
             return
+        thunks = []
         part = native_worker.shard_partition(keys, n)
         if part is not None:
             pos, counts = part
@@ -387,19 +415,18 @@ class ShardedLookup:
                 if c:
                     p = pos[start:start + c]
                     rep = self.replicas[r]
-                    self._with_recovery(
-                        rep, lambda: rep.update_gradients(keys[p], grads[p], group)
-                    )
+                    thunks.append(lambda rep=rep, p=p: self._with_recovery(
+                        rep, lambda: rep.update_gradients(keys[p], grads[p], group)))
                 start += c
-            return
-        shard = sign_to_shard(keys, n)
-        for r in range(n):
-            mask = shard == r
-            if mask.any():
-                rep = self.replicas[r]
-                self._with_recovery(
-                    rep, lambda: rep.update_gradients(keys[mask], grads[mask], group)
-                )
+        else:
+            shard = sign_to_shard(keys, n)
+            for r in range(n):
+                mask = shard == r
+                if mask.any():
+                    rep = self.replicas[r]
+                    thunks.append(lambda rep=rep, m=mask: self._with_recovery(
+                        rep, lambda: rep.update_gradients(keys[m], grads[m], group)))
+        self._concurrent(thunks)
 
 
 def _distinct_rows(

@@ -279,3 +279,56 @@ def test_sharded_probe_entries_fills_out_buffers():
     np.testing.assert_array_equal(warm_out.astype(bool), warm_ref)
     np.testing.assert_allclose(vals_out[warm_ref], vals_ref[warm_ref])
     assert np.isfinite(vals_out[warm_ref]).all()
+
+
+def test_sharded_fanout_is_concurrent():
+    """Per-replica RPCs must be in flight SIMULTANEOUSLY: with 4 fake
+    replicas that each sleep 50ms per call, a concurrent fan-out finishes a
+    routed checkout in ~1 sleep, a serial one needs ~4 (the reference
+    issues all PS futures at once, mod.rs:886-907)."""
+    import time
+
+    import numpy as np
+
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.embedding.store import EmbeddingStore
+    from persia_tpu.embedding.worker import ShardedLookup
+
+    class SlowStore(EmbeddingStore):
+        DELAY = 0.05
+
+        def checkout_entries(self, signs, dim):
+            time.sleep(self.DELAY)
+            return super().checkout_entries(signs, dim)
+
+        def probe_entries(self, signs, dim):
+            time.sleep(self.DELAY)
+            return super().probe_entries(signs, dim)
+
+        def update_gradients(self, signs, grads, group=0):
+            time.sleep(self.DELAY)
+            return super().update_gradients(signs, grads, group)
+
+    replicas = [
+        SlowStore(capacity=1 << 12, num_internal_shards=2,
+                  optimizer=Adagrad(lr=0.1).config, seed=1)
+        for _ in range(4)
+    ]
+    router = ShardedLookup(replicas)
+    rng = np.random.default_rng(0)
+    signs = rng.choice(1 << 20, 512, replace=False).astype(np.uint64)
+
+    t0 = time.perf_counter()
+    out = router.checkout_entries(signs, 8)
+    dt = time.perf_counter() - t0
+    assert out.shape == (512, 16)
+    assert dt < 3 * SlowStore.DELAY  # 4 serial sleeps would be >= 0.2s
+
+    t0 = time.perf_counter()
+    router.update(signs, np.zeros((512, 8), np.float32), 0)
+    assert time.perf_counter() - t0 < 3 * SlowStore.DELAY
+
+    t0 = time.perf_counter()
+    warm, vals = router.probe_entries(signs, 8)
+    assert time.perf_counter() - t0 < 3 * SlowStore.DELAY
+    assert warm.all()  # checkout admitted everything
