@@ -327,6 +327,9 @@ class CachedTrainState:
     emb_state: Dict[str, Dict[str, jnp.ndarray]]  # group → optimizer state (C+1, ·)
     emb_batch_state: jnp.ndarray
     step: jnp.ndarray
+    # dynamic mixed-precision loss scaling (None = static); same state the
+    # hybrid TrainCtx carries (parallel/train_step.py LossScaleState)
+    loss_scale: Optional[object] = None
 
 
 @dataclass(frozen=True)
@@ -588,6 +591,11 @@ def build_cached_train_step(
     loss_fn=None,
     donate: bool = True,
     ps_grad_dtype=jnp.float32,
+    dynamic_loss_scale: bool = False,
+    growth_interval: int = 2000,
+    growth_factor: float = 2.0,
+    backoff_factor: float = 0.5,
+    max_scale: float = float(2 ** 24),
 ):
     """Jitted ``step(state, batch, layout) -> (state, header)``.
 
@@ -608,6 +616,19 @@ def build_cached_train_step(
     ``(state, header, ps_gpacked)``: header = [loss, preds...]; ps_gpacked
     = flat f32 gradients of the ps_emb entries (empty when none) for the
     worker's gradient return.
+
+    ``dynamic_loss_scale`` (same management as the hybrid path's
+    build_train_step; ref GradScaler, persia/ctx.py:926-1005): the loss is
+    scaled before backward, an on-device finite check over EVERY gradient
+    (dense + cached + ps) gates the update — overflow skips the dense
+    update AND the cached-row sparse update (scale *= backoff), a finite
+    streak grows the scale. Header becomes [loss | scale | finite | preds],
+    and ps_gpacked carries [grads... | scale | finite] so the write-back
+    thread can unscale/skip without any extra device fetch. One documented
+    divergence from the reference: the Adam beta powers (device AND PS)
+    advance on overflow-skipped steps too — keeping the two tiers' powers
+    in lockstep without a per-step device sync; the skipped step itself
+    applies no gradient anywhere.
     """
     from functools import partial
 
@@ -638,6 +659,12 @@ def build_cached_train_step(
 
         ps_diff, ps_static = _split_emb(batch.get("ps_emb", []))
 
+        scale = (
+            state.loss_scale.scale
+            if dynamic_loss_scale
+            else jnp.asarray(1.0, jnp.float32)
+        )
+
         def loss_wrapper(params, stacked_in, raw_in, ps_in):
             model_emb = _model_emb_from_gathered(
                 groups, batch, layout, stacked_in, raw_in,
@@ -656,13 +683,31 @@ def build_cached_train_step(
                 logits = model.apply(variables, batch["dense"], model_emb, train=True)
                 new_stats = state.batch_stats
             loss = loss_fn(logits, batch["labels"][0])
-            return loss, (logits, new_stats)
+            return loss * scale.astype(loss.dtype), (loss, logits, new_stats)
 
-        (loss, (logits, new_stats)), (param_grads, stacked_g, raw_g, ps_g) = (
+        (_, (loss, logits, new_stats)), (param_grads, stacked_g, raw_g, ps_g) = (
             jax.value_and_grad(
                 loss_wrapper, argnums=(0, 1, 2, 3), has_aux=True
             )(state.params, stacked_gathered, raw_gathered, ps_diff)
         )
+
+        if dynamic_loss_scale:
+            leaves = (
+                jax.tree.leaves(param_grads)
+                + jax.tree.leaves(stacked_g) + jax.tree.leaves(raw_g)
+                + jax.tree.leaves(ps_g)
+            )
+            finite = jnp.all(
+                jnp.stack([jnp.all(jnp.isfinite(g)) for g in leaves])
+            )
+            inv = jnp.where(finite, 1.0 / scale, 0.0).astype(jnp.float32)
+            param_grads = jax.tree.map(
+                lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype),
+                param_grads,
+            )
+        else:
+            finite = jnp.asarray(True)
+            inv = jnp.asarray(1.0, jnp.float32)
 
         import optax as _optax
 
@@ -670,6 +715,16 @@ def build_cached_train_step(
             param_grads, state.opt_state, state.params
         )
         new_params = _optax.apply_updates(state.params, updates)
+        if dynamic_loss_scale:
+            # overflow: dense update skipped entirely
+            new_params = jax.tree.map(
+                lambda new, old: jnp.where(finite, new, old),
+                new_params, state.params,
+            )
+            new_opt_state = jax.tree.map(
+                lambda new, old: jnp.where(finite, new, old),
+                new_opt_state, state.opt_state,
+            )
 
         # on-device sparse update of the cached rows — ONE duplicate-safe
         # scatter per group (dedup inside sparse_update merges the same row
@@ -682,15 +737,22 @@ def build_cached_train_step(
             if g.name in batch["stacked_rows"]:
                 rows = batch["stacked_rows"][g.name]
                 idp.append(rows.reshape(-1))
-                gp.append(stacked_g[g.name].astype(jnp.float32).reshape(-1, g.dim))
-                mp.append((rows < g.rows).reshape(-1))
+                # unscale under dynamic loss scaling; on overflow every row
+                # is MASKED OUT below (sparse_update touches no row at all —
+                # exact skip for every optimizer incl. weight decay and
+                # Adam's state decay, at O(touched rows)); the grads are
+                # also selected to zero so inf*0 NaNs never enter the math
+                sg = stacked_g[g.name].astype(jnp.float32).reshape(-1, g.dim)
+                gp.append(jnp.where(finite, sg * inv, 0.0))
+                mp.append(((rows < g.rows) & finite).reshape(-1))
             for name in g.raw_slots:
                 if name not in batch["raw_rows"]:
                     continue
                 rows = batch["raw_rows"][name]
                 idp.append(rows.reshape(-1))
-                gp.append(raw_g[name].astype(jnp.float32).reshape(-1, g.dim))
-                mp.append((rows < g.rows).reshape(-1))
+                rg = raw_g[name].astype(jnp.float32).reshape(-1, g.dim)
+                gp.append(jnp.where(finite, rg * inv, 0.0))
+                mp.append(((rows < g.rows) & finite).reshape(-1))
             if not idp:
                 continue
             tables[g.name], emb_state[g.name] = sparse_update(
@@ -703,6 +765,21 @@ def build_cached_train_step(
                 mask=jnp.concatenate(mp) if len(mp) > 1 else mp[0],
             )
 
+        new_ls = state.loss_scale
+        if dynamic_loss_scale:
+            from persia_tpu.parallel.train_step import LossScaleState
+
+            good = jnp.where(finite, state.loss_scale.good_steps + 1, 0)
+            grown = good >= growth_interval
+            new_scale = jnp.where(
+                finite,
+                jnp.where(grown, scale * growth_factor, scale),
+                scale * backoff_factor,
+            )
+            new_scale = jnp.clip(new_scale, 1.0, max_scale)
+            new_ls = LossScaleState(
+                scale=new_scale, good_steps=jnp.where(grown, 0, good)
+            )
         new_state = CachedTrainState(
             params=new_params,
             batch_stats=new_stats,
@@ -711,16 +788,25 @@ def build_cached_train_step(
             emb_state=emb_state,
             emb_batch_state=batch_state,
             step=state.step + 1,
+            loss_scale=new_ls,
         )
-        header = jnp.concatenate(
-            [jnp.reshape(loss, (1,)).astype(jnp.float32),
-             jnp.reshape(jax.nn.sigmoid(logits), (-1,)).astype(jnp.float32)]
-        )
+        head = [jnp.reshape(loss, (1,)).astype(jnp.float32)]
+        if dynamic_loss_scale:
+            head.append(jnp.reshape(scale, (1,)).astype(jnp.float32))
+            head.append(jnp.reshape(finite, (1,)).astype(jnp.float32))
+        head.append(jnp.reshape(jax.nn.sigmoid(logits), (-1,)).astype(jnp.float32))
+        header = jnp.concatenate(head)
         # ps-tier gradients are an inherent d2h; a bf16 wire halves the
         # bytes on the return path (the reference ships scaled-f16 grad
         # wires, lib.rs:157-180) — the host casts back to f32 before the
-        # worker's unscale/update
+        # worker's unscale/update. Under dynamic scaling the buffer's last
+        # two entries are [scale | finite] (both exact in bf16: scale is a
+        # power of two), so the write-back thread needs no extra fetch.
         ps_flat = [jnp.reshape(g, (-1,)).astype(ps_grad_dtype) for g in ps_g]
+        if dynamic_loss_scale and ps_flat:
+            ps_flat.append(
+                jnp.stack([scale, finite.astype(jnp.float32)]).astype(ps_grad_dtype)
+            )
         ps_gpacked = (
             jnp.concatenate(ps_flat) if ps_flat
             else jnp.zeros((0,), ps_grad_dtype)
@@ -1430,6 +1516,10 @@ class CachedTrainCtx:
         admit_touches: int = 1,
         aux_wire_dtype: str = "float32",
         ps_wire_dtype: str = "float32",
+        dynamic_loss_scale: bool = False,
+        loss_scale_init: float = float(2 ** 15),
+        loss_scale_growth_interval: int = 2000,
+        loss_scale_max: float = float(2 ** 24),
     ):
         self.model = model
         self.dense_optimizer = dense_optimizer
@@ -1463,12 +1553,17 @@ class CachedTrainCtx:
             raise ValueError(
                 f"ps_wire_dtype must be float32/bfloat16, got {ps_wire_dtype!r}"
             )
+        self.dynamic_loss_scale = dynamic_loss_scale
+        self._loss_scale_init = loss_scale_init
         self._step = build_cached_train_step(
             model, dense_optimizer, self.sparse_cfg, self.tier.groups,
             loss_fn=loss_fn,
             ps_grad_dtype=(
                 jnp.bfloat16 if ps_wire_dtype == "bfloat16" else jnp.float32
             ),
+            dynamic_loss_scale=dynamic_loss_scale,
+            growth_interval=loss_scale_growth_interval,
+            max_scale=loss_scale_max,
         )
         self._eval = build_cached_eval_step(model, self.tier.groups)
         self.table_dtype = table_dtype
@@ -1538,6 +1633,14 @@ class CachedTrainCtx:
             rng, sample_inputs["dense"], model_emb, train=False
         )
         params = variables["params"]
+        ls = None
+        if self.dynamic_loss_scale:
+            from persia_tpu.parallel.train_step import LossScaleState
+
+            ls = LossScaleState(
+                scale=jnp.asarray(self._loss_scale_init, jnp.float32),
+                good_steps=jnp.zeros((), jnp.int32),
+            )
         self.state = CachedTrainState(
             params=params,
             batch_stats=variables.get("batch_stats", {}),
@@ -1546,6 +1649,7 @@ class CachedTrainCtx:
             emb_state=emb_state,
             emb_batch_state=jnp.ones((2,), dtype=jnp.float32),
             step=jnp.zeros((), dtype=jnp.int32),
+            loss_scale=ls,
         )
         rep = self._replicated()
         if rep is not None:
@@ -1713,12 +1817,22 @@ class CachedTrainCtx:
             gp = np.asarray(ps_gpacked)
             if gp.dtype != np.float32:  # bf16 ps-grad wire
                 gp = gp.astype(np.float32)
+            scale_factor = 1.0
+            if self.dynamic_loss_scale:
+                # buffer tail = [scale | finite] (see build_cached_train_step)
+                scale_factor = float(gp[-2])
+                if not gp[-1] > 0.5:  # overflow: skip-step — drop the grads
+                    self.worker.abort_gradient(ref)
+                    return
+                gp = gp[:-2]
             grads = unpack_step_grads(gp, {"emb": entries})
             slot_grads = {
                 eb.name: (g if d is None else g[:d])
                 for eb, g, d in zip(embs, grads, counts)
             }
-            self.worker.update_gradient_batched(ref, slot_grads)
+            self.worker.update_gradient_batched(
+                ref, slot_grads, scale_factor=scale_factor
+            )
         except BaseException:
             self.worker.abort_gradient(ref)
             raise
@@ -1804,15 +1918,32 @@ class CachedTrainCtx:
             self._pending = None
             self._pending_signs = set()
 
+    def _parse_header(self, h: np.ndarray, label_shape) -> Dict:
+        """Host view of the step header — the layout is owned by ONE pair
+        of decoders (parallel/train_step.py unpack_step_header[_dynamic]);
+        this adapter only supplies the label shape."""
+        from types import SimpleNamespace
+
+        from persia_tpu.parallel.train_step import (
+            unpack_step_header,
+            unpack_step_header_dynamic,
+        )
+
+        shaped = {"labels": [SimpleNamespace(shape=label_shape)]}
+        if self.dynamic_loss_scale:
+            loss, preds, scale, finite = unpack_step_header_dynamic(h, shaped)
+            return {
+                "loss": loss, "preds": preds,
+                "loss_scale": scale, "grads_finite": finite,
+            }
+        loss, preds = unpack_step_header(h, shaped)
+        return {"loss": loss, "preds": preds}
+
     def _fetch_metrics(self) -> Dict:
         if self._pending is None:
             return self._last_metrics or {}
         _meta, _payload, header, label_shape = self._pending
-        header = np.asarray(header)
-        self._last_metrics = {
-            "loss": float(header[0]),
-            "preds": header[1:].reshape(label_shape),
-        }
+        self._last_metrics = self._parse_header(np.asarray(header), label_shape)
         self._last_header_dev = None  # fresher than any stashed stream header
         return self._last_metrics
 
@@ -2217,11 +2348,9 @@ class CachedTrainCtx:
                     for grp in self._cached_groups:
                         self.tier.router.advance_batch_state(grp)
                 if on_metrics is not None:
-                    h = np.asarray(header)
-                    self._last_metrics = {
-                        "loss": float(h[0]),
-                        "preds": h[1:].reshape(label_shape),
-                    }
+                    self._last_metrics = self._parse_header(
+                        np.asarray(header), label_shape
+                    )
                     on_metrics(self._last_metrics)
         finally:
             stop.set()
@@ -2256,11 +2385,9 @@ class CachedTrainCtx:
         if header is not None:
             if on_metrics is not None or fetch_final:
                 if on_metrics is None:
-                    h = np.asarray(header)
-                    self._last_metrics = {
-                        "loss": float(h[0]),
-                        "preds": h[1:].reshape(label_shape),
-                    }
+                    self._last_metrics = self._parse_header(
+                        np.asarray(header), label_shape
+                    )
                 self._last_header_dev = None  # this stream is the freshest
             else:
                 jax.block_until_ready(header)  # completion, no transfer
@@ -2273,11 +2400,9 @@ class CachedTrainCtx:
             return self._fetch_metrics()
         if self._last_header_dev is not None:
             header, label_shape = self._last_header_dev
-            h = np.asarray(header)
-            self._last_metrics = {
-                "loss": float(h[0]),
-                "preds": h[1:].reshape(label_shape),
-            }
+            self._last_metrics = self._parse_header(
+                np.asarray(header), label_shape
+            )
             self._last_header_dev = None
         return self._last_metrics
 

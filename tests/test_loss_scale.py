@@ -141,3 +141,197 @@ def test_recovers_and_trains_after_overflow_window():
     assert not finites[0], "first step must overflow"
     assert finites[-1], "scale never recovered into range"
     assert np.isfinite(losses[-1])
+
+
+# ------------------------------------------------- cached-tier counterpart
+
+
+def _make_cached_ctx(opt=None, **kw):
+    from persia_tpu.embedding import hbm_cache as hbm
+    from persia_tpu.embedding.optim import Adam
+
+    opt = opt or Adagrad(lr=0.1)
+    cfg = EmbeddingConfig(
+        slots_config={"cat": SlotConfig(dim=8)}, feature_index_prefix_bit=4
+    )
+    store = EmbeddingStore(
+        capacity=1 << 12, num_internal_shards=2, optimizer=opt.config, seed=3
+    )
+    worker = EmbeddingWorker(cfg, [store])
+    ctx = hbm.CachedTrainCtx(
+        model=DNN(dense_mlp_size=8, sparse_mlp_size=16, hidden_sizes=(16,)),
+        dense_optimizer=optax.sgd(1e-2),
+        embedding_optimizer=opt,
+        worker=worker,
+        embedding_config=cfg,
+        cache_rows=64,
+        **kw,
+    ).__enter__()
+    return ctx, store
+
+
+def test_cached_overflow_skips_dense_and_table_updates():
+    """Cached tier: an overflowing step must leave dense params AND the
+    HBM-resident embedding tables + optimizer state bit-identical
+    (skip-step), report grads_finite=False, and back the scale off."""
+    from persia_tpu.embedding.optim import Adam
+
+    ctx, _ = _make_cached_ctx(
+        opt=Adam(lr=1e-3),  # the state-decay case: needs the where-select
+        dynamic_loss_scale=True, loss_scale_init=_HUGE, loss_scale_max=_HUGE,
+    )
+    m0 = ctx.train_step(_batch(0, scale=100.0))
+    assert m0["grads_finite"] is False and m0["loss_scale"] == _HUGE
+    p_before = [np.asarray(x).copy() for x in jax.tree.leaves(ctx.state.params)]
+    t_before = {k: np.asarray(v).copy() for k, v in ctx.state.tables.items()}
+    s_before = {
+        (g, k): np.asarray(v).copy()
+        for g, st in ctx.state.emb_state.items() for k, v in st.items()
+    }
+    # SAME batch again: every sign already resident, so no admission
+    # scatters — any table change would be a gradient leaking through
+    m1 = ctx.train_step(_batch(0, scale=100.0))  # still overflows at _HUGE/2
+    assert m1["grads_finite"] is False
+    assert m1["loss_scale"] == pytest.approx(_HUGE / 2, rel=1e-6)
+    for a, b_ in zip(p_before, [np.asarray(x) for x in jax.tree.leaves(ctx.state.params)]):
+        np.testing.assert_array_equal(a, b_)
+    for k, v in ctx.state.tables.items():
+        np.testing.assert_array_equal(t_before[k], np.asarray(v))
+    for (g, k), v in s_before.items():
+        np.testing.assert_array_equal(v, np.asarray(ctx.state.emb_state[g][k]))
+
+
+def test_cached_scale_grows_after_interval():
+    ctx, _ = _make_cached_ctx(
+        dynamic_loss_scale=True, loss_scale_init=8.0,
+        loss_scale_growth_interval=3,
+    )
+    scales = [ctx.train_step(_batch(i))["loss_scale"] for i in range(7)]
+    assert scales[:3] == [8.0, 8.0, 8.0]
+    assert scales[3] == 16.0
+    assert scales[6] == 32.0
+
+
+def test_cached_scaled_training_matches_unscaled():
+    """With a finite scale the trajectory must equal the unscaled run
+    (Adagrad zero-grad no-op + exact unscale): same losses, same flushed
+    PS entries."""
+    batches = [_batch(i) for i in range(6)]
+
+    def run(**kw):
+        ctx, store = _make_cached_ctx(**kw)
+        losses = [ctx.train_step(b)["loss"] for b in batches]
+        ctx.flush()
+        return losses, store
+
+    l0, s0 = run()
+    l1, s1 = run(dynamic_loss_scale=True, loss_scale_init=1024.0)
+    np.testing.assert_allclose(l0, l1, rtol=1e-5, atol=1e-7)
+    for sign in range(0, 50):
+        e0 = s0.get_embedding_entry(sign)
+        e1 = s1.get_embedding_entry(sign)
+        if e0 is None:
+            assert e1 is None
+        else:
+            np.testing.assert_allclose(e0, e1, rtol=1e-4, atol=1e-6)
+
+
+def test_cached_stream_dynamic_scale_recovers():
+    """train_stream with dynamic scaling: a huge init overflows, backs off
+    step by step, then training proceeds — metrics report the moving scale
+    and the run ends healthy."""
+    ctx, _ = _make_cached_ctx(
+        dynamic_loss_scale=True, loss_scale_init=_HUGE, loss_scale_max=_HUGE,
+    )
+    seen = []
+    ctx.train_stream(
+        [_batch(i, scale=1.0) for i in range(30)],
+        on_metrics=lambda m: seen.append((m["loss_scale"], m["grads_finite"])),
+    )
+    assert len(seen) == 30
+    assert not seen[0][1]  # first steps overflow at the huge scale
+    assert seen[-1][1]  # recovered: finite by the end
+    assert seen[-1][0] < seen[0][0]
+    assert np.isfinite(ctx.last_metrics()["loss"])
+
+
+def test_cached_ps_tier_grads_unscale_through_stream():
+    """Mixed tier + dynamic scaling: ps-slot gradients ride the step output
+    SCALED with a [scale|finite] tail; the write-back thread must unscale
+    via the worker's scale_factor — the flushed PS entries must match an
+    unscaled run."""
+    from persia_tpu.embedding import hbm_cache as hbm
+
+    cfg = EmbeddingConfig(
+        slots_config={"cat": SlotConfig(dim=8), "ps": SlotConfig(dim=8)},
+        feature_index_prefix_bit=4,
+    )
+
+    def run(dyn):
+        store = EmbeddingStore(
+            capacity=1 << 12, num_internal_shards=2,
+            optimizer=Adagrad(lr=0.1).config, seed=3,
+        )
+        worker = EmbeddingWorker(cfg, [store])
+        ctx = hbm.CachedTrainCtx(
+            model=DNN(dense_mlp_size=8, sparse_mlp_size=16, hidden_sizes=(16,)),
+            dense_optimizer=optax.sgd(1e-2),
+            embedding_optimizer=Adagrad(lr=0.1),
+            worker=worker,
+            embedding_config=cfg,
+            cache_rows=64,
+            ps_slots=["ps"],
+            dynamic_loss_scale=dyn,
+            loss_scale_init=256.0,
+        ).__enter__()
+        rng = np.random.default_rng(5)
+        losses = []
+
+        def batch(i):
+            r = np.random.default_rng(100 + i)
+            return PersiaBatch(
+                [
+                    IDTypeFeature("cat", list(r.integers(0, 50, (16, 1), dtype=np.uint64))),
+                    IDTypeFeature("ps", list(r.integers(0, 50, (16, 1), dtype=np.uint64))),
+                ],
+                non_id_type_features=[
+                    NonIDTypeFeature(r.normal(size=(16, 4)).astype(np.float32))
+                ],
+                labels=[Label(r.integers(0, 2, (16, 1)).astype(np.float32))],
+                requires_grad=True,
+            )
+
+        ctx.train_stream([batch(i) for i in range(5)],
+                         on_metrics=lambda m: losses.append(m["loss"]))
+        assert worker.staleness == 0
+        ctx.flush()
+        return losses, store
+
+    l0, s0 = run(False)
+    l1, s1 = run(True)
+    np.testing.assert_allclose(l0, l1, rtol=1e-5, atol=1e-7)
+    for sign in range(50):
+        for pref in (0, 1):
+            e0 = s0.get_embedding_entry((pref << 60) | sign)
+            e1 = s1.get_embedding_entry((pref << 60) | sign)
+            if e0 is None:
+                assert e1 is None
+            else:
+                np.testing.assert_allclose(e0, e1, rtol=1e-4, atol=1e-6)
+
+
+def test_cached_overflow_noop_with_weight_decay():
+    """Weight decay makes a zero-grad update NOT a no-op — the overflow
+    skip must therefore mask the rows out entirely: touched rows stay
+    bit-identical even with weight_decay > 0 (regression: the zero-grad
+    trick alone let wd*w leak through on skipped steps)."""
+    ctx, _ = _make_cached_ctx(
+        opt=Adagrad(lr=0.1, weight_decay=0.01),
+        dynamic_loss_scale=True, loss_scale_init=_HUGE, loss_scale_max=_HUGE,
+    )
+    ctx.train_step(_batch(0, scale=100.0))  # admit + overflow
+    t_before = {k: np.asarray(v).copy() for k, v in ctx.state.tables.items()}
+    m = ctx.train_step(_batch(0, scale=100.0))  # same signs: no admissions
+    assert m["grads_finite"] is False
+    for k, v in ctx.state.tables.items():
+        np.testing.assert_array_equal(t_before[k], np.asarray(v))
