@@ -180,7 +180,9 @@ def _cached_tier_ctx(ps_all: bool = False):
         )
     else:
         kw.update(
-            cache_rows=1 << 21,  # 2M rows in HBM vs 26M-sign PS vocabulary
+            # 2M rows in HBM vs 26M-sign PS vocabulary; shrink via env to
+            # reach the post-fill eviction steady state in fewer steps
+            cache_rows=int(os.environ.get("BENCH_CACHE_ROWS", str(1 << 21))),
             wb_wire_dtype="bfloat16",
             aux_wire_dtype=os.environ.get("BENCH_AUX_WIRE", "bfloat16"),
             admit_touches=int(os.environ.get("BENCH_ADMIT_TOUCHES", "2")),
