@@ -130,6 +130,27 @@ void wk_raw_index(const int64_t* counts, const int64_t* inverse, int64_t B,
 // the member positions (into `pos_out`, grouped by shard with stable input
 // order) and per-shard counts (`count_out`, size num_shards). Saves the
 // num_shards boolean-mask passes the numpy router does.
+// Single-id fast-path matrix build: out[s*B + b] = (ids[s][b] & mask) |
+// prefix[s] — replaces the per-slot numpy prefix-OR + row copy loop that
+// dominated the cached feeder's Python time (one call for all S slots).
+// prefix_bit == 0 (or a zero prefix) degenerates to a plain copy.
+void wk_build_sid_matrix(const uint64_t* const* ids, const uint64_t* prefixes,
+                         int64_t S, int64_t B, int32_t prefix_bit,
+                         uint64_t* out) {
+  const uint64_t mask =
+      prefix_bit > 0 ? ((~0ULL) >> prefix_bit) : ~0ULL;
+  for (int64_t s = 0; s < S; ++s) {
+    const uint64_t* src = ids[s];
+    uint64_t* dst = out + s * B;
+    const uint64_t p = prefixes[s];
+    if (p == 0 || prefix_bit == 0) {
+      std::memcpy(dst, src, sizeof(uint64_t) * B);
+    } else {
+      for (int64_t b = 0; b < B; ++b) dst[b] = (src[b] & mask) | p;
+    }
+  }
+}
+
 void wk_shard_partition(const uint64_t* signs, int64_t n, uint32_t num_shards,
                         int64_t* pos_out, int64_t* count_out) {
   std::vector<int64_t> shard(n);

@@ -1163,6 +1163,7 @@ class CachedEmbeddingTier:
         hash-stack, no sqrt scaling, and every feature carries exactly one
         id per sample. Returns [(group, slot_names, (S, B) prefixed sign
         matrix), ...] or None (→ general path)."""
+        from persia_tpu.embedding import native_worker
         from persia_tpu.embedding.hashing import add_index_prefix
 
         feats = {
@@ -1182,23 +1183,30 @@ class CachedEmbeddingTier:
             names = [n for n in g.pooled_slots if n in feats]
             if not names:
                 continue
-            mat = None
-            for i, name in enumerate(names):
+            flats = []
+            for name in names:
                 flat, counts = feats[name].flat_counts()
                 # exactly one id per sample — a total that merely EQUALS the
                 # batch size (counts like [2, 0, 1, ...]) would misalign ids
                 # to samples
                 if len(flat) != len(counts) or not (counts == 1).all():
                     return None
-                if mat is None:
-                    mat = self._ring.get(
-                        ("sid_mat", g.name), (len(names), len(counts)),
-                        np.uint64,
+                flats.append(np.ascontiguousarray(flat, dtype=np.uint64))
+            mat = self._ring.get(
+                ("sid_mat", g.name), (len(names), len(flats[0])), np.uint64
+            )
+            # ONE native call builds every prefixed row (the per-slot numpy
+            # prefix-OR + copy loop was a measurable share of the feeder)
+            prefixes = np.array(
+                [self._fast_prefix[n] for n in names], dtype=np.uint64
+            )
+            if not native_worker.build_sid_matrix(
+                flats, prefixes, prefix_bit, mat
+            ):
+                for i, (name, flat) in enumerate(zip(names, flats)):
+                    mat[i] = add_index_prefix(
+                        flat, self._fast_prefix[name], prefix_bit
                     )
-                mat[i] = add_index_prefix(
-                    flat.astype(np.uint64, copy=False),
-                    self._fast_prefix[name], prefix_bit,
-                )
             out.append((g, tuple(names), mat))
         return out
 

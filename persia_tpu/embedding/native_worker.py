@@ -66,6 +66,9 @@ def _load_lib() -> Optional[ctypes.CDLL]:
     lib.wk_grad_accum.argtypes = [_f32p, _i64p, _i64p, i64, i64, _f32p]
     lib.wk_raw_index.argtypes = [_i64p, _i64p, i64, i64, i32, _i32p]
     lib.wk_shard_partition.argtypes = [_u64p, i64, u32, _i64p, _i64p]
+    lib.wk_build_sid_matrix.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), _u64p, i64, i64, i32, _u64p,
+    ]
     _LIB = lib
     return _LIB
 
@@ -147,6 +150,32 @@ def raw_index(
         pad, _ptr(out, _i32p),
     )
     return out
+
+
+def build_sid_matrix(
+    id_arrays, prefixes: np.ndarray, prefix_bit: int, out: np.ndarray
+) -> bool:
+    """Fill ``out`` (S, B) with per-slot prefixed sign rows in ONE native
+    call (the cached tier's single-id fast path). ``id_arrays``: S
+    contiguous (B,) uint64 arrays; ``prefixes``: (S,) uint64. Returns False
+    when the native core is unavailable (caller falls back to numpy)."""
+    lib = _load_lib()
+    if lib is None:
+        return False
+    S, B = out.shape
+    # fail as loudly as the numpy fallback would: the native call trusts
+    # raw pointers and would read OOB / NULL on a malformed input
+    if len(id_arrays) != S:
+        raise ValueError(f"expected {S} id arrays, got {len(id_arrays)}")
+    for a in id_arrays:
+        if a.dtype != np.uint64 or a.size < B or not a.flags.c_contiguous:
+            raise ValueError("id arrays must be contiguous uint64 of >= B ids")
+    ptrs = (ctypes.c_void_p * S)(*[a.ctypes.data for a in id_arrays])
+    prefixes = np.ascontiguousarray(prefixes, dtype=np.uint64)
+    lib.wk_build_sid_matrix(
+        ptrs, _ptr(prefixes, _u64p), S, B, prefix_bit, _ptr(out, _u64p)
+    )
+    return True
 
 
 def shard_partition(

@@ -153,3 +153,24 @@ def test_worker_end_to_end_native_vs_numpy(monkeypatch):
             # gathered per-sample embeddings must be bit-identical
             np.testing.assert_array_equal(gathered(a), gathered(b))
             np.testing.assert_array_equal(a.sample_id_num, b.sample_id_num)
+
+
+def test_build_sid_matrix_matches_numpy():
+    """Native single-id matrix build == per-slot add_index_prefix rows
+    (incl. the prefix_bit=0 and zero-prefix memcpy fast paths)."""
+    from persia_tpu.embedding.hashing import add_index_prefix
+
+    rng = np.random.default_rng(0)
+    S, B = 5, 257
+    flats = [rng.integers(0, 1 << 40, B).astype(np.uint64) for _ in range(S)]
+    for prefix_bit in (0, 8):
+        prefixes = np.array(
+            [0 if s == 2 else (s + 1) << (64 - max(prefix_bit, 1)) for s in range(S)],
+            dtype=np.uint64,
+        ) if prefix_bit else np.zeros(S, dtype=np.uint64)
+        out = np.empty((S, B), dtype=np.uint64)
+        assert nw.build_sid_matrix(flats, prefixes, prefix_bit, out)
+        for s in range(S):
+            np.testing.assert_array_equal(
+                out[s], add_index_prefix(flats[s], int(prefixes[s]), prefix_bit)
+            )
