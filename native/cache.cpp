@@ -160,21 +160,25 @@ struct Cache {
     return r;
   }
 
-  // batch-local scratch for cache_admit_positions (reused across calls)
-  std::vector<uint64_t> scratch_sign;
-  std::vector<int64_t> scratch_val;  // -1 = empty
+  // batch-local scratch for cache_admit_positions (reused across calls):
+  // 16-byte bucket = sign + (epoch<<32 | int32 val), so a probe costs one
+  // cache-line fetch and there is NO per-call table clear (the clear cost
+  // a multi-MB memset every batch) — a bucket is live only when its u32
+  // epoch stamp matches the current call (wrap needs 2^32 calls)
+  struct ScratchSlot { uint64_t sign; uint64_t packed; };
+  std::vector<ScratchSlot> scratch;
   uint64_t scratch_mask = 0;
+  uint64_t scratch_epoch = 0;
 
   void scratch_reserve(int64_t n) {
     uint64_t want = 16;
     while (want < (uint64_t)n * 2) want <<= 1;
-    if (want > scratch_sign.size()) {
-      scratch_sign.assign(want, 0);
-      scratch_val.assign(want, -1);
+    if (want > scratch.size()) {
+      scratch.assign(want, ScratchSlot{0, 0});
       scratch_mask = want - 1;
-    } else {
-      std::fill(scratch_val.begin(), scratch_val.end(), (int64_t)-1);
+      scratch_epoch = 0;
     }
+    ++scratch_epoch;
   }
 };
 
@@ -266,9 +270,11 @@ int64_t cache_admit_positions(void* h, const uint64_t* signs, int64_t n,
   *n_evict_out = 0;
   c.scratch_reserve(n);
   // pass 1: dedup + touch residents; misses get ordinal placeholders.
-  // scratch_val holds: row (>=0, resident seen this batch — or the pad row
-  // c.capacity for a touch-gated bypass) or -(miss_ordinal + 2) for a
-  // pending miss.
+  // A scratch bucket's val holds: row (>=0, resident seen this batch — or
+  // the pad row c.capacity for a touch-gated bypass) or -(miss_ordinal+2)
+  // for a pending miss; a bucket is live only when its epoch stamp
+  // matches this call.
+  const uint64_t ep = c.scratch_epoch & 0xffffffffULL;
   int64_t n_unique = 0, n_miss = 0;
   const int64_t PF = 16;  // software prefetch distance: the scratch and
   // main tables span tens of MB, so every probe is a DRAM-latency random
@@ -277,15 +283,16 @@ int64_t cache_admit_positions(void* h, const uint64_t* signs, int64_t n,
   for (int64_t i = 0; i < n; ++i) {
     if (i + PF < n) {
       const uint64_t sp = signs[i + PF];
-      __builtin_prefetch(&c.scratch_val[c.scratch_mask & splitmix64(sp)]);
+      __builtin_prefetch(&c.scratch[c.scratch_mask & splitmix64(sp)]);
       __builtin_prefetch(&c.table[c.home(sp)]);
     }
     const uint64_t s = signs[i];
     uint64_t j = c.scratch_mask & splitmix64(s);
     int64_t v;
     for (;;) {
-      v = c.scratch_val[j];
-      if (v == -1 || c.scratch_sign[j] == s) break;
+      const Cache::ScratchSlot& sl = c.scratch[j];
+      if ((sl.packed >> 32) != ep) { v = -1; break; }  // empty this batch
+      if (sl.sign == s) { v = (int32_t)(uint32_t)sl.packed; break; }
       j = (j + 1) & c.scratch_mask;
     }
     if (v == -1) {  // first time this batch
@@ -302,8 +309,7 @@ int64_t cache_admit_positions(void* h, const uint64_t* signs, int64_t n,
         v = -(n_miss + 2);
         ++n_miss;
       }
-      c.scratch_sign[j] = s;
-      c.scratch_val[j] = v;
+      c.scratch[j] = Cache::ScratchSlot{s, (ep << 32) | (uint32_t)(int32_t)v};
     }
     rows_out[i] = (int32_t)v;  // miss placeholders fixed in pass 3
   }
