@@ -51,7 +51,8 @@ EMB_DIM = 16
 
 
 def build_ctx(vocabs, ps_replicas=2, capacity=1 << 20, hashstack_above=None,
-              tier="hybrid"):
+              tier="hybrid", admit_touches=1, wire="float32",
+              dynamic_loss_scale=False):
     slots = {}
     for i, v in enumerate(vocabs):
         hs = HashStackConfig()
@@ -82,6 +83,13 @@ def build_ctx(vocabs, ps_replicas=2, capacity=1 << 20, hashstack_above=None,
             worker=worker,
             embedding_config=cfg,
             cache_rows=1 << 18,  # working set in HBM; vocab stays on the PS
+            # touch-gated admission (reference admit_probability semantics):
+            # >1 keeps one-hit wonders out of the cache entirely
+            admit_touches=admit_touches,
+            # bf16 checkout/eviction wires halve host<->device bytes
+            aux_wire_dtype=wire,
+            wb_wire_dtype=wire,
+            dynamic_loss_scale=dynamic_loss_scale,
         )
     return TrainCtx(
         model=model,
@@ -89,6 +97,7 @@ def build_ctx(vocabs, ps_replicas=2, capacity=1 << 20, hashstack_above=None,
         embedding_optimizer=Adagrad(lr=0.05),
         worker=worker,
         embedding_config=cfg,
+        dynamic_loss_scale=dynamic_loss_scale,
     )
 
 
@@ -103,6 +112,20 @@ def main(argv=None) -> int:
         "--tier", choices=("hybrid", "cached"), default="hybrid",
         help="hybrid = host-PS lookups per step; cached = HBM write-back "
         "cache with on-device sparse updates (capacity tier)",
+    )
+    ap.add_argument(
+        "--admit-touches", type=int, default=1,
+        help="cached tier: admit a sign on its Nth distinct-batch touch "
+        "(1 = always; >1 gates one-hit wonders out, reference "
+        "admit_probability semantics)",
+    )
+    ap.add_argument(
+        "--wire", choices=("float32", "bfloat16"), default="float32",
+        help="cached tier: checkout/eviction wire dtype",
+    )
+    ap.add_argument(
+        "--dynamic-loss-scale", action="store_true",
+        help="AMP GradScaler-style overflow skip + scale backoff/growth",
     )
     ap.add_argument("--ckpt-dir", default=None)
     ap.add_argument(
@@ -121,7 +144,9 @@ def main(argv=None) -> int:
     )
 
     ctx = build_ctx(vocabs, ps_replicas=args.ps_replicas,
-                    hashstack_above=hashstack_above, tier=args.tier)
+                    hashstack_above=hashstack_above, tier=args.tier,
+                    admit_touches=args.admit_touches, wire=args.wire,
+                    dynamic_loss_scale=args.dynamic_loss_scale)
     with ctx:
         losses = []
         if args.tier == "cached":
