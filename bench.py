@@ -190,12 +190,10 @@ def _cached_tier_ctx(ps_all: bool = False):
     return CachedTrainCtx(**kw).__enter__()
 
 
-def bench_cached():
-    """The capacity tier with the HBM write-back cache: vocabulary lives on
-    the host C++ PS (beyond-HBM regime, reference README.md:29), the working
-    set lives in HBM, the sparse optimizer runs on device, and the previous
-    step's eviction write-back overlaps the current step
-    (persia_tpu/embedding/hbm_cache.py)."""
+def _zipf_batch_maker(seed: int = 0):
+    """Batch factory shared by the cached/hybrid/ps-stream modes (and the
+    stage profiler): single-id zipf streams with a stable per-slot hot set,
+    plus dense features and labels at the bench shape."""
     from persia_tpu.data import (
         IDTypeFeatureWithSingleID,
         Label,
@@ -203,10 +201,7 @@ def bench_cached():
         PersiaBatch,
     )
 
-    steps = int(os.environ.get("BENCH_CACHED_STEPS", "100"))
-    ctx = _cached_tier_ctx()
-
-    rng = np.random.default_rng(0)
+    rng = np.random.default_rng(seed)
     slot_offsets = rng.integers(0, VOCAB, N_SLOTS, dtype=np.uint64)
 
     def make_batch():
@@ -224,6 +219,20 @@ def bench_cached():
             labels=[Label(rng.integers(0, 2, (BATCH_SIZE, 1)).astype(np.float32))],
             requires_grad=True,
         )
+
+    return make_batch
+
+
+def bench_cached():
+    """The capacity tier with the HBM write-back cache: vocabulary lives on
+    the host C++ PS (beyond-HBM regime, reference README.md:29), the working
+    set lives in HBM, the sparse optimizer runs on device, and the previous
+    step's eviction write-back overlaps the current step
+    (persia_tpu/embedding/hbm_cache.py)."""
+    steps = int(os.environ.get("BENCH_CACHED_STEPS", "100"))
+    ctx = _cached_tier_ctx()
+
+    make_batch = _zipf_batch_maker()
 
     # distinct batches (not a short cycle): hit rate comes from the zipf
     # skew + warm cache, not from replaying identical batches
@@ -261,34 +270,10 @@ def bench_ps_stream():
     chip). On PCIe-attached hardware (the reference's assumption, ~10 GB/s)
     the same pipeline computes out to ~10M samples/sec of wire headroom.
     """
-    from persia_tpu.data import (
-        IDTypeFeatureWithSingleID,
-        Label,
-        NonIDTypeFeature,
-        PersiaBatch,
-    )
-
     steps = int(os.environ.get("BENCH_PS_STREAM_STEPS", "30"))
     ctx = _cached_tier_ctx(ps_all=True)
 
-    rng = np.random.default_rng(0)
-    slot_offsets = rng.integers(0, VOCAB, N_SLOTS, dtype=np.uint64)
-
-    def make_batch():
-        ids = [
-            IDTypeFeatureWithSingleID(
-                f"cat_{i}", _zipf_ids(rng, BATCH_SIZE, VOCAB, slot_offsets[i])
-            )
-            for i in range(N_SLOTS)
-        ]
-        return PersiaBatch(
-            ids,
-            non_id_type_features=[
-                NonIDTypeFeature(rng.normal(size=(BATCH_SIZE, N_DENSE)).astype(np.float32))
-            ],
-            labels=[Label(rng.integers(0, 2, (BATCH_SIZE, 1)).astype(np.float32))],
-            requires_grad=True,
-        )
+    make_batch = _zipf_batch_maker()
 
     warmup = 4
     batches = [make_batch() for _ in range(warmup + steps)]
@@ -311,12 +296,6 @@ def bench_hybrid():
 
     from persia_tpu.config import EmbeddingConfig, SlotConfig
     from persia_tpu.ctx import TrainCtx
-    from persia_tpu.data import (
-        IDTypeFeatureWithSingleID,
-        Label,
-        NonIDTypeFeature,
-        PersiaBatch,
-    )
     from persia_tpu.data_loader import DataLoader
     from persia_tpu.embedding.native_store import create_store
     from persia_tpu.embedding.optim import Adagrad
@@ -340,27 +319,10 @@ def bench_hybrid():
         embedding_config=cfg, wire_dtype="bfloat16",
     ).__enter__()
 
-    rng = np.random.default_rng(0)
-    slot_offsets = rng.integers(0, VOCAB, N_SLOTS, dtype=np.uint64)
-
-    def make_batch():
-        # single-id contiguous wire (the production shape; also what cached
-        # and ps-stream use) with per-slot zipf streams — distinct batches
-        # at 100+ steps would not fit in host RAM as per-sample array lists
-        ids = [
-            IDTypeFeatureWithSingleID(
-                f"cat_{i}", _zipf_ids(rng, BATCH_SIZE, VOCAB, slot_offsets[i])
-            )
-            for i in range(N_SLOTS)
-        ]
-        return PersiaBatch(
-            ids,
-            non_id_type_features=[
-                NonIDTypeFeature(rng.normal(size=(BATCH_SIZE, N_DENSE)).astype(np.float32))
-            ],
-            labels=[Label(rng.integers(0, 2, (BATCH_SIZE, 1)).astype(np.float32))],
-            requires_grad=True,
-        )
+    # single-id contiguous wire (the production shape; also what cached and
+    # ps-stream use): distinct batches at 100+ steps would not fit in host
+    # RAM as per-sample array lists
+    make_batch = _zipf_batch_maker()
 
     # distinct batches end to end (no short replay cycle: the PS LRU must
     # see the real zipf stream, not a warmed 8-batch loop)

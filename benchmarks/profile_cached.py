@@ -1,11 +1,12 @@
 """Attribute the cached-tier stream time across pipeline stages, in situ.
 
-Runs the exact bench.py BENCH_MODE=cached shape through ``train_stream``
-with PERSIA_TRACE spans enabled and aggregates per-stage busy time per
-step. Because the stream is pipelined across three threads, per-thread
-busy-ms/step > wall-ms/step is possible; the WALL time is bounded below by
-the busiest serial stage chain (feeder: prep; stager: stage; main:
-dispatch; writeback: wb_flush + psgrad).
+Runs the exact bench.py BENCH_MODE=cached configuration (ctx + zipf batch
+stream come from bench.py itself — no copy to drift) through
+``train_stream`` with PERSIA_TRACE spans enabled and aggregates per-stage
+busy time per step. Because the stream is pipelined across three threads,
+per-thread busy-ms/step > wall-ms/step is possible; the WALL time is
+bounded below by the busiest serial stage chain (feeder: prep; stager:
+stage; main: dispatch; writeback: wb_flush + psgrad).
 
 No device->host fetch happens inside the measured window (fetch_final=False)
 — a single d2h permanently degrades dispatch latency ~200x on a
@@ -21,79 +22,19 @@ import sys
 import time
 from collections import defaultdict
 
-import numpy as np
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-BATCH_SIZE = 4096
-N_DENSE = 13
-N_SLOTS = 26
-EMB_DIM = 16
-VOCAB = 1_000_000
+import bench  # noqa: E402
+
 STEPS = int(os.environ.get("PROFILE_STEPS", "100"))
 WARM = int(os.environ.get("PROFILE_WARM", "16"))
 
 
-def _zipf_ids(rng, n, vocab, offset, a=1.2):
-    raw = rng.zipf(a, n).astype(np.uint64)
-    return (raw + np.uint64(offset)) % vocab
-
-
 def main():
-    import optax
-
     from persia_tpu import tracing
-    from persia_tpu.config import EmbeddingConfig, SlotConfig
-    from persia_tpu.data import (
-        IDTypeFeatureWithSingleID,
-        Label,
-        NonIDTypeFeature,
-        PersiaBatch,
-    )
-    from persia_tpu.embedding.hbm_cache import CachedTrainCtx
-    from persia_tpu.embedding.native_store import create_store
-    from persia_tpu.embedding.optim import Adagrad
-    from persia_tpu.embedding.worker import EmbeddingWorker
-    from persia_tpu.models import DLRM
 
-    cfg = EmbeddingConfig(
-        slots_config={f"cat_{i}": SlotConfig(dim=EMB_DIM) for i in range(N_SLOTS)},
-        feature_index_prefix_bit=8,
-    )
-    store = create_store(
-        "auto", capacity=1 << 25, num_internal_shards=64,
-        optimizer=Adagrad(lr=0.05).config, seed=1,
-    )
-    worker = EmbeddingWorker(cfg, [store], num_threads=16)
-    model = DLRM(embedding_dim=EMB_DIM, bottom_mlp=(256, 64, EMB_DIM), top_mlp=(512, 256))
-    ctx = CachedTrainCtx(
-        model=model, dense_optimizer=optax.adam(1e-3),
-        embedding_optimizer=Adagrad(lr=0.05), worker=worker,
-        embedding_config=cfg, cache_rows=1 << 21,
-        wb_wire_dtype="bfloat16",
-        aux_wire_dtype=os.environ.get("BENCH_AUX_WIRE", "bfloat16"),
-        admit_touches=int(os.environ.get("BENCH_ADMIT_TOUCHES", "2")),
-    ).__enter__()
-
-    rng = np.random.default_rng(0)
-    slot_offsets = rng.integers(0, VOCAB, N_SLOTS, dtype=np.uint64)
-
-    def make_batch():
-        ids = [
-            IDTypeFeatureWithSingleID(
-                f"cat_{i}", _zipf_ids(rng, BATCH_SIZE, VOCAB, slot_offsets[i])
-            )
-            for i in range(N_SLOTS)
-        ]
-        return PersiaBatch(
-            ids,
-            non_id_type_features=[
-                NonIDTypeFeature(rng.normal(size=(BATCH_SIZE, N_DENSE)).astype(np.float32))
-            ],
-            labels=[Label(rng.integers(0, 2, (BATCH_SIZE, 1)).astype(np.float32))],
-            requires_grad=True,
-        )
-
+    ctx = bench._cached_tier_ctx()
+    make_batch = bench._zipf_batch_maker()
     batches = [make_batch() for _ in range(WARM + STEPS)]
     ctx.train_stream(batches[:WARM], fetch_final=False)  # warm cache + compile
 
@@ -111,7 +52,7 @@ def main():
 
     out = {
         "wall_ms_per_step": round(wall / STEPS * 1e3, 3),
-        "samples_per_sec": round(STEPS * BATCH_SIZE / wall, 1),
+        "samples_per_sec": round(STEPS * bench.BATCH_SIZE / wall, 1),
     }
     for name in sorted(agg):
         cnt, ms = agg[name]

@@ -206,34 +206,43 @@ class ShardedLookup:
             return [t() for t in thunks]
         return [f.result() for f in [self._fan_pool.submit(t) for t in thunks]]
 
-    def lookup(self, keys: np.ndarray, dim: int, train: bool) -> np.ndarray:
+    def _partition(self, signs: np.ndarray):
+        """[(replica_index, positions-or-mask), ...] for the touched
+        replicas — the one sign-routing split every fan-out method shares
+        (native one-pass partition when available, boolean masks otherwise;
+        both index forms select rows identically downstream)."""
         n = len(self.replicas)
-        if n == 1:
-            r0 = self.replicas[0]
-            return self._with_recovery(r0, lambda: r0.lookup(keys, dim, train))
-        part = native_worker.shard_partition(keys, n)
-        out = np.zeros((len(keys), dim), dtype=np.float32)
-        sel = []  # (positions, thunk) per touched replica, issued at once
+        sel = []
+        part = native_worker.shard_partition(signs, n)
         if part is not None:
             pos, counts = part
             start = 0
             for r in range(n):
                 c = int(counts[r])
                 if c:
-                    p = pos[start:start + c]
-                    rep = self.replicas[r]
-                    sel.append((p, lambda rep=rep, p=p: self._with_recovery(
-                        rep, lambda: rep.lookup(keys[p], dim, train))))
+                    sel.append((r, pos[start:start + c]))
                 start += c
         else:
-            shard = sign_to_shard(keys, n)
+            shard = sign_to_shard(signs, n)
             for r in range(n):
                 mask = shard == r
                 if mask.any():
-                    rep = self.replicas[r]
-                    sel.append((mask, lambda rep=rep, m=mask: self._with_recovery(
-                        rep, lambda: rep.lookup(keys[m], dim, train))))
-        for (idx, _), vals in zip(sel, self._concurrent([t for _, t in sel])):
+                    sel.append((r, mask))
+        return sel
+
+    def lookup(self, keys: np.ndarray, dim: int, train: bool) -> np.ndarray:
+        n = len(self.replicas)
+        if n == 1:
+            r0 = self.replicas[0]
+            return self._with_recovery(r0, lambda: r0.lookup(keys, dim, train))
+        out = np.zeros((len(keys), dim), dtype=np.float32)
+        sel = self._partition(keys)
+        thunks = [
+            (lambda rep=self.replicas[r], idx=idx: self._with_recovery(
+                rep, lambda: rep.lookup(keys[idx], dim, train)))
+            for r, idx in sel
+        ]
+        for (r, idx), vals in zip(sel, self._concurrent(thunks)):
             out[idx] = vals
         return out
 
@@ -248,28 +257,13 @@ class ShardedLookup:
                 r0, lambda: r0.checkout_entries(signs, dim)
             )
         out: Optional[np.ndarray] = None
-        sel = []
-        part = native_worker.shard_partition(signs, n)
-        if part is not None:
-            pos, counts = part
-            start = 0
-            for r in range(n):
-                c = int(counts[r])
-                if c:
-                    p = pos[start:start + c]
-                    rep = self.replicas[r]
-                    sel.append((p, lambda rep=rep, p=p: self._with_recovery(
-                        rep, lambda: rep.checkout_entries(signs[p], dim))))
-                start += c
-        else:
-            shard = sign_to_shard(signs, n)
-            for r in range(n):
-                mask = shard == r
-                if mask.any():
-                    rep = self.replicas[r]
-                    sel.append((mask, lambda rep=rep, m=mask: self._with_recovery(
-                        rep, lambda: rep.checkout_entries(signs[m], dim))))
-        for (idx, _), vals in zip(sel, self._concurrent([t for _, t in sel])):
+        sel = self._partition(signs)
+        thunks = [
+            (lambda rep=self.replicas[r], idx=idx: self._with_recovery(
+                rep, lambda: rep.checkout_entries(signs[idx], dim)))
+            for r, idx in sel
+        ]
+        for (r, idx), vals in zip(sel, self._concurrent(thunks)):
             if out is None:
                 out = np.empty((len(signs), vals.shape[1]), np.float32)
             out[idx] = vals
@@ -315,28 +309,13 @@ class ShardedLookup:
         if vals_out is not None:
             vals = vals_out
             vals[:len(signs)] = 0.0
-        sel = []
-        part = native_worker.shard_partition(signs, n)
-        if part is not None:
-            pos, counts = part
-            start = 0
-            for r in range(n):
-                c = int(counts[r])
-                if c:
-                    p = pos[start:start + c]
-                    rep = self.replicas[r]
-                    sel.append((p, lambda rep=rep, p=p: self._with_recovery(
-                        rep, lambda: rep.probe_entries(signs[p], dim))))
-                start += c
-        else:
-            shard = sign_to_shard(signs, n)
-            for r in range(n):
-                mask = shard == r
-                if mask.any():
-                    rep = self.replicas[r]
-                    sel.append((mask, lambda rep=rep, m=mask: self._with_recovery(
-                        rep, lambda: rep.probe_entries(signs[m], dim))))
-        for (idx, _), (w, v) in zip(sel, self._concurrent([t for _, t in sel])):
+        sel = self._partition(signs)
+        thunks = [
+            (lambda rep=self.replicas[r], idx=idx: self._with_recovery(
+                rep, lambda: rep.probe_entries(signs[idx], dim)))
+            for r, idx in sel
+        ]
+        for (r, idx), (w, v) in zip(sel, self._concurrent(thunks)):
             if vals is None:
                 vals = np.zeros((len(signs), v.shape[1]), np.float32)
             warm[idx] = w
@@ -364,32 +343,13 @@ class ShardedLookup:
                 signs, values, dim, commit_incremental=commit_incremental
             )
             return
-        thunks = []
-        part = native_worker.shard_partition(signs, n)
-        if part is not None:
-            pos, counts = part
-            start = 0
-            for r in range(n):
-                c = int(counts[r])
-                if c:
-                    p = pos[start:start + c]
-                    rep = self.replicas[r]
-                    thunks.append(lambda rep=rep, p=p: rep.set_embedding(
-                        signs[p], values[p], dim,
-                        commit_incremental=commit_incremental,
-                    ))
-                start += c
-        else:
-            shard = sign_to_shard(signs, n)
-            for r in range(n):
-                mask = shard == r
-                if mask.any():
-                    rep = self.replicas[r]
-                    thunks.append(lambda rep=rep, m=mask: rep.set_embedding(
-                        signs[m], values[m], dim,
-                        commit_incremental=commit_incremental,
-                    ))
-        self._concurrent(thunks)
+        self._concurrent([
+            (lambda rep=self.replicas[r], idx=idx: rep.set_embedding(
+                signs[idx], values[idx], dim,
+                commit_incremental=commit_incremental,
+            ))
+            for r, idx in self._partition(signs)
+        ])
 
     def advance_batch_state(self, group: int) -> None:
         self._concurrent([
@@ -405,28 +365,11 @@ class ShardedLookup:
             r0 = self.replicas[0]
             self._with_recovery(r0, lambda: r0.update_gradients(keys, grads, group))
             return
-        thunks = []
-        part = native_worker.shard_partition(keys, n)
-        if part is not None:
-            pos, counts = part
-            start = 0
-            for r in range(n):
-                c = int(counts[r])
-                if c:
-                    p = pos[start:start + c]
-                    rep = self.replicas[r]
-                    thunks.append(lambda rep=rep, p=p: self._with_recovery(
-                        rep, lambda: rep.update_gradients(keys[p], grads[p], group)))
-                start += c
-        else:
-            shard = sign_to_shard(keys, n)
-            for r in range(n):
-                mask = shard == r
-                if mask.any():
-                    rep = self.replicas[r]
-                    thunks.append(lambda rep=rep, m=mask: self._with_recovery(
-                        rep, lambda: rep.update_gradients(keys[m], grads[m], group)))
-        self._concurrent(thunks)
+        self._concurrent([
+            (lambda rep=self.replicas[r], idx=idx: self._with_recovery(
+                rep, lambda: rep.update_gradients(keys[idx], grads[idx], group)))
+            for r, idx in self._partition(keys)
+        ])
 
 
 def _distinct_rows(
