@@ -159,6 +159,15 @@ class _BufRing:
         self.depth = depth
         self._slots: Dict = {}
 
+    def ensure_depth(self, depth: int) -> None:
+        """Grow the ring so ``depth`` buffers rotate before any reuse.
+
+        Safe at any time: ``get`` keeps appending fresh buffers per key
+        until the ring holds ``self.depth`` of them, so raising the depth
+        simply extends the rotation; existing hand-outs are unaffected."""
+        if depth > self.depth:
+            self.depth = depth
+
     def get(self, key, shape, dtype) -> np.ndarray:
         arrs, idx = self._slots.get(key, ([], 0))
         if len(arrs) < self.depth:
@@ -944,6 +953,20 @@ class CachedEmbeddingTier:
                     f"{sorted(ms & set(self.ps_slots))}: one key space "
                     "cannot span both tiers"
                 )
+        # The tier-disjointness above only partitions the PS key space when
+        # groups carry distinct sign prefixes. With feature_index_prefix_bit
+        # == 0 every slot hashes into one raw u64 space, so a PS-tier sign
+        # can collide with a cached-tier sign across groups and eviction
+        # flushes vs ps-grad applies would become unordered writers to the
+        # same PS entry.
+        if self.groups and self.ps_slots and self.cfg.feature_index_prefix_bit == 0:
+            raise ValueError(
+                "mixed-tier config (cached groups + PS-tier slots "
+                f"{sorted(self.ps_slots)}) requires feature_index_prefix_bit "
+                "> 0 so per-group sign prefixes partition the PS key space; "
+                "with prefix bit 0 a cached-tier sign can collide with a "
+                "PS-tier sign and the two tiers would race on one PS entry"
+            )
         self.dirs = {
             g.name: CacheDirectory(g.rows, admit_touches=admit_touches)
             for g in self.groups
@@ -2022,6 +2045,19 @@ class CachedTrainCtx:
         fetch past the region they care about.
         """
         import queue as _queue
+
+        if prefetch < 1:
+            raise ValueError(f"prefetch must be >= 1, got {prefetch}")
+        # The feeder→stager path holds up to prefetch (prep_q) + 2 in-hand
+        # batches of host staging buffers, each still referenced by an async
+        # device_put until its h2d lands. Size every staging ring so a slot
+        # cannot come around for reuse while that many items (plus h2d
+        # slack) are in flight — otherwise a deep-prefetch stream would
+        # silently corrupt device-side data.
+        need_depth = prefetch + 4
+        self.tier._ring.ensure_depth(need_depth)
+        for d in self.tier.dirs.values():
+            d._rows_ring.ensure_depth(need_depth)
 
         self._land_pending()  # do not mix with a sync-path deferred step
         # pending eviction write-backs, seq → per-group record:

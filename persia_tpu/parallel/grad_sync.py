@@ -146,6 +146,17 @@ class QAdam:
     eps: float = 1e-8
     warmup_steps: int = 100
 
+    def __post_init__(self):
+        # warmup_steps == 0 would freeze v at its all-zero init AND make the
+        # frozen bias correction 1 - beta2^0 = 0, so the first update divides
+        # 0/0 and every parameter goes NaN immediately.
+        if self.warmup_steps < 1:
+            raise ValueError(
+                f"QAdam requires warmup_steps >= 1 (got {self.warmup_steps}): "
+                "v freezes at warmup end, so at least one warmup step must "
+                "populate it"
+            )
+
 
 @dataclass(frozen=True)
 class LowPrecisionDecentralized:

@@ -423,3 +423,14 @@ def test_local_params_loss_is_mean():
         losses.append(float(np.asarray(header)[0]))
     assert all(np.isfinite(losses))
     assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+
+def test_qadam_rejects_zero_warmup():
+    """warmup_steps=0 would freeze v at its all-zero init with bias
+    correction 1 - beta2^0 = 0: the first update computes 0/0 and params go
+    NaN — the config must be rejected up front."""
+    with pytest.raises(ValueError, match="warmup_steps"):
+        QAdam(warmup_steps=0)
+    with pytest.raises(ValueError, match="warmup_steps"):
+        QAdam(warmup_steps=-3)
+    QAdam(warmup_steps=1)  # minimum valid

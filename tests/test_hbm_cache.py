@@ -1161,3 +1161,44 @@ def test_stream_dispatch_failure_releases_in_hand_ps_ref():
         ctx.train_stream(_batches(10, seed=6), prefetch=3, psgrad_batch=4)
     assert worker.staleness == 0
     assert not worker.post_forward_buffer
+
+
+def test_mixed_tier_requires_prefix_bit():
+    """cached groups + PS-tier slots in one raw u64 key space (prefix bit 0)
+    would let a cached-tier sign collide with a PS-tier sign, making
+    eviction flushes and ps-grad applies unordered writers to the same PS
+    entry — the constructor must reject the arrangement."""
+    import optax
+
+    from persia_tpu.models import DNN
+
+    cfg = _cfg(prefix_bit=0)
+    store = EmbeddingStore(
+        capacity=1 << 12, num_internal_shards=2,
+        optimizer=SGD(lr=0.1).config, seed=11,
+    )
+    worker = EmbeddingWorker(cfg, [store])
+    with pytest.raises(ValueError, match="feature_index_prefix_bit"):
+        hbm.CachedTrainCtx(
+            model=DNN(dense_mlp_size=8, sparse_mlp_size=32, hidden_sizes=(16,)),
+            dense_optimizer=optax.sgd(1e-2),
+            embedding_optimizer=SGD(lr=0.1),
+            worker=worker,
+            embedding_config=cfg,
+            cache_rows=64,
+            ps_slots=["cat_c"],  # mixed: cat_a/cat_b cached, cat_c on the PS
+        )
+
+
+def test_stream_deep_prefetch_grows_staging_rings():
+    """A prefetch deeper than the staging-ring slack must GROW the rings
+    (not silently reuse a buffer still referenced by an in-flight
+    device_put): train at prefetch=8 and check the rings rotated wide
+    enough, with training still bit-sane."""
+    ctx, _store = _make_cached(SGD(lr=0.1), cache_rows=256)
+    with ctx:
+        m = ctx.train_stream(_batches(12, seed=9), prefetch=8)
+        assert m is not None and np.isfinite(m["loss"])
+        assert ctx.tier._ring.depth >= 8 + 4
+        for d in ctx.tier.dirs.values():
+            assert d._rows_ring.depth >= 8 + 4
