@@ -341,6 +341,116 @@ struct Store {
   }
 };
 
+// What a row does once its entry (or miss) is resolved:
+//   kDefer  — read/update the entry's data; the engine applies it in order
+//             with the data lines prefetched (classify prefetches them)
+//   kDone   — fully handled inside classify (zero-fill, warm=0, skip)
+//   kMutate — needs structural mutation (insert/evict/re-init); the engine
+//             drains earlier rows, runs `mutate` sequentially, then
+//             re-resolves everything after it (an insert can change what a
+//             later duplicate sign resolves to)
+enum class RowAction : int8_t { kDefer = 0, kDone = 1, kMutate = 2 };
+
+// Shard-grouped row walk shared by the batched lookup/update entry points:
+// stable counting sort of row indices by owning shard, then one shard at a
+// time — ONE lock per touched shard instead of per row. Within a shard,
+// rows process in chunks through a 4-pass software pipeline:
+//   1. prefetch the chunk's home buckets        (table spans 100s of MB)
+//   2. probe (buckets hot) + prefetch Entry structs
+//   3. classify (structs hot) + prefetch entry data rows
+//   4. apply in original order (data hot)
+// Each pass issues up to CHUNK independent DRAM loads concurrently instead
+// of one dependent chain per row — the walk is memory-latency bound, and
+// this is where the per-row cost goes from ~4 serialized misses to ~4
+// misses amortized over the whole chunk. Passes 1-3 are read-only; applies
+// and mutations run in the rows' ORIGINAL relative order, so the resulting
+// table/LRU/optimizer state is IDENTICAL to the sequential per-row walk
+// (shards are independent state; stability of the counting sort preserves
+// within-shard order).
+template <class Classify, class Apply, class Mutate>
+inline void walk_rows_by_shard(Store* s, const uint64_t* signs, int64_t n,
+                               Classify&& classify, Apply&& apply,
+                               Mutate&& mutate) {
+  const uint32_t ns = s->num_shards;
+  thread_local std::vector<uint32_t> cnt;
+  thread_local std::vector<uint32_t> shard_idx;
+  thread_local std::vector<int64_t> order;
+  cnt.assign(ns + 1, 0);
+  if ((int64_t)shard_idx.size() < n) { shard_idx.resize(n); order.resize(n); }
+  for (int64_t i = 0; i < n; ++i) {
+    shard_idx[i] = (uint32_t)(splitmix64(signs[i] ^ 0xA5A5A5A5ULL) % ns);
+    cnt[shard_idx[i] + 1]++;
+  }
+  for (uint32_t r = 0; r < ns; ++r) cnt[r + 1] += cnt[r];
+  {
+    thread_local std::vector<uint32_t> ofs;
+    ofs.assign(cnt.begin(), cnt.end() - 1);
+    for (int64_t i = 0; i < n; ++i) order[ofs[shard_idx[i]]++] = i;
+  }
+  constexpr int64_t CHUNK = 32;
+  int32_t ent[CHUNK];
+  RowAction act[CHUNK];
+  for (uint32_t r = 0; r < ns; ++r) {
+    int64_t k = cnt[r];
+    const int64_t k_end = cnt[r + 1];
+    if (k == k_end) continue;
+    Shard& sh = s->shards[r];
+    std::lock_guard<std::mutex> g(sh.mu);
+    while (k < k_end) {
+      const int64_t m = std::min(CHUNK, k_end - k);
+      for (int64_t j = 0; j < m; ++j) {
+        const size_t hp = sh.home(signs[order[k + j]]);
+        __builtin_prefetch(&sh.table_sign[hp]);
+        __builtin_prefetch(&sh.table_slot[hp]);
+      }
+      for (int64_t j = 0; j < m; ++j) {
+        const size_t pos = sh.find_pos(signs[order[k + j]]);
+        const int32_t e = (pos == SIZE_MAX) ? -1 : sh.table_slot[pos];
+        ent[j] = e;
+        if (e >= 0) __builtin_prefetch(&sh.entries[e]);
+      }
+      // classification stops at the first mutation: a structural change can
+      // alter what every later row resolves to (duplicate-sign inserts)
+      int64_t stop = m;
+      for (int64_t j = 0; j < m; ++j) {
+        act[j] = classify(sh, order[k + j], ent[j]);
+        if (act[j] == RowAction::kMutate) { stop = j; break; }
+      }
+      for (int64_t j = 0; j < stop; ++j)
+        if (act[j] == RowAction::kDefer) apply(sh, order[k + j], ent[j]);
+      if (stop < m) {
+        mutate(sh, order[k + stop]);
+        k += stop + 1;
+        // Drain a RUN of consecutive mutations sequentially (cold fill
+        // classifies nearly every row kMutate; restarting the 32-row
+        // pipeline to consume one row per pass would redo ~16x the probe
+        // work). Back to chunked mode at the first non-mutating row.
+        while (k < k_end) {
+          const int64_t i = order[k];
+          const size_t pos = sh.find_pos(signs[i]);
+          const int32_t e = (pos == SIZE_MAX) ? -1 : sh.table_slot[pos];
+          const RowAction a = classify(sh, i, e);
+          if (a == RowAction::kMutate) {
+            mutate(sh, i);
+            ++k;
+            continue;
+          }
+          if (a == RowAction::kDefer) apply(sh, i, e);
+          ++k;
+          break;
+        }
+      } else {
+        k += m;
+      }
+    }
+  }
+}
+
+// data-row prefetch helper for classify passes
+inline void prefetch_row(const float* data, uint32_t n_floats) {
+  for (uint32_t o = 0; o < n_floats; o += 16) __builtin_prefetch(data + o);
+}
+
 }  // namespace
 
 // ------------------------------------------------------------------- C API
@@ -375,46 +485,80 @@ void ps_register_optimizer(void* h, int kind, float lr, float weight_decay,
 
 uint32_t ps_num_shards(void* h) { return ((Store*)h)->num_shards; }
 
-// out: (n, dim) row-major f32
-void ps_lookup(void* h, const uint64_t* signs, int64_t n, uint32_t dim, int train,
-               float* out) {
+// Multi-slot batched lookup: ONE call per training batch instead of one per
+// slot (the per-slot fan-out was measurable pure overhead on a 1-core host;
+// reference batches the same way — lookup_batched_all_slots,
+// embedding_worker_service/mod.rs:874-942). Group g covers rows
+// [key_ofs[g], key_ofs[g+1]) of `signs` with embedding dim dims[g]; its rows
+// are written at out + out_ofs[g] (float offset), row-major. State effects
+// (LRU order, admits, evictions) are identical to per-slot sequential calls
+// — see walk_rows_by_shard.
+void ps_lookup_batched(void* h, const uint64_t* signs, const int64_t* key_ofs,
+                       const uint32_t* dims, const int64_t* out_ofs,
+                       int32_t n_groups, int train, float* out) {
   Store* s = (Store*)h;
-  const uint32_t entry_len = dim + s->opt.state_dim(dim);
-  for (int64_t i = 0; i < n; ++i) {
-    uint64_t sign = signs[i];
-    Shard& sh = s->shard_of(sign);
-    std::lock_guard<std::mutex> g(sh.mu);
-    size_t pos = sh.find_pos(sign);
-    float* row = out + (size_t)i * dim;
-    if (train) {
-      int32_t e = (pos == SIZE_MAX) ? -1 : sh.table_slot[pos];
-      if (e >= 0 && sh.entries[e].dim == dim && sh.entries[e].len == entry_len) {
-        sh.touch(e);
-        std::memcpy(row, sh.entries[e].data, sizeof(float) * dim);
-      } else {
-        if (e >= 0) {
-          sh.remove_entry(e);  // dim mismatch → re-init
-        } else if (!s->admit(sign)) {
-          std::memset(row, 0, sizeof(float) * dim);
-          continue;
+  const int64_t n = n_groups > 0 ? key_ofs[n_groups] : 0;
+  if (n == 0) return;
+  // per-row group resolution (rows are contiguous per group)
+  thread_local std::vector<int32_t> row_group;
+  if ((int64_t)row_group.size() < n) row_group.resize(n);
+  for (int32_t g = 0; g < n_groups; ++g)
+    for (int64_t i = key_ofs[g]; i < key_ofs[g + 1]; ++i) row_group[i] = g;
+  thread_local std::vector<uint32_t> entry_lens;
+  entry_lens.resize(n_groups);
+  for (int32_t g = 0; g < n_groups; ++g)
+    entry_lens[g] = dims[g] + s->opt.state_dim(dims[g]);
+
+  auto row_ptr = [&](int64_t i) {
+    const int32_t g = row_group[i];
+    return out + out_ofs[g] + (size_t)(i - key_ofs[g]) * dims[g];
+  };
+  walk_rows_by_shard(
+      s, signs, n,
+      [&](Shard& sh, int64_t i, int32_t e) {
+        const int32_t g = row_group[i];
+        const uint32_t dim = dims[g];
+        if (e >= 0 && sh.entries[e].dim == dim &&
+            (!train || sh.entries[e].len == entry_lens[g])) {
+          prefetch_row(sh.entries[e].data, dim);
+          return RowAction::kDefer;
         }
-        int32_t ne = sh.insert(sign, dim, entry_len);
+        if (!train) {  // infer: zeros on miss/mismatch — never read state
+          std::memset(row_ptr(i), 0, sizeof(float) * dim);
+          return RowAction::kDone;
+        }
+        if (e < 0 && !s->admit(signs[i])) {
+          std::memset(row_ptr(i), 0, sizeof(float) * dim);
+          return RowAction::kDone;
+        }
+        return RowAction::kMutate;  // admit-miss insert or dim-mismatch re-init
+      },
+      [&](Shard& sh, int64_t i, int32_t e) {
+        if (train) sh.touch(e);
+        std::memcpy(row_ptr(i), sh.entries[e].data,
+                    sizeof(float) * dims[row_group[i]]);
+      },
+      [&](Shard& sh, int64_t i) {
+        const int32_t g = row_group[i];
+        const uint32_t dim = dims[g];
+        const uint64_t sign = signs[i];
+        size_t pos = sh.find_pos(sign);
+        int32_t e = (pos == SIZE_MAX) ? -1 : sh.table_slot[pos];
+        if (e >= 0) sh.remove_entry(e);  // dim mismatch → re-init
+        int32_t ne = sh.insert(sign, dim, entry_lens[g]);
         float* data = sh.entries[ne].data;
         s->init_embedding(sign, dim, data);
         s->init_state(dim, data + dim);
-        std::memcpy(row, data, sizeof(float) * dim);
-      }
-    } else {
-      // infer: the entry's own recorded dim must match — never read optimizer
-      // state bytes as embedding values (zeros on miss/mismatch)
-      int32_t e = (pos == SIZE_MAX) ? -1 : sh.table_slot[pos];
-      if (e >= 0 && sh.entries[e].dim == dim) {
-        std::memcpy(row, sh.entries[e].data, sizeof(float) * dim);
-      } else {
-        std::memset(row, 0, sizeof(float) * dim);
-      }
-    }
-  }
+        std::memcpy(row_ptr(i), data, sizeof(float) * dim);
+      });
+}
+
+// out: (n, dim) row-major f32
+void ps_lookup(void* h, const uint64_t* signs, int64_t n, uint32_t dim, int train,
+               float* out) {
+  const int64_t key_ofs[2] = {0, n};
+  const int64_t out_ofs[1] = {0};
+  ps_lookup_batched(h, signs, key_ofs, &dim, out_ofs, 1, train, out);
 }
 
 // Batched full-entry checkout for the HBM cache tier
@@ -429,25 +573,31 @@ int64_t ps_checkout(void* h, const uint64_t* signs, int64_t n, uint32_t dim,
                     float* out) {
   Store* s = (Store*)h;
   const uint32_t entry_len = dim + s->opt.state_dim(dim);
-  for (int64_t i = 0; i < n; ++i) {
-    uint64_t sign = signs[i];
-    Shard& sh = s->shard_of(sign);
-    std::lock_guard<std::mutex> g(sh.mu);
-    size_t pos = sh.find_pos(sign);
-    float* row = out + (size_t)i * entry_len;
-    int32_t e = (pos == SIZE_MAX) ? -1 : sh.table_slot[pos];
-    if (e >= 0 && sh.entries[e].dim == dim && sh.entries[e].len == entry_len) {
-      sh.touch(e);
-      std::memcpy(row, sh.entries[e].data, sizeof(float) * entry_len);
-    } else {
-      if (e >= 0) sh.remove_entry(e);  // dim mismatch → re-init
-      int32_t ne = sh.insert(sign, dim, entry_len);
-      float* data = sh.entries[ne].data;
-      s->init_embedding(sign, dim, data);
-      s->init_state(dim, data + dim);
-      std::memcpy(row, data, sizeof(float) * entry_len);
-    }
-  }
+  walk_rows_by_shard(
+      s, signs, n,
+      [&](Shard& sh, int64_t, int32_t e) {
+        if (e >= 0 && sh.entries[e].dim == dim && sh.entries[e].len == entry_len) {
+          prefetch_row(sh.entries[e].data, entry_len);
+          return RowAction::kDefer;
+        }
+        return RowAction::kMutate;
+      },
+      [&](Shard& sh, int64_t i, int32_t e) {
+        sh.touch(e);
+        std::memcpy(out + (size_t)i * entry_len, sh.entries[e].data,
+                    sizeof(float) * entry_len);
+      },
+      [&](Shard& sh, int64_t i) {
+        const uint64_t sign = signs[i];
+        size_t pos = sh.find_pos(sign);
+        int32_t e = (pos == SIZE_MAX) ? -1 : sh.table_slot[pos];
+        if (e >= 0) sh.remove_entry(e);  // dim mismatch → re-init
+        int32_t ne = sh.insert(sign, dim, entry_len);
+        float* data = sh.entries[ne].data;
+        s->init_embedding(sign, dim, data);
+        s->init_state(dim, data + dim);
+        std::memcpy(out + (size_t)i * entry_len, data, sizeof(float) * entry_len);
+      });
   return entry_len;
 }
 
@@ -460,78 +610,83 @@ int64_t ps_probe_entries(void* h, const uint64_t* signs, int64_t n, uint32_t dim
                          float* out, uint8_t* warm_out) {
   Store* s = (Store*)h;
   const uint32_t entry_len = dim + s->opt.state_dim(dim);
-  // Group positions by shard (counting sort into thread-local scratch),
-  // then walk one shard at a time: ONE lock per touched shard instead of
-  // per sign, and the open-addressing probes run behind a software
-  // prefetch pipeline — the table spans hundreds of MB at production
-  // capacities, so each probe is a DRAM-latency random access otherwise.
-  const uint32_t ns = s->num_shards;
-  thread_local std::vector<uint32_t> cnt;
-  thread_local std::vector<uint32_t> shard_idx;
-  thread_local std::vector<int64_t> order;
-  cnt.assign(ns + 1, 0);
-  if ((int64_t)shard_idx.size() < n) { shard_idx.resize(n); order.resize(n); }
-  for (int64_t i = 0; i < n; ++i) {
-    shard_idx[i] = (uint32_t)(splitmix64(signs[i] ^ 0xA5A5A5A5ULL) % ns);
-    cnt[shard_idx[i] + 1]++;
-  }
-  for (uint32_t r = 0; r < ns; ++r) cnt[r + 1] += cnt[r];
-  {
-    thread_local std::vector<uint32_t> ofs;
-    ofs.assign(cnt.begin(), cnt.end() - 1);
-    for (int64_t i = 0; i < n; ++i) order[ofs[shard_idx[i]]++] = i;
-  }
-  const int64_t PF = 8;
-  for (uint32_t r = 0; r < ns; ++r) {
-    const int64_t b = cnt[r], e_end = cnt[r + 1];
-    if (b == e_end) continue;
-    Shard& sh = s->shards[r];
-    std::lock_guard<std::mutex> g(sh.mu);
-    for (int64_t k = b; k < e_end; ++k) {
-      if (k + PF < e_end) {
-        const size_t hp = sh.home(signs[order[k + PF]]);
-        __builtin_prefetch(&sh.table_sign[hp]);
-        __builtin_prefetch(&sh.table_slot[hp]);
-      }
-      const int64_t i = order[k];
-      const uint64_t sign = signs[i];
-      size_t pos = sh.find_pos(sign);
-      int32_t e = (pos == SIZE_MAX) ? -1 : sh.table_slot[pos];
-      if (e >= 0 && sh.entries[e].dim == dim && sh.entries[e].len == entry_len) {
+  walk_rows_by_shard(
+      s, signs, n,
+      [&](Shard& sh, int64_t i, int32_t e) {
+        if (e >= 0 && sh.entries[e].dim == dim && sh.entries[e].len == entry_len) {
+          prefetch_row(sh.entries[e].data, entry_len);
+          return RowAction::kDefer;
+        }
+        warm_out[i] = 0;
+        return RowAction::kDone;
+      },
+      [&](Shard& sh, int64_t i, int32_t e) {
         sh.touch(e);
         std::memcpy(out + (size_t)i * entry_len, sh.entries[e].data,
                     sizeof(float) * entry_len);
         warm_out[i] = 1;
-      } else {
-        warm_out[i] = 0;
-      }
-    }
-  }
+      },
+      [](Shard&, int64_t) {});  // probe never mutates
   return entry_len;
 }
 
 void ps_advance_batch_state(void* h, int group) { ((Store*)h)->advance_batch_state(group); }
 
+// Multi-slot batched gradient update: ONE call per gradient batch. Group g
+// covers rows [key_ofs[g], key_ofs[g+1]) with dim dims[g], gradient rows at
+// grads + grad_ofs[g], and optimizer group opt_groups[g] (Adam batch-level
+// beta powers are fetched once per group — the caller advances them once per
+// gradient batch, matching optim.rs:99-221). State-identical to per-slot
+// sequential calls (walk_rows_by_shard preserves within-shard order).
+int ps_update_batched(void* h, const uint64_t* signs, const int64_t* key_ofs,
+                      const uint32_t* dims, const float* grads,
+                      const int64_t* grad_ofs, const int32_t* opt_groups,
+                      int32_t n_groups) {
+  Store* s = (Store*)h;
+  if (s->opt.kind == OPT_NONE) return -1;
+  const int64_t n = n_groups > 0 ? key_ofs[n_groups] : 0;
+  if (n == 0) return 0;
+  thread_local std::vector<int32_t> row_group;
+  if ((int64_t)row_group.size() < n) row_group.resize(n);
+  for (int32_t g = 0; g < n_groups; ++g)
+    for (int64_t i = key_ofs[g]; i < key_ofs[g + 1]; ++i) row_group[i] = g;
+  thread_local std::vector<uint32_t> entry_lens;
+  entry_lens.resize(n_groups);
+  std::vector<std::pair<double, double>> bs(n_groups);
+  for (int32_t g = 0; g < n_groups; ++g) {
+    entry_lens[g] = dims[g] + s->opt.state_dim(dims[g]);
+    bs[g] = s->get_batch_state(opt_groups[g]);
+  }
+
+  walk_rows_by_shard(
+      s, signs, n,
+      [&](Shard& sh, int64_t i, int32_t e) {
+        const int32_t g = row_group[i];
+        if (e < 0 || sh.entries[e].dim != dims[g] ||
+            sh.entries[e].len != entry_lens[g])
+          return RowAction::kDone;  // evicted / never admitted → skip
+        prefetch_row(sh.entries[e].data, entry_lens[g]);
+        return RowAction::kDefer;
+      },
+      [&](Shard& sh, int64_t i, int32_t e) {
+        const int32_t g = row_group[i];
+        const uint32_t dim = dims[g];
+        sh.touch(e);
+        float* data = sh.entries[e].data;
+        s->update_entry(data, data + dim,
+                        grads + grad_ofs[g] + (size_t)(i - key_ofs[g]) * dim,
+                        dim, bs[g]);
+      },
+      [](Shard&, int64_t) {});  // update never mutates structure
+  return 0;
+}
+
 // grads: (n, dim) row-major
 int ps_update_gradients(void* h, const uint64_t* signs, int64_t n, uint32_t dim,
                         const float* grads, int group) {
-  Store* s = (Store*)h;
-  if (s->opt.kind == OPT_NONE) return -1;
-  const uint32_t entry_len = dim + s->opt.state_dim(dim);
-  auto bs = s->get_batch_state(group);
-  for (int64_t i = 0; i < n; ++i) {
-    uint64_t sign = signs[i];
-    Shard& sh = s->shard_of(sign);
-    std::lock_guard<std::mutex> g(sh.mu);
-    size_t pos = sh.find_pos(sign);
-    if (pos == SIZE_MAX) continue;  // evicted / never admitted → skip
-    int32_t e = sh.table_slot[pos];
-    if (sh.entries[e].dim != dim || sh.entries[e].len != entry_len) continue;
-    sh.touch(e);
-    float* data = sh.entries[e].data;
-    s->update_entry(data, data + dim, grads + (size_t)i * dim, dim, bs);
-  }
-  return 0;
+  const int64_t key_ofs[2] = {0, n};
+  const int64_t grad_ofs[1] = {0};
+  return ps_update_batched(h, signs, key_ofs, &dim, grads, grad_ofs, &group, 1);
 }
 
 // values: (n, entry_len) full entries [emb | state]; dim = embedding dim

@@ -81,8 +81,27 @@ def _load_lib() -> ctypes.CDLL:
     lib.ps_dump_shard.argtypes = [p, u32, u8p, i64]
     lib.ps_load_shard.restype = i64
     lib.ps_load_shard.argtypes = [p, u8p, i64]
+    i64p = ctypes.POINTER(i64)
+    u32p = ctypes.POINTER(u32)
+    i32p = ctypes.POINTER(i32)
+    lib.ps_lookup_batched.argtypes = [p, u64p, i64p, u32p, i64p, i32, i32, f32p]
+    lib.ps_update_batched.restype = i32
+    lib.ps_update_batched.argtypes = [p, u64p, i64p, u32p, f32p, i64p, i32p, i32]
     _LIB = lib
     return lib
+
+
+def _check_group_layout(signs: np.ndarray, key_ofs: np.ndarray,
+                        dims: np.ndarray) -> None:
+    """The native batched calls trust this layout with raw pointers: a bad
+    ``key_ofs`` from Python would walk rows outside the group table (stale
+    thread-local group ids → out-of-bounds writes), so reject it here."""
+    if len(key_ofs) != len(dims) + 1:
+        raise ValueError("key_ofs must have len(dims) + 1 entries")
+    if len(key_ofs) == 0 or key_ofs[0] != 0 or key_ofs[-1] != len(signs):
+        raise ValueError("key_ofs must start at 0 and end at len(signs)")
+    if np.any(np.diff(key_ofs) < 0):
+        raise ValueError("key_ofs must be non-decreasing")
 
 
 def _u64p(a: np.ndarray):
@@ -193,6 +212,67 @@ class NativeEmbeddingStore:
         if got != entry_len:
             raise RuntimeError(f"ps_probe_entries entry_len {got} != {entry_len}")
         return warm.view(np.bool_)[:n] if warm_out is not None else warm.astype(bool), vals
+
+    def lookup_batched(self, signs: np.ndarray, key_ofs: np.ndarray,
+                       dims: np.ndarray, train: bool) -> np.ndarray:
+        """Multi-slot lookup in ONE native call (ref batching:
+        lookup_batched_all_slots, embedding_worker_service/mod.rs:874-942).
+        Group g covers ``signs[key_ofs[g]:key_ofs[g+1]]`` with dim
+        ``dims[g]``; returns one flat f32 buffer with group g's rows at
+        float offset ``sum(counts[:g] * dims[:g])`` (the layout
+        ``EmbeddingStore.lookup_batched`` documents). State effects are
+        identical to per-group ``lookup`` calls."""
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        key_ofs = np.ascontiguousarray(key_ofs, dtype=np.int64)
+        dims = np.ascontiguousarray(dims, dtype=np.uint32)
+        _check_group_layout(signs, key_ofs, dims)
+        counts = np.diff(key_ofs)
+        sizes = counts * dims.astype(np.int64)
+        out_ofs = np.zeros(len(dims), dtype=np.int64)
+        np.cumsum(sizes[:-1], out=out_ofs[1:])
+        out = np.empty(int(sizes.sum()), dtype=np.float32)
+        self._lib.ps_lookup_batched(
+            self._h, _u64p(signs),
+            key_ofs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            dims.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            out_ofs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(dims), int(train), _f32p(out),
+        )
+        return out
+
+    def update_batched(self, signs: np.ndarray, key_ofs: np.ndarray,
+                       dims: np.ndarray, grads: np.ndarray,
+                       opt_groups: np.ndarray) -> None:
+        """Multi-slot gradient update in ONE native call; ``grads`` is the
+        flat f32 buffer in ``lookup_batched``'s layout, ``opt_groups[g]`` the
+        optimizer group of slot g. The caller advances Adam batch state once
+        per gradient batch beforehand (batch-level beta powers,
+        optim.rs:99-221)."""
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        key_ofs = np.ascontiguousarray(key_ofs, dtype=np.int64)
+        dims = np.ascontiguousarray(dims, dtype=np.uint32)
+        _check_group_layout(signs, key_ofs, dims)
+        grads = np.ascontiguousarray(grads, dtype=np.float32).reshape(-1)
+        opt_groups = np.ascontiguousarray(opt_groups, dtype=np.int32)
+        counts = np.diff(key_ofs)
+        sizes = counts * dims.astype(np.int64)
+        grad_ofs = np.zeros(len(dims), dtype=np.int64)
+        np.cumsum(sizes[:-1], out=grad_ofs[1:])
+        if grads.size != int(sizes.sum()):
+            raise ValueError("grads size does not match key_ofs/dims layout")
+        rc = self._lib.ps_update_batched(
+            self._h, _u64p(signs),
+            key_ofs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            dims.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            _f32p(grads),
+            grad_ofs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            opt_groups.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(dims),
+        )
+        if rc != 0:
+            raise RuntimeError("no optimizer registered")
+        if self.inc_manager is not None:
+            self.inc_manager.commit(signs)
 
     def advance_batch_state(self, group: int) -> None:
         self._lib.ps_advance_batch_state(self._h, group)

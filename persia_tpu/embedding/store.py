@@ -186,6 +186,40 @@ class EmbeddingStore:
             self._m_miss_ratio.set(misses / len(signs))
         return out
 
+    def lookup_batched(self, signs: np.ndarray, key_ofs: np.ndarray,
+                       dims: np.ndarray, train: bool) -> np.ndarray:
+        """Multi-slot lookup in one call (the golden model of
+        ``NativeEmbeddingStore.lookup_batched``): group g covers
+        ``signs[key_ofs[g]:key_ofs[g+1]]`` with dim ``dims[g]``. Returns one
+        flat f32 buffer — group g's ``(count_g, dims[g])`` rows start at
+        float offset ``sum(counts[:g] * dims[:g])``. State effects are
+        exactly sequential per-group ``lookup`` calls."""
+        key_ofs = np.asarray(key_ofs, dtype=np.int64)
+        parts = [
+            self.lookup(signs[key_ofs[g]:key_ofs[g + 1]], int(dims[g]), train).reshape(-1)
+            for g in range(len(dims))
+        ]
+        return np.concatenate(parts) if parts else np.empty(0, np.float32)
+
+    def update_batched(self, signs: np.ndarray, key_ofs: np.ndarray,
+                       dims: np.ndarray, grads: np.ndarray,
+                       opt_groups: np.ndarray) -> None:
+        """Multi-slot gradient update in one call (golden model of
+        ``NativeEmbeddingStore.update_batched``); ``grads`` is flat in
+        ``lookup_batched``'s layout. Exactly sequential per-group
+        ``update_gradients`` calls."""
+        key_ofs = np.asarray(key_ofs, dtype=np.int64)
+        grads = np.asarray(grads, dtype=np.float32).reshape(-1)
+        off = 0
+        for g in range(len(dims)):
+            d = int(dims[g])
+            ks = signs[key_ofs[g]:key_ofs[g + 1]]
+            size = len(ks) * d
+            self.update_gradients(
+                ks, grads[off:off + size].reshape(len(ks), d), int(opt_groups[g])
+            )
+            off += size
+
     def checkout_entries(self, signs: np.ndarray, dim: int) -> np.ndarray:
         """Batched full-entry fetch for the HBM cache tier: ``(n, dim +
         state_dim)`` rows of ``[emb | optimizer state]`` so the device-side

@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Union
 
@@ -186,6 +185,11 @@ class ShardedLookup:
             )
         else:
             self._fan_pool = None
+        # leaf pool for per-GROUP fallback calls against replicas without a
+        # batched surface (remote clients predating the batched RPC): one
+        # serialized RPC per slot per batch would stack 26+ round-trips —
+        # created lazily, never used for nested tasks (no deadlock)
+        self._group_pool = None
 
     def _with_recovery(self, replica, fn):
         try:
@@ -205,6 +209,19 @@ class ShardedLookup:
         if len(thunks) <= 1 or self._fan_pool is None:
             return [t() for t in thunks]
         return [f.result() for f in [self._fan_pool.submit(t) for t in thunks]]
+
+    def _concurrent_groups(self, thunks):
+        """Concurrent per-GROUP fallback calls (replica lacks the batched
+        surface). These are leaf RPCs — a bounded dedicated pool is safe."""
+        if len(thunks) <= 1:
+            return [t() for t in thunks]
+        if self._group_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._group_pool = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="ps-group-fanout"
+            )
+        return [f.result() for f in [self._group_pool.submit(t) for t in thunks]]
 
     def _partition(self, signs: np.ndarray):
         """[(replica_index, positions-or-mask), ...] for the touched
@@ -229,6 +246,156 @@ class ShardedLookup:
                 if mask.any():
                     sel.append((r, mask))
         return sel
+
+    def _partition_positions(self, signs: np.ndarray):
+        """Like ``_partition`` but always ascending position arrays (the
+        grouped fan-outs need ``searchsorted`` over them)."""
+        return [
+            (r, idx if idx.dtype != np.bool_ else np.flatnonzero(idx))
+            for r, idx in self._partition(signs)
+        ]
+
+    def lookup_groups(
+        self, groups: Sequence, train: bool
+    ) -> List[np.ndarray]:
+        """Multi-slot lookup: ONE call per replica per batch instead of one
+        per slot (ref: lookup_batched_all_slots issues a single batched
+        future per PS, embedding_worker_service/mod.rs:874-942). ``groups``
+        is ``[(keys, dim), ...]``; returns per-group ``(len(keys), dim)``
+        arrays. Falls back to per-group calls on replicas without a
+        ``lookup_batched`` surface."""
+        if not groups:
+            return []
+        dims = np.fromiter((d for _, d in groups), dtype=np.uint32, count=len(groups))
+        key_ofs = np.zeros(len(groups) + 1, dtype=np.int64)
+        np.cumsum([len(k) for k, _ in groups], out=key_ofs[1:])
+        n = len(self.replicas)
+        if n == 1:
+            r0 = self.replicas[0]
+            if hasattr(r0, "lookup_batched"):
+                all_keys = np.concatenate([k for k, _ in groups]) if len(groups) > 1 \
+                    else np.asarray(groups[0][0])
+                flat = self._with_recovery(
+                    r0, lambda: r0.lookup_batched(all_keys, key_ofs, dims, train)
+                )
+                return _split_flat_rows(flat, key_ofs, dims)
+            return self._concurrent_groups([
+                (lambda k=k, d=d: self._with_recovery(
+                    r0, lambda: r0.lookup(k, d, train)))
+                for k, d in groups
+            ])
+        all_keys = np.concatenate([k for k, _ in groups])
+        outs = [
+            np.zeros((len(k), int(d)), dtype=np.float32) for k, d in groups
+        ]
+        sel = self._partition_positions(all_keys)
+
+        def one_replica(rep, pos):
+            sub_keys = all_keys[pos]
+            sub_ofs = np.searchsorted(pos, key_ofs).astype(np.int64)
+            if hasattr(rep, "lookup_batched"):
+                flat = self._with_recovery(
+                    rep, lambda: rep.lookup_batched(sub_keys, sub_ofs, dims, train)
+                )
+                return sub_ofs, _split_flat_rows(flat, sub_ofs, dims)
+
+            def one_group(g):
+                if sub_ofs[g] == sub_ofs[g + 1]:  # no rows on this replica
+                    return np.empty((0, int(dims[g])), np.float32)
+                return self._with_recovery(
+                    rep,
+                    lambda: rep.lookup(
+                        sub_keys[sub_ofs[g]:sub_ofs[g + 1]], int(dims[g]), train
+                    ),
+                )
+
+            return sub_ofs, self._concurrent_groups(
+                [(lambda g=g: one_group(g)) for g in range(len(groups))]
+            )
+
+        thunks = [
+            (lambda rep=self.replicas[r], pos=pos: one_replica(rep, pos))
+            for r, pos in sel
+        ]
+        for (r, pos), (sub_ofs, rows_list) in zip(sel, self._concurrent(thunks)):
+            for g, rows in enumerate(rows_list):
+                b, e = sub_ofs[g], sub_ofs[g + 1]
+                if b < e:
+                    outs[g][pos[b:e] - key_ofs[g]] = rows
+        return outs
+
+    def update_groups(self, groups: Sequence) -> None:
+        """Multi-slot gradient fan-out: ONE call per replica per gradient
+        batch. ``groups`` is ``[(keys, grads (n, dim) f32, opt_group), ...]``.
+        The caller advances Adam batch state once per batch per opt group
+        first (batch-level beta powers, optim.rs:99-221)."""
+        if not groups:
+            return
+        dims = np.fromiter(
+            (g.shape[1] for _, g, _ in groups), dtype=np.uint32, count=len(groups)
+        )
+        opt_groups = np.fromiter(
+            (og for _, _, og in groups), dtype=np.int32, count=len(groups)
+        )
+        key_ofs = np.zeros(len(groups) + 1, dtype=np.int64)
+        np.cumsum([len(k) for k, _, _ in groups], out=key_ofs[1:])
+        n = len(self.replicas)
+        if n == 1:
+            r0 = self.replicas[0]
+            if hasattr(r0, "update_batched"):
+                all_keys = np.concatenate([k for k, _, _ in groups]) \
+                    if len(groups) > 1 else np.asarray(groups[0][0])
+                flat = np.concatenate([g.reshape(-1) for _, g, _ in groups]) \
+                    if len(groups) > 1 else np.asarray(groups[0][1]).reshape(-1)
+                self._with_recovery(
+                    r0,
+                    lambda: r0.update_batched(all_keys, key_ofs, dims, flat, opt_groups),
+                )
+                return
+            self._concurrent_groups([
+                (lambda k=k, g=g, og=og: self._with_recovery(
+                    r0, lambda: r0.update_gradients(k, g, og)))
+                for k, g, og in groups
+            ])
+            return
+        all_keys = np.concatenate([k for k, _, _ in groups])
+        sel = self._partition_positions(all_keys)
+
+        def one_replica(rep, pos):
+            sub_ofs = np.searchsorted(pos, key_ofs).astype(np.int64)
+            sub_keys = all_keys[pos]
+            subs = [
+                np.ascontiguousarray(
+                    groups[g][1][pos[sub_ofs[g]:sub_ofs[g + 1]] - key_ofs[g]]
+                )
+                for g in range(len(groups))
+            ]
+            if hasattr(rep, "update_batched"):
+                flat = (
+                    np.concatenate([s.reshape(-1) for s in subs])
+                    if subs else np.empty(0, np.float32)
+                )
+                self._with_recovery(
+                    rep,
+                    lambda: rep.update_batched(sub_keys, sub_ofs, dims, flat, opt_groups),
+                )
+                return
+            self._concurrent_groups([
+                (lambda g=g: self._with_recovery(
+                    rep,
+                    lambda: rep.update_gradients(
+                        sub_keys[sub_ofs[g]:sub_ofs[g + 1]], subs[g],
+                        int(opt_groups[g]),
+                    ),
+                ))
+                for g in range(len(groups))
+                if sub_ofs[g] < sub_ofs[g + 1]
+            ])
+
+        self._concurrent([
+            (lambda rep=self.replicas[r], pos=pos: one_replica(rep, pos))
+            for r, pos in sel
+        ])
 
     def lookup(self, keys: np.ndarray, dim: int, train: bool) -> np.ndarray:
         n = len(self.replicas)
@@ -372,6 +539,21 @@ class ShardedLookup:
         ])
 
 
+def _split_flat_rows(
+    flat: np.ndarray, key_ofs: np.ndarray, dims: np.ndarray
+) -> List[np.ndarray]:
+    """Slice a batched-lookup reply (flat f32, groups back to back) into
+    per-group (count, dim) views."""
+    out = []
+    off = 0
+    for g in range(len(dims)):
+        c = int(key_ofs[g + 1] - key_ofs[g])
+        d = int(dims[g])
+        out.append(flat[off:off + c * d].reshape(c, d))
+        off += c * d
+    return out
+
+
 def _distinct_rows(
     slot: ProcessedSlot, lookup: ShardedLookup, train: bool
 ) -> np.ndarray:
@@ -379,17 +561,21 @@ def _distinct_rows(
     rounds (ref: mod.rs:348-400)."""
     dim = slot.config.dim
     rows = lookup.lookup(slot.keys, dim, train)
+    return _sum_hashstack_rounds(slot, rows)
+
+
+def _sum_hashstack_rounds(slot: ProcessedSlot, rows: np.ndarray) -> np.ndarray:
     if slot.rounds > 1:
-        rows = rows.reshape(slot.num_distinct, slot.rounds, dim).sum(axis=1)
+        rows = rows.reshape(slot.num_distinct, slot.rounds, slot.config.dim).sum(axis=1)
     return rows
 
 
-def lookup_slot(
-    slot: ProcessedSlot, lookup: ShardedLookup, train: bool
-) -> FeatureEmbeddingBatch:
-    """Lookup + postprocess one slot (ref: mod.rs:486-629)."""
+def postprocess_slot(slot: ProcessedSlot, rows: np.ndarray) -> FeatureEmbeddingBatch:
+    """Pooling/layout postprocess of one slot's looked-up key rows
+    (ref: mod.rs:486-629). ``rows`` is (len(keys), dim) — hash-stack rounds
+    are summed here."""
     dim = slot.config.dim
-    rows = _distinct_rows(slot, lookup, train)
+    rows = _sum_hashstack_rounds(slot, rows)
     if slot.config.embedding_summation:
         if len(slot.sample_of_id):
             pooled = native_worker.sum_pool(
@@ -419,6 +605,16 @@ def lookup_slot(
     if slot.config.sqrt_scaling:
         rows = rows / np.sqrt(np.maximum(D, 1)).astype(np.float32)
     return RawEmbeddingBatch(slot.name, rows, index, sample_id_num)
+
+
+def lookup_slot(
+    slot: ProcessedSlot, lookup: ShardedLookup, train: bool
+) -> FeatureEmbeddingBatch:
+    """Lookup + postprocess one slot (ref: mod.rs:486-629). The batched
+    multi-slot path (``EmbeddingWorker.forward_batch_id``) fetches all
+    slots' rows in one router call and postprocesses each; this per-slot
+    form remains for single-slot callers."""
+    return postprocess_slot(slot, lookup.lookup(slot.keys, slot.config.dim, train))
 
 
 def slot_gradient_to_keys(
@@ -503,12 +699,14 @@ class EmbeddingWorker:
         # DataLoader's concurrent lookup/backward threads
         self._buf_lock = threading.Lock()
         # serializes gradient batches so Adam batch-state advance + apply is
-        # atomic per batch (slots within a batch still fan out in parallel)
+        # atomic per batch
         self._grad_lock = threading.Lock()
-        # per-slot parallelism: the native store's ctypes calls release the
-        # GIL, so slot fan-out gets true CPU parallelism (the reference fans
-        # lookups out across tokio tasks, mod.rs:874-942)
-        self._pool = ThreadPoolExecutor(max_workers=max(1, num_threads))
+        # ``num_threads`` is accepted for config compatibility; the slot
+        # fan-out is batched into ONE router call per batch (the reference's
+        # lookup_batched_all_slots, mod.rs:874-942) — a per-slot thread pool
+        # measured as pure overhead on a single-core feeder host, and the
+        # multi-replica fan-out keeps its own pool in ShardedLookup
+        self.num_threads = num_threads
         # worker-tier observability (ref: emb_worker metrics, mod.rs:49-105,
         # + distinct-id monitor, monitor.rs:29-114)
         m = get_metrics()
@@ -634,9 +832,11 @@ class EmbeddingWorker:
         )
         total = distinct = 0
         for slot in processed.slots:
-            self.monitor.observe(slot.name, slot.distinct)
             total += len(slot.inverse)
             distinct += slot.num_distinct
+        self.monitor.observe_many(
+            [(slot.name, slot.distinct) for slot in processed.slots]
+        )
         if total:
             self._m_unique_rate.set(distinct / total)
         with self._buf_lock:
@@ -659,11 +859,7 @@ class EmbeddingWorker:
                 f"forward id {ref} not found (expired or already consumed)"
             )
         with self._m_lookup_time.time():
-            out = list(
-                self._pool.map(
-                    lambda s: lookup_slot(s, self.lookup_router, train), processed.slots
-                )
-            )
+            out = self._lookup_slots(processed.slots, train)
         if train:
             with self._buf_lock:
                 self.post_forward_buffer[ref] = processed
@@ -671,14 +867,23 @@ class EmbeddingWorker:
                 self._m_staleness.set(self.staleness)
         return out
 
+    def _lookup_slots(
+        self, slots: Sequence[ProcessedSlot], train: bool
+    ) -> List[FeatureEmbeddingBatch]:
+        """All slots' lookups in ONE batched router call, then per-slot
+        postprocess (pooling is vectorized numpy/native — parallelism across
+        slots bought nothing once the store call count collapsed to one)."""
+        rows_list = self.lookup_router.lookup_groups(
+            [(s.keys, s.config.dim) for s in slots], train
+        )
+        return [postprocess_slot(s, rows) for s, rows in zip(slots, rows_list)]
+
     def forward_directly(
         self, batch: PersiaBatch, train: bool = False
     ) -> List[FeatureEmbeddingBatch]:
         """Lookup-direct path for eval/infer (ref: mod.rs:1076-1107)."""
         processed = preprocess_batch(batch.id_type_features, self.embedding_config)
-        return list(
-            self._pool.map(lambda s: lookup_slot(s, self.lookup_router, train), processed.slots)
-        )
+        return self._lookup_slots(processed.slots, train)
 
     def abort_gradient(self, ref: int) -> None:
         """Drop a stashed post-forward batch without applying gradients (the
@@ -706,21 +911,10 @@ class EmbeddingWorker:
                 "(already updated, aborted, or never forwarded)"
             )
         skipped = {}
-
-        def one_slot(slot):
-            grad = slot_grads.get(slot.name)
-            if grad is None:
-                return None
-            per_key = slot_gradient_to_keys(slot, grad, scale_factor)
-            if per_key is None:
-                return slot.name
-            group = self.embedding_config.group_of(slot.name)
-            self.lookup_router.update(slot.keys, per_key, group)
-            return None
-
         # gradient batches are serialized so the Adam batch-state advance is
         # atomic with its batch's updates (ref: batch-level beta powers,
-        # optim.rs:99-221); slots within the batch still fan out in parallel
+        # optim.rs:99-221); the per-slot conversions then ship as ONE
+        # batched router call per replica
         with self._m_update_time.time(), self._grad_lock:
             groups = {
                 self.embedding_config.group_of(s.name)
@@ -729,9 +923,19 @@ class EmbeddingWorker:
             }
             for g in sorted(groups):
                 self.lookup_router.advance_batch_state(g)
-            for name in self._pool.map(one_slot, processed.slots):
-                if name is not None:
-                    skipped[name] = 1
+            trip = []
+            for slot in processed.slots:
+                grad = slot_grads.get(slot.name)
+                if grad is None:
+                    continue
+                per_key = slot_gradient_to_keys(slot, grad, scale_factor)
+                if per_key is None:
+                    skipped[slot.name] = 1
+                    continue
+                trip.append(
+                    (slot.keys, per_key, self.embedding_config.group_of(slot.name))
+                )
+            self.lookup_router.update_groups(trip)
         if skipped:
             self._m_nan_skipped.inc(len(skipped))
         return skipped

@@ -332,3 +332,54 @@ def test_sharded_fanout_is_concurrent():
     warm, vals = router.probe_entries(signs, 8)
     assert time.perf_counter() - t0 < 3 * SlowStore.DELAY
     assert warm.all()  # checkout admitted everything
+
+
+def test_lookup_groups_multi_replica_matches_single():
+    """The grouped multi-replica reassembly (searchsorted sub-offsets +
+    scatter-merge) must agree with a 1-replica batched call and with
+    per-group single lookups — mixed dims, duplicate signs, an empty group,
+    both via the batched replica surface and the per-group fallback."""
+    rng = np.random.default_rng(7)
+    groups = [
+        (rng.integers(0, 3000, 500, dtype=np.uint64), 8),
+        (np.empty(0, dtype=np.uint64), 16),
+        (rng.integers(0, 3000, 700, dtype=np.uint64), 16),
+    ]
+
+    def run(n_replicas, strip_batched):
+        stores = [
+            EmbeddingStore(
+                capacity=65536, num_internal_shards=2,
+                optimizer=SGD(lr=0.5).config, seed=3,
+            )
+            for _ in range(n_replicas)
+        ]
+        if strip_batched:
+            class NoBatch:
+                def __init__(self, s):
+                    self._s = s
+
+                def __getattr__(self, name):
+                    if name in ("lookup_batched", "update_batched"):
+                        raise AttributeError(name)
+                    return getattr(self._s, name)
+
+            stores = [NoBatch(s) for s in stores]
+        router = ShardedLookup(stores)
+        rows = router.lookup_groups(groups, train=True)
+        grads = [
+            np.full((len(k), d), 0.25, dtype=np.float32) for k, d in groups
+        ]
+        router.update_groups(
+            [(k, g, i % 2) for (k, d), g, i in zip(groups, grads, range(3))]
+        )
+        after = router.lookup_groups(groups, train=False)
+        return rows, after
+
+    base_rows, base_after = run(1, strip_batched=False)
+    for n, strip in ((3, False), (3, True), (1, True)):
+        rows, after = run(n, strip)
+        for a, b in zip(base_rows, rows):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+        for a, b in zip(base_after, after):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
