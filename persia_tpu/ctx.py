@@ -23,12 +23,14 @@ from persia_tpu.config import EmbeddingConfig, HyperParameters, JobType
 from persia_tpu.data import PersiaBatch
 from persia_tpu.embedding.optim import SGD as SparseSGD
 from persia_tpu.embedding.worker import (
+    DevicePooledBatch,
     EmbeddingWorker,
     FeatureEmbeddingBatch,
     RawEmbeddingBatch,
     SumEmbeddingBatch,
 )
 from persia_tpu.logger import get_default_logger
+from persia_tpu.utils import round_up_pow2 as _round_up_pow2
 from persia_tpu.parallel.train_step import (
     TrainState,
     build_eval_step,
@@ -45,11 +47,15 @@ from persia_tpu.parallel.train_step import (
 logger = get_default_logger("persia_tpu.ctx")
 
 
-def _round_up_pow2(n: int, floor: int = 8) -> int:
-    p = floor
-    while p < n:
-        p <<= 1
-    return p
+def _pad_bucket(n: int) -> int:
+    """Padded-distinct bucket: pow2 below 512, then 512-quantum — the
+    gradient buffer rides the (slow) device→host wire, so past the small
+    sizes pow2's up-to-2x padding waste costs real link time. Production
+    zipf streams concentrate distinct counts tightly, so the quantum still
+    yields a near-constant step signature."""
+    if n <= 512:
+        return _round_up_pow2(n)
+    return -(-n // 512) * 512
 
 
 def stage_embeddings(
@@ -58,19 +64,49 @@ def stage_embeddings(
 ) -> Tuple[List[Dict], List[Optional[int]]]:
     """Convert worker outputs into the device batch's ``emb`` entries.
 
-    Raw slots: distinct rows are padded to a power-of-two bucket (static
-    shapes for jit — a bounded set of compiled programs instead of one per
-    distinct-count) with one extra zero row absorbing padded index entries.
-    Returns (emb_entries, true_distinct_counts) — counts are None for pooled
-    slots and are used to slice padding off the returned gradients.
+    Raw and device-pooled slots: distinct rows are padded to a bucketed
+    size (static shapes for jit — a bounded set of compiled programs
+    instead of one per distinct-count) with zero rows absorbing padded
+    index entries. Device-pooled slots share ONE bucket (the max) so the
+    step signature stays stable across batches; their index pad keeps
+    pointing at row D (a zero row), and pad gradients land on rows the
+    host slices off. Returns (emb_entries, true_distinct_counts) — counts
+    are None for host-pooled slots and are used to slice padding off the
+    returned gradients.
     """
     entries: List[Dict] = []
     counts: List[Optional[int]] = []
+    shared_p = 0
+    for eb in emb_batches:
+        if isinstance(eb, DevicePooledBatch):
+            shared_p = max(shared_p, eb.distinct.shape[0] + 1)
+    if shared_p:
+        shared_p = _pad_bucket(shared_p)
     for eb in emb_batches:
         if isinstance(eb, SumEmbeddingBatch):
             pooled = eb.pooled if dtype is None else eb.pooled.astype(dtype)
             entries.append({"pooled": pooled})
             counts.append(None)
+        elif isinstance(eb, DevicePooledBatch):
+            d, dim = eb.distinct.shape
+            padded = np.zeros(
+                (shared_p, dim),
+                dtype=eb.distinct.dtype if dtype is None else dtype,
+            )
+            padded[:d] = eb.distinct
+            # uint16 indexes when the padded table allows: the index matrix
+            # rides host→device every batch (cast back on device, fused free)
+            idx_dtype = np.uint16 if shared_p <= 0xFFFF else np.int32
+            entry = {
+                "distinct": padded,
+                "pool_index": np.ascontiguousarray(eb.index, dtype=idx_dtype),
+            }
+            if eb.sqrt_scaling:
+                # 2-D int column (packs with the index matrices on the mesh
+                # staging path); rsqrt happens on device in f32
+                entry["pool_counts"] = eb.counts.reshape(-1, 1).astype(np.int32)
+            entries.append(entry)
+            counts.append(d)
         else:
             d, dim = eb.distinct.shape
             p = _round_up_pow2(d + 1)

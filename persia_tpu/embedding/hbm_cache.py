@@ -62,6 +62,7 @@ from persia_tpu.embedding.worker import (
     preprocess_batch,
 )
 from persia_tpu.logger import get_default_logger
+from persia_tpu.utils import round_up_pow2 as _round_up_pow2
 from persia_tpu.metrics import get_metrics
 from persia_tpu.ops.sparse_update import sparse_update
 from persia_tpu.tracing import span
@@ -357,11 +358,16 @@ class CacheGroup:
         return self.pooled_slots + self.raw_slots
 
 
-def _round_up_pow2(n: int, floor: int = 8) -> int:
-    p = floor
-    while p < n:
-        p <<= 1
-    return p
+def _lazy_pool(existing, prefix: str, workers: int = 8):
+    """Idempotent daemon ThreadPoolExecutor creation (shared by the tier's
+    chunking pool and the stream's fetch pool)."""
+    if existing is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        existing = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix=prefix
+        )
+    return existing
 
 
 def make_cache_groups(
@@ -1006,6 +1012,14 @@ class CachedEmbeddingTier:
     # worker's thread pool (the native store releases the GIL; its internal
     # shard mutexes make disjoint chunks near-contention-free)
     _PAR_CHUNK = 8192
+    _chunk_pool_obj = None
+
+    def _chunk_pool(self):
+        """Pool for chunking big host store calls (probe/write-back): ctypes
+        store calls release the GIL, so chunks get real parallelism on
+        multi-core feeder hosts. Daemon threads; lives with the tier."""
+        self._chunk_pool_obj = _lazy_pool(self._chunk_pool_obj, "cache-chunk")
+        return self._chunk_pool_obj
 
     def _probe(self, signs: np.ndarray, dim: int):
         """Chunk-parallel warm/cold probe across the worker's thread pool.
@@ -1020,11 +1034,11 @@ class CachedEmbeddingTier:
             ("probe_vals", entry_len), (nb, entry_len), np.float32
         )[:n]
         warm8 = self._ring.get("probe_warm", (nb,), np.uint8)[:n]
-        pool = getattr(self.worker, "_pool", None)
-        if pool is None or n <= self._PAR_CHUNK:
+        if n <= self._PAR_CHUNK:
             return self.router.probe_entries(
                 signs, dim, vals_out=vals, warm_out=warm8
             )
+        pool = self._chunk_pool()
         bounds = list(range(0, n, self._PAR_CHUNK)) + [n]
 
         def chunk(se):
@@ -1038,12 +1052,12 @@ class CachedEmbeddingTier:
 
     def _set_embedding(self, signs: np.ndarray, values: np.ndarray, dim: int) -> None:
         n = len(signs)
-        pool = getattr(self.worker, "_pool", None)
-        if pool is None or n <= self._PAR_CHUNK:
+        if n <= self._PAR_CHUNK:
             self.router.set_embedding(
                 signs, values, dim=dim, commit_incremental=True
             )
             return
+        pool = self._chunk_pool()
         bounds = list(range(0, n, self._PAR_CHUNK)) + [n]
         list(
             pool.map(
@@ -1597,8 +1611,19 @@ class CachedTrainCtx:
             max_scale=loss_scale_max,
         )
         self._eval = build_cached_eval_step(model, self.tier.groups)
+        # forward-side ps wire: stage PS-tier entries in the same reduced
+        # dtype the gradients return in (host->device rows are the other
+        # half of the PS tier's link bill)
+        self._ps_stage_dtype = (
+            np.dtype("bfloat16") if ps_wire_dtype == "bfloat16" else None
+        )
         self.table_dtype = table_dtype
         self.state: Optional[CachedTrainState] = None
+        # concurrent device->host gradient/eviction fetch pool for the
+        # stream's write-back thread: each fetch pays the full link
+        # round-trip, so batched fetches MUST overlap (a serial loop is
+        # latency x count)
+        self._fetch_pool_obj = None
         # deferred write-back: (evict_meta, device payload, device header,
         # label shape) of the most recent dispatched step
         self._pending = None
@@ -1698,6 +1723,12 @@ class CachedTrainCtx:
             self._land_pending()  # after landing, the PS probe sees them warm
         return None
 
+    def _fetch_pool(self):
+        """Pool for CONCURRENT device→host fetches in the stream's
+        write-back thread (each fetch pays a full link round-trip)."""
+        self._fetch_pool_obj = _lazy_pool(self._fetch_pool_obj, "cache-fetch")
+        return self._fetch_pool_obj
+
     def _replicated(self):
         if self.mesh is None:
             return None
@@ -1742,6 +1773,14 @@ class CachedTrainCtx:
             for e in device_inputs["ps_emb"]:
                 if "pooled" in e:
                     ps.append({"pooled": jax.device_put(e["pooled"], bsh)})
+                elif "pool_index" in e:  # device-pooled sum slot
+                    entry = {
+                        "distinct": jax.device_put(e["distinct"], rep),
+                        "pool_index": jax.device_put(e["pool_index"], bsh),
+                    }
+                    if "pool_counts" in e:
+                        entry["pool_counts"] = jax.device_put(e["pool_counts"], bsh)
+                    ps.append(entry)
                 else:
                     ps.append({
                         "distinct": jax.device_put(e["distinct"], rep),
@@ -1831,7 +1870,7 @@ class CachedTrainCtx:
         ref = self.worker.put_forward_ids(PersiaBatch(ps_feats, requires_grad=False))
         try:
             embs = self.worker.forward_batch_id(ref, train=True)
-            entries, counts = stage_embeddings(embs)
+            entries, counts = stage_embeddings(embs, dtype=self._ps_stage_dtype)
         except BaseException:
             self.worker.abort_gradient(ref)
             raise
@@ -2226,7 +2265,7 @@ class CachedTrainCtx:
                 _flush_acc_inner(acc)
 
         def _flush_acc_inner(acc) -> None:
-            pool = getattr(self.tier.worker, "_pool", None)
+            pool = self._fetch_pool()
             fetches = []  # (seq, gname, k, device payload)
             for seq, evict_meta, evict_payload in acc:
                 for gn, (ev, k) in evict_meta.items():
@@ -2272,7 +2311,7 @@ class CachedTrainCtx:
             its own concurrent-fetch batching."""
             if not ps_acc:
                 return
-            pool = getattr(self.tier.worker, "_pool", None)
+            pool = self._fetch_pool()
 
             def fetch(it):
                 return np.asarray(it[2])

@@ -66,6 +66,25 @@ def _embedding_model_inputs(emb_diff: List, emb_static: List) -> List:
     for diff, static in zip(emb_diff, emb_static):
         if static is None:  # pooled slot: diff IS the (B, dim) array
             out.append(diff)
+        elif len(static) == 3:  # ("pool", index, counts) — raw statics are
+            # 2-tuples; don't compare static[0] to a string (it may be a
+            # numpy index array, where == broadcasts)
+            # device-pooled sum slot: gather + sum (+ sqrt scaling) inside
+            # the diff'ed function, so autodiff returns per-DISTINCT
+            # gradients — the TPU-side replacement for worker sum pooling
+            # (mod.rs:486-629); index pads point at zero rows past D
+            _, index, pool_counts = static
+            if index.dtype != jnp.int32:  # uint16 wire → device-side cast
+                index = index.astype(jnp.int32)
+            # accumulate in f32 even on a bf16 wire (the host pool summed
+            # in f32 too); (B, L, dim) → (B, dim)
+            pooled = diff[index].astype(jnp.float32).sum(axis=1)
+            if pool_counts is not None:
+                scale = jax.lax.rsqrt(
+                    jnp.maximum(pool_counts[:, 0], 1).astype(jnp.float32)
+                )
+                pooled = pooled * scale[:, None]
+            out.append(pooled)
         else:  # raw slot: gather inside the diff'ed function → autodiff scatter
             index, mask = static
             gathered = diff[index]  # (B, L, dim)
@@ -79,6 +98,9 @@ def _split_emb(emb: List[Dict]) -> Tuple[List, List]:
         if "pooled" in e:
             diff.append(e["pooled"])
             static.append(None)
+        elif "pool_index" in e:
+            diff.append(e["distinct"])
+            static.append(("pool", e["pool_index"], e.get("pool_counts")))
         else:
             diff.append(e["distinct"])
             static.append((e["index"], e["mask"]))
@@ -364,13 +386,24 @@ def shard_device_batch(batch: Dict, mesh=None) -> Dict:
     for j, x in enumerate(batch["labels"]):
         bdim_float.append(("labels", j, np.asarray(x)))
     raw_distinct: List[Tuple[int, np.ndarray]] = []
-    index_mats: List[Tuple[int, np.ndarray]] = []
+    index_mats: List[Tuple[Tuple[str, int], np.ndarray]] = []
     for i, e in enumerate(batch["emb"]):
         if "pooled" in e:
             bdim_float.append(("emb", i, np.asarray(e["pooled"])))
+        elif "pool_index" in e:
+            raw_distinct.append((i, np.asarray(e["distinct"])))
+            index_mats.append(
+                (("idx", i), np.ascontiguousarray(e["pool_index"]))
+            )
+            if "pool_counts" in e:
+                index_mats.append(
+                    (("cnt", i), np.ascontiguousarray(e["pool_counts"], dtype=np.int32))
+                )
         else:
             raw_distinct.append((i, np.asarray(e["distinct"])))
-            index_mats.append((i, np.ascontiguousarray(e["index"], dtype=np.int32)))
+            index_mats.append(
+                (("idx", i), np.ascontiguousarray(e["index"], dtype=np.int32))
+            )
 
     def _packed_groups(leaves, axis, sharding):
         """One device_put per (dtype, off-axis width) group of 2-D leaves;
@@ -410,8 +443,13 @@ def shard_device_batch(batch: Dict, mesh=None) -> Dict:
     for i, e in enumerate(batch["emb"]):
         if "pooled" in e:
             out["emb"].append({"pooled": fviews[("emb", i)]})
+        elif "pool_index" in e:
+            entry = {"distinct": dviews[i], "pool_index": iviews[("idx", i)]}
+            if "pool_counts" in e:
+                entry["pool_counts"] = iviews[("cnt", i)]
+            out["emb"].append(entry)
         else:
-            idx = iviews[i]
+            idx = iviews[("idx", i)]
             p = e["distinct"].shape[0]
             out["emb"].append(
                 {

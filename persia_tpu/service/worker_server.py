@@ -140,6 +140,12 @@ def main(argv: Optional[list] = None) -> None:
     ap.add_argument("--embedding-config", type=str, default=None)
     ap.add_argument("--global-config", type=str, default=None)
     ap.add_argument("--num-threads", type=int, default=8)
+    ap.add_argument("--ps-wire-dtype", type=str, default="float32",
+                    choices=["float32", "float16", "bfloat16"],
+                    help="batched lookup/update wire dtype toward the PS tier "
+                         "(reference parity: f16 embedding/gradient wire)")
+    ap.add_argument("--device-pooling", action="store_true",
+                    help="ship sum slots unpooled (distinct rows + gather layout) so pooling runs on the trainer's device")
     args = ap.parse_args(argv)
 
     from persia_tpu import env
@@ -167,12 +173,13 @@ def main(argv: Optional[list] = None) -> None:
 
     coord = CoordinatorClient(args.coordinator)
     ps_addrs = coord.wait_for("parameter_server", args.num_parameter_servers)
-    replicas = [StoreClient(a) for a in ps_addrs]
+    replicas = [StoreClient(a, wire_dtype=args.ps_wire_dtype) for a in ps_addrs]
     for r in replicas:
         r.wait_ready()
 
     worker = EmbeddingWorker(
-        emb_cfg, replicas, num_threads=args.num_threads, **worker_kwargs
+        emb_cfg, replicas, num_threads=args.num_threads,
+        device_pooling=args.device_pooling, **worker_kwargs
     )
     svc = EmbeddingWorkerService(worker, port=args.port).start()
     logger.info(

@@ -22,6 +22,15 @@ def setup_seed(seed: int) -> None:
     np.random.seed(seed)
 
 
+def round_up_pow2(n: int, floor: int = 8) -> int:
+    """Smallest power of two >= n (>= floor) — the shared shape-bucketing
+    primitive (static jit shapes from dynamic counts)."""
+    p = floor
+    while p < n:
+        p <<= 1
+    return p
+
+
 def load_yaml(path: str) -> Dict[str, Any]:
     with open(path) as f:
         return yaml.safe_load(f) or {}

@@ -7,6 +7,7 @@ training. Assertions: (a) test AUC clears a quality bar, (b) two fresh runs
 produce bit-identical AUC (full-pipeline determinism)."""
 
 import jax
+import pytest
 import numpy as np
 import optax
 
@@ -69,3 +70,77 @@ def test_e2e_sharded_ps_same_quality():
     auc3 = _run_once(num_replicas=3)
     assert auc3 > 0.82, f"sharded AUC too low: {auc3}"
     assert auc3 == _run_once(num_replicas=1)
+
+
+def _pooling_run(device_pooling: bool, sqrt_scaling: bool, steps: int = 12):
+    """Short train on a multi-id LIL stream; returns (losses, final rows)."""
+    from persia_tpu.config import HashStackConfig
+    from persia_tpu.data import IDTypeFeature, Label, NonIDTypeFeature, PersiaBatch
+
+    cfg = EmbeddingConfig(
+        slots_config={
+            "multi": SlotConfig(dim=8, sqrt_scaling=sqrt_scaling),
+            "single": SlotConfig(dim=8),
+            "hs": SlotConfig(
+                dim=8,
+                hash_stack_config=HashStackConfig(
+                    hash_stack_rounds=2, embedding_size=40
+                ),
+            ),
+        },
+        feature_index_prefix_bit=8,
+    )
+    store = EmbeddingStore(
+        capacity=1 << 16, num_internal_shards=4,
+        optimizer=Adagrad(lr=0.1).config, seed=7,
+    )
+    worker = EmbeddingWorker(cfg, [store], device_pooling=device_pooling)
+    rng = np.random.default_rng(3)
+
+    def make_batch(i):
+        r = np.random.default_rng(100 + i)
+        multi = [
+            r.integers(0, 50, r.integers(0, 5), dtype=np.uint64) for _ in range(32)
+        ]
+        single = [r.integers(0, 80, 1, dtype=np.uint64) for _ in range(32)]
+        hs = [r.integers(0, 999, 2, dtype=np.uint64) for _ in range(32)]
+        dense = r.normal(size=(32, 4)).astype(np.float32)
+        labels = (dense.sum(axis=1, keepdims=True) > 0).astype(np.float32)
+        return PersiaBatch(
+            [IDTypeFeature("multi", multi), IDTypeFeature("single", single),
+             IDTypeFeature("hs", hs)],
+            non_id_type_features=[NonIDTypeFeature(dense)],
+            labels=[Label(labels)],
+            requires_grad=True,
+        )
+
+    losses = []
+    with TrainCtx(
+        model=DNN(dense_mlp_size=8, sparse_mlp_size=32, hidden_sizes=(32,)),
+        dense_optimizer=optax.adam(3e-3),
+        embedding_optimizer=Adagrad(lr=0.1),
+        worker=worker,
+        embedding_config=cfg,
+    ) as ctx:
+        for i in range(steps):
+            losses.append(ctx.train_step(make_batch(i))["loss"])
+    probe = np.arange(50, dtype=np.uint64)
+    rows = store.lookup(
+        np.asarray(
+            [int(s) for s in probe], dtype=np.uint64
+        ), 8, train=False,
+    )
+    return np.asarray(losses), rows
+
+
+@pytest.mark.parametrize("sqrt_scaling", [False, True])
+def test_device_pooling_matches_host_pooling(sqrt_scaling):
+    """Sum-pooling on device (DevicePooledBatch: distinct rows + gather →
+    segment-sum differentiated by XLA) must train the same as the
+    host-pooled path — losses and resulting PS rows agree to fp tolerance
+    (summation order differs, so not bit-exact) across multi-id, single-id
+    and hash-stack slots."""
+    host_losses, host_rows = _pooling_run(False, sqrt_scaling)
+    dev_losses, dev_rows = _pooling_run(True, sqrt_scaling)
+    np.testing.assert_allclose(host_losses, dev_losses, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(host_rows, dev_rows, rtol=2e-4, atol=2e-5)

@@ -1202,3 +1202,42 @@ def test_stream_deep_prefetch_grows_staging_rings():
         assert ctx.tier._ring.depth >= 8 + 4
         for d in ctx.tier.dirs.values():
             assert d._rows_ring.depth >= 8 + 4
+
+
+def test_all_ps_stream_device_pooling_matches_host_pooling():
+    """PS-tier slots with a device_pooling worker ship DevicePooledBatch
+    entries (distinct rows + gather layout) through the cache stream; the
+    staging, step and per-distinct gradient return must train the same as
+    the host-pooled stream (regression: the mesh staging branch and
+    _embedding_model_inputs tag check once only knew pooled/raw layouts)."""
+    import optax
+
+    from persia_tpu.models import DNN
+
+    def run(device_pooling):
+        cfg = _cfg()
+        store = EmbeddingStore(
+            capacity=1 << 16, num_internal_shards=2,
+            optimizer=Adagrad(lr=0.05).config, seed=11,
+        )
+        worker = EmbeddingWorker(cfg, [store], device_pooling=device_pooling)
+        ctx = hbm.CachedTrainCtx(
+            model=DNN(dense_mlp_size=8, sparse_mlp_size=32, hidden_sizes=(32,)),
+            dense_optimizer=optax.sgd(1e-2),
+            embedding_optimizer=Adagrad(lr=0.05),
+            worker=worker,
+            embedding_config=cfg,
+            cache_rows=8,
+            ps_slots=["cat_a", "cat_b", "cat_c"],
+        ).__enter__()
+        m = ctx.train_stream(_batches(8, seed=4), prefetch=2, psgrad_batch=2)
+        assert m is not None and np.isfinite(m["loss"])
+        assert worker.staleness == 0
+        return m["loss"], _store_entries(store, _cfg())
+
+    l_host, e_host = run(False)
+    l_dev, e_dev = run(True)
+    assert np.allclose(l_host, l_dev, rtol=1e-3, atol=1e-4)
+    assert set(e_host) == set(e_dev)
+    for k in e_host:
+        np.testing.assert_allclose(e_host[k], e_dev[k], rtol=1e-4, atol=1e-5)
