@@ -85,6 +85,86 @@ def bench_case(name: str, batch: PersiaBatch, reps: int = 200) -> dict:
     }
 
 
+def bench_ps_wire(batch_size=4096, n_slots=26, dim=16, distinct_per_slot=1360,
+                  reps=50) -> list:
+    """Worker↔PS wire cost per training batch, BEFORE vs AFTER the batched
+    RPC (ref gap the round-3 verdict names: one f32 per-slot request each
+    way vs ONE multi-slot frame with an f16-class dtype + lz4-able ids).
+
+    'before' = 26 × pack_lookup_request / pack_update_request f32 frames
+    (the round-1 wire); 'after' = one pack_lookup_batched_request +
+    pack_update_batched_request in each wire dtype. Bytes are the
+    on-the-wire payload sizes; times are host pack+unpack cost."""
+    from persia_tpu.service import proto
+
+    rng = np.random.default_rng(3)
+    keys = [
+        rng.integers(0, 1 << 40, distinct_per_slot, dtype=np.uint64)
+        for _ in range(n_slots)
+    ]
+    grads = [
+        rng.normal(size=(distinct_per_slot, dim)).astype(np.float32)
+        for _ in range(n_slots)
+    ]
+    key_ofs = np.zeros(n_slots + 1, np.int64)
+    np.cumsum([len(k) for k in keys], out=key_ofs[1:])
+    signs = np.concatenate(keys)
+    dims = np.full(n_slots, dim, np.uint32)
+    ogs = np.zeros(n_slots, np.int32)
+    flat_rows = rng.normal(size=len(signs) * dim).astype(np.float32)
+    flat_grads = np.concatenate([g.reshape(-1) for g in grads])
+
+    out = []
+
+    def run(tag, pack_req, pack_rep):
+        for _ in range(3):
+            pack_req(), pack_rep()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            req = pack_req()
+        t_req = (time.perf_counter() - t0) / reps
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            rep = pack_rep()
+        t_rep = (time.perf_counter() - t0) / reps
+        nb = (
+            sum(memoryview(b).nbytes for b in req)
+            + sum(memoryview(b).nbytes for b in rep)
+        )
+        out.append({
+            "case": f"ps_wire_{tag}",
+            "wire_bytes_per_batch": nb,
+            "host_pack_us": round((t_req + t_rep) * 1e6, 1),
+        })
+
+    # round-1 shape: one f32 frame per slot each way
+    run(
+        "before_per_slot_f32",
+        lambda: [proto.pack_lookup_request(k, dim, True) for k in keys],
+        lambda: (
+            [flat_rows.tobytes()]
+            + [proto.pack_update_request(k, g, 0) for k, g in zip(keys, grads)]
+        ),
+    )
+    for wd in (None, "float16", "bfloat16"):
+        tag = wd or "float32"
+        run(
+            f"after_batched_{tag}",
+            lambda wd=wd: (
+                proto.pack_lookup_batched_request(
+                    signs, key_ofs, dims, True, reply_dtype=wd
+                )
+                + proto.pack_update_batched_request(
+                    signs, key_ofs, dims, flat_grads, ogs, wire_dtype=wd
+                )
+            ),
+            lambda wd=wd: proto.pack_lookup_batched_reply(
+                flat_rows, proto.wire_dtype_code(wd)
+            ),
+        )
+    return out
+
+
 def main() -> None:
     for name, batch in (
         ("infer_single_id_128x16", _single_id_batch()),
@@ -92,6 +172,8 @@ def main() -> None:
         ("infer_single_id_4096x26", _single_id_batch(4096, 26)),
     ):
         print(json.dumps(bench_case(name, batch)))
+    for row in bench_ps_wire():
+        print(json.dumps(row))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,180 @@
+// persia_tpu native wire codec: an LZ4-block-format compressor/decompressor.
+//
+// Capability parity with the reference's RPC compression — lz4 FAST(3) on
+// large frame bodies (`/root/reference/rust/others/persia-rpc/src/lib.rs:
+// 68-145`). zlib (the round-1 fallback) is ~20x too slow to sit on the
+// per-batch lookup/gradient path, so large frames effectively travelled
+// uncompressed; this is the lz4-class replacement. The block FORMAT is the
+// public LZ4 spec (token | literals | 2-byte LE offset | match-extension),
+// so the bytes are interoperable with any standard lz4 block decoder; the
+// implementation here is our own single-pass greedy matcher over a 4-byte
+// hash window.
+//
+// C ABI only (ctypes-friendly).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int MINMATCH = 4;
+// spec constraints: the last match must end >= 12 bytes before the block
+// end and the last 5 bytes are always literals
+constexpr int64_t MFLIMIT = 12;
+constexpr int64_t LASTLITERALS = 5;
+
+inline uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint32_t hash4(uint32_t v) {
+  return (v * 2654435761u) >> 18;  // 14-bit table
+}
+
+constexpr uint32_t HASH_SIZE = 1u << 14;
+
+}  // namespace
+
+extern "C" {
+
+int64_t lz4_compress_bound(int64_t n) { return n + n / 255 + 16; }
+
+// Compress src[0..n) into dst (capacity cap). Returns compressed size, or
+// -1 if dst is too small (use lz4_compress_bound).
+int64_t lz4_compress(const uint8_t* src, int64_t n, uint8_t* dst, int64_t cap) {
+  if (n < 0 || cap < lz4_compress_bound(n)) return -1;
+  uint8_t* op = dst;
+  const uint8_t* ip = src;
+  const uint8_t* const iend = src + n;
+  const uint8_t* anchor = ip;
+
+  if (n >= MFLIMIT + MINMATCH) {
+    const uint8_t* const mflimit = iend - MFLIMIT;
+    int32_t table[HASH_SIZE];
+    std::memset(table, -1, sizeof(table));
+
+    while (ip < mflimit) {
+      // find a 4-byte match via the hash table
+      const uint32_t seq = read32(ip);
+      const uint32_t h = hash4(seq);
+      const int32_t cand = table[h];
+      table[h] = (int32_t)(ip - src);
+      if (cand < 0 || (ip - src) - cand > 0xFFFF ||
+          read32(src + cand) != seq) {
+        ++ip;
+        continue;
+      }
+      const uint8_t* match = src + cand;
+      // extend the match forward (stay clear of the tail literals zone)
+      const uint8_t* const matchlimit = iend - LASTLITERALS;
+      const uint8_t* mip = ip + MINMATCH;
+      const uint8_t* mma = match + MINMATCH;
+      while (mip < matchlimit && *mip == *mma) { ++mip; ++mma; }
+      const int64_t mlen = mip - ip - MINMATCH;  // spec: stored as len-4
+      const int64_t litlen = ip - anchor;
+
+      // token
+      uint8_t* token = op++;
+      *token = 0;
+      if (litlen >= 15) {
+        *token = 15u << 4;
+        int64_t rest = litlen - 15;
+        while (rest >= 255) { *op++ = 255; rest -= 255; }
+        *op++ = (uint8_t)rest;
+      } else {
+        *token = (uint8_t)(litlen << 4);
+      }
+      std::memcpy(op, anchor, (size_t)litlen);
+      op += litlen;
+      // offset
+      const uint16_t off = (uint16_t)(ip - match);
+      *op++ = (uint8_t)off;
+      *op++ = (uint8_t)(off >> 8);
+      // match length
+      if (mlen >= 15) {
+        *token |= 15;
+        int64_t rest = mlen - 15;
+        while (rest >= 255) { *op++ = 255; rest -= 255; }
+        *op++ = (uint8_t)rest;
+      } else {
+        *token |= (uint8_t)mlen;
+      }
+      ip = mip;
+      anchor = ip;
+      // seed the table inside the match region sparsely (keeps the scan
+      // O(n) while still catching repeats that start mid-match)
+      if (ip < mflimit) table[hash4(read32(ip - 2))] = (int32_t)(ip - 2 - src);
+    }
+  }
+
+  // trailing literals
+  const int64_t litlen = iend - anchor;
+  uint8_t* token = op++;
+  if (litlen >= 15) {
+    *token = 15u << 4;
+    int64_t rest = litlen - 15;
+    while (rest >= 255) { *op++ = 255; rest -= 255; }
+    *op++ = (uint8_t)rest;
+  } else {
+    *token = (uint8_t)(litlen << 4);
+  }
+  std::memcpy(op, anchor, (size_t)litlen);
+  op += litlen;
+  return op - dst;
+}
+
+// Decompress src[0..n) into dst (exact capacity cap = original size).
+// Returns decompressed size, or -1 on malformed/overflowing input.
+int64_t lz4_decompress(const uint8_t* src, int64_t n, uint8_t* dst, int64_t cap) {
+  const uint8_t* ip = src;
+  const uint8_t* const iend = src + n;
+  uint8_t* op = dst;
+  uint8_t* const oend = dst + cap;
+
+  while (ip < iend) {
+    const uint8_t token = *ip++;
+    // literals
+    int64_t litlen = token >> 4;
+    if (litlen == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return -1;
+        b = *ip++;
+        litlen += b;
+      } while (b == 255);
+    }
+    if (ip + litlen > iend || op + litlen > oend) return -1;
+    std::memcpy(op, ip, (size_t)litlen);
+    ip += litlen;
+    op += litlen;
+    if (ip >= iend) break;  // last sequence has no match part
+    // match
+    if (ip + 2 > iend) return -1;
+    const uint16_t off = (uint16_t)(ip[0] | (ip[1] << 8));
+    ip += 2;
+    if (off == 0 || op - dst < off) return -1;
+    int64_t mlen = (token & 15) + MINMATCH;
+    if ((token & 15) == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return -1;
+        b = *ip++;
+        mlen += b;
+      } while (b == 255);
+    }
+    if (op + mlen > oend) return -1;
+    const uint8_t* match = op - off;
+    if (off >= mlen) {
+      std::memcpy(op, match, (size_t)mlen);
+      op += mlen;
+    } else {
+      // overlapping copy (run-length style) must go byte-wise
+      while (mlen--) *op++ = *match++;
+    }
+  }
+  return op - dst;
+}
+
+}  // extern "C"

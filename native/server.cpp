@@ -1,0 +1,587 @@
+// persia_tpu native RPC server: the parameter-server data plane in C++.
+//
+// Capability parity with the reference's compiled service stack — hyper
+// HTTP + speedy zero-copy bodies + optional lz4 serving tokio services
+// (`/root/reference/rust/others/persia-rpc/src/lib.rs:68-145`,
+// `persia-embedding-server/src/bin/persia-embedding-parameter-server.rs`).
+// The round-1 Python socketserver stays as the control plane; this server
+// owns the listener and handles the HOT methods (ping / lookup_batched /
+// update_batched) entirely in C++ threads — frame parse, dispatch, store
+// call (via dlopen'd libpersia_ps.so), wire-dtype conversion, optional lz4
+// reply compression, writev reply — so per-batch traffic never takes the
+// GIL. Unknown methods bounce to a registered Python callback (ctypes
+// acquires the GIL for us), which serves checkpoints/config/admin exactly
+// as before.
+//
+// Framing (shared with persia_tpu/service/rpc.py):
+//   request:  u32 total | u8 flags | u16 mlen | method | payload
+//             flags bits 0-1: codec (0 none, 1 zlib*, 2 lz4); bit 7:
+//             client accepts compressed replies   (*zlib → Python fallback)
+//   reply:    u32 total | u8 status (low nibble 0 ok/1 err; high: codec) | payload
+//
+// Batched message bodies (persia_tpu/service/proto.py):
+//   lookup_batched:  u8 train | u8 dtype_code | u16 n | u32 dims[n]
+//                    | i64 key_ofs[n+1] | u64 signs[...]
+//     reply: rows in dtype_code (0 f32, 1 f16, 2 bf16)
+//   update_batched:  u8 dtype_code | u16 n | u32 dims[n] | i32 opt_groups[n]
+//                    | i64 key_ofs[n+1] | u64 signs | grads in dtype_code
+
+#include <arpa/inet.h>
+#include <dlfcn.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+int64_t lz4_compress_bound(int64_t n);
+int64_t lz4_compress(const uint8_t* src, int64_t n, uint8_t* dst, int64_t cap);
+int64_t lz4_decompress(const uint8_t* src, int64_t n, uint8_t* dst, int64_t cap);
+}
+
+namespace {
+
+// ---------------------------------------------------------- wire dtypes
+
+inline uint16_t f32_to_f16_bits(float f) {
+  uint32_t x;
+  std::memcpy(&x, &f, 4);
+  const uint32_t sign = (x >> 16) & 0x8000u;
+  uint32_t absx = x & 0x7FFFFFFFu;
+  if (absx >= 0x7F800000u) {  // inf/nan
+    return (uint16_t)(sign | 0x7C00u | (absx > 0x7F800000u ? 0x200u : 0));
+  }
+  if (absx >= 0x477FF000u) return (uint16_t)(sign | 0x7C00u);  // overflow → inf
+  if (absx < 0x38800000u) {  // subnormal / zero
+    if (absx < 0x33000000u) return (uint16_t)sign;
+    const int shift = 126 - (int)(absx >> 23);
+    uint32_t mant = (absx & 0x7FFFFFu) | 0x800000u;
+    const uint32_t rounded = mant >> (shift + 1);
+    const uint32_t rem = mant & ((2u << shift) - 1);
+    const uint32_t half = 1u << shift;
+    uint32_t out = rounded;
+    if (rem > half || (rem == half && (rounded & 1))) ++out;
+    return (uint16_t)(sign | out);
+  }
+  // normal: round to nearest even
+  uint32_t mant = absx + 0xFFFu + ((absx >> 13) & 1u);
+  return (uint16_t)(sign | ((mant - 0x38000000u) >> 13));
+}
+
+inline float f16_bits_to_f32(uint16_t h) {
+  const uint32_t sign = (uint32_t)(h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1F;
+  uint32_t mant = h & 0x3FFu;
+  uint32_t out;
+  if (exp == 0) {
+    if (mant == 0) {
+      out = sign;
+    } else {  // subnormal: normalize
+      int e = -1;
+      do {
+        ++e;
+        mant <<= 1;
+      } while (!(mant & 0x400u));
+      out = sign | ((uint32_t)(113 - e) << 23) | ((mant & 0x3FFu) << 13);
+    }
+  } else if (exp == 31) {
+    out = sign | 0x7F800000u | (mant << 13);
+  } else {
+    out = sign | ((exp + 112) << 23) | (mant << 13);
+  }
+  float f;
+  std::memcpy(&f, &out, 4);
+  return f;
+}
+
+inline uint16_t f32_to_bf16_bits(float f) {
+  uint32_t x;
+  std::memcpy(&x, &f, 4);
+  if ((x & 0x7F800000u) == 0x7F800000u) return (uint16_t)(x >> 16);  // inf/nan
+  // round to nearest even
+  const uint32_t rounding = 0x7FFFu + ((x >> 16) & 1u);
+  return (uint16_t)((x + rounding) >> 16);
+}
+
+inline float bf16_bits_to_f32(uint16_t b) {
+  const uint32_t out = (uint32_t)b << 16;
+  float f;
+  std::memcpy(&f, &out, 4);
+  return f;
+}
+
+void f32_to_wire(const float* src, int64_t n, uint8_t* dst, int code) {
+  uint16_t* d = (uint16_t*)dst;
+  if (code == 1) {
+    for (int64_t i = 0; i < n; ++i) d[i] = f32_to_f16_bits(src[i]);
+  } else {
+    for (int64_t i = 0; i < n; ++i) d[i] = f32_to_bf16_bits(src[i]);
+  }
+}
+
+void wire_to_f32(const uint8_t* src, int64_t n, float* dst, int code) {
+  const uint16_t* s = (const uint16_t*)src;
+  if (code == 1) {
+    for (int64_t i = 0; i < n; ++i) dst[i] = f16_bits_to_f32(s[i]);
+  } else {
+    for (int64_t i = 0; i < n; ++i) dst[i] = bf16_bits_to_f32(s[i]);
+  }
+}
+
+// Wire-supplied group layout: reject anything that could size buffers or
+// offsets negatively (corrupt/hostile frames must error, not scribble).
+bool layout_ok(const int64_t* key_ofs, const uint32_t* dims, int ng,
+               int64_t* total_out) {
+  if (ng < 0 || ng > 0xFFFF) return false;
+  if (ng && key_ofs[0] != 0) return false;
+  int64_t total = 0;
+  for (int g = 0; g < ng; ++g) {
+    if (key_ofs[g + 1] < key_ofs[g]) return false;
+    if (dims[g] == 0 || dims[g] > (1u << 20)) return false;
+    total += (key_ofs[g + 1] - key_ofs[g]) * (int64_t)dims[g];
+    if (total > ((int64_t)1 << 33)) return false;  // > 32 GiB of f32: nonsense
+  }
+  *total_out = total;
+  return true;
+}
+
+// ------------------------------------------------------------- ps symbols
+
+struct PsFns {
+  void (*lookup_batched)(void*, const uint64_t*, const int64_t*, const uint32_t*,
+                         const int64_t*, int32_t, int, float*);
+  int (*update_batched)(void*, const uint64_t*, const int64_t*, const uint32_t*,
+                        const float*, const int64_t*, const int32_t*, int32_t);
+};
+
+// ------------------------------------------------------------- the server
+
+constexpr uint8_t FLAG_CODEC_MASK = 0x03;
+constexpr uint8_t FLAG_REPLY_OK = 0x80;
+constexpr int64_t MAX_FRAME = (int64_t)1 << 31;
+
+struct Server;
+
+// Python fallback: called with (method, payload, len, reply_ctx); Python
+// must invoke net_reply(reply_ctx, status, data, len) before returning.
+typedef void (*FallbackCb)(const char* method, const uint8_t* payload,
+                           int64_t len, void* reply_ctx);
+
+struct ReplyCtx {
+  std::vector<uint8_t> data;
+  int status = 1;
+  bool set = false;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  void* store = nullptr;
+  PsFns ps{};
+  FallbackCb fallback = nullptr;
+  int64_t compress_threshold = 1 << 20;
+  std::atomic<bool> stopping{false};
+  std::thread accept_thread;
+  std::mutex conn_mu;
+  // one slot per accepted connection; `done` flips when its thread is about
+  // to exit, so the accept loop can reap zombies (long-lived servers see
+  // reconnect churn — unjoined threads would accumulate forever)
+  struct ConnSlot {
+    std::thread t;
+    std::atomic<bool> done{false};
+  };
+  std::vector<std::unique_ptr<ConnSlot>> conns;
+  std::vector<int> live_fds;  // open connection sockets (for stop() wakeup)
+
+  void reap_finished() {
+    std::lock_guard<std::mutex> g(conn_mu);
+    for (size_t i = 0; i < conns.size();) {
+      if (conns[i]->done.load(std::memory_order_acquire)) {
+        if (conns[i]->t.joinable()) conns[i]->t.join();
+        conns.erase(conns.begin() + i);
+      } else {
+        ++i;
+      }
+    }
+  }
+
+  void track_fd(int fd, bool add) {
+    std::lock_guard<std::mutex> g(conn_mu);
+    if (add) {
+      live_fds.push_back(fd);
+      // a connection accepted concurrently with stop() missed its wakeup
+      // sweep — unblock it here so the destructor's join can't hang
+      if (stopping.load(std::memory_order_relaxed)) ::shutdown(fd, SHUT_RDWR);
+    } else {
+      for (auto it = live_fds.begin(); it != live_fds.end(); ++it)
+        if (*it == fd) {
+          live_fds.erase(it);
+          break;
+        }
+    }
+  }
+
+  ~Server() { stop(); }
+
+  // Idempotent, and ALWAYS joins: the shutdown RPC handler sets `stopping`
+  // from a connection thread, so stop() must not early-return on the flag
+  // — a joinable std::thread destructing is std::terminate.
+  void stop() {
+    stopping.store(true);
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+      listen_fd = -1;
+    }
+    if (accept_thread.joinable()) accept_thread.join();
+    std::vector<std::unique_ptr<ConnSlot>> local;
+    {
+      std::lock_guard<std::mutex> g(conn_mu);
+      local.swap(conns);
+      // wake connection threads parked in recv (join would hang otherwise);
+      // threads own the close — shutdown only unblocks them
+      for (int fd : live_fds) ::shutdown(fd, SHUT_RDWR);
+    }
+    for (auto& c : local)
+      if (c->t.joinable()) c->t.join();
+  }
+};
+
+bool recv_exact(int fd, uint8_t* buf, int64_t n) {
+  while (n > 0) {
+    ssize_t r = ::recv(fd, buf, (size_t)n, 0);
+    if (r <= 0) return false;
+    buf += r;
+    n -= r;
+  }
+  return true;
+}
+
+bool send_all(int fd, const struct iovec* iov, int iovcnt) {
+  struct iovec local[8];
+  for (int i = 0; i < iovcnt; ++i) local[i] = iov[i];
+  int idx = 0;
+  while (idx < iovcnt) {
+    ssize_t w = ::writev(fd, local + idx, iovcnt - idx);
+    if (w < 0) return false;
+    while (idx < iovcnt && (size_t)w >= local[idx].iov_len) {
+      w -= local[idx].iov_len;
+      ++idx;
+    }
+    if (idx < iovcnt && w > 0) {
+      local[idx].iov_base = (uint8_t*)local[idx].iov_base + w;
+      local[idx].iov_len -= w;
+    }
+  }
+  return true;
+}
+
+// reply with optional lz4 compression (u32 orig | blocks body, codec id 2)
+bool send_reply(int fd, uint8_t status, const uint8_t* body, int64_t blen,
+                bool client_ok, int64_t threshold) {
+  std::vector<uint8_t> comp;
+  if (status == 0 && client_ok && blen >= threshold) {
+    comp.resize(4 + (size_t)lz4_compress_bound(blen));
+    int64_t n = lz4_compress(body, blen, comp.data() + 4, (int64_t)comp.size() - 4);
+    if (n > 0 && n + 4 < blen) {
+      uint32_t orig = (uint32_t)blen;
+      std::memcpy(comp.data(), &orig, 4);
+      body = comp.data();
+      blen = n + 4;
+      status |= 2u << 4;
+    }
+  }
+  uint32_t total = (uint32_t)(blen + 1);
+  uint8_t head[5];
+  std::memcpy(head, &total, 4);
+  head[4] = status;
+  struct iovec iov[2] = {{head, 5}, {(void*)body, (size_t)blen}};
+  return send_all(fd, iov, blen ? 2 : 1);
+}
+
+bool handle_lookup_batched(Server* s, int fd, const uint8_t* p, int64_t n,
+                           bool client_ok) {
+  if (n < 4) return false;
+  const uint8_t train = p[0];
+  const uint8_t code = p[1];
+  uint16_t ng;
+  std::memcpy(&ng, p + 2, 2);
+  int64_t off = 4;
+  if (off + 4 * (int64_t)ng + 8 * ((int64_t)ng + 1) > n) return false;
+  // wire fields are byte-packed: copy to aligned scratch before typed use
+  thread_local std::vector<uint32_t> dims_v;
+  dims_v.resize(ng);
+  std::memcpy(dims_v.data(), p + off, 4 * (size_t)ng);
+  const uint32_t* dims = dims_v.data();
+  off += 4 * ng;
+  thread_local std::vector<int64_t> key_ofs;
+  key_ofs.resize(ng + 1);
+  std::memcpy(key_ofs.data(), p + off, 8 * ((size_t)ng + 1));
+  off += 8 * ((int64_t)ng + 1);
+  const int64_t n_signs = ng ? key_ofs[ng] : 0;
+  if (off + 8 * n_signs > n || n_signs < 0) return false;
+  thread_local std::vector<uint64_t> signs;
+  signs.resize((size_t)n_signs);
+  std::memcpy(signs.data(), p + off, 8 * (size_t)n_signs);
+
+  int64_t total = 0;
+  if (!layout_ok(key_ofs.data(), dims, ng, &total)) return false;
+  thread_local std::vector<int64_t> out_ofs;
+  out_ofs.resize(ng);
+  int64_t acc = 0;
+  for (int g = 0; g < ng; ++g) {
+    out_ofs[g] = acc;
+    acc += (key_ofs[g + 1] - key_ofs[g]) * (int64_t)dims[g];
+  }
+  thread_local std::vector<float> rows;
+  rows.resize((size_t)total);
+  s->ps.lookup_batched(s->store, signs.data(), key_ofs.data(), dims,
+                       out_ofs.data(), ng, train, rows.data());
+  if (code == 0) {
+    return send_reply(fd, 0, (const uint8_t*)rows.data(), total * 4, client_ok,
+                      s->compress_threshold);
+  }
+  thread_local std::vector<uint8_t> wire;
+  wire.resize((size_t)total * 2);
+  f32_to_wire(rows.data(), total, wire.data(), code);
+  return send_reply(fd, 0, wire.data(), total * 2, client_ok,
+                    s->compress_threshold);
+}
+
+bool handle_update_batched(Server* s, int fd, const uint8_t* p, int64_t n,
+                           bool client_ok) {
+  if (n < 3) return false;
+  const uint8_t code = p[0];
+  uint16_t ng;
+  std::memcpy(&ng, p + 1, 2);
+  int64_t off = 3;
+  if (off + 8 * (int64_t)ng + 8 * ((int64_t)ng + 1) > n) return false;
+  thread_local std::vector<uint32_t> dims_v;
+  dims_v.resize(ng);
+  std::memcpy(dims_v.data(), p + off, 4 * (size_t)ng);
+  const uint32_t* dims = dims_v.data();
+  off += 4 * ng;
+  thread_local std::vector<int32_t> ogs;
+  ogs.resize(ng);
+  std::memcpy(ogs.data(), p + off, 4 * (size_t)ng);
+  off += 4 * ng;
+  thread_local std::vector<int64_t> key_ofs;
+  key_ofs.resize(ng + 1);
+  std::memcpy(key_ofs.data(), p + off, 8 * ((size_t)ng + 1));
+  off += 8 * ((int64_t)ng + 1);
+  const int64_t n_signs = ng ? key_ofs[ng] : 0;
+  if (n_signs < 0 || off + 8 * n_signs > n) return false;
+  thread_local std::vector<uint64_t> signs;
+  signs.resize((size_t)n_signs);
+  std::memcpy(signs.data(), p + off, 8 * (size_t)n_signs);
+  off += 8 * n_signs;
+
+  int64_t total = 0;
+  if (!layout_ok(key_ofs.data(), dims, ng, &total)) return false;
+  thread_local std::vector<int64_t> grad_ofs;
+  grad_ofs.resize(ng);
+  int64_t acc = 0;
+  for (int g = 0; g < ng; ++g) {
+    grad_ofs[g] = acc;
+    acc += (key_ofs[g + 1] - key_ofs[g]) * (int64_t)dims[g];
+  }
+  const int64_t want = total * (code ? 2 : 4);
+  if (off + want > n) return false;
+  const float* grads;
+  thread_local std::vector<float> gbuf;
+  if (code == 0) {
+    gbuf.resize((size_t)total);
+    std::memcpy(gbuf.data(), p + off, (size_t)total * 4);  // align
+    grads = gbuf.data();
+  } else {
+    gbuf.resize((size_t)total);
+    wire_to_f32(p + off, total, gbuf.data(), code);
+    grads = gbuf.data();
+  }
+  int rc = s->ps.update_batched(s->store, signs.data(), key_ofs.data(), dims,
+                                grads, grad_ofs.data(), ogs.data(), ng);
+  if (rc != 0) {
+    static const char kErr[] = "remote error: no optimizer registered";
+    return send_reply(fd, 1, (const uint8_t*)kErr, sizeof(kErr) - 1, false, 0);
+  }
+  return send_reply(fd, 0, (const uint8_t*)"ok", 2, false, 0);
+}
+
+void serve_conn_inner(Server* s, int fd);
+
+void serve_conn(Server* s, int fd, Server::ConnSlot* slot) {
+  // close ownership lives HERE (after untrack): closing inside the inner
+  // loop would let the kernel reuse the fd number while stop() still holds
+  // it in live_fds and shutdown()s an unrelated connection
+  s->track_fd(fd, true);
+  serve_conn_inner(s, fd);
+  s->track_fd(fd, false);
+  ::close(fd);
+  slot->done.store(true, std::memory_order_release);
+}
+
+void serve_conn_inner(Server* s, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::vector<uint8_t> frame;
+  std::vector<uint8_t> raw;
+  while (!s->stopping.load(std::memory_order_relaxed)) {
+    uint8_t head[4];
+    if (!recv_exact(fd, head, 4)) break;
+    uint32_t total;
+    std::memcpy(&total, head, 4);
+    if ((int64_t)total > MAX_FRAME || total < 3) break;
+    frame.resize(total);
+    if (!recv_exact(fd, frame.data(), total)) break;
+    const uint8_t flags = frame[0];
+    uint16_t mlen;
+    std::memcpy(&mlen, frame.data() + 1, 2);
+    if ((int64_t)3 + mlen > (int64_t)total) break;
+    char method[64];
+    const size_t mcopy = mlen < sizeof(method) - 1 ? mlen : sizeof(method) - 1;
+    std::memcpy(method, frame.data() + 3, mcopy);
+    method[mcopy] = 0;
+    const uint8_t* payload = frame.data() + 3 + mlen;
+    int64_t plen = (int64_t)total - 3 - mlen;
+    const bool client_ok = (flags & FLAG_REPLY_OK) != 0;
+    const uint8_t codec = flags & FLAG_CODEC_MASK;
+    if (codec == 2) {  // lz4: u32 orig | blocks
+      if (plen < 4) break;
+      uint32_t orig;
+      std::memcpy(&orig, payload, 4);
+      raw.resize(orig);
+      if (lz4_decompress(payload + 4, plen - 4, raw.data(), orig) != (int64_t)orig)
+        break;
+      payload = raw.data();
+      plen = orig;
+    } else if (codec != 0) {
+      // zlib (legacy peers): route through the Python fallback, which
+      // decompresses with the portable codec module
+      ReplyCtx ctx;
+      std::string m = std::string("__zlib__:") + method;
+      s->fallback(m.c_str(), payload, plen, &ctx);
+      if (!ctx.set ||
+          !send_reply(fd, (uint8_t)ctx.status, ctx.data.data(),
+                      (int64_t)ctx.data.size(), client_ok && ctx.status == 0,
+                      s->compress_threshold))
+        break;
+      continue;
+    }
+    bool ok;
+    if (std::strcmp(method, "ping") == 0) {
+      ok = send_reply(fd, 0, (const uint8_t*)"pong", 4, false, 0);
+    } else if (std::strcmp(method, "lookup_batched") == 0) {
+      ok = handle_lookup_batched(s, fd, payload, plen, client_ok);
+    } else if (std::strcmp(method, "update_batched") == 0) {
+      ok = handle_update_batched(s, fd, payload, plen, client_ok);
+    } else {
+      ReplyCtx ctx;
+      s->fallback(method, payload, plen, &ctx);
+      ok = ctx.set && send_reply(fd, (uint8_t)ctx.status, ctx.data.data(),
+                                 (int64_t)ctx.data.size(),
+                                 client_ok && ctx.status == 0,
+                                 s->compress_threshold);
+      if (ok && std::strcmp(method, "shutdown") == 0) {
+        // wake the accept loop; fd close + joins belong to the wrapper and
+        // stop(), which the Python side drives
+        s->stopping.store(true);
+        if (s->listen_fd >= 0) ::shutdown(s->listen_fd, SHUT_RDWR);
+        return;
+      }
+    }
+    if (!ok) break;
+  }
+}
+
+void accept_loop(Server* s) {
+  while (!s->stopping.load(std::memory_order_relaxed)) {
+    int fd = ::accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (s->stopping.load(std::memory_order_relaxed)) return;
+      continue;
+    }
+    s->reap_finished();
+    auto slot = std::make_unique<Server::ConnSlot>();
+    Server::ConnSlot* raw = slot.get();
+    // start the thread BEFORE publishing the slot: reap/stop must only ever
+    // see joinable threads. If stop() swapped `conns` in between, the
+    // ~Server second stop() joins this late slot (track_fd wakes its recv).
+    raw->t = std::thread([s, fd, raw] { serve_conn(s, fd, raw); });
+    std::lock_guard<std::mutex> g(s->conn_mu);
+    s->conns.push_back(std::move(slot));
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void net_reply(void* reply_ctx, int status, const uint8_t* data, int64_t len) {
+  ReplyCtx* ctx = (ReplyCtx*)reply_ctx;
+  ctx->status = status;
+  ctx->data.assign(data, data + (len > 0 ? len : 0));
+  ctx->set = true;
+}
+
+// Start the native server. ps_so_path: path to libpersia_ps.so (dlopened
+// for the store entry points). Returns an opaque handle or null.
+void* net_server_start(int port, void* store_handle, const char* ps_so_path,
+                       FallbackCb fallback, int64_t compress_threshold) {
+  void* so = dlopen(ps_so_path, RTLD_NOW | RTLD_GLOBAL);
+  if (!so) return nullptr;
+  Server* s = new Server();
+  s->ps.lookup_batched = (decltype(s->ps.lookup_batched))dlsym(so, "ps_lookup_batched");
+  s->ps.update_batched = (decltype(s->ps.update_batched))dlsym(so, "ps_update_batched");
+  if (!s->ps.lookup_batched || !s->ps.update_batched) {
+    delete s;
+    return nullptr;
+  }
+  s->store = store_handle;
+  s->fallback = fallback;
+  s->compress_threshold = compress_threshold;
+
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port);
+  if (::bind(s->listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 128) != 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(s->listen_fd, (sockaddr*)&addr, &alen);
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread(accept_loop, s);
+  return s;
+}
+
+int net_server_port(void* h) { return h ? ((Server*)h)->port : -1; }
+
+void net_server_stop(void* h) {
+  if (!h) return;
+  Server* s = (Server*)h;
+  s->stop();
+  delete s;
+}
+
+}  // extern "C"

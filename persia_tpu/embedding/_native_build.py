@@ -34,11 +34,13 @@ def _is_fresh(so: str, stamp: str, h: str) -> bool:
         return f.read().strip() == h
 
 
-def build_so(src: str, so: str, flags, logger, force: bool = False) -> str:
-    """Build ``src`` into ``so`` with g++ if stale; returns ``so``."""
+def build_so(src, so: str, flags, logger, force: bool = False) -> str:
+    """Build ``src`` (one path or a list of paths) into ``so`` with g++ if
+    stale; returns ``so``."""
+    srcs = [src] if isinstance(src, str) else list(src)
     stamp = so + ".srchash"
     with _PROC_LOCK:
-        h = _hash_file(src)
+        h = "".join(_hash_file(p) for p in srcs)
         if not force and _is_fresh(so, stamp, h):
             return so
         with open(so + ".lock", "w") as lf:
@@ -47,7 +49,7 @@ def build_so(src: str, so: str, flags, logger, force: bool = False) -> str:
                 if not force and _is_fresh(so, stamp, h):
                     return so  # another process just built it
                 tmp = f"{so}.tmp.{os.getpid()}"
-                cmd = ["g++", *flags, "-o", tmp, src]
+                cmd = ["g++", *flags, "-o", tmp, *srcs]
                 logger.info("building %s: %s", os.path.basename(so), " ".join(cmd))
                 try:
                     subprocess.check_call(cmd)

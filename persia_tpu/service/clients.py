@@ -21,14 +21,49 @@ from persia_tpu.service.rpc import RpcClient
 
 
 class StoreClient:
-    """Parameter-server RPC client with the EmbeddingStore surface."""
+    """Parameter-server RPC client with the EmbeddingStore surface.
 
-    def __init__(self, addr: str, timeout_s: float = 120.0):
+    ``wire_dtype`` ("float16"/"bfloat16") halves the batched lookup/update
+    wire exactly like the reference's f16 embedding/gradient wire
+    (persia-common/src/lib.rs:157-180); default float32 keeps the
+    determinism oracle bit-exact."""
+
+    def __init__(
+        self, addr: str, timeout_s: float = 120.0,
+        wire_dtype: Optional[str] = None,
+    ):
         self.addr = addr
+        self.wire_dtype = None if wire_dtype == "float32" else wire_dtype
         self._rpc = RpcClient(addr, timeout_s=timeout_s)
 
     def wait_ready(self, timeout_s: float = 60.0) -> None:
         self._rpc.wait_ready(timeout_s)
+
+    def lookup_batched(self, signs: np.ndarray, key_ofs: np.ndarray,
+                       dims: np.ndarray, train: bool) -> np.ndarray:
+        """Multi-slot lookup: ONE rpc per batch (the router's grouped
+        fan-out lands here; ref lookup_batched_all_slots)."""
+        raw = self._rpc.call(
+            "lookup_batched",
+            proto.pack_lookup_batched_request(
+                signs, key_ofs, dims, train, reply_dtype=self.wire_dtype
+            ),
+            idempotent=True,  # same retry-safety argument as lookup
+        )
+        return proto.unpack_lookup_batched_reply(
+            raw, proto.wire_dtype_code(self.wire_dtype)
+        )
+
+    def update_batched(self, signs: np.ndarray, key_ofs: np.ndarray,
+                       dims: np.ndarray, grads, opt_groups: np.ndarray) -> None:
+        """Multi-slot gradient update: ONE rpc per gradient batch."""
+        self._rpc.call(
+            "update_batched",
+            proto.pack_update_batched_request(
+                signs, key_ofs, dims, grads, opt_groups,
+                wire_dtype=self.wire_dtype,
+            ),
+        )
 
     def lookup(self, signs: np.ndarray, dim: int, train: bool) -> np.ndarray:
         # train lookups mutate (LRU/admit) but are retry-safe: re-running a

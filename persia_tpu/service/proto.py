@@ -10,11 +10,15 @@ from __future__ import annotations
 import io
 import json
 import struct
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from persia_tpu.embedding.worker import RawEmbeddingBatch, SumEmbeddingBatch
+from persia_tpu.embedding.worker import (
+    DevicePooledBatch,
+    RawEmbeddingBatch,
+    SumEmbeddingBatch,
+)
 
 
 def pack_ndarray(a: np.ndarray) -> bytes:
@@ -49,6 +53,34 @@ def unpack_json(raw: bytes):
     return json.loads(raw.decode())
 
 
+# ------------------------------------------------- wire dtypes (f16 parity)
+
+# The reference ships f16 embedding rows worker→NN and f16 gradients back
+# (persia-common/src/lib.rs:157-180, ndarray_f32_to_f16 postprocess,
+# embedding_worker_service/mod.rs:486-629); these codes put the same
+# half-width option (plus bf16) on the batched lookup/update wire.
+def wire_dtype_code(name: Optional[str]) -> int:
+    if name in (None, "float32"):
+        return 0
+    if name == "float16":
+        return 1
+    if name == "bfloat16":
+        return 2
+    raise ValueError(f"wire dtype must be float32/float16/bfloat16, got {name!r}")
+
+
+def _wire_np_dtype(code: int) -> np.dtype:
+    if code == 0:
+        return np.dtype(np.float32)
+    if code == 1:
+        return np.dtype(np.float16)
+    if code == 2:
+        from ml_dtypes import bfloat16  # registered numpy scalar (jax dep)
+
+        return np.dtype(bfloat16)
+    raise ValueError(f"unknown wire dtype code {code}")
+
+
 # ---------------------------------------------------------- lookup/update
 
 
@@ -60,6 +92,100 @@ def unpack_lookup_request(raw: bytes) -> Tuple[np.ndarray, int, bool]:
     dim, train = struct.unpack("<IB", raw[:5])
     signs = unpack_ndarray(io.BytesIO(raw[5:]))
     return signs, dim, bool(train)
+
+
+def pack_lookup_batched_request(
+    signs: np.ndarray, key_ofs: np.ndarray, dims: np.ndarray, train: bool,
+    reply_dtype: Optional[str] = None,
+) -> List:
+    """ONE multi-slot lookup frame per batch per replica (ref:
+    lookup_batched_all_slots, embedding_worker_service/mod.rs:874-942).
+    Returns a scatter-gather buffer list — the sign array ships as a
+    memoryview, never joined host-side."""
+    header = struct.pack(
+        "<BBH", int(train), wire_dtype_code(reply_dtype), len(dims)
+    )
+    return [
+        header,
+        np.ascontiguousarray(dims, dtype=np.uint32).data,
+        np.ascontiguousarray(key_ofs, dtype=np.int64).data,
+        np.ascontiguousarray(signs, dtype=np.uint64).data,
+    ]
+
+
+def unpack_lookup_batched_request(raw: bytes):
+    train, dtype_code, n = struct.unpack("<BBH", raw[:4])
+    off = 4
+    dims = np.frombuffer(raw, dtype=np.uint32, count=n, offset=off)
+    off += 4 * n
+    key_ofs = np.frombuffer(raw, dtype=np.int64, count=n + 1, offset=off)
+    off += 8 * (n + 1)
+    signs = np.frombuffer(raw, dtype=np.uint64, offset=off)
+    return signs, key_ofs, dims, bool(train), dtype_code
+
+
+def _export_view(a: np.ndarray):
+    """Buffer-protocol view of any array — bfloat16 (an ml_dtypes scalar)
+    can't export directly, so reinterpret as bytes."""
+    return np.ascontiguousarray(a).view(np.uint8).data
+
+
+def _import_array(raw, dtype: np.dtype, count: int = -1, offset: int = 0):
+    n_bytes = (len(raw) - offset) if count < 0 else count * dtype.itemsize
+    return np.frombuffer(
+        raw, dtype=np.uint8, count=n_bytes, offset=offset
+    ).view(dtype)
+
+
+def pack_lookup_batched_reply(flat: np.ndarray, dtype_code: int) -> List:
+    return [_export_view(flat.astype(_wire_np_dtype(dtype_code), copy=False))]
+
+
+def unpack_lookup_batched_reply(raw: bytes, dtype_code: int) -> np.ndarray:
+    flat = _import_array(raw, _wire_np_dtype(dtype_code))
+    return flat.astype(np.float32) if dtype_code else flat.copy()
+
+
+def pack_update_batched_request(
+    signs: np.ndarray, key_ofs: np.ndarray, dims: np.ndarray,
+    grads_flat: np.ndarray, opt_groups: np.ndarray,
+    wire_dtype: Optional[str] = None,
+) -> List:
+    """ONE multi-slot gradient frame per batch per replica; gradients ship
+    in the (optionally half-width) wire dtype like the reference's f16
+    gradient return (persia-common/src/lib.rs:157-180)."""
+    code = wire_dtype_code(wire_dtype)
+    header = struct.pack("<BH", code, len(dims))
+    return [
+        header,
+        np.ascontiguousarray(dims, dtype=np.uint32).data,
+        np.ascontiguousarray(opt_groups, dtype=np.int32).data,
+        np.ascontiguousarray(key_ofs, dtype=np.int64).data,
+        np.ascontiguousarray(signs, dtype=np.uint64).data,
+        _export_view(
+            np.asarray(grads_flat).reshape(-1).astype(
+                _wire_np_dtype(code), copy=False
+            )
+        ),
+    ]
+
+
+def unpack_update_batched_request(raw: bytes):
+    code, n = struct.unpack("<BH", raw[:3])
+    off = 3
+    dims = np.frombuffer(raw, dtype=np.uint32, count=n, offset=off)
+    off += 4 * n
+    opt_groups = np.frombuffer(raw, dtype=np.int32, count=n, offset=off)
+    off += 4 * n
+    key_ofs = np.frombuffer(raw, dtype=np.int64, count=n + 1, offset=off)
+    off += 8 * (n + 1)
+    n_signs = int(key_ofs[-1]) if n else 0
+    signs = np.frombuffer(raw, dtype=np.uint64, count=n_signs, offset=off)
+    off += 8 * n_signs
+    grads = _import_array(raw, _wire_np_dtype(code), offset=off).astype(
+        np.float32, copy=False
+    )
+    return signs, key_ofs, dims, grads, opt_groups
 
 
 def pack_update_request(signs: np.ndarray, grads: np.ndarray, group: int) -> bytes:
@@ -116,6 +242,10 @@ def pack_emb_batches(batches: Sequence) -> bytes:
         elif isinstance(b, RawEmbeddingBatch):
             out.append(struct.pack("<BH", 1, len(name)) + name)
             out.append(pack_ndarrays([b.distinct, b.index, b.sample_id_num]))
+        elif isinstance(b, DevicePooledBatch):
+            out.append(struct.pack("<BH", 2, len(name)) + name)
+            out.append(struct.pack("<B", int(b.sqrt_scaling)))
+            out.append(pack_ndarrays([b.distinct, b.index, b.counts]))
         else:
             raise TypeError(type(b))
     return b"".join(out)
@@ -130,9 +260,17 @@ def unpack_emb_batches(raw: bytes) -> List:
         name = buf.read(nlen).decode()
         if kind == 0:
             out.append(SumEmbeddingBatch(name, unpack_ndarray(buf)))
-        else:
+        elif kind == 1:
             distinct, index, sample_id_num = unpack_ndarrays(buf)
             out.append(RawEmbeddingBatch(name, distinct, index, sample_id_num))
+        elif kind == 2:
+            (sqrt_scaling,) = struct.unpack("<B", buf.read(1))
+            distinct, index, counts = unpack_ndarrays(buf)
+            out.append(
+                DevicePooledBatch(name, distinct, index, counts, bool(sqrt_scaling))
+            )
+        else:
+            raise ValueError(f"unknown embedding batch kind {kind}")
     return out
 
 

@@ -36,14 +36,32 @@ class ParameterServerService:
         replica_index: int = 0,
         replica_size: int = 1,
         port: int = 0,
+        native_server: Optional[bool] = None,
     ):
         self.store = store
         self.replica_index = replica_index
         self.replica_size = replica_size
         self.status = ModelManagerStatus()
-        self.server = RpcServer(port=port)
+        # data plane: the C++ listener serves the hot methods off the GIL
+        # when the store is native (ref: the reference's entire remote path
+        # is compiled, persia-rpc/src/lib.rs:68-145); Python socketserver
+        # remains the portable fallback and the control plane either way
+        if native_server is None:
+            native_server = os.environ.get("PERSIA_NATIVE_SERVER", "1") != "0"
+        self.server = None
+        if native_server and getattr(store, "_h", None):
+            try:
+                from persia_tpu.service.native_rpc import NativeRpcServer
+
+                self.server = NativeRpcServer(store, port=port)
+            except Exception as e:  # noqa: BLE001 — fall back to Python
+                logger.warning("native rpc server unavailable (%r)", e)
+        if self.server is None:
+            self.server = RpcServer(port=port)
         s = self.server
         s.register("lookup", self._lookup)
+        s.register("lookup_batched", self._lookup_batched)
+        s.register("update_batched", self._update_batched)
         s.register("checkout_entries", self._checkout)
         s.register("probe_entries", self._probe_entries)
         s.register("update_gradients", self._update)
@@ -72,6 +90,48 @@ class ParameterServerService:
     def _lookup(self, payload: bytes) -> bytes:
         signs, dim, train = proto.unpack_lookup_request(payload)
         return self.store.lookup(signs, dim, train).tobytes()
+
+    def _lookup_batched(self, payload: bytes):
+        """ONE frame per training batch: all slots' keys in, one flat
+        (optionally f16/bf16) row buffer out — the hot lookup wire
+        (ref: lookup_batched_all_slots + f16 postprocess,
+        embedding_worker_service/mod.rs:874-942,486-629). Falls back to
+        per-group store calls when the store lacks the batched surface."""
+        signs, key_ofs, dims, train, dtype_code = (
+            proto.unpack_lookup_batched_request(payload)
+        )
+        if hasattr(self.store, "lookup_batched"):
+            flat = self.store.lookup_batched(signs, key_ofs, dims, train)
+        else:
+            parts = [
+                self.store.lookup(
+                    signs[key_ofs[g]:key_ofs[g + 1]], int(dims[g]), train
+                ).reshape(-1)
+                for g in range(len(dims))
+            ]
+            flat = (
+                np.concatenate(parts) if parts else np.empty(0, np.float32)
+            )
+        return proto.pack_lookup_batched_reply(flat, dtype_code)
+
+    def _update_batched(self, payload: bytes) -> bytes:
+        signs, key_ofs, dims, grads, opt_groups = (
+            proto.unpack_update_batched_request(payload)
+        )
+        if hasattr(self.store, "update_batched"):
+            self.store.update_batched(signs, key_ofs, dims, grads, opt_groups)
+        else:
+            off = 0
+            for g in range(len(dims)):
+                d = int(dims[g])
+                ks = signs[key_ofs[g]:key_ofs[g + 1]]
+                size = len(ks) * d
+                self.store.update_gradients(
+                    ks, grads[off:off + size].reshape(len(ks), d),
+                    int(opt_groups[g]),
+                )
+                off += size
+        return b"ok"
 
     def _checkout(self, payload: bytes) -> bytes:
         signs, dim, _ = proto.unpack_lookup_request(payload)

@@ -3,12 +3,18 @@
 Parity target: the reference's bespoke RPC crate (`others/persia-rpc/src/
 lib.rs:68-145` — hyper HTTP POST + speedy bodies + optional lz4) and its
 proc-macro-generated clients (`others/persia-rpc-macro`). Here: a
-length-prefixed framed protocol over raw TCP with optional zlib compression,
-a threaded server, and a reconnecting client. Python implementation is the
-round-1 shell; the C++ data-plane equivalent slots under the same framing.
+length-prefixed framed protocol over raw TCP with lz4-class compression
+(``native/codec.cpp``; zlib fallback), scatter-gather sends (no payload
+concatenation on the hot path), a threaded server, and a reconnecting
+client.
 
 Frame:  u32 total_len | u8 flags | u16 method_len | method | payload
-Reply:  u32 total_len | u8 status (0 ok, 1 app error) | payload
+  flags bits 0-1: payload codec (0 none, 1 zlib, 2 lz4)
+  flags bit 7:    client accepts compressed replies
+Reply:  u32 total_len | u8 status | payload
+  status low nibble: 0 ok, 1 app error; high nibble: payload codec
+(Old peers only ever set/see bit 0 = zlib and a 0/1 status byte, so both
+directions interoperate with round-1 processes.)
 """
 
 from __future__ import annotations
@@ -18,18 +24,57 @@ import socketserver
 import struct
 import threading
 import time
-import zlib
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Sequence, Union
 
 from persia_tpu import diagnostics
 from persia_tpu.logger import get_default_logger
+from persia_tpu.service import codec as _codec
 
 logger = get_default_logger("persia_tpu.rpc")
 
-_FLAG_COMPRESSED = 1
+_FLAG_CODEC_MASK = 0x03
+_FLAG_REPLY_COMPRESS_OK = 0x80
 _SLOW_METHODS = frozenset({"dump", "load"})
 
 _MAX_FRAME = 1 << 31  # 2 GiB sanity bound
+
+Buffers = Union[bytes, Sequence]  # bytes | [bytes/memoryview, ...]
+
+
+def _byte_views(bufs) -> list:
+    """Byte-cast memoryviews (len() on a typed numpy ``.data`` view counts
+    ELEMENTS, not bytes — every length computation below must see bytes)."""
+    return [v for v in (memoryview(b).cast("B") for b in bufs) if len(v)]
+
+
+def _send_buffers(sock: socket.socket, bufs) -> None:
+    """Scatter-gather send: ship header + payload views without joining
+    them into one bytes object first (the join doubles peak memory and
+    copies multi-MB lookup replies once per call). ``bufs`` must already be
+    byte views (``_byte_views``)."""
+    bufs = list(bufs)
+    while bufs:
+        sent = sock.sendmsg(bufs)
+        while bufs and sent >= len(bufs[0]):
+            sent -= len(bufs[0])
+            bufs.pop(0)
+        if sent and bufs:
+            bufs[0] = bufs[0][sent:]
+
+
+def _flatten(payload: Buffers) -> bytes:
+    if isinstance(payload, (bytes, bytearray, memoryview)):
+        return bytes(payload)
+    return b"".join(bytes(p) for p in payload)
+
+
+def _capabilities_reply(_p: bytes = b"") -> bytes:
+    """Codec-negotiation probe: clients only send lz4 frames to peers that
+    advertise it (round-1 peers answer 'unknown method' → zlib only)."""
+    import json
+
+    codecs = ["zlib"] + (["lz4"] if _codec.lz4_available() else [])
+    return json.dumps({"codecs": codecs}).encode()
 
 
 class RpcError(RuntimeError):
@@ -71,8 +116,16 @@ class _Handler(socketserver.BaseRequestHandler):
                 (mlen,) = struct.unpack("<H", frame[1:3])
                 method = frame[3 : 3 + mlen].decode()
                 payload = frame[3 + mlen :]
-                if flags & _FLAG_COMPRESSED:
-                    payload = zlib.decompress(payload)
+                codec_id = flags & _FLAG_CODEC_MASK
+                if codec_id:
+                    try:
+                        payload = _codec.decompress_frame(codec_id, payload)
+                    except Exception as e:  # noqa: BLE001 — e.g. no lz4 here
+                        reply = f"unsupported codec {codec_id}: {e!r}".encode()
+                        sock.sendall(
+                            struct.pack("<IB", len(reply) + 1, 1) + reply
+                        )
+                        continue
                 fn = server.handlers.get(method)
                 if fn is None:
                     reply, status = f"unknown method {method!r}".encode(), 1
@@ -92,7 +145,31 @@ class _Handler(socketserver.BaseRequestHandler):
                         # genuine application errors which stay fatal
                         prefix = b"unavailable: " if _is_transportish(e) else b""
                         reply, status = prefix + repr(e).encode(), 1
-                sock.sendall(struct.pack("<IB", len(reply) + 1, status) + reply)
+                # handlers may return scatter-gather buffer lists (zero-copy
+                # numpy views); compress large replies for peers that opted in
+                rbufs = _byte_views(
+                    [reply] if isinstance(reply, (bytes, bytearray, memoryview))
+                    else reply
+                )
+                rlen = sum(len(b) for b in rbufs)
+                if (
+                    status == 0
+                    and (flags & _FLAG_REPLY_COMPRESS_OK)
+                    and rlen >= server.compress_threshold
+                ):
+                    # lz4-or-nothing: a zlib'd hot reply would cost more
+                    # serving-thread time than the wire saves
+                    cid, body = _codec.compress_frame(
+                        _flatten(rbufs), allow_zlib=False
+                    )
+                    if cid and len(body) < rlen:  # incompressible stays raw
+                        rbufs, rlen = [memoryview(body).cast("B")], len(body)
+                        status |= cid << 4
+                _send_buffers(
+                    sock,
+                    [memoryview(struct.pack("<IB", rlen + 1, status)).cast("B")]
+                    + rbufs,
+                )
                 if method == "shutdown":
                     server.stop()
                     return
@@ -111,9 +188,14 @@ class RpcServer:
     server after replying (graceful shutdown, ref: hyper servers in
     bin/persia-embedding-worker.rs:70-78)."""
 
-    def __init__(self, port: int = 0, host: str = "0.0.0.0"):
-        self.handlers: Dict[str, Callable[[bytes], bytes]] = {
+    def __init__(
+        self, port: int = 0, host: str = "0.0.0.0",
+        compress_threshold: int = 1 << 20,
+    ):
+        self.compress_threshold = compress_threshold
+        self.handlers: Dict[str, Callable[[bytes], Buffers]] = {
             "ping": lambda p: b"pong",
+            "capabilities": _capabilities_reply,  # codec negotiation probe
             "shutdown": lambda p: b"ok",  # framing layer stops after replying
         }
         self._server = _ThreadedTCPServer((host, port), _Handler)
@@ -159,6 +241,7 @@ class RpcClient:
         self.compress_threshold = compress_threshold
         self.retries = retries
         self.pool_size = max(1, pool_size)
+        self._peer_lz4: Optional[bool] = None  # learned from `capabilities`
         self._idle: list = []
         self._total = 0
         self._gen = 0  # close() bumps: stale in-flight sockets die at checkin
@@ -217,7 +300,7 @@ class RpcClient:
     def call(
         self,
         method: str,
-        payload: bytes = b"",
+        payload: Buffers = b"",
         idempotent: bool = False,
         timeout_s: Optional[float] = None,
     ) -> bytes:
@@ -240,20 +323,35 @@ class RpcClient:
         ) from last
 
     def _call_once(
-        self, method: str, payload: bytes, timeout_s: Optional[float] = None
+        self, method: str, payload: Buffers, timeout_s: Optional[float] = None
     ) -> bytes:
-        flags = 0
-        if len(payload) >= self.compress_threshold:
-            payload = zlib.compress(payload, level=1)
-            flags |= _FLAG_COMPRESSED
+        """``payload`` may be bytes or a list of buffers (scatter-gather:
+        numpy views ship without a host-side join)."""
+        # advertise compressed-reply support only when this process can
+        # actually DECODE lz4 (replies are lz4-or-raw; see the server path)
+        flags = _FLAG_REPLY_COMPRESS_OK if _codec.lz4_available() else 0
+        bufs = _byte_views(
+            [payload] if isinstance(payload, (bytes, bytearray, memoryview))
+            else payload
+        )
+        plen = sum(len(b) for b in bufs)
+        if plen >= self.compress_threshold and method != "capabilities":
+            if self._peer_lz4 is None and _codec.lz4_available():
+                self._probe_peer_codecs()
+            cid, body = _codec.compress_frame(
+                _flatten(bufs), prefer_lz4=bool(self._peer_lz4)
+            )
+            if len(body) < plen:  # incompressible payloads stay raw
+                bufs, plen = [memoryview(body).cast("B")], len(body)
+                flags |= cid
         m = method.encode()
-        frame = struct.pack("<BH", flags, len(m)) + m + payload
+        header = struct.pack("<IBH", plen + 3 + len(m), flags, len(m)) + m
         sock, gen = self._checkout()
         try:
             if timeout_s is not None:
                 sock.settimeout(timeout_s)
             try:
-                sock.sendall(struct.pack("<I", len(frame)) + frame)
+                _send_buffers(sock, [memoryview(header).cast("B")] + bufs)
                 (total,) = struct.unpack("<I", _recv_exact(sock, 4))
                 body = _recv_exact(sock, total)
             finally:
@@ -265,9 +363,25 @@ class RpcClient:
         self._checkin(sock, gen)
         status = body[0]
         reply = body[1:]
+        codec_id = status >> 4
+        status &= 0x0F
+        if codec_id:
+            reply = _codec.decompress_frame(codec_id, reply)
         if status != 0:
             raise RpcError(f"rpc {method}: remote error: {reply.decode(errors='replace')}")
         return reply
+
+    def _probe_peer_codecs(self) -> None:
+        """One-shot `capabilities` probe before the first compressed frame:
+        lz4 goes on the wire only to peers that advertise decoding it
+        (round-1 peers answer 'unknown method' → stick to zlib)."""
+        try:
+            import json
+
+            caps = json.loads(self._call_once("capabilities", b""))
+            self._peer_lz4 = "lz4" in caps.get("codecs", [])
+        except Exception:  # noqa: BLE001 — legacy peer or transient error
+            self._peer_lz4 = False
 
     def wait_ready(self, timeout_s: float = 60.0) -> None:
         deadline = time.time() + timeout_s

@@ -1,0 +1,68 @@
+"""LZ4 block codec: roundtrip, malformed-input rejection, zlib fallback,
+and interop with the standard block format when a reference decoder exists."""
+
+import numpy as np
+import pytest
+
+from persia_tpu.service import codec
+
+
+requires_native = pytest.mark.skipif(
+    not codec.lz4_available(), reason="native codec toolchain unavailable"
+)
+
+
+@requires_native
+@pytest.mark.parametrize("case", [
+    b"",
+    b"a",
+    b"abcd" * 1,
+    b"hello world, hello world, hello world",
+    bytes(range(256)) * 41,          # mixed entropy
+    b"\x00" * 100_000,               # long runs (overlapping matches)
+    np.random.default_rng(0).integers(0, 256, 300_000, dtype=np.uint8).tobytes(),
+    np.arange(50_000, dtype=np.float32).tobytes(),   # structured floats
+])
+def test_lz4_roundtrip(case):
+    comp = codec.lz4_compress(case)
+    assert codec.lz4_decompress(comp, len(case)) == case
+
+
+@requires_native
+def test_lz4_compresses_compressible():
+    data = b"persia-tpu " * 10_000
+    comp = codec.lz4_compress(data)
+    assert len(comp) < len(data) // 10
+
+
+@requires_native
+def test_lz4_rejects_malformed():
+    data = b"some payload " * 1000
+    comp = bytearray(codec.lz4_compress(data))
+    with pytest.raises((ValueError, RuntimeError)):
+        codec.lz4_decompress(bytes(comp[:10]), len(data))  # truncated
+    with pytest.raises((ValueError, RuntimeError)):
+        codec.lz4_decompress(bytes(comp), len(data) * 2)  # wrong size claim
+
+
+@requires_native
+def test_lz4_interop_with_reference_decoder():
+    """Bytes follow the public LZ4 block format — if a standard decoder is
+    importable, it must accept our output and vice versa."""
+    try:
+        import lz4.block  # noqa: F401
+    except ImportError:
+        pytest.skip("no reference lz4 available")
+    data = b"interop check " * 5000
+    assert lz4.block.decompress(codec.lz4_compress(data), uncompressed_size=len(data)) == data
+    ref = lz4.block.compress(data, store_size=False)
+    assert codec.lz4_decompress(ref, len(data)) == data
+
+
+def test_frame_codec_roundtrip_both_codecs():
+    payload = np.random.default_rng(1).normal(size=20_000).astype(np.float32).tobytes()
+    cid, body = codec.compress_frame(payload, prefer_lz4=True)
+    assert codec.decompress_frame(cid, body) == payload
+    cid2, body2 = codec.compress_frame(payload, prefer_lz4=False)
+    assert cid2 == codec.CODEC_ZLIB
+    assert codec.decompress_frame(cid2, body2) == payload

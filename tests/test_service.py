@@ -73,3 +73,133 @@ def test_coordinator():
     assert c.kv_get("optimizer") == b"\x01\x02"
     assert c.kv_get("missing") == b""
     coord.stop()
+
+
+def test_batched_rpc_lookup_update_wire_dtypes():
+    """StoreClient.lookup_batched/update_batched against a live PS service:
+    f32 wire is BIT-identical to in-process store calls; f16/bf16 wires
+    round within half precision. Exercises the scatter-gather send path,
+    reply compression negotiation, and the batched server handlers."""
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.embedding.store import EmbeddingStore
+    from persia_tpu.service.clients import StoreClient
+    from persia_tpu.service.ps_server import ParameterServerService
+
+    def fresh_store():
+        return EmbeddingStore(
+            capacity=1 << 14, num_internal_shards=2,
+            optimizer=Adagrad(lr=0.1).config, seed=5,
+        )
+
+    rng = np.random.default_rng(2)
+    groups = [(rng.integers(0, 2000, 600, dtype=np.uint64), 16),
+              (rng.integers(0, 2000, 300, dtype=np.uint64), 8)]
+    key_ofs = np.array([0, 600, 900], dtype=np.int64)
+    signs = np.concatenate([k for k, _ in groups])
+    dims = np.array([16, 8], dtype=np.uint32)
+    ogs = np.array([0, 0], dtype=np.int32)
+    grads = np.concatenate([
+        rng.normal(size=(600, 16)).astype(np.float32).reshape(-1),
+        rng.normal(size=(300, 8)).astype(np.float32).reshape(-1),
+    ])
+
+    ref_store = fresh_store()
+    ref_flat = ref_store.lookup_batched(signs, key_ofs, dims, True)
+    ref_store.advance_batch_state(0)
+    ref_store.update_batched(signs, key_ofs, dims, grads, ogs)
+    ref_after = ref_store.lookup_batched(signs, key_ofs, dims, False)
+
+    for wire, exact in ((None, True), ("float16", False), ("bfloat16", False)):
+        svc = ParameterServerService(fresh_store(), port=0).start()
+        try:
+            c = StoreClient(f"127.0.0.1:{svc.port}", wire_dtype=wire)
+            c.wait_ready()
+            flat = c.lookup_batched(signs, key_ofs, dims, True)
+            c.advance_batch_state(0)
+            c.update_batched(signs, key_ofs, dims, grads, ogs)
+            after = c.lookup_batched(signs, key_ofs, dims, False)
+            if exact:
+                np.testing.assert_array_equal(flat, ref_flat)
+                np.testing.assert_array_equal(after, ref_after)
+            else:
+                # half-width wire: one rounding on the rows out, one on the
+                # grads in; adagrad updates keep the drift near half-eps
+                np.testing.assert_allclose(flat, ref_flat, rtol=0.01, atol=1e-3)
+                np.testing.assert_allclose(after, ref_after, rtol=0.05, atol=5e-3)
+        finally:
+            c.shutdown()
+
+
+def test_native_server_data_plane():
+    """ParameterServerService over a NATIVE store auto-selects the C++
+    listener (native/server.cpp): hot methods (ping/lookup_batched/
+    update_batched incl. f16/bf16 wires and lz4'd frames) are served off
+    the GIL, everything else falls back to the Python handlers. Results
+    must match the Python-server path bit-for-bit on the f32 wire."""
+    native = pytest.importorskip("persia_tpu.embedding.native_store")
+    if not native.native_available():
+        pytest.skip("native core unavailable")
+    from persia_tpu.service.native_rpc import native_server_available
+
+    if not native_server_available():
+        pytest.skip("native server toolchain unavailable")
+
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.service.clients import StoreClient
+    from persia_tpu.service.ps_server import ParameterServerService
+
+    def fresh_store():
+        return native.NativeEmbeddingStore(
+            capacity=1 << 14, num_internal_shards=2,
+            optimizer=Adagrad(lr=0.1).config, seed=5,
+        )
+
+    rng = np.random.default_rng(4)
+    # large enough that the lz4 reply-compression path engages (>1 MiB rows)
+    groups = [(rng.integers(0, 60_000, 40_000, dtype=np.uint64), 16),
+              (rng.integers(0, 60_000, 5_000, dtype=np.uint64), 8)]
+    key_ofs = np.array([0, 40_000, 45_000], dtype=np.int64)
+    signs = np.concatenate([k for k, _ in groups])
+    dims = np.array([16, 8], dtype=np.uint32)
+    ogs = np.array([0, 1], dtype=np.int32)
+    grads = rng.normal(size=40_000 * 16 + 5_000 * 8).astype(np.float32)
+
+    results = {}
+    for native_flag in (False, True):
+        svc = ParameterServerService(
+            fresh_store(), port=0, native_server=native_flag
+        ).start()
+        from persia_tpu.service.native_rpc import NativeRpcServer
+
+        assert isinstance(svc.server, NativeRpcServer) == native_flag
+        c = StoreClient(f"127.0.0.1:{svc.port}")
+        try:
+            c.wait_ready()
+            flat = c.lookup_batched(signs, key_ofs, dims, True)
+            c.advance_batch_state(0)
+            c.advance_batch_state(1)
+            c.update_batched(signs, key_ofs, dims, grads, ogs)
+            after = c.lookup_batched(signs, key_ofs, dims, False)
+            # control plane rides the Python fallback on the native server
+            assert c.size() > 0
+            assert c.num_internal_shards == 2
+            results[native_flag] = (flat, after)
+        finally:
+            c.shutdown()
+    np.testing.assert_array_equal(results[False][0], results[True][0])
+    np.testing.assert_array_equal(results[False][1], results[True][1])
+
+    # half-width wires against the native server
+    svc = ParameterServerService(fresh_store(), port=0, native_server=True).start()
+    c = StoreClient(f"127.0.0.1:{svc.port}", wire_dtype="float16")
+    c2 = StoreClient(f"127.0.0.1:{svc.port}", wire_dtype="bfloat16")
+    try:
+        c.wait_ready()
+        f16 = c.lookup_batched(signs, key_ofs, dims, True)
+        bf16 = c2.lookup_batched(signs, key_ofs, dims, True)
+        np.testing.assert_allclose(f16, results[True][0], rtol=0.01, atol=1e-3)
+        np.testing.assert_allclose(bf16, results[True][0], rtol=0.02, atol=1e-2)
+        c.update_batched(signs, key_ofs, dims, grads, ogs)
+    finally:
+        c.shutdown()
+        c2.shutdown()
