@@ -132,16 +132,52 @@ def main(argv=None) -> int:
         "--deterministic", action="store_true",
         help="reproducible mode: ordered batches, staleness=1 (ref: REPRODUCIBLE=1)",
     )
+    ap.add_argument(
+        "--data-path", default=None,
+        help="train on a real Criteo-Kaggle TSV (.tsv/.tsv.gz/.parquet — "
+        "label, 13 ints, 26 hex cats per row; persia_tpu.datasets.CriteoTSV) "
+        "instead of the synthetic stream; the last --eval-steps batches of "
+        "the budget are held out for eval",
+    )
     args = ap.parse_args(argv)
 
     vocabs = CRITEO_KAGGLE_VOCABS if args.scale == "kaggle" else CRITEO_1TB_VOCABS
     hashstack_above = None if args.scale == "kaggle" else 1_000_000
-    train = CriteoSynthetic(
-        num_samples=args.steps * args.batch_size, vocab_sizes=vocabs, seed=42
-    )
-    test = CriteoSynthetic(
-        num_samples=args.eval_steps * args.batch_size, vocab_sizes=vocabs, seed=4242
-    )
+    if args.data_path:
+        from persia_tpu.datasets import CriteoTSV
+
+        file_batches = list(
+            CriteoTSV(args.data_path).batches(
+                batch_size=args.batch_size,
+                limit_batches=args.steps + args.eval_steps,
+            )
+        )
+        if len(file_batches) <= args.eval_steps:
+            raise SystemExit(
+                f"{args.data_path} yields only {len(file_batches)} batches "
+                f"at batch_size={args.batch_size}; need > {args.eval_steps}"
+            )
+        args.steps = len(file_batches) - args.eval_steps
+
+        class _FileStream:
+            def __init__(self, batches, requires_grad):
+                self._batches = batches
+                self._rg = requires_grad
+
+            def batches(self, batch_size, requires_grad=True):
+                for b in self._batches:
+                    b.requires_grad = self._rg and requires_grad
+                    yield b
+
+        train = _FileStream(file_batches[: args.steps], True)
+        test = _FileStream(file_batches[args.steps:], False)
+    else:
+        train = CriteoSynthetic(
+            num_samples=args.steps * args.batch_size, vocab_sizes=vocabs, seed=42
+        )
+        test = CriteoSynthetic(
+            num_samples=args.eval_steps * args.batch_size, vocab_sizes=vocabs, seed=4242
+        )
 
     ctx = build_ctx(vocabs, ps_replicas=args.ps_replicas,
                     hashstack_above=hashstack_above, tier=args.tier,

@@ -1,0 +1,66 @@
+"""Write-back HBM embedding cache over the host parameter-server tier.
+
+The TPU answer to the reference's beyond-GPU-memory regime
+(`README.md:29` — 100T parameters on CPU parameter servers): keep the
+authoritative, unbounded-vocab store on the host PS tier
+(`persia_tpu.embedding.store` / `native_store`), but keep the *working set*
+resident in HBM as a fixed-size row pool, so
+
+- **hits** never cross the host↔device boundary at all: the step receives
+  int32 cache-row indices (4 B/id instead of ``4·dim`` B/id), gathers from
+  HBM, and applies the sparse optimizer **on device** to the cached rows —
+  gradients never leave the chip;
+- **misses** check full ``[emb | optimizer state]`` rows out of the PS
+  (`checkout_entries`) and scatter them into the cache inside the same
+  jitted step;
+- **evictions** (LRU, decided by the native C++ directory `native/cache.cpp`)
+  read the victim rows back out of the step (they ride the step's output)
+  and write them to the PS — the write-back.
+
+With a skewed (production-like) id distribution the steady-state miss rate
+is small, so per-step host↔device traffic approaches the fused HBM path's
+(ids only) while vocabulary stays unbounded like the reference's PS. This
+replaces the reference's *bounded-staleness* asynchrony with *bounded
+residency*: cached rows train fully synchronously (stronger than the
+reference's staleness>0 mode); only tier migration is asynchronous-ish.
+
+Pipelining: ``CachedTrainCtx.train_step`` defers the previous step's
+eviction write-back (and metric fetch) until after the current step is
+dispatched, so host-side preprocessing and PS traffic overlap the device
+step — the TPU analogue of the reference's latency-hiding lookup workers
+(`rust/persia-core/src/forward.rs:640-779`). A same-sign
+evict-then-re-miss across adjacent steps is detected on the host (the
+directory reports evictions synchronously) and forces the pending
+write-back to land before the fresh checkout reads the PS.
+
+Limitations (v1): hash-stack slots are not cacheable (their table keys are
+many-to-one per distinct id). Adam matches the pure-PS path to fp
+tolerance: the device's shared batch-level beta powers advance once per
+step and are mirrored to the PS each gradient batch — the reference's
+batch-level semantics (persia-common/src/optim.rs:99-221; parity-tested in
+tests/test_hbm_cache.py::test_cached_adam_matches_pure_ps_adam, like the
+Adagrad/SGD exactness tests). The one documented wrinkle: under
+dynamic_loss_scale, powers also advance on overflow-skipped steps (see
+build_cached_train_step).
+"""
+
+from persia_tpu.embedding.hbm_cache.directory import (  # noqa: F401
+    CacheDirectory,
+    _BufRing,
+    build_native,
+    native_uniform_init,
+)
+from persia_tpu.embedding.hbm_cache.groups import (  # noqa: F401
+    CacheGroup,
+    CacheLayout,
+    CachedTrainState,
+    init_cached_tables,
+    make_cache_groups,
+)
+from persia_tpu.embedding.hbm_cache.step import (  # noqa: F401
+    build_cached_eval_step,
+    build_cached_train_step,
+)
+from persia_tpu.embedding.hbm_cache.tier import CachedEmbeddingTier  # noqa: F401
+from persia_tpu.embedding.hbm_cache.ctx import CachedTrainCtx  # noqa: F401
+from persia_tpu.embedding.hbm_cache.stream import run_train_stream  # noqa: F401

@@ -1,0 +1,294 @@
+"""Native cache-directory bindings + host staging rings (split from the
+round-3 monolith; see package __init__ for the design overview)."""
+
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from persia_tpu.config import EmbeddingConfig
+from persia_tpu.data import PersiaBatch
+from persia_tpu.embedding.optim import OPTIMIZER_ADAM, OptimizerConfig
+from persia_tpu.embedding.worker import (
+    ProcessedBatch,
+    ProcessedSlot,
+    ShardedLookup,
+    preprocess_batch,
+)
+from persia_tpu.logger import get_default_logger
+from persia_tpu.utils import round_up_pow2 as _round_up_pow2
+from persia_tpu.metrics import get_metrics
+from persia_tpu.ops.sparse_update import sparse_update
+from persia_tpu.tracing import span
+
+logger = get_default_logger("persia_tpu.hbm_cache")
+
+# ------------------------------------------------------------------ ctypes
+
+
+# one extra level: this file lives in the hbm_cache PACKAGE
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+_SRC = os.path.join(_REPO_ROOT, "native", "cache.cpp")
+_SO = os.path.join(_REPO_ROOT, "native", "libpersia_cache.so")
+_LIB: Optional[ctypes.CDLL] = None
+
+_i64p = ctypes.POINTER(ctypes.c_int64)
+_u64p = ctypes.POINTER(ctypes.c_uint64)
+
+
+def build_native(force: bool = False) -> str:
+    from persia_tpu.embedding._native_build import build_so
+
+    return build_so(
+        _SRC, _SO, ["-O3", "-std=c++17", "-fPIC", "-shared", "-Wall"],
+        logger, force=force,
+    )
+
+
+def _load_lib() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is None:
+        build_native()
+        lib = ctypes.CDLL(_SO)
+        i64, p = ctypes.c_int64, ctypes.c_void_p
+        lib.cache_create.restype = p
+        lib.cache_create.argtypes = [i64]
+        lib.cache_destroy.argtypes = [p]
+        lib.cache_len.restype = i64
+        lib.cache_len.argtypes = [p]
+        lib.cache_capacity.restype = i64
+        lib.cache_capacity.argtypes = [p]
+        lib.cache_admit.restype = i64
+        lib.cache_admit.argtypes = [p, _u64p, i64, _i64p, _i64p, _u64p, _i64p, _i64p]
+        lib.cache_probe.argtypes = [p, _u64p, i64, _i64p]
+        lib.cache_drain.restype = i64
+        lib.cache_drain.argtypes = [p, _u64p, _i64p]
+        lib.cache_snapshot.restype = i64
+        lib.cache_snapshot.argtypes = [p, _u64p, _i64p]
+        lib.cache_set_admit_touches.argtypes = [p, i64]
+        _i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.cache_admit_positions.restype = i64
+        lib.cache_admit_positions.argtypes = [
+            p, _u64p, i64, _i32p, _u64p, _i64p, _u64p, _i64p,
+            ctypes.POINTER(i64), ctypes.POINTER(i64),
+        ]
+        lib.cache_uniform_init.argtypes = [
+            _u64p, i64, i64, ctypes.c_uint64, ctypes.c_double,
+            ctypes.c_double, ctypes.POINTER(ctypes.c_float),
+        ]
+        _LIB = lib
+    return _LIB
+
+
+def native_uniform_init(
+    signs: np.ndarray, seed: int, dim: int, lo: float, hi: float,
+    out: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Seeded cold-miss embedding init in C++ — bit-identical to
+    ``hashing.uniform_init_for_signs`` (tested). ``out`` (M, dim) f32
+    C-contiguous is filled in place when given."""
+    lib = _load_lib()
+    signs = np.ascontiguousarray(signs, dtype=np.uint64)
+    m = len(signs)
+    if out is None:
+        out = np.empty((m, dim), dtype=np.float32)
+    assert out.flags["C_CONTIGUOUS"] and out.dtype == np.float32
+    lib.cache_uniform_init(
+        signs.ctypes.data_as(_u64p), m, dim, ctypes.c_uint64(seed),
+        lo, hi, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    return out
+
+
+class _BufRing:
+    """Reusable host staging buffers for the per-step hot path.
+
+    Fresh ``np.zeros``/``np.empty`` of ~0.5-1 MB per step cross the
+    allocator's mmap threshold, so every step pays mmap + first-touch page
+    faults + munmap TLB churn — profiled at ~20 ms/step of pure allocator
+    cost on a single-core host, dwarfing the actual compute. A ring of
+    ``depth`` buffers per call-site key amortizes that to zero while keeping
+    a buffer alive long enough for any in-flight async ``device_put`` to
+    finish serializing before the slot comes around again (depth must
+    exceed the stream's prefetch depth; 8 > 3)."""
+
+    def __init__(self, depth: int = 8):
+        self.depth = depth
+        self._slots: Dict = {}
+
+    def ensure_depth(self, depth: int) -> None:
+        """Grow the ring so ``depth`` buffers rotate before any reuse.
+
+        Safe at any time: ``get`` keeps appending fresh buffers per key
+        until the ring holds ``self.depth`` of them, so raising the depth
+        simply extends the rotation; existing hand-outs are unaffected."""
+        if depth > self.depth:
+            self.depth = depth
+
+    def get(self, key, shape, dtype) -> np.ndarray:
+        arrs, idx = self._slots.get(key, ([], 0))
+        if len(arrs) < self.depth:
+            arr = np.empty(shape, dtype)
+            arrs.append(arr)
+            self._slots[key] = (arrs, 0)
+            return arr
+        arr = arrs[idx]
+        if arr.shape != shape or arr.dtype != np.dtype(dtype):
+            arr = np.empty(shape, dtype)
+            arrs[idx] = arr
+        self._slots[key] = (arrs, (idx + 1) % self.depth)
+        return arr
+
+    def full(self, key, shape, dtype, fill) -> np.ndarray:
+        arr = self.get(key, shape, dtype)
+        arr.fill(fill)
+        return arr
+
+
+class CacheDirectory:
+    """LRU map sign → device cache row (native C++, O(1) per op).
+
+    ``admit_touches`` — touch-gated admission (the reference's
+    ``admit_probability`` analogue, reference
+    `persia-embedding-config/src/lib.rs` HyperParameters): a non-resident
+    sign is admitted only on its Nth distinct-batch touch; earlier touches
+    map to the pad row ``capacity`` (zero forward contribution, gradient
+    dropped — the reference's non-admitted-sign semantics). Default 1 =
+    admit on first touch (exact parity with the ungated tier)."""
+
+    def __init__(self, capacity: int, admit_touches: int = 1):
+        self._lib = _load_lib()
+        self._h = self._lib.cache_create(capacity)
+        self.capacity = capacity
+        self.admit_touches = int(admit_touches)
+        if self.admit_touches > 1:
+            self._lib.cache_set_admit_touches(self._h, self.admit_touches)
+        # reusable admit_positions outputs: 5 scratch arrays (miss/evict
+        # results are .copy()'d out, so a single reused buffer each is safe)
+        # plus a ring for the per-position rows (which ESCAPE to the async
+        # device staging path as views)
+        self._scratch_n = 0
+        self._rows_ring = _BufRing()
+
+    def _ensure_scratch(self, n: int) -> None:
+        if n <= self._scratch_n:
+            return
+        self._scratch_n = n
+        self._s_miss_signs = np.empty(n, dtype=np.uint64)
+        self._s_miss_rows = np.empty(n, dtype=np.int64)
+        self._s_ev_signs = np.empty(n, dtype=np.uint64)
+        self._s_ev_rows = np.empty(n, dtype=np.int64)
+        self._s_miss_idx = np.empty(n, dtype=np.int64)
+
+    def __del__(self):
+        if getattr(self, "_h", None) is not None:
+            self._lib.cache_destroy(self._h)
+            self._h = None
+
+    def __len__(self) -> int:
+        return self._lib.cache_len(self._h)
+
+    def admit(self, signs: np.ndarray):
+        """signs must be deduplicated. Returns (rows (n,), miss_idx (M,),
+        evict_signs (K,), evict_rows (K,)). Raises if the batch's distinct
+        count exceeds capacity (the C call returns -1 *before* writing
+        rows_out, so the outputs are uninitialized in that case)."""
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        n = len(signs)
+        self._ensure_scratch(n)
+        # bucketed ring shape (n varies per batch; exact shapes would
+        # reallocate every call), result is the [:n] slice
+        rows = self._rows_ring.get("rows64", (_bucket(max(n, 1)),), np.int64)[:n]
+        miss_idx = self._s_miss_idx
+        ev_signs = self._s_ev_signs
+        ev_rows = self._s_ev_rows
+        n_evict = ctypes.c_int64(0)
+        n_miss = self._lib.cache_admit(
+            self._h, signs.ctypes.data_as(_u64p), n,
+            rows.ctypes.data_as(_i64p), miss_idx.ctypes.data_as(_i64p),
+            ev_signs.ctypes.data_as(_u64p), ev_rows.ctypes.data_as(_i64p),
+            ctypes.byref(n_evict),
+        )
+        if n_miss < 0:
+            raise RuntimeError(
+                f"batch distinct-sign count {n} exceeds cache capacity "
+                f"{self.capacity} — raise cache rows or shrink the batch"
+            )
+        k = n_evict.value
+        return rows, miss_idx[:n_miss].copy(), ev_signs[:k].copy(), ev_rows[:k].copy()
+
+    def admit_positions(self, signs: np.ndarray):
+        """Admit a RAW (duplicated) position-level sign stream — the dedup
+        happens natively. Returns (rows (n,) int32 per position,
+        miss_signs (M,), miss_rows (M,), evict_signs (K,), evict_rows (K,),
+        n_unique). One call replaces per-slot dedup + cross-slot dedup +
+        admit + row LUT for the single-id fast path."""
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        n = signs.size
+        self._ensure_scratch(n)
+        rows = self._rows_ring.get("rows", (_bucket(max(n, 1)),), np.int32)[:n]
+        miss_signs = self._s_miss_signs
+        miss_rows = self._s_miss_rows
+        ev_signs = self._s_ev_signs
+        ev_rows = self._s_ev_rows
+        n_unique = ctypes.c_int64(0)
+        n_evict = ctypes.c_int64(0)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        n_miss = self._lib.cache_admit_positions(
+            self._h, signs.ctypes.data_as(_u64p), n,
+            rows.ctypes.data_as(i32p),
+            miss_signs.ctypes.data_as(_u64p), miss_rows.ctypes.data_as(_i64p),
+            ev_signs.ctypes.data_as(_u64p), ev_rows.ctypes.data_as(_i64p),
+            ctypes.byref(n_unique), ctypes.byref(n_evict),
+        )
+        if n_miss < 0:
+            raise RuntimeError(
+                f"batch distinct-sign count exceeds cache capacity "
+                f"{self.capacity} — raise cache rows or shrink the batch"
+            )
+        k = n_evict.value
+        return (
+            rows, miss_signs[:n_miss].copy(), miss_rows[:n_miss].copy(),
+            ev_signs[:k].copy(), ev_rows[:k].copy(), n_unique.value,
+        )
+
+    def probe(self, signs: np.ndarray) -> np.ndarray:
+        """Read-only residency check: row per sign, -1 on miss. No admit, no
+        LRU touch — safe for eval/infer batches."""
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        rows = np.empty(len(signs), dtype=np.int64)
+        self._lib.cache_probe(self._h, signs.ctypes.data_as(_u64p), len(signs),
+                              rows.ctypes.data_as(_i64p))
+        return rows
+
+    def drain(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Empty the directory; returns (signs, rows) of everything resident."""
+        cap = self.capacity
+        signs = np.empty(cap, dtype=np.uint64)
+        rows = np.empty(cap, dtype=np.int64)
+        k = self._lib.cache_drain(self._h, signs.ctypes.data_as(_u64p),
+                                  rows.ctypes.data_as(_i64p))
+        return signs[:k].copy(), rows[:k].copy()
+
+    def snapshot(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Non-destructive (signs, rows) of everything resident — no LRU
+        churn, no eviction, directory unchanged."""
+        cap = self.capacity
+        signs = np.empty(cap, dtype=np.uint64)
+        rows = np.empty(cap, dtype=np.int64)
+        k = self._lib.cache_snapshot(self._h, signs.ctypes.data_as(_u64p),
+                                     rows.ctypes.data_as(_i64p))
+        return signs[:k].copy(), rows[:k].copy()
+
+
+# ------------------------------------------------------------ device state

@@ -1,0 +1,343 @@
+"""The fused cached-tier train/eval step builders (one jitted XLA
+program per step: gather -> model fwd/bwd -> dense update -> on-device
+sparse update -> eviction payload)."""
+
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from persia_tpu.config import EmbeddingConfig
+from persia_tpu.data import PersiaBatch
+from persia_tpu.embedding.optim import OPTIMIZER_ADAM, OptimizerConfig
+from persia_tpu.embedding.worker import (
+    ProcessedBatch,
+    ProcessedSlot,
+    ShardedLookup,
+    preprocess_batch,
+)
+from persia_tpu.logger import get_default_logger
+from persia_tpu.utils import round_up_pow2 as _round_up_pow2
+from persia_tpu.metrics import get_metrics
+from persia_tpu.ops.sparse_update import sparse_update
+from persia_tpu.tracing import span
+
+logger = get_default_logger("persia_tpu.hbm_cache")
+
+# ------------------------------------------------------------------ ctypes
+
+
+from persia_tpu.embedding.hbm_cache.groups import (  # noqa: F401
+    CacheGroup,
+    CacheLayout,
+    CachedTrainState,
+    _apply_aux,
+    _entry_to_state_cols,
+    _gather_entry_rows,
+    _model_emb_from_gathered,
+    _restore_rows,
+    _scatter_entry_block,
+    _slot_group_of,
+    _state_init_consts,
+    _bucket,
+)
+
+def build_cached_train_step(
+    model,
+    dense_optimizer,
+    sparse_cfg: OptimizerConfig,
+    groups: Sequence[CacheGroup],
+    loss_fn=None,
+    donate: bool = True,
+    ps_grad_dtype=jnp.float32,
+    dynamic_loss_scale: bool = False,
+    growth_interval: int = 2000,
+    growth_factor: float = 2.0,
+    backoff_factor: float = 0.5,
+    max_scale: float = float(2 ** 24),
+):
+    """Jitted ``step(state, batch, layout) -> (state, header)``.
+
+    batch = {
+      "dense": [(B,F) f32], "labels": [(B,1) f32],
+      "stacked_rows": {group: (S, B, L) int32 cache rows for the group's
+                       pooled slots (stack order = layout.stacked), pad = C
+                       (the zero row)},
+      "stacked_scale": {group: (S, B) f32} — omitted when no slot scales,
+      "raw_rows": {slot: (B, L) int32} for sequence slots,
+      "ps_emb": [ {"pooled": (B,D)} | {"distinct","index","mask"} ... ] —
+                mixed-tier slots served by the worker/PS path
+                (layout.ps names them, in order),
+    }
+    Miss scatters and the evict-payload read run as a separate fused tiny
+    jit (``_apply_aux``) dispatched by the ctx around this step, so this —
+    the expensive compile — sees only fixed-shape inputs. Returns
+    ``(state, header, ps_gpacked)``: header = [loss, preds...]; ps_gpacked
+    = flat f32 gradients of the ps_emb entries (empty when none) for the
+    worker's gradient return.
+
+    ``dynamic_loss_scale`` (same management as the hybrid path's
+    build_train_step; ref GradScaler, persia/ctx.py:926-1005): the loss is
+    scaled before backward, an on-device finite check over EVERY gradient
+    (dense + cached + ps) gates the update — overflow skips the dense
+    update AND the cached-row sparse update (scale *= backoff), a finite
+    streak grows the scale. Header becomes [loss | scale | finite | preds],
+    and ps_gpacked carries [grads... | scale | finite] so the write-back
+    thread can unscale/skip without any extra device fetch. One documented
+    divergence from the reference: the Adam beta powers (device AND PS)
+    advance on overflow-skipped steps too — keeping the two tiers' powers
+    in lockstep without a per-step device sync; the skipped step itself
+    applies no gradient anywhere.
+    """
+    from functools import partial
+
+    from persia_tpu.parallel.train_step import default_loss_fn
+
+    loss_fn = loss_fn or default_loss_fn
+    by_name = {g.name: g for g in groups}
+
+    @partial(jax.jit, static_argnums=(2,), donate_argnums=(0,) if donate else ())
+    def step(state: CachedTrainState, batch: Dict, layout: CacheLayout):
+        tables, emb_state = dict(state.tables), dict(state.emb_state)
+
+        # ONE gather per group for all its stacked pooled slots, plus one
+        # per raw slot; differentiate w.r.t. the GATHERED arrays (like the
+        # fused path) so cotangents stay gather-shaped instead of dense
+        # table-shaped scatters
+        stacked_gathered = {
+            gname: tables[gname][rows]  # (S, B, L, dim)
+            for gname, rows in batch["stacked_rows"].items()
+        }
+        raw_gathered = {
+            name: tables[_slot_group_of(groups, name)][rows]
+            for name, rows in batch["raw_rows"].items()
+        }
+        from persia_tpu.parallel.train_step import (
+            _embedding_model_inputs, _split_emb,
+        )
+
+        ps_diff, ps_static = _split_emb(batch.get("ps_emb", []))
+
+        scale = (
+            state.loss_scale.scale
+            if dynamic_loss_scale
+            else jnp.asarray(1.0, jnp.float32)
+        )
+
+        def loss_wrapper(params, stacked_in, raw_in, ps_in):
+            model_emb = _model_emb_from_gathered(
+                groups, batch, layout, stacked_in, raw_in,
+                pad_row=lambda gname: by_name[gname].rows,
+                ps_model_inputs=_embedding_model_inputs(ps_in, ps_static),
+            )
+            variables = {"params": params}
+            if state.batch_stats:
+                variables["batch_stats"] = state.batch_stats
+                logits, updates = model.apply(
+                    variables, batch["dense"], model_emb, train=True,
+                    mutable=["batch_stats"],
+                )
+                new_stats = updates["batch_stats"]
+            else:
+                logits = model.apply(variables, batch["dense"], model_emb, train=True)
+                new_stats = state.batch_stats
+            loss = loss_fn(logits, batch["labels"][0])
+            return loss * scale.astype(loss.dtype), (loss, logits, new_stats)
+
+        (_, (loss, logits, new_stats)), (param_grads, stacked_g, raw_g, ps_g) = (
+            jax.value_and_grad(
+                loss_wrapper, argnums=(0, 1, 2, 3), has_aux=True
+            )(state.params, stacked_gathered, raw_gathered, ps_diff)
+        )
+
+        if dynamic_loss_scale:
+            leaves = (
+                jax.tree.leaves(param_grads)
+                + jax.tree.leaves(stacked_g) + jax.tree.leaves(raw_g)
+                + jax.tree.leaves(ps_g)
+            )
+            finite = jnp.all(
+                jnp.stack([jnp.all(jnp.isfinite(g)) for g in leaves])
+            )
+            inv = jnp.where(finite, 1.0 / scale, 0.0).astype(jnp.float32)
+            param_grads = jax.tree.map(
+                lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype),
+                param_grads,
+            )
+        else:
+            finite = jnp.asarray(True)
+            inv = jnp.asarray(1.0, jnp.float32)
+
+        import optax as _optax
+
+        updates, new_opt_state = dense_optimizer.update(
+            param_grads, state.opt_state, state.params
+        )
+        new_params = _optax.apply_updates(state.params, updates)
+        if dynamic_loss_scale:
+            # overflow: dense update skipped entirely
+            new_params = jax.tree.map(
+                lambda new, old: jnp.where(finite, new, old),
+                new_params, state.params,
+            )
+            new_opt_state = jax.tree.map(
+                lambda new, old: jnp.where(finite, new, old),
+                new_opt_state, state.opt_state,
+            )
+
+        # on-device sparse update of the cached rows — ONE duplicate-safe
+        # scatter per group (dedup inside sparse_update merges the same row
+        # appearing in several slots)
+        batch_state = state.emb_batch_state * jnp.array(
+            [sparse_cfg.beta1, sparse_cfg.beta2], dtype=jnp.float32
+        )
+        for g in groups:
+            idp, gp, mp = [], [], []
+            if g.name in batch["stacked_rows"]:
+                rows = batch["stacked_rows"][g.name]
+                idp.append(rows.reshape(-1))
+                # unscale under dynamic loss scaling; on overflow every row
+                # is MASKED OUT below (sparse_update touches no row at all —
+                # exact skip for every optimizer incl. weight decay and
+                # Adam's state decay, at O(touched rows)); the grads are
+                # also selected to zero so inf*0 NaNs never enter the math
+                sg = stacked_g[g.name].astype(jnp.float32).reshape(-1, g.dim)
+                gp.append(jnp.where(finite, sg * inv, 0.0))
+                mp.append(((rows < g.rows) & finite).reshape(-1))
+            for name in g.raw_slots:
+                if name not in batch["raw_rows"]:
+                    continue
+                rows = batch["raw_rows"][name]
+                idp.append(rows.reshape(-1))
+                rg = raw_g[name].astype(jnp.float32).reshape(-1, g.dim)
+                gp.append(jnp.where(finite, rg * inv, 0.0))
+                mp.append(((rows < g.rows) & finite).reshape(-1))
+            if not idp:
+                continue
+            tables[g.name], emb_state[g.name] = sparse_update(
+                sparse_cfg,
+                tables[g.name],
+                emb_state[g.name],
+                jnp.concatenate(idp) if len(idp) > 1 else idp[0],
+                jnp.concatenate(gp) if len(gp) > 1 else gp[0],
+                batch_state,
+                mask=jnp.concatenate(mp) if len(mp) > 1 else mp[0],
+            )
+
+        new_ls = state.loss_scale
+        if dynamic_loss_scale:
+            from persia_tpu.parallel.train_step import LossScaleState
+
+            good = jnp.where(finite, state.loss_scale.good_steps + 1, 0)
+            grown = good >= growth_interval
+            new_scale = jnp.where(
+                finite,
+                jnp.where(grown, scale * growth_factor, scale),
+                scale * backoff_factor,
+            )
+            new_scale = jnp.clip(new_scale, 1.0, max_scale)
+            new_ls = LossScaleState(
+                scale=new_scale, good_steps=jnp.where(grown, 0, good)
+            )
+        new_state = CachedTrainState(
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt_state,
+            tables=tables,
+            emb_state=emb_state,
+            emb_batch_state=batch_state,
+            step=state.step + 1,
+            loss_scale=new_ls,
+        )
+        head = [jnp.reshape(loss, (1,)).astype(jnp.float32)]
+        if dynamic_loss_scale:
+            head.append(jnp.reshape(scale, (1,)).astype(jnp.float32))
+            head.append(jnp.reshape(finite, (1,)).astype(jnp.float32))
+        head.append(jnp.reshape(jax.nn.sigmoid(logits), (-1,)).astype(jnp.float32))
+        header = jnp.concatenate(head)
+        # ps-tier gradients are an inherent d2h; a bf16 wire halves the
+        # bytes on the return path (the reference ships scaled-f16 grad
+        # wires, lib.rs:157-180) — the host casts back to f32 before the
+        # worker's unscale/update. Under dynamic scaling the buffer's last
+        # two entries are [scale | finite] (both exact in bf16: scale is a
+        # power of two), so the write-back thread needs no extra fetch.
+        ps_flat = [jnp.reshape(g, (-1,)).astype(ps_grad_dtype) for g in ps_g]
+        if dynamic_loss_scale and ps_flat:
+            ps_flat.append(
+                jnp.stack([scale, finite.astype(jnp.float32)]).astype(ps_grad_dtype)
+            )
+        ps_gpacked = (
+            jnp.concatenate(ps_flat) if ps_flat
+            else jnp.zeros((0,), ps_grad_dtype)
+        )
+        return new_state, header, ps_gpacked
+
+    return step
+
+
+def build_cached_eval_step(model, groups: Sequence[CacheGroup]):
+    """Jitted ``eval_step(state, batch, layout) -> preds``.
+
+    Eval must not mutate the cache (no admits, no evictions, no directory
+    churn — the ADVICE round-1 corruption bug): resident signs gather from
+    the live cache tables; misses arrive as a host-side PS lookup
+    (``miss_tables``: {group: (Mp, dim)}) with rows pre-assigned to C+1+j.
+    Values come from a two-gather select (no table concat — concatenating
+    would copy the multi-GB pool per eval batch). Mask rule here is
+    ``rows != C`` (pad) since miss rows legitimately exceed C."""
+    from functools import partial
+
+    by_name = {g.name: g for g in groups}
+
+    def _gather_ext(table, miss_table, rows, C):
+        from_cache = table[jnp.minimum(rows, C)]
+        miss_idx = jnp.maximum(rows - (C + 1), 0)
+        from_miss = miss_table[miss_idx].astype(table.dtype)
+        return jnp.where((rows > C)[..., None], from_miss, from_cache)
+
+    @partial(jax.jit, static_argnums=(2,))
+    def eval_step(state: CachedTrainState, batch: Dict, layout: CacheLayout):
+        stacked_gathered = {}
+        for gname, rows in batch["stacked_rows"].items():
+            C = by_name[gname].rows
+            stacked_gathered[gname] = _gather_ext(
+                state.tables[gname], batch["miss_tables"][gname], rows, C
+            )
+        raw_gathered = {}
+        for name, rows in batch["raw_rows"].items():
+            gname = _slot_group_of(groups, name)
+            C = by_name[gname].rows
+            raw_gathered[name] = _gather_ext(
+                state.tables[gname], batch["miss_tables"][gname], rows, C
+            )
+        from persia_tpu.parallel.train_step import (
+            _embedding_model_inputs, _split_emb,
+        )
+
+        ps_diff, ps_static = _split_emb(batch.get("ps_emb", []))
+        model_emb = _model_emb_from_gathered(
+            groups, batch, layout, stacked_gathered, raw_gathered,
+            pad_row=lambda gname: by_name[gname].rows,
+            ps_model_inputs=_embedding_model_inputs(ps_diff, ps_static),
+        )
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        logits = model.apply(variables, batch["dense"], model_emb, train=False)
+        return jax.nn.sigmoid(logits)
+
+    return eval_step
+
+
+# -------------------------------------------------------------- host tier
+
+

@@ -1,0 +1,493 @@
+"""The asynchronous streaming train loop of the cached tier (feeder ->
+stager -> dispatch -> write-back pipeline), split out of CachedTrainCtx
+-- ``CachedTrainCtx.train_stream`` delegates here."""
+
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from persia_tpu.config import EmbeddingConfig
+from persia_tpu.data import PersiaBatch
+from persia_tpu.embedding.optim import OPTIMIZER_ADAM, OptimizerConfig
+from persia_tpu.embedding.worker import (
+    ProcessedBatch,
+    ProcessedSlot,
+    ShardedLookup,
+    preprocess_batch,
+)
+from persia_tpu.logger import get_default_logger
+from persia_tpu.utils import round_up_pow2 as _round_up_pow2
+from persia_tpu.metrics import get_metrics
+from persia_tpu.ops.sparse_update import sparse_update
+from persia_tpu.tracing import span
+
+logger = get_default_logger("persia_tpu.hbm_cache")
+
+# ------------------------------------------------------------------ ctypes
+
+
+from persia_tpu.embedding.hbm_cache.groups import (  # noqa: F401
+    CacheLayout,
+    _bucket,
+)
+from persia_tpu.embedding.hbm_cache.directory import _BufRing  # noqa: F401
+
+def run_train_stream(
+    self,
+    batches,
+    prefetch: int = 3,
+    on_metrics: Optional[Callable[[Dict], None]] = None,
+    wb_flush_steps: int = 8,
+    fetch_final: bool = True,
+    psgrad_batch: int = 8,
+) -> Optional[Dict]:
+    """Fully-pipelined training over an iterable of ``PersiaBatch``.
+
+    Three concurrent stages (the TPU analogue of the reference's
+    latency-hiding forward/backward engines, forward.rs:640-779 /
+    backward.rs:304-354):
+
+    - a **feeder thread** runs host preprocessing, the directory admit,
+      the PS checkout, and kicks off the async host→device staging for
+      batch N+k while the device executes batch N;
+    - the **caller's thread** only dispatches the (tiny) device programs
+      in order;
+    - a **write-back thread** materializes each step's eviction payload
+      (the device→host transfer) and persists it to the PS.
+
+    Correctness across threads: the directory is only touched by the
+    feeder (serial admits), and the feeder's hazard gate blocks a PS
+    checkout while an overlapping eviction write-back is in flight.
+    Returns the final step's metrics; ``on_metrics`` (if given) receives
+    every step's metrics at the cost of a per-step device sync.
+
+    Mixed-tier configs stream too: PS-tier slots forward in the feeder
+    thread and their gradients return through the write-back thread, so
+    they train under BOUNDED staleness (a forward may read entries
+    whose previous-step gradients are in flight, the window set by the
+    prefetch depth) — the reference's async mode; cached slots stay
+    fully synchronous.
+
+    ``psgrad_batch``: PS-tier gradient returns are device→host fetches;
+    on a high-latency link a serial per-step fetch caps the whole
+    pipeline at 1/latency. The write-back thread therefore accumulates
+    up to ``psgrad_batch`` consecutive steps' gradient outputs and
+    fetches them CONCURRENTLY (parallel transfers share the latency),
+    then applies them to the worker in step order — the staleness
+    window grows to ``prefetch + psgrad_batch`` steps, the same
+    throughput/staleness trade the reference's lookup-worker count
+    sets (forward.rs:640-779).
+
+    ``fetch_final=False`` keeps the loop COMPLETELY free of
+    device→host transfers: the final header is only
+    ``block_until_ready``-synced (completion without a fetch) and
+    stashed device-side; ``last_metrics()`` materializes it on demand.
+    On a remote-attached chip a d2h fetch costs tens of ms and can
+    permanently degrade the runtime's dispatch latency (measured ~200×
+    on the axon tunnel), so throughput-critical loops should defer every
+    fetch past the region they care about.
+    """
+    import queue as _queue
+
+    if prefetch < 1:
+        raise ValueError(f"prefetch must be >= 1, got {prefetch}")
+    # The feeder→stager path holds up to prefetch (prep_q) + 2 in-hand
+    # batches of host staging buffers, each still referenced by an async
+    # device_put until its h2d lands. Size every staging ring so a slot
+    # cannot come around for reuse while that many items (plus h2d
+    # slack) are in flight — otherwise a deep-prefetch stream would
+    # silently corrupt device-side data.
+    need_depth = prefetch + 4
+    self.tier._ring.ensure_depth(need_depth)
+    for d in self.tier.dirs.values():
+        d._rows_ring.ensure_depth(need_depth)
+
+    self._land_pending()  # do not mix with a sync-path deferred step
+    # pending eviction write-backs, seq → per-group record:
+    #   {"sorted": {g: sorted u64 signs}, "order": {g: payload row of
+    #    each sorted sign}, "payload": None | {g: DEVICE (Kp, entry_len)}}
+    # "payload" is filled by the main thread at dispatch; the record is
+    # deleted once the batched write-back lands it in the PS.
+    pending: Dict[int, Dict] = {}
+    cv = threading.Condition()
+    stop = threading.Event()
+    staged_q: "_queue.Queue" = _queue.Queue(maxsize=prefetch)
+    # bounds device-memory retention: at most ~(queue + one flush batch)
+    # steps of eviction payloads (+ one psgrad batch) stay pinned in HBM
+    # while the PS lags
+    wb_q: "_queue.Queue" = _queue.Queue(
+        maxsize=max(1, wb_flush_steps) + prefetch + max(1, psgrad_batch)
+    )
+    SENTINEL = object()
+    errors: List[BaseException] = []
+
+    def gate(gname: str, miss_signs: np.ndarray):
+        """Resolve re-missed pending-evicted signs against the in-flight
+        DEVICE payloads: returns restore descriptors, never waits for a
+        device→host transfer (only, rarely, for the main thread to
+        dispatch the step that produces a just-evicted payload)."""
+        out = []
+        with cv:
+            while not (stop.is_set() or errors):
+                out.clear()
+                waiting = False
+                picks: Dict[int, Tuple[int, int]] = {}  # pos → (seq, src)
+                for seq in sorted(pending):  # later steps override earlier
+                    rec = pending[seq]
+                    sg = rec["sorted"].get(gname)
+                    if sg is None:
+                        continue
+                    loc = np.searchsorted(sg, miss_signs)
+                    loc_c = np.minimum(loc, len(sg) - 1)
+                    mask = sg[loc_c] == miss_signs
+                    if not mask.any():
+                        continue
+                    if rec["payload"] is None:
+                        waiting = True  # step not yet dispatched
+                        continue
+                    order = rec["order"][gname]
+                    for i in np.nonzero(mask)[0].tolist():
+                        picks[i] = (seq, int(order[loc_c[i]]))
+                if not waiting:
+                    by_seq: Dict[int, List] = {}
+                    for i, (seq, j) in picks.items():
+                        by_seq.setdefault(seq, []).append((i, j))
+                    for seq, ij in by_seq.items():
+                        pos = np.array([i for i, _ in ij], dtype=np.int64)
+                        src = np.array([j for _, j in ij], dtype=np.int64)
+                        out.append(
+                            (pending[seq]["payload"][gname], src, pos)
+                        )
+                    break
+                cv.wait(timeout=1.0)
+        return out or None
+
+    prep_q: "_queue.Queue" = _queue.Queue(maxsize=prefetch)
+
+    def _put(q, item) -> bool:
+        while not (stop.is_set() or errors):
+            try:
+                q.put(item, timeout=0.5)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def feeder_prep():
+        """Stage 1: host preprocessing + directory admit + PS probe."""
+        seq = 0
+        try:
+            for batch in batches:
+                if stop.is_set() or errors:
+                    break
+                with span("stream.prep"):
+                    item = self.tier.prepare_batch(batch, hazard_gate=gate)
+                with span("stream.ps_forward"):
+                    ps_item = self._ps_forward(batch)
+                if ps_item is not None:
+                    _ref, embs, _counts, entries = ps_item
+                    di0 = item[0]
+                    di0["ps_emb"] = entries
+                    layout0 = CacheLayout(
+                        stacked=item[1].stacked,
+                        ps=tuple(eb.name for eb in embs),
+                    )
+                    item = (di0, layout0) + item[2:]
+                evict_meta = item[6]
+                # evicted signs become hazard-gated HERE (admit time): a
+                # later batch's probe must not trust the PS for them
+                # until the write-back lands their payload
+                if evict_meta:
+                    rec = {"sorted": {}, "order": {}, "payload": None}
+                    for gn, (ev, k) in evict_meta.items():
+                        order = np.argsort(ev[:k])
+                        rec["sorted"][gn] = ev[:k][order]
+                        rec["order"][gn] = order
+                    with cv:
+                        pending[seq] = rec
+                if not _put(prep_q, (seq, item, ps_item)):
+                    if ps_item is not None:
+                        self.worker.abort_gradient(ps_item[0])
+                    return
+                seq += 1
+        except BaseException as e:  # noqa: BLE001 — propagate to caller
+            errors.append(e)
+            with cv:
+                cv.notify_all()
+        finally:
+            prep_q.put(SENTINEL)
+
+    def feeder_dp():
+        """Stage 2: async host→device staging, overlapped with stage 1's
+        preprocessing of the following batch."""
+        try:
+            while True:
+                got = prep_q.get()
+                if got is SENTINEL:
+                    break
+                seq, item, ps_item = got
+                (di, layout, miss_aux, cold_aux, restore_aux, evict_aux,
+                 evict_meta) = item
+                with span("stream.stage"):
+                    di, miss_aux, cold_aux, evict_aux = self._stage(
+                        di, miss_aux, cold_aux, evict_aux
+                    )
+                # restore index arrays must commit like every other aux
+                # input: on a mesh an uncommitted put lands on one
+                # device and _restore_rows would see incompatible
+                # devices against the replicated tables
+                rep = self._replicated()
+                restore_aux = (
+                    jax.device_put(restore_aux) if rep is None
+                    else jax.device_put(restore_aux, rep)
+                )
+                if not _put(
+                    staged_q,
+                    (seq, di, layout, miss_aux, cold_aux, restore_aux,
+                     evict_aux, evict_meta, ps_item),
+                ):
+                    if ps_item is not None:
+                        self.worker.abort_gradient(ps_item[0])
+                    return
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+            with cv:
+                cv.notify_all()
+        finally:
+            staged_q.put(SENTINEL)  # main's shutdown drain guarantees room
+
+    # device→host transfers cost ~60 ms latency each regardless of size,
+    # so the write-back batches many steps' payloads and fetches them
+    # CONCURRENTLY (parallel transfers share the latency), then persists
+    # to the PS. The gate never needs host data (device-side restore).
+    FLUSH_STEPS = max(1, wb_flush_steps)
+
+    def _flush_acc(acc) -> None:
+        if not acc:
+            return
+        with span("stream.wb_flush", steps=len(acc)):
+            _flush_acc_inner(acc)
+
+    def _flush_acc_inner(acc) -> None:
+        pool = self._fetch_pool()
+        fetches = []  # (seq, gname, k, device payload)
+        for seq, evict_meta, evict_payload in acc:
+            for gn, (ev, k) in evict_meta.items():
+                fetches.append((seq, gn, ev, k, evict_payload[gn]))
+
+        def fetch(f):
+            return np.asarray(f[4])[:f[3]].astype(np.float32)
+
+        hosts = list(pool.map(fetch, fetches)) if pool else [fetch(f) for f in fetches]
+        for (seq, gn, ev, k, _p), host in zip(fetches, hosts):
+            g = next(gr for gr in self.tier.groups if gr.name == gn)
+            self.tier._set_embedding(ev[:k], host[:k], dim=g.dim)
+        with cv:
+            for seq, _m, _p in acc:
+                pending.pop(seq, None)
+            cv.notify_all()
+        acc.clear()
+
+    PS_BATCH = max(1, psgrad_batch)
+
+    def _abort_ps_refs(items) -> None:
+        """Best-effort staleness-slot release for queued psgrad items
+        (shutdown paths): one place owns which tuple element holds the
+        ref and the swallow-exceptions policy."""
+        for it in items:
+            try:
+                self.worker.abort_gradient(it[1][0])
+            except Exception:  # noqa: BLE001 — shutdown best-effort
+                pass
+        if isinstance(items, list):
+            items.clear()
+
+    def _flush_ps(ps_acc) -> None:
+        """Fetch the accumulated steps' packed ps-grad outputs
+        CONCURRENTLY (d2h latency is shared), then apply to the worker
+        in step order. On an apply failure, not-yet-applied refs are
+        aborted (the failing apply aborts its own ref itself).
+
+        Ordering vs eviction write-backs: NONE needed — the constructor
+        rejects configs where a feature group spans both tiers, so a PS
+        gradient can never touch a sign an eviction wrote back; psgrad
+        batches and eviction flushes proceed independently, each keeping
+        its own concurrent-fetch batching."""
+        if not ps_acc:
+            return
+        pool = self._fetch_pool()
+
+        def fetch(it):
+            return np.asarray(it[2])
+
+        hosts = (
+            list(pool.map(fetch, ps_acc)) if pool
+            else [fetch(it) for it in ps_acc]
+        )
+        k = 0
+        try:
+            for k, ((_tag, ps_item, _g), host) in enumerate(
+                zip(ps_acc, hosts)
+            ):
+                self._apply_ps_grads(ps_item, host)
+        except BaseException:
+            _abort_ps_refs(ps_acc[k + 1:])
+            ps_acc.clear()
+            raise
+        ps_acc.clear()
+
+    def writeback():
+        acc: List = []
+        ps_acc: List = []
+        while True:
+            item = wb_q.get()
+            try:
+                if item is SENTINEL:
+                    _flush_acc(acc)
+                    _flush_ps(ps_acc)
+                    return
+                if isinstance(item, tuple) and item[0] == "psgrad":
+                    ps_acc.append(item)
+                    if len(ps_acc) >= PS_BATCH:
+                        _flush_ps(ps_acc)
+                    continue
+                acc.append(item)
+                if len(acc) >= FLUSH_STEPS:
+                    _flush_acc(acc)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+                _abort_ps_refs(ps_acc)
+                with cv:
+                    for seq, _m, _p in acc:
+                        pending.pop(seq, None)
+                    acc.clear()
+                    cv.notify_all()
+                if item is SENTINEL:
+                    return
+
+    feeder_t = threading.Thread(target=feeder_prep, daemon=True, name="cache-feeder")
+    dp_t = threading.Thread(target=feeder_dp, daemon=True, name="cache-stager")
+    wb_t = threading.Thread(target=writeback, daemon=True, name="cache-writeback")
+    feeder_t.start()
+    dp_t.start()
+    wb_t.start()
+    header = None
+    label_shape = None
+
+    def _abort_drained(got) -> None:
+        # a drained-but-never-applied item may carry a PS-tier forward
+        # ref: release its staleness slot + stashed layout
+        if (
+            isinstance(got, tuple) and len(got) >= 3
+            and got[-1] is not None
+            and isinstance(got[-1], tuple) and len(got[-1]) == 4
+        ):
+            try:
+                self.worker.abort_gradient(got[-1][0])
+            except Exception:  # noqa: BLE001 — shutdown best-effort
+                pass
+
+    try:
+        while True:
+            item = staged_q.get()
+            if item is SENTINEL:
+                break
+            if errors:
+                _abort_drained(item)
+                break
+            (seq, di, layout, miss_aux, cold_aux, restore_aux, evict_aux,
+             evict_meta, ps_item) = item
+            try:
+                if self.state is None:
+                    self.init_state(jax.random.PRNGKey(0), di, layout)
+                with span("stream.dispatch"):
+                    header, evict_payload, ps_gpacked = self._dispatch(
+                        di, layout, miss_aux, cold_aux, restore_aux,
+                        evict_aux
+                    )
+            except BaseException:
+                # the in-hand item is already off the queue: the
+                # shutdown drain in finally can't see it, so its
+                # staleness ref must be released HERE or it leaks
+                if ps_item is not None:
+                    try:
+                        self.worker.abort_gradient(ps_item[0])
+                    except Exception:  # noqa: BLE001 — shutdown best-effort
+                        pass
+                raise
+            if ps_item is not None:
+                # gradient return for PS-tier slots rides the write-back
+                # thread (its d2h is off the dispatch path); FIFO order
+                # keeps the worker's per-batch Adam advance in step order
+                wb_q.put(("psgrad", ps_item, ps_gpacked))
+            label_shape = di["labels"][0].shape
+            if evict_meta:
+                # publish the DEVICE payload so the feeder's gate can
+                # build restores for re-missed signs without any d2h
+                with cv:
+                    if seq in pending:
+                        pending[seq]["payload"] = evict_payload
+                    cv.notify_all()
+                wb_q.put((seq, evict_meta, evict_payload))
+            if self.sparse_cfg.kind == OPTIMIZER_ADAM:
+                # mirror the device's beta-power advance on the PS every
+                # gradient batch (same contract as the sync train_step)
+                for grp in self._cached_groups:
+                    self.tier.router.advance_batch_state(grp)
+            if on_metrics is not None:
+                self._last_metrics = self._parse_header(
+                    np.asarray(header), label_shape
+                )
+                on_metrics(self._last_metrics)
+    finally:
+        stop.set()
+        with cv:
+            cv.notify_all()
+
+        # unblock stages stuck on full queues, then reap all threads
+        while feeder_t.is_alive() or dp_t.is_alive():
+            try:
+                _abort_drained(prep_q.get_nowait())
+            except _queue.Empty:
+                pass
+            try:
+                _abort_drained(staged_q.get(timeout=0.1))
+            except _queue.Empty:
+                pass
+        # final sweep AFTER the feeders died: on an error shutdown they
+        # exit on their own, leaving queued items whose PS forward refs
+        # would otherwise leak staleness slots
+        for q in (prep_q, staged_q):
+            while True:
+                try:
+                    _abort_drained(q.get_nowait())
+                except _queue.Empty:
+                    break
+        wb_q.put(SENTINEL)
+        feeder_t.join(timeout=300)
+        dp_t.join(timeout=300)
+        wb_t.join(timeout=300)
+    if errors:
+        raise RuntimeError("cached train pipeline failed") from errors[0]
+    if header is not None:
+        if on_metrics is not None or fetch_final:
+            if on_metrics is None:
+                self._last_metrics = self._parse_header(
+                    np.asarray(header), label_shape
+                )
+            self._last_header_dev = None  # this stream is the freshest
+        else:
+            jax.block_until_ready(header)  # completion, no transfer
+            self._last_header_dev = (header, label_shape)
+            return None
+    return self._last_metrics

@@ -1,0 +1,695 @@
+"""CachedEmbeddingTier: host-side cache directory + PS traffic
+(probe/checkout/write-back) + per-batch staging."""
+
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from persia_tpu.config import EmbeddingConfig
+from persia_tpu.data import PersiaBatch
+from persia_tpu.embedding.optim import OPTIMIZER_ADAM, OptimizerConfig
+from persia_tpu.embedding.worker import (
+    ProcessedBatch,
+    ProcessedSlot,
+    ShardedLookup,
+    preprocess_batch,
+)
+from persia_tpu.logger import get_default_logger
+from persia_tpu.utils import round_up_pow2 as _round_up_pow2
+from persia_tpu.metrics import get_metrics
+from persia_tpu.ops.sparse_update import sparse_update
+from persia_tpu.tracing import span
+
+logger = get_default_logger("persia_tpu.hbm_cache")
+
+# ------------------------------------------------------------------ ctypes
+
+
+from persia_tpu.embedding.hbm_cache.directory import (  # noqa: F401
+    CacheDirectory,
+    _BufRing,
+)
+from persia_tpu.embedding.hbm_cache.groups import (  # noqa: F401
+    CacheGroup,
+    CacheLayout,
+    _bucket,
+    _lazy_pool,
+    _slot_group_of,
+    _state_init_consts,
+    init_cached_tables,
+    make_cache_groups,
+)
+
+class CachedEmbeddingTier:
+    """Host orchestration: directory admits, PS checkouts, write-backs.
+
+    ``worker`` is an ``EmbeddingWorker`` (its ``lookup_router`` fans checkout
+    and write-back out to the sharded PS replicas; its dump/load provide the
+    checkpoint path for the authoritative store)."""
+
+    def __init__(
+        self,
+        worker,
+        sparse_cfg: OptimizerConfig,
+        rows: "int | Dict[int, int]",
+        embedding_config: Optional[EmbeddingConfig] = None,
+        init_seed: Optional[int] = None,
+        ps_slots: Sequence[str] = (),
+        admit_touches: int = 1,
+        aux_wire_dtype: str = "float32",
+    ):
+        self.worker = worker
+        self.cfg = embedding_config or worker.embedding_config
+        self.sparse_cfg = sparse_cfg
+        if aux_wire_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"aux_wire_dtype must be float32/bfloat16, got {aux_wire_dtype!r}"
+            )
+        # host→device wire dtype for the per-step miss/cold aux matrices
+        # (the largest per-step transfers). bf16 halves the bytes on a
+        # bandwidth-starved link; the device scatter casts to the table
+        # dtype, so only the checked-out entries/seeds are quantized (the
+        # reference ships f16 lookup wires the same way, lib.rs:157-180).
+        import ml_dtypes
+
+        self.aux_np_dtype = (
+            np.dtype(ml_dtypes.bfloat16)
+            if aux_wire_dtype == "bfloat16" else np.dtype(np.float32)
+        )
+        # cold misses are seeded-init ON THE HOST (bit-identical to the PS's
+        # init) and never touch the PS until eviction — the tier must know
+        # the PS seed + init bounds (all replicas share them by convention)
+        if init_seed is None:
+            init_seed = getattr(worker.lookup_router.replicas[0], "seed", None)
+            if init_seed is None:
+                raise ValueError(
+                    "init_seed not given and PS replicas expose no .seed "
+                    "(pass init_seed= to CachedEmbeddingTier/CachedTrainCtx)"
+                )
+        self.init_seed = int(init_seed)
+        self.init_bounds = tuple(worker.hyperparams.emb_initialization)
+        dims = {
+            slot.dim
+            for name, slot in self.cfg.slots_config.items()
+            if not slot.hash_stack_config.enabled and name not in ps_slots
+        }
+        rows_per_group = rows if isinstance(rows, dict) else {d: rows for d in dims}
+        self.groups, self.ps_slots = make_cache_groups(
+            self.cfg, rows_per_group, sparse_cfg, exclude=ps_slots
+        )
+        # a feature group is ONE shared key space (members share an index
+        # prefix): a cached slot and a ps-tier slot in the same group would
+        # be two incoherent writers to the same PS entries (cache copies go
+        # stale against direct PS updates) — reject the arrangement
+        cached_names = {s for g in self.groups for s in g.slots}
+        for fg_name, members in self.cfg.feature_groups.items():
+            ms = set(members)
+            if ms & cached_names and ms & set(self.ps_slots):
+                raise ValueError(
+                    f"feature group {fg_name!r} mixes cached slots "
+                    f"{sorted(ms & cached_names)} with PS-tier slots "
+                    f"{sorted(ms & set(self.ps_slots))}: one key space "
+                    "cannot span both tiers"
+                )
+        # The tier-disjointness above only partitions the PS key space when
+        # groups carry distinct sign prefixes. With feature_index_prefix_bit
+        # == 0 every slot hashes into one raw u64 space, so a PS-tier sign
+        # can collide with a cached-tier sign across groups and eviction
+        # flushes vs ps-grad applies would become unordered writers to the
+        # same PS entry.
+        if self.groups and self.ps_slots and self.cfg.feature_index_prefix_bit == 0:
+            raise ValueError(
+                "mixed-tier config (cached groups + PS-tier slots "
+                f"{sorted(self.ps_slots)}) requires feature_index_prefix_bit "
+                "> 0 so per-group sign prefixes partition the PS key space; "
+                "with prefix bit 0 a cached-tier sign can collide with a "
+                "PS-tier sign and the two tiers would race on one PS entry"
+            )
+        self.dirs = {
+            g.name: CacheDirectory(g.rows, admit_touches=admit_touches)
+            for g in self.groups
+        }
+        # host staging-buffer reuse (see _BufRing): all per-step aux pieces
+        # and probe results come from here instead of fresh mmap allocations
+        self._ring = _BufRing()
+        self._slot_group = {s: g for g in self.groups for s in g.slots}
+        # static fast-path eligibility per slot (config is immutable): the
+        # per-batch check reduces to "every feature single-id" (the only
+        # data-dependent part)
+        self._fast_prefix: Dict[str, np.uint64] = {}
+        self._fast_eligible: Dict[str, bool] = {}
+        for name, slot in self.cfg.slots_config.items():
+            self._fast_eligible[name] = (
+                slot.embedding_summation
+                and not slot.sqrt_scaling
+                and not slot.hash_stack_config.enabled
+            )
+            self._fast_prefix[name] = slot.index_prefix
+        m = get_metrics()
+        self._m_hit = m.counter(
+            "persia_tpu_cache_hit_count", "batch distinct signs resident in HBM"
+        )
+        self._m_miss = m.counter(
+            "persia_tpu_cache_miss_count", "batch distinct signs checked out of the PS"
+        )
+        self._m_evict = m.counter(
+            "persia_tpu_cache_evict_count", "rows written back to the PS on eviction"
+        )
+
+    @property
+    def router(self) -> ShardedLookup:
+        return self.worker.lookup_router
+
+    # PS traffic helpers: big checkout/write-back calls chunk across the
+    # worker's thread pool (the native store releases the GIL; its internal
+    # shard mutexes make disjoint chunks near-contention-free)
+    _PAR_CHUNK = 8192
+    _chunk_pool_obj = None
+
+    def _chunk_pool(self):
+        """Pool for chunking big host store calls (probe/write-back): ctypes
+        store calls release the GIL, so chunks get real parallelism on
+        multi-core feeder hosts. Daemon threads; lives with the tier."""
+        self._chunk_pool_obj = _lazy_pool(self._chunk_pool_obj, "cache-chunk")
+        return self._chunk_pool_obj
+
+    def _probe(self, signs: np.ndarray, dim: int):
+        """Chunk-parallel warm/cold probe across the worker's thread pool.
+        Results land in ring-reused caller-owned buffers (chunks write
+        disjoint slices, so concurrent fills are safe)."""
+        n = len(signs)
+        entry_len = dim + self.sparse_cfg.state_dim(dim)
+        # ring shapes are bucketed (n varies every step; an exact-shape ring
+        # would reallocate every call), results are the [:n] slices
+        nb = _bucket(max(n, 1))
+        vals = self._ring.get(
+            ("probe_vals", entry_len), (nb, entry_len), np.float32
+        )[:n]
+        warm8 = self._ring.get("probe_warm", (nb,), np.uint8)[:n]
+        if n <= self._PAR_CHUNK:
+            return self.router.probe_entries(
+                signs, dim, vals_out=vals, warm_out=warm8
+            )
+        pool = self._chunk_pool()
+        bounds = list(range(0, n, self._PAR_CHUNK)) + [n]
+
+        def chunk(se):
+            s, e = se
+            self.router.probe_entries(
+                signs[s:e], dim, vals_out=vals[s:e], warm_out=warm8[s:e]
+            )
+
+        list(pool.map(chunk, zip(bounds[:-1], bounds[1:])))
+        return warm8.view(np.bool_), vals
+
+    def _set_embedding(self, signs: np.ndarray, values: np.ndarray, dim: int) -> None:
+        n = len(signs)
+        if n <= self._PAR_CHUNK:
+            self.router.set_embedding(
+                signs, values, dim=dim, commit_incremental=True
+            )
+            return
+        pool = self._chunk_pool()
+        bounds = list(range(0, n, self._PAR_CHUNK)) + [n]
+        list(
+            pool.map(
+                lambda se: self.router.set_embedding(
+                    signs[se[0]:se[1]], values[se[0]:se[1]], dim=dim,
+                    commit_incremental=True,
+                ),
+                zip(bounds[:-1], bounds[1:]),
+            )
+        )
+
+    # ------------------------------------------------------------- helpers
+
+    def _group_slots(self, pb: ProcessedBatch) -> Dict[str, List[ProcessedSlot]]:
+        out: Dict[str, List[ProcessedSlot]] = {}
+        for slot in pb.slots:
+            out.setdefault(self._slot_group[slot.name].name, []).append(slot)
+        for slots in out.values():
+            slots.sort(key=lambda s: s.name)
+        return out
+
+    @staticmethod
+    def _dedup_group_signs(slots: List[ProcessedSlot]):
+        """Concatenate the group's per-slot distinct signs and dedup ACROSS
+        slots (the directory's contract requires globally distinct signs —
+        with feature_index_prefix_bit=0 two slots can carry the same sign)."""
+        from persia_tpu.embedding import native_worker
+
+        all_signs = (
+            np.concatenate([s.distinct for s in slots])
+            if slots else np.empty(0, np.uint64)
+        )
+        native = native_worker.dedup(all_signs)
+        if native is not None:
+            uniq, inv = native
+        else:
+            uniq, inv = np.unique(all_signs, return_inverse=True)
+        return all_signs, uniq, inv.astype(np.int64)
+
+    def _stack_layout(self, g: CacheGroup, slots: List[ProcessedSlot]):
+        """Common (B, L) layout for the group's pooled slots: L = max count
+        across those slots (pow2-bucketed to bound recompiles)."""
+        pooled = [s for s in slots if s.config.embedding_summation]
+        if not pooled:
+            return pooled, 0
+        max_c = max((int(s.counts.max()) if len(s.counts) else 1) for s in pooled)
+        return pooled, _round_up_pow2(max(max_c, 1), floor=1)
+
+    def _slot_rows(
+        self, slot: ProcessedSlot, slot_rows: np.ndarray, L: int, pad_row: int
+    ) -> np.ndarray:
+        idx = _position_index(slot, L)
+        lut = np.append(slot_rows, np.int64(pad_row))
+        return lut[idx].astype(np.int32)
+
+    # ------------------------------------------------------------ train path
+
+    def _admit_aux(
+        self, g: CacheGroup, miss_signs, rows_miss, ev_signs, ev_rows,
+        n_unique, hazard_gate, miss_aux, cold_aux, restore_aux, evict_aux,
+        evict_meta,
+    ) -> None:
+        """Post-admit bookkeeping shared by the general and single-id fast
+        paths: metrics, the cross-step write-back hazard gate, the
+        warm/cold miss split (WARM = PS holds trained state, full entry
+        ships; COLD = brand-new sign, host-seeded emb only, no PS touch
+        until eviction), and the eviction read-back bucket."""
+        C = g.rows
+        self._m_hit.inc(n_unique - len(miss_signs))
+        self._m_miss.inc(len(miss_signs))
+        self._m_evict.inc(len(ev_signs))
+
+        resolved = None
+        if hazard_gate is not None and len(miss_signs):
+            resolved = hazard_gate(g.name, miss_signs)
+
+        m = len(miss_signs)
+        if m:
+            handled = np.zeros(m, dtype=bool)
+            if resolved:
+                for payload, src_idx, pos in resolved:
+                    handled[pos] = True
+                    # pow2-bucketed; src pad reads row 0 harmlessly, dst
+                    # pad C+1 is dropped by the scatter
+                    S = len(pos)
+                    sp = _round_up_pow2(S)
+                    src = np.zeros(sp, dtype=np.int64)
+                    dst = np.full(sp, C + 1, dtype=np.int32)
+                    src[:S] = src_idx
+                    dst[:S] = rows_miss[pos]
+                    restore_aux.setdefault(g.name, []).append(
+                        (payload, src, dst)
+                    )
+            with span("cache.ps_probe", n=m):
+                warm, vals = self._probe(miss_signs, g.dim)
+            widx = np.nonzero(warm[:m] & ~handled)[0]
+            cidx = np.nonzero(~warm[:m] & ~handled)[0]
+            # aux buffers come from the reuse ring and escape to the async
+            # staging path; pad regions carry garbage values on purpose —
+            # pad rows are C+1, which the scatters drop
+            if len(widx):
+                entry_len = g.dim + g.state_dim
+                wp = _bucket(len(widx))
+                w_rows = self._ring.full(("w_rows", g.name), (wp,), np.int32, C + 1)
+                w_entries = self._ring.get(
+                    ("w_entries", g.name), (wp, entry_len), self.aux_np_dtype
+                )
+                w_rows[:len(widx)] = rows_miss[widx]
+                w_entries[:len(widx)] = vals[widx]  # casts on a bf16 wire
+                miss_aux[g.name] = (w_rows, w_entries)
+            if len(cidx):
+                lo, hi = self.init_bounds
+                cp = _bucket(len(cidx))
+                c_rows = self._ring.full(("c_rows", g.name), (cp,), np.int32, C + 1)
+                c_f32 = self._ring.get(("c_emb_f32", g.name), (cp, g.dim), np.float32)
+                c_rows[:len(cidx)] = rows_miss[cidx]
+                native_uniform_init(
+                    miss_signs[cidx], self.init_seed, g.dim, lo, hi,
+                    out=c_f32[:len(cidx)],
+                )
+                if self.aux_np_dtype == np.float32:
+                    c_emb = c_f32
+                else:
+                    c_emb = self._ring.get(
+                        ("c_emb", g.name), (cp, g.dim), self.aux_np_dtype
+                    )
+                    c_emb[:len(cidx)] = c_f32[:len(cidx)]
+                cold_aux[g.name] = (c_rows, c_emb)
+        # evictions: rows to read back (pad → zero row, host slices K)
+        k = len(ev_rows)
+        if k:
+            kp = _bucket(k)
+            e_rows = self._ring.full(("e_rows", g.name), (kp,), np.int32, C)
+            e_rows[:k] = ev_rows
+            evict_aux[g.name] = e_rows
+            evict_meta[g.name] = (ev_signs, k)
+
+    def _single_id_groups(self, batch: PersiaBatch):
+        """The fast-path precondition: EVERY group is pooled-only, no
+        hash-stack, no sqrt scaling, and every feature carries exactly one
+        id per sample. Returns [(group, slot_names, (S, B) prefixed sign
+        matrix), ...] or None (→ general path)."""
+        from persia_tpu.embedding import native_worker
+        from persia_tpu.embedding.hashing import add_index_prefix
+
+        feats = {
+            f.name: f for f in batch.id_type_features
+            if f.name not in self.ps_slots  # mixed-tier: worker/PS path
+        }
+        for name in feats:
+            if name not in self._slot_group:
+                # same loud failure the general path's preprocess raises
+                raise KeyError(f"unknown slot {name!r} (not in embedding config)")
+            if not self._fast_eligible[name]:  # static per-slot precompute
+                return None
+
+        out = []
+        prefix_bit = self.cfg.feature_index_prefix_bit
+        for g in self.groups:
+            names = [n for n in g.pooled_slots if n in feats]
+            if not names:
+                continue
+            flats = []
+            for name in names:
+                flat, counts = feats[name].flat_counts()
+                # exactly one id per sample — a total that merely EQUALS the
+                # batch size (counts like [2, 0, 1, ...]) would misalign ids
+                # to samples
+                if len(flat) != len(counts) or not (counts == 1).all():
+                    return None
+                flats.append(np.ascontiguousarray(flat, dtype=np.uint64))
+            mat = self._ring.get(
+                ("sid_mat", g.name), (len(names), len(flats[0])), np.uint64
+            )
+            # ONE native call builds every prefixed row (the per-slot numpy
+            # prefix-OR + copy loop was a measurable share of the feeder)
+            prefixes = np.array(
+                [self._fast_prefix[n] for n in names], dtype=np.uint64
+            )
+            if not native_worker.build_sid_matrix(
+                flats, prefixes, prefix_bit, mat
+            ):
+                for i, (name, flat) in enumerate(zip(names, flats)):
+                    mat[i] = add_index_prefix(
+                        flat, self._fast_prefix[name], prefix_bit
+                    )
+            out.append((g, tuple(names), mat))
+        return out
+
+    def prepare_batch(
+        self,
+        batch: PersiaBatch,
+        hazard_gate: Optional[Callable[[np.ndarray], None]] = None,
+    ):
+        """Admit the batch's distinct signs, check misses out of the PS, and
+        build the device step inputs. Returns (device_inputs, layout,
+        miss_aux, cold_aux, restore_aux, evict_aux, evict_meta) where
+        miss_aux/cold_aux hold warm/cold miss scatters, restore_aux holds
+        device-side re-admissions resolved by the hazard gate, and
+        evict_meta = {group: (evict_signs, true_K)} describes the write-back
+        due after the step.
+
+        ``hazard_gate(group_name, miss_signs)``: called before each group's
+        PS probe. When a pipelined caller has eviction write-backs still in
+        flight, a fresh miss on one of those signs would read stale data
+        from the PS. The gate returns a list of ``(payload, src_idx,
+        positions)`` restore descriptors — ``payload`` a DEVICE-resident
+        eviction payload array, ``src_idx`` rows within it, ``positions``
+        the resolved indices into ``miss_signs`` — and those signs are
+        re-admitted by an on-device row restore instead of a host checkout.
+        ``None`` means no overlap."""
+        fast = self._single_id_groups(batch)
+        if fast is not None:
+            return self._prepare_batch_single_id(batch, fast, hazard_gate)
+        cached_feats = [
+            f for f in batch.id_type_features if f.name not in self.ps_slots
+        ]
+        pb = preprocess_batch(cached_feats, self.cfg)
+        slots_by_group = self._group_slots(pb)
+
+        stacked_rows: Dict[str, np.ndarray] = {}
+        stacked_scale: Dict[str, np.ndarray] = {}
+        layout_stacked: List[Tuple[str, Tuple[str, ...]]] = []
+        raw_rows: Dict[str, np.ndarray] = {}
+        miss_aux: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        cold_aux: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        restore_aux: Dict[str, List] = {}
+        evict_aux: Dict[str, np.ndarray] = {}
+        evict_meta: Dict[str, Tuple[np.ndarray, int]] = {}
+        any_scale = False
+
+        for g in self.groups:
+            slots = slots_by_group.get(g.name, [])
+            if not slots:
+                continue
+            C = g.rows
+            all_signs, uniq, inv = self._dedup_group_signs(slots)
+            rows_u, miss_idx, ev_signs, ev_rows = self.dirs[g.name].admit(uniq)
+            rows = rows_u[inv]  # per original (slot-concatenated) position
+            miss_signs = uniq[miss_idx]
+            self._admit_aux(
+                g, miss_signs, rows_u[miss_idx], ev_signs, ev_rows,
+                len(uniq), hazard_gate,
+                miss_aux, cold_aux, restore_aux, evict_aux, evict_meta,
+            )
+
+            # per-slot row matrices: pooled slots stack into (S, B, L)
+            pooled, L = self._stack_layout(g, slots)
+            off = 0
+            stack_mats, scale_mats, stack_names = [], [], []
+            for slot in slots:
+                d = slot.num_distinct
+                srows = rows[off:off + d]
+                off += d
+                if slot.config.embedding_summation:
+                    stack_names.append(slot.name)
+                    stack_mats.append(self._slot_rows(slot, srows, L, C))
+                    if slot.config.sqrt_scaling:
+                        any_scale = True
+                        scale_mats.append(
+                            (1.0 / np.sqrt(np.maximum(slot.counts, 1))).astype(np.float32)
+                        )
+                    else:
+                        scale_mats.append(
+                            np.ones(slot.batch_size, dtype=np.float32)
+                        )
+                else:
+                    raw_rows[slot.name] = self._slot_rows(
+                        slot, srows, slot.config.sample_fixed_size, C
+                    )
+            if stack_mats:
+                stacked_rows[g.name] = np.stack(stack_mats)
+                stacked_scale[g.name] = np.stack(scale_mats)
+                layout_stacked.append((g.name, tuple(stack_names)))
+
+        device_inputs = {
+            "dense": [np.asarray(f.data, dtype=np.float32) for f in batch.non_id_type_features],
+            "labels": [np.asarray(l.data, dtype=np.float32) for l in batch.labels],
+            "stacked_rows": stacked_rows,
+            "raw_rows": raw_rows,
+        }
+        if any_scale:
+            device_inputs["stacked_scale"] = stacked_scale
+        layout = CacheLayout(stacked=tuple(layout_stacked))
+        return (
+            device_inputs, layout, miss_aux, cold_aux, restore_aux,
+            evict_aux, evict_meta,
+        )
+
+    def _prepare_batch_single_id(self, batch: PersiaBatch, fast, hazard_gate):
+        """Single-id fast path: ONE native call per group
+        (``cache_admit_positions``: dedup + admit + per-position rows) and
+        the row matrix is its output reshaped — no per-slot dedup, no row
+        LUT, no stack copy. Dominates the 1-core feeder's budget on the
+        Criteo-style all-single-id shape."""
+        stacked_rows: Dict[str, np.ndarray] = {}
+        layout_stacked: List[Tuple[str, Tuple[str, ...]]] = []
+        miss_aux: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        cold_aux: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        restore_aux: Dict[str, List] = {}
+        evict_aux: Dict[str, np.ndarray] = {}
+        evict_meta: Dict[str, Tuple[np.ndarray, int]] = {}
+
+        for g, names, mat in fast:
+            S, B = mat.shape
+            with span("cache.admit", group=g.name, n=mat.size):
+                (rows, miss_signs, miss_rows, ev_signs, ev_rows,
+                 n_unique) = self.dirs[g.name].admit_positions(mat.reshape(-1))
+            with span("cache.admit_aux", group=g.name, misses=len(miss_signs)):
+                self._admit_aux(
+                    g, miss_signs, miss_rows, ev_signs, ev_rows, n_unique,
+                    hazard_gate, miss_aux, cold_aux, restore_aux, evict_aux,
+                    evict_meta,
+                )
+            stacked_rows[g.name] = rows.reshape(S, B, 1)
+            layout_stacked.append((g.name, names))
+
+        device_inputs = {
+            "dense": [np.asarray(f.data, dtype=np.float32) for f in batch.non_id_type_features],
+            "labels": [np.asarray(l.data, dtype=np.float32) for l in batch.labels],
+            "stacked_rows": stacked_rows,
+            "raw_rows": {},
+        }
+        layout = CacheLayout(stacked=tuple(layout_stacked))
+        return (
+            device_inputs, layout, miss_aux, cold_aux, restore_aux,
+            evict_aux, evict_meta,
+        )
+
+    # ------------------------------------------------------------- eval path
+
+    def prepare_eval_batch(self, batch: PersiaBatch):
+        """Build eval-step inputs with ZERO cache mutation: resident signs
+        map to their cache rows via a read-only probe; misses get a plain
+        infer PS lookup (zeros for never-trained signs, no admission) and
+        ride as an appended miss table with rows C+1+j."""
+        cached_feats = [
+            f for f in batch.id_type_features if f.name not in self.ps_slots
+        ]
+        pb = preprocess_batch(cached_feats, self.cfg)
+        slots_by_group = self._group_slots(pb)
+
+        stacked_rows: Dict[str, np.ndarray] = {}
+        stacked_scale: Dict[str, np.ndarray] = {}
+        layout_stacked: List[Tuple[str, Tuple[str, ...]]] = []
+        raw_rows: Dict[str, np.ndarray] = {}
+        miss_tables: Dict[str, np.ndarray] = {}
+        any_scale = False
+
+        for g in self.groups:
+            slots = slots_by_group.get(g.name, [])
+            if not slots:
+                continue
+            C = g.rows
+            all_signs, uniq, inv = self._dedup_group_signs(slots)
+            rows_u = self.dirs[g.name].probe(uniq)
+            miss_mask = rows_u < 0
+            miss_signs = uniq[miss_mask]
+            m = len(miss_signs)
+            mp = _round_up_pow2(max(m, 1))
+            mt = np.zeros((mp, g.dim), dtype=np.float32)
+            if m:
+                mt[:m] = self.router.lookup(miss_signs, g.dim, train=False)
+                rows_u = rows_u.copy()
+                rows_u[miss_mask] = C + 1 + np.arange(m)
+            miss_tables[g.name] = mt
+            rows = rows_u[inv]
+
+            pooled, L = self._stack_layout(g, slots)
+            off = 0
+            stack_mats, scale_mats, stack_names = [], [], []
+            for slot in slots:
+                d = slot.num_distinct
+                srows = rows[off:off + d]
+                off += d
+                if slot.config.embedding_summation:
+                    stack_names.append(slot.name)
+                    stack_mats.append(self._slot_rows(slot, srows, L, C))
+                    if slot.config.sqrt_scaling:
+                        any_scale = True
+                        scale_mats.append(
+                            (1.0 / np.sqrt(np.maximum(slot.counts, 1))).astype(np.float32)
+                        )
+                    else:
+                        scale_mats.append(np.ones(slot.batch_size, dtype=np.float32))
+                else:
+                    raw_rows[slot.name] = self._slot_rows(
+                        slot, srows, slot.config.sample_fixed_size, C
+                    )
+            if stack_mats:
+                stacked_rows[g.name] = np.stack(stack_mats)
+                stacked_scale[g.name] = np.stack(scale_mats)
+                layout_stacked.append((g.name, tuple(stack_names)))
+
+        inputs = {
+            "dense": [np.asarray(f.data, dtype=np.float32) for f in batch.non_id_type_features],
+            "labels": [np.asarray(l.data, dtype=np.float32) for l in batch.labels],
+            "stacked_rows": stacked_rows,
+            "raw_rows": raw_rows,
+            "miss_tables": miss_tables,
+        }
+        if any_scale:
+            inputs["stacked_scale"] = stacked_scale
+        return inputs, CacheLayout(stacked=tuple(layout_stacked))
+
+    # ------------------------------------------------------------ write-back
+
+    def write_back(self, evict_meta, evict_payload) -> None:
+        """Persist evicted rows to the PS (full [emb | state] entries)."""
+        for gname, (ev_signs, k) in evict_meta.items():
+            if not k:
+                continue
+            g = next(gr for gr in self.groups if gr.name == gname)
+            payload = np.asarray(evict_payload[gname])[:k].astype(np.float32)
+            self._set_embedding(ev_signs[:k], payload, dim=g.dim)
+
+    def _write_rows(self, g: CacheGroup, signs, rows, tables, emb_state) -> None:
+        """Shared flush/publish body: gather ``[emb | state]`` for the given
+        rows ON DEVICE (one d2h transfer of only those entries — fetching
+        the full pool arrays would cost the whole table per call on a
+        bandwidth-starved link) and persist to the PS as training updates."""
+        kp = _round_up_pow2(len(rows))
+        rpad = np.zeros(kp, dtype=np.int64)  # pad rows re-read row 0, sliced off
+        rpad[:len(rows)] = rows
+        payload = _gather_entry_rows(
+            tables[g.name], emb_state[g.name], jax.device_put(rpad)
+        )
+        host = np.asarray(payload)[:len(rows)].astype(np.float32)
+        self._set_embedding(signs, host, dim=g.dim)
+
+    def flush(self, tables, emb_state) -> None:
+        """Drain every cached row back to the PS (checkpoint/eval boundary).
+        ``tables``/``emb_state`` are the CURRENT device arrays."""
+        for g in self.groups:
+            signs, rows = self.dirs[g.name].drain()
+            if len(signs):
+                self._write_rows(g, signs, rows, tables, emb_state)
+
+    def publish(self, tables, emb_state) -> int:
+        """Write every RESIDENT row to the PS without evicting anything —
+        the serving-freshness valve. Eviction write-backs only cover rows
+        that LEAVE the cache, so a hot sign trained every step would ship no
+        incremental update while it stays resident; publishing on the
+        serving cadence closes that gap (the reference needs no equivalent —
+        its PS sees every gradient). Returns the number of rows published."""
+        total = 0
+        for g in self.groups:
+            signs, rows = self.dirs[g.name].snapshot()  # no directory churn
+            if len(signs):
+                self._write_rows(g, signs, rows, tables, emb_state)
+                total += len(signs)
+        return total
+
+
+def _position_index(slot: ProcessedSlot, L: int) -> np.ndarray:
+    """(B, L) matrix of positions into the slot's distinct array (pad == D),
+    reusing the native raw-index builder."""
+    from persia_tpu.embedding import native_worker
+
+    idx = native_worker.raw_index(slot.counts, slot.inverse, L, slot.num_distinct)
+    if idx is None:
+        idx = np.full((slot.batch_size, L), slot.num_distinct, dtype=np.int32)
+        pos = 0
+        for b, c in enumerate(slot.counts.tolist()):
+            take = min(c, L)
+            idx[b, :take] = slot.inverse[pos:pos + take]
+            pos += c
+    return idx
+
+
+# ------------------------------------------------------------------- ctx
+
+

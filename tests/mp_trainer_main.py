@@ -1,0 +1,164 @@
+"""Trainer-process entry for the multi-process e2e test
+(tests/test_multiprocess_trainer.py) — NOT a pytest module.
+
+One DDP trainer rank: ``jax.distributed`` over CPU (gloo collectives), a
+``TrainerDataflow`` receiver fed by the test's loaders, embedding lookups
+and gradient returns through the shared RPC worker/PS tier, and a dense
+train step jitted over the GLOBAL mesh (each rank contributes its local
+batch shard via ``host_local_array_to_global_array``; XLA inserts the
+dense-gradient psum — the reference's DDP allreduce,
+`persia/distributed.py`). Rank 0 evaluates the held-out stream with the
+final replicated params and writes ``{"auc": ...}`` to the result file.
+
+Config via env (the launcher's nn-worker role passes the environment
+through): JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID
+(read by ``initialize_multihost()``), MP_DF_PORT, MP_WORKER_ADDR,
+MP_N_LOADERS, MP_OUT (rank 0's result file).
+"""
+
+import json
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    pid = int(os.environ["JAX_PROCESS_ID"])
+    df_port = int(os.environ["MP_DF_PORT"])
+    worker_addr = os.environ["MP_WORKER_ADDR"]
+    n_loaders = int(os.environ["MP_N_LOADERS"])
+    out_path = os.environ["MP_OUT"]
+
+    import numpy as np
+    import optax
+    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from persia_tpu.dataflow import TrainerDataflow
+    from persia_tpu.distributed import initialize_multihost
+    from persia_tpu.models import DLRM
+    from persia_tpu.service.clients import WorkerClient
+    from persia_tpu.testing import SyntheticClickDataset, roc_auc
+
+    df = TrainerDataflow(port=df_port)
+    initialize_multihost()  # env-driven (JAX_COORDINATOR_ADDRESS etc.)
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+
+    worker = WorkerClient(worker_addr)
+    worker.wait_serving(timeout_s=120)
+    from persia_tpu.embedding.optim import Adagrad
+
+    worker.register_optimizer(Adagrad(lr=0.1).config)  # idempotent per rank
+
+    model = DLRM(embedding_dim=8, bottom_mlp=(16, 8), top_mlp=(32,))
+    opt = optax.adam(3e-3)
+
+    def to_global(arr, spec):
+        return multihost_utils.host_local_array_to_global_array(arr, mesh, spec)
+
+    def local_host(garr):
+        """This PROCESS's rows of a batch-sharded global array: all
+        addressable shards in row order (a process may own several mesh
+        devices — e.g. the test harness's 8 virtual CPUs per rank)."""
+        shards = sorted(
+            garr.addressable_shards, key=lambda s: s.index[0].start or 0
+        )
+        return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+
+    def step_fn(params, opt_state, dense, labels, pooled):
+        def loss_fn(p, pooled):
+            logits = model.apply({"params": p}, [dense], list(pooled), train=True)
+            return (
+                optax.sigmoid_binary_cross_entropy(logits, labels).mean(),
+                logits,
+            )
+
+        (loss, _), (gp, gemb) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True
+        )(params, pooled)
+        updates, opt_state = opt.update(gp, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss, gemb
+
+    step = jax.jit(step_fn)
+
+    params = opt_state = None
+    slot_names = None
+    n_local = 0
+    for batch in df.dataset(num_loaders=n_loaders, timeout_s=120):
+        widx, ref = batch.remote_ref
+        embs = worker.forward_batch_id(ref, train=True)
+        slot_names = [e.name for e in embs]
+        dense_np = np.asarray(batch.non_id_type_features[0].data, np.float32)
+        labels_np = np.asarray(batch.labels[0].data, np.float32)
+        pooled_np = [np.asarray(e.pooled, np.float32) for e in embs]
+        if params is None:
+            # init from LOCAL host arrays (shapes only), then replicate —
+            # the same PRNGKey yields identical values on every rank
+            variables = model.init(
+                jax.random.PRNGKey(0), [dense_np], pooled_np, train=False
+            )
+            params = jax.tree.map(
+                lambda x: to_global(np.asarray(x), P()), variables["params"]
+            )
+            opt_state = jax.tree.map(
+                lambda x: to_global(np.asarray(x), P()) if hasattr(x, "shape")
+                else x,
+                opt.init(variables["params"]),
+            )
+        dense = to_global(dense_np, P("data"))
+        labels = to_global(labels_np, P("data"))
+        pooled = tuple(to_global(x, P("data")) for x in pooled_np)
+        params, opt_state, loss, gemb = step(
+            params, opt_state, dense, labels, pooled
+        )
+        if n_local % 4 == 0:
+            gfin = all(
+                np.isfinite(np.asarray(g.addressable_shards[0].data)).all()
+                for g in gemb
+            )
+            print(
+                f"[rank {pid}] step {n_local} bid {batch.batch_id} "
+                f"loss {float(np.asarray(loss.addressable_data(0))):.4f} "
+                f"pooled_fin {all(np.isfinite(x).all() for x in pooled_np)} "
+                f"dense_fin {np.isfinite(dense_np).all()} "
+                f"lab {labels_np.min()}..{labels_np.max()} "
+                f"gemb_fin {gfin}",
+                flush=True,
+            )
+        # each rank returns the gradients for ITS local rows (its own ref)
+        worker.update_gradient_batched(
+            ref, {n: local_host(g) for n, g in zip(slot_names, gemb)}
+        )
+        n_local += 1
+
+    if pid == 0:
+        host_params = jax.tree.map(
+            lambda p: np.asarray(p.addressable_data(0)), params
+        )
+        eval_ds = SyntheticClickDataset(
+            num_samples=1024, vocab_sizes=(64, 32, 16, 100, 50, 8), seed=43
+        )
+        preds, labs = [], []
+        fwd = jax.jit(
+            lambda p, d, e: model.apply({"params": p}, [d], list(e), train=False)
+        )
+        for b in eval_ds.batches(batch_size=128, requires_grad=False):
+            embs = worker.forward_directly(b, train=False)
+            logits = fwd(
+                host_params,
+                np.asarray(b.non_id_type_features[0].data, np.float32),
+                tuple(np.asarray(e.pooled, np.float32) for e in embs),
+            )
+            preds.append(1.0 / (1.0 + np.exp(-np.asarray(logits))))
+            labs.append(np.asarray(b.labels[0].data))
+        auc = roc_auc(np.concatenate(labs), np.concatenate(preds))
+        with open(out_path, "w") as f:
+            json.dump({"auc": float(auc), "steps": n_local}, f)
+    df.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -5,7 +5,13 @@ import numpy as np
 import pytest
 
 from persia_tpu.config import EmbeddingConfig, SlotConfig
-from persia_tpu.data import IDTypeFeature, Label, NonIDTypeFeature, PersiaBatch
+from persia_tpu.data import (
+    IDTypeFeature,
+    IDTypeFeatureWithSingleID,
+    Label,
+    NonIDTypeFeature,
+    PersiaBatch,
+)
 from persia_tpu.embedding.optim import Adagrad, Adam, SGD
 from persia_tpu.embedding.store import EmbeddingStore
 from persia_tpu.embedding.worker import EmbeddingWorker
@@ -1241,3 +1247,70 @@ def test_all_ps_stream_device_pooling_matches_host_pooling():
     assert set(e_host) == set(e_dev)
     for k in e_host:
         np.testing.assert_allclose(e_host[k], e_dev[k], rtol=1e-4, atol=1e-5)
+
+
+def test_cached_adam_matches_pure_ps_adam():
+    """Adam exactness across tiers (the round-3 verdict's ask): the cached
+    tier's on-device Adam — shared batch-level beta powers advancing once
+    per step, mirrored to the PS — must train the same entries as the pure
+    PS path (hybrid TrainCtx, optimizer on the store) on the identical
+    stream. Matches the reference's batch-level beta-power semantics
+    (persia-common/src/optim.rs:99-221)."""
+    import optax
+
+    from persia_tpu.ctx import TrainCtx
+    from persia_tpu.embedding.optim import Adam
+    from persia_tpu.models import DNN
+
+    def batches(n=10):
+        out = []
+        for i in range(n):
+            r = np.random.default_rng(300 + i)
+            dense = r.normal(size=(16, 4)).astype(np.float32)
+            out.append(PersiaBatch(
+                [IDTypeFeatureWithSingleID(
+                    n_, r.integers(0, 60, 16).astype(np.uint64))
+                 for n_ in ("cat_a", "cat_b", "cat_c")],
+                non_id_type_features=[NonIDTypeFeature(dense)],
+                labels=[Label((dense.sum(1, keepdims=True) > 0).astype(np.float32))],
+                requires_grad=True,
+            ))
+        return out
+
+    def run(cached: bool):
+        cfg = _cfg()
+        store = EmbeddingStore(
+            capacity=1 << 14, num_internal_shards=2,
+            optimizer=Adam(lr=0.01).config, seed=11,
+        )
+        worker = EmbeddingWorker(cfg, [store])
+        import jax.numpy as jnp
+
+        kw = dict(
+            # f32 model compute: the parity claim is about Adam SEMANTICS,
+            # so keep bf16 forward noise out of the oracle
+            model=DNN(dense_mlp_size=8, sparse_mlp_size=32, hidden_sizes=(32,),
+                      compute_dtype=jnp.float32),
+            dense_optimizer=optax.sgd(1e-2),
+            embedding_optimizer=Adam(lr=0.01),
+            worker=worker,
+            embedding_config=cfg,
+        )
+        if cached:
+            ctx = hbm.CachedTrainCtx(cache_rows=4096, **kw).__enter__()
+            for b in batches():
+                ctx.train_step(b)
+            ctx.drain()
+            ctx.publish()  # every cached row lands in the PS
+        else:
+            ctx = TrainCtx(**kw).__enter__()
+            for b in batches():
+                ctx.train_step(b)
+        return _store_entries(store, _cfg())
+
+    e_ps = run(False)
+    e_cached = run(True)
+    assert set(e_ps) == set(e_cached)
+    for k in e_ps:
+        # same embedding AND the same [m | v] optimizer state
+        np.testing.assert_allclose(e_cached[k], e_ps[k], rtol=2e-4, atol=2e-5)

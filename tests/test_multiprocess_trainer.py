@@ -1,0 +1,184 @@
+"""Real multi-process trainer e2e (the reference's docker-compose e2e,
+`.buildkite/e2e/docker-compose.train.yml` + `k8s/src/bin/e2e.rs:1-218`):
+2 trainer PROCESSES brought up through ``launcher.py nn-worker`` +
+``jax.distributed`` (CPU/gloo collectives), each with its own
+``TrainerDataflow`` receiver, fed by 2 data-loader replicas through the
+dataflow tier, training against a shared ServiceCtx worker/PS tier over
+RPC — topology 2 loaders × 2 trainers × 1 worker × 2 PS. The 2-rank DDP
+run must reach the same held-out AUC as a single-process run consuming
+the identical global stream."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from persia_tpu.data import IDTypeFeature, Label, NonIDTypeFeature, PersiaBatch
+from persia_tpu.dataflow import DataflowSender
+from persia_tpu.helper import ServiceCtx
+from persia_tpu.service.clients import WorkerClient
+from persia_tpu.testing import SyntheticClickDataset
+
+pytestmark = pytest.mark.slow
+
+VOCABS = (64, 32, 16, 100, 50, 8)
+GLOBAL_BATCH = 128
+STEPS = 16
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAINER = os.path.join(REPO, "tests", "mp_trainer_main.py")
+
+
+@pytest.fixture(scope="module")
+def emb_cfg_path(tmp_path_factory):
+    p = tmp_path_factory.mktemp("mpcfg") / "embedding_config.yml"
+    slots = "\n".join(f"  cat_{i}: {{dim: 8}}" for i in range(len(VOCABS)))
+    p.write_text(
+        textwrap.dedent("feature_index_prefix_bit: 8\nslots_config:\n") + slots
+    )
+    return str(p)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+EPOCHS = 3
+
+
+def _global_stream():
+    ds = SyntheticClickDataset(
+        num_samples=STEPS * GLOBAL_BATCH, vocab_sizes=VOCABS, seed=42
+    )
+    return list(ds.batches(batch_size=GLOBAL_BATCH)) * EPOCHS
+
+
+def _halves(batch: PersiaBatch):
+    """Split one global batch into (first half, second half) so that the
+    2-rank concat [rank0 shard; rank1 shard] reassembles it exactly."""
+    h = GLOBAL_BATCH // 2
+    out = []
+    for lo, hi in ((0, h), (h, GLOBAL_BATCH)):
+        ids = [
+            IDTypeFeature(
+                f.name, [np.asarray(x, np.uint64) for x in f.data[lo:hi]]
+            )
+            for f in batch.id_type_features
+        ]
+        out.append(
+            PersiaBatch(
+                ids,
+                non_id_type_features=[
+                    NonIDTypeFeature(
+                        np.asarray(batch.non_id_type_features[0].data)[lo:hi]
+                    )
+                ],
+                labels=[Label(np.asarray(batch.labels[0].data)[lo:hi])],
+                requires_grad=True,
+            )
+        )
+    return out
+
+
+def _run_trainers(ctx, n_trainers: int, batches_per_rank, tmp_path):
+    """Launch n trainer ranks through the launcher + jax.distributed, feed
+    them through DataflowSenders (one per loader replica), return rank 0's
+    result dict."""
+    worker_addr = ctx.worker_addrs()[0]
+    coord_port = _free_port()
+    df_ports = [_free_port() for _ in range(n_trainers)]
+    out_path = str(tmp_path / f"result_{n_trainers}.json")
+
+    procs = []
+    for rank in range(n_trainers):
+        env = dict(
+            os.environ,
+            PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+            JAX_PLATFORMS="cpu",
+            JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{coord_port}",
+            JAX_NUM_PROCESSES=str(n_trainers),
+            JAX_PROCESS_ID=str(rank),
+            MP_DF_PORT=str(df_ports[rank]),
+            MP_WORKER_ADDR=worker_addr,
+            MP_N_LOADERS=str(n_trainers),  # one loader replica per rank
+            MP_OUT=out_path,
+            PERSIA_NN_WORKER_ENTRY=TRAINER,
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "persia_tpu.launcher", "nn-worker",
+                 TRAINER, "--nnodes", str(n_trainers), "--node-rank", str(rank)],
+                env=env,
+            )
+        )
+    try:
+        df_addrs = [f"127.0.0.1:{p}" for p in df_ports]
+        # wait for every trainer's TrainerDataflow MQ to come up (process
+        # start + imports take seconds; mq_put is not retried)
+        import time
+
+        for port in df_ports:
+            deadline = time.time() + 120
+            while True:
+                try:
+                    socket.create_connection(("127.0.0.1", port), 1).close()
+                    break
+                except OSError:
+                    if time.time() > deadline:
+                        raise TimeoutError(f"trainer MQ on {port} never came up")
+                    time.sleep(0.3)
+        senders = [
+            DataflowSender(
+                [WorkerClient(worker_addr)], df_addrs,
+                replica_index=r, replica_size=n_trainers,
+            )
+            for r in range(n_trainers)
+        ]
+        for shards in batches_per_rank:  # one tuple of per-loader batches
+            for r, b in enumerate(shards):
+                senders[r].send(b)
+        for s in senders:
+            s.finish()
+            s.close()
+        for p in procs:
+            assert p.wait(timeout=600) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    with open(out_path) as f:
+        return json.load(f)
+
+
+def test_two_trainer_ddp_matches_single_process(tmp_path, emb_cfg_path):
+    stream = _global_stream()
+
+    results = {}
+    for n_trainers in (1, 2):
+        with ServiceCtx(
+            num_parameter_servers=2,
+            num_embedding_workers=1,
+            embedding_config_path=emb_cfg_path,
+        ) as ctx:
+            if n_trainers == 1:
+                feed = [(b,) for b in stream]
+            else:
+                feed = [tuple(_halves(b)) for b in stream]
+            results[n_trainers] = _run_trainers(ctx, n_trainers, feed, tmp_path)
+
+    single, ddp = results[1], results[2]
+    assert single["steps"] == STEPS * EPOCHS
+    assert ddp["steps"] == STEPS * EPOCHS  # one rank step per global batch
+    # both trainings learned the task, and 2-rank DDP (dense psum + shared
+    # PS) matches the single-process trajectory on the same global stream
+    assert single["auc"] > 0.72, single
+    assert ddp["auc"] > 0.72, ddp
+    assert abs(single["auc"] - ddp["auc"]) < 0.04, (single, ddp)
