@@ -18,6 +18,7 @@
 // C ABI only (ctypes-friendly).
 
 #include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <cstdlib>
 #include <vector>
@@ -413,6 +414,95 @@ void cache_uniform_init(const uint64_t* signs, int64_t m, int64_t dim,
     for (int64_t j = 0; j < dim; ++j) {
       const uint64_t s = splitmix64(base + (uint64_t)j);
       row[j] = (float)(lo + (double)(s >> 11) * kScale * span);
+    }
+  }
+}
+
+// Non-uniform seeded init for cached-tier cold misses. The algorithms are a
+// verbatim mirror of native/ps.cpp Store::{normal,poisson,gamma}_from (each
+// .cpp is a standalone translation unit by build design — _native_build
+// compiles one source per .so — so the kernels are duplicated; the
+// cross-backend golden tests in tests/test_init_methods.py pin all three
+// implementations, Python included, to the same bits).
+namespace initk {
+
+constexpr double kToUnit = 1.0 / 9007199254740992.0;  // 2^-53
+constexpr double kTwoPi = 6.283185307179586;
+
+struct SubStream {
+  uint64_t b;
+  uint64_t j = 0;
+  SubStream(uint64_t base, uint64_t i) : b(splitmix64(base + i)) {}
+  double next() { return (double)(splitmix64(b + 1 + j++) >> 11) * kToUnit; }
+};
+
+inline double normal_from(SubStream& st, double mean, double std_) {
+  double u1 = st.next();
+  if (u1 < kToUnit) u1 = kToUnit;
+  double u2 = st.next();
+  return mean + std_ * (std::sqrt(-2.0 * std::log(u1)) * std::cos(kTwoPi * u2));
+}
+
+inline double poisson_from(SubStream& st, double lam) {
+  if (lam <= 0.0) return 0.0;
+  double big_l = std::exp(-lam);
+  int k = 0;
+  double p = 1.0;
+  while (k < 4096) {
+    ++k;
+    p *= st.next();
+    if (!(p > big_l)) break;
+  }
+  return (double)(k - 1);
+}
+
+inline double gamma_from(SubStream& st, double shape, double scale) {
+  if (shape <= 0.0) return 0.0;
+  double boost = 1.0, k = shape;
+  if (k < 1.0) {
+    double u = st.next();
+    if (u < kToUnit) u = kToUnit;
+    boost = std::pow(u, 1.0 / k);
+    k += 1.0;
+  }
+  double d = k - 1.0 / 3.0;
+  double c = 1.0 / (3.0 * std::sqrt(d));
+  for (int it = 0; it < 1024; ++it) {
+    double x = normal_from(st, 0.0, 1.0);
+    double v = 1.0 + c * x;
+    if (v <= 0.0) continue;
+    v = v * v * v;
+    double u = st.next();
+    if (u < 1.0 - 0.0331 * x * x * x * x) return boost * d * v * scale;
+    double lu = std::log(u < kToUnit ? kToUnit : u);
+    if (lu < 0.5 * x * x + d * (1.0 - v + std::log(v)))
+      return boost * d * v * scale;
+  }
+  return boost * d * scale;
+}
+
+}  // namespace initk
+
+// kind codes: 0=uniform 1=gamma 2=poisson 3=normal 4=inverse_sqrt
+// (config.py INIT_KIND_CODES)
+void cache_init_rows(const uint64_t* signs, int64_t m, int64_t dim,
+                     uint64_t seed, int kind, double p0, double p1,
+                     float* out) {
+  if (kind == 0) return cache_uniform_init(signs, m, dim, seed, p0, p1, out);
+  if (kind == 4) {
+    double b = 1.0 / std::sqrt((double)dim);
+    return cache_uniform_init(signs, m, dim, seed, -b, b, out);
+  }
+  for (int64_t i = 0; i < m; ++i) {
+    const uint64_t base = splitmix64(signs[i] ^ seed);
+    float* row = out + i * dim;
+    for (int64_t j = 0; j < dim; ++j) {
+      initk::SubStream st(base, (uint64_t)j);
+      double v = 0.0;
+      if (kind == 3) v = initk::normal_from(st, p0, p1);
+      else if (kind == 2) v = initk::poisson_from(st, p0);
+      else if (kind == 1) v = initk::gamma_from(st, p0, p1);
+      row[j] = (float)v;
     }
   }
 }

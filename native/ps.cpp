@@ -207,6 +207,10 @@ struct Store {
   uint64_t seed;
   // hyperparameters (configure())
   double init_lo = -0.01, init_hi = 0.01;
+  // init distribution (ps_set_init_method): 0=uniform 1=gamma 2=poisson
+  // 3=normal 4=inverse_sqrt; p0/p1 per-kind params (config.py INIT_KIND_CODES)
+  int init_kind = 0;
+  double init_p0 = -0.01, init_p1 = 0.01;
   double admit_prob = 1.0;
   float weight_bound = 10.f;
   OptimizerConfig opt;
@@ -234,13 +238,94 @@ struct Store {
   }
 
   // counter-mode uniform init, bit-identical to hashing.uniform_init_for_sign
-  void init_embedding(uint64_t sign, uint32_t dim, float* out) const {
+  void uniform_row(uint64_t sign, uint32_t dim, double lo, double hi,
+                   float* out) const {
     uint64_t base = splitmix64(sign ^ seed);
-    double range = init_hi - init_lo;
+    double range = hi - lo;
     for (uint32_t i = 0; i < dim; ++i) {
       uint64_t s = splitmix64(base + i);
-      double u = (double)(s >> 11) * (1.0 / 9007199254740992.0);  // 2^53
-      out[i] = (float)(init_lo + u * range);
+      double u = (double)(s >> 11) * kToUnit;
+      out[i] = (float)(lo + u * range);
+    }
+  }
+
+  // Seeded init distributions beyond uniform (ref: emb_entry.rs:28-60).
+  // Per-element splitmix64 substreams + glibc libm transcendentals — the
+  // EXACT algorithms of hashing.py _normal_from/_poisson_from/_gamma_from
+  // (CPython math.* calls the same libm), so rows are bit-identical to the
+  // Python golden model; pinned by tests/test_init_methods.py.
+  static constexpr double kToUnit = 1.0 / 9007199254740992.0;  // 2^-53
+  static constexpr double kTwoPi = 6.283185307179586;
+
+  struct SubStream {
+    uint64_t b;
+    uint64_t j = 0;
+    SubStream(uint64_t base, uint64_t i) : b(splitmix64(base + i)) {}
+    double next() { return (double)(splitmix64(b + 1 + j++) >> 11) * kToUnit; }
+  };
+
+  static double normal_from(SubStream& st, double mean, double std_) {
+    double u1 = st.next();
+    if (u1 < kToUnit) u1 = kToUnit;
+    double u2 = st.next();
+    return mean + std_ * (std::sqrt(-2.0 * std::log(u1)) * std::cos(kTwoPi * u2));
+  }
+
+  static double poisson_from(SubStream& st, double lam) {
+    if (lam <= 0.0) return 0.0;
+    double big_l = std::exp(-lam);
+    int k = 0;
+    double p = 1.0;
+    while (k < 4096) {  // hard cap mirrored in hashing.py
+      ++k;
+      p *= st.next();
+      if (!(p > big_l)) break;
+    }
+    return (double)(k - 1);
+  }
+
+  static double gamma_from(SubStream& st, double shape, double scale) {
+    if (shape <= 0.0) return 0.0;
+    double boost = 1.0, k = shape;
+    if (k < 1.0) {
+      double u = st.next();
+      if (u < kToUnit) u = kToUnit;
+      boost = std::pow(u, 1.0 / k);
+      k += 1.0;
+    }
+    double d = k - 1.0 / 3.0;
+    double c = 1.0 / (3.0 * std::sqrt(d));
+    for (int it = 0; it < 1024; ++it) {  // cap mirrored in hashing.py
+      double x = normal_from(st, 0.0, 1.0);
+      double v = 1.0 + c * x;
+      if (v <= 0.0) continue;
+      v = v * v * v;
+      double u = st.next();
+      if (u < 1.0 - 0.0331 * x * x * x * x) return boost * d * v * scale;
+      double lu = std::log(u < kToUnit ? kToUnit : u);
+      if (lu < 0.5 * x * x + d * (1.0 - v + std::log(v)))
+        return boost * d * v * scale;
+    }
+    return boost * d * scale;  // pathological-params fallback (same in Python)
+  }
+
+  void init_embedding(uint64_t sign, uint32_t dim, float* out) const {
+    switch (init_kind) {
+      case 0:  // uniform
+        return uniform_row(sign, dim, init_p0, init_p1, out);
+      case 4: {  // inverse_sqrt: uniform in ±1/sqrt(dim)
+        double b = 1.0 / std::sqrt((double)dim);
+        return uniform_row(sign, dim, -b, b, out);
+      }
+    }
+    uint64_t base = splitmix64(sign ^ seed);
+    for (uint32_t i = 0; i < dim; ++i) {
+      SubStream st(base, i);
+      double v = 0.0;
+      if (init_kind == 3) v = normal_from(st, init_p0, init_p1);
+      else if (init_kind == 2) v = poisson_from(st, init_p0);
+      else if (init_kind == 1) v = gamma_from(st, init_p0, init_p1);
+      out[i] = (float)v;
     }
   }
 
@@ -469,8 +554,21 @@ void ps_configure(void* h, double init_lo, double init_hi, double admit_prob,
   Store* s = (Store*)h;
   s->init_lo = init_lo;
   s->init_hi = init_hi;
+  // keep the uniform params in sync for callers that never push an explicit
+  // init method (ps_set_init_method overrides these after)
+  if (s->init_kind == 0) {
+    s->init_p0 = init_lo;
+    s->init_p1 = init_hi;
+  }
   s->admit_prob = admit_prob;
   s->weight_bound = weight_bound;
+}
+
+void ps_set_init_method(void* h, int kind, double p0, double p1) {
+  Store* s = (Store*)h;
+  s->init_kind = kind;
+  s->init_p0 = p0;
+  s->init_p1 = p1;
 }
 
 void ps_register_optimizer(void* h, int kind, float lr, float weight_decay,

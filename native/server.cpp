@@ -185,7 +185,11 @@ struct ReplyCtx {
 };
 
 struct Server {
-  int listen_fd = -1;
+  // atomic, and stop() closes it only after every thread that might read it
+  // has been joined — the shutdown-RPC path in a connection thread calls
+  // ::shutdown on it, and with fd-number reuse a concurrent close could
+  // redirect that shutdown to an unrelated descriptor
+  std::atomic<int> listen_fd{-1};
   int port = 0;
   void* store = nullptr;
   PsFns ps{};
@@ -239,11 +243,8 @@ struct Server {
   // — a joinable std::thread destructing is std::terminate.
   void stop() {
     stopping.store(true);
-    if (listen_fd >= 0) {
-      ::shutdown(listen_fd, SHUT_RDWR);
-      ::close(listen_fd);
-      listen_fd = -1;
-    }
+    const int lfd = listen_fd.exchange(-1);
+    if (lfd >= 0) ::shutdown(lfd, SHUT_RDWR);
     if (accept_thread.joinable()) accept_thread.join();
     std::vector<std::unique_ptr<ConnSlot>> local;
     {
@@ -255,6 +256,9 @@ struct Server {
     }
     for (auto& c : local)
       if (c->t.joinable()) c->t.join();
+    // all readers of listen_fd are joined; only now is close (and hence
+    // kernel fd-number reuse) safe
+    if (lfd >= 0) ::close(lfd);
   }
 };
 
@@ -330,7 +334,9 @@ bool handle_lookup_batched(Server* s, int fd, const uint8_t* p, int64_t n,
   std::memcpy(key_ofs.data(), p + off, 8 * ((size_t)ng + 1));
   off += 8 * ((int64_t)ng + 1);
   const int64_t n_signs = ng ? key_ofs[ng] : 0;
-  if (off + 8 * n_signs > n || n_signs < 0) return false;
+  // divide form: 8 * n_signs would wrap for hostile key_ofs[ng] >= 2^60,
+  // passing the check and then killing the process in resize()
+  if (n_signs < 0 || n_signs > (n - off) / 8) return false;
   thread_local std::vector<uint64_t> signs;
   signs.resize((size_t)n_signs);
   std::memcpy(signs.data(), p + off, 8 * (size_t)n_signs);
@@ -381,7 +387,7 @@ bool handle_update_batched(Server* s, int fd, const uint8_t* p, int64_t n,
   std::memcpy(key_ofs.data(), p + off, 8 * ((size_t)ng + 1));
   off += 8 * ((int64_t)ng + 1);
   const int64_t n_signs = ng ? key_ofs[ng] : 0;
-  if (n_signs < 0 || off + 8 * n_signs > n) return false;
+  if (n_signs < 0 || n_signs > (n - off) / 8) return false;
   thread_local std::vector<uint64_t> signs;
   signs.resize((size_t)n_signs);
   std::memcpy(signs.data(), p + off, 8 * (size_t)n_signs);
@@ -496,7 +502,11 @@ void serve_conn_inner(Server* s, int fd) {
         // wake the accept loop; fd close + joins belong to the wrapper and
         // stop(), which the Python side drives
         s->stopping.store(true);
-        if (s->listen_fd >= 0) ::shutdown(s->listen_fd, SHUT_RDWR);
+        // shutdown only, never close — stop() owns the close, and defers it
+        // past the join of this very thread so the fd number can't be reused
+        // under us
+        const int lfd = s->listen_fd.load();
+        if (lfd >= 0) ::shutdown(lfd, SHUT_RDWR);
         return;
       }
     }
@@ -506,7 +516,9 @@ void serve_conn_inner(Server* s, int fd) {
 
 void accept_loop(Server* s) {
   while (!s->stopping.load(std::memory_order_relaxed)) {
-    int fd = ::accept(s->listen_fd, nullptr, nullptr);
+    const int lfd = s->listen_fd.load(std::memory_order_relaxed);
+    if (lfd < 0) return;
+    int fd = ::accept(lfd, nullptr, nullptr);
     if (fd < 0) {
       if (s->stopping.load(std::memory_order_relaxed)) return;
       continue;
@@ -551,25 +563,26 @@ void* net_server_start(int port, void* store_handle, const char* ps_so_path,
   s->fallback = fallback;
   s->compress_threshold = compress_threshold;
 
-  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (s->listen_fd < 0) {
+  const int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) {
     delete s;
     return nullptr;
   }
   int one = 1;
-  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  ::setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = htonl(INADDR_ANY);
   addr.sin_port = htons((uint16_t)port);
-  if (::bind(s->listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
-      ::listen(s->listen_fd, 128) != 0) {
-    ::close(s->listen_fd);
+  if (::bind(lfd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      ::listen(lfd, 128) != 0) {
+    ::close(lfd);
     delete s;
     return nullptr;
   }
   socklen_t alen = sizeof(addr);
-  ::getsockname(s->listen_fd, (sockaddr*)&addr, &alen);
+  ::getsockname(lfd, (sockaddr*)&addr, &alen);
+  s->listen_fd.store(lfd);
   s->port = ntohs(addr.sin_port);
   s->accept_thread = std::thread(accept_loop, s);
   return s;

@@ -146,6 +146,54 @@ class EmbeddingConfig:
         raise KeyError(slot_name)
 
 
+INIT_UNIFORM = "uniform"
+INIT_GAMMA = "gamma"
+INIT_POISSON = "poisson"
+INIT_NORMAL = "normal"
+INIT_INVERSE_SQRT = "inverse_sqrt"
+
+# numeric codes shared with native/ps.cpp ps_set_init_method
+INIT_KIND_CODES = {
+    INIT_UNIFORM: 0,
+    INIT_GAMMA: 1,
+    INIT_POISSON: 2,
+    INIT_NORMAL: 3,
+    INIT_INVERSE_SQRT: 4,
+}
+
+
+@dataclass(frozen=True)
+class InitializationMethod:
+    """Seeded-by-sign embedding init distribution
+    (ref: InitializationMethod enum, persia-embedding-config/src/lib.rs:79-98;
+    seeded entry init, persia-embedding-holder/src/emb_entry.rs:28-60).
+
+    ``p0``/``p1`` per kind: uniform → (lower, upper); gamma → (shape, scale);
+    poisson → (lambda, unused); normal → (mean, stddev); inverse_sqrt ignores
+    both and draws uniform in ±1/sqrt(dim)."""
+
+    kind: str = INIT_UNIFORM
+    p0: float = -0.01
+    p1: float = 0.01
+
+    def __post_init__(self):
+        if self.kind not in INIT_KIND_CODES:
+            raise ValueError(f"unknown initialization kind: {self.kind!r}")
+
+    @property
+    def code(self) -> int:
+        return INIT_KIND_CODES[self.kind]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "p0": self.p0, "p1": self.p1}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "InitializationMethod":
+        return InitializationMethod(
+            kind=d["kind"], p0=float(d.get("p0", 0.0)), p1=float(d.get("p1", 0.0))
+        )
+
+
 @dataclass(frozen=True)
 class HyperParameters:
     """Runtime-pushed embedding hyperparameters
@@ -154,6 +202,33 @@ class HyperParameters:
     emb_initialization: Tuple[float, float] = (-0.01, 0.01)
     admit_probability: float = 1.0
     weight_bound: float = 10.0
+    # None → BoundedUniform over emb_initialization (the reference's default)
+    initialization_method: Optional[InitializationMethod] = None
+
+    def resolved_init_method(self) -> InitializationMethod:
+        if self.initialization_method is not None:
+            return self.initialization_method
+        lo, hi = self.emb_initialization
+        return InitializationMethod(INIT_UNIFORM, lo, hi)
+
+    def to_dict(self) -> Dict[str, Any]:
+        m = self.initialization_method
+        return {
+            "emb_initialization": list(self.emb_initialization),
+            "admit_probability": self.admit_probability,
+            "weight_bound": self.weight_bound,
+            "initialization_method": m.to_dict() if m is not None else None,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "HyperParameters":
+        m = d.get("initialization_method")
+        return HyperParameters(
+            emb_initialization=tuple(d["emb_initialization"]),
+            admit_probability=d["admit_probability"],
+            weight_bound=d["weight_bound"],
+            initialization_method=InitializationMethod.from_dict(m) if m else None,
+        )
 
 
 @dataclass(frozen=True)

@@ -99,3 +99,129 @@ def seed_for_sign(sign: int, base_seed: int = 0) -> int:
     (ref: emb_entry.rs:28-60 seeds the entry RNG by sign)."""
     arr = np.array([np.uint64(sign) ^ np.uint64(base_seed)], dtype=np.uint64)
     return int(splitmix64(arr)[0])
+
+
+# ---------------------------------------------------------- init methods
+#
+# Seeded-by-sign init distributions beyond uniform (ref: InitializationMethod,
+# persia-embedding-config/src/lib.rs:79-98; seeded entry init,
+# emb_entry.rs:28-60). Each element i of a row gets its own splitmix64
+# substream, so rejection sampling (gamma) and variable-draw-count algorithms
+# (poisson) stay deterministic per element regardless of how many uniforms a
+# neighbour consumed. All transcendentals go through scalar libm (math.*),
+# which is the same glibc code C++ `std::` calls — that is what makes the
+# numpy golden bit-identical to `native/ps.cpp` (pinned by
+# tests/test_init_methods.py).
+
+_M64 = (1 << 64) - 1
+_TO_UNIT = 1.0 / 9007199254740992.0  # 2^-53
+_TWO_PI = 6.283185307179586
+
+
+def _sm64(x: int) -> int:
+    """Scalar splitmix64 (wrapping u64), identical to the vectorized one."""
+    x = (x + 0x9E3779B97F4A7C15) & _M64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _M64
+    return x ^ (x >> 31)
+
+
+class _SubStream:
+    """The j-th uniform of element ``i``: to_unit(sm64(sm64(base + i) + 1 + j))."""
+
+    def __init__(self, base: int, i: int):
+        self._b = _sm64((base + i) & _M64)
+        self._j = 0
+
+    def next(self) -> float:
+        u = (_sm64((self._b + 1 + self._j) & _M64) >> 11) * _TO_UNIT
+        self._j += 1
+        return u
+
+
+def _normal_from(st: "_SubStream", mean: float, std: float) -> float:
+    import math
+
+    u1 = max(st.next(), _TO_UNIT)
+    u2 = st.next()
+    return mean + std * (math.sqrt(-2.0 * math.log(u1)) * math.cos(_TWO_PI * u2))
+
+
+def _poisson_from(st: "_SubStream", lam: float) -> float:
+    import math
+
+    if lam <= 0.0:
+        return 0.0
+    big_l = math.exp(-lam)
+    k, p = 0, 1.0
+    while k < 4096:  # hard cap mirrored in native/ps.cpp
+        k += 1
+        p *= st.next()
+        if not p > big_l:
+            break
+    return float(k - 1)
+
+
+def _gamma_from(st: "_SubStream", shape: float, scale: float) -> float:
+    """Marsaglia-Tsang; for shape<1 boost via u^(1/shape) drawn FIRST."""
+    import math
+
+    if shape <= 0.0:
+        return 0.0
+    boost, k = 1.0, shape
+    if k < 1.0:
+        boost = math.pow(max(st.next(), _TO_UNIT), 1.0 / k)
+        k += 1.0
+    d = k - 1.0 / 3.0
+    c = 1.0 / (3.0 * math.sqrt(d))
+    for _ in range(1024):  # cap mirrored in native/ps.cpp
+        x = _normal_from(st, 0.0, 1.0)
+        v = 1.0 + c * x
+        if v <= 0.0:
+            continue
+        v = v * v * v
+        u = st.next()
+        if u < 1.0 - 0.0331 * x * x * x * x:
+            return boost * d * v * scale
+        if math.log(max(u, _TO_UNIT)) < 0.5 * x * x + d * (1.0 - v + math.log(v)):
+            return boost * d * v * scale
+    return boost * d * scale  # pathological-params fallback (same in C++)
+
+
+def init_for_sign(sign: int, seed: int, n: int, method) -> np.ndarray:
+    """Dispatch on ``config.InitializationMethod``; f32 row of length n."""
+    import math
+
+    kind = method.kind
+    if kind == "uniform":
+        return uniform_init_for_sign(sign, seed, n, method.p0, method.p1)
+    if kind == "inverse_sqrt":
+        b = 1.0 / math.sqrt(n)
+        return uniform_init_for_sign(sign, seed, n, -b, b)
+    base = seed_for_sign(sign, seed)
+    out = np.empty(n, dtype=np.float32)
+    for i in range(n):
+        st = _SubStream(base, i)
+        if kind == "normal":
+            out[i] = _normal_from(st, method.p0, method.p1)
+        elif kind == "poisson":
+            out[i] = _poisson_from(st, method.p0)
+        elif kind == "gamma":
+            out[i] = _gamma_from(st, method.p0, method.p1)
+        else:
+            raise ValueError(f"unknown init kind: {kind!r}")
+    return out
+
+
+def init_for_signs(signs: np.ndarray, seed: int, n: int, method) -> np.ndarray:
+    """Rows of ``init_for_sign`` stacked to (M, n); uniform kinds take the
+    vectorized path (the only init on a hot path — cached-tier cold misses)."""
+    if method.kind == "uniform":
+        return uniform_init_for_signs(signs, seed, n, method.p0, method.p1)
+    if method.kind == "inverse_sqrt":
+        b = 1.0 / float(np.sqrt(n))
+        return uniform_init_for_signs(signs, seed, n, -b, b)
+    rows = [init_for_sign(int(s), seed, n, method) for s in np.asarray(signs).ravel()]
+    if not rows:
+        return np.empty((0, n), dtype=np.float32)
+    return np.stack(rows)

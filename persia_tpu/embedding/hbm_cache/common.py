@@ -1,0 +1,17 @@
+"""Leaf helpers shared across the hbm_cache package.
+
+This module must stay import-free of the package's other modules —
+``directory`` and ``groups`` both depend on it, so anything here that
+imported back from them would recreate the cycle the round-4 package
+split tripped over.
+"""
+
+from __future__ import annotations
+
+from persia_tpu.utils import round_up_pow2 as _round_up_pow2
+
+
+def _bucket(m: int) -> int:
+    """Padded size: pow2 below 4096, then 4096-multiples (the miss arrays are
+    the dominant per-step transfer — pow2 padding would waste up to 2×)."""
+    return _round_up_pow2(m) if m < 4096 else -(-m // 4096) * 4096

@@ -39,8 +39,11 @@ from persia_tpu.embedding.hbm_cache.directory import CacheDirectory  # noqa: F40
 from persia_tpu.embedding.hbm_cache.groups import (  # noqa: F401
     CacheLayout,
     CachedTrainState,
+    _apply_aux,
     _bucket,
     _lazy_pool,
+    _model_emb_from_gathered,
+    _restore_rows,
     _state_init_consts,
     init_cached_tables,
 )

@@ -26,6 +26,7 @@ from persia_tpu.embedding.worker import (
 )
 from persia_tpu.logger import get_default_logger
 from persia_tpu.utils import round_up_pow2 as _round_up_pow2
+from persia_tpu.embedding.hbm_cache.common import _bucket  # noqa: F401
 from persia_tpu.metrics import get_metrics
 from persia_tpu.ops.sparse_update import sparse_update
 from persia_tpu.tracing import span
@@ -86,6 +87,10 @@ def _load_lib() -> ctypes.CDLL:
             _u64p, i64, i64, ctypes.c_uint64, ctypes.c_double,
             ctypes.c_double, ctypes.POINTER(ctypes.c_float),
         ]
+        lib.cache_init_rows.argtypes = [
+            _u64p, i64, i64, ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_double, ctypes.c_double, ctypes.POINTER(ctypes.c_float),
+        ]
         _LIB = lib
     return _LIB
 
@@ -106,6 +111,28 @@ def native_uniform_init(
     lib.cache_uniform_init(
         signs.ctypes.data_as(_u64p), m, dim, ctypes.c_uint64(seed),
         lo, hi, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    return out
+
+
+def native_init_rows(
+    signs: np.ndarray, seed: int, dim: int, method,
+    out: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Seeded cold-miss init for any ``config.InitializationMethod`` —
+    bit-identical to ``hashing.init_for_signs`` and to the PS cores
+    (tests/test_init_methods.py), so a row born in the cache matches one
+    born on the PS (ref: emb_entry.rs:28-60 seeded init)."""
+    lib = _load_lib()
+    signs = np.ascontiguousarray(signs, dtype=np.uint64)
+    m = len(signs)
+    if out is None:
+        out = np.empty((m, dim), dtype=np.float32)
+    assert out.flags["C_CONTIGUOUS"] and out.dtype == np.float32
+    lib.cache_init_rows(
+        signs.ctypes.data_as(_u64p), m, dim, ctypes.c_uint64(seed),
+        method.code, method.p0, method.p1,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
     )
     return out
 

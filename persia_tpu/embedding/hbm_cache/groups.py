@@ -35,6 +35,7 @@ logger = get_default_logger("persia_tpu.hbm_cache")
 # ------------------------------------------------------------------ ctypes
 
 
+from persia_tpu.embedding.hbm_cache.common import _bucket  # noqa: F401
 from persia_tpu.embedding.hbm_cache.directory import (  # noqa: F401
     native_uniform_init,
 )
@@ -303,9 +304,7 @@ def _state_init_consts(cfg: OptimizerConfig):
     return ()
 
 
-def _bucket(m: int) -> int:
-    """Padded size: pow2 below 4096, then 4096-multiples (the miss arrays are
-    the dominant per-step transfer — pow2 padding would waste up to 2×)."""
-    return _round_up_pow2(m) if m < 4096 else -(-m // 4096) * 4096
+# _bucket lives in hbm_cache.common (leaf module) — re-exported above for
+# the step/stream/tier/ctx imports that predate the package split.
 
 

@@ -38,11 +38,14 @@ logger = get_default_logger("persia_tpu.hbm_cache")
 from persia_tpu.embedding.hbm_cache.directory import (  # noqa: F401
     CacheDirectory,
     _BufRing,
+    native_init_rows,
+    native_uniform_init,
 )
 from persia_tpu.embedding.hbm_cache.groups import (  # noqa: F401
     CacheGroup,
     CacheLayout,
     _bucket,
+    _gather_entry_rows,
     _lazy_pool,
     _slot_group_of,
     _state_init_consts,
@@ -97,7 +100,6 @@ class CachedEmbeddingTier:
                     "(pass init_seed= to CachedEmbeddingTier/CachedTrainCtx)"
                 )
         self.init_seed = int(init_seed)
-        self.init_bounds = tuple(worker.hyperparams.emb_initialization)
         dims = {
             slot.dim
             for name, slot in self.cfg.slots_config.items()
@@ -169,6 +171,14 @@ class CachedEmbeddingTier:
     @property
     def router(self) -> ShardedLookup:
         return self.worker.lookup_router
+
+    @property
+    def init_method(self):
+        """Read LIVE from the worker's hyperparams (not a construction-time
+        snapshot): a configure() pushed after ctx creation reaches the PS
+        replicas immediately, and cold rows born here must stay bit-identical
+        to rows born there."""
+        return self.worker.hyperparams.resolved_init_method()
 
     # PS traffic helpers: big checkout/write-back calls chunk across the
     # worker's thread pool (the native store releases the GIL; its internal
@@ -331,13 +341,12 @@ class CachedEmbeddingTier:
                 w_entries[:len(widx)] = vals[widx]  # casts on a bf16 wire
                 miss_aux[g.name] = (w_rows, w_entries)
             if len(cidx):
-                lo, hi = self.init_bounds
                 cp = _bucket(len(cidx))
                 c_rows = self._ring.full(("c_rows", g.name), (cp,), np.int32, C + 1)
                 c_f32 = self._ring.get(("c_emb_f32", g.name), (cp, g.dim), np.float32)
                 c_rows[:len(cidx)] = rows_miss[cidx]
-                native_uniform_init(
-                    miss_signs[cidx], self.init_seed, g.dim, lo, hi,
+                native_init_rows(
+                    miss_signs[cidx], self.init_seed, g.dim, self.init_method,
                     out=c_f32[:len(cidx)],
                 )
                 if self.aux_np_dtype == np.float32:

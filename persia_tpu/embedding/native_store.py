@@ -56,6 +56,7 @@ def _load_lib() -> ctypes.CDLL:
     lib.ps_create.argtypes = [u64, u32, u64]
     lib.ps_destroy.argtypes = [p]
     lib.ps_configure.argtypes = [p, ctypes.c_double, ctypes.c_double, ctypes.c_double, f32]
+    lib.ps_set_init_method.argtypes = [p, i32, ctypes.c_double, ctypes.c_double]
     lib.ps_register_optimizer.argtypes = [p, i32, f32, f32, f32, f32, f32, i32, f32, f32]
     lib.ps_num_shards.restype = u32
     lib.ps_num_shards.argtypes = [p]
@@ -154,6 +155,8 @@ class NativeEmbeddingStore:
         self._lib.ps_configure(
             self._h, lo, hi, hyperparams.admit_probability, hyperparams.weight_bound
         )
+        m = hyperparams.resolved_init_method()
+        self._lib.ps_set_init_method(self._h, m.code, m.p0, m.p1)
 
     def register_optimizer(self, optimizer: OptimizerConfig) -> None:
         self.optimizer = optimizer

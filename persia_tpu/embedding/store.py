@@ -28,7 +28,11 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from persia_tpu.config import HyperParameters
-from persia_tpu.embedding.hashing import splitmix64, uniform_init_for_sign
+from persia_tpu.embedding.hashing import (
+    init_for_sign,
+    splitmix64,
+    uniform_init_for_sign,  # noqa: F401  (re-export; golden-test anchor)
+)
 from persia_tpu.embedding.optim import OptimizerConfig
 from persia_tpu.metrics import get_metrics
 
@@ -128,9 +132,10 @@ class EmbeddingStore:
         return self._shards[h % self._num_shards]
 
     def _init_entry(self, sign: int, dim: int) -> np.ndarray:
-        lo, hi = self.hyperparams.emb_initialization
         entry = np.empty(dim + self._state_dim(dim), dtype=np.float32)
-        entry[:dim] = uniform_init_for_sign(sign, self.seed, dim, lo, hi)
+        entry[:dim] = init_for_sign(
+            sign, self.seed, dim, self.hyperparams.resolved_init_method()
+        )
         if self.optimizer is not None:
             entry[dim:] = self.optimizer.init_state(dim)
         return entry

@@ -114,13 +114,7 @@ class StoreClient:
     def configure(self, hyperparams: HyperParameters) -> None:
         self._rpc.call(
             "configure",
-            proto.pack_json(
-                {
-                    "emb_initialization": list(hyperparams.emb_initialization),
-                    "admit_probability": hyperparams.admit_probability,
-                    "weight_bound": hyperparams.weight_bound,
-                }
-            ),
+            proto.pack_json(hyperparams.to_dict()),
         )
 
     def set_embedding(
@@ -249,13 +243,7 @@ class WorkerClient:
     def configure(self, hyperparams: HyperParameters) -> None:
         self._rpc.call(
             "configure",
-            proto.pack_json(
-                {
-                    "emb_initialization": list(hyperparams.emb_initialization),
-                    "admit_probability": hyperparams.admit_probability,
-                    "weight_bound": hyperparams.weight_bound,
-                }
-            ),
+            proto.pack_json(hyperparams.to_dict()),
         )
 
     @property

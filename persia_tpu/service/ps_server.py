@@ -165,14 +165,7 @@ class ParameterServerService:
         return b"ok"
 
     def _configure(self, payload: bytes) -> bytes:
-        d = proto.unpack_json(payload)
-        self.store.configure(
-            HyperParameters(
-                emb_initialization=tuple(d["emb_initialization"]),
-                admit_probability=d["admit_probability"],
-                weight_bound=d["weight_bound"],
-            )
-        )
+        self.store.configure(HyperParameters.from_dict(proto.unpack_json(payload)))
         return b"ok"
 
     def _set_embedding(self, payload: bytes) -> bytes:

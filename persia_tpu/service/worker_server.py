@@ -96,13 +96,7 @@ class EmbeddingWorkerService:
         return b"ok"
 
     def _configure(self, payload: bytes) -> bytes:
-        d = proto.unpack_json(payload)
-        hp = HyperParameters(
-            emb_initialization=tuple(d["emb_initialization"]),
-            admit_probability=d["admit_probability"],
-            weight_bound=d["weight_bound"],
-        )
-        self.worker.configure(hp)
+        self.worker.configure(HyperParameters.from_dict(proto.unpack_json(payload)))
         return b"ok"
 
     def _dump(self, payload: bytes) -> bytes:

@@ -1,0 +1,150 @@
+"""KubectlApi exercised against a fake ``kubectl`` binary.
+
+The k8s tier's reconciler/scheduler are covered by the in-memory fake
+(test_k8s_operator.py); this file covers the only remaining layer — the
+shell-out backend's argument construction, JSON parsing, the non-blocking
+CR delete, and the failed-listing → ``None`` contract (ref semantics:
+/root/reference/k8s/src/bin/operator.rs:55-100).
+"""
+
+import json
+import os
+import stat
+import subprocess
+
+import pytest
+
+from persia_tpu.k8s import GROUP, JOB_LABEL, KIND, PLURAL
+from persia_tpu.k8s_operator import KubectlApi
+
+ITEMS = {"items": [{"metadata": {"name": "x"}}]}
+
+
+@pytest.fixture()
+def fake_kubectl(tmp_path):
+    """A kubectl stand-in that logs each argv as a JSON line and replies
+    with canned JSON. Drop a path into ``fail_file`` to make invocations
+    whose argv contains that token exit 1."""
+    log = tmp_path / "calls.jsonl"
+    fail = tmp_path / "failword"
+    script = tmp_path / "kubectl"
+    script.write_text(
+        "#!/bin/bash\n"
+        # one call per line, argv joined by the ASCII unit separator
+        f"{{ for a in \"$@\"; do printf '%s\\x1f' \"$a\"; done; printf '\\n'; }} >> {log}\n"
+        "if [ -n \"$FAKE_KUBECTL_READ_STDIN\" ]; then cat > /dev/null; fi\n"
+        f"if [ -s {fail} ] && printf '%s\\n' \"$@\" | grep -qx -f {fail}; then\n"
+        "  echo 'fake: forbidden' >&2; exit 1\n"
+        "fi\n"
+        f"echo '{json.dumps(ITEMS)}'\n"
+    )
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+
+    class Ctl:
+        path = str(script)
+
+        def calls(self):
+            if not log.exists():
+                return []
+            return [
+                line.split("\x1f")[:-1] for line in log.read_text().splitlines()
+            ]
+
+        def fail_on(self, token):
+            fail.write_text(token + "\n")
+
+    return Ctl()
+
+
+def test_list_jobs_args_and_parse(fake_kubectl):
+    api = KubectlApi(kubectl=fake_kubectl.path)
+    jobs = api.list_jobs()
+    assert jobs == ITEMS["items"]
+    (call,) = fake_kubectl.calls()
+    assert call == ["get", f"{PLURAL}.{GROUP}", "--all-namespaces", "-o", "json"]
+
+
+def test_list_jobs_failure_returns_empty(fake_kubectl):
+    fake_kubectl.fail_on("--all-namespaces")
+    api = KubectlApi(kubectl=fake_kubectl.path)
+    assert api.list_jobs() == []
+
+
+def test_list_labeled_cluster_wide(fake_kubectl):
+    api = KubectlApi(kubectl=fake_kubectl.path)
+    objs = api.list_labeled(None)
+    # one get per child kind, each labeled and cluster-scoped
+    calls = fake_kubectl.calls()
+    assert [c[1] for c in calls] == ["pods", "services", "deployments"]
+    for c in calls:
+        assert c[0] == "get" and "--all-namespaces" in c
+        assert c[c.index("-l") + 1] == JOB_LABEL
+    assert objs == ITEMS["items"] * 3
+
+
+def test_list_labeled_namespaced(fake_kubectl):
+    api = KubectlApi(kubectl=fake_kubectl.path)
+    api.list_labeled("prod")
+    for c in fake_kubectl.calls():
+        assert c[c.index("-n") + 1] == "prod" and "--all-namespaces" not in c
+
+
+def test_list_labeled_any_failure_is_none(fake_kubectl):
+    """A partial listing must surface as None (API down ≠ nothing exists) —
+    otherwise the reconciler sweeps children it merely failed to see."""
+    fake_kubectl.fail_on("services")
+    api = KubectlApi(kubectl=fake_kubectl.path)
+    assert api.list_labeled(None) is None
+
+
+def test_create_pipes_manifest_to_apply(fake_kubectl):
+    os.environ["FAKE_KUBECTL_READ_STDIN"] = "1"
+    try:
+        api = KubectlApi(kubectl=fake_kubectl.path)
+        api.create({"kind": "Pod", "metadata": {"name": "p"}})
+    finally:
+        del os.environ["FAKE_KUBECTL_READ_STDIN"]
+    (call,) = fake_kubectl.calls()
+    assert call == ["apply", "-f", "-"]
+
+
+def test_create_failure_raises(fake_kubectl):
+    fake_kubectl.fail_on("apply")
+    api = KubectlApi(kubectl=fake_kubectl.path)
+    with pytest.raises(subprocess.CalledProcessError):
+        api.create({"kind": "Pod"})
+
+
+def test_delete_cr_is_non_blocking(fake_kubectl):
+    """The CR delete must pass --wait=false: a finalized CR parks on
+    deletionTimestamp until a later reconcile releases the finalizer, so a
+    blocking delete from the reconciler thread deadlocks on itself."""
+    api = KubectlApi(kubectl=fake_kubectl.path)
+    api.delete(KIND, "default", "job1")
+    (call,) = fake_kubectl.calls()
+    assert "--wait=false" in call and "--ignore-not-found" in call
+    assert call[:2] == ["delete", KIND.lower()]
+
+
+def test_delete_child_is_blocking(fake_kubectl):
+    api = KubectlApi(kubectl=fake_kubectl.path)
+    api.delete("Pod", "ns2", "p0")
+    (call,) = fake_kubectl.calls()
+    assert "--wait=false" not in call
+    assert call[:3] == ["delete", "pod", "p0"] and call[call.index("-n") + 1] == "ns2"
+
+
+def test_delete_failure_raises(fake_kubectl):
+    fake_kubectl.fail_on("delete")
+    api = KubectlApi(kubectl=fake_kubectl.path)
+    with pytest.raises(subprocess.CalledProcessError):
+        api.delete("Pod", "ns", "p")
+
+
+def test_set_finalizers_patch(fake_kubectl):
+    api = KubectlApi(kubectl=fake_kubectl.path)
+    api.set_finalizers("ns", "j", [f"{GROUP}/teardown"])
+    (call,) = fake_kubectl.calls()
+    assert call[:3] == ["patch", f"{PLURAL}.{GROUP}", "j"]
+    patch = json.loads(call[call.index("-p") + 1])
+    assert patch == {"metadata": {"finalizers": [f"{GROUP}/teardown"]}}
