@@ -52,7 +52,7 @@ EMB_DIM = 16
 
 def build_ctx(vocabs, ps_replicas=2, capacity=1 << 20, hashstack_above=None,
               tier="hybrid", admit_touches=1, wire="float32",
-              dynamic_loss_scale=False):
+              dynamic_loss_scale=False, fused_vocab_cap=None):
     slots = {}
     for i, v in enumerate(vocabs):
         hs = HashStackConfig()
@@ -62,6 +62,24 @@ def build_ctx(vocabs, ps_replicas=2, capacity=1 << 20, hashstack_above=None,
             hs = HashStackConfig(hash_stack_rounds=2, embedding_size=max(v // 10, 1))
         slots[f"cat_{i}"] = SlotConfig(dim=EMB_DIM, hash_stack_config=hs)
     cfg = EmbeddingConfig(slots_config=slots, feature_index_prefix_bit=8)
+    model = DLRM(embedding_dim=EMB_DIM, bottom_mlp=(64, 32, EMB_DIM), top_mlp=(256, 128))
+    if tier == "fused":
+        # all tables HBM-resident, one XLA program per step — the in-memory
+        # ceiling tier (no PS processes at all)
+        from persia_tpu.parallel.fused_ctx import FusedTrainCtx
+        from persia_tpu.parallel.fused_step import FusedSlotSpec
+
+        cap = fused_vocab_cap or max(vocabs)
+        specs = {
+            f"cat_{i}": FusedSlotSpec(vocab=int(min(v, cap)), dim=EMB_DIM)
+            for i, v in enumerate(vocabs)
+        }
+        return FusedTrainCtx(
+            model=model,
+            dense_optimizer=optax.adam(1e-3),
+            embedding_optimizer=Adagrad(lr=0.05),
+            specs=specs,
+        )
     stores = [
         EmbeddingStore(
             capacity=capacity,
@@ -72,7 +90,6 @@ def build_ctx(vocabs, ps_replicas=2, capacity=1 << 20, hashstack_above=None,
         for r in range(ps_replicas)
     ]
     worker = EmbeddingWorker(cfg, stores)
-    model = DLRM(embedding_dim=EMB_DIM, bottom_mlp=(64, 32, EMB_DIM), top_mlp=(256, 128))
     if tier == "cached":
         from persia_tpu.embedding.hbm_cache import CachedTrainCtx
 
@@ -109,9 +126,10 @@ def main(argv=None) -> int:
     ap.add_argument("--eval-steps", type=int, default=8)
     ap.add_argument("--ps-replicas", type=int, default=2)
     ap.add_argument(
-        "--tier", choices=("hybrid", "cached"), default="hybrid",
+        "--tier", choices=("hybrid", "cached", "fused"), default="hybrid",
         help="hybrid = host-PS lookups per step; cached = HBM write-back "
-        "cache with on-device sparse updates (capacity tier)",
+        "cache with on-device sparse updates (capacity tier); fused = all "
+        "tables HBM-resident, one XLA program per step (in-memory ceiling)",
     )
     ap.add_argument(
         "--admit-touches", type=int, default=1,
@@ -126,6 +144,11 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--dynamic-loss-scale", action="store_true",
         help="AMP GradScaler-style overflow skip + scale backoff/growth",
+    )
+    ap.add_argument(
+        "--fused-vocab-cap", type=int, default=None,
+        help="fused tier: cap each HBM table at N rows (ids fold by modulo) "
+        "— memory control for hosts smaller than the full vocab",
     )
     ap.add_argument("--ckpt-dir", default=None)
     ap.add_argument(
@@ -182,10 +205,40 @@ def main(argv=None) -> int:
     ctx = build_ctx(vocabs, ps_replicas=args.ps_replicas,
                     hashstack_above=hashstack_above, tier=args.tier,
                     admit_touches=args.admit_touches, wire=args.wire,
-                    dynamic_loss_scale=args.dynamic_loss_scale)
+                    dynamic_loss_scale=args.dynamic_loss_scale,
+                    fused_vocab_cap=args.fused_vocab_cap)
+    cap = args.fused_vocab_cap or max(vocabs)
+    eff_vocabs = [min(v, cap) for v in vocabs]
+
+    def _fold_ids(b):
+        """Fused tables are dense [0, vocab) — fold the open hash-sign id
+        space of file-borne data (and any capped slot) into each table
+        (deterministic, so train and eval agree)."""
+        from persia_tpu.data import IDTypeFeatureWithSingleID, PersiaBatch
+
+        feats = [
+            IDTypeFeatureWithSingleID(
+                f.name,
+                (f.flat_counts()[0] % np.uint64(eff_vocabs[i])).astype(np.uint64),
+            )
+            for i, f in enumerate(b.id_type_features)
+        ]
+        return PersiaBatch(
+            feats, non_id_type_features=b.non_id_type_features,
+            labels=b.labels, requires_grad=b.requires_grad,
+        )
+
     with ctx:
         losses = []
-        if args.tier == "cached":
+        if args.tier == "fused":
+            batches = [
+                _fold_ids(b) for b in train.batches(batch_size=args.batch_size)
+            ]
+            t0 = time.time()
+            for b in batches:
+                losses.append(ctx.train_step(b)["loss"])
+            dt = time.time() - t0
+        elif args.tier == "cached":
             batches = list(train.batches(batch_size=args.batch_size))
             t0 = time.time()
             # mixed-tier configs stream too (ps slots train under bounded
@@ -209,8 +262,10 @@ def main(argv=None) -> int:
 
         preds, labels = [], []
         for batch in test.batches(batch_size=args.batch_size, requires_grad=False):
-            preds.append(ctx.eval_batch(batch))
-            labels.append(batch.labels[0].data)
+            if args.tier == "fused":
+                batch = _fold_ids(batch)
+            preds.append(np.asarray(ctx.eval_batch(batch)).reshape(-1, 1))
+            labels.append(np.asarray(batch.labels[0].data).reshape(-1, 1))
         auc = roc_auc(np.concatenate(labels), np.concatenate(preds))
         print(
             f"criteo-dlrm[{args.scale}] steps={args.steps} "

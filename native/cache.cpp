@@ -507,4 +507,149 @@ void cache_init_rows(const uint64_t* signs, int64_t m, int64_t dim,
   }
 }
 
+// ------------------------------------------------------------ pending map
+//
+// sign → (token, src) open-addressing map for the stream's write-back
+// hazard gate: which in-flight eviction payload (token = step seq) holds a
+// sign's freshest row, and at which payload row (src). The Python gate
+// previously re-scanned every pending record with a searchsorted per step
+// (~45 ms/step at saturation on one core); this map makes the gate one
+// native query. Insert overwrites (later steps win); remove is
+// token-conditional so an in-flight flush cannot delete a newer step's
+// entry for the same sign. Not thread-safe by itself — the stream guards
+// all calls with its condvar lock.
+
+struct PendingMap {
+  struct Slot {
+    uint64_t sign;
+    int64_t src;
+    uint32_t token;
+    uint8_t state;  // 0 empty, 1 used, 2 tombstone
+  };
+  std::vector<Slot> t;
+  uint64_t mask = 0;
+  int64_t count = 0;      // used slots
+  int64_t occupied = 0;   // used + tombstones (probe-chain load)
+
+  void init(uint64_t cap) {
+    uint64_t c = 64;
+    while (c < cap) c <<= 1;
+    t.assign(c, Slot{0, 0, 0, 0});
+    mask = c - 1;
+    count = occupied = 0;
+  }
+
+  void grow_if_needed(int64_t incoming) {
+    if ((occupied + incoming) * 10 < (int64_t)t.size() * 7) return;
+    std::vector<Slot> old;
+    old.swap(t);
+    uint64_t c = old.size();
+    while ((count + incoming) * 10 >= (int64_t)c * 7) c <<= 1;
+    t.assign(c, Slot{0, 0, 0, 0});
+    mask = c - 1;
+    count = occupied = 0;
+    for (const Slot& s : old)
+      if (s.state == 1) put(s.sign, s.src, s.token);
+  }
+
+  void put(uint64_t sign, int64_t src, uint32_t token) {
+    uint64_t j = splitmix64(sign) & mask;
+    int64_t first_tomb = -1;
+    for (;;) {
+      Slot& sl = t[j];
+      if (sl.state == 0) {
+        if (first_tomb >= 0) {
+          Slot& ts = t[first_tomb];
+          ts = Slot{sign, src, token, 1};
+        } else {
+          sl = Slot{sign, src, token, 1};
+          ++occupied;
+        }
+        ++count;
+        return;
+      }
+      if (sl.state == 2) {
+        if (first_tomb < 0) first_tomb = (int64_t)j;
+      } else if (sl.sign == sign) {
+        sl.src = src;
+        sl.token = token;  // overwrite: later steps win
+        return;
+      }
+      j = (j + 1) & mask;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pending_map_create() {
+  auto* m = new (std::nothrow) PendingMap();
+  if (m) m->init(1 << 12);
+  return m;
+}
+
+void pending_map_destroy(void* h) { delete static_cast<PendingMap*>(h); }
+
+int64_t pending_map_size(void* h) { return static_cast<PendingMap*>(h)->count; }
+
+void pending_map_insert(void* h, const uint64_t* signs, const int64_t* srcs,
+                        int64_t n, uint32_t token) {
+  PendingMap& m = *static_cast<PendingMap*>(h);
+  m.grow_if_needed(n);
+  for (int64_t i = 0; i < n; ++i) m.put(signs[i], srcs[i], token);
+}
+
+// tokens_out/srcs_out filled per sign; src -1 = not pending. Returns hits.
+int64_t pending_map_query(void* h, const uint64_t* signs, int64_t n,
+                          uint32_t* tokens_out, int64_t* srcs_out) {
+  PendingMap& m = *static_cast<PendingMap*>(h);
+  int64_t hits = 0;
+  const int64_t PF = 16;
+  for (int64_t i = 0; i < n; ++i) {
+    if (i + PF < n)
+      __builtin_prefetch(&m.t[splitmix64(signs[i + PF]) & m.mask]);
+    const uint64_t s = signs[i];
+    uint64_t j = splitmix64(s) & m.mask;
+    srcs_out[i] = -1;
+    tokens_out[i] = 0;
+    for (;;) {
+      const PendingMap::Slot& sl = m.t[j];
+      if (sl.state == 0) break;
+      if (sl.state == 1 && sl.sign == s) {
+        srcs_out[i] = sl.src;
+        tokens_out[i] = sl.token;
+        ++hits;
+        break;
+      }
+      j = (j + 1) & m.mask;
+    }
+  }
+  return hits;
+}
+
+// remove signs whose CURRENT entry carries `token` (a later re-evict of the
+// same sign under a newer token must survive its older flush)
+void pending_map_remove(void* h, const uint64_t* signs, int64_t n,
+                        uint32_t token) {
+  PendingMap& m = *static_cast<PendingMap*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t s = signs[i];
+    uint64_t j = splitmix64(s) & m.mask;
+    for (;;) {
+      PendingMap::Slot& sl = m.t[j];
+      if (sl.state == 0) break;
+      if (sl.state == 1 && sl.sign == s) {
+        if (sl.token == token) {
+          sl.state = 2;  // tombstone (occupied stays; grow compacts)
+          --m.count;
+        }
+        break;
+      }
+      j = (j + 1) & m.mask;
+    }
+  }
+}
+
 }  // extern "C"

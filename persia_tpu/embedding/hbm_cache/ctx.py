@@ -368,6 +368,11 @@ class CachedTrainCtx:
                     evict_payload[gname] = payload
             for gname, restores in restore_aux.items():
                 for payload, src_idx, dst_rows in restores:
+                    if callable(payload):
+                        # deferred reference to an in-flight eviction
+                        # payload (stream gate): steps dispatch in seq
+                        # order, so the producing step has published it
+                        payload = payload()
                     tables[gname], emb_state[gname] = _restore_rows(
                         tables[gname], emb_state[gname], payload,
                         src_idx, dst_rows,

@@ -91,6 +91,16 @@ def _load_lib() -> ctypes.CDLL:
             _u64p, i64, i64, ctypes.c_uint64, ctypes.c_int,
             ctypes.c_double, ctypes.c_double, ctypes.POINTER(ctypes.c_float),
         ]
+        u32 = ctypes.c_uint32
+        u32p = ctypes.POINTER(u32)
+        lib.pending_map_create.restype = p
+        lib.pending_map_destroy.argtypes = [p]
+        lib.pending_map_size.restype = i64
+        lib.pending_map_size.argtypes = [p]
+        lib.pending_map_insert.argtypes = [p, _u64p, _i64p, i64, u32]
+        lib.pending_map_query.restype = i64
+        lib.pending_map_query.argtypes = [p, _u64p, i64, u32p, _i64p]
+        lib.pending_map_remove.argtypes = [p, _u64p, i64, u32]
         _LIB = lib
     return _LIB
 
@@ -319,3 +329,56 @@ class CacheDirectory:
 
 
 # ------------------------------------------------------------ device state
+
+
+class PendingSignMap:
+    """Native sign → (token, src) map for the stream's write-back hazard
+    gate (`native/cache.cpp` pending_map_*): one query call per step
+    replaces a per-pending-record searchsorted scan. Caller provides the
+    locking (the stream already serializes gate/insert/remove under its
+    condvar)."""
+
+    def __init__(self):
+        self._lib = _load_lib()
+        self._h = self._lib.pending_map_create()
+        if not self._h:
+            raise MemoryError("pending_map_create failed")
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.pending_map_destroy(h)
+            self._h = None
+
+    def __len__(self) -> int:
+        return int(self._lib.pending_map_size(self._h))
+
+    def insert(self, signs: np.ndarray, srcs: np.ndarray, token: int) -> None:
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        srcs = np.ascontiguousarray(srcs, dtype=np.int64)
+        assert len(signs) == len(srcs)
+        self._lib.pending_map_insert(
+            self._h, signs.ctypes.data_as(_u64p),
+            srcs.ctypes.data_as(_i64p), len(signs),
+            ctypes.c_uint32(token & 0xFFFFFFFF),
+        )
+
+    def query(self, signs: np.ndarray):
+        """(hits, tokens (n,) u32, srcs (n,) i64 with -1 = not pending)."""
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        n = len(signs)
+        tokens = np.empty(n, dtype=np.uint32)
+        srcs = np.empty(n, dtype=np.int64)
+        hits = self._lib.pending_map_query(
+            self._h, signs.ctypes.data_as(_u64p), n,
+            tokens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            srcs.ctypes.data_as(_i64p),
+        )
+        return int(hits), tokens, srcs
+
+    def remove(self, signs: np.ndarray, token: int) -> None:
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        self._lib.pending_map_remove(
+            self._h, signs.ctypes.data_as(_u64p), len(signs),
+            ctypes.c_uint32(token & 0xFFFFFFFF),
+        )

@@ -304,7 +304,8 @@ class CachedEmbeddingTier:
 
         resolved = None
         if hazard_gate is not None and len(miss_signs):
-            resolved = hazard_gate(g.name, miss_signs)
+            with span("cache.hazard_gate", n=len(miss_signs)):
+                resolved = hazard_gate(g.name, miss_signs)
 
         m = len(miss_signs)
         if m:
@@ -331,32 +332,40 @@ class CachedEmbeddingTier:
             # staging path; pad regions carry garbage values on purpose —
             # pad rows are C+1, which the scatters drop
             if len(widx):
-                entry_len = g.dim + g.state_dim
-                wp = _bucket(len(widx))
-                w_rows = self._ring.full(("w_rows", g.name), (wp,), np.int32, C + 1)
-                w_entries = self._ring.get(
-                    ("w_entries", g.name), (wp, entry_len), self.aux_np_dtype
-                )
-                w_rows[:len(widx)] = rows_miss[widx]
-                w_entries[:len(widx)] = vals[widx]  # casts on a bf16 wire
-                miss_aux[g.name] = (w_rows, w_entries)
-            if len(cidx):
-                cp = _bucket(len(cidx))
-                c_rows = self._ring.full(("c_rows", g.name), (cp,), np.int32, C + 1)
-                c_f32 = self._ring.get(("c_emb_f32", g.name), (cp, g.dim), np.float32)
-                c_rows[:len(cidx)] = rows_miss[cidx]
-                native_init_rows(
-                    miss_signs[cidx], self.init_seed, g.dim, self.init_method,
-                    out=c_f32[:len(cidx)],
-                )
-                if self.aux_np_dtype == np.float32:
-                    c_emb = c_f32
-                else:
-                    c_emb = self._ring.get(
-                        ("c_emb", g.name), (cp, g.dim), self.aux_np_dtype
+                with span("cache.warm_fill", n=len(widx)):
+                    entry_len = g.dim + g.state_dim
+                    wp = _bucket(len(widx))
+                    w_rows = self._ring.full(
+                        ("w_rows", g.name), (wp,), np.int32, C + 1
                     )
-                    c_emb[:len(cidx)] = c_f32[:len(cidx)]
-                cold_aux[g.name] = (c_rows, c_emb)
+                    w_entries = self._ring.get(
+                        ("w_entries", g.name), (wp, entry_len), self.aux_np_dtype
+                    )
+                    w_rows[:len(widx)] = rows_miss[widx]
+                    w_entries[:len(widx)] = vals[widx]  # casts on a bf16 wire
+                    miss_aux[g.name] = (w_rows, w_entries)
+            if len(cidx):
+                with span("cache.cold_fill", n=len(cidx)):
+                    cp = _bucket(len(cidx))
+                    c_rows = self._ring.full(
+                        ("c_rows", g.name), (cp,), np.int32, C + 1
+                    )
+                    c_f32 = self._ring.get(
+                        ("c_emb_f32", g.name), (cp, g.dim), np.float32
+                    )
+                    c_rows[:len(cidx)] = rows_miss[cidx]
+                    native_init_rows(
+                        miss_signs[cidx], self.init_seed, g.dim,
+                        self.init_method, out=c_f32[:len(cidx)],
+                    )
+                    if self.aux_np_dtype == np.float32:
+                        c_emb = c_f32
+                    else:
+                        c_emb = self._ring.get(
+                            ("c_emb", g.name), (cp, g.dim), self.aux_np_dtype
+                        )
+                        c_emb[:len(cidx)] = c_f32[:len(cidx)]
+                    cold_aux[g.name] = (c_rows, c_emb)
         # evictions: rows to read back (pad → zero row, host slices K)
         k = len(ev_rows)
         if k:

@@ -112,3 +112,22 @@ def test_criteo_dlrm_cached_tier(capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "criteo-dlrm[1tb]" in out and "test_auc=" in out
+
+
+def test_criteo_dlrm_fused_tier(capsys):
+    mod = _load("criteo_dlrm/train.py")
+    rc = mod.main(["--tier", "fused", "--batch-size", "32", "--steps", "3",
+                   "--eval-steps", "1", "--fused-vocab-cap", "512"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "criteo-dlrm[kaggle]" in out and "test_auc=" in out
+
+
+def test_criteo_dlrm_fused_tier_file_data(capsys, tmp_path):
+    fixture = os.path.join(os.path.dirname(__file__), "fixtures", "criteo_tiny.tsv")
+    mod = _load("criteo_dlrm/train.py")
+    rc = mod.main(["--tier", "fused", "--batch-size", "8", "--steps", "1",
+                   "--eval-steps", "1", "--fused-vocab-cap", "256",
+                   "--data-path", fixture])
+    assert rc == 0
+    assert "test_auc=" in capsys.readouterr().out

@@ -1314,3 +1314,37 @@ def test_cached_adam_matches_pure_ps_adam():
     for k in e_ps:
         # same embedding AND the same [m | v] optimizer state
         np.testing.assert_allclose(e_cached[k], e_ps[k], rtol=2e-4, atol=2e-5)
+
+
+def test_pending_sign_map_semantics():
+    """Native hazard-gate map: overwrite-wins inserts, token-conditional
+    removes, growth past the initial capacity."""
+    from persia_tpu.embedding.hbm_cache.directory import PendingSignMap
+
+    m = PendingSignMap()
+    s = np.array([10, 20, 30], dtype=np.uint64)
+    m.insert(s, np.array([0, 1, 2], dtype=np.int64), token=1)
+    hits, tok, src = m.query(np.array([20, 99, 30], dtype=np.uint64))
+    assert hits == 2
+    np.testing.assert_array_equal(src, [1, -1, 2])
+    assert tok[0] == 1 and tok[2] == 1
+
+    # later token overwrites sign 20
+    m.insert(np.array([20], dtype=np.uint64), np.array([7], dtype=np.int64), token=2)
+    _, tok, src = m.query(np.array([20], dtype=np.uint64))
+    assert (tok[0], src[0]) == (2, 7)
+
+    # removing with the OLD token must not delete the newer entry
+    m.remove(s, token=1)
+    hits, tok, src = m.query(s)
+    assert hits == 1 and src[1] == 7  # only sign 20 (token 2) survives
+    m.remove(np.array([20], dtype=np.uint64), token=2)
+    assert m.query(s)[0] == 0 and len(m) == 0
+
+    # growth: 200k inserts from the 4096-slot initial table
+    big = np.arange(1, 200_001, dtype=np.uint64)
+    m.insert(big, np.arange(200_000, dtype=np.int64), token=3)
+    assert len(m) == 200_000
+    hits, _, src = m.query(big[::997])
+    assert hits == len(big[::997])
+    np.testing.assert_array_equal(src, np.arange(200_000, dtype=np.int64)[::997])
