@@ -645,14 +645,22 @@ def bench_quality():
             "BENCH_QUALITY_STEPS must be >= 3 (the first 2 batches are the "
             "untimed compile warmup)"
         )
+    budget_s = float(os.environ.get("BENCH_MODE_BUDGET_S", "1800"))
     out = {}
     for tier in ("cached", "ps-stream", "fused"):
         env = dict(os.environ, BENCH_QUALITY_TIER=tier,
                    BENCH_QUALITY_STEPS=str(steps))
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env, capture_output=True, text=True,
-        )
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True, timeout=budget_s,
+            )
+        except subprocess.TimeoutExpired:
+            raise RuntimeError(
+                f"quality tier {tier!r} exceeded its {budget_s:.0f}s budget "
+                "(link weather) — rerun with a larger BENCH_MODE_BUDGET_S "
+                "or fewer BENCH_QUALITY_STEPS"
+            )
         lines = r.stdout.strip().splitlines()
         if r.returncode != 0 or not lines:
             raise RuntimeError(

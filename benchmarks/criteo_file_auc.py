@@ -104,16 +104,20 @@ def main():
     if not os.path.exists(data_path):
         print(f"generating {data_path} ...", flush=True)
         sha = generate_slice(data_path)
+        rows = (STEPS + EVAL) * BS
     else:
+        opener = gzip.open if data_path.endswith(".gz") else open
         h = hashlib.sha256()
-        with gzip.open(data_path, "rt") as f:
+        rows = 0
+        with opener(data_path, "rt") as f:
             for line in f:
                 h.update(line.encode())
+                rows += 1
         sha = h.hexdigest()
     out = {
         "file": os.path.basename(data_path),
         "file_sha256": sha,
-        "rows": (STEPS + EVAL) * BS,
+        "rows": rows,
         "train_steps": STEPS,
         "eval_steps": EVAL,
         "batch_size": BS,

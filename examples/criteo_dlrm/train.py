@@ -79,6 +79,10 @@ def build_ctx(vocabs, ps_replicas=2, capacity=1 << 20, hashstack_above=None,
             dense_optimizer=optax.adam(1e-3),
             embedding_optimizer=Adagrad(lr=0.05),
             specs=specs,
+            # open hash-sign ids (file data, capped slots) fold into each
+            # dense [0, vocab) table by modulo — batch_to_fused also
+            # range-checks and pads multi-id slots correctly
+            fold_ids=True,
         )
     stores = [
         EmbeddingStore(
@@ -207,33 +211,10 @@ def main(argv=None) -> int:
                     admit_touches=args.admit_touches, wire=args.wire,
                     dynamic_loss_scale=args.dynamic_loss_scale,
                     fused_vocab_cap=args.fused_vocab_cap)
-    cap = args.fused_vocab_cap or max(vocabs)
-    eff_vocabs = [min(v, cap) for v in vocabs]
-
-    def _fold_ids(b):
-        """Fused tables are dense [0, vocab) — fold the open hash-sign id
-        space of file-borne data (and any capped slot) into each table
-        (deterministic, so train and eval agree)."""
-        from persia_tpu.data import IDTypeFeatureWithSingleID, PersiaBatch
-
-        feats = [
-            IDTypeFeatureWithSingleID(
-                f.name,
-                (f.flat_counts()[0] % np.uint64(eff_vocabs[i])).astype(np.uint64),
-            )
-            for i, f in enumerate(b.id_type_features)
-        ]
-        return PersiaBatch(
-            feats, non_id_type_features=b.non_id_type_features,
-            labels=b.labels, requires_grad=b.requires_grad,
-        )
-
     with ctx:
         losses = []
         if args.tier == "fused":
-            batches = [
-                _fold_ids(b) for b in train.batches(batch_size=args.batch_size)
-            ]
+            batches = list(train.batches(batch_size=args.batch_size))
             t0 = time.time()
             for b in batches:
                 losses.append(ctx.train_step(b)["loss"])
@@ -262,8 +243,6 @@ def main(argv=None) -> int:
 
         preds, labels = [], []
         for batch in test.batches(batch_size=args.batch_size, requires_grad=False):
-            if args.tier == "fused":
-                batch = _fold_ids(batch)
             preds.append(np.asarray(ctx.eval_batch(batch)).reshape(-1, 1))
             labels.append(np.asarray(batch.labels[0].data).reshape(-1, 1))
         auc = roc_auc(np.concatenate(labels), np.concatenate(preds))

@@ -40,6 +40,7 @@ from persia_tpu.embedding.hbm_cache.groups import (  # noqa: F401
     CacheLayout,
     CachedTrainState,
     _apply_aux,
+    _apply_aux_ring,
     _bucket,
     _lazy_pool,
     _model_emb_from_gathered,
@@ -91,6 +92,7 @@ class CachedTrainCtx:
         loss_scale_init: float = float(2 ** 15),
         loss_scale_growth_interval: int = 2000,
         loss_scale_max: float = float(2 ** 24),
+        wb_ring_rows: int = 1 << 20,
     ):
         self.model = model
         self.dense_optimizer = dense_optimizer
@@ -108,6 +110,12 @@ class CachedTrainCtx:
         # steady state (the reference ships f16 wires); default stays f32
         # because the cached tier is otherwise bit-exact vs the pure-PS path
         self._wb_bf16 = wb_wire_dtype == "bfloat16"
+        # standing per-group DEVICE eviction rings (stream restores gather
+        # from here in ONE program per group; see _apply_aux_ring). Sized in
+        # PADDED rows; the stream's allocator back-pressures when the
+        # in-flight window would overrun.
+        self.wb_ring_rows = int(wb_ring_rows)
+        self._ev_rings: Dict[str, jnp.ndarray] = {}
         self.tier = CachedEmbeddingTier(
             worker, self.sparse_cfg, cache_rows, embedding_config,
             init_seed=init_seed, ps_slots=ps_slots,
@@ -266,7 +274,15 @@ class CachedTrainCtx:
         """Host→device staging with mesh shardings when a DP mesh is set:
         batch-dim leaves shard over ``data`` (dense/labels (B,·); stacked
         row/scale matrices on their middle axis), aux scatters replicate
-        (they address the replicated cache pools)."""
+        (they address the replicated cache pools).
+
+        Every input here is a FRESH per-step host buffer (_BufRing hands
+        out new arrays; see its docstring for the reuse-race history), so
+        the asynchronous ``device_put``s need no completion barrier — the
+        buffers stay alive via the queue items until consumed, and nothing
+        rewrites them. A barrier here costs ~180 ms/step on a
+        remote-attached chip (measured), so do not add one back without
+        re-proving the buffers' lifetime story."""
         if self.mesh is None:
             return (
                 jax.device_put(device_inputs), jax.device_put(miss_aux),
@@ -342,8 +358,32 @@ class CachedTrainCtx:
             }
         return em
 
+    def ring_rows(self, gname: str) -> int:
+        """Standing-ring height for a group: per-step evictions are bounded
+        by the group's own cache rows, so a ring a couple of cache-sizes
+        tall covers any realistic in-flight window without allocating the
+        global ceiling for tiny caches (a 100-row test cache does not need
+        a 2^20-row ring)."""
+        g = next(gr for gr in self.tier.groups if gr.name == gname)
+        return min(self.wb_ring_rows, max(4096, 2 * g.rows))
+
+    def _ev_ring(self, gname: str) -> jnp.ndarray:
+        """The group's standing eviction ring (lazy; replicated on a mesh)."""
+        ring = self._ev_rings.get(gname)
+        if ring is None:
+            g = next(gr for gr in self.tier.groups if gr.name == gname)
+            dt = jnp.bfloat16 if self._wb_bf16 else jnp.float32
+            ring = jnp.zeros(
+                (self.ring_rows(gname), g.dim + g.state_dim), dtype=dt
+            )
+            rep = self._replicated()
+            ring = jax.device_put(ring) if rep is None else jax.device_put(ring, rep)
+            self._ev_rings[gname] = ring
+        return ring
+
     def _dispatch(
-        self, device_inputs, layout, miss_aux, cold_aux, restore_aux, evict_aux
+        self, device_inputs, layout, miss_aux, cold_aux, restore_aux,
+        evict_aux, evict_meta=None,
     ):
         """Dispatch the per-step device programs: ONE fused aux program per
         touched group (evict-payload read → warm scatter → cold scatter; see
@@ -354,33 +394,53 @@ class CachedTrainCtx:
         if touched or restore_aux:
             tables = dict(self.state.tables)
             emb_state = dict(self.state.emb_state)
-            for gname in sorted(touched):
-                em = self._group_empties(gname)
-                ev_rows = evict_aux.get(gname, em["rows"])
-                m_rows, m_entries = miss_aux.get(gname, (em["rows"], em["entries"]))
-                c_rows, c_emb = cold_aux.get(gname, (em["rows"], em["emb"]))
-                tables[gname], emb_state[gname], payload = _apply_aux(
-                    tables[gname], emb_state[gname], ev_rows,
-                    m_rows, m_entries, c_rows, c_emb, self._state_consts,
-                    self._wb_bf16,
-                )
-                if gname in evict_aux:
-                    evict_payload[gname] = payload
-            for gname, restores in restore_aux.items():
-                for payload, src_idx, dst_rows in restores:
-                    if callable(payload):
-                        # deferred reference to an in-flight eviction
-                        # payload (stream gate): steps dispatch in seq
-                        # order, so the producing step has published it
-                        payload = payload()
-                    tables[gname], emb_state[gname] = _restore_rows(
-                        tables[gname], emb_state[gname], payload,
-                        src_idx, dst_rows,
+            with span("ctx.apply_aux", groups=len(touched)):
+                for gname in sorted(touched):
+                    em = self._group_empties(gname)
+                    ev_rows = evict_aux.get(gname, em["rows"])
+                    m_rows, m_entries = miss_aux.get(
+                        gname, (em["rows"], em["entries"])
                     )
+                    c_rows, c_emb = cold_aux.get(gname, (em["rows"], em["emb"]))
+                    ring_pos = -1
+                    if evict_meta and gname in evict_meta:
+                        ring_pos = evict_meta[gname][2]
+                    if ring_pos >= 0:
+                        (tables[gname], emb_state[gname],
+                         self._ev_rings[gname], payload) = _apply_aux_ring(
+                            tables[gname], emb_state[gname],
+                            self._ev_ring(gname), jnp.int32(ring_pos),
+                            ev_rows, m_rows, m_entries, c_rows, c_emb,
+                            self._state_consts, self._wb_bf16,
+                        )
+                    else:
+                        tables[gname], emb_state[gname], payload = _apply_aux(
+                            tables[gname], emb_state[gname], ev_rows,
+                            m_rows, m_entries, c_rows, c_emb,
+                            self._state_consts, self._wb_bf16,
+                        )
+                    if gname in evict_aux:
+                        evict_payload[gname] = payload
+            n_restores = sum(len(r) for r in restore_aux.values())
+            with span("ctx.restores", n=n_restores):
+                for gname, restores in restore_aux.items():
+                    for payload, src_idx, dst_rows in restores:
+                        if payload is None:
+                            # stream gate: gather from the group's standing
+                            # eviction ring — the producing steps dispatch
+                            # before this one (seq order), so their
+                            # dynamic_update_slice writes precede this read
+                            # in device program order
+                            payload = self._ev_ring(gname)
+                        tables[gname], emb_state[gname] = _restore_rows(
+                            tables[gname], emb_state[gname], payload,
+                            src_idx, dst_rows,
+                        )
             self.state = self.state.replace(tables=tables, emb_state=emb_state)
-        self.state, header, ps_gpacked = self._step(
-            self.state, device_inputs, layout
-        )
+        with span("ctx.main_step"):
+            self.state, header, ps_gpacked = self._step(
+                self.state, device_inputs, layout
+            )
         return header, evict_payload, ps_gpacked
 
     def _ps_forward(self, batch: PersiaBatch):
@@ -466,7 +526,7 @@ class CachedTrainCtx:
             )
             header, evict_payload, ps_gpacked = self._dispatch(
                 device_inputs, layout, miss_aux, cold_aux, restore_aux,
-                evict_aux,
+                evict_aux, evict_meta,
             )
         except Exception:
             # any failure after the forward must release the staleness slot
@@ -489,7 +549,9 @@ class CachedTrainCtx:
             evict_meta, evict_payload, header, device_inputs["labels"][0].shape
         )
         self._pending_signs = {
-            int(s) for ev_signs, k in evict_meta.values() for s in ev_signs[:k]
+            int(s)
+            for ev_signs, k, _rp in evict_meta.values()
+            for s in ev_signs[:k]
         }
         if prev is not None:
             self._write_back_only(prev)

@@ -147,47 +147,56 @@ def native_init_rows(
     return out
 
 
-class _BufRing:
-    """Reusable host staging buffers for the per-step hot path.
+def _retain_allocator_pages() -> None:
+    """Tell glibc to satisfy MB-scale allocations from retained heap pages.
 
-    Fresh ``np.zeros``/``np.empty`` of ~0.5-1 MB per step cross the
-    allocator's mmap threshold, so every step pays mmap + first-touch page
-    faults + munmap TLB churn — profiled at ~20 ms/step of pure allocator
-    cost on a single-core host, dwarfing the actual compute. A ring of
-    ``depth`` buffers per call-site key amortizes that to zero while keeping
-    a buffer alive long enough for any in-flight async ``device_put`` to
-    finish serializing before the slot comes around again (depth must
-    exceed the stream's prefetch depth; 8 > 3)."""
+    The per-step staging buffers (~0.5-1 MB each) historically crossed
+    malloc's default mmap threshold, so every step paid mmap +
+    first-touch page faults + munmap TLB churn — profiled at ~20 ms/step
+    of pure allocator cost on a single-core host. The old answer was a
+    fixed-depth buffer-reuse ring, which turned out to hand a
+    still-in-flight buffer back to the feeder whenever the pipeline ran
+    deeper than the depth — measured as run-to-run NONDETERMINISTIC
+    training (torn staging bytes). Raising M_MMAP_THRESHOLD keeps fresh
+    allocations cheap (glibc free-lists, no page churn) so every step can
+    own brand-new buffers: correctness by construction, same speed.
+    No-op where mallopt is unavailable (non-glibc)."""
+    try:
+        libc = ctypes.CDLL(None)
+        M_MMAP_THRESHOLD = -3
+        libc.mallopt(M_MMAP_THRESHOLD, 64 * 1024 * 1024)
+    except Exception:  # noqa: BLE001 — allocator tuning is best-effort
+        pass
+
+
+_retain_allocator_pages()
+
+
+class _BufRing:
+    """Per-step host staging buffer source.
+
+    Every ``get`` returns a FRESH array: the per-step buffers escape into
+    an asynchronously consumed pipeline (device_put serialization, jit
+    argument lifetimes), and no rotation depth or release protocol proved
+    robust against every consumer — a reused buffer whose bytes change
+    while any in-flight reader still needs them silently corrupts
+    training (observed as bimodal per-step losses at deep prefetch).
+    Allocation stays cheap because ``_retain_allocator_pages`` keeps
+    glibc from mmap-ing these MB-scale buffers. The class keeps its
+    pooling-era surface (keys, depth) so call sites stay unchanged."""
 
     def __init__(self, depth: int = 8):
-        self.depth = depth
-        self._slots: Dict = {}
+        self.depth = depth  # API compat; no rotation happens anymore
 
     def ensure_depth(self, depth: int) -> None:
-        """Grow the ring so ``depth`` buffers rotate before any reuse.
-
-        Safe at any time: ``get`` keeps appending fresh buffers per key
-        until the ring holds ``self.depth`` of them, so raising the depth
-        simply extends the rotation; existing hand-outs are unaffected."""
         if depth > self.depth:
             self.depth = depth
 
     def get(self, key, shape, dtype) -> np.ndarray:
-        arrs, idx = self._slots.get(key, ([], 0))
-        if len(arrs) < self.depth:
-            arr = np.empty(shape, dtype)
-            arrs.append(arr)
-            self._slots[key] = (arrs, 0)
-            return arr
-        arr = arrs[idx]
-        if arr.shape != shape or arr.dtype != np.dtype(dtype):
-            arr = np.empty(shape, dtype)
-            arrs[idx] = arr
-        self._slots[key] = (arrs, (idx + 1) % self.depth)
-        return arr
+        return np.empty(shape, dtype)
 
     def full(self, key, shape, dtype, fill) -> np.ndarray:
-        arr = self.get(key, shape, dtype)
+        arr = np.empty(shape, dtype)
         arr.fill(fill)
         return arr
 

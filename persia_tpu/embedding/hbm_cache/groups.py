@@ -292,6 +292,28 @@ def _apply_aux(table, state: Dict[str, jnp.ndarray], ev_rows, m_rows,
     return table, out_state, payload
 
 
+@_partial(jax.jit, donate_argnums=(0, 1, 2), static_argnums=(9, 10))
+def _apply_aux_ring(table, state: Dict[str, jnp.ndarray], ring, ring_pos,
+                    ev_rows, m_rows, m_entries, c_rows, c_emb, state_consts,
+                    wb_bf16=False):
+    """``_apply_aux`` + one extra fused write: the eviction payload also
+    lands in the group's standing DEVICE ring at ``ring_pos``. The stream's
+    hazard restores then gather straight from the ring — ONE
+    ``_restore_rows`` per group per step regardless of how many in-flight
+    steps' payloads are referenced, where per-payload restores cost one
+    degraded-latency dispatch EACH (measured 35 ms/step of a 129 ms wall at
+    saturation). The per-step payload array is still returned for the
+    write-back thread's bounded d2h fetch."""
+    table, out_state, payload = _apply_aux(
+        table, state, ev_rows, m_rows, m_entries, c_rows, c_emb,
+        state_consts, wb_bf16,
+    )
+    ring = jax.lax.dynamic_update_slice(
+        ring, payload.astype(ring.dtype), (ring_pos, 0)
+    )
+    return table, out_state, ring, payload
+
+
 def _state_init_consts(cfg: OptimizerConfig):
     """(key, scalar) pairs for a fresh entry's optimizer-state tail —
     mirrors ``init_sparse_state`` / the PS's ``init_state``."""

@@ -104,24 +104,16 @@ def run_train_stream(
 
     if prefetch < 1:
         raise ValueError(f"prefetch must be >= 1, got {prefetch}")
-    # The feeder→stager path holds up to prefetch (prep_q) + 2 in-hand
-    # batches of host staging buffers, each still referenced by an async
-    # device_put until its h2d lands. Size every staging ring so a slot
-    # cannot come around for reuse while that many items (plus h2d
-    # slack) are in flight — otherwise a deep-prefetch stream would
-    # silently corrupt device-side data.
+    # Host staging buffers are FRESH per step (_BufRing hands out new
+    # arrays; its docstring records the reuse-race history), so no ring
+    # depth needs sizing against the prefetch window anymore; the
+    # ensure_depth calls remain as no-op API compat.
     need_depth = prefetch + 4
     self.tier._ring.ensure_depth(need_depth)
     for d in self.tier.dirs.values():
         d._rows_ring.ensure_depth(need_depth)
 
     self._land_pending()  # do not mix with a sync-path deferred step
-    # pending eviction write-backs, seq → per-group record:
-    #   {"sorted": {g: sorted u64 signs}, "order": {g: payload row of
-    #    each sorted sign}, "payload": None | {g: DEVICE (Kp, entry_len)}}
-    # "payload" is filled by the main thread at dispatch; the record is
-    # deleted once the batched write-back lands it in the PS.
-    pending: Dict[int, Dict] = {}
     cv = threading.Condition()
     stop = threading.Event()
     staged_q: "_queue.Queue" = _queue.Queue(maxsize=prefetch)
@@ -134,48 +126,74 @@ def run_train_stream(
     SENTINEL = object()
     errors: List[BaseException] = []
 
-    # sign → (token=seq, payload row) for every in-flight eviction: ONE
-    # native query per gate call (native/cache.cpp pending_map_*) instead
-    # of a searchsorted scan over every pending record (~45 ms/step at
-    # saturation on one core). All map calls run under `cv`.
+    # Standing-ring accounting. Eviction payloads land in each group's
+    # DEVICE ring (ctx._ev_rings, written inside _apply_aux_ring); the
+    # allocator below reserves PADDED row spans at prepare time and
+    # back-pressures when the in-flight window would overrun the ring. The
+    # write-back thread advances the tail after landing a span in the PS.
+    # All shared state (heads/tails/alloc_q/sign_map) is guarded by `cv`.
+    heads: Dict[str, int] = {}  # monotonic, unwrapped
+    tails: Dict[str, int] = {}
+    # per-group FIFO of reserved span sizes (skip + kp) — allocations and
+    # flushes are both in seq order per group, so tail advance is a pop
+    alloc_q: Dict[str, List[int]] = {}
+    flush_now = threading.Event()  # feeder → wb: ring full, flush early
+
+    def ring_alloc(gname: str, kp: int) -> int:
+        W = self.ring_rows(gname)
+        if kp > W:
+            raise RuntimeError(
+                f"one step evicts {kp} (padded) rows > the {W}-row "
+                f"eviction ring of group {gname!r}; raise wb_ring_rows or "
+                "lower the eviction volume (admit_touches / cache_rows)"
+            )
+        with cv:
+            while not (stop.is_set() or errors):
+                head = heads.get(gname, 0)
+                tail = tails.get(gname, 0)
+                # a span never wraps mid-region: skip to 0 if it would
+                skip = (W - head % W) if (head % W) + kp > W else 0
+                if head + skip + kp - tail <= W:
+                    heads[gname] = head + skip + kp
+                    alloc_q.setdefault(gname, []).append(skip + kp)
+                    return (head + skip) % W
+                if tail == head and head % W:
+                    # ring fully drained, only the wrap waste doesn't fit
+                    # the circular invariant (waste counts as allocated
+                    # until a flush passes it, but there is nothing left
+                    # to flush) — jump both pointers to the next ring
+                    # boundary; no live span exists to overlap
+                    heads[gname] = tails[gname] = -(-head // W) * W
+                    continue
+                # ring full: ask the write-back thread to flush early and
+                # wait for the tail to advance
+                flush_now.set()
+                with span("stream.ring_wait", group=gname):
+                    cv.wait(timeout=0.5)
+            return -1  # unwinding — the step never dispatches
+
+    # sign → (token=seq, ring row) for every in-flight eviction: ONE native
+    # query per gate call (native/cache.cpp pending_map_*), ONE restore
+    # program per group per step (all hits gather from the standing ring,
+    # regardless of how many producing steps are referenced).
     sign_map = PendingSignMap()
 
     def gate(gname: str, miss_signs: np.ndarray):
         """Resolve re-missed pending-evicted signs against the in-flight
-        DEVICE payloads: returns restore descriptors whose payloads are
-        DEFERRED (zero-arg callables). The feeder runs ``prefetch`` steps
-        ahead of the main thread, so a just-evicted payload usually does
-        not exist yet — an older design parked the feeder on a condvar
-        until the main thread dispatched that step, a pipeline stall the
-        saturated regime hit nearly every step (measured 111 ms/step of a
-        158 ms wall). Deferral removes the wait entirely: the main thread
-        dispatches steps in seq order, so by the time it resolves step
-        t's restores, every producing step s < t has published its
-        payload on the captured record (same thread — no race)."""
-        out = []
+        DEVICE ring: returns at most one restore descriptor, whose payload
+        is ``None`` (= the group's standing ring, resolved by the main
+        thread at dispatch). Correctness is dispatch ordering: the steps
+        that wrote the referenced ring rows dispatch before this one, and
+        a span is only reallocated after its write-back lands (tail
+        advance), which also removes its map entries."""
         with cv:
             if stop.is_set() or errors:
                 return None
-            hits, tokens, srcs = sign_map.query(miss_signs)
+            hits, _tokens, srcs = sign_map.query(miss_signs)
             if not hits:
                 return None
-            pos_all = np.nonzero(srcs >= 0)[0]
-            for tok in np.unique(tokens[pos_all]).tolist():
-                rec = pending.get(int(tok))
-                if rec is None:
-                    # flush landed between remove and this query — the PS
-                    # already holds the fresh rows, no restore needed
-                    continue
-                pos = pos_all[tokens[pos_all] == tok]
-                src = srcs[pos]
-                # rec outlives its pending[] entry via this closure, so a
-                # write-back landing between prepare and dispatch cannot
-                # drop the payload out from under the restore
-                out.append(
-                    ((lambda rec=rec, gn=gname: rec["payload"][gn]),
-                     src, pos.astype(np.int64))
-                )
-        return out or None
+            pos = np.nonzero(srcs >= 0)[0]
+            return [(None, srcs[pos], pos)]
 
     prep_q: "_queue.Queue" = _queue.Queue(maxsize=prefetch)
 
@@ -196,7 +214,9 @@ def run_train_stream(
                 if stop.is_set() or errors:
                     break
                 with span("stream.prep"):
-                    item = self.tier.prepare_batch(batch, hazard_gate=gate)
+                    item = self.tier.prepare_batch(
+                        batch, hazard_gate=gate, ring_alloc=ring_alloc
+                    )
                 with span("stream.ps_forward"):
                     ps_item = self._ps_forward(batch)
                 if ps_item is not None:
@@ -211,16 +231,18 @@ def run_train_stream(
                 evict_meta = item[6]
                 # evicted signs become hazard-gated HERE (admit time): a
                 # later batch's probe must not trust the PS for them
-                # until the write-back lands their payload
+                # until the write-back lands their rows. Map srcs are the
+                # STANDING-RING rows reserved by ring_alloc above.
                 if evict_meta:
-                    rec = {"payload": None}
                     with cv:
-                        for gn, (ev, k) in evict_meta.items():
-                            # payload row of ev[i] is i
+                        for gn, (ev, k, ring_pos) in evict_meta.items():
+                            if ring_pos < 0:  # unwinding ring_alloc
+                                continue
                             sign_map.insert(
-                                ev[:k], np.arange(k, dtype=np.int64), seq
+                                ev[:k],
+                                ring_pos + np.arange(k, dtype=np.int64),
+                                seq,
                             )
-                        pending[seq] = rec
                 if not _put(prep_q, (seq, item, ps_item)):
                     if ps_item is not None:
                         self.worker.abort_gradient(ps_item[0])
@@ -252,8 +274,8 @@ def run_train_stream(
                 # input: on a mesh an uncommitted put lands on one
                 # device and _restore_rows would see incompatible
                 # devices against the replicated tables. Payloads stay
-                # untouched — they are deferred callables (resolved at
-                # dispatch) or already-committed device arrays.
+                # untouched — None means "the group's standing eviction
+                # ring", resolved by the main thread at dispatch.
                 rep = self._replicated()
                 put = (
                     jax.device_put if rep is None
@@ -294,7 +316,7 @@ def run_train_stream(
         pool = self._fetch_pool()
         fetches = []  # (seq, gname, k, device payload)
         for seq, evict_meta, evict_payload in acc:
-            for gn, (ev, k) in evict_meta.items():
+            for gn, (ev, k, _ring_pos) in evict_meta.items():
                 fetches.append((seq, gn, ev, k, evict_payload[gn]))
 
         def fetch(f):
@@ -308,9 +330,11 @@ def run_train_stream(
             for seq, evict_meta, _p in acc:
                 # token-conditional: a later re-evict of the same sign
                 # under a newer seq survives this older flush
-                for gn, (ev, k) in evict_meta.items():
+                for gn, (ev, k, _ring_pos) in evict_meta.items():
                     sign_map.remove(ev[:k], seq)
-                pending.pop(seq, None)
+                    q = alloc_q.get(gn)
+                    if q:  # tail advance frees the span for reallocation
+                        tails[gn] = tails.get(gn, 0) + q.pop(0)
             cv.notify_all()
         acc.clear()
 
@@ -366,7 +390,21 @@ def run_train_stream(
         acc: List = []
         ps_acc: List = []
         while True:
-            item = wb_q.get()
+            try:
+                item = wb_q.get(timeout=0.25)
+            except _queue.Empty:
+                # ring-full back-pressure: the feeder is parked waiting for
+                # tail advance, and no new wb items can arrive until it
+                # resumes — flush whatever is accumulated, however small
+                if flush_now.is_set() and acc:
+                    try:
+                        flush_now.clear()
+                        _flush_acc(acc)
+                    except BaseException as e:  # noqa: BLE001
+                        errors.append(e)
+                        with cv:
+                            cv.notify_all()
+                continue
             try:
                 if item is SENTINEL:
                     _flush_acc(acc)
@@ -378,16 +416,16 @@ def run_train_stream(
                         _flush_ps(ps_acc)
                     continue
                 acc.append(item)
-                if len(acc) >= FLUSH_STEPS:
+                if len(acc) >= FLUSH_STEPS or flush_now.is_set():
+                    flush_now.clear()
                     _flush_acc(acc)
             except BaseException as e:  # noqa: BLE001
                 errors.append(e)
                 _abort_ps_refs(ps_acc)
                 with cv:
                     for seq, evict_meta, _p in acc:
-                        for gn, (ev, k) in evict_meta.items():
+                        for gn, (ev, k, _ring_pos) in evict_meta.items():
                             sign_map.remove(ev[:k], seq)
-                        pending.pop(seq, None)
                     acc.clear()
                     cv.notify_all()
                 if item is SENTINEL:
@@ -431,7 +469,7 @@ def run_train_stream(
                 with span("stream.dispatch"):
                     header, evict_payload, ps_gpacked = self._dispatch(
                         di, layout, miss_aux, cold_aux, restore_aux,
-                        evict_aux
+                        evict_aux, evict_meta,
                     )
             except BaseException:
                 # the in-hand item is already off the queue: the
@@ -450,12 +488,9 @@ def run_train_stream(
                 wb_q.put(("psgrad", ps_item, ps_gpacked))
             label_shape = di["labels"][0].shape
             if evict_meta:
-                # publish the DEVICE payload so the feeder's gate can
-                # build restores for re-missed signs without any d2h
-                with cv:
-                    if seq in pending:
-                        pending[seq]["payload"] = evict_payload
-                    cv.notify_all()
+                # the ring rows were written device-side inside this
+                # step's _apply_aux_ring; the wb thread only needs the
+                # per-step payload array for its bounded d2h fetch
                 wb_q.put((seq, evict_meta, evict_payload))
             if self.sparse_cfg.kind == OPTIMIZER_ADAM:
                 # mirror the device's beta-power advance on the PS every

@@ -290,7 +290,7 @@ class CachedEmbeddingTier:
     def _admit_aux(
         self, g: CacheGroup, miss_signs, rows_miss, ev_signs, ev_rows,
         n_unique, hazard_gate, miss_aux, cold_aux, restore_aux, evict_aux,
-        evict_meta,
+        evict_meta, ring_alloc=None,
     ) -> None:
         """Post-admit bookkeeping shared by the general and single-id fast
         paths: metrics, the cross-step write-back hazard gate, the
@@ -301,6 +301,23 @@ class CachedEmbeddingTier:
         self._m_hit.inc(n_unique - len(miss_signs))
         self._m_miss.inc(len(miss_signs))
         self._m_evict.inc(len(ev_signs))
+
+        # Reserve this step's eviction-ring span BEFORE the hazard-gate
+        # query: the allocator only hands out spans with no live map
+        # entries, so rows the gate is about to reference can never land
+        # in THIS step's span — this step's ring write precedes this
+        # step's restores in device program order, and a same-step
+        # overwrite of a restore source would corrupt the restore.
+        k = len(ev_rows)
+        ring_pos = -1
+        if k:
+            kp = _bucket(k)
+            if ring_alloc is not None:
+                ring_pos = ring_alloc(g.name, kp)
+            e_rows = self._ring.full(("e_rows", g.name), (kp,), np.int32, C)
+            e_rows[:k] = ev_rows
+            evict_aux[g.name] = e_rows
+            evict_meta[g.name] = (ev_signs, k, ring_pos)
 
         resolved = None
         if hazard_gate is not None and len(miss_signs):
@@ -366,14 +383,7 @@ class CachedEmbeddingTier:
                         )
                         c_emb[:len(cidx)] = c_f32[:len(cidx)]
                     cold_aux[g.name] = (c_rows, c_emb)
-        # evictions: rows to read back (pad → zero row, host slices K)
-        k = len(ev_rows)
-        if k:
-            kp = _bucket(k)
-            e_rows = self._ring.full(("e_rows", g.name), (kp,), np.int32, C)
-            e_rows[:k] = ev_rows
-            evict_aux[g.name] = e_rows
-            evict_meta[g.name] = (ev_signs, k)
+        # (eviction read-back bucket reserved above, before the gate)
 
     def _single_id_groups(self, batch: PersiaBatch):
         """The fast-path precondition: EVERY group is pooled-only, no
@@ -431,6 +441,7 @@ class CachedEmbeddingTier:
         self,
         batch: PersiaBatch,
         hazard_gate: Optional[Callable[[np.ndarray], None]] = None,
+        ring_alloc: Optional[Callable[[str, int], int]] = None,
     ):
         """Admit the batch's distinct signs, check misses out of the PS, and
         build the device step inputs. Returns (device_inputs, layout,
@@ -451,7 +462,9 @@ class CachedEmbeddingTier:
         ``None`` means no overlap."""
         fast = self._single_id_groups(batch)
         if fast is not None:
-            return self._prepare_batch_single_id(batch, fast, hazard_gate)
+            return self._prepare_batch_single_id(
+                batch, fast, hazard_gate, ring_alloc
+            )
         cached_feats = [
             f for f in batch.id_type_features if f.name not in self.ps_slots
         ]
@@ -482,6 +495,7 @@ class CachedEmbeddingTier:
                 g, miss_signs, rows_u[miss_idx], ev_signs, ev_rows,
                 len(uniq), hazard_gate,
                 miss_aux, cold_aux, restore_aux, evict_aux, evict_meta,
+                ring_alloc,
             )
 
             # per-slot row matrices: pooled slots stack into (S, B, L)
@@ -527,7 +541,8 @@ class CachedEmbeddingTier:
             evict_aux, evict_meta,
         )
 
-    def _prepare_batch_single_id(self, batch: PersiaBatch, fast, hazard_gate):
+    def _prepare_batch_single_id(self, batch: PersiaBatch, fast, hazard_gate,
+                                 ring_alloc=None):
         """Single-id fast path: ONE native call per group
         (``cache_admit_positions``: dedup + admit + per-position rows) and
         the row matrix is its output reshaped — no per-slot dedup, no row
@@ -550,7 +565,7 @@ class CachedEmbeddingTier:
                 self._admit_aux(
                     g, miss_signs, miss_rows, ev_signs, ev_rows, n_unique,
                     hazard_gate, miss_aux, cold_aux, restore_aux, evict_aux,
-                    evict_meta,
+                    evict_meta, ring_alloc,
                 )
             stacked_rows[g.name] = rows.reshape(S, B, 1)
             layout_stacked.append((g.name, names))
@@ -647,7 +662,7 @@ class CachedEmbeddingTier:
 
     def write_back(self, evict_meta, evict_payload) -> None:
         """Persist evicted rows to the PS (full [emb | state] entries)."""
-        for gname, (ev_signs, k) in evict_meta.items():
+        for gname, (ev_signs, k, _ring_pos) in evict_meta.items():
             if not k:
                 continue
             g = next(gr for gr in self.groups if gr.name == gname)

@@ -1348,3 +1348,102 @@ def test_pending_sign_map_semantics():
     hits, _, src = m.query(big[::997])
     assert hits == len(big[::997])
     np.testing.assert_array_equal(src, np.arange(200_000, dtype=np.int64)[::997])
+
+
+def test_stream_tiny_ring_backpressure_matches_sync():
+    """A wb ring far smaller than the in-flight eviction window forces the
+    allocator to park the feeder and the write-back thread to flush early
+    (flush_now): the stream must still complete and produce the same final
+    PS state as the synchronous path."""
+    import optax
+
+    from persia_tpu.models import DNN
+
+    batches = _batches(10, seed=33)
+
+    def run(stream: bool):
+        cfg = _cfg()
+        store = EmbeddingStore(
+            capacity=1 << 16, num_internal_shards=2,
+            optimizer=Adagrad(lr=0.1).config, seed=7,
+        )
+        worker = EmbeddingWorker(cfg, [store])
+        ctx = hbm.CachedTrainCtx(
+            model=DNN(dense_mlp_size=8, sparse_mlp_size=32, hidden_sizes=(32,)),
+            dense_optimizer=optax.sgd(1e-2),
+            embedding_optimizer=Adagrad(lr=0.1),
+            worker=worker,
+            embedding_config=cfg,
+            cache_rows=100,  # constant evictions
+            # each step evicts up to ~bucket(distinct)=128 padded rows; a
+            # 256-row ring holds at most TWO steps' spans vs a deep
+            # prefetch+flush window — the allocator must back-pressure
+            wb_ring_rows=256,
+        )
+        with ctx:
+            if stream:
+                m = ctx.train_stream(batches, prefetch=3, wb_flush_steps=8)
+                assert m is not None and np.isfinite(m["loss"])
+            else:
+                for b in batches:
+                    ctx.train_step(b, fetch_metrics=False)
+                ctx.drain()
+            ctx.flush()
+        return _store_entries(store, _cfg())
+
+    sync_e = run(False)
+    pipe_e = run(True)
+    assert set(sync_e) == set(pipe_e)
+    for k in sync_e:
+        np.testing.assert_allclose(
+            pipe_e[k], sync_e[k], rtol=1e-5, atol=1e-7, err_msg=str(k)
+        )
+
+
+def test_stream_deterministic_under_flush_timing():
+    """Pipelined-stream per-step losses must be bit-identical run to run and
+    INDEPENDENT of write-back timing (regression: the fixed-depth staging
+    buffer ring handed still-in-flight buffers back to the feeder at deep
+    prefetch, corrupting staged bytes — observed as bimodal losses that
+    varied with flush latency)."""
+    import time
+
+    import optax
+
+    from persia_tpu.models import DNN
+
+    def run(slow_flush: bool):
+        cfg = _cfg()
+        store = EmbeddingStore(
+            capacity=1 << 16, num_internal_shards=2,
+            optimizer=Adagrad(lr=0.1).config, seed=7,
+        )
+        worker = EmbeddingWorker(cfg, [store])
+        ctx = hbm.CachedTrainCtx(
+            model=DNN(dense_mlp_size=8, sparse_mlp_size=16, hidden_sizes=(16,)),
+            dense_optimizer=optax.adam(3e-3),
+            embedding_optimizer=Adagrad(lr=0.1),
+            worker=worker, embedding_config=cfg, cache_rows=100,
+        ).__enter__()
+        if slow_flush:
+            orig = ctx.tier._set_embedding
+
+            def slow_set(signs, values, dim):
+                time.sleep(0.1)
+                return orig(signs, values, dim)
+
+            ctx.tier._set_embedding = slow_set
+        out = []
+        ctx.train_stream(
+            _batches(10, seed=41), on_metrics=lambda m: out.append(m["loss"])
+        )
+        ctx.drain()
+        return np.array(out)
+
+    a = run(False)
+    b = run(False)
+    c = run(True)
+    np.testing.assert_array_equal(a, b, err_msg="run-to-run nondeterminism")
+    np.testing.assert_array_equal(
+        a, c, err_msg="write-back timing changed the math"
+    )
