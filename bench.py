@@ -599,8 +599,20 @@ def _quality_fused(steps):
 # BENCH_QUALITY_STRICT=0 to record instead of assert (when changing the
 # math intentionally, rerun and update these).
 EXPECTED_AUC = {
-    # platform -> tier -> exact expected AUC (recorded on TPU v5e)
-    "tpu": {},  # filled by the first strict recording run below
+    # platform -> tier -> (expected AUC, tolerance), recorded on TPU v5e at
+    # BENCH_QUALITY_STEPS=200. cached and fused are EXACT (1e-6): the
+    # stream's bit-determinism fix makes the cached tier's value stable
+    # run-to-run (test_stream_deterministic_under_flush_timing) and the
+    # fused tier is one deterministic XLA program. ps-stream trains its
+    # slots under bounded staleness with ASYNC gradient returns — the
+    # reference's async mode — so its value is timing-dependent BY DESIGN
+    # and gets a measured-drift tolerance instead (two strict runs landed
+    # 4e-4 apart).
+    "tpu": {
+        "cached": (0.630926937, 1e-6),
+        "ps-stream": (0.6301312949, 5e-3),
+        "fused": (0.6302019103, 1e-6),
+    },
 }
 
 
@@ -616,12 +628,12 @@ def _check_expected_auc(out: dict, steps: int) -> None:
     out["expected_auc"] = expected
     if not expected or not strict:
         return
-    for tier, want in expected.items():
+    for tier, (want, tol) in expected.items():
         got = out[tier]["auc"]
-        assert abs(got - want) < 1e-6, (
-            f"{tier} AUC {got!r} != pinned {want!r} on {platform} — a "
-            f"semantic change to this tier's math (update EXPECTED_AUC "
-            f"only if intentional)"
+        assert abs(got - want) < tol, (
+            f"{tier} AUC {got!r} != pinned {want!r} (tol {tol}) on "
+            f"{platform} — a semantic change to this tier's math (update "
+            f"EXPECTED_AUC only if intentional)"
         )
 
 

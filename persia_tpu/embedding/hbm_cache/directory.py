@@ -160,7 +160,14 @@ def _retain_allocator_pages() -> None:
     training (torn staging bytes). Raising M_MMAP_THRESHOLD keeps fresh
     allocations cheap (glibc free-lists, no page churn) so every step can
     own brand-new buffers: correctness by construction, same speed.
-    No-op where mallopt is unavailable (non-glibc)."""
+    Called once, lazily, when the first cache tier is constructed — a
+    process that merely imports this package (fused-tier users, test
+    collection) keeps its default allocator behavior. Opt out with
+    PERSIA_NO_MALLOPT=1. No-op where mallopt is unavailable (non-glibc)."""
+    global _MALLOPT_DONE
+    if _MALLOPT_DONE or os.environ.get("PERSIA_NO_MALLOPT") == "1":
+        return
+    _MALLOPT_DONE = True
     try:
         libc = ctypes.CDLL(None)
         M_MMAP_THRESHOLD = -3
@@ -169,7 +176,7 @@ def _retain_allocator_pages() -> None:
         pass
 
 
-_retain_allocator_pages()
+_MALLOPT_DONE = False
 
 
 class _BufRing:
@@ -183,14 +190,7 @@ class _BufRing:
     training (observed as bimodal per-step losses at deep prefetch).
     Allocation stays cheap because ``_retain_allocator_pages`` keeps
     glibc from mmap-ing these MB-scale buffers. The class keeps its
-    pooling-era surface (keys, depth) so call sites stay unchanged."""
-
-    def __init__(self, depth: int = 8):
-        self.depth = depth  # API compat; no rotation happens anymore
-
-    def ensure_depth(self, depth: int) -> None:
-        if depth > self.depth:
-            self.depth = depth
+    pooling-era ``key`` argument so call sites stay unchanged."""
 
     def get(self, key, shape, dtype) -> np.ndarray:
         return np.empty(shape, dtype)

@@ -105,14 +105,8 @@ def run_train_stream(
     if prefetch < 1:
         raise ValueError(f"prefetch must be >= 1, got {prefetch}")
     # Host staging buffers are FRESH per step (_BufRing hands out new
-    # arrays; its docstring records the reuse-race history), so no ring
-    # depth needs sizing against the prefetch window anymore; the
-    # ensure_depth calls remain as no-op API compat.
-    need_depth = prefetch + 4
-    self.tier._ring.ensure_depth(need_depth)
-    for d in self.tier.dirs.values():
-        d._rows_ring.ensure_depth(need_depth)
-
+    # arrays; its docstring records the reuse-race history), so nothing
+    # needs sizing against the prefetch depth here.
     self._land_pending()  # do not mix with a sync-path deferred step
     cv = threading.Condition()
     stop = threading.Event()

@@ -38,6 +38,7 @@ logger = get_default_logger("persia_tpu.hbm_cache")
 from persia_tpu.embedding.hbm_cache.directory import (  # noqa: F401
     CacheDirectory,
     _BufRing,
+    _retain_allocator_pages,
     native_init_rows,
     native_uniform_init,
 )
@@ -141,8 +142,11 @@ class CachedEmbeddingTier:
             g.name: CacheDirectory(g.rows, admit_touches=admit_touches)
             for g in self.groups
         }
-        # host staging-buffer reuse (see _BufRing): all per-step aux pieces
-        # and probe results come from here instead of fresh mmap allocations
+        # per-step host staging buffers (fresh per step; see _BufRing).
+        # Allocator tuning keeps the fresh MB-scale buffers off the mmap
+        # path — applied here, not at import, so fused-tier-only processes
+        # keep default malloc behavior
+        _retain_allocator_pages()
         self._ring = _BufRing()
         self._slot_group = {s: g for g in self.groups for s in g.slots}
         # static fast-path eligibility per slot (config is immutable): the
@@ -448,18 +452,19 @@ class CachedEmbeddingTier:
         miss_aux, cold_aux, restore_aux, evict_aux, evict_meta) where
         miss_aux/cold_aux hold warm/cold miss scatters, restore_aux holds
         device-side re-admissions resolved by the hazard gate, and
-        evict_meta = {group: (evict_signs, true_K)} describes the write-back
+        evict_meta = {group: (evict_signs, true_K, ring_pos)} describes the write-back
         due after the step.
 
         ``hazard_gate(group_name, miss_signs)``: called before each group's
         PS probe. When a pipelined caller has eviction write-backs still in
         flight, a fresh miss on one of those signs would read stale data
         from the PS. The gate returns a list of ``(payload, src_idx,
-        positions)`` restore descriptors — ``payload`` a DEVICE-resident
-        eviction payload array, ``src_idx`` rows within it, ``positions``
-        the resolved indices into ``miss_signs`` — and those signs are
-        re-admitted by an on-device row restore instead of a host checkout.
-        ``None`` means no overlap."""
+        positions)`` restore descriptors — ``payload`` is ``None`` for
+        "the group's standing device eviction ring" (the stream gate) or a
+        DEVICE-resident payload array, ``src_idx`` rows within it,
+        ``positions`` the resolved indices into ``miss_signs`` — and those
+        signs are re-admitted by an on-device row restore instead of a
+        host checkout. A bare ``None`` return means no overlap."""
         fast = self._single_id_groups(batch)
         if fast is not None:
             return self._prepare_batch_single_id(
@@ -479,7 +484,7 @@ class CachedEmbeddingTier:
         cold_aux: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
         restore_aux: Dict[str, List] = {}
         evict_aux: Dict[str, np.ndarray] = {}
-        evict_meta: Dict[str, Tuple[np.ndarray, int]] = {}
+        evict_meta: Dict[str, Tuple[np.ndarray, int, int]] = {}
         any_scale = False
 
         for g in self.groups:
@@ -554,7 +559,7 @@ class CachedEmbeddingTier:
         cold_aux: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
         restore_aux: Dict[str, List] = {}
         evict_aux: Dict[str, np.ndarray] = {}
-        evict_meta: Dict[str, Tuple[np.ndarray, int]] = {}
+        evict_meta: Dict[str, Tuple[np.ndarray, int, int]] = {}
 
         for g, names, mat in fast:
             S, B = mat.shape
