@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# End-of-round preflight: a snapshot is only DONE when all three proofs
+# pass. Round 4 shipped its final commit with 44 red tests and a broken
+# bench because none of these ran; this script is the institutional
+# answer — run it before any end-of-round (or otherwise milestone) commit:
+#
+#   bash scripts/round_preflight.sh
+#
+# 1. full test suite green
+# 2. bench.py rc=0 (real chip when attached; emits partial records on a
+#    degraded link rather than failing)
+# 3. dryrun_multichip(8) on a virtual CPU mesh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== 1/3 test suite =="
+python -m pytest tests/ -q
+
+echo "== 2/3 bench (BENCH_MODE=${BENCH_MODE:-all}) =="
+python bench.py
+
+echo "== 3/3 multichip dryrun =="
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun OK')"
+
+echo "PREFLIGHT PASSED"

@@ -203,3 +203,67 @@ def test_native_server_data_plane():
     finally:
         c.shutdown()
         c2.shutdown()
+
+
+def test_native_server_survives_hostile_frames():
+    """A frame whose key_ofs[ng] is astronomically large must produce an
+    RPC error, not kill the PS process: the bounds check validates with a
+    division instead of `8 * n_signs` (which signed-wraps for
+    key_ofs[ng] >= 2^60, passing the check and then aborting the process
+    inside resize) — native/server.cpp handle_lookup_batched /
+    handle_update_batched."""
+    import struct
+
+    native = pytest.importorskip("persia_tpu.embedding.native_store")
+    if not native.native_available():
+        pytest.skip("native core unavailable")
+    from persia_tpu.service.native_rpc import native_server_available
+
+    if not native_server_available():
+        pytest.skip("native server toolchain unavailable")
+
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.service.clients import StoreClient
+    from persia_tpu.service.ps_server import ParameterServerService
+    from persia_tpu.service.rpc import RpcClient
+
+    store = native.NativeEmbeddingStore(
+        capacity=1 << 12, num_internal_shards=2,
+        optimizer=Adagrad(lr=0.1).config, seed=5,
+    )
+    svc = ParameterServerService(store, port=0, native_server=True).start()
+    from persia_tpu.service.native_rpc import NativeRpcServer
+
+    assert isinstance(svc.server, NativeRpcServer)
+    rpc = RpcClient(f"127.0.0.1:{svc.port}")
+    c = StoreClient(f"127.0.0.1:{svc.port}")
+    try:
+        rpc.wait_ready()
+        # lookup frame: train u8 | dtype_code u8 | ng u16 | dims u32[ng]
+        # | key_ofs i64[ng+1] with key_ofs[ng] hostile
+        for hostile in (1 << 60, (1 << 62) + 12345):
+            bad_lookup = struct.pack(
+                "<BBH", 1, 0, 1
+            ) + struct.pack("<I", 16) + struct.pack("<qq", 0, hostile)
+            with pytest.raises(Exception):
+                rpc.call("lookup_batched", bad_lookup)
+            # update frame: code u8 | ng u16 | dims u32[ng] | ogs i32[ng]
+            # | key_ofs i64[ng+1] | signs...
+            bad_update = struct.pack(
+                "<BH", 0, 1
+            ) + struct.pack("<I", 16) + struct.pack("<i", 0) + struct.pack(
+                "<qq", 0, hostile
+            )
+            with pytest.raises(Exception):
+                rpc.call("update_batched", bad_update)
+        # the process survived: a well-formed call still round-trips
+        signs = np.array([1, 2, 3], dtype=np.uint64)
+        out = c.lookup_batched(
+            signs, np.array([0, 3], dtype=np.int64),
+            np.array([16], dtype=np.uint32), True,
+        )
+        assert out.shape == (48,) and np.isfinite(out).all()
+        assert c.size() == 3
+    finally:
+        rpc.close()
+        c.shutdown()  # also shuts the server down
