@@ -21,3 +21,4 @@ from persia_tpu.parallel.grad_sync import (  # noqa: F401
     LocalSGD,
     build_sync_train_step,
 )
+from persia_tpu.parallel.fused_ctx import FusedTrainCtx, batch_to_fused  # noqa: F401

@@ -245,7 +245,7 @@ def test_native_server_survives_hostile_frames():
             bad_lookup = struct.pack(
                 "<BBH", 1, 0, 1
             ) + struct.pack("<I", 16) + struct.pack("<qq", 0, hostile)
-            with pytest.raises(Exception):
+            with pytest.raises(RpcError):
                 rpc.call("lookup_batched", bad_lookup)
             # update frame: code u8 | ng u16 | dims u32[ng] | ogs i32[ng]
             # | key_ofs i64[ng+1] | signs...
@@ -254,7 +254,7 @@ def test_native_server_survives_hostile_frames():
             ) + struct.pack("<I", 16) + struct.pack("<i", 0) + struct.pack(
                 "<qq", 0, hostile
             )
-            with pytest.raises(Exception):
+            with pytest.raises(RpcError):
                 rpc.call("update_batched", bad_update)
         # the process survived: a well-formed call still round-trips
         signs = np.array([1, 2, 3], dtype=np.uint64)
