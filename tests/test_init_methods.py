@@ -123,3 +123,90 @@ def test_cache_native_init_rows_matches_golden():
         got = native_init_rows(SIGNS, SEED, DIM, method)
         want = init_for_signs(SIGNS, SEED, DIM, method)
         np.testing.assert_array_equal(got, want, err_msg=str(method))
+
+
+def test_cached_tier_matches_pure_ps_under_gamma_init():
+    """Cross-tier init-method consistency, end to end: with a NON-uniform
+    seeded init (gamma) configured in the worker's hyperparams, the HBM
+    write-back cached tier (tiny cache → host-seeded cold rows, constant
+    evictions) must produce the same final PS entries as the pure-PS run
+    of the identical stream — i.e. rows born cold in the cache are
+    bit-consistent with rows the PS would have seeded itself."""
+    import optax
+
+    from persia_tpu.config import EmbeddingConfig, SlotConfig
+    from persia_tpu.ctx import TrainCtx
+    from persia_tpu.data import IDTypeFeature, Label, NonIDTypeFeature, PersiaBatch
+    from persia_tpu.embedding import hbm_cache as hbm
+    from persia_tpu.embedding.hashing import add_index_prefix
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.embedding.store import EmbeddingStore
+    from persia_tpu.embedding.worker import EmbeddingWorker
+    from persia_tpu.models import DNN
+
+    method = InitializationMethod("gamma", 1.6, 0.05)
+    cfg = EmbeddingConfig(
+        slots_config={"cat_a": SlotConfig(dim=8), "cat_b": SlotConfig(dim=8)},
+        feature_index_prefix_bit=8,
+    )
+
+    def batches(n, bs=24):
+        rng = np.random.default_rng(13)
+        out = []
+        for _ in range(n):
+            ids = [
+                IDTypeFeature(nm, list(rng.integers(0, 300, (bs, 1), dtype=np.uint64)))
+                for nm in ("cat_a", "cat_b")
+            ]
+            out.append(PersiaBatch(
+                ids,
+                non_id_type_features=[NonIDTypeFeature(
+                    rng.normal(size=(bs, 4)).astype(np.float32))],
+                labels=[Label(rng.integers(0, 2, (bs, 1)).astype(np.float32))],
+                requires_grad=True,
+            ))
+        return out
+
+    def store_and_worker():
+        store = EmbeddingStore(
+            capacity=1 << 16, num_internal_shards=2,
+            hyperparams=HyperParameters(initialization_method=method),
+            optimizer=Adagrad(lr=0.1).config, seed=11,
+        )
+        worker = EmbeddingWorker(cfg, [store])
+        worker.configure(HyperParameters(initialization_method=method))
+        return store, worker
+
+    model_kw = dict(
+        model=DNN(dense_mlp_size=8, sparse_mlp_size=16, hidden_sizes=(16,)),
+        dense_optimizer=optax.sgd(1e-2),
+        embedding_optimizer=Adagrad(lr=0.1),
+        embedding_config=cfg,
+    )
+    cstore, cworker = store_and_worker()
+    pstore, pworker = store_and_worker()
+    cached = hbm.CachedTrainCtx(worker=cworker, cache_rows=48, **model_kw)
+    pure = TrainCtx(worker=pworker, **model_kw)
+    with cached, pure:
+        for b in batches(6):
+            cached.train_step(b)
+            pure.train_step(b)
+        cached.flush()
+
+    def entries(store, slot):
+        pre = cfg.slot(slot).index_prefix
+        out = {}
+        for s in range(300):
+            sign = int(add_index_prefix(np.array([s], np.uint64), pre, 8)[0])
+            e = store.get_embedding_entry(sign)
+            if e is not None:
+                out[(slot, s)] = e
+        return out
+
+    for slot in ("cat_a", "cat_b"):
+        ce, pe = entries(cstore, slot), entries(pstore, slot)
+        assert set(ce) == set(pe) and len(ce) > 50
+        for k in ce:
+            np.testing.assert_allclose(
+                ce[k], pe[k], rtol=2e-4, atol=2e-6, err_msg=str(k)
+            )
