@@ -45,13 +45,42 @@ from persia_tpu.parallel.train_step import default_loss_fn
 class FusedSlotSpec:
     """One HBM-resident slot (ref: SlotConfig,
     `persia-embedding-config/src/lib.rs:528-560`; LRU/eviction is the host
-    tier's job — HBM slots are dense [0, vocab) keyed)."""
+    tier's job — HBM slots are dense [0, vocab) keyed).
+
+    ``init_method`` (a ``config.InitializationMethod``) selects the init
+    distribution (uniform/gamma/poisson/normal/inverse_sqrt — the
+    reference's InitializationMethod enum, lib.rs:79-98); ``None`` falls
+    back to uniform over ``init_bounds``. HBM tables are dense-keyed and
+    seeded from a PRNGKey, so parity with the host tiers' seeded-by-sign
+    init is STATISTICAL, not bitwise (the key spaces differ by design)."""
 
     vocab: int
     dim: int
     pooled: bool = True  # embedding_summation; False → raw (B, L, D) + mask
     sqrt_scaling: bool = False
     init_bounds: Tuple[float, float] = (-0.01, 0.01)
+    init_method: "object | None" = None
+
+
+def _sample_init(key, shape, spec: "FusedSlotSpec", dtype):
+    """Draw a table block from the slot's init distribution (traceable)."""
+    m = spec.init_method
+    if m is None:
+        lo, hi = spec.init_bounds
+        return jax.random.uniform(key, shape, dtype=dtype, minval=lo, maxval=hi)
+    kind = m.kind
+    if kind == "uniform":
+        return jax.random.uniform(key, shape, dtype=dtype, minval=m.p0, maxval=m.p1)
+    if kind == "inverse_sqrt":
+        b = 1.0 / float(np.sqrt(shape[-1]))
+        return jax.random.uniform(key, shape, dtype=dtype, minval=-b, maxval=b)
+    if kind == "normal":
+        return (m.p0 + m.p1 * jax.random.normal(key, shape)).astype(dtype)
+    if kind == "gamma":
+        return (jax.random.gamma(key, m.p0, shape) * m.p1).astype(dtype)
+    if kind == "poisson":
+        return jax.random.poisson(key, m.p0, shape).astype(dtype)
+    raise ValueError(f"unknown init kind: {kind!r}")
 
 
 @flax.struct.dataclass
@@ -78,10 +107,7 @@ def create_fused_tables(
     keys = jax.random.split(rng, max(len(names), 1))
     for key, name in zip(keys, names):
         s = specs[name]
-        lo, hi = s.init_bounds
-        tables[name] = jax.random.uniform(
-            key, (s.vocab, s.dim), dtype=dtype, minval=lo, maxval=hi
-        )
+        tables[name] = _sample_init(key, (s.vocab, s.dim), s, dtype)
         emb_state[name] = init_sparse_state(sparse_cfg, s.vocab, s.dim)
     return tables, emb_state
 
@@ -198,17 +224,16 @@ def create_stacked_tables(
     all_names = sorted(n for g in groups for n in g.slots)
     keys = dict(zip(all_names, jax.random.split(rng, max(len(all_names), 1))))
 
-    @partial(jax.jit, static_argnames=("shape", "lo", "hi"), donate_argnums=(0,))
-    def _fill(tbl, key, off, shape, lo, hi):
-        part = jax.random.uniform(key, shape, dtype=tbl.dtype, minval=lo, maxval=hi)
+    @partial(jax.jit, static_argnames=("shape", "spec"), donate_argnums=(0,))
+    def _fill(tbl, key, off, shape, spec):
+        part = _sample_init(key, shape, spec, tbl.dtype)
         return jax.lax.dynamic_update_slice(tbl, part, (off, 0))
 
     for g in groups:
         tbl = jnp.zeros((g.vocab, g.dim), dtype=dtype)
         for name, off in zip(g.slots, g.offsets):
             s = specs[name]
-            lo, hi = s.init_bounds
-            tbl = _fill(tbl, keys[name], jnp.int32(off), (s.vocab, s.dim), lo, hi)
+            tbl = _fill(tbl, keys[name], jnp.int32(off), (s.vocab, s.dim), s)
         tables[g.name] = tbl
         emb_state[g.name] = init_sparse_state(sparse_cfg, g.vocab, g.dim)
     return tables, emb_state

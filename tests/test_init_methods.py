@@ -210,3 +210,51 @@ def test_cached_tier_matches_pure_ps_under_gamma_init():
             np.testing.assert_allclose(
                 ce[k], pe[k], rtol=2e-4, atol=2e-6, err_msg=str(k)
             )
+
+
+def test_fused_tables_honor_init_method():
+    """The HBM-resident fused tier draws its tables from the slot's
+    configured InitializationMethod (statistical parity — dense PRNG-keyed
+    tables, not the host tiers' seeded-by-sign space), for both the
+    per-slot and the stacked (shared-dim) layouts."""
+    import jax
+
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.parallel.fused_step import (
+        FusedSlotSpec,
+        create_fused_tables,
+        create_stacked_tables,
+        group_stacked_specs,
+    )
+
+    specs = {
+        "g": FusedSlotSpec(vocab=4000, dim=8,
+                           init_method=InitializationMethod("gamma", 2.0, 0.5)),
+        "n": FusedSlotSpec(vocab=4000, dim=8,
+                           init_method=InitializationMethod("normal", 0.2, 0.3)),
+        "p": FusedSlotSpec(vocab=4000, dim=8,
+                           init_method=InitializationMethod("poisson", 3.0, 0.0)),
+        "u": FusedSlotSpec(vocab=4000, dim=8),  # default: uniform bounds
+    }
+    cfg = Adagrad(lr=0.1).config
+
+    def check(tbl_of):
+        g = np.asarray(tbl_of("g"))
+        assert abs(g.mean() - 1.0) < 0.05 and g.min() >= 0  # k*theta = 1
+        n = np.asarray(tbl_of("n"))
+        assert abs(n.mean() - 0.2) < 0.02 and abs(n.std() - 0.3) < 0.02
+        pz = np.asarray(tbl_of("p"))
+        assert abs(pz.mean() - 3.0) < 0.1 and np.all(pz == np.rint(pz))
+        u = np.asarray(tbl_of("u"))
+        assert u.min() >= -0.01 and u.max() < 0.01
+
+    tables, _ = create_fused_tables(jax.random.PRNGKey(0), specs, cfg)
+    check(lambda k: tables[k])
+
+    groups = group_stacked_specs(specs, sorted(specs))
+    stacked, _ = create_stacked_tables(
+        jax.random.PRNGKey(0), specs, groups, cfg
+    )
+    (grp,) = groups  # all dim-8 → one physical table
+    offs = dict(zip(grp.slots, grp.offsets))
+    check(lambda k: stacked[grp.name][offs[k]:offs[k] + 4000])
