@@ -27,7 +27,30 @@ from persia_tpu.testing import AVAZU_VOCABS, AvazuSynthetic, roc_auc
 EMB_DIM = 16
 
 
-def build_ctx(model_name: str, num_fields: int, ps_replicas: int = 2):
+def build_ctx(model_name: str, num_fields: int, ps_replicas: int = 2,
+              tier: str = "hybrid", fused_vocab_cap=None):
+    if model_name == "deepfm":
+        model = DeepFM(embedding_dim=EMB_DIM, deep_mlp=(256, 128))
+    else:
+        model = DCNv2(embedding_dim=EMB_DIM, num_cross_layers=3, deep_mlp=(256, 128))
+    if tier == "fused":
+        # the field tables HBM-resident, one XLA program per step
+        from persia_tpu.parallel import FusedTrainCtx
+        from persia_tpu.parallel.fused_step import FusedSlotSpec
+
+        vocabs = AVAZU_VOCABS[:num_fields]
+        cap = fused_vocab_cap or max(vocabs)
+        specs = {
+            f"field_{i}": FusedSlotSpec(vocab=int(min(v, cap)), dim=EMB_DIM)
+            for i, v in enumerate(vocabs)
+        }
+        return FusedTrainCtx(
+            model=model,
+            dense_optimizer=optax.adam(1e-3),
+            embedding_optimizer=Adagrad(lr=0.05),
+            specs=specs,
+            fold_ids=True,
+        )
     cfg = EmbeddingConfig(
         slots_config={f"field_{i}": SlotConfig(dim=EMB_DIM) for i in range(num_fields)},
         feature_index_prefix_bit=8,
@@ -42,10 +65,6 @@ def build_ctx(model_name: str, num_fields: int, ps_replicas: int = 2):
         for r in range(ps_replicas)
     ]
     worker = EmbeddingWorker(cfg, stores)
-    if model_name == "deepfm":
-        model = DeepFM(embedding_dim=EMB_DIM, deep_mlp=(256, 128))
-    else:
-        model = DCNv2(embedding_dim=EMB_DIM, num_cross_layers=3, deep_mlp=(256, 128))
     return TrainCtx(
         model=model,
         dense_optimizer=optax.adam(1e-3),
@@ -63,6 +82,13 @@ def main(argv=None) -> int:
     ap.add_argument("--eval-steps", type=int, default=8)
     ap.add_argument("--ps-replicas", type=int, default=2)
     ap.add_argument(
+        "--tier", choices=("hybrid", "fused"), default="hybrid",
+        help="hybrid = host-PS lookups; fused = tables HBM-resident, one "
+        "XLA program per step",
+    )
+    ap.add_argument("--fused-vocab-cap", type=int, default=None,
+                    help="fused tier: cap each table at N rows (ids fold)")
+    ap.add_argument(
         "--deterministic", action="store_true",
         help="reproducible mode: ordered batches, staleness=1 (ref: REPRODUCIBLE=1)",
     )
@@ -71,25 +97,34 @@ def main(argv=None) -> int:
     train = AvazuSynthetic(num_samples=args.steps * args.batch_size, seed=42)
     test = AvazuSynthetic(num_samples=args.eval_steps * args.batch_size, seed=4242)
 
-    ctx = build_ctx(args.model, num_fields=len(AVAZU_VOCABS), ps_replicas=args.ps_replicas)
+    ctx = build_ctx(args.model, num_fields=len(AVAZU_VOCABS),
+                    ps_replicas=args.ps_replicas, tier=args.tier,
+                    fused_vocab_cap=args.fused_vocab_cap)
     with ctx:
         losses = []
-        loader = DataLoader(
-            train.batches(batch_size=args.batch_size), ctx,
-            num_workers=1 if args.deterministic else 4,
-            staleness=1 if args.deterministic else 4,
-            reproducible=args.deterministic,
-        )
-        t0 = time.time()
-        for tb in loader:
-            losses.append(ctx.train_step_prepared(tb, loader)["loss"])
-        dt = time.time() - t0
+        if args.tier == "fused":
+            batches = list(train.batches(batch_size=args.batch_size))
+            t0 = time.time()
+            for b in batches:
+                losses.append(ctx.train_step(b)["loss"])
+            dt = time.time() - t0
+        else:
+            loader = DataLoader(
+                train.batches(batch_size=args.batch_size), ctx,
+                num_workers=1 if args.deterministic else 4,
+                staleness=1 if args.deterministic else 4,
+                reproducible=args.deterministic,
+            )
+            t0 = time.time()
+            for tb in loader:
+                losses.append(ctx.train_step_prepared(tb, loader)["loss"])
+            dt = time.time() - t0
         sps = args.steps * args.batch_size / dt
 
         preds, labels = [], []
         for batch in test.batches(batch_size=args.batch_size, requires_grad=False):
-            preds.append(ctx.eval_batch(batch))
-            labels.append(batch.labels[0].data)
+            preds.append(np.asarray(ctx.eval_batch(batch)).reshape(-1, 1))
+            labels.append(np.asarray(batch.labels[0].data).reshape(-1, 1))
         auc = roc_auc(np.concatenate(labels), np.concatenate(preds))
         print(
             f"avazu-{args.model} steps={args.steps} loss={np.mean(losses):.4f} "

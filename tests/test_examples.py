@@ -131,3 +131,13 @@ def test_criteo_dlrm_fused_tier_file_data(capsys, tmp_path):
                    "--data-path", fixture])
     assert rc == 0
     assert "test_auc=" in capsys.readouterr().out
+
+
+@pytest.mark.parametrize("model", ["deepfm", "dcnv2"])
+def test_avazu_fused_tier(capsys, model):
+    mod = _load("avazu/train.py")
+    rc = mod.main(["--model", model, "--tier", "fused", "--batch-size", "32",
+                   "--steps", "3", "--eval-steps", "1",
+                   "--fused-vocab-cap", "512"])
+    assert rc == 0
+    assert f"avazu-{model}" in capsys.readouterr().out
