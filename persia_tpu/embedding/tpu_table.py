@@ -25,6 +25,8 @@ tree. ``EmbeddingSpec``/``create_tables``/``embedding_lookup``/``embedding_bag``
 from __future__ import annotations
 
 import functools
+
+from persia_tpu.parallel.mesh import shard_map_compat
 from dataclasses import dataclass
 from typing import Dict, Optional
 
@@ -113,7 +115,7 @@ def embedding_lookup(
     zero-initialized padding rows, ids >= padded_rows return zeros.
     """
     ids_spec = P(data_axis) if data_axis else P()
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         functools.partial(_local_lookup, axis=axis),
         mesh=mesh,
         in_specs=(P(axis, None), ids_spec),

@@ -61,6 +61,32 @@ def unpack_packet(blob: bytes):
     return ts, blob[_HEADER.size :]
 
 
+def iter_packet_entries(body: bytes):
+    """Walk a packet body (shard wire format) without copying the entry
+    data: yields ``(sign, entry_blob)`` where ``entry_blob`` is the entry's
+    full header+data bytes (re-packable into a smaller packet)."""
+    (n,) = struct.unpack_from("<I", body, 0)
+    off = 4
+    for _ in range(n):
+        sign, _dim, ln = struct.unpack_from("<QII", body, off)
+        end = off + 16 + 4 * ln
+        yield sign, body[off:end]
+        off = end
+
+
+def packet_signs(body: bytes) -> np.ndarray:
+    """Signs updated by a packet body — what an infer-side cache must
+    invalidate when the packet applies (persia_tpu/serving/cache.py)."""
+    (n,) = struct.unpack_from("<I", body, 0)
+    signs = np.empty(n, dtype=np.uint64)
+    off = 4
+    for i in range(n):
+        sign, _dim, ln = struct.unpack_from("<QII", body, off)
+        signs[i] = sign
+        off += 16 + 4 * ln
+    return signs
+
+
 class IncrementalUpdateManager:
     """Train-side: buffer touched signs, flush packets (ref: lib.rs:178-312).
 
@@ -200,10 +226,15 @@ class IncrementalLoader:
         inc_dir: Union[str, StoragePath],
         scan_interval_sec: float = 10.0,
         skip_before_us: int = 0,
+        on_apply=None,
     ):
         self.store = store
         self.root = storage_path(inc_dir)
         self.scan_interval_sec = scan_interval_sec
+        # called with the applied packet's signs (np.uint64) AFTER each
+        # load_shard_bytes — the serving hot cache invalidates exactly these
+        # (persia_tpu/serving/cache.py); None = no listener
+        self.on_apply = on_apply
         # packets older than this are marked seen but NOT applied — a serving
         # replica booting from a full checkpoint must not regress entries to
         # retained packets that predate it
@@ -252,6 +283,11 @@ class IncrementalLoader:
             n = self.store.load_shard_bytes(body)
             self._hwm[replica] = seq
             applied += n
+            if self.on_apply is not None and n:
+                try:
+                    self.on_apply(packet_signs(body))
+                except Exception as e:  # noqa: BLE001 — listener must not stop the scan
+                    logger.warning("incremental on_apply hook failed: %s", e)
             self._m_delay.set(max(0.0, time.time() - ts / 1e6))
         if applied:
             self._m_loaded.inc(applied)

@@ -56,6 +56,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     coord = sub.add_parser("coordinator", help="run the discovery/control service")
     coord.add_argument("--port", type=int, default=int(os.environ.get("PERSIA_COORDINATOR_PORT", "7799")))
 
+    # serving replica: exec the user's serve script (it builds the model +
+    # InferCtx — app-specific) with the serving-plane knobs in env; the
+    # script wires them into persia_tpu.serving.ServingServer
+    srv = sub.add_parser("serve", help="launch a model-serving replica")
+    srv.add_argument("entry", nargs="?", default=None)
+    srv.add_argument("--port", type=int, default=int(os.environ.get("PERSIA_SERVE_PORT", "8501")))
+    srv.add_argument("--replica-index", type=int, default=0)
+    srv.add_argument("--checkpoint-dir", type=str, default=None,
+                     help="watch this dir's done-marker for live rollover")
+    srv.add_argument("--incremental-dir", type=str, default=None,
+                     help="scan this dir for .inc delta packets")
+    srv.add_argument("--coordinator", type=str,
+                     default=os.environ.get("PERSIA_COORDINATOR_ADDR"))
+    srv.add_argument("--max-batch", type=int, default=256,
+                     help="micro-batcher: max coalesced rows per forward")
+    srv.add_argument("--max-wait-ms", type=float, default=2.0,
+                     help="micro-batcher: coalescing window")
+    srv.add_argument("--queue-depth", type=int, default=256,
+                     help="admission queue bound (full = 429)")
+    srv.add_argument("--cache-rows", type=int, default=0,
+                     help="hot-embedding LRU capacity (0 = no cache)")
+
     # k8s sub-CLI (ref: persia/k8s_utils.py gencrd/operator/server)
     k8s = sub.add_parser("k8s", help="generate/apply k8s manifests + operator")
     k8s.add_argument("action",
@@ -113,6 +135,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.global_config:
             cmd += ["--global-config", args.global_config]
         return subprocess.call(cmd)
+
+    if args.role == "serve":
+        entry = _user_entry(args.entry, "PERSIA_SERVE_ENTRY", "serve.py")
+        return _run([py, entry], {
+            "PERSIA_SERVE_PORT": args.port,
+            "REPLICA_INDEX": args.replica_index,
+            "PERSIA_CHECKPOINT_DIR": args.checkpoint_dir,
+            "PERSIA_INC_DIR": args.incremental_dir,
+            "PERSIA_COORDINATOR_ADDR": args.coordinator,
+            "PERSIA_SERVE_MAX_BATCH": args.max_batch,
+            "PERSIA_SERVE_MAX_WAIT_MS": args.max_wait_ms,
+            "PERSIA_SERVE_QUEUE_DEPTH": args.queue_depth,
+            "PERSIA_SERVE_CACHE_ROWS": args.cache_rows,
+        })
 
     if args.role == "coordinator":
         from persia_tpu.service.discovery import Coordinator

@@ -65,10 +65,7 @@ from persia_tpu.parallel.train_step import (
     default_loss_fn,
 )
 
-try:  # jax>=0.4.35 exposes it at top level
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from persia_tpu.parallel.mesh import shard_map_compat as shard_map
 
 
 # --------------------------------------------------------------- algorithms

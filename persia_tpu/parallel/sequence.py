@@ -26,6 +26,8 @@ training mesh (mesh axes ("data", "sp")).
 from __future__ import annotations
 
 import functools
+
+from persia_tpu.parallel.mesh import shard_map_compat
 from typing import Optional
 
 import jax
@@ -109,7 +111,7 @@ def ring_attention(
     if scale is None:
         scale = q.shape[-1] ** -0.5
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         functools.partial(
             _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
         ),
@@ -167,7 +169,7 @@ def ulysses_attention(
     if scale is None:
         scale = q.shape[-1] ** -0.5
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         functools.partial(
             _ulysses_local, axis_name=axis_name, causal=causal, scale=scale
         ),

@@ -1,0 +1,57 @@
+"""Production serving plane.
+
+Replaces the single-replica HTTP wrapper (the old ``persia_tpu/serving.py``)
+with a subsystem shaped for heavy traffic:
+
+- :mod:`~persia_tpu.serving.batcher` — micro-batching engine: bounded
+  admission queue, max-batch/max-wait coalescing, per-request deadlines,
+  429 load-shedding;
+- :mod:`~persia_tpu.serving.cache` — infer-side hot-embedding LRU keyed by
+  sign, invalidated by incremental packets, epoch-cleared on rollover;
+- :mod:`~persia_tpu.serving.gateway` — health-checked replica routing with
+  retry and hedged requests over service discovery;
+- :mod:`~persia_tpu.serving.rollover` — atomic model-version rollover from
+  checkpoint done-markers + ``.inc`` scans;
+- :mod:`~persia_tpu.serving.server` — the HTTP replicas
+  (:class:`InferenceServer` single-request, :class:`ServingServer` the
+  full plane);
+- :mod:`~persia_tpu.serving.client` — the matching urllib client.
+
+The old import surface (``from persia_tpu.serving import InferenceServer,
+InferenceClient``) is preserved.
+"""
+
+from persia_tpu.serving.batcher import (
+    DeadlineExceededError,
+    MicroBatcher,
+    QueueFullError,
+    merge_batches,
+)
+from persia_tpu.serving.cache import (
+    CachedLookupRouter,
+    HotEmbeddingCache,
+    attach_cache,
+)
+from persia_tpu.serving.client import InferenceClient
+from persia_tpu.serving.engine import InferenceEngine, clone_infer_ctx
+from persia_tpu.serving.gateway import NoReplicaAvailableError, ReplicaGateway
+from persia_tpu.serving.rollover import ModelRollover
+from persia_tpu.serving.server import InferenceServer, ServingServer
+
+__all__ = [
+    "CachedLookupRouter",
+    "DeadlineExceededError",
+    "HotEmbeddingCache",
+    "InferenceClient",
+    "InferenceEngine",
+    "InferenceServer",
+    "MicroBatcher",
+    "ModelRollover",
+    "NoReplicaAvailableError",
+    "QueueFullError",
+    "ReplicaGateway",
+    "ServingServer",
+    "attach_cache",
+    "clone_infer_ctx",
+    "merge_batches",
+]

@@ -1,0 +1,293 @@
+"""Micro-batching engine: coalesce concurrent predict calls into one forward.
+
+The single-replica server paid one jitted forward + one PS lookup round per
+request. Under concurrent load almost all of that is per-dispatch overhead:
+the same sparsity skew that makes PERSIA's LRU parameter servers work means
+a coalesced batch shares lookups, and XLA's cost per row collapses once
+rows share a program. The batcher turns N in-flight HTTP requests into one
+``PersiaBatch`` forward and slices the scores back per request.
+
+Admission control is explicit, not emergent:
+
+- the queue is bounded (``queue_depth``); a full queue rejects immediately
+  with :class:`QueueFullError` — the HTTP layer maps it to 429 so load
+  sheds at the door instead of growing latency without bound;
+- every request carries a deadline; requests that expire while queued are
+  dropped (:class:`DeadlineExceededError` → 504) rather than wasting a
+  forward on an answer nobody is waiting for;
+- a forming batch closes at ``max_batch`` rows or ``max_wait_ms``,
+  whichever first — the knob pair trades tail latency against coalescing.
+
+Merged batches optionally pad to a power-of-two row bucket so jit sees a
+bounded set of shapes instead of one program per concurrency level.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from persia_tpu.data import IDTypeFeature, NonIDTypeFeature, PersiaBatch
+from persia_tpu.logger import get_default_logger
+from persia_tpu.metrics import get_metrics
+from persia_tpu.utils import round_up_pow2
+
+logger = get_default_logger("persia_tpu.serving.batcher")
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue saturated — shed load (HTTP 429)."""
+
+
+class DeadlineExceededError(RuntimeError):
+    """Request expired before a forward could answer it (HTTP 504)."""
+
+
+def merge_batches(
+    batches: Sequence[PersiaBatch], pad_to: int = 0
+) -> Tuple[PersiaBatch, List[int]]:
+    """Concatenate request batches into one forward batch.
+
+    All batches must carry the same id-slot names (same model contract) and
+    the same dense-feature count. Returns ``(merged, offsets)`` where
+    ``offsets[i]:offsets[i+1]`` are request i's rows in the merged scores.
+    ``pad_to`` > total rows appends empty-id / zero-dense samples (their
+    scores are sliced off; pooled empty-id lookups contribute zero rows).
+    """
+    offsets = [0]
+    for b in batches:
+        offsets.append(offsets[-1] + b.batch_size)
+    total = offsets[-1]
+    pad = max(0, pad_to - total)
+    if len(batches) == 1 and pad == 0:
+        return batches[0], offsets
+
+    first = batches[0]
+    names = [f.name for f in first.id_type_features]
+    merged_ids: List[IDTypeFeature] = []
+    pad_counts = np.zeros(pad, dtype=np.int64)  # padded samples carry no ids
+    for pos, name in enumerate(names):
+        # merge in CSR form (flat ids + counts): IDTypeFeature's canonical
+        # layout, so the merge is K concatenates instead of per-sample list
+        # walks — this runs on the batcher's serial hot path
+        flats: List[np.ndarray] = []
+        counts: List[np.ndarray] = []
+        for b in batches:
+            f = b.id_type_features[pos]
+            if f.name != name:
+                raise ValueError(
+                    f"cannot merge: slot order mismatch ({f.name!r} != {name!r})"
+                )
+            fl, ct = f.flat_counts()
+            flats.append(fl)
+            counts.append(ct)
+        if pad:
+            counts.append(pad_counts)
+        merged_ids.append(IDTypeFeature.from_flat(
+            name,
+            np.concatenate(flats) if flats else np.empty(0, np.uint64),
+            np.concatenate(counts),
+        ))
+
+    merged_dense: List[NonIDTypeFeature] = []
+    for pos, nf in enumerate(first.non_id_type_features):
+        arrs = [b.non_id_type_features[pos].data for b in batches]
+        if pad:
+            arrs.append(np.zeros((pad,) + arrs[0].shape[1:], dtype=arrs[0].dtype))
+        merged_dense.append(NonIDTypeFeature(np.concatenate(arrs), name=nf.name))
+
+    return (
+        PersiaBatch(merged_ids, non_id_type_features=merged_dense,
+                    requires_grad=False),
+        offsets,
+    )
+
+
+class _Pending:
+    __slots__ = ("batch", "deadline", "event", "result", "error")
+
+    def __init__(self, batch: PersiaBatch, deadline: float):
+        self.batch = batch
+        self.deadline = deadline
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+
+
+class MicroBatcher:
+    """Bounded-queue request coalescer around a ``predict_fn(batch)``.
+
+    ``predict_fn`` runs on the batcher's single forward thread — the jitted
+    eval path is serialized by construction, so the engine never sees two
+    concurrent forwards fighting over the dispatch path.
+    """
+
+    def __init__(
+        self,
+        predict_fn: Callable[[PersiaBatch], np.ndarray],
+        max_batch: int = 256,
+        max_wait_ms: float = 2.0,
+        queue_depth: int = 256,
+        default_deadline_s: float = 30.0,
+        forward_grace_s: float = 10.0,
+        pad_buckets: bool = True,
+    ):
+        self._predict = predict_fn
+        self.max_batch = max(1, int(max_batch))
+        self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
+        self.queue_depth = max(1, int(queue_depth))
+        self.default_deadline_s = default_deadline_s
+        # a request popped just before its deadline still gets its forward's
+        # answer: the submitter waits deadline + grace before giving up
+        self.forward_grace_s = forward_grace_s
+        self.pad_buckets = pad_buckets
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        m = get_metrics()
+        self._m_batch_rows = m.histogram(
+            "persia_tpu_serving_batch_rows", "rows per coalesced forward",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+        )
+        self._m_requests = m.counter(
+            "persia_tpu_serving_requests", "predict requests admitted"
+        )
+        self._m_shed = m.counter(
+            "persia_tpu_serving_shed", "requests rejected on a full queue (429)"
+        )
+        self._m_expired = m.counter(
+            "persia_tpu_serving_deadline_expired", "requests expired before answer (504)"
+        )
+        self._m_depth = m.gauge(
+            "persia_tpu_serving_queue_depth", "admission queue depth"
+        )
+
+    # ------------------------------------------------------------ client side
+
+    def submit(self, batch: PersiaBatch, deadline_s: Optional[float] = None) -> np.ndarray:
+        """Blocking: enqueue, wait for the coalesced forward, return this
+        request's score rows. Raises :class:`QueueFullError` /
+        :class:`DeadlineExceededError` per the admission rules above."""
+        budget = self.default_deadline_s if deadline_s is None else float(deadline_s)
+        p = _Pending(batch, time.monotonic() + budget)
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("batcher is stopped")
+            if len(self._q) >= self.queue_depth:
+                self._m_shed.inc()
+                raise QueueFullError(
+                    f"admission queue full ({self.queue_depth} requests)"
+                )
+            self._q.append(p)
+            self._m_depth.set(len(self._q))
+            self._cond.notify()
+        self._m_requests.inc()
+        if not p.event.wait(budget + self.forward_grace_s):
+            p.error = p.error or DeadlineExceededError(
+                f"no answer within {budget + self.forward_grace_s:.3f}s"
+            )
+        if p.error is not None:
+            if isinstance(p.error, DeadlineExceededError):
+                self._m_expired.inc()
+            raise p.error
+        return p.result
+
+    # ----------------------------------------------------------- worker side
+
+    def start(self) -> "MicroBatcher":
+        if self._thread is None:
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="serving-batcher"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        # fail anything still queued so no submitter hangs out its full grace
+        with self._cond:
+            leftovers, self._q = list(self._q), deque()
+        for p in leftovers:
+            self._finish_error(p, RuntimeError("batcher stopped"))
+
+    def _finish_error(self, p: _Pending, e: BaseException) -> None:
+        p.error = e
+        p.event.set()
+
+    def _collect_group(self, first: _Pending) -> List[_Pending]:
+        """Gather more requests until max_batch rows or max_wait closes the
+        window. Oversized requests never split; a request that would overflow
+        the row budget closes the batch and stays queued. The queue drains in
+        bulk under one lock acquire — per-request lock ping-pong with 32+
+        submitter threads was measurable on the serial collection path."""
+        group = [first]
+        rows = first.batch.batch_size
+        close = time.monotonic() + self.max_wait_s
+        while rows < self.max_batch:
+            with self._cond:
+                if not self._q:
+                    remaining = close - time.monotonic()
+                    if remaining <= 0 or self._stop:
+                        break
+                    self._cond.wait(remaining)
+                    if not self._q:
+                        break
+                while self._q:
+                    nxt = self._q[0]
+                    if rows + nxt.batch.batch_size > self.max_batch:
+                        self._m_depth.set(len(self._q))
+                        return group
+                    self._q.popleft()
+                    group.append(nxt)
+                    rows += nxt.batch.batch_size
+                self._m_depth.set(len(self._q))
+        return group
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._q and not self._stop:
+                    self._cond.wait(0.1)
+                if not self._q and self._stop:
+                    return
+                first = self._q.popleft()
+                self._m_depth.set(len(self._q))
+            group = self._collect_group(first)
+            now = time.monotonic()
+            live = []
+            for p in group:
+                if p.deadline < now:
+                    self._finish_error(
+                        p, DeadlineExceededError("expired while queued")
+                    )
+                else:
+                    live.append(p)
+            if not live:
+                continue
+            try:
+                total = sum(p.batch.batch_size for p in live)
+                pad_to = round_up_pow2(total) if self.pad_buckets else 0
+                merged, offsets = merge_batches(
+                    [p.batch for p in live], pad_to=pad_to
+                )
+                scores = np.asarray(self._predict(merged))
+            except Exception as e:  # noqa: BLE001 — the error crosses to every caller
+                logger.exception("coalesced forward failed (%d requests)", len(live))
+                for p in live:
+                    self._finish_error(p, e)
+                continue
+            self._m_batch_rows.observe(offsets[-1])
+            for p, lo, hi in zip(live, offsets, offsets[1:]):
+                p.result = scores[lo:hi]
+                p.event.set()

@@ -1,0 +1,253 @@
+"""Infer-side hot-embedding LRU cache.
+
+PERSIA's sign-access distribution is heavily skewed — that skew is the
+reason its LRU parameter servers hold the working set at all. The same
+skew makes an infer-side cache pay: head signs answer from a local cache
+and never touch the PS tier (in the remote-PS deployment that is a network
+round-trip per batch). The cache interposes on the worker's lookup router
+and only serves ``train=False`` lookups — the training path must always
+see the authoritative store.
+
+Layout is vectorized, not a per-sign dict walk: the serving hot path runs
+a coalesced batch's worth of signs per call, and profiling the batched
+forward put an OrderedDict-LRU at ~6µs/sign — most of the forward. Here a
+hit costs one C-speed ``dict.get`` per sign for the slot index and then a
+single fancy-index gather; recency is an int64 stamp per slot bumped once
+per *call* (approximate LRU: eviction takes the oldest stamps via
+``argpartition``, batched). Rows live in one ``(capacity, dim)`` float32
+pool per distinct dim (``capacity`` is per dim).
+
+Freshness has two tiers, mirroring the update paths that exist:
+
+- **incremental packets** (persia_tpu/incremental.py) carry exactly the
+  signs they update → :meth:`invalidate` drops those entries; the next
+  lookup refetches and counts as ``stale``;
+- **checkpoint rollover** reloads the whole table → :meth:`bump_epoch`
+  clears everything at once (an epoch bump, not a per-sign walk).
+
+Gauges exported: hit/miss/stale counters, resident-entry gauge, epoch
+gauge — a flat hit rate on a skewed stream is a misconfiguration signal
+(capacity too small or invalidation storm), so it is first-class.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from persia_tpu.logger import get_default_logger
+from persia_tpu.metrics import get_metrics
+
+logger = get_default_logger("persia_tpu.serving.cache")
+
+_FREE_SENTINEL = np.int64(1 << 62)  # free slots can never be eviction victims
+
+
+class _DimPool:
+    """Fixed-capacity row pool for one embedding dim."""
+
+    __slots__ = ("rows", "signs", "stamp", "index", "free")
+
+    def __init__(self, capacity: int, dim: int):
+        self.rows = np.zeros((capacity, dim), dtype=np.float32)
+        self.signs = np.zeros(capacity, dtype=np.uint64)
+        self.stamp = np.full(capacity, _FREE_SENTINEL, dtype=np.int64)
+        self.index: Dict[int, int] = {}  # sign -> slot
+        self.free: List[int] = list(range(capacity - 1, -1, -1))
+
+
+class HotEmbeddingCache:
+    """Sign-keyed approximate-LRU of embedding rows (inference values only —
+    no optimizer state; the PS remains authoritative)."""
+
+    def __init__(self, capacity: int = 100_000):
+        self.capacity = max(1, int(capacity))
+        self._pools: Dict[int, _DimPool] = {}
+        self._lock = threading.Lock()
+        self._tick = 0
+        self._epoch = 0
+        # instance-local tallies: the process metric registry dedups by name,
+        # so the exported counters aggregate across caches while stats()
+        # must describe THIS cache
+        self._hits = 0
+        self._misses = 0
+        self._stale = 0
+        m = get_metrics()
+        self._m_hits = m.counter(
+            "persia_tpu_serving_cache_hits", "infer lookups served from the hot cache"
+        )
+        self._m_misses = m.counter(
+            "persia_tpu_serving_cache_misses", "infer lookups forwarded to the PS tier"
+        )
+        self._m_stale = m.counter(
+            "persia_tpu_serving_cache_stale",
+            "entries dropped by incremental-packet invalidation",
+        )
+        self._m_size = m.gauge(
+            "persia_tpu_serving_cache_entries", "rows resident in the hot cache"
+        )
+        self._m_epoch = m.gauge(
+            "persia_tpu_serving_cache_epoch", "cache epoch (bumped on rollover)"
+        )
+
+    # -------------------------------------------------------------- lookups
+
+    def lookup_through(self, inner_lookup, keys: np.ndarray, dim: int) -> np.ndarray:
+        """Serve ``keys`` from the cache; fetch misses through
+        ``inner_lookup(miss_keys, dim)`` and admit them."""
+        keys = np.asarray(keys, dtype=np.uint64)
+        n = len(keys)
+        out = np.empty((n, dim), dtype=np.float32)
+        with self._lock:
+            pool = self._pools.get(dim)
+            if pool is None:
+                pool = self._pools[dim] = _DimPool(self.capacity, dim)
+            self._tick += 1
+            tick = self._tick
+            get = pool.index.get
+            idx = np.fromiter(
+                (get(s, -1) for s in keys.tolist()), dtype=np.int64, count=n
+            )
+            hit = idx >= 0
+            hslots = idx[hit]
+            out[hit] = pool.rows[hslots]
+            pool.stamp[hslots] = tick
+            miss_pos = np.nonzero(~hit)[0]
+            nh = int(hit.sum())
+        if nh:
+            self._hits += nh
+            self._m_hits.inc(nh)
+        if not len(miss_pos):
+            return out
+        self._misses += len(miss_pos)
+        self._m_misses.inc(len(miss_pos))
+        miss_keys = keys[miss_pos]
+        rows = np.asarray(inner_lookup(miss_keys, dim), dtype=np.float32)
+        out[miss_pos] = rows
+        with self._lock:
+            self._admit(pool, miss_keys, rows, tick)
+            self._m_size.set(sum(len(p.index) for p in self._pools.values()))
+        return out
+
+    def _admit(self, pool: _DimPool, signs: np.ndarray, rows: np.ndarray,
+               tick: int) -> None:
+        """Insert fetched rows, evicting the oldest stamps in one batched
+        ``argpartition`` when the pool is full. Caller holds the lock."""
+        todo = []
+        for i, s in enumerate(signs.tolist()):
+            slot = pool.index.get(s)
+            if slot is not None:  # duplicate key within the miss set
+                pool.rows[slot] = rows[i]
+                pool.stamp[slot] = tick
+            else:
+                todo.append((s, i))
+        if len(todo) > self.capacity:  # wider than the cache: keep the tail
+            todo = todo[-self.capacity:]
+        need = len(todo) - len(pool.free)
+        if need > 0:
+            victims = np.argpartition(pool.stamp, need - 1)[:need]
+            for v in victims.tolist():
+                pool.index.pop(int(pool.signs[v]), None)
+                pool.stamp[v] = _FREE_SENTINEL
+                pool.free.append(v)
+        for s, i in todo:
+            slot = pool.free.pop()
+            pool.rows[slot] = rows[i]
+            pool.signs[slot] = s
+            pool.stamp[slot] = tick
+            pool.index[s] = slot
+
+    # ----------------------------------------------------------- freshness
+
+    def invalidate(self, signs: Sequence[int]) -> int:
+        """Drop specific signs (incremental packet applied). Returns how
+        many were actually resident."""
+        dropped = 0
+        with self._lock:
+            for s in np.asarray(signs, dtype=np.uint64).tolist():
+                for pool in self._pools.values():
+                    slot = pool.index.pop(s, None)
+                    if slot is not None:
+                        pool.stamp[slot] = _FREE_SENTINEL
+                        pool.free.append(slot)
+                        dropped += 1
+            self._m_size.set(sum(len(p.index) for p in self._pools.values()))
+        if dropped:
+            self._stale += dropped
+            self._m_stale.inc(dropped)
+        return dropped
+
+    def bump_epoch(self) -> int:
+        """Clear everything (checkpoint rollover). Returns the new epoch."""
+        with self._lock:
+            self._pools.clear()
+            self._epoch += 1
+            self._m_size.set(0)
+            self._m_epoch.set(self._epoch)
+            return self._epoch
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(len(p.index) for p in self._pools.values())
+
+    def stats(self) -> Dict:
+        hits, misses = self._hits, self._misses
+        total = hits + misses
+        return {
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": (hits / total) if total else 0.0,
+            "stale_dropped": self._stale,
+            "entries": len(self),
+            "epoch": self._epoch,
+            "capacity": self.capacity,
+        }
+
+
+class CachedLookupRouter:
+    """Drop-in wrapper over a worker's lookup router (``ShardedLookup`` or a
+    single-replica store client): ``train=False`` lookups flow through the
+    hot cache; everything else — training lookups, gradient updates,
+    checkpoint ops — passes through untouched via ``__getattr__``."""
+
+    def __init__(self, inner, cache: HotEmbeddingCache):
+        self.inner = inner
+        self.cache = cache
+
+    def lookup(self, keys: np.ndarray, dim: int, train: bool) -> np.ndarray:
+        if train:
+            return self.inner.lookup(keys, dim, True)
+        return self.cache.lookup_through(
+            lambda k, d: self.inner.lookup(k, d, False), keys, dim
+        )
+
+    def lookup_groups(self, groups, train: bool):
+        if train:
+            return self.inner.lookup_groups(groups, True)
+        # per-group through the cache; misses of all groups could batch into
+        # one inner call, but the hot path is the all-hit case where no
+        # inner call happens at all
+        return [
+            self.cache.lookup_through(
+                lambda k, d: self.inner.lookup(k, d, False), keys, int(dim)
+            )
+            for keys, dim in groups
+        ]
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def attach_cache(worker, capacity: int = 100_000) -> HotEmbeddingCache:
+    """Interpose a :class:`HotEmbeddingCache` on ``worker``'s lookup router.
+    Returns the cache (wire ``IncrementalLoader(on_apply=cache.invalidate)``
+    and rollover's ``bump_epoch`` to keep it fresh)."""
+    cache = HotEmbeddingCache(capacity=capacity)
+    worker.lookup_router = CachedLookupRouter(worker.lookup_router, cache)
+    return cache

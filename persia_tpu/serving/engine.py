@@ -1,0 +1,97 @@
+"""Versioned inference engine: the swappable core of a serving replica.
+
+A long-running server must upgrade its model without dropping requests.
+The engine holds ONE immutable handle ``(infer_ctx, version)``; readers
+(the batcher's forward thread, health endpoints) grab the handle with a
+single attribute read — atomic under the GIL — so a concurrent
+:meth:`swap` can never expose a half-updated pair. The rollover watcher
+(persia_tpu/serving/rollover.py) builds the replacement ``InferCtx``
+off-thread (dense state deserialized, eval step rebuilt) and swaps it in
+only when it is fully ready; in-flight forwards finish on the handle they
+started with.
+
+The sparse half intentionally does NOT swap: embedding tables load in
+place on the shared worker/store (the same live-apply semantics as
+incremental packets), so a swap only needs to replace the dense state and
+bump the hot-embedding cache epoch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from persia_tpu.data import PersiaBatch
+from persia_tpu.logger import get_default_logger
+from persia_tpu.metrics import get_metrics
+
+logger = get_default_logger("persia_tpu.serving.engine")
+
+
+class InferenceEngine:
+    """Thread-safe holder of the live ``InferCtx`` + model version."""
+
+    def __init__(self, infer_ctx, version: str = "v0"):
+        # ONE tuple attribute: handle reads are a single bytecode, so a
+        # reader can never see ctx from one version paired with another's id
+        self._handle: Tuple[object, str] = (infer_ctx, version)
+        self._swap_lock = threading.Lock()
+        m = get_metrics()
+        self._m_rollovers = m.counter(
+            "persia_tpu_serving_rollovers", "model version swaps applied"
+        )
+        self._m_forwards = m.counter(
+            "persia_tpu_serving_forwards", "jitted eval forwards executed"
+        )
+        self._m_forward_time = m.histogram(
+            "persia_tpu_serving_forward_seconds", "jitted eval forward latency"
+        )
+
+    @property
+    def ctx(self):
+        return self._handle[0]
+
+    @property
+    def version(self) -> str:
+        return self._handle[1]
+
+    def predict(self, batch: PersiaBatch) -> np.ndarray:
+        ctx, _ = self._handle
+        t0 = time.perf_counter()
+        out = ctx.predict(batch)
+        self._m_forward_time.observe(time.perf_counter() - t0)
+        self._m_forwards.inc()
+        return np.asarray(out)
+
+    def predict_from_bytes(self, raw: bytes) -> np.ndarray:
+        return self.predict(PersiaBatch.from_bytes(raw))
+
+    def model_name(self) -> str:
+        return type(self.ctx.model).__name__
+
+    def swap(self, new_ctx, version: str) -> str:
+        """Atomically replace the live context. Returns the old version."""
+        with self._swap_lock:
+            old_ctx, old_version = self._handle
+            self._handle = (new_ctx, version)
+        self._m_rollovers.inc()
+        logger.info("model rollover: %s -> %s", old_version, version)
+        return old_version
+
+
+def clone_infer_ctx(ctx, new_state=None):
+    """Build a fresh ``InferCtx`` sharing the model/worker/config of ``ctx``
+    but holding ``new_state`` (rollover: the dense half swaps, the sparse
+    half is the shared in-place store)."""
+    from persia_tpu.ctx import InferCtx
+
+    return InferCtx(
+        model=ctx.model,
+        state=new_state if new_state is not None else ctx.state,
+        worker=ctx.worker,
+        embedding_config=ctx.embedding_config,
+        mesh=ctx.mesh,
+    )

@@ -1,0 +1,219 @@
+"""Replica gateway: health-checked routing, retry, and hedged requests.
+
+One serving replica is a single point of failure and a single tail-latency
+distribution. The gateway fronts a replica set — either a static address
+list or a role discovered live from the coordinator
+(persia_tpu/service/discovery.py, the control plane every other tier
+already registers with) — and gives callers three properties:
+
+- **health-checked routing**: a background probe loop marks replicas
+  up/down from ``/healthz``; requests round-robin over the live set only;
+- **retry with failover**: a transport failure marks the replica down and
+  the request replays on the next live replica (predict is read-only →
+  safe to retry, unlike the training RPC paths);
+- **hedged requests**: if the primary has not answered within
+  ``hedge_after_ms``, the same request fires at a second replica and the
+  first answer wins — the classic tail-at-scale move; the straggler's
+  answer is discarded.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from persia_tpu.data import PersiaBatch
+from persia_tpu.logger import get_default_logger
+from persia_tpu.metrics import get_metrics
+from persia_tpu.serving.client import InferenceClient
+
+logger = get_default_logger("persia_tpu.serving.gateway")
+
+
+class NoReplicaAvailableError(RuntimeError):
+    """Every replica is down (or the request failed on all of them)."""
+
+
+class ReplicaGateway:
+    """Route ``predict`` over a live replica set.
+
+    ``replicas`` seeds a static set; ``coordinator`` (a
+    ``CoordinatorClient``) + ``role`` refreshes the set each health tick so
+    replicas added later join the rotation without a restart.
+    """
+
+    def __init__(
+        self,
+        replicas: Optional[Sequence[str]] = None,
+        coordinator=None,
+        role: str = "inference",
+        health_interval_s: float = 2.0,
+        hedge_after_ms: float = 50.0,
+        request_timeout_s: float = 30.0,
+        max_attempts: int = 3,
+    ):
+        self._clients: Dict[str, InferenceClient] = {}
+        self._down: set = set()
+        self._lock = threading.Lock()
+        self._coordinator = coordinator
+        self._role = role
+        self.health_interval_s = health_interval_s
+        self.hedge_after_s = max(0.0, hedge_after_ms) / 1e3
+        self.request_timeout_s = request_timeout_s
+        self.max_attempts = max(1, max_attempts)
+        self._rr = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # hedges need their own threads; 2x a small pool bounds the fan-out
+        self._pool = ThreadPoolExecutor(max_workers=16, thread_name_prefix="gw-hedge")
+        m = get_metrics()
+        self._m_requests = m.counter(
+            "persia_tpu_gateway_requests", "predict requests routed"
+        )
+        self._m_retries = m.counter(
+            "persia_tpu_gateway_retries", "failover retries after a replica error"
+        )
+        self._m_hedges = m.counter(
+            "persia_tpu_gateway_hedged", "hedged second requests fired"
+        )
+        self._m_live = m.gauge(
+            "persia_tpu_gateway_live_replicas", "replicas currently passing health"
+        )
+        for addr in replicas or []:
+            self.add_replica(addr)
+
+    # ------------------------------------------------------------- membership
+
+    def add_replica(self, addr: str) -> None:
+        with self._lock:
+            if addr not in self._clients:
+                self._clients[addr] = InferenceClient(
+                    addr, timeout_s=self.request_timeout_s
+                )
+
+    def live_replicas(self) -> List[str]:
+        with self._lock:
+            return [a for a in self._clients if a not in self._down]
+
+    def _mark_down(self, addr: str) -> None:
+        with self._lock:
+            self._down.add(addr)
+            self._m_live.set(len(self._clients) - len(self._down))
+
+    def _probe_all(self) -> None:
+        if self._coordinator is not None:
+            try:
+                for addr in self._coordinator.list(self._role):
+                    self.add_replica(addr)
+            except Exception as e:  # noqa: BLE001 — control plane hiccup
+                logger.warning("coordinator list(%s) failed: %s", self._role, e)
+        with self._lock:
+            addrs = list(self._clients)
+        for addr in addrs:
+            try:
+                ok = self._clients[addr].health().get("status") == "ok"
+            except Exception:  # noqa: BLE001 — any probe failure = down
+                ok = False
+            with self._lock:
+                if ok:
+                    self._down.discard(addr)
+                else:
+                    self._down.add(addr)
+                self._m_live.set(len(self._clients) - len(self._down))
+
+    def start(self) -> "ReplicaGateway":
+        self._probe_all()  # synchronous first probe: start() returns routable
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._health_loop, daemon=True, name="gateway-health"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._pool.shutdown(wait=False)
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.health_interval_s):
+            try:
+                self._probe_all()
+            except Exception as e:  # noqa: BLE001 — prober must survive
+                logger.warning("health probe sweep failed: %s", e)
+
+    # --------------------------------------------------------------- routing
+
+    def _pick(self, exclude: set) -> Optional[str]:
+        live = [a for a in self.live_replicas() if a not in exclude]
+        if not live:
+            return None
+        with self._lock:
+            self._rr += 1
+            return live[self._rr % len(live)]
+
+    def predict(self, batch: PersiaBatch, deadline_ms: Optional[float] = None) -> np.ndarray:
+        return self.predict_bytes(batch.to_bytes(), deadline_ms=deadline_ms)
+
+    def predict_bytes(self, raw: bytes, deadline_ms: Optional[float] = None) -> np.ndarray:
+        """Route one request: round-robin primary, hedge after
+        ``hedge_after_s``, fail over on error up to ``max_attempts``
+        distinct replicas."""
+        self._m_requests.inc()
+        tried: set = set()
+        last: Optional[Exception] = None
+        for attempt in range(self.max_attempts):
+            addr = self._pick(tried)
+            if addr is None:
+                break
+            tried.add(addr)
+            if attempt:
+                self._m_retries.inc()
+            try:
+                return self._one_attempt(addr, raw, tried, deadline_ms)
+            except Exception as e:  # noqa: BLE001 — classify then fail over
+                last = e
+                self._mark_down(addr)
+                logger.warning("replica %s failed (%s); failing over", addr, e)
+        raise NoReplicaAvailableError(
+            f"no live replica answered (tried {sorted(tried) or 'none'})"
+        ) from last
+
+    def _one_attempt(
+        self, addr: str, raw: bytes, tried: set, deadline_ms: Optional[float]
+    ) -> np.ndarray:
+        """Primary request with a hedge: fire ``addr``, and if it has not
+        answered within ``hedge_after_s`` fire one more replica; first
+        success wins, the straggler is abandoned to its own timeout."""
+        client = self._clients[addr]
+        primary = self._pool.submit(client.predict_bytes, raw, deadline_ms)
+        futures = {primary: addr}
+        done, _ = wait([primary], timeout=self.hedge_after_s,
+                       return_when=FIRST_COMPLETED)
+        if not done:
+            hedge_addr = self._pick(tried | set(futures.values()))
+            if hedge_addr is not None:
+                self._m_hedges.inc()
+                futures[self._pool.submit(
+                    self._clients[hedge_addr].predict_bytes, raw, deadline_ms
+                )] = hedge_addr
+        pending = set(futures)
+        first_error: Optional[Exception] = None
+        while pending:
+            done, pending = wait(pending, timeout=self.request_timeout_s,
+                                 return_when=FIRST_COMPLETED)
+            if not done:
+                break
+            for f in done:
+                try:
+                    return f.result()
+                except Exception as e:  # noqa: BLE001 — maybe the hedge wins
+                    first_error = first_error or e
+                    self._mark_down(futures[f])
+        raise first_error or TimeoutError(f"no answer from {addr} within timeout")

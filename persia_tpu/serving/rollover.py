@@ -1,0 +1,198 @@
+"""Atomic model-version rollover for a long-running serving replica.
+
+Training publishes two kinds of updates a server must absorb without a
+restart or a dropped request:
+
+- **full checkpoints** (persia_tpu/checkpoint.py): a directory becomes
+  valid only when its ``embedding_dump_done`` marker lands; the marker's
+  ``session`` id is the version. The watcher polls the marker, and on a
+  new session: deserializes the dense half into a FRESH ``TrainState``
+  (off the request path), reloads the embedding tables in place on the
+  shared worker (per-shard locks keep concurrent lookups valid), bumps
+  the hot-cache epoch, and only then swaps the engine handle — in-flight
+  requests finish on the old dense state, new requests see the new one;
+- **incremental packets** (persia_tpu/incremental.py): applied live by an
+  ``IncrementalLoader`` whose ``on_apply`` hook invalidates exactly the
+  updated signs in the hot cache. Packets that predate the current
+  checkpoint are skipped via ``skip_before_us`` (the marker records its
+  ``time_us`` for exactly this).
+
+The swap is wait-free for readers (one handle assignment, see
+serving/engine.py); the expensive work — storage reads, flax
+deserialization — happens on the watcher thread.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Optional, Union
+
+from persia_tpu.checkpoint import DONE_MARKER
+from persia_tpu.logger import get_default_logger
+from persia_tpu.metrics import get_metrics
+from persia_tpu.serving.engine import InferenceEngine, clone_infer_ctx
+from persia_tpu.storage import StorageError, StoragePath, storage_path
+
+logger = get_default_logger("persia_tpu.serving.rollover")
+
+
+class ModelRollover:
+    """Tie a serving engine to a checkpoint dir (full rollovers) and an
+    incremental dir (live deltas)."""
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        ckpt_dir: Union[str, StoragePath],
+        inc_dir: Optional[Union[str, StoragePath]] = None,
+        cache=None,
+        poll_interval_s: float = 2.0,
+        inc_scan_interval_s: Optional[float] = None,
+    ):
+        self.engine = engine
+        self.root = storage_path(ckpt_dir)
+        self.cache = cache
+        self.poll_interval_s = poll_interval_s
+        self._seen_session: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._inc_loader = None
+        if inc_dir is not None:
+            from persia_tpu.incremental import IncrementalLoader
+
+            self._inc_loader = IncrementalLoader(
+                engine.ctx.worker.lookup_router.replicas[0]
+                if len(engine.ctx.worker.lookup_router.replicas) == 1
+                else _RouterStore(engine.ctx.worker),
+                inc_dir,
+                scan_interval_sec=inc_scan_interval_s or poll_interval_s,
+                on_apply=(cache.invalidate if cache is not None else None),
+            )
+        m = get_metrics()
+        self._m_version_ts = m.gauge(
+            "persia_tpu_serving_model_time_us", "time_us of the live checkpoint"
+        )
+        self._m_failed = m.counter(
+            "persia_tpu_serving_rollover_failures", "rollovers that failed to apply"
+        )
+
+    # ----------------------------------------------------------------- state
+
+    @property
+    def version(self) -> str:
+        return self.engine.version
+
+    def _read_marker(self) -> Optional[Dict]:
+        try:
+            return json.loads(self.root.join(DONE_MARKER).read_text())
+        except (OSError, ValueError, StorageError):
+            return None
+
+    # ------------------------------------------------------------------ poll
+
+    def poll_once(self) -> bool:
+        """One watcher tick: apply a new checkpoint if the done-marker moved,
+        then drain unseen incremental packets. Returns True iff a full
+        rollover was applied."""
+        rolled = False
+        info = self._read_marker()
+        if info is not None:
+            session = str(info.get("session", info.get("datetime", "")))
+            if session and session != self._seen_session:
+                self._apply_checkpoint(info, session)
+                rolled = True
+        if self._inc_loader is not None:
+            self._inc_loader.poll_once()
+        return rolled
+
+    def _apply_checkpoint(self, info: Dict, session: str) -> None:
+        import flax.serialization
+
+        from persia_tpu.checkpoint import load_dense
+
+        ctx = self.engine.ctx
+        try:
+            # dense half: deserialize into a fresh state off the request path
+            new_state = ctx.state
+            raw = load_dense(self.root, missing_ok=True)
+            if raw is not None:
+                new_state = flax.serialization.from_bytes(ctx.state, raw)
+            # sparse half: in-place load on the shared store (entries re-route
+            # by sign; concurrent lookups stay valid under the shard locks)
+            ctx.worker.load(str(self.root))
+        except Exception as e:  # noqa: BLE001 — a bad dump must not kill serving
+            self._m_failed.inc()
+            logger.exception("rollover to session %s failed: %s", session, e)
+            self._seen_session = session  # don't retry a broken dump forever
+            return
+        if self.cache is not None:
+            self.cache.bump_epoch()
+        if self._inc_loader is not None:
+            # packets older than this checkpoint must not regress its entries
+            self._inc_loader.skip_before_us = int(info.get("time_us", 0))
+        self._seen_session = session
+        self._m_version_ts.set(float(info.get("time_us", 0)))
+        self.engine.swap(clone_infer_ctx(ctx, new_state), version=session)
+
+    # --------------------------------------------------------------- thread
+
+    def start(self) -> "ModelRollover":
+        # synchronous first poll: a server started against an existing
+        # checkpoint dir is versioned before it takes traffic
+        try:
+            self.poll_once()
+        except Exception as e:  # noqa: BLE001
+            logger.warning("initial rollover poll failed: %s", e)
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="serving-rollover"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 — watcher must survive
+                logger.warning("rollover poll failed (will retry): %s", e)
+
+
+class _RouterStore:
+    """Adapter: incremental packets re-route by sign across a multi-replica
+    router (the loader only needs ``load_shard_bytes``)."""
+
+    def __init__(self, worker):
+        self._worker = worker
+
+    def load_shard_bytes(self, body: bytes) -> int:
+        from persia_tpu.embedding.hashing import sign_to_shard
+        import numpy as np
+
+        from persia_tpu.incremental import packet_signs
+
+        replicas = self._worker.lookup_router.replicas
+        signs = packet_signs(body)
+        if not len(signs):
+            return 0
+        owner = sign_to_shard(np.asarray(signs, dtype=np.uint64), len(replicas))
+        # split the packet per owning replica, preserving the wire format
+        import struct
+
+        from persia_tpu.incremental import iter_packet_entries
+
+        parts: Dict[int, list] = {}
+        for (sign, blob), own in zip(iter_packet_entries(body), owner.tolist()):
+            parts.setdefault(own, []).append(blob)
+        n = 0
+        for own, blobs in parts.items():
+            payload = struct.pack("<I", len(blobs)) + b"".join(blobs)
+            n += replicas[own].load_shard_bytes(payload)
+        return n

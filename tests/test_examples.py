@@ -56,14 +56,22 @@ def test_taobao_din_smoke(capsys):
     assert "taobao-din" in capsys.readouterr().out
 
 
-def test_synthetic_100t_smoke(capsys):
+def test_synthetic_100t_smoke(capsys, tmp_path):
     mod = _load("synthetic_100t/train.py")
+    # --out to a tmp file: the default path is the COMMITTED BENCH_100T.json
+    # artifact, which a smoke-config run must not overwrite
+    out_json = str(tmp_path / "bench_100t.json")
     rc = mod.main(["--batch-size", "32", "--steps", "2", "--num-slots", "4",
                    "--ids-per-sample", "2", "--ps-replicas", "8",
-                   "--capacity-per-replica", "4096"])
+                   "--capacity-per-replica", "4096", "--out", out_json])
     assert rc == 0
     out = capsys.readouterr().out
     assert "synthetic-100t" in out and "100T params" in out
+    import json
+
+    artifact = json.load(open(out_json))
+    assert artifact["capacity"]["bytes_per_row"] > 0
+    assert artifact["throughput"]["ids_per_sec_through_router"] > 0
 
 
 def test_datasets_deterministic():

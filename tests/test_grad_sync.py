@@ -36,10 +36,8 @@ from persia_tpu.parallel.train_step import (
     unpack_step_header,
 )
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+# version-portable shard_map (check_vma vs check_rep kwarg)
+from persia_tpu.parallel.mesh import shard_map_compat as shard_map
 
 B = 32
 DIM = 8

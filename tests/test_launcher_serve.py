@@ -1,0 +1,77 @@
+"""Launcher CLI: the ``serve`` role alongside the existing role subcommands.
+
+The serve role execs a user serving script with the serving-plane knobs in
+env (mirroring nn-worker's entry-exec contract); these tests smoke the
+argument surface of every subcommand and the serve role's env handoff
+without bringing up real services."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROLES = [
+    "nn-worker",
+    "data-loader",
+    "embedding-worker",
+    "embedding-parameter-server",
+    "coordinator",
+    "serve",
+    "k8s",
+]
+
+
+@pytest.mark.parametrize("role", ROLES)
+def test_role_subcommand_help(role):
+    r = subprocess.run(
+        [sys.executable, "-m", "persia_tpu.launcher", role, "--help"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    assert role in r.stdout or "usage" in r.stdout
+
+
+def test_serve_role_passes_knobs_via_env(tmp_path):
+    entry = tmp_path / "probe_serve.py"
+    entry.write_text(
+        "import json, os\n"
+        "print(json.dumps({k: os.environ.get(k) for k in ("
+        "'PERSIA_SERVE_PORT', 'REPLICA_INDEX', 'PERSIA_CHECKPOINT_DIR',"
+        "'PERSIA_INC_DIR', 'PERSIA_SERVE_MAX_BATCH',"
+        "'PERSIA_SERVE_MAX_WAIT_MS', 'PERSIA_SERVE_QUEUE_DEPTH',"
+        "'PERSIA_SERVE_CACHE_ROWS', 'PERSIA_COORDINATOR_ADDR')}))\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "persia_tpu.launcher", "serve", str(entry),
+         "--port", "8765", "--replica-index", "3",
+         "--checkpoint-dir", "/tmp/ckpt-x", "--incremental-dir", "/tmp/inc-x",
+         "--max-batch", "128", "--max-wait-ms", "1.5",
+         "--queue-depth", "64", "--cache-rows", "4096",
+         "--coordinator", "127.0.0.1:7799"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    env = json.loads(r.stdout.strip().splitlines()[-1])
+    assert env["PERSIA_SERVE_PORT"] == "8765"
+    assert env["REPLICA_INDEX"] == "3"
+    assert env["PERSIA_CHECKPOINT_DIR"] == "/tmp/ckpt-x"
+    assert env["PERSIA_INC_DIR"] == "/tmp/inc-x"
+    assert env["PERSIA_SERVE_MAX_BATCH"] == "128"
+    assert env["PERSIA_SERVE_MAX_WAIT_MS"] == "1.5"
+    assert env["PERSIA_SERVE_QUEUE_DEPTH"] == "64"
+    assert env["PERSIA_SERVE_CACHE_ROWS"] == "4096"
+    assert env["PERSIA_COORDINATOR_ADDR"] == "127.0.0.1:7799"
+
+
+def test_serve_role_env_entry_fallback(tmp_path):
+    entry = tmp_path / "fallback_serve.py"
+    entry.write_text("print('fallback-entry-ran')\n")
+    env = dict(os.environ, PERSIA_SERVE_ENTRY=str(entry))
+    r = subprocess.run(
+        [sys.executable, "-m", "persia_tpu.launcher", "serve"],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "fallback-entry-ran" in r.stdout

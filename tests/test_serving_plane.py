@@ -1,0 +1,468 @@
+"""Serving plane: micro-batcher coalescing/deadlines/shedding, hot-embedding
+cache hit/miss/invalidation, gateway failover + hedging, and atomic model
+rollover under concurrent /predict load."""
+
+import threading
+import time
+import urllib.error
+
+import numpy as np
+import optax
+import pytest
+
+from persia_tpu.config import EmbeddingConfig, SlotConfig
+from persia_tpu.ctx import InferCtx, TrainCtx
+from persia_tpu.data import (
+    IDTypeFeatureWithSingleID,
+    Label,
+    NonIDTypeFeature,
+    PersiaBatch,
+)
+from persia_tpu.embedding.optim import Adagrad
+from persia_tpu.embedding.store import EmbeddingStore
+from persia_tpu.embedding.worker import EmbeddingWorker
+from persia_tpu.incremental import IncrementalLoader, IncrementalUpdateManager
+from persia_tpu.models import DNN
+from persia_tpu.serving import (
+    DeadlineExceededError,
+    HotEmbeddingCache,
+    InferenceClient,
+    InferenceServer,
+    MicroBatcher,
+    QueueFullError,
+    ReplicaGateway,
+    ServingServer,
+    attach_cache,
+    merge_batches,
+)
+from persia_tpu.testing import SyntheticClickDataset
+
+VOCABS = (32, 16, 8)
+
+
+def _req_batch(rows: int, base: float = 0.0, n_dense: int = 4) -> PersiaBatch:
+    """Tiny request batch whose dense first column identifies its rows."""
+    dense = np.zeros((rows, n_dense), dtype=np.float32)
+    dense[:, 0] = base + np.arange(rows, dtype=np.float32)
+    return PersiaBatch(
+        [IDTypeFeatureWithSingleID(
+            "s", (np.arange(rows) % 16).astype(np.uint64))],
+        non_id_type_features=[NonIDTypeFeature(dense)],
+        requires_grad=False,
+    )
+
+
+def _first_col(batch: PersiaBatch) -> np.ndarray:
+    return np.asarray(batch.non_id_type_features[0].data)[:, 0]
+
+
+# ------------------------------------------------------------------ batcher
+
+
+def test_merge_batches_offsets_and_pad():
+    a, b = _req_batch(2, base=10), _req_batch(3, base=20)
+    merged, offsets = merge_batches([a, b], pad_to=8)
+    assert offsets == [0, 2, 5]
+    assert merged.batch_size == 8
+    col = _first_col(merged)
+    np.testing.assert_allclose(col[:2], [10, 11])
+    np.testing.assert_allclose(col[2:5], [20, 21, 22])
+    np.testing.assert_allclose(col[5:], 0.0)  # pad rows are zero
+    # padded samples carry no ids
+    assert all(len(s) == 0 for s in merged.id_type_features[0].data[5:])
+    # single batch without padding passes through unchanged
+    same, off1 = merge_batches([a])
+    assert same is a and off1 == [0, 2]
+
+
+def test_batcher_coalesces_concurrent_requests():
+    seen_rows = []
+
+    def predict(batch):
+        seen_rows.append(batch.batch_size)
+        return _first_col(batch)
+
+    mb = MicroBatcher(predict, max_batch=64, max_wait_ms=50, pad_buckets=False).start()
+    try:
+        results = {}
+        errs = []
+
+        def client(i):
+            try:
+                results[i] = mb.submit(_req_batch(2, base=100.0 * i))
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errs
+        # each caller got exactly its own rows back
+        for i in range(8):
+            np.testing.assert_allclose(results[i], [100.0 * i, 100.0 * i + 1])
+        # and the forwards coalesced: fewer forwards than requests
+        assert len(seen_rows) < 8
+        assert max(seen_rows) > 2
+    finally:
+        mb.stop()
+
+
+def test_batcher_pads_to_pow2_buckets():
+    shapes = []
+
+    def predict(batch):
+        shapes.append(batch.batch_size)
+        return _first_col(batch)
+
+    mb = MicroBatcher(predict, max_batch=64, max_wait_ms=40, pad_buckets=True).start()
+    try:
+        results = []
+
+        def client():
+            results.append(mb.submit(_req_batch(3)))
+
+        threads = [threading.Thread(target=client) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert all(r.shape == (3,) for r in results)  # pad rows sliced off
+        assert all(s & (s - 1) == 0 for s in shapes)  # every forward is pow2
+    finally:
+        mb.stop()
+
+
+def test_batcher_deadline_expiry():
+    started = threading.Event()
+
+    def slow_predict(batch):
+        started.set()
+        time.sleep(0.08)
+        return _first_col(batch)
+
+    mb = MicroBatcher(slow_predict, max_batch=1, max_wait_ms=0).start()
+    try:
+        t = threading.Thread(target=lambda: mb.submit(_req_batch(1)))
+        t.start()
+        assert started.wait(5)  # the forward thread is now busy for 80ms
+        with pytest.raises(DeadlineExceededError):
+            mb.submit(_req_batch(1), deadline_s=0.02)
+        t.join(timeout=10)
+    finally:
+        mb.stop()
+
+
+def test_batcher_sheds_on_full_queue():
+    release = threading.Event()
+    started = threading.Event()
+
+    def gated_predict(batch):
+        started.set()
+        release.wait(5)
+        return _first_col(batch)
+
+    mb = MicroBatcher(gated_predict, max_batch=1, max_wait_ms=0,
+                      queue_depth=1).start()
+    try:
+        threading.Thread(target=lambda: mb.submit(_req_batch(1))).start()
+        assert started.wait(5)  # request 1 holds the forward thread
+        t2 = threading.Thread(target=lambda: mb.submit(_req_batch(1)))
+        t2.start()
+        deadline = time.monotonic() + 5
+        while len(mb._q) < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)  # request 2 occupies the queue's single slot
+        with pytest.raises(QueueFullError):
+            mb.submit(_req_batch(1))
+        release.set()
+        t2.join(timeout=10)
+    finally:
+        release.set()
+        mb.stop()
+
+
+# -------------------------------------------------------------------- cache
+
+
+def test_cache_hit_miss_lru_and_epoch():
+    calls = []
+
+    def inner(keys, dim):
+        calls.append(np.asarray(keys).copy())
+        return np.tile(np.asarray(keys, np.float32)[:, None], (1, dim))
+
+    cache = HotEmbeddingCache(capacity=4)
+    keys = np.array([1, 2, 3], dtype=np.uint64)
+    out1 = cache.lookup_through(inner, keys, 2)
+    assert len(calls) == 1 and len(calls[0]) == 3
+    out2 = cache.lookup_through(inner, keys, 2)  # all hits: no inner call
+    assert len(calls) == 1
+    np.testing.assert_allclose(out1, out2)
+    s = cache.stats()
+    assert s["hits"] == 3 and s["misses"] == 3 and s["hit_rate"] == 0.5
+    # LRU eviction: capacity 4, insert 3 more → oldest fall out
+    cache.lookup_through(inner, np.array([4, 5, 6], dtype=np.uint64), 2)
+    assert len(cache) == 4
+    cache.bump_epoch()
+    assert len(cache) == 0 and cache.epoch == 1
+    cache.lookup_through(inner, keys, 2)  # refetches after epoch bump
+    assert len(calls) == 3
+
+
+def test_cache_invalidation_on_incremental_apply(tmp_path):
+    dim = 4
+    opt = Adagrad(lr=0.1).config
+    src = EmbeddingStore(capacity=1 << 10, num_internal_shards=2,
+                         optimizer=opt, seed=1)
+    dst = EmbeddingStore(capacity=1 << 10, num_internal_shards=2,
+                         optimizer=opt, seed=2)
+    signs = np.array([7, 8, 9], dtype=np.uint64)
+    src.lookup(signs, dim, train=True)  # creates seeded entries
+
+    cache = HotEmbeddingCache(capacity=64)
+
+    def dst_lookup(keys, d):
+        return dst.lookup(np.asarray(keys, np.uint64), d, False)
+
+    # serving side caches the pre-update rows (zeros: dst has no entries yet)
+    before = cache.lookup_through(dst_lookup, signs, dim)
+    np.testing.assert_allclose(before, 0.0)
+
+    mgr = IncrementalUpdateManager(src, str(tmp_path), flush_interval_sec=3600)
+    mgr.commit(signs)
+    assert mgr.flush() == 3
+
+    loader = IncrementalLoader(dst, str(tmp_path), on_apply=cache.invalidate)
+    assert loader.poll_once() == 3
+    assert cache.stats()["stale_dropped"] == 3
+
+    after = cache.lookup_through(dst_lookup, signs, dim)
+    expected = np.stack([src.get_embedding_entry(int(s))[:dim] for s in signs])
+    np.testing.assert_allclose(after, expected)  # fresh rows, not cached zeros
+    assert np.abs(after).sum() > 0
+
+
+def test_cached_router_serves_worker_infer_path():
+    cfg = EmbeddingConfig(
+        slots_config={f"cat_{i}": SlotConfig(dim=8) for i in range(len(VOCABS))},
+        feature_index_prefix_bit=8,
+    )
+    store = EmbeddingStore(capacity=1 << 12, num_internal_shards=2,
+                           optimizer=Adagrad(lr=0.1).config, seed=7)
+    worker = EmbeddingWorker(cfg, [store])
+    cache = attach_cache(worker, capacity=1 << 12)
+    ds = SyntheticClickDataset(num_samples=64, vocab_sizes=VOCABS, seed=3)
+    batch = next(iter(ds.batches(batch_size=64, requires_grad=False)))
+    # create entries through the TRAIN path (bypasses the cache)...
+    worker.forward_directly(batch, train=True)
+    assert cache.stats()["misses"] == 0
+    # ...then two infer passes: first misses populate, second all-hits
+    r1 = worker.forward_directly(batch, train=False)
+    assert cache.stats()["misses"] > 0
+    m_after_first = cache.stats()["misses"]
+    r2 = worker.forward_directly(batch, train=False)
+    assert cache.stats()["misses"] == m_after_first
+    assert cache.stats()["hits"] > 0
+    for a, b in zip(r1, r2):
+        np.testing.assert_allclose(a.pooled, b.pooled)
+
+
+# ------------------------------------------------------------------ gateway
+
+
+class _StubCtx:
+    """predict_from_bytes-only context for InferenceServer-based tests."""
+
+    def __init__(self, value: float, delay_s: float = 0.0):
+        self.model = DNN(dense_mlp_size=4, sparse_mlp_size=4, hidden_sizes=(4,))
+        self.value = value
+        self.delay_s = delay_s
+
+    def predict_from_bytes(self, raw: bytes) -> np.ndarray:
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        batch = PersiaBatch.from_bytes(raw)
+        return np.full((batch.batch_size,), self.value, dtype=np.float32)
+
+
+def test_gateway_failover_when_replica_dies():
+    s1 = InferenceServer(_StubCtx(1.0), port=0).start()
+    s2 = InferenceServer(_StubCtx(2.0), port=0).start()
+    gw = ReplicaGateway(
+        replicas=[f"127.0.0.1:{s1.port}", f"127.0.0.1:{s2.port}"],
+        health_interval_s=30.0, hedge_after_ms=500.0, request_timeout_s=5.0,
+    ).start()
+    try:
+        assert len(gw.live_replicas()) == 2
+        out = gw.predict(_req_batch(2))
+        assert out.shape == (2,)
+        s1.stop()  # replica dies; gateway does not know yet
+        for _ in range(4):  # round-robin must hit the dead one and fail over
+            out = gw.predict(_req_batch(2))
+            np.testing.assert_allclose(out, 2.0)
+        assert f"127.0.0.1:{s1.port}" not in gw.live_replicas()
+    finally:
+        gw.stop()
+        s2.stop()
+
+
+def test_gateway_hedges_slow_replica():
+    slow = InferenceServer(_StubCtx(1.0, delay_s=0.09), port=0).start()
+    fast = InferenceServer(_StubCtx(2.0), port=0).start()
+    gw = ReplicaGateway(
+        replicas=[f"127.0.0.1:{slow.port}", f"127.0.0.1:{fast.port}"],
+        health_interval_s=30.0, hedge_after_ms=15.0, request_timeout_s=5.0,
+    ).start()
+    try:
+        hedges_before = gw._m_hedges.get()
+        for _ in range(4):
+            out = gw.predict(_req_batch(1))
+            assert out.shape == (1,)
+        assert gw._m_hedges.get() > hedges_before
+    finally:
+        gw.stop()
+        slow.stop()
+        fast.stop()
+
+
+# ------------------------------------------------- HTTP admission control
+
+
+def test_http_429_shed_and_504_deadline():
+    gate = threading.Event()
+    started = threading.Event()
+
+    class _GatedCtx(_StubCtx):
+        def predict(self, batch):
+            started.set()
+            gate.wait(5)
+            return np.full((batch.batch_size,), self.value, dtype=np.float32)
+
+    srv = ServingServer(_GatedCtx(1.0), port=0, max_batch=1, max_wait_ms=0,
+                        queue_depth=1).start()
+    cli = InferenceClient(f"127.0.0.1:{srv.port}", timeout_s=10.0)
+    try:
+        results = []
+        t1 = threading.Thread(
+            target=lambda: results.append(cli.predict(_req_batch(1))))
+        t1.start()
+        assert started.wait(5)  # request 1 holds the forward
+        # request 2 fills the queue's only slot and will die there: its
+        # deadline (10ms) expires long before request 1 releases the gate
+        codes = []
+
+        def expect_code(deadline_ms=None):
+            try:
+                cli.predict(_req_batch(1), deadline_ms=deadline_ms)
+                codes.append(200)
+            except urllib.error.HTTPError as e:
+                codes.append(e.code)
+
+        t2 = threading.Thread(target=expect_code, kwargs={"deadline_ms": 10.0})
+        t2.start()
+        deadline = time.monotonic() + 5
+        while len(srv.batcher._q) < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        expect_code()  # queue full → 429 at the door
+        assert codes == [429]
+        time.sleep(0.05)  # let request 2's 10ms deadline lapse in the queue
+        gate.set()
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+        assert sorted(codes) == [429, 504]
+        assert len(results) == 1  # request 1 completed fine
+    finally:
+        gate.set()
+        srv.stop()
+
+
+# ------------------------------------------------------- rollover under load
+
+
+def _train_ctx():
+    cfg = EmbeddingConfig(
+        slots_config={f"cat_{i}": SlotConfig(dim=8) for i in range(len(VOCABS))},
+        feature_index_prefix_bit=8,
+    )
+    store = EmbeddingStore(capacity=1 << 14, num_internal_shards=2,
+                           optimizer=Adagrad(lr=0.1).config, seed=7)
+    worker = EmbeddingWorker(cfg, [store])
+    return TrainCtx(
+        model=DNN(dense_mlp_size=8, sparse_mlp_size=32, hidden_sizes=(32,)),
+        dense_optimizer=optax.adam(3e-3),
+        embedding_optimizer=Adagrad(lr=0.1),
+        worker=worker,
+        embedding_config=cfg,
+    ), cfg
+
+
+def test_rollover_under_concurrent_load(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    train = SyntheticClickDataset(num_samples=512, vocab_sizes=VOCABS, seed=1)
+    ctx, cfg = _train_ctx()
+    batches = list(train.batches(batch_size=128))
+    with ctx:
+        for b in batches[:2]:
+            ctx.train_step(b)
+    ctx.dump_checkpoint(ckpt)
+
+    # serving replica boots from v1 with cache + rollover armed
+    infer = InferCtx(model=ctx.model, state=ctx.state, worker=ctx.worker,
+                     embedding_config=cfg)
+    srv = ServingServer(infer, port=0, max_batch=256, max_wait_ms=2,
+                        cache_rows=1 << 14, ckpt_dir=ckpt,
+                        rollover_poll_s=0.05).start()
+    cli = InferenceClient(f"127.0.0.1:{srv.port}")
+    v1 = srv.engine.version
+    assert v1 != "v0"  # the pre-existing checkpoint versioned the server
+
+    test_ds = SyntheticClickDataset(num_samples=64, vocab_sizes=VOCABS, seed=9)
+    qbatch = next(iter(test_ds.batches(batch_size=64, requires_grad=False)))
+    failures = []
+    stop_load = threading.Event()
+    count = [0]
+
+    def hammer():
+        while not stop_load.is_set():
+            try:
+                out = cli.predict(qbatch)
+                assert out.shape[0] == 64
+                count[0] += 1
+            except Exception as e:  # noqa: BLE001 — any failure fails the test
+                failures.append(e)
+                return
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        # train on and publish v2 while the load runs
+        with ctx:
+            for b in batches[2:]:
+                ctx.train_step(b)
+        ctx.dump_checkpoint(ckpt)
+        deadline = time.monotonic() + 10
+        while srv.engine.version == v1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert srv.engine.version != v1, "rollover never applied"
+        # keep hammering briefly on the new version
+        t_end = time.monotonic() + 0.3
+        while time.monotonic() < t_end:
+            time.sleep(0.02)
+    finally:
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=10)
+
+    assert not failures, f"requests failed across rollover: {failures[:3]}"
+    assert count[0] > 0
+    # post-rollover predictions match the trainer's current eval exactly
+    remote = cli.predict(qbatch)
+    local = ctx.eval_batch(qbatch)
+    np.testing.assert_allclose(remote.reshape(-1),
+                               np.asarray(local).reshape(-1), atol=1e-5)
+    h = cli.health()
+    assert h["version"] == srv.engine.version
+    assert h["cache"]["hits"] >= 0
+    srv.stop()
