@@ -154,6 +154,20 @@ def bench_fused():
         return raw_step(state, batch)
 
     step = jax.jit(packed_step, donate_argnums=(0,))
+    # BENCH_FUSED_K>1: amortize dispatch overhead across K steps with one
+    # jitted multi-step program (the fused-path analogue of the cached
+    # stream's dispatch_k; parallel/fused_step.build_fused_multi_step is
+    # the library form) — on a remote-attached chip every dispatch pays
+    # tunnel latency, so the all-in-HBM ceiling is dispatch-bound too
+    K = max(1, int(os.environ.get("BENCH_FUSED_K", "1")))
+
+    def multi_body(state, ids_t, dl_t):
+        loss = None
+        for ids, dl in zip(ids_t, dl_t):
+            state, (loss, _) = packed_step(state, ids, dl)
+        return state, loss
+
+    multi = jax.jit(multi_body, donate_argnums=(0,)) if K > 1 else None
 
     # init on a sample batch
     ids0, dl0 = make_host_batch()
@@ -171,6 +185,27 @@ def bench_fused():
     )
 
     host_batches = [make_host_batch() for _ in range(8)]
+
+    def group(i):
+        picks = [host_batches[(i + j) % len(host_batches)] for j in range(K)]
+        return (
+            tuple(jnp.asarray(g[0]) for g in picks),
+            tuple(jnp.asarray(g[1]) for g in picks),
+        )
+
+    if K > 1:
+        for i in range(0, max(WARMUP_STEPS, K), K):
+            ids_t, dl_t = group(i)
+            state, loss = multi(state, ids_t, dl_t)
+        loss.block_until_ready()
+        steps_run = ((MEASURE_STEPS + K - 1) // K) * K
+        t0 = time.perf_counter()
+        for i in range(0, steps_run, K):
+            ids_t, dl_t = group(i)
+            state, loss = multi(state, ids_t, dl_t)
+        loss.block_until_ready()
+        elapsed = time.perf_counter() - t0
+        return steps_run * BATCH_SIZE / elapsed
 
     for i in range(WARMUP_STEPS):
         ids, dl = host_batches[i % len(host_batches)]
@@ -219,6 +254,9 @@ def bench_link():
         "h2d_MBps": round(h2d, 1),
         "d2h_MBps": round(d2h, 1),
         "small_d2h_roundtrip_ms": round(rt_ms, 1),
+        # what chip this record was actually measured on — a CPU-hosted
+        # run must not be mistaken for a chip number
+        "platform": jax.default_backend(),
     }
 
 
@@ -274,7 +312,12 @@ def _cached_tier_ctx(ps_all: bool = False):
         kw.update(
             cache_rows=8,  # unused: every slot rides the PS path
             ps_slots=[f"cat_{i}" for i in range(N_SLOTS)],
-            ps_wire_dtype="bfloat16",
+            # int8 error-feedback gradient-return wire by default (~4× vs
+            # f32, 2× vs the previous bf16 on the d2h ceiling that caps
+            # this regime); quality-gated by the int8-vs-f32 parity test
+            # (tests/test_hbm_cache.py) and priced by BENCH_MODE=quality.
+            # BENCH_PS_WIRE=bfloat16/float32 restores the wider wires.
+            ps_wire_dtype=os.environ.get("BENCH_PS_WIRE", "int8"),
         )
     else:
         kw.update(
@@ -286,6 +329,37 @@ def _cached_tier_ctx(ps_all: bool = False):
             admit_touches=int(os.environ.get("BENCH_ADMIT_TOUCHES", "2")),
         )
     return CachedTrainCtx(**kw).__enter__()
+
+
+def _dispatch_k() -> int:
+    """Multi-step fused dispatch depth for the stream modes (the K-step
+    hazard-free packing in hbm_cache/stream.py); BENCH_DISPATCH_K=1
+    restores the serial one-step-per-dispatch cadence for A/B runs."""
+    return int(os.environ.get("BENCH_DISPATCH_K", "8"))
+
+
+def _stream_record(ctx, samples_per_sec: float) -> dict:
+    """The cached-tier mode record: throughput plus the dispatch-mode and
+    feeder-utilization fields that make hot-loop regressions visible from
+    the committed JSON alone (a saturated number that quietly fell back to
+    single-step dispatch, or a feeder pinned at 100%, is a finding)."""
+    st = ctx.stream_stats() or {}
+    total = st.get("packed_steps", 0) + st.get("single_steps", 0)
+    return {
+        "samples_per_sec": round(samples_per_sec, 1),
+        "dispatch_mode": (
+            f"kstep-{st.get('dispatch_k')}"
+            if st.get("dispatch_k", 1) > 1 else "single"
+        ),
+        "packed_step_frac": (
+            round(st.get("packed_steps", 0) / total, 3) if total else 0.0
+        ),
+        "packs": st.get("packs", 0),
+        "feeder_util": (
+            round(st.get("feeder_busy_s", 0.0) / st["wall_s"], 3)
+            if st.get("wall_s") else None
+        ),
+    }
 
 
 def _zipf_batch_maker(seed: int = 0):
@@ -341,16 +415,18 @@ def bench_cached():
     # on a remote-attached chip ONE d2h permanently degrades dispatch
     # latency ~200x, so the loss header is synced without a transfer and
     # materialized only after the timed window
-    ctx.train_stream(batches[:warmup], fetch_final=False)
+    ctx.train_stream(batches[:warmup], fetch_final=False,
+                     dispatch_k=_dispatch_k())
 
     prog = _Progress()
     prog.start()
     t0 = time.perf_counter()
-    ctx.train_stream(prog.wrap(batches[warmup:]), fetch_final=False)
+    ctx.train_stream(prog.wrap(batches[warmup:]), fetch_final=False,
+                     dispatch_k=_dispatch_k())
     elapsed = time.perf_counter() - t0
     m = ctx.last_metrics()  # d2h outside the timed window
     assert m is not None and np.isfinite(m["loss"])
-    return steps * BATCH_SIZE / elapsed
+    return _stream_record(ctx, steps * BATCH_SIZE / elapsed)
 
 
 def bench_cached_saturated():
@@ -365,15 +441,17 @@ def bench_cached_saturated():
     make_batch = _zipf_batch_maker()
     warmup = 8
     batches = [make_batch() for _ in range(warmup + steps)]
-    ctx.train_stream(batches[:warmup], fetch_final=False)
+    ctx.train_stream(batches[:warmup], fetch_final=False,
+                     dispatch_k=_dispatch_k())
     prog = _Progress()
     prog.start()
     t0 = time.perf_counter()
-    ctx.train_stream(prog.wrap(batches[warmup:]), fetch_final=False)
+    ctx.train_stream(prog.wrap(batches[warmup:]), fetch_final=False,
+                     dispatch_k=_dispatch_k())
     elapsed = time.perf_counter() - t0
     m = ctx.last_metrics()
     assert m is not None and np.isfinite(m["loss"])
-    return steps * BATCH_SIZE / elapsed
+    return _stream_record(ctx, steps * BATCH_SIZE / elapsed)
 
 
 def bench_ps_stream():
@@ -594,10 +672,17 @@ def _quality_fused(steps):
 # tier at the DEFAULT 200-step budget on the given jax platform, fixed
 # seeds. Each tier is internally deterministic (the e2e suite asserts
 # bit-identical AUC for the hybrid path; the cached stream orders its
-# write-backs); a drift here means a semantic change to that tier's math,
-# not noise. Applies only at steps=200 on a known platform; set
-# BENCH_QUALITY_STRICT=0 to record instead of assert (when changing the
-# math intentionally, rerun and update these).
+# write-backs, and K-step packing is bit-transparent — pinned by
+# test_stream_kstep_packing_bitwise_parity); a drift here means a
+# semantic change to that tier's math, not noise. Applies only at
+# steps=200 on a known platform; set BENCH_QUALITY_STRICT=0 to record
+# instead of assert (when changing the math intentionally, rerun and
+# update these). Round 6 made int8+error-feedback the ps-stream default
+# wire (BENCH_PS_WIRE): that tier's measured-drift tolerance already
+# absorbs async-timing variance and the EF wire's small perturbation
+# (int8-vs-f32 entry drift measured ~1.7% rel-l2 on the parity test);
+# if a chip run lands outside it, re-pin with BENCH_PS_WIRE=bfloat16
+# first to separate wire drift from timing drift.
 EXPECTED_AUC = {
     # platform -> tier -> (expected AUC, tolerance), recorded on TPU v5e at
     # BENCH_QUALITY_STEPS=200. cached and fused are EXACT (1e-6): the
@@ -770,16 +855,31 @@ def _link_class(link: dict) -> str:
     return "good"
 
 
+def _mode_value(v):
+    """Samples/sec of a completed mode record: a bare number or a dict
+    record carrying ``samples_per_sec`` (the stream modes, which also
+    report dispatch_mode/feeder_util). Partial/errored records yield
+    None — they stay in "modes" but cannot be the headline."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    if (
+        isinstance(v, dict) and not v.get("partial")
+        and "samples_per_sec" in v
+    ):
+        return float(v["samples_per_sec"])
+    return None
+
+
 def _result_line(results: dict) -> str:
     # headline = the capacity tier's SATURATED steady-state (eviction
     # write-back on every step), not the flattering fill phase — a reader
     # of the one-line JSON gets the number the 100T regime actually runs
     # at (VERDICT r05 weak #1); the fill figure stays in cached_regimes.
     # "fused" (all-in-HBM) rides along as the in-memory ceiling. Partial /
-    # errored modes (dicts) stay in "modes" but cannot be the headline.
+    # errored modes stay in "modes" but cannot be the headline.
     throughput = {
-        k: v for k, v in results.items()
-        if k != "link" and isinstance(v, (int, float))
+        k: _mode_value(v) for k, v in results.items()
+        if k != "link" and _mode_value(v) is not None
     }
     headline = throughput.get(
         "cached-saturated",
@@ -809,12 +909,17 @@ def _result_line(results: dict) -> str:
         out["d2h_MBps"] = link.get("d2h_MBps")
         out["small_d2h_roundtrip_ms"] = link.get("small_d2h_roundtrip_ms")
         out["link_class"] = _link_class(link)
+        if "platform" in link:
+            out["platform"] = link["platform"]
         out["link"] = link
     # the cached tier is honest only as a pair: the 100-step fill-phase
-    # number AND the steady-state eviction regime (VERDICT r04 weak #2)
+    # number AND the steady-state eviction regime (VERDICT r04 weak #2);
+    # the stream records also carry dispatch_mode + feeder_util so a
+    # hot-loop regression is visible from this JSON alone
     if "cached" in results and "cached-saturated" in results:
         out["cached_regimes"] = {
-            "fill": results["cached"], "saturated": results["cached-saturated"]
+            "fill": _mode_value(results["cached"]),
+            "saturated": _mode_value(results["cached-saturated"]),
         }
     return json.dumps(out)
 
@@ -850,7 +955,7 @@ def main():
             print(_result_line(results), flush=True)
         return
     r = _BENCHES[mode]()
-    results[mode] = r if mode == "link" else round(r, 1)
+    results[mode] = round(r, 1) if isinstance(r, float) else r
     print(_result_line(results), flush=True)
 
 

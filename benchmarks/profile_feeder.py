@@ -1,0 +1,143 @@
+"""Feeder-thread hot-loop profile: the host-side prepare stage, before vs
+after the fused native feed (``cache_feed_batch``).
+
+The cached tier's saturated throughput is bounded by the single-core
+feeder thread (prep) or the device (dispatch), whichever is slower; this
+bench isolates the FEEDER half, which needs no accelerator — it runs the
+exact bench.py cached configuration's ``tier.prepare_batch`` on the same
+zipf stream, against a warm directory with in-flight eviction spans in the
+hazard ledger, and attributes time across the admit / ledger / PS-probe /
+warm-fill / cold-fill stages via PERSIA_TRACE spans.
+
+Two paths over identically seeded fresh tiers:
+  python-orchestrated  admit_positions + full-width ledger query + nonzero
+                       + arange insert (the pre-fusion hot loop)
+  fused-native         cache_feed_batch (admit+probe+LUT+ledger in ONE
+                       ctypes call) + candidate revalidation + insert_range
+
+Prints one JSON dict; PROFILE_FEEDER.md commits the measured numbers.
+"""
+
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import bench  # noqa: E402
+
+STEPS = int(os.environ.get("PROFILE_STEPS", "60"))
+WARM = int(os.environ.get("PROFILE_WARM", "20"))
+
+
+def _python_orchestrated_prepare(tier, batch, pmap, ring_alloc):
+    """The pre-fusion feeder orchestration, reproduced exactly: separate
+    admit call, then the stream's old gate (full-width ledger query +
+    host-side nonzero compaction) under _admit_aux."""
+
+    def gate(gname, miss_signs):
+        hits, _tokens, srcs = pmap.query(miss_signs)
+        if not hits:
+            return None
+        pos = np.nonzero(srcs >= 0)[0]
+        return [(None, srcs[pos], pos)]
+
+    return tier.prepare_batch(batch, hazard_gate=gate, ring_alloc=ring_alloc)
+
+
+def run_path(fused: bool):
+    from persia_tpu import tracing
+    from persia_tpu.embedding.hbm_cache.directory import PendingSignMap
+
+    ctx = bench._cached_tier_ctx()
+    tier = ctx.tier
+    make_batch = bench._zipf_batch_maker()
+    pmap = PendingSignMap()
+    ring_pos = [0]
+
+    def ring_alloc(gname, kp):  # unbounded stub ring: feeder cost only
+        p = ring_pos[0]
+        ring_pos[0] += kp
+        return p
+
+    token = [0]
+
+    def feed(batch):
+        if fused:
+            item = tier.prepare_batch(
+                batch, ring_alloc=ring_alloc, pending_map=pmap
+            )
+        else:
+            item = _python_orchestrated_prepare(tier, batch, pmap, ring_alloc)
+        # in-flight eviction spans enter the ledger exactly like the stream
+        for gn, (ev, k, rp) in item[6].items():
+            token[0] += 1
+            if fused:
+                pmap.insert_range(ev[:k], rp, token[0])
+            else:
+                pmap.insert(
+                    ev[:k], rp + np.arange(k, dtype=np.int64), token[0]
+                )
+        return item
+
+    # batches pre-generated OUTSIDE the timed loop (bench.py does the
+    # same): the zipf draw is data-pipeline cost, not feeder cost
+    batches = [make_batch() for _ in range(WARM + STEPS)]
+    for b in batches[:WARM]:  # fill the directory + ledger to steady state
+        feed(b)
+
+    tracing.enable()
+    tracing.clear()
+    t0 = time.perf_counter()
+    for b in batches[WARM:]:
+        feed(b)
+    wall = time.perf_counter() - t0
+    tracing.enable(False)
+
+    agg = defaultdict(lambda: [0, 0.0])
+    for ev in tracing.spans_snapshot():
+        agg[ev["name"]][0] += 1
+        agg[ev["name"]][1] += ev["dur"] / 1e3
+    out = {
+        "path": "fused-native" if fused else "python-orchestrated",
+        "prep_ms_per_step": round(wall / STEPS * 1e3, 3),
+        "feeder_ceiling_samples_per_sec": round(
+            STEPS * bench.BATCH_SIZE / wall, 1
+        ),
+        "ledger_entries": len(pmap),
+    }
+    for name in sorted(agg):
+        cnt, ms = agg[name]
+        out[name] = {
+            "per_step": round(cnt / STEPS, 2),
+            "busy_ms_per_step": round(ms / STEPS, 3),
+        }
+    return out
+
+
+def main():
+    results = [run_path(fused=False), run_path(fused=True)]
+    before, after = results
+    summary = {
+        "config": {
+            "batch_size": bench.BATCH_SIZE,
+            "n_slots": bench.N_SLOTS,
+            "positions_per_step": bench.BATCH_SIZE * bench.N_SLOTS,
+            "cache_rows": int(os.environ.get("BENCH_CACHE_ROWS", str(1 << 21))),
+            "steps": STEPS,
+        },
+        "before": before,
+        "after": after,
+        "prep_speedup": round(
+            before["prep_ms_per_step"] / after["prep_ms_per_step"], 3
+        ),
+    }
+    print(json.dumps(summary, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -165,6 +165,57 @@ def bench_ps_wire(batch_size=4096, n_slots=26, dim=16, distinct_per_slot=1360,
     return out
 
 
+def bench_psgrad_wire(batch_size=4096, n_slots=26, dim=16,
+                      distinct_per_slot=1360, reps=100) -> list:
+    """The ps-stream DEVICE→HOST gradient-return wire per training batch —
+    the physical ceiling of that regime (samples/sec ≤ d2h_BW /
+    grad_bytes_per_sample). Bytes per batch for the three wire choices
+    (f32 / bf16 / int8+per-slot-scales, hbm_cache/step.py ps_grad_wire)
+    plus the host-side unpack cost each adds on the write-back thread.
+    int8 rides bytegrad-style absmax quantization with a device-resident
+    error-feedback residual, so the 4× byte cut is not paid in applied
+    gradient fidelity (tests/test_hbm_cache.py int8-vs-f32 gate)."""
+    import ml_dtypes
+
+    rng = np.random.default_rng(9)
+    n = n_slots * distinct_per_slot * dim
+    g32 = rng.normal(size=n).astype(np.float32) * 1e-3
+    gbf = g32.astype(ml_dtypes.bfloat16)
+    scales = np.abs(g32.reshape(n_slots, -1)).max(axis=1).astype(np.float32)
+    q8 = np.clip(
+        np.round(
+            g32.reshape(n_slots, -1) / scales[:, None] * 127.0
+        ), -127, 127,
+    ).astype(np.int8).reshape(-1)
+
+    def timed(fn):
+        for _ in range(3):
+            fn()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn()
+        return (time.perf_counter() - t0) / reps * 1e6
+
+    out = []
+    for tag, nbytes, unpack in (
+        ("float32", g32.nbytes, lambda: g32.reshape(n_slots, -1)),
+        ("bfloat16", gbf.nbytes, lambda: gbf.astype(np.float32)),
+        (
+            "int8_ef",
+            q8.nbytes + scales.nbytes,
+            lambda: q8.reshape(n_slots, -1).astype(np.float32)
+            * (scales[:, None] / np.float32(127.0)),
+        ),
+    ):
+        out.append({
+            "case": f"psgrad_wire_{tag}",
+            "d2h_bytes_per_batch": int(nbytes),
+            "d2h_bytes_per_sample": round(nbytes / batch_size, 1),
+            "host_unpack_us": round(timed(unpack), 1),
+        })
+    return out
+
+
 def main() -> None:
     for name, batch in (
         ("infer_single_id_128x16", _single_id_batch()),
@@ -173,6 +224,8 @@ def main() -> None:
     ):
         print(json.dumps(bench_case(name, batch)))
     for row in bench_ps_wire():
+        print(json.dumps(row))
+    for row in bench_psgrad_wire():
         print(json.dumps(row))
 
 

@@ -21,6 +21,8 @@
 #include <cmath>
 #include <cstdint>
 #include <cstdlib>
+#include <mutex>
+#include <new>
 #include <vector>
 
 namespace {
@@ -516,8 +518,10 @@ void cache_init_rows(const uint64_t* signs, int64_t m, int64_t dim,
 // (~45 ms/step at saturation on one core); this map makes the gate one
 // native query. Insert overwrites (later steps win); remove is
 // token-conditional so an in-flight flush cannot delete a newer step's
-// entry for the same sign. Not thread-safe by itself — the stream guards
-// all calls with its condvar lock.
+// entry for the same sign. Thread-safe via an internal mutex: the fused
+// feeder entry point (cache_feed_batch) queries the ledger inside the
+// admit call while the write-back thread removes landed entries, so the
+// map can no longer rely on the stream's Python condvar alone.
 
 struct PendingMap {
   struct Slot {
@@ -526,6 +530,7 @@ struct PendingMap {
     uint32_t token;
     uint8_t state;  // 0 empty, 1 used, 2 tombstone
   };
+  std::mutex mu;
   std::vector<Slot> t;
   uint64_t mask = 0;
   int64_t count = 0;      // used slots
@@ -578,6 +583,21 @@ struct PendingMap {
       j = (j + 1) & mask;
     }
   }
+
+  // caller holds mu; returns true on a live hit
+  inline bool find(uint64_t s, int64_t* src, uint32_t* token) const {
+    uint64_t j = splitmix64(s) & mask;
+    for (;;) {
+      const Slot& sl = t[j];
+      if (sl.state == 0) return false;
+      if (sl.state == 1 && sl.sign == s) {
+        *src = sl.src;
+        *token = sl.token;
+        return true;
+      }
+      j = (j + 1) & mask;
+    }
+  }
 };
 
 }  // namespace
@@ -592,38 +612,50 @@ void* pending_map_create() {
 
 void pending_map_destroy(void* h) { delete static_cast<PendingMap*>(h); }
 
-int64_t pending_map_size(void* h) { return static_cast<PendingMap*>(h)->count; }
+int64_t pending_map_size(void* h) {
+  PendingMap& m = *static_cast<PendingMap*>(h);
+  std::lock_guard<std::mutex> lk(m.mu);
+  return m.count;
+}
 
 void pending_map_insert(void* h, const uint64_t* signs, const int64_t* srcs,
                         int64_t n, uint32_t token) {
   PendingMap& m = *static_cast<PendingMap*>(h);
+  std::lock_guard<std::mutex> lk(m.mu);
   m.grow_if_needed(n);
   for (int64_t i = 0; i < n; ++i) m.put(signs[i], srcs[i], token);
+}
+
+// insert signs[i] -> (base_src + i, token): the per-step eviction span is
+// always a contiguous ring region, so the feeder needs no host-side arange
+// temporary to record it.
+void pending_map_insert_range(void* h, const uint64_t* signs, int64_t n,
+                              int64_t base_src, uint32_t token) {
+  PendingMap& m = *static_cast<PendingMap*>(h);
+  std::lock_guard<std::mutex> lk(m.mu);
+  m.grow_if_needed(n);
+  for (int64_t i = 0; i < n; ++i) m.put(signs[i], base_src + i, token);
 }
 
 // tokens_out/srcs_out filled per sign; src -1 = not pending. Returns hits.
 int64_t pending_map_query(void* h, const uint64_t* signs, int64_t n,
                           uint32_t* tokens_out, int64_t* srcs_out) {
   PendingMap& m = *static_cast<PendingMap*>(h);
+  std::lock_guard<std::mutex> lk(m.mu);
   int64_t hits = 0;
   const int64_t PF = 16;
   for (int64_t i = 0; i < n; ++i) {
     if (i + PF < n)
       __builtin_prefetch(&m.t[splitmix64(signs[i + PF]) & m.mask]);
     const uint64_t s = signs[i];
-    uint64_t j = splitmix64(s) & m.mask;
     srcs_out[i] = -1;
     tokens_out[i] = 0;
-    for (;;) {
-      const PendingMap::Slot& sl = m.t[j];
-      if (sl.state == 0) break;
-      if (sl.state == 1 && sl.sign == s) {
-        srcs_out[i] = sl.src;
-        tokens_out[i] = sl.token;
-        ++hits;
-        break;
-      }
-      j = (j + 1) & m.mask;
+    int64_t src;
+    uint32_t token;
+    if (m.find(s, &src, &token)) {
+      srcs_out[i] = src;
+      tokens_out[i] = token;
+      ++hits;
     }
   }
   return hits;
@@ -634,6 +666,7 @@ int64_t pending_map_query(void* h, const uint64_t* signs, int64_t n,
 void pending_map_remove(void* h, const uint64_t* signs, int64_t n,
                         uint32_t token) {
   PendingMap& m = *static_cast<PendingMap*>(h);
+  std::lock_guard<std::mutex> lk(m.mu);
   for (int64_t i = 0; i < n; ++i) {
     const uint64_t s = signs[i];
     uint64_t j = splitmix64(s) & m.mask;
@@ -650,6 +683,63 @@ void pending_map_remove(void* h, const uint64_t* signs, int64_t n,
       j = (j + 1) & m.mask;
     }
   }
+}
+
+// ------------------------------------------------------------ fused feeder
+//
+// One call for the feeder hot loop's whole admit stage: dedup + admit +
+// eviction-row selection + per-position LUT fill (cache_admit_positions)
+// FUSED with the write-back hazard-ledger probe of the resulting misses.
+// The Python orchestration this replaces ran two ctypes round-trips plus a
+// full-width numpy query/nonzero per step under the stream lock; here the
+// ledger is consulted inline, under its own mutex, only for the misses,
+// and only the hits are materialized (compacted restore_{src,pos} pairs).
+//
+// Outputs (all sized by the caller as for cache_admit_positions):
+//   restore_src_out[j]  ring row holding miss j's freshest entry
+//   restore_pos_out[j]  ordinal into miss_signs_out/miss_rows_out
+//   *n_restore_out      number of ledger hits among the misses
+// Returns n_miss (or -1 on capacity overflow, same contract as
+// cache_admit_positions; no ledger probe happens in that case).
+//
+// Ordering caveat (documented for the caller): the ledger probe here runs
+// BEFORE the caller reserves this step's eviction-ring span, so a flush
+// landing between this call and the reservation can free a referenced
+// span for reuse by THIS step. The Python side therefore revalidates the
+// (few) restore hits against the ledger again after the reservation; a
+// hit that died in between simply rides the ordinary PS-probe path (its
+// write-back has landed, so the PS copy is fresh).
+int64_t cache_feed_batch(void* h, void* pending_h,
+                         const uint64_t* signs, int64_t n,
+                         int32_t* rows_out,
+                         uint64_t* miss_signs_out, int64_t* miss_rows_out,
+                         uint64_t* evict_signs_out, int64_t* evict_rows_out,
+                         int64_t* n_unique_out, int64_t* n_evict_out,
+                         int64_t* restore_src_out, int64_t* restore_pos_out,
+                         int64_t* n_restore_out) {
+  *n_restore_out = 0;
+  const int64_t n_miss = cache_admit_positions(
+      h, signs, n, rows_out, miss_signs_out, miss_rows_out,
+      evict_signs_out, evict_rows_out, n_unique_out, n_evict_out);
+  if (n_miss < 0 || pending_h == nullptr) return n_miss;
+  PendingMap& m = *static_cast<PendingMap*>(pending_h);
+  std::lock_guard<std::mutex> lk(m.mu);
+  if (m.count == 0) return n_miss;
+  int64_t n_restore = 0;
+  const int64_t PF = 16;
+  for (int64_t j = 0; j < n_miss; ++j) {
+    if (j + PF < n_miss)
+      __builtin_prefetch(&m.t[splitmix64(miss_signs_out[j + PF]) & m.mask]);
+    int64_t src;
+    uint32_t token;
+    if (m.find(miss_signs_out[j], &src, &token)) {
+      restore_src_out[n_restore] = src;
+      restore_pos_out[n_restore] = j;
+      ++n_restore;
+    }
+  }
+  *n_restore_out = n_restore;
+  return n_miss;
 }
 
 }  // extern "C"

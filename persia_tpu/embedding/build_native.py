@@ -10,7 +10,13 @@ toolchain check. Cores:
 - ``native/libpersia_worker.so`` — embedding-worker hot loops (dedup,
   shard partition, pooling; ref: embedding_worker_service preprocessing)
 - ``native/libpersia_cache.so`` — HBM write-back cache directory +
-  positions-level admit + seeded init
+  positions-level admit + the fused feeder entry point
+  (``cache_feed_batch``: admit + eviction selection + row LUT + hazard
+  ledger in one call) + the mutex-protected pending-sign map + seeded init
+
+``scripts/round_preflight.sh`` step 0 force-rebuilds all three and runs
+the ABI parity tests (tests/test_native_feed.py) so a broken ctypes
+signature cannot land silently.
 """
 
 from __future__ import annotations

@@ -128,18 +128,22 @@ class CachedTrainCtx:
             for g in self.tier.groups for s in g.slots
         }))
         self._state_consts = _state_init_consts(self.sparse_cfg)
-        if ps_wire_dtype not in ("float32", "bfloat16"):
+        if ps_wire_dtype not in ("float32", "bfloat16", "int8"):
             raise ValueError(
-                f"ps_wire_dtype must be float32/bfloat16, got {ps_wire_dtype!r}"
+                f"ps_wire_dtype must be float32/bfloat16/int8, got {ps_wire_dtype!r}"
             )
         self.dynamic_loss_scale = dynamic_loss_scale
         self._loss_scale_init = loss_scale_init
+        # "int8" = bytegrad-style absmax quantization of the GRADIENT-RETURN
+        # wire with a device-resident error-feedback residual (see
+        # build_cached_train_step); the forward checkout wire stays bf16
+        # (embedding VALUES do not tolerate int8 the way EF'd gradients do)
+        self._ps_int8 = ps_wire_dtype == "int8"
+        self._ps_residual: Dict[int, jnp.ndarray] = {}
         self._step = build_cached_train_step(
             model, dense_optimizer, self.sparse_cfg, self.tier.groups,
             loss_fn=loss_fn,
-            ps_grad_dtype=(
-                jnp.bfloat16 if ps_wire_dtype == "bfloat16" else jnp.float32
-            ),
+            ps_grad_wire=ps_wire_dtype,
             dynamic_loss_scale=dynamic_loss_scale,
             growth_interval=loss_scale_growth_interval,
             max_scale=loss_scale_max,
@@ -147,9 +151,10 @@ class CachedTrainCtx:
         self._eval = build_cached_eval_step(model, self.tier.groups)
         # forward-side ps wire: stage PS-tier entries in the same reduced
         # dtype the gradients return in (host->device rows are the other
-        # half of the PS tier's link bill)
+        # half of the PS tier's link bill); int8 grad wire keeps bf16 here
         self._ps_stage_dtype = (
-            np.dtype("bfloat16") if ps_wire_dtype == "bfloat16" else None
+            np.dtype("bfloat16")
+            if ps_wire_dtype in ("bfloat16", "int8") else None
         )
         self.table_dtype = table_dtype
         self.state: Optional[CachedTrainState] = None
@@ -168,6 +173,10 @@ class CachedTrainCtx:
         self._last_header_dev = None
         # per-group 0-row stand-ins for absent aux pieces (_group_empties)
         self._empties: Dict[str, Dict[str, jnp.ndarray]] = {}
+        # K-step fused dispatch program (lazy; see _dispatch_packed) and
+        # the most recent train_stream's dispatch/feeder accounting
+        self._kstep_jit = None
+        self._stream_stats: Optional[Dict] = None
 
     def __enter__(self):
         self.worker.register_optimizer(self.sparse_cfg)
@@ -437,11 +446,135 @@ class CachedTrainCtx:
                             src_idx, dst_rows,
                         )
             self.state = self.state.replace(tables=tables, emb_state=emb_state)
+        if self._ps_int8 and "ps_emb" in device_inputs:
+            # thread the device-resident error-feedback residual through
+            # the step; keyed by flat length so a bucketed-shape change
+            # resets it to zeros (positions mean different signs then)
+            total = 0
+            for e in device_inputs["ps_emb"]:
+                shape = (
+                    e["pooled"].shape if "pooled" in e
+                    else e["distinct"].shape
+                )
+                total += int(np.prod(shape))
+            res = self._ps_residual.get(total)
+            if res is None:
+                z = np.zeros((total,), np.float32)
+                rep = self._replicated()
+                res = (
+                    jax.device_put(z) if rep is None
+                    else jax.device_put(z, rep)
+                )
+            device_inputs = dict(device_inputs)
+            device_inputs["ps_gres"] = res
         with span("ctx.main_step"):
             self.state, header, ps_gpacked = self._step(
                 self.state, device_inputs, layout
             )
+        if self._ps_int8 and isinstance(ps_gpacked, tuple):
+            q, scales, new_res = ps_gpacked
+            if new_res.shape[0]:
+                self._ps_residual[new_res.shape[0]] = new_res
+            ps_gpacked = (q, scales)
         return header, evict_payload, ps_gpacked
+
+    # ------------------------------------------------- K-step fused dispatch
+
+    def _kstep_fn(self):
+        """The jitted K-step program: for each packed step, apply its aux
+        scatters (evict-payload read → ring write → warm/cold scatters),
+        then run the main train step — K steps, ONE dispatch. Ordering
+        inside the trace is exactly the single-step path's: step i's aux
+        reads the post-step-(i-1) tables, so packing changes no math
+        (tests pin stream-vs-sync bit parity through packs). Restores are
+        excluded by the stream's packing predicate, which is what makes
+        the unroll safe without any in-window hazard analysis."""
+        if self._kstep_jit is None:
+            def run(state, rings, steps, layout):
+                rings = dict(rings)
+                headers, payloads = [], []
+                for di, aux in steps:
+                    if aux:
+                        tables = dict(state.tables)
+                        emb_state = dict(state.emb_state)
+                    step_payloads = {}
+                    for gname in sorted(aux):
+                        a = aux[gname]
+                        ev_rows = a["ev"]
+                        m_rows, m_entries = a["miss"]
+                        c_rows, c_emb = a["cold"]
+                        if "ring_pos" in a:
+                            (tables[gname], emb_state[gname], rings[gname],
+                             payload) = _apply_aux_ring(
+                                tables[gname], emb_state[gname],
+                                rings[gname], a["ring_pos"],
+                                ev_rows, m_rows, m_entries, c_rows, c_emb,
+                                self._state_consts, self._wb_bf16,
+                            )
+                        else:
+                            tables[gname], emb_state[gname], payload = _apply_aux(
+                                tables[gname], emb_state[gname], ev_rows,
+                                m_rows, m_entries, c_rows, c_emb,
+                                self._state_consts, self._wb_bf16,
+                            )
+                        step_payloads[gname] = payload
+                    if aux:
+                        state = state.replace(
+                            tables=tables, emb_state=emb_state
+                        )
+                    state, header, _ps = self._step(state, di, layout)
+                    headers.append(header)
+                    payloads.append(step_payloads)
+                return state, rings, headers, payloads
+
+            self._kstep_jit = jax.jit(
+                run, static_argnums=(3,), donate_argnums=(0, 1)
+            )
+        return self._kstep_jit
+
+    def _dispatch_packed(self, items):
+        """Dispatch K staged steps as one fused program. ``items``:
+        ``[(di, layout, miss_aux, cold_aux, evict_aux, evict_meta), ...]``
+        — already device-staged, hazard-free (no restore_aux, no ps_emb),
+        one shared layout. Returns ``(headers, payloads)``: the per-step
+        headers and per-step ``{group: eviction payload}`` dicts for the
+        write-back thread's bounded d2h fetches."""
+        layout = items[0][1]
+        steps = []
+        ring_names = set()
+        for di, _lay, miss_aux, cold_aux, evict_aux, evict_meta in items:
+            aux = {}
+            for gname in sorted(set(miss_aux) | set(cold_aux) | set(evict_aux)):
+                em = self._group_empties(gname)
+                entry = {
+                    "ev": evict_aux.get(gname, em["rows"]),
+                    "miss": miss_aux.get(gname, (em["rows"], em["entries"])),
+                    "cold": cold_aux.get(gname, (em["rows"], em["emb"])),
+                }
+                ring_pos = -1
+                if evict_meta and gname in evict_meta:
+                    ring_pos = evict_meta[gname][2]
+                if ring_pos >= 0:
+                    # traced scalar (not static): ring positions change
+                    # every step and must not key the jit cache
+                    entry["ring_pos"] = np.int32(ring_pos)
+                    ring_names.add(gname)
+                aux[gname] = entry
+            steps.append((di, aux))
+        rings = {gn: self._ev_ring(gn) for gn in sorted(ring_names)}
+        state, rings_out, headers, payloads = self._kstep_fn()(
+            self.state, rings, tuple(steps), layout
+        )
+        self.state = state
+        self._ev_rings.update(rings_out)
+        return headers, payloads
+
+    def stream_stats(self) -> Optional[Dict]:
+        """Dispatch/feeder accounting of the most recent ``train_stream``:
+        ``dispatch_k``, ``packs``, ``packed_steps``, ``single_steps``,
+        ``feeder_busy_s``, ``wall_s`` — the artifact fields bench.py
+        commits so hot-loop regressions are visible from the JSON alone."""
+        return self._stream_stats
 
     def _ps_forward(self, batch: PersiaBatch):
         """Forward the PS-tier slot subset through the worker's forward-ref
@@ -475,18 +608,38 @@ class CachedTrainCtx:
 
         ref, embs, counts, entries = ps_item
         try:
-            gp = np.asarray(ps_gpacked)
-            if gp.dtype != np.float32:  # bf16 ps-grad wire
-                gp = gp.astype(np.float32)
-            scale_factor = 1.0
-            if self.dynamic_loss_scale:
-                # buffer tail = [scale | finite] (see build_cached_train_step)
-                scale_factor = float(gp[-2])
-                if not gp[-1] > 0.5:  # overflow: skip-step — drop the grads
-                    self.worker.abort_gradient(ref)
-                    return
-                gp = gp[:-2]
-            grads = unpack_step_grads(gp, {"emb": entries})
+            if isinstance(ps_gpacked, tuple):
+                # int8 wire: (q int8, scales f32 per slot [+finite]); grads
+                # were unscaled on device, so scale_factor stays 1.0
+                from persia_tpu.parallel.grad_sync import dequantize_int8_np
+
+                q = np.asarray(ps_gpacked[0])
+                scales = np.asarray(ps_gpacked[1]).astype(np.float32)
+                scale_factor = 1.0
+                if self.dynamic_loss_scale:
+                    if not scales[-1] > 0.5:  # overflow: skip-step
+                        self.worker.abort_gradient(ref)
+                        return
+                    scales = scales[:-1]
+                grads = [
+                    dequantize_int8_np(g, s)
+                    for g, s in zip(
+                        unpack_step_grads(q, {"emb": entries}), scales
+                    )
+                ]
+            else:
+                gp = np.asarray(ps_gpacked)
+                if gp.dtype != np.float32:  # bf16 ps-grad wire
+                    gp = gp.astype(np.float32)
+                scale_factor = 1.0
+                if self.dynamic_loss_scale:
+                    # buffer tail = [scale | finite] (build_cached_train_step)
+                    scale_factor = float(gp[-2])
+                    if not gp[-1] > 0.5:  # overflow: skip-step — drop grads
+                        self.worker.abort_gradient(ref)
+                        return
+                    gp = gp[:-2]
+                grads = unpack_step_grads(gp, {"emb": entries})
             slot_grads = {
                 eb.name: (g if d is None else g[:d])
                 for eb, g, d in zip(embs, grads, counts)

@@ -98,9 +98,16 @@ def _load_lib() -> ctypes.CDLL:
         lib.pending_map_size.restype = i64
         lib.pending_map_size.argtypes = [p]
         lib.pending_map_insert.argtypes = [p, _u64p, _i64p, i64, u32]
+        lib.pending_map_insert_range.argtypes = [p, _u64p, i64, i64, u32]
         lib.pending_map_query.restype = i64
         lib.pending_map_query.argtypes = [p, _u64p, i64, u32p, _i64p]
         lib.pending_map_remove.argtypes = [p, _u64p, i64, u32]
+        lib.cache_feed_batch.restype = i64
+        lib.cache_feed_batch.argtypes = [
+            p, p, _u64p, i64, _i32p, _u64p, _i64p, _u64p, _i64p,
+            ctypes.POINTER(i64), ctypes.POINTER(i64),
+            _i64p, _i64p, ctypes.POINTER(i64),
+        ]
         _LIB = lib
     return _LIB
 
@@ -235,6 +242,8 @@ class CacheDirectory:
         self._s_ev_signs = np.empty(n, dtype=np.uint64)
         self._s_ev_rows = np.empty(n, dtype=np.int64)
         self._s_miss_idx = np.empty(n, dtype=np.int64)
+        self._s_rst_src = np.empty(n, dtype=np.int64)
+        self._s_rst_pos = np.empty(n, dtype=np.int64)
 
     def __del__(self):
         if getattr(self, "_h", None) is not None:
@@ -308,6 +317,54 @@ class CacheDirectory:
             ev_signs[:k].copy(), ev_rows[:k].copy(), n_unique.value,
         )
 
+    def feed_batch(self, signs: np.ndarray, pending_map: "PendingSignMap | None"):
+        """The feeder hot-loop fused call (``native/cache.cpp``
+        ``cache_feed_batch``): everything ``admit_positions`` does PLUS the
+        write-back hazard-ledger probe of the resulting misses, in ONE
+        native round-trip. Returns ``admit_positions``'s 6-tuple extended
+        with ``(restore_src (R,), restore_pos (R,))`` — the in-flight ring
+        row and miss ordinal of every miss whose freshest entry is still
+        riding an un-landed eviction write-back. The probe runs before the
+        caller's ring-span reservation, so restore hits must be
+        REVALIDATED against the map after reserving (see the C comment);
+        a hit that died in between is safe to route through the PS."""
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        n = signs.size
+        self._ensure_scratch(n)
+        rows = self._rows_ring.get("rows", (_bucket(max(n, 1)),), np.int32)[:n]
+        n_unique = ctypes.c_int64(0)
+        n_evict = ctypes.c_int64(0)
+        n_restore = ctypes.c_int64(0)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        n_miss = self._lib.cache_feed_batch(
+            self._h, pending_map._h if pending_map is not None else None,
+            signs.ctypes.data_as(_u64p), n,
+            rows.ctypes.data_as(i32p),
+            self._s_miss_signs.ctypes.data_as(_u64p),
+            self._s_miss_rows.ctypes.data_as(_i64p),
+            self._s_ev_signs.ctypes.data_as(_u64p),
+            self._s_ev_rows.ctypes.data_as(_i64p),
+            ctypes.byref(n_unique), ctypes.byref(n_evict),
+            self._s_rst_src.ctypes.data_as(_i64p),
+            self._s_rst_pos.ctypes.data_as(_i64p),
+            ctypes.byref(n_restore),
+        )
+        if n_miss < 0:
+            raise RuntimeError(
+                f"batch distinct-sign count exceeds cache capacity "
+                f"{self.capacity} — raise cache rows or shrink the batch"
+            )
+        k = n_evict.value
+        r = n_restore.value
+        return (
+            rows,
+            self._s_miss_signs[:n_miss].copy(),
+            self._s_miss_rows[:n_miss].copy(),
+            self._s_ev_signs[:k].copy(), self._s_ev_rows[:k].copy(),
+            n_unique.value,
+            self._s_rst_src[:r].copy(), self._s_rst_pos[:r].copy(),
+        )
+
     def probe(self, signs: np.ndarray) -> np.ndarray:
         """Read-only residency check: row per sign, -1 on miss. No admit, no
         LRU touch — safe for eval/infer batches."""
@@ -343,9 +400,10 @@ class CacheDirectory:
 class PendingSignMap:
     """Native sign → (token, src) map for the stream's write-back hazard
     gate (`native/cache.cpp` pending_map_*): one query call per step
-    replaces a per-pending-record searchsorted scan. Caller provides the
-    locking (the stream already serializes gate/insert/remove under its
-    condvar)."""
+    replaces a per-pending-record searchsorted scan. Internally
+    mutex-protected, so the fused feeder probe (``cache_feed_batch``) and
+    the write-back thread's removals need no shared Python lock; the
+    stream's condvar still orders removals against ring-tail advances."""
 
     def __init__(self):
         self._lib = _load_lib()
@@ -370,6 +428,16 @@ class PendingSignMap:
             self._h, signs.ctypes.data_as(_u64p),
             srcs.ctypes.data_as(_i64p), len(signs),
             ctypes.c_uint32(token & 0xFFFFFFFF),
+        )
+
+    def insert_range(self, signs: np.ndarray, base_src: int, token: int) -> None:
+        """Insert ``signs[i] -> (base_src + i, token)`` — the contiguous
+        ring-span form every eviction record takes, without the host-side
+        arange temporary."""
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        self._lib.pending_map_insert_range(
+            self._h, signs.ctypes.data_as(_u64p), len(signs),
+            int(base_src), ctypes.c_uint32(token & 0xFFFFFFFF),
         )
 
     def query(self, signs: np.ndarray):

@@ -59,6 +59,7 @@ def build_cached_train_step(
     loss_fn=None,
     donate: bool = True,
     ps_grad_dtype=jnp.float32,
+    ps_grad_wire: Optional[str] = None,
     dynamic_loss_scale: bool = False,
     growth_interval: int = 2000,
     growth_factor: float = 2.0,
@@ -97,6 +98,21 @@ def build_cached_train_step(
     advance on overflow-skipped steps too — keeping the two tiers' powers
     in lockstep without a per-step device sync; the skipped step itself
     applies no gradient anywhere.
+
+    ``ps_grad_wire``: the gradient-RETURN wire for PS-tier slots —
+    "float32" / "bfloat16" (equivalent to ``ps_grad_dtype``, kept for
+    callers that pass the dtype directly) or "int8": bytegrad-style
+    per-slot absmax quantization with an error-feedback residual
+    (``parallel/grad_sync.quantize_int8_ef``) — ~4× fewer d2h bytes than
+    f32 on the wire that physically caps the ps-stream regime. The
+    residual stays DEVICE-resident: the step reads it from
+    ``batch["ps_gres"]`` (flat f32, zeros to reset) and returns the
+    updated one, so what int8 could not represent this step re-enters the
+    next step's wire instead of being lost. With int8 the step returns
+    ``ps_gpacked = (q int8, scales f32 (S[+finite]), new_residual f32)``
+    — grads are unscaled ON DEVICE under dynamic loss scaling (the
+    scales tail then carries the finite flag), and an overflow step ships
+    zeros and carries the residual through unchanged.
     """
     from functools import partial
 
@@ -104,6 +120,14 @@ def build_cached_train_step(
 
     loss_fn = loss_fn or default_loss_fn
     by_name = {g.name: g for g in groups}
+    if ps_grad_wire is not None:
+        if ps_grad_wire not in ("float32", "bfloat16", "int8"):
+            raise ValueError(
+                f"ps_grad_wire must be float32/bfloat16/int8, got {ps_grad_wire!r}"
+            )
+        if ps_grad_wire == "bfloat16":
+            ps_grad_dtype = jnp.bfloat16
+    ps_int8 = ps_grad_wire == "int8"
 
     @partial(jax.jit, static_argnums=(2,), donate_argnums=(0,) if donate else ())
     def step(state: CachedTrainState, batch: Dict, layout: CacheLayout):
@@ -270,6 +294,47 @@ def build_cached_train_step(
         # worker's unscale/update. Under dynamic scaling the buffer's last
         # two entries are [scale | finite] (both exact in bf16: scale is a
         # power of two), so the write-back thread needs no extra fetch.
+        # The int8 wire quarter-widths the same bytes: per-slot absmax
+        # quantization with a device-resident error-feedback residual.
+        if ps_int8:
+            from persia_tpu.parallel.grad_sync import quantize_int8_ef
+
+            flats = [jnp.reshape(g, (-1,)).astype(jnp.float32) for g in ps_g]
+            total = sum(f.shape[0] for f in flats)
+            res = batch.get("ps_gres")
+            if res is None:
+                res = jnp.zeros((total,), jnp.float32)
+            qs, scs, new_res = [], [], []
+            off = 0
+            for f in flats:
+                r = jax.lax.slice(res, (off,), (off + f.shape[0],))
+                off += f.shape[0]
+                # unscale ON the device (inv = 0 on overflow): the residual
+                # must accumulate true-gradient error, not scaled error
+                q, sc, _deq, nr = quantize_int8_ef(f * inv, r)
+                if dynamic_loss_scale:
+                    q = jnp.where(finite, q, jnp.zeros_like(q))
+                    nr = jnp.where(finite, nr, r)
+                qs.append(q)
+                scs.append(sc)
+                new_res.append(nr)
+            q_packed = (
+                jnp.concatenate(qs) if qs else jnp.zeros((0,), jnp.int8)
+            )
+            sc_parts = [jnp.stack(scs)] if scs else []
+            if dynamic_loss_scale:
+                sc_parts.append(
+                    jnp.reshape(finite.astype(jnp.float32), (1,))
+                )
+            sc_packed = (
+                jnp.concatenate(sc_parts) if sc_parts
+                else jnp.zeros((0,), jnp.float32)
+            )
+            res_packed = (
+                jnp.concatenate(new_res) if new_res
+                else jnp.zeros((0,), jnp.float32)
+            )
+            return new_state, header, (q_packed, sc_packed, res_packed)
         ps_flat = [jnp.reshape(g, (-1,)).astype(ps_grad_dtype) for g in ps_g]
         if dynamic_loss_scale and ps_flat:
             ps_flat.append(

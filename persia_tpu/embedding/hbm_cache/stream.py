@@ -53,6 +53,7 @@ def run_train_stream(
     wb_flush_steps: int = 8,
     fetch_final: bool = True,
     psgrad_batch: int = 8,
+    dispatch_k: int = 4,
 ) -> Optional[Dict]:
     """Fully-pipelined training over an iterable of ``PersiaBatch``.
 
@@ -99,8 +100,27 @@ def run_train_stream(
     permanently degrade the runtime's dispatch latency (measured ~200×
     on the axon tunnel), so throughput-critical loops should defer every
     fetch past the region they care about.
+
+    ``dispatch_k``: multi-step fused dispatch. Up to ``dispatch_k``
+    consecutive HAZARD-FREE staged steps (no in-flight-eviction restore,
+    no PS-tier forward — exactly the windows where the hazard ledger
+    shows no overlap) are packed and run as ONE jitted K-step program
+    (``ctx._dispatch_packed``), cutting Python dispatch and header
+    traffic by K×. A step that restores from the standing ring, carries a
+    PS-tier forward, or changes shape signature flushes the pack first,
+    so packing NEVER reorders a restore against the eviction write that
+    produced its ring rows, and the write-back FIFO keeps step order.
+    Packing adds NO staleness to cached slots (every packed step still
+    sees its predecessor's updates inside the program); it only defers
+    the per-step header materialization by < K steps. ``on_metrics``
+    forces ``dispatch_k=1`` (it needs a header sync per step). Partial
+    packs (stream tail, or a 50 ms idle wait while the feeder is parked
+    on ring back-pressure) dispatch through the already-compiled
+    single-step path — only exactly-K uniform windows pay a (one-time)
+    K-step compile.
     """
     import queue as _queue
+    import time as _time
 
     if prefetch < 1:
         raise ValueError(f"prefetch must be >= 1, got {prefetch}")
@@ -200,16 +220,28 @@ def run_train_stream(
                 continue
         return False
 
+    # dispatch/feeder accounting for the bench artifact (ctx.stream_stats):
+    # regressions in the hot loop must be visible from the JSON alone
+    stats = {
+        "dispatch_k": max(1, int(dispatch_k)) if on_metrics is None else 1,
+        "packs": 0, "packed_steps": 0, "single_steps": 0,
+        "feeder_busy_s": 0.0, "wall_s": 0.0,
+    }
+    t_start = _time.perf_counter()
+
     def feeder_prep():
-        """Stage 1: host preprocessing + directory admit + PS probe."""
+        """Stage 1: host preprocessing + directory admit (fused with the
+        native hazard-ledger probe) + PS probe."""
         seq = 0
         try:
             for batch in batches:
                 if stop.is_set() or errors:
                     break
+                t_prep = _time.perf_counter()
                 with span("stream.prep"):
                     item = self.tier.prepare_batch(
-                        batch, hazard_gate=gate, ring_alloc=ring_alloc
+                        batch, hazard_gate=gate, ring_alloc=ring_alloc,
+                        pending_map=sign_map,
                     )
                 with span("stream.ps_forward"):
                     ps_item = self._ps_forward(batch)
@@ -232,11 +264,8 @@ def run_train_stream(
                         for gn, (ev, k, ring_pos) in evict_meta.items():
                             if ring_pos < 0:  # unwinding ring_alloc
                                 continue
-                            sign_map.insert(
-                                ev[:k],
-                                ring_pos + np.arange(k, dtype=np.int64),
-                                seq,
-                            )
+                            sign_map.insert_range(ev[:k], ring_pos, seq)
+                stats["feeder_busy_s"] += _time.perf_counter() - t_prep
                 if not _put(prep_q, (seq, item, ps_item)):
                     if ps_item is not None:
                         self.worker.abort_gradient(ps_item[0])
@@ -362,7 +391,10 @@ def run_train_stream(
         pool = self._fetch_pool()
 
         def fetch(it):
-            return np.asarray(it[2])
+            g = it[2]
+            if isinstance(g, tuple):  # int8 wire: (q, scales)
+                return tuple(np.asarray(x) for x in g)
+            return np.asarray(g)
 
         hosts = (
             list(pool.map(fetch, ps_acc)) if pool
@@ -447,56 +479,148 @@ def run_train_stream(
             except Exception:  # noqa: BLE001 — shutdown best-effort
                 pass
 
+    K = stats["dispatch_k"]
+    pack: List = []  # staged hazard-free items awaiting a K-step dispatch
+    pack_sig: List = [None]
+
+    def _post_step(seq, di, evict_meta, evict_payload):
+        """Per-step bookkeeping shared by the single and packed paths."""
+        nonlocal label_shape
+        label_shape = di["labels"][0].shape
+        if evict_meta:
+            # the ring rows were written device-side inside this step's
+            # _apply_aux_ring; the wb thread only needs the per-step
+            # payload array for its bounded d2h fetch
+            wb_q.put((seq, evict_meta, evict_payload))
+        if self.sparse_cfg.kind == OPTIMIZER_ADAM:
+            # mirror the device's beta-power advance on the PS every
+            # gradient batch (same contract as the sync train_step)
+            for grp in self._cached_groups:
+                self.tier.router.advance_batch_state(grp)
+
+    def _dispatch_one(item):
+        nonlocal header
+        (seq, di, layout, miss_aux, cold_aux, restore_aux, evict_aux,
+         evict_meta, ps_item) = item
+        try:
+            if self.state is None:
+                self.init_state(jax.random.PRNGKey(0), di, layout)
+            with span("stream.dispatch"):
+                header, evict_payload, ps_gpacked = self._dispatch(
+                    di, layout, miss_aux, cold_aux, restore_aux,
+                    evict_aux, evict_meta,
+                )
+        except BaseException:
+            # the in-hand item is already off the queue: the shutdown
+            # drain in finally can't see it, so its staleness ref must
+            # be released HERE or it leaks
+            if ps_item is not None:
+                try:
+                    self.worker.abort_gradient(ps_item[0])
+                except Exception:  # noqa: BLE001 — shutdown best-effort
+                    pass
+            raise
+        stats["single_steps"] += 1
+        if ps_item is not None:
+            # gradient return for PS-tier slots rides the write-back
+            # thread (its d2h is off the dispatch path); FIFO order
+            # keeps the worker's per-batch Adam advance in step order
+            wb_q.put(("psgrad", ps_item, ps_gpacked))
+        _post_step(seq, di, evict_meta, evict_payload)
+        if on_metrics is not None:
+            self._last_metrics = self._parse_header(
+                np.asarray(header), label_shape
+            )
+            on_metrics(self._last_metrics)
+
+    def _item_sig(item):
+        """Shape signature of a staged step. Packs are UNIFORM (every
+        member shares one signature) so the K-step jit cache is keyed on
+        a single step's shapes × K — the same cardinality as the
+        single-step cache, not its K-th power."""
+        (_seq, di, layout, miss_aux, cold_aux, _restore, evict_aux,
+         evict_meta, _ps) = item
+
+        def aux_sig(d):
+            return tuple(sorted(
+                (k, tuple(np.shape(x) for x in (v if isinstance(v, tuple) else (v,))))
+                for k, v in d.items()
+            ))
+
+        return (
+            layout,
+            tuple(sorted((k, tuple(np.shape(v))) for k, v in di["stacked_rows"].items())),
+            tuple(np.shape(x) for x in di["labels"]),
+            aux_sig(miss_aux), aux_sig(cold_aux), aux_sig(evict_aux),
+            tuple(sorted((gn, evict_meta[gn][2] >= 0) for gn in evict_meta)),
+        )
+
+    def _packable(item) -> bool:
+        # hazard-free: no in-flight-eviction restore, no PS-tier forward
+        # (its gradient return is per-step), and the state must exist
+        return (
+            self.state is not None
+            and not item[5]          # restore_aux
+            and item[8] is None      # ps_item
+        )
+
+    def _flush_pack_single():
+        """Dispatch buffered items through the single-step path (partial
+        pack, signature change, or shutdown): reuses already-compiled
+        programs and preserves seq order."""
+        for it in pack:
+            _dispatch_one(it)
+        pack.clear()
+
+    def _dispatch_pack():
+        nonlocal header
+        with span("stream.dispatch_pack", k=len(pack)):
+            headers, payloads = self._dispatch_packed(
+                [(it[1], it[2], it[3], it[4], it[6], it[7]) for it in pack]
+            )
+        header = headers[-1]
+        stats["packs"] += 1
+        stats["packed_steps"] += len(pack)
+        for it, payload in zip(pack, payloads):
+            _post_step(it[0], it[1], it[7], payload)
+        pack.clear()
+
     try:
         while True:
-            item = staged_q.get()
+            if pack:
+                # never hold a partial pack while the pipeline idles: the
+                # feeder may be parked on ring back-pressure waiting for
+                # write-backs that only exist once these steps dispatch
+                try:
+                    item = staged_q.get(timeout=0.05)
+                except _queue.Empty:
+                    _flush_pack_single()
+                    continue
+            else:
+                item = staged_q.get()
             if item is SENTINEL:
+                _flush_pack_single()
                 break
             if errors:
+                # buffered pack items carry no PS refs (_packable) — drop
+                pack.clear()
                 _abort_drained(item)
                 break
-            (seq, di, layout, miss_aux, cold_aux, restore_aux, evict_aux,
-             evict_meta, ps_item) = item
-            try:
-                if self.state is None:
-                    self.init_state(jax.random.PRNGKey(0), di, layout)
-                with span("stream.dispatch"):
-                    header, evict_payload, ps_gpacked = self._dispatch(
-                        di, layout, miss_aux, cold_aux, restore_aux,
-                        evict_aux, evict_meta,
-                    )
-            except BaseException:
-                # the in-hand item is already off the queue: the
-                # shutdown drain in finally can't see it, so its
-                # staleness ref must be released HERE or it leaks
-                if ps_item is not None:
-                    try:
-                        self.worker.abort_gradient(ps_item[0])
-                    except Exception:  # noqa: BLE001 — shutdown best-effort
-                        pass
-                raise
-            if ps_item is not None:
-                # gradient return for PS-tier slots rides the write-back
-                # thread (its d2h is off the dispatch path); FIFO order
-                # keeps the worker's per-batch Adam advance in step order
-                wb_q.put(("psgrad", ps_item, ps_gpacked))
-            label_shape = di["labels"][0].shape
-            if evict_meta:
-                # the ring rows were written device-side inside this
-                # step's _apply_aux_ring; the wb thread only needs the
-                # per-step payload array for its bounded d2h fetch
-                wb_q.put((seq, evict_meta, evict_payload))
-            if self.sparse_cfg.kind == OPTIMIZER_ADAM:
-                # mirror the device's beta-power advance on the PS every
-                # gradient batch (same contract as the sync train_step)
-                for grp in self._cached_groups:
-                    self.tier.router.advance_batch_state(grp)
-            if on_metrics is not None:
-                self._last_metrics = self._parse_header(
-                    np.asarray(header), label_shape
-                )
-                on_metrics(self._last_metrics)
+            if K > 1 and _packable(item):
+                sig = _item_sig(item)
+                if pack and sig != pack_sig[0]:
+                    _flush_pack_single()
+                if not pack:
+                    pack_sig[0] = sig
+                pack.append(item)
+                if len(pack) == K:
+                    _dispatch_pack()
+                continue
+            _flush_pack_single()
+            _dispatch_one(item)
     finally:
+        stats["wall_s"] = _time.perf_counter() - t_start
+        self._stream_stats = stats
         stop.set()
         with cv:
             cv.notify_all()

@@ -446,6 +446,7 @@ class CachedEmbeddingTier:
         batch: PersiaBatch,
         hazard_gate: Optional[Callable[[np.ndarray], None]] = None,
         ring_alloc: Optional[Callable[[str, int], int]] = None,
+        pending_map=None,
     ):
         """Admit the batch's distinct signs, check misses out of the PS, and
         build the device step inputs. Returns (device_inputs, layout,
@@ -464,11 +465,17 @@ class CachedEmbeddingTier:
         DEVICE-resident payload array, ``src_idx`` rows within it,
         ``positions`` the resolved indices into ``miss_signs`` — and those
         signs are re-admitted by an on-device row restore instead of a
-        host checkout. A bare ``None`` return means no overlap."""
+        host checkout. A bare ``None`` return means no overlap.
+
+        ``pending_map``: the stream's native hazard ledger
+        (``PendingSignMap``). When given, the single-id fast path fuses the
+        ledger probe INTO the admit call (``cache_feed_batch``) instead of
+        calling ``hazard_gate`` — one native round-trip for dedup + admit +
+        eviction selection + row LUT + hazard probe."""
         fast = self._single_id_groups(batch)
         if fast is not None:
             return self._prepare_batch_single_id(
-                batch, fast, hazard_gate, ring_alloc
+                batch, fast, hazard_gate, ring_alloc, pending_map
             )
         cached_feats = [
             f for f in batch.id_type_features if f.name not in self.ps_slots
@@ -547,12 +554,15 @@ class CachedEmbeddingTier:
         )
 
     def _prepare_batch_single_id(self, batch: PersiaBatch, fast, hazard_gate,
-                                 ring_alloc=None):
+                                 ring_alloc=None, pending_map=None):
         """Single-id fast path: ONE native call per group
-        (``cache_admit_positions``: dedup + admit + per-position rows) and
-        the row matrix is its output reshaped — no per-slot dedup, no row
-        LUT, no stack copy. Dominates the 1-core feeder's budget on the
-        Criteo-style all-single-id shape."""
+        (``cache_feed_batch``: dedup + admit + per-position rows + hazard
+        probe) and the row matrix is its output reshaped — no per-slot
+        dedup, no row LUT, no stack copy, no separate ledger round-trip.
+        Dominates the 1-core feeder's budget on the Criteo-style
+        all-single-id shape. Without a ``pending_map`` (the sync path) the
+        admit is ``cache_admit_positions`` and the gate rides
+        ``hazard_gate`` exactly as before."""
         stacked_rows: Dict[str, np.ndarray] = {}
         layout_stacked: List[Tuple[str, Tuple[str, ...]]] = []
         miss_aux: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
@@ -563,13 +573,24 @@ class CachedEmbeddingTier:
 
         for g, names, mat in fast:
             S, B = mat.shape
-            with span("cache.admit", group=g.name, n=mat.size):
-                (rows, miss_signs, miss_rows, ev_signs, ev_rows,
-                 n_unique) = self.dirs[g.name].admit_positions(mat.reshape(-1))
+            gate = hazard_gate
+            if pending_map is not None:
+                with span("cache.admit", group=g.name, n=mat.size):
+                    (rows, miss_signs, miss_rows, ev_signs, ev_rows, n_unique,
+                     rst_src, rst_pos) = self.dirs[g.name].feed_batch(
+                        mat.reshape(-1), pending_map
+                    )
+                gate = _make_reval_gate(pending_map, rst_pos)
+            else:
+                with span("cache.admit", group=g.name, n=mat.size):
+                    (rows, miss_signs, miss_rows, ev_signs, ev_rows,
+                     n_unique) = self.dirs[g.name].admit_positions(
+                        mat.reshape(-1)
+                    )
             with span("cache.admit_aux", group=g.name, misses=len(miss_signs)):
                 self._admit_aux(
                     g, miss_signs, miss_rows, ev_signs, ev_rows, n_unique,
-                    hazard_gate, miss_aux, cold_aux, restore_aux, evict_aux,
+                    gate, miss_aux, cold_aux, restore_aux, evict_aux,
                     evict_meta, ring_alloc,
                 )
             stacked_rows[g.name] = rows.reshape(S, B, 1)
@@ -710,6 +731,29 @@ class CachedEmbeddingTier:
                 self._write_rows(g, signs, rows, tables, emb_state)
                 total += len(signs)
         return total
+
+
+def _make_reval_gate(pending_map, rst_pos: np.ndarray):
+    """Hazard gate for the fused feed path: the candidates were already
+    found by ``cache_feed_batch``, but that probe ran BEFORE this step's
+    eviction-ring span was reserved — a write-back landing in between can
+    free a referenced span for reuse by this very step. ``_admit_aux``
+    calls the gate AFTER the reservation, so re-querying the (few)
+    candidates here closes the race: entries still live reference spans
+    the allocator cannot have handed out; entries that died have landed in
+    the PS, and dropping them routes those misses through the ordinary
+    warm-probe path."""
+    if not len(rst_pos):
+        return None
+
+    def gate(gname: str, miss_signs: np.ndarray):
+        _hits, _tokens, srcs = pending_map.query(miss_signs[rst_pos])
+        live = srcs >= 0
+        if not live.any():
+            return None
+        return [(None, srcs[live], rst_pos[live])]
+
+    return gate
 
 
 def _position_index(slot: ProcessedSlot, L: int) -> np.ndarray:

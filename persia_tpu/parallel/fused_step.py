@@ -438,6 +438,44 @@ def build_fused_train_step(
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
+def build_fused_multi_step(
+    model,
+    dense_optimizer: optax.GradientTransformation,
+    sparse_cfg: OptimizerConfig,
+    specs: Dict[str, FusedSlotSpec],
+    k: int,
+    slot_order: Optional[Sequence[str]] = None,
+    loss_fn=default_loss_fn,
+    stack: bool = False,
+):
+    """K-step fused dispatch for the all-in-HBM path: ONE jitted program
+    advances ``k`` consecutive batches — ``multi(state, batches) -> (state,
+    (losses, preds_list))`` with ``batches`` a length-``k`` tuple of the
+    single-step batch dict. The per-dispatch Python/header overhead that
+    bounds small-step-time loops (and dominates on a remote-attached chip,
+    where every dispatch pays tunnel latency) is paid once per K steps; the
+    math is the single-step program iterated, so parity with
+    ``build_fused_train_step`` is exact. The cached tier's stream applies
+    the same idea to its hazard-free windows (hbm_cache/stream.py
+    ``dispatch_k``)."""
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    raw = build_fused_train_step(
+        model, dense_optimizer, sparse_cfg, specs, slot_order,
+        loss_fn=loss_fn, jit=False, stack=stack,
+    )
+
+    def multi(state: FusedTrainState, batches):
+        losses, preds = [], []
+        for b in batches:
+            state, (loss, p) = raw(state, b)
+            losses.append(loss)
+            preds.append(p)
+        return state, (jnp.stack(losses), preds)
+
+    return jax.jit(multi, donate_argnums=(0,))
+
+
 def build_fused_eval_step(model, specs, slot_order=None, stack: bool = False):
     slot_order = list(slot_order or sorted(specs))
     groups = group_stacked_specs(specs, slot_order) if stack else None

@@ -185,6 +185,32 @@ def _tree_cast(tree, dtype):
     return jax.tree.map(lambda g: g.astype(dtype), tree)
 
 
+def quantize_int8_ef(g, residual, scale=None):
+    """Absmax int8 quantization with error feedback — the shared core of
+    :func:`bytegrad_allreduce`, :func:`lp_ring_sync`, and the cached tier's
+    int8 ps-gradient-return wire (hbm_cache/step.py).
+
+    ``g`` (f32) is summed with the carried ``residual``, scaled by absmax
+    (or the caller's ``scale``, e.g. a pmax-shared one), rounded to int8,
+    and the rounding error becomes the new residual — what int8 could not
+    represent is re-sent later instead of lost. Returns
+    ``(q int8, scale f32 scalar, dequantized f32, new_residual f32)``.
+    Traceable; use inside jit/shard_map."""
+    v = g.astype(jnp.float32) + residual
+    if scale is None:
+        scale = jnp.maximum(jnp.max(jnp.abs(v)), 1e-30)
+    q = jnp.clip(jnp.round(v / scale * 127.0), -127, 127).astype(jnp.int8)
+    deq = q.astype(jnp.float32) * (scale / 127.0)
+    return q, scale, deq, v - deq
+
+
+def dequantize_int8_np(q: np.ndarray, scale: float) -> np.ndarray:
+    """Host-side inverse of :func:`quantize_int8_ef` for wire consumers
+    (the stream's write-back thread dequantizes fetched int8 grads with
+    numpy, off the device)."""
+    return q.astype(np.float32) * (np.float32(scale) / np.float32(127.0))
+
+
 def allreduce_mean(grads, axis: str, dtype: str = "float32"):
     """Mean over ``axis``; optionally bf16 on the wire. Use inside shard_map."""
     n = jax.lax.psum(1, axis)
@@ -204,12 +230,12 @@ def bytegrad_allreduce(grads, residual, axis: str):
     n = jax.lax.psum(1, axis)
 
     def one(g, r):
-        g = g.astype(jnp.float32) + r
-        scale = jax.lax.pmax(jnp.max(jnp.abs(g)), axis)
+        # pmax-shared scale so every replica's int8 lattice matches
+        scale = jax.lax.pmax(
+            jnp.max(jnp.abs(g.astype(jnp.float32) + r)), axis
+        )
         scale = jnp.maximum(scale, 1e-30)
-        q = jnp.clip(jnp.round(g / scale * 127.0), -127, 127).astype(jnp.int8)
-        deq_local = q.astype(jnp.float32) * (scale / 127.0)
-        new_r = g - deq_local  # what int8 could not represent, re-sent next step
+        q, _, _deq, new_r = quantize_int8_ef(g, r, scale=scale)
         summed = jax.lax.psum(q.astype(jnp.int32), axis)
         mean = summed.astype(jnp.float32) * (scale / 127.0) / n
         return mean, new_r
@@ -238,11 +264,7 @@ def lp_ring_sync(params, shadows, axis: str, n: int):
     bwd = [(i, (i - 1) % n) for i in range(n)]  # receive from ring-right
 
     def one(x, ss, sl, sr, r):
-        delta = x - ss + r
-        scale = jnp.maximum(jnp.max(jnp.abs(delta)), 1e-30)
-        q = jnp.clip(jnp.round(delta / scale * 127.0), -127, 127).astype(jnp.int8)
-        deq = q.astype(jnp.float32) * (scale / 127.0)
-        new_r = delta - deq
+        q, scale, deq, new_r = quantize_int8_ef(x - ss, r)
         new_ss = ss + deq
         ql = jax.lax.ppermute(q, axis, fwd)
         scl = jax.lax.ppermute(scale, axis, fwd)

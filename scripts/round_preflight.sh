@@ -6,6 +6,9 @@
 #
 #   bash scripts/round_preflight.sh
 #
+# 0. native cores compile from source + the fused-feed ABI parity tests
+#    pass (a broken ctypes signature loads fine and silently corrupts —
+#    only the golden parity tests catch it)
 # 1. full test suite green
 # 2. bench.py rc=0 (real chip when attached; emits partial records on a
 #    degraded link rather than failing)
@@ -13,13 +16,25 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/3 test suite =="
+echo "== 0/4 native build + ABI parity smoke =="
+# force=True recompile of every core: the stamp cache must not mask a
+# toolchain or source breakage
+JAX_PLATFORMS=cpu python - <<'PY'
+from persia_tpu.embedding import hbm_cache, native_store, native_worker
+for name, builder in (("ps", native_store.build_native),
+                      ("worker", native_worker.build_native),
+                      ("cache", hbm_cache.build_native)):
+    print(name, builder(force=True))
+PY
+JAX_PLATFORMS=cpu python -m pytest tests/test_native_feed.py -q
+
+echo "== 1/4 test suite =="
 python -m pytest tests/ -q
 
-echo "== 2/3 bench (BENCH_MODE=${BENCH_MODE:-all}) =="
+echo "== 2/4 bench (BENCH_MODE=${BENCH_MODE:-all}) =="
 python bench.py
 
-echo "== 3/3 multichip dryrun =="
+echo "== 3/4 multichip dryrun =="
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun OK')"
 

@@ -1,0 +1,147 @@
+"""Golden parity for the fused native feeder entry point
+(``native/cache.cpp cache_feed_batch``): one call = dedup + admit +
+eviction-row selection + per-position row LUT + write-back hazard-ledger
+probe. The fused path must reproduce the multi-call orchestration
+(``cache_admit_positions`` + a Python-side ``pending_map_query`` scan)
+EXACTLY — same admits, same evictions, same rows, same restore hits — on
+randomized sign streams, or the feeder hot loop silently trains on wrong
+rows. Also pins the native ledger's range-insert and its thread safety
+(the fused probe runs against concurrent write-back removals)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+hbm = pytest.importorskip("persia_tpu.embedding.hbm_cache")
+
+from persia_tpu.embedding.hbm_cache.directory import (  # noqa: E402
+    CacheDirectory,
+    PendingSignMap,
+)
+
+
+def _python_reference_probe(pmap: PendingSignMap, miss_signs: np.ndarray):
+    """The pre-fusion orchestration: a full-width query + nonzero compact."""
+    if not len(miss_signs):
+        return np.empty(0, np.int64), np.empty(0, np.int64)
+    _hits, _tokens, srcs = pmap.query(miss_signs)
+    pos = np.nonzero(srcs >= 0)[0].astype(np.int64)
+    return srcs[pos], pos
+
+
+@pytest.mark.parametrize("seed", [0, 7, 1234])
+def test_feed_batch_matches_python_orchestration(seed):
+    """Randomized sign streams through BOTH paths against independently
+    evolving directories that must stay in lockstep: per-position rows,
+    miss order, eviction victims, unique counts, and ledger hits all
+    identical, step after step."""
+    rng = np.random.default_rng(seed)
+    cap = 256
+    d_fused = CacheDirectory(cap, admit_touches=2)
+    d_ref = CacheDirectory(cap, admit_touches=2)
+    pmap = PendingSignMap()
+    token = 0
+    for step in range(30):
+        n = int(rng.integers(1, 800))
+        signs = rng.integers(0, 250, n, dtype=np.uint64)
+
+        (rows_f, ms_f, mr_f, es_f, er_f, nu_f,
+         rst_src, rst_pos) = d_fused.feed_batch(signs, pmap)
+        rows_f = rows_f.copy()  # ring buffer — copy before the next call
+        rows_r, ms_r, mr_r, es_r, er_r, nu_r = d_ref.admit_positions(signs)
+        ref_src, ref_pos = _python_reference_probe(pmap, ms_r)
+
+        np.testing.assert_array_equal(rows_f, rows_r)
+        np.testing.assert_array_equal(ms_f, ms_r)
+        np.testing.assert_array_equal(mr_f, mr_r)
+        np.testing.assert_array_equal(es_f, es_r)
+        np.testing.assert_array_equal(er_f, er_r)
+        assert nu_f == nu_r
+        np.testing.assert_array_equal(rst_src, ref_src)
+        np.testing.assert_array_equal(rst_pos, ref_pos)
+
+        # evictions enter the ledger as a contiguous ring span (the
+        # stream's insert_range form); some earlier spans get flushed
+        if len(es_f):
+            token += 1
+            pmap.insert_range(es_f, base_src=step * 1024, token=token)
+        if token > 2 and rng.random() < 0.5:
+            # token-conditional remove of a random previous span's signs
+            pmap.remove(es_f[: len(es_f) // 2], token=token)
+
+
+def test_feed_batch_without_ledger_matches_admit_positions():
+    rng = np.random.default_rng(3)
+    d1, d2 = CacheDirectory(128), CacheDirectory(128)
+    for _ in range(5):
+        signs = rng.integers(0, 120, 300, dtype=np.uint64)
+        out_f = d1.feed_batch(signs, None)
+        out_r = d2.admit_positions(signs)
+        for a, b in zip(out_f[:6], out_r):
+            np.testing.assert_array_equal(a, b)
+        assert len(out_f[6]) == 0 and len(out_f[7]) == 0
+
+
+def test_feed_batch_overflow_raises_before_ledger_probe():
+    d = CacheDirectory(4)
+    pmap = PendingSignMap()
+    with pytest.raises(RuntimeError, match="exceeds cache capacity"):
+        d.feed_batch(np.arange(10, dtype=np.uint64), pmap)
+
+
+def test_insert_range_equals_insert_with_arange():
+    a, b = PendingSignMap(), PendingSignMap()
+    signs = np.arange(100, 600, dtype=np.uint64)
+    a.insert(signs, 7000 + np.arange(len(signs), dtype=np.int64), token=9)
+    b.insert_range(signs, base_src=7000, token=9)
+    ha, ta, sa = a.query(signs)
+    hb, tb, sb = b.query(signs)
+    assert ha == hb == len(signs)
+    np.testing.assert_array_equal(sa, sb)
+    np.testing.assert_array_equal(ta, tb)
+
+
+def test_ledger_concurrent_probe_and_remove():
+    """The fused probe runs inside the admit call while the write-back
+    thread removes landed spans — the native mutex must keep every query
+    answer either the live entry or a clean miss, never garbage."""
+    pmap = PendingSignMap()
+    d = CacheDirectory(1 << 14)
+    rng = np.random.default_rng(11)
+    base = np.arange(1, 20001, dtype=np.uint64)
+    pmap.insert_range(base, base_src=0, token=1)
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        try:
+            t = 1
+            while not stop.is_set():
+                t += 1
+                chunk = rng.integers(1, 20001, 512, dtype=np.uint64)
+                pmap.insert_range(chunk, base_src=t * 100, token=t)
+                pmap.remove(chunk[:256], token=t)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    th = threading.Thread(target=churn, daemon=True)
+    th.start()
+    try:
+        for _ in range(40):
+            signs = rng.integers(1, 40001, 4096, dtype=np.uint64)
+            (_rows, ms, _mr, _es, _er, _nu,
+             rst_src, rst_pos) = d.feed_batch(signs, pmap)
+            # every reported hit indexes a real miss and a sane src
+            assert (rst_pos < len(ms)).all()
+            assert (rst_src >= 0).all()
+            # signs that can never be in the ledger must never hit
+            ghost = ms[ms > 20000]
+            if len(ghost):
+                _h, _t, srcs = pmap.query(ghost)
+                assert (srcs == -1).all()
+            d.drain()  # keep the directory from saturating
+    finally:
+        stop.set()
+        th.join(timeout=10)
+    assert not errors
