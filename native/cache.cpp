@@ -709,6 +709,11 @@ void pending_map_remove(void* h, const uint64_t* signs, int64_t n,
 // (few) restore hits against the ledger again after the reservation; a
 // hit that died in between simply rides the ordinary PS-probe path (its
 // write-back has landed, so the PS copy is fresh).
+// `salt` namespaces the ledger keys per cache group (key = sign ^ salt):
+// the map is global to the stream but the gate is per-group, and with
+// feature_index_prefix_bit=0 two groups can carry the same raw sign — an
+// unsalted probe would resolve the OTHER group's in-flight ring rows.
+// Must match the Python side's PendingSignMap salting exactly.
 int64_t cache_feed_batch(void* h, void* pending_h,
                          const uint64_t* signs, int64_t n,
                          int32_t* rows_out,
@@ -716,7 +721,7 @@ int64_t cache_feed_batch(void* h, void* pending_h,
                          uint64_t* evict_signs_out, int64_t* evict_rows_out,
                          int64_t* n_unique_out, int64_t* n_evict_out,
                          int64_t* restore_src_out, int64_t* restore_pos_out,
-                         int64_t* n_restore_out) {
+                         int64_t* n_restore_out, uint64_t salt) {
   *n_restore_out = 0;
   const int64_t n_miss = cache_admit_positions(
       h, signs, n, rows_out, miss_signs_out, miss_rows_out,
@@ -729,10 +734,11 @@ int64_t cache_feed_batch(void* h, void* pending_h,
   const int64_t PF = 16;
   for (int64_t j = 0; j < n_miss; ++j) {
     if (j + PF < n_miss)
-      __builtin_prefetch(&m.t[splitmix64(miss_signs_out[j + PF]) & m.mask]);
+      __builtin_prefetch(
+          &m.t[splitmix64(miss_signs_out[j + PF] ^ salt) & m.mask]);
     int64_t src;
     uint32_t token;
-    if (m.find(miss_signs_out[j], &src, &token)) {
+    if (m.find(miss_signs_out[j] ^ salt, &src, &token)) {
       restore_src_out[n_restore] = src;
       restore_pos_out[n_restore] = j;
       ++n_restore;

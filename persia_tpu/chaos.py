@@ -1,0 +1,434 @@
+"""Deterministic chaos / fault injection for the training-side service plane.
+
+Nothing in a resilience stack is real until a fault can be injected on
+demand and the recovery asserted. This module provides the two fault
+surfaces the training plane has:
+
+- **transport faults** via :class:`ChaosProxy` — a frame-aware TCP proxy
+  slotted between an RPC client and a real server. Per forwarded frame it
+  can, driven by a SEEDED RNG (same seed → same fault sequence per
+  connection): refuse new connections, cut the stream mid-frame
+  (``reset``), delay delivery (``slow``), flip a payload byte
+  (``corrupt`` — detected end-to-end when the RPC layer's negotiated
+  crc32 trailer is on, ``PERSIA_RPC_CRC=1``), or truncate a frame and
+  close. A ``blackhole`` switch emulates a network partition (every new
+  and existing connection dies) independent of process liveness.
+
+- **process faults** via :class:`ChaosPlane` — wraps a
+  :class:`~persia_tpu.helper.ServiceCtx` local topology: every PS replica
+  gets a proxy, and a scripted :class:`ChaosSchedule` (fired from the
+  training loop through ``on_step``/``wrap_batches``) can SIGKILL a PS
+  shard, restart it (optionally replaying the last snapshot through
+  ``dump_shard``/``load_shard_bytes``), or open/heal a partition at a
+  chosen step — the same schedule file shape ``bench.py --chaos`` takes
+  for soak runs.
+
+Everything is usable both from tests (tests/test_chaos.py) and from
+``bench.py --chaos``.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import struct
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from persia_tpu.logger import get_default_logger
+from persia_tpu.metrics import get_metrics
+
+logger = get_default_logger("persia_tpu.chaos")
+
+
+@dataclass
+class ChaosConfig:
+    """Per-frame fault probabilities (all default 0 = transparent proxy).
+
+    ``seed`` drives every decision: per accepted connection the proxy
+    derives ``Random((seed, conn_id))`` and draws once per forwarded
+    frame, so a schedule replays identically run to run (connection
+    ARRIVAL order is the only nondeterminism left, and each connection's
+    own fault stream is fixed)."""
+
+    seed: int = 0
+    refuse_prob: float = 0.0    # close a brand-new connection at accept
+    reset_prob: float = 0.0     # cut the stream mid-frame
+    slow_prob: float = 0.0      # delay a frame by slow_ms
+    slow_ms: float = 50.0
+    corrupt_prob: float = 0.0   # flip one byte inside the frame body
+    truncate_prob: float = 0.0  # ship a partial frame, then close
+
+    def to_dict(self) -> Dict:
+        return asdict(self)
+
+
+def parse_chaos_spec(spec: str) -> ChaosConfig:
+    """Parse a ``bench.py --chaos`` spec string like
+    ``"seed=7,reset=0.02,slow=0.01,slow_ms=40,corrupt=0.005"``.
+    Keys: seed, refuse, reset, slow, slow_ms, corrupt, truncate."""
+    cfg = ChaosConfig()
+    if not spec:
+        return cfg
+    alias = {
+        "refuse": "refuse_prob", "reset": "reset_prob", "slow": "slow_prob",
+        "corrupt": "corrupt_prob", "truncate": "truncate_prob",
+        "seed": "seed", "slow_ms": "slow_ms",
+    }
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        attr = alias.get(key.strip())
+        if attr is None:
+            raise ValueError(f"unknown chaos knob {key!r} in {spec!r}")
+        setattr(cfg, attr, int(val) if attr == "seed" else float(val))
+    return cfg
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    while n:
+        try:
+            chunk = sock.recv(min(n, 1 << 20))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+class ChaosProxy:
+    """Frame-aware TCP proxy injecting transport faults.
+
+    Understands the RPC framing (``u32 length | body`` in BOTH
+    directions), so faults land on frame boundaries the way real network
+    damage presents to the framing layer: a ``reset`` delivers a partial
+    frame then EOF, a ``corrupt`` flips a byte inside the body (never the
+    length prefix — the point is payload damage the framing alone cannot
+    see), a ``truncate`` ships a prefix and closes.
+    """
+
+    def __init__(self, backend_addr: str, cfg: Optional[ChaosConfig] = None,
+                 name: str = ""):
+        host, port = backend_addr.rsplit(":", 1)
+        self.backend = (host, int(port))
+        self.cfg = cfg or ChaosConfig()
+        self.name = name or backend_addr
+        self.blackhole = threading.Event()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        self.addr = f"127.0.0.1:{self.port}"
+        self._stop = threading.Event()
+        self._conn_id = 0
+        self._live_socks: List[socket.socket] = []
+        self._lock = threading.Lock()
+        # injected-fault accounting (tests assert the schedule actually
+        # fired; bench records it in the artifact)
+        self.counts: Dict[str, int] = {
+            "frames": 0, "refused": 0, "reset": 0, "slow": 0,
+            "corrupt": 0, "truncated": 0,
+        }
+        m = get_metrics()
+        self._m_injected = m.counter(
+            "persia_tpu_chaos_faults_injected", "faults injected by ChaosProxy"
+        )
+        self._accept_t = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"chaos-accept-{self.name}",
+        )
+        self._accept_t.start()
+
+    # ----------------------------------------------------------- lifecycle
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._kill_live()
+
+    def _kill_live(self) -> None:
+        # shutdown (not close) wakes pump threads blocked in recv without
+        # freeing the fd under them (close here would race a concurrent
+        # recv with fd reuse — observed as 5 s client hangs); each pump
+        # closes its own read-side socket on exit
+        with self._lock:
+            socks, self._live_socks = self._live_socks, []
+        for s in socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    def set_blackhole(self, on: bool) -> None:
+        """Partition emulation: while on, new connections are refused and
+        every existing one is cut."""
+        if on:
+            self.blackhole.set()
+            self._kill_live()
+        else:
+            self.blackhole.clear()
+
+    # ------------------------------------------------------------- pumping
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return
+            with self._lock:
+                self._conn_id += 1
+                cid = self._conn_id
+            # int-mixed seeds (tuple seeding is deprecated): one stream
+            # per connection per direction, stable across runs
+            rng = random.Random(self.cfg.seed * 1_000_003 + cid * 2)
+            if self.blackhole.is_set() or (
+                self.cfg.refuse_prob and rng.random() < self.cfg.refuse_prob
+            ):
+                self.counts["refused"] += 1
+                self._m_injected.inc(kind="refused")
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                continue
+            try:
+                upstream = socket.create_connection(self.backend, timeout=10)
+            except OSError:
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                continue
+            for s in (client, upstream):
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._live_socks += [client, upstream]
+            # each direction gets its own deterministic fault stream
+            threading.Thread(
+                target=self._pump, args=(client, upstream, rng),
+                daemon=True, name=f"chaos-c2s-{self.name}-{cid}",
+            ).start()
+            threading.Thread(
+                target=self._pump,
+                args=(upstream, client,
+                      random.Random(self.cfg.seed * 1_000_003 + cid * 2 + 1)),
+                daemon=True, name=f"chaos-s2c-{self.name}-{cid}",
+            ).start()
+
+    def _close_pair(self, a: socket.socket, b: socket.socket) -> None:
+        """Terminate a proxied connection: SHUTDOWN both sockets — this
+        sends FIN to both peers immediately AND wakes the sibling pump
+        thread blocked in recv — but do NOT close fds here: the sibling
+        may still be inside recv() on one of them, and closing would free
+        the fd under it (fd-reuse hands it someone else's bytes). Each
+        pump closes its own read-side socket when it exits."""
+        for s in (a, b):
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    def _pump(self, src: socket.socket, dst: socket.socket,
+              rng: random.Random) -> None:
+        try:
+            self._pump_loop(src, dst, rng)
+        finally:
+            # this thread is the only reader of ``src`` — safe to close it
+            # now that our recv loop has exited
+            try:
+                src.close()
+            except OSError:
+                pass
+
+    def _pump_loop(self, src: socket.socket, dst: socket.socket,
+                   rng: random.Random) -> None:
+        cfg = self.cfg
+        while not self._stop.is_set():
+            header = _recv_exact(src, 4)
+            if header is None:
+                self._close_pair(src, dst)
+                return
+            (total,) = struct.unpack("<I", header)
+            frame = _recv_exact(src, total) if total else b""
+            if frame is None:
+                self._close_pair(src, dst)
+                return
+            self.counts["frames"] += 1
+            if self.blackhole.is_set():
+                self._close_pair(src, dst)
+                return
+            try:
+                r = rng.random()
+                if cfg.reset_prob and r < cfg.reset_prob:
+                    # mid-frame cut: the peer sees a partial frame + EOF
+                    self.counts["reset"] += 1
+                    self._m_injected.inc(kind="reset")
+                    dst.sendall(header + frame[: len(frame) // 2])
+                    self._close_pair(src, dst)
+                    return
+                if cfg.truncate_prob and r < cfg.reset_prob + cfg.truncate_prob:
+                    self.counts["truncated"] += 1
+                    self._m_injected.inc(kind="truncated")
+                    dst.sendall(header + frame[: max(len(frame) - 3, 0)])
+                    self._close_pair(src, dst)
+                    return
+                if cfg.slow_prob and rng.random() < cfg.slow_prob:
+                    self.counts["slow"] += 1
+                    self._m_injected.inc(kind="slow")
+                    time.sleep(cfg.slow_ms / 1e3)
+                if (
+                    cfg.corrupt_prob and len(frame) > 1
+                    and rng.random() < cfg.corrupt_prob
+                ):
+                    # flip one byte INSIDE the body (never byte 0: damaging
+                    # the flags/status byte changes protocol dispatch rather
+                    # than payload content, which is a different fault class)
+                    self.counts["corrupt"] += 1
+                    self._m_injected.inc(kind="corrupt")
+                    pos = 1 + rng.randrange(len(frame) - 1)
+                    frame = bytearray(frame)
+                    frame[pos] ^= 0xFF
+                    frame = bytes(frame)
+                dst.sendall(header + frame)
+            except OSError:
+                self._close_pair(src, dst)
+                return
+
+
+# --------------------------------------------------------------- schedules
+
+
+@dataclass
+class ChaosAction:
+    """One scripted process/topology fault, fired when the driving loop
+    reaches ``step``. ``op``: ``kill_ps`` | ``restart_ps`` |
+    ``kill_restart_ps`` (kill + immediate same-port restart) |
+    ``blackhole`` / ``heal`` (partition one shard's proxy) |
+    ``snapshot`` (record the shard's state for a later replaying
+    restart).
+
+    ``after_s > 0`` executes the op in a BACKGROUND thread after the
+    delay — the idiom for a real outage window: fire ``kill_ps`` inline
+    at step N and a delayed ``restart_ps`` in the same step, so the
+    training loop keeps issuing (and failing, and breaker-tripping)
+    lookups while the shard is genuinely gone."""
+
+    step: int
+    op: str
+    idx: int = 0
+    restore: bool = False  # restart replays the last snapshot
+    after_s: float = 0.0   # 0 = synchronous at fire time
+    fired: bool = False
+
+
+class ChaosPlane:
+    """Chaos harness over a :class:`~persia_tpu.helper.ServiceCtx`.
+
+    Every PS replica is fronted by a :class:`ChaosProxy`; ``ps_clients``
+    hands back StoreClients wired through the proxies, so transport
+    faults hit the same code paths production traffic uses. Process
+    faults run from a scripted schedule driven by the training loop
+    (``on_step`` / ``wrap_batches``) — deterministic by construction.
+    """
+
+    def __init__(
+        self,
+        svc,
+        cfg: Optional[ChaosConfig] = None,
+        schedule: Optional[Sequence[ChaosAction]] = None,
+    ):
+        self.svc = svc
+        self.cfg = cfg or ChaosConfig()
+        self.schedule: List[ChaosAction] = sorted(
+            (schedule or []), key=lambda a: a.step
+        )
+        self.proxies: List[ChaosProxy] = [
+            ChaosProxy(addr, self.cfg, name=f"ps{i}")
+            for i, addr in enumerate(svc.ps_addrs())
+        ]
+        self._step = -1
+
+    def ps_addrs(self) -> List[str]:
+        return [p.addr for p in self.proxies]
+
+    def ps_clients(self, **kwargs) -> List:
+        from persia_tpu.service.clients import StoreClient
+
+        return [StoreClient(p.addr, **kwargs) for p in self.proxies]
+
+    def fault_counts(self) -> Dict[str, int]:
+        total: Dict[str, int] = {}
+        for p in self.proxies:
+            for k, v in p.counts.items():
+                total[k] = total.get(k, 0) + v
+        return total
+
+    # ------------------------------------------------------------ schedule
+
+    def on_step(self, step: int) -> None:
+        """Fire every not-yet-fired action with ``action.step <= step``."""
+        self._step = step
+        for a in self.schedule:
+            if a.fired or a.step > step:
+                continue
+            a.fired = True
+            logger.info(
+                "chaos: firing %s(idx=%d) at step %d%s", a.op, a.idx, step,
+                f" after {a.after_s}s" if a.after_s else "",
+            )
+            if a.after_s > 0:
+                threading.Thread(
+                    target=self._fire_delayed, args=(a,), daemon=True,
+                    name=f"chaos-delayed-{a.op}",
+                ).start()
+            else:
+                self._execute(a)
+
+    def _fire_delayed(self, a: ChaosAction) -> None:
+        time.sleep(a.after_s)
+        try:
+            self._execute(a)
+        except Exception:  # noqa: BLE001 — must not die silently
+            logger.exception("chaos: delayed %s(idx=%d) failed", a.op, a.idx)
+
+    def _execute(self, a: ChaosAction) -> None:
+        if a.op == "snapshot":
+            self.svc.snapshot_ps(a.idx)
+        elif a.op == "kill_ps":
+            self.svc.kill_ps(a.idx)
+        elif a.op == "restart_ps":
+            self.svc.restart_ps(a.idx, restore=a.restore)
+        elif a.op == "kill_restart_ps":
+            if a.restore:
+                self.svc.snapshot_ps(a.idx)
+            self.svc.kill_ps(a.idx)
+            self.svc.restart_ps(a.idx, restore=a.restore)
+        elif a.op == "blackhole":
+            self.proxies[a.idx].set_blackhole(True)
+        elif a.op == "heal":
+            self.proxies[a.idx].set_blackhole(False)
+        else:
+            raise ValueError(f"unknown chaos op {a.op!r}")
+
+    def wrap_batches(self, batches):
+        """Drive the schedule from a batch stream: yields each batch after
+        firing the actions scheduled for its ordinal."""
+        for i, b in enumerate(batches):
+            self.on_step(i)
+            yield b
+
+    def stop(self) -> None:
+        for p in self.proxies:
+            p.stop()

@@ -242,12 +242,17 @@ class TrainCtx(EmbeddingCtx):
         loss_scale_init: float = float(2 ** 15),
         loss_scale_growth_interval: int = 2000,
         loss_scale_max: float = float(2 ** 24),
+        resilience_policy=None,
     ):
         super().__init__(worker, embedding_config, mesh=mesh, wire_dtype=wire_dtype)
         self.model = model
         self.dense_optimizer = dense_optimizer
         self.embedding_optimizer = embedding_optimizer
         self.grad_scale = grad_scale
+        # shared service/resilience.py policy: the DataLoader picks it up
+        # for its recovery backoff + per-batch deadline budget, so trainer-
+        # side retry behavior is configured in ONE place
+        self.resilience_policy = resilience_policy
         # (device header, batch) of the latest fetch_metrics=False prepared
         # step — materialized by last_prepared_metrics()
         self._deferred_header = None

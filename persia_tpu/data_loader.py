@@ -23,6 +23,7 @@ from __future__ import annotations
 import heapq
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
 
@@ -274,6 +275,14 @@ class DataLoader:
         self.buffer_size = buffer_size
         self.timeout_s = timeout_s
         self.recovery_retries = recovery_retries
+        # shared resilience policy: the ctx may carry one (TrainCtx's
+        # resilience_policy), else the process default — backoff delays and
+        # breaker state are then consistent with the RPC clients'
+        from persia_tpu.service.resilience import default_policy
+
+        self._policy = (
+            getattr(ctx, "resilience_policy", None) or default_policy()
+        )
         self.staleness_sem = (
             _OrderedSemaphore(staleness)
             if reproducible
@@ -401,6 +410,8 @@ class DataLoader:
         at embedding worker ``widx`` — so the first attempt skips the
         re-send; a lost ref (expired/worker restart) falls back to
         re-submitting the ids carried in the batch."""
+        from persia_tpu.service.resilience import Deadline
+
         remote = getattr(batch, "remote_ref", None)
         widx = remote[0] if remote else 0
         if widx >= len(self.emb_workers):
@@ -410,6 +421,10 @@ class DataLoader:
                 f"emb_workers= matching the DataflowSender's worker list"
             )
         worker = self.emb_workers[widx]
+        # the whole batch's recovery (all attempts + serving waits + backoff
+        # sleeps) runs under ONE deadline budget, so a wedged tier bounds
+        # this worker's stall at timeout_s instead of retries x timeout_s
+        deadline = Deadline.after(self.timeout_s)
         last: Optional[BaseException] = None
         for attempt in range(self.recovery_retries + 1):
             ref: Optional[int] = None
@@ -423,7 +438,8 @@ class DataLoader:
             except BaseException as e:  # noqa: BLE001
                 lost_ref = "ForwardIdNotFound" in repr(e)
                 if (not (_is_rpc_error(e) or lost_ref)
-                        or attempt == self.recovery_retries):
+                        or attempt == self.recovery_retries
+                        or deadline.expired):
                     raise
                 if ref is not None and not lost_ref:
                     # a lost forward_batch_id REPLY may have succeeded
@@ -441,7 +457,15 @@ class DataLoader:
                     self.recovery_retries,
                 )
                 if not lost_ref:
-                    wait_for_serving(worker, timeout_s=self.timeout_s)
+                    wait_for_serving(
+                        worker, timeout_s=max(deadline.remaining(), 0.1)
+                    )
+                # shared backoff policy (service/resilience.py): jittered
+                # delay between recovery attempts, capped by the budget
+                time.sleep(min(
+                    self._policy.backoff(attempt),
+                    max(deadline.remaining(), 0.0),
+                ))
         raise RuntimeError("unreachable") from last
 
     # ------------------------------------------------------------- consumer

@@ -106,7 +106,7 @@ def _load_lib() -> ctypes.CDLL:
         lib.cache_feed_batch.argtypes = [
             p, p, _u64p, i64, _i32p, _u64p, _i64p, _u64p, _i64p,
             ctypes.POINTER(i64), ctypes.POINTER(i64),
-            _i64p, _i64p, ctypes.POINTER(i64),
+            _i64p, _i64p, ctypes.POINTER(i64), ctypes.c_uint64,
         ]
         _LIB = lib
     return _LIB
@@ -317,7 +317,10 @@ class CacheDirectory:
             ev_signs[:k].copy(), ev_rows[:k].copy(), n_unique.value,
         )
 
-    def feed_batch(self, signs: np.ndarray, pending_map: "PendingSignMap | None"):
+    def feed_batch(
+        self, signs: np.ndarray, pending_map: "PendingSignMap | None",
+        salt: int = 0,
+    ):
         """The feeder hot-loop fused call (``native/cache.cpp``
         ``cache_feed_batch``): everything ``admit_positions`` does PLUS the
         write-back hazard-ledger probe of the resulting misses, in ONE
@@ -327,7 +330,11 @@ class CacheDirectory:
         riding an un-landed eviction write-back. The probe runs before the
         caller's ring-span reservation, so restore hits must be
         REVALIDATED against the map after reserving (see the C comment);
-        a hit that died in between is safe to route through the PS."""
+        a hit that died in between is safe to route through the PS.
+
+        ``salt`` namespaces the ledger probe per cache group (the native
+        side applies the SAME ``sign ^ salt`` the Python map methods do —
+        see :func:`group_salt`)."""
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
         n = signs.size
         self._ensure_scratch(n)
@@ -347,7 +354,7 @@ class CacheDirectory:
             ctypes.byref(n_unique), ctypes.byref(n_evict),
             self._s_rst_src.ctypes.data_as(_i64p),
             self._s_rst_pos.ctypes.data_as(_i64p),
-            ctypes.byref(n_restore),
+            ctypes.byref(n_restore), ctypes.c_uint64(salt & (2**64 - 1)),
         )
         if n_miss < 0:
             raise RuntimeError(
@@ -397,13 +404,36 @@ class CacheDirectory:
 # ------------------------------------------------------------ device state
 
 
+def group_salt(name: str) -> int:
+    """64-bit namespace salt for a cache group's pending-ledger keys.
+
+    The ``PendingSignMap`` is GLOBAL to the stream but its entries are
+    per-group ring rows, while the gate runs per group — with
+    ``feature_index_prefix_bit=0`` two groups can carry the SAME raw sign,
+    and an unsalted probe in group B would resolve group A's in-flight
+    eviction (restoring A's ring rows into B's cache: silent corruption;
+    round-5 advisor finding). Both the Python map methods and the native
+    fused probe (``cache_feed_batch``) key on ``sign ^ group_salt(name)``,
+    so the namespaces cannot collide. Deterministic by group name."""
+    import hashlib
+
+    h = hashlib.blake2b(name.encode(), digest_size=8).digest()
+    return int.from_bytes(h, "little") or 1
+
+
 class PendingSignMap:
     """Native sign → (token, src) map for the stream's write-back hazard
     gate (`native/cache.cpp` pending_map_*): one query call per step
     replaces a per-pending-record searchsorted scan. Internally
     mutex-protected, so the fused feeder probe (``cache_feed_batch``) and
     the write-back thread's removals need no shared Python lock; the
-    stream's condvar still orders removals against ring-tail advances."""
+    stream's condvar still orders removals against ring-tail advances.
+
+    ``salt`` (see :func:`group_salt`) namespaces keys per cache group:
+    every method XORs it into the signs before they touch the native map,
+    and the fused native probe applies the SAME xor (``cache_feed_batch``'s
+    ``salt`` argument) — the two sides must agree or the fused path would
+    silently probe the wrong namespace."""
 
     def __init__(self):
         self._lib = _load_lib()
@@ -420,8 +450,17 @@ class PendingSignMap:
     def __len__(self) -> int:
         return int(self._lib.pending_map_size(self._h))
 
-    def insert(self, signs: np.ndarray, srcs: np.ndarray, token: int) -> None:
+    @staticmethod
+    def _salted(signs: np.ndarray, salt: int) -> np.ndarray:
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        if salt:
+            signs = signs ^ np.uint64(salt)
+        return signs
+
+    def insert(
+        self, signs: np.ndarray, srcs: np.ndarray, token: int, salt: int = 0
+    ) -> None:
+        signs = self._salted(signs, salt)
         srcs = np.ascontiguousarray(srcs, dtype=np.int64)
         assert len(signs) == len(srcs)
         self._lib.pending_map_insert(
@@ -430,19 +469,21 @@ class PendingSignMap:
             ctypes.c_uint32(token & 0xFFFFFFFF),
         )
 
-    def insert_range(self, signs: np.ndarray, base_src: int, token: int) -> None:
+    def insert_range(
+        self, signs: np.ndarray, base_src: int, token: int, salt: int = 0
+    ) -> None:
         """Insert ``signs[i] -> (base_src + i, token)`` — the contiguous
         ring-span form every eviction record takes, without the host-side
         arange temporary."""
-        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        signs = self._salted(signs, salt)
         self._lib.pending_map_insert_range(
             self._h, signs.ctypes.data_as(_u64p), len(signs),
             int(base_src), ctypes.c_uint32(token & 0xFFFFFFFF),
         )
 
-    def query(self, signs: np.ndarray):
+    def query(self, signs: np.ndarray, salt: int = 0):
         """(hits, tokens (n,) u32, srcs (n,) i64 with -1 = not pending)."""
-        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        signs = self._salted(signs, salt)
         n = len(signs)
         tokens = np.empty(n, dtype=np.uint32)
         srcs = np.empty(n, dtype=np.int64)
@@ -453,8 +494,8 @@ class PendingSignMap:
         )
         return int(hits), tokens, srcs
 
-    def remove(self, signs: np.ndarray, token: int) -> None:
-        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+    def remove(self, signs: np.ndarray, token: int, salt: int = 0) -> None:
+        signs = self._salted(signs, salt)
         self._lib.pending_map_remove(
             self._h, signs.ctypes.data_as(_u64p), len(signs),
             ctypes.c_uint32(token & 0xFFFFFFFF),

@@ -189,8 +189,12 @@ def run_train_stream(
     # sign → (token=seq, ring row) for every in-flight eviction: ONE native
     # query per gate call (native/cache.cpp pending_map_*), ONE restore
     # program per group per step (all hits gather from the standing ring,
-    # regardless of how many producing steps are referenced).
+    # regardless of how many producing steps are referenced). Keys are
+    # namespaced per group (directory.group_salt): with
+    # feature_index_prefix_bit=0 the same raw sign can live in two groups,
+    # and an unsalted probe would restore the OTHER group's ring rows.
     sign_map = PendingSignMap()
+    salts = self.tier._group_salt
 
     def gate(gname: str, miss_signs: np.ndarray):
         """Resolve re-missed pending-evicted signs against the in-flight
@@ -203,7 +207,7 @@ def run_train_stream(
         with cv:
             if stop.is_set() or errors:
                 return None
-            hits, _tokens, srcs = sign_map.query(miss_signs)
+            hits, _tokens, srcs = sign_map.query(miss_signs, salt=salts[gname])
             if not hits:
                 return None
             pos = np.nonzero(srcs >= 0)[0]
@@ -226,8 +230,45 @@ def run_train_stream(
         "dispatch_k": max(1, int(dispatch_k)) if on_metrics is None else 1,
         "packs": 0, "packed_steps": 0, "single_steps": 0,
         "feeder_busy_s": 0.0, "wall_s": 0.0,
+        "degraded_steps": 0, "degraded_lookup_frac_max": 0.0,
     }
     t_start = _time.perf_counter()
+    # per-seq degraded-lookup fraction (written by the feeder BEFORE the
+    # item enters prep_q, popped by the dispatcher — queue ordering is the
+    # happens-before edge); the router's window counters are exclusive to
+    # the feeder thread inside one stream
+    deg_fracs: Dict[int, float] = {}
+    _router = self.tier.router
+    _deg_tracking = (
+        hasattr(_router, "take_degraded_window")
+        and getattr(_router, "policy", None) is not None
+    )
+    _m_step_deg = get_metrics().gauge(
+        "persia_tpu_stream_degraded_lookup_frac",
+        "per-step degraded lookup fraction of the cached stream",
+    )
+
+    def _note_degraded(seq: int) -> None:
+        """Per-step degraded accounting + the configurable abort: a step
+        that had to synthesize more than ``max_degraded_frac`` of its
+        lookups kills the stream instead of silently training on mostly-
+        degraded embeddings."""
+        if not _deg_tracking:
+            return
+        d, t = _router.take_degraded_window()
+        frac = (d / t) if t else 0.0
+        deg_fracs[seq] = frac
+        _m_step_deg.set(frac)
+        if frac > 0.0:
+            stats["degraded_steps"] += 1
+            stats["degraded_lookup_frac_max"] = max(
+                stats["degraded_lookup_frac_max"], frac
+            )
+        if frac > _router.policy.max_degraded_frac:
+            raise RuntimeError(
+                f"step {seq}: degraded_lookup_frac {frac:.3f} exceeds the "
+                f"abort threshold {_router.policy.max_degraded_frac:.3f}"
+            )
 
     def feeder_prep():
         """Stage 1: host preprocessing + directory admit (fused with the
@@ -245,6 +286,14 @@ def run_train_stream(
                     )
                 with span("stream.ps_forward"):
                     ps_item = self._ps_forward(batch)
+                try:
+                    _note_degraded(seq)
+                except BaseException:
+                    # abort threshold tripped with a PS forward in hand:
+                    # release its staleness slot before unwinding
+                    if ps_item is not None:
+                        self.worker.abort_gradient(ps_item[0])
+                    raise
                 if ps_item is not None:
                     _ref, embs, _counts, entries = ps_item
                     di0 = item[0]
@@ -264,7 +313,9 @@ def run_train_stream(
                         for gn, (ev, k, ring_pos) in evict_meta.items():
                             if ring_pos < 0:  # unwinding ring_alloc
                                 continue
-                            sign_map.insert_range(ev[:k], ring_pos, seq)
+                            sign_map.insert_range(
+                                ev[:k], ring_pos, seq, salt=salts[gn]
+                            )
                 stats["feeder_busy_s"] += _time.perf_counter() - t_prep
                 if not _put(prep_q, (seq, item, ps_item)):
                     if ps_item is not None:
@@ -335,6 +386,25 @@ def run_train_stream(
         with span("stream.wb_flush", steps=len(acc)):
             _flush_acc_inner(acc)
 
+    def _release_acc(acc) -> None:
+        """ONE owner for the write-back accumulator's bookkeeping — used by
+        the success path after the rows land AND by every failure path
+        (round-5 finding: the queue-timeout early-flush failure leaked all
+        three): token-conditionally remove the steps' hazard-ledger
+        entries (a later re-evict of the same sign under a newer seq
+        survives an older flush), advance the ring tails so the reserved
+        spans free for reallocation, clear the accumulator, and wake the
+        feeder (which may be parked on ring back-pressure)."""
+        with cv:
+            for seq, evict_meta, _p in acc:
+                for gn, (ev, k, _ring_pos) in evict_meta.items():
+                    sign_map.remove(ev[:k], seq, salt=salts[gn])
+                    q = alloc_q.get(gn)
+                    if q:  # tail advance frees the span for reallocation
+                        tails[gn] = tails.get(gn, 0) + q.pop(0)
+            cv.notify_all()
+        acc.clear()
+
     def _flush_acc_inner(acc) -> None:
         pool = self._fetch_pool()
         fetches = []  # (seq, gname, k, device payload)
@@ -349,17 +419,7 @@ def run_train_stream(
         for (seq, gn, ev, k, _p), host in zip(fetches, hosts):
             g = next(gr for gr in self.tier.groups if gr.name == gn)
             self.tier._set_embedding(ev[:k], host[:k], dim=g.dim)
-        with cv:
-            for seq, evict_meta, _p in acc:
-                # token-conditional: a later re-evict of the same sign
-                # under a newer seq survives this older flush
-                for gn, (ev, k, _ring_pos) in evict_meta.items():
-                    sign_map.remove(ev[:k], seq)
-                    q = alloc_q.get(gn)
-                    if q:  # tail advance frees the span for reallocation
-                        tails[gn] = tails.get(gn, 0) + q.pop(0)
-            cv.notify_all()
-        acc.clear()
+        _release_acc(acc)
 
     PS_BATCH = max(1, psgrad_batch)
 
@@ -428,8 +488,11 @@ def run_train_stream(
                         _flush_acc(acc)
                     except BaseException as e:  # noqa: BLE001
                         errors.append(e)
-                        with cv:
-                            cv.notify_all()
+                        # same cleanup contract as the main-loop failure:
+                        # ledger entries out, ring spans released, acc
+                        # cleared — or the parked feeder deadlocks on
+                        # spans nobody will ever free
+                        _release_acc(acc)
                 continue
             try:
                 if item is SENTINEL:
@@ -448,12 +511,7 @@ def run_train_stream(
             except BaseException as e:  # noqa: BLE001
                 errors.append(e)
                 _abort_ps_refs(ps_acc)
-                with cv:
-                    for seq, evict_meta, _p in acc:
-                        for gn, (ev, k, _ring_pos) in evict_meta.items():
-                            sign_map.remove(ev[:k], seq)
-                    acc.clear()
-                    cv.notify_all()
+                _release_acc(acc)
                 if item is SENTINEL:
                     return
 
@@ -531,6 +589,12 @@ def run_train_stream(
             self._last_metrics = self._parse_header(
                 np.asarray(header), label_shape
             )
+            if _deg_tracking:
+                # per-step degraded fraction rides the metrics dict (the
+                # chaos suite asserts it is reported every step)
+                self._last_metrics["degraded_lookup_frac"] = deg_fracs.pop(
+                    seq, 0.0
+                )
             on_metrics(self._last_metrics)
 
     def _item_sig(item):

@@ -39,6 +39,7 @@ from persia_tpu.embedding.hbm_cache.directory import (  # noqa: F401
     CacheDirectory,
     _BufRing,
     _retain_allocator_pages,
+    group_salt,
     native_init_rows,
     native_uniform_init,
 )
@@ -142,6 +143,18 @@ class CachedEmbeddingTier:
             g.name: CacheDirectory(g.rows, admit_touches=admit_touches)
             for g in self.groups
         }
+        # per-group pending-ledger namespace salts (see directory.group_salt:
+        # with feature_index_prefix_bit=0 raw signs can collide ACROSS
+        # groups, and an unsalted hazard probe would restore the wrong
+        # group's in-flight ring rows)
+        self._group_salt = {g.name: group_salt(g.name) for g in self.groups}
+        # signs whose CURRENT cache row was born from a degraded (shard-
+        # down) lookup: their eviction write-back must be DROPPED — the
+        # row's lineage is a synthetic init vector, and persisting it would
+        # clobber whatever the restored shard actually holds. Cleared when
+        # the sign is next admitted from live PS data.
+        self._deg_lock = threading.Lock()
+        self._degraded_born: set = set()
         # per-step host staging buffers (fresh per step; see _BufRing).
         # Allocator tuning keeps the fresh MB-scale buffers off the mmap
         # path — applied here, not at import, so fused-tier-only processes
@@ -170,6 +183,10 @@ class CachedEmbeddingTier:
         )
         self._m_evict = m.counter(
             "persia_tpu_cache_evict_count", "rows written back to the PS on eviction"
+        )
+        self._m_wb_deg_dropped = m.counter(
+            "persia_tpu_degraded_born_wb_rows_dropped",
+            "cache write-back rows dropped because the row was born from a degraded lookup",
         )
 
     @property
@@ -226,7 +243,28 @@ class CachedEmbeddingTier:
         list(pool.map(chunk, zip(bounds[:-1], bounds[1:])))
         return warm8.view(np.bool_), vals
 
+    def _filter_degraded_born(self, signs: np.ndarray, values: np.ndarray):
+        """Drop write-back rows whose cache lineage is a degraded lookup
+        (never misapply synthetic-init-rooted training onto the restored
+        shard's real rows). Counted; no-op while the set is empty."""
+        with self._deg_lock:
+            if not self._degraded_born:
+                return signs, values
+            reg = np.fromiter(
+                self._degraded_born, dtype=np.uint64,
+                count=len(self._degraded_born),
+            )
+        mask = np.isin(np.asarray(signs, dtype=np.uint64), reg)
+        if not mask.any():
+            return signs, values
+        self._m_wb_deg_dropped.inc(int(mask.sum()))
+        keep = ~mask
+        return signs[keep], values[keep]
+
     def _set_embedding(self, signs: np.ndarray, values: np.ndarray, dim: int) -> None:
+        signs, values = self._filter_degraded_born(signs, values)
+        if not len(signs):
+            return
         n = len(signs)
         if n <= self._PAR_CHUNK:
             self.router.set_embedding(
@@ -349,6 +387,32 @@ class CachedEmbeddingTier:
                 warm, vals = self._probe(miss_signs, g.dim)
             widx = np.nonzero(warm[:m] & ~handled)[0]
             cidx = np.nonzero(~warm[:m] & ~handled)[0]
+            # degraded-lineage bookkeeping: misses served while their
+            # shard was down (router recorded them) birth rows whose
+            # write-back must be dropped; every OTHER admit is live PS
+            # data and clears an earlier degraded mark for its sign
+            if hasattr(self.router, "degraded_intersection"):
+                with self._deg_lock:
+                    had_degraded = bool(self._degraded_born)
+                deg = (
+                    self.router.degraded_intersection(miss_signs[:m])
+                    if getattr(self.router, "policy", None) is not None
+                    else None
+                )
+                if deg is not None and deg.any():
+                    with self._deg_lock:
+                        self._degraded_born.update(
+                            int(s) for s in miss_signs[:m][deg]
+                        )
+                if had_degraded:
+                    clean = (
+                        miss_signs[:m][~deg] if deg is not None and deg.any()
+                        else miss_signs[:m]
+                    )
+                    with self._deg_lock:
+                        self._degraded_born.difference_update(
+                            int(s) for s in clean
+                        )
             # aux buffers come from the reuse ring and escape to the async
             # staging path; pad regions carry garbage values on purpose —
             # pad rows are C+1, which the scatters drop
@@ -575,12 +639,13 @@ class CachedEmbeddingTier:
             S, B = mat.shape
             gate = hazard_gate
             if pending_map is not None:
+                salt = self._group_salt[g.name]
                 with span("cache.admit", group=g.name, n=mat.size):
                     (rows, miss_signs, miss_rows, ev_signs, ev_rows, n_unique,
                      rst_src, rst_pos) = self.dirs[g.name].feed_batch(
-                        mat.reshape(-1), pending_map
+                        mat.reshape(-1), pending_map, salt=salt
                     )
-                gate = _make_reval_gate(pending_map, rst_pos)
+                gate = _make_reval_gate(pending_map, rst_pos, salt)
             else:
                 with span("cache.admit", group=g.name, n=mat.size):
                     (rows, miss_signs, miss_rows, ev_signs, ev_rows,
@@ -733,7 +798,7 @@ class CachedEmbeddingTier:
         return total
 
 
-def _make_reval_gate(pending_map, rst_pos: np.ndarray):
+def _make_reval_gate(pending_map, rst_pos: np.ndarray, salt: int = 0):
     """Hazard gate for the fused feed path: the candidates were already
     found by ``cache_feed_batch``, but that probe ran BEFORE this step's
     eviction-ring span was reserved — a write-back landing in between can
@@ -742,12 +807,13 @@ def _make_reval_gate(pending_map, rst_pos: np.ndarray):
     candidates here closes the race: entries still live reference spans
     the allocator cannot have handed out; entries that died have landed in
     the PS, and dropping them routes those misses through the ordinary
-    warm-probe path."""
+    warm-probe path. ``salt`` is the group's ledger namespace — it must
+    match the salt the fused probe used."""
     if not len(rst_pos):
         return None
 
     def gate(gname: str, miss_signs: np.ndarray):
-        _hits, _tokens, srcs = pending_map.query(miss_signs[rst_pos])
+        _hits, _tokens, srcs = pending_map.query(miss_signs[rst_pos], salt=salt)
         live = srcs >= 0
         if not live.any():
             return None

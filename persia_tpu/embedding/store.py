@@ -172,7 +172,15 @@ class EmbeddingStore:
             shard = self._shard_of(s)
             if train:
                 entry = shard.get_refresh(s)
-                if entry is None or entry[0] != dim or len(entry[1]) != entry_len:
+                # pre-registration tolerance: a boot-restored entry carries
+                # its optimizer state (wider than dim) while this store has
+                # no optimizer registered yet — re-initializing it here
+                # would DESTROY restored rows during the restart window
+                ok = entry is not None and entry[0] == dim and (
+                    len(entry[1]) == entry_len
+                    or (self.optimizer is None and len(entry[1]) >= dim)
+                )
+                if not ok:
                     misses += 1
                     if entry is None and not self._admit(s):
                         continue

@@ -186,7 +186,13 @@ class ShardedLookup:
     448-629). ``replicas`` are store-like objects (in-process stores or RPC
     clients exposing the same methods)."""
 
-    def __init__(self, replicas: Sequence, recover=None):
+    def __init__(
+        self,
+        replicas: Sequence,
+        recover=None,
+        policy=None,
+        degraded_init=None,
+    ):
         if not replicas:
             raise ValueError("need at least one PS replica")
         self.replicas = list(replicas)
@@ -195,6 +201,42 @@ class ShardedLookup:
         # worker rebuilds its PS client pool on RpcError,
         # embedding_worker_service/mod.rs:1320-1333)
         self.recover = recover
+        # --- resilience / graceful degradation (service/resilience.py) ---
+        # ``policy.degrade_after_s`` set => a replica that stays down past
+        # that budget stops stalling the caller: its signs are served
+        # DETERMINISTIC init-vector embeddings (``degraded_init(signs,
+        # dim)``; zeros fallback), every such sign is recorded so its
+        # gradient return is DROPPED (never misapplied to the real row),
+        # and the record is reconciled away when the sign is next served
+        # from a live shard. ``policy is None`` keeps the legacy behavior:
+        # transport failures propagate to the caller.
+        self.policy = policy
+        self.degraded_init = degraded_init
+        self._deg_lock = threading.Lock()
+        self._degraded_signs: set = set()  # served degraded, not yet reconciled
+        self._win_degraded = 0  # windowed counters: take_degraded_window()
+        self._win_total = 0
+        m = get_metrics()
+        self._m_degraded = m.counter(
+            "persia_tpu_degraded_lookup_count",
+            "signs served deterministic init vectors because their PS shard was down",
+        )
+        self._m_deg_grad_dropped = m.counter(
+            "persia_tpu_degraded_grad_rows_dropped",
+            "gradient rows dropped because their sign was served degraded",
+        )
+        self._m_deg_frac = m.gauge(
+            "persia_tpu_degraded_lookup_frac",
+            "degraded fraction of the most recent lookup window",
+        )
+        self._m_down_grad_dropped = m.counter(
+            "persia_tpu_grad_rows_dropped_shard_down",
+            "gradient rows dropped because their PS shard stayed down past the degrade budget",
+        )
+        self._m_down_wb_dropped = m.counter(
+            "persia_tpu_writeback_rows_dropped_shard_down",
+            "eviction write-back rows dropped because their PS shard stayed down",
+        )
         # eager pool (lazy init would race: EmbeddingWorker's slot threads
         # call the router concurrently): sized for replicas x concurrent
         # slot callers — the transport below is the pooled RpcClient
@@ -222,6 +264,145 @@ class ShardedLookup:
                 self.recover(replica)
                 return fn()
             raise
+
+    # ----------------------------------------------- degraded-mode machinery
+
+    def replace_replica(self, idx: int, replica) -> None:
+        """Swap replica ``idx`` for a promoted standby (same sign-partition
+        slot, new transport). In-flight calls on the old handle finish or
+        fail through their own retry path; new calls route to the standby."""
+        self.replicas[idx] = replica
+
+    def _guarded(self, rep, fn, signs_for_fallback, fallback):
+        """One replica call under the resilience policy: transport failures
+        block-retry (riding breaker half-open probes via ``wait_ready``)
+        while the ``degrade_after_s`` budget lasts, then either serve the
+        degraded ``fallback`` (recording the signs) or raise. Returns
+        ``(result, degraded)``."""
+        pol = self.policy
+        if pol is None or pol.degrade_after_s is None:
+            return self._with_recovery(rep, fn), False
+        from persia_tpu.service.rpc import _is_transportish
+
+        t0 = time.monotonic()
+        attempt = 0
+        while True:
+            try:
+                return self._with_recovery(rep, fn), False
+            except Exception as e:  # noqa: BLE001 — classify then decide
+                if not _is_transportish(e):
+                    raise
+                budget_left = pol.degrade_after_s - (time.monotonic() - t0)
+                if budget_left <= 0:
+                    if fallback is None:
+                        raise
+                    break
+                # wait for the shard to answer probes again (ping is
+                # breaker-exempt: its success re-closes the breaker), then
+                # retry the real call; if even the probe times out, back off
+                ready = False
+                try:
+                    if hasattr(rep, "wait_ready"):
+                        rep.wait_ready(
+                            timeout_s=min(max(budget_left, 0.05), 1.0)
+                        )
+                        ready = True
+                except Exception:  # noqa: BLE001 — still down
+                    pass
+                if not ready:
+                    time.sleep(
+                        min(pol.backoff(attempt), max(budget_left, 0.0))
+                    )
+                attempt += 1
+        self._record_degraded(signs_for_fallback)
+        return fallback(), True
+
+    def _record_total(self, n: int) -> None:
+        if self.policy is None:
+            return
+        with self._deg_lock:
+            self._win_total += int(n)
+
+    def _record_degraded(self, signs) -> None:
+        n = len(signs)
+        self._m_degraded.inc(n)
+        with self._deg_lock:
+            self._win_degraded += n
+            self._degraded_signs.update(int(s) for s in signs)
+
+    def _record_served(self, signs) -> None:
+        """Reconcile: a sign served from a LIVE shard again drops out of the
+        degraded record — its next gradient was computed against the real
+        row and may be applied."""
+        with self._deg_lock:
+            if self._degraded_signs:
+                self._degraded_signs.difference_update(
+                    int(s) for s in signs
+                )
+
+    def take_degraded_window(self):
+        """(degraded, total) sign counts since the last take — the stream's
+        per-step ``degraded_lookup_frac`` source. Resets the window."""
+        with self._deg_lock:
+            d, t = self._win_degraded, self._win_total
+            self._win_degraded = self._win_total = 0
+        self._m_deg_frac.set(d / t if t else 0.0)
+        return d, t
+
+    def degraded_intersection(self, signs: np.ndarray) -> np.ndarray:
+        """Boolean mask of ``signs`` currently in the degraded record."""
+        with self._deg_lock:
+            if not self._degraded_signs:
+                return np.zeros(len(signs), dtype=bool)
+            reg = np.fromiter(
+                self._degraded_signs, dtype=np.uint64,
+                count=len(self._degraded_signs),
+            )
+        return np.isin(np.asarray(signs, dtype=np.uint64), reg)
+
+    def _check_abort(self, degraded_n: int, total_n: int) -> None:
+        pol = self.policy
+        if pol is None or not degraded_n or not total_n:
+            return
+        frac = degraded_n / total_n
+        if frac > pol.max_degraded_frac:
+            raise RuntimeError(
+                f"degraded_lookup_frac {frac:.3f} exceeds the abort "
+                f"threshold {pol.max_degraded_frac:.3f} — refusing to train "
+                "on mostly-synthetic embeddings (raise max_degraded_frac or "
+                "restore the PS tier)"
+            )
+
+    def _guarded_update(self, rep, fn, n_rows: int, counter=None) -> None:
+        """Apply-side guard: block-retry within the degrade budget, then
+        DROP the rows (counted in a metric) instead of stalling or killing
+        the pipeline — a shard that stayed down past the budget loses
+        those updates either way, and dropping is bounded + measured."""
+        _res, deg = self._guarded(rep, fn, (), lambda: None)
+        if deg:
+            (counter if counter is not None
+             else self._m_down_grad_dropped).inc(n_rows)
+
+    def _degraded_rows(self, signs: np.ndarray, dim: int) -> np.ndarray:
+        """Deterministic stand-in rows for a dead shard's signs: the
+        configured seeded init (what a cold sign would be born with), so
+        the forward stays well-conditioned and reproducible."""
+        if self.degraded_init is not None:
+            return self.degraded_init(signs, dim)
+        return np.zeros((len(signs), dim), dtype=np.float32)
+
+    def _filter_degraded_updates(self, keys: np.ndarray, *arrays):
+        """Drop gradient rows whose sign is in the degraded record — their
+        forward used a synthetic embedding, so applying the gradient to the
+        real row would be a misapplication, not training."""
+        if self.policy is None:
+            return (keys, *arrays)
+        mask = self.degraded_intersection(keys)
+        if not mask.any():
+            return (keys, *arrays)
+        self._m_deg_grad_dropped.inc(int(mask.sum()))
+        keep = ~mask
+        return (keys[keep], *(a[keep] for a in arrays))
 
     def _concurrent(self, thunks):
         """Run per-replica thunks CONCURRENTLY and return their results in
@@ -293,18 +474,39 @@ class ShardedLookup:
         key_ofs = np.zeros(len(groups) + 1, dtype=np.int64)
         np.cumsum([len(k) for k, _ in groups], out=key_ofs[1:])
         n = len(self.replicas)
+        self._record_total(int(key_ofs[-1]))
         if n == 1:
             r0 = self.replicas[0]
             if hasattr(r0, "lookup_batched"):
                 all_keys = np.concatenate([k for k, _ in groups]) if len(groups) > 1 \
                     else np.asarray(groups[0][0])
-                flat = self._with_recovery(
-                    r0, lambda: r0.lookup_batched(all_keys, key_ofs, dims, train)
+
+                def fb():
+                    parts = [
+                        self._degraded_rows(
+                            all_keys[key_ofs[g]:key_ofs[g + 1]], int(dims[g])
+                        ).reshape(-1)
+                        for g in range(len(dims))
+                    ]
+                    return (
+                        np.concatenate(parts) if parts
+                        else np.empty(0, np.float32)
+                    )
+
+                flat, deg = self._guarded(
+                    r0,
+                    lambda: r0.lookup_batched(all_keys, key_ofs, dims, train),
+                    all_keys, fb,
                 )
+                if deg:
+                    self._check_abort(len(all_keys), len(all_keys))
+                else:
+                    self._record_served(all_keys)
                 return _split_flat_rows(flat, key_ofs, dims)
             return self._concurrent_groups([
-                (lambda k=k, d=d: self._with_recovery(
-                    r0, lambda: r0.lookup(k, d, train)))
+                (lambda k=k, d=d: self._guarded(
+                    r0, lambda: r0.lookup(k, d, train), k,
+                    lambda k=k, d=d: self._degraded_rows(k, d))[0])
                 for k, d in groups
             ])
         all_keys = np.concatenate([k for k, _ in groups])
@@ -316,35 +518,51 @@ class ShardedLookup:
         def one_replica(rep, pos):
             sub_keys = all_keys[pos]
             sub_ofs = np.searchsorted(pos, key_ofs).astype(np.int64)
-            if hasattr(rep, "lookup_batched"):
-                flat = self._with_recovery(
-                    rep, lambda: rep.lookup_batched(sub_keys, sub_ofs, dims, train)
-                )
-                return sub_ofs, _split_flat_rows(flat, sub_ofs, dims)
 
-            def one_group(g):
-                if sub_ofs[g] == sub_ofs[g + 1]:  # no rows on this replica
-                    return np.empty((0, int(dims[g])), np.float32)
-                return self._with_recovery(
-                    rep,
-                    lambda: rep.lookup(
-                        sub_keys[sub_ofs[g]:sub_ofs[g + 1]], int(dims[g]), train
-                    ),
+            def live():
+                if hasattr(rep, "lookup_batched"):
+                    flat = rep.lookup_batched(sub_keys, sub_ofs, dims, train)
+                    return _split_flat_rows(flat, sub_ofs, dims)
+
+                def one_group(g):
+                    if sub_ofs[g] == sub_ofs[g + 1]:  # no rows here
+                        return np.empty((0, int(dims[g])), np.float32)
+                    return rep.lookup(
+                        sub_keys[sub_ofs[g]:sub_ofs[g + 1]], int(dims[g]),
+                        train,
+                    )
+
+                return self._concurrent_groups(
+                    [(lambda g=g: one_group(g)) for g in range(len(groups))]
                 )
 
-            return sub_ofs, self._concurrent_groups(
-                [(lambda g=g: one_group(g)) for g in range(len(groups))]
-            )
+            def fb():
+                return [
+                    self._degraded_rows(
+                        sub_keys[sub_ofs[g]:sub_ofs[g + 1]], int(dims[g])
+                    )
+                    for g in range(len(groups))
+                ]
+
+            rows_list, deg = self._guarded(rep, live, sub_keys, fb)
+            if not deg:
+                self._record_served(sub_keys)
+            return sub_ofs, rows_list, (len(sub_keys) if deg else 0)
 
         thunks = [
             (lambda rep=self.replicas[r], pos=pos: one_replica(rep, pos))
             for r, pos in sel
         ]
-        for (r, pos), (sub_ofs, rows_list) in zip(sel, self._concurrent(thunks)):
+        deg_n = 0
+        for (r, pos), (sub_ofs, rows_list, deg_count) in zip(
+            sel, self._concurrent(thunks)
+        ):
+            deg_n += deg_count
             for g, rows in enumerate(rows_list):
                 b, e = sub_ofs[g], sub_ofs[g + 1]
                 if b < e:
                     outs[g][pos[b:e] - key_ofs[g]] = rows
+        self._check_abort(deg_n, len(all_keys))
         return outs
 
     def update_groups(self, groups: Sequence) -> None:
@@ -354,6 +572,15 @@ class ShardedLookup:
         first (batch-level beta powers, optim.rs:99-221)."""
         if not groups:
             return
+        # gradients for signs that were served DEGRADED are dropped here —
+        # their forward used a synthetic embedding, so applying them to the
+        # real (restored) rows would be a misapplication
+        if self.policy is not None:
+            groups = [
+                (k2, g2, og)
+                for (k, g, og) in groups
+                for k2, g2 in (self._filter_degraded_updates(k, g),)
+            ]
         dims = np.fromiter(
             (g.shape[1] for _, g, _ in groups), dtype=np.uint32, count=len(groups)
         )
@@ -370,14 +597,15 @@ class ShardedLookup:
                     if len(groups) > 1 else np.asarray(groups[0][0])
                 flat = np.concatenate([g.reshape(-1) for _, g, _ in groups]) \
                     if len(groups) > 1 else np.asarray(groups[0][1]).reshape(-1)
-                self._with_recovery(
+                self._guarded_update(
                     r0,
                     lambda: r0.update_batched(all_keys, key_ofs, dims, flat, opt_groups),
+                    len(all_keys),
                 )
                 return
             self._concurrent_groups([
-                (lambda k=k, g=g, og=og: self._with_recovery(
-                    r0, lambda: r0.update_gradients(k, g, og)))
+                (lambda k=k, g=g, og=og: self._guarded_update(
+                    r0, lambda: r0.update_gradients(k, g, og), len(k)))
                 for k, g, og in groups
             ])
             return
@@ -398,18 +626,20 @@ class ShardedLookup:
                     np.concatenate([s.reshape(-1) for s in subs])
                     if subs else np.empty(0, np.float32)
                 )
-                self._with_recovery(
+                self._guarded_update(
                     rep,
                     lambda: rep.update_batched(sub_keys, sub_ofs, dims, flat, opt_groups),
+                    len(sub_keys),
                 )
                 return
             self._concurrent_groups([
-                (lambda g=g: self._with_recovery(
+                (lambda g=g: self._guarded_update(
                     rep,
                     lambda: rep.update_gradients(
                         sub_keys[sub_ofs[g]:sub_ofs[g + 1]], subs[g],
                         int(opt_groups[g]),
                     ),
+                    int(sub_ofs[g + 1] - sub_ofs[g]),
                 ))
                 for g in range(len(groups))
                 if sub_ofs[g] < sub_ofs[g + 1]
@@ -422,18 +652,40 @@ class ShardedLookup:
 
     def lookup(self, keys: np.ndarray, dim: int, train: bool) -> np.ndarray:
         n = len(self.replicas)
+        self._record_total(len(keys))
         if n == 1:
             r0 = self.replicas[0]
-            return self._with_recovery(r0, lambda: r0.lookup(keys, dim, train))
+            vals, deg = self._guarded(
+                r0, lambda: r0.lookup(keys, dim, train), keys,
+                lambda: self._degraded_rows(keys, dim),
+            )
+            if deg:
+                self._check_abort(len(keys), len(keys))
+            else:
+                self._record_served(keys)
+            return vals
         out = np.zeros((len(keys), dim), dtype=np.float32)
         sel = self._partition(keys)
+
+        def one(rep, idx):
+            sub = keys[idx]
+            return self._guarded(
+                rep, lambda: rep.lookup(sub, dim, train), sub,
+                lambda: self._degraded_rows(sub, dim),
+            )
+
         thunks = [
-            (lambda rep=self.replicas[r], idx=idx: self._with_recovery(
-                rep, lambda: rep.lookup(keys[idx], dim, train)))
+            (lambda rep=self.replicas[r], idx=idx: one(rep, idx))
             for r, idx in sel
         ]
-        for (r, idx), vals in zip(sel, self._concurrent(thunks)):
+        deg_n = 0
+        for (r, idx), (vals, deg) in zip(sel, self._concurrent(thunks)):
             out[idx] = vals
+            if deg:
+                deg_n += len(vals)
+            else:
+                self._record_served(keys[idx])
+        self._check_abort(deg_n, len(keys))
         return out
 
     def checkout_entries(self, signs: np.ndarray, dim: int) -> np.ndarray:
@@ -441,16 +693,20 @@ class ShardedLookup:
         reaches its owning PS replica (same partition as lookup/update);
         returns (n, dim + state_dim) ``[emb | state]`` rows."""
         n = len(self.replicas)
+        # checkout has no degraded form (it needs the optimizer-state half
+        # of the entry): _guarded without a fallback still rides out a
+        # restart within the degrade budget, then raises
         if n == 1:
             r0 = self.replicas[0]
-            return self._with_recovery(
-                r0, lambda: r0.checkout_entries(signs, dim)
-            )
+            return self._guarded(
+                r0, lambda: r0.checkout_entries(signs, dim), signs, None
+            )[0]
         out: Optional[np.ndarray] = None
         sel = self._partition(signs)
         thunks = [
-            (lambda rep=self.replicas[r], idx=idx: self._with_recovery(
-                rep, lambda: rep.checkout_entries(signs[idx], dim)))
+            (lambda rep=self.replicas[r], idx=idx: self._guarded(
+                rep, lambda: rep.checkout_entries(signs[idx], dim),
+                signs[idx], None)[0])
             for r, idx in sel
         ]
         for (r, idx), vals in zip(sel, self._concurrent(thunks)):
@@ -472,18 +728,43 @@ class ShardedLookup:
         per call); replicas that support direct writes fill them natively,
         others fall back to an extra copy."""
         n = len(self.replicas)
+        self._record_total(len(signs))
         if n == 1:
             r = self.replicas[0]
+
+            def fallback():
+                # degraded probe = "everything cold": the caller's cold
+                # path births deterministic host-seeded rows, so no PS
+                # data is needed — exactly the init-vector degradation
+                nv = len(signs)
+                w = np.zeros(nv, dtype=bool)
+                if warm_out is not None:
+                    warm_out[:nv] = 0
+                if vals_out is not None:
+                    vals_out[:nv] = 0.0
+                    return w, vals_out
+                return w, np.zeros((nv, dim), np.float32)
+
             if getattr(r, "supports_probe_out", False):
-                return self._with_recovery(
+                res, deg = self._guarded(
                     r,
                     lambda: r.probe_entries(
                         signs, dim, vals_out=vals_out, warm_out=warm_out
                     ),
+                    signs, fallback,
                 )
-            warm, vals = self._with_recovery(
-                r, lambda: r.probe_entries(signs, dim)
+                if deg:
+                    self._check_abort(len(signs), len(signs))
+                else:
+                    self._record_served(signs)
+                return res
+            (warm, vals), deg = self._guarded(
+                r, lambda: r.probe_entries(signs, dim), signs, fallback
             )
+            if deg:
+                self._check_abort(len(signs), len(signs))
+                return warm, vals
+            self._record_served(signs)
             if vals_out is not None:
                 vals_out[:len(signs)] = vals
                 vals = vals_out
@@ -500,12 +781,26 @@ class ShardedLookup:
             vals = vals_out
             vals[:len(signs)] = 0.0
         sel = self._partition(signs)
+
+        def one(rep, idx):
+            sub = signs[idx]
+            # degraded marker: (None, None) — the assembly leaves warm
+            # False and vals zeroed for that replica's span (= cold)
+            return self._guarded(
+                rep, lambda: rep.probe_entries(sub, dim), sub,
+                lambda: (None, None),
+            )
+
         thunks = [
-            (lambda rep=self.replicas[r], idx=idx: self._with_recovery(
-                rep, lambda: rep.probe_entries(signs[idx], dim)))
+            (lambda rep=self.replicas[r], idx=idx: one(rep, idx))
             for r, idx in sel
         ]
-        for (r, idx), (w, v) in zip(sel, self._concurrent(thunks)):
+        deg_n = 0
+        for (r, idx), ((w, v), deg) in zip(sel, self._concurrent(thunks)):
+            if deg:
+                deg_n += len(signs[idx])
+                continue
+            self._record_served(signs[idx])
             if vals is None:
                 vals = np.zeros((len(signs), v.shape[1]), np.float32)
             warm[idx] = w
@@ -513,10 +808,11 @@ class ShardedLookup:
         if vals is None:
             vals = (
                 vals_out if vals_out is not None
-                else np.zeros((0, dim), np.float32)
+                else np.zeros((len(signs), dim), np.float32)
             )
         if warm_out is not None:
             warm_out[:len(signs)] = warm
+        self._check_abort(deg_n, len(signs))
         return warm, vals
 
     def set_embedding(
@@ -529,35 +825,54 @@ class ShardedLookup:
         feed the incremental-update manager; loads must not."""
         n = len(self.replicas)
         if n == 1:
-            self.replicas[0].set_embedding(
-                signs, values, dim, commit_incremental=commit_incremental
+            r0 = self.replicas[0]
+            self._guarded_update(
+                r0,
+                lambda: r0.set_embedding(
+                    signs, values, dim, commit_incremental=commit_incremental
+                ),
+                len(signs), counter=self._m_down_wb_dropped,
             )
             return
         self._concurrent([
-            (lambda rep=self.replicas[r], idx=idx: rep.set_embedding(
-                signs[idx], values[idx], dim,
-                commit_incremental=commit_incremental,
+            (lambda rep=self.replicas[r], idx=idx: self._guarded_update(
+                rep,
+                lambda: rep.set_embedding(
+                    signs[idx], values[idx], dim,
+                    commit_incremental=commit_incremental,
+                ),
+                len(signs[idx]), counter=self._m_down_wb_dropped,
             ))
             for r, idx in self._partition(signs)
         ])
 
     def advance_batch_state(self, group: int) -> None:
         self._concurrent([
-            (lambda rep=r: rep.advance_batch_state(group)) for r in self.replicas
+            (lambda rep=r: self._guarded_update(
+                rep, lambda rep=rep: rep.advance_batch_state(group), 0))
+            for r in self.replicas
         ])
 
     def update(self, keys: np.ndarray, grads: np.ndarray, group: int) -> None:
         """Fan one slot's keyed gradients out to the owning replicas. The
         caller advances Adam batch state once per gradient batch (not per
         slot — matches the reference's batch-level beta powers)."""
+        keys, grads = self._filter_degraded_updates(keys, grads)
+        if not len(keys):
+            return
         n = len(self.replicas)
         if n == 1:
             r0 = self.replicas[0]
-            self._with_recovery(r0, lambda: r0.update_gradients(keys, grads, group))
+            self._guarded_update(
+                r0, lambda: r0.update_gradients(keys, grads, group), len(keys)
+            )
             return
         self._concurrent([
-            (lambda rep=self.replicas[r], idx=idx: self._with_recovery(
-                rep, lambda: rep.update_gradients(keys[idx], grads[idx], group)))
+            (lambda rep=self.replicas[r], idx=idx: self._guarded_update(
+                rep,
+                lambda: rep.update_gradients(keys[idx], grads[idx], group),
+                len(keys[idx]),
+            ))
             for r, idx in self._partition(keys)
         ])
 
@@ -739,6 +1054,7 @@ class EmbeddingWorker:
         buffered_data_expired_sec: int = 3600,
         num_threads: int = 8,
         device_pooling: bool = False,
+        policy=None,
     ):
         # device_pooling: sum slots ship unpooled (DevicePooledBatch) and
         # their gradients return per-distinct — the worker-wide mode covers
@@ -746,7 +1062,14 @@ class EmbeddingWorker:
         # inputs stay consistent
         self.device_pooling = device_pooling
         self.embedding_config = embedding_config
-        self.lookup_router = ShardedLookup(replicas, recover=self._recover_replica)
+        # ``policy`` (service/resilience.py): hands the router failover +
+        # degraded-lookup behavior; the degraded stand-in rows use the SAME
+        # seeded init a cold sign would be born with (deterministic, and
+        # consistent with a later real admission of the sign)
+        self.lookup_router = ShardedLookup(
+            replicas, recover=self._recover_replica, policy=policy,
+            degraded_init=self._degraded_init_rows,
+        )
         self.hyperparams = hyperparams
         self._optimizer = None  # cached for replica recovery
         self.forward_buffer_size = forward_buffer_size
@@ -828,6 +1151,19 @@ class EmbeddingWorker:
         self._optimizer = optimizer
         for r in self.lookup_router.replicas:
             r.register_optimizer(optimizer)
+
+    def _degraded_init_rows(self, signs: np.ndarray, dim: int) -> np.ndarray:
+        """Deterministic init-vector rows for degraded lookups: the seeded
+        per-sign init the PS tier itself uses (hashing.init_for_signs), so
+        a degraded forward is reproducible and matches what the sign would
+        look like freshly admitted."""
+        from persia_tpu.embedding.hashing import init_for_signs
+
+        seed = getattr(self.lookup_router.replicas[0], "seed", 0) or 0
+        method = self.hyperparams.resolved_init_method()
+        return init_for_signs(
+            np.asarray(signs, dtype=np.uint64), int(seed), dim, method
+        )
 
     def _recover_replica(self, replica) -> None:
         """Re-push runtime config to a replica that lost it (restarted PS):

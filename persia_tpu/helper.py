@@ -13,6 +13,7 @@ with the EmbeddingWorker surface for TrainCtx/DataLoader.
 from __future__ import annotations
 
 import os
+import socket
 import subprocess
 import sys
 import threading
@@ -54,6 +55,13 @@ class ServiceCtx:
         self._watchdog_stop = threading.Event()
         self._crashed: Optional[str] = None
         self._expected_dead: set = set()
+        # failover state: last dump_shard snapshot per PS index (fed by
+        # snapshot_ps / the snapshot guard; replayed by restart_ps /
+        # promote_standby), and any spawned-but-unregistered standbys
+        self._ps_snapshots: dict = {}
+        self._standbys: List[tuple] = []  # (addr, Popen)
+        self._guard_stop = threading.Event()
+        self._guard_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -137,16 +145,144 @@ class ServiceCtx:
         p.kill()
         p.wait(timeout=10)
 
-    def restart_ps(self, i: int) -> None:
+    def snapshot_ps(self, i: int) -> int:
+        """Record PS ``i``'s full state (every internal shard's
+        ``dump_shard`` bytes, plus the registered optimizer config — a
+        restored shard serving lookups without its optimizer would
+        re-initialize every restored entry on entry-width mismatch) for a
+        later replaying restart/promotion. Returns the snapshot's total
+        byte size."""
+        c = StoreClient(self.ps_addrs()[i])
+        shards = [
+            c.dump_shard(s) for s in range(c.num_internal_shards)
+        ]
+        opt = c.get_optimizer()
+        self._ps_snapshots[i] = (shards, opt.to_dict() if opt else None)
+        return sum(len(s) for s in shards)
+
+    def start_snapshot_guard(self, interval_s: float = 5.0) -> None:
+        """Background snapshot loop over every PS — the failover state
+        source when a shard dies without warning. Snapshot staleness is
+        bounded by ``interval_s`` (the accepted loss window, exactly like
+        a periodic checkpoint)."""
+        if self._guard_thread is not None:
+            return
+
+        def loop():
+            while not self._guard_stop.wait(interval_s):
+                for i in range(self.n_ps):
+                    try:
+                        self.snapshot_ps(i)
+                    except Exception as e:  # noqa: BLE001 — shard may be down
+                        logger.warning("snapshot guard: ps %d failed: %s", i, e)
+
+        self._guard_thread = threading.Thread(
+            target=loop, daemon=True, name="ps-snapshot-guard"
+        )
+        self._guard_thread.start()
+
+    def restart_ps(self, i: int, restore: bool = False) -> None:
         """Respawn parameter server ``i`` on its ORIGINAL port so existing
-        clients reconnect transparently (fresh store, like a k8s pod
-        restart without a boot checkpoint)."""
+        clients reconnect transparently. ``restore=False``: fresh store
+        (k8s pod restart without a boot checkpoint). ``restore=True``:
+        replay the last ``snapshot_ps`` state as a BOOT load
+        (``--load-shards``) — the new process only answers its first probe
+        after the replay, so a reconnecting client can never observe the
+        un-restored store and mistake trained signs for cold ones (loss
+        stays bounded by snapshot staleness)."""
+        import json
+        import tempfile
+
         addr = self.ps_addrs()[i]
         port = int(addr.rsplit(":", 1)[1])
-        p = subprocess.Popen(self._ps_cmd(i, port=port), env=self._env)
+        cmd = self._ps_cmd(i, port=port)
+        snap = self._ps_snapshots.get(i) if restore else None
+        tmp_files = []
+        if snap:
+            shards, opt_dict = snap
+            fd, snap_file = tempfile.mkstemp(prefix=f"ps{i}_boot_", suffix=".shards")
+            tmp_files.append(snap_file)
+            with os.fdopen(fd, "wb") as f:
+                for raw in shards:
+                    f.write(len(raw).to_bytes(8, "little"))
+                    f.write(raw)
+            cmd += ["--load-shards", snap_file]
+            if opt_dict:
+                fd, opt_file = tempfile.mkstemp(prefix=f"ps{i}_opt_", suffix=".json")
+                tmp_files.append(opt_file)
+                with os.fdopen(fd, "w") as f:
+                    json.dump(opt_dict, f)
+                cmd += ["--boot-optimizer", opt_file]
+        p = subprocess.Popen(cmd, env=self._env)
         self.procs.append(p)
         self._ps_procs[i] = p
+        try:
+            StoreClient(addr).wait_ready(timeout_s=self.startup_timeout_s)
+        finally:
+            for path in tmp_files:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
+    def _replay_snapshot(self, i: int, client: StoreClient) -> int:
+        snap = self._ps_snapshots.get(i)
+        if not snap:
+            return 0
+        shards, opt_dict = snap
+        if opt_dict:
+            # optimizer FIRST: a store without it re-initializes restored
+            # entries on the first train lookup (entry-width mismatch)
+            from persia_tpu.embedding.optim import OptimizerConfig
+
+            client.register_optimizer(OptimizerConfig.from_dict(opt_dict))
+        return sum(client.load_shard_bytes(raw) for raw in shards)
+
+    # ---------------------------------------------------- standby failover
+
+    def spawn_standby_ps(self) -> str:
+        """Start a spare, UNREGISTERED parameter server (same config) and
+        return its address. It idles until ``promote_standby`` loads a dead
+        shard's snapshot into it and re-points the coordinator entry."""
+        # reserve a port (races are theoretically possible but this is a
+        # single-machine test/bench topology)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        cmd = [
+            sys.executable, "-m", "persia_tpu.service.ps_server",
+            "--port", str(port),
+            "--replica-index", "0", "--replica-size", str(self.n_ps),
+            "--capacity", str(self.capacity),
+            "--num-internal-shards", str(self.num_internal_shards),
+            "--backend", self.backend, "--seed", str(self.seed),
+        ]
+        if self.global_config_path:
+            cmd += ["--global-config", self.global_config_path]
+        p = subprocess.Popen(cmd, env=self._env)
+        self.procs.append(p)
+        addr = f"127.0.0.1:{port}"
         StoreClient(addr).wait_ready(timeout_s=self.startup_timeout_s)
+        self._standbys.append((addr, p))
+        return addr
+
+    def promote_standby(self, i: int, standby_addr: Optional[str] = None) -> str:
+        """Fail shard ``i`` over onto a standby: replay the last snapshot
+        into it and upsert the coordinator registration so new clients
+        resolve the standby's address. Callers holding an in-process
+        router should also swap the replica handle
+        (``router.replace_replica(i, StoreClient(new_addr))``). Returns
+        the promoted address."""
+        if standby_addr is None:
+            if not self._standbys:
+                raise RuntimeError("no standby spawned (spawn_standby_ps first)")
+            standby_addr, _ = self._standbys.pop(0)
+        c = StoreClient(standby_addr)
+        c.wait_ready(timeout_s=self.startup_timeout_s)
+        self._replay_snapshot(i, c)
+        self.coord_client.register("parameter_server", i, standby_addr)
+        return standby_addr
 
     def _watch(self):
         """Crash watchdog (ref: helper.py:296-315): if any service process
@@ -165,6 +301,7 @@ class ServiceCtx:
 
     def __exit__(self, *exc):
         self._watchdog_stop.set()
+        self._guard_stop.set()
         try:
             for client in self.worker_clients():
                 try:

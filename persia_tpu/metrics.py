@@ -226,6 +226,36 @@ class MetricsRegistry:
             lines.extend(m.render())
         return "\n".join(lines) + "\n"
 
+    def snapshot(self, prefix: str = "") -> Dict[str, Dict[str, float]]:
+        """Programmatic counter/gauge values keyed by metric name then by a
+        ``k=v,...`` label string (empty for unlabeled series). Chaos/bench
+        runs embed this in their artifacts so resilience behavior (breaker
+        trips, degraded counts, injected faults) is auditable from the
+        JSON alone — no scraping, no reaching into private fields."""
+        out: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if prefix and not m.name.startswith(prefix):
+                continue
+            values = getattr(m, "_values", None)
+            if values is None:  # histograms: expose count + sum
+                series = {
+                    "count": dict(getattr(m, "_totals", {})),
+                    "sum": dict(getattr(m, "_sums", {})),
+                }
+                for suffix, vals in series.items():
+                    for key, v in vals.items():
+                        lbl = ",".join(f"{k}={val}" for k, val in key)
+                        out.setdefault(f"{m.name}_{suffix}", {})[lbl] = float(v)
+                continue
+            with m._lock:
+                items = list(values.items())
+            for key, v in items:
+                lbl = ",".join(f"{k}={val}" for k, val in key)
+                out.setdefault(m.name, {})[lbl] = float(v)
+        return out
+
     # ------------------------------------------------------------------ push
 
     def start_push(self, gateway_addr: Optional[str] = None, interval_sec: float = 10.0) -> bool:

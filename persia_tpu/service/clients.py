@@ -17,6 +17,7 @@ from persia_tpu.config import HyperParameters
 from persia_tpu.data import PersiaBatch
 from persia_tpu.embedding.optim import OptimizerConfig
 from persia_tpu.service import proto
+from persia_tpu.service.resilience import Deadline, ResiliencePolicy
 from persia_tpu.service.rpc import RpcClient
 
 
@@ -26,15 +27,35 @@ class StoreClient:
     ``wire_dtype`` ("float16"/"bfloat16") halves the batched lookup/update
     wire exactly like the reference's f16 embedding/gradient wire
     (persia-common/src/lib.rs:157-180); default float32 keeps the
-    determinism oracle bit-exact."""
+    determinism oracle bit-exact.
+
+    ``policy`` is the shared :class:`ResiliencePolicy` (backoff, breaker,
+    degraded knobs — service/resilience.py); ``deadline_s`` is an optional
+    per-data-plane-call time budget propagated into ``RpcClient.call`` so
+    a wedged shard bounds the caller's wait instead of stacking socket
+    timeouts."""
 
     def __init__(
         self, addr: str, timeout_s: float = 120.0,
         wire_dtype: Optional[str] = None,
+        policy: Optional[ResiliencePolicy] = None,
+        deadline_s: Optional[float] = None,
     ):
         self.addr = addr
         self.wire_dtype = None if wire_dtype == "float32" else wire_dtype
-        self._rpc = RpcClient(addr, timeout_s=timeout_s)
+        self.deadline_s = deadline_s
+        self._rpc = RpcClient(addr, timeout_s=timeout_s, policy=policy)
+
+    @property
+    def policy(self) -> ResiliencePolicy:
+        return self._rpc.policy
+
+    @property
+    def endpoint(self) -> str:
+        return self._rpc.endpoint
+
+    def _deadline(self) -> Optional[Deadline]:
+        return None if self.deadline_s is None else Deadline.after(self.deadline_s)
 
     def wait_ready(self, timeout_s: float = 60.0) -> None:
         self._rpc.wait_ready(timeout_s)
@@ -49,6 +70,7 @@ class StoreClient:
                 signs, key_ofs, dims, train, reply_dtype=self.wire_dtype
             ),
             idempotent=True,  # same retry-safety argument as lookup
+            deadline=self._deadline(),
         )
         return proto.unpack_lookup_batched_reply(
             raw, proto.wire_dtype_code(self.wire_dtype)
@@ -69,7 +91,8 @@ class StoreClient:
         # train lookups mutate (LRU/admit) but are retry-safe: re-running a
         # lookup converges to the same entries, so idempotent for RPC purposes
         raw = self._rpc.call(
-            "lookup", proto.pack_lookup_request(signs, dim, train), idempotent=True
+            "lookup", proto.pack_lookup_request(signs, dim, train),
+            idempotent=True, deadline=self._deadline(),
         )
         return np.frombuffer(raw, dtype=np.float32).reshape(len(signs), dim).copy()
 
@@ -79,7 +102,7 @@ class StoreClient:
         raw = self._rpc.call(
             "checkout_entries",
             proto.pack_lookup_request(signs, dim, True),
-            idempotent=True,
+            idempotent=True, deadline=self._deadline(),
         )
         n = max(len(signs), 1)
         width = len(raw) // (4 * n) if len(signs) else dim
@@ -90,7 +113,7 @@ class StoreClient:
         raw = self._rpc.call(
             "probe_entries",
             proto.pack_lookup_request(signs, dim, True),
-            idempotent=True,
+            idempotent=True, deadline=self._deadline(),
         )
         n = len(signs)
         warm = np.frombuffer(raw[:n], dtype=np.uint8).astype(bool)
@@ -123,14 +146,19 @@ class StoreClient:
     ) -> None:
         if dim is None:
             dim = values.shape[1]
+        # a raw full-entry put is idempotent: replaying after a dropped
+        # reply lands the same rows (a duplicate incremental commit is a
+        # same-value upsert), so write-backs survive mid-frame resets
         if commit_incremental:
             self._rpc.call(
                 "set_embedding_v2",
                 proto.pack_set_embedding_v2(signs, values, dim, True),
+                idempotent=True,
             )
         else:  # legacy wire: interoperates with older servers
             self._rpc.call(
-                "set_embedding", proto.pack_set_embedding(signs, values, dim)
+                "set_embedding", proto.pack_set_embedding(signs, values, dim),
+                idempotent=True,
             )
 
     def get_embedding_entry(self, sign: int) -> Optional[np.ndarray]:
@@ -186,9 +214,20 @@ class WorkerClient:
     """Embedding-worker RPC client with the EmbeddingWorker surface used by
     TrainCtx / DataLoader / DataCtx."""
 
-    def __init__(self, addr: str, timeout_s: float = 120.0):
+    def __init__(
+        self, addr: str, timeout_s: float = 120.0,
+        policy: Optional[ResiliencePolicy] = None,
+    ):
         self.addr = addr
-        self._rpc = RpcClient(addr, timeout_s=timeout_s)
+        self._rpc = RpcClient(addr, timeout_s=timeout_s, policy=policy)
+
+    @property
+    def policy(self) -> ResiliencePolicy:
+        return self._rpc.policy
+
+    @property
+    def endpoint(self) -> str:
+        return self._rpc.endpoint
 
     def wait_ready(self, timeout_s: float = 60.0) -> None:
         self._rpc.wait_ready(timeout_s)

@@ -37,11 +37,15 @@ class ParameterServerService:
         replica_size: int = 1,
         port: int = 0,
         native_server: Optional[bool] = None,
+        status: Optional[ModelManagerStatus] = None,
     ):
         self.store = store
         self.replica_index = replica_index
         self.replica_size = replica_size
-        self.status = ModelManagerStatus()
+        # boot loads happen BEFORE this service exists (their status is
+        # threaded in) — the native server's accept loop starts at
+        # construction, so any load after this point races live probes
+        self.status = status or ModelManagerStatus()
         # data plane: the C++ listener serves the hot methods off the GIL
         # when the store is native (ref: the reference's entire remote path
         # is compiled, persia-rpc/src/lib.rs:68-145); Python socketserver
@@ -243,6 +247,15 @@ def main(argv: Optional[list] = None) -> None:
     ap.add_argument("--global-config", type=str, default=None)
     ap.add_argument("--load-checkpoint", type=str, default=None,
                     help="Infer-mode boot checkpoint (ref: ps bin :109-117)")
+    ap.add_argument("--load-shards", type=str, default=None,
+                    help="boot shard-bytes file (failover restart replay: "
+                         "length-prefixed dump_shard blobs, loaded BEFORE "
+                         "the server answers its first probe)")
+    ap.add_argument("--boot-optimizer", type=str, default=None,
+                    help="optimizer-config JSON file registered BEFORE "
+                         "serving (a restored shard answering lookups "
+                         "without its optimizer re-initializes — destroys — "
+                         "every restored entry on width mismatch)")
     args = ap.parse_args(argv)
 
     from persia_tpu import env
@@ -282,7 +295,39 @@ def main(argv: Optional[list] = None) -> None:
             inc_mgr = attach_incremental(
                 store, psc.incremental_dir, replica_index, psc.incremental_buffer_size
             )
-    svc = ParameterServerService(store, replica_index, replica_size, port=args.port)
+    # every boot load runs BEFORE the service binds and serves: a same-port
+    # restart answering probes from a not-yet-restored store would make
+    # clients mistake trained signs for cold ones and fork their rows
+    status = ModelManagerStatus()
+    skip_before_us = 0
+    if args.boot_optimizer:
+        import json as _json
+
+        with open(args.boot_optimizer) as f:
+            store.register_optimizer(OptimizerConfig.from_dict(_json.load(f)))
+    if args.load_shards:
+        with open(args.load_shards, "rb") as f:
+            raw = f.read()
+        off = 0
+        n_loaded = 0
+        while off < len(raw):
+            (ln,) = struct.unpack_from("<Q", raw, off)
+            off += 8
+            n_loaded += store.load_shard_bytes(raw[off:off + ln])
+            off += ln
+        logger.info("boot shard replay: %d entries restored", n_loaded)
+    if args.load_checkpoint:
+        load_store(store, args.load_checkpoint, replica_index, replica_size,
+                   status=status)
+        try:
+            from persia_tpu.checkpoint import checkpoint_info
+
+            skip_before_us = int(checkpoint_info(args.load_checkpoint).get("time_us", 0))
+        except Exception:
+            pass  # markerless/legacy checkpoint — apply all retained packets
+    svc = ParameterServerService(
+        store, replica_index, replica_size, port=args.port, status=status
+    )
     svc.start()
     logger.info(
         "parameter server %d/%d on port %d", replica_index, replica_size, svc.port
@@ -290,16 +335,6 @@ def main(argv: Optional[list] = None) -> None:
     from persia_tpu.diagnostics import maybe_start_from_env
 
     maybe_start_from_env()  # opt-in deadlock/stall detector (ref: lib.rs:494)
-    skip_before_us = 0
-    if args.load_checkpoint:
-        load_store(store, args.load_checkpoint, replica_index, replica_size,
-                   status=svc.status)
-        try:
-            from persia_tpu.checkpoint import checkpoint_info
-
-            skip_before_us = int(checkpoint_info(args.load_checkpoint).get("time_us", 0))
-        except Exception:
-            pass  # markerless/legacy checkpoint — apply all retained packets
     if inc_infer:
         # started only after the boot checkpoint: applies only packets newer
         # than it, so stale retained deltas can't regress loaded entries

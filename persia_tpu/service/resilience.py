@@ -1,0 +1,278 @@
+"""Unified resilience policy for the service plane.
+
+One engine owns every retry/backoff/deadline/breaker decision the process
+makes against a remote peer, so the training-side clients (``StoreClient``
+/ ``WorkerClient`` via ``RpcClient``), the DataLoader's lookup workers,
+the HBM cache tier's PS probe path, and the serving gateway all share ONE
+set of semantics instead of four hand-rolled loops (the pre-PR state:
+``RpcClient.call`` had its own backoff, the gateway its own mark-down
+logic, the loader its own retry counter, the cache tier nothing).
+
+Pieces:
+
+- :class:`RetryPolicy` — exponential backoff with deterministic,
+  seed-driven jitter (chaos tests replay schedules bit-for-bit);
+- :class:`Deadline` — a per-call time budget that PROPAGATES: each RPC
+  attempt's socket timeout and each backoff sleep is capped by the
+  remaining budget, so a call bounded to 2 s cannot spend 3 × 60 s in
+  nested retries (the reference's NATS ops carry the same budget idea,
+  core/nats.rs:162-180);
+- :class:`CircuitBreaker` — per-endpoint consecutive-failure breaker with
+  half-open probes: a dead PS shard costs ONE connect timeout per reset
+  window instead of one per lookup, and the re-close after recovery is an
+  observable event (``trips``/``state``) the chaos suite asserts on;
+- :class:`ResiliencePolicy` — the shared container: breaker registry
+  keyed by endpoint, the retry policy, and the degraded-lookup knobs
+  (``degrade_after_s``, ``max_degraded_frac``) the embedding router uses
+  to trade bounded quality for liveness when a shard stays down.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from persia_tpu.metrics import get_metrics
+
+
+class ResilienceError(RuntimeError):
+    pass
+
+
+class DeadlineExceeded(ResilienceError, TimeoutError):
+    """The call's time budget ran out (subclasses ``TimeoutError`` so the
+    existing transport-error classification in ``rpc._is_transportish``
+    and the retry loops treat it as a transport-class failure)."""
+
+
+class CircuitOpenError(ResilienceError, ConnectionError):
+    """The endpoint's breaker is open — fail fast, no socket was touched
+    (subclasses ``ConnectionError`` for the same classification reason)."""
+
+
+class Deadline:
+    """Monotonic time budget. ``None`` deadlines are represented by the
+    caller passing ``None`` — this class always has a bound."""
+
+    __slots__ = ("t_end",)
+
+    def __init__(self, budget_s: float):
+        self.t_end = time.monotonic() + float(budget_s)
+
+    @classmethod
+    def after(cls, budget_s: float) -> "Deadline":
+        return cls(budget_s)
+
+    def remaining(self) -> float:
+        return self.t_end - time.monotonic()
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def check(self, what: str = "call") -> None:
+        if self.expired:
+            raise DeadlineExceeded(f"deadline exceeded before {what}")
+
+    def cap(self, timeout_s: Optional[float]) -> float:
+        """Largest per-attempt timeout that still fits the budget (floored
+        at 1 ms so sockets never get a non-positive timeout)."""
+        rem = max(self.remaining(), 1e-3)
+        return rem if timeout_s is None else min(float(timeout_s), rem)
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff with deterministic jitter.
+
+    ``jitter`` is the fraction of the nominal delay that is randomized
+    away (0.5 → uniform in [0.5·d, d]); the RNG is seeded so two runs of
+    the same schedule sleep the same sequence — chaos soak runs stay
+    reproducible."""
+
+    max_attempts: int = 3
+    base_s: float = 0.05
+    multiplier: float = 2.0
+    max_s: float = 2.0
+    jitter: float = 0.5
+    seed: int = 0
+
+    def __post_init__(self):
+        self._rng = random.Random(self.seed)
+        self._rng_lock = threading.Lock()
+
+    def backoff(self, attempt: int) -> float:
+        d = min(self.base_s * self.multiplier ** max(attempt, 0), self.max_s)
+        if self.jitter <= 0.0 or d <= 0.0:
+            return d
+        with self._rng_lock:
+            r = self._rng.random()
+        return d * (1.0 - self.jitter * r)
+
+
+_STATE_CLOSED = "closed"
+_STATE_OPEN = "open"
+_STATE_HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with half-open probes.
+
+    closed → (``failure_threshold`` consecutive failures) → open →
+    (``reset_timeout_s`` elapses) → half-open (ONE probe call allowed) →
+    success closes / failure re-opens. ``allow()`` consumes the half-open
+    probe slot; ``available()`` is the non-consuming routing check the
+    gateway uses."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        failure_threshold: int = 5,
+        reset_timeout_s: float = 1.0,
+    ):
+        self.endpoint = endpoint
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.reset_timeout_s = float(reset_timeout_s)
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._state = _STATE_CLOSED
+        self._open_until = 0.0
+        self._probe_inflight = False
+        self.trips = 0  # closed→open transitions (chaos suite asserts on it)
+        m = get_metrics()
+        self._m_state = m.gauge(
+            "persia_tpu_breaker_open", "1 while the endpoint's breaker is open"
+        )
+        self._m_trips = m.counter(
+            "persia_tpu_breaker_trips", "breaker closed->open transitions"
+        )
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open()
+            return self._state
+
+    def _maybe_half_open(self) -> None:
+        if self._state == _STATE_OPEN and time.monotonic() >= self._open_until:
+            self._state = _STATE_HALF_OPEN
+            self._probe_inflight = False
+
+    def allow(self) -> bool:
+        """May a call proceed now? Half-open grants exactly one in-flight
+        probe per reset window."""
+        with self._lock:
+            self._maybe_half_open()
+            if self._state == _STATE_CLOSED:
+                return True
+            if self._state == _STATE_HALF_OPEN and not self._probe_inflight:
+                self._probe_inflight = True
+                return True
+            return False
+
+    def available(self) -> bool:
+        """Non-consuming routing check (round-robin membership)."""
+        with self._lock:
+            self._maybe_half_open()
+            return self._state != _STATE_OPEN
+
+    def on_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probe_inflight = False
+            if self._state != _STATE_CLOSED:
+                self._state = _STATE_CLOSED
+                self._m_state.set(0, endpoint=self.endpoint)
+
+    def on_failure(self) -> None:
+        with self._lock:
+            self._maybe_half_open()
+            self._failures += 1
+            self._probe_inflight = False
+            tripping = (
+                self._state == _STATE_HALF_OPEN
+                or (self._state == _STATE_CLOSED
+                    and self._failures >= self.failure_threshold)
+            )
+            if tripping:
+                if self._state != _STATE_OPEN:
+                    self.trips += 1
+                    self._m_trips.inc(endpoint=self.endpoint)
+                self._state = _STATE_OPEN
+                self._open_until = time.monotonic() + self.reset_timeout_s
+                self._m_state.set(1, endpoint=self.endpoint)
+
+    def force_open(self) -> None:
+        """Administrative open (the gateway's mark-down on a failed health
+        probe maps here)."""
+        with self._lock:
+            if self._state != _STATE_OPEN:
+                self.trips += 1
+                self._m_trips.inc(endpoint=self.endpoint)
+            self._state = _STATE_OPEN
+            self._open_until = time.monotonic() + self.reset_timeout_s
+            self._failures = self.failure_threshold
+            self._m_state.set(1, endpoint=self.endpoint)
+
+
+@dataclass
+class ResiliencePolicy:
+    """The shared policy container: one per process (``default_policy``)
+    or one per test/bench scope.
+
+    ``degrade_after_s``: how long the embedding router blocks-and-retries
+    a dead shard before serving deterministic init-vector embeddings
+    instead (``None`` = never degrade — fail like the pre-PR code).
+    ``max_degraded_frac``: abort threshold — a lookup call (and the
+    stream, per step) whose degraded fraction EXCEEDS this raises instead
+    of silently training on mostly-synthetic embeddings."""
+
+    retry: RetryPolicy = field(default_factory=RetryPolicy)
+    breaker_failure_threshold: int = 5
+    breaker_reset_s: float = 1.0
+    degrade_after_s: Optional[float] = None
+    max_degraded_frac: float = 1.0
+
+    def __post_init__(self):
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._lock = threading.Lock()
+
+    def breaker(self, endpoint: str) -> CircuitBreaker:
+        with self._lock:
+            b = self._breakers.get(endpoint)
+            if b is None:
+                b = self._breakers[endpoint] = CircuitBreaker(
+                    endpoint,
+                    failure_threshold=self.breaker_failure_threshold,
+                    reset_timeout_s=self.breaker_reset_s,
+                )
+            return b
+
+    def breaker_states(self) -> Dict[str, str]:
+        with self._lock:
+            return {ep: b.state for ep, b in self._breakers.items()}
+
+    def breaker_trips(self) -> Dict[str, int]:
+        with self._lock:
+            return {ep: b.trips for ep, b in self._breakers.items()}
+
+    def backoff(self, attempt: int) -> float:
+        return self.retry.backoff(attempt)
+
+
+_DEFAULT: Optional[ResiliencePolicy] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_policy() -> ResiliencePolicy:
+    """Process-wide default policy (lazy). Clients constructed without an
+    explicit policy share this one, so their breakers agree on endpoint
+    health."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = ResiliencePolicy()
+        return _DEFAULT

@@ -19,21 +19,32 @@ directions interoperate with round-1 processes.)
 
 from __future__ import annotations
 
+import os
 import socket
 import socketserver
 import struct
 import threading
 import time
+import zlib
 from typing import Callable, Dict, Optional, Sequence, Union
 
 from persia_tpu import diagnostics
 from persia_tpu.logger import get_default_logger
 from persia_tpu.service import codec as _codec
+from persia_tpu.service.resilience import (
+    CircuitOpenError,
+    Deadline,
+    DeadlineExceeded,
+    ResiliencePolicy,
+    default_policy,
+)
 
 logger = get_default_logger("persia_tpu.rpc")
 
 _FLAG_CODEC_MASK = 0x03
+_FLAG_CRC32 = 0x40  # payload carries a trailing crc32 (negotiated)
 _FLAG_REPLY_COMPRESS_OK = 0x80
+_STATUS_CRC = 0x08  # reply status bit: payload carries a trailing crc32
 _SLOW_METHODS = frozenset({"dump", "load"})
 
 _MAX_FRAME = 1 << 31  # 2 GiB sanity bound
@@ -68,13 +79,34 @@ def _flatten(payload: Buffers) -> bytes:
     return b"".join(bytes(p) for p in payload)
 
 
-def _capabilities_reply(_p: bytes = b"") -> bytes:
+def _caps_sum(caps: dict) -> str:
+    """Self-checksum over the capability fields: the negotiation probe is
+    the one exchange that CANNOT ride the negotiated integrity trailer
+    (bootstrap), so the JSON carries its own crc — a damaged reply is
+    re-probed instead of silently downgrading the connection."""
+    import json
+
+    canon = json.dumps(
+        {k: caps[k] for k in sorted(caps) if k != "sum"}, sort_keys=True
+    )
+    return format(zlib.crc32(canon.encode()) & 0xFFFFFFFF, "08x")
+
+
+def _capabilities_reply(_p: bytes = b"", crc: bool = False) -> bytes:
     """Codec-negotiation probe: clients only send lz4 frames to peers that
-    advertise it (round-1 peers answer 'unknown method' → zlib only)."""
+    advertise it (round-1 peers answer 'unknown method' → zlib only), and
+    only send crc32-trailed frames to peers that advertise ``crc`` (the
+    Python server verifies them; the native C++ data plane does not parse
+    the trailer, so it keeps the default no-crc advertisement). Older
+    clients ignore the ``sum`` field."""
     import json
 
     codecs = ["zlib"] + (["lz4"] if _codec.lz4_available() else [])
-    return json.dumps({"codecs": codecs}).encode()
+    caps = {"codecs": codecs}
+    if crc:
+        caps["integrity"] = ["crc32"]
+    caps["sum"] = _caps_sum(caps)
+    return json.dumps(caps).encode()
 
 
 class RpcError(RuntimeError):
@@ -113,8 +145,29 @@ class _Handler(socketserver.BaseRequestHandler):
                     raise ConnectionError(f"oversized frame {total}")
                 frame = _recv_exact(sock, total)
                 flags = frame[0]
+                want_crc = bool(flags & _FLAG_CRC32)
+                if want_crc:
+                    # integrity trailer (negotiated via `capabilities`):
+                    # covers the WHOLE frame after the length prefix
+                    # (flags + method header + payload), verified BEFORE any
+                    # parsing — a flipped method byte or length field is
+                    # caught here, and the client sees a retryable
+                    # "unavailable:" error instead of silent garbage
+                    if (
+                        len(frame) < 8
+                        or zlib.crc32(frame[:-4])
+                        != struct.unpack("<I", frame[-4:])[0]
+                    ):
+                        reply = b"unavailable: request frame crc mismatch"
+                        sock.sendall(
+                            struct.pack("<IB", len(reply) + 1, 1) + reply
+                        )
+                        continue
+                    frame = frame[:-4]
                 (mlen,) = struct.unpack("<H", frame[1:3])
-                method = frame[3 : 3 + mlen].decode()
+                # errors="replace" keeps an (un-crc'd) corrupt method from
+                # killing the handler thread — it resolves to unknown-method
+                method = frame[3 : 3 + mlen].decode(errors="replace")
                 payload = frame[3 + mlen :]
                 codec_id = flags & _FLAG_CODEC_MASK
                 if codec_id:
@@ -165,6 +218,14 @@ class _Handler(socketserver.BaseRequestHandler):
                     if cid and len(body) < rlen:  # incompressible stays raw
                         rbufs, rlen = [memoryview(body).cast("B")], len(body)
                         status |= cid << 4
+                if want_crc:
+                    # reply trailer covers status byte + payload
+                    status |= _STATUS_CRC
+                    crc = zlib.crc32(bytes([status]))
+                    for b in rbufs:
+                        crc = zlib.crc32(b, crc)
+                    rbufs.append(memoryview(struct.pack("<I", crc)).cast("B"))
+                    rlen += 4
                 _send_buffers(
                     sock,
                     [memoryview(struct.pack("<IB", rlen + 1, status)).cast("B")]
@@ -195,7 +256,8 @@ class RpcServer:
         self.compress_threshold = compress_threshold
         self.handlers: Dict[str, Callable[[bytes], Buffers]] = {
             "ping": lambda p: b"pong",
-            "capabilities": _capabilities_reply,  # codec negotiation probe
+            # codec + integrity negotiation probe (this server verifies crc)
+            "capabilities": lambda p: _capabilities_reply(p, crc=True),
             "shutdown": lambda p: b"ok",  # framing layer stops after replying
         }
         self._server = _ThreadedTCPServer((host, port), _Handler)
@@ -234,14 +296,27 @@ class RpcClient:
         compress_threshold: int = 1 << 20,
         retries: int = 3,
         pool_size: int = 8,
+        policy: Optional[ResiliencePolicy] = None,
+        integrity: Optional[bool] = None,
     ):
         host, port = addr.rsplit(":", 1)
         self.addr = (host, int(port))
+        self.endpoint = f"{host}:{int(port)}"
         self.timeout_s = timeout_s
         self.compress_threshold = compress_threshold
         self.retries = retries
         self.pool_size = max(1, pool_size)
+        # resilience: backoff/jitter + the per-endpoint circuit breaker are
+        # single-sourced in service/resilience.py (shared with the gateway
+        # and the embedding router — no duplicated backoff logic)
+        self.policy = policy if policy is not None else default_policy()
+        # crc32 frame integrity (negotiated; env PERSIA_RPC_CRC=1 turns it
+        # on process-wide — chaos runs flip it to catch corrupt frames)
+        if integrity is None:
+            integrity = os.environ.get("PERSIA_RPC_CRC", "0") == "1"
+        self.integrity = bool(integrity)
         self._peer_lz4: Optional[bool] = None  # learned from `capabilities`
+        self._peer_crc: Optional[bool] = None
         self._idle: list = []
         self._total = 0
         self._gen = 0  # close() bumps: stale in-flight sockets die at checkin
@@ -303,27 +378,59 @@ class RpcClient:
         payload: Buffers = b"",
         idempotent: bool = False,
         timeout_s: Optional[float] = None,
+        deadline: Optional[Deadline] = None,
     ) -> bytes:
         """Invoke ``method``. Transport errors retry with exponential backoff
         ONLY for idempotent calls (ref concept: backoff-retry on NATS ops,
         core/nats.rs:162-180) — retrying a gradient update or dump after a
         dropped reply would double-apply it. ``timeout_s`` overrides the
-        client default for long blocking operations (dump/load)."""
+        client default for long blocking operations (dump/load).
+
+        Resilience (service/resilience.py): backoff delays come from the
+        shared :class:`RetryPolicy`; ``deadline`` caps every attempt's
+        socket timeout AND every backoff sleep by the remaining budget;
+        the endpoint's :class:`CircuitBreaker` fails calls fast while
+        open (``ping`` is exempt — it IS the recovery probe, and its
+        success re-closes the breaker)."""
+        pol = self.policy
+        breaker = pol.breaker(self.endpoint)
+        probe = method == "ping"
         last: Optional[Exception] = None
-        attempts = self.retries if idempotent else 1
+        attempts = max(self.retries, 1) if idempotent else 1
         for attempt in range(attempts):
-            try:
-                return self._call_once(method, payload, timeout_s)
-            except (ConnectionError, OSError, socket.timeout) as e:
-                last = e
-                time.sleep(min(0.1 * 2**attempt, 2.0))
+            if deadline is not None:
+                deadline.check(f"rpc {method}")
+            if not probe and not breaker.allow():
+                last = CircuitOpenError(
+                    f"circuit open for {self.endpoint} (rpc {method})"
+                )
+            else:
+                try:
+                    reply = self._call_once(method, payload, timeout_s, deadline)
+                    breaker.on_success()
+                    return reply
+                except DeadlineExceeded:
+                    breaker.on_failure()
+                    raise
+                except (ConnectionError, OSError, socket.timeout) as e:
+                    breaker.on_failure()
+                    last = e
+            if attempt + 1 < attempts:
+                delay = pol.backoff(attempt)
+                if deadline is not None:
+                    delay = min(delay, max(deadline.remaining(), 0.0))
+                time.sleep(delay)
         raise RpcError(
             f"rpc {method} to {self.addr} failed"
             + (" after retries" if attempts > 1 else "")
         ) from last
 
     def _call_once(
-        self, method: str, payload: Buffers, timeout_s: Optional[float] = None
+        self,
+        method: str,
+        payload: Buffers,
+        timeout_s: Optional[float] = None,
+        deadline: Optional[Deadline] = None,
     ) -> bytes:
         """``payload`` may be bytes or a list of buffers (scatter-gather:
         numpy views ship without a host-side join)."""
@@ -335,27 +442,55 @@ class RpcClient:
             else payload
         )
         plen = sum(len(b) for b in bufs)
-        if plen >= self.compress_threshold and method != "capabilities":
-            if self._peer_lz4 is None and _codec.lz4_available():
+        if method != "capabilities":
+            if self.integrity and self._peer_crc is None:
                 self._probe_peer_codecs()
-            cid, body = _codec.compress_frame(
-                _flatten(bufs), prefer_lz4=bool(self._peer_lz4)
-            )
-            if len(body) < plen:  # incompressible payloads stay raw
-                bufs, plen = [memoryview(body).cast("B")], len(body)
-                flags |= cid
+                if self._peer_crc is None:
+                    # the probe itself was damaged in transit: do NOT send
+                    # an unprotected frame while the peer might support
+                    # crc — surface a retryable transport error instead
+                    raise ConnectionError(
+                        "peer integrity capabilities unresolved"
+                    )
+            if plen >= self.compress_threshold:
+                if self._peer_lz4 is None and _codec.lz4_available():
+                    self._probe_peer_codecs()
+                cid, body = _codec.compress_frame(
+                    _flatten(bufs), prefer_lz4=bool(self._peer_lz4)
+                )
+                if len(body) < plen:  # incompressible payloads stay raw
+                    bufs, plen = [memoryview(body).cast("B")], len(body)
+                    flags |= cid
+        want_crc = (
+            self.integrity and self._peer_crc and method != "capabilities"
+        )
         m = method.encode()
+        if want_crc:
+            # trailer covers the whole frame after the length prefix
+            # (flags + method header + payload) so corruption anywhere in
+            # the frame body is detectable server-side
+            flags |= _FLAG_CRC32
+            crc = zlib.crc32(struct.pack("<BH", flags, len(m)) + m)
+            for b in bufs:
+                crc = zlib.crc32(b, crc)
+            bufs = bufs + [memoryview(struct.pack("<I", crc)).cast("B")]
+            plen += 4
         header = struct.pack("<IBH", plen + 3 + len(m), flags, len(m)) + m
+        eff_timeout = timeout_s
+        if deadline is not None:
+            eff_timeout = deadline.cap(
+                timeout_s if timeout_s is not None else self.timeout_s
+            )
         sock, gen = self._checkout()
         try:
-            if timeout_s is not None:
-                sock.settimeout(timeout_s)
+            if eff_timeout is not None:
+                sock.settimeout(eff_timeout)
             try:
                 _send_buffers(sock, [memoryview(header).cast("B")] + bufs)
                 (total,) = struct.unpack("<I", _recv_exact(sock, 4))
                 body = _recv_exact(sock, total)
             finally:
-                if timeout_s is not None:
+                if eff_timeout is not None:
                     sock.settimeout(self.timeout_s)
         except BaseException:
             self._checkin(sock, gen, broken=True)
@@ -365,23 +500,57 @@ class RpcClient:
         reply = body[1:]
         codec_id = status >> 4
         status &= 0x0F
+        if status & _STATUS_CRC:
+            # reply integrity trailer covers status byte + payload
+            if (
+                len(body) < 5
+                or zlib.crc32(body[:-4]) != struct.unpack("<I", body[-4:])[0]
+            ):
+                raise ConnectionError(
+                    f"rpc {method}: reply frame crc mismatch"
+                )
+            reply = reply[:-4]
+            status &= ~_STATUS_CRC
         if codec_id:
             reply = _codec.decompress_frame(codec_id, reply)
         if status != 0:
+            if reply.startswith(b"unavailable: request frame crc"):
+                # the server rejected a damaged frame: transport-class
+                # failure — idempotent callers retry it like a reset
+                raise ConnectionError(
+                    f"rpc {method}: request frame corrupted in transit"
+                )
             raise RpcError(f"rpc {method}: remote error: {reply.decode(errors='replace')}")
         return reply
 
     def _probe_peer_codecs(self) -> None:
-        """One-shot `capabilities` probe before the first compressed frame:
-        lz4 goes on the wire only to peers that advertise decoding it
-        (round-1 peers answer 'unknown method' → stick to zlib)."""
-        try:
-            import json
+        """One-shot `capabilities` probe before the first compressed (or
+        crc-trailed) frame: lz4/crc32 go on the wire only to peers that
+        advertise decoding them (round-1 peers answer 'unknown method' →
+        zlib, no trailer; the native data plane advertises codecs only)."""
+        import json
 
+        try:
             caps = json.loads(self._call_once("capabilities", b""))
+            if "sum" in caps and caps["sum"] != _caps_sum(caps):
+                return  # damaged-in-transit caps: stay undecided, re-probe
             self._peer_lz4 = "lz4" in caps.get("codecs", [])
-        except Exception:  # noqa: BLE001 — legacy peer or transient error
-            self._peer_lz4 = False
+            self._peer_crc = "crc32" in caps.get("integrity", [])
+        except RpcError as e:
+            # a legacy peer answers "unknown method 'capabilities'" — the
+            # echoed method name is the tell. A CORRUPTED probe draws
+            # "unknown method '<garbage>'" instead and must NOT latch the
+            # legacy verdict (that would silently disable integrity off
+            # one damaged frame).
+            msg = str(e)
+            if "unknown method 'capabilities'" in msg:
+                self._peer_lz4 = False
+                self._peer_crc = False
+        except Exception:  # noqa: BLE001 — transport/parse damage
+            # the probe itself may have been corrupted or cut in transit:
+            # leave the capabilities UNDECIDED so the next call re-probes,
+            # instead of permanently disabling negotiation off one bad frame
+            pass
 
     def wait_ready(self, timeout_s: float = 60.0) -> None:
         deadline = time.time() + timeout_s

@@ -167,13 +167,30 @@ def main(argv: Optional[list] = None) -> None:
 
     coord = CoordinatorClient(args.coordinator)
     ps_addrs = coord.wait_for("parameter_server", args.num_parameter_servers)
-    replicas = [StoreClient(a, wire_dtype=args.ps_wire_dtype) for a in ps_addrs]
+    # env-configured resilience policy (service/resilience.py): setting
+    # PERSIA_DEGRADE_AFTER_S arms degraded-mode lookups on this worker's
+    # PS router — a dead shard then costs bounded quality, not liveness
+    policy = None
+    degrade_s = os.environ.get("PERSIA_DEGRADE_AFTER_S")
+    if degrade_s:
+        from persia_tpu.service.resilience import ResiliencePolicy
+
+        policy = ResiliencePolicy(
+            degrade_after_s=float(degrade_s),
+            max_degraded_frac=float(
+                os.environ.get("PERSIA_MAX_DEGRADED_FRAC", "1.0")
+            ),
+        )
+    replicas = [
+        StoreClient(a, wire_dtype=args.ps_wire_dtype, policy=policy)
+        for a in ps_addrs
+    ]
     for r in replicas:
         r.wait_ready()
 
     worker = EmbeddingWorker(
         emb_cfg, replicas, num_threads=args.num_threads,
-        device_pooling=args.device_pooling, **worker_kwargs
+        device_pooling=args.device_pooling, policy=policy, **worker_kwargs
     )
     svc = EmbeddingWorkerService(worker, port=args.port).start()
     logger.info(

@@ -29,6 +29,7 @@ import numpy as np
 from persia_tpu.data import PersiaBatch
 from persia_tpu.logger import get_default_logger
 from persia_tpu.metrics import get_metrics
+from persia_tpu.service.resilience import ResiliencePolicy, RetryPolicy
 from persia_tpu.serving.client import InferenceClient
 
 logger = get_default_logger("persia_tpu.serving.gateway")
@@ -44,6 +45,14 @@ class ReplicaGateway:
     ``replicas`` seeds a static set; ``coordinator`` (a
     ``CoordinatorClient``) + ``role`` refreshes the set each health tick so
     replicas added later join the rotation without a restart.
+
+    Replica health and retry/backoff run on the SHARED resilience engine
+    (``service/resilience.py`` — the same one the training-side RPC
+    clients use): each replica gets a per-endpoint circuit breaker
+    (threshold 1, reset = the health interval, so a failed replica leaves
+    the rotation immediately and re-enters through a half-open probe),
+    and inter-attempt backoff delays come from the policy's RetryPolicy
+    instead of a hand-rolled loop.
     """
 
     def __init__(
@@ -55,9 +64,9 @@ class ReplicaGateway:
         hedge_after_ms: float = 50.0,
         request_timeout_s: float = 30.0,
         max_attempts: int = 3,
+        policy: Optional[ResiliencePolicy] = None,
     ):
         self._clients: Dict[str, InferenceClient] = {}
-        self._down: set = set()
         self._lock = threading.Lock()
         self._coordinator = coordinator
         self._role = role
@@ -65,6 +74,15 @@ class ReplicaGateway:
         self.hedge_after_s = max(0.0, hedge_after_ms) / 1e3
         self.request_timeout_s = request_timeout_s
         self.max_attempts = max(1, max_attempts)
+        # serving failover wants immediate replica switches, so the backoff
+        # base is tiny; the breaker re-close cadence tracks health probes
+        self.policy = policy if policy is not None else ResiliencePolicy(
+            retry=RetryPolicy(
+                max_attempts=self.max_attempts, base_s=0.002, max_s=0.05
+            ),
+            breaker_failure_threshold=1,
+            breaker_reset_s=health_interval_s,
+        )
         self._rr = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -97,12 +115,17 @@ class ReplicaGateway:
 
     def live_replicas(self) -> List[str]:
         with self._lock:
-            return [a for a in self._clients if a not in self._down]
+            addrs = list(self._clients)
+        return [a for a in addrs if self.policy.breaker(a).available()]
 
     def _mark_down(self, addr: str) -> None:
+        self.policy.breaker(addr).force_open()
+        self._update_live_gauge()
+
+    def _update_live_gauge(self) -> None:
         with self._lock:
-            self._down.add(addr)
-            self._m_live.set(len(self._clients) - len(self._down))
+            total = len(self._clients)
+        self._m_live.set(len(self.live_replicas()) if total else 0)
 
     def _probe_all(self) -> None:
         if self._coordinator is not None:
@@ -118,12 +141,12 @@ class ReplicaGateway:
                 ok = self._clients[addr].health().get("status") == "ok"
             except Exception:  # noqa: BLE001 — any probe failure = down
                 ok = False
-            with self._lock:
-                if ok:
-                    self._down.discard(addr)
-                else:
-                    self._down.add(addr)
-                self._m_live.set(len(self._clients) - len(self._down))
+            b = self.policy.breaker(addr)
+            if ok:
+                b.on_success()
+            else:
+                b.force_open()
+        self._update_live_gauge()
 
     def start(self) -> "ReplicaGateway":
         self._probe_all()  # synchronous first probe: start() returns routable
@@ -175,6 +198,10 @@ class ReplicaGateway:
             tried.add(addr)
             if attempt:
                 self._m_retries.inc()
+                # failover backoff rides the shared RetryPolicy (tiny base:
+                # serving wants an immediate replica switch, but repeated
+                # failures should not hot-spin the fleet)
+                time.sleep(self.policy.backoff(attempt - 1))
             try:
                 return self._one_attempt(addr, raw, tried, deadline_ms)
             except Exception as e:  # noqa: BLE001 — classify then fail over

@@ -9,14 +9,15 @@
 # 0. native cores compile from source + the fused-feed ABI parity tests
 #    pass (a broken ctypes signature loads fine and silently corrupts —
 #    only the golden parity tests catch it)
-# 1. full test suite green
-# 2. bench.py rc=0 (real chip when attached; emits partial records on a
+# 1. chaos suite, fast schedules (fault proxies, breakers, degraded mode)
+# 2. full test suite green
+# 3. bench.py rc=0 (real chip when attached; emits partial records on a
 #    degraded link rather than failing)
-# 3. dryrun_multichip(8) on a virtual CPU mesh
+# 4. dryrun_multichip(8) on a virtual CPU mesh
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 0/4 native build + ABI parity smoke =="
+echo "== 0/5 native build + ABI parity smoke =="
 # force=True recompile of every core: the stamp cache must not mask a
 # toolchain or source breakage
 JAX_PLATFORMS=cpu python - <<'PY'
@@ -28,13 +29,19 @@ for name, builder in (("ps", native_store.build_native),
 PY
 JAX_PLATFORMS=cpu python -m pytest tests/test_native_feed.py -q
 
-echo "== 1/4 test suite =="
+echo "== 1/5 chaos suite (fast schedules) =="
+# deterministic fault injection against live local services: proxies,
+# breakers, crc integrity, degraded-mode router, pending-ledger salts —
+# the fast subset; the full kill+resets bitwise run rides the slow suite
+JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_failure_recovery.py -q -m 'not slow'
+
+echo "== 2/5 test suite =="
 python -m pytest tests/ -q
 
-echo "== 2/4 bench (BENCH_MODE=${BENCH_MODE:-all}) =="
+echo "== 3/5 bench (BENCH_MODE=${BENCH_MODE:-all}) =="
 python bench.py
 
-echo "== 3/4 multichip dryrun =="
+echo "== 4/5 multichip dryrun =="
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun OK')"
 

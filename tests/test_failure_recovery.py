@@ -13,6 +13,12 @@ import time
 import numpy as np
 import pytest
 
+from persia_tpu.service.resilience import (
+    CircuitBreaker,
+    Deadline,
+    ResiliencePolicy,
+    RetryPolicy,
+)
 from persia_tpu.service.rpc import RpcClient, RpcError, RpcServer
 
 
@@ -77,6 +83,196 @@ def test_pool_bounds_connections_and_recovers_broken():
         assert client.call("ping", idempotent=True) == b"pong"
     finally:
         srv.stop()
+
+
+def _hard_stop(srv, *clients):
+    """Simulate a process death, not a graceful drain: stop the accept
+    loop, close the listener (so new connects are refused), and drop the
+    clients' pooled connections (their handler threads die with them)."""
+    srv.stop()
+    srv._server.server_close()
+    for c in clients:
+        rpc = getattr(c, "_rpc", c)
+        rpc.close()
+    time.sleep(0.05)
+
+
+# ------------------------------------------------- breaker trip / half-open
+
+
+def test_breaker_unit_trip_half_open_reclose():
+    """State machine: threshold consecutive failures open the breaker; the
+    reset window grants exactly ONE half-open probe; probe success
+    re-closes, probe failure re-opens."""
+    b = CircuitBreaker("ep", failure_threshold=3, reset_timeout_s=0.1)
+    assert b.state == "closed" and b.allow()
+    b.on_failure()
+    b.on_failure()
+    assert b.state == "closed"  # under threshold
+    b.on_failure()
+    assert b.state == "open" and b.trips == 1
+    assert not b.allow()  # open: fail fast
+    time.sleep(0.12)
+    assert b.state == "half_open"
+    assert b.allow()       # the one probe slot
+    assert not b.allow()   # second caller in the window is rejected
+    b.on_failure()         # probe failed → re-open (counts a trip)
+    assert b.state == "open" and b.trips == 2
+    time.sleep(0.12)
+    assert b.allow()
+    b.on_success()         # probe succeeded → closed, counters reset
+    assert b.state == "closed" and b.allow()
+
+
+def test_client_breaker_trip_then_recovery_recloses():
+    """RPC-level breaker lifecycle: a dead endpoint trips the breaker
+    (subsequent calls fail FAST, no connect timeout), and the endpoint
+    coming back re-closes it through the ping probe path."""
+    srv = RpcServer(port=0).start()
+    port = srv.port
+    policy = ResiliencePolicy(
+        retry=RetryPolicy(max_attempts=2, base_s=0.01, max_s=0.02),
+        breaker_failure_threshold=2, breaker_reset_s=0.15,
+    )
+    client = RpcClient(f"127.0.0.1:{port}", timeout_s=2.0, policy=policy)
+    assert client.call("ping") == b"pong"
+    _hard_stop(srv, client)
+    breaker = policy.breaker(client.endpoint)
+    for _ in range(3):
+        with pytest.raises(RpcError):
+            client.call("ping2", idempotent=True)  # not ping: breaker applies
+    assert breaker.state in ("open", "half_open")
+    assert breaker.trips >= 1
+    # open breaker = fail fast (no 2s connect timeout per call)
+    t0 = time.perf_counter()
+    with pytest.raises(RpcError):
+        client.call("ping2")
+    assert time.perf_counter() - t0 < 1.0
+    # endpoint returns on the SAME port: ping (breaker-exempt) succeeds and
+    # re-closes the breaker
+    srv2 = RpcServer(port=port).start()
+    try:
+        client.wait_ready(timeout_s=10)
+        assert breaker.state == "closed"
+    finally:
+        srv2.stop()
+
+
+def test_deadline_budget_bounds_call():
+    """A per-call Deadline caps the attempt's socket timeout: a wedged
+    handler costs the caller its budget, not the full client timeout."""
+    srv = _slow_server(5.0)  # handler far slower than the budget
+    try:
+        client = RpcClient(f"127.0.0.1:{srv.port}", timeout_s=30.0)
+        t0 = time.perf_counter()
+        with pytest.raises(RpcError):
+            client.call("slow", deadline=Deadline.after(0.2))
+        assert time.perf_counter() - t0 < 2.0
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------- degraded lookup + reconcile
+
+
+def _ps_service(store, port=0):
+    from persia_tpu.service.ps_server import ParameterServerService
+
+    return ParameterServerService(store, native_server=False, port=port).start()
+
+
+def test_degraded_lookup_then_reconcile():
+    """Shard dies past the degrade budget → lookups serve DETERMINISTIC
+    init vectors and the signs' gradients are dropped; shard returns →
+    the next live lookup reconciles the record and gradients apply
+    again."""
+    from persia_tpu.config import HyperParameters
+    from persia_tpu.embedding.hashing import init_for_signs
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.embedding.store import EmbeddingStore
+    from persia_tpu.embedding.worker import ShardedLookup
+    from persia_tpu.service.clients import StoreClient
+
+    seed, dim = 11, 8
+    method = HyperParameters().resolved_init_method()
+    store = EmbeddingStore(
+        capacity=1 << 12, num_internal_shards=2, seed=seed,
+        optimizer=Adagrad(lr=0.5).config,
+    )
+    svc = _ps_service(store)
+    port = svc.port
+    policy = ResiliencePolicy(
+        retry=RetryPolicy(max_attempts=2, base_s=0.01, max_s=0.02),
+        breaker_failure_threshold=2, breaker_reset_s=0.1,
+        degrade_after_s=0.3, max_degraded_frac=1.0,
+    )
+    client = StoreClient(f"127.0.0.1:{port}", timeout_s=2.0, policy=policy)
+    router = ShardedLookup(
+        [client], policy=policy,
+        degraded_init=lambda s, d: init_for_signs(s, seed, d, method),
+    )
+    signs = np.array([3, 9, 17], dtype=np.uint64)
+    init_vals = init_for_signs(signs, seed, dim, method)
+    # admit + train so the REAL rows differ from the init vectors
+    first = router.lookup(signs, dim, train=True)
+    np.testing.assert_array_equal(first, init_vals)
+    router.update(signs, np.ones((3, dim), np.float32), 0)
+    trained = router.lookup(signs, dim, train=True)
+    assert np.abs(trained - init_vals).max() > 1e-3
+
+    _hard_stop(svc.server, client)
+    # degraded: deterministic init vectors, NOT zeros, NOT an exception
+    degraded = router.lookup(signs, dim, train=True)
+    np.testing.assert_array_equal(degraded, init_vals)
+    assert router.degraded_intersection(signs).all()
+    d, t = router.take_degraded_window()
+    assert d == len(signs) and t >= len(signs)
+
+    # shard returns (same store object, same port: state intact)
+    svc2 = _ps_service(store, port=port)
+    try:
+        client.wait_ready(timeout_s=10)
+        # gradients computed against the degraded forward are DROPPED
+        before = router._m_deg_grad_dropped.get()
+        snapshot = store.lookup(signs, dim, train=False).copy()
+        router.update(signs, np.ones((3, dim), np.float32), 0)
+        assert router._m_deg_grad_dropped.get() - before == len(signs)
+        np.testing.assert_array_equal(
+            store.lookup(signs, dim, train=False), snapshot
+        )
+        # a live lookup reconciles; the NEXT gradient applies again
+        live = router.lookup(signs, dim, train=True)
+        np.testing.assert_array_equal(live, trained)
+        assert not router.degraded_intersection(signs).any()
+        router.update(signs, np.ones((3, dim), np.float32), 0)
+        assert np.abs(
+            store.lookup(signs, dim, train=False) - snapshot
+        ).max() > 1e-4
+    finally:
+        svc2.server.stop()
+
+
+def test_degraded_abort_threshold():
+    """A call whose degraded fraction exceeds max_degraded_frac raises
+    instead of silently training on synthetic embeddings."""
+    from persia_tpu.embedding.store import EmbeddingStore
+    from persia_tpu.embedding.worker import ShardedLookup
+    from persia_tpu.service.clients import StoreClient
+
+    store = EmbeddingStore(capacity=1 << 10, num_internal_shards=2, seed=0)
+    svc = _ps_service(store)
+    policy = ResiliencePolicy(
+        retry=RetryPolicy(max_attempts=1, base_s=0.01, max_s=0.02),
+        breaker_failure_threshold=1, breaker_reset_s=0.05,
+        degrade_after_s=0.1, max_degraded_frac=0.5,
+    )
+    client = StoreClient(f"127.0.0.1:{svc.port}", timeout_s=1.0, policy=policy)
+    router = ShardedLookup([client], policy=policy)
+    signs = np.arange(1, 9, dtype=np.uint64)
+    router.lookup(signs, 4, train=True)
+    _hard_stop(svc.server, client)
+    with pytest.raises(RuntimeError, match="degraded_lookup_frac"):
+        router.lookup(signs, 4, train=True)
 
 
 # --------------------------------------------------------- PS kill + restart

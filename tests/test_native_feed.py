@@ -71,6 +71,58 @@ def test_feed_batch_matches_python_orchestration(seed):
             pmap.remove(es_f[: len(es_f) // 2], token=token)
 
 
+@pytest.mark.parametrize("seed", [0, 7])
+def test_feed_batch_salted_probe_matches_python(seed):
+    """The fused probe's per-group salt (``cache_feed_batch``'s trailing
+    argument) must agree EXACTLY with the Python map methods' salting —
+    same admits, same restore hits under a namespaced ledger, and zero
+    cross-namespace hits."""
+    from persia_tpu.embedding.hbm_cache.directory import group_salt
+
+    rng = np.random.default_rng(seed)
+    salt_a, salt_b = group_salt("cache_d8"), group_salt("cache_d16")
+    d_fused = CacheDirectory(256)
+    d_ref = CacheDirectory(256)
+    pmap = PendingSignMap()
+    for step in range(15):
+        signs = rng.integers(0, 250, int(rng.integers(1, 600)), dtype=np.uint64)
+        (rows_f, ms_f, _mr, es_f, _er, nu_f,
+         rst_src, rst_pos) = d_fused.feed_batch(signs, pmap, salt=salt_a)
+        rows_f = rows_f.copy()
+        rows_r, ms_r, _mr2, es_r, _er2, nu_r = d_ref.admit_positions(signs)
+        ref_src, ref_pos = _python_reference_probe_salted(pmap, ms_r, salt_a)
+        np.testing.assert_array_equal(rows_f, rows_r)
+        np.testing.assert_array_equal(ms_f, ms_r)
+        assert nu_f == nu_r
+        np.testing.assert_array_equal(rst_src, ref_src)
+        np.testing.assert_array_equal(rst_pos, ref_pos)
+        if len(es_f):
+            # same raw signs pending under BOTH namespaces, different rows
+            pmap.insert_range(es_f, base_src=step * 1024, token=step + 1,
+                              salt=salt_a)
+            pmap.insert_range(es_f, base_src=step * 1024 + 512,
+                              token=step + 1, salt=salt_b)
+
+    # the other namespace never leaks into this group's probe
+    if len(ms_f):
+        _h, _t, srcs_b = pmap.query(ms_f, salt=salt_b)
+        live_b = ms_f[srcs_b >= 0]
+        if len(live_b):
+            # those signs resolve to the B-namespace rows (base+512), and
+            # the fused A-probe resolved the A rows — never B's
+            assert ((srcs_b[srcs_b >= 0] % 1024) >= 512).all()
+    if len(rst_src):
+        assert ((rst_src % 1024) < 512).all()
+
+
+def _python_reference_probe_salted(pmap, miss_signs, salt):
+    if not len(miss_signs):
+        return np.empty(0, np.int64), np.empty(0, np.int64)
+    _hits, _tokens, srcs = pmap.query(miss_signs, salt=salt)
+    pos = np.nonzero(srcs >= 0)[0].astype(np.int64)
+    return srcs[pos], pos
+
+
 def test_feed_batch_without_ledger_matches_admit_positions():
     rng = np.random.default_rng(3)
     d1, d2 = CacheDirectory(128), CacheDirectory(128)
