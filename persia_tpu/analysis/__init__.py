@@ -1,0 +1,93 @@
+"""persia-lint: static correctness tooling for the hybrid training plane.
+
+``python -m persia_tpu.analysis`` runs three passes and exits nonzero on
+any finding:
+
+- **ABI drift** (ABI000–ABI008): every ctypes binding in the repo
+  cross-checked against the ``extern "C"`` surface of the five native
+  libraries — arity, int-width/pointer-class agreement, missing/mismatched
+  ``restype``, bindings to non-exported symbols, exports with no binding,
+  untyped foreign calls. See :mod:`persia_tpu.analysis.abi`.
+- **Concurrency** (CONC001–CONC004): bare ``acquire`` outside ``with``,
+  permits/ring-spans not released on exception paths, blocking calls made
+  under a lock, lock-order inversions against the declared registry
+  (:mod:`persia_tpu.analysis.lock_order`).
+- **Resilience policy** (RES001–RES004): raw sleeps, constant socket
+  timeouts, ad-hoc retry loops and manual wall-clock deadlines in
+  ``service/``+``serving/`` that bypass ``service/resilience.py``.
+
+Suppress a finding inline with ``# persia-lint: disable=RULE`` (or
+``disable=all``) on the offending line; C sources use the same token in a
+``//`` comment. Pure stdlib — no jax, numpy, or toolchain required.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from persia_tpu.analysis.common import (
+    BINDING_FILES,
+    CTYPES_FILES,
+    NATIVE_LIBS,
+    REPO_ROOT,
+    Finding,
+    apply_suppressions,
+    python_files,
+    read_text,
+    rel,
+)
+
+__all__ = [
+    "Finding",
+    "run_all",
+    "BINDING_FILES",
+    "CTYPES_FILES",
+    "NATIVE_LIBS",
+]
+
+_PASS_PREFIXES = ("ABI", "CONC", "RES")
+
+
+def run_all(
+    root: str = REPO_ROOT, rules: Optional[Sequence[str]] = None,
+) -> Tuple[List[Finding], Dict[str, object]]:
+    """Run every pass over the repo. Returns (findings after suppression,
+    coverage report). ``rules`` filters by rule-id prefix (e.g. ["ABI"])."""
+    from persia_tpu.analysis import abi, concurrency, resilience_lint
+
+    wanted = tuple(r.upper() for r in rules) if rules else _PASS_PREFIXES
+    findings: List[Finding] = []
+    coverage: Dict[str, object] = {}
+
+    if any(w.startswith("ABI") for w in wanted):
+        abi_findings, abi_cov = abi.check(root)
+        findings.extend(abi_findings)
+        coverage["abi"] = abi_cov
+    py_files = python_files(root)
+    if any(w.startswith("CONC") for w in wanted):
+        findings.extend(concurrency.check(root, py_files))
+    if any(w.startswith("RES") for w in wanted):
+        findings.extend(resilience_lint.check(root))
+    coverage["python_files_scanned"] = len(py_files)
+    coverage["ctypes_files"] = [p for p in CTYPES_FILES
+                                if any(rel(f) == p for f in py_files)]
+
+    # rule-id filter (exact ids also allowed, e.g. --rules RES001)
+    findings = [
+        f for f in findings
+        if any(f.rule.startswith(w) or f.rule == w for w in wanted)
+    ]
+
+    texts: Dict[str, str] = {}
+    for f in findings:
+        if f.path not in texts:
+            import os
+
+            abspath = f.path if os.path.isabs(f.path) else os.path.join(root, f.path)
+            try:
+                texts[f.path] = read_text(abspath)
+            except OSError:
+                texts[f.path] = ""
+    findings = apply_suppressions(findings, texts)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, coverage

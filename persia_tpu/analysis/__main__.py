@@ -1,0 +1,79 @@
+"""CLI: ``python -m persia_tpu.analysis`` — exit nonzero on findings."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from persia_tpu.analysis import run_all
+from persia_tpu.analysis.common import BINDING_FILES, NATIVE_LIBS, REPO_ROOT
+
+_RULE_DOC = {
+    "ABI000": "native source unparseable / registry broken (coverage lost)",
+    "ABI001": "ctypes argtypes arity differs from the C parameter list",
+    "ABI002": "ctypes argument type mismatch (width / kind / pointer class)",
+    "ABI003": "missing restype (c_int default truncates 64-bit/pointer returns)",
+    "ABI004": "declared restype disagrees with the C return type",
+    "ABI005": "binding targets a symbol the library does not export",
+    "ABI006": "exported symbol with no ctypes binding anywhere",
+    "ABI007": "bound symbol never declares argtypes",
+    "ABI008": "call through a CDLL handle with no argtypes in that file",
+    "CONC001": "lock acquired with bare .acquire() instead of `with`",
+    "CONC002": "permit/ring-span not released on the exception path",
+    "CONC003": "blocking call (sleep/socket/native) while holding a lock",
+    "CONC004": "lock-order inversion vs analysis/lock_order.py registry",
+    "RES001": "constant time.sleep bypassing resilience.RetryPolicy",
+    "RES002": "constant socket timeout bypassing resilience.Deadline.cap",
+    "RES003": "ad-hoc retry loop outside resilience (swallow+sleep)",
+    "RES004": "manual wall-clock deadline instead of resilience.Deadline",
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m persia_tpu.analysis",
+        description="persia-lint: ABI drift + concurrency + resilience checks",
+    )
+    ap.add_argument("--rules", help="comma-separated rule ids or prefixes "
+                    "(e.g. ABI or RES001); default: all")
+    ap.add_argument("--root", default=REPO_ROOT, help="repo root to scan")
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, doc in _RULE_DOC.items():
+            print(f"{rid}  {doc}")
+        return 0
+
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    findings, coverage = run_all(args.root, rules)
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.__dict__ for f in findings],
+            "coverage": coverage,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        from persia_tpu.analysis.common import CTYPES_FILES
+
+        abi_cov = coverage.get("abi", {})
+        lib_counts = abi_cov.get("libs", {}) if isinstance(abi_cov, dict) else {}
+        print(
+            f"persia-lint: {len(findings)} finding(s); "
+            f"{len(lib_counts)}/{len(NATIVE_LIBS)} native libs "
+            f"({sum(lib_counts.values())} exports), "
+            f"{len(abi_cov.get('binding_files', [])) if isinstance(abi_cov, dict) else 0}"
+            f"/{len(BINDING_FILES)} binding files, "
+            f"{len(coverage.get('ctypes_files', []))}/{len(CTYPES_FILES)} "
+            f"ctypes files, "
+            f"{coverage.get('python_files_scanned', 0)} python files scanned"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,503 @@
+"""ABI drift checker: ctypes bindings vs the native ``extern "C"`` surface.
+
+The hybrid protocol's correctness rests on hand-maintained ctypes
+signatures: a drifted ``argtypes`` reads the wrong registers, a missing
+``restype`` silently defaults to ``c_int`` and truncates 64-bit returns
+(pointers become garbage handles on the NEXT call, not this one), and a
+binding to a renamed symbol only explodes at call time on whatever host
+first takes that code path. This pass cross-checks every binding without
+importing the bound modules (no jax, no .so load, no toolchain):
+
+- the C side comes from :mod:`persia_tpu.analysis.cparse` over each lib's
+  sources (registry: ``common.NATIVE_LIBS``);
+- the Python side comes from an AST walk that tracks ``ctypes.CDLL``
+  handles, resolves the ``_SO``/``_SRC`` module constants to a lib, builds
+  a symbolic ctypes-type environment (including tuple assigns like
+  ``u64, u32 = ctypes.c_uint64, ctypes.c_uint32`` and ``POINTER`` /
+  ``CFUNCTYPE`` aliases), and records every ``lib.sym.argtypes`` /
+  ``lib.sym.restype`` assignment and every ``lib.sym(...)`` call site.
+
+Rules:
+
+- ABI001 arity mismatch between argtypes and the C parameter list
+- ABI002 argument type mismatch (int width / float-vs-int / pointer class)
+- ABI003 missing restype (c_int default: truncates 64-bit/pointer returns;
+         void functions must declare ``restype = None`` so a later C-side
+         return-type change cannot hide behind the default)
+- ABI004 declared restype disagrees with the C return type
+- ABI005 binding targets a symbol the library does not export
+- ABI006 exported symbol with no ctypes binding anywhere
+- ABI007 bound symbol never declares argtypes (declare ``[]`` for
+         zero-argument functions)
+- ABI008 call through a CDLL handle to a symbol with no argtypes in that
+         file (untyped foreign call — every argument silently becomes the
+         ctypes default conversion)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from persia_tpu.analysis import cparse
+from persia_tpu.analysis.common import (
+    BINDING_FILES,
+    NATIVE_LIBS,
+    REPO_ROOT,
+    Finding,
+    read_text,
+    rel,
+)
+
+TypeDesc = cparse.TypeDesc
+
+# ctypes primitive name -> canonical descriptor
+_CTYPES_MAP: Dict[str, TypeDesc] = {
+    "c_void_p": ("ptr", ("void",)),
+    "c_char_p": ("ptr", ("int", 8, True)),
+    "c_bool": ("int", 8, False),
+    "c_int8": ("int", 8, True),
+    "c_uint8": ("int", 8, False),
+    "c_byte": ("int", 8, True),
+    "c_ubyte": ("int", 8, False),
+    "c_char": ("int", 8, True),
+    "c_int16": ("int", 16, True),
+    "c_uint16": ("int", 16, False),
+    "c_short": ("int", 16, True),
+    "c_ushort": ("int", 16, False),
+    "c_int": ("int", 32, True),
+    "c_uint": ("int", 32, False),
+    "c_int32": ("int", 32, True),
+    "c_uint32": ("int", 32, False),
+    "c_long": ("int", 64, True),
+    "c_ulong": ("int", 64, False),
+    "c_int64": ("int", 64, True),
+    "c_uint64": ("int", 64, False),
+    "c_longlong": ("int", 64, True),
+    "c_ulonglong": ("int", 64, False),
+    "c_size_t": ("int", 64, False),
+    "c_ssize_t": ("int", 64, True),
+    "c_float": ("float", 32),
+    "c_double": ("float", 64),
+}
+
+
+@dataclass
+class Binding:
+    symbol: str
+    lib: str  # lib key (e.g. "libpersia_ps.so")
+    path: str  # repo-relative binding file
+    restype: Optional[TypeDesc] = None  # ("void",) means explicit None
+    restype_line: int = 0
+    argtypes: Optional[List[TypeDesc]] = None
+    argtypes_computed: bool = False  # non-literal argtypes expr (flagged)
+    argtypes_line: int = 0
+    first_line: int = 0
+
+
+@dataclass
+class FileScan:
+    path: str
+    libs: Set[str] = field(default_factory=set)
+    bindings: Dict[Tuple[str, str], Binding] = field(default_factory=dict)
+    foreign_declared: Set[str] = field(default_factory=set)  # typed syms on
+    # non-registry handles (libc etc.) — exempt from ABI008, not cross-checked
+    findings: List[Finding] = field(default_factory=list)
+
+
+class _TypeEnv:
+    """Best-effort symbolic evaluation of ctypes type expressions."""
+
+    def __init__(self):
+        self.names: Dict[str, TypeDesc] = {}
+
+    def assign(self, target: ast.expr, value: ast.expr) -> None:
+        if isinstance(target, ast.Name):
+            desc = self.eval(value)
+            if desc is not None:
+                self.names[target.id] = desc
+        elif isinstance(target, ast.Tuple) and isinstance(value, ast.Tuple):
+            if len(target.elts) == len(value.elts):
+                for t, v in zip(target.elts, value.elts):
+                    self.assign(t, v)
+
+    def eval(self, node: ast.expr) -> Optional[TypeDesc]:
+        if isinstance(node, ast.Constant) and node.value is None:
+            return ("void",)
+        if isinstance(node, ast.Name):
+            if node.id in self.names:
+                return self.names[node.id]
+            return _CTYPES_MAP.get(node.id)
+        if isinstance(node, ast.Attribute):
+            return _CTYPES_MAP.get(node.attr)
+        if isinstance(node, ast.Call):
+            fname = _call_name(node)
+            if fname == "POINTER" and node.args:
+                inner = self.eval(node.args[0])
+                return ("ptr", inner if inner is not None else ("void",))
+            if fname in ("CFUNCTYPE", "PYFUNCTYPE", "WINFUNCTYPE"):
+                return ("funcptr",)
+        return None
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _expr_str_value(node: ast.expr, consts: Dict[str, object]):
+    """Resolve a string-ish expression: literal, Name of a tracked module
+    constant, os.path.join(...) (last string component wins), list of the
+    above."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    if isinstance(node, ast.Call) and _call_name(node) == "join":
+        parts = [_expr_str_value(a, consts) for a in node.args]
+        strs = [p for p in parts if isinstance(p, str)]
+        return strs[-1] if strs else None
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return [_expr_str_value(e, consts) for e in node.elts]
+    return None
+
+
+def _basename_lib(value, known: Optional[Dict[str, List[str]]] = None) -> Optional[str]:
+    """Map a resolved _SO-ish string to a registry lib key."""
+    if not isinstance(value, str):
+        return None
+    base = os.path.basename(value)
+    return base if base in (NATIVE_LIBS if known is None else known) else None
+
+
+class _BindingVisitor(ast.NodeVisitor):
+    """One pass over a binding file: CDLL handle tracking + binding
+    assignment extraction + untyped-call detection (ABI008)."""
+
+    def __init__(self, path: str, known_libs: Optional[Dict[str, List[str]]] = None):
+        self.path = path
+        self.env = _TypeEnv()
+        self.consts: Dict[str, object] = {}
+        self.handles: Dict[str, Optional[str]] = {}  # var name -> lib key (None = foreign/libc)
+        self.known_libs = known_libs
+        self.scan = FileScan(path=path)
+        self.calls: List[Tuple[str, str, int]] = []  # (handle var, symbol, line)
+
+    # -- assignments ------------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        value = node.value
+        for target in node.targets:
+            # lib = ctypes.CDLL(...)
+            if (
+                isinstance(target, ast.Name)
+                and isinstance(value, ast.Call)
+                and _call_name(value) == "CDLL"
+            ):
+                libkey = None
+                explicit_foreign = False
+                if value.args:
+                    arg = value.args[0]
+                    if isinstance(arg, ast.Constant) and arg.value is None:
+                        explicit_foreign = True  # CDLL(None) == libc
+                    resolved = _expr_str_value(arg, self.consts)
+                    libkey = _basename_lib(resolved, self.known_libs)
+                if libkey is None and not explicit_foreign:
+                    # the loaders CDLL the path build_so() RETURNS (so the
+                    # sanitizer variant takes effect); the argument is then
+                    # a local var the tracker cannot evaluate. Fall back to
+                    # the file's unique known-lib module constant (_SO).
+                    libs = {
+                        bk
+                        for v in self.consts.values()
+                        if (bk := _basename_lib(v, self.known_libs)) is not None
+                    }
+                    if len(libs) == 1:
+                        libkey = libs.pop()
+                self.handles[target.id] = libkey
+            # module-ish constants (also picked up inside functions: the
+            # loader files assign _SO at module level, tests may not)
+            elif isinstance(target, ast.Name):
+                resolved = _expr_str_value(value, self.consts)
+                if resolved is None and isinstance(value, ast.Call):
+                    # so_path = build_so(_SRCS, _SO, ...): the build returns
+                    # a (possibly variant-suffixed) path to the lib named in
+                    # its arguments — propagate that lib through the var
+                    for a in value.args:
+                        cand = _basename_lib(_expr_str_value(a, self.consts), self.known_libs)
+                        if cand is not None:
+                            resolved = cand
+                            break
+                if resolved is not None:
+                    self.consts[target.id] = resolved
+                self.env.assign(target, value)
+            elif isinstance(target, ast.Tuple):
+                self.env.assign(target, value)
+            # lib.sym.restype / lib.sym.argtypes
+            if (
+                isinstance(target, ast.Attribute)
+                and target.attr in ("restype", "argtypes")
+                and isinstance(target.value, ast.Attribute)
+                and isinstance(target.value.value, ast.Name)
+            ):
+                handle = target.value.value.id
+                if handle not in self.handles:
+                    continue
+                libkey = self.handles[handle]
+                symbol = target.value.attr
+                if libkey is None:
+                    # foreign lib (libc etc.): typing it satisfies ABI008,
+                    # but there is no C surface to cross-check against
+                    self.scan.foreign_declared.add(symbol)
+                    continue
+                b = self.scan.bindings.setdefault(
+                    (libkey, symbol),
+                    Binding(symbol=symbol, lib=libkey, path=self.path,
+                            first_line=node.lineno),
+                )
+                if target.attr == "restype":
+                    desc = self.env.eval(value)
+                    b.restype = desc if desc is not None else ("opaque", ast.dump(value)[:40])
+                    b.restype_line = node.lineno
+                else:
+                    if isinstance(value, (ast.List, ast.Tuple)):
+                        descs: List[TypeDesc] = []
+                        for elt in value.elts:
+                            d = self.env.eval(elt)
+                            descs.append(d if d is not None else ("opaque", ast.unparse(elt)[:40]))
+                        b.argtypes = descs
+                    else:
+                        b.argtypes_computed = True  # arity unverifiable
+                        self.scan.findings.append(Finding(
+                            "ABI002", self.path, node.lineno,
+                            f"argtypes for {symbol} is not a literal list — "
+                            "the checker (and the reader) cannot verify it",
+                        ))
+                    b.argtypes_line = node.lineno
+        self.generic_visit(node)
+
+    # -- calls ------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id in self.handles
+        ):
+            self.calls.append((f.value.id, f.attr, node.lineno))
+        self.generic_visit(node)
+
+
+def _int_compatible(py: TypeDesc, c: TypeDesc) -> bool:
+    # width must agree; signedness is ABI-neutral on every supported target
+    return py[1] == c[1]
+
+
+def _ptr_compatible(py: TypeDesc, c: TypeDesc) -> bool:
+    pin, cin = py[1], c[1]
+    if pin == ("void",) or cin == ("void",):
+        return True  # void* matches any object pointer
+    if pin[0] == "ptr" or cin[0] == "ptr":
+        # pointer-to-pointer: both sides must be pointers (inner void matches)
+        return pin[0] == cin[0] or pin == ("void",) or cin == ("void",)
+    if pin[0] == "opaque" or cin[0] == "opaque":
+        return True
+    if pin[0] == "int" and cin[0] == "int":
+        return pin[1] == cin[1]
+    return pin == cin
+
+
+def _compatible(py: TypeDesc, c: TypeDesc) -> bool:
+    if py[0] == "opaque" or c[0] == "opaque":
+        return True  # lenient: surfaced via parse warnings, not per-arg noise
+    if py[0] == "funcptr":
+        return c[0] in ("funcptr", "ptr")
+    if c[0] == "funcptr":
+        return py[0] in ("funcptr", "ptr") or py == ("ptr", ("void",))
+    if py[0] == "ptr" and c[0] == "ptr":
+        return _ptr_compatible(py, c)
+    if py[0] == "int" and c[0] == "int":
+        return _int_compatible(py, c)
+    return py[0] == c[0] and py[1:2] == c[1:2]
+
+
+def load_native_surface(
+    root: str = REPO_ROOT, libs: Optional[Dict[str, List[str]]] = None,
+) -> Tuple[Dict[str, Dict[str, cparse.CFunc]], List[Finding]]:
+    """Parse every registered lib's sources. Returns
+    ({lib: {symbol: CFunc}}, findings-for-parse-problems)."""
+    libs = NATIVE_LIBS if libs is None else libs
+    surface: Dict[str, Dict[str, cparse.CFunc]] = {}
+    findings: List[Finding] = []
+    parsed_cache: Dict[str, Tuple[List[cparse.CFunc], List[str]]] = {}
+    for lib, sources in libs.items():
+        exports: Dict[str, cparse.CFunc] = {}
+        for src in sources:
+            path = os.path.join(root, src)
+            if src not in parsed_cache:
+                if not os.path.exists(path):
+                    findings.append(Finding(
+                        "ABI000", src, 1, "registered native source is missing"))
+                    parsed_cache[src] = ([], [])
+                else:
+                    parsed_cache[src] = cparse.parse_extern_c(read_text(path), src)
+            funcs, warns = parsed_cache[src]
+            for w in warns:
+                wpath, _, rest = w.partition(":")
+                lineno = 1
+                msg = rest
+                head, _, tail = rest.partition(":")
+                if head.strip().isdigit():
+                    lineno, msg = int(head), tail.strip()
+                findings.append(Finding("ABI000", wpath, lineno, msg.strip()))
+            for fn in funcs:
+                prev = exports.get(fn.name)
+                if prev is not None and (prev.ret, prev.params) != (fn.ret, fn.params):
+                    findings.append(Finding(
+                        "ABI000", fn.path, fn.line,
+                        f"{fn.name} declared with a different signature in "
+                        f"{prev.path}:{prev.line} (same library {lib})",
+                    ))
+                exports.setdefault(fn.name, fn)
+        if not exports:
+            findings.append(Finding(
+                "ABI000", sources[0] if sources else lib, 1,
+                f"{lib}: parsed zero extern \"C\" exports — coverage lost"))
+        surface[lib] = exports
+    return surface, findings
+
+
+def scan_binding_file(
+    path: str, known_libs: Optional[Dict[str, List[str]]] = None,
+) -> Tuple[FileScan, List[Tuple[str, str, int]]]:
+    abspath = path if os.path.isabs(path) else os.path.join(REPO_ROOT, path)
+    text = read_text(abspath)
+    tree = ast.parse(text, filename=path)
+    visitor = _BindingVisitor(rel(abspath), known_libs)
+    visitor.visit(tree)
+    visitor.scan.libs = {lk for lk in visitor.handles.values() if lk}
+    return visitor.scan, visitor.calls
+
+
+def check(
+    root: str = REPO_ROOT,
+    binding_files: Optional[Sequence[str]] = None,
+    libs: Optional[Dict[str, List[str]]] = None,
+) -> Tuple[List[Finding], Dict[str, object]]:
+    """Run the full ABI cross-check. Returns (findings, coverage report)."""
+    binding_files = list(BINDING_FILES if binding_files is None else binding_files)
+    surface, findings = load_native_surface(root, libs)
+
+    scans: List[FileScan] = []
+    all_calls: Dict[str, List[Tuple[str, str, int]]] = {}
+    for bf in binding_files:
+        abspath = bf if os.path.isabs(bf) else os.path.join(root, bf)
+        if not os.path.exists(abspath):
+            findings.append(Finding("ABI000", bf, 1, "registered binding file is missing"))
+            continue
+        scan, calls = scan_binding_file(abspath, libs)
+        scans.append(scan)
+        all_calls[scan.path] = calls
+        findings.extend(scan.findings)
+
+    bound_symbols: Set[str] = set()
+    for scan in scans:
+        for (libkey, symbol), b in sorted(scan.bindings.items()):
+            exports = surface.get(libkey, {})
+            fn = exports.get(symbol)
+            anchor = b.argtypes_line or b.restype_line or b.first_line
+            if fn is None:
+                findings.append(Finding(
+                    "ABI005", scan.path, anchor,
+                    f"{symbol} is not exported by {libkey} "
+                    f"(sources: {', '.join((libs or NATIVE_LIBS)[libkey])})",
+                ))
+                continue
+            bound_symbols.add(symbol)
+            # restype
+            if b.restype is None:
+                want = cparse.describe(fn.ret)
+                hazard = (
+                    "truncates the 64-bit return to c_int"
+                    if fn.ret[0] == "ptr" or (fn.ret[0] == "int" and fn.ret[1] == 64)
+                    else "defaults to c_int"
+                    if fn.ret != ("void",)
+                    else "declare restype = None so a future C return-type "
+                    "change cannot hide behind the c_int default"
+                )
+                findings.append(Finding(
+                    "ABI003", scan.path, anchor,
+                    f"{symbol}: missing restype — C returns {want}; {hazard}",
+                ))
+            elif fn.ret == ("void",):
+                if b.restype != ("void",):
+                    findings.append(Finding(
+                        "ABI004", scan.path, b.restype_line or anchor,
+                        f"{symbol}: restype {cparse.describe(b.restype)} but C "
+                        "returns void (use restype = None)",
+                    ))
+            elif b.restype == ("void",) or not _compatible(b.restype, fn.ret):
+                findings.append(Finding(
+                    "ABI004", scan.path, b.restype_line or anchor,
+                    f"{symbol}: restype {cparse.describe(b.restype)} but C "
+                    f"returns {cparse.describe(fn.ret)}",
+                ))
+            # argtypes
+            if b.argtypes is None:
+                if not b.argtypes_computed:  # computed → already ABI002
+                    findings.append(Finding(
+                        "ABI007", scan.path, anchor,
+                        f"{symbol}: no argtypes declared (C takes "
+                        f"{len(fn.params)} args — declare [] if zero)",
+                    ))
+                continue
+            if len(b.argtypes) != len(fn.params):
+                findings.append(Finding(
+                    "ABI001", scan.path, b.argtypes_line or anchor,
+                    f"{symbol}: argtypes has {len(b.argtypes)} entries but C "
+                    f"takes {len(fn.params)}",
+                ))
+            else:
+                for i, (py, c) in enumerate(zip(b.argtypes, fn.params)):
+                    if not _compatible(py, c):
+                        findings.append(Finding(
+                            "ABI002", scan.path, b.argtypes_line or anchor,
+                            f"{symbol}: arg {i} is {cparse.describe(py)} but C "
+                            f"takes {cparse.describe(c)}",
+                        ))
+
+    # ABI006: exported but never bound anywhere
+    for libkey in sorted(surface):
+        for symbol, fn in sorted(surface[libkey].items()):
+            if symbol not in bound_symbols:
+                findings.append(Finding(
+                    "ABI006", fn.path, fn.line,
+                    f"{symbol} is exported by {libkey} but has no ctypes "
+                    "binding in any registered binding file",
+                ))
+
+    # ABI008: untyped calls through a CDLL handle
+    for scan in scans:
+        declared = {sym for (_lk, sym) in scan.bindings} | scan.foreign_declared
+        for handle, symbol, line in all_calls.get(scan.path, ()):
+            if symbol in declared or symbol in ("restype", "argtypes"):
+                continue
+            findings.append(Finding(
+                "ABI008", scan.path, line,
+                f"call to {symbol} through CDLL handle {handle!r} with no "
+                "argtypes/restype declared in this file (untyped foreign call)",
+            ))
+
+    coverage = {
+        "libs": {lk: len(surface.get(lk, {})) for lk in (libs or NATIVE_LIBS)},
+        "binding_files": [s.path for s in scans],
+        "bindings": sum(len(s.bindings) for s in scans),
+    }
+    return findings, coverage

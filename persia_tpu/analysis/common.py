@@ -1,0 +1,132 @@
+"""Shared plumbing for the persia-lint passes.
+
+A finding is (rule, file, line, message). Every pass returns a list of
+findings; the CLI exits nonzero when any survive suppression. Suppression
+is inline and per-line in both languages::
+
+    something_flagged()  # persia-lint: disable=RES001
+    do_native_call();    // persia-lint: disable=ABI006
+    risky()              # persia-lint: disable=all
+
+The passes are pure stdlib (ast + re) by design: the lint must run on a
+toolchain-less host in well under a second, so it can gate every commit
+(scripts/round_preflight.sh) without jax, numpy, or clang anywhere near it.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Sequence, Set
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_SUPPRESS_RE = re.compile(r"persia-lint:\s*disable=([A-Za-z0-9_,\s]+|all)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+def rel(path: str) -> str:
+    """Repo-relative display path (keeps absolute paths out of findings so
+    fixture-based tests compare stable strings)."""
+    try:
+        return os.path.relpath(path, REPO_ROOT)
+    except ValueError:  # different drive (never on POSIX)
+        return path
+
+
+def read_text(path: str) -> str:
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        return f.read()
+
+
+def suppressed_lines(text: str) -> Dict[int, Set[str]]:
+    """line (1-based) -> set of rule ids disabled on that line ("all" wins)."""
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(text.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        spec = m.group(1).strip()
+        if spec == "all":
+            out[i] = {"all"}
+        else:
+            out[i] = {r.strip().upper() for r in spec.split(",") if r.strip()}
+    return out
+
+
+def apply_suppressions(findings: Iterable[Finding], texts: Dict[str, str]) -> List[Finding]:
+    """Drop findings whose line carries a matching inline disable. ``texts``
+    maps repo-relative path -> raw source."""
+    cache: Dict[str, Dict[int, Set[str]]] = {}
+    kept: List[Finding] = []
+    for f in findings:
+        text = texts.get(f.path)
+        if text is not None:
+            if f.path not in cache:
+                cache[f.path] = suppressed_lines(text)
+            rules = cache[f.path].get(f.line, set())
+            if "all" in rules or f.rule.upper() in rules:
+                continue
+        kept.append(f)
+    return kept
+
+
+def python_files(root: str, subdirs: Sequence[str] = ("persia_tpu",)) -> List[str]:
+    out: List[str] = []
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            out.extend(
+                os.path.join(dirpath, f) for f in sorted(filenames) if f.endswith(".py")
+            )
+    return sorted(out)
+
+
+# ------------------------------------------------------------------ registry
+#
+# The five native libraries and the binding files that speak to them. The
+# ABI pass discovers bindings by parsing ctypes.CDLL call sites, but the
+# registry is the completeness oracle: a lib listed here with zero parsed
+# exports, or a binding file that stops parsing, is itself a finding
+# (silent coverage loss is how drift sneaks back in).
+
+NATIVE_LIBS: Dict[str, List[str]] = {
+    "libpersia_ps.so": ["native/ps.cpp"],
+    "libpersia_worker.so": ["native/worker.cpp"],
+    "libpersia_cache.so": ["native/cache.cpp"],
+    "libpersia_codec.so": ["native/codec.cpp"],
+    "libpersia_net.so": ["native/server.cpp", "native/codec.cpp"],
+}
+
+# Files expected to declare ctypes bindings against the libs above.
+BINDING_FILES: List[str] = [
+    "persia_tpu/embedding/hbm_cache/directory.py",
+    "persia_tpu/embedding/native_store.py",
+    "persia_tpu/embedding/native_worker.py",
+    "persia_tpu/service/codec.py",
+    "persia_tpu/service/native_rpc.py",
+]
+
+# Every file that touches ctypes at all (bindings above + raw-pointer call
+# sites riding a lib loaded elsewhere). The ABI pass asserts it scanned all
+# of them so "covers all ctypes files" stays true as the set grows.
+CTYPES_FILES: List[str] = BINDING_FILES + [
+    "persia_tpu/embedding/build_native.py",
+    "persia_tpu/embedding/hbm_cache/ctx.py",
+    "persia_tpu/embedding/hbm_cache/groups.py",
+    "persia_tpu/embedding/hbm_cache/step.py",
+    "persia_tpu/embedding/hbm_cache/stream.py",
+    "persia_tpu/embedding/hbm_cache/tier.py",
+]

@@ -1,0 +1,262 @@
+"""Concurrency lints for the feeder / write-back / RPC thread plane.
+
+The ~26 locks guarding the training plane are invisible to the type
+system; these AST rules mechanize the conventions the code already relies
+on:
+
+- CONC001 a mutex acquired with a bare ``.acquire()`` instead of ``with``
+          (locks named ``*lock*``/``*mutex*``; semaphores are exempt — a
+          permit legitimately crosses function/thread boundaries, CONC002
+          covers their exception safety instead)
+- CONC002 an ``.acquire()``/ring-span ``reserve()`` whose very next
+          executed statement is not a ``try`` releasing it on the
+          exception path — any statement in the gap (even a log call) can
+          raise and leak the permit/span forever
+- CONC003 a blocking call made while holding a lock: ``time.sleep``,
+          socket connect/recv/send/accept, subprocess, or a ctypes call
+          into a native core (``lib.*`` / ``*_lib.*`` — native calls can
+          take the core's own mutex and block every sibling thread that
+          wants the Python lock). ``Condition.wait`` is exempt (it
+          releases the lock)
+- CONC004 lexically nested ``with`` acquisitions of two registry locks in
+          an order that inverts ``lock_order.LOCK_RANKS``
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Sequence, Tuple
+
+from persia_tpu.analysis.common import Finding, REPO_ROOT, read_text, rel
+from persia_tpu.analysis.lock_order import rank_of
+
+_LOCKISH = ("lock", "mutex", "_mu")
+_SEMISH = ("sem",)
+_ACQUIRE_METHODS = ("acquire",)
+_RESERVE_METHODS = ("reserve", "reserve_span")
+
+# blocking calls flagged under a held lock: (qualifier substring, attr name)
+_BLOCKING_ATTRS = {
+    "sleep", "recv", "recv_into", "send", "sendall", "accept", "connect",
+    "create_connection", "getaddrinfo", "check_call", "check_output", "run",
+    "wait_for", "urlopen",
+}
+_BLOCKING_MODULES = ("time", "_time", "socket", "subprocess")
+
+
+def _expr_name(node: ast.expr) -> str:
+    """Terminal name of an attribute chain: self._deg_lock -> _deg_lock."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _expr_source(node: ast.expr) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover — unparse of synthetic nodes
+        return ""
+
+
+def _is_lockish(name: str) -> bool:
+    low = name.lower()
+    return any(t in low for t in _LOCKISH) or low in ("cv", "cond") or low.endswith("cond")
+
+
+def _is_semish(name: str) -> bool:
+    return any(t in name.lower() for t in _SEMISH)
+
+
+def _releases(node: ast.AST, target_src: str) -> bool:
+    """Does this subtree call <target>.release(...) (or ``_release``-ish
+    cleanup naming the same object)?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+            if sub.func.attr.startswith("release") and _expr_source(sub.func.value) == target_src:
+                return True
+    return False
+
+
+class _FuncChecker:
+    def __init__(self, path: str, findings: List[Finding]):
+        self.path = path
+        self.findings = findings
+
+    # ---------------------------------------------------------------- body
+    def check_body(
+        self,
+        body: Sequence[ast.stmt],
+        held: List[Tuple[str, int, int]],
+        cont: Optional[ast.stmt] = None,
+    ) -> None:
+        """Walk a statement list; ``held`` is the stack of (lock name,
+        rank-or-None, line) currently held via ``with``. ``cont`` is the
+        statement that executes after this list runs off its end (so an
+        acquire that is the LAST statement of an if-branch is judged
+        against the statement following the whole if)."""
+        for idx, stmt in enumerate(body):
+            self._check_stmt(stmt, body, idx, held, cont)
+
+    def _check_stmt(self, stmt, body, idx, held, cont=None) -> None:
+        nxt_stmt = body[idx + 1] if idx + 1 < len(body) else cont
+        if isinstance(stmt, ast.With) or isinstance(stmt, ast.AsyncWith):
+            entered: List[Tuple[str, Optional[int], int]] = []
+            for item in stmt.items:
+                ctx = item.context_expr
+                name = _expr_name(ctx)
+                if _is_lockish(name) or _is_semish(name):
+                    rank = rank_of(name)
+                    # CONC004: nested with against the declared order
+                    for outer_name, outer_rank, outer_line in held:
+                        if (
+                            rank is not None
+                            and outer_rank is not None
+                            and rank < outer_rank
+                        ):
+                            self.findings.append(Finding(
+                                "CONC004", self.path, stmt.lineno,
+                                f"lock-order inversion: {name} (rank {rank}) "
+                                f"acquired while holding {outer_name} (rank "
+                                f"{outer_rank}, line {outer_line}) — declared "
+                                "order in analysis/lock_order.py says "
+                                f"{name} is outermost",
+                            ))
+                    entered.append((name, rank, stmt.lineno))
+            held.extend(entered)
+            # CONC003 inside the with body (only when a lock was entered)
+            if entered:
+                self._check_blocking(stmt.body, [e[0] for e in held if e[0]], stmt)
+            self.check_body(stmt.body, held, nxt_stmt)
+            for _ in entered:
+                held.pop()
+            return
+
+        # CONC001 / CONC002: bare acquire()/reserve() statements
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            if isinstance(call.func, ast.Attribute):
+                attr = call.func.attr
+                target = call.func.value
+                tname = _expr_name(target)
+                tsrc = _expr_source(target)
+                if attr in _ACQUIRE_METHODS and _is_lockish(tname):
+                    self.findings.append(Finding(
+                        "CONC001", self.path, stmt.lineno,
+                        f"{tsrc}.acquire() outside `with` — use `with {tsrc}:` "
+                        "so every exit path releases the lock",
+                    ))
+                elif attr in _ACQUIRE_METHODS and _is_semish(tname):
+                    self._check_release_follows(stmt, nxt_stmt, tsrc, "permit")
+                elif attr in _RESERVE_METHODS and any(
+                    t in tname.lower() for t in ("ring", "span", "ledger")
+                ):
+                    self._check_release_follows(stmt, nxt_stmt, tsrc, "span")
+
+        # recurse into compound statements
+        for sub_body in _sub_bodies(stmt):
+            self.check_body(sub_body, held, nxt_stmt)
+
+    # ------------------------------------------------------------ CONC002
+    def _check_release_follows(self, stmt, nxt, tsrc: str, what: str) -> None:
+        """The statement executing after an acquire/reserve must be a try
+        that releases on the exception path (except or finally)."""
+        ok = False
+        if isinstance(nxt, ast.Try):
+            for h in nxt.handlers:
+                if _releases(h, tsrc):
+                    ok = True
+            for fstmt in nxt.finalbody:
+                if _releases(fstmt, tsrc):
+                    ok = True
+        if not ok:
+            self.findings.append(Finding(
+                "CONC002", self.path, stmt.lineno,
+                f"{tsrc} {what} acquired but the next statement is not a "
+                "try releasing it on the exception path — anything raising "
+                f"in the gap leaks the {what} forever",
+            ))
+
+    # ------------------------------------------------------------ CONC003
+    def _check_blocking(self, body: Sequence[ast.stmt], held_names: List[str], with_stmt) -> None:
+        for stmt in body:
+            for node in ast.walk(stmt):
+                # nested with over the same analysis happens via check_body;
+                # here only flag direct blocking calls
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if not isinstance(f, ast.Attribute):
+                    continue
+                attr = f.attr
+                qual = _expr_source(f.value)
+                qlow = qual.lower()
+                blocking = False
+                detail = ""
+                if attr in _BLOCKING_ATTRS and (
+                    qual in _BLOCKING_MODULES
+                    or qlow.startswith("socket")
+                    or qlow.startswith("subprocess")
+                    or qlow.endswith("sock")
+                    or ".sock" in qlow
+                ):
+                    blocking = True
+                    detail = f"{qual}.{attr}()"
+                elif (
+                    (qlow == "lib" or qlow.endswith("_lib") or qlow.endswith("._lib"))
+                    and not attr.startswith("_")
+                ):
+                    blocking = True
+                    detail = f"native call {qual}.{attr}()"
+                if blocking:
+                    self.findings.append(Finding(
+                        "CONC003", self.path, node.lineno,
+                        f"blocking {detail} while holding "
+                        f"{', '.join(held_names)} (with at line "
+                        f"{with_stmt.lineno}) — every sibling thread wanting "
+                        "the lock stalls behind it",
+                    ))
+
+
+def _sub_bodies(stmt: ast.stmt):
+    for field_name in ("body", "orelse", "finalbody"):
+        sub = getattr(stmt, field_name, None)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)) and field_name == "body":
+            continue  # handled by the with path
+        if sub:
+            yield sub
+    for h in getattr(stmt, "handlers", ()):
+        yield h.body
+
+
+def check_source(text: str, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    tree = ast.parse(text, filename=path)
+    checker = _FuncChecker(path, findings)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            checker.check_body(node.body, [])
+    # nested withs are visited from every enclosing level — dedupe by site
+    seen = set()
+    out: List[Finding] = []
+    for f in findings:
+        key = (f.rule, f.path, f.line)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+def check(root: str = REPO_ROOT, files: Optional[Sequence[str]] = None) -> List[Finding]:
+    from persia_tpu.analysis.common import python_files
+
+    paths = list(files) if files is not None else python_files(root)
+    findings: List[Finding] = []
+    for p in paths:
+        abspath = p if os.path.isabs(p) else os.path.join(root, p)
+        if (os.sep + "analysis" + os.sep) in abspath:
+            continue  # the lint does not lint itself
+        findings.extend(check_source(read_text(abspath), rel(abspath)))
+    return findings

@@ -1,0 +1,289 @@
+"""Lightweight ``extern "C"`` declaration parser for the native sources.
+
+Deliberately not a C++ parser: the exported surface of the five native
+libraries is plain-C by construction (pointer/integer/float scalars only —
+anything fancier would not be ctypes-bindable in the first place), so a
+comment-stripping brace walker that reads declarations at the top level of
+each ``extern "C"`` block is complete for this codebase and needs no clang.
+
+Canonical type descriptors (shared with the Python side in abi.py):
+
+    ("void",)                      C void return
+    ("int", width, signed)         integer scalar, width in bits
+    ("float", width)               float (32) / double (64)
+    ("ptr", inner)                 pointer; inner is a descriptor or
+                                   ("void",) for void* / unknown pointees
+    ("funcptr",)                   function-pointer typedef
+    ("opaque", token)              unrecognized token (matched leniently,
+                                   but surfaced in the parse report)
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+TypeDesc = Tuple  # canonical descriptor tuples, see module docstring
+
+
+@dataclass
+class CFunc:
+    name: str
+    ret: TypeDesc
+    params: List[TypeDesc]
+    line: int  # 1-based line of the declaration in the source file
+    path: str  # repo-relative source path
+
+
+_SCALARS: Dict[str, TypeDesc] = {
+    "void": ("void",),
+    "bool": ("int", 8, False),
+    "char": ("int", 8, True),
+    "int8_t": ("int", 8, True),
+    "uint8_t": ("int", 8, False),
+    "int16_t": ("int", 16, True),
+    "short": ("int", 16, True),
+    "uint16_t": ("int", 16, False),
+    "int": ("int", 32, True),
+    "int32_t": ("int", 32, True),
+    "unsigned": ("int", 32, False),
+    "uint32_t": ("int", 32, False),
+    "long": ("int", 64, True),
+    "int64_t": ("int", 64, True),
+    "uint64_t": ("int", 64, False),
+    "size_t": ("int", 64, False),
+    "ssize_t": ("int", 64, True),
+    "float": ("float", 32),
+    "double": ("float", 64),
+}
+
+_FUNCPTR_TYPEDEF_RE = re.compile(r"typedef\s+[^;{]*\(\s*\*\s*(\w+)\s*\)\s*\(")
+
+
+def _strip_comments(text: str) -> str:
+    """Replace comments with spaces (newlines preserved so line numbers
+    survive)."""
+    out: List[str] = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "/" and i + 1 < n and text[i + 1] == "/":
+            j = text.find("\n", i)
+            j = n if j < 0 else j
+            out.append(" " * (j - i))
+            i = j
+        elif c == "/" and i + 1 < n and text[i + 1] == "*":
+            j = text.find("*/", i + 2)
+            j = n - 2 if j < 0 else j
+            seg = text[i:j + 2]
+            out.append("".join("\n" if ch == "\n" else " " for ch in seg))
+            i = j + 2
+        elif c == '"' or c == "'":
+            q = c
+            j = i + 1
+            while j < n and text[j] != q:
+                j = j + 2 if text[j] == "\\" else j + 1
+            out.append(c + " " * max(j - i - 1, 0) + (q if j < n else ""))
+            i = j + 1
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def parse_c_type(tok: str, funcptr_typedefs=()) -> TypeDesc:
+    """Canonicalize one C parameter/return type string (name already
+    removed)."""
+    t = tok.strip()
+    # drop qualifiers that do not affect the call ABI
+    t = re.sub(r"\b(const|volatile|restrict|struct|enum)\b", " ", t)
+    t = re.sub(r"\s+", " ", t).strip()
+    if t.endswith("*"):
+        inner = parse_c_type(t[:-1], funcptr_typedefs)
+        return ("ptr", inner)
+    # collapse multi-word scalars
+    if t in ("unsigned int",):
+        t = "unsigned"
+    if t in ("long long", "long int", "long long int"):
+        t = "long"
+    if t in ("unsigned long", "unsigned long long", "unsigned long long int"):
+        return ("int", 64, False)
+    if t in ("unsigned char",):
+        return ("int", 8, False)
+    if t in ("signed char",):
+        return ("int", 8, True)
+    if t in _SCALARS:
+        return _SCALARS[t]
+    if t in funcptr_typedefs:
+        return ("funcptr",)
+    return ("opaque", t)
+
+
+def _split_params(paramstr: str) -> List[str]:
+    """Split a parameter list on top-level commas."""
+    parts: List[str] = []
+    depth = 0
+    cur: List[str] = []
+    for ch in paramstr:
+        if ch in "(<[":
+            depth += 1
+        elif ch in ")>]":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur and "".join(cur).strip():
+        parts.append("".join(cur))
+    return parts
+
+
+def _strip_param_name(param: str) -> str:
+    """Remove the trailing parameter name, keeping its type. Handles
+    ``const uint64_t* const* ids`` and bare types (``int64_t``)."""
+    p = param.strip()
+    if not p or p == "void" or p == "...":
+        return p if p == "void" else p
+    m = re.match(r"^(.*?)([A-Za-z_]\w*)\s*(\[\s*\d*\s*\])?$", p, re.S)
+    if not m:
+        return p
+    head, last, arr = m.group(1).strip(), m.group(2), m.group(3)
+    if not head:
+        return last  # a bare type like "void" or a typedef with no name
+    if arr:
+        head += "*"  # T name[] decays to T*
+    return head
+
+
+_KEYWORD_HEADS = ("namespace", "struct", "class", "union", "enum", "typedef",
+                  "using", "template", "static_assert", "extern")
+
+
+def parse_extern_c(text: str, path: str = "<src>") -> Tuple[List[CFunc], List[str]]:
+    """Parse every declaration at the TOP LEVEL of each ``extern "C"``
+    block. Returns (functions, parse_warnings). Nested bodies (function
+    definitions, interior namespaces) are brace-skipped, so calls inside
+    bodies are never mistaken for declarations."""
+    raw = text
+    text = _strip_comments(text)
+    funcptr_typedefs = set(_FUNCPTR_TYPEDEF_RE.findall(text))
+    funcs: List[CFunc] = []
+    warnings: List[str] = []
+    seen: Dict[str, CFunc] = {}
+
+    pos = 0
+    while True:
+        # NB: _strip_comments blanks string-literal contents, so the "C" in
+        # the source reads back as a one-space string here
+        m = re.search(r'extern\s*"[^"\n]*"\s*\{', text[pos:])
+        if not m:
+            break
+        block_start = pos + m.end()
+        # find the matching close brace for the extern block
+        depth = 1
+        i = block_start
+        n = len(text)
+        decl_start = i
+        while i < n and depth > 0:
+            c = text[i]
+            if c == "{":
+                if depth == 1:
+                    # a declaration ending in a body: parse the signature,
+                    # then skip the balanced body
+                    _consume_decl(text, decl_start, i, path, funcptr_typedefs,
+                                  funcs, seen, warnings)
+                    body_depth = 1
+                    i += 1
+                    while i < n and body_depth > 0:
+                        if text[i] == "{":
+                            body_depth += 1
+                        elif text[i] == "}":
+                            body_depth -= 1
+                        i += 1
+                    decl_start = i
+                    continue
+                depth += 1
+            elif c == "}":
+                depth -= 1
+                if depth == 0:
+                    break
+            elif c == ";" and depth == 1:
+                _consume_decl(text, decl_start, i, path, funcptr_typedefs,
+                              funcs, seen, warnings)
+                decl_start = i + 1
+            i += 1
+        pos = i + 1
+    if not funcs and 'extern "C"' in raw:
+        warnings.append(f"{path}: extern \"C\" block parsed to zero declarations")
+    return funcs, warnings
+
+
+def _consume_decl(text, start, end, path, funcptr_typedefs, funcs, seen, warnings):
+    decl = text[start:end].strip()
+    if not decl or "(" not in decl:
+        return
+    head = decl.split("(", 1)[0].strip()
+    first_word = head.split()[0] if head.split() else ""
+    if first_word in _KEYWORD_HEADS:
+        return
+    line = text.count("\n", 0, start + (len(text[start:end]) - len(text[start:end].lstrip()))) + 1
+    # signature: everything up to the matching close paren of the first open
+    open_idx = decl.index("(")
+    depth = 0
+    close_idx = -1
+    for j in range(open_idx, len(decl)):
+        if decl[j] == "(":
+            depth += 1
+        elif decl[j] == ")":
+            depth -= 1
+            if depth == 0:
+                close_idx = j
+                break
+    if close_idx < 0:
+        warnings.append(f"{path}:{line}: unterminated declaration {decl[:60]!r}")
+        return
+    paramstr = decl[open_idx + 1:close_idx]
+    mh = re.match(r"^(.*?)([A-Za-z_]\w*)$", head, re.S)
+    if not mh:
+        warnings.append(f"{path}:{line}: unparseable declaration head {head!r}")
+        return
+    ret_str, name = mh.group(1).strip(), mh.group(2)
+    if not ret_str:
+        return  # constructor-ish / macro — not a C export
+    ret = parse_c_type(ret_str, funcptr_typedefs)
+    params: List[TypeDesc] = []
+    raw_params = _split_params(paramstr)
+    if not (len(raw_params) == 1 and raw_params[0].strip() in ("void", "")):
+        for prm in raw_params:
+            params.append(parse_c_type(_strip_param_name(prm), funcptr_typedefs))
+    fn = CFunc(name=name, ret=ret, params=params, line=line, path=path)
+    prev = seen.get(name)
+    if prev is not None:
+        # re-declaration (e.g. server.cpp forward-declares the codec fns):
+        # signatures must agree or the lib itself is internally drifted
+        if (prev.ret, prev.params) != (fn.ret, fn.params):
+            warnings.append(
+                f"{path}:{line}: conflicting re-declaration of {name} "
+                f"(first at {prev.path}:{prev.line})"
+            )
+        return
+    seen[name] = fn
+    funcs.append(fn)
+
+
+def describe(desc: TypeDesc) -> str:
+    """Human-readable descriptor for findings."""
+    kind = desc[0]
+    if kind == "void":
+        return "void"
+    if kind == "int":
+        return f"{'' if desc[2] else 'u'}int{desc[1]}"
+    if kind == "float":
+        return {32: "float", 64: "double"}[desc[1]]
+    if kind == "ptr":
+        return describe(desc[1]) + "*"
+    if kind == "funcptr":
+        return "<funcptr>"
+    return f"<{desc[1]}>"

@@ -1,0 +1,53 @@
+"""Declared lock-order registry for the feeder / write-back / stream threads.
+
+The stream pipeline (hbm_cache/stream.py) runs three cooperating threads —
+feeder prep, host→device staging, and the write-back flusher — plus the
+RPC client threads underneath them. Deadlock-freedom rests on every thread
+acquiring locks in ONE global order; this registry makes that order a
+checkable artifact instead of tribal knowledge. CONC004 flags any lexically
+nested ``with``-acquisition whose inner lock ranks ABOVE (outer-than) the
+outer lock.
+
+Ranks are matched by attribute-name suffix (the lock's field name), which
+is how the code names them everywhere; a lock field not listed here simply
+does not participate in the check — add it when it starts nesting.
+
+Order (outermost first):
+
+1. ``cv``            — the stream pipeline condition (hbm_cache/stream.py);
+                       guards heads/tails/alloc queue/sign map. Nothing may
+                       be held when taking it.
+2. ``_buf_lock``     — embedding worker forward-buffer table
+3. ``_grad_lock``    — embedding worker gradient-state table
+4. ``_deg_lock``     — degraded-lookup bookkeeping (worker + cache tier)
+5. ``_swap_lock``    — serving engine model-swap latch
+6. ``_lock``         — generic leaf locks (breakers, caches, registries);
+                       must never wrap a ranked-above lock
+7. ``_rng_lock``     — RetryPolicy jitter RNG (innermost; held for one
+                       random() call only)
+8. ``_REGISTRY_LOCK``— metrics registry (innermost leaf)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+# attribute-name suffix -> rank (lower = must be taken first / outermost)
+LOCK_RANKS: Dict[str, int] = {
+    "cv": 0,
+    "_buf_lock": 10,
+    "_grad_lock": 20,
+    "_deg_lock": 30,
+    "_swap_lock": 40,
+    "_lock": 50,
+    "_rng_lock": 60,
+    "_REGISTRY_LOCK": 70,
+}
+
+
+def rank_of(name: str) -> Optional[int]:
+    """Rank for a lock-ish expression's terminal attribute/variable name,
+    or None when the name is not registered."""
+    if name in LOCK_RANKS:
+        return LOCK_RANKS[name]
+    return None

@@ -1,0 +1,192 @@
+"""Resilience-policy lint: no retry/backoff/timeout outside the engine.
+
+PR 3's invariant — every deadline, backoff sleep, and breaker decision in
+the service plane flows through ``service/resilience.py`` — is what makes
+the chaos suite's bit-identical replay argument sound: a hand-rolled
+``time.sleep(0.3)`` poll loop is an unseeded, unbudgeted side channel the
+Deadline cannot cap and the soak cannot replay. These rules mechanically
+protect the invariant inside ``persia_tpu/service/`` and
+``persia_tpu/serving/`` (``resilience.py`` itself is the one exempt file —
+it IS the engine):
+
+- RES001 ``time.sleep`` with a constant delay — backoff must come from
+         ``RetryPolicy.backoff`` (seeded jitter) capped by a ``Deadline``
+- RES002 a constant socket timeout (``settimeout(0.5)``,
+         ``create_connection(..., timeout=2)``) — per-attempt timeouts
+         must be budget-capped (``Deadline.cap``) or config-driven
+- RES003 an ad-hoc retry/poll loop: a ``while``/``for`` whose body both
+         swallows exceptions and sleeps, without referencing the policy
+         engine (``backoff``/``Deadline``/``RetryPolicy``/``poll_until``/
+         ``breaker``) — duplicated backoff is exactly what PR 3 deleted
+- RES004 a manual wall-clock deadline (``time.time() + timeout``) driving
+         a sleep loop — use ``resilience.Deadline`` (monotonic, propagates
+         through nested calls)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Sequence
+
+from persia_tpu.analysis.common import Finding, REPO_ROOT, read_text, rel
+
+_SCOPE_DIRS = (
+    os.path.join("persia_tpu", "service"),
+    os.path.join("persia_tpu", "serving"),
+)
+_EXEMPT_BASENAMES = ("resilience.py",)
+
+# Tokens that prove the loop runs ON the engine. Note "deadline." /
+# "deadline(" (method call / construction) rather than the bare word: a
+# hand-rolled `deadline = time.time() + t` variable must NOT whitelist its
+# own loop.
+_POLICY_TOKENS = (
+    "backoff", "retrypolicy", "deadline.", "deadline(", "poll_until",
+    "breaker", "policy", ".remaining(", ".cap(",
+)
+
+
+def _src(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover
+        return ""
+
+
+def _is_const_number(node: ast.expr) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.operand, ast.Constant):
+        return isinstance(node.operand.value, (int, float))
+    return False
+
+
+def _swallows_exceptions(loop: ast.AST) -> bool:
+    for node in ast.walk(loop):
+        if isinstance(node, ast.ExceptHandler):
+            return True
+    return False
+
+
+def _sleeps(loop: ast.AST) -> Optional[int]:
+    for node in ast.walk(loop):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "sleep"
+        ):
+            return node.lineno
+    return None
+
+
+def _mentions_policy(loop: ast.AST) -> bool:
+    return any(tok in _src(loop).lower() for tok in _POLICY_TOKENS)
+
+
+def check_source(text: str, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    tree = ast.parse(text, filename=path)
+
+    for node in ast.walk(tree):
+        # RES001: constant sleep
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "sleep"
+            and node.args
+            and _is_const_number(node.args[0])
+        ):
+            findings.append(Finding(
+                "RES001", path, node.lineno,
+                f"{_src(node.func)}({_src(node.args[0])}) — constant backoff "
+                "bypasses resilience.RetryPolicy (unseeded, un-budgeted; the "
+                "chaos replay cannot reproduce it)",
+            ))
+        # RES002: constant socket timeouts
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "settimeout" and node.args and _is_const_number(node.args[0]):
+                findings.append(Finding(
+                    "RES002", path, node.lineno,
+                    f"settimeout({_src(node.args[0])}) — constant socket "
+                    "timeout bypasses Deadline.cap / config",
+                ))
+            if node.func.attr in ("create_connection", "connect_ex"):
+                for kw in node.keywords:
+                    if kw.arg == "timeout" and _is_const_number(kw.value):
+                        findings.append(Finding(
+                            "RES002", path, node.lineno,
+                            f"create_connection(timeout={_src(kw.value)}) — "
+                            "constant socket timeout bypasses Deadline.cap",
+                        ))
+        # RES003 / RES004: ad-hoc retry/poll loops
+        if isinstance(node, (ast.While, ast.For)):
+            sleep_line = _sleeps(node)
+            if sleep_line is None:
+                continue
+            if _swallows_exceptions(node) and not _mentions_policy(node):
+                findings.append(Finding(
+                    "RES003", path, node.lineno,
+                    "ad-hoc retry loop (swallows exceptions + sleeps) — "
+                    "route it through resilience.poll_until / RetryPolicy",
+                ))
+            loop_src = _src(node)
+            if not _mentions_policy(node) and (
+                "time.time() +" in loop_src or "time.monotonic() +" in loop_src
+            ):
+                findings.append(Finding(
+                    "RES004", path, node.lineno,
+                    "manual wall-clock deadline driving a sleep loop — use "
+                    "resilience.Deadline (monotonic, propagates)",
+                ))
+
+    # RES004 also fires when the deadline is computed just before the loop
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        body = fn.body
+        for i, stmt in enumerate(body):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            ssrc = _src(stmt.value)
+            if not ("time.time() +" in ssrc or "_time.time() +" in ssrc):
+                continue
+            for later in body[i + 1:]:
+                if isinstance(later, (ast.While, ast.For)) and _sleeps(later) is not None \
+                        and not _mentions_policy(later):
+                    findings.append(Finding(
+                        "RES004", path, stmt.lineno,
+                        "manual wall-clock deadline driving the sleep loop "
+                        f"at line {later.lineno} — use resilience.Deadline",
+                    ))
+                    break
+    # dedupe (a loop can be reached by both RES004 paths)
+    seen = set()
+    out: List[Finding] = []
+    for f in findings:
+        key = (f.rule, f.path, f.line)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+def in_scope(path: str) -> bool:
+    p = path.replace("/", os.sep)
+    if os.path.basename(p) in _EXEMPT_BASENAMES:
+        return False
+    return any(d in p for d in _SCOPE_DIRS)
+
+
+def check(root: str = REPO_ROOT, files: Optional[Sequence[str]] = None) -> List[Finding]:
+    from persia_tpu.analysis.common import python_files
+
+    paths = list(files) if files is not None else python_files(root)
+    findings: List[Finding] = []
+    for p in paths:
+        abspath = p if os.path.isabs(p) else os.path.join(root, p)
+        rp = rel(abspath)
+        if files is None and not in_scope(rp):
+            continue
+        findings.extend(check_source(read_text(abspath), rp))
+    return findings

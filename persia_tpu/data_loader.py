@@ -369,13 +369,16 @@ class DataLoader:
             diagnostics.heartbeat(beat_key)
             # bounded async (forward.rs:686-690); reproducible mode grants
             # permits in ticket order so the PS sees a deterministic
-            # lookup sequence regardless of worker count
+            # lookup sequence regardless of worker count. The try below
+            # must IMMEDIATELY follow the acquire (persia-lint CONC002):
+            # any statement in the gap — even a heartbeat — can raise and
+            # leak the permit, wedging the staleness window forever.
             if self.reproducible:
                 self.staleness_sem.acquire(ticket)
             else:
                 self.staleness_sem.acquire()
-            diagnostics.heartbeat(beat_key)
             try:
+                diagnostics.heartbeat(beat_key)
                 train = batch.requires_grad
                 with span("lookup", batch_id=batch.batch_id):
                     widx, ref, emb_batches = self._lookup_with_recovery(batch, train)

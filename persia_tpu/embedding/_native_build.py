@@ -1,6 +1,11 @@
 """Race-safe native-library builds shared by the ps/worker/cache cores.
 
-The .so is gated on a source hash (git checkouts do not preserve mtimes).
+The .so is gated on a build hash (git checkouts do not preserve mtimes).
+The hash covers the SOURCE BYTES **and** the full compiler flag vector +
+sanitizer variant: a flag change (new -D, -O level, added -fsanitize=...)
+must never reuse a stale cached library — that was exactly the stale-.so
+class of silent corruption the source-only hash still allowed.
+
 Builds must be safe against CONCURRENT builders in other processes (pytest
 xdist workers, a bench subprocess, an editor-triggered rebuild): two g++
 invocations writing the same output path interleave their writes and produce
@@ -9,6 +14,15 @@ load error. So: compile to a per-pid temp file, ``os.replace`` it into place
 (atomic on POSIX — a concurrent ``dlopen`` sees the old or the new inode,
 never a mix), all under an ``flock``'d lockfile with a re-check so losers of
 the race reuse the winner's build instead of rebuilding.
+
+Sanitizer variants (``PERSIA_NATIVE_SANITIZE=asan|ubsan``) build to a
+DISTINCT path (``libpersia_ps.asan.so``) with the sanitizer flags appended
+to the normal flag vector (same -O3/-mavx2 base, so fp codegen — and the
+bit-parity suites — match the production build). Callers must load the
+path ``build_so`` RETURNS, not a precomputed constant, or the variant
+never takes effect; ``scripts/sanitize_native.sh`` drives the parity
+suites through these variants. ASan libraries need the ASan runtime
+preloaded into the host python (the script handles LD_PRELOAD).
 """
 
 from __future__ import annotations
@@ -18,13 +32,56 @@ import hashlib
 import os
 import subprocess
 import threading
+from typing import List
 
 _PROC_LOCK = threading.Lock()
+
+SANITIZER_FLAGS = {
+    # -g for symbolized reports; no -fno-omit-frame-pointer tradeoff debates
+    # here — these are test-only variants, never the serving build
+    "asan": ["-fsanitize=address", "-fno-omit-frame-pointer", "-g"],
+    # halt on the first report: a UBSan finding must fail the parity suite,
+    # not scroll past it
+    "ubsan": ["-fsanitize=undefined", "-fno-sanitize-recover=undefined", "-g"],
+}
+
+
+def sanitize_variant() -> str:
+    """Current sanitizer variant from ``PERSIA_NATIVE_SANITIZE`` ("" when
+    unset). Unknown values raise rather than silently building vanilla."""
+    v = os.environ.get("PERSIA_NATIVE_SANITIZE", "").strip().lower()
+    if v in ("", "0", "none", "off"):
+        return ""
+    if v not in SANITIZER_FLAGS:
+        raise ValueError(
+            f"PERSIA_NATIVE_SANITIZE={v!r}: expected one of "
+            f"{sorted(SANITIZER_FLAGS)} (or unset)"
+        )
+    return v
+
+
+def variant_so_path(so: str, variant: str) -> str:
+    """libpersia_ps.so -> libpersia_ps.asan.so (distinct artifact per
+    variant: a sanitized .so must never shadow the production one)."""
+    if not variant:
+        return so
+    base, ext = os.path.splitext(so)
+    return f"{base}.{variant}{ext}"
 
 
 def _hash_file(path: str) -> str:
     with open(path, "rb") as f:
         return hashlib.sha256(f.read()).hexdigest()
+
+
+def _build_hash(srcs: List[str], flags: List[str], variant: str) -> str:
+    h = hashlib.sha256()
+    for p in srcs:
+        h.update(_hash_file(p).encode())
+        h.update(b"\x00")
+    h.update(("flags:" + "\x1f".join(flags)).encode())
+    h.update(("variant:" + variant).encode())
+    return h.hexdigest()
 
 
 def _is_fresh(so: str, stamp: str, h: str) -> bool:
@@ -36,11 +93,16 @@ def _is_fresh(so: str, stamp: str, h: str) -> bool:
 
 def build_so(src, so: str, flags, logger, force: bool = False) -> str:
     """Build ``src`` (one path or a list of paths) into ``so`` with g++ if
-    stale; returns ``so``."""
+    stale; returns the path actually built — the sanitizer-variant path
+    when ``PERSIA_NATIVE_SANITIZE`` is set. Always ``CDLL`` the returned
+    path."""
     srcs = [src] if isinstance(src, str) else list(src)
+    variant = sanitize_variant()
+    so = variant_so_path(so, variant)
+    flags = list(flags) + (SANITIZER_FLAGS[variant] if variant else [])
     stamp = so + ".srchash"
     with _PROC_LOCK:
-        h = "".join(_hash_file(p) for p in srcs)
+        h = _build_hash(srcs, flags, variant)
         if not force and _is_fresh(so, stamp, h):
             return so
         with open(so + ".lock", "w") as lf:
@@ -52,7 +114,9 @@ def build_so(src, so: str, flags, logger, force: bool = False) -> str:
                 cmd = ["g++", *flags, "-o", tmp, *srcs]
                 logger.info("building %s: %s", os.path.basename(so), " ".join(cmd))
                 try:
-                    subprocess.check_call(cmd)
+                    # blocking-under-lock is the POINT here: the lock exists
+                    # to serialize concurrent builders onto one compile
+                    subprocess.check_call(cmd)  # persia-lint: disable=CONC003
                     os.replace(tmp, so)
                 finally:
                     if os.path.exists(tmp):

@@ -59,11 +59,15 @@ def build_native(force: bool = False) -> str:
 def _load_lib() -> ctypes.CDLL:
     global _LIB
     if _LIB is None:
-        build_native()
-        lib = ctypes.CDLL(_SO)
+        # CDLL the path build_native RETURNS (sanitizer-variant aware)
+        so_path = build_native()
+        lib = ctypes.CDLL(so_path)
         i64, p = ctypes.c_int64, ctypes.c_void_p
+        # every binding declares BOTH restype and argtypes (restype = None
+        # for void) — persia-lint ABI003/ABI007 enforce it mechanically
         lib.cache_create.restype = p
         lib.cache_create.argtypes = [i64]
+        lib.cache_destroy.restype = None
         lib.cache_destroy.argtypes = [p]
         lib.cache_len.restype = i64
         lib.cache_len.argtypes = [p]
@@ -71,11 +75,13 @@ def _load_lib() -> ctypes.CDLL:
         lib.cache_capacity.argtypes = [p]
         lib.cache_admit.restype = i64
         lib.cache_admit.argtypes = [p, _u64p, i64, _i64p, _i64p, _u64p, _i64p, _i64p]
+        lib.cache_probe.restype = None
         lib.cache_probe.argtypes = [p, _u64p, i64, _i64p]
         lib.cache_drain.restype = i64
         lib.cache_drain.argtypes = [p, _u64p, _i64p]
         lib.cache_snapshot.restype = i64
         lib.cache_snapshot.argtypes = [p, _u64p, _i64p]
+        lib.cache_set_admit_touches.restype = None
         lib.cache_set_admit_touches.argtypes = [p, i64]
         _i32p = ctypes.POINTER(ctypes.c_int32)
         lib.cache_admit_positions.restype = i64
@@ -83,10 +89,12 @@ def _load_lib() -> ctypes.CDLL:
             p, _u64p, i64, _i32p, _u64p, _i64p, _u64p, _i64p,
             ctypes.POINTER(i64), ctypes.POINTER(i64),
         ]
+        lib.cache_uniform_init.restype = None
         lib.cache_uniform_init.argtypes = [
             _u64p, i64, i64, ctypes.c_uint64, ctypes.c_double,
             ctypes.c_double, ctypes.POINTER(ctypes.c_float),
         ]
+        lib.cache_init_rows.restype = None
         lib.cache_init_rows.argtypes = [
             _u64p, i64, i64, ctypes.c_uint64, ctypes.c_int,
             ctypes.c_double, ctypes.c_double, ctypes.POINTER(ctypes.c_float),
@@ -94,13 +102,18 @@ def _load_lib() -> ctypes.CDLL:
         u32 = ctypes.c_uint32
         u32p = ctypes.POINTER(u32)
         lib.pending_map_create.restype = p
+        lib.pending_map_create.argtypes = []
+        lib.pending_map_destroy.restype = None
         lib.pending_map_destroy.argtypes = [p]
         lib.pending_map_size.restype = i64
         lib.pending_map_size.argtypes = [p]
+        lib.pending_map_insert.restype = None
         lib.pending_map_insert.argtypes = [p, _u64p, _i64p, i64, u32]
+        lib.pending_map_insert_range.restype = None
         lib.pending_map_insert_range.argtypes = [p, _u64p, i64, i64, u32]
         lib.pending_map_query.restype = i64
         lib.pending_map_query.argtypes = [p, _u64p, i64, u32p, _i64p]
+        lib.pending_map_remove.restype = None
         lib.pending_map_remove.argtypes = [p, _u64p, i64, u32]
         lib.cache_feed_batch.restype = i64
         lib.cache_feed_batch.argtypes = [
@@ -177,6 +190,8 @@ def _retain_allocator_pages() -> None:
     _MALLOPT_DONE = True
     try:
         libc = ctypes.CDLL(None)
+        libc.mallopt.restype = ctypes.c_int
+        libc.mallopt.argtypes = [ctypes.c_int, ctypes.c_int]
         M_MMAP_THRESHOLD = -3
         libc.mallopt(M_MMAP_THRESHOLD, 64 * 1024 * 1024)
     except Exception:  # noqa: BLE001 — allocator tuning is best-effort

@@ -43,8 +43,10 @@ def _load_lib() -> ctypes.CDLL:
     global _LIB
     if _LIB is not None:
         return _LIB
-    build_native()
-    lib = ctypes.CDLL(_SO)
+    # CDLL the path build_native RETURNS: under PERSIA_NATIVE_SANITIZE it
+    # is the sanitizer-variant artifact, not _SO
+    so_path = build_native()
+    lib = ctypes.CDLL(so_path)
     u64, u32, i64, i32, f32 = (
         ctypes.c_uint64, ctypes.c_uint32, ctypes.c_int64, ctypes.c_int32, ctypes.c_float,
     )
@@ -52,22 +54,31 @@ def _load_lib() -> ctypes.CDLL:
     u64p = ctypes.POINTER(u64)
     f32p = ctypes.POINTER(f32)
     u8p = ctypes.POINTER(ctypes.c_uint8)
+    # every binding declares BOTH restype and argtypes (restype = None for
+    # void) — persia-lint ABI003/ABI007 enforce it mechanically
     lib.ps_create.restype = p
     lib.ps_create.argtypes = [u64, u32, u64]
+    lib.ps_destroy.restype = None
     lib.ps_destroy.argtypes = [p]
+    lib.ps_configure.restype = None
     lib.ps_configure.argtypes = [p, ctypes.c_double, ctypes.c_double, ctypes.c_double, f32]
+    lib.ps_set_init_method.restype = None
     lib.ps_set_init_method.argtypes = [p, i32, ctypes.c_double, ctypes.c_double]
+    lib.ps_register_optimizer.restype = None
     lib.ps_register_optimizer.argtypes = [p, i32, f32, f32, f32, f32, f32, i32, f32, f32]
     lib.ps_num_shards.restype = u32
     lib.ps_num_shards.argtypes = [p]
+    lib.ps_lookup.restype = None
     lib.ps_lookup.argtypes = [p, u64p, i64, u32, i32, f32p]
     lib.ps_checkout.restype = i64
     lib.ps_checkout.argtypes = [p, u64p, i64, u32, f32p]
     lib.ps_probe_entries.restype = i64
     lib.ps_probe_entries.argtypes = [p, u64p, i64, u32, f32p, u8p]
+    lib.ps_advance_batch_state.restype = None
     lib.ps_advance_batch_state.argtypes = [p, i32]
     lib.ps_update_gradients.restype = i32
     lib.ps_update_gradients.argtypes = [p, u64p, i64, u32, f32p, i32]
+    lib.ps_set_embedding.restype = None
     lib.ps_set_embedding.argtypes = [p, u64p, i64, u32, u32, f32p]
     lib.ps_get_entry.restype = i32
     lib.ps_get_entry.argtypes = [p, u64, f32p, i32]
@@ -75,6 +86,7 @@ def _load_lib() -> ctypes.CDLL:
     lib.ps_get_entry_dim.argtypes = [p, u64]
     lib.ps_size.restype = i64
     lib.ps_size.argtypes = [p]
+    lib.ps_clear.restype = None
     lib.ps_clear.argtypes = [p]
     lib.ps_dump_shard_size.restype = i64
     lib.ps_dump_shard_size.argtypes = [p, u32]
@@ -85,6 +97,7 @@ def _load_lib() -> ctypes.CDLL:
     i64p = ctypes.POINTER(i64)
     u32p = ctypes.POINTER(u32)
     i32p = ctypes.POINTER(i32)
+    lib.ps_lookup_batched.restype = None
     lib.ps_lookup_batched.argtypes = [p, u64p, i64p, u32p, i64p, i32, i32, f32p]
     lib.ps_update_batched.restype = i32
     lib.ps_update_batched.argtypes = [p, u64p, i64p, u32p, f32p, i64p, i32p, i32]

@@ -53,19 +53,26 @@ def _load_lib() -> Optional[ctypes.CDLL]:
         _LOAD_FAILED = True
         return None
     try:
-        build_native()
-        lib = ctypes.CDLL(_SO)
+        # CDLL the path build_native RETURNS (sanitizer-variant aware)
+        so_path = build_native()
+        lib = ctypes.CDLL(so_path)
     except Exception as e:  # toolchain missing → numpy fallback
         logger.warning("native worker core unavailable (%s); using numpy", e)
         _LOAD_FAILED = True
         return None
     i64, u32, i32 = ctypes.c_int64, ctypes.c_uint32, ctypes.c_int32
+    # restype = None on the void hot loops — persia-lint ABI003 enforces it
     lib.wk_dedup.restype = i64
     lib.wk_dedup.argtypes = [_u64p, i64, _u64p, _i64p]
+    lib.wk_sum_pool.restype = None
     lib.wk_sum_pool.argtypes = [_f32p, _i64p, _i64p, i64, i64, _f32p]
+    lib.wk_grad_accum.restype = None
     lib.wk_grad_accum.argtypes = [_f32p, _i64p, _i64p, i64, i64, _f32p]
+    lib.wk_raw_index.restype = None
     lib.wk_raw_index.argtypes = [_i64p, _i64p, i64, i64, i32, _i32p]
+    lib.wk_shard_partition.restype = None
     lib.wk_shard_partition.argtypes = [_u64p, i64, u32, _i64p, _i64p]
+    lib.wk_build_sid_matrix.restype = None
     lib.wk_build_sid_matrix.argtypes = [
         ctypes.POINTER(ctypes.c_void_p), _u64p, i64, i64, i32, _u64p,
     ]

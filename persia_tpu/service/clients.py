@@ -17,6 +17,7 @@ from persia_tpu.config import HyperParameters
 from persia_tpu.data import PersiaBatch
 from persia_tpu.embedding.optim import OptimizerConfig
 from persia_tpu.service import proto
+from persia_tpu.service import resilience
 from persia_tpu.service.resilience import Deadline, ResiliencePolicy
 from persia_tpu.service.rpc import RpcClient
 
@@ -237,19 +238,14 @@ class WorkerClient:
 
     def wait_serving(self, timeout_s: float = 60.0) -> None:
         """Block until the worker reports its whole PS tier ready (ref:
-        wait_for_serving polling, core/rpc.rs:118-241)."""
-        import time as _time
-
-        deadline = _time.time() + timeout_s
-        while True:
-            try:
-                if self._rpc.call("ready_for_serving", idempotent=True) == b"1":
-                    return
-            except Exception:  # noqa: BLE001
-                pass
-            if _time.time() > deadline:
-                raise TimeoutError("embedding worker's PS tier not serving")
-            _time.sleep(0.3)
+        wait_for_serving polling, core/rpc.rs:118-241). Policy-driven poll:
+        seeded backoff, Deadline-capped, shared breaker state."""
+        resilience.poll_until(
+            lambda: self._rpc.call("ready_for_serving", idempotent=True) == b"1",
+            timeout_s,
+            policy=self._rpc.policy,
+            what="embedding worker's PS tier serving",
+        )
 
     def put_forward_ids(self, batch: PersiaBatch) -> int:
         return struct.unpack("<q", self._rpc.call("forward_batched", batch.to_bytes()))[0]

@@ -34,12 +34,13 @@ def _load() -> Optional[ctypes.CDLL]:
     try:
         from persia_tpu.embedding._native_build import build_so
 
-        build_so(
+        # CDLL the path build_so RETURNS (sanitizer-variant aware)
+        so_path = build_so(
             _SRC, _SO,
             ["-O3", "-std=c++17", "-fPIC", "-shared", "-Wall"],
             logger,
         )
-        lib = ctypes.CDLL(_SO)
+        lib = ctypes.CDLL(so_path)
         i64, u8p = ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8)
         lib.lz4_compress_bound.restype = i64
         lib.lz4_compress_bound.argtypes = [i64]

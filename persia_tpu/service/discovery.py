@@ -10,10 +10,10 @@ pushed at context entry, replacing `publish_register_optimizer`)."""
 from __future__ import annotations
 
 import threading
-import time
 from typing import Dict, List, Optional, Tuple
 
 from persia_tpu.service import proto
+from persia_tpu.service import resilience
 from persia_tpu.service.rpc import RpcClient, RpcServer
 
 
@@ -81,19 +81,24 @@ class CoordinatorClient:
         return proto.unpack_json(self._client.call("list", role.encode(), idempotent=True))
 
     def wait_for(self, role: str, count: int, timeout_s: float = 120.0) -> List[str]:
-        """Readiness barrier with backoff (ref: nats.rs:162-216)."""
-        deadline = time.time() + timeout_s
-        delay = 0.1
-        while True:
-            addrs = self.list(role)
-            if len(addrs) >= count:
-                return addrs
-            if time.time() > deadline:
-                raise TimeoutError(
-                    f"waited {timeout_s}s for {count} {role!r}, have {len(addrs)}"
-                )
-            time.sleep(delay)
-            delay = min(delay * 2, 2.0)
+        """Readiness barrier on the shared engine (ref: nats.rs:162-216).
+        Probe errors are NOT swallowed — a dead coordinator should fail
+        fast, only a short registry is worth waiting out."""
+        have: List[str] = []
+
+        def _probe() -> Optional[List[str]]:
+            have[:] = self.list(role)
+            return list(have) if len(have) >= count else None
+
+        try:
+            return resilience.poll_until(
+                _probe, timeout_s, what=f"{count} {role!r} registrations",
+                swallow=(),
+            )
+        except resilience.DeadlineExceeded:
+            raise TimeoutError(
+                f"waited {timeout_s}s for {count} {role!r}, have {len(have)}"
+            ) from None
 
     def kv_put(self, key: str, value: bytes) -> None:
         self._client.call("kv_put", proto.pack_json({"key": key}) + b"\x00" + value)

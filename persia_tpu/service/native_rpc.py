@@ -33,6 +33,7 @@ _SRCS = [
 ]
 _SO = os.path.join(_REPO_ROOT, "native", "libpersia_net.so")
 _PS_SO = os.path.join(_REPO_ROOT, "native", "libpersia_ps.so")
+_PS_SO_PATH = _PS_SO  # resolved (variant-aware) by _load()
 
 _FALLBACK_CB = ctypes.CFUNCTYPE(
     None, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
@@ -51,13 +52,18 @@ def _load() -> Optional[ctypes.CDLL]:
         from persia_tpu.embedding._native_build import build_so
         from persia_tpu.embedding.native_store import build_native as build_ps
 
-        build_ps()  # the server dlopens libpersia_ps.so for the store calls
-        build_so(
+        global _PS_SO_PATH
+        # the server dlopens libpersia_ps.so for the store calls — under a
+        # sanitizer that must be the matching VARIANT ps artifact (mixed
+        # sanitized/unsanitized cores in one process would miss reports)
+        _PS_SO_PATH = build_ps()
+        # CDLL the path build_so RETURNS (sanitizer-variant aware)
+        so_path = build_so(
             _SRCS, _SO,
             ["-O3", "-std=c++17", "-fPIC", "-shared", "-Wall", "-pthread", "-ldl"],
             logger,
         )
-        lib = ctypes.CDLL(_SO)
+        lib = ctypes.CDLL(so_path)
         lib.net_server_start.restype = ctypes.c_void_p
         lib.net_server_start.argtypes = [
             ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p, _FALLBACK_CB,
@@ -65,7 +71,9 @@ def _load() -> Optional[ctypes.CDLL]:
         ]
         lib.net_server_port.restype = ctypes.c_int
         lib.net_server_port.argtypes = [ctypes.c_void_p]
+        lib.net_server_stop.restype = None
         lib.net_server_stop.argtypes = [ctypes.c_void_p]
+        lib.net_reply.restype = None
         lib.net_reply.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int64,
         ]
@@ -105,7 +113,7 @@ class NativeRpcServer:
         # raw pointer)
         self._cb = _FALLBACK_CB(self._fallback)
         self._handle = lib.net_server_start(
-            port, store._h, _PS_SO.encode(), self._cb, compress_threshold
+            port, store._h, _PS_SO_PATH.encode(), self._cb, compress_threshold
         )
         if not self._handle:
             raise RuntimeError("net_server_start failed")

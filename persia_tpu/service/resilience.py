@@ -263,6 +263,42 @@ class ResiliencePolicy:
         return self.retry.backoff(attempt)
 
 
+def poll_until(
+    probe,
+    timeout_s: float,
+    policy: Optional[ResiliencePolicy] = None,
+    what: str = "condition",
+    swallow=(Exception,),
+):
+    """Policy-driven readiness poll — THE way to wait for a remote state.
+
+    Calls ``probe()`` until it returns a truthy value (which is returned),
+    swallowing ``swallow`` exceptions (pass ``()`` to fail fast on probe
+    errors), sleeping the engine's seeded backoff between attempts with
+    every sleep capped by the remaining :class:`Deadline` budget. Raises
+    :class:`DeadlineExceeded` (a ``TimeoutError``) when the budget runs
+    out. Replaces the hand-rolled ``while True: try/except/sleep`` loops
+    persia-lint RES003/RES004 forbid outside this module."""
+    pol = policy if policy is not None else default_policy()
+    dl = Deadline(timeout_s)
+    attempt = 0
+    while True:
+        try:
+            val = probe()
+            if val:
+                return val
+        except swallow:  # noqa: PERF203 — probe failures ARE the poll signal
+            pass
+        if dl.expired:
+            raise DeadlineExceeded(
+                f"timed out after {timeout_s:g}s waiting for {what}"
+            )
+        # cap backoff growth at attempt 8 (~policy max anyway) and by the
+        # remaining budget so the final sleep never overshoots the deadline
+        time.sleep(min(pol.backoff(min(attempt, 8)), max(dl.remaining(), 0.0)))
+        attempt += 1
+
+
 _DEFAULT: Optional[ResiliencePolicy] = None
 _DEFAULT_LOCK = threading.Lock()
 

@@ -37,6 +37,7 @@ from persia_tpu.service.resilience import (
     DeadlineExceeded,
     ResiliencePolicy,
     default_policy,
+    poll_until,
 )
 
 logger = get_default_logger("persia_tpu.rpc")
@@ -553,13 +554,12 @@ class RpcClient:
             pass
 
     def wait_ready(self, timeout_s: float = 60.0) -> None:
-        deadline = time.time() + timeout_s
-        while True:
-            try:
-                if self.call("ping") == b"pong":
-                    return
-            except RpcError:
-                pass
-            if time.time() > deadline:
-                raise TimeoutError(f"service at {self.addr} not ready")
-            time.sleep(0.2)
+        """Ping-poll on the shared engine (seeded backoff, Deadline-capped;
+        pings are breaker-exempt so a half-open endpoint can re-close)."""
+        poll_until(
+            lambda: self.call("ping") == b"pong",
+            timeout_s,
+            policy=self.policy,
+            what=f"service at {self.addr}",
+            swallow=(RpcError,),
+        )

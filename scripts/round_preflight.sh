@@ -6,9 +6,13 @@
 #
 #   bash scripts/round_preflight.sh
 #
-# 0. native cores compile from source + the fused-feed ABI parity tests
-#    pass (a broken ctypes signature loads fine and silently corrupts —
-#    only the golden parity tests catch it)
+# 0. persia-lint (ABI drift + concurrency + resilience rules) + native
+#    cores compile from source + the fused-feed ABI parity tests pass
+#    (a broken ctypes signature loads fine and silently corrupts — the
+#    lint catches the declaration drift, the golden parity tests catch
+#    the rest) + the native parity suites under UBSan (zero reports or
+#    the run aborts). ASan is opt-in (PREFLIGHT_ASAN=1) — preloading
+#    libasan instruments all of python and costs ~100s.
 # 1. chaos suite, fast schedules (fault proxies, breakers, degraded mode)
 # 2. full test suite green
 # 3. bench.py rc=0 (real chip when attached; emits partial records on a
@@ -17,7 +21,9 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 0/5 native build + ABI parity smoke =="
+echo "== 0/5 persia-lint + native build + ABI parity smoke =="
+# static pass first: it needs no toolchain and fails in <1s on drift
+python -m persia_tpu.analysis
 # force=True recompile of every core: the stamp cache must not mask a
 # toolchain or source breakage
 JAX_PLATFORMS=cpu python - <<'PY'
@@ -28,6 +34,9 @@ for name, builder in (("ps", native_store.build_native),
     print(name, builder(force=True))
 PY
 JAX_PLATFORMS=cpu python -m pytest tests/test_native_feed.py -q
+# UBSan variant of the full parity surface (~10s incl. variant builds);
+# SANITIZE_ASAN rides the same script when PREFLIGHT_ASAN=1
+SANITIZE_ASAN="${PREFLIGHT_ASAN:-0}" bash scripts/sanitize_native.sh
 
 echo "== 1/5 chaos suite (fast schedules) =="
 # deterministic fault injection against live local services: proxies,

@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Sanitizer-hardened runs of the native parity suites.
+#
+#   bash scripts/sanitize_native.sh            # UBSan (fast, no preload)
+#   SANITIZE_ASAN=1 bash scripts/sanitize_native.sh   # + ASan pass
+#
+# The four suites (test_native_feed / test_native_store / test_codec /
+# test_native_worker) drive every extern "C" entry point through the same
+# golden-parity assertions as the production build, but against
+# PERSIA_NATIVE_SANITIZE variant .so's (distinct artifacts + distinct
+# srchash, so they never shadow or stale-cache the production libraries).
+#
+# UBSan is built with -fno-sanitize-recover=undefined: the FIRST report
+# aborts the test process, so "suite green" == "zero reports". ASan is
+# opt-in because preloading libasan instruments the whole python process
+# (jax/numpy included) — it is several times slower and belongs in the
+# deep soak, not every preflight. ASan runs with detect_leaks=0: the
+# leak checker would drown real errors in python-interpreter noise.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SUITES=(tests/test_native_feed.py tests/test_native_store.py
+        tests/test_codec.py tests/test_native_worker.py)
+
+echo "== sanitize_native: UBSan parity =="
+PERSIA_NATIVE_SANITIZE=ubsan \
+UBSAN_OPTIONS=print_stacktrace=1:halt_on_error=1 \
+JAX_PLATFORMS=cpu \
+    python -m pytest "${SUITES[@]}" -q -m 'not slow' -p no:cacheprovider
+
+if [[ "${SANITIZE_ASAN:-0}" == "1" ]]; then
+    echo "== sanitize_native: ASan parity (opt-in) =="
+    ASAN_RT="$(g++ -print-file-name=libasan.so)"
+    PERSIA_NATIVE_SANITIZE=asan \
+    LD_PRELOAD="$ASAN_RT" \
+    ASAN_OPTIONS=detect_leaks=0:abort_on_error=1 \
+    JAX_PLATFORMS=cpu \
+        python -m pytest "${SUITES[@]}" -q -m 'not slow' -p no:cacheprovider
+fi
+
+echo "SANITIZE OK"

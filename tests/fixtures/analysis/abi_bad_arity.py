@@ -1,0 +1,7 @@
+"""ABI001 seed: fx_touch takes 3 args in C, bound with 2."""
+import ctypes
+
+lib = ctypes.CDLL("libfx.so")
+p, i64 = ctypes.c_void_p, ctypes.c_int64
+lib.fx_touch.restype = None
+lib.fx_touch.argtypes = [p, i64]

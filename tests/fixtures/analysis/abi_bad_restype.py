@@ -1,0 +1,6 @@
+"""ABI004 seed: fx_len returns int64, declared c_int32."""
+import ctypes
+
+lib = ctypes.CDLL("libfx.so")
+lib.fx_len.restype = ctypes.c_int32
+lib.fx_len.argtypes = [ctypes.c_void_p]

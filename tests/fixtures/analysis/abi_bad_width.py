@@ -1,0 +1,8 @@
+"""ABI002 seed: fx_touch's int64 count bound as c_int32 (width drift)."""
+import ctypes
+
+lib = ctypes.CDLL("libfx.so")
+p = ctypes.c_void_p
+u64p = ctypes.POINTER(ctypes.c_uint64)
+lib.fx_touch.restype = None
+lib.fx_touch.argtypes = [p, u64p, ctypes.c_int32]

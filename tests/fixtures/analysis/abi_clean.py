@@ -1,0 +1,19 @@
+"""Fully consistent bindings for fake_native.cpp — the zero-findings case."""
+import ctypes
+import os
+
+_SO = os.path.join("native", "libfx.so")
+
+lib = ctypes.CDLL(_SO)
+p, i64 = ctypes.c_void_p, ctypes.c_int64
+u64p = ctypes.POINTER(ctypes.c_uint64)
+lib.fx_create.restype = p
+lib.fx_create.argtypes = [i64]
+lib.fx_destroy.restype = None
+lib.fx_destroy.argtypes = [p]
+lib.fx_len.restype = i64
+lib.fx_len.argtypes = [p]
+lib.fx_touch.restype = None
+lib.fx_touch.argtypes = [p, u64p, i64]
+lib.fx_orphan.restype = i64
+lib.fx_orphan.argtypes = [p]

@@ -1,0 +1,5 @@
+"""ABI007 seed: restype declared, argtypes never."""
+import ctypes
+
+lib = ctypes.CDLL("libfx.so")
+lib.fx_len.restype = ctypes.c_int64

@@ -1,0 +1,5 @@
+"""ABI003 seed: fx_len returns int64; no restype -> c_int truncation."""
+import ctypes
+
+lib = ctypes.CDLL("libfx.so")
+lib.fx_len.argtypes = [ctypes.c_void_p]

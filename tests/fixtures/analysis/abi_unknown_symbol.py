@@ -1,0 +1,6 @@
+"""ABI005 seed: binds a symbol fake_native.cpp never exports."""
+import ctypes
+
+lib = ctypes.CDLL("libfx.so")
+lib.fx_does_not_exist.restype = ctypes.c_int64
+lib.fx_does_not_exist.argtypes = [ctypes.c_void_p]

@@ -1,0 +1,6 @@
+"""ABI008 seed: calls through the handle with no declarations at all."""
+import ctypes
+
+lib = ctypes.CDLL("libfx.so")
+handle = lib.fx_create(1024)
+n = lib.fx_len(handle)

@@ -1,0 +1,11 @@
+"""CONC001 seed: mutex taken with bare acquire() instead of `with`."""
+import threading
+
+_lock = threading.Lock()
+state = []
+
+
+def update(item):
+    _lock.acquire()
+    state.append(item)
+    _lock.release()

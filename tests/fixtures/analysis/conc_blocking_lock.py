@@ -1,0 +1,12 @@
+"""CONC003 seed: sleeping and making a native ctypes call under a lock."""
+import threading
+import time
+
+_lock = threading.Lock()
+lib = None
+
+
+def slow_update(handle, n):
+    with _lock:
+        time.sleep(0.5)
+        lib.cache_admit(handle, n)

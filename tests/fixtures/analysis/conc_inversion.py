@@ -1,0 +1,15 @@
+"""CONC004 seed: takes the stream cv while holding a leaf _lock —
+inverting the declared order (cv is rank 0 / outermost)."""
+import threading
+
+cv = threading.Condition()
+
+
+class Tier:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def drain(self):
+        with self._lock:
+            with cv:
+                cv.notify_all()

@@ -1,0 +1,29 @@
+"""CONC002 seed: a permit acquired with a raise-capable gap before the
+try that releases it — the permit leaks if log_progress throws."""
+import threading
+
+staleness_sem = threading.Semaphore(4)
+
+
+def log_progress():
+    pass
+
+
+def feed(batch, out_q):
+    staleness_sem.acquire()
+    log_progress()  # anything raising here leaks the permit
+    try:
+        out_q.put(batch)
+    except Exception:
+        staleness_sem.release()
+        raise
+
+
+def feed_span(ring, batch):
+    ring.reserve(len(batch))
+    log_progress()  # same gap, ring-span flavour
+    try:
+        ring.fill(batch)
+    except Exception:
+        ring.release(len(batch))
+        raise

@@ -1,0 +1,20 @@
+// Seeded-violation fixture surface for the persia-lint ABI tests: a tiny
+// extern "C" library ("libfx.so") each abi_*.py fixture binds against.
+// Never compiled — the checker only parses declarations.
+#include <cstdint>
+
+extern "C" {
+
+void* fx_create(int64_t capacity);
+
+void fx_destroy(void* h);
+
+int64_t fx_len(void* h);
+
+void fx_touch(void* h, const uint64_t* signs, int64_t n);
+
+// exported on purpose with NO binding in abi_clean.py's siblings: the
+// ABI006 fixture asserts the unbound-export rule fires
+int64_t fx_orphan(void* h);
+
+}  // extern "C"

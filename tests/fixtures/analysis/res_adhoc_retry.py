@@ -1,0 +1,11 @@
+"""RES003 seed: hand-rolled swallow-and-sleep retry loop."""
+import time
+
+
+def fetch(client, delay_s):
+    while True:
+        try:
+            return client.call("get")
+        except ConnectionError:
+            pass
+        time.sleep(delay_s)

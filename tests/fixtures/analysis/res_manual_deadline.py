@@ -1,0 +1,12 @@
+"""RES004 seed: wall-clock deadline variable driving a sleep poll."""
+import time
+
+
+def wait_ready(client, timeout_s, delay_s):
+    deadline = time.time() + timeout_s
+    while True:
+        if client.ready():
+            return
+        if time.time() > deadline:
+            raise TimeoutError("not ready")
+        time.sleep(delay_s)

@@ -1,0 +1,7 @@
+"""RES001 seed: constant backoff sleep outside the resilience engine."""
+import time
+
+
+def nudge(client):
+    client.poke()
+    time.sleep(0.25)

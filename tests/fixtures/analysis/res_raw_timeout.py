@@ -1,0 +1,8 @@
+"""RES002 seed: constant socket timeouts bypassing Deadline.cap."""
+import socket
+
+
+def connect(host, port):
+    s = socket.create_connection((host, port), timeout=2.0)
+    s.settimeout(0.5)
+    return s

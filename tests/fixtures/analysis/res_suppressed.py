@@ -1,0 +1,9 @@
+"""Inline-suppression fixture: the same RES001 violation as
+res_raw_sleep.py, silenced with a justified disable comment."""
+import time
+
+
+def nudge(client):
+    client.poke()
+    # settling delay required by the peer's accept loop, not a retry
+    time.sleep(0.25)  # persia-lint: disable=RES001

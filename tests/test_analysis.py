@@ -1,0 +1,303 @@
+"""persia-lint (persia_tpu.analysis) + sanitizer-variant build tests.
+
+Two halves:
+
+- seeded-violation fixtures under tests/fixtures/analysis/ — one bad
+  snippet per rule — assert every rule FIRES (a lint whose rules can rot
+  silently is worse than no lint);
+- the clean-tree gate — the real repo must produce ZERO findings with
+  full coverage (5 native libs, all registered binding files), which is
+  exactly what scripts/round_preflight.sh step 0 enforces.
+
+Plus unit coverage for the sanitizer-variant native builds: distinct
+artifact names, flag/variant folding into the srchash (a flag change must
+rebuild), and a real UBSan compile through build_so.
+"""
+
+import logging
+import os
+import subprocess
+import sys
+
+import pytest
+
+from persia_tpu.analysis import abi, concurrency, cparse, resilience_lint, run_all
+from persia_tpu.analysis.common import (
+    CTYPES_FILES,
+    NATIVE_LIBS,
+    REPO_ROOT,
+    apply_suppressions,
+    read_text,
+)
+from persia_tpu.embedding import _native_build
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures", "analysis")
+FX_LIBS = {"libfx.so": ["fake_native.cpp"]}
+
+logger = logging.getLogger("test_analysis")
+
+
+def _fixture(name: str) -> str:
+    return os.path.join(FIXDIR, name)
+
+
+def _abi_rules(binding_file: str):
+    findings, _cov = abi.check(
+        root=FIXDIR, binding_files=[_fixture(binding_file)], libs=FX_LIBS
+    )
+    return findings, {f.rule for f in findings}
+
+
+# --------------------------------------------------------------- C parser
+
+
+def test_cparse_fake_surface():
+    funcs, warns = cparse.parse_extern_c(
+        read_text(_fixture("fake_native.cpp")), "fake_native.cpp"
+    )
+    assert warns == []
+    by_name = {f.name: f for f in funcs}
+    assert set(by_name) == {"fx_create", "fx_destroy", "fx_len", "fx_touch", "fx_orphan"}
+    assert by_name["fx_create"].ret == ("ptr", ("void",))
+    assert by_name["fx_touch"].ret == ("void",)
+    assert by_name["fx_touch"].params == [
+        ("ptr", ("void",)), ("ptr", ("int", 64, False)), ("int", 64, True),
+    ]
+
+
+def test_cparse_real_surfaces_parse_fully():
+    """All five production libs parse with no warnings and plausible
+    export counts — the coverage the clean-tree gate depends on."""
+    for lib, sources in NATIVE_LIBS.items():
+        for src in sources:
+            funcs, warns = cparse.parse_extern_c(
+                read_text(os.path.join(REPO_ROOT, src)), src
+            )
+            assert warns == [], f"{src}: {warns}"
+            assert funcs, f"{src} parsed zero extern C declarations"
+
+
+# ------------------------------------------------------------ ABI fixtures
+
+
+@pytest.mark.parametrize(
+    "fixture, rule",
+    [
+        ("abi_bad_arity.py", "ABI001"),
+        ("abi_bad_width.py", "ABI002"),
+        ("abi_missing_restype.py", "ABI003"),
+        ("abi_bad_restype.py", "ABI004"),
+        ("abi_unknown_symbol.py", "ABI005"),
+        ("abi_missing_argtypes.py", "ABI007"),
+        ("abi_untyped_call.py", "ABI008"),
+    ],
+)
+def test_abi_rule_fires(fixture, rule):
+    findings, rules = _abi_rules(fixture)
+    assert rule in rules, f"{fixture}: expected {rule}, got {findings}"
+
+
+def test_abi_unbound_export_fires():
+    # any fixture that leaves fx_orphan unbound triggers ABI006 on the cpp
+    findings, rules = _abi_rules("abi_missing_restype.py")
+    assert "ABI006" in rules
+    orphaned = [f for f in findings if f.rule == "ABI006"]
+    assert any("fx_orphan" in f.message for f in orphaned)
+
+
+def test_abi_clean_bindings_zero_findings():
+    findings, cov = abi.check(
+        root=FIXDIR, binding_files=[_fixture("abi_clean.py")], libs=FX_LIBS
+    )
+    assert findings == [], findings
+    assert cov["libs"] == {"libfx.so": 5}
+
+
+# ----------------------------------------------------- concurrency fixtures
+
+
+@pytest.mark.parametrize(
+    "fixture, rule",
+    [
+        ("conc_bare_acquire.py", "CONC001"),
+        ("conc_leaky_acquire.py", "CONC002"),
+        ("conc_blocking_lock.py", "CONC003"),
+        ("conc_inversion.py", "CONC004"),
+    ],
+)
+def test_concurrency_rule_fires(fixture, rule):
+    findings = concurrency.check_source(read_text(_fixture(fixture)), fixture)
+    assert rule in {f.rule for f in findings}, findings
+
+
+def test_conc_leaky_acquire_flags_both_permit_and_span():
+    findings = concurrency.check_source(
+        read_text(_fixture("conc_leaky_acquire.py")), "conc_leaky_acquire.py"
+    )
+    msgs = [f.message for f in findings if f.rule == "CONC002"]
+    assert any("permit" in m for m in msgs)
+    assert any("span" in m for m in msgs)
+
+
+def test_conc_blocking_lock_flags_native_call_too():
+    findings = concurrency.check_source(
+        read_text(_fixture("conc_blocking_lock.py")), "conc_blocking_lock.py"
+    )
+    msgs = [f.message for f in findings if f.rule == "CONC003"]
+    assert any("time.sleep" in m for m in msgs)
+    assert any("native call" in m for m in msgs)
+
+
+def test_conc_correct_patterns_stay_silent():
+    src = (
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "sem = threading.Semaphore(2)\n"
+        "def ok(out_q, batch):\n"
+        "    with _lock:\n"
+        "        pass\n"
+        "    sem.acquire()\n"
+        "    try:\n"
+        "        out_q.put(batch)\n"
+        "    except Exception:\n"
+        "        sem.release()\n"
+        "        raise\n"
+    )
+    assert concurrency.check_source(src, "ok.py") == []
+
+
+# ------------------------------------------------------ resilience fixtures
+
+
+@pytest.mark.parametrize(
+    "fixture, rule",
+    [
+        ("res_raw_sleep.py", "RES001"),
+        ("res_raw_timeout.py", "RES002"),
+        ("res_adhoc_retry.py", "RES003"),
+        ("res_manual_deadline.py", "RES004"),
+    ],
+)
+def test_resilience_rule_fires(fixture, rule):
+    findings = resilience_lint.check_source(read_text(_fixture(fixture)), fixture)
+    assert rule in {f.rule for f in findings}, findings
+
+
+def test_resilience_policy_driven_loop_is_allowed():
+    src = (
+        "import time\n"
+        "def call_with_retry(pol, deadline, fn):\n"
+        "    for attempt in range(3):\n"
+        "        try:\n"
+        "            return fn()\n"
+        "        except ConnectionError:\n"
+        "            pass\n"
+        "        time.sleep(min(pol.backoff(attempt), deadline.remaining()))\n"
+    )
+    assert resilience_lint.check_source(src, "engineish.py") == []
+
+
+def test_inline_suppression_silences_finding():
+    path = "res_suppressed.py"
+    text = read_text(_fixture(path))
+    raw = resilience_lint.check_source(text, path)
+    assert {f.rule for f in raw} == {"RES001"}  # the violation IS there
+    assert apply_suppressions(raw, {path: text}) == []  # and the disable works
+
+
+# ------------------------------------------------------------- clean tree
+
+
+def test_clean_tree_zero_findings_with_full_coverage():
+    findings, coverage = run_all()
+    assert findings == [], "\n".join(f.format() for f in findings)
+    abi_cov = coverage["abi"]
+    assert set(abi_cov["libs"]) == set(NATIVE_LIBS)
+    assert all(n > 0 for n in abi_cov["libs"].values()), abi_cov["libs"]
+    assert len(abi_cov["binding_files"]) == 5
+    # every registered ctypes file is inside the scanned python set
+    assert sorted(coverage["ctypes_files"]) == sorted(CTYPES_FILES)
+    assert len(CTYPES_FILES) == 11
+
+
+def test_cli_exit_codes():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ok = subprocess.run(
+        [sys.executable, "-m", "persia_tpu.analysis"],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "0 finding(s)" in ok.stdout
+    bad = subprocess.run(
+        [sys.executable, "-m", "persia_tpu.analysis", "--rules", "RES001",
+         "--root", REPO_ROOT],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+    )
+    assert bad.returncode == 0  # clean tree stays clean under a filter too
+
+
+# --------------------------------------------------- sanitizer build variants
+
+
+def test_variant_so_path_naming():
+    assert _native_build.variant_so_path("/x/libpersia_ps.so", "") == "/x/libpersia_ps.so"
+    assert _native_build.variant_so_path("/x/libpersia_ps.so", "asan") == "/x/libpersia_ps.asan.so"
+    assert _native_build.variant_so_path("/x/libpersia_ps.so", "ubsan") == "/x/libpersia_ps.ubsan.so"
+
+
+def test_sanitize_variant_env_parsing(monkeypatch):
+    monkeypatch.delenv("PERSIA_NATIVE_SANITIZE", raising=False)
+    assert _native_build.sanitize_variant() == ""
+    monkeypatch.setenv("PERSIA_NATIVE_SANITIZE", "ubsan")
+    assert _native_build.sanitize_variant() == "ubsan"
+    monkeypatch.setenv("PERSIA_NATIVE_SANITIZE", "ASAN")
+    assert _native_build.sanitize_variant() == "asan"
+    monkeypatch.setenv("PERSIA_NATIVE_SANITIZE", "tsan")
+    with pytest.raises(ValueError):
+        _native_build.sanitize_variant()
+
+
+_TINY_SRC = (
+    "#include <cstdint>\n"
+    'extern "C" int64_t tiny_add(int64_t a, int64_t b) { return a + b; }\n'
+)
+_BASE_FLAGS = ["-O2", "-std=c++17", "-fPIC", "-shared"]
+
+
+def test_flag_change_invalidates_srchash(tmp_path, monkeypatch):
+    """The stale-cache hole the source-only hash left open: same source,
+    different flags must recompile."""
+    monkeypatch.delenv("PERSIA_NATIVE_SANITIZE", raising=False)
+    src = tmp_path / "tiny.cpp"
+    src.write_text(_TINY_SRC)
+    so = str(tmp_path / "libtiny.so")
+    _native_build.build_so(str(src), so, _BASE_FLAGS, logger)
+    stamp1 = read_text(so + ".srchash")
+    # same flags -> stamp unchanged, no rebuild
+    _native_build.build_so(str(src), so, _BASE_FLAGS, logger)
+    assert read_text(so + ".srchash") == stamp1
+    # a -D define changes semantics without touching the source
+    _native_build.build_so(str(src), so, _BASE_FLAGS + ["-DEXTRA=1"], logger)
+    assert read_text(so + ".srchash") != stamp1
+
+
+def test_ubsan_variant_builds_distinct_artifact(tmp_path, monkeypatch):
+    src = tmp_path / "tiny.cpp"
+    src.write_text(_TINY_SRC)
+    so = str(tmp_path / "libtiny.so")
+    monkeypatch.delenv("PERSIA_NATIVE_SANITIZE", raising=False)
+    vanilla = _native_build.build_so(str(src), so, _BASE_FLAGS, logger)
+    monkeypatch.setenv("PERSIA_NATIVE_SANITIZE", "ubsan")
+    sanitized = _native_build.build_so(str(src), so, _BASE_FLAGS, logger)
+    assert vanilla == so
+    assert sanitized == str(tmp_path / "libtiny.ubsan.so")
+    assert os.path.exists(vanilla) and os.path.exists(sanitized)
+    # distinct stamps: the variant can never satisfy the vanilla freshness
+    # check (or vice versa) even though the source bytes are identical
+    assert read_text(vanilla + ".srchash") != read_text(sanitized + ".srchash")
+    import ctypes
+
+    lib = ctypes.CDLL(sanitized)
+    lib.tiny_add.restype = ctypes.c_int64
+    lib.tiny_add.argtypes = [ctypes.c_int64, ctypes.c_int64]
+    assert lib.tiny_add(40, 2) == 42
