@@ -795,13 +795,91 @@ def _quality_tier_main(tier: str, steps: int):
     print(json.dumps(res), flush=True)
 
 
+def _bench_kill_resume():
+    """Trainer kill-resume scenario for the chaos artifact: a journaled
+    TrainCtx run is abandoned mid-window (the state a SIGKILLed trainer
+    leaves: PS alive, trainer memory gone), then resumed from the newest
+    manifest. Records recovery metrics for BOTH resume modes —
+    ``rewind`` (PS shards rewound to the fence; the replay re-applies and
+    must end bit-identical to an uninterrupted run, asserted here) and
+    ``journal`` (PS kept; the replayed window's applies dedupe against
+    the apply-journal — journal_hits counts them)."""
+    import shutil
+    import tempfile
+
+    import optax
+
+    from persia_tpu.config import EmbeddingConfig, SlotConfig
+    from persia_tpu.ctx import TrainCtx
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.embedding.store import EmbeddingStore
+    from persia_tpu.embedding.worker import EmbeddingWorker
+    from persia_tpu.jobstate import JobStateManager
+    from persia_tpu.models import DNN
+    from persia_tpu.testing import SyntheticClickDataset
+
+    STEPS, K, KILL_AT = 12, 4, 9
+    cfg = EmbeddingConfig(
+        slots_config={"cat_0": SlotConfig(dim=8), "cat_1": SlotConfig(dim=8)},
+        feature_index_prefix_bit=8,
+    )
+    batches = list(
+        SyntheticClickDataset(num_samples=STEPS * 64, vocab_sizes=(64, 32), seed=9)
+        .batches(64)
+    )[:STEPS]
+
+    def make_ctx(stores):
+        return TrainCtx(
+            model=DNN(dense_mlp_size=8, sparse_mlp_size=16, hidden_sizes=(32,)),
+            dense_optimizer=optax.adam(3e-3),
+            embedding_optimizer=Adagrad(lr=0.1),
+            worker=EmbeddingWorker(cfg, stores), embedding_config=cfg,
+        ).__enter__()
+
+    out = {"steps": STEPS, "snapshot_every": K, "killed_at_step": KILL_AT}
+    for mode, restore_ps in (("rewind", True), ("journal", False)):
+        tmp = tempfile.mkdtemp(prefix=f"bench_resume_{mode}_")
+        try:
+            stores = [
+                EmbeddingStore(capacity=1 << 16, num_internal_shards=4, seed=7)
+                for _ in range(2)
+            ]
+            mgr = JobStateManager(tmp)
+            ctx1 = make_ctx(stores)
+            ctx1.resume(mgr)
+            for i in range(KILL_AT):
+                ctx1.train_step(batches[i])
+                if (i + 1) % K == 0:
+                    ctx1.snapshot_job(mgr)
+            del ctx1  # the trainer "dies"; the PS tier survives
+
+            t0 = time.perf_counter()
+            ctx2 = make_ctx(stores)
+            m = ctx2.resume(mgr, restore_ps=restore_ps)
+            resume_s = time.perf_counter() - t0
+            for i in range(m.step, STEPS):
+                ctx2.train_step(batches[i])
+            router = ctx2.worker.lookup_router
+            out[mode] = {
+                "time_to_resume_s": round(resume_s, 4),
+                "steps_replayed": STEPS - m.step,
+                "journal_hits": router.journal_skips,
+                "resume_info": ctx2.last_resume_info,
+            }
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def bench_chaos():
     """Chaos soak: the cached stream against REAL subprocess PS shards
     fronted by fault-injecting proxies (persia_tpu/chaos.py), with a
-    scripted mid-run kill + snapshot-replaying restart of one shard. The
-    record carries the chaos config, the injected-fault counts, breaker
-    trips/states, and the degraded-lookup accounting — a soak run is only
-    evidence if the artifact shows what was injected and what it cost.
+    scripted mid-run kill + snapshot-replaying restart of one shard,
+    plus a trainer kill-resume scenario recording recovery metrics
+    (time-to-resume, steps replayed, journal hits). The record carries
+    the chaos config, the injected-fault counts, breaker trips/states,
+    and the degraded-lookup accounting — a soak run is only evidence if
+    the artifact shows what was injected and what it cost.
 
     Spec via ``BENCH_CHAOS`` (see chaos.parse_chaos_spec), e.g.
     ``python bench.py --chaos=reset=0.02,slow=0.01,seed=7``. Runs on the
@@ -891,6 +969,9 @@ def bench_chaos():
                 "samples_per_sec": round(steps * batch / elapsed, 1),
                 "steps": steps,
                 "chaos": cfg_chaos.to_dict(),
+                # trainer kill-resume recovery metrics (jobstate.py):
+                # time-to-resume, steps replayed, journal hits per mode
+                "kill_resume": _bench_kill_resume(),
                 "faults_injected": plane.fault_counts(),
                 "degraded_steps": st.get("degraded_steps", 0),
                 "degraded_lookup_frac_max": st.get(

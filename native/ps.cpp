@@ -28,6 +28,7 @@
 #include <map>
 #include <mutex>
 #include <new>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -216,6 +217,52 @@ struct Store {
   OptimizerConfig opt;
   std::map<int, std::pair<double, double>> batch_state;  // group -> (b1^t, b2^t)
   std::mutex batch_mu;
+
+  // Bounded apply-journal (crash-consistent trainer resume): ids of
+  // gradient batches already applied between snapshot fences, each with a
+  // crc32 of its payload. A resuming trainer probes before re-applying a
+  // replayed batch — present+matching means "already applied, skip"
+  // (exactly-once), present+mismatching means the replay diverged (error).
+  // FIFO-bounded: the ring evicts the oldest id once `journal_cap` is
+  // reached, which is safe because a resume only replays ids newer than
+  // the last committed fence.
+  std::unordered_map<uint64_t, uint32_t> journal_map;  // id -> payload crc
+  std::vector<uint64_t> journal_ring;                  // insertion order
+  size_t journal_cap = 1 << 16;
+  size_t journal_head = 0;  // ring slot the next insert overwrites when full
+  std::mutex journal_mu;
+
+  void journal_record(uint64_t id, uint32_t crc) {
+    std::lock_guard<std::mutex> g(journal_mu);
+    auto it = journal_map.find(id);
+    if (it != journal_map.end()) {
+      it->second = crc;
+      return;
+    }
+    if (journal_ring.size() < journal_cap) {
+      journal_ring.push_back(id);
+    } else {
+      journal_map.erase(journal_ring[journal_head]);
+      journal_ring[journal_head] = id;
+      journal_head = (journal_head + 1) % journal_cap;
+    }
+    journal_map.emplace(id, crc);
+  }
+
+  // 1 = applied (crc matches), 0 = unknown, -1 = applied w/ different crc
+  int journal_probe(uint64_t id, uint32_t crc) {
+    std::lock_guard<std::mutex> g(journal_mu);
+    auto it = journal_map.find(id);
+    if (it == journal_map.end()) return 0;
+    return it->second == crc ? 1 : -1;
+  }
+
+  void journal_clear() {
+    std::lock_guard<std::mutex> g(journal_mu);
+    journal_map.clear();
+    journal_ring.clear();
+    journal_head = 0;
+  }
 
   Store(uint64_t capacity, uint32_t n_shards, uint64_t seed_) : shards(n_shards) {
     num_shards = n_shards;
@@ -893,6 +940,29 @@ int64_t ps_dump_shard(void* h, uint32_t shard, uint8_t* out, int64_t cap) {
   }
   return p - out;
 }
+
+// ------------------------------------------------------------ apply-journal
+// Trainer-resume exactly-once hooks: record/probe applied gradient-batch
+// ids (see Store::journal_*). Journal state is intentionally NOT part of
+// the shard dump wire format — a PS rewind (clear + shard replay) must
+// also clear the journal so replayed post-fence batches re-apply.
+
+void ps_journal_record(void* h, uint64_t id, uint32_t crc) {
+  ((Store*)h)->journal_record(id, crc);
+}
+
+// 1 = already applied (crc matches), 0 = unknown id, -1 = crc mismatch
+int32_t ps_journal_probe(void* h, uint64_t id, uint32_t crc) {
+  return ((Store*)h)->journal_probe(id, crc);
+}
+
+int64_t ps_journal_len(void* h) {
+  Store* s = (Store*)h;
+  std::lock_guard<std::mutex> g(s->journal_mu);
+  return (int64_t)s->journal_map.size();
+}
+
+void ps_journal_clear(void* h) { ((Store*)h)->journal_clear(); }
 
 int64_t ps_load_shard(void* h, const uint8_t* data, int64_t len) {
   Store* s = (Store*)h;

@@ -15,6 +15,10 @@ any finding:
 - **Resilience policy** (RES001–RES004): raw sleeps, constant socket
   timeouts, ad-hoc retry loops and manual wall-clock deadlines in
   ``service/``+``serving/`` that bypass ``service/resilience.py``.
+- **Durability** (DUR001): checkpoint/manifest artifacts written with a
+  plain ``open(..., "w")`` (or direct ``np.savez``) instead of the
+  temp + fsync + atomic-rename publish the crash-consistency layer
+  (persia_tpu.jobstate / checkpoint.py) requires.
 
 Suppress a finding inline with ``# persia-lint: disable=RULE`` (or
 ``disable=all``) on the offending line; C sources use the same token in a
@@ -45,7 +49,7 @@ __all__ = [
     "NATIVE_LIBS",
 ]
 
-_PASS_PREFIXES = ("ABI", "CONC", "RES")
+_PASS_PREFIXES = ("ABI", "CONC", "RES", "DUR")
 
 
 def run_all(
@@ -53,7 +57,7 @@ def run_all(
 ) -> Tuple[List[Finding], Dict[str, object]]:
     """Run every pass over the repo. Returns (findings after suppression,
     coverage report). ``rules`` filters by rule-id prefix (e.g. ["ABI"])."""
-    from persia_tpu.analysis import abi, concurrency, resilience_lint
+    from persia_tpu.analysis import abi, concurrency, durability, resilience_lint
 
     wanted = tuple(r.upper() for r in rules) if rules else _PASS_PREFIXES
     findings: List[Finding] = []
@@ -68,6 +72,8 @@ def run_all(
         findings.extend(concurrency.check(root, py_files))
     if any(w.startswith("RES") for w in wanted):
         findings.extend(resilience_lint.check(root))
+    if any(w.startswith("DUR") for w in wanted):
+        findings.extend(durability.check(root, py_files))
     coverage["python_files_scanned"] = len(py_files)
     coverage["ctypes_files"] = [p for p in CTYPES_FILES
                                 if any(rel(f) == p for f in py_files)]

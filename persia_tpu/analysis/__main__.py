@@ -27,6 +27,7 @@ _RULE_DOC = {
     "RES002": "constant socket timeout bypassing resilience.Deadline.cap",
     "RES003": "ad-hoc retry loop outside resilience (swallow+sleep)",
     "RES004": "manual wall-clock deadline instead of resilience.Deadline",
+    "DUR001": "checkpoint/manifest artifact written without temp+fsync+rename",
 }
 
 

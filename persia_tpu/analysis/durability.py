@@ -1,0 +1,142 @@
+"""Durability lint: checkpoint/manifest artifacts must publish atomically.
+
+The crash-consistency story (persia_tpu.jobstate + checkpoint.py) rests on
+one mechanical invariant: no checkpoint-class artifact — shard files,
+manifests, done-markers, dense state, job-state pointers — is ever written
+with a plain ``open(path, "w")`` (or a direct ``np.savez``), because a
+crash mid-write leaves a torn file under the FINAL name that a later load
+happily reads. Durable writes go temp + fsync + atomic rename
+(``jobstate.fsync_write_bytes`` / ``storage.DiskPath.write_bytes``).
+
+- DUR001: a plain ``open(..., "w"/"wb"/"a"/"ab")`` (or ``np.savez[_
+  compressed]``) whose target expression names a checkpoint artifact
+  (manifest / ckpt / checkpoint / shard / snapshot / .emb / done-marker /
+  last_good / fused_state), inside a function with no atomic-publish
+  machinery (mkstemp / NamedTemporaryFile / os.replace / rename / fsync /
+  write_bytes) anywhere in it.
+
+Scope: the whole ``persia_tpu`` tree — durability holes do not respect
+module boundaries the way the resilience rules' service-plane scope does.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, Optional, Sequence
+
+from persia_tpu.analysis.common import Finding, REPO_ROOT, read_text, rel
+
+# what makes a write target a checkpoint-class artifact
+_ARTIFACT_RE = re.compile(
+    r"manifest|ckpt|checkpoint|shard|snapshot|\.emb|done_marker|done-marker"
+    r"|last_good|fused_state",
+    re.IGNORECASE,
+)
+
+# what proves the enclosing function publishes atomically
+_ATOMIC_RE = re.compile(
+    r"mkstemp|NamedTemporaryFile|os\.replace|\brename\b|fsync|write_bytes"
+    r"|fsync_write_bytes|add_blob|storage_path",
+)
+
+_WRITE_MODES = {"w", "wb", "a", "ab", "w+", "wb+", "a+", "ab+"}
+
+
+def _src(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover
+        return ""
+
+
+def _open_write_mode(call: ast.Call) -> bool:
+    """True when this is ``open(target, <write mode>)`` (positional or kw)."""
+    mode: Optional[ast.expr] = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    return (
+        isinstance(mode, ast.Constant)
+        and isinstance(mode.value, str)
+        and mode.value in _WRITE_MODES
+    )
+
+
+def _is_open(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name) and f.id == "open":
+        return True
+    return (
+        isinstance(f, ast.Attribute) and f.attr == "open"
+        and isinstance(f.value, ast.Name) and f.value.id == "io"
+    )
+
+
+def _is_savez(call: ast.Call) -> bool:
+    f = call.func
+    return isinstance(f, ast.Attribute) and f.attr in ("savez", "savez_compressed")
+
+
+def check_source(text: str, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    tree = ast.parse(text, filename=path)
+
+    # map every write call to its enclosing function (module level counts as
+    # its own scope) so the atomicity whitelist is judged function-locally —
+    # a helper that mkstemps in one function must not whitelist another
+    scopes: List[ast.AST] = [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+
+    def enclosing(call: ast.Call) -> Optional[ast.AST]:
+        best = None
+        for fn in scopes:
+            if fn.lineno <= call.lineno <= max(
+                getattr(fn, "end_lineno", fn.lineno), fn.lineno
+            ):
+                if best is None or fn.lineno > best.lineno:  # innermost
+                    best = fn
+        return best
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target: Optional[ast.expr] = None
+        what = None
+        if _is_open(node) and _open_write_mode(node) and node.args:
+            target, what = node.args[0], "open"
+        elif _is_savez(node) and node.args:
+            target, what = node.args[0], _src(node.func)
+        if target is None:
+            continue
+        tsrc = _src(target)
+        if not _ARTIFACT_RE.search(tsrc):
+            continue
+        fn = enclosing(node)
+        scope_src = _src(fn) if fn is not None else text
+        if _ATOMIC_RE.search(scope_src):
+            continue
+        findings.append(Finding(
+            "DUR001", path, node.lineno,
+            f"{what}({tsrc!r}, <write>) publishes a checkpoint artifact "
+            "without temp + fsync + atomic rename — a crash mid-write "
+            "leaves a torn file under the final name (use "
+            "jobstate.fsync_write_bytes / storage.write_bytes)",
+        ))
+    return findings
+
+
+def check(root: str = REPO_ROOT, files: Optional[Sequence[str]] = None) -> List[Finding]:
+    from persia_tpu.analysis.common import python_files
+
+    paths = list(files) if files is not None else python_files(root)
+    findings: List[Finding] = []
+    for p in paths:
+        abspath = p if os.path.isabs(p) else os.path.join(root, p)
+        findings.extend(check_source(read_text(abspath), rel(abspath)))
+    return findings

@@ -29,6 +29,7 @@ Everything is usable both from tests (tests/test_chaos.py) and from
 
 from __future__ import annotations
 
+import os
 import random
 import socket
 import struct
@@ -307,6 +308,74 @@ class ChaosProxy:
                 return
 
 
+# ----------------------------------------------------------- trainer kills
+
+
+def write_progress(path: str, step: int) -> None:
+    """Trainer-side step beacon for an external killer/watchdog: atomic
+    replace so a reader never sees a torn value. Called once per step by a
+    trainer under chaos test (tests/jobstate_trainer_main.py)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(str(int(step)))
+    os.replace(tmp, path)
+
+
+def read_progress(path: str) -> int:
+    """-1 until the trainer has published its first step."""
+    try:
+        with open(path) as f:
+            return int(f.read().strip() or -1)
+    except (OSError, ValueError):
+        return -1
+
+
+class TrainerKiller:
+    """SIGKILL a trainer subprocess when its progress beacon reaches a
+    target step — the process-fault half of the trainer-crash story (the
+    PS-side kills live in :class:`ChaosPlane`). The kill is a real
+    ``SIGKILL`` mid-step: no atexit, no flush, exactly the failure a TPU
+    preemption or OOM-kill presents."""
+
+    def __init__(self, proc, progress_path: str, kill_at_step: int,
+                 poll_s: float = 0.02):
+        self.proc = proc
+        self.progress_path = progress_path
+        self.kill_at_step = int(kill_at_step)
+        self.poll_s = poll_s
+        self.killed_at: Optional[int] = None
+        self._done = threading.Event()
+        self._thread = threading.Thread(
+            target=self._watch, daemon=True, name="chaos-trainer-killer"
+        )
+
+    def start(self) -> "TrainerKiller":
+        self._thread.start()
+        return self
+
+    def _watch(self) -> None:
+        try:
+            while self.proc.poll() is None:
+                step = read_progress(self.progress_path)
+                if step >= self.kill_at_step:
+                    self.proc.kill()
+                    self.proc.wait(timeout=30)
+                    self.killed_at = step
+                    logger.info(
+                        "chaos: SIGKILLed trainer pid %d at step %d",
+                        self.proc.pid, step,
+                    )
+                    return
+                time.sleep(self.poll_s)
+        finally:
+            self._done.set()
+
+    def wait(self, timeout_s: float = 120.0) -> bool:
+        """True once the watcher finished (kill fired, or the trainer
+        exited on its own first — ``killed_at`` distinguishes)."""
+        return self._done.wait(timeout_s)
+
+
 # --------------------------------------------------------------- schedules
 
 
@@ -331,6 +400,11 @@ class ChaosAction:
     restore: bool = False  # restart replays the last snapshot
     after_s: float = 0.0   # 0 = synchronous at fire time
     fired: bool = False
+    # ``op="kill_trainer"`` SIGKILLs the subprocess registered via
+    # ChaosPlane.attach_trainer — only meaningful when the schedule is
+    # driven from OUTSIDE the trainer process (a parent harness walking
+    # the trainer's progress beacon), since a trainer cannot outlive
+    # firing its own SIGKILL.
 
 
 class ChaosPlane:
@@ -359,6 +433,12 @@ class ChaosPlane:
             for i, addr in enumerate(svc.ps_addrs())
         ]
         self._step = -1
+        self._trainer_proc = None
+
+    def attach_trainer(self, proc) -> None:
+        """Register the trainer subprocess the ``kill_trainer`` op targets
+        (the watchdogging parent harness owns the Popen)."""
+        self._trainer_proc = proc
 
     def ps_addrs(self) -> List[str]:
         return [p.addr for p in self.proxies]
@@ -419,6 +499,14 @@ class ChaosPlane:
             self.proxies[a.idx].set_blackhole(True)
         elif a.op == "heal":
             self.proxies[a.idx].set_blackhole(False)
+        elif a.op == "kill_trainer":
+            if self._trainer_proc is None:
+                raise RuntimeError(
+                    "kill_trainer scheduled but no trainer attached "
+                    "(ChaosPlane.attach_trainer)"
+                )
+            self._trainer_proc.kill()
+            self._trainer_proc.wait(timeout=30)
         else:
             raise ValueError(f"unknown chaos op {a.op!r}")
 

@@ -25,6 +25,7 @@ import json
 import struct
 import threading
 import time
+import zlib
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Union
 
@@ -37,6 +38,35 @@ from persia_tpu.storage import StoragePath, storage_path
 logger = get_default_logger("persia_tpu.checkpoint")
 
 DONE_MARKER = "embedding_dump_done"
+
+# integrity trailer on every shard file: crc32 (LE u32) + magic. Legacy
+# files (no magic) still load; a file carrying the magic with a mismatched
+# crc — or a truncated/garbled payload — raises CorruptCheckpointError
+# instead of silently loading a torn shard.
+_CRC_MAGIC = b"PCK1"
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint shard file is torn or corrupt (crc/format mismatch)."""
+
+
+def _wrap_shard_blob(data: bytes) -> bytes:
+    return data + struct.pack("<I", zlib.crc32(data) & 0xFFFFFFFF) + _CRC_MAGIC
+
+
+def _unwrap_shard_blob(blob: bytes, name: str) -> bytes:
+    """Strip + verify the crc trailer; legacy (magic-less) blobs pass
+    through for the format check in the store's loader."""
+    if len(blob) >= 8 and blob[-4:] == _CRC_MAGIC:
+        data, (crc,) = blob[:-8], struct.unpack("<I", blob[-8:-4])
+        if (zlib.crc32(data) & 0xFFFFFFFF) != crc:
+            raise CorruptCheckpointError(
+                f"shard file {name} failed its crc32 check — the checkpoint "
+                "is corrupt (torn write or bit rot); fall back to an older "
+                "checkpoint"
+            )
+        return data
+    return blob
 
 
 class ModelManagerStatus:
@@ -116,7 +146,12 @@ def dump_store(
         def dump_one(i: int):
             nonlocal done
             blob = store.dump_shard(i)
-            root.join(_shard_name(replica_index, i)).write_bytes(blob)
+            # write_bytes is temp + fsync + atomic rename (storage.DiskPath),
+            # so a crash mid-dump can never leave a torn shard under the
+            # final name; the crc trailer catches everything else on load
+            root.join(_shard_name(replica_index, i)).write_bytes(
+                _wrap_shard_blob(blob)
+            )
             with lock:
                 done += 1
                 status.set("dumping", done / n)
@@ -228,10 +263,21 @@ def load_store(
 
         def load_one(fname: str) -> int:
             nonlocal done
-            blob = root.join(fname).read_bytes()
-            if need_filter:
-                blob = _filter_blob_for_replica(blob, replica_index, replica_size)
-            n = store.load_shard_bytes(blob)
+            blob = _unwrap_shard_blob(root.join(fname).read_bytes(), fname)
+            try:
+                if need_filter:
+                    blob = _filter_blob_for_replica(
+                        blob, replica_index, replica_size
+                    )
+                n = store.load_shard_bytes(blob)
+            except (struct.error, ValueError, IndexError) as e:
+                # a magic-less blob that fails the wire-format parse is a
+                # torn legacy file (or garbage) — surface it as corruption,
+                # never as a partial load
+                raise CorruptCheckpointError(
+                    f"shard file {fname} does not parse as a checkpoint "
+                    f"shard ({e!r}) — torn or corrupt"
+                ) from e
             with lock:
                 done += 1
                 status.set("loading", done / max(total, 1))

@@ -256,6 +256,15 @@ class TrainCtx(EmbeddingCtx):
         # (device header, batch) of the latest fetch_metrics=False prepared
         # step — materialized by last_prepared_metrics()
         self._deferred_header = None
+        # crash-consistent job state (persia_tpu.jobstate): once resume()
+        # has been called (even on a cold start) every gradient batch is
+        # tagged with a (manifest epoch, global step) journal id so the PS
+        # apply-journal can dedupe a post-crash replay; snapshot_job()
+        # advances the epoch at each fence
+        self._job_epoch: Optional[int] = None
+        self._global_step: int = 0
+        self._resume_state_bytes: Optional[bytes] = None
+        self.last_resume_info: Optional[Dict] = None
         # dynamic mixed-precision loss scaling (ref: GradScaler management,
         # persia/ctx.py:926-1005): on-device finite check every step,
         # skip-step + scale backoff on overflow, periodic growth
@@ -301,10 +310,122 @@ class TrainCtx(EmbeddingCtx):
             self.model, rng, sample_batch, self.dense_optimizer,
             loss_scale_init=self._loss_scale_init,
         )
+        if self._resume_state_bytes is not None:
+            # deferred resume: the manifest's dense/opt state overlays the
+            # freshly initialized template (same model + optimizer shapes)
+            import flax.serialization
+
+            state = flax.serialization.from_bytes(
+                state, self._resume_state_bytes
+            )
+            self._resume_state_bytes = None
         if self.mesh is not None:
             state = replicate_state(state, self.mesh)
         self.state = state
         return state
+
+    # -------------------------------------------------- crash-consistent jobs
+
+    def _ps_replicas(self):
+        router = getattr(self.worker, "lookup_router", None)
+        if router is None:
+            from persia_tpu.jobstate import ManifestError
+
+            raise ManifestError(
+                "job-state snapshots need direct PS replica handles (an "
+                "in-process EmbeddingWorker over stores/StoreClients); a "
+                "remote WorkerClient trainer should checkpoint via "
+                "worker.dump instead"
+            )
+        return router.replicas
+
+    def snapshot_job(self, job_state, loader=None, include_ps: bool = True,
+                     extra_meta: Optional[Dict] = None, generators=None):
+        """Step-fenced snapshot: drain the loader's in-flight gradients,
+        then commit PS shards + dense/opt state + RNG streams as one
+        manifest epoch (persia_tpu.jobstate). Returns the Manifest."""
+        import flax.serialization
+
+        from persia_tpu import jobstate
+
+        mgr = jobstate.coerce_manager(job_state)
+        if loader is not None:
+            loader.flush()  # fence invariant: nothing in flight past here
+        router = getattr(self.worker, "lookup_router", None)
+        meta = {"kind": "train_ctx"}
+        meta.update(extra_meta or {})
+        manifest = jobstate.snapshot_job(
+            mgr, self._global_step,
+            state_bytes=(
+                flax.serialization.to_bytes(self.state)
+                if self.state is not None else None
+            ),
+            replicas=self._ps_replicas() if include_ps else None,
+            batch_advances=(
+                dict(getattr(router, "batch_advances", {})) if router else None
+            ),
+            components={
+                "loader.json": {
+                    "consumed_batches": self._global_step,
+                    "staleness_outstanding": 0,  # fence = flushed
+                },
+            },
+            meta=meta,
+            generators=generators,
+        )
+        self._job_epoch = manifest.job_epoch
+        return manifest
+
+    def resume(self, job_state, restore_ps: bool = True, generators=None):
+        """Rebuild the exact fence state from the newest good manifest (or
+        arm journaling on a cold start). Returns the Manifest or None.
+
+        ``restore_ps=True`` rewinds the PS to the fence — the replayed
+        window re-applies and the run is bit-identical to a fault-free
+        replay. ``restore_ps=False`` keeps the PS's post-crash state and
+        relies on the apply-journal to skip already-applied batches
+        (exactly-once, bounded staleness)."""
+        from persia_tpu import jobstate
+
+        mgr = jobstate.coerce_manager(job_state)
+        router = getattr(self.worker, "lookup_router", None)
+        manifest, info = jobstate.resume_job(
+            mgr,
+            replicas=(router.replicas if router is not None else None),
+            rewind_ps=restore_ps,
+            optimizer=self.embedding_optimizer.config,
+            generators=generators,
+        )
+        self.last_resume_info = info
+        if manifest is None:
+            self._job_epoch = 0  # cold start: journal from step 0, epoch 0
+            self._global_step = 0
+            return None
+        if manifest.has("dense.state"):
+            self._resume_state_bytes = manifest.read_blob("dense.state")
+            if self.state is not None:
+                import flax.serialization
+
+                self.state = flax.serialization.from_bytes(
+                    self.state, self._resume_state_bytes
+                )
+                if self.mesh is not None:
+                    self.state = replicate_state(self.state, self.mesh)
+                self._resume_state_bytes = None
+        router = getattr(self.worker, "lookup_router", None)
+        if router is not None:
+            # fences record CUMULATIVE advance counts; continue from them
+            router.batch_advances = dict(info.get("batch_advances", {}))
+        self._job_epoch = manifest.job_epoch
+        self._global_step = manifest.step
+        return manifest
+
+    def _journal_id(self) -> Optional[int]:
+        if self._job_epoch is None:
+            return None
+        from persia_tpu.jobstate import make_journal_id
+
+        return make_journal_id(self._job_epoch, self._global_step)
 
     def train_step(self, batch: PersiaBatch) -> Dict:
         """One synchronous hybrid step: lookup → jitted step → gradient
@@ -326,7 +447,14 @@ class TrainCtx(EmbeddingCtx):
         # grad_scale composes with the dynamic loss scale instead of being
         # silently discarded by it.
         scale = metrics.get("loss_scale", 1.0) * self.grad_scale
-        self.worker.update_gradient_batched(ref, slot_grads, scale_factor=scale)
+        jid = self._journal_id()
+        if jid is not None:
+            self.worker.update_gradient_batched(
+                ref, slot_grads, scale_factor=scale, journal_id=jid
+            )
+        else:
+            self.worker.update_gradient_batched(ref, slot_grads, scale_factor=scale)
+        self._global_step += 1
         out = {
             "loss": float(metrics["loss"]),
             "preds": np.asarray(metrics["preds"]),
@@ -386,7 +514,11 @@ class TrainCtx(EmbeddingCtx):
         except Exception:
             loader.mark_consumed(training_batch)
             raise
-        loader.backward_packed(training_batch, gpacked, scale_factor=scale)
+        loader.backward_packed(
+            training_batch, gpacked, scale_factor=scale,
+            journal_id=self._journal_id(),
+        )
+        self._global_step += 1
         if defer:
             return None
         out = {"loss": loss, "preds": np.asarray(preds)}

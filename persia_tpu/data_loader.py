@@ -131,22 +131,34 @@ class BackwardEngine:
             t.start()
 
     def push(
-        self, ref: int, slot_grads, scale_factor: float = 1.0, worker=None
+        self, ref: int, slot_grads, scale_factor: float = 1.0, worker=None,
+        journal_id=None,
     ) -> None:
         """``slot_grads`` is either the per-slot gradient dict or a zero-arg
         callable producing it — the callable form defers the device→host
         gradient fetch into this engine's thread so it overlaps the next
         step. ``worker`` overrides the engine's default target (multi-worker
-        dataflow routes each ref back to the worker that holds it)."""
+        dataflow routes each ref back to the worker that holds it);
+        ``journal_id`` tags the apply for the PS apply-journal
+        (exactly-once trainer resume, persia_tpu.jobstate)."""
         with self._lock:
             if self._error is not None:
                 raise RuntimeError("backward engine failed") from self._error
             self._pending += 1
-        self._q.put((ref, slot_grads, scale_factor, worker))
+        self._q.put((ref, slot_grads, scale_factor, worker, journal_id))
 
-    def _apply(self, worker, ref: int, slot_grads, scale: float) -> None:
-        try:
+    @staticmethod
+    def _do_update(worker, ref: int, slot_grads, scale: float, jid) -> None:
+        if jid is not None:
+            worker.update_gradient_batched(
+                ref, slot_grads, scale_factor=scale, journal_id=jid
+            )
+        else:
             worker.update_gradient_batched(ref, slot_grads, scale_factor=scale)
+
+    def _apply(self, worker, ref: int, slot_grads, scale: float, jid=None) -> None:
+        try:
+            self._do_update(worker, ref, slot_grads, scale, jid)
             return
         except BaseException as e:  # noqa: BLE001
             if not _is_rpc_error(e):
@@ -154,7 +166,7 @@ class BackwardEngine:
             logger.warning("gradient update for ref %d hit %r; waiting for serving", ref, e)
         wait_for_serving(worker)
         try:
-            worker.update_gradient_batched(ref, slot_grads, scale_factor=scale)
+            self._do_update(worker, ref, slot_grads, scale, jid)
         except BaseException as e:  # noqa: BLE001
             if "ForwardIdNotFound" in repr(e):
                 return  # first attempt consumed the buffer entry → applied
@@ -172,12 +184,12 @@ class BackwardEngine:
             item = self._q.get()
             if item is _SENTINEL:
                 return
-            ref, slot_grads, scale, worker = item
+            ref, slot_grads, scale, worker, jid = item
             worker = worker if worker is not None else self._worker
             try:
                 if callable(slot_grads):
                     slot_grads = slot_grads()
-                self._apply(worker, ref, slot_grads, scale)
+                self._apply(worker, ref, slot_grads, scale, jid)
             except BaseException as e:  # noqa: BLE001 — propagate to trainer
                 try:
                     worker.abort_gradient(ref)
@@ -205,6 +217,34 @@ class BackwardEngine:
             self._q.put(_SENTINEL)
         for t in self._threads:
             t.join(timeout=5)
+
+
+class BatchCursor:
+    """The loader cursor a job-state manifest records: wraps a batch
+    iterable, counts what it hands out, and fast-forwards past batches a
+    crashed run already consumed (persia_tpu.jobstate).
+
+    Skipping happens HERE — before preprocessing, lookup, or staging — so
+    resume pays one cheap iterator drain instead of re-running the
+    pipeline for steps the fence already covers. Deterministic sources
+    (the only kind a bit-identical resume supports) yield the same batch
+    at the same ordinal every run, which is the whole contract."""
+
+    def __init__(self, batches: Iterable[PersiaBatch], skip: int = 0):
+        self._batches = batches
+        self.skip = int(skip)
+        self.consumed = int(skip)  # absolute ordinal of the next yield
+
+    def __iter__(self) -> Iterator[PersiaBatch]:
+        it = iter(self._batches)
+        for _ in range(self.skip):
+            next(it, None)
+        for b in it:
+            yield b
+            self.consumed += 1
+
+    def state(self) -> Dict:
+        return {"consumed_batches": self.consumed}
 
 
 class _OrderedSemaphore:
@@ -532,7 +572,8 @@ class DataLoader:
     # --------------------------------------------------------------- grads
 
     def backward(
-        self, training_batch: PersiaTrainingBatch, emb_grads, scale_factor: float = 1.0
+        self, training_batch: PersiaTrainingBatch, emb_grads,
+        scale_factor: float = 1.0, journal_id=None,
     ) -> None:
         """Queue this batch's embedding gradients for asynchronous return."""
         slot_grads = self.ctx.emb_grads_to_slot_grads(
@@ -541,10 +582,12 @@ class DataLoader:
         self.backward_engine.push(
             training_batch.ref, slot_grads, scale_factor,
             worker=self.emb_workers[training_batch.worker_idx],
+            journal_id=journal_id,
         )
 
     def backward_packed(
-        self, training_batch: PersiaTrainingBatch, gpacked, scale_factor: float = 1.0
+        self, training_batch: PersiaTrainingBatch, gpacked,
+        scale_factor: float = 1.0, journal_id=None,
     ) -> None:
         """Queue the step's still-on-device packed gradient buffer; the
         engine thread materializes it (np.asarray = the bulk device→host
@@ -563,6 +606,7 @@ class DataLoader:
         self.backward_engine.push(
             training_batch.ref, _materialize, scale_factor,
             worker=self.emb_workers[training_batch.worker_idx],
+            journal_id=journal_id,
         )
 
     def mark_consumed(self, training_batch: PersiaTrainingBatch) -> None:
@@ -575,6 +619,20 @@ class DataLoader:
 
     def flush(self):
         self.backward_engine.flush(timeout=self.timeout_s)
+
+    def staleness_state(self) -> Dict:
+        """Staleness-window occupancy for the job-state manifest: at a
+        snapshot fence (post-``flush``) ``outstanding`` must be 0 — every
+        permit returned, every gradient landed."""
+        with self.backward_engine._lock:
+            outstanding = self.backward_engine._pending
+        return {
+            "outstanding_gradient_batches": outstanding,
+            "cursor": (
+                self.dataset.state()
+                if isinstance(self.dataset, BatchCursor) else None
+            ),
+        }
 
     def shutdown(self):
         self.backward_engine.shutdown()

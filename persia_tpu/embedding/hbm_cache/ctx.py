@@ -177,6 +177,14 @@ class CachedTrainCtx:
         # the most recent train_stream's dispatch/feeder accounting
         self._kstep_jit = None
         self._stream_stats: Optional[Dict] = None
+        # crash-consistent job state (persia_tpu.jobstate): manifest epoch
+        # of the last committed fence (journal-id namespace), the global
+        # step counter fences/journal ids run on, and a deferred resume
+        # blob applied when init_state builds the state template
+        self._job_epoch: Optional[int] = None
+        self._global_step: int = 0
+        self._resume_state_bytes: Optional[bytes] = None
+        self.last_resume_info: Optional[Dict] = None
 
     def __enter__(self):
         self.worker.register_optimizer(self.sparse_cfg)
@@ -250,6 +258,16 @@ class CachedTrainCtx:
             step=jnp.zeros((), dtype=jnp.int32),
             loss_scale=ls,
         )
+        if self._resume_state_bytes is not None:
+            # deferred resume (persia_tpu.jobstate): the manifest captured
+            # the state at a post-flush fence (cold pools), so overlaying
+            # it on the fresh template reproduces the fence exactly
+            import flax.serialization
+
+            self.state = flax.serialization.from_bytes(
+                self.state, self._resume_state_bytes
+            )
+            self._resume_state_bytes = None
         rep = self._replicated()
         if rep is not None:
             self.state = jax.tree.map(
@@ -600,12 +618,19 @@ class CachedTrainCtx:
             raise
         return ref, embs, counts, entries
 
-    def _apply_ps_grads(self, ps_item, ps_gpacked) -> None:
+    def _apply_ps_grads(self, ps_item, ps_gpacked, journal_step=None) -> None:
         """Unpack the step's packed ps-slot gradients (one layout
         convention: unpack_step_grads) and return them to the worker; the
-        ref is released either by the update or by an abort on failure."""
+        ref is released either by the update or by an abort on failure.
+        ``journal_step`` tags the apply for the PS apply-journal when the
+        ctx runs under a job-state manager (exactly-once resume)."""
         from persia_tpu.parallel.train_step import unpack_step_grads
 
+        jid = None
+        if journal_step is not None and self._job_epoch is not None:
+            from persia_tpu.jobstate import make_journal_id
+
+            jid = make_journal_id(self._job_epoch, journal_step)
         ref, embs, counts, entries = ps_item
         try:
             if isinstance(ps_gpacked, tuple):
@@ -644,9 +669,14 @@ class CachedTrainCtx:
                 eb.name: (g if d is None else g[:d])
                 for eb, g, d in zip(embs, grads, counts)
             }
-            self.worker.update_gradient_batched(
-                ref, slot_grads, scale_factor=scale_factor
-            )
+            if jid is not None:
+                self.worker.update_gradient_batched(
+                    ref, slot_grads, scale_factor=scale_factor, journal_id=jid
+                )
+            else:
+                self.worker.update_gradient_batched(
+                    ref, slot_grads, scale_factor=scale_factor
+                )
         except BaseException:
             self.worker.abort_gradient(ref)
             raise
@@ -696,7 +726,9 @@ class CachedTrainCtx:
             # both tiers, so these gradients can never touch a sign an
             # eviction wrote back (same invariant the stream path's
             # _flush_ps documents).
-            self._apply_ps_grads(ps_item, ps_gpacked)
+            self._apply_ps_grads(
+                ps_item, ps_gpacked, journal_step=self._global_step
+            )
         prev = self._pending
         self._pending = (
             evict_meta, evict_payload, header, device_inputs["labels"][0].shape
@@ -718,6 +750,7 @@ class CachedTrainCtx:
             # groups are disjoint, so no group can be advanced twice.
             for grp in self._cached_groups:
                 self.tier.router.advance_batch_state(grp)
+        self._global_step += 1  # the job-state fence/journal step counter
         if fetch_metrics:
             return self._fetch_metrics()
         return None
@@ -853,3 +886,105 @@ class CachedTrainCtx:
     def load_checkpoint(self, src: str) -> None:
         self.flush()
         self.worker.load(src)
+
+    # ------------------------------------------------- crash-consistent jobs
+
+    def _fence_capture(self, job_mgr, step: int, occupancy: Dict):
+        """Commit one job-state epoch at a drained stream fence (or from
+        ``snapshot_job`` on the sync path): flush every resident cached row
+        to the PS (the pools restart cold — checkout round-trips full
+        [emb | state] entries, so the training math is unchanged), then
+        capture PS shards + the full CachedTrainState (dense params,
+        optimizer state, the now-cold pools, Adam emb_batch_state) + the
+        pre-flush directory/ring occupancy + loader cursor + RNG streams
+        under one manifest (persia_tpu.jobstate)."""
+        import flax.serialization
+
+        from persia_tpu import jobstate
+
+        if self.state is not None:
+            self.tier.flush(self.state.tables, self.state.emb_state)
+            tables, emb_state = init_cached_tables(
+                self.tier.groups, self.sparse_cfg, dtype=self.table_dtype
+            )
+            self.state = self.state.replace(tables=tables, emb_state=emb_state)
+        router = self.tier.router
+        manifest = jobstate.snapshot_job(
+            job_mgr, step,
+            state_bytes=(
+                flax.serialization.to_bytes(self.state)
+                if self.state is not None else None
+            ),
+            replicas=router.replicas,
+            batch_advances=dict(getattr(router, "batch_advances", {})),
+            components={
+                "cache.json": occupancy,
+                "loader.json": {"consumed_batches": step},
+            },
+            meta={"kind": "cached_ctx"},
+        )
+        self._job_epoch = manifest.job_epoch
+        self._global_step = step
+        return manifest
+
+    def snapshot_job(self, job_state, extra_occupancy: Optional[Dict] = None):
+        """Sync-path step-fenced snapshot: land the deferred write-back,
+        then fence-capture at the current global step. (The stream path
+        fences itself — ``train_stream(snapshot_every=, job_state=)``.)"""
+        from persia_tpu import jobstate
+
+        self._land_pending()
+        occupancy = {
+            "resident_rows": {
+                g.name: len(self.tier.dirs[g.name]) for g in self.tier.groups
+            },
+            "pending_ledger_entries": 0,
+        }
+        occupancy.update(extra_occupancy or {})
+        return self._fence_capture(
+            jobstate.coerce_manager(job_state), self._global_step, occupancy
+        )
+
+    def resume(self, job_state, restore_ps: bool = True, generators=None):
+        """Rebuild the exact mid-epoch fence state from the newest good
+        manifest: PS shards rewound (default — bit-identical replay) or
+        kept with journal dedupe (``restore_ps=False``, exactly-once), the
+        CachedTrainState overlaid when ``init_state`` runs, Adam batch
+        advances re-applied, RNG streams restored. Returns the Manifest
+        (resume the stream with ``train_stream(batches_from(manifest.step),
+        start_step=manifest.step, ...)``) or None on a cold start."""
+        from persia_tpu import jobstate
+
+        mgr = jobstate.coerce_manager(job_state)
+        router = self.tier.router
+        manifest, info = jobstate.resume_job(
+            mgr,
+            replicas=router.replicas,
+            rewind_ps=restore_ps,
+            optimizer=self.sparse_cfg,
+            generators=generators,
+        )
+        self.last_resume_info = info
+        if manifest is None:
+            self._job_epoch = 0
+            self._global_step = 0
+            return None
+        if manifest.has("dense.state"):
+            self._resume_state_bytes = manifest.read_blob("dense.state")
+            if self.state is not None:
+                import flax.serialization
+
+                state = flax.serialization.from_bytes(
+                    self.state, self._resume_state_bytes
+                )
+                rep = self._replicated()
+                if rep is not None:
+                    state = jax.tree.map(
+                        lambda x: jax.device_put(x, rep), state
+                    )
+                self.state = state
+                self._resume_state_bytes = None
+        router.batch_advances = dict(info.get("batch_advances", {}))
+        self._job_epoch = manifest.job_epoch
+        self._global_step = manifest.step
+        return manifest

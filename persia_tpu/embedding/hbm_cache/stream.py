@@ -54,6 +54,9 @@ def run_train_stream(
     fetch_final: bool = True,
     psgrad_batch: int = 8,
     dispatch_k: int = 4,
+    snapshot_every: Optional[int] = None,
+    job_state=None,
+    start_step: int = 0,
 ) -> Optional[Dict]:
     """Fully-pipelined training over an iterable of ``PersiaBatch``.
 
@@ -118,12 +121,35 @@ def run_train_stream(
     on ring back-pressure) dispatch through the already-compiled
     single-step path — only exactly-K uniform windows pay a (one-time)
     K-step compile.
+
+    ``snapshot_every`` + ``job_state``: step-fenced consistent snapshots
+    (persia_tpu.jobstate). Every ``snapshot_every`` global steps the
+    FEEDER pauses before preparing the next batch and a fence marker
+    rides the pipeline's own FIFO: by the time the dispatcher sees it,
+    every earlier step has dispatched; a drain marker then flushes the
+    write-back thread (eviction landings + PS-tier gradient applies), the
+    hazard ledger and eviction rings are verified empty (tails caught up
+    to heads — the same accounting the in-flight gate uses), and
+    ``ctx._fence_capture`` flushes the resident cache to the PS and
+    commits one manifest epoch: PS shards, dense params + optimizer
+    state + (now cold) cache pools, directory/ring occupancy, the loader
+    cursor, and the RNG streams. ``start_step`` offsets the fence cadence
+    and journal ids for a resumed stream
+    (``train_stream(batches_from_F, start_step=F, ...)``).
     """
     import queue as _queue
     import time as _time
 
     if prefetch < 1:
         raise ValueError(f"prefetch must be >= 1, got {prefetch}")
+    job_mgr = None
+    if job_state is not None:
+        from persia_tpu.jobstate import coerce_manager
+
+        job_mgr = coerce_manager(job_state)
+        if self._job_epoch is None:
+            self._job_epoch = 0  # journal from the first step; see jobstate
+    fence_done = threading.Event()
     # Host staging buffers are FRESH per step (_BufRing hands out new
     # arrays; its docstring records the reuse-race history), so nothing
     # needs sizing against the prefetch depth here.
@@ -231,6 +257,7 @@ def run_train_stream(
         "packs": 0, "packed_steps": 0, "single_steps": 0,
         "feeder_busy_s": 0.0, "wall_s": 0.0,
         "degraded_steps": 0, "degraded_lookup_frac_max": 0.0,
+        "fences": 0,
     }
     t_start = _time.perf_counter()
     # per-seq degraded-lookup fraction (written by the feeder BEFORE the
@@ -278,6 +305,22 @@ def run_train_stream(
             for batch in batches:
                 if stop.is_set() or errors:
                     break
+                if (
+                    job_mgr is not None and snapshot_every
+                    and seq > 0 and (start_step + seq) % snapshot_every == 0
+                ):
+                    # snapshot fence: pause BEFORE this step's prepare — a
+                    # prepare would touch the directory and the PS (admits,
+                    # checkout LRU) and the capture must see exactly the
+                    # post-step-(seq-1) state. The marker rides the FIFO so
+                    # the dispatcher reaches it only after every earlier
+                    # step dispatched; fence_done unparks us post-capture.
+                    fence_done.clear()
+                    if not _put(prep_q, ("fence", start_step + seq)):
+                        return
+                    while not fence_done.wait(0.25):
+                        if stop.is_set() or errors:
+                            return
                 t_prep = _time.perf_counter()
                 with span("stream.prep"):
                     item = self.tier.prepare_batch(
@@ -337,6 +380,10 @@ def run_train_stream(
                 got = prep_q.get()
                 if got is SENTINEL:
                     break
+                if isinstance(got, tuple) and got[0] == "fence":
+                    if not _put(staged_q, got):  # FIFO keeps fence ordering
+                        return
+                    continue
                 seq, item, ps_item = got
                 (di, layout, miss_aux, cold_aux, restore_aux, evict_aux,
                  evict_meta) = item
@@ -462,10 +509,10 @@ def run_train_stream(
         )
         k = 0
         try:
-            for k, ((_tag, ps_item, _g), host) in enumerate(
+            for k, ((_tag, ps_item, _g, gstep), host) in enumerate(
                 zip(ps_acc, hosts)
             ):
-                self._apply_ps_grads(ps_item, host)
+                self._apply_ps_grads(ps_item, host, journal_step=gstep)
         except BaseException:
             _abort_ps_refs(ps_acc[k + 1:])
             ps_acc.clear()
@@ -499,6 +546,18 @@ def run_train_stream(
                     _flush_acc(acc)
                     _flush_ps(ps_acc)
                     return
+                if isinstance(item, tuple) and item[0] == "fence":
+                    # drain marker: everything queued before it (FIFO) must
+                    # land — eviction write-backs AND PS-tier gradient
+                    # applies — before the capture reads the PS. The event
+                    # is set even on failure (the error unwinds the main
+                    # loop; an unset event would deadlock it instead).
+                    try:
+                        _flush_acc(acc)
+                        _flush_ps(ps_acc)
+                    finally:
+                        item[1].set()
+                    continue
                 if isinstance(item, tuple) and item[0] == "psgrad":
                     ps_acc.append(item)
                     if len(ps_acc) >= PS_BATCH:
@@ -541,10 +600,57 @@ def run_train_stream(
     pack: List = []  # staged hazard-free items awaiting a K-step dispatch
     pack_sig: List = [None]
 
+    def _run_fence(gstep: int) -> None:
+        """Snapshot fence, main-thread side: every step < gstep has
+        dispatched (the marker rode the FIFO); drain the write-back
+        thread, verify the hazard accounting empty, capture, unpark the
+        feeder."""
+        ev = threading.Event()
+        wb_q.put(("fence", ev))
+        while not ev.wait(0.25):
+            if errors:
+                break
+        if not errors:
+            with cv:
+                undrained = {
+                    gn: (heads.get(gn, 0), tails.get(gn, 0))
+                    for gn in set(heads) | set(tails)
+                    if heads.get(gn, 0) != tails.get(gn, 0)
+                }
+                occupancy = {
+                    "resident_rows": {
+                        g.name: len(self.tier.dirs[g.name])
+                        for g in self.tier.groups
+                    },
+                    "ring": {
+                        gn: {
+                            "head": heads.get(gn, 0),
+                            "tail": tails.get(gn, 0),
+                            "rows": self.ring_rows(gn),
+                        }
+                        for gn in set(heads) | set(tails)
+                    },
+                    "pending_ledger_entries": len(sign_map),
+                }
+            if undrained:
+                errors.append(RuntimeError(
+                    f"fence at step {gstep}: eviction ring spans still in "
+                    f"flight after the write-back drain: {undrained}"
+                ))
+            else:
+                try:
+                    with span("stream.fence", step=gstep):
+                        self._fence_capture(job_mgr, gstep, occupancy)
+                    stats["fences"] = stats.get("fences", 0) + 1
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(e)
+        fence_done.set()
+
     def _post_step(seq, di, evict_meta, evict_payload):
         """Per-step bookkeeping shared by the single and packed paths."""
         nonlocal label_shape
         label_shape = di["labels"][0].shape
+        self._global_step = start_step + seq + 1  # fences/journal continue here
         if evict_meta:
             # the ring rows were written device-side inside this step's
             # _apply_aux_ring; the wb thread only needs the per-step
@@ -582,8 +688,9 @@ def run_train_stream(
         if ps_item is not None:
             # gradient return for PS-tier slots rides the write-back
             # thread (its d2h is off the dispatch path); FIFO order
-            # keeps the worker's per-batch Adam advance in step order
-            wb_q.put(("psgrad", ps_item, ps_gpacked))
+            # keeps the worker's per-batch Adam advance in step order.
+            # The global step rides along as the apply-journal step id.
+            wb_q.put(("psgrad", ps_item, ps_gpacked, start_step + seq))
         _post_step(seq, di, evict_meta, evict_payload)
         if on_metrics is not None:
             self._last_metrics = self._parse_header(
@@ -670,6 +777,10 @@ def run_train_stream(
                 pack.clear()
                 _abort_drained(item)
                 break
+            if isinstance(item, tuple) and len(item) == 2 and item[0] == "fence":
+                _flush_pack_single()
+                _run_fence(item[1])
+                continue
             if K > 1 and _packable(item):
                 sig = _item_sig(item)
                 if pack and sig != pack_sig[0]:

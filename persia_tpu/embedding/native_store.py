@@ -101,6 +101,15 @@ def _load_lib() -> ctypes.CDLL:
     lib.ps_lookup_batched.argtypes = [p, u64p, i64p, u32p, i64p, i32, i32, f32p]
     lib.ps_update_batched.restype = i32
     lib.ps_update_batched.argtypes = [p, u64p, i64p, u32p, f32p, i64p, i32p, i32]
+    # bounded apply-journal (exactly-once trainer resume, jobstate.py)
+    lib.ps_journal_record.restype = None
+    lib.ps_journal_record.argtypes = [p, u64, u32]
+    lib.ps_journal_probe.restype = i32
+    lib.ps_journal_probe.argtypes = [p, u64, u32]
+    lib.ps_journal_len.restype = i64
+    lib.ps_journal_len.argtypes = [p]
+    lib.ps_journal_clear.restype = None
+    lib.ps_journal_clear.argtypes = [p]
     _LIB = lib
     return lib
 
@@ -305,6 +314,45 @@ class NativeEmbeddingStore:
             raise RuntimeError("no optimizer registered")
         if self.inc_manager is not None:
             self.inc_manager.commit(signs)
+
+    # apply-journal ----------------------------------------------------------
+
+    def journal_record(self, journal_id: int, crc: int) -> None:
+        self._lib.ps_journal_record(self._h, journal_id, crc & 0xFFFFFFFF)
+
+    def journal_probe(self, journal_id: int, crc: int) -> int:
+        """1 = already applied (crc matches), 0 = unknown, -1 = same id
+        recorded with a DIFFERENT payload crc (replay divergence)."""
+        return int(self._lib.ps_journal_probe(self._h, journal_id, crc & 0xFFFFFFFF))
+
+    def journal_len(self) -> int:
+        return int(self._lib.ps_journal_len(self._h))
+
+    def journal_clear(self) -> None:
+        self._lib.ps_journal_clear(self._h)
+
+    def update_batched_journaled(
+        self, journal_id: int, crc: int, signs, key_ofs, dims, grads, opt_groups,
+    ) -> bool:
+        """Exactly-once gradient apply: skip if the journal already holds
+        (id, crc); apply + record otherwise. Returns True when applied,
+        False on a duplicate. See ``EmbeddingStore.update_batched_journaled``
+        for the window semantics."""
+        st = self.journal_probe(journal_id, crc)
+        if st != 0:
+            if st == -1:
+                # journal-only resume: the replay recomputed different
+                # gradients (its forwards saw post-fence PS state); the
+                # original application stands — skip = exactly-once
+                logger.warning(
+                    "apply-journal id %#x replayed with a different payload "
+                    "crc — keeping the original application (exactly-once)",
+                    journal_id,
+                )
+            return False
+        self.update_batched(signs, key_ofs, dims, grads, opt_groups)
+        self.journal_record(journal_id, crc)
+        return True
 
     # management -----------------------------------------------------------
 

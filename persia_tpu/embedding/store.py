@@ -34,7 +34,10 @@ from persia_tpu.embedding.hashing import (
     uniform_init_for_sign,  # noqa: F401  (re-export; golden-test anchor)
 )
 from persia_tpu.embedding.optim import OptimizerConfig
+from persia_tpu.logger import get_default_logger
 from persia_tpu.metrics import get_metrics
+
+logger = get_default_logger("persia_tpu.store")
 
 
 class _Shard:
@@ -102,6 +105,13 @@ class EmbeddingStore:
         self.inc_manager = None  # set by persia_tpu.incremental.attach_incremental
         # Adam per-feature-group accumulated beta powers (ref: optim.rs:99-221).
         self._batch_state: Dict[int, Tuple[float, float]] = {}
+        # bounded apply-journal: id -> payload crc32 of gradient batches
+        # already applied between snapshot fences (exactly-once trainer
+        # resume, persia_tpu.jobstate). FIFO-bounded, mirroring the native
+        # core's ring — safe because a resume only replays post-fence ids.
+        self._journal: Dict[int, int] = {}
+        self._journal_order: List[int] = []
+        self._journal_cap = 1 << 16
         # PS-tier observability (ref: emb_param metrics, mod.rs:27-79)
         m = get_metrics()
         self._m_miss = m.counter(
@@ -325,6 +335,70 @@ class EmbeddingStore:
                 np.clip(vec[:dim], -bound, bound, out=vec[:dim])
         if grad_misses:
             self._m_grad_miss.inc(grad_misses)
+
+    # --------------------------------------------------------- apply-journal
+
+    def journal_record(self, journal_id: int, crc: int) -> None:
+        with self._lock:
+            if journal_id in self._journal:
+                self._journal[journal_id] = crc & 0xFFFFFFFF
+                return
+            if len(self._journal_order) >= self._journal_cap:
+                self._journal.pop(self._journal_order.pop(0), None)
+            self._journal_order.append(journal_id)
+            self._journal[journal_id] = crc & 0xFFFFFFFF
+
+    def journal_probe(self, journal_id: int, crc: int) -> int:
+        """1 = already applied (crc matches), 0 = unknown, -1 = same id
+        recorded with a DIFFERENT payload crc (replay divergence)."""
+        with self._lock:
+            rec = self._journal.get(journal_id)
+        if rec is None:
+            return 0
+        return 1 if rec == (crc & 0xFFFFFFFF) else -1
+
+    def journal_len(self) -> int:
+        with self._lock:
+            return len(self._journal)
+
+    def journal_clear(self) -> None:
+        """Drop every journal record — MUST accompany a PS rewind (clear +
+        shard replay): after rewinding to a fence, the post-fence batches
+        the journal remembers have been UN-applied and must re-apply."""
+        with self._lock:
+            self._journal.clear()
+            self._journal_order.clear()
+
+    def update_batched_journaled(
+        self, journal_id: int, crc: int, signs, key_ofs, dims, grads, opt_groups,
+    ) -> bool:
+        """Exactly-once gradient apply for crash-consistent resume: a
+        (journal_id, crc) already recorded means the crashed run applied
+        this batch after the last fence — skip it (returns False); a
+        matching id with a different crc means the replay diverged (error).
+        Check→apply→record is not atomic against a PS crash between apply
+        and record, but the journal protects against TRAINER crashes — a
+        PS crash loses the whole store and recovers through shard replay
+        (helper.restart_ps) or a fence rewind, both of which reset the
+        journal with the data."""
+        st = self.journal_probe(journal_id, crc)
+        if st != 0:
+            # 1 = exact duplicate; -1 = same id, different payload (a
+            # journal-only resume recomputes the replay window against a
+            # PS that already moved past the fence, so its gradients can
+            # legitimately differ). Either way the crashed run's ORIGINAL
+            # application stands — skipping preserves exactly-once; the -1
+            # case is surfaced for observability via journal_probe.
+            if st == -1:
+                logger.warning(
+                    "apply-journal id %#x replayed with a different payload "
+                    "crc — keeping the original application (exactly-once)",
+                    journal_id,
+                )
+            return False
+        self.update_batched(signs, key_ofs, dims, grads, opt_groups)
+        self.journal_record(journal_id, crc)
+        return True
 
     # ------------------------------------------------------------ management
 

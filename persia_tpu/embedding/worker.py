@@ -237,6 +237,17 @@ class ShardedLookup:
             "persia_tpu_writeback_rows_dropped_shard_down",
             "eviction write-back rows dropped because their PS shard stayed down",
         )
+        # exactly-once resume accounting (persia_tpu.jobstate): gradient
+        # batches skipped because the PS apply-journal already held their
+        # (id, crc) record, and per-group Adam batch-state advance counts
+        # (captured into the snapshot manifest; a PS rewind re-advances
+        # from them so beta powers match the fence)
+        self.journal_skips = 0
+        self.batch_advances: Dict[int, int] = {}
+        self._m_journal_skips = m.counter(
+            "persia_tpu_journal_dup_skips",
+            "gradient batches skipped by the PS apply-journal on resume replay",
+        )
         # eager pool (lazy init would race: EmbeddingWorker's slot threads
         # call the router concurrently): sized for replicas x concurrent
         # slot callers — the transport below is the pooled RpcClient
@@ -565,11 +576,36 @@ class ShardedLookup:
         self._check_abort(deg_n, len(all_keys))
         return outs
 
-    def update_groups(self, groups: Sequence) -> None:
+    def _journaled_update_batched(
+        self, rep, replica_index: int, journal_id: int,
+        keys, key_ofs, dims, flat, opt_groups,
+    ) -> None:
+        """Apply one replica's share of a gradient batch through the PS
+        apply-journal (exactly-once across a trainer crash + resume replay,
+        persia_tpu.jobstate): the id carries (manifest epoch, step, this
+        replica), the crc fingerprints the payload."""
+        from persia_tpu.jobstate import journal_shard_id, payload_crc
+
+        jid = journal_shard_id(journal_id, replica_index)
+        crc = payload_crc(keys, flat)
+        applied = rep.update_batched_journaled(
+            jid, crc, keys, key_ofs, dims, flat, opt_groups
+        )
+        if not applied:
+            self.journal_skips += 1
+            self._m_journal_skips.inc()
+
+    def update_groups(self, groups: Sequence, journal_id=None) -> None:
         """Multi-slot gradient fan-out: ONE call per replica per gradient
         batch. ``groups`` is ``[(keys, grads (n, dim) f32, opt_group), ...]``.
         The caller advances Adam batch state once per batch per opt group
-        first (batch-level beta powers, optim.rs:99-221)."""
+        first (batch-level beta powers, optim.rs:99-221).
+
+        ``journal_id`` (a :func:`persia_tpu.jobstate.make_journal_id` base)
+        routes the apply through the PS apply-journal — exactly-once under
+        trainer-crash resume. Only the batched path journals (both shipped
+        store backends and the RPC client have it); the per-group legacy
+        fallback stays at-least-once."""
         if not groups:
             return
         # gradients for signs that were served DEGRADED are dropped here —
@@ -597,6 +633,16 @@ class ShardedLookup:
                     if len(groups) > 1 else np.asarray(groups[0][0])
                 flat = np.concatenate([g.reshape(-1) for _, g, _ in groups]) \
                     if len(groups) > 1 else np.asarray(groups[0][1]).reshape(-1)
+                if journal_id is not None and hasattr(r0, "update_batched_journaled"):
+                    self._guarded_update(
+                        r0,
+                        lambda: self._journaled_update_batched(
+                            r0, 0, journal_id, all_keys, key_ofs, dims, flat,
+                            opt_groups,
+                        ),
+                        len(all_keys),
+                    )
+                    return
                 self._guarded_update(
                     r0,
                     lambda: r0.update_batched(all_keys, key_ofs, dims, flat, opt_groups),
@@ -612,7 +658,7 @@ class ShardedLookup:
         all_keys = np.concatenate([k for k, _, _ in groups])
         sel = self._partition_positions(all_keys)
 
-        def one_replica(rep, pos):
+        def one_replica(rep, ridx, pos):
             sub_ofs = np.searchsorted(pos, key_ofs).astype(np.int64)
             sub_keys = all_keys[pos]
             subs = [
@@ -626,6 +672,16 @@ class ShardedLookup:
                     np.concatenate([s.reshape(-1) for s in subs])
                     if subs else np.empty(0, np.float32)
                 )
+                if journal_id is not None and hasattr(rep, "update_batched_journaled"):
+                    self._guarded_update(
+                        rep,
+                        lambda: self._journaled_update_batched(
+                            rep, ridx, journal_id, sub_keys, sub_ofs, dims,
+                            flat, opt_groups,
+                        ),
+                        len(sub_keys),
+                    )
+                    return
                 self._guarded_update(
                     rep,
                     lambda: rep.update_batched(sub_keys, sub_ofs, dims, flat, opt_groups),
@@ -646,7 +702,7 @@ class ShardedLookup:
             ])
 
         self._concurrent([
-            (lambda rep=self.replicas[r], pos=pos: one_replica(rep, pos))
+            (lambda rep=self.replicas[r], r=r, pos=pos: one_replica(rep, r, pos))
             for r, pos in sel
         ])
 
@@ -847,6 +903,9 @@ class ShardedLookup:
         ])
 
     def advance_batch_state(self, group: int) -> None:
+        # counted for the snapshot manifest: a PS rewind replays exactly
+        # this many advances so Adam's beta powers match the fence
+        self.batch_advances[group] = self.batch_advances.get(group, 0) + 1
         self._concurrent([
             (lambda rep=r: self._guarded_update(
                 rep, lambda rep=rep: rep.advance_batch_state(group), 0))
@@ -1294,11 +1353,14 @@ class EmbeddingWorker:
                 self._m_staleness.set(self.staleness)
 
     def update_gradient_batched(
-        self, ref: int, slot_grads: Dict[str, np.ndarray], scale_factor: float = 1.0
+        self, ref: int, slot_grads: Dict[str, np.ndarray],
+        scale_factor: float = 1.0, journal_id=None,
     ) -> Dict[str, int]:
         """Gradient return: pop the stashed layout, convert device grads to
         per-key grads, fan out to PS replicas (ref: mod.rs:1109-1129,703-872).
-        Returns per-slot skip info for metrics."""
+        Returns per-slot skip info for metrics. ``journal_id`` (see
+        jobstate.make_journal_id) routes the apply through the PS
+        apply-journal for exactly-once trainer resume."""
         with self._buf_lock:
             processed = self.post_forward_buffer.pop(ref, None)
             if processed is not None:
@@ -1336,7 +1398,7 @@ class EmbeddingWorker:
                 trip.append(
                     (slot.keys, per_key, self.embedding_config.group_of(slot.name))
                 )
-            self.lookup_router.update_groups(trip)
+            self.lookup_router.update_groups(trip, journal_id=journal_id)
         if skipped:
             self._m_nan_skipped.inc(len(skipped))
         return skipped

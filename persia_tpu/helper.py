@@ -145,26 +145,74 @@ class ServiceCtx:
         p.kill()
         p.wait(timeout=10)
 
-    def snapshot_ps(self, i: int) -> int:
+    def snapshot_ps(self, i: int, job_state=None) -> int:
         """Record PS ``i``'s full state (every internal shard's
         ``dump_shard`` bytes, plus the registered optimizer config — a
         restored shard serving lookups without its optimizer would
         re-initialize every restored entry on entry-width mismatch) for a
         later replaying restart/promotion. Returns the snapshot's total
-        byte size."""
+        byte size.
+
+        ``job_state`` (a directory or :class:`~persia_tpu.jobstate.
+        JobStateManager`) additionally commits the snapshot as a DURABLE
+        manifest epoch, so the failover state survives the ServiceCtx
+        process itself: a fresh process calls
+        :meth:`restore_ps_snapshots` and can ``restart_ps(restore=True)``
+        replicas it never snapshotted in-memory."""
         c = StoreClient(self.ps_addrs()[i])
         shards = [
             c.dump_shard(s) for s in range(c.num_internal_shards)
         ]
         opt = c.get_optimizer()
-        self._ps_snapshots[i] = (shards, opt.to_dict() if opt else None)
+        opt_dict = opt.to_dict() if opt else None
+        self._ps_snapshots[i] = (shards, opt_dict)
+        if job_state is not None:
+            from persia_tpu import jobstate
+
+            writer = jobstate.coerce_manager(job_state).begin_epoch()
+            for si, blob in enumerate(shards):
+                writer.add_blob(f"ps/replica_{i}_shard_{si}.emb", blob)
+            writer.commit({
+                "kind": "ps_failover",
+                "replica_index": i,
+                "n_shards": len(shards),
+                "optimizer": opt_dict,
+            })
         return sum(len(s) for s in shards)
 
-    def start_snapshot_guard(self, interval_s: float = 5.0) -> None:
+    def restore_ps_snapshots(self, job_state) -> List[int]:
+        """Rebuild the in-memory failover snapshot cache from durable
+        ``snapshot_ps(..., job_state=)`` manifests — the path a REPLACEMENT
+        ServiceCtx process takes after the original host died. Newest
+        manifest per replica wins; replicas already cached in memory are
+        left alone. Returns the replica indices restored."""
+        from persia_tpu import jobstate
+
+        mgr = jobstate.coerce_manager(job_state)
+        found: List[int] = []
+        for _e, d in reversed(mgr._epoch_dirs()):
+            m = mgr._load_manifest(d)
+            if m is None or m.meta.get("kind") != "ps_failover":
+                continue
+            ri = int(m.meta["replica_index"])
+            if ri in self._ps_snapshots or ri in found:
+                continue
+            shards = [
+                m.read_blob(f"ps/replica_{ri}_shard_{si}.emb")
+                for si in range(int(m.meta["n_shards"]))
+            ]
+            self._ps_snapshots[ri] = (shards, m.meta.get("optimizer"))
+            found.append(ri)
+        return found
+
+    def start_snapshot_guard(
+        self, interval_s: float = 5.0, job_state=None
+    ) -> None:
         """Background snapshot loop over every PS — the failover state
         source when a shard dies without warning. Snapshot staleness is
         bounded by ``interval_s`` (the accepted loss window, exactly like
-        a periodic checkpoint)."""
+        a periodic checkpoint). ``job_state`` makes every guard snapshot
+        durable (see :meth:`snapshot_ps`)."""
         if self._guard_thread is not None:
             return
 
@@ -172,7 +220,7 @@ class ServiceCtx:
             while not self._guard_stop.wait(interval_s):
                 for i in range(self.n_ps):
                     try:
-                        self.snapshot_ps(i)
+                        self.snapshot_ps(i, job_state=job_state)
                     except Exception as e:  # noqa: BLE001 — shard may be down
                         logger.warning("snapshot guard: ps %d failed: %s", i, e)
 

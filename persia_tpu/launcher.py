@@ -35,6 +35,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     nn.add_argument("--nproc-per-node", type=int, default=1)
     nn.add_argument("--node-rank", type=int, default=0)
     nn.add_argument("--nnodes", type=int, default=1)
+    nn.add_argument("--job-state-dir", type=str,
+                    default=os.environ.get("PERSIA_JOB_STATE_DIR"),
+                    help="step-fenced snapshot directory (persia_tpu.jobstate); "
+                         "exported to the entry as PERSIA_JOB_STATE_DIR")
+    nn.add_argument("--auto-resume", action="store_true",
+                    help="restart the entry after a crash (any nonzero exit, "
+                         "incl. SIGKILL); the entry resumes from the newest "
+                         "manifest in --job-state-dir")
+    nn.add_argument("--max-restarts", type=int, default=3,
+                    help="auto-resume restart budget per launcher invocation")
 
     dl = sub.add_parser("data-loader", help="launch the data-loader script")
     dl.add_argument("entry", nargs="?", default=None)
@@ -104,8 +114,33 @@ def main(argv: Optional[List[str]] = None) -> int:
         # one TPU process per host: JAX owns all local chips (no
         # torch.distributed.launch equivalent needed; multi-host uses
         # jax.distributed.initialize via env)
-        return _run([py, entry], {"WORLD_SIZE": args.nnodes * args.nproc_per_node,
-                                  "RANK": args.node_rank, "LOCAL_RANK": 0})
+        env = {"WORLD_SIZE": args.nnodes * args.nproc_per_node,
+               "RANK": args.node_rank, "LOCAL_RANK": 0}
+        if args.job_state_dir:
+            env["PERSIA_JOB_STATE_DIR"] = args.job_state_dir
+        if not args.auto_resume:
+            return _run([py, entry], env)
+        if not args.job_state_dir:
+            print("--auto-resume requires --job-state-dir "
+                  "(or PERSIA_JOB_STATE_DIR)", file=sys.stderr)
+            return 2
+        # auto-resume loop: a crashed trainer (any nonzero exit — SIGKILL,
+        # OOM, preemption) restarts and resumes from the newest manifest
+        # (entry scripts call ctx.resume(os.environ["PERSIA_JOB_STATE_DIR"]));
+        # PERSIA_RESUME_ATTEMPT lets the entry log which life it is on
+        attempt = 0
+        while True:
+            env["PERSIA_RESUME_ATTEMPT"] = attempt
+            rc = _run([py, entry], env)
+            if rc == 0:
+                return 0
+            attempt += 1
+            if attempt > args.max_restarts:
+                print(f"nn-worker failed with rc={rc}; restart budget "
+                      f"({args.max_restarts}) exhausted", file=sys.stderr)
+                return rc
+            print(f"nn-worker exited rc={rc}; auto-resume attempt "
+                  f"{attempt}/{args.max_restarts}", file=sys.stderr)
 
     if args.role == "data-loader":
         entry = _user_entry(args.entry, "PERSIA_DATALOADER_ENTRY", "data_loader.py")

@@ -181,6 +181,10 @@ class FusedTrainCtx:
 
     def dump_checkpoint(self, path: str) -> None:
         assert self.state is not None, "no state to dump (train first)"
+        import io
+
+        from persia_tpu.jobstate import fsync_write_bytes
+
         os.makedirs(path, exist_ok=True)
         leaves = jax.tree_util.tree_leaves_with_path(self.state)
         arrays = {}
@@ -188,9 +192,14 @@ class FusedTrainCtx:
         for i, (kp, leaf) in enumerate(leaves):
             arrays[f"a{i}"] = np.asarray(leaf)
             manifest.append(jax.tree_util.keystr(kp))
-        np.savez(os.path.join(path, "fused_state.npz"), **arrays)
-        with open(os.path.join(path, "fused_state.json"), "w") as f:
-            json.dump(manifest, f)
+        # atomic + fsync'd publish (persia-lint DUR001): a crash mid-dump
+        # must never leave a torn archive under the final name
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        fsync_write_bytes(os.path.join(path, "fused_state.npz"), buf.getvalue())
+        fsync_write_bytes(
+            os.path.join(path, "fused_state.json"), json.dumps(manifest).encode()
+        )
         logger.info("fused checkpoint written to %s (%d leaves)", path, len(manifest))
 
     def load_checkpoint(self, path: str) -> None:

@@ -88,6 +88,40 @@ class StoreClient:
             ),
         )
 
+    def update_batched_journaled(
+        self, journal_id: int, crc: int, signs: np.ndarray, key_ofs: np.ndarray,
+        dims: np.ndarray, grads, opt_groups: np.ndarray,
+    ) -> bool:
+        """Exactly-once multi-slot gradient update: the PS's bounded
+        apply-journal (persia_tpu.jobstate) dedupes on (id, crc), which
+        ALSO makes the call idempotent-retryable — a dropped reply re-sent
+        cannot double-apply. Returns True when applied, False on a
+        duplicate (a resumed trainer replaying an already-applied step)."""
+        raw = self._rpc.call(
+            "update_journaled",
+            proto.pack_update_journaled_request(
+                journal_id, crc, signs, key_ofs, dims, grads, opt_groups,
+                wire_dtype=self.wire_dtype,
+            ),
+            idempotent=True,
+        )
+        return raw == b"\x01"
+
+    def journal_probe(self, journal_id: int, crc: int) -> int:
+        raw = self._rpc.call(
+            "journal_probe", struct.pack("<QI", journal_id, crc & 0xFFFFFFFF),
+            idempotent=True,
+        )
+        return struct.unpack("<b", raw)[0]
+
+    def journal_len(self) -> int:
+        return struct.unpack(
+            "<q", self._rpc.call("journal_len", idempotent=True)
+        )[0]
+
+    def journal_clear(self) -> None:
+        self._rpc.call("journal_clear")
+
     def lookup(self, signs: np.ndarray, dim: int, train: bool) -> np.ndarray:
         # train lookups mutate (LRU/admit) but are retry-safe: re-running a
         # lookup converges to the same entries, so idempotent for RPC purposes
@@ -261,8 +295,19 @@ class WorkerClient:
         return proto.unpack_emb_batches(raw)
 
     def update_gradient_batched(
-        self, ref: int, slot_grads: Dict[str, np.ndarray], scale_factor: float = 1.0
+        self, ref: int, slot_grads: Dict[str, np.ndarray],
+        scale_factor: float = 1.0, journal_id=None,
     ) -> Dict[str, int]:
+        if journal_id is not None:
+            # the remote worker tier does not carry the apply-journal wire
+            # yet; failing loudly beats silently downgrading exactly-once
+            # resume to at-least-once
+            raise NotImplementedError(
+                "journaled gradient returns require an in-process "
+                "EmbeddingWorker (the worker-server RPC wire has no journal "
+                "frame yet) — run the trainer direct-to-PS for exactly-once "
+                "resume"
+            )
         raw = self._rpc.call(
             "update_gradient_batched",
             struct.pack("<q", ref) + proto.pack_slot_grads(slot_grads, scale_factor),

@@ -86,15 +86,19 @@ class CoordinatorClient:
         fast, only a short registry is worth waiting out."""
         have: List[str] = []
 
-        def _probe() -> Optional[List[str]]:
+        def _probe():
             have[:] = self.list(role)
-            return list(have) if len(have) >= count else None
+            # boxed: poll_until succeeds on TRUTHY values, and a satisfied
+            # count==0 barrier (worker-less topologies, e.g. the cached
+            # tier's trainer-direct-to-PS chaos runs) yields an EMPTY list
+            # — unboxed it would poll until the deadline and fail
+            return [list(have)] if len(have) >= count else None
 
         try:
             return resilience.poll_until(
                 _probe, timeout_s, what=f"{count} {role!r} registrations",
                 swallow=(),
-            )
+            )[0]
         except resilience.DeadlineExceeded:
             raise TimeoutError(
                 f"waited {timeout_s}s for {count} {role!r}, have {len(have)}"

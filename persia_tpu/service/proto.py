@@ -188,6 +188,28 @@ def unpack_update_batched_request(raw: bytes):
     return signs, key_ofs, dims, grads, opt_groups
 
 
+def pack_update_journaled_request(
+    journal_id: int, crc: int,
+    signs: np.ndarray, key_ofs: np.ndarray, dims: np.ndarray,
+    grads_flat: np.ndarray, opt_groups: np.ndarray,
+    wire_dtype: Optional[str] = None,
+) -> List:
+    """Journaled multi-slot gradient frame: a 12-byte (u64 id, u32 crc)
+    prefix on the plain ``update_batched`` wire. The id/crc pair is the PS
+    apply-journal record (persia_tpu.jobstate) that makes the call
+    retry-safe AND exactly-once across a trainer crash."""
+    return [struct.pack("<QI", journal_id, crc & 0xFFFFFFFF)] + (
+        pack_update_batched_request(
+            signs, key_ofs, dims, grads_flat, opt_groups, wire_dtype=wire_dtype
+        )
+    )
+
+
+def unpack_update_journaled_request(raw: bytes):
+    journal_id, crc = struct.unpack_from("<QI", raw)
+    return (journal_id, crc) + unpack_update_batched_request(raw[12:])
+
+
 def pack_update_request(signs: np.ndarray, grads: np.ndarray, group: int) -> bytes:
     return struct.pack("<i", group) + pack_ndarrays([signs, grads])
 

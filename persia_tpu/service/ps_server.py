@@ -66,6 +66,10 @@ class ParameterServerService:
         s.register("lookup", self._lookup)
         s.register("lookup_batched", self._lookup_batched)
         s.register("update_batched", self._update_batched)
+        s.register("update_journaled", self._update_journaled)
+        s.register("journal_probe", self._journal_probe)
+        s.register("journal_len", self._journal_len)
+        s.register("journal_clear", self._journal_clear)
         s.register("checkout_entries", self._checkout)
         s.register("probe_entries", self._probe_entries)
         s.register("update_gradients", self._update)
@@ -135,6 +139,35 @@ class ParameterServerService:
                     int(opt_groups[g]),
                 )
                 off += size
+        return b"ok"
+
+    def _update_journaled(self, payload: bytes) -> bytes:
+        """Exactly-once gradient apply through the store's bounded
+        apply-journal (persia_tpu.jobstate): ``b"\\x01"`` applied,
+        ``b"\\x00"`` duplicate skipped. Retry-safe by construction — a
+        dropped reply re-sent lands on the journal record."""
+        (jid, crc, signs, key_ofs, dims, grads, opt_groups) = (
+            proto.unpack_update_journaled_request(payload)
+        )
+        if hasattr(self.store, "update_batched_journaled"):
+            applied = self.store.update_batched_journaled(
+                jid, crc, signs, key_ofs, dims, grads, opt_groups
+            )
+            return b"\x01" if applied else b"\x00"
+        # store without a journal (should not happen for the shipped
+        # backends): fall back to a plain apply — at-least-once
+        self.store.update_batched(signs, key_ofs, dims, grads, opt_groups)
+        return b"\x01"
+
+    def _journal_probe(self, payload: bytes) -> bytes:
+        jid, crc = struct.unpack("<QI", payload)
+        return struct.pack("<b", self.store.journal_probe(jid, crc))
+
+    def _journal_len(self, payload: bytes) -> bytes:
+        return struct.pack("<q", self.store.journal_len())
+
+    def _journal_clear(self, payload: bytes) -> bytes:
+        self.store.journal_clear()
         return b"ok"
 
     def _checkout(self, payload: bytes) -> bytes:

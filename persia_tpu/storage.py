@@ -128,7 +128,24 @@ class DiskPath(StoragePath):
             os.fchmod(fd, 0o666 & ~_UMASK)
             with os.fdopen(fd, "wb") as f:
                 f.write(data)
+                # fsync BEFORE the rename: without it a power cut after the
+                # replace can publish a zero-length file under the final
+                # name (the rename is durable before the data is) — the
+                # torn-checkpoint hole the durability layer exists to close
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, self.uri)
+            try:
+                dfd = os.open(d, os.O_RDONLY)
+            except OSError:
+                dfd = -1  # directory fsync unsupported — rename still atomic
+            if dfd >= 0:
+                try:
+                    os.fsync(dfd)
+                except OSError:
+                    pass
+                finally:
+                    os.close(dfd)
         except BaseException:
             if os.path.exists(tmp):
                 os.remove(tmp)

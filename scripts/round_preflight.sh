@@ -38,11 +38,13 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_native_feed.py -q
 # SANITIZE_ASAN rides the same script when PREFLIGHT_ASAN=1
 SANITIZE_ASAN="${PREFLIGHT_ASAN:-0}" bash scripts/sanitize_native.sh
 
-echo "== 1/5 chaos suite (fast schedules) =="
+echo "== 1/5 chaos suite (fast schedules + resume-chaos) =="
 # deterministic fault injection against live local services: proxies,
 # breakers, crc integrity, degraded-mode router, pending-ledger salts —
-# the fast subset; the full kill+resets bitwise run rides the slow suite
-JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_failure_recovery.py -q -m 'not slow'
+# plus the fast resume-chaos runs (trainer-kill/resume bit-parity for the
+# hybrid ctx, the cached stream fence, and the RPC journal wire); the
+# full kill+resets and trainer-SIGKILL bitwise runs ride the slow suite
+JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_failure_recovery.py tests/test_jobstate.py -q -m 'not slow'
 
 echo "== 2/5 test suite =="
 python -m pytest tests/ -q

@@ -1,0 +1,32 @@
+"""DUR001 fixture: checkpoint artifacts published with plain writes — a
+crash mid-write leaves a torn file under the final name."""
+
+import json
+
+import numpy as np
+
+
+def save_manifest(path, obj):
+    with open(path + "/MANIFEST.json", "w") as f:  # DUR001
+        json.dump(obj, f)
+
+
+def save_shard(path, blob):
+    with open(path + "/replica_0_shard_0.emb", "wb") as f:  # DUR001
+        f.write(blob)
+
+
+def save_fused(path, arrays):
+    np.savez(path + "/fused_state.npz", **arrays)  # DUR001
+
+
+def read_manifest(path):
+    # reads never fire the rule
+    with open(path + "/MANIFEST.json") as f:
+        return json.load(f)
+
+
+def save_trace(path, events):
+    # not a checkpoint artifact: silent
+    with open(path + "/trace.json", "w") as f:
+        json.dump(events, f)

@@ -205,6 +205,51 @@ def test_inline_suppression_silences_finding():
     assert apply_suppressions(raw, {path: text}) == []  # and the disable works
 
 
+# ------------------------------------------------------ durability fixtures
+
+
+def test_durability_rule_fires():
+    from persia_tpu.analysis import durability
+
+    findings = durability.check_source(
+        read_text(_fixture("dur_plain_write.py")), "dur_plain_write.py"
+    )
+    assert {f.rule for f in findings} == {"DUR001"}
+    # the manifest open(), the shard open(), and the np.savez all fire;
+    # the read and the non-artifact trace write stay silent
+    assert len(findings) == 3, findings
+
+
+def test_durability_atomic_publish_is_allowed():
+    from persia_tpu.analysis import durability
+
+    src = (
+        "import json, os, tempfile\n"
+        "def save_manifest(path, obj):\n"
+        "    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))\n"
+        "    with os.fdopen(fd, 'w') as f:\n"
+        "        json.dump(obj, f)\n"
+        "        f.flush()\n"
+        "        os.fsync(f.fileno())\n"
+        "    os.replace(tmp, path + '/MANIFEST.json')\n"
+    )
+    assert durability.check_source(src, "atomicish.py") == []
+
+
+def test_durability_suppression_works():
+    from persia_tpu.analysis import durability
+
+    src = (
+        "def save(path, raw):\n"
+        "    with open(path + '/x.ckpt', 'wb') as f:"
+        "  # persia-lint: disable=DUR001\n"
+        "        f.write(raw)\n"
+    )
+    raw = durability.check_source(src, "supp.py")
+    assert {f.rule for f in raw} == {"DUR001"}
+    assert apply_suppressions(raw, {"supp.py": src}) == []
+
+
 # ------------------------------------------------------------- clean tree
 
 

@@ -402,6 +402,98 @@ def test_chaos_stream_kill_and_resets_bitwise(monkeypatch):
 
 
 @pytest.mark.slow
+def test_trainer_sigkill_auto_resume_bitwise(tmp_path):
+    """THE trainer-crash acceptance run (ISSUE 5): a REAL ``SIGKILL`` of
+    the trainer subprocess at a seeded-RANDOM mid-stream step (landed via
+    the progress beacon, i.e. between "gradient applied" and "next
+    manifest committed"), an auto-resume relaunch from the newest
+    manifest, and final PS entries + dense params BIT-IDENTICAL to an
+    uninterrupted run of the same seeds — no lost and no double-applied
+    gradients anywhere."""
+    import os as _os
+    import random
+    import subprocess
+    import sys
+
+    from persia_tpu.chaos import TrainerKiller
+    from persia_tpu.embedding.hashing import add_index_prefix
+    from persia_tpu.helper import ServiceCtx
+    from persia_tpu.service.clients import StoreClient
+
+    STEPS, K = 22, 5
+    VOCABS = (64, 32)
+    kill_at = random.Random(1234).randint(6, 16)  # randomized, reproducible
+    trainer_main = _os.path.join(_os.path.dirname(__file__), "jobstate_trainer_main.py")
+
+    def run_topology(workdir, kill: bool):
+        workdir.mkdir()
+        out_path = str(workdir / "final.state")
+        progress = str(workdir / "progress")
+        with ServiceCtx(
+            num_parameter_servers=2, num_embedding_workers=0,
+            backend="numpy", seed=7,
+        ) as svc:
+            env = dict(_os.environ)
+            repo_root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+            env.update({
+                "PYTHONPATH": repo_root + _os.pathsep + env.get("PYTHONPATH", ""),
+                "JAX_PLATFORMS": "cpu",
+                "JS_PS_ADDRS": ",".join(svc.ps_addrs()),
+                "JS_DIR": str(workdir / "js"),
+                "JS_PROGRESS": progress,
+                "JS_OUT": out_path,
+                "JS_STEPS": str(STEPS),
+                "JS_SNAPSHOT_EVERY": str(K),
+                "JS_SEED": "9",
+            })
+            cmd = [sys.executable, trainer_main]
+            proc = subprocess.Popen(cmd, env=env)
+            if kill:
+                killer = TrainerKiller(proc, progress, kill_at).start()
+                assert killer.wait(timeout_s=300)
+                assert killer.killed_at is not None, "trainer finished before the kill"
+                assert proc.wait(timeout=30) != 0  # SIGKILL, not clean exit
+                # auto-resume relaunch (what the launcher's loop does)
+                proc = subprocess.Popen(cmd, env=env)
+            assert proc.wait(timeout=600) == 0
+            state_bytes = open(out_path, "rb").read()
+            entries = {}
+            direct = [StoreClient(a) for a in svc.ps_addrs()]
+            from persia_tpu.config import EmbeddingConfig, SlotConfig
+
+            cfg = EmbeddingConfig(
+                slots_config={"cat_0": SlotConfig(dim=8), "cat_1": SlotConfig(dim=8)},
+                feature_index_prefix_bit=8,
+            )
+            for slot, vocab in zip(("cat_0", "cat_1"), VOCABS):
+                pre = cfg.slot(slot).index_prefix
+                for s in range(vocab):
+                    sign = int(add_index_prefix(
+                        np.array([s], np.uint64), pre, 8)[0])
+                    for c in direct:
+                        e = c.get_embedding_entry(sign)
+                        if e is not None:
+                            entries[(slot, s)] = e
+                            break
+            return state_bytes, entries
+
+    chaos_state, chaos_entries = run_topology(tmp_path / "chaos", kill=True)
+    clean_state, clean_entries = run_topology(tmp_path / "clean", kill=False)
+
+    # dense params + optimizer state: BYTE-identical serialized trees
+    assert chaos_state == clean_state
+    # every PS entry bitwise (values AND optimizer state)
+    assert set(chaos_entries) == set(clean_entries)
+    checked = 0
+    for k in clean_entries:
+        np.testing.assert_array_equal(
+            chaos_entries[k], clean_entries[k], err_msg=str(k)
+        )
+        checked += 1
+    assert checked > 50
+
+
+@pytest.mark.slow
 def test_standby_promotion_with_snapshot_replay():
     """A spare PS is promoted into a dead shard's slot: the snapshot
     replays through dump_shard/load_shard_bytes, the coordinator entry is

@@ -1,13 +1,17 @@
-"""Checkpoint subsystem tests: markers, sessions, stale-dir reuse, re-shard."""
+"""Checkpoint subsystem tests: markers, sessions, stale-dir reuse, re-shard,
+and the durability layer (crc trailers, torn-file rejection, manifest
+last-good fallback)."""
 
 import json
 import os
+import struct
 
 import numpy as np
 import pytest
 
 from persia_tpu.checkpoint import (
     DONE_MARKER,
+    CorruptCheckpointError,
     ModelManagerStatus,
     checkpoint_info,
     dump_store,
@@ -118,6 +122,146 @@ def test_replica_reshard_on_load(tmp_path):
     owners = sign_to_shard(signs, 3)
     for r in range(3):
         assert stores3[r].size() == int((owners == r).sum())
+
+
+def _shard_files(d):
+    return sorted(f for f in os.listdir(d) if f.endswith(".emb"))
+
+
+def test_crc_corrupt_shard_rejected(tmp_path):
+    """A flipped byte inside a shard file must raise CorruptCheckpointError
+    on load — never load silently garbled rows."""
+    d = str(tmp_path / "ckpt")
+    s = _store()
+    _fill(s)
+    dump_store(s, d)
+    victim = os.path.join(d, _shard_files(d)[0])
+    raw = bytearray(open(victim, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF  # payload damage; the crc trailer stays
+    with open(victim, "wb") as f:
+        f.write(raw)
+    with pytest.raises(CorruptCheckpointError):
+        load_store(_store(), d)
+
+
+def test_torn_shard_file_rejected(tmp_path):
+    """A truncated shard file (the torn write a plain open() could leave)
+    must be rejected, whether the truncation cuts the trailer off (legacy-
+    looking blob that fails the format parse) or keeps it stale."""
+    d = str(tmp_path / "ckpt")
+    s = _store()
+    _fill(s)
+    dump_store(s, d)
+    victim = os.path.join(d, _shard_files(d)[0])
+    raw = open(victim, "rb").read()
+    with open(victim, "wb") as f:
+        f.write(raw[: len(raw) // 2])  # torn: trailer gone, payload cut
+    with pytest.raises(CorruptCheckpointError):
+        load_store(_store(), d)
+
+
+def test_legacy_trailerless_shards_still_load(tmp_path):
+    """Files dumped by pre-durability builds carry no crc trailer; they
+    must keep loading (rolling-upgrade compatibility)."""
+    d = str(tmp_path / "ckpt")
+    s = _store()
+    _fill(s, 120)
+    dump_store(s, d)
+    for fname in _shard_files(d):
+        p = os.path.join(d, fname)
+        raw = open(p, "rb").read()
+        assert raw[-4:] == b"PCK1"
+        with open(p, "wb") as f:
+            f.write(raw[:-8])  # strip trailer → legacy format
+    s2 = _store()
+    assert load_store(s2, d) == 120
+    signs = np.arange(120, dtype=np.uint64)
+    np.testing.assert_array_equal(
+        s.lookup(signs, 8, False), s2.lookup(signs, 8, False)
+    )
+
+
+def test_dump_leaves_no_temp_files(tmp_path):
+    """The atomic-rename publish must not litter staging files (retry
+    loops would otherwise fill the checkpoint dir)."""
+    d = str(tmp_path / "ckpt")
+    s = _store()
+    _fill(s, 50)
+    dump_store(s, d)
+    assert not [f for f in os.listdir(d) if f.startswith(".tmp_")]
+
+
+# ---------------------------------------------------- job-state manifests
+
+
+def test_manifest_commit_and_last_good(tmp_path):
+    from persia_tpu.jobstate import JobStateManager
+
+    mgr = JobStateManager(str(tmp_path / "js"))
+    assert mgr.latest() is None
+    w = mgr.begin_epoch()
+    w.add_blob("dense.state", b"hello world")
+    w.add_json("loader.json", {"consumed_batches": 7})
+    m = w.commit({"step": 7})
+    assert m.job_epoch == 1 and m.step == 7
+    got = mgr.latest()
+    assert got is not None and got.job_epoch == 1
+    assert got.read_blob("dense.state") == b"hello world"
+    assert got.read_json("loader.json")["consumed_batches"] == 7
+
+
+def test_manifest_last_good_fallback_on_torn_epoch(tmp_path):
+    """A crash mid-capture (no MANIFEST.json) or a torn manifest in the
+    newest epoch must fall back to the previous good epoch — the
+    LAST_GOOD pointer plus the newest-first scan."""
+    from persia_tpu.jobstate import JobStateManager, MANIFEST_NAME
+
+    mgr = JobStateManager(str(tmp_path / "js"))
+    w1 = mgr.begin_epoch()
+    w1.add_blob("dense.state", b"epoch-one")
+    w1.commit({"step": 4})
+    # epoch 2: components written, crash before MANIFEST.json → invisible
+    w2 = mgr.begin_epoch()
+    w2.add_blob("dense.state", b"epoch-two")
+    assert mgr.latest().job_epoch == 1
+    # epoch 3: manifest exists but is torn JSON → skipped by the scanner
+    w3 = mgr.begin_epoch()
+    w3.add_blob("dense.state", b"epoch-three")
+    m3 = w3.commit({"step": 12})
+    with open(os.path.join(m3.dir, MANIFEST_NAME), "wb") as f:
+        f.write(b'{"job_epoch": 3, "compo')  # torn write
+    got = mgr.latest()
+    assert got is not None and got.job_epoch == 1
+    assert got.read_blob("dense.state") == b"epoch-one"
+
+
+def test_manifest_blob_crc_verified_on_read(tmp_path):
+    from persia_tpu.jobstate import CorruptManifestError, JobStateManager
+
+    mgr = JobStateManager(str(tmp_path / "js"))
+    w = mgr.begin_epoch()
+    w.add_blob("dense.state", b"x" * 100)
+    m = w.commit({"step": 1})
+    path = os.path.join(m.dir, "dense.state")
+    raw = bytearray(open(path, "rb").read())
+    raw[50] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(raw)
+    with pytest.raises(CorruptManifestError):
+        mgr.latest().read_blob("dense.state")
+
+
+def test_manifest_prune_keeps_newest(tmp_path):
+    from persia_tpu.jobstate import JobStateManager
+
+    mgr = JobStateManager(str(tmp_path / "js"))
+    for step in (1, 2, 3, 4):
+        w = mgr.begin_epoch()
+        w.add_blob("dense.state", b"s%d" % step)
+        w.commit({"step": step})
+    assert mgr.prune(keep=2) == 2
+    assert mgr.latest().step == 4
+    assert len(mgr._epoch_dirs()) == 2
 
 
 def test_status_machine(tmp_path):

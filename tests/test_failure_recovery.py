@@ -278,6 +278,114 @@ def test_degraded_abort_threshold():
 # --------------------------------------------------------- PS kill + restart
 
 
+def test_trainer_kill_resume_over_rpc_bit_identical(tmp_path):
+    """Trainer-crash recovery over the REAL RPC wire (in-process PS
+    services, StoreClient transport — the journaled update frame included):
+    the trainer is abandoned mid-window with post-fence gradients already
+    applied; a fresh trainer resumes from the manifest (PS rewind + journal
+    clear over RPC) and finishes bit-identical to an uninterrupted run."""
+    import optax
+
+    from persia_tpu.config import EmbeddingConfig, SlotConfig
+    from persia_tpu.ctx import TrainCtx
+    from persia_tpu.embedding.hashing import add_index_prefix
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.embedding.store import EmbeddingStore
+    from persia_tpu.embedding.worker import EmbeddingWorker
+    from persia_tpu.jobstate import JobStateManager
+    from persia_tpu.models import DNN
+    from persia_tpu.service.clients import StoreClient
+    from persia_tpu.testing import SyntheticClickDataset
+
+    VOCABS = (64, 32)
+    cfg = EmbeddingConfig(
+        slots_config={"cat_0": SlotConfig(dim=8), "cat_1": SlotConfig(dim=8)},
+        feature_index_prefix_bit=8,
+    )
+    STEPS, K, KILL_AT = 10, 4, 7
+    batches = list(
+        SyntheticClickDataset(num_samples=STEPS * 32, vocab_sizes=VOCABS, seed=9)
+        .batches(32)
+    )[:STEPS]
+
+    def make_stores():
+        return [
+            EmbeddingStore(capacity=1 << 16, num_internal_shards=4, seed=7)
+            for _ in range(2)
+        ]
+
+    def make_ctx(worker):
+        return TrainCtx(
+            model=DNN(dense_mlp_size=8, sparse_mlp_size=16, hidden_sizes=(32,)),
+            dense_optimizer=optax.adam(3e-3),
+            embedding_optimizer=Adagrad(lr=0.1),
+            worker=worker, embedding_config=cfg,
+        ).__enter__()
+
+    def entries_of(stores):
+        out = {}
+        for slot, vocab in zip(("cat_0", "cat_1"), VOCABS):
+            pre = cfg.slot(slot).index_prefix
+            for s in range(vocab):
+                sign = int(add_index_prefix(np.array([s], np.uint64), pre, 8)[0])
+                e = next(
+                    (st.get_embedding_entry(sign) for st in stores
+                     if st.get_embedding_entry(sign) is not None), None,
+                )
+                if e is not None:
+                    out[(slot, s)] = e
+        return out
+
+    # baseline: in-process stores, uninterrupted
+    base_stores = make_stores()
+    base = make_ctx(EmbeddingWorker(cfg, base_stores))
+    for b in batches:
+        base.train_step(b)
+    import jax
+
+    base_params = jax.tree.map(np.asarray, base.state.params)
+
+    # chaos run: PS behind real RPC servers; trainer dies mid-window
+    stores = make_stores()
+    services = [_ps_service(s) for s in stores]
+    try:
+        clients = [StoreClient(f"127.0.0.1:{svc.port}") for svc in services]
+        for c in clients:
+            c.wait_ready()
+        mgr = JobStateManager(str(tmp_path / "js"))
+        ctx1 = make_ctx(EmbeddingWorker(cfg, clients))
+        ctx1.resume(mgr)  # cold start arms journaling
+        for i, b in enumerate(batches[:KILL_AT]):
+            ctx1.train_step(b)
+            if (i + 1) % K == 0:
+                ctx1.snapshot_job(mgr)
+        del ctx1  # trainer "dies"; PS processes keep serving
+
+        ctx2 = make_ctx(EmbeddingWorker(
+            cfg, [StoreClient(f"127.0.0.1:{svc.port}") for svc in services]
+        ))
+        m = ctx2.resume(mgr)  # PS rewind + journal clear over RPC
+        assert m is not None and m.step == 4
+        for b in batches[m.step:]:
+            ctx2.train_step(b)
+        res_params = jax.tree.map(np.asarray, ctx2.state.params)
+        for (kp, a), (_, b_) in zip(
+            jax.tree_util.tree_leaves_with_path(base_params),
+            jax.tree_util.tree_leaves_with_path(res_params),
+        ):
+            np.testing.assert_array_equal(a, b_, err_msg=str(kp))
+    finally:
+        for svc in services:
+            try:
+                svc.server.stop()
+            except Exception:
+                pass
+    base_e, chaos_e = entries_of(base_stores), entries_of(stores)
+    assert set(base_e) == set(chaos_e) and len(base_e) > 50
+    for k in base_e:
+        np.testing.assert_array_equal(base_e[k], chaos_e[k], err_msg=str(k))
+
+
 @pytest.mark.slow
 def test_training_survives_ps_kill_and_restart(tmp_path):
     """SIGKILL one PS replica mid-training, restart it on the same port:
