@@ -1,0 +1,291 @@
+"""Flagship online train-to-serve chaos soak — the committed evidence is
+``BENCH_ONLINE.json``.
+
+The full continuous-learning loop runs live: a trainer streams sequence-
+numbered crc32-framed incremental packets + periodic checkpoints while a
+zipfian request generator (multi-million-user id space — the production
+skew) hammers a staleness-aware gateway fronting three serving replicas
+that consume the deltas in real time. **While the load runs**, a seeded
+chaos schedule:
+
+1. SIGKILLs the trainer mid-step → jobstate auto-resume brings it back and
+   the packet sequence continues (no consumer high-water mark reset);
+2. SIGKILLs a replica during live delta apply → restarted on its original
+   port, boots from the newest checkpoint, replays the retained tail, and
+   the gateway heals it back into rotation;
+3. black-holes one replica's delta channel until its freshness lag blows
+   the staleness bound → the gateway QUARANTINES it (drained from the
+   balance set, health probes continue, in-flight requests unharmed),
+   then heals the channel → resync catches the replica up → auto-heal;
+4. black-holes EVERY replica's channel → the gateway degrades instead of
+   failing: requests are served by the least-stale replica with an
+   explicit ``X-Staleness-Steps`` answer;
+ — plus continuous per-delivery corruption/truncation/drop faults on the
+delta relay for the whole window (crc-frame detection → skip + resync).
+
+Acceptance (asserted, then recorded): ZERO failed requests (429/504 sheds
+allowed, 5xx/transport failures not), every quarantined replica auto-
+heals, the trainer auto-resumed at least once, and freshness-lag p50/p99,
+QPS, and quarantine/heal counts land in the artifact.
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/online_bench.py
+Env:  BENCH_ONLINE_SECONDS (default 30), BENCH_ONLINE_CLIENTS (default 8),
+      BENCH_ONLINE_ROWS (default 8), BENCH_ONLINE_USERS (default 5M).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _pcts(vals, nd=2):
+    if not vals:
+        return {}
+    a = np.asarray(vals, dtype=np.float64)
+    return {
+        "p50": round(float(np.percentile(a, 50)), nd),
+        "p99": round(float(np.percentile(a, 99)), nd),
+        "max": round(float(a.max()), nd),
+        "mean": round(float(a.mean()), nd),
+    }
+
+
+def main():
+    import jax
+
+    from persia_tpu.chaos import ChaosConfig
+    from persia_tpu.serving import InferenceClient
+    from persia_tpu.topology import LocalTopology, demo_batch
+
+    seconds = float(os.environ.get("BENCH_ONLINE_SECONDS", "30"))
+    n_clients = int(os.environ.get("BENCH_ONLINE_CLIENTS", "8"))
+    rows = int(os.environ.get("BENCH_ONLINE_ROWS", "8"))
+    users = int(os.environ.get("BENCH_ONLINE_USERS", str(5_000_000)))
+    seed = int(os.environ.get("BENCH_ONLINE_SEED", "11"))
+    staleness_bound = 100  # steps; at step_ms=10 ≈ 1 s of trainer progress
+
+    chaos_cfg = ChaosConfig(
+        seed=seed, corrupt_prob=0.04, truncate_prob=0.02, refuse_prob=0.02
+    )
+    topo = LocalTopology(
+        trainers=1, replicas=3,
+        steps=1_000_000,  # the window, not the step budget, ends the run
+        rows=32, vocab=users, step_ms=10.0,
+        flush_every=5, ckpt_every=300, snapshot_every=50,
+        cache_rows=1 << 15, replica_poll_s=0.1,
+        max_staleness_steps=staleness_bound,
+        health_interval_s=0.3,
+        auto_resume=True, max_restarts=5,
+        delta_chaos=chaos_cfg, seed=7,
+    )
+
+    # zipfian request pool over the multi-million-user id space
+    pool = [
+        demo_batch(1_000_000 + i, rows, users, seed=seed,
+                   requires_grad=False).to_bytes()
+        for i in range(128)
+    ]
+
+    lock = threading.Lock()
+    latencies, failures = [], []
+    counts = {"ok": 0, "shed": 0, "stale_served": 0, "staleness_hdr_max": 0}
+    lag_samples = {"steps": [], "seconds": []}
+    stop_load = threading.Event()
+
+    def client(idx):
+        i = idx
+        while not stop_load.is_set():
+            raw = pool[i % len(pool)]
+            i += 1
+            t0 = time.perf_counter()
+            try:
+                _scores, info = topo.gateway.predict_bytes_ex(raw)
+            except urllib.error.HTTPError as e:
+                with lock:
+                    if e.code in (429, 504):
+                        counts["shed"] += 1  # admission control, not failure
+                    else:
+                        failures.append(f"HTTP {e.code}")
+                continue
+            except Exception as e:  # noqa: BLE001 — anything else IS a failure
+                with lock:
+                    failures.append(repr(e))
+                continue
+            dt = (time.perf_counter() - t0) * 1e3
+            with lock:
+                counts["ok"] += 1
+                latencies.append(round(dt, 3))
+                if info.get("stale_fallback"):
+                    counts["stale_served"] += 1
+                counts["staleness_hdr_max"] = max(
+                    counts["staleness_hdr_max"], info.get("staleness_steps", 0)
+                )
+
+    def sampler():
+        # the gateway's fleet-head view, NOT the replicas' self-reports: a
+        # black-holed replica reads locally fresh (its head view froze with
+        # its applied state) — only the gateway sees its true lag
+        while not stop_load.is_set():
+            for f in topo.gateway.freshness_view().values():
+                with lock:
+                    lag_samples["steps"].append(float(f["lag_steps"]))
+                    lag_samples["seconds"].append(float(f["lag_seconds"]))
+            time.sleep(0.25)
+
+    schedule_log = []
+
+    def note(event, **kw):
+        kw.update({"event": event, "t": round(time.monotonic() - t0, 2)})
+        schedule_log.append(kw)
+        print(f"[chaos t+{kw['t']:.1f}s] {event} {kw}", flush=True)
+
+    with topo:
+        # wait until every replica is versioned + consuming deltas
+        for p in topo.replica_ports:
+            cli = InferenceClient(f"127.0.0.1:{p}", timeout_s=5.0)
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                try:
+                    h = cli.health()
+                    if (h.get("version", "v0") != "v0"
+                            and (h.get("freshness") or {}).get("applied_step", -1) >= 0):
+                        break
+                except Exception:  # noqa: BLE001
+                    pass
+                time.sleep(0.2)
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(n_clients)]
+        threads.append(threading.Thread(target=sampler, daemon=True))
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+
+        # ---- the seeded chaos schedule, while the load runs
+        def until(frac):
+            dt = t0 + seconds * frac - time.monotonic()
+            if dt > 0:
+                time.sleep(dt)
+
+        until(0.10)
+        kill_step = topo.trainer_step(0)
+        topo.kill_trainer(0)
+        note("kill_trainer", step=kill_step)
+
+        until(0.25)
+        topo.kill_replica(1)
+        note("kill_replica_mid_apply", replica=1)
+        until(0.35)
+        topo.restart_replica(1)
+        note("restart_replica", replica=1)
+
+        until(0.45)
+        topo.delta_chaos.set_blackhole(2, True)
+        note("blackhole_delta_channel", replica=2)
+        until(0.65)
+        topo.delta_chaos.set_blackhole(2, False)
+        note("heal_delta_channel", replica=2)
+
+        until(0.75)
+        for i in range(topo.n_replicas):
+            topo.delta_chaos.set_blackhole(i, True)
+        note("blackhole_all_channels")
+        until(0.90)
+        for i in range(topo.n_replicas):
+            topo.delta_chaos.set_blackhole(i, False)
+        note("heal_all_channels")
+
+        until(1.0)
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=30)
+        elapsed = time.monotonic() - t0
+
+        # settle: resyncs finish, every quarantined replica must heal
+        deadline = time.monotonic() + 30
+        while topo.gateway.quarantined_replicas() and time.monotonic() < deadline:
+            time.sleep(0.3)
+        final = topo.stats()
+        resumed_step = topo.trainer_step(0)
+
+    gw = final["gateway"]
+    out = {
+        "metric": "online_train_to_serve_chaos",
+        "users": users,
+        "clients": n_clients,
+        "rows_per_request": rows,
+        "window_seconds": round(elapsed, 1),
+        "staleness_bound_steps": staleness_bound,
+        "requests": {
+            "completed": counts["ok"],
+            "qps": round(counts["ok"] / elapsed, 1),
+            "failures": len(failures),
+            "failure_samples": failures[:5],
+            "sheds_429_504": counts["shed"],
+            "latency_ms": _pcts(latencies),
+        },
+        "freshness_lag": {
+            "samples": len(lag_samples["steps"]),
+            "steps": _pcts(lag_samples["steps"]),
+            "seconds": _pcts(lag_samples["seconds"], nd=3),
+        },
+        "degraded_serving": {
+            "stale_fallback_served": counts["stale_served"],
+            "gateway_stale_served": int(gw["stale_served"]),
+            "max_staleness_header_steps": counts["staleness_hdr_max"],
+        },
+        "quarantine": {
+            "events": int(gw["quarantine_events"]),
+            "heals": int(gw["heal_events"]),
+            "final_quarantined": gw["quarantined"],
+            "log": topo.gateway.quarantine_log if topo.gateway else [],
+        },
+        "trainer": {
+            "restarts": final["trainer_restarts"],
+            "killed_at_step": kill_step,
+            "final_step": resumed_step,
+        },
+        "delta_channel_faults": final.get("delta_channel", {}),
+        "chaos": chaos_cfg.to_dict(),
+        "schedule": schedule_log,
+        "platform": jax.default_backend(),
+    }
+    print(json.dumps(out, indent=1))
+
+    assert not failures, f"requests failed under chaos: {failures[:5]}"
+    assert counts["ok"] > 0, "no requests completed"
+    assert final["trainer_restarts"] >= 1, "trainer never auto-resumed"
+    assert resumed_step > kill_step, "trainer did not make progress after resume"
+    assert out["quarantine"]["events"] >= 1, "no replica was ever quarantined"
+    assert out["quarantine"]["heals"] >= 1, "no quarantined replica healed"
+    assert not out["quarantine"]["final_quarantined"], (
+        f"replicas stuck in quarantine: {out['quarantine']['final_quarantined']}"
+    )
+    faults = out["delta_channel_faults"]
+    assert faults.get("corrupt", 0) + faults.get("truncated", 0) > 0, (
+        "delta-channel corruption never fired"
+    )
+    assert counts["stale_served"] > 0, (
+        "all-stale degraded serving never engaged"
+    )
+    assert counts["staleness_hdr_max"] > staleness_bound, (
+        "degraded answers never carried an over-bound staleness label"
+    )
+    out["zero_failed_requests"] = True
+
+    dst = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                       "BENCH_ONLINE.json")
+    with open(dst, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {dst}")
+
+
+if __name__ == "__main__":
+    main()

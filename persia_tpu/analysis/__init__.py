@@ -12,9 +12,11 @@ any finding:
   permits/ring-spans not released on exception paths, blocking calls made
   under a lock, lock-order inversions against the declared registry
   (:mod:`persia_tpu.analysis.lock_order`).
-- **Resilience policy** (RES001–RES004): raw sleeps, constant socket
-  timeouts, ad-hoc retry loops and manual wall-clock deadlines in
-  ``service/``+``serving/`` that bypass ``service/resilience.py``.
+- **Resilience policy** (RES001–RES005): raw sleeps, constant socket
+  timeouts, ad-hoc retry loops, manual wall-clock deadlines, and
+  swallow-without-metric ``except Exception`` loops in
+  ``service/``+``serving/`` that bypass ``service/resilience.py`` or
+  fail invisibly.
 - **Durability** (DUR001): checkpoint/manifest artifacts written with a
   plain ``open(..., "w")`` (or direct ``np.savez``) instead of the
   temp + fsync + atomic-rename publish the crash-consistency layer

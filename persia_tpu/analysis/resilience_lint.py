@@ -21,6 +21,11 @@ it IS the engine):
 - RES004 a manual wall-clock deadline (``time.time() + timeout``) driving
          a sleep loop — use ``resilience.Deadline`` (monotonic, propagates
          through nested calls)
+- RES005 a loop whose broad ``except Exception`` handler swallows with
+         ONLY a log line — no metric increment, no re-raise. A watcher
+         that can fail forever while exporting nothing is invisible to
+         alerting; every swallow-and-continue loop must count its
+         failures (``counter.inc()``) so the failure rate is observable
 """
 
 from __future__ import annotations
@@ -84,6 +89,40 @@ def _mentions_policy(loop: ast.AST) -> bool:
     return any(tok in _src(loop).lower() for tok in _POLICY_TOKENS)
 
 
+def _is_broad_handler(h: ast.ExceptHandler) -> bool:
+    """Bare ``except:`` or ``except (Base)Exception`` (incl. in a tuple)."""
+    if h.type is None:
+        return True
+    types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+    for t in types:
+        if isinstance(t, ast.Name) and t.id in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def _log_only_swallow(h: ast.ExceptHandler) -> bool:
+    """True when the handler body is nothing but logging/pass/continue —
+    no metric ``.inc(``, no ``raise``, no state change the loop can act on."""
+    src = _src(h).lower()
+    if ".inc(" in src or "raise" in src:
+        return False
+    for stmt in h.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            f = stmt.value.func
+            base = ""
+            if isinstance(f, ast.Attribute):
+                v = f.value
+                base = str(getattr(v, "id", getattr(v, "attr", "")))
+            elif isinstance(f, ast.Name):
+                base = f.id
+            if "log" in base.lower() or base == "print":
+                continue
+        return False
+    return True
+
+
 def check_source(text: str, path: str) -> List[Finding]:
     findings: List[Finding] = []
     tree = ast.parse(text, filename=path)
@@ -119,6 +158,20 @@ def check_source(text: str, path: str) -> List[Finding]:
                             f"create_connection(timeout={_src(kw.value)}) — "
                             "constant socket timeout bypasses Deadline.cap",
                         ))
+        # RES005: swallow-without-metric loops (failure invisible forever)
+        if isinstance(node, (ast.While, ast.For)):
+            for inner in ast.walk(node):
+                if (
+                    isinstance(inner, ast.ExceptHandler)
+                    and _is_broad_handler(inner)
+                    and _log_only_swallow(inner)
+                ):
+                    findings.append(Finding(
+                        "RES005", path, inner.lineno,
+                        "loop swallows Exception with only a log line — "
+                        "count the failure (counter.inc()) or re-raise; an "
+                        "un-metered retry loop can fail forever invisibly",
+                    ))
         # RES003 / RES004: ad-hoc retry/poll loops
         if isinstance(node, (ast.While, ast.For)):
             sleep_line = _sleeps(node)

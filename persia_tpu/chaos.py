@@ -308,6 +308,183 @@ class ChaosProxy:
                 return
 
 
+# ------------------------------------------------------- delta-channel chaos
+
+
+class DeltaChannelChaos:
+    """Fault-injecting relay for the incremental delta channel.
+
+    The train-to-serve delta stream (persia_tpu/incremental.py) is a
+    storage directory, not a TCP stream, so :class:`ChaosProxy` cannot
+    damage it. This relay gives each serving replica its OWN delivery
+    directory and copies packets + done-markers from the trainer's source
+    dir into it — with per-delivery faults decided by a SEEDED hash of
+    ``(seed, replica, name)``, so a schedule replays identically:
+
+    - ``corrupt_prob`` — flip one byte inside the packet body (caught by
+      the v2 crc32 frame);
+    - ``truncate_prob`` — deliver a torn prefix (caught by the crc/framing
+      check);
+    - ``drop_prob`` — never deliver the packet (a seq gap at the consumer);
+    - ``set_blackhole(i)`` — stop delivering ANYTHING to replica ``i``
+      (partition: its freshness head freezes and its lag grows until the
+      gateway quarantines it);
+    - :meth:`redeliver` — recopy every retained source file fresh (the
+      consumer's resync path re-fetches from durable storage).
+
+    Damaged deliveries stay damaged until redelivered — exactly how object
+    storage presents a torn upload.
+    """
+
+    def __init__(self, src_dir, base_dir, n_replicas: int,
+                 cfg: Optional[ChaosConfig] = None, seed: int = 0):
+        from persia_tpu.storage import storage_path
+
+        self.src = storage_path(str(src_dir))
+        self.cfg = cfg or ChaosConfig()
+        self.seed = seed if not (cfg and cfg.seed) else cfg.seed
+        self.replica_dirs = [
+            storage_path(str(base_dir)).join(f"replica_{i}")
+            for i in range(n_replicas)
+        ]
+        for d in self.replica_dirs:
+            d.makedirs()
+        self._delivered: List[set] = [set() for _ in range(n_replicas)]
+        self._blackholed: List[bool] = [False] * n_replicas
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.counts: Dict[str, int] = {
+            "delivered": 0, "corrupt": 0, "truncated": 0, "dropped": 0,
+            "blackholed": 0, "redelivered": 0,
+        }
+
+    def inc_dir(self, i: int) -> str:
+        """The delivery directory replica ``i``'s IncrementalLoader scans."""
+        return str(self.replica_dirs[i])
+
+    def set_blackhole(self, i: int, on: bool) -> None:
+        with self._lock:
+            self._blackholed[i] = on
+
+    def _fault_for(self, replica: int, name: str) -> str:
+        """Deterministic per-(replica, delivery) fault draw."""
+        rng = random.Random(f"{self.seed}:{replica}:{name}")
+        r = rng.random()
+        cfg = self.cfg
+        if cfg.corrupt_prob and r < cfg.corrupt_prob:
+            return "corrupt"
+        r -= cfg.corrupt_prob
+        if cfg.truncate_prob and r < cfg.truncate_prob:
+            return "truncated"
+        r -= cfg.truncate_prob
+        # reuse refuse_prob as the drop knob (a refused delivery = a gap)
+        if cfg.refuse_prob and r < cfg.refuse_prob:
+            return "dropped"
+        return "ok"
+
+    def _damage(self, blob: bytes, fault: str, replica: int, name: str) -> bytes:
+        rng = random.Random(f"{self.seed}:damage:{replica}:{name}")
+        if fault == "corrupt" and len(blob) > 40:
+            # flip a byte INSIDE the body (past the 36-byte v2 header): the
+            # point is payload damage only the crc frame can see
+            pos = 40 + rng.randrange(len(blob) - 40)
+            out = bytearray(blob)
+            out[pos] ^= 0xFF
+            return bytes(out)
+        if fault == "truncated":
+            return blob[: max(len(blob) - max(4, len(blob) // 3), 1)]
+        return blob
+
+    def _src_names(self) -> List[str]:
+        """Published packet + done-marker names only — never a publisher's
+        in-flight ``.tmp_*`` file (temp + atomic-rename means those vanish
+        under a concurrent read)."""
+        from persia_tpu.incremental import _MARKER_RE, _PACKET_RE
+        from persia_tpu.storage import StorageError
+
+        try:
+            names = sorted(self.src.list()) if self.src.exists() else []
+        except StorageError:
+            return []
+        return [n for n in names if _PACKET_RE.match(n) or _MARKER_RE.match(n)]
+
+    def pump_once(self) -> int:
+        """Relay every undelivered source file to every non-blackholed
+        replica. Returns deliveries made."""
+        names = self._src_names()
+        made = 0
+        for i, dst in enumerate(self.replica_dirs):
+            with self._lock:
+                if self._blackholed[i]:
+                    self.counts["blackholed"] += 1  # pumps withheld
+                    continue
+                todo = [n for n in names if n not in self._delivered[i]]
+            for name in todo:
+                made += self._deliver(i, dst, name)
+        return made
+
+    def _deliver(self, i: int, dst, name: str, force_clean: bool = False) -> int:
+        from persia_tpu.storage import StorageError
+
+        try:
+            blob = self.src.join(name).read_bytes()
+        except StorageError:
+            return 0  # pruned mid-pump; next scan settles
+        fault = "ok" if force_clean else self._fault_for(i, name)
+        with self._lock:
+            self._delivered[i].add(name)
+            if fault == "dropped":
+                self.counts["dropped"] += 1
+                return 0
+            if fault != "ok":
+                self.counts[fault] += 1
+            self.counts["delivered"] += 1
+        try:
+            dst.join(name).write_bytes(self._damage(blob, fault, i, name))
+        except StorageError:
+            with self._lock:
+                self._delivered[i].discard(name)  # retry next pump
+            return 0
+        return 1
+
+    def redeliver(self, i: int) -> int:
+        """Resync support: recopy every retained source file to replica
+        ``i`` fresh (clean — the durable source is intact; the damage
+        happened in delivery). Clears the delivery memory first so future
+        pumps stay consistent."""
+        names = self._src_names()
+        with self._lock:
+            self._delivered[i].clear()
+        n = 0
+        for name in names:
+            n += self._deliver(i, self.replica_dirs[i], name, force_clean=True)
+        with self._lock:
+            self.counts["redelivered"] += n
+        return n
+
+    def start(self, interval_s: float = 0.2) -> "DeltaChannelChaos":
+        if self._thread is None:
+            def loop():
+                while not self._stop.wait(interval_s):
+                    try:
+                        self.pump_once()
+                    except Exception:  # noqa: BLE001 — relay must survive
+                        logger.exception("delta-channel pump failed")
+
+            self._thread = threading.Thread(
+                target=loop, daemon=True, name="chaos-delta-relay"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
 # ----------------------------------------------------------- trainer kills
 
 

@@ -15,6 +15,17 @@ TPU-first differences: packets reuse the checkpoint shard wire format
 is just ``store.load_shard_bytes`` — entries re-route by sign, which also
 makes packets topology-independent. All IO goes through
 :mod:`persia_tpu.storage` (disk / hdfs:// / gs://).
+
+The **delta channel is chaos-hardened**: v2 packets are crc32-framed and
+carry the publishing trainer's ``train_step`` plus a monotone ``seq``, so a
+consuming replica detects torn/bit-flipped payloads (:class:`
+PacketIntegrityError`), duplicate deliveries (seq high-water mark), and
+sequence gaps (pruned or black-holed packets) — any unrecoverable damage
+raises the loader's ``needs_resync`` flag, and :meth:`IncrementalLoader.
+resync` (or the rollover watcher's checkpoint re-apply) repairs it. Every
+replica exports its **freshness lag** — newest applied train step vs. the
+trainer head, in steps and seconds — which is what the serving gateway's
+staleness-bounded quarantine keys on (persia_tpu/serving/gateway.py).
 """
 
 from __future__ import annotations
@@ -24,6 +35,8 @@ import re
 import struct
 import threading
 import time
+import zlib
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Union
 
 import numpy as np
@@ -36,29 +49,77 @@ logger = get_default_logger("persia_tpu.incremental")
 
 DONE_MARKER = "inc_update_done"
 _PACKET_RE = re.compile(r"^(\d+)_(\d+)\.inc$")
+_MARKER_RE = re.compile(rf"^{DONE_MARKER}\.(\d+)$")
 
-_HEADER = struct.Struct("<4sIQ")  # magic, version, timestamp_us
+_HEADER_V1 = struct.Struct("<4sIQ")  # magic, version, timestamp_us
+# v2 adds the publisher's train step, the packet seq (also in the filename —
+# the header copy survives a rename), and a crc32 over the body so payload
+# damage is detected end-to-end, not just at the transport
+_HEADER_V2 = struct.Struct("<4sIQQQI")  # magic, ver, ts_us, train_step, seq, body_crc
 _MAGIC = b"PINC"
 
 
-def _pack_packet(entries: List[tuple], timestamp_us: int) -> bytes:
+class PacketIntegrityError(ValueError):
+    """The packet failed its crc32 / framing check (torn, bit-flipped, or
+    truncated in the delta channel). Subclasses ``ValueError`` so existing
+    bad-packet handling catches it."""
+
+
+@dataclass
+class PacketMeta:
+    """Parsed packet header."""
+
+    timestamp_us: int
+    train_step: int
+    seq: int
+    version: int
+
+
+def _pack_packet(entries: List[tuple], timestamp_us: int,
+                 train_step: int = 0, seq: int = 0) -> bytes:
     """entries: [(sign, dim, entry_vec)] with entry_vec = [emb | opt state]."""
-    parts = [_HEADER.pack(_MAGIC, 1, timestamp_us), struct.pack("<I", len(entries))]
+    parts = [struct.pack("<I", len(entries))]
     for sign, dim, vec in entries:
         parts.append(struct.pack("<QII", sign, dim, len(vec)))
         parts.append(vec.astype(np.float32).tobytes())
-    return b"".join(parts)
+    body = b"".join(parts)
+    head = _HEADER_V2.pack(
+        _MAGIC, 2, timestamp_us, train_step, seq, zlib.crc32(body) & 0xFFFFFFFF
+    )
+    return head + body
+
+
+def packet_meta(blob: bytes):
+    """Parse + integrity-check a packet. Returns ``(PacketMeta, body)`` —
+    the body is exactly the checkpoint shard wire format, ready for
+    ``store.load_shard_bytes``. Raises :class:`PacketIntegrityError` when a
+    v2 packet's crc32 does not cover its body (torn / corrupt)."""
+    if len(blob) < _HEADER_V1.size:
+        raise PacketIntegrityError("packet shorter than any header")
+    magic, version = struct.unpack_from("<4sI", blob, 0)
+    if magic != _MAGIC:
+        raise ValueError("not an incremental packet")
+    if version == 1:
+        _, _, ts = _HEADER_V1.unpack_from(blob, 0)
+        return PacketMeta(ts, 0, -1, 1), blob[_HEADER_V1.size:]
+    if version != 2:
+        raise ValueError(f"unsupported packet version {version}")
+    if len(blob) < _HEADER_V2.size:
+        raise PacketIntegrityError("torn v2 packet (header truncated)")
+    _, _, ts, step, seq, crc = _HEADER_V2.unpack_from(blob, 0)
+    body = blob[_HEADER_V2.size:]
+    if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
+        raise PacketIntegrityError(
+            f"packet crc mismatch (seq {seq}): torn or corrupt body"
+        )
+    return PacketMeta(ts, step, seq, 2), body
 
 
 def unpack_packet(blob: bytes):
-    """Returns (timestamp_us, shard_format_blob) — the body is exactly the
-    checkpoint shard wire format, ready for ``store.load_shard_bytes``."""
-    magic, version, ts = _HEADER.unpack_from(blob, 0)
-    if magic != _MAGIC:
-        raise ValueError("not an incremental packet")
-    if version != 1:
-        raise ValueError(f"unsupported packet version {version}")
-    return ts, blob[_HEADER.size :]
+    """Returns (timestamp_us, shard_format_blob) — compatibility surface
+    over :func:`packet_meta` (v2 packets are crc-verified here too)."""
+    meta, body = packet_meta(blob)
+    return meta.timestamp_us, body
 
 
 def iter_packet_entries(body: bytes):
@@ -94,6 +155,13 @@ class IncrementalUpdateManager:
     after each gradient batch. Flushing happens on a background thread when
     the dedup buffer crosses ``buffer_size`` (and at ``flush_interval_sec``
     heartbeats), never on the gradient hot path.
+
+    The training loop calls :meth:`note_step` once per step so packets and
+    the done-marker head beacon carry the trainer's committed train step —
+    that beacon is what serving replicas measure freshness lag against. A
+    restarted trainer (crash + jobstate auto-resume) RECOVERS its packet
+    sequence from the directory listing, so replicas' high-water marks stay
+    valid across trainer lives instead of silently ignoring a reset stream.
     """
 
     def __init__(
@@ -104,6 +172,7 @@ class IncrementalUpdateManager:
         buffer_size: int = 1_000_000,
         flush_interval_sec: float = 10.0,
         retain_packets: int = 64,
+        train_step: int = 0,
     ):
         self.store = store
         self.root = storage_path(inc_dir)
@@ -113,7 +182,8 @@ class IncrementalUpdateManager:
         self.retain_packets = retain_packets
         self._pending: List[np.ndarray] = []
         self._pending_count = 0
-        self._seq = 0
+        self._train_step = int(train_step)
+        self._seq = self._recover_seq()
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -122,7 +192,33 @@ class IncrementalUpdateManager:
             "persia_tpu_inc_entries_flushed", "embedding entries shipped incrementally"
         )
 
+    def _recover_seq(self) -> int:
+        """Continue the packet sequence after a trainer restart: a reset
+        stream (seq back to 0) would be invisible to every consumer's
+        high-water mark — their deltas would silently stop applying."""
+        try:
+            names = self.root.list() if self.root.exists() else []
+        except StorageError:
+            return 0
+        top = -1
+        for name in names:
+            m = _PACKET_RE.match(name)
+            if m and int(m.group(1)) == self.replica_index:
+                top = max(top, int(m.group(2)))
+        return top + 1
+
     # ------------------------------------------------------------- train side
+
+    def note_step(self, step: int) -> None:
+        """Record the trainer's committed step (monotone); stamped into the
+        next packet + done marker as the freshness head."""
+        with self._lock:
+            if step > self._train_step:
+                self._train_step = int(step)
+
+    @property
+    def train_step(self) -> int:
+        return self._train_step
 
     def commit(self, signs: np.ndarray) -> None:
         """Record signs touched by a gradient batch (dedup happens at flush)."""
@@ -167,6 +263,7 @@ class IncrementalUpdateManager:
             if not self._pending_count:
                 return 0
             arrays, self._pending, self._pending_count = self._pending, [], 0
+            step = self._train_step
         signs = np.unique(np.concatenate(arrays))
         entries = []
         for s in signs.tolist():
@@ -184,7 +281,7 @@ class IncrementalUpdateManager:
         try:
             self.root.makedirs()
             self.root.join(f"{self.replica_index}_{seq}.inc").write_bytes(
-                _pack_packet(entries, ts)
+                _pack_packet(entries, ts, train_step=step, seq=seq)
             )
         except Exception:
             # requeue so the retry actually retries these signs (otherwise a
@@ -195,11 +292,13 @@ class IncrementalUpdateManager:
                 # the taken seq stays burned: reusing it could overwrite a
                 # packet a concurrent flush shipped in the meantime
             raise
-        # informational marker for operators/external tooling: last shipped
-        # seq + flush time per replica (ref: inc_update_done, lib.rs:283-300).
-        # The loader itself discovers packets by listing, not via this marker.
+        # the head beacon: last shipped seq + flush time + committed train
+        # step per replica (ref: inc_update_done, lib.rs:283-300). Consumers
+        # discover packets by listing; they read THIS to learn the trainer
+        # head their freshness lag is measured against.
         self.root.join(DONE_MARKER + f".{self.replica_index}").write_text(
-            json.dumps({"replica": self.replica_index, "last_seq": seq, "time_us": ts})
+            json.dumps({"replica": self.replica_index, "last_seq": seq,
+                        "time_us": ts, "train_step": step})
         )
         # retention: a serving replica that boots from the latest full
         # checkpoint only needs recent deltas; prune the tail so the dir and
@@ -211,14 +310,40 @@ class IncrementalUpdateManager:
             except StorageError as e:
                 logger.warning("could not prune old packet %d: %s", stale, e)
         self._m_flushed.inc(len(entries))
-        logger.debug("incremental packet %d_%d.inc: %d entries", self.replica_index, seq, len(entries))
+        logger.debug("incremental packet %d_%d.inc: %d entries (step %d)",
+                     self.replica_index, seq, len(entries), step)
         return len(entries)
 
 
 class IncrementalLoader:
     """Infer-side: scan the incremental dir, load unseen packets
     (ref: lib.rs:314-364). Entries re-route by sign on insert, so the serving
-    topology is independent of the training topology."""
+    topology is independent of the training topology.
+
+    Damage handling (the delta channel is assumed hostile — see chaos.py's
+    ``DeltaChannelChaos``):
+
+    - **duplicate** deliveries are skipped by the per-publisher seq
+      high-water mark (applying them would be idempotent anyway — packets
+      carry full entry values — but the skip keeps ordering monotone);
+    - **out-of-order** late deliveries (seq below the mark) are never
+      applied — they would regress entries to stale values;
+    - **torn / bit-flipped** packets fail the crc32 check; the loader holds
+      position (strict per-publisher ordering) and retries once — a chaos
+      relay may redeliver an intact copy — then gives up, skips past, and
+      raises ``needs_resync``;
+    - **gaps** (a seq jump: pruned retention or a black-holed channel) apply
+      what arrived but raise ``needs_resync`` — the skipped packets' signs
+      may never be re-covered by later packets.
+
+    ``needs_resync`` is consumed by :meth:`resync` (clear marks, re-apply
+    the retained tail — callers pairing with a chaos relay redeliver first)
+    or by the rollover watcher, which re-applies the full checkpoint and
+    then resyncs (persia_tpu/serving/rollover.py).
+    """
+
+    #: integrity-failed packets get this many reads before being skipped
+    max_bad_retries = 2
 
     def __init__(
         self,
@@ -243,6 +368,21 @@ class IncrementalLoader:
         # with every packet ever shipped) and makes restarts replay only the
         # retained tail
         self._hwm: Dict[int, int] = {}
+        self._bad: Dict[str, int] = {}  # integrity-failure count per packet
+        # per-publisher seq at the last resync: gaps at/below it are part
+        # of the accepted (already-repaired) base, not new damage
+        self._gap_accepted: Dict[int, int] = {}
+        self.needs_resync = False
+        # freshness state: newest applied (step, publish time) vs. the
+        # trainer head read from the done-marker beacons
+        self.applied_step = -1
+        self.applied_time_us = 0
+        self.head_step = -1
+        self.head_time_us = 0
+        self.stats: Dict[str, int] = {
+            "applied_packets": 0, "corrupt_skipped": 0, "gaps": 0,
+            "stale_dropped": 0, "resyncs": 0,
+        }
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         m = get_metrics()
@@ -253,6 +393,67 @@ class IncrementalLoader:
         self._m_loaded = m.counter(
             "persia_tpu_inc_entries_loaded", "embedding entries applied from packets"
         )
+        self._m_corrupt = m.counter(
+            "persia_tpu_inc_packets_corrupt",
+            "incremental packets skipped on crc/framing failure",
+        )
+        self._m_gaps = m.counter(
+            "persia_tpu_inc_packet_gaps", "seq gaps observed in the delta stream"
+        )
+        self._m_resyncs = m.counter(
+            "persia_tpu_inc_resyncs", "loader resyncs after channel damage"
+        )
+        self._m_lag_steps = m.gauge(
+            "persia_tpu_inc_freshness_lag_steps",
+            "train steps between the trainer head and the newest applied packet",
+        )
+        self._m_lag_sec = m.gauge(
+            "persia_tpu_inc_freshness_lag_seconds",
+            "seconds between the trainer head and the newest applied packet",
+        )
+
+    # ------------------------------------------------------------- freshness
+
+    def _read_head(self, names: List[str]) -> None:
+        for name in names:
+            if not _MARKER_RE.match(name):
+                continue
+            try:
+                info = json.loads(self.root.join(name).read_text())
+            except (StorageError, ValueError):
+                continue  # marker mid-write / damaged: next scan retries
+            step = int(info.get("train_step", -1))
+            ts = int(info.get("time_us", 0))
+            if step > self.head_step:
+                self.head_step = step
+            if ts > self.head_time_us:
+                self.head_time_us = ts
+
+    def freshness(self) -> Dict:
+        """Per-replica freshness snapshot: newest applied train step vs. the
+        trainer head, in steps and seconds. The serving gateway's staleness
+        quarantine keys on these numbers (via /healthz)."""
+        head = max(self.head_step, self.applied_step)
+        lag_steps = max(0, head - self.applied_step) if head >= 0 else 0
+        lag_s = 0.0
+        if lag_steps > 0 and self.head_time_us > self.applied_time_us:
+            lag_s = (self.head_time_us - self.applied_time_us) / 1e6
+        return {
+            "applied_step": self.applied_step,
+            "applied_time_us": self.applied_time_us,
+            "head_step": head,
+            "head_time_us": self.head_time_us,
+            "lag_steps": lag_steps,
+            "lag_seconds": round(lag_s, 3),
+            "needs_resync": self.needs_resync,
+        }
+
+    def _export_freshness(self) -> None:
+        f = self.freshness()
+        self._m_lag_steps.set(float(f["lag_steps"]))
+        self._m_lag_sec.set(float(f["lag_seconds"]))
+
+    # ----------------------------------------------------------------- apply
 
     def poll_once(self) -> int:
         """Scan + apply all unseen packets in (replica, seq) order. Returns
@@ -261,37 +462,100 @@ class IncrementalLoader:
             names = self.root.list() if self.root.exists() else []
         except StorageError:
             return 0
-        todo = []
+        self._read_head(names)
+        per_replica: Dict[int, List] = {}
         for name in names:
             m = _PACKET_RE.match(name)
             if m:
                 replica, seq = int(m.group(1)), int(m.group(2))
                 if seq > self._hwm.get(replica, -1):
-                    todo.append((replica, seq, name))
-        todo.sort()
+                    per_replica.setdefault(replica, []).append((seq, name))
         applied = 0
-        for replica, seq, name in todo:
-            try:
-                ts, body = unpack_packet(self.root.join(name).read_bytes())
-            except (StorageError, ValueError, struct.error) as e:
-                logger.warning("skipping bad incremental packet %s: %s", name, e)
-                self._hwm[replica] = seq  # don't retry a corrupt packet forever
+        for replica in sorted(per_replica):
+            applied += self._apply_replica(replica, sorted(per_replica[replica]))
+        if applied:
+            self._m_loaded.inc(applied)
+        self._export_freshness()
+        return applied
+
+    def _apply_replica(self, replica: int, todo: List) -> int:
+        """Apply one publisher's pending packets in seq order. Stops at the
+        first integrity failure (strict ordering: applying past damage would
+        hide it) until the packet exhausts its retries."""
+        applied = 0
+        for seq, name in todo:
+            if self._bad.get(name, 0) >= self.max_bad_retries:
+                # damaged beyond the retry budget: skip past it so the
+                # stream keeps flowing; resync owns the repair
+                self._hwm[replica] = seq
                 continue
-            if ts < self.skip_before_us:
+            try:
+                meta, body = packet_meta(self.root.join(name).read_bytes())
+            except (StorageError, ValueError, struct.error) as e:
+                self._bad[name] = self._bad.get(name, 0) + 1
+                self.stats["corrupt_skipped"] += 1
+                self._m_corrupt.inc()
+                self.needs_resync = True
+                logger.warning(
+                    "bad incremental packet %s (attempt %d/%d): %s", name,
+                    self._bad[name], self.max_bad_retries, e,
+                )
+                break  # hold position: a redelivery may still repair seq
+            prev = self._hwm.get(replica, -1)
+            # a gap is a seq jump past an ESTABLISHED position — the first
+            # packet ever seen never flags (the head of a retention-pruned
+            # dir), and a post-resync replay never re-flags gaps at or
+            # below the pre-resync mark (a permanently lost packet must
+            # not re-trigger resync forever)
+            if (prev >= 0 and seq > prev + 1
+                    and seq > self._gap_accepted.get(replica, -1)):
+                # seq jump: packets pruned (retention) or black-holed — what
+                # they carried may never re-arrive; flag for resync
+                self.stats["gaps"] += 1
+                self._m_gaps.inc()
+                self.needs_resync = True
+                logger.warning(
+                    "delta-stream gap for publisher %d: %d -> %d", replica,
+                    prev, seq,
+                )
+            if meta.timestamp_us < self.skip_before_us:
                 self._hwm[replica] = seq  # predates our boot checkpoint
+                self.stats["stale_dropped"] += 1
                 continue
             n = self.store.load_shard_bytes(body)
             self._hwm[replica] = seq
             applied += n
+            self.stats["applied_packets"] += 1
+            if meta.train_step > self.applied_step:
+                self.applied_step = meta.train_step
+            if meta.timestamp_us > self.applied_time_us:
+                self.applied_time_us = meta.timestamp_us
             if self.on_apply is not None and n:
                 try:
                     self.on_apply(packet_signs(body))
                 except Exception as e:  # noqa: BLE001 — listener must not stop the scan
                     logger.warning("incremental on_apply hook failed: %s", e)
-            self._m_delay.set(max(0.0, time.time() - ts / 1e6))
-        if applied:
-            self._m_loaded.inc(applied)
+            self._m_delay.set(max(0.0, time.time() - meta.timestamp_us / 1e6))
         return applied
+
+    def resync(self) -> int:
+        """Recover from channel damage: clear the high-water marks and the
+        bad-packet memory, then re-apply everything retained in order.
+        Packets carry full entry values, so re-application is idempotent and
+        converges to the newest value per sign. Callers whose channel is a
+        chaos relay should ``redeliver`` first so damaged copies are
+        replaced; callers with a checkpoint dir should re-apply the
+        checkpoint first (rollover does both — serving/rollover.py).
+        Returns entries applied."""
+        for replica, hwm in self._hwm.items():
+            if hwm > self._gap_accepted.get(replica, -1):
+                self._gap_accepted[replica] = hwm
+        self._hwm.clear()
+        self._bad.clear()
+        self.needs_resync = False
+        self.stats["resyncs"] += 1
+        self._m_resyncs.inc()
+        return self.poll_once()
 
     def start(self) -> "IncrementalLoader":
         if self._thread is None:
@@ -313,6 +577,31 @@ class IncrementalLoader:
                 self.poll_once()
             except Exception as e:  # scanner must survive transient errors
                 logger.warning("incremental scan failed (will retry): %s", e)
+
+
+def read_head(inc_dir: Union[str, StoragePath]):
+    """Read the trainer head — ``(head_step, head_time_us)`` — straight
+    from a delta directory's done-marker beacons. The serving gateway uses
+    this as its ``head_source`` against the DURABLE source dir, so a
+    partition that freezes every replica's local head view cannot also
+    freeze the staleness measurement (the replicas would otherwise all
+    report the same stale head and nobody would look behind)."""
+    root = storage_path(inc_dir)
+    head_step, head_time = -1, 0
+    try:
+        names = root.list() if root.exists() else []
+    except StorageError:
+        return head_step, head_time
+    for name in names:
+        if not _MARKER_RE.match(name):
+            continue
+        try:
+            info = json.loads(root.join(name).read_text())
+        except (StorageError, ValueError):
+            continue
+        head_step = max(head_step, int(info.get("train_step", -1)))
+        head_time = max(head_time, int(info.get("time_us", 0)))
+    return head_step, head_time
 
 
 def attach_incremental(

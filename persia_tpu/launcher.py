@@ -88,6 +88,33 @@ def main(argv: Optional[List[str]] = None) -> int:
     srv.add_argument("--cache-rows", type=int, default=0,
                      help="hot-embedding LRU capacity (0 = no cache)")
 
+    # one-command local train-to-serve topology (persia_tpu/topology.py):
+    # K demo trainers streaming incremental deltas + R serving replicas
+    # consuming them live behind a staleness-aware gateway, with optional
+    # PS/worker services as the discovery fabric
+    loc = sub.add_parser("local", help="one-command local train-to-serve topology")
+    loc.add_argument("--ps", type=int, default=0,
+                     help="parameter-server replicas (0 = no service tier)")
+    loc.add_argument("--workers", type=int, default=0,
+                     help="embedding-worker replicas (needs --ps > 0)")
+    loc.add_argument("--trainers", type=int, default=1)
+    loc.add_argument("--replicas", type=int, default=2)
+    loc.add_argument("--steps", type=int, default=2000,
+                     help="train steps per trainer before it finishes")
+    loc.add_argument("--duration-s", type=float, default=0.0,
+                     help="stop after this long (0 = run until trainers finish)")
+    loc.add_argument("--vocab", type=int, default=100_000)
+    loc.add_argument("--rows", type=int, default=32)
+    loc.add_argument("--step-ms", type=float, default=5.0)
+    loc.add_argument("--ckpt-every", type=int, default=200)
+    loc.add_argument("--flush-every", type=int, default=5)
+    loc.add_argument("--cache-rows", type=int, default=1 << 15)
+    loc.add_argument("--max-staleness-steps", type=int, default=None,
+                     help="quarantine replicas lagging past this many steps")
+    loc.add_argument("--base-dir", type=str, default=None,
+                     help="working directory (default: a fresh tempdir)")
+    loc.add_argument("--seed", type=int, default=7)
+
     # k8s sub-CLI (ref: persia/k8s_utils.py gencrd/operator/server)
     k8s = sub.add_parser("k8s", help="generate/apply k8s manifests + operator")
     k8s.add_argument("action",
@@ -184,6 +211,45 @@ def main(argv: Optional[List[str]] = None) -> int:
             "PERSIA_SERVE_QUEUE_DEPTH": args.queue_depth,
             "PERSIA_SERVE_CACHE_ROWS": args.cache_rows,
         })
+
+    if args.role == "local":
+        import json as _json
+        import time as _time
+
+        from persia_tpu.topology import LocalTopology
+
+        topo = LocalTopology(
+            ps=args.ps, workers=args.workers, trainers=args.trainers,
+            replicas=args.replicas, base_dir=args.base_dir, steps=args.steps,
+            rows=args.rows, vocab=args.vocab, step_ms=args.step_ms,
+            ckpt_every=args.ckpt_every, flush_every=args.flush_every,
+            cache_rows=args.cache_rows,
+            max_staleness_steps=args.max_staleness_steps, seed=args.seed,
+        )
+        with topo:
+            ports = " ".join(f"127.0.0.1:{p}" for p in topo.replica_ports)
+            print(f"local topology up: {args.trainers} trainer(s), "
+                  f"{args.replicas} replica(s) [{ports}]", flush=True)
+            print(f"workdir: {topo.base_dir}", flush=True)
+            t_end = (_time.monotonic() + args.duration_s
+                     if args.duration_s > 0 else None)
+            try:
+                while topo.trainer_running():
+                    if t_end is not None and _time.monotonic() >= t_end:
+                        break
+                    _time.sleep(2.0)
+                    s = topo.stats()
+                    gw = s.get("gateway", {})
+                    print(
+                        f"steps={s['trainer_steps']} head={gw.get('head_step')} "
+                        f"live={len(gw.get('live', []))} "
+                        f"quarantined={gw.get('quarantined', [])}",
+                        flush=True,
+                    )
+            except KeyboardInterrupt:
+                pass
+            print(_json.dumps(topo.stats(), default=str), flush=True)
+        return 0
 
     if args.role == "coordinator":
         from persia_tpu.service.discovery import Coordinator

@@ -262,6 +262,19 @@ class ResiliencePolicy:
     def backoff(self, attempt: int) -> float:
         return self.retry.backoff(attempt)
 
+    def sleep_backoff(self, attempt: int, deadline: Optional[Deadline] = None) -> float:
+        """THE sanctioned inter-attempt sleep: seeded-jitter backoff, capped
+        by the remaining ``Deadline`` budget when one is in flight. Callers
+        in ``service/``+``serving/`` must sleep through here (never a bare
+        ``time.sleep``) so the RES lint rules can see every backoff and the
+        chaos soak can replay it. Returns the seconds actually slept."""
+        d = self.retry.backoff(attempt)
+        if deadline is not None:
+            d = min(d, max(deadline.remaining(), 0.0))
+        if d > 0.0:
+            time.sleep(d)
+        return d
+
 
 def poll_until(
     probe,

@@ -9,9 +9,11 @@ with a subsystem shaped for heavy traffic:
 - :mod:`~persia_tpu.serving.cache` — infer-side hot-embedding LRU keyed by
   sign, invalidated by incremental packets, epoch-cleared on rollover;
 - :mod:`~persia_tpu.serving.gateway` — health-checked replica routing with
-  retry and hedged requests over service discovery;
+  retry, hedged requests, per-replica circuit breakers, and freshness-lag
+  quarantine with staleness-labelled degraded serving;
 - :mod:`~persia_tpu.serving.rollover` — atomic model-version rollover from
-  checkpoint done-markers + ``.inc`` scans;
+  checkpoint done-markers + live ``.inc`` delta consumption with
+  crc-framed integrity + resync repair;
 - :mod:`~persia_tpu.serving.server` — the HTTP replicas
   (:class:`InferenceServer` single-request, :class:`ServingServer` the
   full plane);

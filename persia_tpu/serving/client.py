@@ -80,8 +80,14 @@ class InferenceClient:
 
     def _request(self, method: str, path: str, body: bytes = b"",
                  extra_headers: str = "") -> bytes:
+        return self._request_ex(method, path, body, extra_headers)[0]
+
+    def _request_ex(self, method: str, path: str, body: bytes = b"",
+                    extra_headers: str = ""):
         """One request over the thread's persistent connection; a dead
-        connection retries once on a fresh one (GET/predict are reads)."""
+        connection retries once on a fresh one (GET/predict are reads).
+        Returns ``(data, response_headers)`` — header names lowercased
+        (the serving plane's staleness contract rides ``x-staleness-steps``)."""
         head = (
             f"{method} {path} HTTP/1.1\r\nHost: {self.host}\r\n"
             f"Content-Length: {len(body)}\r\n{extra_headers}\r\n"
@@ -96,12 +102,14 @@ class InferenceClient:
                 status = int(line.split(None, 2)[1])
                 clen = 0
                 close_after = False
+                headers = {}
                 while True:
                     h = conn.rfile.readline(8192)
                     if h in (b"\r\n", b"\n", b""):
                         break
                     k, _, v = h.partition(b":")
                     k = k.strip().lower()
+                    headers[k.decode()] = v.strip().decode()
                     if k == b"content-length":
                         clen = int(v.strip())
                     elif k == b"connection" and v.strip().lower() == b"close":
@@ -120,9 +128,9 @@ class InferenceClient:
                 # keep it; 429/504 are the admission-control contract
                 raise urllib.error.HTTPError(
                     f"{self.base}{path}", status,
-                    data.decode(errors="replace"), {}, io.BytesIO(data),
+                    data.decode(errors="replace"), headers, io.BytesIO(data),
                 )
-            return data
+            return data, headers
         raise ConnectionError("unreachable")  # pragma: no cover
 
     # -------------------------------------------------------------- surface
@@ -133,10 +141,18 @@ class InferenceClient:
 
     def predict_bytes(self, raw: bytes,
                       deadline_ms: Optional[float] = None) -> np.ndarray:
+        return self.predict_bytes_ex(raw, deadline_ms=deadline_ms)[0]
+
+    def predict_bytes_ex(self, raw: bytes,
+                         deadline_ms: Optional[float] = None):
+        """Like :meth:`predict_bytes` but also returns the response headers
+        (lowercased) — the serving replica advertises its freshness lag as
+        ``x-staleness-steps`` there."""
         extra = ""
         if deadline_ms is not None:
             extra = f"X-Deadline-Ms: {float(deadline_ms)}\r\n"
-        return np.load(io.BytesIO(self._request("POST", "/predict", raw, extra)))
+        data, headers = self._request_ex("POST", "/predict", raw, extra)
+        return np.load(io.BytesIO(data)), headers
 
     def health(self) -> dict:
         return json.loads(self._request("GET", "/healthz"))

@@ -1,20 +1,36 @@
-"""Replica gateway: health-checked routing, retry, and hedged requests.
+"""Replica gateway: health-checked routing, retry/hedging, and
+staleness-bounded quarantine.
 
 One serving replica is a single point of failure and a single tail-latency
 distribution. The gateway fronts a replica set — either a static address
 list or a role discovered live from the coordinator
 (persia_tpu/service/discovery.py, the control plane every other tier
-already registers with) — and gives callers three properties:
+already registers with) — and gives callers four properties:
 
 - **health-checked routing**: a background probe loop marks replicas
   up/down from ``/healthz``; requests round-robin over the live set only;
-- **retry with failover**: a transport failure marks the replica down and
-  the request replays on the next live replica (predict is read-only →
-  safe to retry, unlike the training RPC paths);
+- **retry with failover**: a transport failure trips the replica's
+  circuit breaker and the request replays on the next live replica
+  (predict is read-only → safe to retry, unlike the training RPC paths);
 - **hedged requests**: if the primary has not answered within
   ``hedge_after_ms``, the same request fires at a second replica and the
   first answer wins — the classic tail-at-scale move; the straggler's
-  answer is discarded.
+  answer is discarded. Hedge candidates and hedge failures ride the same
+  per-replica breakers as primaries;
+- **staleness quarantine**: each replica's ``/healthz`` reports its
+  freshness lag against the trainer head (persia_tpu/incremental.py); a
+  replica lagging past ``max_staleness_steps`` / ``max_staleness_s`` is
+  *quarantined* — drained from the balance set but kept on health probes,
+  auto-healed when resync catches it up. In-flight requests on a replica
+  entering quarantine are never cancelled (quarantine only changes
+  routing). When EVERY replica is stale the gateway degrades instead of
+  failing: it serves from the least-stale replica and surfaces the
+  replica's ``X-Staleness-Steps`` answer header to the caller — stale
+  scores beat no scores, but only with an explicit label.
+
+Every retry/backoff/breaker decision runs on the SHARED resilience engine
+(``service/resilience.py``) — no hand-rolled sleeps, so the RES lint rules
+and the chaos soak's replay both see all of it.
 """
 
 from __future__ import annotations
@@ -22,7 +38,7 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -51,8 +67,14 @@ class ReplicaGateway:
     clients use): each replica gets a per-endpoint circuit breaker
     (threshold 1, reset = the health interval, so a failed replica leaves
     the rotation immediately and re-enters through a half-open probe),
-    and inter-attempt backoff delays come from the policy's RetryPolicy
-    instead of a hand-rolled loop.
+    and inter-attempt backoff rides ``policy.sleep_backoff``.
+
+    ``max_staleness_steps`` / ``max_staleness_s`` arm the freshness
+    quarantine (None = replicas are never quarantined for lag; replicas
+    that report no ``freshness`` block in /healthz are always exempt).
+    The trainer head is estimated fleet-wide: the max head any replica
+    reports, kept monotone — a black-holed replica cannot shrink the head
+    by reporting its own frozen view.
     """
 
     def __init__(
@@ -65,6 +87,9 @@ class ReplicaGateway:
         request_timeout_s: float = 30.0,
         max_attempts: int = 3,
         policy: Optional[ResiliencePolicy] = None,
+        max_staleness_steps: Optional[int] = None,
+        max_staleness_s: Optional[float] = None,
+        head_source=None,
     ):
         self._clients: Dict[str, InferenceClient] = {}
         self._lock = threading.Lock()
@@ -74,6 +99,13 @@ class ReplicaGateway:
         self.hedge_after_s = max(0.0, hedge_after_ms) / 1e3
         self.request_timeout_s = request_timeout_s
         self.max_attempts = max(1, max_attempts)
+        self.max_staleness_steps = max_staleness_steps
+        self.max_staleness_s = max_staleness_s
+        # optional durable head oracle: () -> (head_step, head_time_us),
+        # e.g. incremental.read_head over the SOURCE delta dir. Without it
+        # the head is the max any replica reports — enough unless a
+        # partition freezes EVERY replica's view at once.
+        self.head_source = head_source
         # serving failover wants immediate replica switches, so the backoff
         # base is tiny; the breaker re-close cadence tracks health probes
         self.policy = policy if policy is not None else ResiliencePolicy(
@@ -86,6 +118,14 @@ class ReplicaGateway:
         self._rr = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # freshness bookkeeping (all guarded by _lock): last /healthz
+        # freshness block per replica, the monotone fleet head estimate,
+        # and the quarantine set + event log the bench records
+        self._freshness: Dict[str, Dict] = {}
+        self._quarantined: set = set()
+        self._head_step = -1
+        self._head_time_us = 0
+        self.quarantine_log: List[Dict] = []
         # hedges need their own threads; 2x a small pool bounds the fan-out
         self._pool = ThreadPoolExecutor(max_workers=16, thread_name_prefix="gw-hedge")
         m = get_metrics()
@@ -101,6 +141,23 @@ class ReplicaGateway:
         self._m_live = m.gauge(
             "persia_tpu_gateway_live_replicas", "replicas currently passing health"
         )
+        self._m_quarantined = m.gauge(
+            "persia_tpu_gateway_quarantined_replicas",
+            "replicas drained for freshness-lag violations",
+        )
+        self._m_quarantines = m.counter(
+            "persia_tpu_gateway_quarantine_events", "replica quarantine entries"
+        )
+        self._m_heals = m.counter(
+            "persia_tpu_gateway_heal_events", "replicas healed out of quarantine"
+        )
+        self._m_stale_served = m.counter(
+            "persia_tpu_gateway_stale_served",
+            "requests served by a quarantined replica (all replicas stale)",
+        )
+        self._m_probe_errors = m.counter(
+            "persia_tpu_gateway_probe_errors", "health probe sweeps that failed"
+        )
         for addr in replicas or []:
             self.add_replica(addr)
 
@@ -114,9 +171,14 @@ class ReplicaGateway:
                 )
 
     def live_replicas(self) -> List[str]:
+        """The balance set: breaker-available AND not staleness-quarantined."""
         with self._lock:
-            addrs = list(self._clients)
+            addrs = [a for a in self._clients if a not in self._quarantined]
         return [a for a in addrs if self.policy.breaker(a).available()]
+
+    def quarantined_replicas(self) -> List[str]:
+        with self._lock:
+            return sorted(self._quarantined)
 
     def _mark_down(self, addr: str) -> None:
         self.policy.breaker(addr).force_open()
@@ -126,8 +188,105 @@ class ReplicaGateway:
         with self._lock:
             total = len(self._clients)
         self._m_live.set(len(self.live_replicas()) if total else 0)
+        self._m_quarantined.set(len(self._quarantined))
+
+    # ------------------------------------------------------------- freshness
+
+    def _lag_of(self, fresh: Dict) -> Tuple[int, float]:
+        """A replica's lag against the FLEET head estimate (steps, seconds).
+        Using the fleet head — not the replica's own — is what makes a
+        black-holed replica (whose local head view is frozen along with its
+        applied state) quarantinable at all."""
+        applied = int(fresh.get("applied_step", -1))
+        lag_steps = max(0, self._head_step - applied) if self._head_step >= 0 else 0
+        applied_us = int(fresh.get("applied_time_us", 0))
+        lag_s = 0.0
+        if lag_steps > 0 and self._head_time_us > applied_us:
+            lag_s = (self._head_time_us - applied_us) / 1e6
+        return lag_steps, lag_s
+
+    def _over_bound(self, lag_steps: int, lag_s: float) -> bool:
+        if self.max_staleness_steps is not None and lag_steps > self.max_staleness_steps:
+            return True
+        if self.max_staleness_s is not None and lag_s > self.max_staleness_s:
+            return True
+        return False
+
+    def _eval_quarantine(self, addr: str, fresh: Optional[Dict]) -> None:
+        """Quarantine/heal one replica from its latest freshness report.
+        Caller does NOT hold the lock."""
+        with self._lock:
+            if fresh is None:
+                # no freshness contract → exempt (and heal a stale record:
+                # a replica that dropped its delta channel stops being
+                # judged on it)
+                self._freshness.pop(addr, None)
+                if addr in self._quarantined:
+                    self._quarantined.discard(addr)
+                    self._log_event("heal", addr, 0, 0.0)
+                return
+            self._freshness[addr] = fresh
+            if int(fresh.get("head_step", -1)) > self._head_step:
+                self._head_step = int(fresh["head_step"])
+            if int(fresh.get("head_time_us", 0)) > self._head_time_us:
+                self._head_time_us = int(fresh["head_time_us"])
+            lag_steps, lag_s = self._lag_of(fresh)
+            over = self._over_bound(lag_steps, lag_s)
+            if over and addr not in self._quarantined:
+                self._quarantined.add(addr)
+                self._log_event("quarantine", addr, lag_steps, lag_s)
+            elif not over and addr in self._quarantined:
+                self._quarantined.discard(addr)
+                self._log_event("heal", addr, lag_steps, lag_s)
+
+    def _log_event(self, action: str, addr: str, lag_steps: int, lag_s: float) -> None:
+        """Record + count a quarantine transition. Caller holds the lock."""
+        self.quarantine_log.append({
+            "action": action, "replica": addr, "lag_steps": lag_steps,
+            "lag_seconds": round(lag_s, 3), "time": time.time(),
+        })
+        if action == "quarantine":
+            self._m_quarantines.inc()
+            logger.warning("replica %s quarantined (lag %d steps / %.2fs)",
+                           addr, lag_steps, lag_s)
+        else:
+            self._m_heals.inc()
+            logger.info("replica %s healed (lag %d steps)", addr, lag_steps)
+
+    def staleness_of(self, addr: str) -> int:
+        """Current lag estimate in steps for one replica (0 = fresh/unknown)."""
+        with self._lock:
+            fresh = self._freshness.get(addr)
+            return self._lag_of(fresh)[0] if fresh else 0
+
+    def freshness_view(self) -> Dict[str, Dict]:
+        """Every replica's lag against the FLEET head (the gateway's honest
+        view — a black-holed replica's self-report reads fresh because its
+        head view froze along with its applied state)."""
+        with self._lock:
+            out = {}
+            for addr, fresh in self._freshness.items():
+                steps, secs = self._lag_of(fresh)
+                out[addr] = {
+                    "lag_steps": steps,
+                    "lag_seconds": round(secs, 3),
+                    "quarantined": addr in self._quarantined,
+                }
+            return out
+
+    # ----------------------------------------------------------------- probes
 
     def _probe_all(self) -> None:
+        if self.head_source is not None:
+            try:
+                hs, ht = self.head_source()
+                with self._lock:
+                    if int(hs) > self._head_step:
+                        self._head_step = int(hs)
+                    if int(ht) > self._head_time_us:
+                        self._head_time_us = int(ht)
+            except Exception as e:  # noqa: BLE001 — oracle outage ≠ gateway outage
+                logger.warning("head_source read failed: %s", e)
         if self._coordinator is not None:
             try:
                 for addr in self._coordinator.list(self._role):
@@ -137,13 +296,20 @@ class ReplicaGateway:
         with self._lock:
             addrs = list(self._clients)
         for addr in addrs:
+            fresh = None
             try:
-                ok = self._clients[addr].health().get("status") == "ok"
+                h = self._clients[addr].health()
+                ok = h.get("status") == "ok"
+                fresh = h.get("freshness")
             except Exception:  # noqa: BLE001 — any probe failure = down
                 ok = False
             b = self.policy.breaker(addr)
             if ok:
                 b.on_success()
+                # quarantine is evaluated on every probe — including for
+                # breaker-open replicas that just recovered — so healing
+                # needs no request traffic, only probes
+                self._eval_quarantine(addr, fresh)
             else:
                 b.force_open()
         self._update_live_gauge()
@@ -169,6 +335,7 @@ class ReplicaGateway:
             try:
                 self._probe_all()
             except Exception as e:  # noqa: BLE001 — prober must survive
+                self._m_probe_errors.inc()
                 logger.warning("health probe sweep failed: %s", e)
 
     # --------------------------------------------------------------- routing
@@ -181,54 +348,102 @@ class ReplicaGateway:
             self._rr += 1
             return live[self._rr % len(live)]
 
+    def _pick_stale_fallback(self, exclude: set) -> Optional[str]:
+        """All-replicas-stale degradation: the least-stale quarantined
+        replica whose breaker still answers. Explicitly labelled service
+        beats an outage — PR 3's degraded-lookup trade, at the gateway."""
+        with self._lock:
+            cands = [a for a in self._quarantined if a not in exclude]
+            cands = sorted(
+                cands,
+                key=lambda a: self._lag_of(self._freshness[a])[0]
+                if a in self._freshness else 0,
+            )
+        for a in cands:
+            if self.policy.breaker(a).available():
+                return a
+        return None
+
     def predict(self, batch: PersiaBatch, deadline_ms: Optional[float] = None) -> np.ndarray:
         return self.predict_bytes(batch.to_bytes(), deadline_ms=deadline_ms)
 
     def predict_bytes(self, raw: bytes, deadline_ms: Optional[float] = None) -> np.ndarray:
+        return self.predict_bytes_ex(raw, deadline_ms=deadline_ms)[0]
+
+    def predict_bytes_ex(
+        self, raw: bytes, deadline_ms: Optional[float] = None
+    ) -> Tuple[np.ndarray, Dict]:
         """Route one request: round-robin primary, hedge after
         ``hedge_after_s``, fail over on error up to ``max_attempts``
-        distinct replicas."""
+        distinct replicas; when every fresh replica is gone, degrade onto
+        the least-stale quarantined one. Returns ``(scores, info)`` where
+        ``info`` carries ``staleness_steps`` (the serving replica's
+        ``X-Staleness-Steps`` answer) and ``stale_fallback``."""
         self._m_requests.inc()
         tried: set = set()
         last: Optional[Exception] = None
+        stale_fallback = False
         for attempt in range(self.max_attempts):
             addr = self._pick(tried)
             if addr is None:
-                break
+                addr = self._pick_stale_fallback(tried)
+                if addr is None:
+                    break
+                stale_fallback = True
             tried.add(addr)
             if attempt:
                 self._m_retries.inc()
-                # failover backoff rides the shared RetryPolicy (tiny base:
+                # failover backoff rides the shared engine (tiny base:
                 # serving wants an immediate replica switch, but repeated
                 # failures should not hot-spin the fleet)
-                time.sleep(self.policy.backoff(attempt - 1))
+                self.policy.sleep_backoff(attempt - 1)
             try:
-                return self._one_attempt(addr, raw, tried, deadline_ms)
+                scores, headers = self._one_attempt(addr, raw, tried, deadline_ms)
             except Exception as e:  # noqa: BLE001 — classify then fail over
                 last = e
-                self._mark_down(addr)
+                self.policy.breaker(addr).on_failure()
+                self._update_live_gauge()
                 logger.warning("replica %s failed (%s); failing over", addr, e)
+                continue
+            # the staleness answer is max(replica self-report, gateway fleet
+            # view): a partitioned replica reads locally fresh — only the
+            # gateway's head estimate exposes how far behind it really is
+            info = {
+                "replica": addr,
+                "staleness_steps": max(
+                    int(headers.get("x-staleness-steps", 0)),
+                    self.staleness_of(addr),
+                ),
+                "stale_fallback": stale_fallback,
+            }
+            if stale_fallback:
+                self._m_stale_served.inc()
+            return scores, info
         raise NoReplicaAvailableError(
             f"no live replica answered (tried {sorted(tried) or 'none'})"
         ) from last
 
     def _one_attempt(
         self, addr: str, raw: bytes, tried: set, deadline_ms: Optional[float]
-    ) -> np.ndarray:
+    ) -> Tuple[np.ndarray, Dict]:
         """Primary request with a hedge: fire ``addr``, and if it has not
         answered within ``hedge_after_s`` fire one more replica; first
-        success wins, the straggler is abandoned to its own timeout."""
+        success wins, the straggler is abandoned to its own timeout. Both
+        the primary and the hedge settle their replica's breaker."""
         client = self._clients[addr]
-        primary = self._pool.submit(client.predict_bytes, raw, deadline_ms)
+        primary = self._pool.submit(client.predict_bytes_ex, raw, deadline_ms)
         futures = {primary: addr}
         done, _ = wait([primary], timeout=self.hedge_after_s,
                        return_when=FIRST_COMPLETED)
         if not done:
             hedge_addr = self._pick(tried | set(futures.values()))
-            if hedge_addr is not None:
+            # the hedge consumes the target's breaker probe slot like any
+            # real call: a half-open replica admits ONE probe, and a hedge
+            # must not slip past that gate
+            if hedge_addr is not None and self.policy.breaker(hedge_addr).allow():
                 self._m_hedges.inc()
                 futures[self._pool.submit(
-                    self._clients[hedge_addr].predict_bytes, raw, deadline_ms
+                    self._clients[hedge_addr].predict_bytes_ex, raw, deadline_ms
                 )] = hedge_addr
         pending = set(futures)
         first_error: Optional[Exception] = None
@@ -239,8 +454,31 @@ class ReplicaGateway:
                 break
             for f in done:
                 try:
-                    return f.result()
+                    scores, headers = f.result()
                 except Exception as e:  # noqa: BLE001 — maybe the hedge wins
                     first_error = first_error or e
-                    self._mark_down(futures[f])
+                    self.policy.breaker(futures[f]).on_failure()
+                else:
+                    self.policy.breaker(futures[f]).on_success()
+                    return scores, headers
         raise first_error or TimeoutError(f"no answer from {addr} within timeout")
+
+    # ------------------------------------------------------------------ stats
+
+    def stats(self) -> Dict:
+        with self._lock:
+            quarantined = sorted(self._quarantined)
+            head = self._head_step
+        return {
+            "replicas": sorted(self._clients),
+            "live": self.live_replicas(),
+            "quarantined": quarantined,
+            "head_step": head,
+            "requests": self._m_requests.get(),
+            "retries": self._m_retries.get(),
+            "hedges": self._m_hedges.get(),
+            "quarantine_events": self._m_quarantines.get(),
+            "heal_events": self._m_heals.get(),
+            "stale_served": self._m_stale_served.get(),
+            "breaker_states": self.policy.breaker_states(),
+        }

@@ -17,6 +17,14 @@ restart or a dropped request:
   checkpoint are skipped via ``skip_before_us`` (the marker records its
   ``time_us`` for exactly this).
 
+Failure handling runs ON the shared resilience engine
+(service/resilience.py): a checkpoint that fails to apply retries through
+the policy's seeded backoff before being abandoned, every failure counts
+into the ``persia_tpu_serving_rollover_failures`` counter, and a delta
+channel that reports unrecoverable damage (``needs_resync``) triggers a
+**resync**: re-apply the newest full checkpoint (when one exists), then
+replay the retained packet tail from a clean high-water mark.
+
 The swap is wait-free for readers (one handle assignment, see
 serving/engine.py); the expensive work — storage reads, flax
 deserialization — happens on the watcher thread.
@@ -31,6 +39,7 @@ from typing import Dict, Optional, Union
 from persia_tpu.checkpoint import DONE_MARKER
 from persia_tpu.logger import get_default_logger
 from persia_tpu.metrics import get_metrics
+from persia_tpu.service.resilience import ResiliencePolicy, RetryPolicy, poll_until
 from persia_tpu.serving.engine import InferenceEngine, clone_infer_ctx
 from persia_tpu.storage import StorageError, StoragePath, storage_path
 
@@ -39,22 +48,30 @@ logger = get_default_logger("persia_tpu.serving.rollover")
 
 class ModelRollover:
     """Tie a serving engine to a checkpoint dir (full rollovers) and an
-    incremental dir (live deltas)."""
+    incremental dir (live deltas). ``ckpt_dir=None`` runs a delta-only
+    watcher (freshness + packet apply, resync from the retained tail)."""
 
     def __init__(
         self,
         engine: InferenceEngine,
-        ckpt_dir: Union[str, StoragePath],
+        ckpt_dir: Optional[Union[str, StoragePath]] = None,
         inc_dir: Optional[Union[str, StoragePath]] = None,
         cache=None,
         poll_interval_s: float = 2.0,
         inc_scan_interval_s: Optional[float] = None,
+        policy: Optional[ResiliencePolicy] = None,
     ):
         self.engine = engine
-        self.root = storage_path(ckpt_dir)
+        self.root = storage_path(ckpt_dir) if ckpt_dir is not None else None
         self.cache = cache
         self.poll_interval_s = poll_interval_s
+        # apply/initial-poll retries ride the shared engine; rollover wants
+        # patient backoff (storage may be mid-publish), not serving-fast
+        self.policy = policy if policy is not None else ResiliencePolicy(
+            retry=RetryPolicy(max_attempts=3, base_s=0.05, max_s=1.0)
+        )
         self._seen_session: Optional[str] = None
+        self._new_state = None  # staged by _apply_session for the swap
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._inc_loader = None
@@ -76,6 +93,10 @@ class ModelRollover:
         self._m_failed = m.counter(
             "persia_tpu_serving_rollover_failures", "rollovers that failed to apply"
         )
+        self._m_resyncs = m.counter(
+            "persia_tpu_serving_resyncs",
+            "full resyncs after delta-channel damage",
+        )
 
     # ----------------------------------------------------------------- state
 
@@ -83,7 +104,12 @@ class ModelRollover:
     def version(self) -> str:
         return self.engine.version
 
+    def freshness(self) -> Optional[Dict]:
+        return self._inc_loader.freshness() if self._inc_loader else None
+
     def _read_marker(self) -> Optional[Dict]:
+        if self.root is None:
+            return None
         try:
             return json.loads(self.root.join(DONE_MARKER).read_text())
         except (OSError, ValueError, StorageError):
@@ -93,8 +119,9 @@ class ModelRollover:
 
     def poll_once(self) -> bool:
         """One watcher tick: apply a new checkpoint if the done-marker moved,
-        then drain unseen incremental packets. Returns True iff a full
-        rollover was applied."""
+        then drain unseen incremental packets, then repair any channel
+        damage the drain reported. Returns True iff a full rollover was
+        applied."""
         rolled = False
         info = self._read_marker()
         if info is not None:
@@ -104,45 +131,104 @@ class ModelRollover:
                 rolled = True
         if self._inc_loader is not None:
             self._inc_loader.poll_once()
+            if self._inc_loader.needs_resync:
+                self._resync(info)
         return rolled
 
-    def _apply_checkpoint(self, info: Dict, session: str) -> None:
+    def _resync(self, info: Optional[Dict]) -> None:
+        """Delta-channel damage repair: re-apply the newest checkpoint (the
+        authoritative base — a gap's lost signs may exist nowhere else),
+        then replay the retained packet tail from clean marks."""
+        self._m_resyncs.inc()
+        if info is not None and self._seen_session is not None:
+            logger.warning(
+                "delta channel damaged: resyncing from checkpoint %s",
+                self._seen_session,
+            )
+            try:
+                self._apply_session(info)
+            except Exception as e:  # noqa: BLE001 — resync retries next tick
+                self._m_failed.inc()
+                logger.warning("resync checkpoint re-apply failed: %s", e)
+                return
+        else:
+            logger.warning(
+                "delta channel damaged: no checkpoint — replaying the "
+                "retained packet tail"
+            )
+        if self.cache is not None:
+            self.cache.bump_epoch()
+        self._inc_loader.resync()
+
+    def _apply_session(self, info: Dict) -> None:
+        """The load half of a rollover: dense deserialize + in-place sparse
+        load. Raises on failure (caller owns retry/abandon policy)."""
         import flax.serialization
 
         from persia_tpu.checkpoint import load_dense
 
         ctx = self.engine.ctx
-        try:
-            # dense half: deserialize into a fresh state off the request path
-            new_state = ctx.state
-            raw = load_dense(self.root, missing_ok=True)
-            if raw is not None:
-                new_state = flax.serialization.from_bytes(ctx.state, raw)
-            # sparse half: in-place load on the shared store (entries re-route
-            # by sign; concurrent lookups stay valid under the shard locks)
-            ctx.worker.load(str(self.root))
-        except Exception as e:  # noqa: BLE001 — a bad dump must not kill serving
-            self._m_failed.inc()
-            logger.exception("rollover to session %s failed: %s", session, e)
-            self._seen_session = session  # don't retry a broken dump forever
-            return
+        new_state = ctx.state
+        raw = load_dense(self.root, missing_ok=True)
+        if raw is not None:
+            new_state = flax.serialization.from_bytes(ctx.state, raw)
+        # sparse half: in-place load on the shared store (entries re-route
+        # by sign; concurrent lookups stay valid under the shard locks)
+        ctx.worker.load(str(self.root))
+        self._new_state = new_state
+
+    def _apply_checkpoint(self, info: Dict, session: str) -> None:
+        attempts = max(1, self.policy.retry.max_attempts)
+        for attempt in range(attempts):
+            try:
+                self._apply_session(info)
+                break
+            except Exception as e:  # noqa: BLE001 — a bad dump must not kill serving
+                self._m_failed.inc()
+                logger.exception(
+                    "rollover to session %s failed (attempt %d/%d): %s",
+                    session, attempt + 1, attempts, e,
+                )
+                if attempt + 1 >= attempts:
+                    # storage answered but the dump is broken: a fresh dump
+                    # gets a fresh session id, so don't retry this one forever
+                    self._seen_session = session
+                    return
+                self.policy.sleep_backoff(attempt)
         if self.cache is not None:
             self.cache.bump_epoch()
         if self._inc_loader is not None:
             # packets older than this checkpoint must not regress its entries
             self._inc_loader.skip_before_us = int(info.get("time_us", 0))
+            # the checkpoint IS an applied state: replicas resynced from it
+            # report its step as their floor (trainer-annotated markers)
+            step = int(info.get("train_step", -1))
+            if step > self._inc_loader.applied_step:
+                self._inc_loader.applied_step = step
+                self._inc_loader.applied_time_us = max(
+                    self._inc_loader.applied_time_us, int(info.get("time_us", 0))
+                )
         self._seen_session = session
         self._m_version_ts.set(float(info.get("time_us", 0)))
-        self.engine.swap(clone_infer_ctx(ctx, new_state), version=session)
+        self.engine.swap(clone_infer_ctx(self.engine.ctx, self._new_state),
+                         version=session)
 
     # --------------------------------------------------------------- thread
 
     def start(self) -> "ModelRollover":
-        # synchronous first poll: a server started against an existing
-        # checkpoint dir is versioned before it takes traffic
+        # synchronous first poll through the policy engine: a server started
+        # against an existing checkpoint dir is versioned before it takes
+        # traffic, and a storage hiccup retries on seeded backoff instead of
+        # silently serving unversioned
         try:
-            self.poll_once()
-        except Exception as e:  # noqa: BLE001
+            poll_until(
+                lambda: (self.poll_once() or True),
+                timeout_s=max(2 * self.poll_interval_s, 5.0),
+                policy=self.policy,
+                what="initial rollover poll",
+            )
+        except Exception as e:  # noqa: BLE001 — serve cold; the loop keeps trying
+            self._m_failed.inc()
             logger.warning("initial rollover poll failed: %s", e)
         if self._thread is None:
             self._thread = threading.Thread(
@@ -162,6 +248,7 @@ class ModelRollover:
             try:
                 self.poll_once()
             except Exception as e:  # noqa: BLE001 — watcher must survive
+                self._m_failed.inc()
                 logger.warning("rollover poll failed (will retry): %s", e)
 
 

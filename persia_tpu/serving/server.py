@@ -99,16 +99,26 @@ class _LeanHandler(socketserver.StreamRequestHandler):
                     headers[k.strip().lower().decode()] = v.strip().decode()
                 n = int(headers.get("content-length", 0))
                 body = self.rfile.read(n) if n else b""
+                extra = {}
                 try:
-                    status, payload, ctype = self.route(
+                    routed = self.route(
                         method.decode(), path.decode(), headers, body
                     )
+                    # 3-tuple or (status, payload, ctype, extra_headers) —
+                    # the 4th slot carries per-response contract headers
+                    # (X-Staleness-Steps on /predict)
+                    if len(routed) == 4:
+                        status, payload, ctype, extra = routed
+                    else:
+                        status, payload, ctype = routed
                 except Exception:  # noqa: BLE001 — route() maps its own errors
                     logger.exception("unhandled route error")
                     status, payload, ctype = 500, b"internal error", "text/plain"
+                extra_lines = "".join(f"{k}: {v}\r\n" for k, v in extra.items())
                 head = (
                     f"HTTP/1.1 {status} {_STATUS_TEXT.get(status, 'Status')}\r\n"
                     f"Content-Type: {ctype}\r\n"
+                    f"{extra_lines}"
                     f"Content-Length: {len(payload)}\r\n\r\n"
                 ).encode()
                 self.wfile.write(head + payload)
@@ -239,9 +249,12 @@ class ServingServer:
             max_wait_ms=max_wait_ms,
             queue_depth=queue_depth,
         )
-        if ckpt_dir is not None:
+        if ckpt_dir is not None or inc_dir is not None:
             from persia_tpu.serving.rollover import ModelRollover
 
+            # inc_dir alone is valid: a delta-only replica (no full
+            # checkpoints) still consumes the live stream and reports
+            # freshness; resync then replays the retained packet tail
             self.rollover = ModelRollover(
                 self.engine, ckpt_dir, inc_dir=inc_dir, cache=self.cache,
                 poll_interval_s=rollover_poll_s,
@@ -273,7 +286,15 @@ class ServingServer:
                     except Exception as e:  # noqa: BLE001 — app error crosses the wire
                         logger.exception("predict failed")
                         return 400, repr(e).encode(), "text/plain"
-                    return 200, _npy_bytes(scores), "application/octet-stream"
+                    # staleness contract: every answer states how far behind
+                    # the trainer head it was computed, so a caller (or the
+                    # gateway's all-replicas-stale fallback) can judge it
+                    extra = {}
+                    f = outer.freshness()
+                    if f is not None:
+                        extra["X-Staleness-Steps"] = str(int(f["lag_steps"]))
+                    return (200, _npy_bytes(scores),
+                            "application/octet-stream", extra)
                 if method == "GET" and path == "/healthz":
                     return (200, json.dumps(outer.health()).encode(),
                             "application/json")
@@ -289,6 +310,14 @@ class ServingServer:
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
+    def freshness(self):
+        """Freshness snapshot from the armed incremental loader (None when
+        the replica has no delta channel — such a replica is exempt from
+        staleness quarantine: there is nothing to lag behind)."""
+        if self.rollover is not None and self.rollover._inc_loader is not None:
+            return self.rollover._inc_loader.freshness()
+        return None
+
     def health(self) -> dict:
         h = {
             "status": "ok",
@@ -298,6 +327,9 @@ class ServingServer:
         }
         if self.cache is not None:
             h["cache"] = self.cache.stats()
+        f = self.freshness()
+        if f is not None:
+            h["freshness"] = f
         return h
 
     def start(self) -> "ServingServer":

@@ -38,13 +38,15 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_native_feed.py -q
 # SANITIZE_ASAN rides the same script when PREFLIGHT_ASAN=1
 SANITIZE_ASAN="${PREFLIGHT_ASAN:-0}" bash scripts/sanitize_native.sh
 
-echo "== 1/5 chaos suite (fast schedules + resume-chaos) =="
+echo "== 1/5 chaos suite (fast schedules + resume-chaos + serving-chaos) =="
 # deterministic fault injection against live local services: proxies,
 # breakers, crc integrity, degraded-mode router, pending-ledger salts —
 # plus the fast resume-chaos runs (trainer-kill/resume bit-parity for the
-# hybrid ctx, the cached stream fence, and the RPC journal wire); the
-# full kill+resets and trainer-SIGKILL bitwise runs ride the slow suite
-JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_failure_recovery.py tests/test_jobstate.py -q -m 'not slow'
+# hybrid ctx, the cached stream fence, and the RPC journal wire) and the
+# fast serving-chaos subset (staleness quarantine/heal + delta-packet
+# integrity/resync); the full kill+resets, trainer-SIGKILL bitwise runs,
+# and the zipfian online soak (benchmarks/online_bench.py) ride slow
+JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_failure_recovery.py tests/test_jobstate.py tests/test_serving_chaos.py tests/test_incremental.py -q -m 'not slow'
 
 echo "== 2/5 test suite =="
 python -m pytest tests/ -q

@@ -1,0 +1,13 @@
+"""RES005 seed: a watcher loop that swallows every failure with only a log
+line — no metric, no re-raise; it can fail forever and nobody will know."""
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def watch(poll):
+    while True:
+        try:
+            poll()
+        except Exception as e:  # broad swallow, log-only
+            logger.warning("poll failed (will retry): %s", e)

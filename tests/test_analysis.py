@@ -176,11 +176,64 @@ def test_conc_correct_patterns_stay_silent():
         ("res_raw_timeout.py", "RES002"),
         ("res_adhoc_retry.py", "RES003"),
         ("res_manual_deadline.py", "RES004"),
+        ("res_swallow_no_metric.py", "RES005"),
     ],
 )
 def test_resilience_rule_fires(fixture, rule):
     findings = resilience_lint.check_source(read_text(_fixture(fixture)), fixture)
     assert rule in {f.rule for f in findings}, findings
+
+
+def test_res005_metered_or_reraising_loops_are_allowed():
+    # counting the failure makes the swallow observable — compliant
+    metered = (
+        "import logging\n"
+        "logger = logging.getLogger(__name__)\n"
+        "def watch(poll, m_failed):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            poll()\n"
+        "        except Exception as e:\n"
+        "            m_failed.inc()\n"
+        "            logger.warning('poll failed: %s', e)\n"
+    )
+    assert resilience_lint.check_source(metered, "metered.py") == []
+    # re-raising is not a swallow
+    reraising = (
+        "def watch(poll):\n"
+        "    for _ in range(3):\n"
+        "        try:\n"
+        "            return poll()\n"
+        "        except Exception:\n"
+        "            raise\n"
+    )
+    assert resilience_lint.check_source(reraising, "reraising.py") == []
+    # narrow exception classes are a deliberate contract, not a swallow
+    narrow = (
+        "import logging\n"
+        "logger = logging.getLogger(__name__)\n"
+        "def watch(poll):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            poll()\n"
+        "        except (OSError, ValueError) as e:\n"
+        "            logger.warning('transient: %s', e)\n"
+    )
+    assert resilience_lint.check_source(narrow, "narrow.py") == []
+
+
+def test_res005_handler_with_state_change_is_allowed():
+    # the handler feeds the loop's control state — the failure is acted on
+    src = (
+        "def drain(fetch):\n"
+        "    bad = 0\n"
+        "    while True:\n"
+        "        try:\n"
+        "            fetch()\n"
+        "        except Exception:\n"
+        "            bad += 1\n"
+    )
+    assert resilience_lint.check_source(src, "stateful.py") == []
 
 
 def test_resilience_policy_driven_loop_is_allowed():

@@ -1,6 +1,9 @@
 """Incremental update manager/loader tests (ref:
 persia-incremental-update-manager/src/lib.rs — train-side packet dumps,
-infer-side scanning, delay gauge)."""
+infer-side scanning, delay gauge) + the chaos-hardened delta channel:
+crc32 packet integrity (torn / bit-flipped), duplicate + out-of-order
+delivery, seq-gap detection, resync convergence, and freshness-lag
+tracking against the trainer head."""
 
 import numpy as np
 import pytest
@@ -10,7 +13,10 @@ from persia_tpu.embedding.store import EmbeddingStore
 from persia_tpu.incremental import (
     IncrementalLoader,
     IncrementalUpdateManager,
+    PacketIntegrityError,
     attach_incremental,
+    packet_meta,
+    read_head,
     unpack_packet,
 )
 from persia_tpu.metrics import get_metrics
@@ -114,8 +120,14 @@ def test_bad_packet_skipped(tmp_path):
     root.join("0_0.inc").write_bytes(b"garbage-not-a-packet")
     dst = EmbeddingStore(capacity=64, num_internal_shards=1)
     loader = IncrementalLoader(dst, str(tmp_path))
+    # first reads hold position (a redelivery may repair the packet)...
+    for _ in range(loader.max_bad_retries):
+        assert loader.poll_once() == 0
+    assert loader.needs_resync
+    # ...then the retry budget exhausts and the stream skips past it
     assert loader.poll_once() == 0
     assert loader._hwm[0] == 0  # not retried forever
+    assert loader.stats["corrupt_skipped"] == loader.max_bad_retries
 
 
 def test_retention_prunes_old_packets(tmp_path):
@@ -331,3 +343,216 @@ def test_cached_tier_publish_ships_resident_signs(tmp_path):
             assert all(np.isfinite(l) for l in loss_after_publish)
     finally:
         mgr.stop()
+
+
+# ----------------------------------------------- chaos-hardened delta channel
+
+
+def _entries_of(store, signs):
+    return np.stack([store.get_embedding_entry(int(s)) for s in signs])
+
+
+def _stream_packets(src, mgr, rounds, start_sign=1, per=3):
+    """``rounds`` flushes of ``per`` fresh signs each; step advances by 1
+    per flush. Returns every sign touched."""
+    touched = []
+    for r in range(rounds):
+        signs = np.arange(start_sign + r * per, start_sign + (r + 1) * per,
+                          dtype=np.uint64)
+        _touch(src, signs)
+        mgr.commit(signs)
+        mgr.note_step(mgr.train_step + 1)
+        assert mgr.flush() == per
+        touched.extend(signs.tolist())
+    return np.asarray(touched, dtype=np.uint64)
+
+
+def test_packet_v2_meta_and_crc_roundtrip(tmp_path):
+    src = _train_store()
+    mgr = IncrementalUpdateManager(src, str(tmp_path), train_step=41)
+    _touch(src, [1, 2])
+    mgr.commit(np.array([1, 2], dtype=np.uint64))
+    mgr.note_step(42)
+    assert mgr.flush() == 2
+    blob = mgr.root.join("0_0.inc").read_bytes()
+    meta, body = packet_meta(blob)
+    assert meta.version == 2 and meta.seq == 0 and meta.train_step == 42
+    # unpack_packet stays compatible (and crc-verifies)
+    ts, body2 = unpack_packet(blob)
+    assert ts == meta.timestamp_us and body2 == body
+
+
+def test_bitflipped_packet_detected_and_skipped(tmp_path):
+    src = _train_store()
+    mgr = IncrementalUpdateManager(src, str(tmp_path))
+    _stream_packets(src, mgr, rounds=1)
+    p = mgr.root.join("0_0.inc")
+    blob = bytearray(p.read_bytes())
+    blob[-3] ^= 0xFF  # flip a byte inside the body
+    p.write_bytes(bytes(blob))
+    with pytest.raises(PacketIntegrityError):
+        packet_meta(bytes(blob))
+    dst = EmbeddingStore(capacity=4096, num_internal_shards=1)
+    loader = IncrementalLoader(dst, str(tmp_path))
+    for _ in range(loader.max_bad_retries):
+        assert loader.poll_once() == 0
+    assert loader.needs_resync
+    assert dst.size() == 0  # the damaged payload never applied
+
+
+def test_torn_packet_detected_and_later_packets_held(tmp_path):
+    """A torn packet holds its publisher's stream (strict ordering) until
+    the retry budget exhausts — then the stream skips past and resync owns
+    the repair."""
+    src = _train_store()
+    mgr = IncrementalUpdateManager(src, str(tmp_path))
+    _stream_packets(src, mgr, rounds=2)
+    p = mgr.root.join("0_0.inc")
+    blob = p.read_bytes()
+    p.write_bytes(blob[: len(blob) // 2])  # torn mid-body
+    dst = EmbeddingStore(capacity=4096, num_internal_shards=1)
+    loader = IncrementalLoader(dst, str(tmp_path))
+    assert loader.poll_once() == 0  # packet 1 held behind the torn packet 0
+    assert loader.needs_resync
+    assert loader.poll_once() == 0  # retry budget (2) now exhausted
+    n = loader.poll_once()  # skips past the torn packet, applies packet 1
+    assert n == 3
+    assert loader._hwm[0] == 1
+
+
+def test_duplicate_delivery_is_idempotent(tmp_path):
+    src = _train_store()
+    mgr = IncrementalUpdateManager(src, str(tmp_path))
+    signs = _stream_packets(src, mgr, rounds=2)
+    dst = EmbeddingStore(capacity=4096, num_internal_shards=2)
+    loader = IncrementalLoader(dst, str(tmp_path))
+    assert loader.poll_once() == 6
+    before = _entries_of(dst, signs)
+    # duplicate delivery: the same packets land again (relay redelivery /
+    # scanner re-listing) — nothing reapplies, nothing changes
+    assert loader.poll_once() == 0
+    np.testing.assert_array_equal(_entries_of(dst, signs), before)
+    assert not loader.needs_resync
+
+
+def test_out_of_order_delivery_skips_stale_and_flags_gap(tmp_path):
+    """Packet 1 delayed: the consumer applies 0 then 2 (gap flagged); when
+    1 finally lands it is NEVER applied (it would regress sign values) and
+    resync converges the replica to the source bitwise."""
+    src = _train_store()
+    mgr = IncrementalUpdateManager(src, str(tmp_path))
+    # packet 0: signs 1..3, packet 1: overlapping sign 2 re-trained,
+    # packet 2: signs 4..6 — so packet 1 carries a STALE value for sign 2
+    _touch(src, [1, 2, 3])
+    mgr.commit(np.array([1, 2, 3], dtype=np.uint64))
+    mgr.note_step(1)
+    mgr.flush()
+    _touch(src, [2])
+    mgr.commit(np.array([2], dtype=np.uint64))
+    mgr.note_step(2)
+    mgr.flush()
+    _touch(src, [2, 4, 5])  # sign 2 trains AGAIN after packet 1
+    mgr.commit(np.array([2, 4, 5], dtype=np.uint64))
+    mgr.note_step(3)
+    mgr.flush()
+
+    delayed = mgr.root.join("0_1.inc").read_bytes()
+    mgr.root.join("0_1.inc").remove()  # packet 1 lost in flight
+
+    dst = EmbeddingStore(capacity=4096, num_internal_shards=1)
+    loader = IncrementalLoader(dst, str(tmp_path))
+    loader.poll_once()  # applies 0 then 2 — seq gap flagged
+    assert loader.stats["gaps"] == 1 and loader.needs_resync
+    after_gap = _entries_of(dst, [1, 2, 3, 4, 5])
+
+    mgr.root.join("0_1.inc").write_bytes(delayed)  # late delivery arrives
+    assert loader.poll_once() == 0  # below the high-water mark: never applied
+    np.testing.assert_array_equal(_entries_of(dst, [1, 2, 3, 4, 5]), after_gap)
+
+    # resync replays the retained tail in order: 0, 1, 2 — last writer wins
+    # per sign, so the replica converges bitwise to the source
+    loader.resync()
+    assert not loader.needs_resync
+    probe = np.array([1, 2, 3, 4, 5], dtype=np.uint64)
+    np.testing.assert_array_equal(_entries_of(dst, probe), _entries_of(src, probe))
+
+
+def test_resynced_replica_bitwise_matches_clean_replica(tmp_path):
+    """The acceptance pin: one replica's channel is damaged (relay corrupts
+    a delivery), it skips + resyncs (redelivery), and ends bitwise
+    IDENTICAL to a replica that never saw a fault."""
+    from persia_tpu.chaos import ChaosConfig, DeltaChannelChaos
+
+    src_dir = tmp_path / "src"
+    src = _train_store()
+    mgr = IncrementalUpdateManager(src, str(src_dir))
+    relay = DeltaChannelChaos(
+        str(src_dir), str(tmp_path / "delta"), n_replicas=2,
+        cfg=ChaosConfig(corrupt_prob=0.35, seed=5), seed=5,
+    )
+    signs = _stream_packets(src, mgr, rounds=6)
+    relay.pump_once()
+    assert relay.counts["corrupt"] > 0, "chaos config never corrupted a delivery"
+
+    clean = EmbeddingStore(capacity=4096, num_internal_shards=2)
+    faulty = EmbeddingStore(capacity=4096, num_internal_shards=1)
+    # replica 1's channel is fault-free for this seed? force it: deliver
+    # replica-0's dir through the relay, and give the clean replica the
+    # SOURCE dir (the ground truth)
+    clean_loader = IncrementalLoader(clean, str(src_dir))
+    faulty_loader = IncrementalLoader(faulty, relay.inc_dir(0))
+    clean_loader.poll_once()
+    deadline = 0
+    while deadline < 4:  # drain retries until the stream settles
+        faulty_loader.poll_once()
+        deadline += 1
+    assert faulty_loader.stats["corrupt_skipped"] > 0
+    # repair: redeliver intact copies, then resync
+    relay.redeliver(0)
+    faulty_loader.resync()
+    assert not faulty_loader.needs_resync
+    np.testing.assert_array_equal(
+        _entries_of(faulty, signs), _entries_of(clean, signs)
+    )
+    relay.stop()
+
+
+def test_manager_seq_recovers_after_restart(tmp_path):
+    """A crash-resumed trainer must CONTINUE its packet sequence: a reset
+    stream would sit below every consumer's high-water mark forever."""
+    src = _train_store()
+    mgr = IncrementalUpdateManager(src, str(tmp_path))
+    _stream_packets(src, mgr, rounds=3)
+    assert mgr._seq == 3
+    # trainer dies; a new manager over the same dir picks up at seq 3
+    mgr2 = IncrementalUpdateManager(src, str(tmp_path), train_step=3)
+    assert mgr2._seq == 3
+    _touch(src, [100])
+    mgr2.commit(np.array([100], dtype=np.uint64))
+    mgr2.note_step(4)
+    mgr2.flush()
+    dst = EmbeddingStore(capacity=4096, num_internal_shards=1)
+    loader = IncrementalLoader(dst, str(tmp_path))
+    assert loader.poll_once() == 10  # 3 rounds * 3 + the post-restart packet
+    assert loader._hwm[0] == 3
+
+
+def test_freshness_lag_tracks_trainer_head(tmp_path):
+    src = _train_store()
+    mgr = IncrementalUpdateManager(src, str(tmp_path))
+    _stream_packets(src, mgr, rounds=2)  # head at step 2
+    dst = EmbeddingStore(capacity=4096, num_internal_shards=1)
+    loader = IncrementalLoader(dst, str(tmp_path))
+    loader.poll_once()
+    f = loader.freshness()
+    assert f["applied_step"] == 2 and f["head_step"] == 2 and f["lag_steps"] == 0
+    assert read_head(str(tmp_path)) == (2, f["head_time_us"])
+    # trainer advances but the consumer has not polled: lag grows
+    _stream_packets(src, mgr, rounds=3, start_sign=100)
+    loader._read_head([n for n in loader.root.list()])
+    f = loader.freshness()
+    assert f["head_step"] == 5 and f["lag_steps"] == 3
+    assert f["lag_seconds"] >= 0.0
+    # polling catches up and the lag collapses
+    loader.poll_once()
+    assert loader.freshness()["lag_steps"] == 0

@@ -19,6 +19,7 @@ ROLES = [
     "embedding-parameter-server",
     "coordinator",
     "serve",
+    "local",
     "k8s",
 ]
 
@@ -75,3 +76,25 @@ def test_serve_role_env_entry_fallback(tmp_path):
     )
     assert r.returncode == 0, r.stderr
     assert "fallback-entry-ran" in r.stdout
+
+
+def test_local_role_knob_surface():
+    """The one-command topology exposes the knobs the quickstart and the
+    online bench document (no cluster is brought up here)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "persia_tpu.launcher", "local", "--help"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    for knob in ("--ps", "--workers", "--trainers", "--replicas", "--steps",
+                 "--duration-s", "--max-staleness-steps", "--base-dir"):
+        assert knob in r.stdout, f"missing {knob} in local --help"
+
+
+def test_topology_role_dispatch_rejects_unknown():
+    r = subprocess.run(
+        [sys.executable, "-m", "persia_tpu.topology", "nonsense"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 2
+    assert "unknown topology role" in r.stderr
