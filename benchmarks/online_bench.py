@@ -62,6 +62,7 @@ def main():
 
     from persia_tpu.chaos import ChaosConfig
     from persia_tpu.serving import InferenceClient
+    from persia_tpu.serving.gateway import hop_latency_summary
     from persia_tpu.topology import LocalTopology, demo_batch
 
     seconds = float(os.environ.get("BENCH_ONLINE_SECONDS", "30"))
@@ -253,6 +254,7 @@ def main():
             "final_step": resumed_step,
         },
         "delta_channel_faults": final.get("delta_channel", {}),
+        "hop_latency": hop_latency_summary(),
         "chaos": chaos_cfg.to_dict(),
         "schedule": schedule_log,
         "platform": jax.default_backend(),

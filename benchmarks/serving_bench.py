@@ -230,6 +230,7 @@ def main():
 
     from persia_tpu.ctx import InferCtx
     from persia_tpu.serving import InferenceClient, InferenceServer, ServingServer
+    from persia_tpu.serving.gateway import hop_latency_summary
 
     seconds = float(os.environ.get("BENCH_SERVING_SECONDS", "6"))
     n_clients = int(os.environ.get("BENCH_SERVING_CLIENTS", "32"))
@@ -337,6 +338,7 @@ def main():
             "entries": int(cache_stats["entries"]),
         },
         "batch_rows_histogram": hist,
+        "hop_latency": hop_latency_summary(),
         "rollover": {
             **rollover_info,
             "failed_requests_during_window": len(b_failures),

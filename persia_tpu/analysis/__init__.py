@@ -21,6 +21,10 @@ any finding:
   plain ``open(..., "w")`` (or direct ``np.savez``) instead of the
   temp + fsync + atomic-rename publish the crash-consistency layer
   (persia_tpu.jobstate / checkpoint.py) requires.
+- **Observability** (OBS001–OBS002): metrics registered outside the
+  ``persia_tpu_``/``persia_`` namespace, and hand-rolled
+  ``t0 = time.time()`` stage timers in pipeline modules that bypass
+  ``tracing.stage_span`` (:mod:`persia_tpu.analysis.observability_lint`).
 
 Suppress a finding inline with ``# persia-lint: disable=RULE`` (or
 ``disable=all``) on the offending line; C sources use the same token in a
@@ -51,7 +55,7 @@ __all__ = [
     "NATIVE_LIBS",
 ]
 
-_PASS_PREFIXES = ("ABI", "CONC", "RES", "DUR")
+_PASS_PREFIXES = ("ABI", "CONC", "RES", "DUR", "OBS")
 
 
 def run_all(
@@ -59,7 +63,13 @@ def run_all(
 ) -> Tuple[List[Finding], Dict[str, object]]:
     """Run every pass over the repo. Returns (findings after suppression,
     coverage report). ``rules`` filters by rule-id prefix (e.g. ["ABI"])."""
-    from persia_tpu.analysis import abi, concurrency, durability, resilience_lint
+    from persia_tpu.analysis import (
+        abi,
+        concurrency,
+        durability,
+        observability_lint,
+        resilience_lint,
+    )
 
     wanted = tuple(r.upper() for r in rules) if rules else _PASS_PREFIXES
     findings: List[Finding] = []
@@ -76,6 +86,8 @@ def run_all(
         findings.extend(resilience_lint.check(root))
     if any(w.startswith("DUR") for w in wanted):
         findings.extend(durability.check(root, py_files))
+    if any(w.startswith("OBS") for w in wanted):
+        findings.extend(observability_lint.check(root, py_files))
     coverage["python_files_scanned"] = len(py_files)
     coverage["ctypes_files"] = [p for p in CTYPES_FILES
                                 if any(rel(f) == p for f in py_files)]

@@ -28,6 +28,8 @@ _RULE_DOC = {
     "RES003": "ad-hoc retry loop outside resilience (swallow+sleep)",
     "RES004": "manual wall-clock deadline instead of resilience.Deadline",
     "DUR001": "checkpoint/manifest artifact written without temp+fsync+rename",
+    "OBS001": "metric registered outside the persia_tpu_/persia_ namespace",
+    "OBS002": "hand-rolled stage timer bypassing tracing.stage_span",
 }
 
 

@@ -1,0 +1,163 @@
+"""Observability lint: one metric namespace, one stage-timing mechanism.
+
+The telemetry plane (persia_tpu/tracing.py + metrics.py) only composes
+into one fleet view if every process follows two mechanical conventions:
+
+- OBS001 a metric registered (``.counter(`` / ``.gauge(`` /
+         ``.histogram(``) with a literal name OUTSIDE the
+         ``persia_tpu_`` / ``persia_`` namespace — the fleet scraper
+         aggregates by prefix, and an off-namespace series silently
+         drops out of every dashboard and bench artifact
+- OBS002 a hand-rolled ``t0 = time.time()`` / ``time.perf_counter()``
+         stage timer in a pipeline module whose result feeds a
+         subtraction, in a function with no ``tracing.span`` /
+         ``stage_span`` / metric ``.time(`` in sight — the duration is
+         measured but invisible to both the live stage histogram and the
+         merged trace; use :func:`persia_tpu.tracing.stage_span`
+
+OBS002 scope: the hot pipeline modules (``embedding/hbm_cache/``,
+``serving/``, ``data_loader.py``, ``incremental.py``) — a stage duration
+there IS an observability artifact. ``tracing.py``/``metrics.py`` are the
+mechanism and exempt; deadline arithmetic on ``time.monotonic()`` is the
+resilience engine's business (RES004), not flagged here.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Sequence
+
+from persia_tpu.analysis.common import Finding, REPO_ROOT, read_text, rel
+
+_METRIC_METHODS = ("counter", "gauge", "histogram")
+_NAME_PREFIXES = ("persia_tpu_", "persia_")
+
+_TIMER_SCOPE_DIRS = (
+    os.path.join("persia_tpu", "embedding", "hbm_cache"),
+    os.path.join("persia_tpu", "serving"),
+)
+_TIMER_SCOPE_FILES = (
+    os.path.join("persia_tpu", "data_loader.py"),
+    os.path.join("persia_tpu", "incremental.py"),
+)
+# the mechanism itself may hold raw clocks
+_EXEMPT_BASENAMES = ("tracing.py", "metrics.py")
+
+# what proves the enclosing function already times through the sanctioned
+# machinery: a tracing span (span/stage_span), or a metric timer context
+_SANCTIONED_TOKENS = ("span(", ".time(")
+
+_CLOCK_FUNCS = ("time", "perf_counter")
+
+
+def _src(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover
+        return ""
+
+
+def _is_clock_call(node: ast.expr) -> bool:
+    """``time.time()`` / ``time.perf_counter()`` (module aliased ``_time``
+    too, the stream module's idiom)."""
+    if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+        return False
+    f = node.func
+    return (
+        f.attr in _CLOCK_FUNCS
+        and isinstance(f.value, ast.Name)
+        and f.value.id in ("time", "_time")
+    )
+
+
+def _metric_name_findings(tree: ast.AST, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_METHODS):
+            continue
+        if not node.args:
+            continue
+        name = node.args[0]
+        if not (isinstance(name, ast.Constant) and isinstance(name.value, str)):
+            continue  # computed names are the registry's own business
+        if name.value.startswith(_NAME_PREFIXES):
+            continue
+        findings.append(Finding(
+            "OBS001", path, node.lineno,
+            f".{node.func.attr}({name.value!r}) registers a metric outside "
+            "the persia_tpu_/persia_ namespace — the fleet scraper "
+            "aggregates by prefix, so this series drops out of every "
+            "dashboard and bench artifact",
+        ))
+    return findings
+
+
+def _timer_findings(tree: ast.AST, text: str, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    scopes = [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for fn in scopes:
+        # nested defs belong to the inner scope: judge each function only
+        # on its OWN direct statements' clock assignments, but whitelist
+        # on the full source (a closure timing into an outer span is fine)
+        fn_src = _src(fn)
+        if any(tok in fn_src for tok in _SANCTIONED_TOKENS):
+            continue
+        assigns = {}
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign) and _is_clock_call(node.value)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                assigns[node.targets[0].id] = node.lineno
+        if not assigns:
+            continue
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)
+                    and isinstance(node.right, ast.Name)
+                    and node.right.id in assigns):
+                var = node.right.id
+                findings.append(Finding(
+                    "OBS002", path, assigns.pop(var),
+                    f"hand-rolled stage timer ({var} = time.{_CLOCK_FUNCS[0]}"
+                    f"()/perf_counter() ... X - {var}) in a pipeline module "
+                    "— the duration never reaches the stage histogram or "
+                    "the trace; wrap the stage in tracing.stage_span(...)",
+                ))
+    return findings
+
+
+def _timer_in_scope(path: str) -> bool:
+    p = rel(path)
+    if os.path.basename(p) in _EXEMPT_BASENAMES:
+        return False
+    if p in _TIMER_SCOPE_FILES:
+        return True
+    return any(p.startswith(d + os.sep) for d in _TIMER_SCOPE_DIRS)
+
+
+def check_source(text: str, path: str,
+                 timer_scope: Optional[bool] = None) -> List[Finding]:
+    """Lint one file. ``timer_scope`` forces OBS002 on/off (fixtures);
+    None = decide from the path."""
+    tree = ast.parse(text, filename=path)
+    findings = _metric_name_findings(tree, path)
+    if timer_scope if timer_scope is not None else _timer_in_scope(path):
+        findings.extend(_timer_findings(tree, text, path))
+    return findings
+
+
+def check(root: str = REPO_ROOT,
+          files: Optional[Sequence[str]] = None) -> List[Finding]:
+    from persia_tpu.analysis.common import python_files
+
+    paths = list(files) if files is not None else python_files(root)
+    findings: List[Finding] = []
+    for p in paths:
+        abspath = p if os.path.isabs(p) else os.path.join(root, p)
+        findings.extend(check_source(read_text(abspath), rel(abspath)))
+    return findings

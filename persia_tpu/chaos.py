@@ -40,6 +40,7 @@ from typing import Dict, List, Optional, Sequence
 
 from persia_tpu.logger import get_default_logger
 from persia_tpu.metrics import get_metrics
+from persia_tpu.tracing import record_event
 
 logger = get_default_logger("persia_tpu.chaos")
 
@@ -148,6 +149,14 @@ class ChaosProxy:
         )
         self._accept_t.start()
 
+    def _note_fault(self, kind: str) -> None:
+        """ONE ledger per injected fault: the counts dict (tests), the
+        metric (scrapes), and the flight recorder (post-mortem
+        correlation against the breaker/quarantine events it caused)."""
+        self.counts[kind] += 1
+        self._m_injected.inc(kind=kind)
+        record_event(f"chaos.{kind}", proxy=self.name)
+
     # ----------------------------------------------------------- lifecycle
 
     def stop(self) -> None:
@@ -197,8 +206,7 @@ class ChaosProxy:
             if self.blackhole.is_set() or (
                 self.cfg.refuse_prob and rng.random() < self.cfg.refuse_prob
             ):
-                self.counts["refused"] += 1
-                self._m_injected.inc(kind="refused")
+                self._note_fault("refused")
                 try:
                     client.close()
                 except OSError:
@@ -274,20 +282,17 @@ class ChaosProxy:
                 r = rng.random()
                 if cfg.reset_prob and r < cfg.reset_prob:
                     # mid-frame cut: the peer sees a partial frame + EOF
-                    self.counts["reset"] += 1
-                    self._m_injected.inc(kind="reset")
+                    self._note_fault("reset")
                     dst.sendall(header + frame[: len(frame) // 2])
                     self._close_pair(src, dst)
                     return
                 if cfg.truncate_prob and r < cfg.reset_prob + cfg.truncate_prob:
-                    self.counts["truncated"] += 1
-                    self._m_injected.inc(kind="truncated")
+                    self._note_fault("truncated")
                     dst.sendall(header + frame[: max(len(frame) - 3, 0)])
                     self._close_pair(src, dst)
                     return
                 if cfg.slow_prob and rng.random() < cfg.slow_prob:
-                    self.counts["slow"] += 1
-                    self._m_injected.inc(kind="slow")
+                    self._note_fault("slow")
                     time.sleep(cfg.slow_ms / 1e3)
                 if (
                     cfg.corrupt_prob and len(frame) > 1
@@ -296,8 +301,7 @@ class ChaosProxy:
                     # flip one byte INSIDE the body (never byte 0: damaging
                     # the flags/status byte changes protocol dispatch rather
                     # than payload content, which is a different fault class)
-                    self.counts["corrupt"] += 1
-                    self._m_injected.inc(kind="corrupt")
+                    self._note_fault("corrupt")
                     pos = 1 + rng.randrange(len(frame) - 1)
                     frame = bytearray(frame)
                     frame[pos] ^= 0xFF
@@ -366,6 +370,7 @@ class DeltaChannelChaos:
     def set_blackhole(self, i: int, on: bool) -> None:
         with self._lock:
             self._blackholed[i] = on
+        record_event("chaos.blackhole" if on else "chaos.heal", replica=i)
 
     def _fault_for(self, replica: int, name: str) -> str:
         """Deterministic per-(replica, delivery) fault draw."""
@@ -436,9 +441,11 @@ class DeltaChannelChaos:
             self._delivered[i].add(name)
             if fault == "dropped":
                 self.counts["dropped"] += 1
+                record_event("chaos.dropped", replica=i, packet=name)
                 return 0
             if fault != "ok":
                 self.counts[fault] += 1
+                record_event(f"chaos.{fault}", replica=i, packet=name)
             self.counts["delivered"] += 1
         try:
             dst.join(name).write_bytes(self._damage(blob, fault, i, name))
@@ -661,6 +668,7 @@ class ChaosPlane:
             logger.exception("chaos: delayed %s(idx=%d) failed", a.op, a.idx)
 
     def _execute(self, a: ChaosAction) -> None:
+        record_event(f"chaos.{a.op}", idx=a.idx, step=a.step)
         if a.op == "snapshot":
             self.svc.snapshot_ps(a.idx)
         elif a.op == "kill_ps":

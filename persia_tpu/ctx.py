@@ -430,6 +430,15 @@ class TrainCtx(EmbeddingCtx):
     def train_step(self, batch: PersiaBatch) -> Dict:
         """One synchronous hybrid step: lookup → jitted step → gradient
         return. Returns host metrics {loss, preds}."""
+        from persia_tpu import tracing
+
+        # the step IS the trace edge on the synchronous path: the lookup
+        # and gradient-update RPCs beneath inherit one trace_id, linking
+        # this gradient batch to its journaled PS apply
+        with tracing.span("train.step", step=self._global_step):
+            return self._train_step_sync(batch)
+
+    def _train_step_sync(self, batch: PersiaBatch) -> Dict:
         ref = self.worker.put_forward_ids(batch)
         emb_batches = self.worker.forward_batch_id(ref, train=True)
         try:

@@ -20,6 +20,7 @@ batch N.
 
 from __future__ import annotations
 
+import contextlib
 import heapq
 import queue
 import threading
@@ -29,7 +30,7 @@ from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
 
 import numpy as np
 
-from persia_tpu import diagnostics
+from persia_tpu import diagnostics, tracing
 from persia_tpu.data import PersiaBatch
 from persia_tpu.logger import get_default_logger
 from persia_tpu.tracing import span
@@ -52,6 +53,10 @@ class PersiaTrainingBatch:
     batch_id: Optional[int] = None
     worker_idx: int = 0  # which embedding worker holds the ref (dataflow)
     ticket: Optional[int] = None  # reorder emit sequence (reproducible mode)
+    # the batch's trace frame (trace_id, parent_span), opened at the lookup
+    # edge — the async gradient return adopts it so the journaled PS apply
+    # carries the same trace_id as the lookup that produced the batch
+    trace_ctx: Optional[tuple] = None
 
 
 class _WorkerError:
@@ -132,7 +137,7 @@ class BackwardEngine:
 
     def push(
         self, ref: int, slot_grads, scale_factor: float = 1.0, worker=None,
-        journal_id=None,
+        journal_id=None, trace_ctx=None,
     ) -> None:
         """``slot_grads`` is either the per-slot gradient dict or a zero-arg
         callable producing it — the callable form defers the device→host
@@ -140,12 +145,16 @@ class BackwardEngine:
         step. ``worker`` overrides the engine's default target (multi-worker
         dataflow routes each ref back to the worker that holds it);
         ``journal_id`` tags the apply for the PS apply-journal
-        (exactly-once trainer resume, persia_tpu.jobstate)."""
+        (exactly-once trainer resume, persia_tpu.jobstate);
+        ``trace_ctx`` is the batch's (trace_id, parent_span) frame — the
+        engine thread adopts it so the PS apply RPC carries the id the
+        lookup opened."""
         with self._lock:
             if self._error is not None:
                 raise RuntimeError("backward engine failed") from self._error
             self._pending += 1
-        self._q.put((ref, slot_grads, scale_factor, worker, journal_id))
+        self._q.put((ref, slot_grads, scale_factor, worker, journal_id,
+                     trace_ctx))
 
     @staticmethod
     def _do_update(worker, ref: int, slot_grads, scale: float, jid) -> None:
@@ -184,12 +193,18 @@ class BackwardEngine:
             item = self._q.get()
             if item is _SENTINEL:
                 return
-            ref, slot_grads, scale, worker, jid = item
+            ref, slot_grads, scale, worker, jid, trace_ctx = item
             worker = worker if worker is not None else self._worker
             try:
-                if callable(slot_grads):
-                    slot_grads = slot_grads()
-                self._apply(worker, ref, slot_grads, scale, jid)
+                with contextlib.ExitStack() as tstack:
+                    if trace_ctx is not None:
+                        tstack.enter_context(
+                            tracing.trace_context(trace_ctx[0], trace_ctx[1])
+                        )
+                        tstack.enter_context(span("grad.apply", ref=ref))
+                    if callable(slot_grads):
+                        slot_grads = slot_grads()
+                    self._apply(worker, ref, slot_grads, scale, jid)
             except BaseException as e:  # noqa: BLE001 — propagate to trainer
                 try:
                     worker.abort_gradient(ref)
@@ -420,10 +435,16 @@ class DataLoader:
             try:
                 diagnostics.heartbeat(beat_key)
                 train = batch.requires_grad
-                with span("lookup", batch_id=batch.batch_id):
-                    widx, ref, emb_batches = self._lookup_with_recovery(batch, train)
-                with span("stage", batch_id=batch.batch_id):
-                    device_batch, counts = self.ctx.prepare_features(batch, emb_batches)
+                with contextlib.ExitStack() as tstack:
+                    # per-batch trace edge: the lookup/stage spans, the
+                    # lookup RPCs, and (via trace_ctx on the staged batch)
+                    # the eventual gradient apply all share one trace_id
+                    frame = (tstack.enter_context(tracing.trace_context())
+                             if tracing.enabled() else None)
+                    with span("lookup", batch_id=batch.batch_id):
+                        widx, ref, emb_batches = self._lookup_with_recovery(batch, train)
+                    with span("stage", batch_id=batch.batch_id):
+                        device_batch, counts = self.ctx.prepare_features(batch, emb_batches)
                 out_q.put(
                     PersiaTrainingBatch(
                         ref=ref,
@@ -434,6 +455,7 @@ class DataLoader:
                         batch_id=batch.batch_id,
                         worker_idx=widx,
                         ticket=ticket,
+                        trace_ctx=frame,
                     )
                 )
             except BaseException as e:  # noqa: BLE001
@@ -582,7 +604,7 @@ class DataLoader:
         self.backward_engine.push(
             training_batch.ref, slot_grads, scale_factor,
             worker=self.emb_workers[training_batch.worker_idx],
-            journal_id=journal_id,
+            journal_id=journal_id, trace_ctx=training_batch.trace_ctx,
         )
 
     def backward_packed(
@@ -606,7 +628,7 @@ class DataLoader:
         self.backward_engine.push(
             training_batch.ref, _materialize, scale_factor,
             worker=self.emb_workers[training_batch.worker_idx],
-            journal_id=journal_id,
+            journal_id=journal_id, trace_ctx=training_batch.trace_ctx,
         )
 
     def mark_consumed(self, training_batch: PersiaTrainingBatch) -> None:

@@ -29,7 +29,7 @@ from persia_tpu.logger import get_default_logger
 from persia_tpu.utils import round_up_pow2 as _round_up_pow2
 from persia_tpu.metrics import get_metrics
 from persia_tpu.ops.sparse_update import sparse_update
-from persia_tpu.tracing import span
+from persia_tpu.tracing import record_event, span, stage_span
 
 logger = get_default_logger("persia_tpu.hbm_cache")
 
@@ -274,6 +274,25 @@ def run_train_stream(
         "persia_tpu_stream_degraded_lookup_frac",
         "per-step degraded lookup fraction of the cached stream",
     )
+    _m_feeder_util = get_metrics().gauge(
+        "persia_tpu_stream_feeder_util",
+        "fraction of stream wall time the feeder thread was busy",
+    )
+    _m_packed_frac = get_metrics().gauge(
+        "persia_tpu_stream_packed_step_frac",
+        "fraction of dispatched steps that rode a K-step pack",
+    )
+
+    def _publish_live_stats() -> None:
+        """Export the stream's headline ratios as live gauges so the
+        telemetry collector sees them mid-run, not just in the final
+        stats dict."""
+        elapsed = _time.perf_counter() - t_start
+        if elapsed > 0.0:
+            _m_feeder_util.set(stats["feeder_busy_s"] / elapsed)
+        done = stats["packed_steps"] + stats["single_steps"]
+        if done:
+            _m_packed_frac.set(stats["packed_steps"] / done)
 
     def _note_degraded(seq: int) -> None:
         """Per-step degraded accounting + the configurable abort: a step
@@ -322,7 +341,7 @@ def run_train_stream(
                         if stop.is_set() or errors:
                             return
                 t_prep = _time.perf_counter()
-                with span("stream.prep"):
+                with stage_span("stream.prep"):
                     item = self.tier.prepare_batch(
                         batch, hazard_gate=gate, ring_alloc=ring_alloc,
                         pending_map=sign_map,
@@ -387,7 +406,7 @@ def run_train_stream(
                 seq, item, ps_item = got
                 (di, layout, miss_aux, cold_aux, restore_aux, evict_aux,
                  evict_meta) = item
-                with span("stream.stage"):
+                with stage_span("stream.stage"):
                     di, miss_aux, cold_aux, evict_aux = self._stage(
                         di, miss_aux, cold_aux, evict_aux
                     )
@@ -430,7 +449,7 @@ def run_train_stream(
     def _flush_acc(acc) -> None:
         if not acc:
             return
-        with span("stream.wb_flush", steps=len(acc)):
+        with stage_span("stream.wb_flush", steps=len(acc)):
             _flush_acc_inner(acc)
 
     def _release_acc(acc) -> None:
@@ -642,6 +661,7 @@ def run_train_stream(
                     with span("stream.fence", step=gstep):
                         self._fence_capture(job_mgr, gstep, occupancy)
                     stats["fences"] = stats.get("fences", 0) + 1
+                    record_event("stream.fence_commit", step=gstep)
                 except BaseException as e:  # noqa: BLE001
                     errors.append(e)
         fence_done.set()
@@ -661,6 +681,7 @@ def run_train_stream(
             # gradient batch (same contract as the sync train_step)
             for grp in self._cached_groups:
                 self.tier.router.advance_batch_state(grp)
+        _publish_live_stats()
 
     def _dispatch_one(item):
         nonlocal header
@@ -669,7 +690,7 @@ def run_train_stream(
         try:
             if self.state is None:
                 self.init_state(jax.random.PRNGKey(0), di, layout)
-            with span("stream.dispatch"):
+            with stage_span("stream.dispatch"):
                 header, evict_payload, ps_gpacked = self._dispatch(
                     di, layout, miss_aux, cold_aux, restore_aux,
                     evict_aux, evict_meta,
@@ -745,7 +766,7 @@ def run_train_stream(
 
     def _dispatch_pack():
         nonlocal header
-        with span("stream.dispatch_pack", k=len(pack)):
+        with stage_span("stream.dispatch_pack", k=len(pack)):
             headers, payloads = self._dispatch_packed(
                 [(it[1], it[2], it[3], it[4], it[6], it[7]) for it in pack]
             )
@@ -795,6 +816,7 @@ def run_train_stream(
             _dispatch_one(item)
     finally:
         stats["wall_s"] = _time.perf_counter() - t_start
+        _publish_live_stats()
         self._stream_stats = stats
         stop.set()
         with cv:

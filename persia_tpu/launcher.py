@@ -114,6 +114,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     loc.add_argument("--base-dir", type=str, default=None,
                      help="working directory (default: a fresh tempdir)")
     loc.add_argument("--seed", type=int, default=7)
+    loc.add_argument("--trace-dir", type=str, default=None,
+                     help="arm fleet tracing: every role serves /metrics + "
+                          "/spans + /flight and a merged Perfetto timeline "
+                          "(merged_trace.json) lands here on shutdown")
 
     # k8s sub-CLI (ref: persia/k8s_utils.py gencrd/operator/server)
     k8s = sub.add_parser("k8s", help="generate/apply k8s manifests + operator")
@@ -225,6 +229,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             ckpt_every=args.ckpt_every, flush_every=args.flush_every,
             cache_rows=args.cache_rows,
             max_staleness_steps=args.max_staleness_steps, seed=args.seed,
+            trace_dir=args.trace_dir,
         )
         with topo:
             ports = " ".join(f"127.0.0.1:{p}" for p in topo.replica_ports)
@@ -249,6 +254,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             except KeyboardInterrupt:
                 pass
             print(_json.dumps(topo.stats(), default=str), flush=True)
+            if args.trace_dir:
+                # merge while the roles are still up: live /spans beats the
+                # dead-role fallback files
+                merged = topo.merge_traces()
+                if merged:
+                    print(f"merged trace: {merged}", flush=True)
         return 0
 
     if args.role == "coordinator":

@@ -297,27 +297,64 @@ class MetricsRegistry:
 
     # ------------------------------------------------------------------ pull
 
-    def serve_http(self, port: int = 0) -> int:
-        """Expose ``/metrics`` for scraping; returns the bound port."""
+    def serve_http(self, port: int = 0, host: str = "127.0.0.1") -> int:
+        """Expose the role's telemetry endpoints; returns the bound port.
+
+        - ``/metrics`` — Prometheus text exposition (scrape);
+        - ``/spans`` — the tracing span ring as JSON plus a ``now_us`` clock
+          sample for the fleet collector's offset handshake; ``?drain=1``
+          drains the ring so repeated scrapes never double-count;
+        - ``/flight`` — the flight-recorder event ring as JSON.
+
+        Binds loopback by default; a fleet deployment that actually wants a
+        cross-host scrape passes ``host="0.0.0.0"`` explicitly."""
         registry = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
-                if self.path.rstrip("/") in ("", "/metrics".rstrip("/")):
+                path, _, query = self.path.partition("?")
+                path = path.rstrip("/")
+                if path in ("", "/metrics"):
                     body = registry.render().encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/plain; version=0.0.4")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    ctype = "text/plain; version=0.0.4"
+                elif path == "/spans":
+                    from persia_tpu import tracing
+                    import json as _json
+
+                    spans = (tracing.spans_drain() if "drain=1" in query
+                             else tracing.spans_snapshot())
+                    body = _json.dumps({
+                        "now_us": time.time() * 1e6,
+                        "pid": os.getpid(),
+                        "role": tracing.get_role(),
+                        "spans": spans,
+                    }).encode()
+                    ctype = "application/json"
+                elif path == "/flight":
+                    from persia_tpu import tracing
+                    import json as _json
+
+                    body = _json.dumps({
+                        "now_us": time.time() * 1e6,
+                        "pid": os.getpid(),
+                        "role": tracing.get_role(),
+                        "events": tracing.flight_snapshot(),
+                    }).encode()
+                    ctype = "application/json"
                 else:
                     self.send_response(404)
                     self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def log_message(self, *a):
                 pass
 
-        self._server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self._server = ThreadingHTTPServer((host, port), Handler)
         threading.Thread(target=self._server.serve_forever, daemon=True, name="metrics-http").start()
         return self._server.server_address[1]
 

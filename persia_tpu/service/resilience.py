@@ -201,9 +201,18 @@ class CircuitBreaker:
                 if self._state != _STATE_OPEN:
                     self.trips += 1
                     self._m_trips.inc(endpoint=self.endpoint)
+                    self._record_trip("failure")
                 self._state = _STATE_OPEN
                 self._open_until = time.monotonic() + self.reset_timeout_s
                 self._m_state.set(1, endpoint=self.endpoint)
+
+    def _record_trip(self, cause: str) -> None:
+        """Every closed→open transition lands in the flight recorder,
+        stamped with the trace that pushed the endpoint over (if any)."""
+        from persia_tpu import tracing
+
+        tracing.record_event("breaker.trip", endpoint=self.endpoint,
+                             cause=cause, trips=self.trips)
 
     def force_open(self) -> None:
         """Administrative open (the gateway's mark-down on a failed health
@@ -212,6 +221,7 @@ class CircuitBreaker:
             if self._state != _STATE_OPEN:
                 self.trips += 1
                 self._m_trips.inc(endpoint=self.endpoint)
+                self._record_trip("forced")
             self._state = _STATE_OPEN
             self._open_until = time.monotonic() + self.reset_timeout_s
             self._failures = self.failure_threshold

@@ -8,13 +8,16 @@ length-prefixed framed protocol over raw TCP with lz4-class compression
 concatenation on the hot path), a threaded server, and a reconnecting
 client.
 
-Frame:  u32 total_len | u8 flags | u16 method_len | method | payload
+Frame:  u32 total_len | u8 flags | u16 method_len | method | [trace] | payload
   flags bits 0-1: payload codec (0 none, 1 zlib, 2 lz4)
+  flags bit 5:    trace-context header present (negotiated)
   flags bit 7:    client accepts compressed replies
+  trace:          u8 len | "<trace_id>:<parent_span_id>" (ASCII)
 Reply:  u32 total_len | u8 status | payload
   status low nibble: 0 ok, 1 app error; high nibble: payload codec
 (Old peers only ever set/see bit 0 = zlib and a 0/1 status byte, so both
-directions interoperate with round-1 processes.)
+directions interoperate with round-1 processes. The trace header, like the
+crc trailer, only goes on the wire to peers that advertise the capability.)
 """
 
 from __future__ import annotations
@@ -28,7 +31,7 @@ import time
 import zlib
 from typing import Callable, Dict, Optional, Sequence, Union
 
-from persia_tpu import diagnostics
+from persia_tpu import diagnostics, tracing
 from persia_tpu.logger import get_default_logger
 from persia_tpu.service import codec as _codec
 from persia_tpu.service.resilience import (
@@ -43,6 +46,7 @@ from persia_tpu.service.resilience import (
 logger = get_default_logger("persia_tpu.rpc")
 
 _FLAG_CODEC_MASK = 0x03
+_FLAG_TRACE = 0x20  # frame carries a trace-context header (negotiated)
 _FLAG_CRC32 = 0x40  # payload carries a trailing crc32 (negotiated)
 _FLAG_REPLY_COMPRESS_OK = 0x80
 _STATUS_CRC = 0x08  # reply status bit: payload carries a trailing crc32
@@ -93,19 +97,23 @@ def _caps_sum(caps: dict) -> str:
     return format(zlib.crc32(canon.encode()) & 0xFFFFFFFF, "08x")
 
 
-def _capabilities_reply(_p: bytes = b"", crc: bool = False) -> bytes:
+def _capabilities_reply(_p: bytes = b"", crc: bool = False,
+                        trace: bool = False) -> bytes:
     """Codec-negotiation probe: clients only send lz4 frames to peers that
-    advertise it (round-1 peers answer 'unknown method' → zlib only), and
-    only send crc32-trailed frames to peers that advertise ``crc`` (the
-    Python server verifies them; the native C++ data plane does not parse
-    the trailer, so it keeps the default no-crc advertisement). Older
-    clients ignore the ``sum`` field."""
+    advertise it (round-1 peers answer 'unknown method' → zlib only), only
+    send crc32-trailed frames to peers that advertise ``crc``, and only
+    send trace-context headers to peers that advertise ``trace`` (the
+    Python server parses both; the native C++ data plane parses neither,
+    so it keeps the default codecs-only advertisement). Older clients
+    ignore the extra fields and the ``sum`` field."""
     import json
 
     codecs = ["zlib"] + (["lz4"] if _codec.lz4_available() else [])
     caps = {"codecs": codecs}
     if crc:
         caps["integrity"] = ["crc32"]
+    if trace:
+        caps["trace"] = ["ctx1"]
     caps["sum"] = _caps_sum(caps)
     return json.dumps(caps).encode()
 
@@ -169,7 +177,16 @@ class _Handler(socketserver.BaseRequestHandler):
                 # errors="replace" keeps an (un-crc'd) corrupt method from
                 # killing the handler thread — it resolves to unknown-method
                 method = frame[3 : 3 + mlen].decode(errors="replace")
-                payload = frame[3 + mlen :]
+                off = 3 + mlen
+                trace_blob = None
+                if flags & _FLAG_TRACE and off < len(frame):
+                    # negotiated trace-context header: "<trace_id>:<parent>"
+                    tlen = frame[off]
+                    trace_blob = frame[off + 1 : off + 1 + tlen].decode(
+                        errors="replace"
+                    )
+                    off += 1 + tlen
+                payload = frame[off:]
                 codec_id = flags & _FLAG_CODEC_MASK
                 if codec_id:
                     try:
@@ -190,7 +207,16 @@ class _Handler(socketserver.BaseRequestHandler):
                         # 3600s) so they get a matching threshold
                         slow = 3600.0 if method in _SLOW_METHODS else None
                         with diagnostics.inflight(f"rpc:{method}", stall_after_s=slow):
-                            reply, status = fn(payload) or b"", 0
+                            if trace_blob is not None:
+                                # adopt the caller's context for the handler's
+                                # duration: spans it opens (and flight events
+                                # it records) carry the caller's trace_id
+                                tid, _, parent = trace_blob.partition(":")
+                                with tracing.trace_context(tid, parent or None), \
+                                        tracing.span(f"rpc.server.{method}"):
+                                    reply, status = fn(payload) or b"", 0
+                            else:
+                                reply, status = fn(payload) or b"", 0
                     except Exception as e:  # noqa: BLE001 — app error crosses the wire
                         logger.exception("handler %s failed", method)
                         # a handler failing on a DOWNSTREAM transport error
@@ -257,8 +283,9 @@ class RpcServer:
         self.compress_threshold = compress_threshold
         self.handlers: Dict[str, Callable[[bytes], Buffers]] = {
             "ping": lambda p: b"pong",
-            # codec + integrity negotiation probe (this server verifies crc)
-            "capabilities": lambda p: _capabilities_reply(p, crc=True),
+            # codec + integrity + trace negotiation probe (this server
+            # verifies crc and parses trace-context headers)
+            "capabilities": lambda p: _capabilities_reply(p, crc=True, trace=True),
             "shutdown": lambda p: b"ok",  # framing layer stops after replying
         }
         self._server = _ThreadedTCPServer((host, port), _Handler)
@@ -318,6 +345,7 @@ class RpcClient:
         self.integrity = bool(integrity)
         self._peer_lz4: Optional[bool] = None  # learned from `capabilities`
         self._peer_crc: Optional[bool] = None
+        self._peer_trace: Optional[bool] = None
         self._idle: list = []
         self._total = 0
         self._gen = 0  # close() bumps: stale in-flight sockets die at checkin
@@ -398,6 +426,19 @@ class RpcClient:
         probe = method == "ping"
         last: Optional[Exception] = None
         attempts = max(self.retries, 1) if idempotent else 1
+        # the client-side hop span: no-op when tracing is disabled; when
+        # enabled it opens (or extends) the ambient trace so _call_once can
+        # ship the context to a trace-capable peer
+        with tracing.span(f"rpc.client.{method}", endpoint=self.endpoint):
+            return self._call_with_retries(
+                method, payload, timeout_s, deadline,
+                pol, breaker, probe, attempts, last,
+            )
+
+    def _call_with_retries(
+        self, method, payload, timeout_s, deadline,
+        pol, breaker, probe, attempts, last,
+    ) -> bytes:
         for attempt in range(attempts):
             if deadline is not None:
                 deadline.check(f"rpc {method}")
@@ -466,17 +507,33 @@ class RpcClient:
             self.integrity and self._peer_crc and method != "capabilities"
         )
         m = method.encode()
+        trace_hdr = b""
+        if method != "capabilities" and tracing.enabled():
+            ctx = tracing.current_context()
+            if ctx is not None:
+                if self._peer_trace is None:
+                    self._probe_peer_codecs()
+                if self._peer_trace:
+                    # negotiated trace-context header rides between the
+                    # method name and the payload; best-effort (an
+                    # undecided probe just skips it — unlike crc, a lost
+                    # trace header costs visibility, not correctness)
+                    blob = f"{ctx[0]}:{ctx[1] or ''}".encode()[:255]
+                    trace_hdr = struct.pack("<B", len(blob)) + blob
+                    flags |= _FLAG_TRACE
         if want_crc:
             # trailer covers the whole frame after the length prefix
-            # (flags + method header + payload) so corruption anywhere in
-            # the frame body is detectable server-side
+            # (flags + method header + trace header + payload) so corruption
+            # anywhere in the frame body is detectable server-side
             flags |= _FLAG_CRC32
-            crc = zlib.crc32(struct.pack("<BH", flags, len(m)) + m)
+            crc = zlib.crc32(struct.pack("<BH", flags, len(m)) + m + trace_hdr)
             for b in bufs:
                 crc = zlib.crc32(b, crc)
             bufs = bufs + [memoryview(struct.pack("<I", crc)).cast("B")]
             plen += 4
-        header = struct.pack("<IBH", plen + 3 + len(m), flags, len(m)) + m
+        header = struct.pack(
+            "<IBH", plen + 3 + len(m) + len(trace_hdr), flags, len(m)
+        ) + m + trace_hdr
         eff_timeout = timeout_s
         if deadline is not None:
             eff_timeout = deadline.cap(
@@ -537,6 +594,7 @@ class RpcClient:
                 return  # damaged-in-transit caps: stay undecided, re-probe
             self._peer_lz4 = "lz4" in caps.get("codecs", [])
             self._peer_crc = "crc32" in caps.get("integrity", [])
+            self._peer_trace = "ctx1" in caps.get("trace", [])
         except RpcError as e:
             # a legacy peer answers "unknown method 'capabilities'" — the
             # echoed method name is the tell. A CORRUPTED probe draws
@@ -547,6 +605,7 @@ class RpcClient:
             if "unknown method 'capabilities'" in msg:
                 self._peer_lz4 = False
                 self._peer_crc = False
+                self._peer_trace = False
         except Exception:  # noqa: BLE001 — transport/parse damage
             # the probe itself may have been corrupted or cut in transit:
             # leave the capabilities UNDECIDED so the next call re-probes,

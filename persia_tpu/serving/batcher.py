@@ -31,6 +31,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from persia_tpu import tracing
 from persia_tpu.data import IDTypeFeature, NonIDTypeFeature, PersiaBatch
 from persia_tpu.logger import get_default_logger
 from persia_tpu.metrics import get_metrics
@@ -108,7 +109,8 @@ def merge_batches(
 
 
 class _Pending:
-    __slots__ = ("batch", "deadline", "event", "result", "error")
+    __slots__ = ("batch", "deadline", "event", "result", "error", "ctx",
+                 "t_submit")
 
     def __init__(self, batch: PersiaBatch, deadline: float):
         self.batch = batch
@@ -116,6 +118,11 @@ class _Pending:
         self.event = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
+        # the submitter's trace context crosses to the forward thread with
+        # the request (thread-locals don't): the coalesced forward adopts
+        # the lead request's context so engine spans carry its trace_id
+        self.ctx = tracing.current_context()
+        self.t_submit = time.monotonic()
 
 
 class MicroBatcher:
@@ -165,6 +172,11 @@ class MicroBatcher:
         )
         self._m_depth = m.gauge(
             "persia_tpu_serving_queue_depth", "admission queue depth"
+        )
+        self._m_queue_wait = m.histogram(
+            "persia_tpu_serving_queue_wait_seconds",
+            "per-request wait from submit to coalesced forward start "
+            "(the replica-side queue hop of the latency attribution)",
         )
 
     # ------------------------------------------------------------ client side
@@ -254,6 +266,20 @@ class MicroBatcher:
                 self._m_depth.set(len(self._q))
         return group
 
+    def _forward(self, live: List[_Pending], merged: PersiaBatch):
+        """Run the coalesced forward under the lead request's trace context
+        (if any): the engine's span — and anything beneath it — carries
+        that request's trace_id, and the batch span lists every coalesced
+        trace id so no request is unfindable in the merged timeline."""
+        lead = next((p.ctx for p in live if p.ctx is not None), None)
+        if lead is None or not tracing.enabled():
+            return self._predict(merged)
+        ids = ",".join(p.ctx[0] for p in live if p.ctx is not None)
+        with tracing.trace_context(lead[0], lead[1]):
+            with tracing.span("serving.batch_forward", coalesced=len(live),
+                              trace_ids=ids[:512]):
+                return self._predict(merged)
+
     def _loop(self) -> None:
         while True:
             with self._cond:
@@ -275,13 +301,15 @@ class MicroBatcher:
                     live.append(p)
             if not live:
                 continue
+            for p in live:
+                self._m_queue_wait.observe(now - p.t_submit)
             try:
                 total = sum(p.batch.batch_size for p in live)
                 pad_to = round_up_pow2(total) if self.pad_buckets else 0
                 merged, offsets = merge_batches(
                     [p.batch for p in live], pad_to=pad_to
                 )
-                scores = np.asarray(self._predict(merged))
+                scores = np.asarray(self._forward(live, merged))
             except Exception as e:  # noqa: BLE001 — the error crosses to every caller
                 logger.exception("coalesced forward failed (%d requests)", len(live))
                 for p in live:

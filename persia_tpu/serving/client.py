@@ -30,6 +30,7 @@ from typing import Optional
 
 import numpy as np
 
+from persia_tpu import tracing
 from persia_tpu.data import PersiaBatch
 
 
@@ -151,6 +152,12 @@ class InferenceClient:
         extra = ""
         if deadline_ms is not None:
             extra = f"X-Deadline-Ms: {float(deadline_ms)}\r\n"
+        if tracing.enabled():
+            # ship the ambient trace context (X-Trace-Id / X-Parent-Span)
+            # so the replica's spans join this caller's timeline
+            extra += "".join(
+                f"{k}: {v}\r\n" for k, v in tracing.wire_headers().items()
+            )
         data, headers = self._request_ex("POST", "/predict", raw, extra)
         return np.load(io.BytesIO(data)), headers
 

@@ -19,11 +19,11 @@ bump the hot-embedding cache epoch.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Optional, Tuple
 
 import numpy as np
 
+from persia_tpu import tracing
 from persia_tpu.data import PersiaBatch
 from persia_tpu.logger import get_default_logger
 from persia_tpu.metrics import get_metrics
@@ -59,10 +59,15 @@ class InferenceEngine:
         return self._handle[1]
 
     def predict(self, batch: PersiaBatch) -> np.ndarray:
-        ctx, _ = self._handle
-        t0 = time.perf_counter()
-        out = ctx.predict(batch)
-        self._m_forward_time.observe(time.perf_counter() - t0)
+        ctx, version = self._handle
+        # the engine hop of the distributed trace: inherits the request's
+        # trace_id when the caller (batcher forward thread / request
+        # thread) adopted one, so a client id is visible down to the
+        # jitted forward
+        with tracing.span("serving.engine_forward", version=version,
+                          rows=batch.batch_size):
+            with self._m_forward_time.time():
+                out = ctx.predict(batch)
         self._m_forwards.inc()
         return np.asarray(out)
 

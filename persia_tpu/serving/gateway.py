@@ -42,6 +42,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from persia_tpu import tracing
 from persia_tpu.data import PersiaBatch
 from persia_tpu.logger import get_default_logger
 from persia_tpu.metrics import get_metrics
@@ -53,6 +54,35 @@ logger = get_default_logger("persia_tpu.serving.gateway")
 
 class NoReplicaAvailableError(RuntimeError):
     """Every replica is down (or the request failed on all of them)."""
+
+
+# The per-hop split of a served request: time queued behind the routing
+# decision, time the replica reports holding the request (X-Server-Ms),
+# wire + serialization overhead (attempt wall minus replica hold), and the
+# replica-side micro-batcher queue wait.
+_HOP_SERIES = (
+    "persia_tpu_gateway_queue_wait_seconds",
+    "persia_tpu_gateway_replica_server_seconds",
+    "persia_tpu_gateway_wire_seconds",
+    "persia_tpu_serving_queue_wait_seconds",
+)
+
+
+def hop_latency_summary() -> Dict[str, Dict[str, float]]:
+    """Per-hop latency attribution from the split histograms, in artifact
+    form (count / total seconds / mean ms per hop). Benches embed this so
+    "where did the milliseconds go" is answerable from the JSON alone."""
+    snap = get_metrics().snapshot()
+    out: Dict[str, Dict[str, float]] = {}
+    for name in _HOP_SERIES:
+        count = sum(snap.get(f"{name}_count", {}).values())
+        total = sum(snap.get(f"{name}_sum", {}).values())
+        out[name] = {
+            "count": int(count),
+            "sum_s": round(total, 6),
+            "mean_ms": round(total / count * 1e3, 4) if count else 0.0,
+        }
+    return out
 
 
 class ReplicaGateway:
@@ -158,6 +188,23 @@ class ReplicaGateway:
         self._m_probe_errors = m.counter(
             "persia_tpu_gateway_probe_errors", "health probe sweeps that failed"
         )
+        # per-hop latency attribution (recorded per successful attempt):
+        # dispatch queue wait in the hedge pool, the replica's self-reported
+        # hold time (X-Server-Ms: its queue wait + coalesced forward), and
+        # wire = gateway-observed wall minus the replica's hold
+        self._m_queue_wait = m.histogram(
+            "persia_tpu_gateway_queue_wait_seconds",
+            "wait from routing decision to the attempt actually firing",
+        )
+        self._m_server_time = m.histogram(
+            "persia_tpu_gateway_replica_server_seconds",
+            "replica-reported request hold time (X-Server-Ms)",
+        )
+        self._m_wire = m.histogram(
+            "persia_tpu_gateway_wire_seconds",
+            "attempt wall time minus the replica's reported hold (wire + "
+            "serialization overhead)",
+        )
         for addr in replicas or []:
             self.add_replica(addr)
 
@@ -245,6 +292,10 @@ class ReplicaGateway:
             "action": action, "replica": addr, "lag_steps": lag_steps,
             "lag_seconds": round(lag_s, 3), "time": time.time(),
         })
+        # the black box sees every quarantine transition, stamped with the
+        # ambient trace_id (if a traced request triggered the evaluation)
+        tracing.record_event(f"gateway.{action}", replica=addr,
+                             lag_steps=lag_steps, lag_seconds=round(lag_s, 3))
         if action == "quarantine":
             self._m_quarantines.inc()
             logger.warning("replica %s quarantined (lag %d steps / %.2fs)",
@@ -378,7 +429,19 @@ class ReplicaGateway:
         distinct replicas; when every fresh replica is gone, degrade onto
         the least-stale quarantined one. Returns ``(scores, info)`` where
         ``info`` carries ``staleness_steps`` (the serving replica's
-        ``X-Staleness-Steps`` answer) and ``stale_fallback``."""
+        ``X-Staleness-Steps`` answer) and ``stale_fallback`` (plus
+        ``trace_id`` when tracing is on)."""
+        if tracing.enabled() and tracing.current_context() is None:
+            # THE edge: a request arriving without a trace gets its id here,
+            # and every hop below (gateway span, replica HTTP headers,
+            # engine span) inherits it
+            with tracing.trace_context():
+                return self._predict_routed(raw, deadline_ms)
+        return self._predict_routed(raw, deadline_ms)
+
+    def _predict_routed(
+        self, raw: bytes, deadline_ms: Optional[float]
+    ) -> Tuple[np.ndarray, Dict]:
         self._m_requests.inc()
         tried: set = set()
         last: Optional[Exception] = None
@@ -398,7 +461,11 @@ class ReplicaGateway:
                 # failures should not hot-spin the fleet)
                 self.policy.sleep_backoff(attempt - 1)
             try:
-                scores, headers = self._one_attempt(addr, raw, tried, deadline_ms)
+                with tracing.span("gateway.predict", replica=addr,
+                                  attempt=attempt):
+                    scores, headers = self._one_attempt(
+                        addr, raw, tried, deadline_ms
+                    )
             except Exception as e:  # noqa: BLE001 — classify then fail over
                 last = e
                 self.policy.breaker(addr).on_failure()
@@ -416,6 +483,9 @@ class ReplicaGateway:
                 ),
                 "stale_fallback": stale_fallback,
             }
+            tid = tracing.current_trace_id()
+            if tid:
+                info["trace_id"] = tid
             if stale_fallback:
                 self._m_stale_served.inc()
             return scores, info
@@ -431,7 +501,7 @@ class ReplicaGateway:
         success wins, the straggler is abandoned to its own timeout. Both
         the primary and the hedge settle their replica's breaker."""
         client = self._clients[addr]
-        primary = self._pool.submit(client.predict_bytes_ex, raw, deadline_ms)
+        primary = self._submit_attempt(addr, client, raw, deadline_ms)
         futures = {primary: addr}
         done, _ = wait([primary], timeout=self.hedge_after_s,
                        return_when=FIRST_COMPLETED)
@@ -442,11 +512,45 @@ class ReplicaGateway:
             # must not slip past that gate
             if hedge_addr is not None and self.policy.breaker(hedge_addr).allow():
                 self._m_hedges.inc()
-                futures[self._pool.submit(
-                    self._clients[hedge_addr].predict_bytes_ex, raw, deadline_ms
+                futures[self._submit_attempt(
+                    hedge_addr, self._clients[hedge_addr], raw, deadline_ms
                 )] = hedge_addr
         pending = set(futures)
         first_error: Optional[Exception] = None
+        return self._first_answer(addr, futures, pending, first_error)
+
+    def _submit_attempt(self, addr: str, client: InferenceClient, raw: bytes,
+                        deadline_ms: Optional[float]):
+        """Dispatch one replica attempt on the hedge pool, carrying the
+        routing thread's trace context across (thread-locals do not), and
+        recording the per-hop latency attribution on success: pool queue
+        wait, the replica's self-reported hold (``X-Server-Ms``), and
+        wire = observed wall − replica hold."""
+        ctx = tracing.current_context()
+        t_sub = time.perf_counter()
+
+        def run():
+            self._m_queue_wait.observe(time.perf_counter() - t_sub)
+            t0 = time.perf_counter()
+            if ctx is not None:
+                with tracing.trace_context(ctx[0], ctx[1]):
+                    with tracing.span("gateway.attempt", replica=addr):
+                        scores, headers = client.predict_bytes_ex(raw, deadline_ms)
+            else:
+                scores, headers = client.predict_bytes_ex(raw, deadline_ms)
+            total = time.perf_counter() - t0
+            try:
+                server_s = float(headers.get("x-server-ms", 0.0)) / 1e3
+            except ValueError:
+                server_s = 0.0
+            if server_s > 0.0:
+                self._m_server_time.observe(server_s)
+                self._m_wire.observe(max(0.0, total - server_s))
+            return scores, headers
+
+        return self._pool.submit(run)
+
+    def _first_answer(self, addr, futures, pending, first_error):
         while pending:
             done, pending = wait(pending, timeout=self.request_timeout_s,
                                  return_when=FIRST_COMPLETED)

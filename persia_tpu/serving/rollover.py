@@ -42,6 +42,7 @@ from persia_tpu.metrics import get_metrics
 from persia_tpu.service.resilience import ResiliencePolicy, RetryPolicy, poll_until
 from persia_tpu.serving.engine import InferenceEngine, clone_infer_ctx
 from persia_tpu.storage import StorageError, StoragePath, storage_path
+from persia_tpu.tracing import record_event
 
 logger = get_default_logger("persia_tpu.serving.rollover")
 
@@ -140,6 +141,11 @@ class ModelRollover:
         authoritative base — a gap's lost signs may exist nowhere else),
         then replay the retained packet tail from clean marks."""
         self._m_resyncs.inc()
+        record_event(
+            "serving.resync",
+            session=self._seen_session or "",
+            has_checkpoint=info is not None,
+        )
         if info is not None and self._seen_session is not None:
             logger.warning(
                 "delta channel damaged: resyncing from checkpoint %s",

@@ -28,11 +28,13 @@ import json
 import socket
 import socketserver
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
 
+from persia_tpu import tracing
 from persia_tpu.logger import get_default_logger
 from persia_tpu.serving.batcher import (
     DeadlineExceededError,
@@ -269,32 +271,17 @@ class ServingServer:
         class Handler(_LeanHandler):
             def route(self, method: str, path: str, headers: dict, body: bytes):
                 if method == "POST" and path == "/predict":
-                    try:
-                        deadline_hdr = headers.get("x-deadline-ms")
-                        deadline_s = (
-                            float(deadline_hdr) / 1e3 if deadline_hdr else None
-                        )
-                        from persia_tpu.data import PersiaBatch
-
-                        scores = outer.batcher.submit(
-                            PersiaBatch.from_bytes(body), deadline_s=deadline_s
-                        )
-                    except QueueFullError as e:
-                        return 429, repr(e).encode(), "text/plain"
-                    except DeadlineExceededError as e:
-                        return 504, repr(e).encode(), "text/plain"
-                    except Exception as e:  # noqa: BLE001 — app error crosses the wire
-                        logger.exception("predict failed")
-                        return 400, repr(e).encode(), "text/plain"
-                    # staleness contract: every answer states how far behind
-                    # the trainer head it was computed, so a caller (or the
-                    # gateway's all-replicas-stale fallback) can judge it
-                    extra = {}
-                    f = outer.freshness()
-                    if f is not None:
-                        extra["X-Staleness-Steps"] = str(int(f["lag_steps"]))
-                    return (200, _npy_bytes(scores),
-                            "application/octet-stream", extra)
+                    # trace contract: a request carrying X-Trace-Id has its
+                    # context adopted for the handler's duration, so the
+                    # replica-side spans (request, batch forward, engine)
+                    # join the caller's timeline
+                    tid = headers.get("x-trace-id")
+                    if tid:
+                        with tracing.trace_context(
+                            tid, headers.get("x-parent-span")
+                        ):
+                            return outer._predict_route(headers, body)
+                    return outer._predict_route(headers, body)
                 if method == "GET" and path == "/healthz":
                     return (200, json.dumps(outer.health()).encode(),
                             "application/json")
@@ -309,6 +296,46 @@ class ServingServer:
         self._httpd = _LeanHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    def _predict_route(self, headers: dict, body: bytes):
+        """The /predict route body (runs under the adopted trace context,
+        if the request carried one)."""
+        t0 = time.perf_counter()
+        try:
+            deadline_hdr = headers.get("x-deadline-ms")
+            deadline_s = (
+                float(deadline_hdr) / 1e3 if deadline_hdr else None
+            )
+            from persia_tpu.data import PersiaBatch
+
+            with tracing.span("serving.request",
+                              replica=self.replica_index):
+                scores = self.batcher.submit(
+                    PersiaBatch.from_bytes(body), deadline_s=deadline_s
+                )
+        except QueueFullError as e:
+            return 429, repr(e).encode(), "text/plain"
+        except DeadlineExceededError as e:
+            return 504, repr(e).encode(), "text/plain"
+        except Exception as e:  # noqa: BLE001 — app error crosses the wire
+            logger.exception("predict failed")
+            return 400, repr(e).encode(), "text/plain"
+        # staleness contract: every answer states how far behind
+        # the trainer head it was computed, so a caller (or the
+        # gateway's all-replicas-stale fallback) can judge it
+        extra = {}
+        f = self.freshness()
+        if f is not None:
+            extra["X-Staleness-Steps"] = str(int(f["lag_steps"]))
+        # latency attribution: the time this replica held the request
+        # (queue wait + coalesced forward) — the gateway subtracts it
+        # from its own wall clock to attribute the wire hop
+        extra["X-Server-Ms"] = f"{(time.perf_counter() - t0) * 1e3:.3f}"
+        tid = tracing.current_trace_id()
+        if tid:
+            extra["X-Trace-Id"] = tid
+        return (200, _npy_bytes(scores),
+                "application/octet-stream", extra)
 
     def freshness(self):
         """Freshness snapshot from the armed incremental loader (None when

@@ -114,6 +114,37 @@ def demo_batch(step: int, rows: int, vocab: int, seed: int = 0,
     )
 
 
+def _arm_telemetry(role: str, trace_dir: Optional[str] = None) -> Optional[int]:
+    """Arm a role's telemetry plane when the parent asked for one
+    (``PERSIA_TRACE_DIR`` in the child env, or an explicit ``trace_dir``):
+    enable tracing tagged with ``role``, serve ``/metrics`` + ``/spans`` +
+    ``/flight`` on a loopback port advertised through an atomic
+    ``<role>.endpoint`` file in the trace dir, arm the flight recorder,
+    and export the span ring on exit. Returns the bound port (None when
+    telemetry is off)."""
+    trace_dir = trace_dir or os.environ.get("PERSIA_TRACE_DIR")
+    if not trace_dir:
+        return None
+    from persia_tpu import tracing
+    from persia_tpu.metrics import get_metrics
+
+    os.makedirs(trace_dir, exist_ok=True)
+    tracing.enable(True)
+    tracing.set_role(role)
+    tracing.install_flight_recorder(
+        os.path.join(trace_dir, f"{role}.flight.json")
+    )
+    port = get_metrics().serve_http(0, host="127.0.0.1")
+    ep = os.path.join(trace_dir, f"{role}.endpoint")
+    tmp = f"{ep}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"role": role, "pid": os.getpid(), "port": port}, f)
+    os.replace(tmp, ep)  # atomic: the collector never reads a torn file
+    tracing.arm_trace_export(os.path.join(trace_dir, f"{role}.trace.json"))
+    logger.info("telemetry armed for %s on 127.0.0.1:%d", role, port)
+    return port
+
+
 def _annotate_checkpoint_step(ckpt_dir: str, step: int) -> None:
     """Stamp the trainer's committed step onto the checkpoint done-marker:
     a replica resyncing from this checkpoint reports the step as its
@@ -160,6 +191,7 @@ def trainer_main(argv: Optional[List[str]] = None) -> int:
     from persia_tpu.chaos import write_progress
     from persia_tpu.incremental import attach_incremental
 
+    _arm_telemetry(f"trainer{args.publisher_index}")
     ctx, _cfg = build_demo_ctx(seed=args.seed)
     store = ctx.worker.lookup_router.replicas[0]
     with ctx:
@@ -225,6 +257,7 @@ def replica_main(argv: Optional[List[str]] = None) -> int:
     from persia_tpu.ctx import InferCtx
     from persia_tpu.serving import ServingServer
 
+    _arm_telemetry(f"replica{args.replica_index}")
     train_ctx, cfg = build_demo_ctx(seed=args.seed)
     # initialize dense shapes off one sample batch; the rollover watcher
     # overlays real weights the moment a checkpoint marker lands
@@ -307,6 +340,7 @@ class LocalTopology:
         delta_chaos=None,
         seed: int = 7,
         startup_timeout_s: float = 120.0,
+        trace_dir: Optional[str] = None,
     ):
         import tempfile
 
@@ -345,6 +379,13 @@ class LocalTopology:
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
             + os.pathsep + self._env.get("PYTHONPATH", "")
         )
+        self.trace_dir = trace_dir
+        if self.trace_dir:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            # children inherit the telemetry contract through the env:
+            # every role arms tracing + its /spans endpoint on boot
+            self._env["PERSIA_TRACE"] = "1"
+            self._env["PERSIA_TRACE_DIR"] = self.trace_dir
 
     # -------------------------------------------------------------- lifecycle
 
@@ -359,6 +400,10 @@ class LocalTopology:
         from persia_tpu.serving import InferenceClient, ReplicaGateway
         from persia_tpu.service.resilience import poll_until
 
+        if self.trace_dir:
+            # the parent process hosts the gateway (and the delta relay
+            # under chaos): its spans and flight events join the fleet too
+            _arm_telemetry("gateway", self.trace_dir)
         coordinator = None
         if self.n_ps > 0:
             from persia_tpu.helper import ServiceCtx
@@ -512,6 +557,116 @@ class LocalTopology:
             out["gateway"] = self.gateway.stats()
         if self.delta_chaos is not None:
             out["delta_channel"] = dict(self.delta_chaos.counts)
+        return out
+
+    # ------------------------------------------------------------- telemetry
+
+    def telemetry_endpoints(self) -> Dict[str, Dict]:
+        """``role -> {pid, port}`` read from the atomic ``<role>.endpoint``
+        files every armed role writes on boot (empty when tracing is off)."""
+        out: Dict[str, Dict] = {}
+        if not self.trace_dir:
+            return out
+        for fn in sorted(os.listdir(self.trace_dir)):
+            if not fn.endswith(".endpoint"):
+                continue
+            try:
+                with open(os.path.join(self.trace_dir, fn)) as f:
+                    info = json.load(f)
+                out[str(info["role"])] = {
+                    "pid": int(info["pid"]), "port": int(info["port"]),
+                }
+            except (OSError, ValueError, KeyError):
+                continue
+        return out
+
+    @staticmethod
+    def _scrape(port: int, path: str, drain: bool = False):
+        """GET one telemetry endpoint; returns ``(doc, offset_us)`` where
+        ``offset_us`` is the remote clock minus the local clock, estimated
+        from the remote ``now_us`` sample against the local midpoint of the
+        request (the classic NTP-style half-RTT handshake)."""
+        import urllib.request
+
+        url = f"http://127.0.0.1:{port}{path}" + ("?drain=1" if drain else "")
+        t0 = time.time()
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            doc = json.loads(resp.read())
+        t1 = time.time()
+        offset_us = float(doc.get("now_us", 0.0)) - (t0 + t1) / 2.0 * 1e6
+        return doc, offset_us
+
+    def _role_events(self, role: str, info: Dict, kind: str, drain: bool):
+        """One role's span (or flight) events, clock-aligned into THIS
+        process's wall clock. A dead role falls back to the trace file its
+        atexit export left behind (offset 0 — same host, same clock)."""
+        try:
+            doc, offset_us = self._scrape(
+                info["port"], f"/{kind}", drain=drain
+            )
+            return doc.get("spans" if kind == "spans" else "events", []), \
+                offset_us
+        except (OSError, ValueError):
+            if kind != "spans":
+                return [], 0.0
+            path = os.path.join(self.trace_dir, f"{role}.trace.json")
+            try:
+                with open(path) as f:
+                    return json.load(f).get("traceEvents", []), 0.0
+            except (OSError, ValueError):
+                return [], 0.0
+
+    def merge_traces(self, out_path: Optional[str] = None,
+                     drain: bool = False) -> Optional[str]:
+        """Fleet aggregation: scrape every role's ``/spans`` ring, align
+        clocks via the offset handshake, and write ONE Perfetto-loadable
+        timeline (plus a merged flight-event ledger) into the trace dir.
+        Returns the merged trace path (None when tracing is off)."""
+        from persia_tpu import tracing
+
+        if not self.trace_dir:
+            return None
+        merged: List[Dict] = []
+        flight: List[Dict] = []
+        meta: List[Dict] = []
+        offsets: Dict[str, float] = {}
+        for role, info in sorted(self.telemetry_endpoints().items()):
+            events, offset_us = self._role_events(role, info, "spans", drain)
+            offsets[role] = offset_us
+            for ev in events:
+                ev = dict(ev)
+                ev["ts"] = float(ev.get("ts", 0.0)) - offset_us
+                merged.append(ev)
+            fl, f_off = self._role_events(role, info, "flight", drain)
+            for ev in fl:
+                ev = dict(ev)
+                ev["ts_us"] = float(ev.get("ts_us", 0.0)) - f_off
+                ev["role"] = role
+                flight.append(ev)
+            # Perfetto names each process track after its role
+            meta.append({
+                "name": "process_name", "ph": "M", "pid": info["pid"],
+                "args": {"name": role},
+            })
+        merged.sort(key=lambda ev: ev.get("ts", 0.0))
+        flight.sort(key=lambda ev: ev.get("ts_us", 0.0))
+        doc = {
+            "traceEvents": meta + merged,
+            "displayTimeUnit": "ms",
+            "metadata": {
+                "merged_by_pid": os.getpid(),
+                "clock_offsets_us": offsets,
+                "roles": sorted(offsets),
+            },
+        }
+        out = out_path or os.path.join(self.trace_dir, "merged_trace.json")
+        tracing._atomic_write_json(out, doc)
+        tracing._atomic_write_json(
+            os.path.join(self.trace_dir, "merged_flight.json"),
+            {"events": flight},
+        )
+        logger.info("merged %d spans + %d flight events from %d roles -> %s",
+                    len(merged), len(flight), len(offsets), out)
         return out
 
     def stop(self) -> None:

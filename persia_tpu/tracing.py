@@ -1,37 +1,54 @@
-"""Stage-latency tracing.
+"""Stage-latency tracing, distributed trace context, and the flight recorder.
 
 Parity target: the reference's pervasive `tracing::debug!` stage timers
 around every pipeline hop (`rust/persia-core/src/forward.rs:591-593,665-669`,
 `embedding_worker_service/mod.rs:909-938`) with the `LOG_LEVEL` env filter
 (`rust/persia-core/src/lib.rs:463-465`).
 
-Adds what the reference lacks: an in-memory ring of completed spans that can
-be exported as a **chrome://tracing / Perfetto JSON** file, so a training-run
-timeline (lookup → stage → device step → grad return) is viewable alongside
-JAX's own profiler traces.
+Adds what the reference lacks:
+
+- an in-memory ring of completed spans exported as **chrome://tracing /
+  Perfetto JSON**, so a training-run timeline (lookup → stage → device step
+  → grad return) is viewable alongside JAX's own profiler traces;
+- a **trace context** (``trace_id/span_id/parent_id``), thread-local and
+  generated at the edge, that rides the RPC frame header (negotiated
+  capability, see ``service/rpc.py``) and the serving path's
+  ``X-Trace-Id``/``X-Parent-Span`` HTTP headers — one id links a client
+  request to the replica's cache probe, and a gradient batch to its
+  journaled PS apply;
+- a **flight recorder**: a bounded ring of structured events (breaker
+  trips, quarantine/heal, resyncs, fence commits, injected chaos faults),
+  each stamped with the active trace_id, dumped atomically on
+  SIGTERM/atexit/uncaught-fatal so every chaos failure has a black box.
 
 Usage::
 
-    from persia_tpu.tracing import span, trace_export
+    from persia_tpu import tracing
 
     tracing.enable()          # or PERSIA_TRACE=1; off by default
-    with span("lookup", slot="cat_0"):
+    with tracing.span("lookup", slot="cat_0"):
         ...
-    trace_export("/tmp/trace.json")
+    tracing.trace_export("/tmp/trace.json")
 
-Spans nest via a thread-local stack; duration is also pushed to the metrics
-Histogram ``persia_stage_duration_seconds`` when metrics are enabled.
+Spans nest via a thread-local context stack; duration is also pushed to the
+metrics Histogram ``persia_stage_duration_seconds`` when metrics are enabled.
+A span on a disabled tracer is a strict no-op — hot paths pay ~nothing by
+default. The flight recorder is always on (its events are rare by
+construction); only the dump path needs arming.
 """
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
+import signal
+import sys
 import threading
 import time
 from collections import deque
 from contextlib import contextmanager
-from typing import Any, Deque, Dict, Optional
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from persia_tpu.logger import get_default_logger
 
@@ -45,15 +62,28 @@ _tls = threading.local()
 # disabled tracer is a no-op, so hot paths pay ~nothing by default.
 _enabled = os.environ.get("PERSIA_TRACE", "0") in ("1", "true")
 _histogram = None
-
-
-def _depth() -> int:
-    return getattr(_tls, "depth", 0)
+# Role tag stamped on exports/flight dumps so the fleet merger can name
+# processes ("trainer0", "replica1", "gateway", ...). Set once per process.
+_role = os.environ.get("PERSIA_ROLE", "")
 
 
 def enable(on: bool = True) -> None:
     global _enabled
     _enabled = on
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_role(role: str) -> None:
+    """Tag this process's spans/flight dumps with a fleet role name."""
+    global _role
+    _role = role
+
+
+def get_role() -> str:
+    return _role or f"proc_{os.getpid()}"
 
 
 def _get_histogram():
@@ -70,22 +100,96 @@ def _get_histogram():
     return _histogram
 
 
+# --------------------------------------------------------------------- context
+#
+# The thread-local stack holds (trace_id, span_id) frames. ``span`` pushes a
+# frame for its own id; ``trace_context`` pushes an adopted frame carrying a
+# REMOTE parent (what arrived on the wire), so spans opened beneath it become
+# children of the caller's span in the merged timeline. The stack works even
+# when tracing is disabled — adoption is cheap and the flight recorder wants
+# the ambient trace_id regardless — but ``span`` itself never touches it on
+# the disabled path.
+
+def _gen_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def current_context() -> Optional[Tuple[str, Optional[str]]]:
+    """The ambient ``(trace_id, span_id)`` to propagate to a downstream hop,
+    or ``None`` when no trace is active on this thread."""
+    st = getattr(_tls, "stack", None)
+    if st:
+        return st[-1]
+    return None
+
+
+def current_trace_id() -> Optional[str]:
+    ctx = current_context()
+    return ctx[0] if ctx else None
+
+
+@contextmanager
+def trace_context(trace_id: Optional[str] = None,
+                  parent_span: Optional[str] = None):
+    """Open (edge) or adopt (wire) a trace scope on this thread.
+
+    With no arguments a fresh ``trace_id`` is generated — this is the edge.
+    With ids parsed off a frame/header, spans beneath become children of the
+    remote caller's span. Yields the ``(trace_id, parent_span)`` frame."""
+    st = _stack()
+    frame = (trace_id or _gen_id(16), parent_span)
+    st.append(frame)
+    try:
+        yield frame
+    finally:
+        st.pop()
+
+
+def wire_headers() -> Dict[str, str]:
+    """HTTP headers carrying the ambient context (empty when none active)."""
+    ctx = current_context()
+    if ctx is None:
+        return {}
+    h = {"X-Trace-Id": ctx[0]}
+    if ctx[1]:
+        h["X-Parent-Span"] = ctx[1]
+    return h
+
+
 @contextmanager
 def span(name: str, **attrs):
     """Time a pipeline stage; logs at debug level, records for export."""
     if not _enabled:
         yield
         return
+    st = _stack()
+    if st:
+        trace_id, parent = st[-1]
+    else:
+        trace_id, parent = _gen_id(16), None  # this span IS the edge
+    span_id = _gen_id(8)
+    st.append((trace_id, span_id))
     t0 = time.perf_counter()
     ts_us = time.time() * 1e6
-    _tls.depth = _depth() + 1
     try:
         yield
     finally:
-        _tls.depth -= 1
+        st.pop()
         dur = time.perf_counter() - t0
-        logger.debug("%s%s took %.3f ms %s", "  " * _depth(), name, dur * 1e3,
+        logger.debug("%s%s took %.3f ms %s", "  " * len(st), name, dur * 1e3,
                      attrs if attrs else "")
+        args = {k: str(v) for k, v in attrs.items()}
+        args["trace_id"] = trace_id
+        args["span_id"] = span_id
+        if parent:
+            args["parent_id"] = parent
         with _lock:
             _spans.append({
                 "name": name,
@@ -94,11 +198,31 @@ def span(name: str, **attrs):
                 "dur": dur * 1e6,
                 "pid": os.getpid(),
                 "tid": threading.get_ident() % 2**31,
-                "args": {k: str(v) for k, v in attrs.items()},
+                "args": args,
             })
         h = _get_histogram()
         if h:
             h.observe(dur, stage=name)
+
+
+@contextmanager
+def stage_span(name: str, **attrs):
+    """Pipeline-stage timer that ALWAYS feeds the live stage histogram
+    (``persia_stage_duration_seconds{stage=...}``) and records a trace span
+    only when tracing is enabled. The sanctioned replacement for hand-rolled
+    ``t0 = time.time()`` stage timers in pipeline modules (persia-lint
+    OBS002); the bench reads the same series the trace viewer shows."""
+    if _enabled:
+        with span(name, **attrs):
+            yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        h = _get_histogram()
+        if h:
+            h.observe(time.perf_counter() - t0, stage=name)
 
 
 def timed(name: Optional[str] = None):
@@ -123,15 +247,183 @@ def spans_snapshot() -> list:
         return list(_spans)
 
 
+def spans_drain() -> list:
+    """Snapshot AND clear the ring in one lock hold — the ``/spans``
+    endpoint uses this so the fleet collector never double-counts."""
+    with _lock:
+        out = list(_spans)
+        _spans.clear()
+    return out
+
+
 def clear() -> None:
     with _lock:
         _spans.clear()
 
 
+def _atomic_write_json(path: str, doc: Dict[str, Any]) -> None:
+    """temp + fsync + rename: the artifact never exists half-written (the
+    same durable-write discipline persia-lint DUR001 polices elsewhere)."""
+    data = json.dumps(doc).encode()
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def export_doc(events: Optional[List[Dict[str, Any]]] = None) -> Dict[str, Any]:
+    """The per-role export document: trace events plus the clock/role
+    metadata the fleet merger needs to align and name this process."""
+    return {
+        "traceEvents": spans_snapshot() if events is None else events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "role": get_role(),
+            "pid": os.getpid(),
+            "clock_unix_us": time.time() * 1e6,
+        },
+    }
+
+
 def trace_export(path: str) -> int:
     """Write the span ring as chrome://tracing JSON; returns span count."""
-    events = spans_snapshot()
-    with open(path, "w") as f:
-        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
-    logger.info("exported %d trace events to %s", len(events), path)
-    return len(events)
+    doc = export_doc()
+    _atomic_write_json(path, doc)
+    n = len(doc["traceEvents"])
+    logger.info("exported %d trace events to %s", n, path)
+    return n
+
+
+# ------------------------------------------------------------ flight recorder
+#
+# A bounded ring of structured events — the black box. Unlike spans it is
+# ALWAYS on: the events it records (breaker trips, quarantine/heal, resyncs,
+# fence commits, injected chaos faults) are rare by construction, so the
+# cost is one dict build + deque append per event. Each event is stamped
+# with the ambient trace_id so a chaos fault can be correlated with the
+# request/batch it hit. ``install_flight_recorder`` arms an atomic dump on
+# SIGTERM, atexit, and uncaught fatal exceptions.
+
+_FLIGHT_MAX = int(os.environ.get("PERSIA_FLIGHT_BUFFER", "4096"))
+_flight_lock = threading.Lock()
+_flight: Deque[Dict[str, Any]] = deque(maxlen=_FLIGHT_MAX)
+_flight_seq = 0
+_flight_path: Optional[str] = None
+_flight_installed = False
+
+
+def record_event(kind: str, **attrs) -> Dict[str, Any]:
+    """Append a structured event to the flight ring (always on)."""
+    global _flight_seq
+    evt = {
+        "kind": kind,
+        "ts_us": time.time() * 1e6,
+        "pid": os.getpid(),
+        "tid": threading.get_ident() % 2**31,
+        "trace_id": current_trace_id(),
+        "attrs": {k: str(v) for k, v in attrs.items()},
+    }
+    with _flight_lock:
+        evt["seq"] = _flight_seq
+        _flight_seq += 1
+        _flight.append(evt)
+    return evt
+
+
+def flight_snapshot() -> list:
+    with _flight_lock:
+        return list(_flight)
+
+
+def flight_clear() -> None:
+    global _flight_seq
+    with _flight_lock:
+        _flight.clear()
+        _flight_seq = 0
+
+
+def flight_dump(path: Optional[str] = None) -> Optional[str]:
+    """Atomically write the flight ring (and its metadata) to ``path`` or
+    the armed path; returns the path written, or None when unarmed."""
+    target = path or _flight_path
+    if not target:
+        return None
+    doc = {
+        "role": get_role(),
+        "pid": os.getpid(),
+        "dumped_unix_us": time.time() * 1e6,
+        "events": flight_snapshot(),
+    }
+    _atomic_write_json(target, doc)
+    return target
+
+
+def _dump_quietly() -> None:
+    try:
+        flight_dump()
+    except Exception:  # noqa: BLE001 — a failing black box must not mask the crash
+        pass
+    if _export_path:
+        try:
+            # write directly (no logging): at interpreter exit the log
+            # streams may already be closed, and logging then prints a
+            # "--- Logging error ---" traceback over the real output
+            _atomic_write_json(_export_path, export_doc())
+        except Exception:  # noqa: BLE001
+            pass
+
+
+_export_path: Optional[str] = None
+_export_armed = False
+
+
+def arm_trace_export(path: str) -> None:
+    """Arm a span-ring export to ``path`` at interpreter exit AND alongside
+    any flight dump (SIGTERM / fatal excepthook) — a terminated role still
+    leaves its timeline behind for the fleet merger's dead-role fallback."""
+    global _export_path, _export_armed
+    _export_path = path
+    if not _export_armed:
+        _export_armed = True
+        atexit.register(_dump_quietly)
+
+
+def install_flight_recorder(path: str) -> None:
+    """Arm the flight recorder to dump to ``path`` on SIGTERM, interpreter
+    exit, and uncaught fatal exceptions. Chains any handlers already
+    installed (topology roles install their own SIGTERM shutdown first)."""
+    global _flight_path, _flight_installed
+    _flight_path = path
+    if _flight_installed:
+        return
+    _flight_installed = True
+    atexit.register(_dump_quietly)
+
+    prev_hook = sys.excepthook
+
+    def hook(exc_type, exc, tb):
+        record_event("fatal", exc=f"{exc_type.__name__}: {exc}")
+        _dump_quietly()
+        prev_hook(exc_type, exc, tb)
+
+    sys.excepthook = hook
+
+    try:
+        prev_term = signal.getsignal(signal.SIGTERM)
+
+        def on_term(signum, frame):
+            record_event("sigterm")
+            _dump_quietly()
+            if callable(prev_term):
+                prev_term(signum, frame)
+            else:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, on_term)
+    except ValueError:
+        pass  # not the main thread: atexit + excepthook still cover us

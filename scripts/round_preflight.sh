@@ -48,6 +48,29 @@ echo "== 1/5 chaos suite (fast schedules + resume-chaos + serving-chaos) =="
 # and the zipfian online soak (benchmarks/online_bench.py) ride slow
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_failure_recovery.py tests/test_jobstate.py tests/test_serving_chaos.py tests/test_incremental.py -q -m 'not slow'
 
+echo "== 1.5/5 telemetry plane (trace propagation + flight recorder) =="
+# the fast tracing/telemetry subset: span mechanics, RPC + gateway HTTP
+# trace propagation, the flight-recorder dump paths, and the per-role
+# /spans endpoints (the merged-fleet topology pin rides the full suite)
+JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py -q -m 'not slow' \
+    --deselect tests/test_telemetry.py::test_local_topology_merged_trace
+# tracing-disabled overhead guard: a span on a disabled tracer must stay
+# a no-op — no id generation, no record, no ring append
+JAX_PLATFORMS=cpu python - <<'PY'
+import time
+from persia_tpu import tracing
+assert not tracing.enabled()
+n = 200_000
+t0 = time.perf_counter()
+for _ in range(n):
+    with tracing.span("preflight.noop"):
+        pass
+per_us = (time.perf_counter() - t0) / n * 1e6
+assert tracing.spans_snapshot() == [], "disabled tracer recorded spans"
+assert per_us < 25.0, f"disabled span costs {per_us:.2f}us (no-op bound 25us)"
+print(f"disabled-span overhead {per_us:.2f}us/call OK")
+PY
+
 echo "== 2/5 test suite =="
 python -m pytest tests/ -q
 

@@ -1,0 +1,8 @@
+"""Seeded OBS001 violation: metric registered off-namespace."""
+
+from persia_tpu.metrics import get_metrics
+
+m = get_metrics()
+REQS = m.counter("http_requests_total", "requests served")      # OBS001
+LAT = m.histogram("request_latency_seconds", "request latency")  # OBS001
+OK = m.gauge("persia_tpu_fixture_ok", "properly namespaced")     # clean

@@ -1,0 +1,24 @@
+"""Seeded OBS002 violation: hand-rolled stage timer in a pipeline module."""
+
+import time
+
+stats = {"prep_s": 0.0}
+
+
+def prepare_batch(batch):
+    t0 = time.perf_counter()            # OBS002: invisible stage duration
+    out = [x * 2 for x in batch]
+    stats["prep_s"] += time.perf_counter() - t0
+    return out
+
+
+def prepare_batch_spanned(batch):
+    from persia_tpu.tracing import stage_span
+
+    with stage_span("fixture.prep"):    # clean: sanctioned mechanism
+        return [x * 2 for x in batch]
+
+
+def timed_by_metric(batch, hist):
+    with hist.time(stage="prep"):       # clean: metric timer context
+        return [x * 2 for x in batch]

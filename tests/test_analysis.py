@@ -303,6 +303,53 @@ def test_durability_suppression_works():
     assert apply_suppressions(raw, {"supp.py": src}) == []
 
 
+# --------------------------------------------------- observability fixtures
+
+
+def test_obs001_off_namespace_metric_fires():
+    from persia_tpu.analysis import observability_lint
+
+    findings = observability_lint.check_source(
+        read_text(_fixture("obs_bad_metric_name.py")), "obs_bad_metric_name.py"
+    )
+    # the two off-namespace registrations fire; the persia_tpu_ one is clean
+    assert [f.rule for f in findings] == ["OBS001", "OBS001"], findings
+
+
+def test_obs002_manual_stage_timer_fires():
+    from persia_tpu.analysis import observability_lint
+
+    findings = observability_lint.check_source(
+        read_text(_fixture("obs_manual_timer.py")), "obs_manual_timer.py",
+        timer_scope=True,
+    )
+    # only the raw-clock function fires; the stage_span and metric-.time()
+    # flavors are the sanctioned mechanisms
+    assert [f.rule for f in findings] == ["OBS002"], findings
+
+
+def test_obs002_scope_is_pipeline_modules_only():
+    from persia_tpu.analysis import observability_lint
+
+    src = read_text(_fixture("obs_manual_timer.py"))
+    # same source outside the pipeline scope: OBS002 must stay silent
+    # (deadline math in service/resilience.py is RES004's business)
+    assert observability_lint.check_source(src, "tools/somescript.py") == []
+
+
+def test_obs_suppression_works():
+    from persia_tpu.analysis import observability_lint
+
+    src = (
+        "def reg(m):\n"
+        "    return m.counter('requests_total', 'x')"
+        "  # persia-lint: disable=OBS001\n"
+    )
+    raw = observability_lint.check_source(src, "supp.py")
+    assert {f.rule for f in raw} == {"OBS001"}
+    assert apply_suppressions(raw, {"supp.py": src}) == []
+
+
 # ------------------------------------------------------------- clean tree
 
 

@@ -33,7 +33,10 @@ def test_span_records_and_exports(tmp_path):
     spans = tracing.spans_snapshot()
     names = [s["name"] for s in spans]
     assert names == ["inner", "outer"]  # completion order
-    assert spans[1]["args"] == {"key": "v"}
+    # args carry the user attrs plus the span's trace identity
+    assert spans[1]["args"]["key"] == "v"
+    assert spans[1]["args"]["trace_id"] == spans[0]["args"]["trace_id"]
+    assert spans[0]["args"]["parent_id"] == spans[1]["args"]["span_id"]
     assert spans[1]["dur"] >= spans[0]["dur"]
 
     p = tmp_path / "trace.json"
