@@ -363,7 +363,25 @@ def _stream_record(ctx, samples_per_sec: float) -> dict:
         # (synthetic) lookups must say so in its own record
         "degraded_steps": st.get("degraded_steps", 0),
         "degraded_lookup_frac_max": st.get("degraded_lookup_frac_max", 0.0),
+        # tier accounting (auto-tiering observability): where every slot
+        # lives at stream end, per-group occupancy, and the cache hit rate
+        # — a placement regression shows up here before it shows up in
+        # samples_per_sec
+        "tiers": st.get("tiers"),
+        "migrations": st.get("migrations", 0),
+        "cache_hit_rate": _cache_hit_rate(),
     }
+
+
+def _cache_hit_rate():
+    """Process-cumulative HBM hit rate from the tier's metrics (each bench
+    mode runs subprocess-isolated, so cumulative == this run)."""
+    from persia_tpu.metrics import get_metrics
+
+    snap = get_metrics().snapshot(prefix="persia_tpu_cache_")
+    hit = sum((snap.get("persia_tpu_cache_hit_count") or {}).values())
+    miss = sum((snap.get("persia_tpu_cache_miss_count") or {}).values())
+    return round(hit / (hit + miss), 4) if hit + miss else None
 
 
 def _zipf_batch_maker(seed: int = 0):

@@ -749,3 +749,284 @@ int64_t cache_feed_batch(void* h, void* pending_h,
 }
 
 }  // extern "C"
+
+// -------------------------------------------------------- access sketch
+//
+// Per-slot frequency / working-set sketch for the auto-tiering profiler
+// (persia_tpu/embedding/tiering/). The feeder already walks every sign of
+// every batch through cache_feed_batch, so this piggybacks on that stream:
+// one sketch_observe call per group per step, attributing positions to
+// slots by stride (the single-id fast path feeds a (S, B) prefixed sign
+// matrix flattened row-major, so position i belongs to slot i / B).
+//
+// Three estimators, all O(1) per sign:
+//   - a SHARED count-min (depth x width u32, the slot index mixed into the
+//     key so identical raw signs in different slots don't collide) gives
+//     per-sign frequency estimates;
+//   - per-slot decayed totals (double) give the access mass;
+//   - per-slot two-window linear-counting bitmaps give a decayed
+//     distinct-sign (working set) estimate: observes set bits in the
+//     CURRENT window, a decay swaps windows, and the estimate reads the
+//     UNION of both — a sliding working set over the last two decay
+//     periods, immune to the reset cliff a single bitmap would have;
+//   - a per-slot top-K heavy-hitter list (count-min estimates) gives the
+//     hot-mass fraction the planner uses to separate "skewed, cacheable"
+//     from "uniform, stream-through" slots.
+//
+// Everything is guarded by one mutex: observe runs on the feeder thread,
+// decay/stats/export on the fence (main) thread. The export is a
+// versioned, geometry-checked byte blob so the profiler state rides a
+// jobstate snapshot and resumes bit-identically.
+
+namespace {
+
+constexpr uint64_t SK_MAGIC = 0x70736b3176ULL;  // "psk1v"
+constexpr uint64_t SK_SLOT_MIX = 0x9E3779B97F4A7C15ULL;
+constexpr uint64_t SK_BM_SEED = 0x5BF03635F0C59A1FULL;
+constexpr int64_t SK_MAX_DEPTH = 8;
+constexpr uint64_t SK_DEPTH_SEED[SK_MAX_DEPTH] = {
+    0xA076D1F3E59B7C21ULL, 0x2545F4914F6CDD1DULL, 0xDE916ABCC965815BULL,
+    0x8C5FB1B7D477F4C1ULL, 0x27D4EB2F165667C5ULL, 0x165667B19E3779F9ULL,
+    0xC2B2AE3D27D4EB4FULL, 0x9E3779B185EBCA87ULL,
+};
+
+struct AccessSketch {
+  std::mutex mu;
+  int64_t n_slots = 0, depth = 0, width = 0, bitmap_bits = 0, topk = 0;
+  uint64_t width_mask = 0;
+  int64_t bm_words = 0;
+  std::vector<uint32_t> cm;          // depth * width
+  std::vector<double> totals;        // n_slots
+  std::vector<uint64_t> bits_cur;    // n_slots * bm_words
+  std::vector<uint64_t> bits_prev;   // n_slots * bm_words
+  std::vector<uint64_t> top_sign;    // n_slots * topk
+  std::vector<double> top_est;       // n_slots * topk
+
+  // caller holds mu
+  inline uint32_t observe_one(int64_t slot, uint64_t sign) {
+    const uint64_t key = sign ^ ((uint64_t)slot * SK_SLOT_MIX);
+    uint32_t est = UINT32_MAX;
+    for (int64_t d = 0; d < depth; ++d) {
+      const uint64_t idx = splitmix64(key ^ SK_DEPTH_SEED[d]) & width_mask;
+      uint32_t& c = cm[(size_t)(d * width + (int64_t)idx)];
+      if (c != UINT32_MAX) ++c;
+      if (c < est) est = c;
+    }
+    totals[(size_t)slot] += 1.0;
+    const uint64_t b = splitmix64(key ^ SK_BM_SEED) % (uint64_t)bitmap_bits;
+    bits_cur[(size_t)(slot * bm_words + (int64_t)(b >> 6))] |=
+        (uint64_t)1 << (b & 63);
+    return est;
+  }
+
+  // caller holds mu: keep the slot's top-K heavy hitters by cm estimate
+  inline void maybe_top(int64_t slot, uint64_t sign, uint32_t est) {
+    double* e = &top_est[(size_t)(slot * topk)];
+    uint64_t* s = &top_sign[(size_t)(slot * topk)];
+    int64_t min_i = 0;
+    for (int64_t k = 0; k < topk; ++k) {
+      if (s[k] == sign && e[k] > 0.0) {
+        if ((double)est > e[k]) e[k] = (double)est;
+        return;
+      }
+      if (e[k] < e[min_i]) min_i = k;
+    }
+    if ((double)est > e[min_i]) {
+      s[min_i] = sign;
+      e[min_i] = (double)est;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// width_log2: log2 of the count-min row width; depth in [1, 8];
+// bitmap_bits is rounded up to a multiple of 64; topk >= 1.
+void* sketch_create(int64_t n_slots, int64_t width_log2, int64_t depth,
+                    int64_t bitmap_bits, int64_t topk) {
+  if (n_slots <= 0 || width_log2 < 4 || width_log2 > 28 || depth < 1 ||
+      depth > SK_MAX_DEPTH || bitmap_bits < 64 || topk < 1)
+    return nullptr;
+  auto* sk = new (std::nothrow) AccessSketch();
+  if (!sk) return nullptr;
+  sk->n_slots = n_slots;
+  sk->depth = depth;
+  sk->width = (int64_t)1 << width_log2;
+  sk->width_mask = (uint64_t)(sk->width - 1);
+  sk->bitmap_bits = (bitmap_bits + 63) & ~(int64_t)63;
+  sk->bm_words = sk->bitmap_bits >> 6;
+  sk->topk = topk;
+  sk->cm.assign((size_t)(sk->depth * sk->width), 0);
+  sk->totals.assign((size_t)n_slots, 0.0);
+  sk->bits_cur.assign((size_t)(n_slots * sk->bm_words), 0);
+  sk->bits_prev.assign((size_t)(n_slots * sk->bm_words), 0);
+  sk->top_sign.assign((size_t)(n_slots * topk), 0);
+  sk->top_est.assign((size_t)(n_slots * topk), 0.0);
+  return sk;
+}
+
+void sketch_destroy(void* h) { delete static_cast<AccessSketch*>(h); }
+
+int64_t sketch_n_slots(void* h) {
+  return static_cast<AccessSketch*>(h)->n_slots;
+}
+
+// Strided attribution: position i belongs to slot_base + i/samples_per_slot
+// (the feeder's flattened (S, B) group matrix); samples_per_slot <= 0 sends
+// every sign to slot_base (the general path's per-slot calls). Signs
+// falling past n_slots are dropped (defensive — the Python side sizes the
+// call). Returns the number of signs observed.
+int64_t sketch_observe(void* h, const uint64_t* signs, int64_t n,
+                       int64_t samples_per_slot, int64_t slot_base) {
+  AccessSketch& sk = *static_cast<AccessSketch*>(h);
+  std::lock_guard<std::mutex> lk(sk.mu);
+  int64_t seen = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t slot =
+        slot_base + (samples_per_slot > 0 ? i / samples_per_slot : 0);
+    if (slot < 0 || slot >= sk.n_slots) continue;
+    const uint32_t est = sk.observe_one(slot, signs[i]);
+    sk.maybe_top(slot, signs[i], est);
+    ++seen;
+  }
+  return seen;
+}
+
+// Exponential decay: scales the count-min counters, per-slot totals and
+// heavy-hitter estimates by `factor` (clamped to [0, 1]) and slides the
+// working-set window (prev = cur, cur cleared). Called at fences.
+void sketch_decay(void* h, double factor) {
+  AccessSketch& sk = *static_cast<AccessSketch*>(h);
+  std::lock_guard<std::mutex> lk(sk.mu);
+  if (factor < 0.0) factor = 0.0;
+  if (factor > 1.0) factor = 1.0;
+  for (auto& c : sk.cm) c = (uint32_t)((double)c * factor);
+  for (auto& t : sk.totals) t *= factor;
+  for (auto& e : sk.top_est) e *= factor;
+  sk.bits_prev = sk.bits_cur;
+  std::fill(sk.bits_cur.begin(), sk.bits_cur.end(), 0);
+}
+
+// out[0] = decayed access total, out[1] = distinct-sign (working set)
+// estimate over the union of both windows (linear counting),
+// out[2] = hot-mass fraction (top-K estimate mass / total),
+// out[3] = top-1 fraction. Returns 0, or -1 on a bad slot index.
+int64_t sketch_slot_stats(void* h, int64_t slot, double* out) {
+  AccessSketch& sk = *static_cast<AccessSketch*>(h);
+  std::lock_guard<std::mutex> lk(sk.mu);
+  if (slot < 0 || slot >= sk.n_slots) return -1;
+  int64_t ones = 0;
+  const uint64_t* c = &sk.bits_cur[(size_t)(slot * sk.bm_words)];
+  const uint64_t* p = &sk.bits_prev[(size_t)(slot * sk.bm_words)];
+  for (int64_t w = 0; w < sk.bm_words; ++w)
+    ones += __builtin_popcountll(c[w] | p[w]);
+  const double m = (double)sk.bitmap_bits;
+  const int64_t zeros = sk.bitmap_bits - ones;
+  const double unique = zeros == 0 ? m : m * std::log(m / (double)zeros);
+  const double total = sk.totals[(size_t)slot];
+  double hot = 0.0, top1 = 0.0;
+  const double* e = &sk.top_est[(size_t)(slot * sk.topk)];
+  for (int64_t k = 0; k < sk.topk; ++k) {
+    hot += e[k];
+    if (e[k] > top1) top1 = e[k];
+  }
+  out[0] = total;
+  out[1] = unique;
+  out[2] = total > 0.0 ? std::min(1.0, hot / total) : 0.0;
+  out[3] = total > 0.0 ? std::min(1.0, top1 / total) : 0.0;
+  return 0;
+}
+
+// Count-min point estimate for (slot, sign) — test/introspection surface.
+double sketch_estimate(void* h, int64_t slot, uint64_t sign) {
+  AccessSketch& sk = *static_cast<AccessSketch*>(h);
+  std::lock_guard<std::mutex> lk(sk.mu);
+  if (slot < 0 || slot >= sk.n_slots) return -1.0;
+  const uint64_t key = sign ^ ((uint64_t)slot * SK_SLOT_MIX);
+  uint32_t est = UINT32_MAX;
+  for (int64_t d = 0; d < sk.depth; ++d) {
+    const uint64_t idx = splitmix64(key ^ SK_DEPTH_SEED[d]) & sk.width_mask;
+    const uint32_t v = sk.cm[(size_t)(d * sk.width + (int64_t)idx)];
+    if (v < est) est = v;
+  }
+  return (double)est;
+}
+
+int64_t sketch_export_size(void* h) {
+  AccessSketch& sk = *static_cast<AccessSketch*>(h);
+  std::lock_guard<std::mutex> lk(sk.mu);
+  return (int64_t)(sizeof(uint64_t) * 7 + sk.cm.size() * sizeof(uint32_t) +
+                   sk.totals.size() * sizeof(double) +
+                   (sk.bits_cur.size() + sk.bits_prev.size() +
+                    sk.top_sign.size()) * sizeof(uint64_t) +
+                   sk.top_est.size() * sizeof(double));
+}
+
+// Versioned byte blob: magic + geometry header, then the raw arrays.
+// Returns bytes written, or -1 when cap is too small.
+int64_t sketch_export(void* h, uint8_t* out, int64_t cap) {
+  AccessSketch& sk = *static_cast<AccessSketch*>(h);
+  std::lock_guard<std::mutex> lk(sk.mu);
+  const uint64_t hdr[7] = {SK_MAGIC, 1,
+                           (uint64_t)sk.n_slots, (uint64_t)sk.depth,
+                           (uint64_t)sk.width, (uint64_t)sk.bitmap_bits,
+                           (uint64_t)sk.topk};
+  int64_t need = (int64_t)sizeof(hdr);
+  need += (int64_t)(sk.cm.size() * sizeof(uint32_t));
+  need += (int64_t)(sk.totals.size() * sizeof(double));
+  need += (int64_t)((sk.bits_cur.size() + sk.bits_prev.size() +
+                     sk.top_sign.size()) * sizeof(uint64_t));
+  need += (int64_t)(sk.top_est.size() * sizeof(double));
+  if (cap < need) return -1;
+  uint8_t* q = out;
+  auto put = [&q](const void* src, size_t nb) {
+    __builtin_memcpy(q, src, nb);
+    q += nb;
+  };
+  put(hdr, sizeof(hdr));
+  put(sk.cm.data(), sk.cm.size() * sizeof(uint32_t));
+  put(sk.totals.data(), sk.totals.size() * sizeof(double));
+  put(sk.bits_cur.data(), sk.bits_cur.size() * sizeof(uint64_t));
+  put(sk.bits_prev.data(), sk.bits_prev.size() * sizeof(uint64_t));
+  put(sk.top_sign.data(), sk.top_sign.size() * sizeof(uint64_t));
+  put(sk.top_est.data(), sk.top_est.size() * sizeof(double));
+  return (int64_t)(q - out);
+}
+
+// Geometry must match the receiving sketch exactly (the profiler
+// re-creates it from the same config before importing). Returns 0, or -1
+// on a short/mismatched blob.
+int64_t sketch_import(void* h, const uint8_t* data, int64_t n) {
+  AccessSketch& sk = *static_cast<AccessSketch*>(h);
+  std::lock_guard<std::mutex> lk(sk.mu);
+  uint64_t hdr[7];
+  if (n < (int64_t)sizeof(hdr)) return -1;
+  __builtin_memcpy(hdr, data, sizeof(hdr));
+  if (hdr[0] != SK_MAGIC || hdr[1] != 1 || hdr[2] != (uint64_t)sk.n_slots ||
+      hdr[3] != (uint64_t)sk.depth || hdr[4] != (uint64_t)sk.width ||
+      hdr[5] != (uint64_t)sk.bitmap_bits || hdr[6] != (uint64_t)sk.topk)
+    return -1;
+  const uint8_t* q = data + sizeof(hdr);
+  int64_t left = n - (int64_t)sizeof(hdr);
+  auto take = [&q, &left](void* dst, size_t nb) -> bool {
+    if (left < (int64_t)nb) return false;
+    __builtin_memcpy(dst, q, nb);
+    q += nb;
+    left -= (int64_t)nb;
+    return true;
+  };
+  if (!take(sk.cm.data(), sk.cm.size() * sizeof(uint32_t))) return -1;
+  if (!take(sk.totals.data(), sk.totals.size() * sizeof(double))) return -1;
+  if (!take(sk.bits_cur.data(), sk.bits_cur.size() * sizeof(uint64_t)))
+    return -1;
+  if (!take(sk.bits_prev.data(), sk.bits_prev.size() * sizeof(uint64_t)))
+    return -1;
+  if (!take(sk.top_sign.data(), sk.top_sign.size() * sizeof(uint64_t)))
+    return -1;
+  if (!take(sk.top_est.data(), sk.top_est.size() * sizeof(double))) return -1;
+  return 0;
+}
+
+}  // extern "C"

@@ -32,6 +32,9 @@ Rules:
 - ABI008 call through a CDLL handle to a symbol with no argtypes in that
          file (untyped foreign call — every argument silently becomes the
          ctypes default conversion)
+- ABI009 a persia_tpu/ file calls ctypes.CDLL but is absent from the
+         ``common.CTYPES_FILES`` registry — a binding surface the drift
+         checker silently skips (registry completeness)
 """
 
 from __future__ import annotations
@@ -44,9 +47,11 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 from persia_tpu.analysis import cparse
 from persia_tpu.analysis.common import (
     BINDING_FILES,
+    CTYPES_FILES,
     NATIVE_LIBS,
     REPO_ROOT,
     Finding,
+    ctypes_loader_files,
     read_text,
     rel,
 )
@@ -481,6 +486,22 @@ def check(
                     "ABI006", fn.path, fn.line,
                     f"{symbol} is exported by {libkey} but has no ctypes "
                     "binding in any registered binding file",
+                ))
+
+    # ABI009: registry completeness — every CDLL loader under persia_tpu/
+    # must be listed in CTYPES_FILES (the superset containing BINDING_FILES),
+    # else its bindings never reach this cross-check. Only enforced against
+    # the real registry: fixture-driven tests pass a custom binding_files
+    # list whose synthetic trees have no registry to be complete against.
+    if binding_files == list(BINDING_FILES) and libs is None:
+        registered = set(CTYPES_FILES)
+        for loader in ctypes_loader_files(root):
+            if loader not in registered:
+                findings.append(Finding(
+                    "ABI009", loader, 1,
+                    "file calls ctypes.CDLL but is not registered in "
+                    "common.CTYPES_FILES — the ABI drift checker is "
+                    "silently skipping its bindings",
                 ))
 
     # ABI008: untyped calls through a CDLL handle

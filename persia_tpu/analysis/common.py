@@ -15,6 +15,7 @@ toolchain-less host in well under a second, so it can gate every commit
 
 from __future__ import annotations
 
+import ast
 import os
 import re
 from dataclasses import dataclass
@@ -115,6 +116,7 @@ BINDING_FILES: List[str] = [
     "persia_tpu/embedding/hbm_cache/directory.py",
     "persia_tpu/embedding/native_store.py",
     "persia_tpu/embedding/native_worker.py",
+    "persia_tpu/embedding/tiering/native.py",
     "persia_tpu/service/codec.py",
     "persia_tpu/service/native_rpc.py",
 ]
@@ -130,3 +132,29 @@ CTYPES_FILES: List[str] = BINDING_FILES + [
     "persia_tpu/embedding/hbm_cache/stream.py",
     "persia_tpu/embedding/hbm_cache/tier.py",
 ]
+
+
+def ctypes_loader_files(root: str = REPO_ROOT) -> List[str]:
+    """Repo-relative persia_tpu/ files that load a native library via
+    ``ctypes.CDLL``. The ABI pass (ABI009) diffs this against CTYPES_FILES:
+    a loader the registry does not know about is a binding surface the
+    drift checker silently skips. AST-based so comments, docstrings, and
+    the lint passes' own string literals never count as call sites."""
+    out: List[str] = []
+    for path in python_files(root):
+        text = read_text(path)
+        if "CDLL" not in text:  # cheap pre-filter before parsing
+            continue
+        try:
+            tree = ast.parse(text, filename=path)
+        except SyntaxError:
+            continue  # the style passes own broken-file reporting
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                f = node.func
+                name = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else "")
+                if name == "CDLL":
+                    out.append(os.path.relpath(path, root))
+                    break
+    return sorted(out)

@@ -116,6 +116,19 @@ class CachedTrainCtx:
         # in-flight window would overrun.
         self.wb_ring_rows = int(wb_ring_rows)
         self._ev_rings: Dict[str, jnp.ndarray] = {}
+        # live-migration bookkeeping (tiering): the constructor args a
+        # fence-point re-registration rebuilds the tier/step from, the
+        # explicit ps exclude set as it evolves, and the migration hooks
+        self.cache_rows = cache_rows
+        self._admit_touches = int(admit_touches)
+        self._aux_wire_dtype = aux_wire_dtype
+        self._loss_fn = loss_fn
+        self._ps_wire_dtype = ps_wire_dtype
+        self._ls_growth_interval = loss_scale_growth_interval
+        self._ls_max = loss_scale_max
+        self._ps_exclude: Set[str] = set(ps_slots)
+        self._auto_tier = None
+        self._pending_migration: Optional[Dict] = None
         self.tier = CachedEmbeddingTier(
             worker, self.sparse_cfg, cache_rows, embedding_config,
             init_seed=init_seed, ps_slots=ps_slots,
@@ -887,6 +900,168 @@ class CachedTrainCtx:
         self.flush()
         self.worker.load(src)
 
+    # ------------------------------------------------------- live migration
+
+    def attach_auto_tier(self, controller) -> None:
+        """Attach a ``tiering.AutoTierController``: its profiler taps the
+        tier's admit walk from the next batch on, and the stream's fences
+        drive planning/migration (``_maybe_migrate_at_fence``)."""
+        self._auto_tier = controller
+        self.tier.profiler = controller.profiler
+
+    @property
+    def auto_tier(self):
+        return self._auto_tier
+
+    def request_migration(
+        self,
+        to_cached: Sequence[str] = (),
+        to_ps: Sequence[str] = (),
+        cache_rows: "int | Dict[int, int] | None" = None,
+    ) -> None:
+        """Queue a manual tier migration; it applies at the NEXT stream
+        snapshot fence (feeder parked, hazard ledger drained, manifest
+        committed) — the only point where the PS provably holds the single
+        authoritative copy of every moving slot."""
+        self._pending_migration = {
+            "to_cached": tuple(to_cached), "to_ps": tuple(to_ps),
+            "cache_rows": cache_rows,
+        }
+
+    def apply_migration(
+        self,
+        to_cached: Sequence[str] = (),
+        to_ps: Sequence[str] = (),
+        cache_rows: "int | Dict[int, int] | None" = None,
+    ) -> None:
+        """Re-register slots between the cached and ps tiers. The cache
+        MUST be cold (every directory drained — i.e. immediately after
+        ``flush``/``_fence_capture``): with all rows flushed, the PS holds
+        the only copy of every embedding and the move is pure metadata —
+        rebuild the tier (directories, salts, groups), the step programs
+        (their traces close over the group list), and the device pools.
+
+        Bit-parity contract: a run migrated at fence F matches a run
+        RESUMED from F's manifest directly into the final placement — both
+        start from the identical flushed PS state and run identical device
+        programs from F on (tests/test_tiering.py pins it)."""
+        to_cached, to_ps = set(to_cached), set(to_ps)
+        if to_cached & to_ps:
+            raise ValueError(
+                f"slots in both directions: {sorted(to_cached & to_ps)}"
+            )
+        slots_cfg = self.embedding_config.slots_config
+        for s in to_cached | to_ps:
+            if s not in slots_cfg:
+                raise KeyError(f"unknown slot {s!r} (not in embedding config)")
+        for s in to_cached:
+            if slots_cfg[s].hash_stack_config.enabled:
+                raise ValueError(
+                    f"slot {s!r} is hash-stacked: it is served by the "
+                    "worker/PS path and cannot move into the cache tier"
+                )
+        cached_now = {s for g in self.tier.groups for s in g.slots}
+        to_cached &= set(self.tier.ps_slots)  # drop no-op moves
+        to_ps &= cached_now
+        if not (to_cached or to_ps) and cache_rows is None:
+            return
+        self._land_pending()
+        for g in self.tier.groups:
+            n = len(self.tier.dirs[g.name])
+            if n:
+                raise RuntimeError(
+                    f"apply_migration with a warm cache: group {g.name!r} "
+                    f"still holds {n} resident rows — flush first (the "
+                    "stream applies migrations only at drained fences)"
+                )
+        init_seed = self.tier.init_seed
+        profiler = self.tier.profiler
+        new_exclude = (self._ps_exclude | to_ps) - to_cached
+        rows = self.cache_rows if cache_rows is None else cache_rows
+        # the tier constructor re-validates the mixed-tier invariants
+        # (feature-group disjointness, prefix-bit partitioning) against the
+        # NEW placement — an invalid plan fails loudly here, pre-mutation
+        self.tier = CachedEmbeddingTier(
+            self.worker, self.sparse_cfg, rows, self.embedding_config,
+            init_seed=init_seed, ps_slots=sorted(new_exclude),
+            admit_touches=self._admit_touches,
+            aux_wire_dtype=self._aux_wire_dtype,
+        )
+        self.tier.profiler = profiler
+        self.cache_rows = rows
+        self._ps_exclude = new_exclude
+        self._cached_groups = tuple(sorted({
+            self.embedding_config.group_of(s)
+            for g in self.tier.groups for s in g.slots
+        }))
+        # step/eval traces close over the group list — rebuild them, and
+        # drop every group-shaped device cache (rings, empties, K-step jit,
+        # int8 residuals); all are rebuilt lazily against the new groups
+        self._step = build_cached_train_step(
+            self.model, self.dense_optimizer, self.sparse_cfg,
+            self.tier.groups,
+            loss_fn=self._loss_fn,
+            ps_grad_wire=self._ps_wire_dtype,
+            dynamic_loss_scale=self.dynamic_loss_scale,
+            growth_interval=self._ls_growth_interval,
+            max_scale=self._ls_max,
+        )
+        self._eval = build_cached_eval_step(self.model, self.tier.groups)
+        self._kstep_jit = None
+        self._empties = {}
+        self._ev_rings = {}
+        self._ps_residual = {}
+        if self.state is not None:
+            tables, emb_state = init_cached_tables(
+                self.tier.groups, self.sparse_cfg, dtype=self.table_dtype
+            )
+            rep = self._replicated()
+            if rep is not None:
+                tables = {
+                    k: jax.device_put(v, rep) for k, v in tables.items()
+                }
+                emb_state = {
+                    k: jax.device_put(v, rep) for k, v in emb_state.items()
+                }
+            self.state = self.state.replace(tables=tables, emb_state=emb_state)
+        logger.info(
+            "tier migration applied: -> cached %s, -> ps %s (ps tier now %s)",
+            sorted(to_cached), sorted(to_ps), sorted(self.tier.ps_slots),
+        )
+
+    def _maybe_migrate_at_fence(self, gstep: int) -> bool:
+        """Stream fence hook (feeder parked, write-back drained, ledger
+        empty, manifest committed): apply a queued ``request_migration``
+        and/or run the auto-tier controller's planning round. Returns True
+        when the tier was re-registered — the stream then resets its ring
+        accounting and re-reads the group salts."""
+        from persia_tpu.tracing import record_event
+        migrated = False
+        req = self._pending_migration
+        if req is not None:
+            self._pending_migration = None
+            n = len(req["to_cached"]) + len(req["to_ps"])
+            with span(
+                "tiering.migration", step=gstep,
+                to_cached=len(req["to_cached"]), to_ps=len(req["to_ps"]),
+            ):
+                self.apply_migration(**req)
+            get_metrics().counter(
+                "persia_tpu_tiering_migrations",
+                "slots live-migrated between sparse tiers at a fence",
+            ).inc(n)
+            record_event(
+                "tiering.migrate", step=gstep,
+                moves={
+                    **{s: "->cached" for s in req["to_cached"]},
+                    **{s: "->ps" for s in req["to_ps"]},
+                },
+            )
+            migrated = True
+        if self._auto_tier is not None:
+            migrated = bool(self._auto_tier.on_fence(self, gstep)) or migrated
+        return migrated
+
     # ------------------------------------------------- crash-consistent jobs
 
     def _fence_capture(self, job_mgr, step: int, occupancy: Dict):
@@ -909,6 +1084,14 @@ class CachedTrainCtx:
             )
             self.state = self.state.replace(tables=tables, emb_state=emb_state)
         router = self.tier.router
+        components = {
+            "cache.json": occupancy,
+            "loader.json": {"consumed_batches": step},
+        }
+        if self._auto_tier is not None:
+            # profiler sketch + current placements ride the manifest so a
+            # resumed job keeps its access history (and its tier layout)
+            components["tiering.json"] = self._auto_tier.export_state()
         manifest = jobstate.snapshot_job(
             job_mgr, step,
             state_bytes=(
@@ -917,10 +1100,7 @@ class CachedTrainCtx:
             ),
             replicas=router.replicas,
             batch_advances=dict(getattr(router, "batch_advances", {})),
-            components={
-                "cache.json": occupancy,
-                "loader.json": {"consumed_batches": step},
-            },
+            components=components,
             meta={"kind": "cached_ctx"},
         )
         self._job_epoch = manifest.job_epoch
@@ -969,6 +1149,25 @@ class CachedTrainCtx:
             self._job_epoch = 0
             self._global_step = 0
             return None
+        if self._auto_tier is not None and manifest.has("tiering.json"):
+            from persia_tpu.embedding.tiering.planner import TIER_PS
+
+            self._auto_tier.load_state(manifest.read_json("tiering.json"))
+            # re-register to the SAVED placement BEFORE touching dense.state:
+            # the manifest's cache pools (and the state template the bytes
+            # deserialize against) were captured under it, and the profiler's
+            # history only makes sense against the layout it scored
+            want_ps = {
+                s for s, t in self._auto_tier.placements.items()
+                if t == TIER_PS
+            }
+            tracked = set(self._auto_tier.placements)
+            have_ps = set(self.tier.ps_slots) & tracked
+            cached_now = {s for g in self.tier.groups for s in g.slots}
+            self.apply_migration(
+                to_cached=sorted((have_ps - want_ps) & tracked),
+                to_ps=sorted(want_ps & cached_now),
+            )
         if manifest.has("dense.state"):
             self._resume_state_bytes = manifest.read_blob("dense.state")
             if self.state is not None:

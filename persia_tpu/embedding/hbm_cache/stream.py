@@ -220,7 +220,11 @@ def run_train_stream(
     # feature_index_prefix_bit=0 the same raw sign can live in two groups,
     # and an unsalted probe would restore the OTHER group's ring rows.
     sign_map = PendingSignMap()
-    salts = self.tier._group_salt
+    # a COPY, refreshed in place after a fence-point tier migration (the
+    # migration replaces self.tier, and the feeder/gate closures hold this
+    # dict): group names usually survive a move (cache_d{dim}) but a dim
+    # appearing/disappearing changes the key set
+    salts = dict(self.tier._group_salt)
 
     def gate(gname: str, miss_signs: np.ndarray):
         """Resolve re-missed pending-evicted signs against the in-flight
@@ -662,9 +666,46 @@ def run_train_stream(
                         self._fence_capture(job_mgr, gstep, occupancy)
                     stats["fences"] = stats.get("fences", 0) + 1
                     record_event("stream.fence_commit", step=gstep)
+                    _fence_migrate(gstep)
                 except BaseException as e:  # noqa: BLE001
                     errors.append(e)
         fence_done.set()
+
+    def _fence_migrate(gstep: int) -> None:
+        """Tier migration point: runs right after the fence's manifest
+        commit, with the feeder parked and the write-back drained — the PS
+        holds the only copy of every cached row, so a re-registration moves
+        pure metadata. The hazard ledger (PendingSignMap) SURVIVES the
+        re-registration (same native map; the ring-drain check above
+        already proved heads == tails) — it must read empty here or an
+        in-flight eviction would dangle across the tier swap."""
+        if self._pending_migration is None and self._auto_tier is None:
+            return
+        with cv:
+            n_pending = len(sign_map)
+        if n_pending:
+            raise RuntimeError(
+                f"migration fence at step {gstep}: hazard ledger still "
+                f"holds {n_pending} entries after the write-back drain"
+            )
+        if not self._maybe_migrate_at_fence(gstep):
+            return
+        with cv:
+            # re-registration sanity: the drained ledger survived the tier
+            # swap untouched
+            if len(sign_map):
+                raise RuntimeError(
+                    "hazard ledger grew during a parked-feeder migration"
+                )
+            # fresh device rings were installed (ctx._ev_rings cleared):
+            # restart the ring accounting so spans allocate against the
+            # NEW ring heights from position 0
+            heads.clear()
+            tails.clear()
+            alloc_q.clear()
+            salts.clear()
+            salts.update(self.tier._group_salt)
+        stats["migrations"] = stats.get("migrations", 0) + 1
 
     def _post_step(seq, di, evict_meta, evict_payload):
         """Per-step bookkeeping shared by the single and packed paths."""
@@ -817,6 +858,24 @@ def run_train_stream(
     finally:
         stats["wall_s"] = _time.perf_counter() - t_start
         _publish_live_stats()
+        # per-tier layout + occupancy ride the stats dict so bench stream
+        # records report EVERY tier, not just the active one's cache stats
+        try:
+            stats["tiers"] = {
+                "cached_slots": sorted(
+                    s for g in self.tier.groups for s in g.slots
+                ),
+                "ps_slots": sorted(self.tier.ps_slots),
+                "resident_rows": {
+                    g.name: len(self.tier.dirs[g.name])
+                    for g in self.tier.groups
+                },
+                "capacity_rows": {
+                    g.name: g.rows for g in self.tier.groups
+                },
+            }
+        except Exception:  # noqa: BLE001 — stats are best-effort at teardown
+            pass
         self._stream_stats = stats
         stop.set()
         with cv:

@@ -162,6 +162,10 @@ class CachedEmbeddingTier:
         _retain_allocator_pages()
         self._ring = _BufRing()
         self._slot_group = {s: g for g in self.groups for s in g.slots}
+        # optional auto-tiering access profiler (tiering.AccessProfiler):
+        # when attached, the prepare paths feed it every slot's sign stream
+        # — one strided native observe per group on the fast path
+        self.profiler = None
         # static fast-path eligibility per slot (config is immutable): the
         # per-batch check reduces to "every feature single-id" (the only
         # data-dependent part)
@@ -284,6 +288,21 @@ class CachedEmbeddingTier:
         )
 
     # ------------------------------------------------------------- helpers
+
+    def _observe_ps_feats(self, batch: PersiaBatch) -> None:
+        """Feed PS-tier slots' sign streams to the access profiler: a slot
+        that migrated OUT of the cache must keep accruing stats or it could
+        never earn its way back (its sketch mass would just decay away).
+        Raw (unprefixed) signs are fine — stats are per slot, and a
+        constant prefix changes neither totals nor distinct counts."""
+        if self.profiler is None or not self.ps_slots:
+            return
+        for f in batch.id_type_features:
+            if f.name in self.ps_slots:
+                flat, _counts = f.flat_counts()
+                self.profiler.observe_slot(
+                    f.name, np.ascontiguousarray(flat, dtype=np.uint64)
+                )
 
     def _group_slots(self, pb: ProcessedBatch) -> Dict[str, List[ProcessedSlot]]:
         out: Dict[str, List[ProcessedSlot]] = {}
@@ -544,6 +563,7 @@ class CachedEmbeddingTier:
         cached_feats = [
             f for f in batch.id_type_features if f.name not in self.ps_slots
         ]
+        self._observe_ps_feats(batch)
         pb = preprocess_batch(cached_feats, self.cfg)
         slots_by_group = self._group_slots(pb)
 
@@ -563,6 +583,13 @@ class CachedEmbeddingTier:
             if not slots:
                 continue
             C = g.rows
+            if self.profiler is not None:
+                for slot in slots:
+                    # position-level stream: distinct[inverse] rebuilds the
+                    # raw (duplicated) sign sequence frequencies need
+                    self.profiler.observe_slot(
+                        slot.name, slot.distinct[slot.inverse]
+                    )
             all_signs, uniq, inv = self._dedup_group_signs(slots)
             rows_u, miss_idx, ev_signs, ev_rows = self.dirs[g.name].admit(uniq)
             rows = rows_u[inv]  # per original (slot-concatenated) position
@@ -634,9 +661,14 @@ class CachedEmbeddingTier:
         restore_aux: Dict[str, List] = {}
         evict_aux: Dict[str, np.ndarray] = {}
         evict_meta: Dict[str, Tuple[np.ndarray, int, int]] = {}
+        self._observe_ps_feats(batch)
 
         for g, names, mat in fast:
             S, B = mat.shape
+            if self.profiler is not None:
+                # the (S, B) matrix attributes positions to slots by stride
+                # — ONE native observe for the whole group
+                self.profiler.observe_group(names, mat.reshape(-1), B)
             gate = hazard_gate
             if pending_map is not None:
                 salt = self._group_salt[g.name]

@@ -1,0 +1,36 @@
+"""Sparsity-aware auto-tiering (ROADMAP direction 4; Parallax-style
+per-variable placement, arxiv 1808.02621).
+
+Three parts, one pipeline:
+
+- :mod:`profiler` — per-slot frequency / working-set sketch fed by the
+  native admit walk (``native/cache.cpp sketch_*``): decayed access
+  totals, a count-min over signs, two-window linear-counting working-set
+  estimates, top-K heavy hitters.
+- :mod:`planner` — scores each slot (reuse = total/unique, traffic
+  density = total/vocab) against tier capacity budgets and assigns
+  fused / cached / ps, with hysteresis + dwell so placement cannot flap.
+- :mod:`controller` — applies the plan at stream snapshot fences
+  (the PR 5 jobstate machinery): feeder parked, ledger drained,
+  manifest committed, then ``CachedTrainCtx.apply_migration``
+  re-registers the moving slots and the stream resumes.
+"""
+
+from persia_tpu.embedding.tiering.controller import (  # noqa: F401
+    AUTO_TIER_ENV,
+    AutoTierController,
+    auto_tier_enabled,
+    enable_auto_tier,
+)
+from persia_tpu.embedding.tiering.planner import (  # noqa: F401
+    TIER_CACHED,
+    TIER_FUSED,
+    TIER_PS,
+    TIERS,
+    PlacementPlanner,
+    TierPlan,
+)
+from persia_tpu.embedding.tiering.profiler import (  # noqa: F401
+    AccessProfiler,
+    SlotStats,
+)

@@ -1,0 +1,173 @@
+"""AutoTierController: profiler + planner + the fence-point migration.
+
+The stream's snapshot fence (``stream.py _run_fence``) is the ONLY point
+where a slot can change tiers: the feeder is parked, the write-back thread
+is drained, the hazard ledger is empty (heads == tails), and
+``_fence_capture`` has just flushed every cached row to the PS and
+committed a manifest — so the PS holds the single authoritative copy of
+every migrating slot and the re-registration moves only METADATA. The
+controller runs right after that commit: decay the sketch, score a plan,
+and (hysteresis permitting) apply the migrations through
+``CachedTrainCtx.apply_migration``.
+
+Enable with :func:`enable_auto_tier` (or the launcher's ``--auto-tier``
+knob, which exports ``PERSIA_AUTO_TIER=1`` for the training script to
+consult).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Mapping, Optional, Tuple
+
+from persia_tpu.logger import get_default_logger
+from persia_tpu.metrics import get_metrics
+from persia_tpu.tracing import record_event, span
+
+from persia_tpu.embedding.tiering.planner import (
+    TIER_CACHED,
+    TIER_FUSED,
+    TIER_PS,
+    PlacementPlanner,
+    TierPlan,
+)
+from persia_tpu.embedding.tiering.profiler import AccessProfiler
+
+logger = get_default_logger("persia_tpu.tiering")
+
+AUTO_TIER_ENV = "PERSIA_AUTO_TIER"
+
+
+def auto_tier_enabled() -> bool:
+    """The launcher's ``--auto-tier`` exports PERSIA_AUTO_TIER=1."""
+    return os.environ.get(AUTO_TIER_ENV, "0") == "1"
+
+
+class AutoTierController:
+    """One planning round per stream fence.
+
+    ``placements`` tracks where each slot CURRENTLY lives. Inside a
+    ``CachedTrainCtx`` the ``fused`` tier is realized as a cached slot
+    whose full vocabulary fits its group pool (it never misses after
+    warm-up), so at the re-registration level only the cached/ps boundary
+    moves; the three-way label is kept for planning and reporting.
+    """
+
+    def __init__(
+        self,
+        profiler: AccessProfiler,
+        planner: PlacementPlanner,
+        placements: Mapping[str, str],
+        decay: float = 0.5,
+    ):
+        self.profiler = profiler
+        self.planner = planner
+        self.placements: Dict[str, str] = dict(placements)
+        self.decay = float(decay)
+        self.last_plan: Optional[TierPlan] = None
+        m = get_metrics()
+        self._m_migrations = m.counter(
+            "persia_tpu_tiering_migrations",
+            "slots live-migrated between sparse tiers at a fence",
+        )
+        self._m_suppressed = m.counter(
+            "persia_tpu_tiering_flap_suppressed",
+            "tier moves suppressed by hysteresis/dwell",
+        )
+
+    # ----------------------------------------------------------- fence hook
+
+    def on_fence(self, ctx, gstep: int) -> Dict[str, Tuple[str, str]]:
+        """Run one planning round at a drained fence; returns the applied
+        migrations ({slot: (from, to)}, empty when nothing moved). Every
+        placement DECISION is observable: a flight-recorder event fires
+        whether or not a migration happens, and suppressed flaps count."""
+        self.profiler.decay(self.decay)
+        stats = self.profiler.stats()
+        plan = self.planner.plan(stats, self.placements)
+        self.last_plan = plan
+        self._m_suppressed.inc(plan.suppressed)
+        record_event(
+            "tiering.plan", step=gstep,
+            migrations=len(plan.migrations), suppressed=plan.suppressed,
+        )
+        if not plan.migrations:
+            return {}
+        # cached/ps boundary moves only (fused rides the cached side here)
+        to_cached = sorted(
+            s for s, (src, dst) in plan.migrations.items()
+            if src == TIER_PS and dst in (TIER_CACHED, TIER_FUSED)
+        )
+        to_ps = sorted(
+            s for s, (src, dst) in plan.migrations.items() if dst == TIER_PS
+        )
+        if to_cached or to_ps:
+            with span(
+                "tiering.migration", step=gstep,
+                to_cached=len(to_cached), to_ps=len(to_ps),
+            ):
+                ctx.apply_migration(to_cached=to_cached, to_ps=to_ps)
+        self._m_migrations.inc(len(plan.migrations))
+        record_event(
+            "tiering.migrate", step=gstep,
+            moves={s: f"{src}->{dst}" for s, (src, dst) in plan.migrations.items()},
+        )
+        logger.info(
+            "auto-tier fence %d: migrated %s (suppressed %d)",
+            gstep, dict(plan.migrations), plan.suppressed,
+        )
+        self.placements = dict(plan.placements)
+        return dict(plan.migrations)
+
+    # ------------------------------------------------- snapshot / resume
+
+    def export_state(self) -> Dict:
+        return {
+            "placements": dict(self.placements),
+            "profiler": self.profiler.export_state(),
+        }
+
+    def load_state(self, state: Dict) -> None:
+        self.placements = dict(state["placements"])
+        self.profiler.load_state(state["profiler"])
+
+
+def enable_auto_tier(
+    ctx,
+    cached_min_reuse: float = 2.0,
+    hysteresis: float = 0.25,
+    min_dwell: int = 1,
+    decay: float = 0.5,
+    fused_row_budget: int = 0,
+    vocabs: Optional[Mapping[str, int]] = None,
+    profiler_kwargs: Optional[Dict] = None,
+) -> AutoTierController:
+    """Wire auto-tiering onto a ``CachedTrainCtx``: build the profiler over
+    every slot (cached groups in group order — their sketch indices stay
+    contiguous for the strided observe — then the ps slots), a planner
+    budgeted by the tier's cache pools, and attach the controller so the
+    stream's fences drive it."""
+    tier = ctx.tier
+    slot_order = [s for g in tier.groups for s in g.slots] + sorted(
+        s for s in tier.ps_slots
+    )
+    profiler = AccessProfiler(slot_order, **(profiler_kwargs or {}))
+    lockstep = [
+        list(members)
+        for members in ctx.embedding_config.feature_groups.values()
+        if len(members) > 1
+    ]
+    planner = PlacementPlanner(
+        cached_row_budget=sum(g.rows for g in tier.groups),
+        fused_row_budget=fused_row_budget,
+        vocabs=vocabs,
+        cached_min_reuse=cached_min_reuse,
+        hysteresis=hysteresis,
+        min_dwell=min_dwell,
+        lockstep_groups=lockstep,
+    )
+    placements = {s: TIER_CACHED for g in tier.groups for s in g.slots}
+    placements.update({s: TIER_PS for s in tier.ps_slots})
+    ctrl = AutoTierController(profiler, planner, placements, decay=decay)
+    ctx.attach_auto_tier(ctrl)
+    return ctrl

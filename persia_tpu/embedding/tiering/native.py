@@ -1,0 +1,143 @@
+"""ctypes surface of the native access-stats sketch (``native/cache.cpp``
+``sketch_*`` — it lives in the cache library because the feeder's admit
+walk is where the signs stream past).
+
+Registered in ``persia_tpu.analysis.common.BINDING_FILES`` so persia-lint's
+ABI drift checker (ABI000-ABI008) cross-checks every binding here against
+the ``extern "C"`` surface, exactly like the cache-directory bindings.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from persia_tpu.embedding.hbm_cache.directory import build_native
+
+# the lib this file binds — persia-lint's ABI pass resolves the CDLL
+# handle below through this constant (build_native() returns a variant
+# path the AST tracker cannot evaluate)
+_SO = "libpersia_cache.so"
+
+_LIB: Optional[ctypes.CDLL] = None
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_u64p = ctypes.POINTER(ctypes.c_uint64)
+_f64p = ctypes.POINTER(ctypes.c_double)
+
+
+def _load_lib() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is None:
+        # same .so as the cache directory (build_native is variant-aware);
+        # a separate CDLL keeps this module importable without dragging the
+        # directory's staging machinery into scope
+        lib = ctypes.CDLL(build_native())
+        i64, p, f64 = ctypes.c_int64, ctypes.c_void_p, ctypes.c_double
+        # every binding declares BOTH restype and argtypes (restype = None
+        # for void) — persia-lint ABI003/ABI007 enforce it mechanically
+        lib.sketch_create.restype = p
+        lib.sketch_create.argtypes = [i64, i64, i64, i64, i64]
+        lib.sketch_destroy.restype = None
+        lib.sketch_destroy.argtypes = [p]
+        lib.sketch_n_slots.restype = i64
+        lib.sketch_n_slots.argtypes = [p]
+        lib.sketch_observe.restype = i64
+        lib.sketch_observe.argtypes = [p, _u64p, i64, i64, i64]
+        lib.sketch_decay.restype = None
+        lib.sketch_decay.argtypes = [p, f64]
+        lib.sketch_slot_stats.restype = i64
+        lib.sketch_slot_stats.argtypes = [p, i64, _f64p]
+        lib.sketch_estimate.restype = f64
+        lib.sketch_estimate.argtypes = [p, i64, ctypes.c_uint64]
+        lib.sketch_export_size.restype = i64
+        lib.sketch_export_size.argtypes = [p]
+        lib.sketch_export.restype = i64
+        lib.sketch_export.argtypes = [p, _u8p, i64]
+        lib.sketch_import.restype = i64
+        lib.sketch_import.argtypes = [p, _u8p, i64]
+        _LIB = lib
+    return _LIB
+
+
+class NativeSketch:
+    """Thin RAII handle over one native AccessSketch."""
+
+    def __init__(
+        self,
+        n_slots: int,
+        width_log2: int = 16,
+        depth: int = 4,
+        bitmap_bits: int = 1 << 15,
+        topk: int = 8,
+    ):
+        self._lib = _load_lib()
+        self._h = self._lib.sketch_create(
+            n_slots, width_log2, depth, bitmap_bits, topk
+        )
+        if not self._h:
+            raise ValueError(
+                f"sketch_create rejected geometry (n_slots={n_slots}, "
+                f"width_log2={width_log2}, depth={depth}, "
+                f"bitmap_bits={bitmap_bits}, topk={topk})"
+            )
+        self.n_slots = int(n_slots)
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.sketch_destroy(h)
+            self._h = None
+
+    def observe(
+        self, signs: np.ndarray, samples_per_slot: int, slot_base: int
+    ) -> int:
+        """Strided attribution: position i -> slot_base + i//samples_per_slot
+        (a group's flattened (S, B) sign matrix); samples_per_slot <= 0
+        attributes everything to slot_base."""
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        return int(self._lib.sketch_observe(
+            self._h, signs.ctypes.data_as(_u64p), signs.size,
+            int(samples_per_slot), int(slot_base),
+        ))
+
+    def decay(self, factor: float) -> None:
+        self._lib.sketch_decay(self._h, float(factor))
+
+    def slot_stats(self, slot: int) -> tuple:
+        """(total, unique_est, hot_frac, top1_frac) for one slot index."""
+        out = np.empty(4, dtype=np.float64)
+        rc = self._lib.sketch_slot_stats(
+            self._h, int(slot), out.ctypes.data_as(_f64p)
+        )
+        if rc != 0:
+            raise IndexError(f"slot {slot} out of range [0, {self.n_slots})")
+        return float(out[0]), float(out[1]), float(out[2]), float(out[3])
+
+    def estimate(self, slot: int, sign: int) -> float:
+        return float(self._lib.sketch_estimate(
+            self._h, int(slot), ctypes.c_uint64(int(sign) & (2**64 - 1))
+        ))
+
+    def export_bytes(self) -> bytes:
+        size = int(self._lib.sketch_export_size(self._h))
+        buf = np.empty(size, dtype=np.uint8)
+        n = int(self._lib.sketch_export(
+            self._h, buf.ctypes.data_as(_u8p), size
+        ))
+        if n < 0:
+            raise RuntimeError("sketch_export: buffer undersized")
+        return buf[:n].tobytes()
+
+    def import_bytes(self, blob: bytes) -> None:
+        buf = np.frombuffer(blob, dtype=np.uint8)
+        rc = int(self._lib.sketch_import(
+            self._h, buf.ctypes.data_as(_u8p), buf.size
+        ))
+        if rc != 0:
+            raise ValueError(
+                "sketch_import: blob geometry does not match this sketch "
+                "(profiler config changed across the snapshot?)"
+            )

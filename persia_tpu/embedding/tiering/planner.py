@@ -1,0 +1,215 @@
+"""Placement planner: sketch stats + capacity budgets -> a tier per slot.
+
+Parallax (PAPERS.md, arxiv 1808.02621) chooses a parallelism architecture
+PER VARIABLE from measured sparsity; this is the same move across the
+repo's three sparse tiers:
+
+- ``fused``  — the slot's FULL vocabulary lives in HBM (never misses).
+  Worth it when the table is small relative to its traffic: score is
+  traffic density ``total / vocab`` (accesses each pinned row earns).
+- ``cached`` — working set cached in HBM over the PS. Worth it when signs
+  repeat: score is ``reuse = total / unique`` (hits each cached row
+  earns before eviction).
+- ``ps``     — stream through the host PS. The fallback for heavy-tail /
+  near-uniform slots whose rows would thrash any cache.
+
+Hysteresis: a slot only MOVES when its score clears the admission
+threshold by a ``(1 + hysteresis)`` margin (or falls below by the same
+margin on the way down) AND it has dwelled ``min_dwell`` planning rounds
+in its current tier. Everything else is a suppressed flap, counted and
+exported (``persia_tpu_tiering_flap_suppressed``) — placement decisions
+are observable even when nothing moves.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from persia_tpu.embedding.tiering.profiler import SlotStats
+
+TIER_FUSED = "fused"
+TIER_CACHED = "cached"
+TIER_PS = "ps"
+TIERS = (TIER_FUSED, TIER_CACHED, TIER_PS)
+
+
+@dataclass(frozen=True)
+class TierPlan:
+    """One planning round's output."""
+
+    placements: Dict[str, str]                 # slot -> tier
+    migrations: Dict[str, Tuple[str, str]]     # slot -> (from, to)
+    suppressed: int                            # hysteresis-blocked moves
+    scores: Dict[str, Dict[str, float]]        # slot -> score breakdown
+
+
+class PlacementPlanner:
+    """Greedy scored assignment under capacity budgets, with hysteresis.
+
+    ``vocabs`` maps slot -> vocabulary size where known; only slots with a
+    known vocab are fused candidates (pinning needs a bound).
+    ``lockstep_groups``: slots sharing a feature group may not straddle
+    the cached/PS boundary (the tier constructor rejects it), so each
+    group lands together in the tier carrying its access-mass majority.
+    """
+
+    def __init__(
+        self,
+        cached_row_budget: int,
+        fused_row_budget: int = 0,
+        vocabs: Optional[Mapping[str, int]] = None,
+        cached_min_reuse: float = 2.0,
+        fused_min_density: float = 0.05,
+        hysteresis: float = 0.25,
+        min_dwell: int = 2,
+        lockstep_groups: Optional[Sequence[Sequence[str]]] = None,
+    ):
+        if cached_row_budget < 0 or fused_row_budget < 0:
+            raise ValueError("budgets must be >= 0")
+        self.cached_row_budget = int(cached_row_budget)
+        self.fused_row_budget = int(fused_row_budget)
+        self.vocabs = dict(vocabs or {})
+        self.cached_min_reuse = float(cached_min_reuse)
+        self.fused_min_density = float(fused_min_density)
+        self.hysteresis = float(hysteresis)
+        self.min_dwell = int(min_dwell)
+        self.lockstep_groups = [list(g) for g in (lockstep_groups or [])]
+        self._dwell: Dict[str, int] = {}
+
+    # ------------------------------------------------------------ scoring
+
+    def _raw_assign(self, stats: Mapping[str, SlotStats]) -> Dict[str, str]:
+        """Budget-constrained greedy assignment ignoring hysteresis."""
+        assign: Dict[str, str] = {}
+        # fused: best traffic density first, while full vocabs fit
+        fused_left = self.fused_row_budget
+        density = {
+            s: st.total / max(self.vocabs.get(s, 0), 1)
+            for s, st in stats.items()
+        }
+        for s in sorted(stats, key=lambda s: -density[s]):
+            vocab = self.vocabs.get(s, 0)
+            if (
+                vocab > 0 and vocab <= fused_left
+                and density[s] >= self.fused_min_density
+            ):
+                assign[s] = TIER_FUSED
+                fused_left -= vocab
+        # cached: best reuse first, while working sets fit the cache pool
+        cached_left = self.cached_row_budget
+        rest = [s for s in stats if s not in assign]
+        for s in sorted(rest, key=lambda s: -stats[s].reuse):
+            ws = max(int(stats[s].unique), 1)
+            if stats[s].reuse >= self.cached_min_reuse and ws <= cached_left:
+                assign[s] = TIER_CACHED
+                cached_left -= ws
+            else:
+                assign[s] = TIER_PS
+        # lockstep: a feature group may not straddle cached/ps — move the
+        # minority (by access mass) to the group's majority side
+        for grp in self.lockstep_groups:
+            members = [s for s in grp if s in assign]
+            sides = {assign[s] for s in members} - {TIER_FUSED}
+            if len(sides) <= 1:
+                continue
+            mass = {t: 0.0 for t in sides}
+            for s in members:
+                if assign[s] in mass:
+                    mass[assign[s]] += stats[s].total
+            winner = max(mass, key=lambda t: mass[t])
+            for s in members:
+                if assign[s] != TIER_FUSED:
+                    assign[s] = winner
+        return assign
+
+    def _clears_margin(self, slot: str, st: SlotStats, target: str) -> bool:
+        """A MOVE must clear its destination's admission threshold by the
+        hysteresis margin (or, moving down-tier, have fallen below the
+        source threshold by the same margin) — borderline slots stay put."""
+        m = 1.0 + self.hysteresis
+        if target == TIER_CACHED:
+            return st.reuse >= self.cached_min_reuse * m
+        if target == TIER_FUSED:
+            vocab = max(self.vocabs.get(slot, 0), 1)
+            return st.total / vocab >= self.fused_min_density * m
+        # down to ps: reuse must be clearly below the cached threshold
+        return st.reuse * m <= self.cached_min_reuse
+    # ------------------------------------------------------------- plan
+
+    def plan(
+        self, stats: Mapping[str, SlotStats], current: Mapping[str, str]
+    ) -> TierPlan:
+        for t in current.values():
+            if t not in TIERS:
+                raise ValueError(f"unknown tier {t!r}")
+        raw = self._raw_assign(stats)
+        placements: Dict[str, str] = {}
+        migrations: Dict[str, Tuple[str, str]] = {}
+        suppressed = 0
+        # hysteresis must act on MOVE UNITS, not slots: a lockstep group
+        # moves (or stays) as one — a per-slot veto after _raw_assign
+        # harmonized the group would leave the final placement straddling
+        # the cached/ps boundary, which the tier constructor rejects
+        unit_of: Dict[str, int] = {}
+        units: List[List[str]] = []
+        for grp in self.lockstep_groups:
+            members = [
+                s for s in grp
+                if s in raw and raw[s] != TIER_FUSED and s not in unit_of
+            ]
+            if members:
+                for s in members:
+                    unit_of[s] = len(units)
+                units.append(members)
+        for s in raw:
+            if s not in unit_of:
+                units.append([s])
+        for unit in units:
+            moving = [s for s in unit if current.get(s, raw[s]) != raw[s]]
+            if not moving:
+                for s in unit:
+                    placements[s] = raw[s]
+                continue
+            # the unit clears hysteresis when every moving member has
+            # dwelled AND the unit's aggregate mass clears the margin
+            # (the group caches/streams as one working set)
+            agg = SlotStats(
+                total=sum(stats[s].total for s in moving),
+                unique=sum(stats[s].unique for s in moving),
+                hot_frac=0.0, top1_frac=0.0,
+            )
+            ok = all(
+                self._dwell.get(s, self.min_dwell) >= self.min_dwell
+                for s in moving
+            ) and all(
+                self._clears_margin(s, agg if len(unit) > 1 else stats[s],
+                                    raw[s])
+                for s in moving
+            )
+            if not ok:
+                for s in unit:
+                    placements[s] = current.get(s, raw[s])
+                suppressed += len(moving)
+                continue
+            for s in unit:
+                placements[s] = raw[s]
+            for s in moving:
+                migrations[s] = (current.get(s, raw[s]), raw[s])
+        # dwell accounting: migrated slots restart, everyone else ages
+        for s, t in placements.items():
+            if s in migrations:
+                self._dwell[s] = 0
+            else:
+                self._dwell[s] = self._dwell.get(s, self.min_dwell) + 1
+        scores = {
+            s: {
+                "reuse": st.reuse,
+                "density": st.total / max(self.vocabs.get(s, 0), 1),
+                "total": st.total,
+                "unique": st.unique,
+                "hot_frac": st.hot_frac,
+            }
+            for s, st in stats.items()
+        }
+        return TierPlan(placements, migrations, suppressed, scores)

@@ -1,0 +1,159 @@
+"""Per-slot access-stats profiler over the native sketch.
+
+The feeder's admit walk (``CachedEmbeddingTier.prepare_batch`` /
+``_prepare_batch_single_id``) already materializes every sign of every
+batch; the profiler taps that stream in place: one ``sketch_observe``
+per group per step on the single-id fast path (the flattened (S, B)
+matrix attributes positions to slots by stride), one per slot on the
+general path. The walk is DRAM-latency-bound like the admit walk it
+rides (~75 ns/sign measured on the 1-core build host — the feeder
+ceiling stays an order of magnitude above chip dispatch rates; see
+PROFILE_FEEDER.md). Everything downstream — the skew/working-set stats
+the placement planner scores, the snapshot/resume persistence — reads
+the same sketch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from persia_tpu.embedding.tiering.native import NativeSketch
+
+
+@dataclass(frozen=True)
+class SlotStats:
+    """Decayed access statistics for one slot.
+
+    ``total``     access mass (position count) under exponential decay;
+    ``unique``    working-set estimate (distinct signs, two-window
+                  linear counting);
+    ``hot_frac``  fraction of the mass carried by the top-K signs;
+    ``top1_frac`` fraction carried by the single hottest sign.
+
+    ``reuse`` = total/unique is the planner's primary score: expected
+    hits per distinct sign, i.e. how much a cached row earns its HBM.
+    A slot whose working-set windows are EMPTY (no traffic for two decay
+    rounds) scores 0, not total/1 — residual decayed mass with no recent
+    distinct signs is a slot going cold, and inflating its reuse would
+    promote exactly the slots that should drain to the PS.
+    """
+
+    total: float
+    unique: float
+    hot_frac: float
+    top1_frac: float
+
+    @property
+    def reuse(self) -> float:
+        if self.unique <= 0.0:
+            return 0.0
+        return self.total / max(self.unique, 1.0)
+
+
+class AccessProfiler:
+    """Slot-name-addressed wrapper over one :class:`NativeSketch`.
+
+    ``slot_order`` fixes the name -> sketch-index mapping for the life of
+    the profiler (and of every exported blob): keep it stable across
+    migrations — a slot keeps its index no matter which tier currently
+    serves it, so its history survives the move.
+    """
+
+    def __init__(
+        self,
+        slot_order: Sequence[str],
+        width_log2: int = 16,
+        depth: int = 4,
+        bitmap_bits: int = 1 << 15,
+        topk: int = 8,
+    ):
+        self.slot_order: List[str] = list(slot_order)
+        if len(set(self.slot_order)) != len(self.slot_order):
+            raise ValueError("duplicate slot names in slot_order")
+        self._index: Dict[str, int] = {
+            n: i for i, n in enumerate(self.slot_order)
+        }
+        self._cfg = dict(
+            width_log2=width_log2, depth=depth,
+            bitmap_bits=bitmap_bits, topk=topk,
+        )
+        self._sk = NativeSketch(len(self.slot_order), **self._cfg)
+
+    # ---------------------------------------------------------- observe
+
+    def observe_group(
+        self, names: Sequence[str], flat_signs: np.ndarray, batch: int
+    ) -> None:
+        """Feed one group's flattened (S, B) sign matrix (the single-id
+        fast path): position i belongs to ``names[i // batch]``. One
+        native call when the group's slots are index-contiguous (they are
+        by construction when the profiler is built in group order),
+        otherwise one call per slot."""
+        if batch <= 0 or flat_signs.size == 0:
+            return
+        idx = [self._index[n] for n in names]
+        if idx == list(range(idx[0], idx[0] + len(idx))):
+            self._sk.observe(flat_signs, batch, idx[0])
+            return
+        for j, i in enumerate(idx):
+            self._sk.observe(
+                flat_signs[j * batch:(j + 1) * batch], 0, i
+            )
+
+    def observe_slot(self, name: str, signs: np.ndarray) -> None:
+        """Feed one slot's raw (duplicated) sign stream (general path)."""
+        if signs.size:
+            self._sk.observe(signs, 0, self._index[name])
+
+    # ------------------------------------------------------------ stats
+
+    def decay(self, factor: float = 0.5) -> None:
+        """Exponential decay + working-set window slide; call once per
+        planning round (fence) so stats track the recent stream."""
+        self._sk.decay(factor)
+
+    def stats(self) -> Dict[str, SlotStats]:
+        out = {}
+        for name, i in self._index.items():
+            total, unique, hot, top1 = self._sk.slot_stats(i)
+            out[name] = SlotStats(total, unique, hot, top1)
+        return out
+
+    def estimate(self, name: str, sign: int) -> float:
+        return self._sk.estimate(self._index[name], sign)
+
+    # ------------------------------------------------- snapshot / resume
+
+    def export_bytes(self) -> bytes:
+        return self._sk.export_bytes()
+
+    def import_bytes(self, blob: bytes) -> None:
+        self._sk.import_bytes(blob)
+
+    def export_state(self) -> Dict:
+        """JSON-safe form for a jobstate component (the blob rides as hex;
+        sketches are ~1-2 MB at default geometry, and the manifest epoch
+        already carries multi-MB PS shards)."""
+        return {
+            "slot_order": self.slot_order,
+            "cfg": dict(self._cfg),
+            "blob_hex": self.export_bytes().hex(),
+        }
+
+    @classmethod
+    def from_state(cls, state: Dict) -> "AccessProfiler":
+        prof = cls(state["slot_order"], **state["cfg"])
+        prof.import_bytes(bytes.fromhex(state["blob_hex"]))
+        return prof
+
+    def load_state(self, state: Dict) -> None:
+        """Import into THIS profiler (geometry and slot order must match)."""
+        if list(state["slot_order"]) != self.slot_order:
+            raise ValueError(
+                "profiler slot_order changed across the snapshot: "
+                f"{state['slot_order']} != {self.slot_order}"
+            )
+        self.import_bytes(bytes.fromhex(state["blob_hex"]))

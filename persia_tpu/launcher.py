@@ -45,6 +45,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "manifest in --job-state-dir")
     nn.add_argument("--max-restarts", type=int, default=3,
                     help="auto-resume restart budget per launcher invocation")
+    nn.add_argument("--auto-tier", action="store_true",
+                    help="export PERSIA_AUTO_TIER=1: the entry enables "
+                         "sparsity-aware auto-tiering (embedding.tiering) — "
+                         "slots migrate between sparse tiers at snapshot "
+                         "fences based on profiled access skew")
 
     dl = sub.add_parser("data-loader", help="launch the data-loader script")
     dl.add_argument("entry", nargs="?", default=None)
@@ -149,6 +154,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                "RANK": args.node_rank, "LOCAL_RANK": 0}
         if args.job_state_dir:
             env["PERSIA_JOB_STATE_DIR"] = args.job_state_dir
+        if args.auto_tier:
+            env["PERSIA_AUTO_TIER"] = 1  # tiering.auto_tier_enabled()
         if not args.auto_resume:
             return _run([py, entry], env)
         if not args.job_state_dir:

@@ -45,8 +45,15 @@ echo "== 1/5 chaos suite (fast schedules + resume-chaos + serving-chaos) =="
 # hybrid ctx, the cached stream fence, and the RPC journal wire) and the
 # fast serving-chaos subset (staleness quarantine/heal + delta-packet
 # integrity/resync); the full kill+resets, trainer-SIGKILL bitwise runs,
-# and the zipfian online soak (benchmarks/online_bench.py) ride slow
-JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_failure_recovery.py tests/test_jobstate.py tests/test_serving_chaos.py tests/test_incremental.py -q -m 'not slow'
+# and the zipfian online soak (benchmarks/online_bench.py) ride slow.
+# tests/test_tiering.py rides here too — the fast subset (sketch accuracy,
+# planner hysteresis/lockstep, controller rounds, snapshot roundtrip);
+# the four multi-second stream/e2e/bit-parity runs stay in the full suite
+JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_failure_recovery.py tests/test_jobstate.py tests/test_serving_chaos.py tests/test_incremental.py tests/test_tiering.py -q -m 'not slow' \
+    --deselect tests/test_tiering.py::test_stream_migration_at_fence_and_ledger_drained \
+    --deselect tests/test_tiering.py::test_auto_tier_demotes_cold_slot_and_survives_resume \
+    --deselect tests/test_tiering.py::test_migration_bit_parity_with_fresh_placement_resume \
+    --deselect tests/test_tiering.py::test_fence_manifest_carries_tiering_component
 
 echo "== 1.5/5 telemetry plane (trace propagation + flight recorder) =="
 # the fast tracing/telemetry subset: span mechanics, RPC + gateway HTTP

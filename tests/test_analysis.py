@@ -113,6 +113,40 @@ def test_abi_clean_bindings_zero_findings():
     assert cov["libs"] == {"libfx.so": 5}
 
 
+def test_abi009_registry_covers_every_cdll_loader():
+    """Registry completeness (ABI009): every persia_tpu/ file that calls
+    ctypes.CDLL is listed in CTYPES_FILES — including the tiering sketch
+    bindings — so the drift checker cannot silently skip a loader."""
+    from persia_tpu.analysis.common import ctypes_loader_files
+
+    loaders = ctypes_loader_files(REPO_ROOT)
+    assert "persia_tpu/embedding/tiering/native.py" in loaders
+    unregistered = sorted(set(loaders) - set(CTYPES_FILES))
+    assert unregistered == [], (
+        f"CDLL loaders missing from common.CTYPES_FILES: {unregistered}"
+    )
+
+
+def test_abi009_fires_on_unregistered_loader(tmp_path):
+    """A rogue CDLL call site outside the registry is a finding."""
+    from persia_tpu.analysis.common import ctypes_loader_files
+
+    pkg = tmp_path / "persia_tpu"
+    pkg.mkdir()
+    (pkg / "rogue.py").write_text(
+        "import ctypes\nlib = ctypes.CDLL('libsomething.so')\n"
+    )
+    # a docstring/comment mention must NOT count as a loader
+    (pkg / "innocent.py").write_text(
+        '"""talks about ctypes.CDLL(path) but never calls it"""\n'
+        "# lib = ctypes.CDLL(so_path)\n"
+    )
+    assert ctypes_loader_files(str(tmp_path)) == ["persia_tpu/rogue.py"]
+    findings, _cov = abi.check(root=str(tmp_path))
+    abi009 = [f for f in findings if f.rule == "ABI009"]
+    assert len(abi009) == 1 and abi009[0].path == "persia_tpu/rogue.py"
+
+
 # ----------------------------------------------------- concurrency fixtures
 
 
@@ -359,10 +393,10 @@ def test_clean_tree_zero_findings_with_full_coverage():
     abi_cov = coverage["abi"]
     assert set(abi_cov["libs"]) == set(NATIVE_LIBS)
     assert all(n > 0 for n in abi_cov["libs"].values()), abi_cov["libs"]
-    assert len(abi_cov["binding_files"]) == 5
+    assert len(abi_cov["binding_files"]) == 6
     # every registered ctypes file is inside the scanned python set
     assert sorted(coverage["ctypes_files"]) == sorted(CTYPES_FILES)
-    assert len(CTYPES_FILES) == 11
+    assert len(CTYPES_FILES) == 12
 
 
 def test_cli_exit_codes():

@@ -1,0 +1,610 @@
+"""Sparsity-aware auto-tiering (persia_tpu.embedding.tiering): the native
+access sketch, the placement planner, and live slot migration at stream
+fences.
+
+The flagship-shaped runs mirror tests/test_jobstate.py's fence machinery:
+a migration rides the SAME drained fence a snapshot commits on (feeder
+parked, write-back drained, hazard ledger heads == tails, manifest on
+disk), so the bit-parity contract is provable — a run migrated at fence F
+lands bit-identical to a run RESUMED from F's manifest directly into the
+final placement."""
+
+import os
+
+import numpy as np
+import pytest
+
+from persia_tpu.config import EmbeddingConfig, SlotConfig
+from persia_tpu.embedding.optim import Adagrad
+from persia_tpu.embedding.store import EmbeddingStore
+from persia_tpu.embedding.tiering import (
+    AUTO_TIER_ENV,
+    AccessProfiler,
+    AutoTierController,
+    PlacementPlanner,
+    SlotStats,
+    TIER_CACHED,
+    TIER_FUSED,
+    TIER_PS,
+    auto_tier_enabled,
+    enable_auto_tier,
+)
+from persia_tpu.embedding.tiering.native import NativeSketch
+from persia_tpu.embedding.worker import EmbeddingWorker
+
+VOCABS = (64, 32)
+
+
+def _cfg():
+    return EmbeddingConfig(
+        slots_config={"cat_0": SlotConfig(dim=8), "cat_1": SlotConfig(dim=8)},
+        feature_index_prefix_bit=8,
+    )
+
+
+def _stores(n=2, seed=7):
+    return [
+        EmbeddingStore(capacity=1 << 16, num_internal_shards=4, seed=seed)
+        for _ in range(n)
+    ]
+
+
+def _make_ctx(stores, **kw):
+    import optax
+
+    from persia_tpu.embedding import hbm_cache as hbm
+    from persia_tpu.models import DNN
+
+    cfg = _cfg()
+    return hbm.CachedTrainCtx(
+        model=DNN(dense_mlp_size=8, sparse_mlp_size=16, hidden_sizes=(32,)),
+        dense_optimizer=optax.adam(3e-3),
+        embedding_optimizer=Adagrad(lr=0.1),
+        worker=EmbeddingWorker(cfg, stores), embedding_config=cfg,
+        cache_rows=256, init_seed=7, **kw,
+    ).__enter__()
+
+
+def _batches(steps=12, seed=9):
+    from persia_tpu.testing import SyntheticClickDataset
+
+    return list(
+        SyntheticClickDataset(
+            num_samples=steps * 32, vocab_sizes=VOCABS, seed=seed
+        ).batches(32)
+    )[:steps]
+
+
+def _ps_entries(cfg, stores):
+    from persia_tpu.embedding.hashing import add_index_prefix
+
+    out = {}
+    for slot, vocab in zip(("cat_0", "cat_1"), VOCABS):
+        pre = cfg.slot(slot).index_prefix
+        for s in range(vocab):
+            sign = int(add_index_prefix(np.array([s], np.uint64), pre, 8)[0])
+            e = next(
+                (st.get_embedding_entry(sign) for st in stores
+                 if st.get_embedding_entry(sign) is not None), None,
+            )
+            if e is not None:
+                out[(slot, s)] = np.array(e, copy=True)
+    return out
+
+
+def _assert_entries_equal(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=str(k))
+
+
+def _assert_params_equal(pa, pb):
+    import jax
+
+    for (kp, x), (_, y) in zip(
+        jax.tree_util.tree_leaves_with_path(pa),
+        jax.tree_util.tree_leaves_with_path(pb),
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y), err_msg=str(kp))
+
+
+# --------------------------------------------------------------- the sketch
+
+
+def test_sketch_zipf_estimates_within_tolerance():
+    """Seeded zipf stream: totals exact, linear-counting uniques within a
+    few percent of the true distinct count, count-min never underestimates
+    and stays tight on the heavy hitters."""
+    rng = np.random.default_rng(3)
+    ids = (rng.zipf(1.3, 60_000) % 50_000).astype(np.uint64)
+    sk = NativeSketch(1, width_log2=16, depth=4, bitmap_bits=1 << 15, topk=8)
+    assert sk.observe(ids, 0, 0) == ids.size
+
+    total, unique, hot_frac, top1_frac = sk.slot_stats(0)
+    assert total == ids.size  # exact by construction
+    exact_unique = len(np.unique(ids))
+    assert abs(unique - exact_unique) / exact_unique < 0.05, (
+        unique, exact_unique
+    )
+    # count-min is a strict overestimator; tolerance covers the collision
+    # mass at this width (2^16 cells per row, 4 rows)
+    signs, counts = np.unique(ids, return_counts=True)
+    top = np.argsort(-counts)[:20]
+    for i in top:
+        est = sk.estimate(0, int(signs[i]))
+        assert est >= counts[i]
+        assert est <= counts[i] + 0.01 * ids.size
+    # a zipf stream's mass concentrates: the top-8 tracker must see it
+    assert hot_frac > 0.3
+    assert 0.0 < top1_frac <= hot_frac
+
+
+def test_sketch_decay_slides_working_set_window():
+    """decay() halves the mass and slides the two-window unique estimate:
+    history survives one round, then ages out with no fresh traffic."""
+    sk = NativeSketch(1, width_log2=12, depth=2, bitmap_bits=1 << 12, topk=4)
+    ids = np.arange(1000, dtype=np.uint64)
+    sk.observe(ids, 0, 0)
+    t0, u0, _, _ = sk.slot_stats(0)
+    sk.decay(0.5)
+    t1, u1, _, _ = sk.slot_stats(0)
+    assert t1 == pytest.approx(t0 / 2)
+    assert u1 == pytest.approx(u0, rel=0.01)  # prev window still counted
+    sk.decay(0.5)
+    _, u2, _, _ = sk.slot_stats(0)
+    assert u2 == 0.0  # both windows slid past the old traffic
+
+
+def test_sketch_strided_observe_matches_per_slot():
+    """The single-native-call strided path (flattened (S, B) matrix) must
+    attribute positions exactly like per-slot observe calls."""
+    rng = np.random.default_rng(5)
+    mat = rng.integers(0, 1 << 20, size=(3, 256)).astype(np.uint64)
+    a = NativeSketch(3, width_log2=12, depth=4, bitmap_bits=1 << 12, topk=4)
+    b = NativeSketch(3, width_log2=12, depth=4, bitmap_bits=1 << 12, topk=4)
+    a.observe(mat.reshape(-1), 256, 0)
+    for i in range(3):
+        b.observe(mat[i], 0, i)
+    for i in range(3):
+        assert a.slot_stats(i) == b.slot_stats(i)
+
+
+def test_sketch_export_import_roundtrip_and_geometry_guard():
+    sk = NativeSketch(2, width_log2=10, depth=3, bitmap_bits=1 << 10, topk=4)
+    sk.observe(np.arange(500, dtype=np.uint64), 0, 0)
+    sk.observe(np.arange(100, dtype=np.uint64) * 7, 0, 1)
+    blob = sk.export_bytes()
+
+    twin = NativeSketch(2, width_log2=10, depth=3, bitmap_bits=1 << 10, topk=4)
+    twin.import_bytes(blob)
+    assert twin.slot_stats(0) == sk.slot_stats(0)
+    assert twin.slot_stats(1) == sk.slot_stats(1)
+    assert twin.estimate(0, 123) == sk.estimate(0, 123)
+
+    other = NativeSketch(2, width_log2=11, depth=3, bitmap_bits=1 << 10, topk=4)
+    with pytest.raises(ValueError):
+        other.import_bytes(blob)
+    with pytest.raises(ValueError):
+        twin.import_bytes(blob[:32])  # truncated header/payload
+
+
+def test_sketch_rejects_bad_geometry():
+    with pytest.raises(ValueError):
+        NativeSketch(0)
+    with pytest.raises(ValueError):
+        NativeSketch(1, width_log2=2)  # below native floor
+    with pytest.raises(ValueError):
+        NativeSketch(1, depth=99)
+    with pytest.raises(IndexError):
+        NativeSketch(1).slot_stats(5)
+
+
+# ------------------------------------------------------------- the profiler
+
+
+def test_profiler_names_and_group_observe():
+    prof = AccessProfiler(
+        ["x", "y"], width_log2=12, depth=2, bitmap_bits=1 << 12, topk=4
+    )
+    with pytest.raises(ValueError):
+        AccessProfiler(["dup", "dup"])
+    mat = np.arange(64, dtype=np.uint64).reshape(2, 32)
+    prof.observe_group(["x", "y"], mat.reshape(-1), 32)
+    st = prof.stats()
+    assert st["x"].total == 32 and st["y"].total == 32
+    # non-contiguous order falls back to per-slot slices, same result
+    prof2 = AccessProfiler(
+        ["x", "y"], width_log2=12, depth=2, bitmap_bits=1 << 12, topk=4
+    )
+    prof2.observe_group(["y", "x"], mat.reshape(-1), 32)
+    assert prof2.stats()["y"].total == 32
+    assert prof2.stats()["x"].total == 32
+
+
+def test_profiler_state_roundtrip_and_slot_order_guard():
+    prof = AccessProfiler(
+        ["a", "b"], width_log2=12, depth=2, bitmap_bits=1 << 12, topk=4
+    )
+    prof.observe_slot("a", np.arange(300, dtype=np.uint64))
+    state = prof.export_state()
+    # dict must be JSON-safe (it rides a jobstate manifest component)
+    import json
+
+    state = json.loads(json.dumps(state))
+    twin = AccessProfiler.from_state(state)
+    assert twin.stats()["a"].total == prof.stats()["a"].total
+    assert twin.stats()["a"].unique == prof.stats()["a"].unique
+
+    reordered = AccessProfiler(
+        ["b", "a"], width_log2=12, depth=2, bitmap_bits=1 << 12, topk=4
+    )
+    with pytest.raises(ValueError):
+        reordered.load_state(state)
+
+
+# -------------------------------------------------------------- the planner
+
+
+def _st(total, unique, hot=0.0, top1=0.0):
+    return SlotStats(float(total), float(unique), hot, top1)
+
+
+def test_planner_admission_by_reuse_under_budget():
+    pl = PlacementPlanner(cached_row_budget=1000, cached_min_reuse=2.0,
+                          hysteresis=0.0, min_dwell=0)
+    stats = {
+        "hot": _st(10_000, 200),      # reuse 50 — cached
+        "warm": _st(3_000, 700),      # reuse 4.3 — cached, fills budget
+        "uniform": _st(5_000, 4_900), # reuse ~1 — ps (fails threshold)
+        "big": _st(9_000, 3_000),     # reuse 3 — ps (working set > budget)
+    }
+    plan = pl.plan(stats, {s: TIER_PS for s in stats})
+    assert plan.placements == {
+        "hot": TIER_CACHED, "warm": TIER_CACHED,
+        "uniform": TIER_PS, "big": TIER_PS,
+    }
+    assert set(plan.migrations) == {"hot", "warm"}
+    assert plan.scores["hot"]["reuse"] == pytest.approx(50.0)
+
+
+def test_planner_fused_admission_needs_vocab_and_density():
+    pl = PlacementPlanner(
+        cached_row_budget=10_000, fused_row_budget=500,
+        vocabs={"tiny": 400, "huge": 1_000_000},
+        cached_min_reuse=2.0, fused_min_density=0.5,
+        hysteresis=0.0, min_dwell=0,
+    )
+    stats = {
+        "tiny": _st(5_000, 390),   # density 12.5 — full vocab pins
+        "huge": _st(50_000, 40_000),  # vocab exceeds fused budget
+        "unknown": _st(50_000, 100),  # no vocab known -> not fusable
+    }
+    plan = pl.plan(stats, {s: TIER_PS for s in stats})
+    assert plan.placements["tiny"] == TIER_FUSED
+    assert plan.placements["huge"] == TIER_PS
+    assert plan.placements["unknown"] == TIER_CACHED
+
+
+def test_planner_hysteresis_blocks_borderline_moves():
+    pl = PlacementPlanner(cached_row_budget=10_000, cached_min_reuse=2.0,
+                          hysteresis=0.25, min_dwell=0)
+    # reuse 2.2 clears the threshold (raw plan says cached) but not the
+    # 2.0 * 1.25 = 2.5 admission margin -> suppressed flap, not a move
+    plan = pl.plan({"edge": _st(2_200, 1_000)}, {"edge": TIER_PS})
+    assert plan.placements == {"edge": TIER_PS}
+    assert plan.migrations == {} and plan.suppressed == 1
+    # reuse 3.0 clears the margin -> migrates
+    plan = pl.plan({"edge": _st(3_000, 1_000)}, {"edge": TIER_PS})
+    assert plan.migrations == {"edge": (TIER_PS, TIER_CACHED)}
+
+
+def test_planner_dwell_pins_fresh_migrants():
+    pl = PlacementPlanner(cached_row_budget=10_000, cached_min_reuse=2.0,
+                          hysteresis=0.0, min_dwell=2)
+    hot, cold = _st(8_000, 100), _st(1_000, 990)
+    # round 1: unseen slots carry min_dwell (free to move)
+    plan = pl.plan({"s": hot}, {"s": TIER_PS})
+    assert plan.migrations == {"s": (TIER_PS, TIER_CACHED)}
+    # round 2: just migrated (dwell restarted) — an immediate reversal is
+    # suppressed no matter how the stats flipped
+    plan = pl.plan({"s": cold}, plan.placements)
+    assert plan.migrations == {} and plan.suppressed == 1
+    # round 3: still dwelling
+    plan = pl.plan({"s": cold}, plan.placements)
+    assert plan.migrations == {} and plan.suppressed == 1
+    # round 4: dwell satisfied, the demotion lands
+    plan = pl.plan({"s": cold}, plan.placements)
+    assert plan.migrations == {"s": (TIER_CACHED, TIER_PS)}
+
+
+def test_planner_lockstep_group_moves_together():
+    """Slots sharing a feature group cannot straddle cached/ps (the tier
+    constructor rejects it): the minority follows the access-mass winner."""
+    pl = PlacementPlanner(cached_row_budget=10_000, cached_min_reuse=2.0,
+                          hysteresis=0.0, min_dwell=0,
+                          lockstep_groups=[["a", "b"]])
+    stats = {"a": _st(9_000, 100), "b": _st(1_000, 990)}
+    plan = pl.plan(stats, {"a": TIER_PS, "b": TIER_PS})
+    # b alone would go ps (reuse ~1) but a carries 9x its mass
+    assert plan.placements == {"a": TIER_CACHED, "b": TIER_CACHED}
+
+
+def test_planner_rejects_unknown_tier():
+    pl = PlacementPlanner(cached_row_budget=10)
+    with pytest.raises(ValueError):
+        pl.plan({}, {"s": "warm-ish"})
+
+
+# ----------------------------------------------------------- the controller
+
+
+def test_controller_on_fence_plans_migrates_and_records():
+    from persia_tpu import tracing
+    from persia_tpu.metrics import get_metrics
+
+    class _FakeCtx:
+        def __init__(self):
+            self.calls = []
+
+        def apply_migration(self, to_cached=(), to_ps=()):
+            self.calls.append((tuple(to_cached), tuple(to_ps)))
+
+    prof = AccessProfiler(
+        ["hot", "cold"], width_log2=12, depth=2,
+        bitmap_bits=1 << 12, topk=4,
+    )
+    rng = np.random.default_rng(11)
+    prof.observe_slot("hot", (rng.zipf(1.5, 8_000) % 500).astype(np.uint64))
+    prof.observe_slot("cold", np.arange(4_000, dtype=np.uint64))
+    planner = PlacementPlanner(cached_row_budget=8_192, cached_min_reuse=2.0,
+                               hysteresis=0.1, min_dwell=0)
+    ctrl = AutoTierController(
+        prof, planner,
+        {"hot": TIER_PS, "cold": TIER_CACHED}, decay=0.5,
+    )
+    ctx = _FakeCtx()
+    tracing.flight_clear()
+    before = get_metrics().snapshot(prefix="persia_tpu_tiering_")
+    moves = ctrl.on_fence(ctx, gstep=4)
+    assert moves == {
+        "hot": (TIER_PS, TIER_CACHED), "cold": (TIER_CACHED, TIER_PS),
+    }
+    assert ctx.calls == [(("hot",), ("cold",))]
+    assert ctrl.placements == {"hot": TIER_CACHED, "cold": TIER_PS}
+    kinds = [e["kind"] for e in tracing.flight_snapshot()]
+    assert "tiering.plan" in kinds and "tiering.migrate" in kinds
+    after = get_metrics().snapshot(prefix="persia_tpu_tiering_")
+
+    def _val(snap, name):
+        return sum((snap.get(name) or {}).values())
+
+    assert (
+        _val(after, "persia_tpu_tiering_migrations")
+        - _val(before, "persia_tpu_tiering_migrations")
+    ) == 2
+
+    # a decision round with nothing to move still leaves evidence: the
+    # same traffic shape continues, the new placement is already right
+    prof.observe_slot("hot", (rng.zipf(1.5, 8_000) % 500).astype(np.uint64))
+    prof.observe_slot("cold", np.arange(4_000, 8_000, dtype=np.uint64))
+    tracing.flight_clear()
+    assert ctrl.on_fence(ctx, gstep=8) == {}
+    assert [e["kind"] for e in tracing.flight_snapshot()] == ["tiering.plan"]
+
+    # controller state round-trips (it rides the fence manifest)
+    state = ctrl.export_state()
+    twin = AutoTierController(
+        AccessProfiler(["hot", "cold"], width_log2=12, depth=2,
+                       bitmap_bits=1 << 12, topk=4),
+        planner, {"hot": TIER_CACHED, "cold": TIER_CACHED},
+    )
+    twin.load_state(state)
+    assert twin.placements == ctrl.placements
+
+
+def test_auto_tier_env_knob(monkeypatch):
+    monkeypatch.delenv(AUTO_TIER_ENV, raising=False)
+    assert not auto_tier_enabled()
+    monkeypatch.setenv(AUTO_TIER_ENV, "1")
+    assert auto_tier_enabled()
+
+
+def test_launcher_exports_auto_tier_env(monkeypatch):
+    from persia_tpu import launcher
+
+    captured = {}
+
+    def _fake_run(cmd, extra_env):
+        captured.update(extra_env)
+        return 0
+
+    monkeypatch.setattr(launcher, "_run", _fake_run)
+    assert launcher.main(["nn-worker", "train.py", "--auto-tier"]) == 0
+    assert captured.get("PERSIA_AUTO_TIER") == 1
+    captured.clear()
+    assert launcher.main(["nn-worker", "train.py"]) == 0
+    assert "PERSIA_AUTO_TIER" not in captured
+
+
+# ------------------------------------------- live migration (stream fences)
+
+
+def test_stream_migration_at_fence_and_ledger_drained(tmp_path):
+    """A queued migration applies at the first fence: the stream must
+    verify heads == tails and an EMPTY hazard ledger before the tier is
+    re-registered (mirrors the PR 5 fence verification), then keep
+    training — including a SECOND fence on the rebuilt tier."""
+    from persia_tpu import tracing
+
+    batches = _batches(12)
+    ctx = _make_ctx(_stores())
+    ctx.request_migration(to_ps=["cat_1"])
+    tracing.flight_clear()
+    ctx.train_stream(batches, snapshot_every=4, job_state=str(tmp_path / "js"))
+    st = ctx.stream_stats()
+    assert st["fences"] == 2 and st["migrations"] == 1
+    assert st["tiers"]["ps_slots"] == ["cat_1"]
+    assert st["tiers"]["cached_slots"] == ["cat_0"]
+    assert set(ctx.tier.ps_slots) == {"cat_1"}
+    # hazard ledger fully drained across the re-registration
+    assert ctx._pending_signs == set()
+    kinds = [e["kind"] for e in tracing.flight_snapshot()]
+    assert "tiering.migrate" in kinds
+    assert kinds.index("stream.fence_commit") < kinds.index("tiering.migrate")
+    ctx.flush()
+    # the post-migration fence's manifest recorded the drained evidence
+    from persia_tpu import jobstate
+
+    m = jobstate.coerce_manager(str(tmp_path / "js")).latest()
+    assert m.step == 8
+    assert m.read_json("cache.json")["pending_ledger_entries"] == 0
+
+
+def test_migration_bit_parity_with_fresh_placement_resume(tmp_path):
+    """THE tiering parity contract: run A migrates cat_1 -> ps at fence 4
+    and continues; run B resumes from that SAME fence manifest and applies
+    the final placement directly. Identical flushed PS state + identical
+    post-fence device programs => bit-identical params and PS entries."""
+    cfg = _cfg()
+    batches = _batches(6)
+
+    stores = _stores()
+    ctx_a = _make_ctx(stores)
+    ctx_a.request_migration(to_ps=["cat_1"])
+    ctx_a.train_stream(
+        batches, snapshot_every=4, job_state=str(tmp_path / "js")
+    )
+    assert ctx_a.stream_stats()["migrations"] == 1
+    ctx_a.flush()
+    params_a = ctx_a.state.params
+    entries_a = _ps_entries(cfg, stores)
+
+    # run B: born all-cached (same constructor as A), rewound to A's fence
+    # manifest, then re-registered STRAIGHT into the final placement
+    ctx_b = _make_ctx(stores)
+    m = ctx_b.resume(str(tmp_path / "js"))
+    assert m is not None and m.step == 4
+    ctx_b.apply_migration(to_ps=["cat_1"])
+    ctx_b.train_stream(
+        batches[m.step:], snapshot_every=4,
+        job_state=str(tmp_path / "js2"), start_step=m.step,
+    )
+    ctx_b.flush()
+
+    _assert_params_equal(params_a, ctx_b.state.params)
+    _assert_entries_equal(entries_a, _ps_entries(cfg, stores))
+
+
+def test_apply_migration_validates():
+    ctx = _make_ctx(_stores())
+    with pytest.raises(ValueError):
+        ctx.apply_migration(to_cached=["cat_0"], to_ps=["cat_0"])
+    with pytest.raises(KeyError):
+        ctx.apply_migration(to_ps=["nope"])
+    # no-op moves (already in the target tier) are dropped silently
+    ctx.apply_migration(to_cached=["cat_0"])
+    assert set(s for g in ctx.tier.groups for s in g.slots) == {
+        "cat_0", "cat_1",
+    }
+
+
+# --------------------------------------------------- auto-tiering end to end
+
+
+def _skewed_batches(steps, batch=32, seed=13):
+    """cat_0: zipf over a tiny stable hot set (earns its cache rows);
+    cat_1: near-unique wide ids (reuse ~1 — thrashes any cache)."""
+    from persia_tpu.data import (
+        IDTypeFeatureWithSingleID,
+        Label,
+        NonIDTypeFeature,
+        PersiaBatch,
+    )
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for b in range(steps):
+        hot = (rng.zipf(1.4, batch) % 48).astype(np.uint64)
+        cold = (
+            np.arange(b * batch, (b + 1) * batch, dtype=np.uint64) % 60_000
+        )
+        out.append(PersiaBatch(
+            [
+                IDTypeFeatureWithSingleID("cat_0", hot),
+                IDTypeFeatureWithSingleID("cat_1", cold),
+            ],
+            non_id_type_features=[NonIDTypeFeature(
+                rng.normal(size=(batch, 5)).astype(np.float32)
+            )],
+            labels=[Label(
+                rng.integers(0, 2, (batch, 1)).astype(np.float32)
+            )],
+            requires_grad=True,
+            batch_id=b,
+        ))
+    return out
+
+
+def test_auto_tier_demotes_cold_slot_and_survives_resume(tmp_path):
+    """End to end: the profiler taps the feeder, the planner demotes the
+    reuse-free slot at a fence, the sketch + placements ride the manifest,
+    and a resumed job re-registers straight into the saved placement."""
+    batches = _skewed_batches(12)
+
+    stores = _stores()
+    ctx = _make_ctx(stores)
+    ctrl = enable_auto_tier(ctx, cached_min_reuse=2.0, hysteresis=0.1,
+                            min_dwell=0, decay=0.5)
+    assert ctx.auto_tier is ctrl and ctx.tier.profiler is ctrl.profiler
+    ctx.train_stream(
+        batches, snapshot_every=4, job_state=str(tmp_path / "js")
+    )
+    st = ctx.stream_stats()
+    assert ctrl.placements["cat_1"] == TIER_PS, ctrl.last_plan
+    assert ctrl.placements["cat_0"] == TIER_CACHED
+    assert st["migrations"] >= 1
+    assert "cat_1" in st["tiers"]["ps_slots"]
+    # the profiler kept counting across the migration (on BOTH paths:
+    # strided while cached, per-slot once it moved to the ps tier)
+    assert ctrl.profiler.stats()["cat_1"].total > 0
+    ctx.flush()
+
+    # resume: fresh ctx born all-cached + a fresh controller; the manifest
+    # restores the sketch AND the placement before any training
+    ctx2 = _make_ctx(stores)
+    ctrl2 = enable_auto_tier(ctx2, cached_min_reuse=2.0, hysteresis=0.1,
+                             min_dwell=0, decay=0.5)
+    m = ctx2.resume(str(tmp_path / "js"))
+    assert m is not None
+    assert ctrl2.placements["cat_1"] == TIER_PS
+    assert set(ctx2.tier.ps_slots) >= {"cat_1"}
+    st2 = ctrl2.profiler.stats()
+    assert st2["cat_0"].total > 0  # history survived the snapshot
+    # and the resumed stream keeps training on the migrated layout
+    ctx2.train_stream(
+        batches[m.step:], snapshot_every=4,
+        job_state=str(tmp_path / "js"), start_step=m.step,
+    )
+    ctx2.flush()
+
+
+def test_fence_manifest_carries_tiering_component(tmp_path):
+    from persia_tpu import jobstate
+
+    # the end-of-stream boundary does not fence: 8 steps at K=4 commits
+    # exactly one mid-stream manifest (step 4)
+    batches = _skewed_batches(8)
+    ctx = _make_ctx(_stores())
+    enable_auto_tier(ctx, min_dwell=0)
+    ctx.train_stream(
+        batches, snapshot_every=4, job_state=str(tmp_path / "js")
+    )
+    ctx.flush()
+    m = jobstate.coerce_manager(str(tmp_path / "js")).latest()
+    assert m is not None and m.step == 4
+    assert m.has("tiering.json")
+    doc = m.read_json("tiering.json")
+    assert set(doc) == {"placements", "profiler"}
+    assert set(doc["placements"]) == {"cat_0", "cat_1"}
+    # the sketch blob is importable as exported
+    AccessProfiler.from_state(doc["profiler"])
