@@ -900,12 +900,19 @@ def bench_chaos():
     the artifact shows what was injected and what it cost.
 
     Spec via ``BENCH_CHAOS`` (see chaos.parse_chaos_spec), e.g.
-    ``python bench.py --chaos=reset=0.02,slow=0.01,seed=7``. Runs on the
+    ``python bench.py --chaos=reset=0.02,slow=0.01,seed=7``. Data-plane
+    content faults (NaN dense features, label flips, sign corruption,
+    gradient spikes — persia_tpu/health's detection surface) ride along
+    via ``BENCH_CHAOS_DATA`` (chaos.parse_data_chaos_spec) and their
+    counts land in the artifact. Runs on the
     CPU-host topology; the number is a liveness/robustness datapoint, not
     a throughput headline."""
     import optax
 
-    from persia_tpu.chaos import ChaosAction, ChaosPlane, parse_chaos_spec
+    from persia_tpu.chaos import (
+        ChaosAction, ChaosPlane, DataPlaneChaos, parse_chaos_spec,
+        parse_data_chaos_spec,
+    )
     from persia_tpu.config import EmbeddingConfig, SlotConfig
     from persia_tpu.data import (
         IDTypeFeatureWithSingleID, Label, NonIDTypeFeature, PersiaBatch,
@@ -919,6 +926,16 @@ def bench_chaos():
     from persia_tpu.service.resilience import ResiliencePolicy, RetryPolicy
 
     cfg_chaos = parse_chaos_spec(os.environ.get("BENCH_CHAOS", ""))
+    # data-plane content faults (BENCH_CHAOS_DATA, chaos.parse_data_chaos_spec
+    # format) — poisons the health layer detects, vs. the transport faults
+    # above which the crc/breaker layer detects
+    data_chaos = DataPlaneChaos(
+        parse_data_chaos_spec(os.environ.get("BENCH_CHAOS_DATA", ""))
+    )
+    data_faults_on = any((
+        data_chaos.cfg.nan_prob, data_chaos.cfg.label_flip_prob,
+        data_chaos.cfg.sign_corrupt_prob, data_chaos.cfg.spike_prob,
+    ))
     steps = int(os.environ.get("BENCH_CHAOS_STEPS", "60"))
     n_slots, batch = 6, 1024
     # corrupt frames must be DETECTED, not silently trained on
@@ -952,7 +969,20 @@ def bench_chaos():
                 embedding_optimizer=Adagrad(lr=0.05),
                 worker=worker, embedding_config=emb_cfg,
                 cache_rows=1 << 14, init_seed=7,
+                # content faults poison the model without the on-device
+                # finite gate: arm the probe whenever data chaos is on
+                health_probe=data_faults_on,
             ).__enter__()
+            sentinel = None
+            if data_faults_on:
+                from persia_tpu.health import SentinelConfig, StreamSentinel
+
+                # count-rungs only (finite skip / clip): the soak measures
+                # injected-vs-detected, the rollback ladder is exercised by
+                # tests/test_health.py with a jobstate fence to return to
+                sentinel = StreamSentinel.from_ctx(
+                    ctx, SentinelConfig(z_threshold=1e9, warmup_steps=1 << 30)
+                )
             rng = np.random.default_rng(3)
 
             def batches():
@@ -977,11 +1007,18 @@ def bench_chaos():
             prog.start()
             t0 = time.perf_counter()
             ctx.train_stream(
-                prog.wrap(plane.wrap_batches(batches())), fetch_final=False
+                prog.wrap(plane.wrap_batches(data_chaos.wrap(batches()))),
+                fetch_final=False,
+                sentinel=sentinel,
             )
             elapsed = time.perf_counter() - t0
             m = ctx.last_metrics()
-            assert m is not None and np.isfinite(m["loss"])
+            assert m is not None
+            # a poisoned final batch legitimately reports a non-finite
+            # LOSS (its update was zeroed on device); the health claim is
+            # that the non-finite never lands in trained state
+            if not data_faults_on:
+                assert np.isfinite(m["loss"])
             st = ctx.stream_stats() or {}
             return {
                 "samples_per_sec": round(steps * batch / elapsed, 1),
@@ -991,6 +1028,11 @@ def bench_chaos():
                 # time-to-resume, steps replayed, journal hits per mode
                 "kill_resume": _bench_kill_resume(),
                 "faults_injected": plane.fault_counts(),
+                "data_chaos": data_chaos.cfg.to_dict(),
+                "data_faults_injected": dict(data_chaos.counts),
+                "data_faults_detected": (
+                    dict(sentinel.stats) if sentinel is not None else {}
+                ),
                 "degraded_steps": st.get("degraded_steps", 0),
                 "degraded_lookup_frac_max": st.get(
                     "degraded_lookup_frac_max", 0.0

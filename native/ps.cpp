@@ -993,4 +993,34 @@ int64_t ps_load_shard(void* h, const uint8_t* data, int64_t len) {
   return (int64_t)cnt;
 }
 
+// Fence-point row scrubber (persia_tpu/health): scan every live entry for
+// NaN/Inf anywhere in its [emb | state] floats and repair damaged rows to
+// the deterministic seeded init — the SAME contract as a degraded-mode or
+// cold lookup (init_embedding + init_state), so a scrubbed row is
+// indistinguishable from a freshly admitted one. Returns the repaired-row
+// count; up to `cap` repaired signs land in out_signs for the caller's
+// journal / flight-recorder record. Per-shard locking only — lookups on
+// other shards proceed during the scan.
+int64_t ps_scan_nonfinite(void* h, uint64_t* out_signs, int64_t cap) {
+  Store* s = (Store*)h;
+  int64_t repaired = 0;
+  for (uint32_t si = 0; si < s->num_shards; ++si) {
+    Shard& sh = s->shards[si];
+    std::lock_guard<std::mutex> g(sh.mu);
+    for (Entry& en : sh.entries) {
+      if (!en.data) continue;  // free-listed slot
+      bool bad = false;
+      for (uint32_t i = 0; i < en.len; ++i) {
+        if (!std::isfinite(en.data[i])) { bad = true; break; }
+      }
+      if (!bad) continue;
+      s->init_embedding(en.sign, en.dim, en.data);
+      s->init_state(en.dim, en.data + en.dim);
+      if (repaired < cap) out_signs[repaired] = en.sign;
+      ++repaired;
+    }
+  }
+  return repaired;
+}
+
 }  // extern "C"

@@ -25,6 +25,10 @@ any finding:
   ``persia_tpu_``/``persia_`` namespace, and hand-rolled
   ``t0 = time.time()`` stage timers in pipeline modules that bypass
   ``tracing.stage_span`` (:mod:`persia_tpu.analysis.observability_lint`).
+- **Numerical health** (NUM001): train-plane code consuming loss/grad
+  scalars on the host (``.item()``, ``float(...)``, ``np.asarray``)
+  with no finite guard in the function — a blind spot in the health
+  escalation ladder (:mod:`persia_tpu.analysis.numeric_lint`).
 
 Suppress a finding inline with ``# persia-lint: disable=RULE`` (or
 ``disable=all``) on the offending line; C sources use the same token in a
@@ -55,7 +59,7 @@ __all__ = [
     "NATIVE_LIBS",
 ]
 
-_PASS_PREFIXES = ("ABI", "CONC", "RES", "DUR", "OBS")
+_PASS_PREFIXES = ("ABI", "CONC", "RES", "DUR", "OBS", "NUM")
 
 
 def run_all(
@@ -67,6 +71,7 @@ def run_all(
         abi,
         concurrency,
         durability,
+        numeric_lint,
         observability_lint,
         resilience_lint,
     )
@@ -88,6 +93,8 @@ def run_all(
         findings.extend(durability.check(root, py_files))
     if any(w.startswith("OBS") for w in wanted):
         findings.extend(observability_lint.check(root, py_files))
+    if any(w.startswith("NUM") for w in wanted):
+        findings.extend(numeric_lint.check(root, py_files))
     coverage["python_files_scanned"] = len(py_files)
     coverage["ctypes_files"] = [p for p in CTYPES_FILES
                                 if any(rel(f) == p for f in py_files)]

@@ -1,0 +1,170 @@
+"""Numerical-health lint: loss/grad scalars must cross the host boundary
+through a finite guard.
+
+The train plane's escalation ladder (persia_tpu/health) only works if
+every point where a loss or gradient statistic becomes a HOST scalar —
+``.item()``, ``float(...)``, ``np.asarray(...)`` on a device value — can
+see a NaN/Inf when one arrives. A decode site that converts and consumes
+the number without any finite check is a blind spot: the poisoned value
+flows into logs, EMAs, or LR schedules and the sentinel never hears
+about it.
+
+- NUM001 a function in a train-plane module converts a loss/grad-named
+         value to a host scalar with no finite-guard token
+         (``isfinite`` / ``isnan`` / ``nonfinite``) anywhere in the
+         function — route the value through a guard such as
+         ``parallel.train_step._note_nonfinite_loss`` or check it
+         inline before consuming it
+
+Scope: the modules that decode device step results or publish training
+stats (``embedding/hbm_cache/``, ``parallel/``, ``data_loader.py``,
+``topology.py``). The health package itself is the guard mechanism and
+exempt. A function-level whitelist (rather than expression-level
+dataflow) keeps the pass stdlib-pure and fast; the guard token must
+live in the SAME function so the check stays local and reviewable.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, Optional, Sequence
+
+from persia_tpu.analysis.common import Finding, REPO_ROOT, read_text, rel
+
+_SCOPE_DIRS = (
+    os.path.join("persia_tpu", "embedding", "hbm_cache"),
+    os.path.join("persia_tpu", "parallel"),
+)
+_SCOPE_FILES = (
+    os.path.join("persia_tpu", "data_loader.py"),
+    os.path.join("persia_tpu", "topology.py"),
+)
+# the guard mechanism itself may convert unguarded
+_EXEMPT_DIRS = (os.path.join("persia_tpu", "health"),)
+
+# a conversion site is loss/grad-plane when the converted expression or
+# its assignment target carries one of these name stems
+_VALUE_RE = re.compile(r"(?:^|[^a-z])(loss|grad|gnorm)", re.IGNORECASE)
+
+# what proves the enclosing function already guards: any finite check or
+# a call into the nonfinite-note helper
+_GUARD_TOKENS = ("isfinite", "isnan", "nonfinite")
+
+
+def _src(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover
+        return ""
+
+
+def _conversion(node: ast.expr) -> Optional[str]:
+    """Return the converted sub-expression's source when ``node`` is a
+    host-scalar conversion (``float(x)``, ``x.item()``,
+    ``np.asarray(x)`` / ``np.array(x)``), else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Name) and f.id == "float" and len(node.args) == 1:
+        return _src(node.args[0])
+    if isinstance(f, ast.Attribute):
+        if f.attr == "item" and not node.args:
+            return _src(f.value)
+        # np.asarray is the host sync; jnp.asarray is device-ward and
+        # never materializes the value on the host — not a crossing
+        if (f.attr in ("asarray", "array") and node.args
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("np", "numpy")):
+            return _src(node.args[0])
+    return None
+
+
+def _own_nodes(fn: ast.AST):
+    """Walk a function's body WITHOUT descending into nested defs — each
+    function is judged (and whitelisted) on its own source only."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _function_findings(fn: ast.AST, path: str) -> List[Finding]:
+    fn_src = _src(fn)
+    if any(tok in fn_src for tok in _GUARD_TOKENS):
+        return []
+    findings: List[Finding] = []
+    for node in _own_nodes(fn):
+        targets = ""
+        expr = node
+        if isinstance(node, ast.Assign):
+            targets = " ".join(_src(t) for t in node.targets)
+            expr = node.value
+        for sub in ast.walk(expr):
+            conv = _conversion(sub)
+            if conv is None:
+                continue
+            if not (_VALUE_RE.search(conv) or _VALUE_RE.search(targets)):
+                continue
+            findings.append(Finding(
+                "NUM001", path, sub.lineno,
+                f"loss/grad scalar crosses to host unguarded ({_src(sub)}) "
+                "— a NaN/Inf here flows into stats/schedules invisibly; "
+                "check np.isfinite (or route through "
+                "parallel.train_step._note_nonfinite_loss) in this "
+                "function before consuming it",
+            ))
+    # one finding per line: a chained conversion (float(x.item())) is one
+    # blind spot, not two
+    seen = set()
+    out = []
+    for f in findings:
+        if (f.path, f.line) not in seen:
+            seen.add((f.path, f.line))
+            out.append(f)
+    return out
+
+
+def _in_scope(path: str) -> bool:
+    p = rel(path)
+    if any(p.startswith(d + os.sep) for d in _EXEMPT_DIRS):
+        return False
+    if p in _SCOPE_FILES:
+        return True
+    return any(p.startswith(d + os.sep) for d in _SCOPE_DIRS)
+
+
+def check_source(text: str, path: str) -> List[Finding]:
+    """Lint one file (no scope filter — fixtures call this directly)."""
+    tree = ast.parse(text, filename=path)
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            findings.extend(_function_findings(node, path))
+    # nested defs are walked twice (outer pass sees them inside the
+    # enclosing function's walk); dedupe keeps one finding per site
+    seen = set()
+    out = []
+    for f in findings:
+        if (f.path, f.line) not in seen:
+            seen.add((f.path, f.line))
+            out.append(f)
+    return out
+
+
+def check(root: str = REPO_ROOT,
+          files: Optional[Sequence[str]] = None) -> List[Finding]:
+    from persia_tpu.analysis.common import python_files
+
+    paths = list(files) if files is not None else python_files(root)
+    findings: List[Finding] = []
+    for p in paths:
+        abspath = p if os.path.isabs(p) else os.path.join(root, p)
+        if not _in_scope(abspath):
+            continue
+        findings.extend(check_source(read_text(abspath), rel(abspath)))
+    return findings

@@ -38,6 +38,8 @@ import time
 from dataclasses import asdict, dataclass, field
 from typing import Dict, List, Optional, Sequence
 
+import numpy as np
+
 from persia_tpu.logger import get_default_logger
 from persia_tpu.metrics import get_metrics
 from persia_tpu.tracing import record_event
@@ -490,6 +492,140 @@ class DeltaChannelChaos:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+
+
+@dataclass
+class DataPlaneChaosConfig:
+    """Per-batch data-corruption probabilities for :class:`DataPlaneChaos`
+    (all default 0 = transparent). One fault class fires per batch at
+    most — draws share a single uniform sample so probabilities compose
+    the same way as :class:`ChaosConfig`."""
+
+    seed: int = 0
+    nan_prob: float = 0.0          # NaN/Inf written into a dense feature
+    label_flip_prob: float = 0.0   # binary labels inverted
+    sign_corrupt_prob: float = 0.0 # high bits set on id-feature signs
+    spike_prob: float = 0.0        # dense features scaled by spike_scale
+    spike_scale: float = 1e6       # finite, but large enough to spike grads
+
+    def to_dict(self) -> Dict:
+        return asdict(self)
+
+
+def parse_data_chaos_spec(spec: str) -> DataPlaneChaosConfig:
+    """Parse a ``bench.py --chaos`` data-plane spec string like
+    ``"seed=7,nan=0.01,label_flip=0.02,sign=0.01,spike=0.01"``.
+    Keys: seed, nan, label_flip, sign, spike, spike_scale."""
+    cfg = DataPlaneChaosConfig()
+    if not spec:
+        return cfg
+    alias = {
+        "nan": "nan_prob", "label_flip": "label_flip_prob",
+        "sign": "sign_corrupt_prob", "spike": "spike_prob",
+        "spike_scale": "spike_scale", "seed": "seed",
+    }
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        attr = alias.get(key.strip())
+        if attr is None:
+            raise ValueError(f"unknown data-chaos knob {key!r} in {spec!r}")
+        setattr(cfg, attr, int(val) if attr == "seed" else float(val))
+    return cfg
+
+
+class DataPlaneChaos:
+    """Seeded batch-level fault injector for the training data plane.
+
+    The transport fault classes above damage bytes in flight; this one
+    damages batch CONTENT — the poisons the health layer
+    (persia_tpu/health) exists to catch: non-finite dense features and
+    labels (validator reject), out-of-domain signs (validator reject),
+    flipped labels and finite gradient spikes (on-device sentinel /
+    host z-score). The fault draw hashes ``(seed, batch_index)`` so a
+    schedule replays identically — the same property that makes the
+    bit-parity rollback test deterministic.
+
+    Mutated arrays are COPIES: the source batch stays clean, so a
+    clean-vs-poisoned parity run can share one dataset object.
+    """
+
+    def __init__(self, cfg: Optional[DataPlaneChaosConfig] = None):
+        self.cfg = cfg or DataPlaneChaosConfig()
+        self.counts: Dict[str, int] = {
+            "batches": 0, "nan": 0, "label_flip": 0, "sign_corrupt": 0,
+            "spike": 0,
+        }
+
+    def _fault_for(self, index: int) -> str:
+        rng = random.Random(f"{self.cfg.seed}:batch:{index}")
+        r = rng.random()
+        cfg = self.cfg
+        for name, prob in (("nan", cfg.nan_prob),
+                           ("label_flip", cfg.label_flip_prob),
+                           ("sign_corrupt", cfg.sign_corrupt_prob),
+                           ("spike", cfg.spike_prob)):
+            if prob and r < prob:
+                return name
+            r -= prob
+        return "ok"
+
+    def _poison(self, batch, fault: str, index: int):
+        from persia_tpu.data import (IDTypeFeature, Label, NonIDTypeFeature,
+                                     PersiaBatch)
+
+        rng = random.Random(f"{self.cfg.seed}:poison:{index}")
+        id_feats = batch.id_type_features
+        dense = list(batch.non_id_type_features)
+        labels = list(batch.labels)
+        if fault == "nan" and dense:
+            fi = rng.randrange(len(dense))
+            arr = dense[fi].data.astype(np.float32, copy=True)
+            flat = arr.reshape(-1)
+            flat[rng.randrange(flat.size)] = (
+                np.nan if rng.random() < 0.5 else np.inf
+            )
+            dense[fi] = NonIDTypeFeature(arr, name=dense[fi].name)
+        elif fault == "label_flip" and labels:
+            li = rng.randrange(len(labels))
+            arr = labels[li].data.astype(np.float32, copy=True)
+            labels[li] = Label(1.0 - arr, name=labels[li].name)
+        elif fault == "sign_corrupt" and id_feats:
+            fi = rng.randrange(len(id_feats))
+            feat = id_feats[fi]
+            flat, cnts = feat.flat_counts()
+            if flat.size:
+                flat = flat.copy()
+                flat[rng.randrange(flat.size)] |= np.uint64(1) << np.uint64(63)
+                id_feats = list(id_feats)
+                id_feats[fi] = IDTypeFeature.from_flat(feat.name, flat, cnts)
+        elif fault == "spike" and dense:
+            fi = rng.randrange(len(dense))
+            arr = dense[fi].data.astype(np.float32, copy=True)
+            dense[fi] = NonIDTypeFeature(
+                arr * np.float32(self.cfg.spike_scale), name=dense[fi].name
+            )
+        return PersiaBatch(
+            id_type_features=id_feats,
+            non_id_type_features=dense,
+            labels=labels,
+            requires_grad=batch.requires_grad,
+            batch_id=batch.batch_id,
+            meta=batch.meta,
+        )
+
+    def wrap(self, batches):
+        """Yield each batch, poisoned per the seeded schedule."""
+        for index, batch in enumerate(batches):
+            self.counts["batches"] += 1
+            fault = self._fault_for(index)
+            if fault != "ok":
+                self.counts[fault] += 1
+                record_event("chaos.data_fault", fault=fault, batch=index)
+                batch = self._poison(batch, fault, index)
+            yield batch
 
 
 # ----------------------------------------------------------- trainer kills

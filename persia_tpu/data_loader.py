@@ -317,11 +317,16 @@ class DataLoader:
         timeout_s: float = 120.0,
         recovery_retries: int = 3,
         emb_workers: Optional[List] = None,
+        validator=None,
     ):
         if staleness < 1:
             raise ValueError("staleness must be >= 1")
         self.dataset = dataset
         self.ctx = ctx
+        # optional data-plane integrity gate (health.BatchValidator): a
+        # rejected batch is quarantined at the feed stage and never enters
+        # the lookup pipeline — batch_ids stay contiguous for the survivors
+        self.validator = validator
         # embedding-worker handles addressable by a dataflow batch's
         # remote_ref worker index (defaults to the ctx's single worker)
         self.emb_workers = list(emb_workers) if emb_workers else [ctx.worker]
@@ -353,7 +358,10 @@ class DataLoader:
     def _feed(self, in_q: "queue.Queue"):
         try:
             next_id = 0
-            for batch in self.dataset:
+            for step, batch in enumerate(self.dataset):
+                if (self.validator is not None
+                        and not self.validator.admit(batch, step=step)):
+                    continue  # quarantined: never assigned an id
                 if batch.batch_id is None:
                     batch.batch_id = next_id
                 next_id = batch.batch_id + 1
@@ -618,9 +626,20 @@ class DataLoader:
         from persia_tpu.parallel.train_step import unpack_step_grads
 
         def _materialize():
-            emb_grads = unpack_step_grads(
-                np.asarray(gpacked), training_batch.device_batch
-            )
+            packed = np.asarray(gpacked)
+            if not np.isfinite(packed).all():
+                # poisoned grad buffer reaching the PS wire: note it for
+                # the health ladder (the on-device sentinel zeroes these
+                # when armed; unarmed, detection must still not be silent)
+                from persia_tpu.metrics import get_metrics
+                from persia_tpu.tracing import record_event
+
+                get_metrics().counter(
+                    "persia_tpu_health_nonfinite_grads",
+                    "non-finite packed gradient buffers at host decode",
+                ).inc()
+                record_event("health.anomaly", cause="nonfinite_grad_buffer")
+            emb_grads = unpack_step_grads(packed, training_batch.device_batch)
             return self.ctx.emb_grads_to_slot_grads(
                 training_batch.emb_batches, emb_grads, training_batch.counts
             )

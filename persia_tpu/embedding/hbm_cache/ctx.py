@@ -93,6 +93,9 @@ class CachedTrainCtx:
         loss_scale_growth_interval: int = 2000,
         loss_scale_max: float = float(2 ** 24),
         wb_ring_rows: int = 1 << 20,
+        health_probe: Optional[bool] = None,
+        health_clip_norm: Optional[float] = None,
+        health_scrub_at_fence: Optional[bool] = None,
     ):
         self.model = model
         self.dense_optimizer = dense_optimizer
@@ -153,6 +156,20 @@ class CachedTrainCtx:
         # (embedding VALUES do not tolerate int8 the way EF'd gradients do)
         self._ps_int8 = ps_wire_dtype == "int8"
         self._ps_residual: Dict[int, jnp.ndarray] = {}
+        # numerical-health layer (persia_tpu/health): the on-device probe
+        # tail + finite gate and the fence-point PS row scrubber. Defaults
+        # follow PERSIA_HEALTH=1; explicit flags override the env.
+        from persia_tpu.health import health_enabled
+
+        self._health_probe = (
+            health_enabled() if health_probe is None else bool(health_probe)
+        )
+        self._health_clip_norm = health_clip_norm
+        self._health_scrub = (
+            self._health_probe
+            if health_scrub_at_fence is None
+            else bool(health_scrub_at_fence)
+        )
         self._step = build_cached_train_step(
             model, dense_optimizer, self.sparse_cfg, self.tier.groups,
             loss_fn=loss_fn,
@@ -160,6 +177,8 @@ class CachedTrainCtx:
             dynamic_loss_scale=dynamic_loss_scale,
             growth_interval=loss_scale_growth_interval,
             max_scale=loss_scale_max,
+            sentinel_probe=self._health_probe,
+            guard_clip_norm=health_clip_norm,
         )
         self._eval = build_cached_eval_step(model, self.tier.groups)
         # forward-side ps wire: stage PS-tier entries in the same reduced
@@ -654,8 +673,8 @@ class CachedTrainCtx:
                 q = np.asarray(ps_gpacked[0])
                 scales = np.asarray(ps_gpacked[1]).astype(np.float32)
                 scale_factor = 1.0
-                if self.dynamic_loss_scale:
-                    if not scales[-1] > 0.5:  # overflow: skip-step
+                if self.dynamic_loss_scale or self._health_probe:
+                    if not scales[-1] > 0.5:  # overflow/non-finite: skip-step
                         self.worker.abort_gradient(ref)
                         return
                     scales = scales[:-1]
@@ -670,10 +689,10 @@ class CachedTrainCtx:
                 if gp.dtype != np.float32:  # bf16 ps-grad wire
                     gp = gp.astype(np.float32)
                 scale_factor = 1.0
-                if self.dynamic_loss_scale:
+                if self.dynamic_loss_scale or self._health_probe:
                     # buffer tail = [scale | finite] (build_cached_train_step)
                     scale_factor = float(gp[-2])
-                    if not gp[-1] > 0.5:  # overflow: skip-step — drop grads
+                    if not gp[-1] > 0.5:  # overflow/non-finite: skip-step
                         self.worker.abort_gradient(ref)
                         return
                     gp = gp[:-2]
@@ -831,6 +850,15 @@ class CachedTrainCtx:
             self._last_header_dev = None
         return self._last_metrics
 
+
+    def sentinel_spec(self) -> Dict:
+        """Shape the health sentinel needs to decode the probe tail —
+        ``StreamSentinel.from_ctx(ctx)`` consumes this."""
+        return {
+            "n_groups": len(self.tier.groups),
+            "dynamic_loss_scale": self.dynamic_loss_scale,
+            "probe": self._health_probe,
+        }
 
     def train_stream(self, *args, **kwargs):
         """Asynchronous pipelined stream training — see
@@ -1005,6 +1033,8 @@ class CachedTrainCtx:
             dynamic_loss_scale=self.dynamic_loss_scale,
             growth_interval=self._ls_growth_interval,
             max_scale=self._ls_max,
+            sentinel_probe=self._health_probe,
+            guard_clip_norm=self._health_clip_norm,
         )
         self._eval = build_cached_eval_step(self.model, self.tier.groups)
         self._kstep_jit = None
@@ -1084,6 +1114,13 @@ class CachedTrainCtx:
             )
             self.state = self.state.replace(tables=tables, emb_state=emb_state)
         router = self.tier.router
+        if self._health_scrub:
+            # repair any non-finite PS rows (flushed cache rows included)
+            # BEFORE they are captured into the manifest; journaled so a
+            # retried fence is exactly-once per (epoch, step, replica)
+            from persia_tpu.health.scrub import scrub_router
+
+            scrub_router(router, self._job_epoch or 0, step)
         components = {
             "cache.json": occupancy,
             "loader.json": {"consumed_batches": step},

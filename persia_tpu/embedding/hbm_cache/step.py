@@ -65,6 +65,8 @@ def build_cached_train_step(
     growth_factor: float = 2.0,
     backoff_factor: float = 0.5,
     max_scale: float = float(2 ** 24),
+    sentinel_probe: bool = False,
+    guard_clip_norm: Optional[float] = None,
 ):
     """Jitted ``step(state, batch, layout) -> (state, header)``.
 
@@ -113,6 +115,19 @@ def build_cached_train_step(
     — grads are unscaled ON DEVICE under dynamic loss scaling (the
     scales tail then carries the finite flag), and an overflow step ships
     zeros and carries the residual through unchanged.
+
+    ``sentinel_probe``: numerical-health probe for the stream sentinel
+    (persia_tpu/health). Appends a fixed probe tail to the header —
+    ``[dense_gnorm, group_gnorm x n_groups, ps_gnorm, finite, clipped]``
+    (norms unscaled, pre-clip) — and arms the finite gate even without
+    dynamic loss scaling: a non-finite gradient skips the dense update,
+    masks every cached row, and ships a flagged/zeroed ps wire, exactly
+    like an overflow step (device-side "skip-batch" rung; the ps wire
+    then carries the ``[scale|finite]`` tail so the write-back thread can
+    honor the skip). Healthy unclipped steps multiply by exactly 1.0
+    everywhere, so arming the probe is bit-transparent. ``guard_clip_norm``
+    (requires ``sentinel_probe``) rescales the whole update on device when
+    the total grad norm exceeds it — the sentinel's "clip" rung.
     """
     from functools import partial
 
@@ -183,7 +198,8 @@ def build_cached_train_step(
             )(state.params, stacked_gathered, raw_gathered, ps_diff)
         )
 
-        if dynamic_loss_scale:
+        need_guard = dynamic_loss_scale or sentinel_probe
+        if need_guard:
             leaves = (
                 jax.tree.leaves(param_grads)
                 + jax.tree.leaves(stacked_g) + jax.tree.leaves(raw_g)
@@ -193,13 +209,59 @@ def build_cached_train_step(
                 jnp.stack([jnp.all(jnp.isfinite(g)) for g in leaves])
             )
             inv = jnp.where(finite, 1.0 / scale, 0.0).astype(jnp.float32)
+        else:
+            finite = jnp.asarray(True)
+            inv = jnp.asarray(1.0, jnp.float32)
+
+        clip_f = jnp.asarray(1.0, jnp.float32)
+        probe_tail = None
+        if sentinel_probe:
+            # Norms of the UNSCALED gradients (inv divides the loss scale
+            # out; overflow steps report 0 and carry the finite flag).
+            def _gnorm(parts):
+                parts = list(parts)
+                if not parts:
+                    return jnp.asarray(0.0, jnp.float32)
+                return jnp.sqrt(
+                    sum(jnp.sum(jnp.square(p.astype(jnp.float32)))
+                        for p in parts)
+                )
+
+            dense_gnorm = _gnorm(jax.tree.leaves(param_grads)) * inv
+            group_gnorms = []
+            for g in groups:
+                parts = []
+                if g.name in batch["stacked_rows"]:
+                    parts.append(stacked_g[g.name])
+                for name in g.raw_slots:
+                    if name in batch["raw_rows"]:
+                        parts.append(raw_g[name])
+                group_gnorms.append(_gnorm(parts) * inv)
+            ps_gnorm = _gnorm(jax.tree.leaves(ps_g)) * inv
+            if guard_clip_norm is not None:
+                total = jnp.sqrt(
+                    jnp.square(dense_gnorm) + jnp.square(ps_gnorm)
+                    + sum(jnp.square(n) for n in group_gnorms)
+                )
+                clip_f = jnp.where(
+                    total > guard_clip_norm,
+                    guard_clip_norm / jnp.maximum(total, 1e-12),
+                    1.0,
+                ).astype(jnp.float32)
+            probe_tail = jnp.stack(
+                [dense_gnorm] + group_gnorms + [
+                    ps_gnorm,
+                    finite.astype(jnp.float32),
+                    (clip_f < 1.0).astype(jnp.float32),
+                ]
+            )
+            inv = inv * clip_f
+
+        if need_guard:
             param_grads = jax.tree.map(
                 lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype),
                 param_grads,
             )
-        else:
-            finite = jnp.asarray(True)
-            inv = jnp.asarray(1.0, jnp.float32)
 
         import optax as _optax
 
@@ -207,8 +269,8 @@ def build_cached_train_step(
             param_grads, state.opt_state, state.params
         )
         new_params = _optax.apply_updates(state.params, updates)
-        if dynamic_loss_scale:
-            # overflow: dense update skipped entirely
+        if need_guard:
+            # overflow / non-finite grads: dense update skipped entirely
             new_params = jax.tree.map(
                 lambda new, old: jnp.where(finite, new, old),
                 new_params, state.params,
@@ -287,6 +349,8 @@ def build_cached_train_step(
             head.append(jnp.reshape(scale, (1,)).astype(jnp.float32))
             head.append(jnp.reshape(finite, (1,)).astype(jnp.float32))
         head.append(jnp.reshape(jax.nn.sigmoid(logits), (-1,)).astype(jnp.float32))
+        if probe_tail is not None:
+            head.append(probe_tail)
         header = jnp.concatenate(head)
         # ps-tier gradients are an inherent d2h; a bf16 wire halves the
         # bytes on the return path (the reference ships scaled-f16 grad
@@ -312,7 +376,7 @@ def build_cached_train_step(
                 # unscale ON the device (inv = 0 on overflow): the residual
                 # must accumulate true-gradient error, not scaled error
                 q, sc, _deq, nr = quantize_int8_ef(f * inv, r)
-                if dynamic_loss_scale:
+                if need_guard:
                     q = jnp.where(finite, q, jnp.zeros_like(q))
                     nr = jnp.where(finite, nr, r)
                 qs.append(q)
@@ -322,7 +386,7 @@ def build_cached_train_step(
                 jnp.concatenate(qs) if qs else jnp.zeros((0,), jnp.int8)
             )
             sc_parts = [jnp.stack(scs)] if scs else []
-            if dynamic_loss_scale:
+            if need_guard:
                 sc_parts.append(
                     jnp.reshape(finite.astype(jnp.float32), (1,))
                 )
@@ -335,8 +399,13 @@ def build_cached_train_step(
                 else jnp.zeros((0,), jnp.float32)
             )
             return new_state, header, (q_packed, sc_packed, res_packed)
-        ps_flat = [jnp.reshape(g, (-1,)).astype(ps_grad_dtype) for g in ps_g]
-        if dynamic_loss_scale and ps_flat:
+        ps_flat = [
+            (jnp.reshape(g, (-1,)).astype(jnp.float32) * clip_f).astype(
+                ps_grad_dtype
+            )
+            for g in ps_g
+        ]
+        if need_guard and ps_flat:
             ps_flat.append(
                 jnp.stack([scale, finite.astype(jnp.float32)]).astype(ps_grad_dtype)
             )

@@ -57,6 +57,8 @@ def run_train_stream(
     snapshot_every: Optional[int] = None,
     job_state=None,
     start_step: int = 0,
+    sentinel=None,
+    skip_steps=None,
 ) -> Optional[Dict]:
     """Fully-pipelined training over an iterable of ``PersiaBatch``.
 
@@ -136,6 +138,17 @@ def run_train_stream(
     cursor, and the RNG streams. ``start_step`` offsets the fence cadence
     and journal ids for a resumed stream
     (``train_stream(batches_from_F, start_step=F, ...)``).
+
+    ``sentinel`` + ``skip_steps`` (persia_tpu/health): an armed
+    :class:`~persia_tpu.health.sentinel.StreamSentinel` digests each
+    step's header one dispatch behind the newest in-flight step (the
+    probe tail rides the header the step already emits; disabled cost is
+    one ``is None`` check) and raises ``SentinelRollback`` through the
+    caller's thread for the fence auto-rollback driver
+    (``health.run_guarded_stream``). ``skip_steps`` is the quarantined
+    global-step set: the feeder consumes those batches WITHOUT preparing
+    or training them — seq/fence cadence and journal ids stay aligned
+    with the unquarantined run.
     """
     import queue as _queue
     import time as _time
@@ -261,8 +274,13 @@ def run_train_stream(
         "packs": 0, "packed_steps": 0, "single_steps": 0,
         "feeder_busy_s": 0.0, "wall_s": 0.0,
         "degraded_steps": 0, "degraded_lookup_frac_max": 0.0,
-        "fences": 0,
+        "fences": 0, "quarantine_skips": 0,
     }
+    # health sentinel: headers queued at dispatch, digested one window
+    # behind (sentinel.py); both hooks are no-ops when sentinel is None
+    from persia_tpu.health.sentinel import sentinel_drain, sentinel_note
+
+    sent_pending: List = []
     t_start = _time.perf_counter()
     # per-seq degraded-lookup fraction (written by the feeder BEFORE the
     # item enters prep_q, popped by the dispatcher — queue ordering is the
@@ -344,6 +362,17 @@ def run_train_stream(
                     while not fence_done.wait(0.25):
                         if stop.is_set() or errors:
                             return
+                if skip_steps and (start_step + seq) in skip_steps:
+                    # quarantined step: consume the batch but never touch
+                    # the directory/PS/device with it — seq still advances
+                    # so fence cadence + journal ids match a run where the
+                    # step never existed
+                    record_event(
+                        "health.quarantine_skip", step=start_step + seq
+                    )
+                    stats["quarantine_skips"] += 1
+                    seq += 1
+                    continue
                 t_prep = _time.perf_counter()
                 with stage_span("stream.prep"):
                     item = self.tier.prepare_batch(
@@ -754,6 +783,10 @@ def run_train_stream(
             # The global step rides along as the apply-journal step id.
             wb_q.put(("psgrad", ps_item, ps_gpacked, start_step + seq))
         _post_step(seq, di, evict_meta, evict_payload)
+        sentinel_note(
+            sentinel, sent_pending, start_step + seq, header,
+            int(np.prod(di["labels"][0].shape)),
+        )
         if on_metrics is not None:
             self._last_metrics = self._parse_header(
                 np.asarray(header), label_shape
@@ -816,6 +849,11 @@ def run_train_stream(
         stats["packed_steps"] += len(pack)
         for it, payload in zip(pack, payloads):
             _post_step(it[0], it[1], it[7], payload)
+        for it, h in zip(pack, headers):
+            sentinel_note(
+                sentinel, sent_pending, start_step + it[0], h,
+                int(np.prod(it[1]["labels"][0].shape)),
+            )
         pack.clear()
 
     try:
@@ -833,6 +871,7 @@ def run_train_stream(
                 item = staged_q.get()
             if item is SENTINEL:
                 _flush_pack_single()
+                sentinel_drain(sentinel, sent_pending)
                 break
             if errors:
                 # buffered pack items carry no PS refs (_packable) — drop
@@ -841,6 +880,9 @@ def run_train_stream(
                 break
             if isinstance(item, tuple) and len(item) == 2 and item[0] == "fence":
                 _flush_pack_single()
+                # the sentinel must digest every pre-fence header BEFORE
+                # the capture: a poisoned step must never become LAST_GOOD
+                sentinel_drain(sentinel, sent_pending)
                 _run_fence(item[1])
                 continue
             if K > 1 and _packable(item):

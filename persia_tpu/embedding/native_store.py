@@ -110,6 +110,8 @@ def _load_lib() -> ctypes.CDLL:
     lib.ps_journal_len.argtypes = [p]
     lib.ps_journal_clear.restype = None
     lib.ps_journal_clear.argtypes = [p]
+    lib.ps_scan_nonfinite.restype = i64
+    lib.ps_scan_nonfinite.argtypes = [p, u64p, i64]
     _LIB = lib
     return lib
 
@@ -327,6 +329,14 @@ class NativeEmbeddingStore:
 
     def journal_len(self) -> int:
         return int(self._lib.ps_journal_len(self._h))
+
+    def scan_nonfinite(self, cap: int = 65536):
+        """Health scrub (persia_tpu/health): repair every NaN/Inf row to
+        the deterministic seeded init. Returns ``(repaired_count, signs)``
+        — ``signs`` holds at most ``cap`` repaired signs."""
+        out = np.zeros(max(int(cap), 1), dtype=np.uint64)
+        n = int(self._lib.ps_scan_nonfinite(self._h, _u64p(out), len(out)))
+        return n, out[: min(n, len(out))].copy()
 
     def journal_clear(self) -> None:
         self._lib.ps_journal_clear(self._h)

@@ -400,6 +400,26 @@ class EmbeddingStore:
         self.journal_record(journal_id, crc)
         return True
 
+    def scan_nonfinite(self, cap: int = 65536):
+        """Health scrub (persia_tpu/health): walk every live entry and
+        repair any row with a NaN/Inf anywhere in its ``[emb | state]``
+        floats back to the deterministic seeded init — the exact entry a
+        fresh admit of the same sign would create (``_init_entry``), which
+        is also the degraded-mode lookup contract. Returns
+        ``(repaired_count, signs)`` with at most ``cap`` signs reported."""
+        repaired = 0
+        signs: List[int] = []
+        with self._lock:
+            for shard in self._shards:
+                for sign, (dim, vec) in shard.entries.items():
+                    if np.isfinite(vec).all():
+                        continue
+                    vec[:] = self._init_entry(sign, dim)
+                    if repaired < cap:
+                        signs.append(sign)
+                    repaired += 1
+        return repaired, signs
+
     # ------------------------------------------------------------ management
 
     def set_embedding(

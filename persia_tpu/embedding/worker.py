@@ -902,6 +902,20 @@ class ShardedLookup:
             for r, idx in self._partition(signs)
         ])
 
+    def scan_nonfinite(self, cap: int = 65536):
+        """Health scrub fan-out (persia_tpu/health): repair non-finite
+        rows on every replica to the deterministic seeded init. Returns
+        the aggregate ``(repaired_count, signs)``. For journaled
+        exactly-once scrubs use ``health.scrub.scrub_router`` — it probes
+        each replica's apply-journal before scanning."""
+        total = 0
+        signs: list = []
+        for rep in self.replicas:
+            n, s = self._with_recovery(rep, lambda rep=rep: rep.scan_nonfinite(cap=cap))
+            total += int(n)
+            signs.extend(int(x) for x in s)
+        return total, signs[:cap]
+
     def advance_batch_state(self, group: int) -> None:
         # counted for the snapshot manifest: a PS rewind replays exactly
         # this many advances so Adam's beta powers match the fence

@@ -1,0 +1,71 @@
+"""Data-plane integrity layer: validator -> sentinel -> scrubber.
+
+persia_tpu.health spans the whole train-to-serve loop:
+
+- :mod:`~persia_tpu.health.validator` rejects malformed batches at the
+  loader boundary and persists them to a quarantine directory for
+  postmortem (schema, finiteness, label-range, sign-domain rules).
+- :mod:`~persia_tpu.health.sentinel` watches the per-step probe tail the
+  cached train step emits (finite flag, dense/per-group/ps grad norms)
+  and drives the escalation ladder: on-device skip-batch -> clip ->
+  auto-rollback to the LAST_GOOD jobstate fence -> abort at
+  ``max_anomaly_frac``.
+- :mod:`~persia_tpu.health.scrub` repairs non-finite PS rows to the
+  deterministic seeded init at snapshot fences, journaled exactly-once.
+- :func:`~persia_tpu.health.guard.run_guarded_stream` is the rollback
+  driver: it owns the ctx lifecycle so a sentinel trip can rebuild a
+  fresh ctx, ``resume()`` from the last fence, and replay the stream
+  minus the quarantined steps.
+
+Arming: pass explicit flags, or set ``PERSIA_HEALTH=1`` to arm the
+on-device probe + fence scrub by default (off by default — the disabled
+path costs one ``is None`` check on the stream hot path).
+"""
+from __future__ import annotations
+
+import os
+
+
+def health_enabled() -> bool:
+    """True when PERSIA_HEALTH=1 arms the data-plane health layer."""
+    return os.environ.get("PERSIA_HEALTH", "0") in ("1", "true")
+
+
+from persia_tpu.health.validator import (  # noqa: E402
+    BatchValidator,
+    Quarantine,
+    ValidatorConfig,
+)
+from persia_tpu.health.sentinel import (  # noqa: E402
+    SentinelAbort,
+    SentinelConfig,
+    SentinelRollback,
+    StreamSentinel,
+    sentinel_drain,
+    sentinel_note,
+)
+from persia_tpu.health.scrub import (  # noqa: E402
+    SCRUB_CRC,
+    scrub_journal_id,
+    scrub_router,
+    scrub_store,
+)
+from persia_tpu.health.guard import run_guarded_stream  # noqa: E402
+
+__all__ = [
+    "BatchValidator",
+    "Quarantine",
+    "ValidatorConfig",
+    "SentinelAbort",
+    "SentinelConfig",
+    "SentinelRollback",
+    "StreamSentinel",
+    "sentinel_drain",
+    "sentinel_note",
+    "SCRUB_CRC",
+    "scrub_journal_id",
+    "scrub_router",
+    "scrub_store",
+    "run_guarded_stream",
+    "health_enabled",
+]

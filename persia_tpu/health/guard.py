@@ -1,0 +1,82 @@
+"""Fence-point auto-rollback driver for the cached train stream.
+
+``ctx.resume()`` rewinds PS shards and dense state but a live ctx's cache
+directory / pools are NOT rewound — the proven bit-identical recovery
+path (tests/test_jobstate.py) is a FRESH ctx + ``resume()``. The guard
+therefore owns the ctx lifecycle: the caller hands it a ``ctx_factory``
+and a ``batches_fn(start_step)`` that can re-open the stream at any
+global step, and the guard loops
+
+    fresh ctx -> resume(LAST_GOOD fence) -> train_stream(minus skips)
+
+until the stream finishes, adding each :class:`SentinelRollback` step to
+the quarantined skip set before replaying. ``SentinelAbort`` (anomaly
+fraction / rollback budget) propagates to the caller.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional, Set
+
+from persia_tpu.health.sentinel import (
+    SentinelConfig,
+    SentinelRollback,
+    StreamSentinel,
+)
+from persia_tpu.tracing import record_event
+
+
+def run_guarded_stream(
+    ctx_factory: Callable[[], object],
+    batches_fn: Callable[[int], Iterable],
+    job_state,
+    sentinel,
+    snapshot_every: int,
+    skip_steps: Optional[Iterable[int]] = None,
+    **stream_kwargs,
+):
+    """Run ``train_stream`` under sentinel guard with fence auto-rollback.
+
+    ``sentinel`` is a :class:`StreamSentinel`, or a :class:`SentinelConfig`
+    to have the guard build one from the first ctx's ``sentinel_spec()``
+    (the probe-tail shape is a property of the ctx, not the caller).
+
+    Returns ``(metrics, ctx, skipped)`` — the final stream metrics, the
+    ctx that finished the stream (for state inspection / further use),
+    and the full set of quarantined global steps.
+    """
+    from persia_tpu import jobstate
+
+    skipped: Set[int] = set(skip_steps or ())
+    while True:
+        ctx = ctx_factory()
+        if isinstance(sentinel, SentinelConfig):
+            sentinel = StreamSentinel.from_ctx(ctx, sentinel)
+        manifest = ctx.resume(job_state)
+        start = manifest.step if manifest is not None else 0
+        try:
+            metrics = ctx.train_stream(
+                batches_fn(start),
+                start_step=start,
+                snapshot_every=snapshot_every,
+                job_state=job_state,
+                sentinel=sentinel,
+                skip_steps=skipped,
+                **stream_kwargs,
+            )
+        except SentinelRollback as rb:
+            skipped.add(rb.step)
+            mgr = jobstate.coerce_manager(job_state)
+            last_good = mgr.latest()
+            fence = last_good.step if last_good is not None else 0
+            record_event(
+                "health.rollback",
+                anomaly_step=rb.step,
+                fence_step=fence,
+                cause=rb.kind,
+                metric=rb.metric,
+                z=rb.z,
+            )
+            # Raises SentinelAbort once the rollback budget is spent.
+            sentinel.note_rollback(rb.step, fence)
+            continue
+        return metrics, ctx, skipped

@@ -1,0 +1,100 @@
+"""PS row scrubber: repair non-finite embedding rows at snapshot fences.
+
+``scan_nonfinite`` (numpy store, native store via the ``ps_scan_nonfinite``
+export, RPC client, and the ShardedLookup fan-out) walks every live entry
+and re-initializes any row whose embedding or optimizer-state floats are
+NaN/Inf back to the deterministic seeded init — the SAME contract the
+degraded-mode lookups use, so a scrubbed row is indistinguishable from a
+freshly admitted one.
+
+Repairs are recorded in the PS apply-journal under a scrub-reserved id
+(the top half of the per-replica low byte of :func:`make_journal_id`), so
+a retried fence — e.g. a trainer killed between scan and capture — probes
+the journal first and becomes a no-op: exactly-once per (epoch, step,
+replica).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from persia_tpu.jobstate import make_journal_id, payload_crc
+from persia_tpu.metrics import get_metrics
+from persia_tpu.tracing import record_event
+
+# Constant crc tag for scrub journal records: a probe hit with a
+# DIFFERENT crc under a scrub id means the id space collided with a
+# gradient record — loud error, never silent skip.
+SCRUB_CRC = payload_crc(np.frombuffer(b"health.scrub", dtype=np.uint8))
+
+# Scrub ids claim the top half of the low-byte (replica) space of
+# make_journal_id; gradient records use journal_shard_id(base, replica)
+# with small replica indices, so the two never collide in practice.
+_SCRUB_SUBID = 0x80
+
+
+def scrub_journal_id(job_epoch: int, step: int, replica_index: int = 0) -> int:
+    return make_journal_id(job_epoch, step) | _SCRUB_SUBID | (replica_index & 0x7F)
+
+
+def scrub_store(store, journal_id: Optional[int] = None, cap: int = 65536) -> dict:
+    """Scan one store-like for non-finite rows and repair them.
+
+    With ``journal_id``, the scrub is exactly-once: an already-recorded
+    id skips the scan entirely (retry after a crash between scan and
+    fence capture), and a successful scan records the id before
+    returning.
+    """
+    if journal_id is not None:
+        probe = store.journal_probe(journal_id, SCRUB_CRC)
+        if probe == 1:
+            return {"repaired": 0, "signs": [], "skipped": True}
+        if probe == -1:
+            raise RuntimeError(
+                f"scrub journal id {journal_id:#x} collides with a "
+                "non-scrub record (crc mismatch)"
+            )
+    repaired, signs = store.scan_nonfinite(cap=cap)
+    if journal_id is not None:
+        store.journal_record(journal_id, SCRUB_CRC)
+    return {"repaired": int(repaired), "signs": list(signs), "skipped": False}
+
+
+def scrub_router(
+    router,
+    job_epoch: int = 0,
+    step: int = 0,
+    journaled: bool = True,
+    cap: int = 65536,
+) -> dict:
+    """Scrub every PS replica behind a router (or a bare store).
+
+    Emits one ``health.scrub`` flight-recorder event per replica and
+    bumps ``persia_tpu_health_rows_scrubbed``. Returns the aggregate
+    ``{"repaired": n, "replicas": [...]}``.
+    """
+    replicas = getattr(router, "replicas", None)
+    if replicas is None:
+        replicas = [router]
+    m_scrubbed = get_metrics().counter(
+        "persia_tpu_health_rows_scrubbed",
+        "non-finite PS rows repaired to seeded init by the fence scrubber",
+    )
+    total = 0
+    per_replica = []
+    for i, replica in enumerate(replicas):
+        jid = scrub_journal_id(job_epoch, step, i) if journaled else None
+        res = scrub_store(replica, journal_id=jid, cap=cap)
+        if res["repaired"]:
+            m_scrubbed.inc(res["repaired"])
+        record_event(
+            "health.scrub",
+            step=step,
+            replica=i,
+            repaired=res["repaired"],
+            skipped=res["skipped"],
+        )
+        total += res["repaired"]
+        per_replica.append(res)
+    return {"repaired": total, "replicas": per_replica}

@@ -1,0 +1,227 @@
+"""On-device numerical sentinel for the cached train stream.
+
+The cached train step (``build_cached_train_step(sentinel_probe=True)``)
+appends a fixed-length probe tail to the step header it already emits:
+
+    [dense_gnorm, group_gnorm..., ps_gnorm, finite_flag, clipped_flag]
+
+Everything in the tail is computed on device inside the jitted step —
+when the sentinel is disabled the stream hot path pays exactly one
+``is None`` check (pinned by ``tests/test_health.py``); when armed, the
+host reads headers one dispatch behind the newest in-flight step, so
+detection lands within one dispatch window without stalling dispatch.
+
+Escalation ladder:
+
+1. **skip-batch** — non-finite grads zero the update on device (the
+   step's ``finite`` gate); the sentinel only counts the skip.
+2. **clip** — ``guard_clip_norm`` rescales the update on device; the
+   sentinel counts the clip.
+3. **rollback** — a grad global-norm z-score blowout vs the decayed EMA
+   raises :class:`SentinelRollback`; ``run_guarded_stream`` parks the
+   feeder, rebuilds a fresh ctx, resumes from the LAST_GOOD jobstate
+   fence and replays the stream minus the quarantined step.
+4. **abort** — anomaly fraction above ``max_anomaly_frac`` (or rollback
+   budget exhausted) raises :class:`SentinelAbort`.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from persia_tpu.metrics import get_metrics
+from persia_tpu.tracing import record_event
+
+
+class SentinelRollback(RuntimeError):
+    """Raised when the sentinel demands a rollback to the last fence."""
+
+    def __init__(self, step: int, kind: str = "grad_z", metric: float = 0.0, z: float = 0.0):
+        super().__init__(
+            f"sentinel anomaly at step {step}: {kind} metric={metric:g} z={z:g}"
+        )
+        self.step = step
+        self.kind = kind
+        self.metric = metric
+        self.z = z
+
+
+class SentinelAbort(RuntimeError):
+    """Raised when the anomaly fraction exceeds ``max_anomaly_frac``."""
+
+
+@dataclass(frozen=True)
+class SentinelConfig:
+    z_threshold: float = 6.0
+    warmup_steps: int = 8
+    decay: float = 0.9
+    # Relative floor added to the EMA stddev so near-constant norm
+    # streams do not turn numeric jitter into huge z-scores.
+    rel_floor: float = 0.05
+    max_anomaly_frac: float = 0.5
+    # Anomaly-fraction abort only applies once this many steps observed.
+    min_anomaly_steps: int = 8
+    max_rollbacks: int = 4
+
+
+class StreamSentinel:
+    """Decayed-EMA z-score watchdog over the on-device probe tail."""
+
+    def __init__(
+        self,
+        config: Optional[SentinelConfig] = None,
+        n_groups: int = 0,
+        dynamic_loss_scale: bool = False,
+    ):
+        self.config = config or SentinelConfig()
+        self.n_groups = int(n_groups)
+        self.dynamic_loss_scale = bool(dynamic_loss_scale)
+        self._mean = 0.0
+        self._var = 0.0
+        self._warm = 0
+        self._max_seen = -1
+        self.stats = {
+            "observed": 0,
+            "replayed": 0,
+            "nonfinite_skips": 0,
+            "clips": 0,
+            "z_anomalies": 0,
+            "anomalies": 0,
+            "rollbacks": 0,
+        }
+        m = get_metrics()
+        self._m_anomaly = m.counter(
+            "persia_tpu_health_anomalies",
+            "sentinel anomalies by kind",
+        )
+        self._m_rollback = m.counter(
+            "persia_tpu_health_rollbacks",
+            "sentinel-driven fence rollbacks",
+        )
+        self._m_observed = m.counter(
+            "persia_tpu_health_steps_observed",
+            "train steps observed by the sentinel",
+        )
+
+    @classmethod
+    def from_ctx(cls, ctx, config: Optional[SentinelConfig] = None) -> "StreamSentinel":
+        spec = ctx.sentinel_spec()
+        return cls(
+            config,
+            n_groups=spec["n_groups"],
+            dynamic_loss_scale=spec["dynamic_loss_scale"],
+        )
+
+    # -- internals -----------------------------------------------------
+    def _anomaly(self, kind: str, step: int, **attrs) -> None:
+        self.stats["anomalies"] += 1
+        self._m_anomaly.inc(kind=kind)
+        record_event("health.anomaly", cause=kind, step=step, **attrs)
+        cfg = self.config
+        obs = self.stats["observed"]
+        if obs >= cfg.min_anomaly_steps:
+            frac = self.stats["anomalies"] / max(obs, 1)
+            if frac > cfg.max_anomaly_frac:
+                raise SentinelAbort(
+                    f"anomaly fraction {frac:.3f} > max_anomaly_frac "
+                    f"{cfg.max_anomaly_frac:.3f} after {obs} steps"
+                )
+
+    def note_rollback(self, anomaly_step: int, fence_step: int) -> None:
+        self.stats["rollbacks"] += 1
+        self._m_rollback.inc()
+        if self.stats["rollbacks"] > self.config.max_rollbacks:
+            raise SentinelAbort(
+                f"rollback budget exhausted ({self.config.max_rollbacks}); "
+                f"last anomaly at step {anomaly_step} (fence {fence_step})"
+            )
+
+    # -- observation ---------------------------------------------------
+    def observe(self, gstep: int, header: np.ndarray, n_labels: int) -> None:
+        """Digest one completed step header; raise on escalation.
+
+        Steps at or below the replay high-water mark are counted but not
+        re-folded into the EMA, so a post-rollback replay cannot double
+        count or re-trip on history it already digested.
+        """
+        if gstep <= self._max_seen:
+            self.stats["replayed"] += 1
+            return
+        self._max_seen = gstep
+        self.stats["observed"] += 1
+        self._m_observed.inc()
+        from persia_tpu.parallel.train_step import unpack_step_probe
+
+        probe = unpack_step_probe(
+            header, n_labels, self.n_groups, dynamic=self.dynamic_loss_scale
+        )
+        if probe["finite"] < 0.5:
+            # Rung 1: update already zeroed on device — state is clean.
+            self.stats["nonfinite_skips"] += 1
+            self._anomaly("nonfinite_grad", gstep, device_skipped=True)
+            return
+        if probe["clipped"] >= 0.5:
+            # Rung 2: update rescaled on device — contained, but counted.
+            self.stats["clips"] += 1
+            self._anomaly(
+                "grad_clipped", gstep, grad_norm=float(probe["total_gnorm"])
+            )
+        x = float(probe["total_gnorm"])
+        if not math.isfinite(x):
+            self.stats["nonfinite_skips"] += 1
+            self._anomaly("nonfinite_probe", gstep, device_skipped=False)
+            return
+        cfg = self.config
+        if self._warm >= cfg.warmup_steps:
+            sd = math.sqrt(max(self._var, 0.0)) + cfg.rel_floor * abs(self._mean) + 1e-12
+            z = (x - self._mean) / sd
+            if z > cfg.z_threshold:
+                # Rung 3: the update already landed — demand a rollback.
+                self.stats["z_anomalies"] += 1
+                self._anomaly("grad_norm_z", gstep, grad_norm=x, z=z)
+                raise SentinelRollback(gstep, kind="grad_norm_z", metric=x, z=z)
+        d = cfg.decay
+        delta = x - self._mean
+        self._mean = d * self._mean + (1.0 - d) * x
+        self._var = d * self._var + (1.0 - d) * delta * delta
+        self._warm += 1
+
+
+# -- stream hot-path hooks ---------------------------------------------
+# The stream calls these unconditionally; the disabled cost is the
+# ``sentinel is None`` check (overhead pinned tracer-style in tests).
+
+def sentinel_note(
+    sentinel: Optional[StreamSentinel],
+    pending: List[Tuple[int, object, int]],
+    gstep: int,
+    header,
+    n_labels: int,
+) -> None:
+    """Queue a just-dispatched step header; digest all-but-newest.
+
+    Only headers strictly older than the newest in-flight dispatch are
+    materialized, so the host never blocks on work it just issued —
+    detection trails dispatch by at most one window.
+    """
+    if sentinel is None:
+        return
+    pending.append((gstep, header, n_labels))
+    while len(pending) > 1:
+        g, h, n = pending.pop(0)
+        sentinel.observe(g, np.asarray(h), n)
+
+
+def sentinel_drain(
+    sentinel: Optional[StreamSentinel],
+    pending: List[Tuple[int, object, int]],
+) -> None:
+    """Digest every pending header (end-of-stream / fence barrier)."""
+    if sentinel is None:
+        return
+    while pending:
+        g, h, n = pending.pop(0)
+        sentinel.observe(g, np.asarray(h), n)

@@ -1,0 +1,204 @@
+"""Batch validator + quarantine directory.
+
+The validator sits at the loader boundary (``DataLoader(validator=...)``
+or ``validator.wrap(batches)``) and applies cheap, vectorized checks to
+every :class:`~persia_tpu.data.PersiaBatch` before it can reach the
+train plane:
+
+- ``schema``       — labels present when ``requires_grad``, consistent
+                     batch sizes across id/dense/label parts.
+- ``nonfinite``    — NaN/Inf anywhere in a float dense feature or label.
+- ``label_range``  — labels outside ``[label_min, label_max]``.
+- ``sign_domain``  — raw ids touching the per-group salt prefix
+                     (``id >= 2**(64 - prefix_bit)``), which would alias
+                     across embedding groups after salting.
+
+A rejected batch is never trained on: it is persisted to the quarantine
+directory (full ``PersiaBatch.to_bytes()`` wire plus a JSON sidecar with
+rule / reason / trace_id / step ordinal) so the poisoned payload can be
+reloaded for postmortem, then counted and dropped.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from persia_tpu.data import PersiaBatch
+from persia_tpu.metrics import get_metrics
+from persia_tpu.tracing import current_trace_id, record_event
+
+
+@dataclass(frozen=True)
+class ValidatorConfig:
+    label_min: float = 0.0
+    label_max: float = 1.0
+    # Bits reserved at the top of the u64 sign space for group salting;
+    # 0 disables the sign-domain rule.
+    sign_prefix_bit: int = 0
+    check_nonfinite: bool = True
+    check_label_range: bool = True
+
+
+class Quarantine:
+    """Append-only quarantine directory with postmortem round-trip.
+
+    Each rejected batch lands as ``<name>.batch`` (the exact
+    ``PersiaBatch.to_bytes()`` wire) next to ``<name>.json``
+    (rule, reason, step, trace_id, batch_id).
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def put(
+        self,
+        batch: PersiaBatch,
+        rule: str,
+        reason: str,
+        step: Optional[int] = None,
+    ) -> str:
+        with self._lock:
+            ordinal = self._seq
+            self._seq += 1
+        name = f"q{ordinal:06d}"
+        sidecar = {
+            "rule": rule,
+            "reason": reason,
+            "step": step,
+            "trace_id": current_trace_id(),
+            "batch_id": batch.batch_id,
+        }
+        blob = batch.to_bytes()
+        tmp = os.path.join(self.path, f".{name}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, os.path.join(self.path, f"{name}.batch"))
+        with open(os.path.join(self.path, f"{name}.json"), "w") as f:
+            json.dump(sidecar, f, sort_keys=True)
+        return name
+
+    def names(self) -> List[str]:
+        out = []
+        for fn in os.listdir(self.path):
+            if fn.endswith(".batch") and not fn.startswith("."):
+                out.append(fn[: -len(".batch")])
+        return sorted(out)
+
+    def load(self, name: str) -> Tuple[PersiaBatch, dict]:
+        with open(os.path.join(self.path, f"{name}.batch"), "rb") as f:
+            batch = PersiaBatch.from_bytes(f.read())
+        with open(os.path.join(self.path, f"{name}.json")) as f:
+            sidecar = json.load(f)
+        return batch, sidecar
+
+    def __len__(self) -> int:
+        return len(self.names())
+
+
+class BatchValidator:
+    """Schema / finiteness / label / sign-domain checks for PersiaBatch."""
+
+    def __init__(
+        self,
+        config: Optional[ValidatorConfig] = None,
+        quarantine: Optional[Quarantine] = None,
+    ):
+        self.config = config or ValidatorConfig()
+        self.quarantine = quarantine
+        m = get_metrics()
+        self._m_checked = m.counter(
+            "persia_tpu_health_batches_validated",
+            "batches inspected by the health validator",
+        )
+        self._m_rejected = m.counter(
+            "persia_tpu_health_batches_rejected",
+            "batches rejected and quarantined by the health validator",
+        )
+        self.rejected_by_rule: dict = {}
+
+    # -- rules ---------------------------------------------------------
+    def check(self, batch: PersiaBatch) -> Optional[Tuple[str, str]]:
+        """Return (rule, reason) for the first violated rule, else None."""
+        cfg = self.config
+        bs = batch.batch_size
+        if batch.requires_grad and not batch.labels:
+            return "schema", "requires_grad batch has no labels"
+        for lab in batch.labels:
+            if lab.batch_size != bs:
+                return "schema", (
+                    f"label {lab.name!r} rows {lab.batch_size} != batch {bs}"
+                )
+        for dense in batch.non_id_type_features:
+            if dense.batch_size != bs:
+                return "schema", (
+                    f"dense {dense.name!r} rows {dense.batch_size} != batch {bs}"
+                )
+        if cfg.check_nonfinite:
+            for dense in batch.non_id_type_features:
+                if np.issubdtype(dense.data.dtype, np.floating) and not bool(
+                    np.isfinite(dense.data).all()
+                ):
+                    return "nonfinite", f"non-finite value in dense {dense.name!r}"
+            for lab in batch.labels:
+                if np.issubdtype(lab.data.dtype, np.floating) and not bool(
+                    np.isfinite(lab.data).all()
+                ):
+                    return "nonfinite", f"non-finite value in label {lab.name!r}"
+        if cfg.check_label_range:
+            for lab in batch.labels:
+                if lab.data.size == 0:
+                    continue
+                lo = float(np.min(lab.data))
+                hi = float(np.max(lab.data))
+                if lo < cfg.label_min or hi > cfg.label_max:
+                    return "label_range", (
+                        f"label {lab.name!r} range [{lo:g}, {hi:g}] outside "
+                        f"[{cfg.label_min:g}, {cfg.label_max:g}]"
+                    )
+        if cfg.sign_prefix_bit > 0:
+            bound = np.uint64(1) << np.uint64(64 - cfg.sign_prefix_bit)
+            for feat in batch.id_type_features:
+                flat, _ = feat.flat_counts()
+                if flat.size and bool(np.any(flat >= bound)):
+                    return "sign_domain", (
+                        f"id feature {feat.name!r} has signs touching the "
+                        f"{cfg.sign_prefix_bit}-bit salt prefix"
+                    )
+        return None
+
+    # -- admission -----------------------------------------------------
+    def admit(self, batch: PersiaBatch, step: Optional[int] = None) -> bool:
+        """Check one batch; quarantine + count on rejection."""
+        self._m_checked.inc()
+        verdict = self.check(batch)
+        if verdict is None:
+            return True
+        rule, reason = verdict
+        self.rejected_by_rule[rule] = self.rejected_by_rule.get(rule, 0) + 1
+        self._m_rejected.inc(rule=rule)
+        name = None
+        if self.quarantine is not None:
+            name = self.quarantine.put(batch, rule, reason, step=step)
+        record_event(
+            "health.anomaly",
+            cause="batch_rejected",
+            rule=rule,
+            reason=reason,
+            step=step,
+            quarantined=name,
+        )
+        return False
+
+    def wrap(self, batches: Iterable[PersiaBatch]) -> Iterator[PersiaBatch]:
+        """Yield only admitted batches (rejected ones are quarantined)."""
+        for i, batch in enumerate(batches):
+            if self.admit(batch, step=i):
+                yield batch

@@ -135,6 +135,24 @@ def iter_packet_entries(body: bytes):
         off = end
 
 
+def packet_body_nonfinite(body: bytes) -> int:
+    """Count entries in a packet body whose float payload carries a
+    NaN/Inf — the same finite contract the fence-point PS scrubber
+    enforces (persia_tpu/health). A crc-valid packet can still ship
+    non-finite rows if the PUBLISHER was corrupted; a consumer that
+    applies it would re-serve the damage."""
+    (n,) = struct.unpack_from("<I", body, 0)
+    off = 4
+    bad = 0
+    for _ in range(n):
+        _sign, _dim, ln = struct.unpack_from("<QII", body, off)
+        vals = np.frombuffer(body, dtype=np.float32, count=ln, offset=off + 16)
+        if not np.isfinite(vals).all():
+            bad += 1
+        off += 16 + 4 * ln
+    return bad
+
+
 def packet_signs(body: bytes) -> np.ndarray:
     """Signs updated by a packet body — what an infer-side cache must
     invalidate when the packet applies (persia_tpu/serving/cache.py)."""
@@ -352,8 +370,14 @@ class IncrementalLoader:
         scan_interval_sec: float = 10.0,
         skip_before_us: int = 0,
         on_apply=None,
+        reject_nonfinite: bool = True,
     ):
         self.store = store
+        # data-plane health gate (persia_tpu/health): a crc-VALID packet
+        # whose entry payload carries NaN/Inf is refused like a torn one
+        # (hold position, retry for a clean redelivery, then skip +
+        # needs_resync) — serving must never apply non-finite rows
+        self.reject_nonfinite = reject_nonfinite
         self.root = storage_path(inc_dir)
         self.scan_interval_sec = scan_interval_sec
         # called with the applied packet's signs (np.uint64) AFTER each
@@ -381,7 +405,7 @@ class IncrementalLoader:
         self.head_time_us = 0
         self.stats: Dict[str, int] = {
             "applied_packets": 0, "corrupt_skipped": 0, "gaps": 0,
-            "stale_dropped": 0, "resyncs": 0,
+            "stale_dropped": 0, "resyncs": 0, "nonfinite_rejected": 0,
         }
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -402,6 +426,10 @@ class IncrementalLoader:
         )
         self._m_resyncs = m.counter(
             "persia_tpu_inc_resyncs", "loader resyncs after channel damage"
+        )
+        self._m_nonfinite = m.counter(
+            "persia_tpu_health_delta_rejected",
+            "delta packets refused because their payload failed the finite check",
         )
         self._m_lag_steps = m.gauge(
             "persia_tpu_inc_freshness_lag_steps",
@@ -491,6 +519,21 @@ class IncrementalLoader:
                 continue
             try:
                 meta, body = packet_meta(self.root.join(name).read_bytes())
+                if self.reject_nonfinite:
+                    bad_rows = packet_body_nonfinite(body)
+                    if bad_rows:
+                        from persia_tpu.tracing import record_event
+
+                        self.stats["nonfinite_rejected"] += 1
+                        self._m_nonfinite.inc()
+                        record_event(
+                            "health.anomaly", cause="nonfinite_delta",
+                            packet=name, seq=seq, rows=bad_rows,
+                        )
+                        raise PacketIntegrityError(
+                            f"{bad_rows} non-finite entry row(s) in packet "
+                            f"payload (seq {seq})"
+                        )
             except (StorageError, ValueError, struct.error) as e:
                 self._bad[name] = self._bad.get(name, 0) + 1
                 self.stats["corrupt_skipped"] += 1

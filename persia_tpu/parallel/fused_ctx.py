@@ -31,6 +31,7 @@ from persia_tpu.parallel.fused_step import (
     build_fused_train_step,
     init_fused_state,
 )
+from persia_tpu.parallel.train_step import _note_nonfinite_loss
 
 logger = get_default_logger("persia_tpu.fused_ctx")
 
@@ -160,13 +161,15 @@ class FusedTrainCtx:
         self._last = (loss, preds)
         if not fetch_metrics:
             return {}
-        return {"loss": float(loss), "preds": np.asarray(preds)}
+        return {"loss": _note_nonfinite_loss(float(loss)),
+                "preds": np.asarray(preds)}
 
     def last_metrics(self) -> Optional[Dict]:
         if getattr(self, "_last", None) is None:
             return None
         loss, preds = self._last
-        return {"loss": float(loss), "preds": np.asarray(preds)}
+        return {"loss": _note_nonfinite_loss(float(loss)),
+                "preds": np.asarray(preds)}
 
     def eval_batch(self, batch: PersiaBatch) -> np.ndarray:
         fb = batch_to_fused(batch, self.specs, self.fold_ids)

@@ -270,11 +270,30 @@ def build_train_step(
     return jax.jit(step)
 
 
+def _note_nonfinite_loss(loss: float) -> float:
+    """Finite-guard on every host loss consumption: a NaN/Inf loss bumps
+    the health counter + flight recorder instead of flowing silently into
+    metrics/telemetry consumers."""
+    if not np.isfinite(loss):
+        from persia_tpu.metrics import get_metrics
+        from persia_tpu.tracing import record_event
+
+        get_metrics().counter(
+            "persia_tpu_health_nonfinite_loss",
+            "non-finite loss scalars observed at header decode",
+        ).inc()
+        record_event("health.anomaly", cause="nonfinite_loss", loss=repr(loss))
+    return loss
+
+
 def unpack_step_header(header: np.ndarray, batch: Dict):
-    """Host view of the step's small output: (loss, preds)."""
+    """Host view of the step's small output: (loss, preds). A sentinel
+    probe tail (if any) rides after the preds and is ignored here — use
+    :func:`unpack_step_probe` for it."""
     labels = batch["labels"][0]
-    loss = float(header[0])
-    preds = header[1:].reshape(labels.shape)
+    loss = _note_nonfinite_loss(float(header[0]))
+    n = int(np.prod(labels.shape))
+    preds = header[1:1 + n].reshape(labels.shape)
     return loss, preds
 
 
@@ -282,11 +301,49 @@ def unpack_step_header_dynamic(header: np.ndarray, batch: Dict):
     """Header view for a ``dynamic_loss_scale`` step:
     (loss, preds, scale_used, grads_finite)."""
     labels = batch["labels"][0]
-    loss = float(header[0])
+    loss = _note_nonfinite_loss(float(header[0]))
     scale = float(header[1])
     finite = bool(header[2] > 0.5)
-    preds = header[3:].reshape(labels.shape)
+    n = int(np.prod(labels.shape))
+    preds = header[3:3 + n].reshape(labels.shape)
     return loss, preds, scale, finite
+
+
+def probe_tail_len(n_groups: int) -> int:
+    """Floats appended to the header by ``sentinel_probe=True``:
+    [dense_gnorm, group_gnorm x n_groups, ps_gnorm, finite, clipped]."""
+    return n_groups + 4
+
+
+def unpack_step_probe(
+    header: np.ndarray, n_labels: int, n_groups: int, dynamic: bool = False
+) -> Dict:
+    """Decode the sentinel probe tail from a step header.
+
+    All norms are unscaled (loss-scale divided out on device) and
+    pre-clip; ``finite`` is the device-side skip gate, ``clipped``
+    whether ``guard_clip_norm`` rescaled the update.
+    """
+    base = (3 if dynamic else 1) + int(n_labels)
+    tail = np.asarray(header[base:base + probe_tail_len(n_groups)], np.float32)
+    if tail.shape[0] != probe_tail_len(n_groups):
+        raise ValueError(
+            f"header carries no probe tail (got {tail.shape[0]} floats, "
+            f"want {probe_tail_len(n_groups)}) — was the step built with "
+            "sentinel_probe=True?"
+        )
+    dense = float(tail[0])
+    groups = [float(v) for v in tail[1:1 + n_groups]]
+    ps = float(tail[1 + n_groups])
+    total = float(np.sqrt(dense * dense + ps * ps + sum(g * g for g in groups)))
+    return {
+        "dense_gnorm": dense,
+        "group_gnorms": groups,
+        "ps_gnorm": ps,
+        "total_gnorm": total,
+        "finite": float(tail[1 + n_groups + 1]),
+        "clipped": float(tail[1 + n_groups + 2]),
+    }
 
 
 def unpack_step_grads(gpacked: np.ndarray, batch: Dict) -> List[np.ndarray]:

@@ -122,6 +122,21 @@ class StoreClient:
     def journal_clear(self) -> None:
         self._rpc.call("journal_clear")
 
+    def scan_nonfinite(self, cap: int = 65536):
+        """Health scrub (persia_tpu/health): ask the PS to repair its
+        NaN/Inf rows to the seeded init. NOT idempotent for retry
+        purposes at the journal level — the journaled exactly-once wrapper
+        (``health.scrub.scrub_store``) probes before calling — but the
+        repair itself is convergent (a re-scan finds nothing), so the
+        transport may retry it safely."""
+        raw = self._rpc.call(
+            "scan_nonfinite", struct.pack("<q", int(cap)), idempotent=True,
+            timeout_s=120.0,
+        )
+        repaired = struct.unpack("<q", raw[:8])[0]
+        signs = np.frombuffer(raw[8:], dtype=np.uint64).copy()
+        return int(repaired), signs
+
     def lookup(self, signs: np.ndarray, dim: int, train: bool) -> np.ndarray:
         # train lookups mutate (LRU/admit) but are retry-safe: re-running a
         # lookup converges to the same entries, so idempotent for RPC purposes

@@ -70,6 +70,7 @@ class ParameterServerService:
         s.register("journal_probe", self._journal_probe)
         s.register("journal_len", self._journal_len)
         s.register("journal_clear", self._journal_clear)
+        s.register("scan_nonfinite", self._scan_nonfinite)
         s.register("checkout_entries", self._checkout)
         s.register("probe_entries", self._probe_entries)
         s.register("update_gradients", self._update)
@@ -169,6 +170,15 @@ class ParameterServerService:
     def _journal_clear(self, payload: bytes) -> bytes:
         self.store.journal_clear()
         return b"ok"
+
+    def _scan_nonfinite(self, payload: bytes) -> bytes:
+        """Health scrub (persia_tpu/health): repair NaN/Inf rows to the
+        seeded init. Reply = [repaired i64 | reported signs u64...]."""
+        (cap,) = struct.unpack("<q", payload)
+        repaired, signs = self.store.scan_nonfinite(cap=cap)
+        return struct.pack("<q", repaired) + np.asarray(
+            signs, dtype=np.uint64
+        ).tobytes()
 
     def _checkout(self, payload: bytes) -> bytes:
         signs, dim, _ = proto.unpack_lookup_request(payload)

@@ -189,7 +189,10 @@ def trainer_main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
 
     from persia_tpu.chaos import write_progress
+    from persia_tpu.health import health_enabled
+    from persia_tpu.health.scrub import scrub_router
     from persia_tpu.incremental import attach_incremental
+    from persia_tpu.parallel.train_step import _note_nonfinite_loss
 
     _arm_telemetry(f"trainer{args.publisher_index}")
     ctx, _cfg = build_demo_ctx(seed=args.seed)
@@ -205,10 +208,13 @@ def trainer_main(argv: Optional[List[str]] = None) -> int:
         )
         mgr.note_step(ctx._global_step)
         start = ctx._global_step
+        sentinel_armed = health_enabled()
         for step in range(start, args.steps):
-            ctx.train_step(demo_batch(step, args.rows, args.vocab,
-                                      seed=args.seed,
-                                      publisher=args.publisher_index))
+            out = ctx.train_step(demo_batch(step, args.rows, args.vocab,
+                                            seed=args.seed,
+                                            publisher=args.publisher_index))
+            if sentinel_armed and isinstance(out, dict) and "loss" in out:
+                _note_nonfinite_loss(float(out["loss"]))
             done = step + 1
             mgr.note_step(done)
             if args.progress_file:
@@ -217,6 +223,11 @@ def trainer_main(argv: Optional[List[str]] = None) -> int:
                 mgr.flush()
             if args.snapshot_every and args.job_state_dir and \
                     done % args.snapshot_every == 0:
+                if sentinel_armed:
+                    # fence-point scrub: repair any non-finite PS row
+                    # BEFORE it can be captured into LAST_GOOD
+                    scrub_router(ctx.worker.lookup_router,
+                                 getattr(ctx, "_job_epoch", 0) or 0, done)
                 ctx.snapshot_job(args.job_state_dir)
             if args.ckpt_every and args.ckpt_dir and done % args.ckpt_every == 0:
                 ctx.dump_checkpoint(args.ckpt_dir)
@@ -386,6 +397,10 @@ class LocalTopology:
             # every role arms tracing + its /spans endpoint on boot
             self._env["PERSIA_TRACE"] = "1"
             self._env["PERSIA_TRACE_DIR"] = self.trace_dir
+        # data-plane health sentinel armed by default in the demo fleet:
+        # health.* events (scrub at fences, anomalies) land in the merged
+        # trace alongside the fence/rollover events they correlate with
+        self._env.setdefault("PERSIA_HEALTH", "1")
 
     # -------------------------------------------------------------- lifecycle
 
@@ -608,7 +623,15 @@ class LocalTopology:
                 offset_us
         except (OSError, ValueError):
             if kind != "spans":
-                return [], 0.0
+                # a finished role's flight ring lives in its atexit dump
+                # (trainers exit long before the merge; their health.* /
+                # fence events must still make the ledger)
+                path = os.path.join(self.trace_dir, f"{role}.flight.json")
+                try:
+                    with open(path) as f:
+                        return json.load(f).get("events", []), 0.0
+                except (OSError, ValueError):
+                    return [], 0.0
             path = os.path.join(self.trace_dir, f"{role}.trace.json")
             try:
                 with open(path) as f:

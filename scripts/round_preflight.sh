@@ -49,11 +49,18 @@ echo "== 1/5 chaos suite (fast schedules + resume-chaos + serving-chaos) =="
 # tests/test_tiering.py rides here too — the fast subset (sketch accuracy,
 # planner hysteresis/lockstep, controller rounds, snapshot roundtrip);
 # the four multi-second stream/e2e/bit-parity runs stay in the full suite
-JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_failure_recovery.py tests/test_jobstate.py tests/test_serving_chaos.py tests/test_incremental.py tests/test_tiering.py -q -m 'not slow' \
+# tests/test_health.py rides here too — the fast subset (validator +
+# quarantine, sentinel ladder/dedupe, scrubber exactly-once, delta
+# rejection, NUM001, data-plane chaos determinism); the two multi-second
+# cached-stream runs (poisoned-stream bit-parity, on-device skip rung)
+# stay in the full suite
+JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_failure_recovery.py tests/test_jobstate.py tests/test_serving_chaos.py tests/test_incremental.py tests/test_tiering.py tests/test_health.py -q -m 'not slow' \
     --deselect tests/test_tiering.py::test_stream_migration_at_fence_and_ledger_drained \
     --deselect tests/test_tiering.py::test_auto_tier_demotes_cold_slot_and_survives_resume \
     --deselect tests/test_tiering.py::test_migration_bit_parity_with_fresh_placement_resume \
-    --deselect tests/test_tiering.py::test_fence_manifest_carries_tiering_component
+    --deselect tests/test_tiering.py::test_fence_manifest_carries_tiering_component \
+    --deselect tests/test_health.py::test_poisoned_stream_rollback_bit_parity \
+    --deselect tests/test_health.py::test_on_device_nonfinite_skip_rung
 
 echo "== 1.5/5 telemetry plane (trace propagation + flight recorder) =="
 # the fast tracing/telemetry subset: span mechanics, RPC + gateway HTTP
@@ -76,6 +83,23 @@ per_us = (time.perf_counter() - t0) / n * 1e6
 assert tracing.spans_snapshot() == [], "disabled tracer recorded spans"
 assert per_us < 25.0, f"disabled span costs {per_us:.2f}us (no-op bound 25us)"
 print(f"disabled-span overhead {per_us:.2f}us/call OK")
+PY
+# sentinel-disabled overhead guard: same contract on the stream hot path —
+# sentinel off must cost exactly one ``is None`` check per step
+JAX_PLATFORMS=cpu python - <<'PY'
+import time
+import numpy as np
+from persia_tpu.health import sentinel_drain, sentinel_note
+pending, header = [], np.zeros(6, np.float32)
+n = 200_000
+t0 = time.perf_counter()
+for g in range(n):
+    sentinel_note(None, pending, g, header, 1)
+sentinel_drain(None, pending)
+per_us = (time.perf_counter() - t0) / n * 1e6
+assert pending == [], "disabled sentinel queued headers"
+assert per_us < 25.0, f"disabled sentinel_note costs {per_us:.2f}us (no-op bound 25us)"
+print(f"disabled-sentinel overhead {per_us:.2f}us/call OK")
 PY
 
 echo "== 2/5 test suite =="

@@ -1,0 +1,22 @@
+"""NUM001 clean half: the same conversions with a finite guard in the
+function, plus conversions of values outside the loss/grad plane."""
+
+import numpy as np
+
+
+def publish_stats_guarded(step_out):
+    loss = float(step_out["loss"])
+    if not np.isfinite(loss):
+        loss = 0.0
+    return {"loss": loss}
+
+
+def materialize_grads_guarded(gpacked):
+    emb_grads = np.asarray(gpacked)
+    assert np.isfinite(emb_grads).all()
+    return emb_grads
+
+
+def decode_labels(batch):
+    # not a loss/grad value: never flagged
+    return np.asarray(batch["labels"]), float(batch["weight"])

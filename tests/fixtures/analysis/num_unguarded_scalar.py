@@ -1,0 +1,18 @@
+"""NUM001 seed: loss/grad scalars consumed on the host with no finite
+guard anywhere in the function."""
+
+import numpy as np
+
+
+def publish_stats(step_out):
+    loss = float(step_out["loss"])  # NUM001: unguarded host loss decode
+    return {"loss": loss}
+
+
+def materialize_grads(gpacked):
+    emb_grads = np.asarray(gpacked)  # NUM001: grad buffer, no guard
+    return emb_grads
+
+
+def log_norm(gnorm_dev):
+    return gnorm_dev.item()  # NUM001: gnorm scalar, no guard

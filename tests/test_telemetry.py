@@ -497,9 +497,12 @@ def test_local_topology_merged_trace(tmp_path):
     from persia_tpu.topology import LocalTopology
 
     trace_dir = str(tmp_path / "traces")
+    # snapshot_every>0 so the trainer hits fence points: the armed
+    # sentinel (PERSIA_HEALTH=1, LocalTopology default) scrubs the PS
+    # there and its health.* events must land in the merged flight ledger
     topo = LocalTopology(
         trainers=1, replicas=1, steps=25, step_ms=0.0, rows=8,
-        vocab=1000, flush_every=5, ckpt_every=0, snapshot_every=0,
+        vocab=1000, flush_every=5, ckpt_every=0, snapshot_every=10,
         base_dir=str(tmp_path / "work"), trace_dir=trace_dir,
         auto_resume=False, startup_timeout_s=180.0,
     )
@@ -523,6 +526,24 @@ def test_local_topology_merged_trace(tmp_path):
 
         _wait(replica_has_span, timeout_s=30.0,
               what="replica span with the client trace id")
+
+        def trainer_scrubbed():
+            # live ring while the trainer runs; atexit dump once it exits
+            try:
+                eps = topo.telemetry_endpoints()
+                doc, _ = LocalTopology._scrape(
+                    eps["trainer0"]["port"], "/flight")
+                evs = doc.get("events", [])
+            except Exception:
+                try:
+                    evs = json.loads(open(os.path.join(
+                        trace_dir, "trainer0.flight.json")).read())["events"]
+                except (OSError, ValueError):
+                    return False
+            return any(e["kind"] == "health.scrub" for e in evs)
+
+        _wait(trainer_scrubbed, timeout_s=120.0,
+              what="trainer fence-point health.scrub event")
         merged = topo.merge_traces()
         assert merged and os.path.exists(merged)
         doc = json.loads(open(merged).read())
@@ -542,3 +563,10 @@ def test_local_topology_merged_trace(tmp_path):
         assert "gateway.predict" in names_with_tid
         assert "serving.request" in names_with_tid
         assert "serving.engine_forward" in names_with_tid
+        # the armed trainer's fence-point health scrubs crossed the
+        # process boundary into the merged flight ledger
+        fl = json.loads(open(
+            os.path.join(trace_dir, "merged_flight.json")).read())
+        health_kinds = {e["kind"] for e in fl["events"]
+                        if e["kind"].startswith("health.")}
+        assert "health.scrub" in health_kinds, health_kinds
