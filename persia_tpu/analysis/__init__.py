@@ -12,6 +12,16 @@ any finding:
   permits/ring-spans not released on exception paths, blocking calls made
   under a lock, lock-order inversions against the declared registry
   (:mod:`persia_tpu.analysis.lock_order`).
+- **Interprocedural concurrency** (CONC005–CONC007): a module-level call
+  graph over the whole package with held-lock sets propagated through
+  call edges — transitive blocking-call-under-lock, cross-function
+  lock-order inversion, and locks created but absent from the ranking
+  registry (:mod:`persia_tpu.analysis.interproc`).
+- **JAX trace discipline** (JAX001–JAX004): host syncs on jit outputs in
+  hot paths, retrace hazards from traced-argument branches, donated-buffer
+  reuse after ``donate_argnums``, and benchmark timer windows that read
+  the clock without ``block_until_ready``
+  (:mod:`persia_tpu.analysis.jax_lint`).
 - **Resilience policy** (RES001–RES005): raw sleeps, constant socket
   timeouts, ad-hoc retry loops, manual wall-clock deadlines, and
   swallow-without-metric ``except Exception`` loops in
@@ -59,7 +69,7 @@ __all__ = [
     "NATIVE_LIBS",
 ]
 
-_PASS_PREFIXES = ("ABI", "CONC", "RES", "DUR", "OBS", "NUM")
+_PASS_PREFIXES = ("ABI", "CONC", "RES", "DUR", "OBS", "NUM", "JAX")
 
 
 def run_all(
@@ -71,6 +81,8 @@ def run_all(
         abi,
         concurrency,
         durability,
+        interproc,
+        jax_lint,
         numeric_lint,
         observability_lint,
         resilience_lint,
@@ -87,6 +99,11 @@ def run_all(
     py_files = python_files(root)
     if any(w.startswith("CONC") for w in wanted):
         findings.extend(concurrency.check(root, py_files))
+        ip_findings, ip_cov = interproc.check(root, py_files)
+        findings.extend(ip_findings)
+        coverage["callgraph"] = ip_cov
+    if any(w.startswith("JAX") for w in wanted):
+        findings.extend(jax_lint.check(root))
     if any(w.startswith("RES") for w in wanted):
         findings.extend(resilience_lint.check(root))
     if any(w.startswith("DUR") for w in wanted):
@@ -116,5 +133,8 @@ def run_all(
             except OSError:
                 texts[f.path] = ""
     findings = apply_suppressions(findings, texts)
-    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    # stable RULE-sorted order: the --json output is diffed against a
+    # committed baseline in CI, and rule-major ordering keeps a new file
+    # from reshuffling every other rule's block of the diff
+    findings.sort(key=lambda f: (f.rule, f.path, f.line))
     return findings, coverage

@@ -1,4 +1,23 @@
-"""CLI: ``python -m persia_tpu.analysis`` — exit nonzero on findings."""
+"""CLI: ``python -m persia_tpu.analysis`` — the persia-verify entry point.
+
+Exit contract (what CI and ``round_preflight.sh`` rely on):
+
+- **0**: no findings survived suppression (with ``--baseline``: no finding
+  absent from the baseline).
+- **1**: at least one (new) finding. Findings are printed in stable
+  rule-sorted order — ``(rule, path, line)`` — so two runs over the same
+  tree diff cleanly.
+- **2**: argparse usage errors (argparse's own convention).
+
+``--json`` emits ``{"findings": [{rule, path, line, message}...],
+"coverage": {...}}`` with the same ordering, for machine diffing.
+``--write-baseline FILE`` records the current findings;
+``--baseline FILE`` fails only on findings NOT in that record, so a
+legacy finding can be grandfathered without an inline suppression while
+still gating new ones. Baselines match on (rule, path, message) — line
+numbers drift with unrelated edits; regenerate with ``--write-baseline``
+when a recorded finding moves enough that its message changes.
+"""
 
 from __future__ import annotations
 
@@ -23,6 +42,9 @@ _RULE_DOC = {
     "CONC002": "permit/ring-span not released on the exception path",
     "CONC003": "blocking call (sleep/socket/native) while holding a lock",
     "CONC004": "lock-order inversion vs analysis/lock_order.py registry",
+    "CONC005": "transitive blocking call under a lock through the call graph",
+    "CONC006": "cross-function lock-order inversion (callee acquires outer lock)",
+    "CONC007": "lock created but absent from the lock_order.py ranking registry",
     "RES001": "constant time.sleep bypassing resilience.RetryPolicy",
     "RES002": "constant socket timeout bypassing resilience.Deadline.cap",
     "RES003": "ad-hoc retry loop outside resilience (swallow+sleep)",
@@ -30,19 +52,40 @@ _RULE_DOC = {
     "DUR001": "checkpoint/manifest artifact written without temp+fsync+rename",
     "OBS001": "metric registered outside the persia_tpu_/persia_ namespace",
     "OBS002": "hand-rolled stage timer bypassing tracing.stage_span",
+    "NUM001": "host consumption of loss/grad scalars with no finite guard",
+    "JAX001": "host sync on jit output in a hot path without a guard rationale",
+    "JAX002": "branch on a traced argument inside jit (retrace/ConcretizationError)",
+    "JAX003": "donated buffer read after the donating call",
+    "JAX004": "benchmark timer window reads the clock without block_until_ready",
 }
+
+
+def _baseline_key(f) -> tuple:
+    # (rule, path, message) — deliberately NOT line: unrelated edits shift
+    # line numbers and would un-grandfather every recorded finding below them
+    return (f["rule"], f["path"], f["message"]) if isinstance(f, dict) \
+        else (f.rule, f.path, f.message)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m persia_tpu.analysis",
-        description="persia-lint: ABI drift + concurrency + resilience checks",
+        description="persia-verify: ABI drift + (interprocedural) concurrency "
+        "+ JAX trace-discipline + resilience checks",
+        epilog="exit status: 0 = clean (with --baseline: no NEW finding), "
+        "1 = findings, 2 = usage error. Output is stable rule-sorted "
+        "(rule, path, line) so runs diff cleanly.",
     )
     ap.add_argument("--rules", help="comma-separated rule ids or prefixes "
                     "(e.g. ABI or RES001); default: all")
     ap.add_argument("--root", default=REPO_ROOT, help="repo root to scan")
     ap.add_argument("--json", action="store_true", help="machine-readable output")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="JSON findings file (from --write-baseline); exit "
+                    "nonzero only on findings not recorded there")
+    ap.add_argument("--write-baseline", metavar="FILE",
+                    help="record current findings to FILE and exit 0")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -52,6 +95,25 @@ def main(argv=None) -> int:
 
     rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
     findings, coverage = run_all(args.root, rules)
+
+    if args.write_baseline:
+        with open(args.write_baseline, "w") as fh:
+            json.dump({"findings": [f.__dict__ for f in findings]}, fh, indent=2)
+            fh.write("\n")
+        print(f"persia-lint: baseline written "
+              f"({len(findings)} finding(s)) -> {args.write_baseline}")
+        return 0
+
+    if args.baseline:
+        with open(args.baseline) as fh:
+            recorded = {_baseline_key(f)
+                        for f in json.load(fh).get("findings", [])}
+        new = [f for f in findings if _baseline_key(f) not in recorded]
+        grandfathered = len(findings) - len(new)
+        findings = new
+        if grandfathered:
+            print(f"persia-lint: {grandfathered} baseline finding(s) "
+                  f"grandfathered ({args.baseline})", file=sys.stderr)
 
     if args.json:
         print(json.dumps({

@@ -63,11 +63,43 @@ def _expr_source(node: ast.expr) -> str:
 
 def _is_lockish(name: str) -> bool:
     low = name.lower()
-    return any(t in low for t in _LOCKISH) or low in ("cv", "cond") or low.endswith("cond")
+    return (
+        any(t in low for t in _LOCKISH)
+        or low in ("cv", "cond")
+        or low.endswith("cond")
+        or low.endswith("_cv")
+    )
 
 
 def _is_semish(name: str) -> bool:
     return any(t in name.lower() for t in _SEMISH)
+
+
+def blocking_call_detail(node: ast.Call) -> Optional[str]:
+    """Human-readable description when ``node`` is a call this pass treats
+    as blocking (sleep/socket/subprocess or a ctypes call into a native
+    core), else None. Shared with the interprocedural pass (CONC005) so
+    the two rules can never disagree about what "blocking" means."""
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    attr = f.attr
+    qual = _expr_source(f.value)
+    qlow = qual.lower()
+    if attr in _BLOCKING_ATTRS and (
+        qual in _BLOCKING_MODULES
+        or qlow.startswith("socket")
+        or qlow.startswith("subprocess")
+        or qlow.endswith("sock")
+        or ".sock" in qlow
+    ):
+        return f"{qual}.{attr}()"
+    if (
+        (qlow == "lib" or qlow.endswith("_lib") or qlow.endswith("._lib"))
+        and not attr.startswith("_")
+    ):
+        return f"native call {qual}.{attr}()"
+    return None
 
 
 def _releases(node: ast.AST, target_src: str) -> bool:
@@ -187,30 +219,8 @@ class _FuncChecker:
                 # here only flag direct blocking calls
                 if not isinstance(node, ast.Call):
                     continue
-                f = node.func
-                if not isinstance(f, ast.Attribute):
-                    continue
-                attr = f.attr
-                qual = _expr_source(f.value)
-                qlow = qual.lower()
-                blocking = False
-                detail = ""
-                if attr in _BLOCKING_ATTRS and (
-                    qual in _BLOCKING_MODULES
-                    or qlow.startswith("socket")
-                    or qlow.startswith("subprocess")
-                    or qlow.endswith("sock")
-                    or ".sock" in qlow
-                ):
-                    blocking = True
-                    detail = f"{qual}.{attr}()"
-                elif (
-                    (qlow == "lib" or qlow.endswith("_lib") or qlow.endswith("._lib"))
-                    and not attr.startswith("_")
-                ):
-                    blocking = True
-                    detail = f"native call {qual}.{attr}()"
-                if blocking:
+                detail = blocking_call_detail(node)
+                if detail is not None:
                     self.findings.append(Finding(
                         "CONC003", self.path, node.lineno,
                         f"blocking {detail} while holding "

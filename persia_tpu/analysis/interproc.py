@@ -1,0 +1,515 @@
+"""Interprocedural concurrency analysis: whole-program lock discipline.
+
+The lexical pass (:mod:`persia_tpu.analysis.concurrency`) sees one
+function at a time, so a blocking call reached THROUGH a helper —
+``with self._lock: self._flush()`` where ``_flush`` does the native
+call — is invisible to CONC003, and a lock acquired inside a callee is
+invisible to CONC004. This pass builds a module-level call graph over
+the whole package, propagates held-lock sets through call edges, and
+re-issues those rules as whole-program checks:
+
+- CONC005 **transitive blocking-call-under-lock**: a call made while
+  holding a lock whose callee (transitively, through any number of
+  resolved call edges) reaches a blocking call — ``time.sleep``, socket
+  I/O, subprocess, or a ctypes call into a native core. Reported at the
+  call site under the lock (that is the line that owns the decision to
+  hold the lock across the call), with the full call chain in the
+  message. Direct blocking in the same function stays CONC003's job.
+- CONC006 **cross-function lock-order inversion**: a call made while
+  holding a ranked lock whose callee transitively acquires a lock that
+  ranks ABOVE (outer-than) the held one per
+  :mod:`persia_tpu.analysis.lock_order`. CONC004 catches the lexically
+  nested ``with``; this catches the same deadlock built out of two
+  functions.
+- CONC007 **unranked lock**: any lock-ish attribute/variable created via
+  ``threading.Lock/RLock/Condition`` whose terminal name has no entry in
+  ``lock_order.LOCK_RANKS``. Unranked locks are invisible to CONC004 and
+  CONC006 — the registry must be complete for the order checks to mean
+  anything.
+
+Call resolution is deliberately conservative (a missed edge is a missed
+finding, never a false one): ``self.m()`` resolves within the enclosing
+class; bare ``f()`` to the module's own functions, then ``from``-imports,
+then a package-wide UNIQUE module-level name; ``mod.f()`` through import
+aliases; ``obj.m()`` only when exactly one class in the package defines
+``m``. Suppress a finding with ``# persia-lint: disable=CONC005`` (or 006)
+**on the call site under the lock** — the leaf that eventually blocks may
+be shared by many callers, each of which must justify holding ITS lock
+across the call. Like every pass here: pure stdlib, never lints
+``analysis/`` itself.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from persia_tpu.analysis.common import Finding, REPO_ROOT, read_text, rel
+from persia_tpu.analysis.concurrency import (
+    _expr_name,
+    _is_lockish,
+    _is_semish,
+    blocking_call_detail,
+)
+from persia_tpu.analysis.lock_order import rank_of
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+# Method names NEVER resolved through the unique-name fallback: they are
+# (also) methods of builtin containers / str / files / hashlib / queues /
+# threading primitives, and the receiver's type is unknown — ``h.update()``
+# on a hashlib object must not resolve to the one CLASS in the package that
+# happens to define ``update``. Conservative by design: a genuine repo
+# method with one of these names just loses its fallback edge (exact
+# ``self.``/module-alias resolution still works).
+_FALLBACK_DENY = frozenset({
+    # dict / set / list / deque
+    "update", "get", "pop", "popitem", "setdefault", "keys", "values",
+    "items", "clear", "copy", "append", "appendleft", "extend",
+    "extendleft", "insert", "remove", "sort", "reverse", "index", "count",
+    "add", "discard", "union", "intersection", "difference",
+    # str / bytes
+    "join", "split", "rsplit", "splitlines", "strip", "lstrip", "rstrip",
+    "startswith", "endswith", "replace", "format", "encode", "decode",
+    "lower", "upper", "zfill",
+    # files / buffers
+    "read", "readline", "readlines", "write", "writelines", "seek",
+    "tell", "flush", "close", "fileno",
+    # hashlib / re
+    "digest", "hexdigest", "group", "groups", "search", "match", "sub",
+    "findall", "finditer",
+    # threading / queue / futures (lock semantics differ per receiver —
+    # Condition.wait_for RELEASES the lock, so attributing it to some
+    # repo method named wait_for inverts the rule's meaning)
+    "wait", "wait_for", "notify", "notify_all", "acquire", "release",
+    "locked", "set", "is_set", "put", "put_nowait", "get_nowait", "qsize",
+    "empty", "full", "task_done", "start", "cancel", "result", "done",
+    "submit", "shutdown",
+    # numpy scalars/arrays
+    "item", "tolist", "tobytes", "astype", "reshape", "fill", "mean",
+    "sum", "min", "max", "all", "any",
+})
+
+# held-lock entry: (lock name, rank or None, with-stmt line)
+_Held = Tuple[str, Optional[int], int]
+
+
+@dataclass
+class _CallSite:
+    kind: str  # "local" | "self" | "modattr" | "method"
+    owner: str  # alias before the dot for modattr; "" otherwise
+    name: str  # callee function/method name
+    line: int
+    held: Tuple[_Held, ...]
+    resolved: Optional[str] = None  # function key, filled by _resolve_all
+
+
+@dataclass
+class _FuncInfo:
+    key: str  # "<module>::<qualname>"
+    path: str  # repo-relative
+    module: str
+    cls: str  # "" for module-level functions
+    name: str
+    blocking: List[Tuple[int, str]] = field(default_factory=list)
+    acquires: List[Tuple[str, Optional[int], int]] = field(default_factory=list)
+    calls: List[_CallSite] = field(default_factory=list)
+
+
+@dataclass
+class _ModuleInfo:
+    module: str  # dotted name
+    path: str
+    imports: Dict[str, str] = field(default_factory=dict)  # alias -> dotted target
+    lock_creations: List[Tuple[str, int]] = field(default_factory=list)
+
+
+class _Index:
+    def __init__(self) -> None:
+        self.funcs: Dict[str, _FuncInfo] = {}
+        self.modules: Dict[str, _ModuleInfo] = {}
+        # fallback tables for unique-name resolution
+        self.funcs_by_name: Dict[str, List[str]] = {}
+        self.methods_by_name: Dict[str, List[str]] = {}
+
+    def add_func(self, fi: _FuncInfo) -> None:
+        self.funcs[fi.key] = fi
+        table = self.methods_by_name if fi.cls else self.funcs_by_name
+        table.setdefault(fi.name, []).append(fi.key)
+
+
+def _dotted(path: str) -> str:
+    p = rel(path) if os.path.isabs(path) else path
+    p = p[:-3] if p.endswith(".py") else p
+    parts = [x for x in p.split(os.sep) if x]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+# --------------------------------------------------------------- indexing
+
+
+class _ModuleIndexer:
+    """One pass over a module's AST collecting per-function facts: direct
+    blocking calls, lock acquisitions, call sites with the held-lock
+    stack at that point, plus the module's imports and lock creations."""
+
+    def __init__(self, index: _Index, text: str, path: str, module: str):
+        self.index = index
+        self.path = path
+        self.module = module
+        self.mi = _ModuleInfo(module=module, path=path)
+        index.modules[module] = self.mi
+        self.tree = ast.parse(text, filename=path)
+
+    def run(self) -> None:
+        self._imports(self.tree)
+        self._lock_creations(self.tree)
+        for node in self.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._index_func(node, cls="")
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self._index_func(sub, cls=node.name)
+
+    def _imports(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    alias = a.asname or a.name.split(".")[0]
+                    # `import a.b.c` binds `a`; `import a.b.c as d` binds d->a.b.c
+                    self.mi.imports[alias] = a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:  # relative: resolve against this module's package
+                    pkg = self.module.split(".")
+                    pkg = pkg[: len(pkg) - node.level]
+                    base = ".".join(pkg + ([node.module] if node.module else []))
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    alias = a.asname or a.name
+                    self.mi.imports[alias] = f"{base}.{a.name}" if base else a.name
+
+    def _lock_creations(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = node.value
+            if not (isinstance(value, ast.Call) and self._is_lock_ctor(value.func)):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for tgt in targets:
+                name = _expr_name(tgt)
+                if name and _is_lockish(name):
+                    self.mi.lock_creations.append((name, node.lineno))
+
+    def _is_lock_ctor(self, func: ast.expr) -> bool:
+        if isinstance(func, ast.Attribute):
+            return func.attr in _LOCK_CTORS and _expr_name(func.value) == "threading"
+        if isinstance(func, ast.Name):
+            return (
+                func.id in _LOCK_CTORS
+                and self.mi.imports.get(func.id, "") == f"threading.{func.id}"
+            )
+        return False
+
+    # ------------------------------------------------------------ functions
+
+    def _index_func(self, node, cls: str) -> None:
+        qual = f"{cls}.{node.name}" if cls else node.name
+        fi = _FuncInfo(
+            key=f"{self.module}::{qual}",
+            path=self.path, module=self.module, cls=cls, name=node.name,
+        )
+        self._walk_stmts(fi, node.body, held=[])
+        self.index.add_func(fi)
+
+    def _walk_stmts(self, fi: _FuncInfo, stmts: Sequence[ast.stmt], held: List[_Held]) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # nested scopes do not execute inline
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                entered: List[_Held] = []
+                for item in st.items:
+                    self._scan_expr(fi, item.context_expr, held)
+                    name = _expr_name(item.context_expr)
+                    if name and _is_lockish(name) and not _is_semish(name):
+                        entry = (name, rank_of(name), st.lineno)
+                        entered.append(entry)
+                        fi.acquires.append(entry)
+                held.extend(entered)
+                self._walk_stmts(fi, st.body, held)
+                for _ in entered:
+                    held.pop()
+                continue
+            # the statement's own (header) expressions
+            for fname, value in ast.iter_fields(st):
+                if fname in ("body", "orelse", "finalbody", "handlers"):
+                    continue
+                for expr in value if isinstance(value, list) else [value]:
+                    if isinstance(expr, ast.AST):
+                        self._scan_expr(fi, expr, held)
+            for fname in ("body", "orelse", "finalbody"):
+                sub = getattr(st, fname, None)
+                if sub:
+                    self._walk_stmts(fi, sub, held)
+            for h in getattr(st, "handlers", ()):
+                self._walk_stmts(fi, h.body, held)
+
+    def _scan_expr(self, fi: _FuncInfo, expr: ast.AST, held: List[_Held]) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            detail = blocking_call_detail(node)
+            if detail is not None:
+                fi.blocking.append((node.lineno, detail))
+                continue
+            site = self._call_site(node, tuple(held))
+            if site is not None:
+                fi.calls.append(site)
+
+    def _call_site(self, node: ast.Call, held: Tuple[_Held, ...]) -> Optional[_CallSite]:
+        f = node.func
+        if isinstance(f, ast.Name):
+            return _CallSite("local", "", f.id, node.lineno, held)
+        if isinstance(f, ast.Attribute):
+            value = f.value
+            if isinstance(value, ast.Name):
+                if value.id in ("self", "cls"):
+                    return _CallSite("self", "", f.attr, node.lineno, held)
+                return _CallSite("modattr", value.id, f.attr, node.lineno, held)
+            return _CallSite("method", "", f.attr, node.lineno, held)
+        return None
+
+
+# -------------------------------------------------------------- resolution
+
+
+def _resolve_all(index: _Index) -> int:
+    edges = 0
+    for fi in index.funcs.values():
+        mi = index.modules[fi.module]
+        for site in fi.calls:
+            site.resolved = _resolve(index, mi, fi, site)
+            if site.resolved is not None:
+                edges += 1
+    return edges
+
+
+def _resolve(index: _Index, mi: _ModuleInfo, fi: _FuncInfo, site: _CallSite) -> Optional[str]:
+    if site.kind == "self":
+        key = f"{fi.module}::{fi.cls}.{site.name}"
+        if key in index.funcs:
+            return key
+        return _unique(index.methods_by_name, site.name)
+    if site.kind == "local":
+        key = f"{fi.module}::{site.name}"
+        if key in index.funcs:
+            return key
+        tgt = mi.imports.get(site.name)
+        if tgt and "." in tgt:
+            owner, leaf = tgt.rsplit(".", 1)
+            key = f"{owner}::{leaf}"
+            if key in index.funcs:
+                return key
+        return _unique(index.funcs_by_name, site.name)
+    if site.kind == "modattr":
+        tgt = mi.imports.get(site.owner)
+        if tgt:
+            key = f"{tgt}::{site.name}"
+            if key in index.funcs:
+                return key
+        # not a module alias (or not ours): treat as a method receiver
+        return _unique(index.methods_by_name, site.name)
+    if site.kind == "method":
+        return _unique(index.methods_by_name, site.name)
+    return None
+
+
+def _unique(table: Dict[str, List[str]], name: str) -> Optional[str]:
+    if name in _FALLBACK_DENY:
+        return None
+    hits = table.get(name, ())
+    return hits[0] if len(hits) == 1 else None
+
+
+# --------------------------------------------------------------- summaries
+
+
+def _blocking_path(
+    index: _Index, key: str,
+    memo: Dict[str, Optional[Tuple[Tuple[str, ...], str, int]]],
+    stack: Set[str],
+) -> Optional[Tuple[Tuple[str, ...], str, int]]:
+    """(call chain of keys, blocking detail, leaf line) if ``key``
+    transitively reaches a blocking call, else None. Cycles break to None
+    for the in-progress member (a cycle adds no new blocking leaf)."""
+    if key in memo:
+        return memo[key]
+    if key in stack:
+        return None
+    fi = index.funcs[key]
+    if fi.blocking:
+        line, detail = min(fi.blocking)
+        memo[key] = ((key,), detail, line)
+        return memo[key]
+    stack.add(key)
+    found = None
+    for site in fi.calls:
+        if site.resolved is None:
+            continue
+        sub = _blocking_path(index, site.resolved, memo, stack)
+        if sub is not None:
+            found = ((key,) + sub[0], sub[1], sub[2])
+            break
+    stack.discard(key)
+    memo[key] = found
+    return found
+
+
+def _transitive_acquires(
+    index: _Index, key: str,
+    memo: Dict[str, Dict[str, Tuple[Optional[int], Tuple[str, ...], int]]],
+    stack: Set[str],
+) -> Dict[str, Tuple[Optional[int], Tuple[str, ...], int]]:
+    """lock name -> (rank, example call chain, acquire line) for every
+    lock ``key`` acquires itself or through resolved callees."""
+    if key in memo:
+        return memo[key]
+    if key in stack:
+        return {}
+    fi = index.funcs[key]
+    out: Dict[str, Tuple[Optional[int], Tuple[str, ...], int]] = {}
+    for name, rank, line in fi.acquires:
+        out.setdefault(name, (rank, (key,), line))
+    stack.add(key)
+    for site in fi.calls:
+        if site.resolved is None:
+            continue
+        for name, (rank, path, line) in _transitive_acquires(
+            index, site.resolved, memo, stack
+        ).items():
+            out.setdefault(name, (rank, (key,) + path, line))
+    stack.discard(key)
+    memo[key] = out
+    return out
+
+
+def _chain(keys: Sequence[str]) -> str:
+    return " -> ".join(k.split("::", 1)[1] for k in keys)
+
+
+# ------------------------------------------------------------------- rules
+
+
+def _apply_rules(index: _Index) -> List[Finding]:
+    findings: List[Finding] = []
+    bmemo: Dict[str, Optional[Tuple[Tuple[str, ...], str, int]]] = {}
+    amemo: Dict[str, Dict[str, Tuple[Optional[int], Tuple[str, ...], int]]] = {}
+
+    for fi in index.funcs.values():
+        for site in fi.calls:
+            if not site.held or site.resolved is None:
+                continue
+            held_names = [h[0] for h in site.held]
+            # CONC005: callee transitively blocks while we hold a lock
+            bp = _blocking_path(index, site.resolved, bmemo, set())
+            if bp is not None:
+                path_keys, detail, leaf_line = bp
+                leaf = index.funcs[path_keys[-1]]
+                findings.append(Finding(
+                    "CONC005", fi.path, site.line,
+                    f"call under {', '.join(held_names)} reaches blocking "
+                    f"{detail} via {_chain((fi.key,) + path_keys)} "
+                    f"(at {leaf.path}:{leaf_line}) — every sibling thread "
+                    "wanting the lock stalls behind the whole chain",
+                ))
+            # CONC006: callee transitively acquires an outer-ranked lock
+            acq = _transitive_acquires(index, site.resolved, amemo, set())
+            for name, (rank, path_keys, line) in sorted(acq.items()):
+                if rank is None:
+                    continue
+                for held_name, held_rank, _ in site.held:
+                    if held_rank is None or name == held_name:
+                        continue
+                    if rank < held_rank:
+                        findings.append(Finding(
+                            "CONC006", fi.path, site.line,
+                            f"cross-function lock-order inversion: call under "
+                            f"{held_name} (rank {held_rank}) acquires {name} "
+                            f"(rank {rank}) via {_chain((fi.key,) + path_keys)} "
+                            f"(at {index.funcs[path_keys[-1]].path}:{line}) — "
+                            "declared order in analysis/lock_order.py says "
+                            f"{name} is outermost",
+                        ))
+
+    # CONC007: lock created but absent from the ranking registry
+    for mi in index.modules.values():
+        for name, line in mi.lock_creations:
+            if rank_of(name) is None:
+                findings.append(Finding(
+                    "CONC007", mi.path, line,
+                    f"unranked lock '{name}' — absent from "
+                    "analysis/lock_order.LOCK_RANKS, so CONC004/CONC006 "
+                    "cannot order it; register a rank for it",
+                ))
+
+    # a call site under two locks (or one reached twice) reports once
+    seen: Set[Tuple[str, str, int]] = set()
+    out: List[Finding] = []
+    for f in findings:
+        k = (f.rule, f.path, f.line)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out
+
+
+# --------------------------------------------------------------------- API
+
+
+def build_index(
+    root: str = REPO_ROOT, files: Optional[Sequence[str]] = None,
+) -> Tuple[_Index, Dict[str, object]]:
+    from persia_tpu.analysis.common import python_files
+
+    paths = list(files) if files is not None else python_files(root)
+    index = _Index()
+    n_files = 0
+    for p in paths:
+        abspath = p if os.path.isabs(p) else os.path.join(root, p)
+        if (os.sep + "analysis" + os.sep) in abspath:
+            continue  # the lint does not lint itself
+        try:
+            _ModuleIndexer(index, read_text(abspath), rel(abspath), _dotted(abspath)).run()
+        except SyntaxError:
+            continue  # the style passes own broken-file reporting
+        n_files += 1
+    edges = _resolve_all(index)
+    coverage = {
+        "files": n_files,
+        "functions": len(index.funcs),
+        "edges": edges,
+    }
+    return index, coverage
+
+
+def check_source(text: str, path: str) -> List[Finding]:
+    """Single-module entry point (fixtures): the call graph spans just
+    this module, so only self/local/unique-name edges resolve."""
+    index = _Index()
+    _ModuleIndexer(index, text, path, _dotted(path)).run()
+    _resolve_all(index)
+    return _apply_rules(index)
+
+
+def check(
+    root: str = REPO_ROOT, files: Optional[Sequence[str]] = None,
+) -> Tuple[List[Finding], Dict[str, object]]:
+    index, coverage = build_index(root, files)
+    return _apply_rules(index), coverage

@@ -17,15 +17,27 @@ Order (outermost first):
 1. ``cv``            — the stream pipeline condition (hbm_cache/stream.py);
                        guards heads/tails/alloc queue/sign map. Nothing may
                        be held when taking it.
-2. ``_buf_lock``     — embedding worker forward-buffer table
-3. ``_grad_lock``    — embedding worker gradient-state table
-4. ``_deg_lock``     — degraded-lookup bookkeeping (worker + cache tier)
-5. ``_swap_lock``    — serving engine model-swap latch
-6. ``_lock``         — generic leaf locks (breakers, caches, registries);
-                       must never wrap a ranked-above lock
-7. ``_rng_lock``     — RetryPolicy jitter RNG (innermost; held for one
+2. ``_cv``           — data-loader prefetch pipeline condition; same
+                       contract as ``cv`` for the loader threads
+3. ``_cond``         — RPC response-waiter / serving-batcher queue
+                       conditions; taken first by their worker threads
+4. ``_buf_lock``     — embedding worker forward-buffer table
+5. ``_grad_lock``    — embedding worker gradient-state table
+6. ``_deg_lock``     — degraded-lookup bookkeeping (worker + cache tier)
+7. ``_swap_lock``    — serving engine model-swap latch
+8. ``_lock``/``lock``— generic leaf locks (breakers, caches, registries,
+                       checkpoint shard fan-out); must never wrap a
+                       ranked-above lock
+9. ``_flight_lock``  — tracing flight-recorder ring (leaf; appends only)
+10. ``_rng_lock``    — RetryPolicy jitter RNG (innermost; held for one
                        random() call only)
-8. ``_REGISTRY_LOCK``— metrics registry (innermost leaf)
+11. ``_DEFAULT_LOCK``— resilience default-policy registry (leaf)
+12. ``_PROC_LOCK``   — native-build serializer (_native_build.py): a LAZY
+                       first-use build can trigger under any lock above,
+                       and nothing ranked is ever taken under it (only the
+                       compile subprocess + flock), so it is a leaf despite
+                       being held the longest
+13. ``_REGISTRY_LOCK``— metrics registry (innermost leaf)
 """
 
 from __future__ import annotations
@@ -35,12 +47,18 @@ from typing import Dict, Optional
 # attribute-name suffix -> rank (lower = must be taken first / outermost)
 LOCK_RANKS: Dict[str, int] = {
     "cv": 0,
+    "_cv": 2,
+    "_cond": 6,
     "_buf_lock": 10,
     "_grad_lock": 20,
     "_deg_lock": 30,
     "_swap_lock": 40,
     "_lock": 50,
+    "lock": 50,
+    "_flight_lock": 55,
     "_rng_lock": 60,
+    "_DEFAULT_LOCK": 65,
+    "_PROC_LOCK": 68,
     "_REGISTRY_LOCK": 70,
 }
 

@@ -15,7 +15,7 @@ load error. So: compile to a per-pid temp file, ``os.replace`` it into place
 never a mix), all under an ``flock``'d lockfile with a re-check so losers of
 the race reuse the winner's build instead of rebuilding.
 
-Sanitizer variants (``PERSIA_NATIVE_SANITIZE=asan|ubsan``) build to a
+Sanitizer variants (``PERSIA_NATIVE_SANITIZE=asan|ubsan|tsan``) build to a
 DISTINCT path (``libpersia_ps.asan.so``) with the sanitizer flags appended
 to the normal flag vector (same -O3/-mavx2 base, so fp codegen — and the
 bit-parity suites — match the production build). Callers must load the
@@ -43,6 +43,14 @@ SANITIZER_FLAGS = {
     # halt on the first report: a UBSan finding must fail the parity suite,
     # not scroll past it
     "ubsan": ["-fsanitize=undefined", "-fno-sanitize-recover=undefined", "-g"],
+    # ThreadSanitizer: every instrumented load/store is checked against the
+    # happens-before graph, so the seeded multi-thread stress harness
+    # (tests/test_race_stress.py via scripts/race_native.sh) turns "the
+    # PendingMap/AccessSketch/journal mutexes actually cover every shared
+    # access" into a machine-checked claim. Needs libtsan preloaded into
+    # the host python (the script handles LD_PRELOAD) and abort-on-report
+    # TSAN_OPTIONS so a race fails the suite instead of scrolling past.
+    "tsan": ["-fsanitize=thread", "-fno-omit-frame-pointer", "-g"],
 }
 
 

@@ -803,7 +803,9 @@ class CachedEmbeddingTier:
         payload = _gather_entry_rows(
             tables[g.name], emb_state[g.name], jax.device_put(rpad)
         )
-        host = np.asarray(payload)[:len(rows)].astype(np.float32)
+        # this d2h IS the operation (bounded entry fetch to persist to the
+        # PS) and runs on the flush/publish path, not the per-step hot path
+        host = np.asarray(payload)[:len(rows)].astype(np.float32)  # persia-lint: disable=JAX001
         self._set_embedding(signs, host, dim=g.dim)
 
     def flush(self, tables, emb_state) -> None:

@@ -1386,18 +1386,12 @@ class EmbeddingWorker:
                 "(already updated, aborted, or never forwarded)"
             )
         skipped = {}
-        # gradient batches are serialized so the Adam batch-state advance is
-        # atomic with its batch's updates (ref: batch-level beta powers,
-        # optim.rs:99-221); the per-slot conversions then ship as ONE
-        # batched router call per replica
-        with self._m_update_time.time(), self._grad_lock:
-            groups = {
-                self.embedding_config.group_of(s.name)
-                for s in processed.slots
-                if s.name in slot_grads
-            }
-            for g in sorted(groups):
-                self.lookup_router.advance_batch_state(g)
+        with self._m_update_time.time():
+            # per-slot grad→key conversion (vectorized numpy + the native
+            # accum kernel) runs on a batch this call exclusively owns — it
+            # was popped from the buffer above — so it stays OUTSIDE
+            # _grad_lock: holding the lock across lib.wk_grad_accum stalled
+            # every sibling gradient thread behind pure compute (CONC005)
             trip = []
             for slot in processed.slots:
                 grad = slot_grads.get(slot.name)
@@ -1412,7 +1406,20 @@ class EmbeddingWorker:
                 trip.append(
                     (slot.keys, per_key, self.embedding_config.group_of(slot.name))
                 )
-            self.lookup_router.update_groups(trip, journal_id=journal_id)
+            # gradient batches are serialized so the Adam batch-state advance
+            # is atomic with its batch's updates (ref: batch-level beta
+            # powers, optim.rs:99-221); that atomicity is exactly why the
+            # replica fan-out must stay under the lock even though its
+            # transport-retry path can sleep (bounded by degrade_after_s)
+            with self._grad_lock:
+                groups = {
+                    self.embedding_config.group_of(s.name)
+                    for s in processed.slots
+                    if s.name in slot_grads
+                }
+                for g in sorted(groups):
+                    self.lookup_router.advance_batch_state(g)
+                self.lookup_router.update_groups(trip, journal_id=journal_id)  # persia-lint: disable=CONC005
         if skipped:
             self._m_nan_skipped.inc(len(skipped))
         return skipped

@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# TSan-instrumented race verification of the mutex-protected native cores.
+#
+#   bash scripts/race_native.sh            # full seeded stress under TSan
+#   RACE_STRESS_ITERS=10 bash scripts/race_native.sh   # quicker smoke
+#
+# Builds the tsan variant .so's (PERSIA_NATIVE_SANITIZE=tsan — distinct
+# libpersia_X.tsan.so artifacts, srchash folds the flags, so they never
+# shadow or stale-cache the production libraries) and drives
+# tests/test_race_stress.py: a seeded 8-thread harness hammering
+# cache_feed_batch vs write-back ledger flushes, sketch observe vs
+# decay/stats/export, the ps journal ring, and concurrent ps
+# update/lookup/scrub/dump — the interleavings the production feeder,
+# write-back, fence, and RPC-worker threads actually produce.
+#
+# TSan needs its runtime in the host python (LD_PRELOAD) and runs with
+# halt_on_error=1 + abort_on_error=1: the FIRST data race aborts the test
+# process, so "suite green" == "zero reports" (the -fno-sanitize-recover
+# contract, same shape as the UBSan gate in sanitize_native.sh). The
+# harness's canary test seeds a REAL race first and requires TSan to kill
+# it — a silently-dead detector cannot fake a clean run.
+#
+# The harness imports no jax/flax, so the whole run (variant builds
+# included) stays in the tens of seconds. Opt into it from the preflight
+# with PREFLIGHT_TSAN=1 (scripts/round_preflight.sh step 0).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TSAN_RT="$(g++ -print-file-name=libtsan.so)"
+if [[ ! -e "$TSAN_RT" ]]; then
+    echo "race_native: libtsan.so not found (g++ without tsan runtime)" >&2
+    exit 2
+fi
+
+echo "== race_native: TSan stress (8 threads, seeded) =="
+PERSIA_NATIVE_SANITIZE=tsan \
+LD_PRELOAD="$TSAN_RT" \
+RACE_NATIVE_TSAN=1 \
+TSAN_OPTIONS="halt_on_error=1:abort_on_error=1:print_stacktrace=1:second_deadlock_stack=1:suppressions=$PWD/scripts/tsan_suppressions.txt" \
+    python -m pytest tests/test_race_stress.py -q -p no:cacheprovider
+
+echo "RACE OK (zero TSan reports)"

@@ -6,13 +6,19 @@
 #
 #   bash scripts/round_preflight.sh
 #
-# 0. persia-lint (ABI drift + concurrency + resilience rules) + native
-#    cores compile from source + the fused-feed ABI parity tests pass
+# 0. persia-verify (ABI drift + lexical AND interprocedural concurrency
+#    + JAX trace-discipline + resilience rules; fails on any finding not
+#    in scripts/lint_baseline.json when that file exists) + native cores
+#    compile from source + the fused-feed ABI parity tests pass
 #    (a broken ctypes signature loads fine and silently corrupts — the
 #    lint catches the declaration drift, the golden parity tests catch
 #    the rest) + the native parity suites under UBSan (zero reports or
 #    the run aborts). ASan is opt-in (PREFLIGHT_ASAN=1) — preloading
-#    libasan instruments all of python and costs ~100s.
+#    libasan instruments all of python and costs ~100s. The TSan race
+#    gate (scripts/race_native.sh: seeded multithread stress over all
+#    four native cores, zero-report-or-abort) is opt-in the same way
+#    via PREFLIGHT_TSAN=1 — it rebuilds every core at -O1 with
+#    -fsanitize=thread and costs ~2min.
 # 1. chaos suite, fast schedules (fault proxies, breakers, degraded mode)
 # 2. full test suite green
 # 3. bench.py rc=0 (real chip when attached; emits partial records on a
@@ -21,9 +27,15 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 0/5 persia-lint + native build + ABI parity smoke =="
-# static pass first: it needs no toolchain and fails in <1s on drift
-python -m persia_tpu.analysis
+echo "== 0/5 persia-verify + native build + ABI parity smoke =="
+# static pass first: it needs no toolchain and fails fast on drift.
+# With a committed baseline only NEW findings fail the round — exit
+# contract documented in persia_tpu/analysis/__main__.py
+if [ -f scripts/lint_baseline.json ]; then
+    python -m persia_tpu.analysis --baseline scripts/lint_baseline.json
+else
+    python -m persia_tpu.analysis
+fi
 # force=True recompile of every core: the stamp cache must not mask a
 # toolchain or source breakage
 JAX_PLATFORMS=cpu python - <<'PY'
@@ -37,6 +49,11 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_native_feed.py -q
 # UBSan variant of the full parity surface (~10s incl. variant builds);
 # SANITIZE_ASAN rides the same script when PREFLIGHT_ASAN=1
 SANITIZE_ASAN="${PREFLIGHT_ASAN:-0}" bash scripts/sanitize_native.sh
+# TSan race gate: seeded multithread stress over the four native cores
+# under -fsanitize=thread, zero TSan reports or the run aborts
+if [ "${PREFLIGHT_TSAN:-0}" = "1" ]; then
+    bash scripts/race_native.sh
+fi
 
 echo "== 1/5 chaos suite (fast schedules + resume-chaos + serving-chaos) =="
 # deterministic fault injection against live local services: proxies,

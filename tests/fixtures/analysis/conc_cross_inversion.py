@@ -1,0 +1,29 @@
+"""CONC006 seed: lock-order inversion split across two functions.
+
+``accumulate`` holds ``_grad_lock`` (rank 20) and calls ``self._stage``,
+which acquires ``_buf_lock`` (rank 10 — OUTER per lock_order.LOCK_RANKS).
+Lexically neither function nests the two ``with`` blocks, so CONC004
+cannot see it. ``drain`` takes them in the declared order (buf outside
+grad, lexically nested) and must stay silent.
+"""
+import threading
+
+
+class WriteBack:
+    def __init__(self):
+        self._buf_lock = threading.Lock()
+        self._grad_lock = threading.Lock()
+        self.buf = []
+
+    def _stage(self, item):
+        with self._buf_lock:
+            self.buf.append(item)
+
+    def accumulate(self, item):
+        with self._grad_lock:
+            self._stage(item)
+
+    def drain(self):
+        with self._buf_lock:
+            with self._grad_lock:
+                return list(self.buf)

@@ -1,0 +1,27 @@
+"""CONC005 seed: the blocking call is hidden behind a helper.
+
+``refresh`` holds ``_lock`` across ``self._flush()``, and ``_flush`` is
+the one that sleeps and makes the native call — invisible to the lexical
+CONC003 pass, visible to the interprocedural one. ``refresh_unlocked``
+makes the identical call with no lock held and must stay silent.
+"""
+import threading
+import time
+
+lib = None
+
+
+class Feeder:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def _flush(self, handle, n):
+        time.sleep(0.2)
+        lib.cache_admit(handle, n)
+
+    def refresh(self, handle, n):
+        with self._lock:
+            self._flush(handle, n)
+
+    def refresh_unlocked(self, handle, n):
+        self._flush(handle, n)

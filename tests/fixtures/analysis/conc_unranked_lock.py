@@ -1,0 +1,16 @@
+"""CONC007 seed: a lock the ordering registry has never heard of.
+
+``_stats_lock`` has no entry in lock_order.LOCK_RANKS, so CONC004/CONC006
+cannot order it against anything — the registry gap IS the finding. The
+ranked ``_buf_lock`` next to it must stay silent.
+"""
+import threading
+
+_stats_lock = threading.Lock()
+_buf_lock = threading.Lock()
+_stats = {}
+
+
+def bump(key):
+    with _stats_lock:
+        _stats[key] = _stats.get(key, 0) + 1

@@ -1,0 +1,29 @@
+"""JAX003 seed: reading a buffer after donating it.
+
+``bad_loop`` passes ``state`` in the donated position and then reads it
+again — XLA may have aliased the buffer into the output. ``good_loop``
+uses the sanctioned rebind idiom ``state, loss = step(state, batch)``
+and must stay silent.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def _step(state, batch):
+    new_state = state + batch
+    return new_state, jnp.sum(new_state)
+
+
+step = jax.jit(_step, donate_argnums=(0,))
+
+
+def bad_loop(state, batch):
+    out = step(state, batch)
+    stale = state + 1.0
+    return out, stale
+
+
+def good_loop(state, batches):
+    for batch in batches:
+        state, loss = step(state, batch)
+    return state, loss

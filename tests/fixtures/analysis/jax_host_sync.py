@@ -1,0 +1,34 @@
+"""JAX001 seed: per-step host syncs on a jit output in the hot path.
+
+``hot_step`` consumes the jitted output with .item(), float(), and
+np.asarray — three dispatch-queue drains per step. ``guarded_step`` does
+the same read behind the sanctioned sentinel/isfinite idiom and must stay
+silent.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _step(x):
+    return jnp.sum(x * x)
+
+
+step = jax.jit(_step)
+
+
+def hot_step(x):
+    out = step(x)
+    loss = out.item()
+    scale = float(out)
+    host = np.asarray(out)
+    return loss, scale, host
+
+
+def guarded_step(x):
+    out = step(x)
+    # sentinel-style: one deliberate sync, finite-guarded
+    host = np.asarray(out)
+    if not np.isfinite(host):
+        raise ValueError("non-finite loss sentinel")
+    return host

@@ -1,0 +1,31 @@
+"""JAX002 seed: jitted functions branching on traced arguments.
+
+``bad_clip`` branches on traced ``limit`` and sizes a range() loop with
+traced ``n`` — a ConcretizationError for arrays, a retrace per distinct
+value for Python scalars. ``good_clip`` marks ``n`` static and probes
+only trace-static facts (``is None``, ``x.ndim``) and must stay silent.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_clip(x, limit, n):
+    if limit > 0:
+        x = jnp.clip(x, -limit, limit)
+    for _ in range(n):
+        x = x * 0.5
+    return x
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def good_clip(x, bias, n):
+    if bias is None:
+        bias = 0.0
+    if x.ndim > 1:
+        x = x.reshape(-1)
+    for _ in range(n):
+        x = x * 0.5
+    return x + bias

@@ -1,0 +1,34 @@
+"""JAX004 seed: a benchmark window that times dispatch, not execution.
+
+``bench_bad`` reads the clock right after the jitted call returns —
+which is as soon as the work is ENQUEUED. ``bench_good`` blocks on the
+result inside the window and must stay silent.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def _kernel(x):
+    return jnp.dot(x, x.T)
+
+
+kernel = jax.jit(_kernel)
+
+
+def bench_bad(x, iters):
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        y = kernel(x)
+    elapsed = time.perf_counter() - t0
+    return elapsed, y
+
+
+def bench_good(x, iters):
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        y = kernel(x)
+    jax.block_until_ready(y)
+    elapsed = time.perf_counter() - t0
+    return elapsed, y
