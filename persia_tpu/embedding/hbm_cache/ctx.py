@@ -209,6 +209,16 @@ class CachedTrainCtx:
         # the most recent train_stream's dispatch/feeder accounting
         self._kstep_jit = None
         self._stream_stats: Optional[Dict] = None
+        # stage-graph pipelining (parallel/stage_graph.py): every
+        # read-modify-replace of ``state``/``_ev_rings`` holds _state_lock
+        # once train_stream dispatches feed programs from its stager
+        # thread (pipeline_depth > 1); the sync path is single-threaded
+        # and pays only an uncontended acquire. _stage_rebuild_hooks are
+        # copied onto each stream's StageGraph and fire at a drained
+        # fence after a tier migration (StageGraph.rebuild).
+        self._state_lock = threading.Lock()
+        self._stage_graph = None
+        self._stage_rebuild_hooks: List[Callable[[int], None]] = []
         # crash-consistent job state (persia_tpu.jobstate): manifest epoch
         # of the last committed fence (journal-id namespace), the global
         # step counter fences/journal ids run on, and a deferred resume
@@ -440,46 +450,67 @@ class CachedTrainCtx:
             self._ev_rings[gname] = ring
         return ring
 
+    def _apply_feed(self, miss_aux, cold_aux, evict_aux, evict_meta=None):
+        """The FEED stage: ONE fused aux program per touched group
+        (evict-payload read → ring write → warm scatter → cold scatter;
+        ``_apply_aux``/``_apply_aux_ring``). Returns the per-group eviction
+        payloads for the write-back thread's bounded d2h fetch.
+
+        In the pipelined stream this runs on the STAGER thread under
+        ``_state_lock``, up to ``pipeline_depth - 1`` steps ahead of its
+        own dense stage — sound because the stream only hoists a feed
+        whose rows are disjoint from every in-flight dense stage's trained
+        rows (stage_graph.feed_hazard_info), and scatter/gather chains
+        over disjoint rows commute bitwise."""
+        evict_payload = {}
+        touched = set(miss_aux) | set(cold_aux) | set(evict_aux)
+        if not touched:
+            return evict_payload
+        tables = dict(self.state.tables)
+        emb_state = dict(self.state.emb_state)
+        with span("ctx.apply_aux", groups=len(touched)):
+            for gname in sorted(touched):
+                em = self._group_empties(gname)
+                ev_rows = evict_aux.get(gname, em["rows"])
+                m_rows, m_entries = miss_aux.get(
+                    gname, (em["rows"], em["entries"])
+                )
+                c_rows, c_emb = cold_aux.get(gname, (em["rows"], em["emb"]))
+                ring_pos = -1
+                if evict_meta and gname in evict_meta:
+                    ring_pos = evict_meta[gname][2]
+                if ring_pos >= 0:
+                    (tables[gname], emb_state[gname],
+                     self._ev_rings[gname], payload) = _apply_aux_ring(
+                        tables[gname], emb_state[gname],
+                        self._ev_ring(gname), jnp.int32(ring_pos),
+                        ev_rows, m_rows, m_entries, c_rows, c_emb,
+                        self._state_consts, self._wb_bf16,
+                    )
+                else:
+                    tables[gname], emb_state[gname], payload = _apply_aux(
+                        tables[gname], emb_state[gname], ev_rows,
+                        m_rows, m_entries, c_rows, c_emb,
+                        self._state_consts, self._wb_bf16,
+                    )
+                if gname in evict_aux:
+                    evict_payload[gname] = payload
+        self.state = self.state.replace(tables=tables, emb_state=emb_state)
+        return evict_payload
+
     def _dispatch(
         self, device_inputs, layout, miss_aux, cold_aux, restore_aux,
         evict_aux, evict_meta=None,
     ):
-        """Dispatch the per-step device programs: ONE fused aux program per
-        touched group (evict-payload read → warm scatter → cold scatter; see
-        ``_apply_aux``) + in-flight restores + the main step. Inputs must
+        """Dispatch the per-step device programs in order: the FEED stage
+        (``_apply_feed``) + in-flight restores + the main step. Inputs must
         already be device arrays."""
-        evict_payload = {}
-        touched = set(miss_aux) | set(cold_aux) | set(evict_aux)
-        if touched or restore_aux:
+        evict_payload = self._apply_feed(
+            miss_aux, cold_aux, evict_aux, evict_meta
+        )
+        if restore_aux:
             tables = dict(self.state.tables)
             emb_state = dict(self.state.emb_state)
-            with span("ctx.apply_aux", groups=len(touched)):
-                for gname in sorted(touched):
-                    em = self._group_empties(gname)
-                    ev_rows = evict_aux.get(gname, em["rows"])
-                    m_rows, m_entries = miss_aux.get(
-                        gname, (em["rows"], em["entries"])
-                    )
-                    c_rows, c_emb = cold_aux.get(gname, (em["rows"], em["emb"]))
-                    ring_pos = -1
-                    if evict_meta and gname in evict_meta:
-                        ring_pos = evict_meta[gname][2]
-                    if ring_pos >= 0:
-                        (tables[gname], emb_state[gname],
-                         self._ev_rings[gname], payload) = _apply_aux_ring(
-                            tables[gname], emb_state[gname],
-                            self._ev_ring(gname), jnp.int32(ring_pos),
-                            ev_rows, m_rows, m_entries, c_rows, c_emb,
-                            self._state_consts, self._wb_bf16,
-                        )
-                    else:
-                        tables[gname], emb_state[gname], payload = _apply_aux(
-                            tables[gname], emb_state[gname], ev_rows,
-                            m_rows, m_entries, c_rows, c_emb,
-                            self._state_consts, self._wb_bf16,
-                        )
-                    if gname in evict_aux:
-                        evict_payload[gname] = payload
             n_restores = sum(len(r) for r in restore_aux.values())
             with span("ctx.restores", n=n_restores):
                 for gname, restores in restore_aux.items():
@@ -618,6 +649,33 @@ class CachedTrainCtx:
         self.state = state
         self._ev_rings.update(rings_out)
         return headers, payloads
+
+    # -------------------------------------------- pipelined (dense-only)
+
+    def _dispatch_dense(self, device_inputs, layout):
+        """DENSE stage of a pipelined step: the feed was already
+        dispatched from the stager thread (``_apply_feed``), so only the
+        main train program runs here. Caller holds ``_state_lock``."""
+        with span("ctx.main_step"):
+            self.state, header, _ps = self._step(
+                self.state, device_inputs, layout
+            )
+        return header
+
+    def _dispatch_packed_dense(self, items):
+        """Dispatch K feed-done steps as ONE dense-only K-step program.
+        Reuses ``_kstep_fn`` with empty per-step aux — its ``if aux:``
+        branch folds away at trace time, so the packed window carries no
+        aux leaves in the call pytree and no new program shape beyond the
+        (one-time) dense-only trace. ``items``: ``[(di, layout), ...]``
+        with one shared layout. Caller holds ``_state_lock``."""
+        layout = items[0][1]
+        steps = tuple((di, {}) for di, _lay in items)
+        state, _rings, headers, _payloads = self._kstep_fn()(
+            self.state, {}, steps, layout
+        )
+        self.state = state
+        return headers
 
     def stream_stats(self) -> Optional[Dict]:
         """Dispatch/feeder accounting of the most recent ``train_stream``:
@@ -864,6 +922,14 @@ class CachedTrainCtx:
         """Asynchronous pipelined stream training — see
         ``persia_tpu.embedding.hbm_cache.stream.run_train_stream``."""
         return run_train_stream(self, *args, **kwargs)
+
+    def register_stage_rebuild(self, fn) -> None:
+        """Register a fence-point stage-graph rebuild hook: ``fn(step)``
+        fires inside every subsequent stream's drained fence right after a
+        tier migration re-registered the groups (StageGraph.rebuild) —
+        the extension point for promoting a migrated group into
+        ``FusedTrainCtx`` proper (ROADMAP direction 1)."""
+        self._stage_rebuild_hooks.append(fn)
 
     def eval_batch(self, batch: PersiaBatch) -> np.ndarray:
         # eval misses consult the PS, so a deferred eviction must land first

@@ -54,6 +54,7 @@ def run_train_stream(
     fetch_final: bool = True,
     psgrad_batch: int = 8,
     dispatch_k: int = 4,
+    pipeline_depth: int = 1,
     snapshot_every: Optional[int] = None,
     job_state=None,
     start_step: int = 0,
@@ -139,6 +140,27 @@ def run_train_stream(
     and journal ids for a resumed stream
     (``train_stream(batches_from_F, start_step=F, ...)``).
 
+    ``pipeline_depth``: MPMD stage-graph pipelining
+    (persia_tpu/parallel/stage_graph.py). At depth >= 2 the step's FEED
+    stage (the fused aux scatters of ``_apply_feed``) dispatches from the
+    STAGER thread up to ``depth - 1`` steps ahead of its own dense stage,
+    so batch N+k's embedding feed rides under batch N's dense compute —
+    the source paper's bounded-staleness overlap expressed in the
+    dispatch layer, with the depth as the staleness knob. Bit-parity is
+    preserved (not approximated): a feed only hoists when its rows are
+    disjoint from every in-flight dense stage's trained rows (disjoint
+    scatters commute bitwise); a conflict stalls the feed
+    (``pipeline.stall``) until the dense stages retire. Steps the hazard
+    ledger already serializes — in-flight-eviction restores, PS-tier
+    forwards — enter the window as BARRIERS: they dispatch through the
+    full in-order path and no later feed hoists across them. Feed-done
+    steps pack into dense-only K-step windows (``min(dispatch_k, depth)``
+    wide, so a full pack never overruns the window); fences drain the
+    window before capture (``pipeline.drain``) so jobstate bit-parity
+    holds unchanged, and a post-migration fence fires the stage graph's
+    ``rebuild()`` hooks. ``on_metrics`` forces depth 1 (per-step header
+    sync), like ``dispatch_k``.
+
     ``sentinel`` + ``skip_steps`` (persia_tpu/health): an armed
     :class:`~persia_tpu.health.sentinel.StreamSentinel` digests each
     step's header one dispatch behind the newest in-flight step (the
@@ -155,6 +177,17 @@ def run_train_stream(
 
     if prefetch < 1:
         raise ValueError(f"prefetch must be >= 1, got {prefetch}")
+    if pipeline_depth < 1:
+        raise ValueError(f"pipeline_depth must be >= 1, got {pipeline_depth}")
+    from persia_tpu.parallel.stage_graph import StageGraph, feed_hazard_info
+
+    # on_metrics needs a per-step header sync, which serializes the
+    # stages anyway — force the in-order pipeline (same rule as dispatch_k)
+    PIPE = pipeline_depth > 1 and on_metrics is None
+    graph = StageGraph(pipeline_depth if PIPE else 1)
+    self._stage_graph = graph
+    for _hook in self._stage_rebuild_hooks:
+        graph.on_rebuild(_hook)
     job_mgr = None
     if job_state is not None:
         from persia_tpu.jobstate import coerce_manager
@@ -169,12 +202,15 @@ def run_train_stream(
     self._land_pending()  # do not mix with a sync-path deferred step
     cv = threading.Condition()
     stop = threading.Event()
-    staged_q: "_queue.Queue" = _queue.Queue(maxsize=prefetch)
+    # a pipelined stream needs the staged queue at least window-deep or
+    # the queue cap (not the depth knob) would bound the feed look-ahead
+    qcap = max(prefetch, graph.depth)
+    staged_q: "_queue.Queue" = _queue.Queue(maxsize=qcap)
     # bounds device-memory retention: at most ~(queue + one flush batch)
     # steps of eviction payloads (+ one psgrad batch) stay pinned in HBM
     # while the PS lags
     wb_q: "_queue.Queue" = _queue.Queue(
-        maxsize=max(1, wb_flush_steps) + prefetch + max(1, psgrad_batch)
+        maxsize=max(1, wb_flush_steps) + qcap + max(1, psgrad_batch)
     )
     SENTINEL = object()
     errors: List[BaseException] = []
@@ -272,6 +308,7 @@ def run_train_stream(
     stats = {
         "dispatch_k": max(1, int(dispatch_k)) if on_metrics is None else 1,
         "packs": 0, "packed_steps": 0, "single_steps": 0,
+        "pipelined_feeds": 0,
         "feeder_busy_s": 0.0, "wall_s": 0.0,
         "degraded_steps": 0, "degraded_lookup_frac_max": 0.0,
         "fences": 0, "quarantine_skips": 0,
@@ -424,9 +461,19 @@ def run_train_stream(
         finally:
             prep_q.put(SENTINEL)
 
+    def _pipe_abort() -> bool:
+        return stop.is_set() or bool(errors)
+
     def feeder_dp():
-        """Stage 2: async host→device staging, overlapped with stage 1's
-        preprocessing of the following batch."""
+        """Stage 2 — the FEED stage of the stage graph: async host→device
+        staging, and (pipeline_depth > 1) the feed-program dispatch
+        itself, hoisted above the not-yet-dispatched dense stages of
+        earlier steps. ``reserve_feed`` holds a feed back while its rows
+        collide with an in-flight dense stage (bit-parity by row
+        disjointness; stage_graph module docstring) or while the window
+        is at depth (the staleness bound). Restore/PS/pre-init steps
+        forward un-fed as window BARRIERS and keep the full in-order
+        dispatch path."""
         try:
             while True:
                 got = prep_q.get()
@@ -439,29 +486,69 @@ def run_train_stream(
                 seq, item, ps_item = got
                 (di, layout, miss_aux, cold_aux, restore_aux, evict_aux,
                  evict_meta) = item
-                with stage_span("stream.stage"):
-                    di, miss_aux, cold_aux, evict_aux = self._stage(
-                        di, miss_aux, cold_aux, evict_aux
-                    )
-                # restore index arrays must commit like every other aux
-                # input: on a mesh an uncommitted put lands on one
-                # device and _restore_rows would see incompatible
-                # devices against the replicated tables. Payloads stay
-                # untouched — None means "the group's standing eviction
-                # ring", resolved by the main thread at dispatch.
-                rep = self._replicated()
-                put = (
-                    jax.device_put if rep is None
-                    else (lambda a: jax.device_put(a, rep))
+                # self.state races only benignly here: the main thread
+                # sets it once (init_state at step 0); a stale None read
+                # just routes this step through the in-order barrier path
+                pipelinable = (
+                    PIPE and not restore_aux and ps_item is None
+                    and self.state is not None
                 )
-                restore_aux = {
-                    gn: [(p, put(src), put(dst)) for (p, src, dst) in lst]
-                    for gn, lst in restore_aux.items()
-                }
+                hazard = None
+                if pipelinable:
+                    # hazard sets come from the HOST arrays, before the
+                    # staging below turns them into device buffers
+                    hazard = feed_hazard_info(
+                        di, miss_aux, cold_aux, evict_aux,
+                        {n: g.name for n, g in self.tier._slot_group.items()},
+                    )
+                with graph.lane("feed"):
+                    with stage_span("stream.stage"):
+                        di, miss_aux, cold_aux, evict_aux = self._stage(
+                            di, miss_aux, cold_aux, evict_aux
+                        )
+                    # restore index arrays must commit like every other aux
+                    # input: on a mesh an uncommitted put lands on one
+                    # device and _restore_rows would see incompatible
+                    # devices against the replicated tables. Payloads stay
+                    # untouched — None means "the group's standing eviction
+                    # ring", resolved by the main thread at dispatch.
+                    rep = self._replicated()
+                    put = (
+                        jax.device_put if rep is None
+                        else (lambda a: jax.device_put(a, rep))
+                    )
+                    restore_aux = {
+                        gn: [(p, put(src), put(dst)) for (p, src, dst) in lst]
+                        for gn, lst in restore_aux.items()
+                    }
+                feed_done = False
+                feed_payload = None
+                if pipelinable:
+                    # stall time (reserve_feed) stays OUTSIDE the feed
+                    # lane so stage_overlap_frac measures work, not waits
+                    if not graph.reserve_feed(
+                        seq, hazard[0], hazard[1], should_abort=_pipe_abort
+                    ):
+                        return
+                    with graph.lane("feed"):
+                        with span("stream.feed_dispatch", step=seq):
+                            with self._state_lock:
+                                feed_payload = self._apply_feed(
+                                    miss_aux, cold_aux, evict_aux, evict_meta
+                                )
+                    feed_done = True
+                elif PIPE:
+                    if not graph.reserve_feed(
+                        seq, None, None, should_abort=_pipe_abort,
+                        barrier=True,
+                    ):
+                        if ps_item is not None:
+                            self.worker.abort_gradient(ps_item[0])
+                        return
                 if not _put(
                     staged_q,
                     (seq, di, layout, miss_aux, cold_aux, restore_aux,
-                     evict_aux, evict_meta, ps_item),
+                     evict_aux, evict_meta, ps_item, feed_done, feed_payload),
                 ):
                     if ps_item is not None:
                         self.worker.abort_gradient(ps_item[0])
@@ -482,8 +569,11 @@ def run_train_stream(
     def _flush_acc(acc) -> None:
         if not acc:
             return
-        with stage_span("stream.wb_flush", steps=len(acc)):
-            _flush_acc_inner(acc)
+        # the d2h return lane is the stage graph's third stage: eviction
+        # write-backs and PS gradient returns ride it
+        with graph.lane("psgrad", steps=len(acc)):
+            with stage_span("stream.wb_flush", steps=len(acc)):
+                _flush_acc_inner(acc)
 
     def _release_acc(acc) -> None:
         """ONE owner for the write-back accumulator's bookkeeping — used by
@@ -535,6 +625,12 @@ def run_train_stream(
             items.clear()
 
     def _flush_ps(ps_acc) -> None:
+        if not ps_acc:
+            return
+        with graph.lane("psgrad", steps=len(ps_acc)):
+            _flush_ps_inner(ps_acc)
+
+    def _flush_ps_inner(ps_acc) -> None:
         """Fetch the accumulated steps' packed ps-grad outputs
         CONCURRENTLY (d2h latency is shared), then apply to the worker
         in step order. On an apply failure, not-yet-applied refs are
@@ -545,8 +641,6 @@ def run_train_stream(
         gradient can never touch a sign an eviction wrote back; psgrad
         batches and eviction flushes proceed independently, each keeping
         its own concurrent-fetch batching."""
-        if not ps_acc:
-            return
         pool = self._fetch_pool()
 
         def fetch(it):
@@ -637,18 +731,25 @@ def run_train_stream(
 
     def _abort_drained(got) -> None:
         # a drained-but-never-applied item may carry a PS-tier forward
-        # ref: release its staleness slot + stashed layout
+        # ref: release its staleness slot + stashed layout. prep_q items
+        # are (seq, item, ps_item) 3-tuples; staged items carry ps_item
+        # at index 8 (the pipelined fields ride behind it)
+        if not (isinstance(got, tuple) and len(got) >= 3):
+            return
+        ps_item = got[8] if len(got) >= 9 else got[-1]
         if (
-            isinstance(got, tuple) and len(got) >= 3
-            and got[-1] is not None
-            and isinstance(got[-1], tuple) and len(got[-1]) == 4
+            ps_item is not None
+            and isinstance(ps_item, tuple) and len(ps_item) == 4
         ):
             try:
-                self.worker.abort_gradient(got[-1][0])
+                self.worker.abort_gradient(ps_item[0])
             except Exception:  # noqa: BLE001 — shutdown best-effort
                 pass
 
     K = stats["dispatch_k"]
+    # a full pack retires as ONE dense stage: cap it at the window depth
+    # so pack assembly never waits on feeds the window cannot admit
+    K_eff = min(K, graph.depth) if PIPE else K
     pack: List = []  # staged hazard-free items awaiting a K-step dispatch
     pack_sig: List = [None]
 
@@ -695,7 +796,13 @@ def run_train_stream(
                         self._fence_capture(job_mgr, gstep, occupancy)
                     stats["fences"] = stats.get("fences", 0) + 1
                     record_event("stream.fence_commit", step=gstep)
+                    n_mig = stats.get("migrations", 0)
                     _fence_migrate(gstep)
+                    if stats.get("migrations", 0) != n_mig:
+                        # the tier swap re-registered groups under the
+                        # stage programs: fire the fence-point stage-graph
+                        # rebuild hooks (window drained, feeder parked)
+                        graph.rebuild(gstep)
                 except BaseException as e:  # noqa: BLE001
                     errors.append(e)
         fence_done.set()
@@ -756,15 +863,27 @@ def run_train_stream(
     def _dispatch_one(item):
         nonlocal header
         (seq, di, layout, miss_aux, cold_aux, restore_aux, evict_aux,
-         evict_meta, ps_item) = item
+         evict_meta, ps_item, feed_done, feed_payload) = item
         try:
             if self.state is None:
                 self.init_state(jax.random.PRNGKey(0), di, layout)
-            with stage_span("stream.dispatch"):
-                header, evict_payload, ps_gpacked = self._dispatch(
-                    di, layout, miss_aux, cold_aux, restore_aux,
-                    evict_aux, evict_meta,
-                )
+            if feed_done:
+                # FEED already dispatched from the stager thread: dense
+                # stage only (the payload came back with the feed)
+                with graph.lane("dense"):
+                    with stage_span("stream.dispatch"):
+                        with self._state_lock:
+                            header = self._dispatch_dense(di, layout)
+                evict_payload, ps_gpacked = feed_payload, None
+                stats["pipelined_feeds"] += 1
+            else:
+                with graph.lane("dense"):
+                    with stage_span("stream.dispatch"):
+                        with self._state_lock:
+                            header, evict_payload, ps_gpacked = self._dispatch(
+                                di, layout, miss_aux, cold_aux, restore_aux,
+                                evict_aux, evict_meta,
+                            )
         except BaseException:
             # the in-hand item is already off the queue: the shutdown
             # drain in finally can't see it, so its staleness ref must
@@ -775,6 +894,8 @@ def run_train_stream(
                 except Exception:  # noqa: BLE001 — shutdown best-effort
                     pass
             raise
+        if PIPE:
+            graph.note_dense(seq)
         stats["single_steps"] += 1
         if ps_item is not None:
             # gradient return for PS-tier slots rides the write-back
@@ -805,7 +926,7 @@ def run_train_stream(
         a single step's shapes × K — the same cardinality as the
         single-step cache, not its K-th power."""
         (_seq, di, layout, miss_aux, cold_aux, _restore, evict_aux,
-         evict_meta, _ps) = item
+         evict_meta, _ps, _fd, _fp) = item
 
         def aux_sig(d):
             return tuple(sorted(
@@ -830,6 +951,25 @@ def run_train_stream(
             and item[8] is None      # ps_item
         )
 
+    def _dense_sig(item):
+        """Signature of a feed-done step's DENSE stage: the feed's aux is
+        out of the program, so only the model-input shapes key the
+        dense-only K-step jit cache."""
+        (_seq, di, layout) = item[:3]
+        return (
+            layout,
+            tuple(sorted(
+                (k, tuple(np.shape(v)))
+                for k, v in di["stacked_rows"].items()
+            )),
+            tuple(sorted(
+                (k, tuple(np.shape(v)))
+                for k, v in di.get("raw_rows", {}).items()
+            )),
+            tuple(np.shape(x) for x in di["labels"]),
+            "stacked_scale" in di,
+        )
+
     def _flush_pack_single():
         """Dispatch buffered items through the single-step path (partial
         pack, signature change, or shutdown): reuses already-compiled
@@ -840,15 +980,40 @@ def run_train_stream(
 
     def _dispatch_pack():
         nonlocal header
-        with stage_span("stream.dispatch_pack", k=len(pack)):
-            headers, payloads = self._dispatch_packed(
-                [(it[1], it[2], it[3], it[4], it[6], it[7]) for it in pack]
-            )
+        with graph.lane("dense"):
+            with stage_span("stream.dispatch_pack", k=len(pack)):
+                headers, payloads = self._dispatch_packed(
+                    [(it[1], it[2], it[3], it[4], it[6], it[7]) for it in pack]
+                )
         header = headers[-1]
         stats["packs"] += 1
         stats["packed_steps"] += len(pack)
         for it, payload in zip(pack, payloads):
             _post_step(it[0], it[1], it[7], payload)
+        for it, h in zip(pack, headers):
+            sentinel_note(
+                sentinel, sent_pending, start_step + it[0], h,
+                int(np.prod(it[1]["labels"][0].shape)),
+            )
+        pack.clear()
+
+    def _dispatch_pack_dense():
+        """One dense-only K-step dispatch over feed-done items — a packed
+        window is ONE dense stage of the graph."""
+        nonlocal header
+        with graph.lane("dense"):
+            with stage_span("stream.dispatch_pack", k=len(pack)):
+                with self._state_lock:
+                    headers = self._dispatch_packed_dense(
+                        [(it[1], it[2]) for it in pack]
+                    )
+        header = headers[-1]
+        stats["packs"] += 1
+        stats["packed_steps"] += len(pack)
+        stats["pipelined_feeds"] += len(pack)
+        graph.note_dense(pack[-1][0])
+        for it in pack:
+            _post_step(it[0], it[1], it[7], it[10])
         for it, h in zip(pack, headers):
             sentinel_note(
                 sentinel, sent_pending, start_step + it[0], h,
@@ -872,6 +1037,9 @@ def run_train_stream(
             if item is SENTINEL:
                 _flush_pack_single()
                 sentinel_drain(sentinel, sent_pending)
+                if not errors:
+                    # end-of-stream drain: every feed's dense retired
+                    graph.drain_for_fence(self._global_step, reason="end")
                 break
             if errors:
                 # buffered pack items carry no PS refs (_packable) — drop
@@ -883,9 +1051,22 @@ def run_train_stream(
                 # the sentinel must digest every pre-fence header BEFORE
                 # the capture: a poisoned step must never become LAST_GOOD
                 sentinel_drain(sentinel, sent_pending)
+                # feeder parked + FIFO => the window is empty here; the
+                # drain is asserted + recorded before the capture reads
+                graph.drain_for_fence(item[1])
                 _run_fence(item[1])
                 continue
-            if K > 1 and _packable(item):
+            if PIPE and K_eff > 1 and item[9]:  # feed_done: dense-only pack
+                sig = _dense_sig(item)
+                if pack and sig != pack_sig[0]:
+                    _flush_pack_single()
+                if not pack:
+                    pack_sig[0] = sig
+                pack.append(item)
+                if len(pack) == K_eff:
+                    _dispatch_pack_dense()
+                continue
+            if K > 1 and not PIPE and _packable(item):
                 sig = _item_sig(item)
                 if pack and sig != pack_sig[0]:
                     _flush_pack_single()
@@ -918,8 +1099,10 @@ def run_train_stream(
             }
         except Exception:  # noqa: BLE001 — stats are best-effort at teardown
             pass
+        stats.update(graph.stats(stats["wall_s"]))
         self._stream_stats = stats
         stop.set()
+        graph.abort()  # unparks a stager blocked in reserve_feed
         with cv:
             cv.notify_all()
 

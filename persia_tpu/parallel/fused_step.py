@@ -455,9 +455,14 @@ def build_fused_multi_step(
     bounds small-step-time loops (and dominates on a remote-attached chip,
     where every dispatch pays tunnel latency) is paid once per K steps; the
     math is the single-step program iterated, so parity with
-    ``build_fused_train_step`` is exact. The cached tier's stream applies
-    the same idea to its hazard-free windows (hbm_cache/stream.py
-    ``dispatch_k``)."""
+    ``build_fused_train_step`` is exact in program terms — but NOT bitwise:
+    XLA compiles the step subgraph differently inside the larger program
+    (cross-step/cluster fusion reorders float ops at the ~1 ulp level, and
+    ``optimization_barrier`` between steps does not recover the standalone
+    bits). Callers needing bit parity with the single-step loop must use
+    k=1. The cached tier's stream applies the same idea to its hazard-free
+    windows (hbm_cache/stream.py ``dispatch_k``) — there the K program IS
+    bit-exact (pinned by test_stream_kstep_packing_bitwise_parity)."""
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
     raw = build_fused_train_step(
@@ -543,3 +548,154 @@ def unpack_ids(flat_dev: jnp.ndarray, slot_order: Sequence[str], shapes) -> Dict
         out[name] = jax.lax.slice(flat_dev, (off,), (off + k,)).reshape(shape)
         off += k
     return out
+
+
+class FusedPipeline:
+    """Stage-pipelined driver for the fused tier: a feeder thread runs the
+    FEED stage (host batch conversion + h2d staging, double-buffered up to
+    ``depth`` in flight) while the caller's thread runs the DENSE stage
+    (the jitted single- or K-step program). Every table row is HBM-resident
+    and the sparse update is fused INTO the dense program, so there are no
+    feed/gradient hazards to ledger — the stage graph's window only bounds
+    how many staged batches (and therefore how much staging HBM) ride
+    ahead of the dense stage. Batches enter the program in stream order,
+    so with ``k == 1`` the result is the sequential ``step`` loop's bit
+    for bit (pinned by test_stage_graph.py); ``k > 1`` packs the dense
+    stage via ``build_fused_multi_step``, whose parity is numerical, not
+    bitwise (see its docstring) — same trade as calling that program
+    directly.
+
+    ``run`` drains the window before returning — callers may checkpoint
+    (``FusedTrainCtx.dump_checkpoint``) immediately after with fence
+    semantics. The cached tier's ``train_stream(pipeline_depth=...)``
+    applies the same stage graph WITH the hazard ledger (rows there are
+    cache slots that feeds mutate); see parallel/stage_graph.py.
+    """
+
+    def __init__(self, step, multi=None, depth: int = 2, k: int = 1):
+        from persia_tpu.parallel.stage_graph import StageGraph
+
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        if k > 1 and multi is None:
+            raise ValueError("k > 1 needs the multi-step program")
+        self._step = step
+        self._multi = multi
+        self.depth = int(depth)
+        # a full pack must fit in the window or feed and dense deadlock
+        # waiting on each other
+        self.k = max(1, min(int(k), self.depth))
+        self.graph = StageGraph(self.depth)
+
+    def run(self, state, batches, stage=None):
+        """Drive ``batches`` (iterable of fused batch dicts — or anything
+        ``stage`` maps to one) through the pipeline. The iterable is
+        consumed by the FEED thread, so host-side conversion inside a
+        generator rides the feed lane too. Returns ``(state, losses)``
+        with ``losses`` the per-step device scalars in stream order;
+        :meth:`stats` reports overlap after the run."""
+        import queue as _queue
+        import threading
+        import time as _time
+
+        stage = jax.device_put if stage is None else stage
+        graph = self.graph
+        q: "_queue.Queue" = _queue.Queue(maxsize=self.depth)
+        errors: List[BaseException] = []
+        SENTINEL = object()
+
+        def feeder():
+            try:
+                for seq, b in enumerate(batches):
+                    if errors:
+                        break
+                    # no hazard rows: empty feed/trained sets, the window
+                    # acts purely as the staging-buffer bound
+                    if not graph.reserve_feed(
+                        seq, {}, {}, should_abort=lambda: bool(errors)
+                    ):
+                        break
+                    with graph.lane("feed"):
+                        staged = stage(b)
+                    q.put((seq, staged))
+            except BaseException as e:  # noqa: BLE001 — reraised on the caller
+                errors.append(e)
+            finally:
+                q.put(SENTINEL)
+
+        t0 = _time.perf_counter()
+        th = threading.Thread(target=feeder, name="fused-pipe-feeder", daemon=True)
+        th.start()
+        losses: List[jnp.ndarray] = []
+        pack: List[Tuple[int, Dict]] = []
+        n_seen = 0
+        try:
+            def flush():
+                nonlocal state
+                if not pack:
+                    return
+                if len(pack) > 1:
+                    with self.graph.lane("dense", k=len(pack)):
+                        state, (ls, _preds) = self._multi(
+                            state, tuple(b for _, b in pack)
+                        )
+                    losses.extend(ls[i] for i in range(len(pack)))
+                else:
+                    with self.graph.lane("dense"):
+                        state, (loss, _preds) = self._step(state, pack[0][1])
+                    losses.append(loss)
+                graph.note_dense(pack[-1][0])
+                pack.clear()
+
+            while True:
+                item = q.get()
+                if item is SENTINEL:
+                    break
+                pack.append(item)
+                n_seen += 1
+                if len(pack) >= self.k:
+                    flush()
+            flush()
+            if errors:
+                raise errors[0]
+            graph.drain_for_fence(n_seen, reason="end")
+        finally:
+            graph.abort()
+            th.join(timeout=5.0)
+        self._wall_s = _time.perf_counter() - t0
+        return state, losses
+
+    def stats(self) -> Dict:
+        """Pipeline stats of the last :meth:`run` (stage_graph stats dict
+        plus the run's wall seconds)."""
+        out = self.graph.stats(getattr(self, "_wall_s", 0.0))
+        out["wall_s"] = round(getattr(self, "_wall_s", 0.0), 6)
+        return out
+
+
+def build_fused_pipeline(
+    model,
+    dense_optimizer: optax.GradientTransformation,
+    sparse_cfg: OptimizerConfig,
+    specs: Dict[str, FusedSlotSpec],
+    slot_order: Optional[Sequence[str]] = None,
+    loss_fn=default_loss_fn,
+    stack: bool = False,
+    depth: int = 2,
+    k: int = 1,
+) -> FusedPipeline:
+    """Convenience factory: builds the jitted single-step (and, when
+    ``k > 1``, the K-step) program and wraps them in a
+    :class:`FusedPipeline`. Reuse the returned pipeline across runs — each
+    factory call retraces."""
+    step = build_fused_train_step(
+        model, dense_optimizer, sparse_cfg, specs, slot_order,
+        loss_fn=loss_fn, stack=stack,
+    )
+    multi = None
+    if k > 1:
+        multi = build_fused_multi_step(
+            model, dense_optimizer, sparse_cfg, specs, min(k, depth),
+            slot_order, loss_fn=loss_fn, stack=stack,
+        )
+    return FusedPipeline(step, multi, depth=depth, k=k)

@@ -78,6 +78,11 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_failure_recove
     --deselect tests/test_tiering.py::test_fence_manifest_carries_tiering_component \
     --deselect tests/test_health.py::test_poisoned_stream_rollback_bit_parity \
     --deselect tests/test_health.py::test_on_device_nonfinite_skip_rung
+# stage-graph fast subset: the pipeline's hazard/window/drain/rebuild unit
+# tests (test_unit_*; sub-second, no jit). The multi-second pipelined-stream
+# bit-parity runs (depth A/B, fence+migration, kill/resume) ride the full
+# suite in step 2.
+JAX_PLATFORMS=cpu python -m pytest tests/test_stage_graph.py -q -m 'not slow' -k "unit"
 
 echo "== 1.5/5 telemetry plane (trace propagation + flight recorder) =="
 # the fast tracing/telemetry subset: span mechanics, RPC + gateway HTTP
