@@ -205,7 +205,7 @@ def bench_fused():
             state, loss = multi(state, ids_t, dl_t)
         loss.block_until_ready()
         elapsed = time.perf_counter() - t0
-        return steps_run * BATCH_SIZE / elapsed
+        return _fused_record(steps_run * BATCH_SIZE / elapsed, k=K)
 
     for i in range(WARMUP_STEPS):
         ids, dl = host_batches[i % len(host_batches)]
@@ -218,7 +218,20 @@ def bench_fused():
         state, (loss, _) = step(state, jnp.asarray(ids), jnp.asarray(dl))
     loss.block_until_ready()
     elapsed = time.perf_counter() - t0
-    return MEASURE_STEPS * BATCH_SIZE / elapsed
+    return _fused_record(MEASURE_STEPS * BATCH_SIZE / elapsed, k=1)
+
+
+def _fused_record(samples_per_sec: float, k: int) -> dict:
+    """The fused-tier mode record: like _stream_record, it carries the
+    dense-plane sync fields — "local"/0 by construction (one device, one
+    program), but stated explicitly so fused/stream/hybrid rows compare on
+    the same vocabulary instead of by omission."""
+    return {
+        "samples_per_sec": round(samples_per_sec, 1),
+        "dispatch_mode": f"fused-k{k}" if k > 1 else "fused",
+        "sync_mode": "local",
+        "dense_wire_bytes_per_step": 0,
+    }
 
 
 def bench_link():
@@ -383,6 +396,13 @@ def _stream_record(ctx, samples_per_sec: float) -> dict:
         "tiers": st.get("tiers"),
         "migrations": st.get("migrations", 0),
         "cache_hit_rate": _cache_hit_rate(),
+        # dense-plane sync accounting (grad_sync mode vocabulary): which
+        # collective the dense half rode and its modeled bytes/step — the
+        # baseline the block-int8-ring WIRE_BENCH rows are priced against
+        "sync_mode": st.get("sync_mode", ctx.sync_mode),
+        "dense_wire_bytes_per_step": st.get(
+            "dense_wire_bytes_per_step", ctx.dense_wire_bytes_per_step()
+        ),
     }
     if depth > 1:
         # stage-pipeline accounting: per-stage wall + the overlap fraction

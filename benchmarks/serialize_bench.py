@@ -216,6 +216,83 @@ def bench_psgrad_wire(batch_size=4096, n_slots=26, dim=16,
     return out
 
 
+def bench_allreduce_wire(n=8, block_size=256, reps=200) -> list:
+    """Dense-plane allreduce wire cost per step per replica, priced by
+    grad_sync.dense_sync_wire_bytes (the SAME model bench.py and the
+    telemetry counters use) at the bench DLRM's dense shape — f32 /
+    bytegrad / block-int8-ring and the ZeRO-style sharded variants.
+
+    The honest line this table exists for: "bytegrad" quantizes at the
+    endpoints but XLA's psum carries int8 summands AS INT32, so its wire is
+    f32-width — only the explicit block-scaled ring actually moves ~1
+    byte/elem. Host rows also time the per-chunk numpy block
+    quantize/dequantize (the work each ring hop adds), priced on one
+    chunk = P/n rounded to the block multiple."""
+    import jax
+    import optax
+
+    from persia_tpu.models import DLRM
+    from persia_tpu.parallel.grad_sync import (
+        dense_param_count,
+        dense_sync_wire_bytes,
+    )
+    from persia_tpu.parallel.train_step import init_train_state
+
+    # the throughput bench's exact dense shape (bench.py bench_fused)
+    rng = np.random.default_rng(11)
+    batch = {
+        "dense": [rng.normal(size=(32, 13)).astype(np.float32)],
+        "labels": [rng.integers(0, 2, (32, 1)).astype(np.float32)],
+        "emb": [
+            {"pooled": rng.normal(size=(32, 16)).astype(np.float32)}
+            for _ in range(26)
+        ],
+    }
+    model = DLRM(embedding_dim=16, bottom_mlp=(256, 64, 16), top_mlp=(512, 256))
+    state = init_train_state(model, jax.random.PRNGKey(0), batch, optax.sgd(0.1))
+    p = dense_param_count(state.params)
+
+    out = []
+    f32 = dense_sync_wire_bytes("f32", p, n)
+    for mode in (
+        "f32", "bf16", "bytegrad", "block-int8-ring",
+        "f32-sharded", "block-int8-ring-sharded",
+    ):
+        nb = dense_sync_wire_bytes(mode, p, n, block_size=block_size)
+        out.append({
+            "case": f"allreduce_wire_{mode}",
+            "wire_bytes_per_step_per_replica": int(nb),
+            "vs_f32": round(f32 / nb, 2) if nb else None,
+            "dense_params": int(p),
+            "n": n,
+            "block_size": block_size,
+        })
+
+    # per-hop host-side cost proxy: block quantize + dequantize of one
+    # ring chunk (on TPU this runs fused on-device; the numpy timing bounds
+    # the arithmetic the wire saving buys back)
+    chunk = (-(-p // n) + block_size - 1) // block_size * block_size
+    v = rng.normal(size=chunk).astype(np.float32)
+
+    def qdq():
+        b = v.reshape(-1, block_size)
+        s = np.maximum(np.abs(b).max(axis=1), 1e-30)
+        q = np.clip(np.round(b / s[:, None] * 127.0), -127, 127).astype(np.int8)
+        return q.astype(np.float32) * (s[:, None] / np.float32(127.0))
+
+    for _ in range(5):
+        qdq()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        qdq()
+    out.append({
+        "case": "allreduce_block_int8_chunk_qdq",
+        "chunk_elems": int(chunk),
+        "host_qdq_us": round((time.perf_counter() - t0) / reps * 1e6, 1),
+    })
+    return out
+
+
 def main() -> None:
     for name, batch in (
         ("infer_single_id_128x16", _single_id_batch()),
@@ -226,6 +303,8 @@ def main() -> None:
     for row in bench_ps_wire():
         print(json.dumps(row))
     for row in bench_psgrad_wire():
+        print(json.dumps(row))
+    for row in bench_allreduce_wire():
         print(json.dumps(row))
 
 

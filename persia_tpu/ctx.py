@@ -243,6 +243,8 @@ class TrainCtx(EmbeddingCtx):
         loss_scale_growth_interval: int = 2000,
         loss_scale_max: float = float(2 ** 24),
         resilience_policy=None,
+        dense_sync: Optional[str] = None,
+        dense_sync_block_size: int = 256,
     ):
         super().__init__(worker, embedding_config, mesh=mesh, wire_dtype=wire_dtype)
         self.model = model
@@ -278,13 +280,119 @@ class TrainCtx(EmbeddingCtx):
             max_scale=loss_scale_max,
             **kwargs,
         )
+        # explicit dense-plane sync mode (persia_tpu.parallel.grad_sync
+        # DENSE_SYNC_MODES): None keeps the default implicit-psum path; a
+        # mode string swaps the jitted step for build_sync_train_step's
+        # explicit-collective step (quantized ring and/or ZeRO-style sharded
+        # optimizer update). The bytegrad mode's error-feedback residual is
+        # carried on the ctx (not in TrainState), so it does NOT survive a
+        # jobstate resume — ring modes carry theirs inside opt_state and do.
+        self.dense_sync = dense_sync
+        self.dense_sync_block_size = int(dense_sync_block_size)
+        self._sync_step = None
+        self._sync_algorithm = None
+        self._sync_sharded = False
+        self._sync_wrapped = False
+        self._sync_residual = None
+        self._dense_wire_bytes_per_step = 0
+        self._wire_counter = None
+        if dense_sync is not None:
+            if mesh is None:
+                raise ValueError("dense_sync requires a device mesh")
+            if dynamic_loss_scale:
+                raise ValueError(
+                    "dense_sync and dynamic_loss_scale are mutually "
+                    "exclusive: the explicit-collective step has no "
+                    "loss-scale path"
+                )
+            from persia_tpu.parallel.grad_sync import (
+                BlockInt8Ring,
+                build_sync_train_step,
+                sync_mode_algorithm,
+            )
+
+            algo, sharded = sync_mode_algorithm(
+                dense_sync, block_size=self.dense_sync_block_size
+            )
+            self._sync_algorithm = algo
+            self._sync_sharded = sharded
+            self._sync_wrapped = sharded or isinstance(algo, BlockInt8Ring)
+            self._sync_step = build_sync_train_step(
+                model, dense_optimizer, mesh, algo,
+                sharded_update=sharded, **kwargs,
+            )
         self._eval_step = build_eval_step(model)
         self.state: Optional[TrainState] = None
+
+    @property
+    def sync_mode(self) -> str:
+        """The dense-plane sync mode label this ctx runs (and records):
+        an explicit ``dense_sync`` mode, else "implicit-psum" on a real DP
+        mesh, else "local"."""
+        if self.dense_sync is not None:
+            return self.dense_sync
+        if self.mesh is not None and int(self.mesh.shape["data"]) > 1:
+            return "implicit-psum"
+        return "local"
+
+    def dense_wire_bytes_per_step(self) -> int:
+        """Modeled per-replica dense collective bytes per step for this
+        ctx's sync mode (0 before state init — the param count prices it)."""
+        return self._dense_wire_bytes_per_step
+
+    def _note_dense_sync(self, state) -> None:
+        """Price the per-step dense collective once (param count is known
+        after state init) so the hot path only adds a python-int counter
+        bump — no host syncs (persia-lint JAX001)."""
+        from persia_tpu.metrics import get_metrics
+        from persia_tpu.parallel.grad_sync import (
+            dense_param_count,
+            dense_sync_wire_bytes,
+        )
+
+        n = int(self.mesh.shape["data"]) if self.mesh is not None else 1
+        self._dense_wire_bytes_per_step = dense_sync_wire_bytes(
+            self.sync_mode, dense_param_count(state.params), n,
+            block_size=self.dense_sync_block_size,
+        )
+        self._wire_counter = get_metrics().counter(
+            "persia_tpu_dense_wire_bytes",
+            "modeled dense-plane collective bytes dispatched, by sync mode",
+        )
+
+    def _run_dense_step(self, state, device_batch):
+        """Dispatch one jitted dense step through the selected sync mode.
+
+        Explicit modes get a sync-stage span on the dispatch edge; every
+        mode (implicit-psum included) bumps the wire-bytes counter with the
+        precomputed per-step cost. The default path stays exactly
+        ``self._train_step_jit`` — zero new overhead when ``dense_sync`` is
+        unset and the mesh is single-device."""
+        if self._sync_step is not None:
+            from persia_tpu import tracing
+
+            with tracing.span(
+                "train.dense_sync", mode=self.dense_sync,
+                wire_bytes=self._dense_wire_bytes_per_step,
+            ):
+                if self._sync_residual is not None:
+                    state, out, self._sync_residual = self._sync_step(
+                        state, device_batch, self._sync_residual
+                    )
+                else:
+                    state, out = self._sync_step(state, device_batch)
+        else:
+            state, out = self._train_step_jit(state, device_batch)
+        if self._wire_counter is not None and self._dense_wire_bytes_per_step:
+            self._wire_counter.inc(
+                self._dense_wire_bytes_per_step, mode=self.sync_mode
+            )
+        return state, out
 
     def _train_step(self, state, device_batch):
         """Run the jitted step and unpack its single-transfer output into the
         (state, metrics, emb_grads) host view."""
-        state, (header, gpacked) = self._train_step_jit(state, device_batch)
+        state, (header, gpacked) = self._run_dense_step(state, device_batch)
         if self.dynamic_loss_scale:
             loss, preds, scale, finite = unpack_step_header_dynamic(
                 np.asarray(header), device_batch
@@ -310,6 +418,19 @@ class TrainCtx(EmbeddingCtx):
             self.model, rng, sample_batch, self.dense_optimizer,
             loss_scale_init=self._loss_scale_init,
         )
+        if self._sync_wrapped:
+            # ring/sharded modes carry opt state in the init_sync_opt_state
+            # wrapper (sharded moments + EF residual). Swap the template in
+            # BEFORE the deferred overlay so a restored manifest's sharded
+            # opt state lands on matching shapes.
+            from persia_tpu.parallel.grad_sync import init_sync_opt_state
+
+            state = state.replace(
+                opt_state=init_sync_opt_state(
+                    state.params, self.dense_optimizer, self.mesh,
+                    self._sync_algorithm, self._sync_sharded,
+                )
+            )
         if self._resume_state_bytes is not None:
             # deferred resume: the manifest's dense/opt state overlays the
             # freshly initialized template (same model + optimizer shapes)
@@ -320,9 +441,26 @@ class TrainCtx(EmbeddingCtx):
             )
             self._resume_state_bytes = None
         if self.mesh is not None:
-            state = replicate_state(state, self.mesh)
+            state = self._place_state(state)
         self.state = state
+        if self._sync_residual is None and self.dense_sync == "bytegrad":
+            from persia_tpu.parallel.grad_sync import init_residual
+
+            self._sync_residual = init_residual(state.params)
+        self._note_dense_sync(state)
         return state
+
+    def _place_state(self, state: TrainState) -> TrainState:
+        """Mesh placement for a (possibly host-resident) TrainState: the
+        sync wrapper's lead-axis leaves shard over ``data``, everything else
+        replicates."""
+        if self._sync_wrapped:
+            from persia_tpu.parallel.grad_sync import place_sync_state
+
+            return place_sync_state(
+                state, self.mesh, self._sync_algorithm, self._sync_sharded
+            )
+        return replicate_state(state, self.mesh)
 
     # -------------------------------------------------- crash-consistent jobs
 
@@ -410,7 +548,7 @@ class TrainCtx(EmbeddingCtx):
                     self.state, self._resume_state_bytes
                 )
                 if self.mesh is not None:
-                    self.state = replicate_state(self.state, self.mesh)
+                    self.state = self._place_state(self.state)
                 self._resume_state_bytes = None
         router = getattr(self.worker, "lookup_router", None)
         if router is not None:
@@ -495,7 +633,7 @@ class TrainCtx(EmbeddingCtx):
         if not defer:
             self._deferred_header = None  # this step's metrics are fresher
         try:
-            self.state, (header, gpacked) = self._train_step_jit(self.state, device_batch)
+            self.state, (header, gpacked) = self._run_dense_step(self.state, device_batch)
             # start the bulk gradient download without blocking; the
             # BackwardEngine thread materializes it, so the device→host
             # transfer overlaps the next step instead of serializing with it

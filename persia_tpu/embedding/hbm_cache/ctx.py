@@ -680,9 +680,39 @@ class CachedTrainCtx:
     def stream_stats(self) -> Optional[Dict]:
         """Dispatch/feeder accounting of the most recent ``train_stream``:
         ``dispatch_k``, ``packs``, ``packed_steps``, ``single_steps``,
-        ``feeder_busy_s``, ``wall_s`` — the artifact fields bench.py
-        commits so hot-loop regressions are visible from the JSON alone."""
+        ``feeder_busy_s``, ``wall_s``, plus the dense-plane sync record
+        (``sync_mode``, ``dense_wire_bytes_per_step``) — the artifact
+        fields bench.py commits so hot-loop regressions are visible from
+        the JSON alone."""
         return self._stream_stats
+
+    @property
+    def sync_mode(self) -> str:
+        """Dense-plane sync label for records: the cached tier's dense half
+        rides XLA's implicit psum on a DP mesh ("implicit-psum"), or no
+        collective at all on one device ("local"). The explicit quantized /
+        sharded modes live on the hybrid TrainCtx (``dense_sync=``); this
+        property keeps the vocabulary shared so bench rows compare."""
+        if self.mesh is not None and int(self.mesh.shape["data"]) > 1:
+            return "implicit-psum"
+        return "local"
+
+    def dense_wire_bytes_per_step(self) -> int:
+        """Modeled per-replica dense collective bytes/step
+        (grad_sync.dense_sync_wire_bytes over the live dense param count);
+        0 before state init or off-mesh."""
+        if self.state is None or self.mesh is None:
+            return 0
+        from persia_tpu.parallel.grad_sync import (
+            dense_param_count,
+            dense_sync_wire_bytes,
+        )
+
+        return dense_sync_wire_bytes(
+            self.sync_mode,
+            dense_param_count(self.state.params),
+            int(self.mesh.shape["data"]),
+        )
 
     def _ps_forward(self, batch: PersiaBatch):
         """Forward the PS-tier slot subset through the worker's forward-ref

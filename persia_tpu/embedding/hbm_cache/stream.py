@@ -1099,6 +1099,12 @@ def run_train_stream(
             }
         except Exception:  # noqa: BLE001 — stats are best-effort at teardown
             pass
+        # dense-plane sync accounting (grad_sync.dense_sync_wire_bytes):
+        # the cached tier's dense half rides XLA's implicit psum, so the
+        # record carries the modeled f32-allreduce cost — the honest
+        # baseline the explicit block-int8 ring modes are priced against
+        stats["sync_mode"] = self.sync_mode
+        stats["dense_wire_bytes_per_step"] = self.dense_wire_bytes_per_step()
         stats.update(graph.stats(stats["wall_s"]))
         self._stream_stats = stats
         stop.set()

@@ -224,6 +224,18 @@ class FusedTrainCtx:
         """Stage/overlap stats of the last :meth:`train_pipelined` run."""
         return self._pipe_stats
 
+    @property
+    def sync_mode(self) -> str:
+        """Dense-plane sync label for bench records: the fused tier is one
+        device, one program — no dense collective crosses any wire. Shares
+        the grad_sync mode vocabulary so fused/stream/hybrid rows compare."""
+        return "local"
+
+    def dense_wire_bytes_per_step(self) -> int:
+        """Per-replica dense collective bytes/step: 0 by construction (the
+        whole hybrid step is one single-device XLA program)."""
+        return 0
+
     def last_metrics(self) -> Optional[Dict]:
         if getattr(self, "_last", None) is None:
             return None

@@ -83,6 +83,14 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_failure_recove
 # bit-parity runs (depth A/B, fence+migration, kill/resume) ride the full
 # suite in step 2.
 JAX_PLATFORMS=cpu python -m pytest tests/test_stage_graph.py -q -m 'not slow' -k "unit"
+# dense-plane sync fast subset (ISSUE 13): quantizer edge cases, the
+# block-int8 ring's exact-mean/EF/replica-parity gates, sharded-update
+# parity + ~1/n memory, the mode registry/wire model, and the TrainCtx
+# mode plumbing incl. the sharded jobstate kill/resume bit-parity run.
+# The n=32/64 forced-device-count dp-invariance subprocesses ride slow.
+JAX_PLATFORMS=cpu python -m pytest tests/test_dense_sync.py -q -m 'not slow'
+JAX_PLATFORMS=cpu python -m pytest tests/test_grad_sync.py -q -m 'not slow' \
+    -k "block_int8 or sharded or quantize or sync_mode"
 
 echo "== 1.5/5 telemetry plane (trace propagation + flight recorder) =="
 # the fast tracing/telemetry subset: span mechanics, RPC + gateway HTTP

@@ -432,3 +432,298 @@ def test_qadam_rejects_zero_warmup():
     with pytest.raises(ValueError, match="warmup_steps"):
         QAdam(warmup_steps=-3)
     QAdam(warmup_steps=1)  # minimum valid
+
+
+# ------------------------------------------------ block-int8 ring (ISSUE 13)
+
+
+def _ring_state(model, hb, opt, mesh, algorithm, sharded=False):
+    from persia_tpu.parallel.grad_sync import (
+        init_sync_opt_state,
+        place_sync_state,
+    )
+
+    state = _init(model, hb, opt)
+    state = state.replace(
+        opt_state=init_sync_opt_state(
+            state.params, opt, mesh, algorithm, sharded_update=sharded
+        )
+    )
+    return place_sync_state(state, mesh, algorithm, sharded_update=sharded)
+
+
+def test_quantize_int8_ef_all_zero_block_no_nan():
+    """An all-zero gradient (dead layer, first step) must quantize to zeros
+    without NaN/inf — the absmax scale is clamped, not divided by zero."""
+    from persia_tpu.parallel.grad_sync import (
+        block_quantize_int8,
+        quantize_int8_ef,
+    )
+
+    g = jnp.zeros((64,), jnp.float32)
+    q, scale, deq, res = quantize_int8_ef(g, jnp.zeros_like(g))
+    for a in (scale, deq, res):
+        assert np.isfinite(np.asarray(a)).all()
+    assert not np.asarray(q).any() and not np.asarray(deq).any()
+
+    qb, scales, deqb = block_quantize_int8(g, 32)
+    assert np.isfinite(np.asarray(scales)).all()
+    assert not np.asarray(qb).any() and not np.asarray(deqb).any()
+
+
+def test_quantize_int8_ef_residual_dtype_under_bf16():
+    """bf16 gradients must not poison the error-feedback state: the residual
+    (and dequantized value) stay f32 so sub-bf16 rounding error accumulates
+    instead of being re-rounded away."""
+    from persia_tpu.parallel.grad_sync import quantize_int8_ef
+
+    g = jnp.asarray(np.random.default_rng(0).normal(size=33), jnp.bfloat16)
+    q, scale, deq, res = quantize_int8_ef(g, jnp.zeros((33,), jnp.float32))
+    assert q.dtype == jnp.int8
+    assert deq.dtype == jnp.float32
+    assert res.dtype == jnp.float32
+
+
+def test_block_quantize_round_trip_error_bound():
+    """Per-element round-trip error <= half an int8 lattice step of the
+    element's OWN block (scale/127 covers round-to-nearest both ways), and
+    quant + residual is lossless by construction."""
+    from persia_tpu.parallel.grad_sync import (
+        block_dequantize_int8,
+        block_quantize_int8,
+    )
+
+    rng = np.random.default_rng(4)
+    bs = 32
+    v = jnp.asarray(
+        (rng.normal(size=256) * np.repeat(10.0 ** rng.integers(-3, 3, 8), bs))
+        .astype(np.float32)
+    )
+    q, scales, deq = block_quantize_int8(v, bs)
+    per_block_step = np.repeat(np.asarray(scales), bs) / 127.0
+    err = np.abs(np.asarray(deq) - np.asarray(v))
+    assert (err <= per_block_step / 2 + 1e-7).all()
+    np.testing.assert_allclose(
+        np.asarray(block_dequantize_int8(q, scales, bs)), np.asarray(deq),
+        rtol=0, atol=0,
+    )
+
+
+def test_block_int8_ring_matches_exact_mean_within_bound():
+    """One ring allreduce of random per-device vectors lands within the
+    summed per-hop int8 resolution of the exact mean, and the error-feedback
+    residual carries exactly what the wire dropped (units conserved)."""
+    from persia_tpu.parallel.grad_sync import (
+        BlockInt8Ring,
+        _block_ring_allreduce_flat,
+        _flat_chunk,
+    )
+    from persia_tpu.parallel.mesh import shard_map_compat
+
+    mesh = data_parallel_mesh()
+    n = mesh.shape["data"]
+    bs = 16
+    p = 96
+    _, p_pad = _flat_chunk(p, n, bs)
+    rng = np.random.default_rng(7)
+    per_dev = np.zeros((n, p_pad), np.float32)
+    per_dev[:, :p] = rng.normal(size=(n, p)).astype(np.float32)
+    exact = per_dev.sum(axis=0)
+    algo = BlockInt8Ring(block_size=bs)
+
+    def f(x, ef):
+        s, new_ef = _block_ring_allreduce_flat(x[0], ef[0], algo, n)
+        return s, new_ef[None]
+
+    summed, ef = jax.jit(
+        shard_map_compat(
+            f, mesh=mesh, in_specs=(P("data"), P("data")),
+            out_specs=(P(), P("data")), check_vma=False,
+        )
+    )(jnp.asarray(per_dev), jnp.zeros((n, p_pad), jnp.float32))
+    summed, ef = np.asarray(summed), np.asarray(ef)
+
+    # each element crosses <= n-1 quantized hops; absmax<=~4 at these draws
+    step = np.abs(per_dev).max() / 127.0
+    assert np.abs(summed - exact).max() <= (n - 1) * step * 2
+    # EF conservation: what the allreduce result is missing vs exact is
+    # exactly what the residuals still carry (up to accumulation order)
+    np.testing.assert_allclose(
+        summed + ef.sum(axis=0), exact, rtol=0, atol=5e-5
+    )
+
+
+def test_block_int8_ring_replicas_bit_identical():
+    """Every replica must apply the SAME dequantized sum — the owner does
+    not shortcut to its exact partial — so params never drift apart."""
+    from persia_tpu.parallel.grad_sync import BlockInt8Ring
+
+    mesh = data_parallel_mesh()
+    model = _model()
+    opt = optax.adam(1e-2)
+    hb = _host_batch(raw=False)
+    algo = BlockInt8Ring(block_size=32)
+    state = _ring_state(model, hb, opt, mesh, algo)
+    step = build_sync_train_step(model, opt, mesh, algo)
+    for i in range(3):
+        state, _ = step(state, shard_device_batch(_host_batch(seed=i, raw=False), mesh))
+    for leaf in jax.tree.leaves(state.params):
+        shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+        for s in shards[1:]:
+            np.testing.assert_array_equal(shards[0], s)
+
+
+def test_block_int8_ring_trains_and_tracks_f32():
+    """The quantized ring trains (loss drops) and stays near the f32
+    trajectory over 20 steps — error feedback keeps the bias bounded."""
+    from persia_tpu.parallel.grad_sync import BlockInt8Ring
+
+    mesh = data_parallel_mesh()
+    model = _model()
+    hb = _host_batch(raw=False)
+
+    def run(algorithm, ring):
+        opt = optax.adam(1e-2)
+        if ring:
+            state = _ring_state(model, hb, opt, mesh, algorithm)
+        else:
+            state = replicate_state(_init(model, hb, opt), mesh)
+        step = build_sync_train_step(model, opt, mesh, algorithm)
+        losses = []
+        for i in range(20):
+            db = shard_device_batch(_host_batch(seed=i % 3, raw=False), mesh)
+            state, (header, _) = step(state, db)
+            losses.append(float(np.asarray(header)[0]))
+        return np.asarray(losses), np.concatenate(
+            [np.asarray(p).reshape(-1) for p in jax.tree.leaves(state.params)]
+        )
+
+    l_ring, p_ring = run(BlockInt8Ring(block_size=32), ring=True)
+    l_f32, p_f32 = run(GradientAllReduce(), ring=False)
+    assert np.isfinite(l_ring).all()
+    assert np.mean(l_ring[-5:]) < np.mean(l_ring[:5])
+    assert np.abs(l_ring - l_f32).max() < 0.05
+    assert np.abs(p_ring - p_f32).max() < 0.1
+
+
+def test_block_int8_ring_rejects_bad_block_size():
+    from persia_tpu.parallel.grad_sync import BlockInt8Ring
+
+    with pytest.raises(ValueError, match="block_size"):
+        BlockInt8Ring(block_size=0)
+    BlockInt8Ring(block_size=1)
+
+
+# ------------------------------------------ sharded optimizer update (ZeRO)
+
+
+def test_sharded_f32_update_matches_replicated():
+    """reduce-scatter + 1/n-shard update + all-gather must reproduce the
+    replicated f32 step — same gradients, same adam math, just partitioned —
+    so sharding is a pure memory win. One step is bit-identical on this
+    harness; over 4 steps psum and psum_scatter reduce in different orders
+    (~1 ulp) and adam compounds it, so the gate is 1e-7 absolute (measured
+    drift 4.7e-10, >200x slack) with zero rtol."""
+    mesh = data_parallel_mesh()
+    model = _model()
+    hb = _host_batch(raw=False)
+
+    opt = optax.adam(1e-2)
+    s_rep = replicate_state(_init(model, hb, opt), mesh)
+    step_rep = build_sync_train_step(model, opt, mesh, GradientAllReduce())
+
+    opt2 = optax.adam(1e-2)
+    algo = GradientAllReduce()
+    s_shd = _ring_state(model, hb, opt2, mesh, algo, sharded=True)
+    step_shd = build_sync_train_step(
+        model, opt2, mesh, algo, sharded_update=True
+    )
+
+    for i in range(4):
+        db = shard_device_batch(_host_batch(seed=i, raw=False), mesh)
+        s_rep, _ = step_rep(s_rep, db)
+        s_shd, _ = step_shd(s_shd, db)
+    for a, b in zip(jax.tree.leaves(s_rep.params), jax.tree.leaves(s_shd.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0, atol=1e-7
+        )
+
+
+def test_sharded_opt_state_memory_is_fraction():
+    """Measured per-replica optimizer bytes (real addressable shards) must
+    be ~1/n of the replicated layout (chunk padding + optax's replicated
+    scalar count allow a small excess over the ideal)."""
+    from persia_tpu.parallel.grad_sync import per_replica_opt_state_bytes
+
+    mesh = data_parallel_mesh()
+    n = mesh.shape["data"]
+    model = _model()
+    hb = _host_batch(raw=False)
+    opt = optax.adam(1e-2)
+    rep = replicate_state(_init(model, hb, opt), mesh)
+    shd = _ring_state(model, hb, opt, mesh, GradientAllReduce(), sharded=True)
+    rep_b = per_replica_opt_state_bytes(rep.opt_state)
+    shd_b = per_replica_opt_state_bytes(shd.opt_state["opt"])
+    assert shd_b < rep_b * 1.35 / n, (rep_b, shd_b, n)
+
+
+def test_sharded_ring_trains():
+    """block-int8-ring-sharded (quantized reduce-scatter + sharded update +
+    param all-gather) trains end to end."""
+    from persia_tpu.parallel.grad_sync import BlockInt8Ring
+
+    mesh = data_parallel_mesh()
+    model = _model()
+    opt = optax.adam(1e-2)
+    hb = _host_batch(raw=False)
+    algo = BlockInt8Ring(block_size=32)
+    state = _ring_state(model, hb, opt, mesh, algo, sharded=True)
+    step = build_sync_train_step(model, opt, mesh, algo, sharded_update=True)
+    losses = []
+    for i in range(20):
+        db = shard_device_batch(_host_batch(seed=i % 3, raw=False), mesh)
+        state, (header, _) = step(state, db)
+        losses.append(float(np.asarray(header)[0]))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+
+def test_sharded_update_rejects_unsupported_algorithm():
+    """sharded_update is a dense-plane contract for the allreduce-family
+    algorithms only; pairing it with a local/decentralized algorithm must
+    fail loudly at build time, not corrupt state at step time."""
+    mesh = data_parallel_mesh()
+    with pytest.raises(ValueError, match="sharded_update"):
+        build_sync_train_step(
+            _model(), optax.adam(1e-2), mesh, Decentralized(),
+            sharded_update=True,
+        )
+
+
+def test_sync_mode_registry_and_wire_model():
+    """Mode registry round-trips and the wire model encodes the claims the
+    artifacts make: bytegrad's psum carries int32 (f32-width wire), the
+    block ring cuts >= 3.5x, sharding never inflates the gradient half."""
+    from persia_tpu.parallel.grad_sync import (
+        DENSE_SYNC_MODES,
+        BlockInt8Ring,
+        dense_sync_wire_bytes,
+        sync_mode_algorithm,
+    )
+
+    for m in DENSE_SYNC_MODES:
+        algo, sharded = sync_mode_algorithm(m)
+        assert sharded == m.endswith("-sharded")
+    assert isinstance(sync_mode_algorithm("block-int8-ring")[0], BlockInt8Ring)
+    with pytest.raises(ValueError, match="unknown dense sync mode"):
+        sync_mode_algorithm("int4-telepathy")
+
+    p, n = 1_000_000, 8
+    f32 = dense_sync_wire_bytes("f32", p, n)
+    assert dense_sync_wire_bytes("bytegrad", p, n) == f32
+    assert dense_sync_wire_bytes("bf16", p, n) * 2 == f32
+    assert f32 / dense_sync_wire_bytes("block-int8-ring", p, n) >= 3.5
+    assert dense_sync_wire_bytes("f32-sharded", p, n) == f32
+    assert dense_sync_wire_bytes("implicit-psum", p, n) == f32
+    assert dense_sync_wire_bytes("local", p, n) == 0
+    assert dense_sync_wire_bytes("f32", p, 1) == 0
