@@ -15,6 +15,15 @@ Two paths over identically seeded fresh tiers:
   fused-native         cache_feed_batch (admit+probe+LUT+ledger in ONE
                        ctypes call) + candidate revalidation + insert_range
 
+Round 14 adds the tiering-ON sweep (``"tiering"`` key): the same stream
+with an access profiler attached, comparing the legacy shape (unsharded
+directory + standalone ``cache.sketch_observe`` call per group) against
+the sharded feeder (admit directory partitioned by the group salt, sketch
+observe FUSED into the admit walk) at feed_threads ∈ {1, 2, 4}. Each
+sharded run also prints the per-shard busy table (native-measured walk ns
+accumulated over the timed steps) — a skewed column means the partition
+salt is fighting the key distribution.
+
 Prints one JSON dict; PROFILE_FEEDER.md commits the measured numbers.
 """
 
@@ -119,6 +128,105 @@ def run_path(fused: bool):
     return out
 
 
+def run_tier_path(shards, threads):
+    """Tiering-ON feeder cost: admit walk + sketch observe per step.
+
+    ``shards=None`` — unsharded directory + classic single-sketch
+    profiler; the observe is a SEPARATE native call per group (the
+    pre-round-14 shape, visible as the ``cache.sketch_observe`` span).
+    ``shards=S`` — directory partitioned into S shards, profiler family
+    matched to it (one sub-sketch per shard, routed by the group salt),
+    observe fused into the admit walk across ``threads`` walkers; the
+    per-shard walk times surface as ``feed.shard`` spans.
+    """
+    from persia_tpu import tracing
+    from persia_tpu.embedding.hbm_cache.directory import PendingSignMap
+    from persia_tpu.embedding.tiering import AccessProfiler
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("PERSIA_FEED_SHARDS", "PERSIA_FEED_THREADS")
+    }
+    os.environ["PERSIA_FEED_SHARDS"] = "0" if shards is None else str(shards)
+    os.environ["PERSIA_FEED_THREADS"] = str(threads)
+    try:
+        ctx = bench._cached_tier_ctx()  # tier reads the env at construction
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    tier = ctx.tier
+    # slot_order follows the tier's group order so each group's slots map
+    # to a CONTIGUOUS profiler index run — the fuse gate's precondition
+    tier.profiler = AccessProfiler(
+        [s for g in tier.groups for s in g.slots],
+        shards=tier.feed_shards,
+        slot_salts=tier.profiler_slot_salts() if tier.feed_shards else None,
+    )
+    make_batch = bench._zipf_batch_maker()
+    pmap = PendingSignMap()
+    ring_pos = [0]
+
+    def ring_alloc(gname, kp):
+        p = ring_pos[0]
+        ring_pos[0] += kp
+        return p
+
+    token = [0]
+
+    def feed(batch):
+        item = tier.prepare_batch(batch, ring_alloc=ring_alloc, pending_map=pmap)
+        for gn, (ev, k, rp) in item[6].items():
+            token[0] += 1
+            pmap.insert_range(ev[:k], rp, token[0])
+
+    batches = [make_batch() for _ in range(WARM + STEPS)]
+    for b in batches[:WARM]:
+        feed(b)
+
+    n_shards = tier.feed_shards or 0
+    shard_busy = np.zeros(n_shards, dtype=np.float64)
+    tracing.enable()
+    tracing.clear()
+    t0 = time.perf_counter()
+    for b in batches[WARM:]:
+        feed(b)
+        if n_shards:  # busy_ns is per-feed: accumulate each timed step
+            for st in tier.feeder_shard_stats().values():
+                shard_busy += np.asarray(st["busy_ns"], dtype=np.float64)
+    wall = time.perf_counter() - t0
+    tracing.enable(False)
+
+    agg = defaultdict(lambda: [0, 0.0])
+    for ev in tracing.spans_snapshot():
+        agg[ev["name"]][0] += 1
+        agg[ev["name"]][1] += ev["dur"] / 1e3
+    out = {
+        "path": (
+            "sharded-fused-observe" if n_shards else "unsharded+standalone-observe"
+        ),
+        "feed_shards": tier.feed_shards,
+        "feed_threads": tier.feed_threads,
+        "prep_ms_per_step": round(wall / STEPS * 1e3, 3),
+        "feeder_ceiling_samples_per_sec": round(
+            STEPS * bench.BATCH_SIZE / wall, 1
+        ),
+    }
+    if n_shards:
+        out["shard_busy_ms_per_step"] = [
+            round(v / STEPS / 1e6, 3) for v in shard_busy.tolist()
+        ]
+    for name in sorted(agg):
+        cnt, ms = agg[name]
+        out[name] = {
+            "per_step": round(cnt / STEPS, 2),
+            "busy_ms_per_step": round(ms / STEPS, 3),
+        }
+    return out
+
+
 def main():
     results = [run_path(fused=False), run_path(fused=True)]
     before, after = results
@@ -134,6 +242,22 @@ def main():
         "after": after,
         "prep_speedup": round(
             before["prep_ms_per_step"] / after["prep_ms_per_step"], 3
+        ),
+    }
+    shards = int(os.environ.get("PROFILE_FEED_SHARDS", "8"))
+    legacy = run_tier_path(shards=None, threads=1)
+    sweep = {
+        f"t{t}": run_tier_path(shards=shards, threads=t) for t in (1, 2, 4)
+    }
+    summary["tiering"] = {
+        "legacy": legacy,
+        "sharded": sweep,
+        "fused_t1_vs_legacy": round(
+            legacy["prep_ms_per_step"] / sweep["t1"]["prep_ms_per_step"], 3
+        ),
+        "t4_vs_t1": round(
+            sweep["t1"]["prep_ms_per_step"] / sweep["t4"]["prep_ms_per_step"],
+            3,
         ),
     }
     print(json.dumps(summary, indent=1))

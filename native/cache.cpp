@@ -18,11 +18,17 @@
 // C ABI only (ctypes-friendly).
 
 #include <algorithm>
+#include <atomic>
+#include <chrono>
 #include <cmath>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdlib>
+#include <functional>
+#include <memory>
 #include <mutex>
 #include <new>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -783,6 +789,7 @@ namespace {
 constexpr uint64_t SK_MAGIC = 0x70736b3176ULL;  // "psk1v"
 constexpr uint64_t SK_SLOT_MIX = 0x9E3779B97F4A7C15ULL;
 constexpr uint64_t SK_BM_SEED = 0x5BF03635F0C59A1FULL;
+constexpr uint64_t SK_SAMPLE_SEED = 0xD1B54A32D192ED03ULL;
 constexpr int64_t SK_MAX_DEPTH = 8;
 constexpr uint64_t SK_DEPTH_SEED[SK_MAX_DEPTH] = {
     0xA076D1F3E59B7C21ULL, 0x2545F4914F6CDD1DULL, 0xDE916ABCC965815BULL,
@@ -801,22 +808,36 @@ struct AccessSketch {
   std::vector<uint64_t> bits_prev;   // n_slots * bm_words
   std::vector<uint64_t> top_sign;    // n_slots * topk
   std::vector<double> top_est;       // n_slots * topk
+  // PERSIA_SKETCH_SAMPLE: observe only signs with hash%k == 0, every
+  // increment scaled by k — totals/cm stay unbiased in expectation, the
+  // unfused ServiceCtx observe walk costs 1/k of its DRAM traffic.
+  int64_t sample_k = 1;
 
-  // caller holds mu
-  inline uint32_t observe_one(int64_t slot, uint64_t sign) {
+  // caller holds mu: weighted observe — one call with weight w leaves the
+  // count-min rows, totals and bitmap in EXACTLY the state w unit observes
+  // of the same (slot, sign) would (saturating adds commute; the bitmap
+  // bit is idempotent). The fused feeder walk uses this to observe each
+  // distinct (slot, sign) of a batch once with its occurrence count.
+  inline uint32_t observe_w(int64_t slot, uint64_t sign, uint64_t w) {
     const uint64_t key = sign ^ ((uint64_t)slot * SK_SLOT_MIX);
     uint32_t est = UINT32_MAX;
     for (int64_t d = 0; d < depth; ++d) {
       const uint64_t idx = splitmix64(key ^ SK_DEPTH_SEED[d]) & width_mask;
       uint32_t& c = cm[(size_t)(d * width + (int64_t)idx)];
-      if (c != UINT32_MAX) ++c;
+      const uint64_t nv = (uint64_t)c + w;
+      c = nv > (uint64_t)UINT32_MAX ? UINT32_MAX : (uint32_t)nv;
       if (c < est) est = c;
     }
-    totals[(size_t)slot] += 1.0;
+    totals[(size_t)slot] += (double)w;
     const uint64_t b = splitmix64(key ^ SK_BM_SEED) % (uint64_t)bitmap_bits;
     bits_cur[(size_t)(slot * bm_words + (int64_t)(b >> 6))] |=
         (uint64_t)1 << (b & 63);
     return est;
+  }
+
+  // caller holds mu
+  inline uint32_t observe_one(int64_t slot, uint64_t sign) {
+    return observe_w(slot, sign, 1);
   }
 
   // caller holds mu: keep the slot's top-K heavy hitters by cm estimate
@@ -883,15 +904,32 @@ int64_t sketch_observe(void* h, const uint64_t* signs, int64_t n,
   AccessSketch& sk = *static_cast<AccessSketch*>(h);
   std::lock_guard<std::mutex> lk(sk.mu);
   int64_t seen = 0;
+  const uint64_t k = (uint64_t)sk.sample_k;
   for (int64_t i = 0; i < n; ++i) {
     const int64_t slot =
         slot_base + (samples_per_slot > 0 ? i / samples_per_slot : 0);
     if (slot < 0 || slot >= sk.n_slots) continue;
-    const uint32_t est = sk.observe_one(slot, signs[i]);
+    if (k > 1 && splitmix64(signs[i] ^ SK_SAMPLE_SEED) % k != 0) {
+      ++seen;  // sampled away, not dropped: the caller sized the call
+      continue;
+    }
+    const uint32_t est = sk.observe_w(slot, signs[i], k);
     sk.maybe_top(slot, signs[i], est);
     ++seen;
   }
   return seen;
+}
+
+// 1-in-k observe sampling (PERSIA_SKETCH_SAMPLE): the sign-hash gate keeps
+// the sample set consistent across batches (a kept sign is always kept, so
+// per-sign frequency estimates stay exact * k), increments are scaled by k
+// so totals/cm stay unbiased, and slot_stats scales the linear-counting
+// unique estimate back up by k (only 1/k of distinct signs reach the
+// bitmap). k <= 1 disables sampling.
+void sketch_set_sample(void* h, int64_t k) {
+  AccessSketch& sk = *static_cast<AccessSketch*>(h);
+  std::lock_guard<std::mutex> lk(sk.mu);
+  sk.sample_k = k < 1 ? 1 : (k > (1 << 20) ? (1 << 20) : k);
 }
 
 // Exponential decay: scales the count-min counters, per-slot totals and
@@ -924,7 +962,9 @@ int64_t sketch_slot_stats(void* h, int64_t slot, double* out) {
     ones += __builtin_popcountll(c[w] | p[w]);
   const double m = (double)sk.bitmap_bits;
   const int64_t zeros = sk.bitmap_bits - ones;
-  const double unique = zeros == 0 ? m : m * std::log(m / (double)zeros);
+  double unique = zeros == 0 ? m : m * std::log(m / (double)zeros);
+  // under 1-in-k sampling only ~unique/k distinct signs reach the bitmap
+  if (sk.sample_k > 1) unique *= (double)sk.sample_k;
   const double total = sk.totals[(size_t)slot];
   double hot = 0.0, top1 = 0.0;
   const double* e = &sk.top_est[(size_t)(slot * sk.topk)];
@@ -1027,6 +1067,721 @@ int64_t sketch_import(void* h, const uint8_t* data, int64_t n) {
     return -1;
   if (!take(sk.top_est.data(), sk.top_est.size() * sizeof(double))) return -1;
   return 0;
+}
+
+}  // extern "C"
+
+// ------------------------------------------------------------ sharded feeder
+//
+// ISSUE 14: the admit directory + LRU partitioned into S independent shards
+// keyed by the per-group salted sign hash (the pending-ledger salt from the
+// fused-feed PR doubles as the partition key), each with its own mutex, LRU
+// chain and row-range of the device slab. cache_feed_batch_sharded buckets
+// the raw position stream by shard, runs the admit/evict/row-LUT walk one
+// shard per pool thread (software-prefetch pipelining preserved per shard)
+// and FUSES the tiering sketch observe into the same walk: each position
+// bumps a shard-local (slot, sign) occurrence scratch, and after the admit
+// walk every distinct pair is observed ONCE into the shard's private
+// sub-sketch with its occurrence count as the weight (observe_w above) —
+// the sign matrix is traversed once instead of twice, and the dominant
+// count-min DRAM traffic shrinks by the batch's per-(slot, sign) dedup
+// ratio even on one core.
+//
+// Determinism: the shard of a sign is a pure function of (sign, part_salt,
+// S); the counting-sort bucketing is stable, so each shard walks its
+// positions in input order against shard-private state; results are merged
+// in ascending shard order on the calling thread. The emitted row LUT,
+// miss list, eviction list, ledger-restore entries and sub-sketch states
+// are therefore bit-identical at ANY thread count (threads only change
+// which OS thread runs a shard's walk, never the walk itself) — pinned by
+// tests/test_sharded_feeder.py. With S == 1 the walk degenerates to the
+// legacy cache_feed_batch algorithm and its outputs match it bitwise.
+//
+// Locking (ranked in persia_tpu/analysis/lock_order.py): a walker thread
+// holds its OWN shard's mu for the admit passes, releases it, then takes
+// the sub-sketch mu (observe apply) and then the pending-ledger mu (miss
+// probe). The three are never nested and no thread ever holds two shard
+// mutexes, so the feeder adds leaf-level locks only. Concurrent
+// cache_sharded_probe/len/snapshot calls serialize per shard on shard.mu;
+// concurrent feed/drain calls on one handle are the caller's to serialize
+// (the Python stream lock already does), matching the legacy contract.
+
+namespace {
+
+constexpr int64_t SHARD_MAX = 64;
+
+inline int64_t shard_route(uint64_t sign, uint64_t part_salt,
+                           int64_t n_shards) {
+  // multiply-high range reduction of the salted sign hash: uniform for any
+  // shard count, no modulo bias, and a pure function of (sign, salt, S) —
+  // the partition never depends on thread count.
+  return (int64_t)((unsigned __int128)splitmix64(sign ^ part_salt) *
+                   (unsigned __int128)(uint64_t)n_shards >> 64);
+}
+
+struct FeedShard {
+  Cache dir;      // shard-local directory; emitted rows offset by row_base
+  std::mutex mu;  // guards dir: feed walk vs probe/drain/snapshot/len
+  int64_t row_base = 0;
+  // per-feed outputs, merged by the caller in ascending shard order
+  std::vector<uint64_t> miss_signs;
+  std::vector<int64_t> miss_rows;
+  std::vector<uint64_t> ev_signs;
+  std::vector<int64_t> ev_rows;
+  std::vector<int64_t> rst_src;
+  std::vector<int64_t> rst_pos;  // shard-local miss ordinals
+  int64_t n_unique = 0;
+  bool overflow = false;
+  // last feed's walk time (both phases + observe + ledger probe), written
+  // by whichever pool thread ran this shard; atomic so the stats thread
+  // can read mid-feed
+  std::atomic<int64_t> busy_ns{0};
+  // fused observe scratch: occurrence counts + slot ids PARALLEL to the
+  // admit scratch (indexed by the same bucket). The admit walk already
+  // dedups the batch by sign, so when signs are slot-prefixed
+  // (feature_index_prefix_bit > 0: sign -> slot is injective) the
+  // (slot, sign) observe dedup rides the probe the admit walk has ALREADY
+  // paid for — the fused observe adds one 4-byte counter bump per
+  // position and a weighted sub-sketch observe per DISTINCT sign, never a
+  // second hash-table walk over the sign matrix. The Python side only
+  // passes sketches when the prefix invariant holds; without it the
+  // unfused routed observe stays in charge.
+  std::vector<uint32_t> obs_count;  // sized like Cache::scratch
+  std::vector<uint32_t> obs_slot;   // UINT32_MAX = unattributed (skip)
+  std::vector<uint32_t> obs_order;  // scratch indices, first-seen order
+
+  explicit FeedShard(int64_t cap) : dir(cap) {}
+
+  void obs_reserve(int64_t n) {
+    if (obs_count.size() != dir.scratch.size()) {
+      obs_count.assign(dir.scratch.size(), 0);
+      obs_slot.assign(dir.scratch.size(), 0);
+    }
+    obs_order.clear();
+    obs_order.reserve((size_t)n);
+  }
+};
+
+struct ShardedCache {
+  int64_t total_capacity = 0;
+  int64_t n_shards = 1;
+  uint64_t part_salt = 0;
+  std::vector<std::unique_ptr<FeedShard>> shards;
+
+  // calling-thread bucketing buffers (one feed in flight per handle at a
+  // time — the caller serializes feed/drain, so these never race)
+  std::vector<uint8_t> sid;
+  std::vector<int64_t> start;  // CSR offsets, n_shards + 1
+  std::vector<int64_t> fill;
+  std::vector<int64_t> pos;    // position indices grouped by shard
+
+  // persistent pool: n_threads - 1 workers + the calling thread. Every
+  // dispatch is exactly n_shards items; that invariant makes the lock-free
+  // item claim in drain_items safe (a stale wake can fetch-add past the
+  // end but can never claim a live item of a later dispatch while an
+  // earlier one is unfinished — the caller's items_done barrier forbids
+  // replacing `job` while any invocation is in flight).
+  std::mutex pool_mu;
+  std::condition_variable cv_work, cv_done;
+  uint64_t gen = 0;
+  std::function<void(int64_t)> job;
+  std::atomic<int64_t> next_item{0};
+  int64_t items_done = 0;
+  bool stopping = false;
+  int64_t n_threads = 1;
+  std::vector<std::thread> workers;
+
+  ShardedCache(int64_t cap, int64_t n, uint64_t salt, int64_t threads)
+      : total_capacity(cap), n_shards(n), part_salt(salt) {
+    const int64_t base = cap / n, rem = cap % n;
+    int64_t row_base = 0;
+    for (int64_t s = 0; s < n; ++s) {
+      const int64_t c = base + (s < rem ? 1 : 0);
+      shards.emplace_back(new FeedShard(c));
+      shards.back()->row_base = row_base;
+      row_base += c;
+    }
+    set_threads(threads);
+  }
+
+  ~ShardedCache() { set_threads(1); }
+
+  void set_threads(int64_t t) {
+    if (t < 1) t = 1;
+    if (t > n_shards) t = n_shards;  // >S threads would only idle
+    {
+      std::lock_guard<std::mutex> lk(pool_mu);
+      if (t == n_threads) return;
+      stopping = true;
+    }
+    cv_work.notify_all();
+    for (auto& w : workers) w.join();
+    workers.clear();
+    {
+      std::lock_guard<std::mutex> lk(pool_mu);
+      stopping = false;
+      n_threads = t;
+    }
+    for (int64_t i = 0; i < t - 1; ++i)
+      workers.emplace_back([this] { worker_loop(); });
+  }
+
+  void drain_items() {
+    int64_t done = 0;
+    for (;;) {
+      const int64_t s = next_item.fetch_add(1);
+      if (s >= n_shards) break;
+      job(s);
+      ++done;
+    }
+    if (done > 0) {
+      std::lock_guard<std::mutex> lk(pool_mu);
+      items_done += done;
+      if (items_done >= n_shards) cv_done.notify_all();
+    }
+  }
+
+  void worker_loop() {
+    uint64_t seen = 0;
+    for (;;) {
+      {
+        std::unique_lock<std::mutex> lk(pool_mu);
+        cv_work.wait(lk, [&] { return stopping || gen != seen; });
+        if (stopping) return;
+        seen = gen;
+      }
+      drain_items();
+    }
+  }
+
+  // run fn(s) for every shard (caller participates); returns only when all
+  // n_shards items completed — the completion barrier that licenses
+  // replacing `job` on the next dispatch.
+  void run_shards(const std::function<void(int64_t)>& fn) {
+    if (n_threads <= 1) {
+      for (int64_t s = 0; s < n_shards; ++s) fn(s);
+      return;
+    }
+    {
+      std::lock_guard<std::mutex> lk(pool_mu);
+      job = fn;
+      items_done = 0;
+      next_item.store(0);
+      ++gen;
+    }
+    cv_work.notify_all();
+    drain_items();
+    std::unique_lock<std::mutex> lk(pool_mu);
+    cv_done.wait(lk, [&] { return items_done >= n_shards; });
+  }
+};
+
+// phase A for one shard (caller holds sh.mu): dedup + LRU-touch residents
+// over the shard's slice of the position list; misses get ordinal
+// placeholders. Scratch values are GLOBAL: row_base-offset rows, the
+// global pad row (total_capacity) for touch-gated bypasses, or
+// -(local_miss_ordinal + 2). Nothing is admitted yet, so a capacity
+// overflow in any shard can still bail with only LRU touches applied —
+// the cache_admit_positions contract. When observing, every position also
+// bumps the (slot, sign) occurrence scratch: the fused single pass.
+void shard_pass1(FeedShard& sh, const uint64_t* signs, int32_t* rows_out,
+                 const int64_t* pos, int64_t p0, int64_t p1,
+                 int64_t total_capacity, bool observing,
+                 int64_t samples_per_slot, int64_t slot_base) {
+  Cache& c = sh.dir;
+  const int64_t n_local = p1 - p0;
+  c.scratch_reserve(n_local);
+  if (observing) sh.obs_reserve(n_local);
+  sh.miss_signs.clear();
+  sh.n_unique = 0;
+  sh.overflow = false;
+  const uint64_t ep = c.scratch_epoch & 0xffffffffULL;
+  const int64_t PF = 16;  // same DRAM-latency pipelining as the legacy walk
+  for (int64_t t = p0; t < p1; ++t) {
+    if (t + PF < p1) {
+      const uint64_t sp = signs[pos[t + PF]];
+      const uint64_t sh_home = c.scratch_mask & splitmix64(sp);
+      __builtin_prefetch(&c.scratch[sh_home]);
+      __builtin_prefetch(&c.table[c.home(sp)]);
+      if (observing) __builtin_prefetch(&sh.obs_count[sh_home]);
+    }
+    const int64_t i = pos[t];
+    const uint64_t s = signs[i];
+    uint64_t j = c.scratch_mask & splitmix64(s);
+    int64_t v;
+    for (;;) {
+      const Cache::ScratchSlot& sl = c.scratch[j];
+      if ((sl.packed >> 32) != ep) { v = -1; break; }
+      if (sl.sign == s) { v = (int32_t)(uint32_t)sl.packed; break; }
+      j = (j + 1) & c.scratch_mask;
+    }
+    if (v == -1) {  // first time this batch
+      ++sh.n_unique;
+      const int64_t lpos = c.find_pos(s);
+      if (lpos >= 0) {
+        const int64_t r = c.table[lpos].row;
+        c.touch(r);
+        v = sh.row_base + r;
+      } else if (!c.touch_admits(s)) {
+        v = total_capacity;  // global pad row: zero fwd, grad dropped
+      } else {
+        v = -((int64_t)sh.miss_signs.size() + 2);
+        sh.miss_signs.push_back(s);
+      }
+      c.scratch[j] = Cache::ScratchSlot{s, (ep << 32) | (uint32_t)(int32_t)v};
+      if (observing) {
+        const int64_t slot =
+            slot_base + (samples_per_slot > 0 ? i / samples_per_slot : 0);
+        sh.obs_count[j] = 1;
+        sh.obs_slot[j] = slot < 0 ? UINT32_MAX : (uint32_t)slot;
+        sh.obs_order.push_back((uint32_t)j);
+      }
+    } else if (observing) {
+      ++sh.obs_count[j];  // repeat: slot attribution rides the first touch
+    }
+    rows_out[i] = (int32_t)v;
+  }
+  sh.overflow = sh.n_unique > c.capacity;
+}
+
+// phase B admit for one shard (caller holds sh.mu): assign rows to misses
+// (evicting shard-LRU residents not in this batch), then resolve the
+// placeholder LUT entries. Row values are global (row_base offset).
+void shard_pass2(FeedShard& sh, int32_t* rows_out, const int64_t* pos,
+                 int64_t p0, int64_t p1) {
+  Cache& c = sh.dir;
+  const int64_t n_miss = (int64_t)sh.miss_signs.size();
+  sh.miss_rows.clear();
+  sh.ev_signs.clear();
+  sh.ev_rows.clear();
+  for (int64_t m = 0; m < n_miss; ++m) {
+    if (c.count >= c.capacity) {
+      uint64_t ev_sign;
+      const int64_t ev_row = c.evict_lru(&ev_sign);
+      sh.ev_signs.push_back(ev_sign);
+      sh.ev_rows.push_back(sh.row_base + ev_row);
+      c.free_rows.push_back(ev_row);
+    }
+    sh.miss_rows.push_back(sh.row_base + c.insert(sh.miss_signs[m]));
+  }
+  for (int64_t t = p0; t < p1; ++t) {
+    const int64_t i = pos[t];
+    const int32_t v = rows_out[i];
+    if (v < 0) rows_out[i] = (int32_t)sh.miss_rows[-(int64_t)v - 2];
+  }
+}
+
+// fused observe apply for one shard: its private (slot, sign) occurrence
+// scratch lands in the shard's private sub-sketch, first-seen order, one
+// weighted observe per distinct pair. Caller must NOT hold sh.mu (leaf
+// locks only). Final cm/totals/bitmap state is identical to per-position
+// observes; the top-K list sees each pair once at its full batch weight.
+void shard_observe_apply(FeedShard& sh, AccessSketch* sk) {
+  if (sk == nullptr || sh.obs_order.empty()) return;
+  std::lock_guard<std::mutex> lk(sk->mu);
+  const Cache& c = sh.dir;
+  const uint64_t k = (uint64_t)sk->sample_k;
+  const int64_t n = (int64_t)sh.obs_order.size();
+  // Two-stage prefetch pipeline, same discipline as the admit walk: the
+  // scratch entry is pulled at distance 2*PF, its count-min lines (whose
+  // addresses need the sign from that entry) at distance PF. A sentinel
+  // obs_slot just hashes to a garbage-but-masked in-bounds cm index.
+  const int64_t PF = 8;
+  for (int64_t t = 0; t < n; ++t) {
+    if (t + 2 * PF < n)
+      __builtin_prefetch(&c.scratch[sh.obs_order[(size_t)(t + 2 * PF)]]);
+    if (t + PF < n) {
+      const uint32_t jp = sh.obs_order[(size_t)(t + PF)];
+      const uint64_t keyp =
+          c.scratch[jp].sign ^ ((uint64_t)sh.obs_slot[jp] * SK_SLOT_MIX);
+      for (int64_t d = 0; d < sk->depth; ++d)
+        __builtin_prefetch(
+            &sk->cm[(size_t)(d * sk->width +
+                             (int64_t)(splitmix64(keyp ^ SK_DEPTH_SEED[d]) &
+                                       sk->width_mask))],
+            1);
+    }
+    const uint32_t j = sh.obs_order[(size_t)t];
+    const int64_t slot = (int64_t)sh.obs_slot[j];
+    if (slot >= sk->n_slots) continue;  // incl. the UINT32_MAX sentinel
+    const uint64_t sign = c.scratch[j].sign;
+    if (k > 1 && splitmix64(sign ^ SK_SAMPLE_SEED) % k != 0) continue;
+    const uint32_t est =
+        sk->observe_w(slot, sign, (uint64_t)sh.obs_count[j] * k);
+    sk->maybe_top(slot, sign, est);
+  }
+}
+
+// hazard-ledger probe of one shard's misses (same revalidation contract as
+// cache_feed_batch: the caller re-checks hits after reserving the ring
+// span). Caller must NOT hold sh.mu.
+void shard_ledger_probe(FeedShard& sh, PendingMap* m, uint64_t salt) {
+  sh.rst_src.clear();
+  sh.rst_pos.clear();
+  if (m == nullptr) return;
+  std::lock_guard<std::mutex> lk(m->mu);
+  if (m->count == 0) return;
+  const int64_t n_miss = (int64_t)sh.miss_signs.size();
+  const int64_t PF = 16;
+  for (int64_t j = 0; j < n_miss; ++j) {
+    if (j + PF < n_miss)
+      __builtin_prefetch(
+          &m->t[splitmix64(sh.miss_signs[j + PF] ^ salt) & m->mask]);
+    int64_t src;
+    uint32_t token;
+    if (m->find(sh.miss_signs[j] ^ salt, &src, &token)) {
+      sh.rst_src.push_back(src);
+      sh.rst_pos.push_back(j);
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// capacity split evenly across shards (first capacity % S shards get one
+// extra row); n_shards clamped to [1, min(64, capacity)]; threads clamped
+// to [1, n_shards]. part_salt is the PR 3 per-group salt — the partition
+// key that keeps routing consistent with the pending-ledger namespace.
+void* cache_create_sharded(int64_t capacity, int64_t n_shards,
+                           uint64_t part_salt, int64_t threads) {
+  if (capacity < 1) return nullptr;
+  if (n_shards < 1) n_shards = 1;
+  if (n_shards > SHARD_MAX) n_shards = SHARD_MAX;
+  if (n_shards > capacity) n_shards = capacity;
+  return new (std::nothrow) ShardedCache(capacity, n_shards, part_salt,
+                                         threads);
+}
+
+void cache_sharded_destroy(void* h) { delete static_cast<ShardedCache*>(h); }
+
+int64_t cache_sharded_len(void* h) {
+  ShardedCache& sc = *static_cast<ShardedCache*>(h);
+  int64_t total = 0;
+  for (auto& sh : sc.shards) {  // one shard mu at a time, never nested
+    std::lock_guard<std::mutex> lk(sh->mu);
+    total += sh->dir.count;
+  }
+  return total;
+}
+
+int64_t cache_sharded_capacity(void* h) {
+  return static_cast<ShardedCache*>(h)->total_capacity;
+}
+
+int64_t cache_sharded_n_shards(void* h) {
+  return static_cast<ShardedCache*>(h)->n_shards;
+}
+
+int64_t cache_sharded_threads(void* h) {
+  ShardedCache& sc = *static_cast<ShardedCache*>(h);
+  std::lock_guard<std::mutex> lk(sc.pool_mu);
+  return sc.n_threads;
+}
+
+void cache_sharded_set_threads(void* h, int64_t t) {
+  static_cast<ShardedCache*>(h)->set_threads(t);
+}
+
+void cache_sharded_set_admit_touches(void* h, int64_t t) {
+  ShardedCache& sc = *static_cast<ShardedCache*>(h);
+  for (auto& sh : sc.shards) {
+    std::lock_guard<std::mutex> lk(sh->mu);
+    Cache& c = sh->dir;
+    c.admit_touches = t < 1 ? 1 : (t > 255 ? 255 : t);
+    if (c.admit_touches > 1) c.ensure_touch_table();
+  }
+}
+
+// per-shard resident counts (out sized n_shards) — the stats surface
+void cache_sharded_shard_sizes(void* h, int64_t* out) {
+  ShardedCache& sc = *static_cast<ShardedCache*>(h);
+  for (int64_t s = 0; s < sc.n_shards; ++s) {
+    std::lock_guard<std::mutex> lk(sc.shards[s]->mu);
+    out[s] = sc.shards[s]->dir.count;
+  }
+}
+
+// per-shard walk time of the LAST feed in ns (out sized n_shards) — the
+// profile_feeder per-shard table and the feeder_shard_busy gauges
+void cache_sharded_shard_busy_ns(void* h, int64_t* out) {
+  ShardedCache& sc = *static_cast<ShardedCache*>(h);
+  for (int64_t s = 0; s < sc.n_shards; ++s)
+    out[s] = sc.shards[s]->busy_ns.load(std::memory_order_relaxed);
+}
+
+// read-only probe (no admit, no LRU touch): rows_out[i] = global row or -1.
+// One pass per shard so a probe never takes more than one lock at a time
+// and shares no scratch with a concurrent feed.
+void cache_sharded_probe(void* h, const uint64_t* signs, int64_t n,
+                         int64_t* rows_out) {
+  ShardedCache& sc = *static_cast<ShardedCache*>(h);
+  const int64_t S = sc.n_shards;
+  for (int64_t s = 0; s < S; ++s) {
+    FeedShard& sh = *sc.shards[s];
+    std::lock_guard<std::mutex> lk(sh.mu);
+    for (int64_t i = 0; i < n; ++i) {
+      if (S != 1 && shard_route(signs[i], sc.part_salt, S) != s) continue;
+      const int64_t pos = sh.dir.find_pos(signs[i]);
+      rows_out[i] = pos >= 0 ? sh.row_base + sh.dir.table[pos].row : -1;
+    }
+  }
+}
+
+// deduped-batch admit (the general path's surface): same contract as
+// cache_admit with global rows; miss_idx_out lists missing input indices
+// in shard-merged order (ascending shard, input order within a shard).
+// Returns -1 before mutating anything if any shard's routed distinct
+// count exceeds its capacity.
+int64_t cache_sharded_admit(void* h, const uint64_t* signs, int64_t n,
+                            int64_t* rows_out, int64_t* miss_idx_out,
+                            uint64_t* evict_signs_out, int64_t* evict_rows_out,
+                            int64_t* n_evict_out) {
+  ShardedCache& sc = *static_cast<ShardedCache*>(h);
+  *n_evict_out = 0;
+  const int64_t S = sc.n_shards;
+  std::vector<int64_t> routed(S, 0);
+  std::vector<uint8_t> sid(n);
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t s = S == 1 ? 0 : shard_route(signs[i], sc.part_salt, S);
+    sid[i] = (uint8_t)s;
+    ++routed[s];
+  }
+  for (int64_t s = 0; s < S; ++s)
+    if (routed[s] > sc.shards[s]->dir.capacity) return -1;
+  int64_t n_miss = 0, n_evict = 0;
+  std::vector<int64_t> local_miss;
+  for (int64_t s = 0; s < S; ++s) {
+    FeedShard& sh = *sc.shards[s];
+    std::lock_guard<std::mutex> lk(sh.mu);
+    Cache& c = sh.dir;
+    local_miss.clear();
+    for (int64_t i = 0; i < n; ++i) {
+      if (sid[i] != (uint8_t)s) continue;
+      const int64_t pos = c.find_pos(signs[i]);
+      if (pos >= 0) {
+        const int64_t r = c.table[pos].row;
+        c.touch(r);
+        rows_out[i] = sh.row_base + r;
+      } else if (!c.touch_admits(signs[i])) {
+        rows_out[i] = sc.total_capacity;  // global pad row
+      } else {
+        local_miss.push_back(i);
+      }
+    }
+    for (const int64_t i : local_miss) {
+      if (c.count >= c.capacity) {
+        uint64_t ev_sign;
+        const int64_t ev_row = c.evict_lru(&ev_sign);
+        evict_signs_out[n_evict] = ev_sign;
+        evict_rows_out[n_evict] = sh.row_base + ev_row;
+        ++n_evict;
+        c.free_rows.push_back(ev_row);
+      }
+      rows_out[i] = sh.row_base + c.insert(signs[i]);
+      miss_idx_out[n_miss++] = i;
+    }
+  }
+  *n_evict_out = n_evict;
+  return n_miss;
+}
+
+// resident (sign, global row) pairs, ascending shard order, MRU first
+// within a shard. Non-destructive.
+int64_t cache_sharded_snapshot(void* h, uint64_t* signs_out,
+                               int64_t* rows_out) {
+  ShardedCache& sc = *static_cast<ShardedCache*>(h);
+  int64_t k = 0;
+  for (auto& shp : sc.shards) {
+    FeedShard& sh = *shp;
+    std::lock_guard<std::mutex> lk(sh.mu);
+    const Cache& c = sh.dir;
+    for (int64_t r = c.lru_head; r >= 0; r = c.lru[r].next) {
+      signs_out[k] = c.row_sign[r];
+      rows_out[k] = sh.row_base + r;
+      ++k;
+    }
+  }
+  return k;
+}
+
+// drain every resident entry (flush-all at fences), same order as
+// snapshot, and empty every shard.
+int64_t cache_sharded_drain(void* h, uint64_t* signs_out, int64_t* rows_out) {
+  ShardedCache& sc = *static_cast<ShardedCache*>(h);
+  int64_t k = 0;
+  for (auto& shp : sc.shards) {
+    FeedShard& sh = *shp;
+    std::lock_guard<std::mutex> lk(sh.mu);
+    Cache& c = sh.dir;
+    for (int64_t r = c.lru_head; r >= 0; r = c.lru[r].next) {
+      signs_out[k] = c.row_sign[r];
+      rows_out[k] = sh.row_base + r;
+      ++k;
+    }
+    std::fill(c.table.begin(), c.table.end(), Cache::Slot{0, -1});
+    std::fill(c.lru.begin(), c.lru.end(), Cache::Link{-1, -1});
+    c.lru_head = c.lru_tail = -1;
+    c.count = 0;
+    c.free_rows.clear();
+    for (int64_t r = c.capacity - 1; r >= 0; --r) c.free_rows.push_back(r);
+  }
+  return k;
+}
+
+// The sharded, single-pass feeder entry point. Same outputs and contract
+// as cache_feed_batch (global rows; -1 on any shard's capacity overflow
+// with nothing admitted), plus the fused observe: when `sketches` carries
+// exactly n_shards AccessSketch handles, each shard's walk also lands its
+// batch (slot, sign) occurrences in its private sub-sketch (position i
+// belongs to slot_base + i / samples_per_slot, the flattened (S, B) group
+// matrix convention; samples_per_slot <= 0 sends everything to slot_base).
+// The fused observe attributes a sign to the slot of its FIRST position in
+// the batch — exact whenever sign -> slot is injective (slot-prefixed
+// signs, feature_index_prefix_bit > 0); the caller must keep the unfused
+// observe path when that invariant does not hold. Pass sketches = NULL
+// (or n_sketches != n_shards) to feed without observing. One feed per handle at a time — the caller serializes, as
+// with the legacy entry point; probes/stats may run concurrently.
+int64_t cache_feed_batch_sharded(
+    void* h, void* pending_h, const uint64_t* signs, int64_t n,
+    int32_t* rows_out, uint64_t* miss_signs_out, int64_t* miss_rows_out,
+    uint64_t* evict_signs_out, int64_t* evict_rows_out,
+    int64_t* n_unique_out, int64_t* n_evict_out, int64_t* restore_src_out,
+    int64_t* restore_pos_out, int64_t* n_restore_out, uint64_t salt,
+    void** sketches, int64_t n_sketches, int64_t samples_per_slot,
+    int64_t slot_base) {
+  ShardedCache& sc = *static_cast<ShardedCache*>(h);
+  *n_unique_out = *n_evict_out = *n_restore_out = 0;
+  const int64_t S = sc.n_shards;
+  const bool observing = sketches != nullptr && n_sketches == S;
+  // stable counting-sort bucketing: each shard's slice preserves input
+  // order, so the per-shard walk is a pure function of (signs, shard
+  // state) — independent of which thread runs it
+  sc.sid.resize((size_t)n);
+  sc.start.assign((size_t)S + 1, 0);
+  sc.fill.assign((size_t)S, 0);
+  sc.pos.resize((size_t)n);
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t s = S == 1 ? 0 : shard_route(signs[i], sc.part_salt, S);
+    sc.sid[i] = (uint8_t)s;
+    ++sc.start[s + 1];
+  }
+  for (int64_t s = 0; s < S; ++s) sc.start[s + 1] += sc.start[s];
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t s = sc.sid[i];
+    sc.pos[sc.start[s] + sc.fill[s]++] = i;
+  }
+  // phase A: dedup/touch walks (+ fused occurrence scratch). Barriered
+  // before phase B so an overflow anywhere bails before ANY shard admits.
+  sc.run_shards([&](int64_t s) {
+    FeedShard& sh = *sc.shards[s];
+    const auto t0 = std::chrono::steady_clock::now();
+    {
+      std::lock_guard<std::mutex> lk(sh.mu);
+      shard_pass1(sh, signs, rows_out, sc.pos.data(), sc.start[s],
+                  sc.start[s + 1], sc.total_capacity, observing,
+                  samples_per_slot, slot_base);
+    }
+    sh.busy_ns.store(std::chrono::duration_cast<std::chrono::nanoseconds>(
+                         std::chrono::steady_clock::now() - t0)
+                         .count(),
+                     std::memory_order_relaxed);
+  });
+  for (int64_t s = 0; s < S; ++s)
+    if (sc.shards[s]->overflow) return -1;
+  // phase B: admit + placeholder resolution under the shard mu, then the
+  // observe apply and ledger probe under their own (leaf) locks
+  sc.run_shards([&](int64_t s) {
+    FeedShard& sh = *sc.shards[s];
+    const auto t0 = std::chrono::steady_clock::now();
+    {
+      std::lock_guard<std::mutex> lk(sh.mu);
+      shard_pass2(sh, rows_out, sc.pos.data(), sc.start[s], sc.start[s + 1]);
+    }
+    shard_observe_apply(
+        sh, observing ? static_cast<AccessSketch*>(sketches[s]) : nullptr);
+    shard_ledger_probe(sh, static_cast<PendingMap*>(pending_h), salt);
+    sh.busy_ns.fetch_add(std::chrono::duration_cast<std::chrono::nanoseconds>(
+                             std::chrono::steady_clock::now() - t0)
+                             .count(),
+                         std::memory_order_relaxed);
+  });
+  // deterministic shard-order merge
+  int64_t n_miss = 0, n_unique = 0, n_evict = 0, n_restore = 0;
+  for (int64_t s = 0; s < S; ++s) {
+    FeedShard& sh = *sc.shards[s];
+    const int64_t miss_base = n_miss;
+    std::copy(sh.miss_signs.begin(), sh.miss_signs.end(),
+              miss_signs_out + n_miss);
+    std::copy(sh.miss_rows.begin(), sh.miss_rows.end(),
+              miss_rows_out + n_miss);
+    n_miss += (int64_t)sh.miss_signs.size();
+    std::copy(sh.ev_signs.begin(), sh.ev_signs.end(),
+              evict_signs_out + n_evict);
+    std::copy(sh.ev_rows.begin(), sh.ev_rows.end(), evict_rows_out + n_evict);
+    n_evict += (int64_t)sh.ev_signs.size();
+    for (size_t j = 0; j < sh.rst_pos.size(); ++j) {
+      restore_src_out[n_restore] = sh.rst_src[j];
+      restore_pos_out[n_restore] = miss_base + sh.rst_pos[j];
+      ++n_restore;
+    }
+    n_unique += sh.n_unique;
+  }
+  *n_unique_out = n_unique;
+  *n_evict_out = n_evict;
+  *n_restore_out = n_restore;
+  return n_miss;
+}
+
+// the per-slot top-K heavy-hitter list (signs + decayed cm estimates, out
+// arrays sized topk; unfilled entries are zero) — the Python side merges
+// per-shard sub-sketch lists deterministically. Returns topk or -1.
+int64_t sketch_slot_tops(void* h, int64_t slot, uint64_t* signs_out,
+                         double* ests_out) {
+  AccessSketch& sk = *static_cast<AccessSketch*>(h);
+  std::lock_guard<std::mutex> lk(sk.mu);
+  if (slot < 0 || slot >= sk.n_slots) return -1;
+  for (int64_t k = 0; k < sk.topk; ++k) {
+    signs_out[k] = sk.top_sign[(size_t)(slot * sk.topk + k)];
+    ests_out[k] = sk.top_est[(size_t)(slot * sk.topk + k)];
+  }
+  return sk.topk;
+}
+
+// routed observe over a sub-sketch family: sign i lands in
+// handles[shard_route(sign, part_salt, n_handles)], same partition as the
+// sharded feeder, so the UNFUSED paths (ServiceCtx per-slot observes, PS
+// slots) keep sub-sketch states consistent with the fused walk. One pass
+// per handle (one lock at a time). Returns signs observed (incl. ones
+// sampled away by each sketch's sample_k).
+int64_t sketch_observe_routed(void** handles, int64_t n_handles,
+                              uint64_t part_salt, const uint64_t* signs,
+                              int64_t n, int64_t samples_per_slot,
+                              int64_t slot_base) {
+  if (handles == nullptr || n_handles < 1) return 0;
+  if (n_handles == 1)
+    return sketch_observe(handles[0], signs, n, samples_per_slot, slot_base);
+  int64_t seen = 0;
+  for (int64_t hs = 0; hs < n_handles; ++hs) {
+    AccessSketch& sk = *static_cast<AccessSketch*>(handles[hs]);
+    std::lock_guard<std::mutex> lk(sk.mu);
+    const uint64_t k = (uint64_t)sk.sample_k;
+    for (int64_t i = 0; i < n; ++i) {
+      if (shard_route(signs[i], part_salt, n_handles) != hs) continue;
+      const int64_t slot =
+          slot_base + (samples_per_slot > 0 ? i / samples_per_slot : 0);
+      if (slot < 0 || slot >= sk.n_slots) continue;
+      if (k > 1 && splitmix64(signs[i] ^ SK_SAMPLE_SEED) % k != 0) {
+        ++seen;
+        continue;
+      }
+      const uint32_t est = sk.observe_w(slot, signs[i], k);
+      sk.maybe_top(slot, signs[i], est);
+      ++seen;
+    }
+  }
+  return seen;
 }
 
 }  // extern "C"

@@ -51,11 +51,36 @@ Order (outermost first):
                        compile subprocess + flock), so it is a leaf despite
                        being held the longest
 14. ``_REGISTRY_LOCK``— metrics registry (innermost leaf)
+
+Native mutexes (native/cache.cpp) live below every Python lock: a ctypes
+call can run under any ``with`` above (CONC005 audits which ones), and the
+native side never calls back into Python. ``NATIVE_LOCK_RANKS`` records
+the round-14 sharded-feeder order so the TSan harness and reviewers have
+one artifact to check the C++ against. The discipline is deliberately
+**never-nested**: a feed walker releases each mutex before taking the
+next — FeedShard::mu for the admit passes, then AccessSketch::mu for the
+fused observe apply, then PendingMap::mu for the ledger probe — and
+ShardedCache::pool_mu is only ever held around the dispatch/teardown
+handshake, never across a shard walk. The ranks therefore encode the
+SEQUENCE of a walker's acquisitions, not a nesting tree; any future change
+that nests two of them must follow this order (and will face TSan's
+deadlock detector in scripts/race_native.sh either way). Stats-plane
+readers (probe/len/snapshot/shard_sizes) take one FeedShard::mu at a time.
 """
 
 from __future__ import annotations
 
 from typing import Dict, Optional
+
+# native/cache.cpp mutex order (outermost / first-acquired first). These
+# are C++ fields, invisible to the AST lints above — the registry is the
+# documented contract the TSan gate exercises.
+NATIVE_LOCK_RANKS: Dict[str, int] = {
+    "pool_mu": 0,   # ShardedCache walker-pool handshake (dispatch only)
+    "mu@FeedShard": 10,    # per-shard directory + LRU + result buffers
+    "mu@AccessSketch": 20,  # count-min/bitmap/top-K (observe vs fence)
+    "mu@PendingMap": 30,   # hazard ledger (feeder probe vs write-back)
+}
 
 # attribute-name suffix -> rank (lower = must be taken first / outermost)
 LOCK_RANKS: Dict[str, int] = {

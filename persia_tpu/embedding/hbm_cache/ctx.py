@@ -96,6 +96,8 @@ class CachedTrainCtx:
         health_probe: Optional[bool] = None,
         health_clip_norm: Optional[float] = None,
         health_scrub_at_fence: Optional[bool] = None,
+        feed_threads: Optional[int] = None,
+        feed_shards: Optional[int] = None,
     ):
         self.model = model
         self.dense_optimizer = dense_optimizer
@@ -132,11 +134,20 @@ class CachedTrainCtx:
         self._ps_exclude: Set[str] = set(ps_slots)
         self._auto_tier = None
         self._pending_migration: Optional[Dict] = None
+        # sharded feeder (round 14): feed_threads sizes the native walker
+        # pool (None -> PERSIA_FEED_THREADS, pure throughput knob);
+        # feed_shards pins the directory partition count (None ->
+        # PERSIA_FEED_SHARDS, else 8 when threads > 1). The tier resolves
+        # the defaults; the RESOLVED values are remembered here so the
+        # fence-point migration rebuild reconstructs the same partition.
         self.tier = CachedEmbeddingTier(
             worker, self.sparse_cfg, cache_rows, embedding_config,
             init_seed=init_seed, ps_slots=ps_slots,
             admit_touches=admit_touches, aux_wire_dtype=aux_wire_dtype,
+            feed_threads=feed_threads, feed_shards=feed_shards,
         )
+        self._feed_threads = self.tier.feed_threads
+        self._feed_shards = self.tier.feed_shards
         # feature groups containing cached slots: the PS-side Adam beta
         # powers of EVERY one of them mirror the device's per-step advance
         self._cached_groups = tuple(sorted({
@@ -1033,6 +1044,12 @@ class CachedTrainCtx:
         self._auto_tier = controller
         self.tier.profiler = controller.profiler
 
+    def set_feed_threads(self, threads: int) -> None:
+        """Resize the sharded feeder's native walker pool (no-op on an
+        unsharded tier). Thread count never affects output bits."""
+        self._feed_threads = max(1, int(threads))
+        self.tier.set_feed_threads(self._feed_threads)
+
     @property
     def auto_tier(self):
         return self._auto_tier
@@ -1042,14 +1059,18 @@ class CachedTrainCtx:
         to_cached: Sequence[str] = (),
         to_ps: Sequence[str] = (),
         cache_rows: "int | Dict[int, int] | None" = None,
+        feed_shards: "int | None" = None,
     ) -> None:
         """Queue a manual tier migration; it applies at the NEXT stream
         snapshot fence (feeder parked, hazard ledger drained, manifest
         committed) — the only point where the PS provably holds the single
-        authoritative copy of every moving slot."""
+        authoritative copy of every moving slot. ``feed_shards`` reshards
+        the feed partition in the same rebuild (0 forces unsharded); the
+        drained fence is the only safe point, since resident rows cannot
+        survive a change of their shard row-ranges."""
         self._pending_migration = {
             "to_cached": tuple(to_cached), "to_ps": tuple(to_ps),
-            "cache_rows": cache_rows,
+            "cache_rows": cache_rows, "feed_shards": feed_shards,
         }
 
     def apply_migration(
@@ -1057,6 +1078,7 @@ class CachedTrainCtx:
         to_cached: Sequence[str] = (),
         to_ps: Sequence[str] = (),
         cache_rows: "int | Dict[int, int] | None" = None,
+        feed_shards: "int | None" = None,
     ) -> None:
         """Re-register slots between the cached and ps tiers. The cache
         MUST be cold (every directory drained — i.e. immediately after
@@ -1087,7 +1109,8 @@ class CachedTrainCtx:
         cached_now = {s for g in self.tier.groups for s in g.slots}
         to_cached &= set(self.tier.ps_slots)  # drop no-op moves
         to_ps &= cached_now
-        if not (to_cached or to_ps) and cache_rows is None:
+        if (not (to_cached or to_ps) and cache_rows is None
+                and feed_shards is None):
             return
         self._land_pending()
         for g in self.tier.groups:
@@ -1102,6 +1125,11 @@ class CachedTrainCtx:
         profiler = self.tier.profiler
         new_exclude = (self._ps_exclude | to_ps) - to_cached
         rows = self.cache_rows if cache_rows is None else cache_rows
+        # the drained fence is the ONLY safe point to change the feed
+        # partition (reshard): every directory is cold, so new shard
+        # row-ranges cannot orphan resident rows
+        if feed_shards is not None:
+            self._feed_shards = feed_shards if feed_shards >= 1 else None
         # the tier constructor re-validates the mixed-tier invariants
         # (feature-group disjointness, prefix-bit partitioning) against the
         # NEW placement — an invalid plan fails loudly here, pre-mutation
@@ -1110,8 +1138,15 @@ class CachedTrainCtx:
             init_seed=init_seed, ps_slots=sorted(new_exclude),
             admit_touches=self._admit_touches,
             aux_wire_dtype=self._aux_wire_dtype,
+            feed_threads=self._feed_threads,
+            feed_shards=self._feed_shards if self._feed_shards else 0,
         )
+        self._feed_shards = self.tier.feed_shards
         self.tier.profiler = profiler
+        # regrouping can move slots between group salts — keep the sharded
+        # profiler's routing consistent with the NEW directories
+        if profiler is not None and getattr(profiler, "shards", None):
+            profiler.set_slot_salts(self.tier.profiler_slot_salts())
         self.cache_rows = rows
         self._ps_exclude = new_exclude
         self._cached_groups = tuple(sorted({

@@ -51,7 +51,9 @@ def build_native(force: bool = False) -> str:
     from persia_tpu.embedding._native_build import build_so
 
     return build_so(
-        _SRC, _SO, ["-O3", "-std=c++17", "-fPIC", "-shared", "-Wall"],
+        # -pthread: the sharded feeder runs its shard walks on a native pool
+        _SRC, _SO, ["-O3", "-std=c++17", "-fPIC", "-shared", "-Wall",
+                    "-pthread"],
         logger, force=force,
     )
 
@@ -120,6 +122,45 @@ def _load_lib() -> ctypes.CDLL:
             p, p, _u64p, i64, _i32p, _u64p, _i64p, _u64p, _i64p,
             ctypes.POINTER(i64), ctypes.POINTER(i64),
             _i64p, _i64p, ctypes.POINTER(i64), ctypes.c_uint64,
+        ]
+        # ---- sharded feeder directory (round 14) ----
+        pp = ctypes.POINTER(p)  # void** — the per-shard sketch array
+        lib.cache_create_sharded.restype = p
+        lib.cache_create_sharded.argtypes = [i64, i64, ctypes.c_uint64, i64]
+        lib.cache_sharded_destroy.restype = None
+        lib.cache_sharded_destroy.argtypes = [p]
+        lib.cache_sharded_len.restype = i64
+        lib.cache_sharded_len.argtypes = [p]
+        lib.cache_sharded_capacity.restype = i64
+        lib.cache_sharded_capacity.argtypes = [p]
+        lib.cache_sharded_n_shards.restype = i64
+        lib.cache_sharded_n_shards.argtypes = [p]
+        lib.cache_sharded_threads.restype = i64
+        lib.cache_sharded_threads.argtypes = [p]
+        lib.cache_sharded_set_threads.restype = None
+        lib.cache_sharded_set_threads.argtypes = [p, i64]
+        lib.cache_sharded_set_admit_touches.restype = None
+        lib.cache_sharded_set_admit_touches.argtypes = [p, i64]
+        lib.cache_sharded_shard_sizes.restype = None
+        lib.cache_sharded_shard_sizes.argtypes = [p, _i64p]
+        lib.cache_sharded_shard_busy_ns.restype = None
+        lib.cache_sharded_shard_busy_ns.argtypes = [p, _i64p]
+        lib.cache_sharded_probe.restype = None
+        lib.cache_sharded_probe.argtypes = [p, _u64p, i64, _i64p]
+        lib.cache_sharded_admit.restype = i64
+        lib.cache_sharded_admit.argtypes = [
+            p, _u64p, i64, _i64p, _i64p, _u64p, _i64p, ctypes.POINTER(i64),
+        ]
+        lib.cache_sharded_snapshot.restype = i64
+        lib.cache_sharded_snapshot.argtypes = [p, _u64p, _i64p]
+        lib.cache_sharded_drain.restype = i64
+        lib.cache_sharded_drain.argtypes = [p, _u64p, _i64p]
+        lib.cache_feed_batch_sharded.restype = i64
+        lib.cache_feed_batch_sharded.argtypes = [
+            p, p, _u64p, i64, _i32p, _u64p, _i64p, _u64p, _i64p,
+            ctypes.POINTER(i64), ctypes.POINTER(i64),
+            _i64p, _i64p, ctypes.POINTER(i64), ctypes.c_uint64,
+            pp, i64, i64, i64,
         ]
         _LIB = lib
     return _LIB
@@ -232,21 +273,84 @@ class CacheDirectory:
     sign is admitted only on its Nth distinct-batch touch; earlier touches
     map to the pad row ``capacity`` (zero forward contribution, gradient
     dropped — the reference's non-admitted-sign semantics). Default 1 =
-    admit on first touch (exact parity with the ungated tier)."""
+    admit on first touch (exact parity with the ungated tier).
 
-    def __init__(self, capacity: int, admit_touches: int = 1):
+    ``shards`` — when set, the directory is partitioned into that many
+    independent shards (own mutex + LRU chain + row range) keyed by
+    ``shard_route(sign ^ part_salt)``; the feed walk can then run on the
+    native thread pool (``feed_threads``) and fuse the tiering sketch
+    observe into the same pass (``feed_batch(..., sketches=)``). Outputs
+    are merged in shard order, so they are bit-identical at ANY thread
+    count (but differ from the unsharded directory's LRU order for
+    ``shards > 1`` — ``shards`` must therefore be a jobstate-stable
+    choice, not derived from the host). ``shards=1`` is bit-identical to
+    the legacy directory. ``part_salt`` is the per-group ledger salt
+    (:func:`group_salt`) so partitioning rides the same namespace the
+    hazard ledger already uses."""
+
+    def __init__(self, capacity: int, admit_touches: int = 1,
+                 shards: Optional[int] = None, feed_threads: int = 1,
+                 part_salt: int = 0):
         self._lib = _load_lib()
-        self._h = self._lib.cache_create(capacity)
+        self.part_salt = int(part_salt) & (2**64 - 1)
+        self._sharded = shards is not None
+        if self._sharded:
+            self._h = self._lib.cache_create_sharded(
+                capacity, max(1, int(shards)), self.part_salt,
+                max(1, int(feed_threads)))
+            # the native side clamps shards to [1, min(64, capacity)]
+            self.shards: Optional[int] = int(
+                self._lib.cache_sharded_n_shards(self._h))
+        else:
+            self._h = self._lib.cache_create(capacity)
+            self.shards = None
         self.capacity = capacity
         self.admit_touches = int(admit_touches)
         if self.admit_touches > 1:
-            self._lib.cache_set_admit_touches(self._h, self.admit_touches)
+            if self._sharded:
+                self._lib.cache_sharded_set_admit_touches(
+                    self._h, self.admit_touches)
+            else:
+                self._lib.cache_set_admit_touches(self._h, self.admit_touches)
         # reusable admit_positions outputs: 5 scratch arrays (miss/evict
         # results are .copy()'d out, so a single reused buffer each is safe)
         # plus a ring for the per-position rows (which ESCAPE to the async
         # device staging path as views)
         self._scratch_n = 0
         self._rows_ring = _BufRing()
+
+    @property
+    def feed_threads(self) -> int:
+        return (int(self._lib.cache_sharded_threads(self._h))
+                if self._sharded else 1)
+
+    def set_feed_threads(self, threads: int) -> None:
+        """Resize the native walker pool (sharded mode only; clamped to
+        [1, shards]). Output bits never depend on this — it is purely a
+        throughput knob, safe to change between feeds."""
+        if self._sharded:
+            self._lib.cache_sharded_set_threads(self._h, max(1, int(threads)))
+
+    def shard_sizes(self) -> np.ndarray:
+        """Resident count per shard (sharded mode; (shards,) i64) — the
+        per-shard occupancy surfaced in stream stats and fence logs."""
+        if not self._sharded:
+            return np.array([len(self)], dtype=np.int64)
+        out = np.empty(self.shards, dtype=np.int64)
+        self._lib.cache_sharded_shard_sizes(
+            self._h, out.ctypes.data_as(_i64p))
+        return out
+
+    def shard_busy_ns(self) -> np.ndarray:
+        """Per-shard walk time of the LAST feed in ns (sharded mode) —
+        feeds the ``persia_tpu_feeder_shard_busy`` gauges + ``feed.shard``
+        spans."""
+        if not self._sharded:
+            return np.zeros(1, dtype=np.int64)
+        out = np.empty(self.shards, dtype=np.int64)
+        self._lib.cache_sharded_shard_busy_ns(
+            self._h, out.ctypes.data_as(_i64p))
+        return out
 
     def _ensure_scratch(self, n: int) -> None:
         if n <= self._scratch_n:
@@ -262,10 +366,15 @@ class CacheDirectory:
 
     def __del__(self):
         if getattr(self, "_h", None) is not None:
-            self._lib.cache_destroy(self._h)
+            if self._sharded:
+                self._lib.cache_sharded_destroy(self._h)
+            else:
+                self._lib.cache_destroy(self._h)
             self._h = None
 
     def __len__(self) -> int:
+        if self._sharded:
+            return self._lib.cache_sharded_len(self._h)
         return self._lib.cache_len(self._h)
 
     def admit(self, signs: np.ndarray):
@@ -283,7 +392,9 @@ class CacheDirectory:
         ev_signs = self._s_ev_signs
         ev_rows = self._s_ev_rows
         n_evict = ctypes.c_int64(0)
-        n_miss = self._lib.cache_admit(
+        admit_fn = (self._lib.cache_sharded_admit if self._sharded
+                    else self._lib.cache_admit)
+        n_miss = admit_fn(
             self._h, signs.ctypes.data_as(_u64p), n,
             rows.ctypes.data_as(_i64p), miss_idx.ctypes.data_as(_i64p),
             ev_signs.ctypes.data_as(_u64p), ev_rows.ctypes.data_as(_i64p),
@@ -303,6 +414,9 @@ class CacheDirectory:
         miss_signs (M,), miss_rows (M,), evict_signs (K,), evict_rows (K,),
         n_unique). One call replaces per-slot dedup + cross-slot dedup +
         admit + row LUT for the single-id fast path."""
+        if self._sharded:
+            out = self.feed_batch(signs, None)
+            return out[:6]
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
         n = signs.size
         self._ensure_scratch(n)
@@ -335,6 +449,8 @@ class CacheDirectory:
     def feed_batch(
         self, signs: np.ndarray, pending_map: "PendingSignMap | None",
         salt: int = 0,
+        sketches: Optional[Sequence] = None,
+        samples_per_slot: int = 0, slot_base: int = 0,
     ):
         """The feeder hot-loop fused call (``native/cache.cpp``
         ``cache_feed_batch``): everything ``admit_positions`` does PLUS the
@@ -349,7 +465,17 @@ class CacheDirectory:
 
         ``salt`` namespaces the ledger probe per cache group (the native
         side applies the SAME ``sign ^ salt`` the Python map methods do —
-        see :func:`group_salt`)."""
+        see :func:`group_salt`).
+
+        Sharded mode only: ``sketches`` (one per shard — native sketch
+        handles or objects carrying ``_h``) fuses the tiering observe into
+        the admit walk itself, one traversal of the sign matrix instead of
+        two. ``samples_per_slot``/``slot_base`` give the position → slot
+        map (position ``i`` → ``slot_base + i // samples_per_slot``). The
+        fused observe attributes a sign to the slot of its FIRST position
+        in the batch — callers must only fuse when sign → slot is
+        injective (``feature_index_prefix_bit > 0``) and keep the routed
+        unfused observe otherwise."""
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
         n = signs.size
         self._ensure_scratch(n)
@@ -358,8 +484,8 @@ class CacheDirectory:
         n_evict = ctypes.c_int64(0)
         n_restore = ctypes.c_int64(0)
         i32p = ctypes.POINTER(ctypes.c_int32)
-        n_miss = self._lib.cache_feed_batch(
-            self._h, pending_map._h if pending_map is not None else None,
+        pending_h = pending_map._h if pending_map is not None else None
+        common = (
             signs.ctypes.data_as(_u64p), n,
             rows.ctypes.data_as(i32p),
             self._s_miss_signs.ctypes.data_as(_u64p),
@@ -371,6 +497,24 @@ class CacheDirectory:
             self._s_rst_pos.ctypes.data_as(_i64p),
             ctypes.byref(n_restore), ctypes.c_uint64(salt & (2**64 - 1)),
         )
+        if self._sharded:
+            sk_arr, n_sk = None, 0
+            if sketches is not None:
+                handles = [getattr(s, "_h", s) for s in sketches]
+                if len(handles) != self.shards:
+                    raise ValueError(
+                        f"fused observe needs one sketch per shard "
+                        f"({self.shards}), got {len(handles)}")
+                sk_arr = (ctypes.c_void_p * len(handles))(*handles)
+                n_sk = len(handles)
+            n_miss = self._lib.cache_feed_batch_sharded(
+                self._h, pending_h, *common,
+                sk_arr, n_sk, int(samples_per_slot), int(slot_base),
+            )
+        else:
+            if sketches is not None:
+                raise ValueError("fused sketch observe needs shards= set")
+            n_miss = self._lib.cache_feed_batch(self._h, pending_h, *common)
         if n_miss < 0:
             raise RuntimeError(
                 f"batch distinct-sign count exceeds cache capacity "
@@ -392,8 +536,10 @@ class CacheDirectory:
         LRU touch — safe for eval/infer batches."""
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
         rows = np.empty(len(signs), dtype=np.int64)
-        self._lib.cache_probe(self._h, signs.ctypes.data_as(_u64p), len(signs),
-                              rows.ctypes.data_as(_i64p))
+        probe_fn = (self._lib.cache_sharded_probe if self._sharded
+                    else self._lib.cache_probe)
+        probe_fn(self._h, signs.ctypes.data_as(_u64p), len(signs),
+                 rows.ctypes.data_as(_i64p))
         return rows
 
     def drain(self) -> Tuple[np.ndarray, np.ndarray]:
@@ -401,8 +547,10 @@ class CacheDirectory:
         cap = self.capacity
         signs = np.empty(cap, dtype=np.uint64)
         rows = np.empty(cap, dtype=np.int64)
-        k = self._lib.cache_drain(self._h, signs.ctypes.data_as(_u64p),
-                                  rows.ctypes.data_as(_i64p))
+        drain_fn = (self._lib.cache_sharded_drain if self._sharded
+                    else self._lib.cache_drain)
+        k = drain_fn(self._h, signs.ctypes.data_as(_u64p),
+                     rows.ctypes.data_as(_i64p))
         return signs[:k].copy(), rows[:k].copy()
 
     def snapshot(self) -> Tuple[np.ndarray, np.ndarray]:
@@ -411,8 +559,10 @@ class CacheDirectory:
         cap = self.capacity
         signs = np.empty(cap, dtype=np.uint64)
         rows = np.empty(cap, dtype=np.int64)
-        k = self._lib.cache_snapshot(self._h, signs.ctypes.data_as(_u64p),
-                                     rows.ctypes.data_as(_i64p))
+        snap_fn = (self._lib.cache_sharded_snapshot if self._sharded
+                   else self._lib.cache_snapshot)
+        k = snap_fn(self._h, signs.ctypes.data_as(_u64p),
+                    rows.ctypes.data_as(_i64p))
         return signs[:k].copy(), rows[:k].copy()
 
 

@@ -785,6 +785,11 @@ def run_train_stream(
                     },
                     "pending_ledger_entries": len(sign_map),
                 }
+                if self.tier.feed_shards is not None:
+                    # per-shard directory occupancy + cumulative walk time:
+                    # a skewed shard here means the partition salt is
+                    # fighting the key distribution
+                    occupancy["feeder_shards"] = self.tier.feeder_shard_stats()
             if undrained:
                 errors.append(RuntimeError(
                     f"fence at step {gstep}: eviction ring spans still in "
@@ -1097,6 +1102,12 @@ def run_train_stream(
                     g.name: g.rows for g in self.tier.groups
                 },
             }
+            if self.tier.feed_shards is not None:
+                stats["feeder"] = {
+                    "feed_threads": self.tier.feed_threads,
+                    "feed_shards": self.tier.feed_shards,
+                    "shards": self.tier.feeder_shard_stats(),
+                }
         except Exception:  # noqa: BLE001 — stats are best-effort at teardown
             pass
         # dense-plane sync accounting (grad_sync.dense_sync_wire_bytes):

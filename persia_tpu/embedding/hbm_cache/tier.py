@@ -28,7 +28,7 @@ from persia_tpu.logger import get_default_logger
 from persia_tpu.utils import round_up_pow2 as _round_up_pow2
 from persia_tpu.metrics import get_metrics
 from persia_tpu.ops.sparse_update import sparse_update
-from persia_tpu.tracing import span
+from persia_tpu.tracing import record_span, span
 
 logger = get_default_logger("persia_tpu.hbm_cache")
 
@@ -72,6 +72,8 @@ class CachedEmbeddingTier:
         ps_slots: Sequence[str] = (),
         admit_touches: int = 1,
         aux_wire_dtype: str = "float32",
+        feed_threads: Optional[int] = None,
+        feed_shards: Optional[int] = None,
     ):
         self.worker = worker
         self.cfg = embedding_config or worker.embedding_config
@@ -139,15 +141,41 @@ class CachedEmbeddingTier:
                 "with prefix bit 0 a cached-tier sign can collide with a "
                 "PS-tier sign and the two tiers would race on one PS entry"
             )
-        self.dirs = {
-            g.name: CacheDirectory(g.rows, admit_touches=admit_touches)
-            for g in self.groups
-        }
         # per-group pending-ledger namespace salts (see directory.group_salt:
         # with feature_index_prefix_bit=0 raw signs can collide ACROSS
         # groups, and an unsalted hazard probe would restore the wrong
         # group's in-flight ring rows)
         self._group_salt = {g.name: group_salt(g.name) for g in self.groups}
+        # sharded feeder (round 14): feed_threads sizes the native walker
+        # pool (pure throughput knob — sharded outputs are bit-identical at
+        # any thread count); feed_shards partitions each group's directory
+        # by its group salt. The shard COUNT is numerics-affecting (row
+        # assignment differs from the unsharded walk for S > 1), so it is
+        # pinned independently of the thread count: enabling threads
+        # defaults S to 8, and a jobstate-resumed run must keep its S.
+        if feed_threads is None:
+            feed_threads = int(os.environ.get("PERSIA_FEED_THREADS", "1") or 1)
+        self.feed_threads = max(1, int(feed_threads))
+        if feed_shards is None:
+            env = os.environ.get("PERSIA_FEED_SHARDS", "")
+            if env:
+                feed_shards = int(env)
+            elif self.feed_threads > 1:
+                feed_shards = 8
+        if feed_shards is not None and int(feed_shards) < 1:
+            feed_shards = None  # PERSIA_FEED_SHARDS=0 forces unsharded
+        self.feed_shards = None if feed_shards is None else int(feed_shards)
+        self.dirs = {
+            g.name: CacheDirectory(
+                g.rows, admit_touches=admit_touches,
+                shards=self.feed_shards, feed_threads=self.feed_threads,
+                part_salt=self._group_salt[g.name],
+            )
+            for g in self.groups
+        }
+        if self.feed_shards is not None and self.dirs:
+            # the native side clamps shards to [1, min(64, capacity)]
+            self.feed_shards = next(iter(self.dirs.values())).shards
         # signs whose CURRENT cache row was born from a degraded (shard-
         # down) lookup: their eviction write-back must be DROPPED — the
         # row's lineage is a synthetic init vector, and persisting it would
@@ -192,6 +220,47 @@ class CachedEmbeddingTier:
             "persia_tpu_degraded_born_wb_rows_dropped",
             "cache write-back rows dropped because the row was born from a degraded lookup",
         )
+        self._m_shard_busy = m.gauge(
+            "persia_tpu_feeder_shard_busy",
+            "per-shard walk seconds of the last sharded feed (labels: group, shard)",
+        )
+
+    def set_feed_threads(self, threads: int) -> None:
+        """Resize every group directory's native walker pool. Output bits
+        never depend on the thread count — safe to change mid-job."""
+        self.feed_threads = max(1, int(threads))
+        for d in self.dirs.values():
+            d.set_feed_threads(self.feed_threads)
+
+    def profiler_slot_salts(self) -> Dict[str, int]:
+        """Partition salt per cached slot (its group's salt): the sharded
+        profiler must route a slot's unfused observes with the SAME salt
+        its group's directory partitions by, or the fused and unfused
+        observe paths would land the same sign in different sub-sketches."""
+        return {
+            s: self._group_salt[g.name] for g in self.groups for s in g.slots
+        }
+
+    def _note_shard_walk(self, gname: str, d: CacheDirectory) -> None:
+        """Publish the last feed's native-measured per-shard walk times:
+        one ``feed.shard`` span + one ``persia_tpu_feeder_shard_busy``
+        gauge sample per shard."""
+        for s, ns in enumerate(d.shard_busy_ns().tolist()):
+            self._m_shard_busy.set(ns * 1e-9, group=gname, shard=str(s))
+            record_span("feed.shard", ns * 1e-9, group=gname, shard=s)
+
+    def feeder_shard_stats(self) -> Dict[str, Dict[str, List[int]]]:
+        """Per-group per-shard occupancy + last-feed walk ns (sharded mode;
+        empty when unsharded) — surfaced in stream stats and fence logs."""
+        if self.feed_shards is None:
+            return {}
+        return {
+            g.name: {
+                "sizes": self.dirs[g.name].shard_sizes().tolist(),
+                "busy_ns": self.dirs[g.name].shard_busy_ns().tolist(),
+            }
+            for g in self.groups
+        }
 
     @property
     def router(self) -> ShardedLookup:
@@ -297,12 +366,13 @@ class CachedEmbeddingTier:
         constant prefix changes neither totals nor distinct counts."""
         if self.profiler is None or not self.ps_slots:
             return
-        for f in batch.id_type_features:
-            if f.name in self.ps_slots:
-                flat, _counts = f.flat_counts()
-                self.profiler.observe_slot(
-                    f.name, np.ascontiguousarray(flat, dtype=np.uint64)
-                )
+        with span("cache.sketch_observe", group="__ps__"):
+            for f in batch.id_type_features:
+                if f.name in self.ps_slots:
+                    flat, _counts = f.flat_counts()
+                    self.profiler.observe_slot(
+                        f.name, np.ascontiguousarray(flat, dtype=np.uint64)
+                    )
 
     def _group_slots(self, pb: ProcessedBatch) -> Dict[str, List[ProcessedSlot]]:
         out: Dict[str, List[ProcessedSlot]] = {}
@@ -584,12 +654,13 @@ class CachedEmbeddingTier:
                 continue
             C = g.rows
             if self.profiler is not None:
-                for slot in slots:
-                    # position-level stream: distinct[inverse] rebuilds the
-                    # raw (duplicated) sign sequence frequencies need
-                    self.profiler.observe_slot(
-                        slot.name, slot.distinct[slot.inverse]
-                    )
+                with span("cache.sketch_observe", group=g.name):
+                    for slot in slots:
+                        # position-level stream: distinct[inverse] rebuilds
+                        # the raw (duplicated) sign sequence frequencies need
+                        self.profiler.observe_slot(
+                            slot.name, slot.distinct[slot.inverse]
+                        )
             all_signs, uniq, inv = self._dedup_group_signs(slots)
             rows_u, miss_idx, ev_signs, ev_rows = self.dirs[g.name].admit(uniq)
             rows = rows_u[inv]  # per original (slot-concatenated) position
@@ -665,25 +736,56 @@ class CachedEmbeddingTier:
 
         for g, names, mat in fast:
             S, B = mat.shape
-            if self.profiler is not None:
+            d = self.dirs[g.name]
+            # fused sketch observe (round 14): when the directory is
+            # sharded and the profiler carries a matching sub-sketch
+            # family, the observe rides the admit walk itself — one
+            # traversal of the sign matrix instead of two. The fused walk
+            # attributes a sign to its first position's slot, exact only
+            # when sign -> slot is injective, hence the prefix-bit gate;
+            # otherwise (and on the general/ServiceCtx paths) the routed
+            # unfused observe keeps the same sub-sketch state.
+            fuse_base = None
+            if (
+                self.profiler is not None
+                and d.shards is not None
+                and getattr(self.profiler, "shards", None) == d.shards
+                and self.cfg.feature_index_prefix_bit > 0
+            ):
+                fuse_base = self.profiler.group_contiguous_base(names)
+            if self.profiler is not None and fuse_base is None:
                 # the (S, B) matrix attributes positions to slots by stride
                 # — ONE native observe for the whole group
-                self.profiler.observe_group(names, mat.reshape(-1), B)
+                with span("cache.sketch_observe", group=g.name, n=mat.size):
+                    self.profiler.observe_group(names, mat.reshape(-1), B)
+            sketches = self.profiler.sketches if fuse_base is not None else None
             gate = hazard_gate
             if pending_map is not None:
                 salt = self._group_salt[g.name]
-                with span("cache.admit", group=g.name, n=mat.size):
+                with span("cache.admit", group=g.name, n=mat.size,
+                          fused_observe=fuse_base is not None):
                     (rows, miss_signs, miss_rows, ev_signs, ev_rows, n_unique,
-                     rst_src, rst_pos) = self.dirs[g.name].feed_batch(
-                        mat.reshape(-1), pending_map, salt=salt
+                     rst_src, rst_pos) = d.feed_batch(
+                        mat.reshape(-1), pending_map, salt=salt,
+                        sketches=sketches, samples_per_slot=B,
+                        slot_base=fuse_base or 0,
                     )
                 gate = _make_reval_gate(pending_map, rst_pos, salt)
+            elif sketches is not None:
+                with span("cache.admit", group=g.name, n=mat.size,
+                          fused_observe=True):
+                    (rows, miss_signs, miss_rows, ev_signs, ev_rows,
+                     n_unique) = d.feed_batch(
+                        mat.reshape(-1), None,
+                        sketches=sketches, samples_per_slot=B,
+                        slot_base=fuse_base,
+                    )[:6]
             else:
                 with span("cache.admit", group=g.name, n=mat.size):
                     (rows, miss_signs, miss_rows, ev_signs, ev_rows,
-                     n_unique) = self.dirs[g.name].admit_positions(
-                        mat.reshape(-1)
-                    )
+                     n_unique) = d.admit_positions(mat.reshape(-1))
+            if d.shards is not None:
+                self._note_shard_walk(g.name, d)
             with span("cache.admit_aux", group=g.name, misses=len(miss_signs)):
                 self._admit_aux(
                     g, miss_signs, miss_rows, ev_signs, ev_rows, n_unique,

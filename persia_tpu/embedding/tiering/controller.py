@@ -151,7 +151,14 @@ def enable_auto_tier(
     slot_order = [s for g in tier.groups for s in g.slots] + sorted(
         s for s in tier.ps_slots
     )
-    profiler = AccessProfiler(slot_order, **(profiler_kwargs or {}))
+    kwargs = dict(profiler_kwargs or {})
+    # sharded tier -> sharded profiler (one sub-sketch per directory
+    # shard, routed by each slot's group salt) so the observe can fuse
+    # into the sharded feed walk; explicit profiler_kwargs still win
+    if getattr(tier, "feed_shards", None) and "shards" not in kwargs:
+        kwargs["shards"] = tier.feed_shards
+        kwargs.setdefault("slot_salts", tier.profiler_slot_salts())
+    profiler = AccessProfiler(slot_order, **kwargs)
     lockstep = [
         list(members)
         for members in ctx.embedding_config.feature_groups.values()

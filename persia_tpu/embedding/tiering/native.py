@@ -58,8 +58,34 @@ def _load_lib() -> ctypes.CDLL:
         lib.sketch_export.argtypes = [p, _u8p, i64]
         lib.sketch_import.restype = i64
         lib.sketch_import.argtypes = [p, _u8p, i64]
+        lib.sketch_set_sample.restype = None
+        lib.sketch_set_sample.argtypes = [p, i64]
+        lib.sketch_slot_tops.restype = i64
+        lib.sketch_slot_tops.argtypes = [p, i64, _u64p, _f64p]
+        lib.sketch_observe_routed.restype = i64
+        lib.sketch_observe_routed.argtypes = [
+            ctypes.POINTER(p), i64, ctypes.c_uint64, _u64p, i64, i64, i64,
+        ]
         _LIB = lib
     return _LIB
+
+
+_M64 = (1 << 64) - 1
+
+
+def splitmix64(x: int) -> int:
+    """Python mirror of the native ``splitmix64`` (native/cache.cpp)."""
+    x = (x + 0x9E3779B97F4A7C15) & _M64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _M64
+    return x ^ (x >> 31)
+
+
+def shard_route(sign: int, part_salt: int, n_shards: int) -> int:
+    """Python mirror of the native ``shard_route`` — the mulhi partition
+    the sharded feed directory and the sub-sketch family share. Must stay
+    bit-identical to the C++ side (pinned by tests)."""
+    return (splitmix64((int(sign) ^ int(part_salt)) & _M64) * n_shards) >> 64
 
 
 class NativeSketch:
@@ -84,6 +110,7 @@ class NativeSketch:
                 f"bitmap_bits={bitmap_bits}, topk={topk})"
             )
         self.n_slots = int(n_slots)
+        self.topk = int(topk)
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -105,6 +132,30 @@ class NativeSketch:
 
     def decay(self, factor: float) -> None:
         self._lib.sketch_decay(self._h, float(factor))
+
+    def set_sample(self, k: int) -> None:
+        """``PERSIA_SKETCH_SAMPLE=1/k`` observe sampling: only signs with
+        ``hash(sign) % k == 0`` touch the count-min, every increment scaled
+        by k — totals/cm/unique stay unbiased in expectation while the
+        unfused observe walk costs 1/k of its DRAM traffic. The hash gate
+        is sign-deterministic, so repeated observes of a hot sign are
+        consistently kept or consistently skipped (no per-call jitter in
+        its estimate). Native clamps k to [1, 2**20]."""
+        self._lib.sketch_set_sample(self._h, int(k))
+
+    def slot_tops(self, slot: int) -> tuple:
+        """(signs (topk,) u64, ests (topk,) f64) heavy-hitter list for one
+        slot; unfilled entries are zero. Used to merge per-shard sub-sketch
+        lists deterministically in the sharded profiler."""
+        signs = np.zeros(self.topk, dtype=np.uint64)
+        ests = np.zeros(self.topk, dtype=np.float64)
+        rc = self._lib.sketch_slot_tops(
+            self._h, int(slot), signs.ctypes.data_as(_u64p),
+            ests.ctypes.data_as(_f64p),
+        )
+        if rc < 0:
+            raise IndexError(f"slot {slot} out of range [0, {self.n_slots})")
+        return signs, ests
 
     def slot_stats(self, slot: int) -> tuple:
         """(total, unique_est, hot_frac, top1_frac) for one slot index."""
@@ -141,3 +192,22 @@ class NativeSketch:
                 "sketch_import: blob geometry does not match this sketch "
                 "(profiler config changed across the snapshot?)"
             )
+
+
+def observe_routed(
+    sketches, part_salt: int, signs: np.ndarray,
+    samples_per_slot: int, slot_base: int,
+) -> int:
+    """Observe a sign stream into a per-shard sub-sketch family, routing
+    each sign with the SAME ``shard_route(sign ^ part_salt)`` the sharded
+    feed directory uses — the unfused paths (ServiceCtx, PS-tier slots)
+    land updates in the same sub-sketch the fused walk would, so the two
+    observe paths share state instead of forking it."""
+    signs = np.ascontiguousarray(signs, dtype=np.uint64)
+    handles = [s._h for s in sketches]
+    arr = (ctypes.c_void_p * len(handles))(*handles)
+    return int(_load_lib().sketch_observe_routed(
+        arr, len(handles), ctypes.c_uint64(int(part_salt) & (2**64 - 1)),
+        signs.ctypes.data_as(_u64p), signs.size,
+        int(samples_per_slot), int(slot_base),
+    ))

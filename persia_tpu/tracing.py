@@ -205,6 +205,39 @@ def span(name: str, **attrs):
             h.observe(dur, stage=name)
 
 
+def record_span(name: str, dur_s: float, **attrs) -> None:
+    """Record a span whose duration was measured EXTERNALLY (e.g. the
+    native sharded-feed walker reports per-shard walk ns from inside the
+    thread pool — wrapping the ctypes call in :func:`span` would time the
+    whole dispatch, not the shard). The span ends "now"; its start is
+    back-dated by the given duration. No-op when tracing is off."""
+    if not _enabled:
+        return
+    st = _stack()
+    if st:
+        trace_id, parent = st[-1]
+    else:
+        trace_id, parent = _gen_id(16), None
+    args = {k: str(v) for k, v in attrs.items()}
+    args["trace_id"] = trace_id
+    args["span_id"] = _gen_id(8)
+    if parent:
+        args["parent_id"] = parent
+    with _lock:
+        _spans.append({
+            "name": name,
+            "ph": "X",
+            "ts": time.time() * 1e6 - dur_s * 1e6,
+            "dur": dur_s * 1e6,
+            "pid": os.getpid(),
+            "tid": threading.get_ident() % 2**31,
+            "args": args,
+        })
+    h = _get_histogram()
+    if h:
+        h.observe(dur_s, stage=name)
+
+
 @contextmanager
 def stage_span(name: str, **attrs):
     """Pipeline-stage timer that ALWAYS feeds the live stage histogram

@@ -46,6 +46,11 @@ for name, builder in (("ps", native_store.build_native),
     print(name, builder(force=True))
 PY
 JAX_PLATFORMS=cpu python -m pytest tests/test_native_feed.py -q
+# sharded-feeder parity goldens against the cores just force-rebuilt:
+# shard-route Python/C++ mirror, S=1 bitwise-vs-legacy, thread-count
+# bit-invariance, fused-observe equivalence, sampling convergence
+# (~1s; the ctx-level reshard/kill-resume parity runs ride step 2)
+JAX_PLATFORMS=cpu python -m pytest tests/test_sharded_feeder.py -q
 # UBSan variant of the full parity surface (~10s incl. variant builds);
 # SANITIZE_ASAN rides the same script when PREFLIGHT_ASAN=1
 SANITIZE_ASAN="${PREFLIGHT_ASAN:-0}" bash scripts/sanitize_native.sh
@@ -64,8 +69,10 @@ echo "== 1/5 chaos suite (fast schedules + resume-chaos + serving-chaos) =="
 # integrity/resync); the full kill+resets, trainer-SIGKILL bitwise runs,
 # and the zipfian online soak (benchmarks/online_bench.py) ride slow.
 # tests/test_tiering.py rides here too — the fast subset (sketch accuracy,
-# planner hysteresis/lockstep, controller rounds, snapshot roundtrip);
-# the four multi-second stream/e2e/bit-parity runs stay in the full suite
+# planner hysteresis/lockstep, controller rounds, snapshot roundtrip, the
+# sharded-feeder env knobs); the multi-second stream/e2e/bit-parity runs —
+# incl. the round-14 fused-observe invariance, reshard-at-fence and
+# sharded kill/resume parity ctx runs — stay in the full suite
 # tests/test_health.py rides here too — the fast subset (validator +
 # quarantine, sentinel ladder/dedupe, scrubber exactly-once, delta
 # rejection, NUM001, data-plane chaos determinism); the two multi-second
@@ -76,6 +83,9 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_failure_recove
     --deselect tests/test_tiering.py::test_auto_tier_demotes_cold_slot_and_survives_resume \
     --deselect tests/test_tiering.py::test_migration_bit_parity_with_fresh_placement_resume \
     --deselect tests/test_tiering.py::test_fence_manifest_carries_tiering_component \
+    --deselect tests/test_tiering.py::test_sharded_feeder_fused_observe_and_thread_invariance \
+    --deselect tests/test_tiering.py::test_reshard_at_fence_parity_with_fresh_resume \
+    --deselect tests/test_tiering.py::test_sharded_feeder_kill_resume_parity \
     --deselect tests/test_health.py::test_poisoned_stream_rollback_bit_parity \
     --deselect tests/test_health.py::test_on_device_nonfinite_skip_rung
 # stage-graph fast subset: the pipeline's hazard/window/drain/rebuild unit

@@ -728,3 +728,35 @@ def test_ubsan_variant_builds_distinct_artifact(tmp_path, monkeypatch):
     lib.tiny_add.restype = ctypes.c_int64
     lib.tiny_add.argtypes = [ctypes.c_int64, ctypes.c_int64]
     assert lib.tiny_add(40, 2) == 42
+
+
+def test_native_lock_ranks_match_cache_cpp():
+    """The round-14 native mutex registry must track the C++ it documents:
+    every ranked field exists in native/cache.cpp on the struct the rank
+    names, and the ranks encode the walker's acquisition sequence
+    (pool handshake -> shard -> sketch -> ledger) strictly."""
+    import os
+    import re
+
+    from persia_tpu.analysis.common import REPO_ROOT
+    from persia_tpu.analysis.lock_order import LOCK_RANKS, NATIVE_LOCK_RANKS
+
+    src = open(os.path.join(REPO_ROOT, "native", "cache.cpp")).read()
+    ranks = []
+    for key, rank in NATIVE_LOCK_RANKS.items():
+        field, _, owner = key.partition("@")
+        if owner:
+            body = re.search(
+                r"struct %s\b.*?\n};" % re.escape(owner), src, re.S
+            )
+            assert body, f"struct {owner} gone from cache.cpp"
+            assert re.search(
+                r"std::mutex\s+%s\b" % re.escape(field), body.group(0)
+            ), f"{owner}.{field} is not a mutex field anymore"
+        else:
+            assert re.search(r"std::mutex\s+%s\b" % re.escape(field), src)
+        ranks.append(rank)
+    assert ranks == sorted(ranks) and len(set(ranks)) == len(ranks)
+    # the native plane sits below every Python lock: no shared names that
+    # would make rank_of() ambiguous about which registry it answers from
+    assert not set(NATIVE_LOCK_RANKS) & set(LOCK_RANKS)

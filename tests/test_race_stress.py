@@ -111,6 +111,22 @@ def cache_lib():
     _sig(lib, "sketch_export_size", _i64, [_p])
     _sig(lib, "sketch_export", _i64, [_p, _u8p, _i64])
     _sig(lib, "sketch_import", _i64, [_p, _u8p, _i64])
+    _sig(lib, "sketch_set_sample", None, [_p, _i64])
+    # round 14: the sharded feeder surface
+    _sig(lib, "cache_create_sharded", _p, [_i64, _i64, _u64, _i64])
+    _sig(lib, "cache_sharded_destroy", None, [_p])
+    _sig(lib, "cache_sharded_len", _i64, [_p])
+    _sig(lib, "cache_sharded_threads", _i64, [_p])
+    _sig(lib, "cache_sharded_set_threads", None, [_p, _i64])
+    _sig(lib, "cache_sharded_probe", None, [_p, _u64p, _i64, _i64p])
+    _sig(lib, "cache_sharded_shard_sizes", None, [_p, _i64p])
+    _sig(lib, "cache_sharded_shard_busy_ns", None, [_p, _i64p])
+    _sig(lib, "cache_sharded_drain", _i64, [_p, _u64p, _i64p])
+    _sig(lib, "cache_feed_batch_sharded", _i64, [
+        _p, _p, _u64p, _i64, _i32p, _u64p, _i64p, _u64p, _i64p,
+        _i64p, _i64p, _i64p, _i64p, _i64p, _u64,
+        ctypes.POINTER(_p), _i64, _i64, _i64,
+    ])
     return lib
 
 
@@ -528,3 +544,183 @@ def test_tsan_canary_detects_seeded_race(tmp_path):
         f"of this run is void. stdout={proc.stdout!r} stderr={proc.stderr!r}"
     )
     assert "ThreadSanitizer" in proc.stderr
+
+
+# ----------------------------------- round 14: sharded feeder vs the world
+
+
+def test_sharded_feed_vs_probe_evict_sketch_decay(cache_lib):
+    """The round-14 thread plane, concentrated: ONE feeder thread drives
+    ``cache_feed_batch_sharded`` (4 shards, its OWN native walker pool,
+    the sketch observe FUSED into the walk across 4 sub-sketches, the
+    hazard ledger probed under the PendingMap mutex) while sibling threads
+    hammer every reader the production stream runs concurrently —
+    ``cache_sharded_probe`` + per-shard occupancy/busy gauges (stats
+    plane), ledger query/remove (write-back plane), and sub-sketch
+    decay/slot_stats/export (fence plane). The feeder also resizes its
+    walker pool mid-run (the ``set_feed_threads`` path, legal only from
+    the feed caller) — pool teardown/rebuild must be invisible to the
+    concurrent readers. TSan judges the shard mutexes, the pool handshake
+    and the sketch mutexes; the functional assertions pin occupancy and
+    estimator sanity."""
+    lib = cache_lib
+    cap = 1 << 12
+    S = 4
+    n_slots = 4
+    salt = 0xD00DFEEDFACE1234
+    sc = lib.cache_create_sharded(cap, S, _u64(salt), 2)
+    pending = lib.pending_map_create()
+    sks = [lib.sketch_create(n_slots, 12, 4, 1 << 11, 8) for _ in range(S)]
+    assert sc and pending and all(sks)
+    lib.sketch_set_sample(sks[0], 4)  # one sampled sub-sketch in the mix
+    sk_arr = (_p * S)(*sks)
+    stop = threading.Event()
+    spans = []
+    spans_lock = threading.Lock()
+
+    def feeder():
+        rng = np.random.default_rng(SEED)
+        rows = np.empty(BATCH, np.int32)
+        miss_s = np.empty(BATCH, np.uint64)
+        miss_r = np.empty(BATCH, np.int64)
+        ev_s = np.empty(cap, np.uint64)
+        ev_r = np.empty(cap, np.int64)
+        rest_src = np.empty(BATCH, np.int64)
+        rest_pos = np.empty(BATCH, np.int64)
+        n_unique = _i64(0)
+        n_evict = _i64(0)
+        n_restore = _i64(0)
+        drain_s = np.empty(cap, np.uint64)
+        drain_r = np.empty(cap, np.int64)
+        try:
+            for it in range(ITERS * 4):
+                if it % 16 == 8:
+                    # single-writer contract: only the feed caller may
+                    # resize the pool (joins the walker threads)
+                    lib.cache_sharded_set_threads(sc, 1 + (it // 16) % S)
+                hot = rng.integers(0, 512, BATCH // 2, dtype=np.uint64)
+                cold = rng.integers(it * 64, it * 64 + (1 << 14),
+                                    BATCH // 2, dtype=np.uint64)
+                signs = _u64arr(np.concatenate([hot, cold]))
+                n_miss = lib.cache_feed_batch_sharded(
+                    sc, pending, signs.ctypes.data_as(_u64p), BATCH,
+                    rows.ctypes.data_as(_i32p),
+                    miss_s.ctypes.data_as(_u64p), miss_r.ctypes.data_as(_i64p),
+                    ev_s.ctypes.data_as(_u64p), ev_r.ctypes.data_as(_i64p),
+                    ctypes.byref(n_unique), ctypes.byref(n_evict),
+                    rest_src.ctypes.data_as(_i64p),
+                    rest_pos.ctypes.data_as(_i64p),
+                    ctypes.byref(n_restore), _u64(salt),
+                    sk_arr, S, BATCH // n_slots, 0,
+                )
+                assert 0 <= n_miss <= BATCH
+                assert 0 <= n_restore.value <= n_miss
+                assert 0 < n_unique.value <= BATCH
+                ne = n_evict.value
+                if ne:
+                    evicted = _u64arr(ev_s[:ne] ^ np.uint64(salt))
+                    token = _u32(it & 0xFFFFFFFF)
+                    lib.pending_map_insert_range(
+                        pending, evicted.ctypes.data_as(_u64p), ne,
+                        it * cap, token,
+                    )
+                    with spans_lock:
+                        spans.append((evicted, token))
+                if it % 64 == 63:
+                    # eviction-heavy churn: cold-restart the directory
+                    # (drain is feed-caller-only, like the stream fence)
+                    nd = lib.cache_sharded_drain(
+                        sc, drain_s.ctypes.data_as(_u64p),
+                        drain_r.ctypes.data_as(_i64p),
+                    )
+                    assert 0 <= nd <= cap
+        finally:
+            stop.set()
+
+    def prober(tid):
+        def run():
+            rng = np.random.default_rng(SEED + 200 + tid)
+            rows = np.empty(256, np.int64)
+            sizes = np.empty(S, np.int64)
+            busy = np.empty(S, np.int64)
+            while not stop.is_set():
+                probe = _u64arr(
+                    rng.integers(0, 1 << 14, 256, dtype=np.uint64)
+                )
+                lib.cache_sharded_probe(
+                    sc, probe.ctypes.data_as(_u64p), 256,
+                    rows.ctypes.data_as(_i64p),
+                )
+                assert ((rows >= -1) & (rows < cap)).all()
+                lib.cache_sharded_shard_sizes(sc, sizes.ctypes.data_as(_i64p))
+                assert 0 <= sizes.sum() <= cap
+                lib.cache_sharded_shard_busy_ns(sc, busy.ctypes.data_as(_i64p))
+                assert (busy >= 0).all()
+                assert 1 <= lib.cache_sharded_threads(sc) <= S
+                assert 0 <= lib.cache_sharded_len(sc) <= cap
+        return run
+
+    def fencer(tid):
+        def run():
+            stats = np.empty(4, np.float64)
+            buf = np.empty(1 << 20, np.uint8)
+            i = 0
+            while not stop.is_set():
+                i += 1
+                sk = sks[(tid + i) % S]
+                if i % 3 == 0:
+                    lib.sketch_decay(sk, 0.7)
+                for slot in range(n_slots):
+                    assert lib.sketch_slot_stats(
+                        sk, slot, stats.ctypes.data_as(_f64p)
+                    ) == 0
+                    assert stats[0] >= 0.0 and stats[1] >= 0.0
+                size = lib.sketch_export_size(sk)
+                assert 0 < size <= buf.size
+                assert lib.sketch_export(
+                    sk, buf.ctypes.data_as(_u8p), buf.size
+                ) == size
+        return run
+
+    def writeback(tid):
+        def run():
+            rng = np.random.default_rng(SEED + 100 + tid)
+            tokens = np.empty(BATCH, np.uint32)
+            srcs = np.empty(BATCH, np.int64)
+            while not stop.is_set() or spans:
+                with spans_lock:
+                    span = spans.pop() if spans else None
+                if span is None:
+                    probe = _u64arr(
+                        rng.integers(0, 1 << 14, 64, dtype=np.uint64)
+                    )
+                    lib.pending_map_query(
+                        pending, probe.ctypes.data_as(_u64p), 64,
+                        tokens.ctypes.data_as(_u32p),
+                        srcs.ctypes.data_as(_i64p),
+                    )
+                    continue
+                signs, token = span
+                n = len(signs)
+                hits = lib.pending_map_query(
+                    pending, signs.ctypes.data_as(_u64p), n,
+                    tokens.ctypes.data_as(_u32p), srcs.ctypes.data_as(_i64p),
+                )
+                assert 0 <= hits <= n
+                lib.pending_map_remove(
+                    pending, signs.ctypes.data_as(_u64p), n, token
+                )
+        return run
+
+    _run_threads(
+        [feeder]
+        + [writeback(t) for t in range(3)]
+        + [prober(t) for t in range(2)]
+        + [fencer(t) for t in range(2)]
+    )
+    assert lib.pending_map_size(pending) >= 0
+    assert lib.cache_sharded_len(sc) <= cap
+    for sk in sks:
+        lib.sketch_destroy(sk)
+    lib.pending_map_destroy(pending)
+    lib.cache_sharded_destroy(sc)

@@ -1,0 +1,458 @@
+"""Round-14 sharded multi-core feeder: the partitioned admit directory
+(``native/cache.cpp ShardedCache`` + ``cache_feed_batch_sharded``) and the
+sketch observe fused into the same native walk.
+
+The contracts pinned here:
+
+  * the Python ``shard_route`` mirror and the native mulhi partition agree
+    bit-for-bit (the partition IS the numerics: it decides each sign's
+    row-range and sub-sketch);
+  * ``shards=1`` reproduces the legacy single-directory walk EXACTLY —
+    rows, miss order, eviction victims, hazard-ledger restores;
+  * outputs are invariant in ``feed_threads`` — the merge order is shard
+    order, never thread arrival order — so row LUT, eviction list and
+    ledger contents are bit-identical at any thread count;
+  * the fused observe (riding the admit scratch) lands every update in the
+    same sub-sketch cell the standalone routed observe would: identical
+    exported sketch state;
+  * ``PERSIA_SKETCH_SAMPLE=1/k`` keeps totals/uniques/heavy-hitter
+    estimates convergent on a zipf stream while observing 1/k of signs.
+"""
+
+import numpy as np
+import pytest
+
+hbm = pytest.importorskip("persia_tpu.embedding.hbm_cache")
+
+from persia_tpu.embedding.hbm_cache.directory import (  # noqa: E402
+    CacheDirectory,
+    PendingSignMap,
+    group_salt,
+)
+from persia_tpu.embedding.tiering.native import (  # noqa: E402
+    NativeSketch,
+    observe_routed,
+    shard_route,
+    splitmix64,
+)
+from persia_tpu.embedding.tiering.profiler import (  # noqa: E402
+    AccessProfiler,
+    sketch_sample_k,
+)
+
+SALT = group_salt("cache_d8")
+
+
+def _zipf(rng, n, mod=220, a=1.2):
+    return (rng.zipf(a, n) % mod).astype(np.uint64)
+
+
+def _feed(d, signs, pmap, salt=0):
+    """feed_batch with the ring-buffer row LUT copied out."""
+    out = d.feed_batch(signs, pmap, salt=salt)
+    return (out[0].copy(),) + tuple(out[1:])
+
+
+# ------------------------------------------------------------ the partition
+
+
+def test_shard_route_python_matches_native_partition():
+    """Feed distinct signs into a sharded directory and check the native
+    per-shard occupancy equals the Python-mirror route histogram — the two
+    sides of the partition can never drift."""
+    S = 4
+    d = CacheDirectory(4096, shards=S, part_salt=SALT)
+    assert d.shards == S
+    signs = (np.arange(1, 2001, dtype=np.uint64) * 2654435761) & ((1 << 63) - 1)
+    d.feed_batch(signs, None, salt=SALT)
+    want = np.bincount(
+        [shard_route(int(s), SALT, S) for s in signs], minlength=S
+    )
+    np.testing.assert_array_equal(d.shard_sizes(), want)
+    assert len(d) == len(signs)
+
+
+def test_shard_route_depends_on_salt():
+    """The PR 3 group salt is the partition key: two groups route the same
+    sign independently."""
+    signs = np.arange(1, 4001, dtype=np.uint64)
+    a = np.array([shard_route(int(s), group_salt("g_a"), 8) for s in signs])
+    b = np.array([shard_route(int(s), group_salt("g_b"), 8) for s in signs])
+    assert (a != b).any()
+    assert a.min() >= 0 and a.max() < 8
+    # mulhi over splitmix64 is near-uniform: no shard is starved
+    assert np.bincount(a, minlength=8).min() > len(signs) // 16
+
+
+def test_splitmix64_mirror_fixed_points():
+    """Known-answer pin of the Python splitmix64 mirror (the native side is
+    exercised transitively by the partition-histogram test above)."""
+    assert splitmix64(0) == 0xE220A8397B1DCDAF
+    assert splitmix64(1) == 0x910A2DEC89025CC1
+
+
+# ------------------------------------------------- S=1 == legacy, bitwise
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+def test_sharded_s1_bitwise_matches_legacy(seed):
+    """One shard, one thread IS the legacy walk: every output of
+    cache_feed_batch_sharded (rows, miss order, evictions, restore hits)
+    matches cache_feed_batch bit-for-bit over an evolving stream with a
+    live hazard ledger."""
+    rng = np.random.default_rng(seed)
+    d_s = CacheDirectory(256, admit_touches=2, shards=1, part_salt=SALT)
+    d_l = CacheDirectory(256, admit_touches=2)
+    pm_s, pm_l = PendingSignMap(), PendingSignMap()
+    for step in range(12):
+        signs = _zipf(rng, int(rng.integers(64, 900)))
+        out_s = _feed(d_s, signs, pm_s, salt=SALT)
+        out_l = _feed(d_l, signs, pm_l, salt=SALT)
+        for a, b in zip(out_s, out_l):
+            np.testing.assert_array_equal(a, b)
+        es = out_s[3]
+        if len(es):
+            pm_s.insert_range(es, base_src=step * 1024, token=step + 1,
+                              salt=SALT)
+            pm_l.insert_range(es, base_src=step * 1024, token=step + 1,
+                              salt=SALT)
+        if step > 3 and rng.random() < 0.5 and len(es):
+            pm_s.remove(es[: len(es) // 2], token=step + 1, salt=SALT)
+            pm_l.remove(es[: len(es) // 2], token=step + 1, salt=SALT)
+    np.testing.assert_array_equal(d_s.probe(np.arange(220, dtype=np.uint64)),
+                                  d_l.probe(np.arange(220, dtype=np.uint64)))
+    assert len(pm_s) == len(pm_l)
+
+
+# ------------------------------------------------- thread-count invariance
+
+
+@pytest.mark.parametrize("shards", [2, 4])
+def test_thread_count_invariance(shards):
+    """The ISSUE's parity pin: row LUT, eviction list and hazard-ledger
+    contents are bit-identical at feed_threads 1, 2 and 4 — the per-shard
+    results merge in shard order, so thread scheduling cannot leak into
+    numerics."""
+    rng = np.random.default_rng(3)
+    steps = [
+        _zipf(rng, int(rng.integers(64, 900))) for _ in range(10)
+    ]
+    runs = {}
+    for threads in (1, 2, 4):
+        d = CacheDirectory(
+            256, admit_touches=2, shards=shards,
+            feed_threads=threads, part_salt=SALT,
+        )
+        # one shard per walker: threads clamp to the shard count
+        assert d.feed_threads == min(threads, shards)
+        pmap = PendingSignMap()
+        outs = []
+        for step, signs in enumerate(steps):
+            out = _feed(d, signs, pmap, salt=SALT)
+            outs.append(out)
+            if len(out[3]):
+                pmap.insert_range(out[3], base_src=step * 1024,
+                                  token=step + 1, salt=SALT)
+        probe_set = np.arange(220, dtype=np.uint64)
+        outs.append(d.probe(probe_set).copy())
+        outs.append(pmap.query(probe_set, salt=SALT))
+        snap_s, snap_r = d.snapshot()
+        outs.append((snap_s.copy(), snap_r.copy()))
+        runs[threads] = outs
+    for threads in (2, 4):
+        for got, want in zip(runs[threads], runs[1]):
+            if isinstance(got, tuple):
+                for a, b in zip(got, want):
+                    np.testing.assert_array_equal(a, b)
+            else:
+                np.testing.assert_array_equal(got, want)
+
+
+def test_set_feed_threads_midstream_is_invariant():
+    """Thread count is pure throughput: changing it MID-STREAM (no fence,
+    no drain) must not perturb any output."""
+    rng = np.random.default_rng(5)
+    steps = [_zipf(rng, 500) for _ in range(8)]
+    d_a = CacheDirectory(256, shards=4, feed_threads=1, part_salt=SALT)
+    d_b = CacheDirectory(256, shards=4, feed_threads=1, part_salt=SALT)
+    for i, signs in enumerate(steps):
+        if i == 4:
+            d_b.set_feed_threads(4)
+        for a, b in zip(_feed(d_a, signs, None), _feed(d_b, signs, None)):
+            np.testing.assert_array_equal(a, b)
+
+
+# ------------------------------------------------------- sharded surface
+
+
+def test_sharded_surface_probe_snapshot_drain():
+    d = CacheDirectory(512, shards=4, part_salt=SALT)
+    signs = np.arange(1, 301, dtype=np.uint64)
+    rows = _feed(d, signs, None)[0]
+    assert len(d) == 300
+    np.testing.assert_array_equal(d.probe(signs), rows)
+    assert (d.probe(np.arange(400, 500, dtype=np.uint64)) == -1).all()
+    snap_s, snap_r = d.snapshot()
+    assert len(snap_s) == 300
+    np.testing.assert_array_equal(
+        np.sort(snap_s), np.sort(signs.astype(np.uint64))
+    )
+    # row ranges partition [0, capacity) without overlap across shards
+    assert len(np.unique(snap_r)) == 300
+    dr_s, _dr_r = d.drain()
+    assert len(dr_s) == 300 and len(d) == 0
+    assert d.shard_sizes().sum() == 0
+
+
+def test_sharded_overflow_raises():
+    d = CacheDirectory(64, shards=4, part_salt=SALT)
+    with pytest.raises(RuntimeError, match="capacity"):
+        d.feed_batch(np.arange(1, 400, dtype=np.uint64), None)
+
+
+def test_unsharded_rejects_sketches():
+    d = CacheDirectory(64)
+    sk = NativeSketch(1)
+    with pytest.raises(ValueError):
+        d.feed_batch(np.arange(10, dtype=np.uint64), None, sketches=[sk])
+
+
+def test_sharded_rejects_wrong_sketch_count():
+    d = CacheDirectory(64, shards=4, part_salt=SALT)
+    sk = NativeSketch(1)
+    with pytest.raises(ValueError):
+        d.feed_batch(np.arange(10, dtype=np.uint64), None, sketches=[sk])
+
+
+# ------------------------------------------------------------ fused observe
+
+
+def _sub_family(n_slots, shards):
+    """Sub-sketch family at the profiler's scaled geometry."""
+    lg = (shards - 1).bit_length()
+    return [
+        NativeSketch(n_slots, width_log2=max(4, 16 - lg), depth=4,
+                     bitmap_bits=max(64, (1 << 15) >> lg), topk=8)
+        for _ in range(shards)
+    ]
+
+
+def test_fused_observe_matches_routed():
+    """The tentpole fusion contract: observes riding the sharded admit walk
+    land in the same sub-sketch cells as the standalone routed observe —
+    identical count-min estimates for every sign, identical totals and
+    working-set bitmaps, identical heavy-hitter (sign, est) sets. Only the
+    top-K array's insertion ORDER may differ (routed updates a repeated
+    sign per occurrence, fused once per unique with the summed weight), so
+    the tracker is compared as a sorted set. The fused walk itself must be
+    thread-invariant at the byte level: exports at feed_threads 1 and 4
+    are identical."""
+    S, B, n_slots = 4, 64, 3
+    seen = {}
+    fused_by_threads = {}
+    routed = _sub_family(n_slots, S)
+    for threads in (1, 4):
+        rng = np.random.default_rng(11)
+        d = CacheDirectory(8192, shards=S, feed_threads=threads,
+                           part_salt=SALT)
+        fused = _sub_family(n_slots, S)
+        for _ in range(6):
+            # slot-prefixed signs (injective sign -> slot), zipf ids
+            mat = np.stack([
+                (np.uint64((s + 1) << 40) | _zipf(rng, B, mod=1500))
+                for s in range(n_slots)
+            ])
+            flat = mat.reshape(-1)
+            d.feed_batch(flat, None, sketches=fused,
+                         samples_per_slot=B, slot_base=0)
+            if threads == 1:
+                observe_routed(routed, SALT, flat, B, 0)
+                for s in range(n_slots):
+                    for sign in mat[s]:
+                        seen.setdefault(s, set()).add(int(sign))
+        fused_by_threads[threads] = [sk.export_bytes() for sk in fused]
+        if threads == 1:
+            for s in range(n_slots):
+                # cm estimate per sign: identical, to the cell
+                for sign in seen[s]:
+                    sub = shard_route(sign, SALT, S)
+                    assert (fused[sub].estimate(s, sign)
+                            == routed[sub].estimate(s, sign)), (s, sign)
+                for i in range(S):
+                    # totals + linear-counting bitmap: identical
+                    assert (fused[i].slot_stats(s)[:2]
+                            == routed[i].slot_stats(s)[:2])
+                    # heavy hitters: same (est, sign) set
+                    fa, fb = fused[i].slot_tops(s), routed[i].slot_tops(s)
+                    assert (sorted(zip(fa[1], fa[0]))
+                            == sorted(zip(fb[1], fb[0]))), (i, s)
+                merged = sum(fused[i].slot_stats(s)[0] for i in range(S))
+                assert merged == 6 * B  # every position observed once
+    # thread invariance of the fused observe is exact, bytes and all
+    assert fused_by_threads[1] == fused_by_threads[4]
+
+
+def test_fused_observe_weights_repeats():
+    """A sign appearing r times in one batch contributes weight r (the
+    obs_count accumulation), exactly like r standalone observes."""
+    S = 2
+    d = CacheDirectory(1024, shards=S, part_salt=SALT)
+    fused = _sub_family(1, S)
+    ref = _sub_family(1, S)
+    signs = np.array([5, 5, 5, 9, 9, 5], dtype=np.uint64)
+    d.feed_batch(signs, None, sketches=fused, samples_per_slot=0, slot_base=0)
+    observe_routed(ref, SALT, signs, 0, 0)
+    for a, b in zip(fused, ref):
+        assert a.export_bytes() == b.export_bytes()
+    i5 = shard_route(5, SALT, S)
+    assert fused[i5].estimate(0, 5) == 4.0
+
+
+def test_profiler_fused_gate_requires_matching_shards():
+    """AccessProfiler built with a different shard count than the
+    directory cannot fuse — feed_batch validates the family size."""
+    d = CacheDirectory(256, shards=4, part_salt=SALT)
+    prof = AccessProfiler(["a"], shards=2, part_salt=SALT)
+    with pytest.raises(ValueError):
+        d.feed_batch(np.arange(8, dtype=np.uint64), None,
+                     sketches=prof.sketches, samples_per_slot=0, slot_base=0)
+
+
+# ----------------------------------------------- sharded profiler surface
+
+
+def test_profiler_sharded_stats_match_unsharded():
+    """Routed observe across the sub-sketch family aggregates to the same
+    totals (exact) and near-identical uniques/heavy-hitters as one
+    unsharded sketch over the same stream."""
+    rng = np.random.default_rng(2)
+    names = ["a", "b"]
+    p1 = AccessProfiler(names)
+    pS = AccessProfiler(names, shards=4, part_salt=SALT)
+    assert pS.shards == 4 and len(pS.sketches) == 4
+    for _ in range(4):
+        for i, n in enumerate(names):
+            ids = (np.uint64((i + 1) << 40) | _zipf(rng, 4096, mod=9000))
+            p1.observe_slot(n, ids)
+            pS.observe_slot(n, ids)
+    s1, sS = p1.stats(), pS.stats()
+    for n in names:
+        assert s1[n].total == sS[n].total
+        assert abs(s1[n].unique - sS[n].unique) <= 0.15 * max(s1[n].unique, 1)
+
+
+def test_profiler_sharded_state_roundtrip_and_guards():
+    rng = np.random.default_rng(4)
+    p = AccessProfiler(["a"], shards=2, part_salt=SALT)
+    p.observe_slot("a", _zipf(rng, 2000, mod=500))
+    st = p.export_state()
+    assert st["shards"] == 2 and st["part_salt"] == SALT
+    q = AccessProfiler.from_state(st)
+    assert q.stats() == p.stats()
+    # shard-count mismatch across a snapshot fails loudly
+    mismatch = AccessProfiler(["a"], shards=4, part_salt=SALT)
+    with pytest.raises(ValueError):
+        mismatch.load_state(st)
+    with pytest.raises(RuntimeError):
+        p.export_bytes()
+
+
+def test_profiler_slot_salts_route_estimate():
+    """Per-slot salts (two groups, two partition keys) keep estimate() and
+    observe_slot() landing in the same sub-sketch."""
+    salts = {"a": group_salt("g_a"), "b": group_salt("g_b")}
+    p = AccessProfiler(["a", "b"], shards=4, slot_salts=salts)
+    p.observe_slot("a", np.array([123], dtype=np.uint64))
+    p.observe_slot("b", np.array([123], dtype=np.uint64))
+    assert p.estimate("a", 123) >= 1.0
+    assert p.estimate("b", 123) >= 1.0
+    # the raw sign lives in (potentially) different sub-sketches per group
+    ra = shard_route(123, salts["a"], 4)
+    assert p.sketches[ra].estimate(0, 123) >= 1.0
+
+
+# --------------------------------------------- PERSIA_SKETCH_SAMPLE (1/k)
+
+
+def test_sketch_sample_k_parses():
+    assert sketch_sample_k("") == 1
+    assert sketch_sample_k("1/8") == 8
+    assert sketch_sample_k("16") == 16
+    assert sketch_sample_k("2/8") == 1  # only 1/k rates are meaningful
+    assert sketch_sample_k("garbage") == 1
+    assert sketch_sample_k("1/0") == 1
+    assert sketch_sample_k("0") == 1
+
+
+def test_sketch_sample_env_default(monkeypatch):
+    monkeypatch.setenv("PERSIA_SKETCH_SAMPLE", "1/4")
+    assert sketch_sample_k() == 4
+    p = AccessProfiler(["a"])
+    p.observe_slot("a", np.arange(1, 101, dtype=np.uint64))
+    total = p.stats()["a"].total
+    # every kept sign counts with weight k: total stays unbiased-ish and
+    # is always an exact multiple of k
+    assert total % 4 == 0
+
+
+# native/cache.cpp SK_SAMPLE_SEED — known-answer pinned here so the Python
+# splitmix64 mirror can reproduce the gate's kept-set exactly
+_SK_SAMPLE_SEED = 0xD1B54A32D192ED03
+
+
+def _kept(signs, k):
+    return np.array(
+        [splitmix64(int(s) ^ _SK_SAMPLE_SEED) % k == 0 for s in signs]
+    )
+
+
+def test_sampled_sketch_zipf_convergence():
+    """Satellite 1's convergence pin, on a seeded zipf stream at 1/8
+    sampling: the sign-deterministic gate keeps ~1/k of the distinct signs;
+    kept signs' count-min estimates are tight overestimates of k * their
+    true count (the increment scaling), skipped signs read ~0; the total is
+    EXACTLY k * (kept mass) and the scaled working-set estimate converges
+    to the true distinct count within sampling noise."""
+    rng = np.random.default_rng(6)
+    ids = (rng.zipf(1.3, 120_000) % 30_000).astype(np.uint64)
+    k = 8
+    sk = NativeSketch(1, width_log2=16, depth=4, bitmap_bits=1 << 15, topk=8)
+    sk.set_sample(k)
+    # every position is attributed (sampled-away signs count as seen —
+    # the caller sized the call)
+    assert sk.observe(ids, 0, 0) == ids.size
+
+    signs, counts = np.unique(ids, return_counts=True)
+    keep = _kept(signs, k)
+    # the hash gate is a fair 1/k sampler over distinct signs
+    assert abs(keep.mean() - 1.0 / k) < 0.2 / k, keep.mean()
+
+    total, unique, _hot, _top1 = sk.slot_stats(0)
+    kept_mass = int(counts[keep].sum())
+    assert total == float(k * kept_mass)  # exact: increments scaled by k
+    # ... which converges on the true mass (fixed seed: the zipf head's
+    # keep/skip coin flips are frozen; the tolerance absorbs them)
+    assert abs(total - ids.size) < 0.5 * ids.size
+    exact_unique = len(np.unique(ids))
+    # linear counting sees kept distinct, scaled back up by k
+    assert abs(unique - exact_unique) / exact_unique < 0.2, (
+        unique, exact_unique
+    )
+
+    kept_n = skipped_n = 0
+    for i in np.argsort(-counts)[:24]:
+        est = sk.estimate(0, int(signs[i]))
+        if keep[i]:
+            kept_n += 1
+            # unbiased per-sign: est/k is a tight overestimate of count
+            assert est >= k * counts[i]
+            assert est <= k * counts[i] + 0.02 * k * ids.size
+        else:
+            skipped_n += 1
+            assert est <= 0.01 * k * ids.size
+    assert kept_n >= 1 and skipped_n >= 1
+
+    # k=1 reference is untouched by the sampling machinery
+    ref = NativeSketch(1, width_log2=16, depth=4, bitmap_bits=1 << 15, topk=8)
+    assert ref.observe(ids, 0, 0) == ids.size
+    assert ref.slot_stats(0)[0] == float(ids.size)

@@ -436,6 +436,46 @@ def test_pipeline_stage_events_metrics_and_spans(tmp_path):
     assert {"stage.feed", "stage.dense", "stage.psgrad"} <= names, names
 
 
+def test_sharded_feeder_gauge_and_spans(monkeypatch):
+    """OBS PIN for the round-14 sharded feeder: a sharded cached run must
+    land (a) one ``persia_tpu_feeder_shard_busy`` gauge series per
+    (group, shard) and (b) one ``feed.shard`` span per shard per feed —
+    the native walker's self-measured walk time, surfaced via
+    ``record_span`` (a Python-side ``span()`` would time the whole
+    dispatch, not the shard)."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(REPO_ROOT, "tests"))
+    from test_hbm_cache import _block_batches, _one_slot_ctx
+
+    from persia_tpu.metrics import get_metrics
+
+    monkeypatch.setenv("PERSIA_FEED_SHARDS", "4")
+    monkeypatch.setenv("PERSIA_FEED_THREADS", "2")
+    tracing.enable(True)
+    cfg, batches = _block_batches(4)
+    ctx, _store = _one_slot_ctx(cfg, cache_rows=64)
+    with ctx:
+        assert ctx.tier.feed_shards == 4
+        assert ctx.tier.feed_threads == 2
+        gname = ctx.tier.groups[0].name
+        ctx.train_stream(batches)
+        ctx.flush()
+
+    shard_spans = _spans_by_name().get("feed.shard", [])
+    assert len(shard_spans) == 4 * len(batches), len(shard_spans)
+    assert {ev["args"]["shard"] for ev in shard_spans} == {"0", "1", "2", "3"}
+    assert all(ev["args"]["group"] == gname for ev in shard_spans)
+    assert all(ev["dur"] >= 0 for ev in shard_spans)
+
+    busy = get_metrics().snapshot("persia_tpu_feeder")[
+        "persia_tpu_feeder_shard_busy"
+    ]
+    want = {f"group={gname},shard={s}" for s in range(4)}
+    assert want <= set(busy), busy
+    assert all(busy[k] >= 0.0 for k in want)
+
+
 # ----------------------------------- flight recorder × chaos (acceptance)
 
 

@@ -608,3 +608,127 @@ def test_fence_manifest_carries_tiering_component(tmp_path):
     assert set(doc["placements"]) == {"cat_0", "cat_1"}
     # the sketch blob is importable as exported
     AccessProfiler.from_state(doc["profiler"])
+
+
+# ----------------------------------- round 14: the sharded multi-core feeder
+
+
+def test_sharded_feeder_fused_observe_and_thread_invariance():
+    """End-to-end fusion + invariance pin through CachedTrainCtx: a tier
+    built with feed_shards=4 gets a matching sharded profiler from
+    enable_auto_tier, the observe rides the fused admit walk (totals
+    exactly equal the unsharded standalone-observe run), params stay
+    bit-identical to the unsharded run, and feed_threads=2 changes NO bit
+    of either params or profiler state."""
+    batches = _batches(6)
+
+    ctx0 = _make_ctx(_stores())
+    ctrl0 = enable_auto_tier(ctx0, min_dwell=10, decay=1.0)
+    assert ctrl0.profiler.shards is None
+    ctx0.train_stream(batches, snapshot_every=100)
+    ref = ctrl0.profiler.stats()
+    ctx0.flush()
+
+    ctx1 = _make_ctx(_stores(), feed_shards=4)
+    assert ctx1.tier.feed_shards == 4
+    ctrl1 = enable_auto_tier(ctx1, min_dwell=10, decay=1.0)
+    assert ctrl1.profiler.shards == 4  # built to match the tier partition
+    ctx1.train_stream(batches, snapshot_every=100)
+    st = ctx1.stream_stats()
+    assert st["feeder"]["feed_shards"] == 4
+    for shard_stats in st["feeder"]["shards"].values():
+        assert len(shard_stats["sizes"]) == 4
+        assert len(shard_stats["busy_ns"]) == 4
+    ctx1.flush()
+    got = ctrl1.profiler.stats()
+    for name, s in ref.items():
+        assert s.total == got[name].total  # fused observe misses nothing
+    _assert_params_equal(ctx0.state.params, ctx1.state.params)
+
+    ctx2 = _make_ctx(_stores(), feed_shards=4, feed_threads=2)
+    ctrl2 = enable_auto_tier(ctx2, min_dwell=10, decay=1.0)
+    ctx2.train_stream(batches, snapshot_every=100)
+    ctx2.flush()
+    assert ctrl2.profiler.stats() == got
+    _assert_params_equal(ctx1.state.params, ctx2.state.params)
+
+
+def test_reshard_at_fence_parity_with_fresh_resume(tmp_path):
+    """The migration parity contract extended to a RESHARD: run A starts
+    unsharded, queues {cat_1 -> ps, feed_shards=4} for the fence; run B is
+    born sharded, resumes from A's fence manifest straight into the final
+    placement. Bit-identical params and PS entries."""
+    cfg = _cfg()
+    batches = _batches(6)
+    stores = _stores()
+    ctx_a = _make_ctx(stores)
+    assert ctx_a.tier.feed_shards is None
+    ctx_a.request_migration(to_ps=["cat_1"], feed_shards=4)
+    ctx_a.train_stream(
+        batches, snapshot_every=4, job_state=str(tmp_path / "js")
+    )
+    assert ctx_a.stream_stats()["migrations"] == 1
+    assert ctx_a.tier.feed_shards == 4  # resharded at the drained fence
+    ctx_a.flush()
+    params_a = ctx_a.state.params
+    entries_a = _ps_entries(cfg, stores)
+
+    ctx_b = _make_ctx(stores, feed_shards=4)
+    m = ctx_b.resume(str(tmp_path / "js"))
+    assert m is not None and m.step == 4
+    ctx_b.apply_migration(to_ps=["cat_1"])
+    ctx_b.train_stream(
+        batches[m.step:], snapshot_every=4,
+        job_state=str(tmp_path / "js2"), start_step=m.step,
+    )
+    ctx_b.flush()
+    _assert_params_equal(params_a, ctx_b.state.params)
+    _assert_entries_equal(entries_a, _ps_entries(cfg, stores))
+
+
+def test_sharded_feeder_kill_resume_parity(tmp_path):
+    """Kill/resume on a sharded feeder — and resume at a DIFFERENT thread
+    count: run A trains 6 steps sharded, committing a fence at step 4; run
+    B resumes that manifest with feed_threads=4 and replays the tail.
+    Identical params and PS entries (thread count is pure throughput)."""
+    cfg = _cfg()
+    batches = _batches(6)
+    stores = _stores()
+    ctx_a = _make_ctx(stores, feed_shards=4)
+    ctx_a.train_stream(
+        batches, snapshot_every=4, job_state=str(tmp_path / "js")
+    )
+    ctx_a.flush()
+    params_a = ctx_a.state.params
+    entries_a = _ps_entries(cfg, stores)
+
+    ctx_b = _make_ctx(stores, feed_shards=4, feed_threads=4)
+    m = ctx_b.resume(str(tmp_path / "js"))
+    assert m is not None and m.step == 4
+    ctx_b.train_stream(
+        batches[m.step:], snapshot_every=100,
+        job_state=str(tmp_path / "js2"), start_step=m.step,
+    )
+    ctx_b.flush()
+    _assert_params_equal(params_a, ctx_b.state.params)
+    _assert_entries_equal(entries_a, _ps_entries(cfg, stores))
+
+
+def test_feed_env_knobs(monkeypatch):
+    """PERSIA_FEED_THREADS sizes the walker pool; with threads > 1 and no
+    explicit partition, the tier defaults to 8 shards; PERSIA_FEED_SHARDS=0
+    forces the legacy unsharded walk."""
+    monkeypatch.setenv("PERSIA_FEED_THREADS", "4")
+    ctx = _make_ctx(_stores())
+    assert ctx.tier.feed_shards == 8
+    assert ctx.tier.feed_threads == 4
+    ctx.set_feed_threads(2)
+    assert ctx.tier.feed_threads == 2
+    for d in ctx.tier.dirs.values():
+        assert d.shards == 8 and d.feed_threads == 2
+
+    monkeypatch.setenv("PERSIA_FEED_SHARDS", "0")
+    ctx2 = _make_ctx(_stores())
+    assert ctx2.tier.feed_shards is None
+    for d in ctx2.tier.dirs.values():
+        assert d.shards is None
