@@ -1053,6 +1053,10 @@ def bench_chaos():
         plane = ChaosPlane(svc, cfg_chaos, schedule=[
             ChaosAction(step=max(steps // 3, 1), op="kill_restart_ps",
                         idx=0, restore=True),
+            # arm a seeded kill for the POST-STREAM reshard: the handoff op
+            # it lands on comes from the chaos seed (reshard_fault_hook)
+            ChaosAction(step=max(2 * steps // 3, 2), op="kill_during_reshard",
+                        idx=1, handoff_op="import", op_index=-1),
         ])
         try:
             ps = plane.ps_clients(policy=policy)
@@ -1117,6 +1121,29 @@ def bench_chaos():
             if not data_faults_on:
                 assert np.isfinite(m["loss"])
             st = ctx.stream_stats() or {}
+            # elastic reshard under fire: the stream above is drained (the
+            # fence), so grow the PS tier 2->4 with the armed seeded kill
+            # landing mid-handoff, resume to completion, shrink back. The
+            # artifact records the interruption and both runs' op ledgers;
+            # reshard_kills rides in faults_injected.
+            import tempfile as _tempfile
+
+            js = _tempfile.mkdtemp(prefix="bench_reshard_js_")
+            hook = plane.reshard_fault_hook()
+            try:
+                grow = svc.reshard_ps(4, js, step=steps, fault_hook=hook)
+                interrupted = False
+            except Exception:  # noqa: BLE001 — the armed kill fired
+                interrupted = True
+                grow = svc.resume_reshard(js, fault_hook=hook)
+            shrink = svc.reshard_ps(2, js, step=steps + 1)
+            reshard_rec = {
+                "interrupted": interrupted,
+                "grow": {k: v for k, v in (grow or {}).items()
+                         if k != "skew_splits"},
+                "shrink": {k: v for k, v in shrink.items()
+                           if k != "skew_splits"},
+            }
             return {
                 "samples_per_sec": round(steps * batch / elapsed, 1),
                 "steps": steps,
@@ -1124,6 +1151,7 @@ def bench_chaos():
                 # trainer kill-resume recovery metrics (jobstate.py):
                 # time-to-resume, steps replayed, journal hits per mode
                 "kill_resume": _bench_kill_resume(),
+                "reshard": reshard_rec,
                 "faults_injected": plane.fault_counts(),
                 "data_chaos": data_chaos.cfg.to_dict(),
                 "data_faults_injected": dict(data_chaos.counts),

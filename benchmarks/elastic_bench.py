@@ -1,0 +1,151 @@
+"""Elastic PS tier: per-shard load skew, hash-uniform vs the sketch plan.
+
+Emits ONE JSON record (committed as BENCH_ELASTIC.json) answering the
+question the sparsity-aware :class:`ShardPlanner` exists for: under the
+zipf traffic recommenders actually serve, how unbalanced are hash-uniform
+ring shards, and how much of that skew does a plan driven by the tiering
+access sketch (``AccessProfiler`` heavy hitters + decayed totals) recover?
+
+Method: a deterministic zipf sign stream is observed into a real
+``AccessProfiler`` (the native count-min/top-K sketch, the same artifact
+the auto-tiering planner reads); ``ShardPlanner.plan`` inverts its load
+CDF into ring splits. A held-out stream from the same distribution is
+then routed by both rings (``sign_to_range_shard``) and the EMPIRICAL
+per-shard access counts scored — skew = max/mean, 1.0 is perfect. The
+modeled skews (what the planner believed) ride along so sketch error is
+visible. Finally the plan is executed as a REAL 2->4 elastic reshard over
+in-process stores holding the stream's working set, recording move
+counts, bytes and wall time for the handoff engine itself.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_SHARDS = int(os.environ.get("ELASTIC_SHARDS", "4"))
+N_SLOTS = 4
+VOCAB = int(os.environ.get("ELASTIC_VOCAB", str(1 << 17)))
+ZIPF_A = float(os.environ.get("ELASTIC_ZIPF_A", "1.5"))
+STEPS = int(os.environ.get("ELASTIC_STEPS", "64"))
+BATCH = int(os.environ.get("ELASTIC_BATCH", "4096"))
+SEED = 7
+DIM = 16
+
+
+def zipf_batch(rng, slot: int) -> np.ndarray:
+    ids = rng.zipf(ZIPF_A, BATCH).astype(np.uint64) % VOCAB
+    return ids + np.uint64(slot * VOCAB + 1)
+
+
+def empirical_skew(splits, streams) -> tuple:
+    from persia_tpu.embedding.hashing import sign_to_range_shard
+
+    counts = np.zeros(N_SHARDS, dtype=np.int64)
+    for signs in streams:
+        counts += np.bincount(
+            sign_to_range_shard(signs, np.asarray(splits, np.uint64)),
+            minlength=N_SHARDS,
+        )
+    return float(counts.max() / counts.mean()), counts.tolist()
+
+
+def main() -> int:
+    from persia_tpu import elastic, jobstate
+    from persia_tpu.embedding.hashing import uniform_splits
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.embedding.store import EmbeddingStore
+    from persia_tpu.embedding.tiering.profiler import AccessProfiler
+    from persia_tpu.embedding.tiering.shard_planner import ShardPlanner
+
+    slot_names = [f"cat_{i}" for i in range(N_SLOTS)]
+    prof = AccessProfiler(slot_names, topk=16)
+    rng = np.random.default_rng(SEED)
+    t0 = time.time()
+    for _ in range(STEPS):
+        for s, name in enumerate(slot_names):
+            prof.observe_slot(name, zipf_batch(rng, s))
+    observe_s = time.time() - t0
+
+    planner = ShardPlanner()
+    plan = planner.plan(N_SHARDS, profiler=prof)
+    uni = uniform_splits(N_SHARDS)
+    pos, w, residual = ShardPlanner.mass_from_profiler(prof)
+    modeled_uniform = ShardPlanner.skew_of(
+        ShardPlanner.shard_loads(uni, pos, w, residual)
+    )
+
+    # held-out traffic from the same distribution scores both rings
+    heldout = [zipf_batch(rng, s) for s in range(N_SLOTS) for _ in range(STEPS)]
+    skew_uniform, counts_uniform = empirical_skew(uni, heldout)
+    skew_planned, counts_planned = empirical_skew(plan.splits, heldout)
+
+    # the plan as a real handoff: grow 2->4 over in-process stores holding
+    # the stream's working set, landing on the sketch-driven ring
+    opt = Adagrad(lr=0.05).config
+    working_set = np.unique(np.concatenate(heldout))
+    srcs = [EmbeddingStore(capacity=1 << 20, num_internal_shards=4,
+                           optimizer=opt, seed=SEED) for _ in range(2)]
+    for r, st in enumerate(srcs):
+        st.lookup(working_set[working_set % 2 == r], DIM, True)
+    dests = list(srcs) + [
+        EmbeddingStore(capacity=1 << 20, num_internal_shards=4,
+                       optimizer=opt, seed=SEED)
+        for _ in range(N_SHARDS - 2)
+    ]
+    rplan = elastic.plan_reshard(
+        2, N_SHARDS, None, [int(x) for x in plan.splits],
+        jobstate.make_journal_id(1, 0),
+    )
+    import tempfile
+
+    t0 = time.time()
+    stats = elastic.execute_reshard(
+        rplan, srcs, dests, tempfile.mkdtemp(prefix="elastic_bench_js_")
+    )
+    reshard_s = time.time() - t0
+
+    rec = {
+        "bench": "elastic",
+        "workload": {
+            "slots": N_SLOTS, "vocab_per_slot": VOCAB, "zipf_a": ZIPF_A,
+            "steps": STEPS, "batch": BATCH, "seed": SEED,
+        },
+        "n_shards": N_SHARDS,
+        "skew_uniform": round(skew_uniform, 4),
+        "skew_planned": round(skew_planned, 4),
+        "counts_uniform": counts_uniform,
+        "counts_planned": counts_planned,
+        "modeled_skew_uniform": round(modeled_uniform, 4),
+        "modeled_skew_planned": round(plan.skew, 4),
+        "observe_s": round(observe_s, 3),
+        "reshard": {
+            "old_n": 2, "new_n": N_SHARDS,
+            "entries": int(len(working_set)),
+            "moves": len(rplan.moves),
+            "imports_applied": stats["imports_applied"],
+            "deletes_applied": stats["deletes_applied"],
+            "moved_bytes": stats["moved_bytes"],
+            "entries_removed": stats["entries_removed"],
+            "wall_s": round(reshard_s, 3),
+        },
+    }
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_ELASTIC.json")
+    with open(out, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(json.dumps(rec))
+    if skew_planned >= skew_uniform:
+        print("FAIL: sketch-driven plan did not reduce empirical skew",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,7 @@
 //
 // C ABI only (ctypes-friendly); no Python headers needed.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -991,6 +992,92 @@ int64_t ps_load_shard(void* h, const uint8_t* data, int64_t len) {
     p += (int64_t)elen * 4;
   }
   return (int64_t)cnt;
+}
+
+// ------------------------------------------------------- elastic handoff
+// Range export/import/delete for live PS resharding: an entry belongs to
+// the hash range [lo, hi) iff splitmix64(sign) — the ROUTING hash the
+// worker's ring positions on, NOT the store-internal `sign ^ 0xA5A5A5A5`
+// shard hash — lies in it; hi == 0 encodes 2^64 (the end of the ring),
+// which a u64 cannot carry. Export emits the dump_shard wire format but
+// SORTED BY SIGN (dump_shard is LRU-ordered): a re-export after any crash
+// or restore yields byte-identical payload, so the handoff journal's crc
+// dedups replays. Import is plain ps_load_shard (sign-routed, any layout).
+
+static inline bool range_owns(uint64_t sign, uint64_t lo, uint64_t hi) {
+  uint64_t hh = splitmix64(sign);
+  return hh >= lo && (hi == 0 || hh < hi);
+}
+
+int64_t ps_export_range_size(void* h, uint64_t lo, uint64_t hi) {
+  Store* s = (Store*)h;
+  int64_t bytes = 4;
+  for (auto& sh : s->shards) {
+    std::lock_guard<std::mutex> g(sh.mu);
+    for (const Entry& en : sh.entries) {
+      if (!en.data) continue;  // free-listed slot
+      if (range_owns(en.sign, lo, hi)) bytes += 16 + (int64_t)en.len * 4;
+    }
+  }
+  return bytes;
+}
+
+int64_t ps_export_range(void* h, uint64_t lo, uint64_t hi, uint8_t* out,
+                        int64_t cap) {
+  Store* s = (Store*)h;
+  // copy matching entries out under per-shard locks, then sort by sign and
+  // serialize lock-free — the extra copy buys deterministic bytes (handoff
+  // is a fence-time path, not a hot one)
+  struct Row {
+    uint64_t sign;
+    uint32_t dim, len;
+    std::vector<float> data;
+  };
+  std::vector<Row> rows;
+  for (auto& sh : s->shards) {
+    std::lock_guard<std::mutex> g(sh.mu);
+    for (const Entry& en : sh.entries) {
+      if (!en.data || !range_owns(en.sign, lo, hi)) continue;
+      rows.push_back(Row{en.sign, en.dim, en.len,
+                         std::vector<float>(en.data, en.data + en.len)});
+    }
+  }
+  std::sort(rows.begin(), rows.end(),
+            [](const Row& a, const Row& b) { return a.sign < b.sign; });
+  uint8_t* p = out;
+  uint8_t* end = out + cap;
+  if (p + 4 > end) return -1;
+  uint32_t cnt = (uint32_t)rows.size();
+  std::memcpy(p, &cnt, 4);
+  p += 4;
+  for (const Row& r : rows) {
+    int64_t need = 16 + (int64_t)r.len * 4;
+    if (p + need > end) return -1;
+    std::memcpy(p, &r.sign, 8);
+    std::memcpy(p + 8, &r.dim, 4);
+    std::memcpy(p + 12, &r.len, 4);
+    std::memcpy(p + 16, r.data.data(), (size_t)r.len * 4);
+    p += need;
+  }
+  return p - out;
+}
+
+// drop every entry in [lo, hi); returns entries removed (idempotent — a
+// journal-deduped replay of the delete removes 0)
+int64_t ps_delete_range(void* h, uint64_t lo, uint64_t hi) {
+  Store* s = (Store*)h;
+  int64_t removed = 0;
+  for (auto& sh : s->shards) {
+    std::lock_guard<std::mutex> g(sh.mu);
+    std::vector<int32_t> victims;
+    for (int32_t e = 0; e < (int32_t)sh.entries.size(); ++e) {
+      const Entry& en = sh.entries[e];
+      if (en.data && range_owns(en.sign, lo, hi)) victims.push_back(e);
+    }
+    for (int32_t e : victims) sh.remove_entry(e);
+    removed += (int64_t)victims.size();
+  }
+  return removed;
 }
 
 // Fence-point row scrubber (persia_tpu/health): scan every live entry for

@@ -228,6 +228,8 @@ def _consume_decl(text, start, end, path, funcptr_typedefs, funcs, seen, warning
     first_word = head.split()[0] if head.split() else ""
     if first_word in _KEYWORD_HEADS:
         return
+    if "static" in head.split():
+        return  # internal linkage — never in the dynamic symbol table
     line = text.count("\n", 0, start + (len(text[start:end]) - len(text[start:end].lstrip()))) + 1
     # signature: everything up to the matching close paren of the first open
     open_idx = decl.index("(")

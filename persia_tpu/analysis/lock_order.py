@@ -30,27 +30,34 @@ Order (outermost first):
 4. ``_buf_lock``     — embedding worker forward-buffer table
 5. ``_grad_lock``    — embedding worker gradient-state table
 6. ``_deg_lock``     — degraded-lookup bookkeeping (worker + cache tier)
-7. ``_swap_lock``    — serving engine model-swap latch
-8. ``_state_lock``   — CachedTrainCtx device-state mutex (hbm_cache/ctx.py):
+7. ``_ring_lock``    — ShardedLookup versioned-topology swap latch
+                       (embedding/worker.py): guards the atomic publish of
+                       the (replicas, ring, version) tuple during an
+                       elastic reshard / replica replacement. Held for the
+                       tuple swap only — every side effect (gauge, breaker
+                       reset, degraded purge, flight event) runs after
+                       release, so nothing is ever nested under it
+8. ``_swap_lock``    — serving engine model-swap latch
+9. ``_state_lock``   — CachedTrainCtx device-state mutex (hbm_cache/ctx.py):
                        serializes the stager thread's feed dispatch against
                        the main thread's dense dispatch in pipelined
                        streams (every read-modify-replace of ``self.state``
                        / ``self._ev_rings``). Never nested with ``cv`` or
                        ``_pipe_cv``; only generic leaves below may be taken
                        under it
-9. ``_lock``/``lock``— generic leaf locks (breakers, caches, registries,
+10. ``_lock``/``lock``— generic leaf locks (breakers, caches, registries,
                        checkpoint shard fan-out); must never wrap a
                        ranked-above lock
-10. ``_flight_lock``  — tracing flight-recorder ring (leaf; appends only)
-11. ``_rng_lock``    — RetryPolicy jitter RNG (innermost; held for one
+11. ``_flight_lock``  — tracing flight-recorder ring (leaf; appends only)
+12. ``_rng_lock``    — RetryPolicy jitter RNG (innermost; held for one
                        random() call only)
-12. ``_DEFAULT_LOCK``— resilience default-policy registry (leaf)
-13. ``_PROC_LOCK``   — native-build serializer (_native_build.py): a LAZY
+13. ``_DEFAULT_LOCK``— resilience default-policy registry (leaf)
+14. ``_PROC_LOCK``   — native-build serializer (_native_build.py): a LAZY
                        first-use build can trigger under any lock above,
                        and nothing ranked is ever taken under it (only the
                        compile subprocess + flock), so it is a leaf despite
                        being held the longest
-14. ``_REGISTRY_LOCK``— metrics registry (innermost leaf)
+15. ``_REGISTRY_LOCK``— metrics registry (innermost leaf)
 
 Native mutexes (native/cache.cpp) live below every Python lock: a ctypes
 call can run under any ``with`` above (CONC005 audits which ones), and the
@@ -91,6 +98,7 @@ LOCK_RANKS: Dict[str, int] = {
     "_buf_lock": 10,
     "_grad_lock": 20,
     "_deg_lock": 30,
+    "_ring_lock": 35,
     "_swap_lock": 40,
     "_state_lock": 45,
     "_lock": 50,

@@ -40,6 +40,10 @@ _TIMER_SCOPE_DIRS = (
 _TIMER_SCOPE_FILES = (
     os.path.join("persia_tpu", "data_loader.py"),
     os.path.join("persia_tpu", "incremental.py"),
+    # the elastic reshard engine: fence/handoff/release durations are
+    # recovery-time evidence and must flow through spans, and its
+    # reshard.* flight events ride the same OBS001 namespace rule
+    os.path.join("persia_tpu", "elastic.py"),
 )
 # the mechanism itself may hold raw clocks
 _EXEMPT_BASENAMES = ("tracing.py", "metrics.py")

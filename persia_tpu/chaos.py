@@ -725,6 +725,16 @@ class ChaosAction:
     # driven from OUTSIDE the trainer process (a parent harness walking
     # the trainer's progress beacon), since a trainer cannot outlive
     # firing its own SIGKILL.
+    #
+    # ``op="kill_during_reshard"`` does not kill at fire time: it ARMS the
+    # plane so the NEXT reshard driven with ``reshard_fault_hook()``
+    # SIGKILLs PS ``idx`` when the handoff reaches (handoff_op, op_index)
+    # — the reshard engine's own injection point, so the kill lands
+    # between two journaled ops rather than between two steps.
+    # ``op_index < 0`` draws the target op ordinal from the plane's seed
+    # instead (same seed → same kill point, run to run).
+    handoff_op: str = "import"  # "import" | "delete"
+    op_index: int = 0
 
 
 class ChaosPlane:
@@ -754,6 +764,9 @@ class ChaosPlane:
         ]
         self._step = -1
         self._trainer_proc = None
+        # kill_during_reshard arms land here; reshard_fault_hook consumes
+        self._reshard_arms: List[ChaosAction] = []
+        self._reshard_counts: Dict[str, int] = {"reshard_kills": 0}
 
     def attach_trainer(self, proc) -> None:
         """Register the trainer subprocess the ``kill_trainer`` op targets
@@ -769,11 +782,41 @@ class ChaosPlane:
         return [StoreClient(p.addr, **kwargs) for p in self.proxies]
 
     def fault_counts(self) -> Dict[str, int]:
-        total: Dict[str, int] = {}
+        total: Dict[str, int] = dict(self._reshard_counts)
         for p in self.proxies:
             for k, v in p.counts.items():
                 total[k] = total.get(k, 0) + v
         return total
+
+    def reshard_fault_hook(self):
+        """The ``fault_hook`` to pass into ``ServiceCtx.reshard_ps`` /
+        ``resume_reshard``: fires every armed ``kill_during_reshard``
+        action whose (handoff_op, op_index) the engine reaches. A seeded
+        arm (``op_index < 0``) resolves its target ordinal from the
+        plane's chaos seed, counting hook invocations of its op kind."""
+        import random as _random
+
+        for a in self._reshard_arms:
+            if a.op_index < 0:
+                a.op_index = _random.Random(
+                    self.cfg.seed * 1_000_003 + a.idx * 2 + a.step
+                ).randrange(0, 4)
+        def hook(kind: str, idx: int, mv) -> None:
+            for a in list(self._reshard_arms):
+                if a.handoff_op == kind and a.op_index == idx:
+                    self._reshard_arms.remove(a)
+                    self._reshard_counts["reshard_kills"] += 1
+                    record_event(
+                        "chaos.kill_during_reshard", idx=a.idx,
+                        handoff_op=kind, op_index=idx,
+                    )
+                    logger.info(
+                        "chaos: SIGKILL ps%d during reshard at %s[%d]",
+                        a.idx, kind, idx,
+                    )
+                    self.svc.kill_ps(a.idx)
+
+        return hook
 
     # ------------------------------------------------------------ schedule
 
@@ -820,6 +863,8 @@ class ChaosPlane:
             self.proxies[a.idx].set_blackhole(True)
         elif a.op == "heal":
             self.proxies[a.idx].set_blackhole(False)
+        elif a.op == "kill_during_reshard":
+            self._reshard_arms.append(a)
         elif a.op == "kill_trainer":
             if self._trainer_proc is None:
                 raise RuntimeError(

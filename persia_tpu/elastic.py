@@ -1,0 +1,429 @@
+"""Elastic PS tier: live shard resharding with exactly-once handoff.
+
+``ServiceCtx.reshard_ps(n)`` (helper.py) adds or removes parameter-server
+replicas mid-job. This module is the transport-agnostic engine underneath:
+given the old and new ring (``hashing.uniform_splits`` or a sparsity-aware
+:class:`~persia_tpu.embedding.tiering.shard_planner.ShardPlanner` plan), it
+moves exactly the sign ranges whose ownership changes, under the same
+exactly-once journal discipline PR 5 built for gradient batches:
+
+- Every handoff op (range import, range delete) carries a
+  :func:`~persia_tpu.jobstate.handoff_journal_id` — the 0x80 low-byte
+  namespace of the PS apply-journal, so a resumed reshard replaying its op
+  list dedupes against what the crashed run already applied, and can never
+  collide with a gradient batch's per-replica id.
+- ``export_range`` is read-only and byte-deterministic (sign-sorted), so a
+  re-export after a source restore produces the identical blob and crc; an
+  import probe of ``-1`` (id known, crc differs) means the source range was
+  already released by phase 2 — the original import stands and the replay
+  skips it.
+
+Crash matrix (the flagship chaos test kills at every point):
+
+==================  =========================================================
+victim / phase      recovery
+==================  =========================================================
+source, handoff     restore from the fence snapshot in the ``handoff``
+                    manifest (:func:`source_snapshot`), re-run the plan —
+                    re-exports are bit-identical, imports dedupe.
+dest, handoff       restart FRESH (its journal died with it); re-imports
+                    re-apply the identical blobs.
+dest, imported      restore from the post-import snapshot in the
+                    ``imported`` manifest (:func:`dest_snapshot`); remaining
+                    deletes re-apply (idempotent) or dedupe.
+coordinator, any    the phase-fenced manifests are durable; a new process
+                    calls :func:`resume_reshard` and re-executes from the
+                    recorded phase — journal ids are recomputed from the
+                    recorded ``base_id`` + deterministic move order, so
+                    every already-applied op dedupes.
+==================  =========================================================
+
+Phase order is what makes the matrix closed: the ``handoff`` manifest
+(fence snapshot of every source) commits BEFORE the first import; the
+``imported`` manifest (post-import snapshot of every dest) commits before
+the first delete; the ``done`` manifest commits last. Until ``done``, the
+reshard is visibly incomplete and :func:`find_reshard_manifest` will hand
+it to the resume path.
+
+The caller guarantees the FENCE invariant: the training stream is drained
+(no in-flight lookups/updates against the moving ranges) for the duration.
+The router swap (``ShardedLookup.swap_topology``) happens at the
+``imported`` boundary — entries exist on BOTH the old and new owner until
+the deletes run, so lookups racing the tail of the reshard still hit live
+data whichever ring they routed by.
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from persia_tpu import jobstate, tracing
+from persia_tpu.logger import get_default_logger
+from persia_tpu.metrics import get_metrics
+
+logger = get_default_logger("persia_tpu.elastic")
+
+_RING = 1 << 64
+# handoff_journal_id's op_index is 7 bits; one plan's imports + deletes
+# must fit the namespace
+MAX_HANDOFF_OPS = 128
+
+_m = get_metrics()
+_m_reshards = _m.counter(
+    "persia_tpu_reshard_total", "resharding plans driven to the done phase"
+)
+_m_moved_bytes = _m.counter(
+    "persia_tpu_reshard_moved_bytes", "bytes imported across PS replicas by handoffs"
+)
+_m_deduped = _m.counter(
+    "persia_tpu_reshard_ops_deduped",
+    "handoff ops skipped because the apply-journal already held them (resume replay)",
+)
+
+
+# ------------------------------------------------------------------- planning
+
+
+@dataclass(frozen=True)
+class Move:
+    """One range handoff: entries of ``src`` whose ring position falls in
+    ``[lo, hi)`` (``hi == 0`` meaning 2^64, the ``hash_range_mask``
+    convention) move to ``dst``."""
+
+    src: int
+    dst: int
+    lo: int
+    hi: int
+
+
+@dataclass
+class ReshardPlan:
+    old_n: int
+    new_n: int
+    old_splits: Optional[List[int]]  # None = legacy modulo routing
+    new_splits: List[int]
+    base_id: int  # journal-id base; op k applies as handoff_journal_id(base, k)
+    moves: List[Move]
+
+    @property
+    def deletes(self) -> List[Move]:
+        """Phase-2 release ops: every moved-away range still held by a
+        SURVIVING source (removed replicas are shut down whole, nothing to
+        delete). Same deterministic order as ``moves`` — op indices (and so
+        journal ids) are reproducible from the plan alone."""
+        return [m for m in self.moves if m.src < self.new_n]
+
+    def to_meta(self) -> Dict:
+        return {
+            "old_n": self.old_n,
+            "new_n": self.new_n,
+            "old_splits": None if self.old_splits is None
+            else [int(x) for x in self.old_splits],
+            "new_splits": [int(x) for x in self.new_splits],
+            "base_id": int(self.base_id),
+        }
+
+    @classmethod
+    def from_meta(cls, meta: Dict) -> "ReshardPlan":
+        r = meta["reshard"]
+        return plan_reshard(
+            int(r["old_n"]), int(r["new_n"]), r["old_splits"],
+            r["new_splits"], int(r["base_id"]),
+        )
+
+
+def _ranges(splits: Optional[Sequence[int]], n: int) -> List[Tuple[int, int]]:
+    """Contiguous ring arcs per shard, in PYTHON ints with an exclusive
+    ``hi`` (2^64 for the last arc — converted to the wire's 0 only at Move
+    construction)."""
+    if n == 1:
+        return [(0, _RING)]
+    s = [int(x) for x in splits]  # type: ignore[union-attr]
+    if len(s) != n - 1 or any(b <= a for a, b in zip(s, s[1:])) or s[0] <= 0:
+        raise ValueError(f"need {n - 1} strictly-ascending positive splits, got {s}")
+    edges = [0] + s + [_RING]
+    return [(edges[i], edges[i + 1]) for i in range(n)]
+
+
+def _isect(a: Tuple[int, int], b: Tuple[int, int]) -> Optional[Tuple[int, int]]:
+    lo, hi = max(a[0], b[0]), min(a[1], b[1])
+    return (lo, hi) if lo < hi else None
+
+
+def plan_reshard(
+    old_n: int,
+    new_n: int,
+    old_splits: Optional[Sequence[int]],
+    new_splits: Sequence[int],
+    base_id: int,
+) -> ReshardPlan:
+    """Derive the deterministic move list. ``old_splits=None`` means the
+    incumbent topology routes by modulo (the pre-elastic default): every
+    source may hold signs anywhere on the ring, so each moves the WHOLE of
+    every other dest's new arc (its own arc's entries stay put — the delete
+    phase strips everything else). Ring→ring reshards move only the arc
+    intersections whose owner changed."""
+    if old_n < 1 or new_n < 1:
+        raise ValueError(f"replica counts must be >= 1 ({old_n} -> {new_n})")
+    new_r = _ranges(new_splits, new_n)
+    old_r = [(0, _RING)] * old_n if old_splits is None else _ranges(old_splits, old_n)
+    moves: List[Move] = []
+    for s in range(old_n):
+        for d in range(new_n):
+            if s == d:
+                continue  # the overlap (if any) is already in place
+            r = _isect(old_r[s], new_r[d])
+            if r is not None:
+                moves.append(Move(s, d, r[0], r[1] % _RING))
+    plan = ReshardPlan(old_n, new_n,
+                       None if old_splits is None else [int(x) for x in old_splits],
+                       [int(x) for x in new_splits], int(base_id), moves)
+    n_ops = len(moves) + len(plan.deletes)
+    if n_ops >= MAX_HANDOFF_OPS:
+        raise ValueError(
+            f"reshard {old_n}->{new_n} needs {n_ops} handoff ops but the "
+            f"journal-id namespace holds {MAX_HANDOFF_OPS - 1}; reshard in "
+            f"smaller steps"
+        )
+    return plan
+
+
+def reshard_base_id(mgr: "jobstate.JobStateManager", step: int = 0) -> int:
+    """Journal-id base for a new plan: the epoch the fence manifest will
+    (most likely) land on + the caller's step. Uniqueness vs gradient ids
+    is structural (the 0x80 namespace); vs other reshards it only needs to
+    differ, and the recorded manifest is the source of truth on resume."""
+    latest = mgr.latest()
+    epoch = (latest.job_epoch + 1) if latest is not None else 1
+    return jobstate.make_journal_id(epoch, step)
+
+
+# ------------------------------------------------------------------ manifests
+
+
+def _blob_counts(replicas: Sequence) -> List[int]:
+    return [int(r.num_internal_shards) for r in replicas]
+
+
+def _capture(writer: "jobstate.EpochWriter", prefix: str, replicas: Sequence) -> List[int]:
+    counts = _blob_counts(replicas)
+    for ri, rep in enumerate(replicas):
+        for si in range(counts[ri]):
+            writer.add_blob(f"reshard/{prefix}_{ri}_shard_{si}.emb", rep.dump_shard(si))
+    return counts
+
+
+def _snapshot(man: "jobstate.Manifest", prefix: str, counts_key: str, idx: int) -> List[bytes]:
+    counts = man.meta.get(counts_key) or []
+    if idx >= len(counts):
+        raise jobstate.ManifestError(
+            f"reshard manifest {man.dir} has no {prefix} {idx} snapshot"
+        )
+    return [
+        man.read_blob(f"reshard/{prefix}_{idx}_shard_{si}.emb")
+        for si in range(int(counts[idx]))
+    ]
+
+
+def source_snapshot(man: "jobstate.Manifest", src: int) -> List[bytes]:
+    """Fence-time shard blobs of source ``src`` (``handoff`` manifest) —
+    what a SIGKILLed source restores from before the plan re-runs."""
+    return _snapshot(man, "source", "source_shards", src)
+
+
+def dest_snapshot(man: "jobstate.Manifest", dst: int) -> List[bytes]:
+    """Post-import shard blobs of dest ``dst`` (``imported`` manifest) —
+    what a dest killed during the delete phase restores from."""
+    return _snapshot(man, "dest", "dest_shards", dst)
+
+
+def find_reshard_manifest(
+    mgr: "jobstate.JobStateManager",
+) -> Optional["jobstate.Manifest"]:
+    """Newest committed manifest of ``kind == "reshard"`` regardless of
+    phase (callers check ``meta["phase"]``); None if no reshard ever ran."""
+    for _e, d in reversed(mgr._epoch_dirs()):
+        m = mgr._load_manifest(d)
+        if m is not None and m.meta.get("kind") == "reshard":
+            return m
+    return None
+
+
+# ------------------------------------------------------------------ execution
+
+FaultHook = Callable[[str, int, Move], None]
+
+
+def _run_imports(
+    plan: ReshardPlan, sources: Sequence, dests: Sequence,
+    stats: Dict, fault_hook: Optional[FaultHook],
+) -> None:
+    with tracing.span("reshard.handoff", moves=len(plan.moves)):
+        for idx, mv in enumerate(plan.moves):
+            if fault_hook is not None:
+                fault_hook("import", idx, mv)
+            blob = sources[mv.src].export_range(mv.lo, mv.hi)
+            jid = jobstate.handoff_journal_id(plan.base_id, idx)
+            crc = zlib.crc32(blob) & 0xFFFFFFFF
+            applied = dests[mv.dst].import_range_journaled(jid, crc, blob)
+            if applied:
+                stats["imports_applied"] += 1
+                stats["moved_bytes"] += len(blob)
+                _m_moved_bytes.inc(len(blob))
+            else:
+                stats["imports_deduped"] += 1
+                _m_deduped.inc()
+            tracing.record_event(
+                "reshard.import", op=idx, src=mv.src, dst=mv.dst,
+                bytes=len(blob), applied=bool(applied),
+            )
+
+
+def _run_deletes(
+    plan: ReshardPlan, sources: Sequence,
+    stats: Dict, fault_hook: Optional[FaultHook],
+) -> None:
+    deletes = plan.deletes
+    with tracing.span("reshard.release", deletes=len(deletes)):
+        for i, mv in enumerate(deletes):
+            if fault_hook is not None:
+                fault_hook("delete", i, mv)
+            jid = jobstate.handoff_journal_id(plan.base_id, len(plan.moves) + i)
+            crc = jobstate.payload_crc(np.array([mv.lo, mv.hi], dtype=np.uint64))
+            applied, removed = sources[mv.src].delete_range_journaled(
+                jid, crc, mv.lo, mv.hi
+            )
+            if applied:
+                stats["deletes_applied"] += 1
+                stats["entries_removed"] += int(removed)
+            else:
+                stats["deletes_deduped"] += 1
+                _m_deduped.inc()
+            tracing.record_event(
+                "reshard.release", op=i, src=mv.src, removed=int(removed),
+                applied=bool(applied),
+            )
+
+
+def _commit_phase(
+    mgr: "jobstate.JobStateManager", plan: ReshardPlan, phase: str,
+    extra: Optional[Dict] = None, capture: Optional[Tuple[str, str, Sequence]] = None,
+) -> "jobstate.Manifest":
+    writer = mgr.begin_epoch()
+    meta: Dict = {"kind": "reshard", "phase": phase, "reshard": plan.to_meta()}
+    meta.update(extra or {})
+    if capture is not None:
+        prefix, counts_key, replicas = capture
+        meta[counts_key] = _capture(writer, prefix, replicas)
+    man = writer.commit(meta)
+    tracing.record_event(
+        "reshard.phase", phase=phase, job_epoch=writer.job_epoch,
+        old_n=plan.old_n, new_n=plan.new_n,
+    )
+    return man
+
+
+def _finish(
+    plan: ReshardPlan, sources: Sequence, dests: Sequence,
+    mgr: "jobstate.JobStateManager", stats: Dict, start_phase: str,
+    fault_hook: Optional[FaultHook], on_imported: Optional[Callable[[], None]],
+    extra_meta: Optional[Dict],
+) -> Dict:
+    """Drive the plan from ``start_phase`` to ``done``. Everything in here
+    is a pure replay: journal ids come from the plan, so re-entering after
+    any crash dedupes instead of double-applying."""
+    if start_phase == "handoff":
+        _run_imports(plan, sources, dests, stats, fault_hook)
+        _commit_phase(mgr, plan, "imported", extra_meta,
+                      capture=("dest", "dest_shards", dests))
+    if on_imported is not None:
+        on_imported()
+    _run_deletes(plan, sources, stats, fault_hook)
+    _commit_phase(mgr, plan, "done", extra_meta)
+    _m_reshards.inc()
+    logger.info(
+        "reshard %d->%d done: %d/%d imports applied/deduped, %d/%d deletes, "
+        "%d bytes moved, %d entries released",
+        plan.old_n, plan.new_n, stats["imports_applied"],
+        stats["imports_deduped"], stats["deletes_applied"],
+        stats["deletes_deduped"], stats["moved_bytes"], stats["entries_removed"],
+    )
+    return stats
+
+
+def _new_stats(start_phase: str, resumed: bool) -> Dict:
+    return {
+        "imports_applied": 0, "imports_deduped": 0,
+        "deletes_applied": 0, "deletes_deduped": 0,
+        "moved_bytes": 0, "entries_removed": 0,
+        "start_phase": start_phase, "resumed": resumed,
+    }
+
+
+def execute_reshard(
+    plan: ReshardPlan,
+    sources: Sequence,
+    dests: Sequence,
+    job_state,
+    *,
+    fault_hook: Optional[FaultHook] = None,
+    on_imported: Optional[Callable[[], None]] = None,
+    extra_meta: Optional[Dict] = None,
+) -> Dict:
+    """Run a fresh plan end to end. ``sources``/``dests`` are store handles
+    (StoreClient or in-process stores) indexed by OLD/NEW replica index —
+    surviving replicas appear in both lists as the same endpoint. The
+    caller holds the stream fence. ``fault_hook(kind, op_index, move)``
+    fires before every handoff op (chaos injection); ``on_imported`` fires
+    once at the imported boundary (where the router swaps rings);
+    ``extra_meta`` (e.g. the optimizer config) rides on every phase
+    manifest so the resume path can rebuild dead replicas."""
+    if len(sources) != plan.old_n or len(dests) != plan.new_n:
+        raise ValueError(
+            f"plan is {plan.old_n}->{plan.new_n} but got "
+            f"{len(sources)} sources / {len(dests)} dests"
+        )
+    mgr = jobstate.coerce_manager(job_state)
+    with tracing.span("reshard.fence", old_n=plan.old_n, new_n=plan.new_n):
+        _commit_phase(mgr, plan, "handoff", extra_meta,
+                      capture=("source", "source_shards", sources))
+    stats = _new_stats("handoff", resumed=False)
+    return _finish(plan, sources, dests, mgr, stats, "handoff",
+                   fault_hook, on_imported, extra_meta)
+
+
+def resume_reshard(
+    job_state,
+    sources: Sequence,
+    dests: Sequence,
+    *,
+    fault_hook: Optional[FaultHook] = None,
+    on_imported: Optional[Callable[[], None]] = None,
+) -> Optional[Dict]:
+    """Re-enter an interrupted reshard from its recorded phase. Returns the
+    run stats, or None when the newest reshard already reached ``done`` (or
+    none ever ran). The caller restores any DEAD replicas first — from
+    :func:`source_snapshot` / :func:`dest_snapshot` per the crash matrix —
+    and passes live handles here; this function only replays ops, and the
+    journal turns every already-applied one into a dedupe."""
+    mgr = jobstate.coerce_manager(job_state)
+    man = find_reshard_manifest(mgr)
+    if man is None or man.meta.get("phase") == "done":
+        return None
+    plan = ReshardPlan.from_meta(man.meta)
+    if len(sources) != plan.old_n or len(dests) != plan.new_n:
+        raise ValueError(
+            f"recorded plan is {plan.old_n}->{plan.new_n} but got "
+            f"{len(sources)} sources / {len(dests)} dests"
+        )
+    phase = man.meta["phase"]
+    extra = {"optimizer": man.meta["optimizer"]} if "optimizer" in man.meta else None
+    tracing.record_event("reshard.resume", phase=phase,
+                         old_n=plan.old_n, new_n=plan.new_n)
+    stats = _new_stats(phase, resumed=True)
+    return _finish(plan, sources, dests, mgr, stats, phase,
+                   fault_hook, on_imported, extra)

@@ -44,6 +44,47 @@ def sign_to_shard(signs: np.ndarray, num_shards: int) -> np.ndarray:
     return (splitmix64(signs) % np.uint64(num_shards)).astype(np.int64)
 
 
+def uniform_splits(num_shards: int) -> np.ndarray:
+    """Hash-uniform ring split points for ``num_shards`` PS replicas: the
+    ``num_shards - 1`` ascending u64 boundaries at ``k * 2^64 / n``. Replica
+    ``k`` owns hash positions ``[splits[k-1], splits[k])`` (half-open, with
+    the implicit ends 0 and 2^64). The elastic tier's planner replaces these
+    with load-weighted boundaries; routing stays :func:`sign_to_range_shard`
+    either way."""
+    n = int(num_shards)
+    if n < 1:
+        raise ValueError(f"num_shards must be >= 1, got {n}")
+    return np.array(
+        [(k * (1 << 64)) // n for k in range(1, n)], dtype=np.uint64
+    )
+
+
+def sign_to_range_shard(signs: np.ndarray, splits: np.ndarray) -> np.ndarray:
+    """Route each sign to a PS replica by its position on the splitmix64
+    ring: replica index = number of split points <= hash. ``splits`` is an
+    ascending u64 array of length ``n - 1`` (see :func:`uniform_splits`);
+    with load-weighted splits the same function implements the elastic
+    tier's skew-balanced routing. NOT numerically interchangeable with the
+    modulo router :func:`sign_to_shard` — a ring swap at a fence must move
+    the affected ranges first."""
+    h = splitmix64(np.asarray(signs, dtype=np.uint64))
+    return np.searchsorted(
+        np.asarray(splits, dtype=np.uint64), h, side="right"
+    ).astype(np.int64)
+
+
+def hash_range_mask(signs: np.ndarray, lo: int, hi: int) -> np.ndarray:
+    """Bool mask of signs whose splitmix64 hash lies in ``[lo, hi)`` —
+    ``hi == 0`` means "to the end of the ring" (2^64, which a u64 cannot
+    carry). The Python mirror of the native ``ps_export_range`` /
+    ``ps_delete_range`` ownership predicate."""
+    h = splitmix64(np.asarray(signs, dtype=np.uint64))
+    m = h >= np.uint64(lo)
+    if hi:
+        m &= h < np.uint64(hi)
+    return m
+
+
 def hash_stack(signs: np.ndarray, rounds: int, embedding_size: int) -> np.ndarray:
     """Expand each sign into ``rounds`` compressed table keys.
 

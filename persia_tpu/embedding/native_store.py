@@ -112,6 +112,13 @@ def _load_lib() -> ctypes.CDLL:
     lib.ps_journal_clear.argtypes = [p]
     lib.ps_scan_nonfinite.restype = i64
     lib.ps_scan_nonfinite.argtypes = [p, u64p, i64]
+    # elastic handoff (live resharding): hash-range export/delete
+    lib.ps_export_range_size.restype = i64
+    lib.ps_export_range_size.argtypes = [p, u64, u64]
+    lib.ps_export_range.restype = i64
+    lib.ps_export_range.argtypes = [p, u64, u64, u8p, i64]
+    lib.ps_delete_range.restype = i64
+    lib.ps_delete_range.argtypes = [p, u64, u64]
     _LIB = lib
     return lib
 
@@ -454,6 +461,55 @@ class NativeEmbeddingStore:
         if n < 0:
             raise ValueError("corrupt shard payload")
         return int(n)
+
+    # elastic handoff --------------------------------------------------------
+
+    def export_range(self, lo: int, hi: int) -> bytes:
+        """Serialize every entry whose routing hash lies in ``[lo, hi)``
+        (``hi == 0`` = 2^64), sorted by sign — deterministic bytes so the
+        handoff journal's crc dedups re-exports. Same size/retry idiom as
+        ``dump_shard`` (the size and export calls lock separately)."""
+        n = self._lib.ps_export_range_size(self._h, lo, hi)
+        for _ in range(8):
+            buf = np.empty(max(n, 4), dtype=np.uint8)
+            written = self._lib.ps_export_range(
+                self._h, lo, hi,
+                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(buf),
+            )
+            if written >= 0:
+                return buf[:written].tobytes()
+            n = max(self._lib.ps_export_range_size(self._h, lo, hi), n * 2)
+        raise RuntimeError("export_range failed: range kept growing concurrently")
+
+    def delete_range(self, lo: int, hi: int) -> int:
+        """Drop every entry whose routing hash lies in ``[lo, hi)``; returns
+        the removed count (0 on an idempotent replay)."""
+        return int(self._lib.ps_delete_range(self._h, lo, hi))
+
+    def import_range_journaled(self, journal_id: int, crc: int, blob: bytes) -> bool:
+        """Exactly-once range import — see the golden model's docstring for
+        the -1 (source-already-released) resume semantics."""
+        st = self.journal_probe(journal_id, crc)
+        if st != 0:
+            if st == -1:
+                logger.info(
+                    "handoff import id %#x re-offered with a different crc — "
+                    "source already released the range; original import "
+                    "stands (exactly-once)", journal_id,
+                )
+            return False
+        self.load_shard_bytes(blob)
+        self.journal_record(journal_id, crc)
+        return True
+
+    def delete_range_journaled(self, journal_id: int, crc: int, lo: int, hi: int):
+        """Exactly-once source-side range release; (lo, hi)-constant crc.
+        Returns (applied, removed)."""
+        if self.journal_probe(journal_id, crc) != 0:
+            return False, 0
+        removed = self.delete_range(lo, hi)
+        self.journal_record(journal_id, crc)
+        return True, removed
 
 
 def native_available() -> bool:

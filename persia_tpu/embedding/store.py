@@ -492,6 +492,77 @@ class EmbeddingStore:
             buf.write(vec.tobytes())
         return buf.getvalue()
 
+    def _range_signs(self, lo: int, hi: int) -> List[int]:
+        """Signs owned by the hash range ``[lo, hi)`` (``hi == 0`` = 2^64)
+        under the ROUTING hash (``splitmix64(sign)`` — what
+        ``sign_to_range_shard`` positions on the ring, NOT the store-internal
+        ``^ 0xA5A5A5A5`` shard hash). Caller holds ``_lock``."""
+        lo_u, hi_u = np.uint64(lo), np.uint64(hi)
+        out: List[int] = []
+        for shard in self._shards:
+            for sign in shard.entries:
+                h = splitmix64(np.array([sign], dtype=np.uint64))[0]
+                if h >= lo_u and (hi == 0 or h < hi_u):
+                    out.append(sign)
+        return out
+
+    def export_range(self, lo: int, hi: int) -> bytes:
+        """Serialize every entry whose routing hash lies in ``[lo, hi)``
+        (``hi == 0`` = to the end of the ring), SORTED BY SIGN — unlike
+        ``dump_shard``'s LRU order, a re-export after any crash/restore
+        yields byte-identical payload, so the handoff journal's crc dedups
+        replays. Read-only (no LRU touch); the wire format is
+        ``dump_shard``'s, so ``load_shard_bytes`` imports it anywhere."""
+        with self._lock:
+            items = sorted(
+                (s, self._shard_of(s).get(s)) for s in self._range_signs(lo, hi)
+            )
+        buf = io.BytesIO()
+        buf.write(struct.pack("<I", len(items)))
+        for sign, (dim, vec) in items:
+            buf.write(struct.pack("<QII", sign, dim, len(vec)))
+            buf.write(vec.tobytes())
+        return buf.getvalue()
+
+    def delete_range(self, lo: int, hi: int) -> int:
+        """Drop every entry whose routing hash lies in ``[lo, hi)`` — the
+        handoff's source-side release after the destination durably holds
+        the range. Returns the number of entries removed (idempotent: a
+        journal-deduped replay removes 0)."""
+        with self._lock:
+            signs = self._range_signs(lo, hi)
+            for s in signs:
+                self._shard_of(s).entries.pop(s, None)
+        return len(signs)
+
+    def import_range_journaled(self, journal_id: int, crc: int, blob: bytes) -> bool:
+        """Exactly-once range import: a journal hit means the crashed run
+        already imported this blob (1) or the source has since released the
+        range so a resumed re-export differs (-1) — either way the ORIGINAL
+        import stands and we skip. True when applied."""
+        st = self.journal_probe(journal_id, crc)
+        if st != 0:
+            if st == -1:
+                logger.info(
+                    "handoff import id %#x re-offered with a different crc — "
+                    "source already released the range; original import "
+                    "stands (exactly-once)", journal_id,
+                )
+            return False
+        self.load_shard_bytes(blob)
+        self.journal_record(journal_id, crc)
+        return True
+
+    def delete_range_journaled(self, journal_id: int, crc: int, lo: int, hi: int):
+        """Exactly-once source-side range release; the crc covers the
+        (lo, hi) constants (content-independent — a replayed delete must
+        dedup even after the entries are gone). Returns (applied, removed)."""
+        if self.journal_probe(journal_id, crc) != 0:
+            return False, 0
+        removed = self.delete_range(lo, hi)
+        self.journal_record(journal_id, crc)
+        return True, removed
+
     def load_shard_bytes(self, raw: bytes) -> int:
         """Load entries (routed by sign, so files from any shard layout work —
         the re-shard-on-load path, ref: emb_worker:1150-1259)."""

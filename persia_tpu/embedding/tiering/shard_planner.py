@@ -1,0 +1,208 @@
+"""Sparsity-aware PS shard planning: load-weighted ring split points.
+
+Hash-uniform sharding (``hashing.uniform_splits``) balances *key counts*,
+not *traffic*: under production zipf skew a handful of heavy-hitter signs
+concentrates lookup/update mass on whichever shard their hashes land in
+(Parallax's motivating observation — sparse variables need size- and
+access-aware partitioning, arxiv 1808.02621). The tiering access sketch
+already measures exactly that mass (``AccessProfiler.slot_tops`` heavy
+hitters + per-slot decayed totals), so the elastic tier can place ring
+boundaries where the *load* CDF crosses k/n rather than where the hash
+space does.
+
+Model: each merged heavy hitter is a point mass at its ring position
+``splitmix64(sign)`` (the position ``sign_to_range_shard`` routes by); the
+un-tracked remainder of each slot's mass is spread uniformly over the ring
+(sketch tails are hash-uniform to first order). Splits come from inverting
+that piecewise-linear CDF at the n-1 equal-mass targets; a point mass
+heavier than a whole target gets the boundary placed just past it, so one
+pathological sign never straddles two shards.
+
+Hysteresis follows :class:`..planner.PlacementPlanner`'s discipline: a
+same-count rebalance is adopted only when the candidate's modeled skew
+beats the incumbent's by a ``(1 + hysteresis)`` margin AND the incumbent
+has dwelled ``min_dwell`` planning rounds — two shards trading a hot range
+every round would otherwise thrash the handoff machinery. A *different*
+shard count always adopts (the reshard was explicitly requested).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from persia_tpu.embedding.hashing import splitmix64, uniform_splits
+
+_RING = float(1 << 64)
+
+
+@dataclass
+class ShardPlan:
+    """One planning round's outcome."""
+
+    splits: np.ndarray  # (n-1,) ascending u64 ring boundaries
+    loads: np.ndarray  # (n,) modeled load fraction per shard (sums to 1)
+    skew: float  # max(loads) / mean(loads) — 1.0 is perfect balance
+    adopted: bool  # False = hysteresis kept the incumbent
+    suppressed: int  # cumulative rebalances suppressed by hysteresis
+
+
+class ShardPlanner:
+    """Load-weighted ring splits from the tiering access sketch."""
+
+    def __init__(self, hysteresis: float = 0.1, min_dwell: int = 2):
+        self.hysteresis = float(hysteresis)
+        self.min_dwell = int(min_dwell)
+        self._current: Optional[np.ndarray] = None
+        self._dwell = 0  # rounds the incumbent has been stable
+        self.suppressed = 0
+
+    # ----------------------------------------------------------- load model
+
+    @staticmethod
+    def mass_from_profiler(profiler) -> Tuple[np.ndarray, np.ndarray, float]:
+        """(positions u64, point masses, uniform residual mass) summed over
+        every slot the profiler tracks: heavy hitters become point masses
+        at their ring positions; each slot's remaining (total - tracked)
+        mass joins the uniform residual."""
+        pos_l: List[int] = []
+        w_l: List[float] = []
+        residual = 0.0
+        for name, st in profiler.stats().items():
+            tracked = 0.0
+            for sign, est in profiler.slot_tops(name):
+                pos_l.append(sign)
+                w_l.append(float(est))
+                tracked += float(est)
+            residual += max(float(st.total) - tracked, 0.0)
+        if not pos_l:
+            return (np.empty(0, np.uint64), np.empty(0, np.float64), residual)
+        pos = splitmix64(np.array(pos_l, dtype=np.uint64))
+        w = np.array(w_l, dtype=np.float64)
+        # same sign may be hot in several slots → one combined point mass
+        pos, inv = np.unique(pos, return_inverse=True)
+        combined = np.zeros(len(pos), dtype=np.float64)
+        np.add.at(combined, inv, w)
+        return pos, combined, residual
+
+    @staticmethod
+    def shard_loads(
+        splits: np.ndarray, pos: np.ndarray, w: np.ndarray, residual: float,
+    ) -> np.ndarray:
+        """Modeled load fraction per shard for a given ring: uniform
+        residual proportional to arc length + point masses routed by
+        ``searchsorted(side="right")`` (the router's own rule)."""
+        splits = np.asarray(splits, dtype=np.uint64)
+        n = len(splits) + 1
+        edges = np.concatenate([[0.0], splits.astype(np.float64), [_RING]])
+        loads = residual * np.diff(edges) / _RING
+        if len(pos):
+            shard = np.searchsorted(splits, np.asarray(pos, np.uint64),
+                                    side="right")
+            np.add.at(loads, shard, np.asarray(w, np.float64))
+        total = loads.sum()
+        return loads / total if total > 0 else np.full(n, 1.0 / n)
+
+    @staticmethod
+    def skew_of(loads: np.ndarray) -> float:
+        loads = np.asarray(loads, dtype=np.float64)
+        mean = loads.mean()
+        return float(loads.max() / mean) if mean > 0 else 1.0
+
+    # ------------------------------------------------------------ inversion
+
+    @staticmethod
+    def _invert_cdf(
+        num_shards: int, pos: np.ndarray, w: np.ndarray, residual: float,
+    ) -> np.ndarray:
+        """Place n-1 boundaries at the equal-mass crossings of the
+        piecewise-linear load CDF. A target landing inside a point mass's
+        jump puts the boundary just past it (the hot sign stays whole on
+        the left shard). Degenerate inputs (no mass at all) fall back to
+        hash-uniform splits."""
+        n = int(num_shards)
+        if n < 1:
+            raise ValueError(f"num_shards must be >= 1, got {n}")
+        total = float(np.sum(w)) + residual
+        if n == 1:
+            return np.empty(0, dtype=np.uint64)
+        if total <= 0.0:
+            return uniform_splits(n)
+        order = np.argsort(pos)
+        pos_u = np.asarray(pos, np.uint64)[order]
+        pos_s = pos_u.astype(np.float64)
+        w_s = np.asarray(w, np.float64)[order]
+        u = residual / _RING  # uniform density per ring unit
+        cum_w = np.concatenate([[0.0], np.cumsum(w_s)])  # before hotspot j
+        splits = np.empty(n - 1, dtype=np.uint64)
+        j = 0
+        for k in range(1, n):
+            t = total * k / n
+            while j < len(pos_s) and cum_w[j + 1] + u * pos_s[j] < t:
+                j += 1
+            if j < len(pos_s) and cum_w[j] + u * pos_s[j] >= t:
+                # the target lies in the linear segment BEFORE hotspot j is
+                # even reached — solve the uniform part alone
+                x = (t - cum_w[j]) / u if u > 0 else pos_s[j]
+            elif j < len(pos_s):
+                # inside hotspot j's jump: boundary just past the hot sign,
+                # in EXACT u64 arithmetic — float64 spacing at 2^61+ ring
+                # positions exceeds 1, so ``pos + 1.0`` would round back
+                # onto (or below) the hot position and drop the mass on the
+                # wrong side of the split
+                splits[k - 1] = np.uint64(min(int(pos_u[j]) + 1,
+                                              (1 << 64) - 1))
+                j += 1
+                continue
+            else:
+                x = (t - cum_w[-1]) / u if u > 0 else _RING - 1.0
+            # clamp to the largest float64 BELOW 2^64: ``_RING - 1.0``
+            # rounds up to 2^64 itself, which overflows the u64 cast
+            splits[k - 1] = np.uint64(min(max(x, 0.0), 18446744073709549568.0))
+        # float inversion can collapse neighbours; ring splits must be
+        # strictly ascending — nudge forward deterministically
+        for i in range(1, n - 1):
+            if splits[i] <= splits[i - 1]:
+                splits[i] = splits[i - 1] + np.uint64(1)
+        return splits
+
+    # ----------------------------------------------------------------- plan
+
+    def plan(
+        self,
+        num_shards: int,
+        profiler=None,
+        pos: Optional[np.ndarray] = None,
+        w: Optional[np.ndarray] = None,
+        residual: Optional[float] = None,
+    ) -> ShardPlan:
+        """One planning round. Load either from ``profiler`` or from raw
+        ``(pos, w, residual)`` point masses (tests / offline benches)."""
+        if profiler is not None:
+            pos, w, residual = self.mass_from_profiler(profiler)
+        if pos is None:
+            pos, w, residual = (np.empty(0, np.uint64),
+                                np.empty(0, np.float64), 1.0)
+        residual = 1.0 if residual is None else float(residual)
+        cand = self._invert_cdf(num_shards, pos, w, residual)
+        cand_loads = self.shard_loads(cand, pos, w, residual)
+        cand_skew = self.skew_of(cand_loads)
+        incumbent = self._current
+        if incumbent is not None and len(incumbent) == len(cand):
+            inc_skew = self.skew_of(
+                self.shard_loads(incumbent, pos, w, residual)
+            )
+            clears = cand_skew * (1.0 + self.hysteresis) < inc_skew
+            if not (clears and self._dwell >= self.min_dwell):
+                if clears:  # margin met but still dwelling — a flap
+                    self.suppressed += 1
+                self._dwell += 1
+                inc_loads = self.shard_loads(incumbent, pos, w, residual)
+                return ShardPlan(incumbent, inc_loads, inc_skew,
+                                 adopted=False, suppressed=self.suppressed)
+        self._current = cand
+        self._dwell = 0
+        return ShardPlan(cand, cand_loads, cand_skew, adopted=True,
+                         suppressed=self.suppressed)

@@ -30,7 +30,12 @@ import numpy as np
 from persia_tpu.config import EmbeddingConfig, HyperParameters, SlotConfig
 from persia_tpu.data import IDTypeFeature, PersiaBatch
 from persia_tpu.embedding import native_worker
-from persia_tpu.embedding.hashing import add_index_prefix, hash_stack, sign_to_shard
+from persia_tpu.embedding.hashing import (
+    add_index_prefix,
+    hash_stack,
+    sign_to_range_shard,
+    sign_to_shard,
+)
 from persia_tpu.embedding.store import EmbeddingStore
 from persia_tpu.metrics import get_metrics
 from persia_tpu.monitor import EmbeddingMonitor
@@ -192,10 +197,20 @@ class ShardedLookup:
         recover=None,
         policy=None,
         degraded_init=None,
+        ring=None,
     ):
         if not replicas:
             raise ValueError("need at least one PS replica")
-        self.replicas = list(replicas)
+        # --- versioned topology (elastic PS tier) ---------------------------
+        # The replica list and its optional routing ring live in ONE tuple
+        # swapped atomically at a reshard fence (``swap_topology``): a reader
+        # that captured the tuple sees a consistent (replicas, ring) pair even
+        # while a swap publishes the next version. ``ring`` is the ascending
+        # u64 split-point array of hashing.sign_to_range_shard (len == n - 1);
+        # None keeps the legacy hash-modulo routing (and its native one-pass
+        # partition fast path).
+        self._ring_lock = threading.Lock()  # serializes swaps, not reads
+        self._topo = (list(replicas), self._check_ring(ring, len(replicas)), 0)
         # callable(replica) -> None: re-push optimizer + hyperparams to a
         # replica that lost its runtime config (restarted PS; ref: the
         # worker rebuilds its PS client pool on RpcError,
@@ -248,6 +263,11 @@ class ShardedLookup:
             "persia_tpu_journal_dup_skips",
             "gradient batches skipped by the PS apply-journal on resume replay",
         )
+        self._m_replicas = m.gauge(
+            "persia_tpu_ps_replicas",
+            "PS replica count in the router's current topology",
+        )
+        self._m_replicas.set(len(replicas))
         # eager pool (lazy init would race: EmbeddingWorker's slot threads
         # call the router concurrently): sized for replicas x concurrent
         # slot callers — the transport below is the pooled RpcClient
@@ -276,13 +296,126 @@ class ShardedLookup:
                 return fn()
             raise
 
+    # ------------------------------------------------- versioned topology
+
+    @staticmethod
+    def _check_ring(ring, n: int):
+        """Validate a split-point ring against the replica count: ``None``
+        (modulo routing) or an ascending u64 array of length ``n - 1``."""
+        if ring is None:
+            return None
+        ring = np.asarray(ring, dtype=np.uint64)
+        if ring.shape != (n - 1,):
+            raise ValueError(
+                f"ring has {ring.shape[0] if ring.ndim == 1 else ring.shape} "
+                f"split points, need {n - 1} for {n} replicas"
+            )
+        if ring.size > 1 and not (ring[:-1] < ring[1:]).all():
+            raise ValueError("ring split points must be strictly ascending")
+        return ring
+
+    @property
+    def replicas(self) -> List:
+        """Current replica list (one consistent topology snapshot)."""
+        return self._topo[0]
+
+    @property
+    def ring(self):
+        """Current split-point ring (None => hash-modulo routing)."""
+        return self._topo[1]
+
+    @property
+    def topology_version(self) -> int:
+        """Monotonic version, bumped by every swap — reshard telemetry and
+        tests pin ring swaps to it."""
+        return self._topo[2]
+
+    def swap_topology(self, replicas: Sequence, ring=None) -> int:
+        """Atomically publish a new (replicas, ring) pair — the router half
+        of a reshard fence. The caller guarantees the stream is drained (no
+        in-flight lookups straddle the swap) and the sign ranges have been
+        handed off; this method only swaps routing. Degraded-sign records
+        and per-endpoint circuit breakers deliberately SURVIVE: degraded
+        records are keyed by sign (still valid under any routing) and
+        breakers by endpoint (a surviving replica keeps its health history
+        across the swap). Returns the new topology version."""
+        replicas = list(replicas)
+        if not replicas:
+            raise ValueError("need at least one PS replica")
+        ring = self._check_ring(ring, len(replicas))
+        with self._ring_lock:
+            version = self._topo[2] + 1
+            if len(replicas) > 1 and self._fan_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._fan_pool = ThreadPoolExecutor(
+                    max_workers=min(32, 8 * len(replicas)),
+                    thread_name_prefix="ps-fanout",
+                )
+            self._topo = (replicas, ring, version)
+        self._m_replicas.set(len(replicas))
+        from persia_tpu import tracing
+
+        tracing.record_event(
+            "reshard.ring_swap",
+            version=version,
+            replicas=len(replicas),
+            ring="range" if ring is not None else "modulo",
+        )
+        return version
+
     # ----------------------------------------------- degraded-mode machinery
 
     def replace_replica(self, idx: int, replica) -> None:
-        """Swap replica ``idx`` for a promoted standby (same sign-partition
-        slot, new transport). In-flight calls on the old handle finish or
-        fail through their own retry path; new calls route to the standby."""
-        self.replicas[idx] = replica
+        """Swap replica ``idx`` for a promoted standby or a restarted
+        process (same sign-partition slot, new transport). In-flight calls
+        on the old handle finish or fail through their own retry path; new
+        calls route to the fresh replica.
+
+        Unlike ``swap_topology`` (surviving replicas keep their history),
+        the slot's health state is RESET here: the fresh process inherits
+        no breaker penalty from its predecessor (a stale OPEN breaker on
+        the reused endpoint would quarantine a healthy standby for a full
+        reset window), and degraded-sign records routed to this slot are
+        purged — the new replica serves the real rows, so their next
+        gradients must NOT be dropped as degraded."""
+        with self._ring_lock:
+            reps, ring, version = self._topo
+            if not (0 <= idx < len(reps)):
+                raise IndexError(f"replica index {idx} out of range 0..{len(reps) - 1}")
+            reps = list(reps)
+            reps[idx] = replica
+            self._topo = (reps, ring, version + 1)
+        endpoint = getattr(replica, "endpoint", None)
+        if self.policy is not None and endpoint is not None:
+            self.policy.reset_breaker(endpoint)
+        self._purge_degraded_for_slot(idx)
+        from persia_tpu import tracing
+
+        tracing.record_event(
+            "reshard.replace_replica", slot=idx, endpoint=str(endpoint)
+        )
+
+    def _purge_degraded_for_slot(self, idx: int) -> None:
+        """Drop degraded-sign records that route to replica slot ``idx``
+        under the CURRENT topology (their stand-in rows came from this
+        slot's dead predecessor; the fresh process serves real rows)."""
+        with self._deg_lock:
+            if not self._degraded_signs:
+                return
+            signs = np.fromiter(
+                self._degraded_signs, dtype=np.uint64,
+                count=len(self._degraded_signs),
+            )
+        reps, ring, _ = self._topo
+        if ring is not None:
+            routed = sign_to_range_shard(signs, ring)
+        else:
+            routed = sign_to_shard(signs, len(reps))
+        mine = signs[routed == idx]
+        if len(mine):
+            with self._deg_lock:
+                self._degraded_signs.difference_update(int(s) for s in mine)
 
     def _guarded(self, rep, fn, signs_for_fallback, fallback):
         """One replica call under the resilience policy: transport failures
@@ -442,9 +575,19 @@ class ShardedLookup:
         """[(replica_index, positions-or-mask), ...] for the touched
         replicas — the one sign-routing split every fan-out method shares
         (native one-pass partition when available, boolean masks otherwise;
-        both index forms select rows identically downstream)."""
-        n = len(self.replicas)
+        both index forms select rows identically downstream). With a
+        split-point ring installed the native modulo partition is invalid —
+        range routing via :func:`sign_to_range_shard` replaces it."""
+        reps, ring, _ = self._topo
+        n = len(reps)
         sel = []
+        if ring is not None:
+            shard = sign_to_range_shard(signs, ring)
+            for r in range(n):
+                mask = shard == r
+                if mask.any():
+                    sel.append((r, mask))
+            return sel
         part = native_worker.shard_partition(signs, n)
         if part is not None:
             pos, counts = part

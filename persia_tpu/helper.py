@@ -62,6 +62,11 @@ class ServiceCtx:
         self._standbys: List[tuple] = []  # (addr, Popen)
         self._guard_stop = threading.Event()
         self._guard_thread: Optional[threading.Thread] = None
+        # elastic tier: the PS ring currently in force (None = the legacy
+        # modulo topology every fresh cluster starts with); set by
+        # reshard_ps / resume_reshard and published to the coordinator KV
+        # as "ps_ring" so late joiners route by the live ring
+        self.ps_ring = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -331,6 +336,193 @@ class ServiceCtx:
         self._replay_snapshot(i, c)
         self.coord_client.register("parameter_server", i, standby_addr)
         return standby_addr
+
+    # ------------------------------------------------------ elastic reshard
+
+    def _publish_ring(self, splits) -> None:
+        import numpy as np
+
+        self.ps_ring = np.asarray(splits, dtype=np.uint64)
+        self.coord_client.kv_put(
+            "ps_ring", self.ps_ring.astype("<u8").tobytes()
+        )
+
+    def _grow_ps(self, i: int) -> str:
+        """Bring replica ``i`` (>= current fleet) online: reuse an idle
+        standby if one was pre-spawned (warm add — no process startup on
+        the critical path), else spawn one, then claim the coordinator
+        slot. Extends the per-index process table so restart_ps/kill_ps
+        address the new replica like any other."""
+        if not self._standbys:
+            self.spawn_standby_ps()
+        addr, p = self._standbys.pop(0)
+        self.coord_client.register("parameter_server", i, addr)
+        while len(self._ps_procs) <= i:
+            self._ps_procs.append(p)
+        self._ps_procs[i] = p
+        return addr
+
+    def reshard_ps(
+        self,
+        n_new: int,
+        job_state,
+        *,
+        step: int = 0,
+        splits=None,
+        planner=None,
+        profiler=None,
+        router=None,
+        fault_hook=None,
+        batch_advances=None,
+    ) -> dict:
+        """Live-reshard the PS tier to ``n_new`` replicas at a drained
+        stream fence (the caller guarantees nothing is in flight). The new
+        ring comes from ``splits`` if given, else a sparsity-aware
+        ``planner.plan(n_new, profiler=...)`` (load-weighted boundaries
+        from the tiering access sketch), else hash-uniform. Handoffs run
+        under the exactly-once journal discipline of
+        :mod:`persia_tpu.elastic`; a crash at ANY point (ours or a PS's)
+        resumes via :meth:`resume_reshard` to a state bit-identical to an
+        uninterrupted reshard. ``router`` (a ``ShardedLookup``) is swapped
+        to the new ring at the imported boundary; ``fault_hook`` is the
+        chaos plane's injection point."""
+        from persia_tpu import elastic, jobstate
+        from persia_tpu.embedding.hashing import uniform_splits
+
+        mgr = jobstate.coerce_manager(job_state)
+        old_n = self.n_ps
+        old_addrs = self.ps_addrs()
+        if splits is None:
+            if planner is not None:
+                splits = planner.plan(n_new, profiler=profiler).splits
+            else:
+                splits = uniform_splits(n_new)
+        old_splits = None if self.ps_ring is None else [int(x) for x in self.ps_ring]
+        plan = elastic.plan_reshard(
+            old_n, n_new, old_splits, splits,
+            elastic.reshard_base_id(mgr, step),
+        )
+
+        sources = [StoreClient(a) for a in old_addrs]
+        opt = sources[0].get_optimizer()
+        opt_dict = opt.to_dict() if opt else None
+        dest_addrs = list(old_addrs[:min(old_n, n_new)])
+        for i in range(old_n, n_new):
+            dest_addrs.append(self._grow_ps(i))
+        dests = [
+            sources[i] if i < old_n else StoreClient(dest_addrs[i])
+            for i in range(n_new)
+        ]
+        # joiners need the optimizer BEFORE the first import: a store
+        # without it re-initializes imported entries on entry-width
+        # mismatch at the first train lookup (see _replay_snapshot), and
+        # Adam joiners additionally re-advance beta powers to the fence
+        for i in range(old_n, n_new):
+            if opt is not None:
+                dests[i].register_optimizer(opt)
+            for group, count in (batch_advances or {}).items():
+                for _ in range(int(count)):
+                    dests[i].advance_batch_state(int(group))
+        self.n_ps = max(old_n, n_new)
+
+        stats = elastic.execute_reshard(
+            plan, sources, dests, mgr,
+            fault_hook=fault_hook,
+            on_imported=self._ring_swapper(router, dests, splits),
+            extra_meta={"optimizer": opt_dict,
+                        "batch_advances": {str(k): int(v) for k, v in
+                                           (batch_advances or {}).items()}},
+        )
+        self._finalize_reshard(plan, splits)
+        stats["skew_splits"] = [int(x) for x in splits]
+        return stats
+
+    def _ring_swapper(self, router, dests, splits):
+        if router is None:
+            return None
+
+        def swap():
+            import numpy as np
+
+            router.swap_topology(list(dests), ring=np.asarray(splits, np.uint64))
+
+        return swap
+
+    def _finalize_reshard(self, plan, splits) -> None:
+        """Post-``done`` topology bookkeeping: drop drained replicas from
+        the registry and the process table, publish the new ring."""
+        for i in range(plan.new_n, plan.old_n):
+            self.coord_client.deregister("parameter_server", i)
+            self.kill_ps(i)
+        self._ps_procs = self._ps_procs[: plan.new_n]
+        self.n_ps = plan.new_n
+        self._publish_ring(splits)
+
+    def resume_reshard(self, job_state, *, router=None, fault_hook=None):
+        """Re-enter a reshard interrupted by a SIGKILL — of a source PS, a
+        dest PS, or the coordinating process itself. Restores dead replicas
+        per the crash matrix (fence snapshot for sources mid-handoff, fresh
+        + re-import for dests mid-handoff, post-import snapshot for dests
+        mid-delete), then replays the recorded plan; every op the crashed
+        run already applied dedupes against the PS apply-journal. Returns
+        the run stats, or None when there is nothing to resume."""
+        from persia_tpu import elastic, jobstate
+        from persia_tpu.embedding.optim import OptimizerConfig
+
+        mgr = jobstate.coerce_manager(job_state)
+        man = elastic.find_reshard_manifest(mgr)
+        if man is None or man.meta.get("phase") == "done":
+            return None
+        plan = elastic.ReshardPlan.from_meta(man.meta)
+        phase = man.meta["phase"]
+        opt_dict = man.meta.get("optimizer")
+        self.n_ps = max(plan.old_n, plan.new_n)
+        addrs = self.ps_addrs()
+
+        def dead(i: int) -> bool:
+            return i >= len(self._ps_procs) or self._ps_procs[i].poll() is not None
+
+        if phase == "handoff":
+            for i in range(plan.old_n):
+                if dead(i):
+                    self._ps_snapshots[i] = (
+                        elastic.source_snapshot(man, i), opt_dict,
+                    )
+                    self.restart_ps(i, restore=True)
+            for i in range(plan.old_n, plan.new_n):
+                if dead(i):
+                    # a joiner's journal died with it: restart FRESH, the
+                    # replayed imports re-apply the identical blobs
+                    self.restart_ps(i, restore=False)
+                    c = StoreClient(addrs[i])
+                    if opt_dict:
+                        c.register_optimizer(OptimizerConfig.from_dict(opt_dict))
+                    for group, count in (
+                        man.meta.get("batch_advances") or {}
+                    ).items():
+                        for _ in range(int(count)):
+                            c.advance_batch_state(int(group))
+        else:  # "imported": only surviving replicas matter for the deletes
+            for i in range(plan.new_n):
+                if dead(i):
+                    self._ps_snapshots[i] = (
+                        elastic.dest_snapshot(man, i), opt_dict,
+                    )
+                    self.restart_ps(i, restore=True)
+
+        sources = [StoreClient(a) for a in addrs[: plan.old_n]]
+        dests = [
+            sources[i] if i < plan.old_n else StoreClient(addrs[i])
+            for i in range(plan.new_n)
+        ]
+        splits = plan.new_splits
+        stats = elastic.resume_reshard(
+            mgr, sources, dests, fault_hook=fault_hook,
+            on_imported=self._ring_swapper(router, dests, splits),
+        )
+        if stats is not None:
+            self._finalize_reshard(plan, splits)
+        return stats
 
     def _watch(self):
         """Crash watchdog (ref: helper.py:296-315): if any service process

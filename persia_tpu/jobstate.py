@@ -123,6 +123,15 @@ def journal_shard_id(base_id: int, replica_index: int) -> int:
     return base_id | (replica_index & 0xFF)
 
 
+def handoff_journal_id(base_id: int, op_index: int) -> int:
+    """Journal id for one reshard-handoff op (range import or delete):
+    the 0x80 low-byte namespace — real PS replica indices stay < 0x80
+    (:func:`journal_shard_id`), so a handoff at the same fence step can
+    never collide with a gradient batch's per-replica id. ``op_index``
+    numbers the ops of one reshard plan (< 128)."""
+    return base_id | 0x80 | (op_index & 0x7F)
+
+
 def payload_crc(*arrays) -> int:
     """crc32 of a gradient batch's payload arrays — the ``crc`` member of
     the journal's (step, shard, crc) record. A replay that produces a

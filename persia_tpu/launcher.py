@@ -118,6 +118,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                      help="quarantine replicas lagging past this many steps")
     loc.add_argument("--base-dir", type=str, default=None,
                      help="working directory (default: a fresh tempdir)")
+    loc.add_argument("--reshard-ps", type=int, default=0,
+                     help="live-reshard the PS tier to this many replicas "
+                          "once the fleet is up (needs --ps > 0): exercises "
+                          "the exactly-once elastic handoff "
+                          "(persia_tpu/elastic.py) on a real topology")
     loc.add_argument("--seed", type=int, default=7)
     loc.add_argument("--trace-dir", type=str, default=None,
                      help="arm fleet tracing: every role serves /metrics + "
@@ -243,6 +248,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"local topology up: {args.trainers} trainer(s), "
                   f"{args.replicas} replica(s) [{ports}]", flush=True)
             print(f"workdir: {topo.base_dir}", flush=True)
+            if args.reshard_ps > 0:
+                if args.ps <= 0:
+                    print("--reshard-ps needs --ps > 0", file=sys.stderr)
+                    return 2
+                stats = topo.reshard_ps(args.reshard_ps)
+                print(f"PS tier resharded {args.ps} -> {args.reshard_ps}: "
+                      f"{_json.dumps({k: v for k, v in stats.items() if k != 'skew_splits'})}",
+                      flush=True)
             t_end = (_time.monotonic() + args.duration_s
                      if args.duration_s > 0 else None)
             try:

@@ -231,6 +231,37 @@ class StoreClient:
     def load_shard_bytes(self, raw: bytes) -> int:
         return struct.unpack("<q", self._rpc.call("load_shard", raw))[0]
 
+    # elastic handoff --------------------------------------------------------
+
+    def export_range(self, lo: int, hi: int) -> bytes:
+        """Hash-range export [lo, hi) (hi == 0 = 2^64), sorted by sign —
+        read-only and deterministic, so retries and resumed handoffs carry
+        the same payload crc."""
+        return self._rpc.call(
+            "export_range", struct.pack("<QQ", lo, hi),
+            idempotent=True, timeout_s=600.0,
+        )
+
+    def import_range_journaled(self, journal_id: int, crc: int, blob: bytes) -> bool:
+        """Exactly-once range import on the destination PS; journal-deduped,
+        so a dropped reply re-sent cannot double-import. True when applied."""
+        raw = self._rpc.call(
+            "import_range_journaled",
+            struct.pack("<QI", journal_id, crc & 0xFFFFFFFF) + blob,
+            idempotent=True, timeout_s=600.0,
+        )
+        return raw == b"\x01"
+
+    def delete_range_journaled(self, journal_id: int, crc: int, lo: int, hi: int):
+        """Exactly-once source-side range release. Returns (applied, removed)."""
+        raw = self._rpc.call(
+            "delete_range_journaled",
+            struct.pack("<QIQQ", journal_id, crc & 0xFFFFFFFF, lo, hi),
+            idempotent=True, timeout_s=600.0,
+        )
+        applied, removed = struct.unpack("<bq", raw)
+        return bool(applied), int(removed)
+
     @property
     def num_internal_shards(self) -> int:
         return struct.unpack("<I", self._rpc.call("num_shards"))[0]

@@ -27,6 +27,7 @@ class Coordinator:
         self._lock = threading.Lock()
         self.server = RpcServer(port=port)
         self.server.register("register", self._register)
+        self.server.register("deregister", self._deregister)
         self.server.register("list", self._list)
         self.server.register("kv_put", self._kv_put)
         self.server.register("kv_get", self._kv_get)
@@ -43,6 +44,14 @@ class Coordinator:
         req = proto.unpack_json(payload)
         with self._lock:
             self._registry.setdefault(req["role"], {})[int(req["index"])] = req["addr"]
+        return b"ok"
+
+    def _deregister(self, payload: bytes) -> bytes:
+        # the elastic tier's shrink path: a removed PS replica leaves the
+        # registry so late joiners don't resolve a drained endpoint
+        req = proto.unpack_json(payload)
+        with self._lock:
+            self._registry.get(req["role"], {}).pop(int(req["index"]), None)
         return b"ok"
 
     def _list(self, payload: bytes) -> bytes:
@@ -74,6 +83,13 @@ class CoordinatorClient:
         self._client.call(
             "register",
             proto.pack_json({"role": role, "index": index, "addr": addr}),
+            idempotent=True,
+        )
+
+    def deregister(self, role: str, index: int) -> None:
+        # keyed delete → safe to retry (elastic shrink removes the replica)
+        self._client.call(
+            "deregister", proto.pack_json({"role": role, "index": index}),
             idempotent=True,
         )
 

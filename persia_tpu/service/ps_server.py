@@ -86,6 +86,14 @@ class ParameterServerService:
         s.register("get_optimizer", self._get_optimizer)
         s.register("dump_shard", self._dump_shard)
         s.register("load_shard", self._load_shard)
+        # elastic handoff (live resharding, persia_tpu.elastic): range
+        # export is read-only; import/delete ride the SAME bounded
+        # apply-journal as gradient batches (handoff ids live in the
+        # jobstate.handoff_journal_id 0x80 low-byte namespace, so they
+        # never collide with per-replica gradient ids)
+        s.register("export_range", self._export_range)
+        s.register("import_range_journaled", self._import_range_journaled)
+        s.register("delete_range_journaled", self._delete_range_journaled)
         s.register("dump_to_dir", self._dump_to_dir)
         s.register("load_from_dir", self._load_from_dir)
         s.register("model_manager_status", lambda p: proto.pack_json(self.status.get()))
@@ -239,6 +247,30 @@ class ParameterServerService:
 
     def _load_shard(self, payload: bytes) -> bytes:
         return struct.pack("<q", self.store.load_shard_bytes(payload))
+
+    # elastic handoff --------------------------------------------------------
+
+    def _export_range(self, payload: bytes) -> bytes:
+        """Serialize the hash range [lo, hi) (hi == 0 = 2^64), sorted by
+        sign — deterministic bytes, so a resumed handoff's re-export
+        carries the same crc and the journal dedups it."""
+        lo, hi = struct.unpack("<QQ", payload)
+        return self.store.export_range(lo, hi)
+
+    def _import_range_journaled(self, payload: bytes) -> bytes:
+        """Exactly-once range import: ``b"\\x01"`` applied, ``b"\\x00"``
+        skipped (journal dedup — see ``EmbeddingStore.import_range_journaled``
+        for the resume semantics)."""
+        jid, crc = struct.unpack_from("<QI", payload)
+        applied = self.store.import_range_journaled(jid, crc, payload[12:])
+        return b"\x01" if applied else b"\x00"
+
+    def _delete_range_journaled(self, payload: bytes) -> bytes:
+        """Exactly-once source-side range release; reply = applied flag +
+        removed count."""
+        jid, crc, lo, hi = struct.unpack("<QIQQ", payload)
+        applied, removed = self.store.delete_range_journaled(jid, crc, lo, hi)
+        return struct.pack("<bq", int(applied), removed)
 
     def _dump_to_dir(self, payload: bytes) -> bytes:
         req = proto.unpack_json(payload)

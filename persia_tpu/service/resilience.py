@@ -227,6 +227,19 @@ class CircuitBreaker:
             self._failures = self.failure_threshold
             self._m_state.set(1, endpoint=self.endpoint)
 
+    def reset(self) -> None:
+        """Administrative re-close: forget the failure history in place
+        (object identity survives for callers holding a reference). The
+        elastic tier's ``replace_replica`` maps here — a fresh process on a
+        reused endpoint must not inherit its dead predecessor's OPEN state."""
+        with self._lock:
+            self._failures = 0
+            self._probe_inflight = False
+            self._open_until = 0.0
+            if self._state != _STATE_CLOSED:
+                self._state = _STATE_CLOSED
+                self._m_state.set(0, endpoint=self.endpoint)
+
 
 @dataclass
 class ResiliencePolicy:
@@ -260,6 +273,24 @@ class ResiliencePolicy:
                     reset_timeout_s=self.breaker_reset_s,
                 )
             return b
+
+    def reset_breaker(self, endpoint: str) -> None:
+        """Forget the endpoint's breaker history — the elastic tier calls
+        this when a FRESH process takes over an endpoint (standby promotion,
+        restart on the original port): the predecessor's OPEN state would
+        otherwise quarantine the healthy newcomer for a full reset window.
+        A no-op when the endpoint has no breaker yet."""
+        with self._lock:
+            b = self._breakers.get(endpoint)
+        if b is not None:
+            prior = b.state
+            b.reset()
+            from persia_tpu import tracing
+
+            tracing.record_event(
+                "breaker.reset", endpoint=endpoint, prior_state=prior,
+                trips=b.trips,
+            )
 
     def breaker_states(self) -> Dict[str, str]:
         with self._lock:

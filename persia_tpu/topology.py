@@ -534,6 +534,19 @@ class LocalTopology:
         heals it back into rotation when its breaker re-closes."""
         self._replica_procs[i] = self._spawn_replica(i)
 
+    def reshard_ps(self, n_new: int, **kw) -> Dict:
+        """Live-reshard the PS tier to ``n_new`` replicas (needs ``ps > 0``):
+        delegates to :meth:`ServiceCtx.reshard_ps` with a journal dir under
+        this topology's base_dir, so an interrupted reshard resumes through
+        ``self.svc.resume_reshard`` against the same manifests. Accepts the
+        same keyword knobs (``planner``/``profiler``/``router``/
+        ``fault_hook``/...)."""
+        if self.svc is None:
+            raise RuntimeError("reshard_ps needs a PS tier (ps > 0)")
+        js = os.path.join(self.base_dir, "reshard_js")
+        os.makedirs(js, exist_ok=True)
+        return self.svc.reshard_ps(n_new, js, **kw)
+
     def _watch(self) -> None:
         while not self._watch_stop.wait(0.3):
             for k, p in enumerate(self._trainer_procs):
@@ -572,6 +585,10 @@ class LocalTopology:
             out["gateway"] = self.gateway.stats()
         if self.delta_chaos is not None:
             out["delta_channel"] = dict(self.delta_chaos.counts)
+        if self.svc is not None:
+            out["n_ps"] = self.svc.n_ps
+            if self.svc.ps_ring is not None:
+                out["ps_ring"] = [int(x) for x in self.svc.ps_ring]
         return out
 
     # ------------------------------------------------------------- telemetry

@@ -101,6 +101,13 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_stage_graph.py -q -m 'not slow' -k
 JAX_PLATFORMS=cpu python -m pytest tests/test_dense_sync.py -q -m 'not slow'
 JAX_PLATFORMS=cpu python -m pytest tests/test_grad_sync.py -q -m 'not slow' \
     -k "block_int8 or sharded or quantize or sync_mode"
+# elastic PS tier fast subset (ISSUE 15): reshard planning + journal-id
+# namespace units, the sparsity-aware ShardPlanner, router ring-swap /
+# replace_replica breaker-reset regression, range handoff dedupe, and the
+# in-proc engine crash/resume matrix; the multi-process ServiceCtx
+# grow/shrink chaos parity runs (test_ctx_*) ride the full suite in step 2
+JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q -m 'not slow' \
+    -k "not ctx_"
 
 echo "== 1.5/5 telemetry plane (trace propagation + flight recorder) =="
 # the fast tracing/telemetry subset: span mechanics, RPC + gateway HTTP

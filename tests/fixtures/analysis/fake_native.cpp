@@ -17,4 +17,8 @@ void fx_touch(void* h, const uint64_t* signs, int64_t n);
 // ABI006 fixture asserts the unbound-export rule fires
 int64_t fx_orphan(void* h);
 
+// internal linkage — must NOT be treated as an export (no ABI006), even
+// though it lexically sits inside the extern "C" block
+static inline bool fx_helper(uint64_t sign) { return (sign & 1) != 0; }
+
 }  // extern "C"

@@ -1,0 +1,532 @@
+"""Elastic PS tier (ISSUE 15): live resharding with exactly-once handoff.
+
+Fast units (the preflight subset, ``-k "not ctx_"``): reshard planning
+(ring->ring, modulo bootstrap, shrink, the 128-op journal-namespace cap),
+the 0x80 handoff journal-id namespace, the sparsity-aware ShardPlanner
+(skew reduction, hot-sign-whole placement, hysteresis, degenerate inputs),
+the router's versioned topology (atomic ring swap preserving health state,
+``replace_replica`` resetting it — the stale-breaker regression), the
+journaled range export/import/delete dedupe discipline, and the in-proc
+engine crash/resume matrix over real jobstate manifests.
+
+The multi-process ServiceCtx runs (``test_ctx_*``) are the flagship
+proofs: grow 2->4 and shrink back with bit-identical PS entries, and
+seeded SIGKILLs during the handoff (armed through ``ChaosPlane``'s
+``kill_during_reshard`` op) resuming to a state bit-identical to an
+uninterrupted reshard.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from persia_tpu import elastic, jobstate
+from persia_tpu.elastic import Move, plan_reshard
+from persia_tpu.embedding.hashing import (
+    sign_to_range_shard,
+    sign_to_shard,
+    uniform_splits,
+)
+from persia_tpu.embedding.optim import Adagrad
+from persia_tpu.embedding.store import EmbeddingStore
+from persia_tpu.embedding.tiering.shard_planner import ShardPlanner
+from persia_tpu.embedding.worker import ShardedLookup
+from persia_tpu.service.resilience import ResiliencePolicy
+
+_RING = 1 << 64
+Q = _RING // 4  # one quarter arc
+DIM = 16
+SIGNS = np.arange(1, 201, dtype=np.uint64)
+OPT = Adagrad(lr=0.05).config
+
+
+# ------------------------------------------------------------------- planning
+
+
+def test_plan_reshard_ring_to_ring_grow():
+    old = [int(x) for x in uniform_splits(2)]
+    new = [int(x) for x in uniform_splits(4)]
+    plan = plan_reshard(2, 4, old, new, base_id=jobstate.make_journal_id(1, 0))
+    # only the arcs whose owner changed move; same-index overlap stays put
+    assert plan.moves == [
+        Move(0, 1, Q, 2 * Q),
+        Move(1, 2, 2 * Q, 3 * Q),
+        Move(1, 3, 3 * Q, 0),  # hi == 0 is the wire's 2^64
+    ]
+    assert plan.deletes == plan.moves  # every source survives a grow
+
+
+def test_plan_reshard_modulo_bootstrap():
+    # old_splits=None: the incumbent routes by modulo, so every source may
+    # hold signs anywhere — each moves the WHOLE of every other dest arc
+    new = [int(x) for x in uniform_splits(4)]
+    plan = plan_reshard(2, 4, None, new, base_id=1 << 40)
+    assert len(plan.moves) == 6
+    assert [(m.src, m.dst) for m in plan.moves] == [
+        (0, 1), (0, 2), (0, 3), (1, 0), (1, 2), (1, 3),
+    ]
+    for m in plan.moves:
+        lo, hi = m.dst * Q, ((m.dst + 1) * Q) % _RING
+        assert (m.lo, m.hi) == (lo, hi)
+    assert plan.deletes == plan.moves
+
+
+def test_plan_reshard_shrink():
+    old = [int(x) for x in uniform_splits(4)]
+    new = [int(x) for x in uniform_splits(2)]
+    plan = plan_reshard(4, 2, old, new, base_id=1 << 40)
+    assert plan.moves == [
+        Move(1, 0, Q, 2 * Q),
+        Move(2, 1, 2 * Q, 3 * Q),
+        Move(3, 1, 3 * Q, 0),
+    ]
+    # removed replicas (2, 3) shut down whole — only the surviving source
+    # with a moved-away arc needs a release op
+    assert plan.deletes == [Move(1, 0, Q, 2 * Q)]
+
+
+def test_plan_reshard_op_cap():
+    # 8 -> 9 modulo bootstrap needs 64 imports + 64 deletes = 128 ops,
+    # one past what the 7-bit op-index namespace holds
+    with pytest.raises(ValueError, match="journal-id namespace"):
+        plan_reshard(8, 9, None, [int(x) for x in uniform_splits(9)], 1 << 40)
+    # a ring->ring 8->9 moves far less and fits fine
+    plan_reshard(8, 9, [int(x) for x in uniform_splits(8)],
+                 [int(x) for x in uniform_splits(9)], 1 << 40)
+
+
+def test_plan_reshard_rejects_bad_splits():
+    with pytest.raises(ValueError):
+        plan_reshard(2, 3, None, [5, 5], 1)  # not strictly ascending
+    with pytest.raises(ValueError):
+        plan_reshard(2, 3, None, [7], 1)  # wrong count
+    with pytest.raises(ValueError):
+        plan_reshard(0, 2, None, [int(uniform_splits(2)[0])], 1)
+
+
+def test_plan_meta_roundtrip():
+    old = [int(x) for x in uniform_splits(2)]
+    new = [int(x) for x in uniform_splits(4)]
+    plan = plan_reshard(2, 4, old, new, base_id=jobstate.make_journal_id(3, 9))
+    again = elastic.ReshardPlan.from_meta({"reshard": plan.to_meta()})
+    # journal ids on resume come from base_id + deterministic move order —
+    # the recomputed plan must be IDENTICAL, not merely equivalent
+    assert again.moves == plan.moves
+    assert again.base_id == plan.base_id
+    assert (again.old_splits, again.new_splits) == (old, new)
+
+
+def test_handoff_journal_id_namespace():
+    base = jobstate.make_journal_id(7, 123)
+    handoff = {jobstate.handoff_journal_id(base, k) for k in range(128)}
+    assert len(handoff) == 128  # distinct per op
+    for jid in handoff:
+        assert jid & 0x80  # the handoff namespace bit
+    # gradient per-replica ids (replica < 0x80) can never collide with a
+    # handoff op at the same fence step
+    grads = {jobstate.journal_shard_id(base, r) for r in range(0x80)}
+    assert not (grads & handoff)
+
+
+# ------------------------------------------------------------- shard planner
+
+
+def test_shard_planner_beats_uniform_under_skew():
+    # three heavy hitters clustered on one quarter of the ring
+    pos = np.array([Q // 2, Q // 2 + 5, Q // 2 + 9], dtype=np.uint64)
+    w = np.array([4.0, 3.0, 3.0])
+    planner = ShardPlanner()
+    plan = planner.plan(4, pos=pos, w=w, residual=3.0)
+    uni_loads = ShardPlanner.shard_loads(uniform_splits(4), pos, w, 3.0)
+    assert plan.adopted
+    assert plan.skew < ShardPlanner.skew_of(uni_loads)
+    assert plan.skew < 1.5  # hash-uniform would sit near 4x here
+    s = plan.splits.astype(object).tolist()
+    assert all(0 < a < _RING for a in s) and s == sorted(s)
+
+
+def test_shard_planner_hot_sign_stays_whole():
+    # a point mass heavier than a whole equal-mass target: the boundary
+    # lands just past it, so the hot sign never straddles two shards
+    pos = np.array([3 * Q], dtype=np.uint64)
+    plan = ShardPlanner().plan(2, pos=pos, w=np.array([10.0]), residual=0.0)
+    assert int(plan.splits[0]) == 3 * Q + 1
+    routed = np.searchsorted(plan.splits, pos, side="right")
+    assert routed[0] == 0 and plan.loads[0] == pytest.approx(1.0)
+
+
+def test_shard_planner_hysteresis_dwell_then_adopt():
+    planner = ShardPlanner(hysteresis=0.1, min_dwell=2)
+    # round 1: residual-only mass -> hash-uniform incumbent
+    p1 = planner.plan(4)
+    assert p1.adopted
+    # rounds 2-3: skewed mass makes the candidate clearly better, but the
+    # incumbent has not dwelled long enough — the flap is suppressed
+    pos = np.array([Q // 3, Q // 3 + 7], dtype=np.uint64)
+    w = np.array([8.0, 6.0])
+    p2 = planner.plan(4, pos=pos, w=w, residual=0.2)
+    p3 = planner.plan(4, pos=pos, w=w, residual=0.2)
+    assert not p2.adopted and not p3.adopted
+    assert planner.suppressed == 2
+    # round 4: dwell satisfied -> adopt
+    p4 = planner.plan(4, pos=pos, w=w, residual=0.2)
+    assert p4.adopted and p4.skew < p2.skew
+
+
+def test_shard_planner_same_plan_not_churned():
+    planner = ShardPlanner()
+    pos = np.array([5 * Q // 2], dtype=np.uint64)
+    p1 = planner.plan(4, pos=pos, w=np.array([2.0]), residual=1.0)
+    p2 = planner.plan(4, pos=pos, w=np.array([2.0]), residual=1.0)
+    assert p1.adopted and not p2.adopted  # identical skew never re-adopts
+    assert (p2.splits == p1.splits).all()
+    # an explicitly requested different count always adopts
+    assert planner.plan(2, pos=pos, w=np.array([2.0]), residual=1.0).adopted
+
+
+def test_shard_planner_degenerate_inputs():
+    plan = ShardPlanner().plan(4, pos=np.empty(0, np.uint64),
+                               w=np.empty(0), residual=0.0)
+    assert (plan.splits == uniform_splits(4)).all()  # no mass -> uniform
+    assert ShardPlanner().plan(1).splits.size == 0
+    with pytest.raises(ValueError):
+        ShardPlanner().plan(0)
+
+
+# ------------------------------------------------------- router topology
+
+
+class _Rep:
+    def __init__(self, endpoint):
+        self.endpoint = endpoint
+
+
+def test_swap_topology_health_survives():
+    pol = ResiliencePolicy(degrade_after_s=0.01)
+    router = ShardedLookup([_Rep("ep0"), _Rep("ep1")], policy=pol)
+    assert router.topology_version == 0 and router.ring is None
+    pol.breaker("ep1").force_open()
+    deg = np.array([11, 12, 13], dtype=np.uint64)
+    router._record_degraded(deg)
+
+    ring = uniform_splits(4)
+    v = router.swap_topology([_Rep(f"ep{i}") for i in range(4)], ring=ring)
+    assert v == 1 and router.topology_version == 1
+    assert len(router.replicas) == 4 and (router.ring == ring).all()
+    # breakers key by endpoint and degraded records by sign: both SURVIVE
+    # the swap (a surviving replica keeps its health history)
+    assert pol.breaker("ep1").state == "open"
+    assert router.degraded_intersection(deg).all()
+
+
+def test_swap_topology_validates_ring():
+    router = ShardedLookup([_Rep("a"), _Rep("b")])
+    with pytest.raises(ValueError):
+        router.swap_topology([_Rep("a")], ring=uniform_splits(4))  # wrong len
+    with pytest.raises(ValueError):
+        router.swap_topology([_Rep("a"), _Rep("b"), _Rep("c")],
+                             ring=np.array([9, 9], dtype=np.uint64))
+    with pytest.raises(ValueError):
+        router.swap_topology([])
+
+
+def test_replace_replica_resets_breaker_and_purges_degraded():
+    """Satellite regression: a standby promoted onto a reused endpoint must
+    not inherit its dead predecessor's OPEN breaker — the stale breaker
+    would quarantine the healthy fresh replica for a full reset window —
+    and degraded-sign records routed to the slot must be purged so the new
+    replica's real rows don't have their gradients dropped."""
+    pol = ResiliencePolicy(degrade_after_s=0.01, breaker_reset_s=60.0)
+    router = ShardedLookup([_Rep("ep0"), _Rep("ep1")], policy=pol)
+    br = pol.breaker("ep0")
+    br.force_open()
+    assert br.state == "open"
+
+    routed = sign_to_shard(SIGNS, 2)
+    deg0, deg1 = SIGNS[routed == 0][:5], SIGNS[routed == 1][:5]
+    router._record_degraded(np.concatenate([deg0, deg1]))
+
+    router.replace_replica(0, _Rep("ep0"))
+    assert router.topology_version == 1
+    # reset happens IN PLACE: callers holding the breaker keep the object
+    assert pol.breaker("ep0") is br and br.state == "closed"
+    # slot-0 records purged (real rows now live there); slot-1 untouched
+    assert not router.degraded_intersection(deg0).any()
+    assert router.degraded_intersection(deg1).all()
+
+    with pytest.raises(IndexError):
+        router.replace_replica(7, _Rep("ep7"))
+
+
+# ------------------------------------------- journaled range handoff (store)
+
+
+def _mk_store():
+    return EmbeddingStore(capacity=1 << 14, num_internal_shards=2,
+                          optimizer=OPT, seed=11)
+
+
+def _parse(blob):
+    out = {}
+    (n,) = struct.unpack_from("<I", blob, 0)
+    off = 4
+    for _ in range(n):
+        sign, _dim, ln = struct.unpack_from("<QII", blob, off)
+        off += 16
+        out[sign] = blob[off:off + ln * 4]
+        off += ln * 4
+    return out
+
+
+def _full_state(stores):
+    out = {}
+    for s in stores:
+        d = _parse(s.export_range(0, 0))
+        assert not (set(d) & set(out)), "duplicate signs across replicas"
+        out.update(d)
+    return out
+
+
+def test_range_handoff_journal_dedupe():
+    src, dst = _mk_store(), _mk_store()
+    src.lookup(SIGNS, DIM, True)
+    lo, hi = Q, 2 * Q
+    blob = src.export_range(lo, hi)
+    assert blob == src.export_range(lo, hi)  # sign-sorted => deterministic
+    import zlib
+
+    base = jobstate.make_journal_id(2, 5)
+    jid = jobstate.handoff_journal_id(base, 0)
+    crc = zlib.crc32(blob) & 0xFFFFFFFF
+    n_moved = len(_parse(blob))
+    assert n_moved > 0
+    assert dst.import_range_journaled(jid, crc, blob) is True
+    assert dst.size() == n_moved
+    # exact replay dedupes; a re-export that DIFFERS (source already
+    # released the range: probe -1) also skips — the original import stands
+    assert dst.import_range_journaled(jid, crc, blob) is False
+    assert dst.import_range_journaled(jid, crc ^ 0xDEAD, b"\x00\x00\x00\x00") is False
+    assert dst.size() == n_moved
+
+    del_jid = jobstate.handoff_journal_id(base, 1)
+    del_crc = jobstate.payload_crc(np.array([lo, hi], dtype=np.uint64))
+    applied, removed = src.delete_range_journaled(del_jid, del_crc, lo, hi)
+    assert applied and removed == n_moved
+    assert src.delete_range_journaled(del_jid, del_crc, lo, hi) == (False, 0)
+    # nothing lost, nothing duplicated
+    assert len(_full_state([src, dst])) == len(SIGNS)
+
+
+# ------------------------------------------------- in-proc engine crash matrix
+
+
+def _setup(populate=True):
+    """2 populated sources + 2 fresh joiners and the modulo-bootstrap 2->4
+    plan. Seeded per-sign init makes every rebuild bit-identical, so each
+    crash scenario rebuilds fresh and compares against one reference."""
+    srcs = [_mk_store(), _mk_store()]
+    if populate:
+        for r, st in enumerate(srcs):
+            st.lookup(SIGNS[SIGNS % 2 == r], DIM, True)
+    dests = list(srcs) + [_mk_store(), _mk_store()]
+    plan = plan_reshard(2, 4, None, [int(x) for x in uniform_splits(4)],
+                        jobstate.make_journal_id(1, 0))
+    return srcs, dests, plan
+
+
+def _reference(tmp_path):
+    srcs, dests, plan = _setup()
+    stats = elastic.execute_reshard(plan, srcs, dests, str(tmp_path / "ref_js"))
+    assert stats["imports_applied"] == 6 and stats["deletes_applied"] == 6
+    assert stats["moved_bytes"] > 0 and stats["entries_removed"] > 0
+    ref = _full_state(dests)
+    assert len(ref) == len(SIGNS)
+    # post-reshard ownership: every resident sign is in its replica's arc
+    ring = np.asarray(plan.new_splits, dtype=np.uint64)
+    for i, d in enumerate(dests):
+        mine = np.array(sorted(_parse(d.export_range(0, 0))), dtype=np.uint64)
+        assert (sign_to_range_shard(mine, ring) == i).all()
+    return ref
+
+
+class _Boom(RuntimeError):
+    pass
+
+
+def _crash_once_at(kind, op_index):
+    state = {"armed": True}
+
+    def hook(k, i, mv):
+        if state["armed"] and k == kind and i == op_index:
+            state["armed"] = False
+            raise _Boom(f"chaos at {kind}[{op_index}]")
+
+    return hook
+
+
+def test_engine_resume_after_import_crash(tmp_path):
+    ref = _reference(tmp_path)
+    srcs, dests, plan = _setup()
+    js = str(tmp_path / "js")
+    with pytest.raises(_Boom):
+        elastic.execute_reshard(plan, srcs, dests, js,
+                                fault_hook=_crash_once_at("import", 2))
+    stats = elastic.resume_reshard(js, srcs, dests)
+    assert stats["resumed"] and stats["start_phase"] == "handoff"
+    # ops 0-1 landed before the crash: the journal turns them into dedupes
+    assert stats["imports_deduped"] == 2 and stats["imports_applied"] == 4
+    assert stats["deletes_applied"] == 6
+    assert _full_state(dests) == ref
+    # a second resume finds the done phase and is a no-op
+    assert elastic.resume_reshard(js, srcs, dests) is None
+
+
+def test_engine_resume_with_source_restore(tmp_path):
+    """Source SIGKILLed mid-handoff: restore it from the fence snapshot in
+    the handoff manifest; its re-exports are bit-identical, so replayed
+    imports dedupe instead of double-applying."""
+    ref = _reference(tmp_path)
+    srcs, dests, plan = _setup()
+    js = str(tmp_path / "js")
+    with pytest.raises(_Boom):
+        elastic.execute_reshard(plan, srcs, dests, js,
+                                fault_hook=_crash_once_at("import", 2))
+    man = elastic.find_reshard_manifest(jobstate.coerce_manager(js))
+    assert man is not None and man.meta["phase"] == "handoff"
+    restored = _mk_store()  # the dead source comes back EMPTY...
+    for blob in elastic.source_snapshot(man, 0):
+        restored.load_shard_bytes(blob)  # ...then rewinds to the fence
+    srcs[0] = dests[0] = restored
+    stats = elastic.resume_reshard(js, srcs, dests)
+    assert stats["resumed"] and stats["imports_applied"] == 4
+    assert _full_state(dests) == ref
+
+
+def test_engine_resume_after_delete_crash_with_dest_restore(tmp_path):
+    """Crash in the delete phase: resume starts from the ``imported``
+    manifest (imports never re-run), and a dest lost mid-delete restores
+    from the post-import snapshot."""
+    ref = _reference(tmp_path)
+    srcs, dests, plan = _setup()
+    js = str(tmp_path / "js")
+    with pytest.raises(_Boom):
+        elastic.execute_reshard(plan, srcs, dests, js,
+                                fault_hook=_crash_once_at("delete", 1))
+    man = elastic.find_reshard_manifest(jobstate.coerce_manager(js))
+    assert man is not None and man.meta["phase"] == "imported"
+    restored = _mk_store()
+    for blob in elastic.dest_snapshot(man, 1):
+        restored.load_shard_bytes(blob)
+    srcs[1] = dests[1] = restored
+    stats = elastic.resume_reshard(js, srcs, dests)
+    assert stats["start_phase"] == "imported"
+    assert stats["imports_applied"] == 0 and stats["imports_deduped"] == 0
+    # delete op 0 hit the surviving source whose journal remembers it; the
+    # restored replica's ops re-apply idempotently
+    assert stats["deletes_deduped"] == 1
+    assert stats["deletes_applied"] == 5
+    assert _full_state(dests) == ref
+
+
+def test_engine_resume_nothing_to_do(tmp_path):
+    srcs, dests, _ = _setup(populate=False)
+    assert elastic.resume_reshard(str(tmp_path / "empty"), srcs, dests) is None
+
+
+def test_engine_rejects_mismatched_handles(tmp_path):
+    srcs, dests, plan = _setup(populate=False)
+    with pytest.raises(ValueError, match="sources"):
+        elastic.execute_reshard(plan, srcs[:1], dests, str(tmp_path / "js"))
+    with pytest.raises(ValueError, match="dests"):
+        elastic.execute_reshard(plan, srcs, dests[:3], str(tmp_path / "js"))
+
+
+# --------------------------------------------- multi-process ServiceCtx runs
+
+
+def _ctx_full_state(clients):
+    out = {}
+    for c in clients:
+        d = _parse(c.export_range(0, 0))
+        assert not (set(d) & set(out)), "duplicate signs across replicas"
+        out.update(d)
+    return out
+
+
+def _ctx_populate(ctx, signs):
+    cs = ctx.ps_clients()
+    for c in cs:
+        c.register_optimizer(OPT)
+    for r, c in enumerate(cs):
+        c.lookup(signs[signs % len(cs) == r], DIM, True)
+    return _ctx_full_state(cs)
+
+
+def test_ctx_elastic_grow_shrink_bit_parity(tmp_path):
+    """Flagship: grow 2->4 then shrink back over a REAL multi-process PS
+    tier; every entry lands bit-identical and in its ring arc."""
+    from persia_tpu.helper import ServiceCtx
+
+    signs = np.arange(1, 401, dtype=np.uint64)
+    with ServiceCtx(num_parameter_servers=2, num_embedding_workers=0,
+                    capacity=1 << 14, num_internal_shards=2) as ctx:
+        before = _ctx_populate(ctx, signs)
+        assert len(before) == len(signs)
+
+        js = str(tmp_path / "js")
+        grow = ctx.reshard_ps(4, js)
+        assert ctx.n_ps == 4 and grow["imports_applied"] == 6
+        cs4 = ctx.ps_clients()
+        assert _ctx_full_state(cs4) == before
+        for i, c in enumerate(cs4):
+            mine = np.array(sorted(_parse(c.export_range(0, 0))),
+                            dtype=np.uint64)
+            assert (sign_to_range_shard(mine, ctx.ps_ring) == i).all()
+
+        shrink = ctx.reshard_ps(2, js)
+        assert ctx.n_ps == 2 and not shrink["resumed"]
+        assert _ctx_full_state(ctx.ps_clients()) == before
+
+
+def test_ctx_reshard_kill_resume_bit_parity(tmp_path):
+    """Seeded SIGKILLs during the 2->4 handoff — a source mid-import, a
+    joiner mid-import, a survivor mid-delete, each armed through
+    ``ChaosPlane``'s ``kill_during_reshard`` op — every resume lands
+    bit-identical to an uninterrupted reshard."""
+    from persia_tpu.chaos import ChaosAction, ChaosPlane
+    from persia_tpu.helper import ServiceCtx
+
+    signs = np.arange(1, 401, dtype=np.uint64)
+
+    def spawn():
+        return ServiceCtx(num_parameter_servers=2, num_embedding_workers=0,
+                          capacity=1 << 14, num_internal_shards=2)
+
+    with spawn() as ctx:
+        _ctx_populate(ctx, signs)
+        ctx.reshard_ps(4, str(tmp_path / "ref_js"))
+        ref = _ctx_full_state(ctx.ps_clients())
+    assert len(ref) == len(signs)
+
+    for n, (handoff_op, op_index, victim) in enumerate(
+            [("import", 1, 1), ("import", 2, 2), ("delete", 0, 0)]):
+        with spawn() as ctx:
+            _ctx_populate(ctx, signs)
+            plane = ChaosPlane(ctx, schedule=[ChaosAction(
+                step=0, op="kill_during_reshard", idx=victim,
+                handoff_op=handoff_op, op_index=op_index,
+            )])
+            try:
+                plane.on_step(0)  # arm
+                hook = plane.reshard_fault_hook()
+                js = str(tmp_path / f"js_{n}")
+                with pytest.raises(Exception):
+                    ctx.reshard_ps(4, js, fault_hook=hook)
+                assert plane.fault_counts()["reshard_kills"] == 1
+                stats = ctx.resume_reshard(js)
+                assert stats is not None and stats["resumed"]
+                assert ctx.n_ps == 4
+                assert _ctx_full_state(ctx.ps_clients()) == ref
+            finally:
+                plane.stop()
