@@ -1085,13 +1085,25 @@ def bench_chaos():
                     ctx, SentinelConfig(z_threshold=1e9, warmup_steps=1 << 30)
                 )
             rng = np.random.default_rng(3)
+            # BENCH_CHAOS_LOAD (chaos.parse_load_spec) swaps the uniform
+            # draw for a seeded load SHAPE — zipf ramp / spike / hot-set
+            # rotation — the same schedule autopilot_bench.py soaks under
+            load_sched = None
+            load_spec = os.environ.get("BENCH_CHAOS_LOAD", "")
+            if load_spec:
+                from persia_tpu.chaos import LoadSchedule, parse_load_spec
+
+                load_sched = LoadSchedule(parse_load_spec(load_spec))
 
             def batches():
-                for _ in range(steps):
+                for step in range(steps):
                     ids = [
                         IDTypeFeatureWithSingleID(
                             f"cat_{j}",
-                            rng.integers(0, 200_000, batch, dtype=np.uint64),
+                            load_sched.signs(step, batch, slot=j)
+                            if load_sched is not None
+                            else rng.integers(0, 200_000, batch,
+                                              dtype=np.uint64),
                         )
                         for j in range(n_slots)
                     ]
@@ -1148,6 +1160,8 @@ def bench_chaos():
                 "samples_per_sec": round(steps * batch / elapsed, 1),
                 "steps": steps,
                 "chaos": cfg_chaos.to_dict(),
+                "load": (load_sched.cfg.to_dict()
+                         if load_sched is not None else None),
                 # trainer kill-resume recovery metrics (jobstate.py):
                 # time-to-resume, steps replayed, journal hits per mode
                 "kill_resume": _bench_kill_resume(),

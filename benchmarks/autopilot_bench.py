@@ -1,0 +1,351 @@
+"""Autopilot chaos soak: the closed-loop controller vs a drifting workload.
+
+Emits ONE JSON record (committed as BENCH_AUTOPILOT.json) answering the
+question the autopilot exists for: when the traffic shape drifts out from
+under a fixed fleet — zipf skew ramping up, a QPS spike, the hot set
+rotating — does the closed loop notice and reshape the fleet, without
+dropping a request, and does a SIGKILL mid-decision resume to the exact
+bytes an uninterrupted run produces?
+
+Four legs over the SAME seeded :class:`~persia_tpu.chaos.LoadSchedule`
+(zipf ramp + traffic spike + hot-set rotation):
+
+1. **soak** — a 4-shard in-process PS tier behind a ``ShardedLookup``
+   ring, a real ``AccessProfiler`` sketch, and an :class:`Autopilot`
+   driving all three actuators: the skew ramp breaches the target and the
+   ring re-splits through the REAL elastic handoff engine (journaled
+   range moves over the live stores), the rotating hot set refreshes the
+   journaled read-replica map, and the QPS spike scales a serving fleet
+   up then back down. Every step serves a read batch through the router;
+   a single failed request fails the bench.
+2. **tail skew** — the final rotation window's reads routed by the
+   soak's final topology (ring + hot fan-out): empirical per-replica
+   read skew must be <= the policy's 1.10 target. The control leg's
+   number shows what the same drift costs a fleet nobody reshapes.
+3. **SIGKILL resume** — two identical fleets plan the same replication
+   round; one is killed mid-actuation (planned manifest committed, a
+   PREFIX of the journaled copies applied), rebuilt, and resumed. The
+   resumed fleet's full store bytes must equal the uninterrupted one's,
+   with the prefix ops visibly deduped and a second resume a no-op.
+4. **control** — the soak traffic with no controller: uniform ring, no
+   replication, fleet pinned at its initial size. Reports the read skew
+   and overloaded-step count the autopilot avoided.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_SHARDS = int(os.environ.get("AUTOPILOT_SHARDS", "4"))
+N_SLOTS = 4
+STEPS = int(os.environ.get("AUTOPILOT_STEPS", "72"))
+BATCH = int(os.environ.get("AUTOPILOT_BATCH", "2048"))
+READ_BATCH = 512
+FENCE_EVERY = 8
+# the tail probes the FINAL workload shape: steps 64..71 sit inside the
+# last rotation window (rotate=24 → steps 48-71 are one hot set), after
+# the controller has had three fences (48, 56, 64) to settle on it
+TAIL_STEPS = 8
+DIM = 16
+SEED = 7
+SKETCH_TOPK = 64  # per-slot tracked heavy hitters (model fidelity)
+LOAD_SPEC = os.environ.get(
+    "AUTOPILOT_LOAD",
+    "seed=7,vocab=131072,a0=1.05,a1=1.45,ramp=8:32,"
+    "qps=120,spike=5x36:52,rotate=24,stride=7919",
+)
+SKEW_TARGET = 1.10
+
+
+def build_fleet(tmp, opt):
+    from persia_tpu.embedding.hashing import uniform_splits
+    from persia_tpu.embedding.store import EmbeddingStore
+    from persia_tpu.embedding.worker import ShardedLookup
+
+    stores = [
+        EmbeddingStore(capacity=1 << 20, num_internal_shards=4,
+                       optimizer=opt, seed=SEED)
+        for _ in range(N_SHARDS)
+    ]
+    router = ShardedLookup(stores, ring=uniform_splits(N_SHARDS))
+    return stores, router
+
+
+def read_counts(router, batches):
+    """Empirical per-replica READ routing counts (hot fan-out applied)."""
+    counts = np.zeros(len(router.replicas), dtype=np.int64)
+    for signs in batches:
+        for r, idx in router._partition_positions(signs, read=True):
+            counts[r] += len(idx)
+    return counts
+
+
+def skew_of(counts) -> float:
+    return float(counts.max() / counts.mean())
+
+
+def drive_soak(sched, stores, router, tmp):
+    from persia_tpu.autopilot import Autopilot, PolicyConfig, PolicyEngine
+    from persia_tpu import elastic, jobstate
+
+    reshard_js = os.path.join(tmp, "reshard")
+    events = {"reshard": [], "replicate": [], "scale": []}
+    fleet = {"replicas": 1}
+    cur = {"step": 0}
+
+    def do_reshard(n, splits, step):
+        old = router.ring
+        plan = elastic.plan_reshard(
+            n, n, None if old is None else [int(x) for x in old],
+            [int(x) for x in splits], jobstate.make_journal_id(1, step),
+        )
+        stats = elastic.execute_reshard(plan, stores, stores, reshard_js)
+        router.swap_topology(stores, ring=splits)
+        events["reshard"].append({
+            "step": int(step), "moves": len(plan.moves),
+            "moved_bytes": stats["moved_bytes"],
+            "imports_applied": stats["imports_applied"],
+        })
+        return stats
+
+    def scale_to(target):
+        events["scale"].append(
+            {"step": cur["step"], "from": fleet["replicas"],
+             "to": int(target)}
+        )
+        fleet["replicas"] = int(target)
+        return fleet["replicas"]
+
+    def sensors():
+        return {"qps": sched.qps(cur["step"]),
+                "replicas": fleet["replicas"], "quarantined": 0}
+
+    pilot = Autopilot(
+        os.path.join(tmp, "decisions"),
+        policy=PolicyEngine(PolicyConfig(
+            skew_target=SKEW_TARGET, reshard_hysteresis=0.05,
+            reshard_min_dwell=1, hot_fanout=N_SHARDS, hot_max_signs=32,
+            hot_mass_frac=0.005, hot_min_dwell=0, qps_per_replica=200.0,
+            scale_hysteresis=0.2, scale_min_dwell=1, scale_max_replicas=8,
+        )),
+        profiler=None,  # installed below (import cycle keeps this lazy)
+        router=router,
+        reshard=do_reshard,
+        resume_reshard=lambda: None,
+        scale_to=scale_to,
+        serving_sensors=sensors,
+    )
+    from persia_tpu.embedding.tiering import AccessProfiler
+
+    prof = AccessProfiler([f"cat_{i}" for i in range(N_SLOTS)],
+                          topk=SKETCH_TOPK)
+    pilot.profiler = prof
+
+    requests = {"ok": 0, "failed": 0}
+    t0 = time.time()
+    for step in range(STEPS):
+        cur["step"] = step
+        for s in range(N_SLOTS):
+            signs = sched.signs(step, BATCH, slot=s)
+            router.lookup(signs, DIM, train=True)
+            prof.observe_slot(f"cat_{s}", signs)
+        # the serving plane: one read batch per step MUST come back whole
+        reads = sched.signs(step, READ_BATCH, slot=step % N_SLOTS)
+        try:
+            vals = router.lookup(reads, DIM, train=False)
+            assert vals.shape == (len(reads), DIM)
+            requests["ok"] += 1
+        except Exception:  # noqa: BLE001 — any failure is the metric
+            requests["failed"] += 1
+        pilot.on_tick(step)  # serving plane ticks every step
+        if step > 0 and step % FENCE_EVERY == 0:
+            # decay the sketch so it tracks the CURRENT shape (the same
+            # half-life discipline the tiering loop runs the sketch under)
+            prof.decay(0.5)
+            pilot.on_fence(step)  # the drained-fence window
+    soak_s = time.time() - t0
+    hot = router.hot_read_state()
+    return {
+        "pilot": pilot,
+        "events": events,
+        "requests": requests,
+        "soak_s": round(soak_s, 3),
+        "suppressed_flaps": int(pilot.policy.suppressed),
+        "rounds": int(pilot.rounds),
+        "hot_signs_installed": 0 if hot is None else int(len(hot[0])),
+        "final_serving_replicas": fleet["replicas"],
+    }
+
+
+def sigkill_resume_leg(sched, opt, tmp):
+    """Two identical fleets, same replication round; one dies mid-copy
+    (prefix of the journaled ops applied) and resumes. Bytes must match."""
+    from persia_tpu.autopilot import (
+        Autopilot, PolicyConfig, PolicyEngine, replicate_hot_signs,
+    )
+    from persia_tpu.autopilot.policy import Decision, KIND_REPLICATE
+    from persia_tpu.embedding.tiering import AccessProfiler
+
+    def materialize():
+        stores, router = build_fleet(tmp, opt)
+        prof = AccessProfiler([f"cat_{i}" for i in range(N_SLOTS)], topk=16)
+        for step in range(8):
+            for s in range(N_SLOTS):
+                signs = sched.signs(step, BATCH, slot=s)
+                router.lookup(signs, DIM, train=True)
+                prof.observe_slot(f"cat_{s}", signs)
+        return stores, router, prof
+
+    pol = PolicyConfig(hot_fanout=3, hot_max_signs=16, hot_mass_frac=0.005,
+                       hot_min_dwell=0)
+
+    # leg A: uninterrupted drive
+    stores_a, router_a, prof_a = materialize()
+    pilot_a = Autopilot(os.path.join(tmp, "ap_a"),
+                        policy=PolicyEngine(pol), profiler=prof_a,
+                        router=router_a)
+    applied_a = pilot_a.on_fence(8)
+    decision_a = applied_a.get(KIND_REPLICATE)
+    assert decision_a is not None, "replication round never fired"
+
+    # leg B: same decision planned, killed after a PREFIX of the copies
+    stores_b, router_b, prof_b = materialize()
+    pilot_b = Autopilot(os.path.join(tmp, "ap_b"),
+                        policy=PolicyEngine(pol), profiler=prof_b,
+                        router=router_b)
+    d = pilot_b.policy.decide_replicate(prof_b)
+    assert d is not None
+    pilot_b._commit("planned", d, step=8)
+    epoch = pilot_b.mgr.latest().meta["job_epoch"]
+    prefix = len(d.params["signs"]) // 2
+    partial = replicate_hot_signs(
+        router_b, d.params["signs"][:prefix], job_epoch=epoch, step=8,
+        fanout=d.params["fanout"], salt=d.params["salt"],
+    )
+    # ...SIGKILL here: pilot_b is gone; a fresh controller takes the root
+    pilot_b2 = Autopilot(os.path.join(tmp, "ap_b"),
+                         policy=PolicyEngine(pol), profiler=prof_b,
+                         router=router_b)
+    resumed = pilot_b2.resume()
+    assert resumed is not None
+    again = pilot_b2.resume()  # exactly-once: nothing left pending
+
+    bit_identical = all(
+        stores_a[i].export_range(0, 0) == stores_b[i].export_range(0, 0)
+        for i in range(N_SHARDS)
+    )
+    hot_a, hot_b = router_a.hot_read_state(), router_b.hot_read_state()
+    maps_match = (
+        hot_a is not None and hot_b is not None
+        and np.array_equal(hot_a[0], hot_b[0])
+        and hot_a[1:] == hot_b[1:]
+    )
+    return {
+        "signs": len(d.params["signs"]),
+        "killed_after_ops": int(partial["applied"]),
+        "resume_deduped": int(resumed.get("deduped", 0)),
+        "resume_applied": int(resumed.get("applied", 0)),
+        "second_resume_noop": again is None,
+        "bit_identical": bool(bit_identical and maps_match),
+    }
+
+
+def control_leg(sched, opt, tmp):
+    """No controller: the same drift over a fleet nobody reshapes."""
+    stores, router = build_fleet(tmp, opt)
+    qps_capacity = 200.0  # matches the soak policy's qps_per_replica
+    overloaded = 0
+    for step in range(STEPS):
+        for s in range(N_SLOTS):
+            router.lookup(sched.signs(step, BATCH, slot=s), DIM, train=True)
+        if sched.qps(step) > qps_capacity:  # pinned single replica
+            overloaded += 1
+    tail = [
+        sched.signs(STEPS - TAIL_STEPS + t, READ_BATCH, slot=s)
+        for t in range(TAIL_STEPS) for s in range(N_SLOTS)
+    ]
+    counts = read_counts(router, tail)
+    return {
+        "tail_read_skew": round(skew_of(counts), 4),
+        "tail_read_counts": counts.tolist(),
+        "overloaded_steps": int(overloaded),
+        "serving_replicas": 1,
+    }
+
+
+def main() -> int:
+    from persia_tpu.chaos import LoadSchedule, parse_load_spec
+    from persia_tpu.embedding.optim import Adagrad
+
+    sched = LoadSchedule(parse_load_spec(LOAD_SPEC))
+    opt = Adagrad(lr=0.05).config
+    tmp = tempfile.mkdtemp(prefix="autopilot_bench_")
+
+    stores, router = build_fleet(tmp, opt)
+    soak = drive_soak(sched, stores, router, tmp)
+    pilot = soak.pop("pilot")
+    events = soak.pop("events")
+
+    # tail: the final rotation window's reads, routed by the final
+    # topology (ring + hot fan-out) — the load the soak's last decisions
+    # actually balanced
+    tail = [
+        sched.signs(STEPS - TAIL_STEPS + t, READ_BATCH, slot=s)
+        for t in range(TAIL_STEPS) for s in range(N_SLOTS)
+    ]
+    counts = read_counts(router, tail)
+    tail_skew = skew_of(counts)
+
+    resume = sigkill_resume_leg(sched, opt, tmp)
+    control = control_leg(sched, opt, tmp)
+
+    rec = {
+        "bench": "autopilot",
+        "workload": {"spec": LOAD_SPEC, "slots": N_SLOTS, "steps": STEPS,
+                     "batch": BATCH, "read_batch": READ_BATCH,
+                     "fence_every": FENCE_EVERY, "n_shards": N_SHARDS},
+        "soak": {
+            **soak,
+            "reshard_events": events["reshard"],
+            "scale_events": events["scale"],
+            "tail_read_skew": round(tail_skew, 4),
+            "tail_read_counts": counts.tolist(),
+        },
+        "resume": resume,
+        "control": control,
+    }
+    ok = True
+
+    def check(cond, msg):
+        nonlocal ok
+        if not cond:
+            print(f"FAIL: {msg}", file=sys.stderr)
+            ok = False
+
+    check(len(events["reshard"]) >= 1, "no autonomous reshard fired")
+    check(len(events["scale"]) >= 1, "no serving scale event fired")
+    check(soak["requests"]["failed"] == 0,
+          f"{soak['requests']['failed']} serving requests failed")
+    check(tail_skew <= SKEW_TARGET,
+          f"post-reshard read skew {tail_skew:.4f} > {SKEW_TARGET}")
+    check(resume["bit_identical"] and resume["second_resume_noop"],
+          "SIGKILL resume was not bit-identical exactly-once")
+    rec["pass"] = ok
+
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_AUTOPILOT.json")
+    with open(out, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(json.dumps(rec))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

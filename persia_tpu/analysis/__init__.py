@@ -39,6 +39,10 @@ any finding:
   scalars on the host (``.item()``, ``float(...)``, ``np.asarray``)
   with no finite guard in the function — a blind spot in the health
   escalation ladder (:mod:`persia_tpu.analysis.numeric_lint`).
+- **Control loops** (CTRL001): a loop mutating fleet topology
+  (``reshard_ps`` / ``swap_topology`` / replica add-remove) with no
+  hysteresis/dwell guard on the decision path — an unguarded control
+  loop is a flap machine (:mod:`persia_tpu.analysis.control_lint`).
 
 Suppress a finding inline with ``# persia-lint: disable=RULE`` (or
 ``disable=all``) on the offending line; C sources use the same token in a
@@ -69,7 +73,7 @@ __all__ = [
     "NATIVE_LIBS",
 ]
 
-_PASS_PREFIXES = ("ABI", "CONC", "RES", "DUR", "OBS", "NUM", "JAX")
+_PASS_PREFIXES = ("ABI", "CONC", "RES", "DUR", "OBS", "NUM", "JAX", "CTRL")
 
 
 def run_all(
@@ -80,6 +84,7 @@ def run_all(
     from persia_tpu.analysis import (
         abi,
         concurrency,
+        control_lint,
         durability,
         interproc,
         jax_lint,
@@ -112,6 +117,8 @@ def run_all(
         findings.extend(observability_lint.check(root, py_files))
     if any(w.startswith("NUM") for w in wanted):
         findings.extend(numeric_lint.check(root, py_files))
+    if any(w.startswith("CTRL") for w in wanted):
+        findings.extend(control_lint.check(root, py_files))
     coverage["python_files_scanned"] = len(py_files)
     coverage["ctypes_files"] = [p for p in CTYPES_FILES
                                 if any(rel(f) == p for f in py_files)]

@@ -1,0 +1,130 @@
+"""Control-loop lint: topology mutation must sit behind a flap guard.
+
+- CTRL001 a ``while`` loop whose body calls a topology-mutating
+         actuator — ``reshard_ps`` / ``swap_topology`` / ``add_replica``
+         / ``remove_replica`` / ``restart_replica`` / ``kill_replica`` /
+         ``scale_serving`` — from a function (or module scope) whose
+         source shows no hysteresis/dwell/cooldown guard token anywhere
+         on the decision path. An unguarded control loop is a flap
+         machine: two states trading places every round thrash the
+         exactly-once handoff journal, churn the gateway's breaker
+         history, and turn every sensor blip into a fleet mutation. Route
+         the decision through a guarded policy
+         (:class:`persia_tpu.autopilot.PolicyEngine`,
+         :class:`~persia_tpu.embedding.tiering.shard_planner.ShardPlanner`)
+         or put the margin + dwell check next to the loop.
+
+Scope notes: only ``while`` loops are control loops here — a bounded
+``for`` over a static membership list (gateway bootstrap, a probe sweep)
+applies a decision, it doesn't make one. A mutator call outside any loop
+is fine too (a one-shot reshard is an operator action). The guard search
+covers the whole enclosing function's source — comments and docstrings
+count, so an actuator whose guard genuinely lives one call up can say so
+(``# dwell/hysteresis guard in PolicyEngine.decide_*``) and the reader
+gets the pointer the lint wanted. Test files exercise flap paths on
+purpose and are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Sequence
+
+from persia_tpu.analysis.common import Finding, REPO_ROOT, read_text, rel
+
+# actuators that change fleet topology when called
+_MUTATORS = (
+    "reshard_ps",
+    "swap_topology",
+    "add_replica",
+    "remove_replica",
+    "restart_replica",
+    "kill_replica",
+    "scale_serving",
+)
+
+# evidence of a flap guard on the decision path
+_GUARD_TOKENS = ("hysteresis", "dwell", "cooldown")
+
+
+def _called_mutators(loop: ast.AST) -> List[ast.Call]:
+    """Mutator calls anywhere inside the loop body (method or bare name)."""
+    out: List[ast.Call] = []
+    for node in ast.walk(loop):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = (f.attr if isinstance(f, ast.Attribute)
+                else f.id if isinstance(f, ast.Name) else None)
+        if name in _MUTATORS:
+            out.append(node)
+    return out
+
+
+def _guarded(scope_src: str) -> bool:
+    low = scope_src.lower()
+    return any(tok in low for tok in _GUARD_TOKENS)
+
+
+def _scope_source(text: str, scope: Optional[ast.AST]) -> str:
+    if scope is None:
+        return text  # module-level loop: the whole file is the scope
+    seg = ast.get_source_segment(text, scope)
+    return seg if seg is not None else text
+
+
+def check_source(text: str, path: str) -> List[Finding]:
+    """Lint one file for CTRL001."""
+    tree = ast.parse(text, filename=path)
+    findings: List[Finding] = []
+    # map every loop to its innermost enclosing function scope
+    scopes: List[tuple] = []  # (loop, enclosing function or None)
+
+    def walk(node: ast.AST, func: Optional[ast.AST]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk(child, child)
+            else:
+                if isinstance(child, ast.While):
+                    scopes.append((child, func))
+                walk(child, func)
+
+    walk(tree, None)
+    for loop, func in scopes:
+        calls = _called_mutators(loop)
+        if not calls:
+            continue
+        if _guarded(_scope_source(text, func)):
+            continue
+        for call in calls:
+            f = call.func
+            name = (f.attr if isinstance(f, ast.Attribute)
+                    else getattr(f, "id", "?"))
+            where = (f"function {func.name!r}" if func is not None
+                     else "module scope")
+            findings.append(Finding(
+                "CTRL001", path, call.lineno,
+                f"control loop in {where} mutates topology ({name}) with "
+                f"no hysteresis/dwell guard on the decision path — flap "
+                f"risk; gate it through a guarded policy "
+                f"(autopilot.PolicyEngine / tiering.ShardPlanner)",
+            ))
+    return findings
+
+
+def check(root: str = REPO_ROOT,
+          files: Optional[Sequence[str]] = None) -> List[Finding]:
+    from persia_tpu.analysis.common import python_files
+
+    paths = list(files) if files is not None else python_files(root)
+    findings: List[Finding] = []
+    for p in paths:
+        abspath = p if os.path.isabs(p) else os.path.join(root, p)
+        rp = rel(abspath)
+        base = os.path.basename(rp)
+        # tests exercise flap paths on purpose
+        if base.startswith("test_") or rp.startswith("tests" + os.sep):
+            continue
+        findings.extend(check_source(read_text(abspath), rp))
+    return findings

@@ -1,0 +1,373 @@
+"""The Autopilot: sense → decide → two-phase actuate, at stream fences.
+
+One control loop, three actuators behind one :class:`PolicyEngine`:
+
+1. **PS reshard** — the access sketch's per-shard load model breaches the
+   skew target → a load-weighted ring re-split runs at the next drained
+   fence (``train_stream(fence_callback=...)`` parks the feeder, drains
+   the write-back, then hands this controller the one window where
+   topology may change).
+2. **hot-sign read replication** — heavy hitters no split can spread get
+   journaled copies on ring neighbours + a read fan-out map
+   (:mod:`replicate`); single-writer gradients keep exactly-once.
+3. **serving scale** — gateway QPS + quarantine pressure size the serving
+   replica set through injected spawn/kill actuators (the quarantine/heal
+   plumbing absorbs the membership churn).
+
+**Exactly-once across SIGKILL.** Every actuation is two-phase against a
+dedicated jobstate root: commit a ``planned`` manifest carrying the full
+decision + policy state, actuate, commit ``done``. A controller killed at
+ANY point and rebuilt over the same root (:meth:`Autopilot.resume`)
+re-drives the newest planned-without-done decision idempotently — the
+reshard resumes through :func:`persia_tpu.elastic.resume_reshard` (or
+re-runs with the SAME recorded splits, every handoff op deduping on the PS
+apply-journal), replication re-runs the same (epoch, step) round (journal
+dedupe), and a scale re-drives toward the recorded target. The soft guard
+state (dwell clocks) rides the manifests too; losing an uncommitted tick
+of it can only DELAY the next decision, never double-apply one.
+
+**Observable by construction.** Every round emits an ``autopilot.sense``
+flight-recorder event (the sensor snapshot), every decision an
+``autopilot.decide`` event, and every suppressed flap increments
+``persia_tpu_autopilot_suppressed_flaps`` — a guard that silently holds is
+indistinguishable from a dead sensor, so the holds are data.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from persia_tpu import jobstate
+from persia_tpu.embedding.tiering.profiler import publish_sketch_metrics
+from persia_tpu.embedding.tiering.shard_planner import ShardPlanner
+from persia_tpu.logger import get_default_logger
+from persia_tpu.metrics import get_metrics
+from persia_tpu.tracing import record_event, span
+
+from persia_tpu.autopilot.policy import (
+    KIND_REPLICATE,
+    KIND_RESHARD,
+    KIND_SCALE,
+    Decision,
+    PolicyConfig,
+    PolicyEngine,
+)
+from persia_tpu.autopilot.replicate import replicate_hot_signs
+
+logger = get_default_logger("persia_tpu.autopilot")
+
+AUTOPILOT_ENV = "PERSIA_AUTOPILOT"
+
+
+def autopilot_enabled() -> bool:
+    """The launcher's ``--autopilot`` exports PERSIA_AUTOPILOT=1."""
+    return os.environ.get(AUTOPILOT_ENV, "0") == "1"
+
+
+class Autopilot:
+    """Closed-loop fleet controller. Actuators are INJECTED callables so
+    the same control loop runs over a live ``ServiceCtx`` topology, the
+    in-process bench harness, or pure-stub tests:
+
+    - ``reshard(n_shards, splits, step) -> dict`` — re-split the PS ring
+      at the (already drained) fence; e.g.
+      ``lambda n, sp, st: svc.reshard_ps(n, mgr, step=st, splits=sp,
+      router=router)``.
+    - ``resume_reshard() -> Optional[dict]`` — re-enter an interrupted
+      reshard (None when none is pending).
+    - ``scale_to(target) -> int`` — grow/shrink the serving replica set,
+      returning the achieved count.
+    - ``serving_sensors() -> dict`` — ``{"qps": .., "replicas": ..,
+      "quarantined": ..}`` (see :func:`gateway_sensors`).
+
+    ``state_dir`` is the controller's OWN jobstate root (decision
+    manifests); keep it separate from the stream's snapshot root and pass
+    the reshard actuator its own root too — three manifest streams, three
+    directories, no cross-parsing.
+    """
+
+    def __init__(
+        self,
+        state_dir,
+        *,
+        policy: Optional[PolicyEngine] = None,
+        profiler=None,
+        router=None,
+        reshard: Optional[Callable] = None,
+        resume_reshard: Optional[Callable] = None,
+        scale_to: Optional[Callable] = None,
+        serving_sensors: Optional[Callable] = None,
+    ):
+        self.policy = policy or PolicyEngine(PolicyConfig())
+        self.mgr = jobstate.coerce_manager(state_dir)
+        self.profiler = profiler
+        self.router = router
+        self._reshard = reshard
+        self._resume_reshard = resume_reshard
+        self._scale_to = scale_to
+        self._serving_sensors = serving_sensors
+        self.rounds = 0
+        m = get_metrics()
+        self._m_decisions = m.counter(
+            "persia_tpu_autopilot_decisions",
+            "autopilot decisions actuated, by kind",
+        )
+        self._m_suppressed = m.counter(
+            "persia_tpu_autopilot_suppressed_flaps",
+            "decisions held back by hysteresis/dwell guards",
+        )
+        self._m_rounds = m.counter(
+            "persia_tpu_autopilot_rounds", "control-loop rounds run",
+        )
+        self._m_skew = m.gauge(
+            "persia_tpu_autopilot_modeled_skew",
+            "sketch-modeled load skew of the current PS ring",
+        )
+        self._m_serving = m.gauge(
+            "persia_tpu_autopilot_serving_replicas",
+            "serving replica count the autopilot last observed",
+        )
+        self._m_resumed = m.counter(
+            "persia_tpu_autopilot_resumed",
+            "planned decisions re-driven after a controller crash",
+        )
+
+    # --------------------------------------------------------------- sense
+
+    def sense(self) -> Dict:
+        """One sensor snapshot (also published: sketch load metrics via
+        :func:`publish_sketch_metrics`, serving gauge). Recorded as an
+        ``autopilot.sense`` flight event every round."""
+        snap: Dict = {}
+        if self.profiler is not None:
+            splits = self.router.ring if self.router is not None else None
+            snap.update(publish_sketch_metrics(self.profiler, splits=splits))
+            self._m_skew.set(float(snap.get("skew", 1.0)))
+        if self._serving_sensors is not None:
+            sv = self._serving_sensors()
+            snap.update({f"serving_{k}": v for k, v in sv.items()})
+            self._m_serving.set(float(sv.get("replicas", 0)))
+        return snap
+
+    # ----------------------------------------------------- two-phase drive
+
+    def _commit(self, phase: str, decision: Decision, step: int,
+                result: Optional[Dict] = None) -> None:
+        w = self.mgr.begin_epoch()
+        w.add_json("decision.json", decision.to_meta())
+        w.commit({
+            "autopilot": {
+                "phase": phase,
+                "step": int(step),
+                "decision": decision.to_meta(),
+                "policy_state": self.policy.export_state(),
+                "result": result or {},
+            },
+        })
+
+    def _actuate(self, decision: Decision, step: int) -> Dict:
+        p = decision.params
+        if decision.kind == KIND_RESHARD:
+            if self._reshard is None:
+                raise RuntimeError("reshard decision without an actuator")
+            return dict(self._reshard(
+                int(p["n_shards"]),
+                np.asarray(p["splits"], dtype=np.uint64),
+                int(step),
+            ) or {})
+        if decision.kind == KIND_REPLICATE:
+            if self.router is None:
+                raise RuntimeError("replicate decision without a router")
+            return replicate_hot_signs(
+                self.router, p["signs"],
+                job_epoch=self.mgr.latest().meta["job_epoch"],
+                step=int(step), fanout=int(p["fanout"]),
+                salt=int(p.get("salt", 0)),
+            )
+        if decision.kind == KIND_SCALE:
+            if self._scale_to is None:
+                raise RuntimeError("scale decision without an actuator")
+            return {"achieved": int(self._scale_to(int(p["target"])))}
+        raise ValueError(f"unknown decision kind {decision.kind!r}")
+
+    def _drive(self, decision: Decision, step: int) -> Dict:
+        """planned → actuate → done. A kill anywhere in between leaves the
+        planned manifest as the resume token."""
+        record_event("autopilot.decide", step=step, decision=decision.kind,
+                     reason=decision.reason, **{
+                         k: v for k, v in decision.params.items()
+                         if not isinstance(v, (list, dict))
+                     })
+        logger.info("autopilot: %s @ step %d — %s",
+                    decision.kind, step, decision.reason)
+        self._commit("planned", decision, step)
+        with span("autopilot.actuate", kind=decision.kind, step=step):
+            result = self._actuate(decision, step)
+        self._commit("done", decision, step, result)
+        self._m_decisions.inc(kind=decision.kind)
+        return result
+
+    # --------------------------------------------------------------- loops
+
+    def on_fence(self, gstep: int) -> Dict[str, Dict]:
+        """The training-plane round — pass this method directly as
+        ``train_stream(fence_callback=pilot.on_fence)``. The stream
+        guarantees the fence invariants (feeder parked, write-back
+        drained); everything here runs inside that window."""
+        self.rounds += 1
+        self._m_rounds.inc()
+        snap = self.sense()
+        record_event("autopilot.sense", step=gstep, **snap)
+        applied: Dict[str, Dict] = {}
+        before = self.policy.suppressed
+        if self.profiler is not None and self._reshard is not None:
+            n = len(self.router.replicas) if self.router is not None else 1
+            splits = self.router.ring if self.router is not None else None
+            d = self.policy.decide_reshard(self.profiler, n, splits)
+            if d is not None:
+                applied[KIND_RESHARD] = self._drive(d, gstep)
+                # the swap cleared the hot-read map — re-replicate now,
+                # onto the NEW owners' neighbours
+                self.policy.notify_topology_changed()
+        if self.profiler is not None and self.router is not None:
+            d = self.policy.decide_replicate(self.profiler)
+            if d is not None:
+                applied[KIND_REPLICATE] = self._drive(d, gstep)
+        held = self.policy.suppressed - before
+        if held:
+            self._m_suppressed.inc(held)
+            record_event("autopilot.suppressed", step=gstep, held=held)
+        return applied
+
+    def on_tick(self, step: int = 0) -> Dict[str, Dict]:
+        """The serving-plane round — called on a timer (the launcher's
+        ``--autopilot`` thread), independent of the training fence."""
+        self.rounds += 1
+        self._m_rounds.inc()
+        if self._serving_sensors is None or self._scale_to is None:
+            return {}
+        sv = self._serving_sensors()
+        self._m_serving.set(float(sv.get("replicas", 0)))
+        record_event("autopilot.sense", step=step,
+                     **{f"serving_{k}": v for k, v in sv.items()})
+        before = self.policy.suppressed
+        d = self.policy.decide_scale(
+            float(sv.get("qps", 0.0)), int(sv.get("replicas", 0)),
+            int(sv.get("quarantined", 0)),
+        )
+        applied: Dict[str, Dict] = {}
+        if d is not None:
+            applied[KIND_SCALE] = self._drive(d, step)
+        held = self.policy.suppressed - before
+        if held:
+            self._m_suppressed.inc(held)
+            record_event("autopilot.suppressed", step=step, held=held)
+        return applied
+
+    # -------------------------------------------------------------- resume
+
+    def pending(self) -> Optional[Dict]:
+        """The newest decision left ``planned`` without a ``done`` — the
+        resume token, or None when the log is clean."""
+        man = self.mgr.latest()
+        if man is None:
+            return None
+        meta = man.meta.get("autopilot")
+        if not meta or meta.get("phase") != "planned":
+            return None
+        return meta
+
+    def resume(self) -> Optional[Dict]:
+        """Re-drive a decision interrupted by SIGKILL, exactly-once:
+
+        - **reshard**: if the elastic engine left its own phase manifest,
+          :func:`~persia_tpu.elastic.resume_reshard` replays it (every op
+          journal-deduped); if the kill landed BEFORE the engine's first
+          commit, re-run with the SAME recorded splits — same plan, same
+          journal ids, same outcome.
+        - **replicate**: re-run the same (epoch, step) round; already-
+          imported blobs dedupe.
+        - **scale**: re-drive toward the recorded target (idempotent by
+          construction — the actuator converges on a count).
+
+        Restores the manifest's policy state first, then commits ``done``.
+        Returns the actuation result, or None when nothing was pending."""
+        meta = self.pending()
+        if meta is None:
+            return None
+        decision = Decision.from_meta(meta["decision"])
+        step = int(meta.get("step", 0))
+        self.policy.load_state(meta.get("policy_state", {}))
+        record_event("autopilot.resume", step=step, decision=decision.kind)
+        logger.info("autopilot: resuming planned %s from step %d",
+                    decision.kind, step)
+        with span("autopilot.resume", kind=decision.kind, step=step):
+            if decision.kind == KIND_RESHARD and self._resume_reshard is not None:
+                result = self._resume_reshard()
+                if result is None:  # killed before the engine's first phase
+                    result = self._actuate(decision, step)
+                result = dict(result)
+            else:
+                result = self._actuate(decision, step)
+        self._commit("done", decision, step, result)
+        self._m_resumed.inc()
+        self._m_decisions.inc(kind=decision.kind)
+        return result
+
+
+# ------------------------------------------------------------------ wiring
+
+
+def gateway_sensors(gateway) -> Callable[[], Dict]:
+    """Serving sensor closure over a ReplicaGateway: windowed request rate
+    + membership/quarantine pressure."""
+
+    def sensors() -> Dict:
+        st = gateway.stats()
+        return {
+            "qps": float(gateway.request_rate()),
+            "replicas": len(st["replicas"]),
+            "live": len(st["live"]),
+            "quarantined": len(st["quarantined"]),
+        }
+
+    return sensors
+
+
+def enable_autopilot(
+    svc,
+    state_dir: str,
+    *,
+    profiler,
+    router=None,
+    gateway=None,
+    scale_to: Optional[Callable] = None,
+    config: Optional[PolicyConfig] = None,
+) -> Autopilot:
+    """Wire an Autopilot over a live ``ServiceCtx`` topology: decisions
+    journal to ``state_dir/decisions``, reshards run their phase manifests
+    in ``state_dir/reshard``. Pass the returned pilot's ``on_fence`` as
+    ``train_stream(fence_callback=...)`` and (when a gateway is given)
+    call ``on_tick`` from a timer for the serving plane."""
+    reshard_mgr = jobstate.JobStateManager(
+        os.path.join(str(state_dir), "reshard")
+    )
+    pilot = Autopilot(
+        os.path.join(str(state_dir), "decisions"),
+        policy=PolicyEngine(config or PolicyConfig()),
+        profiler=profiler,
+        router=router,
+        reshard=lambda n, sp, st: svc.reshard_ps(
+            n, reshard_mgr, step=st, splits=sp, router=router,
+        ),
+        resume_reshard=lambda: svc.resume_reshard(
+            reshard_mgr, router=router,
+        ),
+        scale_to=scale_to,
+        serving_sensors=gateway_sensors(gateway) if gateway is not None
+        else None,
+    )
+    return pilot

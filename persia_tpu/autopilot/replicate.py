@@ -1,0 +1,92 @@
+"""Hot-sign read replication: journaled copies + the routing swap.
+
+Heavy hitters concentrate READ traffic that no ring re-split can spread —
+a single sign is atomic under range sharding (shard_planner places a
+boundary just past it, never through it). The remaining lever is
+replication: copy the hot sign's full entry (embedding + optimizer slots)
+onto the ``fanout - 1`` ring neighbours after its owner, then tell the
+router (``ShardedLookup.set_hot_read_replicas``) to fan READ lookups out
+across the copies. Writes are untouched — gradients keep flowing to the
+single owner under their journaled exactly-once ids, so there is exactly
+one authoritative copy and the read replicas are *bounded-stale*, refreshed
+every controller round (the same staleness contract asynchronous PS
+training already grants the cache tier).
+
+Exactly-once: each sign's copy is one ``export_range(h, h+1)`` blob (h =
+splitmix64(sign), the routing hash — a colliding sign rides along and is
+co-owned, which is harmless) imported under
+``jobstate.replication_journal_id(epoch, step, i)``. A controller killed
+mid-round and resumed re-runs the SAME (epoch, step) round: every blob
+already imported dedupes on its journal id + crc, the rest apply — the
+post-resume store state is bit-identical to an uninterrupted round.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from persia_tpu.embedding.hashing import (
+    sign_to_range_shard,
+    sign_to_shard,
+    splitmix64,
+)
+from persia_tpu.jobstate import replication_journal_id
+from persia_tpu.tracing import record_event, span
+
+# the journal op-index field is 7 bits (handoff_journal_id); index 0..126
+MAX_REPLICATED_SIGNS = 127
+
+
+def replicate_hot_signs(
+    router,
+    signs: Sequence[int],
+    *,
+    job_epoch: int,
+    step: int,
+    fanout: int,
+    salt: int = 0,
+) -> Dict:
+    """Copy each hot sign to its read replicas, then install the fan-out
+    map on ``router``. Passing an empty ``signs`` clears the map (no
+    copies). Idempotent for a fixed (job_epoch, step): replays dedupe on
+    the replication journal. Returns stats (copies applied vs deduped)."""
+    signs_u = np.unique(np.asarray(list(signs), dtype=np.uint64))
+    if len(signs_u) > MAX_REPLICATED_SIGNS:
+        raise ValueError(
+            f"{len(signs_u)} hot signs exceed the replication journal's "
+            f"op-index namespace ({MAX_REPLICATED_SIGNS})"
+        )
+    reps = router.replicas
+    ring = router.ring
+    n = len(reps)
+    stats = {"signs": int(len(signs_u)), "fanout": int(fanout),
+             "applied": 0, "deduped": 0}
+    if len(signs_u) == 0 or fanout <= 1 or n <= 1:
+        router.set_hot_read_replicas(
+            np.empty(0, np.uint64), 0, salt=salt
+        )
+        return stats
+    eff_fanout = min(int(fanout), n)
+    owners = (sign_to_range_shard(signs_u, ring) if ring is not None
+              else sign_to_shard(signs_u, n))
+    pos = splitmix64(signs_u)
+    with span("autopilot.replicate", signs=int(len(signs_u)),
+              fanout=eff_fanout, step=step):
+        for i in range(len(signs_u)):
+            h = int(pos[i])
+            hi = (h + 1) & 0xFFFFFFFFFFFFFFFF  # hi == 0 wraps to ring end
+            blob = reps[int(owners[i])].export_range(h, hi)
+            crc = zlib.crc32(blob) & 0xFFFFFFFF
+            jid = replication_journal_id(job_epoch, step, i)
+            for j in range(1, eff_fanout):
+                dst = (int(owners[i]) + j) % n
+                if reps[dst].import_range_journaled(jid, crc, blob):
+                    stats["applied"] += 1
+                else:
+                    stats["deduped"] += 1
+    router.set_hot_read_replicas(signs_u, eff_fanout, salt=salt)
+    record_event("autopilot.replicated", step=step, **stats)
+    return stats

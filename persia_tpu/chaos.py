@@ -696,6 +696,133 @@ class TrainerKiller:
         return self._done.wait(timeout_s)
 
 
+# ---------------------------------------------------------- load schedules
+
+
+@dataclass
+class LoadShapeConfig:
+    """Seeded TRAFFIC-shape schedule — the workload half of the chaos
+    plane. The fault machinery above perturbs the transport; this perturbs
+    the LOAD so a closed-loop controller (persia_tpu/autopilot) has
+    something real to react to. Three composable shapes, all driven by the
+    step ordinal and ``seed`` alone (bit-reproducible run to run):
+
+    - **zipf exponent ramp**: the sign distribution's zipf exponent
+      interpolates ``zipf_a0 → zipf_a1`` over steps
+      ``[ramp_start, ramp_end]`` — skew concentrates (or relaxes) under
+      the fleet, moving the per-shard load balance the sketch measures;
+    - **step traffic spike**: modeled request rate multiplies by
+      ``spike_x`` inside ``[spike_start, spike_end)`` — the serving-plane
+      scale-up/scale-down trigger;
+    - **hot-set rotation**: every ``rotate_every`` steps the IDENTITY of
+      the hot head shifts by ``rotate_stride`` sign positions (the
+      distribution's shape is unchanged, its support moves) — yesterday's
+      heavy hitters go cold, invalidating any placement pinned to them.
+
+    Used by both ``benchmarks/autopilot_bench.py`` and ``bench.py
+    --chaos`` (``BENCH_CHAOS_LOAD`` spec, :func:`parse_load_spec`)."""
+
+    seed: int = 7
+    vocab: int = 1 << 17
+    zipf_a0: float = 1.2
+    zipf_a1: float = 1.2
+    ramp_start: int = 0
+    ramp_end: int = 0
+    base_qps: float = 100.0
+    spike_x: float = 1.0
+    spike_start: int = 0
+    spike_end: int = 0
+    rotate_every: int = 0  # 0 = no rotation
+    rotate_stride: int = 7919  # prime stride keeps rotations disjoint
+
+    def to_dict(self) -> Dict:
+        return asdict(self)
+
+
+def parse_load_spec(spec: str) -> LoadShapeConfig:
+    """Parse a ``BENCH_CHAOS_LOAD`` spec like
+    ``"a0=1.1,a1=1.7,ramp=10:50,spike=4x20:30,rotate=16,seed=7"``.
+    Keys: seed, vocab, a0, a1, ramp=START:END, qps, spike=Xx|spike=XxS:E,
+    rotate (= rotate_every), stride."""
+    cfg = LoadShapeConfig()
+    if not spec:
+        return cfg
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        key = key.strip()
+        if key == "seed":
+            cfg.seed = int(val)
+        elif key == "vocab":
+            cfg.vocab = int(val)
+        elif key == "a0":
+            cfg.zipf_a0 = float(val)
+        elif key == "a1":
+            cfg.zipf_a1 = float(val)
+        elif key == "ramp":
+            s, _, e = val.partition(":")
+            cfg.ramp_start, cfg.ramp_end = int(s), int(e)
+        elif key == "qps":
+            cfg.base_qps = float(val)
+        elif key == "spike":
+            mult, _, window = val.partition("x")
+            cfg.spike_x = float(mult)
+            if window:
+                s, _, e = window.partition(":")
+                cfg.spike_start, cfg.spike_end = int(s), int(e)
+        elif key == "rotate":
+            cfg.rotate_every = int(val)
+        elif key == "stride":
+            cfg.rotate_stride = int(val)
+        else:
+            raise ValueError(f"unknown load knob {key!r} in {spec!r}")
+    return cfg
+
+
+class LoadSchedule:
+    """Materializes a :class:`LoadShapeConfig`: per-step zipf exponent,
+    modeled request rate, and seeded sign batches. Every draw derives its
+    generator from ``(seed, step, slot)`` so any step is reproducible in
+    isolation — a resumed soak replays the exact traffic of the run it
+    resumes (the same discipline the fault proxies keep per connection)."""
+
+    def __init__(self, cfg: Optional[LoadShapeConfig] = None):
+        self.cfg = cfg or LoadShapeConfig()
+
+    def zipf_a(self, step: int) -> float:
+        c = self.cfg
+        if c.ramp_end <= c.ramp_start:
+            return c.zipf_a0
+        t = min(max((step - c.ramp_start) / (c.ramp_end - c.ramp_start), 0.0),
+                1.0)
+        return c.zipf_a0 + t * (c.zipf_a1 - c.zipf_a0)
+
+    def qps(self, step: int) -> float:
+        c = self.cfg
+        if c.spike_end > c.spike_start and c.spike_start <= step < c.spike_end:
+            return c.base_qps * c.spike_x
+        return c.base_qps
+
+    def rotation(self, step: int) -> int:
+        c = self.cfg
+        return 0 if c.rotate_every <= 0 else step // c.rotate_every
+
+    def signs(self, step: int, n: int, slot: int = 0) -> np.ndarray:
+        """One seeded sign batch: zipf(``zipf_a(step)``) ranks, rotated by
+        the step's hot-set rotation, offset into the slot's sign space
+        (u64, never 0 — sign 0 is the stores' reserved empty key)."""
+        c = self.cfg
+        rng = np.random.default_rng(
+            (c.seed * 1_000_003 + step) * 1_000_033 + slot
+        )
+        ranks = rng.zipf(max(self.zipf_a(step), 1.001), n).astype(np.uint64)
+        rot = np.uint64((self.rotation(step) * c.rotate_stride) % c.vocab)
+        ids = (ranks + rot) % np.uint64(c.vocab)
+        return ids + np.uint64(slot * c.vocab + 1)
+
+
 # --------------------------------------------------------------- schedules
 
 

@@ -60,6 +60,7 @@ def run_train_stream(
     start_step: int = 0,
     sentinel=None,
     skip_steps=None,
+    fence_callback: Optional[Callable[[int], None]] = None,
 ) -> Optional[Dict]:
     """Fully-pipelined training over an iterable of ``PersiaBatch``.
 
@@ -160,6 +161,18 @@ def run_train_stream(
     holds unchanged, and a post-migration fence fires the stage graph's
     ``rebuild()`` hooks. ``on_metrics`` forces depth 1 (per-step header
     sync), like ``dispatch_k``.
+
+    ``fence_callback``: a hook invoked at EVERY fence with the global
+    step, after the manifest commit (when ``job_state`` is armed) and the
+    migration point, while the feeder is still parked and the write-back
+    drained — the one window where topology may change under the stream
+    (the autopilot controller's reshard/replication actuation point;
+    persia_tpu/autopilot). Park → callback → resume: the drained-fence
+    invariants are identical to snapshot fences, and a no-op callback is
+    bit-transparent to the stream (tests/test_autopilot.py pins this).
+    With ``fence_callback`` set the fence cadence runs even without
+    ``job_state`` (no manifest is committed then). A callback exception
+    aborts the stream like any fence failure.
 
     ``sentinel`` + ``skip_steps`` (persia_tpu/health): an armed
     :class:`~persia_tpu.health.sentinel.StreamSentinel` digests each
@@ -384,7 +397,8 @@ def run_train_stream(
                 if stop.is_set() or errors:
                     break
                 if (
-                    job_mgr is not None and snapshot_every
+                    (job_mgr is not None or fence_callback is not None)
+                    and snapshot_every
                     and seq > 0 and (start_step + seq) % snapshot_every == 0
                 ):
                     # snapshot fence: pause BEFORE this step's prepare — a
@@ -797,8 +811,9 @@ def run_train_stream(
                 ))
             else:
                 try:
-                    with span("stream.fence", step=gstep):
-                        self._fence_capture(job_mgr, gstep, occupancy)
+                    if job_mgr is not None:
+                        with span("stream.fence", step=gstep):
+                            self._fence_capture(job_mgr, gstep, occupancy)
                     stats["fences"] = stats.get("fences", 0) + 1
                     record_event("stream.fence_commit", step=gstep)
                     n_mig = stats.get("migrations", 0)
@@ -808,6 +823,13 @@ def run_train_stream(
                         # stage programs: fire the fence-point stage-graph
                         # rebuild hooks (window drained, feeder parked)
                         graph.rebuild(gstep)
+                    if fence_callback is not None:
+                        # topology-change window: feeder parked, write-back
+                        # drained, rings verified empty, manifest (if any)
+                        # committed — the callback may reshard the PS tier
+                        # or swap routing before the stream resumes
+                        with span("stream.fence_callback", step=gstep):
+                            fence_callback(gstep)
                 except BaseException as e:  # noqa: BLE001
                     errors.append(e)
         fence_done.set()

@@ -33,4 +33,5 @@ from persia_tpu.embedding.tiering.planner import (  # noqa: F401
 from persia_tpu.embedding.tiering.profiler import (  # noqa: F401
     AccessProfiler,
     SlotStats,
+    publish_sketch_metrics,
 )

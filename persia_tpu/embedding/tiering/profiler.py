@@ -357,3 +357,66 @@ class AccessProfiler:
             )
         for sk, blob in zip(self._sks, blobs):
             sk.import_bytes(bytes.fromhex(blob))
+
+
+# ----------------------------------------------------- /metrics publication
+
+
+def publish_sketch_metrics(profiler: "AccessProfiler",
+                           splits=None) -> Dict[str, float]:
+    """Publish the access sketch's view onto the process /metrics endpoint
+    (persia_tpu.metrics.serve_http) so the autopilot controller and a human
+    operator read the SAME signal — until now the sketch was only readable
+    in-process through ``stats()``/``slot_tops()``.
+
+    Exports, all in the ``persia_tpu_`` namespace:
+
+    - ``persia_tpu_ps_shard_load{shard=i}``  modeled load fraction per PS
+      shard under ``splits`` (the live ring, or hash-uniform when None) —
+      the ShardPlanner's own load model (heavy-hitter point masses +
+      uniform residual), i.e. what the reshard decision is made FROM;
+    - ``persia_tpu_ps_shard_load_skew``      max/mean of those fractions;
+    - ``persia_tpu_sketch_heavy_hitter_mass{slot=...}``  fraction of the
+      slot's decayed mass carried by its tracked top-K (hot_frac);
+    - ``persia_tpu_sketch_working_set{slot=...}``        distinct-sign
+      working-set estimate per slot.
+
+    Returns ``{"skew": ..., "total_mass": ...}`` for the caller's own
+    decision path. ``splits`` defaults to hash-uniform for the CURRENT
+    modeled shard count only when given explicitly as an int via
+    ``uniform_splits`` by the caller; passing None publishes a single
+    whole-ring shard (n=1)."""
+    from persia_tpu.embedding.tiering.shard_planner import ShardPlanner
+    from persia_tpu.metrics import get_metrics
+
+    m = get_metrics()
+    g_load = m.gauge(
+        "persia_tpu_ps_shard_load",
+        "modeled PS shard load fraction from the access sketch",
+    )
+    g_skew = m.gauge(
+        "persia_tpu_ps_shard_load_skew",
+        "modeled PS load skew (max/mean) under the current ring",
+    )
+    g_hh = m.gauge(
+        "persia_tpu_sketch_heavy_hitter_mass",
+        "fraction of a slot's decayed access mass in its top-K heavy hitters",
+    )
+    g_ws = m.gauge(
+        "persia_tpu_sketch_working_set",
+        "distinct-sign working-set estimate per slot",
+    )
+    pos, w, residual = ShardPlanner.mass_from_profiler(profiler)
+    ring = (np.empty(0, np.uint64) if splits is None
+            else np.asarray(splits, np.uint64))
+    loads = ShardPlanner.shard_loads(ring, pos, w, residual)
+    for i, frac in enumerate(loads):
+        g_load.set(float(frac), shard=str(i))
+    skew = ShardPlanner.skew_of(loads)
+    g_skew.set(skew)
+    total = 0.0
+    for name, st in profiler.stats().items():
+        g_hh.set(float(st.hot_frac), slot=name)
+        g_ws.set(float(st.unique), slot=name)
+        total += float(st.total)
+    return {"skew": skew, "total_mass": total}

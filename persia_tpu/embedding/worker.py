@@ -35,6 +35,7 @@ from persia_tpu.embedding.hashing import (
     hash_stack,
     sign_to_range_shard,
     sign_to_shard,
+    splitmix64,
 )
 from persia_tpu.embedding.store import EmbeddingStore
 from persia_tpu.metrics import get_metrics
@@ -211,6 +212,17 @@ class ShardedLookup:
         # partition fast path).
         self._ring_lock = threading.Lock()  # serializes swaps, not reads
         self._topo = (list(replicas), self._check_ring(ring, len(replicas)), 0)
+        # --- hot-sign read replication (persia_tpu/autopilot) ---------------
+        # ``(sorted hot signs u64, fanout, salt)`` or None. READ fan-out
+        # only: a hot sign's lookups round-robin over ``fanout`` consecutive
+        # ring neighbours (per-sign hash phase + per-call sequence), while
+        # every WRITE surface (gradient updates, checkout, set_embedding,
+        # scrub) keeps owner routing — the single-writer invariant that
+        # preserves the apply-journal's exactly-once story. Replicas serve
+        # bounded-stale copies refreshed at stream fences (the same
+        # staleness contract PS-tier training already runs under).
+        self._hot = None
+        self._hot_seq = 0  # read-call sequence for the round-robin spread
         # callable(replica) -> None: re-push optimizer + hyperparams to a
         # replica that lost its runtime config (restarted PS; ref: the
         # worker rebuilds its PS client pool on RpcError,
@@ -268,6 +280,14 @@ class ShardedLookup:
             "PS replica count in the router's current topology",
         )
         self._m_replicas.set(len(replicas))
+        self._m_hot_signs = m.gauge(
+            "persia_tpu_hot_replicated_signs",
+            "heavy-hitter signs currently read-replicated across PS shards",
+        )
+        self._m_hot_reads = m.counter(
+            "persia_tpu_hot_replica_reads",
+            "lookup rows served by a hot-sign read replica (not the owner)",
+        )
         # eager pool (lazy init would race: EmbeddingWorker's slot threads
         # call the router concurrently): sized for replicas x concurrent
         # slot callers — the transport below is the pooled RpcClient
@@ -353,7 +373,14 @@ class ShardedLookup:
                     thread_name_prefix="ps-fanout",
                 )
             self._topo = (replicas, ring, version)
+            # a topology change invalidates the hot-read map wholesale:
+            # replica copies were placed relative to the OLD owner layout,
+            # so keeping the map would fan reads out to shards that never
+            # received the rows. The controller re-replicates at the next
+            # fence from the same sketch signal.
+            self._hot = None
         self._m_replicas.set(len(replicas))
+        self._m_hot_signs.set(0)
         from persia_tpu import tracing
 
         tracing.record_event(
@@ -363,6 +390,88 @@ class ShardedLookup:
             ring="range" if ring is not None else "modulo",
         )
         return version
+
+    # ------------------------------------------- hot-sign read replication
+
+    def set_hot_read_replicas(self, signs, fanout: int, salt: int = 0) -> int:
+        """Install (or clear) the hot-sign read fan-out map. ``signs`` are
+        the heavy hitters whose full entries the caller has ALREADY copied
+        onto the ``fanout - 1`` ring neighbours after each owner
+        (:func:`persia_tpu.autopilot.replicate.replicate_hot_signs` — the
+        journaled copy and this routing swap are one actuation). Reads for
+        a hot sign round-robin over its ``fanout`` copies: ``(owner +
+        (mix(sign ^ salt) + seq + occurrence) % fanout) % n``, where
+        ``occurrence`` is the read's rank among same-sign rows in the
+        batch and ``seq`` advances once per call — a single scorching sign
+        (the atomic point mass no ring split can spread) really does
+        divide by ``fanout`` inside every batch, each sign phase-shifted
+        by its hash so the hot set never marches in lockstep. ``seq``
+        resets on install, so a replayed run reroutes identically. Empty
+        signs or ``fanout <= 1`` clears the map. Returns the number of
+        hot signs installed."""
+        signs = np.asarray(signs if signs is not None else [], dtype=np.uint64)
+        with self._ring_lock:
+            self._hot_seq = 0
+            if len(signs) == 0 or fanout <= 1 or len(self._topo[0]) <= 1:
+                self._hot = None
+                n_hot = 0
+            else:
+                self._hot = (
+                    np.sort(signs),
+                    int(min(fanout, len(self._topo[0]))),
+                    np.uint64(salt),
+                )
+                n_hot = len(signs)
+        self._m_hot_signs.set(n_hot)
+        from persia_tpu import tracing
+
+        tracing.record_event(
+            "autopilot.hot_read_map", signs=n_hot,
+            fanout=int(fanout) if n_hot else 0,
+        )
+        return n_hot
+
+    def hot_read_state(self):
+        """(signs, fanout, salt) of the installed hot-read map, or None."""
+        hot = self._hot
+        return None if hot is None else (hot[0].copy(), hot[1], int(hot[2]))
+
+    def _hot_reroute(self, signs: np.ndarray, shard: np.ndarray, n: int):
+        """Apply the hot-read map to an owner-shard array (READ paths
+        only): members of the hot set move to their per-sign replica."""
+        hot = self._hot
+        if hot is None or n <= 1:
+            return shard
+        hsigns, fanout, salt = hot
+        idx = np.searchsorted(hsigns, signs)
+        np.minimum(idx, len(hsigns) - 1, out=idx)
+        member = hsigns[idx] == signs
+        if not member.any():
+            return shard
+        seq = self._hot_seq  # benign race: any value spreads the load
+        self._hot_seq = seq + 1
+        m_signs = signs[member]
+        # per-occurrence round-robin: a batch carrying k reads of one hot
+        # sign sends ~k/fanout to EACH of its copies (the occurrence rank
+        # within the batch advances the offset), so a single scorching
+        # sign divides by ``fanout`` inside every batch, not just across
+        # batches; ``seq`` rotates the phase call-to-call on top
+        order = np.argsort(m_signs, kind="stable")
+        s_sorted = m_signs[order]
+        starts = np.flatnonzero(
+            np.r_[True, s_sorted[1:] != s_sorted[:-1]]
+        )
+        runs = np.diff(np.r_[starts, len(s_sorted)])
+        occ = np.empty(len(s_sorted), dtype=np.uint64)
+        occ[order] = (np.arange(len(s_sorted), dtype=np.uint64)
+                      - np.repeat(starts, runs).astype(np.uint64))
+        offs = (splitmix64(m_signs ^ salt) + np.uint64(seq) + occ) \
+            % np.uint64(fanout)
+        shard = shard.copy()
+        moved = (shard[member].astype(np.uint64) + offs) % np.uint64(n)
+        self._m_hot_reads.inc(int((moved != shard[member]).sum()))
+        shard[member] = moved.astype(shard.dtype)
+        return shard
 
     # ----------------------------------------------- degraded-mode machinery
 
@@ -571,18 +680,26 @@ class ShardedLookup:
             )
         return [f.result() for f in [self._group_pool.submit(t) for t in thunks]]
 
-    def _partition(self, signs: np.ndarray):
+    def _partition(self, signs: np.ndarray, read: bool = False):
         """[(replica_index, positions-or-mask), ...] for the touched
         replicas — the one sign-routing split every fan-out method shares
         (native one-pass partition when available, boolean masks otherwise;
         both index forms select rows identically downstream). With a
         split-point ring installed the native modulo partition is invalid —
-        range routing via :func:`sign_to_range_shard` replaces it."""
+        range routing via :func:`sign_to_range_shard` replaces it.
+
+        ``read=True`` (lookup paths only) additionally applies the
+        hot-sign read fan-out map: heavy hitters spread over their owner's
+        ring neighbours. Write paths keep ``read=False`` owner routing."""
         reps, ring, _ = self._topo
         n = len(reps)
+        hot_active = read and self._hot is not None and n > 1
         sel = []
-        if ring is not None:
-            shard = sign_to_range_shard(signs, ring)
+        if ring is not None or hot_active:
+            shard = (sign_to_range_shard(signs, ring) if ring is not None
+                     else sign_to_shard(signs, n))
+            if hot_active:
+                shard = self._hot_reroute(signs, shard, n)
             for r in range(n):
                 mask = shard == r
                 if mask.any():
@@ -605,12 +722,12 @@ class ShardedLookup:
                     sel.append((r, mask))
         return sel
 
-    def _partition_positions(self, signs: np.ndarray):
+    def _partition_positions(self, signs: np.ndarray, read: bool = False):
         """Like ``_partition`` but always ascending position arrays (the
         grouped fan-outs need ``searchsorted`` over them)."""
         return [
             (r, idx if idx.dtype != np.bool_ else np.flatnonzero(idx))
-            for r, idx in self._partition(signs)
+            for r, idx in self._partition(signs, read=read)
         ]
 
     def lookup_groups(
@@ -667,7 +784,7 @@ class ShardedLookup:
         outs = [
             np.zeros((len(k), int(d)), dtype=np.float32) for k, d in groups
         ]
-        sel = self._partition_positions(all_keys)
+        sel = self._partition_positions(all_keys, read=True)
 
         def one_replica(rep, pos):
             sub_keys = all_keys[pos]
@@ -864,7 +981,7 @@ class ShardedLookup:
                 self._record_served(keys)
             return vals
         out = np.zeros((len(keys), dim), dtype=np.float32)
-        sel = self._partition(keys)
+        sel = self._partition(keys, read=True)
 
         def one(rep, idx):
             sub = keys[idx]

@@ -132,6 +132,19 @@ def handoff_journal_id(base_id: int, op_index: int) -> int:
     return base_id | 0x80 | (op_index & 0x7F)
 
 
+def replication_journal_id(job_epoch: int, step: int, op_index: int) -> int:
+    """Journal id for one hot-sign read-replication copy (owner range
+    export → replica journaled import; persia_tpu/autopilot/replicate).
+    The low byte reuses the handoff's 0x80 namespace, so the STEP field's
+    top bit (bit 31 — fence steps never reach 2^31) separates the two: a
+    replication refresh and a reshard handoff at the SAME fence step
+    dedupe independently on a shared destination replica. ``op_index``
+    numbers the hot signs of one refresh round (< 128)."""
+    return handoff_journal_id(
+        make_journal_id(job_epoch, (step & 0x7FFFFFFF) | 0x80000000), op_index
+    )
+
+
 def payload_crc(*arrays) -> int:
     """crc32 of a gradient batch's payload arrays — the ``crc`` member of
     the journal's (step, shard, crc) record. A replay that produces a

@@ -123,6 +123,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                           "once the fleet is up (needs --ps > 0): exercises "
                           "the exactly-once elastic handoff "
                           "(persia_tpu/elastic.py) on a real topology")
+    loc.add_argument("--autopilot", action="store_true",
+                     help="arm the closed-loop fleet controller "
+                          "(persia_tpu/autopilot): a parent-side thread "
+                          "senses gateway QPS/quarantine pressure and "
+                          "scales the serving replica set (decisions "
+                          "two-phase-journaled, hysteresis+dwell guarded); "
+                          "exports PERSIA_AUTOPILOT=1 so trainer entries "
+                          "can arm the fence-driven PS side too")
+    loc.add_argument("--autopilot-interval-s", type=float, default=2.0,
+                     help="serving autopilot sense/decide cadence")
     loc.add_argument("--seed", type=int, default=7)
     loc.add_argument("--trace-dir", type=str, default=None,
                      help="arm fleet tracing: every role serves /metrics + "
@@ -234,6 +244,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         from persia_tpu.topology import LocalTopology
 
+        if args.autopilot:
+            # children inherit the opt-in (autopilot.autopilot_enabled())
+            os.environ["PERSIA_AUTOPILOT"] = "1"
         topo = LocalTopology(
             ps=args.ps, workers=args.workers, trainers=args.trainers,
             replicas=args.replicas, base_dir=args.base_dir, steps=args.steps,
@@ -248,6 +261,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"local topology up: {args.trainers} trainer(s), "
                   f"{args.replicas} replica(s) [{ports}]", flush=True)
             print(f"workdir: {topo.base_dir}", flush=True)
+            if args.autopilot:
+                topo.start_autopilot(interval_s=args.autopilot_interval_s)
+                print("autopilot armed (serving plane)", flush=True)
             if args.reshard_ps > 0:
                 if args.ps <= 0:
                     print("--reshard-ps needs --ps > 0", file=sys.stderr)

@@ -146,6 +146,9 @@ class ReplicaGateway:
             breaker_reset_s=health_interval_s,
         )
         self._rr = 0
+        # QPS sensor window: (monotonic, request-counter reading) at the
+        # previous request_rate() call — the autopilot polls it per tick
+        self._rate_mark: Optional[Tuple[float, float]] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # freshness bookkeeping (all guarded by _lock): last /healthz
@@ -216,6 +219,30 @@ class ReplicaGateway:
                 self._clients[addr] = InferenceClient(
                     addr, timeout_s=self.request_timeout_s
                 )
+
+    def remove_replica(self, addr: str) -> bool:
+        """Drain one replica out of the rotation (the autopilot's
+        scale-down actuator): it leaves the balance set immediately — new
+        requests never route to it, in-flight attempts on its client
+        finish or fail through their own retry path — and its freshness /
+        quarantine / breaker records are dropped so a later re-add starts
+        with a clean slate (the :meth:`replace_replica`-style reset, not
+        the swap-preserving one: the process behind the address is going
+        away). Returns True when the address was a member.
+
+        With a ``coordinator`` wired the caller must ALSO deregister the
+        address there, or the next probe sweep re-adds it."""
+        with self._lock:
+            client = self._clients.pop(addr, None)
+            self._freshness.pop(addr, None)
+            self._quarantined.discard(addr)
+        if client is None:
+            return False
+        self.policy.reset_breaker(addr)
+        self._update_live_gauge()
+        tracing.record_event("gateway.remove_replica", replica=addr)
+        logger.info("replica %s removed from the rotation", addr)
+        return True
 
     def live_replicas(self) -> List[str]:
         """The balance set: breaker-available AND not staleness-quarantined."""
@@ -568,6 +595,20 @@ class ReplicaGateway:
         raise first_error or TimeoutError(f"no answer from {addr} within timeout")
 
     # ------------------------------------------------------------------ stats
+
+    def request_rate(self) -> float:
+        """Requests/second over the window since the previous call — the
+        autopilot's serving-load sensor. The first call establishes the
+        window and returns 0.0; subsequent calls measure the counter delta
+        against the monotonic clock. A sub-millisecond window also returns
+        0.0 rather than a spike artifact."""
+        now = time.monotonic()
+        count = float(self._m_requests.get())
+        with self._lock:
+            mark, self._rate_mark = self._rate_mark, (now, count)
+        if mark is None or (now - mark[0]) < 1e-3:
+            return 0.0
+        return max(0.0, count - mark[1]) / (now - mark[0])
 
     def stats(self) -> Dict:
         with self._lock:

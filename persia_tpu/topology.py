@@ -385,6 +385,9 @@ class LocalTopology:
         self._expected_dead: set = set()
         self._watch_stop = threading.Event()
         self._watch_thread: Optional[threading.Thread] = None
+        self.autopilot = None
+        self._ap_stop = threading.Event()
+        self._ap_thread: Optional[threading.Thread] = None
         self._env = dict(os.environ, JAX_PLATFORMS="cpu")
         self._env["PYTHONPATH"] = (
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -534,6 +537,104 @@ class LocalTopology:
         heals it back into rotation when its breaker re-closes."""
         self._replica_procs[i] = self._spawn_replica(i)
 
+    # ------------------------------------------------------------- autopilot
+
+    def live_serving(self) -> List[int]:
+        """Indices of serving replicas whose process is currently alive."""
+        return [i for i, p in enumerate(self._replica_procs)
+                if p is not None and p.poll() is None]
+
+    def scale_serving(self, target: int) -> int:
+        """Grow/shrink the live serving replica set to ``target`` — the
+        autopilot's scale actuator. Shrink drains from the highest live
+        index (kill + ``gateway.remove_replica``, so no new requests route
+        there); grow reuses dead slots' original ports first (the healed
+        replica boots from the newest checkpoint + delta tail) before
+        allocating fresh ones, waits for ``/healthz``, then folds the
+        address into the gateway's balance set. Idempotent: re-driving the
+        same target converges without churn. This is a pure ACTUATOR —
+        the flap guards (hysteresis margin + min-dwell, CTRL001) live
+        upstream in ``autopilot.PolicyEngine.decide_scale``, which decides
+        ``target``; nothing here re-decides. Returns the live count."""
+        from persia_tpu.serving import InferenceClient
+        from persia_tpu.service.resilience import poll_until
+
+        target = max(1, int(target))
+        coordinator = (f"127.0.0.1:{self.svc.coordinator.port}"
+                       if self.svc is not None else None)
+        live = self.live_serving()
+        while len(live) > target:
+            i = live.pop()
+            addr = f"127.0.0.1:{self.replica_ports[i]}"
+            logger.info("autopilot scale: draining serving replica %d", i)
+            self.kill_replica(i)
+            self._replica_procs[i] = None
+            if self.gateway is not None:
+                self.gateway.remove_replica(addr)
+        while len(live) < target:
+            dead = [i for i in range(len(self._replica_procs))
+                    if i not in live]
+            if dead:
+                i = dead[0]
+            else:
+                i = len(self._replica_procs)
+                self._replica_procs.append(None)
+                self.replica_ports.append(_free_port())
+            logger.info("autopilot scale: spawning serving replica %d", i)
+            self._replica_procs[i] = self._spawn_replica(
+                i, coordinator=coordinator
+            )
+            addr = f"127.0.0.1:{self.replica_ports[i]}"
+            cli = InferenceClient(addr, timeout_s=5.0)
+            poll_until(
+                lambda c=cli: c.health().get("status") == "ok",
+                timeout_s=self.startup_timeout_s,
+                what=f"replica {i} health",
+            )
+            if self.gateway is not None:
+                self.gateway.add_replica(addr)
+            live.append(i)
+        return len(live)
+
+    def start_autopilot(self, interval_s: float = 2.0, config=None):
+        """Arm the parent-side serving autopilot: a timer thread sensing
+        the gateway (QPS, quarantine pressure) and actuating
+        :meth:`scale_serving`, every decision two-phase-journaled under
+        ``base_dir/autopilot`` and resumed on re-arm. The PS-reshard and
+        hot-replication actuators are fence-driven and live INSIDE the
+        trainer (``train_stream(fence_callback=pilot.on_fence)``, see
+        persia_tpu/autopilot) — this thread covers the serving plane,
+        whose control loop has no fence to ride. All flap suppression
+        (hysteresis margin + min-dwell) happens in the shared
+        :class:`~persia_tpu.autopilot.PolicyEngine` on the decision path,
+        never here."""
+        from persia_tpu.autopilot import (
+            Autopilot, PolicyConfig, PolicyEngine, gateway_sensors,
+        )
+
+        self.autopilot = Autopilot(
+            os.path.join(self.base_dir, "autopilot", "decisions"),
+            policy=PolicyEngine(config or PolicyConfig()),
+            scale_to=self.scale_serving,
+            serving_sensors=gateway_sensors(self.gateway),
+        )
+        self.autopilot.resume()
+
+        def _loop() -> None:
+            tick = 0
+            while not self._ap_stop.wait(interval_s):
+                tick += 1
+                try:
+                    self.autopilot.on_tick(tick)
+                except Exception:
+                    logger.exception("autopilot tick %d failed", tick)
+
+        self._ap_thread = threading.Thread(
+            target=_loop, daemon=True, name="autopilot"
+        )
+        self._ap_thread.start()
+        return self.autopilot
+
     def reshard_ps(self, n_new: int, **kw) -> Dict:
         """Live-reshard the PS tier to ``n_new`` replicas (needs ``ps > 0``):
         delegates to :meth:`ServiceCtx.reshard_ps` with a journal dir under
@@ -585,6 +686,8 @@ class LocalTopology:
             out["gateway"] = self.gateway.stats()
         if self.delta_chaos is not None:
             out["delta_channel"] = dict(self.delta_chaos.counts)
+        if self.autopilot is not None:
+            out["autopilot_rounds"] = self.autopilot.rounds
         if self.svc is not None:
             out["n_ps"] = self.svc.n_ps
             if self.svc.ps_ring is not None:
@@ -710,6 +813,9 @@ class LocalTopology:
         return out
 
     def stop(self) -> None:
+        self._ap_stop.set()
+        if self._ap_thread is not None:
+            self._ap_thread.join(timeout=5)
         self._watch_stop.set()
         if self._watch_thread is not None:
             self._watch_thread.join(timeout=5)

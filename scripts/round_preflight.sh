@@ -108,6 +108,12 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_grad_sync.py -q -m 'not slow' \
 # grow/shrink chaos parity runs (test_ctx_*) ride the full suite in step 2
 JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q -m 'not slow' \
     -k "not ctx_"
+# autopilot fast subset (ISSUE 16): policy hysteresis/dwell guards,
+# journaled hot-sign replication exactly-once + read fan-out, two-phase
+# decision SIGKILL resume, gateway sensors/actuators, LoadSchedule
+# parsing/determinism; the multi-second fence_callback bit-transparency
+# stream runs ride the full suite in step 2
+JAX_PLATFORMS=cpu python -m pytest tests/test_autopilot.py -q -m 'not slow'
 
 echo "== 1.5/5 telemetry plane (trace propagation + flight recorder) =="
 # the fast tracing/telemetry subset: span mechanics, RPC + gateway HTTP

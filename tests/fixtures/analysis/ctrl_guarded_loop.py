@@ -1,0 +1,33 @@
+"""CTRL001 clean fixture: the same loops, guarded (or not loops at all)."""
+import time
+
+
+def guarded_rebalancer(svc, mgr, planner, sensor):
+    # clean: hysteresis margin + min-dwell on the decision path
+    dwell = 0
+    while True:
+        plan = planner.plan(4, profiler=sensor)
+        dwell = dwell + 1 if not plan.adopted else 0
+        if plan.adopted and dwell >= planner.min_dwell \
+                and plan.skew * (1.0 + planner.hysteresis) < sensor.skew():
+            svc.reshard_ps(4, mgr, splits=plan.splits)
+        time.sleep(1.0)
+
+
+def policy_scaler(topo, policy, gateway):
+    # clean: the decision is delegated — dwell/hysteresis guard lives in
+    # PolicyEngine.decide_scale, referenced here for the reader
+    while True:
+        d = policy.decide_scale(gateway.request_rate(), 2)
+        if d is not None:
+            topo.scale_serving(d.params["target"])
+
+
+def one_shot_reshard(svc, mgr):
+    # clean: a mutator OUTSIDE any loop is an operator action
+    return svc.reshard_ps(4, mgr)
+
+
+def suppressed_loop(svc, mgr):
+    while True:
+        svc.reshard_ps(2, mgr)  # persia-lint: disable=CTRL001

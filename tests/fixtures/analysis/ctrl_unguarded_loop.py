@@ -1,0 +1,25 @@
+"""CTRL001 fixture: control loops mutating topology with no flap guard."""
+import time
+
+
+def naive_rebalancer(svc, mgr, sensor):
+    # fires: reshard in a loop keyed directly off a raw sensor read
+    while True:
+        if sensor.skew() > 1.2:
+            svc.reshard_ps(4, mgr)
+        time.sleep(1.0)
+
+
+def naive_scaler(topo, gateway, stop):
+    # fires: membership churned straight from the qps sample
+    while not stop.is_set():
+        if gateway.request_rate() > 500:
+            topo.scale_serving(8)
+        else:
+            topo.scale_serving(2)
+
+
+def churn_router(router, replicas):
+    # fires at module function level too
+    while replicas:
+        router.swap_topology(replicas.pop())

@@ -543,6 +543,55 @@ def test_obs_suppression_works():
     assert apply_suppressions(raw, {"supp.py": src}) == []
 
 
+# -------------------------------------------------- control-loop fixtures
+
+
+def test_ctrl001_unguarded_topology_loop_fires():
+    from persia_tpu.analysis import control_lint
+
+    findings = control_lint.check_source(
+        read_text(_fixture("ctrl_unguarded_loop.py")), "ctrl_unguarded_loop.py"
+    )
+    # reshard loop, both scale_serving branches, and the swap loop fire
+    assert [f.rule for f in findings] == ["CTRL001"] * 4, findings
+    assert {"reshard_ps", "scale_serving", "swap_topology"} <= {
+        f.message.split("(")[1].split(")")[0] for f in findings
+    }
+
+
+def test_ctrl001_guarded_and_one_shot_stay_clean():
+    from persia_tpu.analysis import control_lint
+    from persia_tpu.analysis.common import apply_suppressions as sup
+
+    src = read_text(_fixture("ctrl_guarded_loop.py"))
+    raw = control_lint.check_source(src, "ctrl_guarded_loop.py")
+    # only the explicitly suppressed loop remains raw; suppression drops it
+    assert [f.rule for f in raw] == ["CTRL001"], raw
+    assert sup(raw, {"ctrl_guarded_loop.py": src}) == []
+
+
+def test_ctrl001_for_loop_membership_apply_is_clean():
+    from persia_tpu.analysis import control_lint
+
+    # a bounded for over a static list APPLIES a decision — not a control
+    # loop (the gateway's bootstrap/probe sweeps)
+    src = (
+        "def bootstrap(gw, addrs):\n"
+        "    for a in addrs:\n"
+        "        gw.add_replica(a)\n"
+    )
+    assert control_lint.check_source(src, "boot.py") == []
+
+
+def test_ctrl001_skips_test_files():
+    from persia_tpu.analysis import control_lint
+
+    findings = control_lint.check(files=[_fixture("ctrl_unguarded_loop.py"),
+                                         "tests/test_analysis.py"])
+    # fixture dir rides under tests/ → exempt via the tests/ prefix rule
+    assert findings == []
+
+
 # ------------------------------------------------------------- clean tree
 
 

@@ -1,0 +1,577 @@
+"""Autopilot (persia_tpu/autopilot): the closed-loop fleet controller.
+
+Covers the control loop's whole contract surface: the ``fence_callback``
+stream hook is bit-transparent when it does nothing; the policy guards
+(hysteresis + min-dwell) suppress flaps and the suppressions are counted;
+hot-sign read replication is journaled exactly-once, fans READS out while
+writes stay single-owner, and a topology swap clears the map; every
+actuation is two-phase-journaled so a controller SIGKILLed mid-decision
+resumes its plan exactly-once; and the serving sensors/actuators
+(``request_rate``, ``remove_replica``) behave on a bare gateway.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from persia_tpu import jobstate
+from persia_tpu.autopilot import (
+    Autopilot,
+    Decision,
+    KIND_SCALE,
+    MAX_REPLICATED_SIGNS,
+    PolicyConfig,
+    PolicyEngine,
+    replicate_hot_signs,
+)
+from persia_tpu.config import EmbeddingConfig, SlotConfig
+from persia_tpu.embedding.hashing import (
+    sign_to_range_shard,
+    sign_to_shard,
+    splitmix64,
+    uniform_splits,
+)
+from persia_tpu.embedding.optim import Adagrad
+from persia_tpu.embedding.store import EmbeddingStore
+from persia_tpu.embedding.tiering import AccessProfiler, publish_sketch_metrics
+from persia_tpu.embedding.worker import EmbeddingWorker, ShardedLookup
+from persia_tpu.metrics import get_metrics
+
+VOCABS = (64, 32)
+
+
+def _cfg():
+    return EmbeddingConfig(
+        slots_config={"cat_0": SlotConfig(dim=8), "cat_1": SlotConfig(dim=8)},
+        feature_index_prefix_bit=8,
+    )
+
+
+def _stores(n=2, seed=7):
+    return [
+        EmbeddingStore(capacity=1 << 16, num_internal_shards=4, seed=seed)
+        for _ in range(n)
+    ]
+
+
+def _profiler(**kw):
+    kw.setdefault("width_log2", 10)
+    kw.setdefault("depth", 2)
+    kw.setdefault("bitmap_bits", 1 << 10)
+    kw.setdefault("topk", 8)
+    return AccessProfiler(["cat_0", "cat_1"], **kw)
+
+
+# ------------------------------------------------------ fence_callback hook
+
+
+def _make_cached_ctx(cfg, stores):
+    import optax
+
+    from persia_tpu.embedding import hbm_cache as hbm
+    from persia_tpu.models import DNN
+
+    return hbm.CachedTrainCtx(
+        model=DNN(dense_mlp_size=8, sparse_mlp_size=16, hidden_sizes=(32,)),
+        dense_optimizer=optax.adam(3e-3),
+        embedding_optimizer=Adagrad(lr=0.1),
+        worker=EmbeddingWorker(cfg, stores), embedding_config=cfg,
+        cache_rows=256, init_seed=7,
+    ).__enter__()
+
+
+def _entries(cfg, stores):
+    from persia_tpu.embedding.hashing import add_index_prefix
+
+    out = {}
+    for slot, vocab in zip(("cat_0", "cat_1"), VOCABS):
+        pre = cfg.slot(slot).index_prefix
+        for s in range(vocab):
+            sign = int(add_index_prefix(np.array([s], np.uint64), pre, 8)[0])
+            e = next(
+                (st.get_embedding_entry(sign) for st in stores
+                 if st.get_embedding_entry(sign) is not None), None,
+            )
+            if e is not None:
+                out[(slot, s)] = e
+    return out
+
+
+@pytest.mark.slow
+def test_fence_callback_noop_is_bit_transparent(tmp_path):
+    """A no-op fence_callback must not perturb the stream by a single
+    bit: same batches, same fences, bit-identical PS entries and dense
+    params vs a run with no callback."""
+    import jax
+
+    from persia_tpu.testing import SyntheticClickDataset
+
+    cfg = _cfg()
+    STEPS, K = 12, 4
+    batches = list(
+        SyntheticClickDataset(num_samples=STEPS * 32, vocab_sizes=VOCABS,
+                              seed=9).batches(32)
+    )[:STEPS]
+
+    base_stores = _stores()
+    base = _make_cached_ctx(cfg, base_stores)
+    base.train_stream(batches, snapshot_every=K,
+                      job_state=str(tmp_path / "base"))
+    base.flush()
+
+    seen = []
+    cb_stores = _stores()
+    ctx = _make_cached_ctx(cfg, cb_stores)
+    ctx.train_stream(batches, snapshot_every=K,
+                     job_state=str(tmp_path / "cb"),
+                     fence_callback=seen.append)
+    ctx.flush()
+
+    # every INTERIOR fence, after capture, at its global step (the stream
+    # end is not a fence — a fully drained stream needs no topology window)
+    assert seen == [4, 8]
+    assert ctx.stream_stats()["fences"] == base.stream_stats()["fences"]
+    a, b = _entries(cfg, base_stores), _entries(cfg, cb_stores)
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=str(k))
+    for (kp, x), (_, y) in zip(
+        jax.tree_util.tree_leaves_with_path(base.state.params),
+        jax.tree_util.tree_leaves_with_path(ctx.state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=str(kp))
+
+
+@pytest.mark.slow
+def test_fence_callback_runs_without_job_state(tmp_path):
+    """The callback cadence must not require snapshot manifests: with
+    fence_callback set and job_state omitted the fences still drain and
+    fire the hook (no manifest is committed)."""
+    from persia_tpu.testing import SyntheticClickDataset
+
+    cfg = _cfg()
+    batches = list(
+        SyntheticClickDataset(num_samples=8 * 32, vocab_sizes=VOCABS,
+                              seed=3).batches(32)
+    )[:8]
+    seen = []
+    ctx = _make_cached_ctx(cfg, _stores())
+    ctx.train_stream(batches, snapshot_every=4, fence_callback=seen.append)
+    assert seen == [4]  # 8 steps → one interior fence
+    assert ctx.stream_stats()["fences"] == 1
+
+    # and a callback exception aborts the stream like any fence failure
+    def boom(gstep):
+        raise RuntimeError("controller crashed at the fence")
+
+    ctx2 = _make_cached_ctx(cfg, _stores())
+    with pytest.raises(RuntimeError) as ei:
+        ctx2.train_stream(batches, snapshot_every=4, fence_callback=boom)
+    assert "controller crashed" in str(ei.value.__cause__)
+
+
+# ---------------------------------------------------------- policy guards
+
+
+def test_policy_scale_dwell_suppresses_then_fires():
+    pe = PolicyEngine(PolicyConfig(qps_per_replica=200.0,
+                                   scale_min_dwell=2, scale_max_replicas=8))
+    # a target must hold for min_dwell+1 consecutive rounds
+    assert pe.decide_scale(1000.0, 1) is None
+    assert pe.decide_scale(1000.0, 1) is None
+    d = pe.decide_scale(1000.0, 1)
+    assert d is not None and d.kind == KIND_SCALE
+    assert d.params["target"] == 5 and d.params["from"] == 1
+    assert pe.suppressed == 2  # both held rounds counted as flaps
+
+
+def test_policy_scale_hysteresis_band_holds_borderline():
+    pe = PolicyEngine(PolicyConfig(qps_per_replica=100.0,
+                                   scale_hysteresis=0.25, scale_min_dwell=1))
+    # 2 replicas, qps 210: raw desired is 3, but 210 <= 2*100*1.25 — the
+    # band says the current size still fits; nothing may even start
+    # dwelling, and no flap is recorded
+    for _ in range(5):
+        assert pe.decide_scale(210.0, 2) is None
+    assert pe.suppressed == 0
+    # a flapping sensor that changes its mind every round never fires
+    for _ in range(6):
+        assert pe.decide_scale(900.0, 2) is None
+        assert pe.decide_scale(110.0, 2) is None
+    assert pe.suppressed > 0
+
+
+def test_policy_scale_quarantine_pressure_and_bounds():
+    pe = PolicyEngine(PolicyConfig(qps_per_replica=100.0, scale_min_dwell=0,
+                                   scale_max_replicas=4))
+    # quarantined replicas are drained capacity: target grows by their
+    # count, clamped at the max
+    d = None
+    while d is None:
+        d = pe.decide_scale(250.0, 2, quarantined=2)
+    assert d.params["target"] == 4  # ceil(2.5)=3 +2 quarantined, max 4
+
+
+def test_policy_replicate_set_change_dwell_and_salt_rotation():
+    pe = PolicyEngine(PolicyConfig(hot_fanout=2, hot_max_signs=4,
+                                   hot_mass_frac=0.05, hot_min_dwell=1))
+    prof = _profiler(topk=4)
+    hot = np.array([11, 13], dtype=np.uint64)
+    prof.observe_slot("cat_0", np.repeat(hot, 400))
+    prof.observe_slot("cat_0", np.arange(100, 164, dtype=np.uint64))
+    d1 = pe.decide_replicate(prof)
+    assert d1 is not None and len(d1.params["signs"]) >= 2
+    salt1 = d1.params["salt"]
+    # unchanged set → dwell, no decision
+    assert pe.decide_replicate(prof) is None
+    # the hot set rotates → new set must out-dwell the incumbent first
+    hot2 = np.array([901, 907], dtype=np.uint64)
+    prof.decay(0.01)
+    prof.observe_slot("cat_0", np.repeat(hot2, 2000))
+    before = pe.suppressed
+    first = pe.decide_replicate(prof)
+    if first is None:  # suppressed by dwell — fires on a later round
+        assert pe.suppressed == before + 1
+        first = pe.decide_replicate(prof)
+    assert first is not None and first.params["salt"] == salt1 + 1
+    assert set(first.params["signs"]) >= {901, 907}
+
+
+def test_policy_reshard_only_on_breach_and_planner_guards():
+    pe = PolicyEngine(PolicyConfig(skew_target=1.10, reshard_hysteresis=0.1,
+                                   reshard_min_dwell=0))
+    prof = _profiler()
+    # near-uniform traffic on a (modeled-uniform) modulo fleet: the skew
+    # sits under the target → no decision, round after round
+    prof.observe_slot("cat_0", np.arange(1, 2049, dtype=np.uint64))
+    prof.observe_slot("cat_1", np.arange(3000, 4024, dtype=np.uint64))
+    assert pe.decide_reshard(prof, 4, None) is None
+    assert pe.decide_reshard(prof, 4, uniform_splits(4)) is None
+    # the live ring drifted lopsided (three boundaries crammed at the
+    # ring's start leave shard 3 owning ~the whole ring): breach → the
+    # candidate re-split clears hysteresis and adopts
+    bad = np.array([1 << 20, 2 << 20, 3 << 20], dtype=np.uint64)
+    d = pe.decide_reshard(prof, 4, bad)
+    assert d is not None
+    assert d.params["skew_before"] > 3.0  # one shard held ~everything
+    assert d.params["skew_after"] < 1.5
+    splits = np.asarray(d.params["splits"], dtype=np.uint64)
+    assert splits.shape == (3,)
+    assert (splits[:-1] < splits[1:]).all()
+
+
+def test_policy_reshard_single_dominant_sign_is_not_reshardable():
+    """One sign carrying ~everything is ATOMIC under range sharding — a
+    re-split cannot help, hysteresis must refuse the pointless move (the
+    replication actuator handles this shape instead)."""
+    pe = PolicyEngine(PolicyConfig(skew_target=1.10, reshard_hysteresis=0.1,
+                                   reshard_min_dwell=0))
+    prof = _profiler()
+    prof.observe_slot("cat_0", np.arange(1, 1025, dtype=np.uint64))
+    prof.observe_slot("cat_0",
+                      np.repeat(np.array([424242], np.uint64), 20000))
+    for _ in range(4):  # no oscillation either: every round holds
+        assert pe.decide_reshard(prof, 4, uniform_splits(4)) is None
+    # ...and the same profile IS a replication candidate
+    assert pe.decide_replicate(prof) is not None
+
+
+# ------------------------------------------- hot-sign read replication
+
+
+def _seeded_router(n=3, dim=8):
+    stores = [EmbeddingStore(capacity=1 << 14, num_internal_shards=2,
+                             optimizer=Adagrad(lr=0.1).config, seed=11)
+              for _ in range(n)]
+    router = ShardedLookup(stores)
+    signs = np.arange(1, 257, dtype=np.uint64)
+    router.lookup(signs, dim, train=True)  # materialize owner entries
+    return stores, router, signs
+
+
+def test_replicate_hot_signs_exactly_once_and_read_fanout():
+    stores, router, signs = _seeded_router()
+    n = len(stores)
+    hot = signs[:8]
+    owners = sign_to_shard(hot, n)
+
+    s1 = replicate_hot_signs(router, hot, job_epoch=3, step=4, fanout=2,
+                             salt=1)
+    assert s1["applied"] == len(hot) and s1["deduped"] == 0
+    # a resumed controller re-runs the SAME round: pure dedupe, and the
+    # store state is bit-identical to the uninterrupted run
+    before = {i: stores[i].export_range(0, 0) for i in range(n)}
+    s2 = replicate_hot_signs(router, hot, job_epoch=3, step=4, fanout=2,
+                             salt=1)
+    assert s2["applied"] == 0 and s2["deduped"] == len(hot)
+    for i in range(n):
+        assert stores[i].export_range(0, 0) == before[i]
+
+    # copies are the owners' bytes: every hot sign's entry now also lives
+    # on the next ring neighbour, byte-identical
+    for s, o in zip(hot, owners):
+        h = int(splitmix64(np.array([s], np.uint64))[0])
+        blob = stores[int(o)].export_range(h, (h + 1) & ((1 << 64) - 1))
+        copy = stores[(int(o) + 1) % n].export_range(
+            h, (h + 1) & ((1 << 64) - 1)
+        )
+        assert blob == copy and len(blob) > 4
+
+    # READ routing fans hot signs out; WRITE routing stays owner-only
+    st = router.hot_read_state()
+    assert st is not None and st[1] == 2 and st[2] == 1
+    read_counter = get_metrics().counter("persia_tpu_hot_replica_reads")
+    c0 = read_counter.get()
+    vals = router.lookup(hot, 8, train=False)
+    assert read_counter.get() > c0  # some reads landed on replicas
+    owner_vals = np.stack([
+        stores[int(o)].lookup(np.array([s], np.uint64), 8, False)[0]
+        for s, o in zip(hot, owners)
+    ])
+    np.testing.assert_array_equal(vals, owner_vals)  # copies identical
+    # write partition ignores the hot map: each replica slot gets exactly
+    # its owner-routed signs (positions-or-mask both select rows)
+    for r, sel in router._partition(hot):
+        got = hot[sel] if sel.dtype == bool else hot[sel]
+        np.testing.assert_array_equal(np.sort(got), np.sort(hot[owners == r]))
+
+
+def test_replicate_swap_topology_clears_map_and_caps():
+    stores, router, signs = _seeded_router()
+    replicate_hot_signs(router, signs[:4], job_epoch=1, step=1, fanout=2)
+    assert router.hot_read_state() is not None
+    # a reshard swaps routing: copies were placed relative to the OLD
+    # owner layout, so the map must clear wholesale
+    router.swap_topology(stores, ring=uniform_splits(len(stores)))
+    assert router.hot_read_state() is None
+    # empty set clears; over-cap raises (journal op-index is 7 bits)
+    replicate_hot_signs(router, [], job_epoch=1, step=2, fanout=2)
+    assert router.hot_read_state() is None
+    with pytest.raises(ValueError):
+        replicate_hot_signs(
+            router, np.arange(1, MAX_REPLICATED_SIGNS + 2, dtype=np.uint64),
+            job_epoch=1, step=3, fanout=2,
+        )
+
+
+def test_replication_journal_ids_disjoint_from_handoff():
+    """The replication namespace (step bit 31) can never collide with a
+    reshard handoff journaled at the same fence step."""
+    ids = set()
+    for step in (0, 4, 100, 2**31 - 1):
+        for op in (0, 1, 126):
+            h = jobstate.handoff_journal_id(
+                jobstate.make_journal_id(7, step), op
+            )
+            r = jobstate.replication_journal_id(7, step, op)
+            assert h != r
+            ids.add(h), ids.add(r)
+    assert len(ids) == 24  # all distinct across steps and ops
+
+
+# ------------------------------------------------- two-phase SIGKILL resume
+
+
+class _FlakyActuator:
+    """Scale actuator that dies on the first call (the SIGKILL stand-in:
+    the planned manifest is committed, the actuation never finishes)."""
+
+    def __init__(self, die_first=True):
+        self.calls = []
+        self.die = die_first
+
+    def __call__(self, target):
+        if self.die:
+            self.die = False
+            raise RuntimeError("SIGKILL mid-actuation")
+        self.calls.append(int(target))
+        return int(target)
+
+
+def _hot_sensors(qps=1000.0, replicas=1):
+    return lambda: {"qps": qps, "replicas": replicas, "quarantined": 0}
+
+
+def test_two_phase_decision_resumes_exactly_once(tmp_path):
+    state = str(tmp_path / "ap")
+    cfgp = PolicyConfig(qps_per_replica=200.0, scale_min_dwell=0,
+                        scale_max_replicas=8)
+    act = _FlakyActuator()
+    pilot = Autopilot(state, policy=PolicyEngine(cfgp), scale_to=act,
+                      serving_sensors=_hot_sensors())
+    # dwell=0 still needs one held round to start the target's clock
+    assert pilot.on_tick(1) == {}
+    with pytest.raises(RuntimeError, match="SIGKILL"):
+        pilot.on_tick(2)  # planned manifest lands, actuation dies
+    assert act.calls == []
+    assert pilot.pending() is not None
+    assert pilot.pending()["decision"]["params"]["target"] == 5
+
+    # a FRESH controller over the same root re-drives the plan once
+    act2 = _FlakyActuator(die_first=False)
+    pilot2 = Autopilot(state, policy=PolicyEngine(cfgp), scale_to=act2,
+                       serving_sensors=_hot_sensors())
+    res = pilot2.resume()
+    assert res == {"achieved": 5} and act2.calls == [5]
+    assert pilot2.pending() is None  # done committed
+    assert pilot2.resume() is None and act2.calls == [5]  # exactly once
+
+    # policy soft state rode the manifest: the restored engine remembers
+    # its suppression history
+    assert pilot2.policy.suppressed >= 1
+
+
+def test_two_phase_done_manifest_records_result(tmp_path):
+    state = str(tmp_path / "ap")
+    act = _FlakyActuator(die_first=False)
+    pilot = Autopilot(state, policy=PolicyEngine(
+        PolicyConfig(qps_per_replica=100.0, scale_min_dwell=0)),
+        scale_to=act, serving_sensors=_hot_sensors(qps=350.0, replicas=1))
+    assert pilot.on_tick(1) == {}
+    out = pilot.on_tick(2)
+    assert out == {KIND_SCALE: {"achieved": 4}}
+    man = pilot.mgr.latest()
+    meta = man.meta["autopilot"]
+    assert meta["phase"] == "done"
+    assert meta["result"] == {"achieved": 4}
+    assert Decision.from_meta(meta["decision"]).kind == KIND_SCALE
+    # decision.json component rides the epoch for offline forensics
+    assert man.read_json("decision.json")["kind"] == KIND_SCALE
+
+
+def test_resume_reshard_prefers_engine_manifest(tmp_path):
+    """A reshard killed after the elastic engine's first phase commit must
+    resume through resume_reshard, not re-plan."""
+    calls = {"resumed": 0, "replanned": 0}
+
+    def resume_reshard():
+        calls["resumed"] += 1
+        return {"resumed": True}
+
+    def reshard(n, splits, step):
+        calls["replanned"] += 1
+        return {"fresh": True}
+
+    state = str(tmp_path / "ap")
+    pilot = Autopilot(state, policy=PolicyEngine(), reshard=reshard,
+                      resume_reshard=resume_reshard)
+    d = Decision("reshard", "test", {"n_shards": 4, "splits": [1, 2, 3]})
+    pilot._commit("planned", d, step=8)
+    assert pilot.resume() == {"resumed": True}
+    assert calls == {"resumed": 1, "replanned": 0}
+
+    # killed BEFORE the engine's first commit: resume_reshard finds
+    # nothing and the recorded plan re-runs verbatim
+    pilot._commit("planned", d, step=12)
+    pilot._resume_reshard = lambda: None
+    assert pilot.resume() == {"fresh": True}
+    assert calls["replanned"] == 1
+
+
+# -------------------------------------------------- serving plane sensors
+
+
+def test_gateway_remove_replica_and_request_rate():
+    from persia_tpu.serving.gateway import ReplicaGateway
+
+    gw = ReplicaGateway(replicas=["127.0.0.1:1"])
+    assert gw.request_rate() == 0.0  # first call: no window yet
+    gw.add_replica("127.0.0.1:2")
+    assert sorted(gw.stats()["replicas"]) == ["127.0.0.1:1", "127.0.0.1:2"]
+    assert gw.remove_replica("127.0.0.1:2") is True
+    assert gw.remove_replica("127.0.0.1:2") is False  # not a member now
+    assert gw.stats()["replicas"] == ["127.0.0.1:1"]
+    # rate = counter delta over the wall-clock window (which must be
+    # wider than the <1ms degenerate-window guard)
+    gw._m_requests.inc(50)
+    time.sleep(0.005)
+    assert gw.request_rate() > 0.0
+    gw._pool.shutdown(wait=False)
+
+
+def test_gateway_sensors_closure():
+    from persia_tpu.autopilot import gateway_sensors
+    from persia_tpu.serving.gateway import ReplicaGateway
+
+    gw = ReplicaGateway(replicas=["127.0.0.1:1", "127.0.0.1:2"])
+    s = gateway_sensors(gw)()
+    assert s["replicas"] == 2 and s["quarantined"] == 0
+    assert "qps" in s and "live" in s
+    gw._pool.shutdown(wait=False)
+
+
+# --------------------------------------------------- sketch metrics export
+
+
+def test_publish_sketch_metrics_series_render():
+    prof = _profiler()
+    prof.observe_slot("cat_0", np.repeat(
+        np.array([5, 9], dtype=np.uint64), 500))
+    prof.observe_slot("cat_1", np.arange(1, 129, dtype=np.uint64))
+    out = publish_sketch_metrics(prof, splits=uniform_splits(4))
+    assert out["skew"] > 1.0 and out["total_mass"] > 0
+    text = get_metrics().render()
+    for series in ("persia_tpu_ps_shard_load{",
+                   "persia_tpu_ps_shard_load_skew",
+                   "persia_tpu_sketch_heavy_hitter_mass{",
+                   "persia_tpu_sketch_working_set{"):
+        assert series in text, series
+    # n=1 ring (no splits): one shard, skew exactly 1
+    assert publish_sketch_metrics(prof, splits=None)["skew"] == \
+        pytest.approx(1.0)
+
+
+# ----------------------------------------------------- load-shape schedule
+
+
+def test_load_schedule_deterministic_and_shapes():
+    from persia_tpu.chaos import LoadSchedule, parse_load_spec
+
+    cfg = parse_load_spec(
+        "a0=1.1,a1=1.9,ramp=10:50,qps=100,spike=4x20:30,rotate=16,"
+        "stride=997,seed=5,vocab=4096"
+    )
+    ls = LoadSchedule(cfg)
+    # exponent ramps linearly inside the window, clamps outside
+    assert ls.zipf_a(0) == pytest.approx(1.1)
+    assert ls.zipf_a(30) == pytest.approx(1.5)
+    assert ls.zipf_a(99) == pytest.approx(1.9)
+    # spike multiplies qps only inside [start, end)
+    assert ls.qps(19) == 100.0 and ls.qps(20) == 400.0
+    assert ls.qps(29) == 400.0 and ls.qps(30) == 100.0
+    # per-(step, slot) determinism — replay yields the same batch
+    a = ls.signs(7, 512, slot=1)
+    b = ls.signs(7, 512, slot=1)
+    np.testing.assert_array_equal(a, b)
+    assert a.dtype == np.uint64 and (a > 0).all()
+    assert not np.array_equal(a, ls.signs(8, 512, slot=1))
+    # hot-set rotation moves the head's identity, not the shape
+    r0 = ls.signs(0, 4096, slot=0)
+    r1 = ls.signs(16, 4096, slot=0)
+    assert ls.rotation(0) == 0 and ls.rotation(16) == 1
+    top0 = np.bincount((r0 - 1).astype(np.int64)).argmax()
+    top1 = np.bincount((r1 - 1).astype(np.int64)).argmax()
+    assert top0 != top1  # yesterday's heavy hitter went cold
+
+
+def test_load_spec_defaults_and_rejects_unknown():
+    from persia_tpu.chaos import LoadShapeConfig, parse_load_spec
+
+    assert parse_load_spec("") == LoadShapeConfig()
+    with pytest.raises(ValueError, match="unknown load knob"):
+        parse_load_spec("bogus=1")
+
+
+# ----------------------------------------------------------- launcher knob
+
+
+def test_autopilot_env_knob(monkeypatch):
+    from persia_tpu.autopilot import AUTOPILOT_ENV, autopilot_enabled
+
+    monkeypatch.delenv(AUTOPILOT_ENV, raising=False)
+    assert not autopilot_enabled()
+    monkeypatch.setenv(AUTOPILOT_ENV, "1")
+    assert autopilot_enabled()

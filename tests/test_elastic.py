@@ -194,6 +194,52 @@ def test_shard_planner_degenerate_inputs():
         ShardPlanner().plan(0)
 
 
+def test_shard_planner_single_shard_fleet_never_moves():
+    # n=1: there is nothing to re-split — every round must be the same
+    # empty no-move ring, with zero oscillation however skewed the mass
+    planner = ShardPlanner()
+    pos = np.array([3 * Q], dtype=np.uint64)
+    for _ in range(5):
+        plan = planner.plan(1, pos=pos, w=np.array([100.0]), residual=0.0)
+        assert plan.splits.size == 0
+        assert plan.skew == pytest.approx(1.0)
+    assert planner.suppressed == 0
+
+
+def test_shard_planner_cold_start_empty_sketch_is_stable():
+    # an empty profiler (cold start, nothing observed yet) must yield the
+    # hash-uniform ring once and then hold it — no churn before data
+    from persia_tpu.embedding.tiering import AccessProfiler
+
+    prof = AccessProfiler(["cat_0", "cat_1"], width_log2=10, depth=2,
+                          bitmap_bits=1 << 10, topk=4)
+    planner = ShardPlanner()
+    first = planner.plan(4, profiler=prof)
+    assert (first.splits == uniform_splits(4)).all()
+    for _ in range(4):
+        nxt = planner.plan(4, profiler=prof)
+        assert not nxt.adopted  # identical skew never re-adopts
+        assert (nxt.splits == first.splits).all()
+    assert planner.suppressed == 0
+
+
+def test_shard_planner_all_load_on_one_sign_converges_not_oscillates():
+    # the whole load on ONE sign: a split cannot help (the point mass is
+    # atomic), so after the first adoption every further round is a
+    # no-move — the pathological input must converge, not flap
+    planner = ShardPlanner(hysteresis=0.1, min_dwell=2)
+    pos = np.array([5 * Q // 2], dtype=np.uint64)
+    w = np.array([42.0])
+    plans = [planner.plan(4, pos=pos, w=w, residual=0.0) for _ in range(6)]
+    adopted = [p.adopted for p in plans]
+    assert adopted[0] and not any(adopted[1:])
+    for p in plans[1:]:
+        assert (p.splits == plans[0].splits).all()
+    # one shard necessarily carries everything — skew is the n=4 ceiling
+    assert plans[0].skew == pytest.approx(4.0)
+    assert planner.suppressed == 0  # stability, not suppression, holds it
+
+
 # ------------------------------------------------------- router topology
 
 
