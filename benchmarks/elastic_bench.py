@@ -57,8 +57,11 @@ def empirical_skew(splits, streams) -> tuple:
 def main() -> int:
     from persia_tpu import elastic, jobstate
     from persia_tpu.embedding.hashing import uniform_splits
+    from persia_tpu.embedding.native_store import (
+        create_store,
+        store_backend_name,
+    )
     from persia_tpu.embedding.optim import Adagrad
-    from persia_tpu.embedding.store import EmbeddingStore
     from persia_tpu.embedding.tiering.profiler import AccessProfiler
     from persia_tpu.embedding.tiering.shard_planner import ShardPlanner
 
@@ -88,13 +91,16 @@ def main() -> int:
     # the stream's working set, landing on the sketch-driven ring
     opt = Adagrad(lr=0.05).config
     working_set = np.unique(np.concatenate(heldout))
-    srcs = [EmbeddingStore(capacity=1 << 20, num_internal_shards=4,
-                           optimizer=opt, seed=SEED) for _ in range(2)]
+    # fleet-default backend: auto resolves to the native C++ store, so the
+    # handoff wire measured below is the native ps_export_range path
+    backend = os.environ.get("PERSIA_STORE_BACKEND", "auto")
+    srcs = [create_store(backend, capacity=1 << 20, num_internal_shards=4,
+                         optimizer=opt, seed=SEED) for _ in range(2)]
     for r, st in enumerate(srcs):
         st.lookup(working_set[working_set % 2 == r], DIM, True)
     dests = list(srcs) + [
-        EmbeddingStore(capacity=1 << 20, num_internal_shards=4,
-                       optimizer=opt, seed=SEED)
+        create_store(backend, capacity=1 << 20, num_internal_shards=4,
+                     optimizer=opt, seed=SEED)
         for _ in range(N_SHARDS - 2)
     ]
     rplan = elastic.plan_reshard(
@@ -108,6 +114,15 @@ def main() -> int:
         rplan, srcs, dests, tempfile.mkdtemp(prefix="elastic_bench_js_")
     )
     reshard_s = time.time() - t0
+
+    # direct store ns/lookup on the post-reshard fleet (warm rows): the
+    # native-vs-numpy delta committed alongside the backend name
+    probe_signs = working_set[: min(4096, len(working_set))]
+    dests[0].lookup(probe_signs, DIM, False)
+    t0 = time.perf_counter_ns()
+    for _ in range(10):
+        dests[0].lookup(probe_signs, DIM, False)
+    store_ns = (time.perf_counter_ns() - t0) / (10 * max(len(probe_signs), 1))
 
     rec = {
         "bench": "elastic",
@@ -125,11 +140,15 @@ def main() -> int:
         "observe_s": round(observe_s, 3),
         "reshard": {
             "old_n": 2, "new_n": N_SHARDS,
+            "store_backend": store_backend_name(srcs[0]),
+            "store_ns_per_lookup": round(store_ns, 1),
             "entries": int(len(working_set)),
             "moves": len(rplan.moves),
             "imports_applied": stats["imports_applied"],
             "deletes_applied": stats["deletes_applied"],
             "moved_bytes": stats["moved_bytes"],
+            "moved_bytes_per_s": round(stats["moved_bytes"]
+                                       / max(reshard_s, 1e-9)),
             "entries_removed": stats["entries_removed"],
             "wall_s": round(reshard_s, 3),
         },

@@ -128,7 +128,7 @@ def run_path(fused: bool):
     return out
 
 
-def run_tier_path(shards, threads):
+def run_tier_path(shards, threads, probe=None):
     """Tiering-ON feeder cost: admit walk + sketch observe per step.
 
     ``shards=None`` — unsharded directory + classic single-sketch
@@ -138,6 +138,10 @@ def run_tier_path(shards, threads):
     matched to it (one sub-sketch per shard, routed by the group salt),
     observe fused into the admit walk across ``threads`` walkers; the
     per-shard walk times surface as ``feed.shard`` spans.
+    ``probe`` (round 17) — 0 pins the scalar slot walk, 1 the SIMD tag
+    probe + wave passes, None keeps the library default; applied to every
+    group directory after construction (bit-identical either way, so the
+    A/B swaps ONLY the probe layout).
     """
     from persia_tpu import tracing
     from persia_tpu.embedding.hbm_cache.directory import PendingSignMap
@@ -158,6 +162,9 @@ def run_tier_path(shards, threads):
             else:
                 os.environ[k] = v
     tier = ctx.tier
+    if probe is not None:
+        for d in tier.dirs.values():
+            d.set_probe_mode(probe)
     # slot_order follows the tier's group order so each group's slots map
     # to a CONTIGUOUS profiler index run — the fuse gate's precondition
     tier.profiler = AccessProfiler(
@@ -218,6 +225,13 @@ def run_tier_path(shards, threads):
         out["shard_busy_ms_per_step"] = [
             round(v / STEPS / 1e6, 3) for v in shard_busy.tolist()
         ]
+        # native-measured admit-walk cost per position: the number the
+        # round-17 probe A/B compares (prep_ms also carries python-side
+        # staging, which the probe layout does not touch)
+        out["walk_ns_per_sign"] = round(
+            float(shard_busy.sum())
+            / STEPS / (bench.BATCH_SIZE * bench.N_SLOTS), 2,
+        )
     for name in sorted(agg):
         cnt, ms = agg[name]
         out[name] = {
@@ -258,6 +272,34 @@ def main():
         "t4_vs_t1": round(
             sweep["t1"]["prep_ms_per_step"] / sweep["t4"]["prep_ms_per_step"],
             3,
+        ),
+    }
+    # round 17: scalar vs SIMD probe layout at t=1 (same stream, same
+    # directories, only the probe walk differs — outputs bit-identical).
+    # The two paths run INTERLEAVED over several rounds and the headline
+    # is the MEDIAN of each side: single-pass A/Bs on this 1-core host
+    # swing +-20% with scheduler luck, and interleaving keeps a slow
+    # machine moment from landing entirely on one side.
+    rounds = int(os.environ.get("PROFILE_PROBE_ROUNDS", "3"))
+    scalar_rs, simd_rs = [], []
+    for _ in range(rounds):
+        scalar_rs.append(run_tier_path(shards=shards, threads=1, probe=0))
+        simd_rs.append(run_tier_path(shards=shards, threads=1, probe=1))
+    scalar_rs.sort(key=lambda r: r["walk_ns_per_sign"])
+    simd_rs.sort(key=lambda r: r["walk_ns_per_sign"])
+    scalar = scalar_rs[len(scalar_rs) // 2]
+    simd = simd_rs[len(simd_rs) // 2]
+    summary["probe17"] = {
+        "rounds": rounds,
+        "scalar_t1": scalar,
+        "simd_t1": simd,
+        "scalar_walk_ns_rounds": [r["walk_ns_per_sign"] for r in scalar_rs],
+        "simd_walk_ns_rounds": [r["walk_ns_per_sign"] for r in simd_rs],
+        "admit_walk_speedup": round(
+            scalar["walk_ns_per_sign"] / simd["walk_ns_per_sign"], 3
+        ),
+        "prep_speedup": round(
+            scalar["prep_ms_per_step"] / simd["prep_ms_per_step"], 3
         ),
     }
     print(json.dumps(summary, indent=1))

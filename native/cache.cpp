@@ -24,12 +24,19 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstdlib>
+#include <cstring>
 #include <functional>
 #include <memory>
 #include <mutex>
 #include <new>
 #include <thread>
 #include <vector>
+
+#if defined(__linux__)
+#include <pthread.h>
+#include <sched.h>
+#include <unistd.h>
+#endif
 
 namespace {
 
@@ -38,6 +45,30 @@ inline uint64_t splitmix64(uint64_t x) {
   x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
   x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
   return x ^ (x >> 31);
+}
+
+// SWAR helpers for the 8-at-a-time tag probe: broadcast one byte across a
+// u64 lane group, and mark (with 0x80 in that byte) every zero byte of v.
+// The haszero trick only borrows INTO a byte when that byte is zero, so the
+// markers are exact for our operands (tags are never 0x01..0x7F: a live tag
+// always has its 0x80 occupancy bit set, an empty tag is 0x00).
+inline uint64_t swar_bcast8(uint8_t b) {
+  return (uint64_t)b * 0x0101010101010101ULL;
+}
+inline uint64_t swar_zero_bytes(uint64_t v) {
+  return (v - 0x0101010101010101ULL) & ~v & 0x8080808080808080ULL;
+}
+
+// PERSIA_FEED_PROBE=scalar forces the legacy one-slot-at-a-time probe
+// (golden reference); anything else (default) selects the SIMD tag-array
+// walk. Read once per process — per-handle overrides ride the
+// cache_set_probe_mode exports.
+inline int default_probe_mode() {
+  static const int mode = [] {
+    const char* e = std::getenv("PERSIA_FEED_PROBE");
+    return (e != nullptr && std::strcmp(e, "scalar") == 0) ? 0 : 1;
+  }();
+  return mode;
 }
 
 struct Cache {
@@ -57,6 +88,22 @@ struct Cache {
   struct Slot { uint64_t sign; int64_t row; };  // row -1 = empty
   std::vector<Slot> table;
   uint64_t mask = 0;
+  // SIMD probe layout (round 17): a 1-byte tag per table slot, kept in a
+  // separate dense array so one cache-line fetch covers 64 probe positions
+  // instead of 4. tag = 0x80 | top-7-bits of splitmix64(sign) (the home
+  // slot uses the LOW bits, so tag and placement are independent); 0x00 =
+  // empty. The probe loads 8 tags as one u64 and resolves match/empty
+  // lanes with SWAR compares; only tag-matching lanes touch the 16-byte
+  // payload table. Tags are maintained on EVERY mutation regardless of
+  // probe_mode, so the mode can flip at any time and both probes always
+  // see a coherent layout. The 8 bytes past the end mirror tags[0..8) so
+  // a group load starting near the top wraps without a branch.
+  std::vector<uint8_t> tags;
+  // 0 = scalar probe (golden reference), 1 = SIMD tag walk + probe-wave
+  // passes in the sharded feeder. Same results bit-for-bit by
+  // construction: linear probing's result depends only on slot contents,
+  // never on how many slots a step inspects at once.
+  int probe_mode = default_probe_mode();
   // touch-gated admission (the reference's admit_probability analogue,
   // persia-embedding-config HyperParameters): a sign is only ADMITTED on
   // its admit_touches'th distinct-batch touch; earlier touches map to the
@@ -77,6 +124,7 @@ struct Cache {
     uint64_t tsize = 16;
     while (tsize < (uint64_t)cap * 2) tsize <<= 1;
     table.assign(tsize, Slot{0, -1});
+    tags.assign(tsize + 8, 0);  // +8: wraparound mirror of tags[0..8)
     mask = tsize - 1;
   }
 
@@ -89,10 +137,14 @@ struct Cache {
     }
   }
 
+  inline uint64_t touch_idx(uint64_t sign) const {
+    return splitmix64(sign ^ 0x5851F42D4C957F2DULL) & touch_mask;
+  }
+
   // true -> admit now; false -> bypass this batch (counter bumped)
   inline bool touch_admits(uint64_t sign) {
     if (admit_touches <= 1) return true;
-    uint8_t& c = touch_counts[(splitmix64(sign ^ 0x5851F42D4C957F2DULL)) & touch_mask];
+    uint8_t& c = touch_counts[touch_idx(sign)];
     if (c + 1 >= admit_touches) { c = 0; return true; }
     ++c;
     return false;
@@ -100,13 +152,69 @@ struct Cache {
 
   inline uint64_t home(uint64_t sign) const { return splitmix64(sign) & mask; }
 
-  int64_t find_pos(uint64_t sign) const {
+  static inline uint8_t tag_of_hash(uint64_t h) {
+    return (uint8_t)(0x80u | (uint32_t)(h >> 57));
+  }
+
+  // every tag write goes through here so the wrap mirror stays coherent
+  inline void tag_set(uint64_t i, uint8_t v) {
+    tags[i] = v;
+    if (i < 8) tags[mask + 1 + i] = v;
+  }
+
+  int64_t find_pos_scalar(uint64_t sign) const {
     uint64_t i = home(sign);
     while (table[i].row >= 0) {
       if (table[i].sign == sign) return (int64_t)i;
       i = (i + 1) & mask;
     }
     return -1;
+  }
+
+  // SIMD tag walk with a precomputed sign hash: scan 8 tags per u64 load,
+  // resolve candidate lanes in probe order, stop at the first empty lane.
+  // Returns exactly what find_pos_scalar returns: linear probing's answer
+  // ("the slot holding `sign` before the first empty slot from home") is a
+  // property of the table contents alone, so inspecting 8 slots at a time
+  // cannot change it — the lane mask discards candidates past the first
+  // empty lane, and a tag hit (7-bit, ~1/128 false-positive rate) is
+  // confirmed against the payload sign before it counts.
+  int64_t find_pos_simd_h(uint64_t sign, uint64_t h) const {
+    // home fast path: at the table's <=50% load factor most chains are one
+    // slot long, and the home payload line is already prefetched by the
+    // probe-wave stage — answer chain-length-1 probes with the SAME single
+    // load the scalar walk pays, without touching the tag array's line
+    const uint64_t home_p = h & mask;
+    const Slot& s0 = table[home_p];
+    if (s0.row < 0) return -1;
+    if (s0.sign == sign) return (int64_t)home_p;
+    const uint64_t target = swar_bcast8(tag_of_hash(h));
+    uint64_t i = (home_p + 1) & mask;
+    for (uint64_t probed = 0; probed <= mask; probed += 8) {
+      uint64_t g;
+      std::memcpy(&g, &tags[i], 8);  // mirror bytes make the top wrap safe
+      uint64_t match = swar_zero_bytes(g ^ target);
+      const uint64_t empty = swar_zero_bytes(g);
+      if (empty) {
+        // lanes at or past the first empty slot are beyond the probe
+        // chain's end — a match there belongs to some other home's chain
+        const int first_empty_lane = __builtin_ctzll(empty) >> 3;
+        match &= ((uint64_t)1 << (8 * first_empty_lane)) - 1;
+      }
+      while (match) {
+        const uint64_t p = (i + (uint64_t)(__builtin_ctzll(match) >> 3)) & mask;
+        if (table[p].sign == sign) return (int64_t)p;
+        match &= match - 1;  // clear this lane's 0x80 marker
+      }
+      if (empty) return -1;
+      i = (i + 8) & mask;
+    }
+    return -1;
+  }
+
+  int64_t find_pos(uint64_t sign) const {
+    return probe_mode ? find_pos_simd_h(sign, splitmix64(sign))
+                      : find_pos_scalar(sign);
   }
 
   void lru_unlink(int64_t r) {
@@ -133,6 +241,7 @@ struct Cache {
     uint64_t j = i;
     for (;;) {
       table[i].row = -1;
+      tag_set(i, 0);
       uint64_t k;
       for (;;) {
         j = (j + 1) & mask;
@@ -142,6 +251,7 @@ struct Cache {
         if (!home_in_range) break;
       }
       table[i] = table[j];
+      tag_set(i, tags[j]);
       i = j;
     }
   }
@@ -161,12 +271,25 @@ struct Cache {
     const int64_t r = free_rows.back();
     free_rows.pop_back();
     row_sign[r] = sign;
-    uint64_t i = home(sign);
+    const uint64_t h = splitmix64(sign);
+    uint64_t i = h & mask;
     while (table[i].row >= 0) i = (i + 1) & mask;
     table[i] = Slot{sign, r};
+    tag_set(i, tag_of_hash(h));
     lru_push_front(r);
     ++count;
     return r;
+  }
+
+  // full reset (the drain paths): empty table + tags + LRU + free list
+  void reset_directory() {
+    std::fill(table.begin(), table.end(), Slot{0, -1});
+    std::fill(tags.begin(), tags.end(), 0);
+    std::fill(lru.begin(), lru.end(), Link{-1, -1});
+    lru_head = lru_tail = -1;
+    count = 0;
+    free_rows.clear();
+    for (int64_t r = capacity - 1; r >= 0; --r) free_rows.push_back(r);
   }
 
   // batch-local scratch for cache_admit_positions (reused across calls):
@@ -226,7 +349,11 @@ int64_t cache_admit(void* h, const uint64_t* signs, int64_t n,
   int64_t n_miss = 0, n_evict = 0;
   const int64_t PF = 16;  // software prefetch distance (latency-bound probes)
   for (int64_t i = 0; i < n; ++i) {
-    if (i + PF < n) __builtin_prefetch(&c.table[c.home(signs[i + PF])]);
+    if (i + PF < n) {
+      const uint64_t hp = c.home(signs[i + PF]);
+      __builtin_prefetch(&c.tags[hp]);
+      __builtin_prefetch(&c.table[hp]);
+    }
     const int64_t pos = c.find_pos(signs[i]);
     if (pos >= 0) {
       const int64_t r = c.table[pos].row;
@@ -291,9 +418,10 @@ int64_t cache_admit_positions(void* h, const uint64_t* signs, int64_t n,
   // outstanding misses and is the main single-core speedup here
   for (int64_t i = 0; i < n; ++i) {
     if (i + PF < n) {
-      const uint64_t sp = signs[i + PF];
-      __builtin_prefetch(&c.scratch[c.scratch_mask & splitmix64(sp)]);
-      __builtin_prefetch(&c.table[c.home(sp)]);
+      const uint64_t hp = splitmix64(signs[i + PF]);
+      __builtin_prefetch(&c.scratch[c.scratch_mask & hp]);
+      __builtin_prefetch(&c.tags[hp & c.mask]);
+      __builtin_prefetch(&c.table[hp & c.mask]);
     }
     const uint64_t s = signs[i];
     uint64_t j = c.scratch_mask & splitmix64(s);
@@ -353,7 +481,11 @@ int64_t cache_admit_positions(void* h, const uint64_t* signs, int64_t n,
 void cache_probe(void* h, const uint64_t* signs, int64_t n, int64_t* rows_out) {
   Cache& c = *static_cast<Cache*>(h);
   for (int64_t i = 0; i < n; ++i) {
-    if (i + 16 < n) __builtin_prefetch(&c.table[c.home(signs[i + 16])]);
+    if (i + 16 < n) {
+      const uint64_t hp = c.home(signs[i + 16]);
+      __builtin_prefetch(&c.tags[hp]);
+      __builtin_prefetch(&c.table[hp]);
+    }
     const int64_t pos = c.find_pos(signs[i]);
     rows_out[i] = pos >= 0 ? c.table[pos].row : -1;
   }
@@ -370,6 +502,18 @@ void cache_set_admit_touches(void* h, int64_t t) {
   // "admit on the 255th touch" instead of wrapping and never admitting
   c.admit_touches = t < 1 ? 1 : (t > 255 ? 255 : t);
   if (c.admit_touches > 1) c.ensure_touch_table();
+}
+
+// Probe implementation switch: 0 = scalar (golden reference), nonzero =
+// SIMD tag walk. Tags are maintained under both modes, so switching is
+// always safe and results are bit-identical either way (the golden parity
+// suite in tests/test_probe_layout.py is the enforcement).
+void cache_set_probe_mode(void* h, int64_t mode) {
+  static_cast<Cache*>(h)->probe_mode = mode ? 1 : 0;
+}
+
+int64_t cache_probe_mode(void* h) {
+  return static_cast<Cache*>(h)->probe_mode;
 }
 
 // Non-destructive listing of every resident (sign, row) pair in LRU order
@@ -397,13 +541,7 @@ int64_t cache_drain(void* h, uint64_t* signs_out, int64_t* rows_out) {
     rows_out[k] = r;
     ++k;
   }
-  // reset
-  std::fill(c.table.begin(), c.table.end(), Cache::Slot{0, -1});
-  std::fill(c.lru.begin(), c.lru.end(), Cache::Link{-1, -1});
-  c.lru_head = c.lru_tail = -1;
-  c.count = 0;
-  c.free_rows.clear();
-  for (int64_t r = c.capacity - 1; r >= 0; --r) c.free_rows.push_back(r);
+  c.reset_directory();
   return k;
 }
 
@@ -1136,6 +1274,11 @@ struct FeedShard {
   // by whichever pool thread ran this shard; atomic so the stats thread
   // can read mid-feed
   std::atomic<int64_t> busy_ns{0};
+  // last feed's scheduling wait: dispatch-to-walk-start ns summed over
+  // both phases. busy says how long the shard's walk ran; stall says how
+  // long the walk sat in the pool queue first — together they separate
+  // "shard imbalance" from "not enough cores" on the gauge surface.
+  std::atomic<int64_t> stall_ns{0};
   // fused observe scratch: occurrence counts + slot ids PARALLEL to the
   // admit scratch (indexed by the same bucket). The admit walk already
   // dedups the batch by sign, so when signs are slot-prefixed
@@ -1149,6 +1292,22 @@ struct FeedShard {
   std::vector<uint32_t> obs_count;  // sized like Cache::scratch
   std::vector<uint32_t> obs_slot;   // UINT32_MAX = unattributed (skip)
   std::vector<uint32_t> obs_order;  // scratch indices, first-seen order
+  // probe-wave compact observe stream (round 17): the wave detect already
+  // knows each first-seen sign at the moment it enqueues the probe, so in
+  // probe mode the (sign, slot, count) triples land in these first-seen-
+  // order SoA vectors instead of being scattered across the scratch-sized
+  // tables above — shard_observe_apply then STREAMS them linearly (zero
+  // random reads) rather than chasing a random scratch + obs_slot line
+  // per distinct sign. In this mode obs_count[j] holds the compact
+  // ORDINAL (index into obs_cnt_c) so the duplicate bump stays one
+  // already-prefetched random write plus one L1-resident increment, and
+  // obs_slot/obs_order are not written at all. The scalar walk leaves
+  // these empty (obs_reserve clears them), which is how
+  // shard_observe_apply picks its path; the sketch sees the SAME
+  // (slot, sign, weight) sequence either way — state stays bit-identical.
+  std::vector<uint64_t> obs_sign_c;
+  std::vector<uint32_t> obs_slot_c;
+  std::vector<uint32_t> obs_cnt_c;
 
   explicit FeedShard(int64_t cap) : dir(cap) {}
 
@@ -1159,6 +1318,9 @@ struct FeedShard {
     }
     obs_order.clear();
     obs_order.reserve((size_t)n);
+    obs_sign_c.clear();
+    obs_slot_c.clear();
+    obs_cnt_c.clear();
   }
 };
 
@@ -1189,6 +1351,12 @@ struct ShardedCache {
   int64_t items_done = 0;
   bool stopping = false;
   int64_t n_threads = 1;
+  // walker pinning policy (PERSIA_FEED_AFFINITY): 0 = none, 1 = compact
+  // (worker i -> cpu i % ncpu, packs walkers onto one socket for shared
+  // LLC), 2 = spread (workers striped across the cpu range, one walker
+  // per NUMA node's worth of cores). Guarded by pool_mu; changing it
+  // respawns the workers so the pin applies from thread start.
+  int64_t affinity_mode = 0;
   std::vector<std::thread> workers;
 
   ShardedCache(int64_t cap, int64_t n, uint64_t salt, int64_t threads)
@@ -1223,7 +1391,58 @@ struct ShardedCache {
       n_threads = t;
     }
     for (int64_t i = 0; i < t - 1; ++i)
-      workers.emplace_back([this] { worker_loop(); });
+      workers.emplace_back([this, i] { worker_loop(i); });
+  }
+
+  void set_affinity(int64_t mode) {
+    if (mode < 0 || mode > 2) mode = 0;
+    int64_t t;
+    {
+      std::lock_guard<std::mutex> lk(pool_mu);
+      if (mode == affinity_mode) return;
+      affinity_mode = mode;
+      t = n_threads;
+      if (workers.empty()) return;  // pin applies when workers next spawn
+      stopping = true;
+    }
+    // respawn so every worker re-reads the policy at thread start
+    cv_work.notify_all();
+    for (auto& w : workers) w.join();
+    workers.clear();
+    {
+      std::lock_guard<std::mutex> lk(pool_mu);
+      stopping = false;
+    }
+    for (int64_t i = 0; i < t - 1; ++i)
+      workers.emplace_back([this, i] { worker_loop(i); });
+  }
+
+  // Best-effort CPU pin for pool worker widx, applied once at thread
+  // start. The calling thread (which also walks shards) is never pinned —
+  // the embedding tier owns its placement. No-op off Linux or when the
+  // policy is 0.
+  void apply_affinity(int64_t widx) {
+#if defined(__linux__)
+    int64_t mode, t;
+    {
+      std::lock_guard<std::mutex> lk(pool_mu);
+      mode = affinity_mode;
+      t = n_threads;
+    }
+    if (mode == 0) return;
+    const long ncpu_l = sysconf(_SC_NPROCESSORS_ONLN);
+    if (ncpu_l <= 0) return;
+    const int64_t ncpu = (int64_t)ncpu_l;
+    const int64_t n_workers = t > 1 ? t - 1 : 1;
+    const int64_t cpu = mode == 1 ? widx % ncpu
+                                  : (widx * ncpu / n_workers) % ncpu;
+    cpu_set_t set;
+    CPU_ZERO(&set);
+    CPU_SET((int)cpu, &set);
+    pthread_setaffinity_np(pthread_self(), sizeof(set), &set);
+#else
+    (void)widx;
+#endif
   }
 
   void drain_items() {
@@ -1241,7 +1460,8 @@ struct ShardedCache {
     }
   }
 
-  void worker_loop() {
+  void worker_loop(int64_t widx) {
+    apply_affinity(widx);
     uint64_t seen = 0;
     for (;;) {
       {
@@ -1296,6 +1516,157 @@ void shard_pass1(FeedShard& sh, const uint64_t* signs, int32_t* rows_out,
   sh.n_unique = 0;
   sh.overflow = false;
   const uint64_t ep = c.scratch_epoch & 0xffffffffULL;
+  if (c.probe_mode) {
+    // Probe-wave walk (round 17), three phases per wave of W positions:
+    //
+    //   stage   — hash the NEXT wave's signs and prefetch their scratch
+    //             (and occurrence) lines one full wave ahead.
+    //   detect  — walk the current wave in input order through the
+    //             scratch dedup. Batch duplicates (the bulk of a zipf
+    //             stream) finish here and touch NOTHING else; first-seen
+    //             signs enqueue on a pending list, write a sentinel
+    //             scratch entry, and prefetch ONLY THEN their tag-group
+    //             and payload-home lines. The directory's random DRAM
+    //             lines are fetched once per unique sign, not once per
+    //             position (the scalar walk's fixed lookahead prefetches
+    //             the payload line for every position, duplicates
+    //             included — wasted line-fill-buffer slots that this
+    //             shape gives back).
+    //   resolve — run the pending probes back to back with the SIMD tag
+    //             walk, their lines in flight since detect; patch the
+    //             sentinel scratch entries, the row LUT, and the
+    //             dup-of-pending fixups. The LRU splice is deferred to a
+    //             wave-local buffer (node line prefetched at hit time)
+    //             drained after the probe loop — the pointer-chasing
+    //             unlink/push-front no longer sits between probes.
+    //
+    // Bit-identity with the scalar walk: the scratch chain is walked and
+    // written in the same input order (sentinels occupy exactly the slots
+    // the scalar's values would), find_pos and the dedup never read LRU
+    // state, misses/touch_admits/obs_order happen in first-seen input
+    // order, hits within one batch touch DISTINCT rows, and the touch
+    // drain preserves input order — the LRU list after every wave is
+    // identical, and pass 2 (the only LRU consumer) runs strictly after
+    // pass 1. Sentinels (INT32_MIN + k, k < W) cannot collide with real
+    // LUT values: rows/pad are >= 0 and miss placeholders are bounded by
+    // -(batch + 2), far above INT32_MIN for any int32-addressable batch.
+    constexpr int64_t W = 32;
+    uint64_t h_a[W], h_b[W];
+    uint64_t* h_cur = h_a;
+    uint64_t* h_next = h_b;
+    int64_t pend_i[W];   // position index of each first-seen sign
+    uint64_t pend_s[W];  // its sign
+    uint64_t pend_h[W];  // its splitmix64 hash
+    uint64_t pend_j[W];  // its scratch slot (sentinel to patch)
+    int64_t pend_v[W];   // resolved LUT value
+    int64_t fix_i[W];    // positions that duped a still-pending sign
+    int32_t fix_k[W];    // ... and which pending entry they duped
+    int64_t touch_rows[W];
+    const auto stage_wave = [&](int64_t w0, int64_t w1, uint64_t* hs) {
+      for (int64_t t = w0; t < w1; ++t) {
+        const uint64_t hp = splitmix64(signs[pos[t]]);
+        hs[t - w0] = hp;
+        __builtin_prefetch(&c.scratch[c.scratch_mask & hp]);
+        if (observing) __builtin_prefetch(&sh.obs_count[c.scratch_mask & hp]);
+      }
+    };
+    stage_wave(p0, std::min(p0 + W, p1), h_cur);
+    for (int64_t w0 = p0; w0 < p1; w0 += W) {
+      const int64_t w1 = std::min(w0 + W, p1);
+      stage_wave(w1, std::min(w1 + W, p1), h_next);
+      int64_t n_pend = 0, n_fix = 0, n_touch = 0;
+      for (int64_t t = w0; t < w1; ++t) {  // detect
+        const int64_t i = pos[t];
+        const uint64_t s = signs[i];
+        const uint64_t hp = h_cur[t - w0];
+        uint64_t j = c.scratch_mask & hp;
+        int64_t v;
+        for (;;) {
+          const Cache::ScratchSlot& sl = c.scratch[j];
+          if ((sl.packed >> 32) != ep) { v = -1; break; }
+          if (sl.sign == s) { v = (int32_t)(uint32_t)sl.packed; break; }
+          j = (j + 1) & c.scratch_mask;
+        }
+        if (v == -1) {  // first time this batch: enqueue, probe later
+          ++sh.n_unique;
+          const int64_t k = n_pend++;
+          pend_i[k] = i;
+          pend_s[k] = s;
+          pend_h[k] = hp;
+          pend_j[k] = j;
+          c.scratch[j] = Cache::ScratchSlot{
+              s, (ep << 32) | (uint32_t)(int32_t)(INT32_MIN + k)};
+          __builtin_prefetch(&c.tags[hp & c.mask]);
+          __builtin_prefetch(&c.table[hp & c.mask]);
+          if (observing) {  // compact stream: ordinal in obs_count[j]
+            const int64_t slot =
+                slot_base + (samples_per_slot > 0 ? i / samples_per_slot : 0);
+            sh.obs_count[j] = (uint32_t)sh.obs_sign_c.size();
+            sh.obs_sign_c.push_back(s);
+            sh.obs_slot_c.push_back(slot < 0 ? UINT32_MAX : (uint32_t)slot);
+            sh.obs_cnt_c.push_back(1);
+          }
+        } else {
+          if (v <= INT32_MIN + (W - 1)) {  // duped a pending probe
+            fix_i[n_fix] = i;
+            fix_k[n_fix++] = (int32_t)(v - INT32_MIN);
+          } else {
+            rows_out[i] = (int32_t)v;
+          }
+          if (observing) ++sh.obs_cnt_c[sh.obs_count[j]];
+        }
+      }
+      // resolve, two loops: the probes run back to back first, and each
+      // outcome fires the NEXT dependent line's prefetch (hit -> its LRU
+      // node, miss -> its admission-counter byte in the touch table — a
+      // random DRAM line the scalar walk always eats cold) so loop two
+      // finds every line it patches already in flight.
+      int64_t hit_r[W];
+      for (int64_t k = 0; k < n_pend; ++k) {
+        const int64_t lpos = c.find_pos_simd_h(pend_s[k], pend_h[k]);
+        hit_r[k] = lpos < 0 ? -1 : (int64_t)c.table[lpos].row;
+        if (lpos >= 0) {
+          __builtin_prefetch(&c.lru[hit_r[k]]);
+        } else if (c.admit_touches > 1) {
+          __builtin_prefetch(&c.touch_counts[c.touch_idx(pend_s[k])], 1);
+        }
+      }
+      for (int64_t k = 0; k < n_pend; ++k) {  // patch (first-seen order)
+        const uint64_t s = pend_s[k];
+        int64_t v;
+        if (hit_r[k] >= 0) {
+          const int64_t r = hit_r[k];
+          touch_rows[n_touch++] = r;  // LRU splice deferred past the wave
+          v = sh.row_base + r;
+        } else if (!c.touch_admits(s)) {
+          v = total_capacity;  // global pad row: zero fwd, grad dropped
+        } else {
+          v = -((int64_t)sh.miss_signs.size() + 2);
+          sh.miss_signs.push_back(s);
+        }
+        pend_v[k] = v;
+        c.scratch[pend_j[k]].packed = (ep << 32) | (uint32_t)(int32_t)v;
+        rows_out[pend_i[k]] = (int32_t)v;
+      }
+      for (int64_t f = 0; f < n_fix; ++f)
+        rows_out[fix_i[f]] = (int32_t)pend_v[fix_k[f]];
+      // two-phase touch drain: the unlink needs each node's NEIGHBOR
+      // lines, a serial two-miss chain when done inline. Phase 1 reads
+      // the (already-prefetched) nodes and fires their neighbors'
+      // prefetches across the whole wave; phase 2 splices. Reads can go
+      // stale between phases when touched rows neighbor each other —
+      // harmless, prefetch is a hint and touch() re-reads live links.
+      for (int64_t k = 0; k < n_touch; ++k) {
+        const Cache::Link& nd = c.lru[touch_rows[k]];
+        if (nd.prev >= 0) __builtin_prefetch(&c.lru[nd.prev]);
+        if (nd.next >= 0) __builtin_prefetch(&c.lru[nd.next]);
+      }
+      for (int64_t k = 0; k < n_touch; ++k) c.touch(touch_rows[k]);
+      std::swap(h_cur, h_next);
+    }
+    sh.overflow = sh.n_unique > c.capacity;
+    return;
+  }
   const int64_t PF = 16;  // same DRAM-latency pipelining as the legacy walk
   for (int64_t t = p0; t < p1; ++t) {
     if (t + PF < p1) {
@@ -1354,13 +1725,39 @@ void shard_pass2(FeedShard& sh, int32_t* rows_out, const int64_t* pos,
   sh.miss_rows.clear();
   sh.ev_signs.clear();
   sh.ev_rows.clear();
+  // Probe-layout mode extends the wave discipline into the admit loop —
+  // every miss sign is known upfront, so its insert-probe home lines ride
+  // a rolling prefetch window, and each eviction prefetches the NEXT
+  // LRU-tail node + its row sign one insert ahead of use. Pure prefetch:
+  // the admit/evict sequence (the golden scalar reference) is unchanged.
+  const int64_t PF2 = 8;
+  if (c.probe_mode) {
+    for (int64_t m = 0; m < std::min(PF2, n_miss); ++m) {
+      const uint64_t hp = splitmix64(sh.miss_signs[m]);
+      __builtin_prefetch(&c.tags[hp & c.mask]);
+      __builtin_prefetch(&c.table[hp & c.mask]);
+    }
+    if (n_miss && c.count >= c.capacity && c.lru_tail >= 0) {
+      __builtin_prefetch(&c.lru[c.lru_tail]);
+      __builtin_prefetch(&c.row_sign[c.lru_tail]);
+    }
+  }
   for (int64_t m = 0; m < n_miss; ++m) {
+    if (c.probe_mode && m + PF2 < n_miss) {
+      const uint64_t hp = splitmix64(sh.miss_signs[m + PF2]);
+      __builtin_prefetch(&c.tags[hp & c.mask]);
+      __builtin_prefetch(&c.table[hp & c.mask]);
+    }
     if (c.count >= c.capacity) {
       uint64_t ev_sign;
       const int64_t ev_row = c.evict_lru(&ev_sign);
       sh.ev_signs.push_back(ev_sign);
       sh.ev_rows.push_back(sh.row_base + ev_row);
       c.free_rows.push_back(ev_row);
+      if (c.probe_mode && c.lru_tail >= 0) {
+        __builtin_prefetch(&c.lru[c.lru_tail]);
+        __builtin_prefetch(&c.row_sign[c.lru_tail]);
+      }
     }
     sh.miss_rows.push_back(sh.row_base + c.insert(sh.miss_signs[m]));
   }
@@ -1377,7 +1774,41 @@ void shard_pass2(FeedShard& sh, int32_t* rows_out, const int64_t* pos,
 // locks only). Final cm/totals/bitmap state is identical to per-position
 // observes; the top-K list sees each pair once at its full batch weight.
 void shard_observe_apply(FeedShard& sh, AccessSketch* sk) {
-  if (sk == nullptr || sh.obs_order.empty()) return;
+  if (sk == nullptr) return;
+  const int64_t n_c = (int64_t)sh.obs_sign_c.size();
+  if (n_c == 0 && sh.obs_order.empty()) return;
+  if (n_c > 0) {
+    // compact stream from the probe-wave walk: (sign, slot, count) in
+    // first-seen order, read LINEARLY — the count-min lines are the only
+    // non-streaming accesses left, and their addresses come straight off
+    // the sequential sign read, so one short pipeline covers them. Same
+    // triples in the same order as the scratch-indexed path below: the
+    // sketch state stays bit-identical across probe modes.
+    std::lock_guard<std::mutex> lk(sk->mu);
+    const uint64_t k = (uint64_t)sk->sample_k;
+    const int64_t PF = 8;
+    for (int64_t t = 0; t < n_c; ++t) {
+      if (t + PF < n_c) {
+        const uint64_t keyp =
+            sh.obs_sign_c[(size_t)(t + PF)] ^
+            ((uint64_t)sh.obs_slot_c[(size_t)(t + PF)] * SK_SLOT_MIX);
+        for (int64_t d = 0; d < sk->depth; ++d)
+          __builtin_prefetch(
+              &sk->cm[(size_t)(d * sk->width +
+                               (int64_t)(splitmix64(keyp ^ SK_DEPTH_SEED[d]) &
+                                         sk->width_mask))],
+              1);
+      }
+      const int64_t slot = (int64_t)sh.obs_slot_c[(size_t)t];
+      if (slot >= sk->n_slots) continue;  // incl. the UINT32_MAX sentinel
+      const uint64_t sign = sh.obs_sign_c[(size_t)t];
+      if (k > 1 && splitmix64(sign ^ SK_SAMPLE_SEED) % k != 0) continue;
+      const uint32_t est =
+          sk->observe_w(slot, sign, (uint64_t)sh.obs_cnt_c[(size_t)t] * k);
+      sk->maybe_top(slot, sign, est);
+    }
+    return;
+  }
   std::lock_guard<std::mutex> lk(sk->mu);
   const Cache& c = sh.dir;
   const uint64_t k = (uint64_t)sk->sample_k;
@@ -1511,6 +1942,46 @@ void cache_sharded_shard_busy_ns(void* h, int64_t* out) {
     out[s] = sc.shards[s]->busy_ns.load(std::memory_order_relaxed);
 }
 
+// per-shard pool-queue wait of the LAST feed in ns (out sized n_shards):
+// dispatch-to-walk-start summed over both phases. busy/stall together
+// separate shard imbalance from core starvation on the gauge surface.
+void cache_sharded_shard_stall_ns(void* h, int64_t* out) {
+  ShardedCache& sc = *static_cast<ShardedCache*>(h);
+  for (int64_t s = 0; s < sc.n_shards; ++s)
+    out[s] = sc.shards[s]->stall_ns.load(std::memory_order_relaxed);
+}
+
+// probe layout selector for every shard directory: 1 = SIMD tag probe
+// (default, PERSIA_FEED_PROBE), 0 = scalar slot walk. Taken under each
+// shard's mu so a concurrent probe/feed never sees the mode flip
+// mid-walk; output is bit-identical either way — this knob exists for
+// the golden parity suite and A/B profiling.
+void cache_sharded_set_probe_mode(void* h, int64_t mode) {
+  ShardedCache& sc = *static_cast<ShardedCache*>(h);
+  for (auto& sh : sc.shards) {
+    std::lock_guard<std::mutex> lk(sh->mu);
+    sh->dir.probe_mode = mode ? 1 : 0;
+  }
+}
+
+int64_t cache_sharded_probe_mode(void* h) {
+  ShardedCache& sc = *static_cast<ShardedCache*>(h);
+  std::lock_guard<std::mutex> lk(sc.shards[0]->mu);
+  return sc.shards[0]->dir.probe_mode;
+}
+
+// walker pinning policy (PERSIA_FEED_AFFINITY): 0 none, 1 compact,
+// 2 spread. Respawns pool workers so the pin applies from thread start.
+void cache_sharded_set_affinity(void* h, int64_t mode) {
+  static_cast<ShardedCache*>(h)->set_affinity(mode);
+}
+
+int64_t cache_sharded_affinity(void* h) {
+  ShardedCache& sc = *static_cast<ShardedCache*>(h);
+  std::lock_guard<std::mutex> lk(sc.pool_mu);
+  return sc.affinity_mode;
+}
+
 // read-only probe (no admit, no LRU touch): rows_out[i] = global row or -1.
 // One pass per shard so a probe never takes more than one lock at a time
 // and shares no scratch with a concurrent feed.
@@ -1620,12 +2091,7 @@ int64_t cache_sharded_drain(void* h, uint64_t* signs_out, int64_t* rows_out) {
       rows_out[k] = sh.row_base + r;
       ++k;
     }
-    std::fill(c.table.begin(), c.table.end(), Cache::Slot{0, -1});
-    std::fill(c.lru.begin(), c.lru.end(), Cache::Link{-1, -1});
-    c.lru_head = c.lru_tail = -1;
-    c.count = 0;
-    c.free_rows.clear();
-    for (int64_t r = c.capacity - 1; r >= 0; --r) c.free_rows.push_back(r);
+    c.reset_directory();
   }
   return k;
 }
@@ -1674,9 +2140,17 @@ int64_t cache_feed_batch_sharded(
   }
   // phase A: dedup/touch walks (+ fused occurrence scratch). Barriered
   // before phase B so an overflow anywhere bails before ANY shard admits.
+  // t_dispatch anchors the per-shard stall counter: walk-start minus
+  // dispatch is time the shard item sat in the pool queue (or behind
+  // earlier items on the same worker) — queueing, not walking.
+  const auto t_dispatch_a = std::chrono::steady_clock::now();
   sc.run_shards([&](int64_t s) {
     FeedShard& sh = *sc.shards[s];
     const auto t0 = std::chrono::steady_clock::now();
+    sh.stall_ns.store(std::chrono::duration_cast<std::chrono::nanoseconds>(
+                          t0 - t_dispatch_a)
+                          .count(),
+                      std::memory_order_relaxed);
     {
       std::lock_guard<std::mutex> lk(sh.mu);
       shard_pass1(sh, signs, rows_out, sc.pos.data(), sc.start[s],
@@ -1692,9 +2166,14 @@ int64_t cache_feed_batch_sharded(
     if (sc.shards[s]->overflow) return -1;
   // phase B: admit + placeholder resolution under the shard mu, then the
   // observe apply and ledger probe under their own (leaf) locks
+  const auto t_dispatch_b = std::chrono::steady_clock::now();
   sc.run_shards([&](int64_t s) {
     FeedShard& sh = *sc.shards[s];
     const auto t0 = std::chrono::steady_clock::now();
+    sh.stall_ns.fetch_add(std::chrono::duration_cast<std::chrono::nanoseconds>(
+                              t0 - t_dispatch_b)
+                              .count(),
+                          std::memory_order_relaxed);
     {
       std::lock_guard<std::mutex> lk(sh.mu);
       shard_pass2(sh, rows_out, sc.pos.data(), sc.start[s], sc.start[s + 1]);

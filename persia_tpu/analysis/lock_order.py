@@ -73,6 +73,12 @@ SEQUENCE of a walker's acquisitions, not a nesting tree; any future change
 that nests two of them must follow this order (and will face TSan's
 deadlock detector in scripts/race_native.sh either way). Stats-plane
 readers (probe/len/snapshot/shard_sizes) take one FeedShard::mu at a time.
+
+Round 17 (SIMD probe layout + walker affinity) adds NO new mutexes: the
+tag array and probe_mode flag mutate only under the owning shard's
+FeedShard::mu (so scalar<->simd flips are legal from any thread), the
+stall gauge is a relaxed atomic beside busy_ns, and affinity_mode rides
+pool_mu with the same join-outside-the-lock respawn shape as set_threads.
 """
 
 from __future__ import annotations

@@ -85,6 +85,11 @@ def _load_lib() -> ctypes.CDLL:
         lib.cache_snapshot.argtypes = [p, _u64p, _i64p]
         lib.cache_set_admit_touches.restype = None
         lib.cache_set_admit_touches.argtypes = [p, i64]
+        # probe layout selector (round 17): 1 = SIMD tag probe, 0 = scalar
+        lib.cache_set_probe_mode.restype = None
+        lib.cache_set_probe_mode.argtypes = [p, i64]
+        lib.cache_probe_mode.restype = i64
+        lib.cache_probe_mode.argtypes = [p]
         _i32p = ctypes.POINTER(ctypes.c_int32)
         lib.cache_admit_positions.restype = i64
         lib.cache_admit_positions.argtypes = [
@@ -145,6 +150,17 @@ def _load_lib() -> ctypes.CDLL:
         lib.cache_sharded_shard_sizes.argtypes = [p, _i64p]
         lib.cache_sharded_shard_busy_ns.restype = None
         lib.cache_sharded_shard_busy_ns.argtypes = [p, _i64p]
+        # ---- probe layout + walker affinity (round 17) ----
+        lib.cache_sharded_shard_stall_ns.restype = None
+        lib.cache_sharded_shard_stall_ns.argtypes = [p, _i64p]
+        lib.cache_sharded_set_probe_mode.restype = None
+        lib.cache_sharded_set_probe_mode.argtypes = [p, i64]
+        lib.cache_sharded_probe_mode.restype = i64
+        lib.cache_sharded_probe_mode.argtypes = [p]
+        lib.cache_sharded_set_affinity.restype = None
+        lib.cache_sharded_set_affinity.argtypes = [p, i64]
+        lib.cache_sharded_affinity.restype = i64
+        lib.cache_sharded_affinity.argtypes = [p]
         lib.cache_sharded_probe.restype = None
         lib.cache_sharded_probe.argtypes = [p, _u64p, i64, _i64p]
         lib.cache_sharded_admit.restype = i64
@@ -164,6 +180,28 @@ def _load_lib() -> ctypes.CDLL:
         ]
         _LIB = lib
     return _LIB
+
+
+#: PERSIA_FEED_AFFINITY policy names → native mode codes. ``none`` leaves
+#: walkers unpinned; ``compact`` packs worker i onto cpu ``i % ncpu``
+#: (shared-LLC locality); ``spread`` stripes workers across the cpu range
+#: (one walker per NUMA node's worth of cores on big hosts).
+AFFINITY_MODES = {"none": 0, "compact": 1, "spread": 2}
+
+
+def feed_affinity_from_env() -> int:
+    """Resolve PERSIA_FEED_AFFINITY to a native pinning mode (default 0 =
+    none). Unknown values fall back to none — placement is best-effort."""
+    return AFFINITY_MODES.get(
+        os.environ.get("PERSIA_FEED_AFFINITY", "none").strip().lower(), 0)
+
+
+def feed_probe_from_env() -> int:
+    """Resolve PERSIA_FEED_PROBE to a probe-layout mode: ``scalar`` → 0,
+    anything else (including unset) → 1, the SIMD tag probe. Mirrors the
+    native ``default_probe_mode`` so Python-side introspection agrees with
+    directories created before the first setter call."""
+    return 0 if os.environ.get("PERSIA_FEED_PROBE", "").strip() == "scalar" else 1
 
 
 def native_uniform_init(
@@ -290,7 +328,8 @@ class CacheDirectory:
 
     def __init__(self, capacity: int, admit_touches: int = 1,
                  shards: Optional[int] = None, feed_threads: int = 1,
-                 part_salt: int = 0):
+                 part_salt: int = 0, probe: Optional[int] = None,
+                 affinity: Optional[int] = None):
         self._lib = _load_lib()
         self.part_salt = int(part_salt) & (2**64 - 1)
         self._sharded = shards is not None
@@ -304,6 +343,15 @@ class CacheDirectory:
         else:
             self._h = self._lib.cache_create(capacity)
             self.shards = None
+        # probe layout (round 17): the native side already defaulted from
+        # PERSIA_FEED_PROBE at load; an explicit arg overrides per handle.
+        # Bit-identical either way — a profiling/parity knob, never a
+        # jobstate-stable choice.
+        if probe is not None:
+            self.set_probe_mode(probe)
+        aff = feed_affinity_from_env() if affinity is None else int(affinity)
+        if self._sharded and aff:
+            self._lib.cache_sharded_set_affinity(self._h, aff)
         self.capacity = capacity
         self.admit_touches = int(admit_touches)
         if self.admit_touches > 1:
@@ -351,6 +399,50 @@ class CacheDirectory:
         self._lib.cache_sharded_shard_busy_ns(
             self._h, out.ctypes.data_as(_i64p))
         return out
+
+    def shard_stall_ns(self) -> np.ndarray:
+        """Per-shard pool-queue wait of the LAST feed in ns (sharded mode):
+        dispatch-to-walk-start, summed over both walk phases. Busy says how
+        long a shard's walk ran; stall says how long it waited for a core
+        first — together they separate shard imbalance from core starvation
+        on the ``persia_tpu_feeder_shard_stall`` gauge."""
+        if not self._sharded:
+            return np.zeros(1, dtype=np.int64)
+        out = np.empty(self.shards, dtype=np.int64)
+        self._lib.cache_sharded_shard_stall_ns(
+            self._h, out.ctypes.data_as(_i64p))
+        return out
+
+    @property
+    def probe_mode(self) -> int:
+        """Active probe layout: 1 = SIMD tag probe, 0 = scalar slot walk."""
+        if self._sharded:
+            return int(self._lib.cache_sharded_probe_mode(self._h))
+        return int(self._lib.cache_probe_mode(self._h))
+
+    def set_probe_mode(self, mode: int) -> None:
+        """Select the probe layout (1 = SIMD tag probe, 0 = scalar).
+        Output bits never depend on this — it exists for the golden parity
+        suite and A/B profiling; safe to flip between feeds."""
+        mode = 1 if int(mode) else 0
+        if self._sharded:
+            self._lib.cache_sharded_set_probe_mode(self._h, mode)
+        else:
+            self._lib.cache_set_probe_mode(self._h, mode)
+
+    @property
+    def feed_affinity(self) -> int:
+        """Walker pinning policy (sharded mode): 0 none, 1 compact,
+        2 spread — see ``PERSIA_FEED_AFFINITY``."""
+        if not self._sharded:
+            return 0
+        return int(self._lib.cache_sharded_affinity(self._h))
+
+    def set_feed_affinity(self, mode: int) -> None:
+        """Re-pin the walker pool (sharded mode only; best-effort, Linux
+        only). Purely a placement knob — output bits never depend on it."""
+        if self._sharded:
+            self._lib.cache_sharded_set_affinity(self._h, int(mode))
 
     def _ensure_scratch(self, n: int) -> None:
         if n <= self._scratch_n:

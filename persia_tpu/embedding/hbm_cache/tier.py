@@ -224,6 +224,12 @@ class CachedEmbeddingTier:
             "persia_tpu_feeder_shard_busy",
             "per-shard walk seconds of the last sharded feed (labels: group, shard)",
         )
+        self._m_shard_stall = m.gauge(
+            "persia_tpu_feeder_shard_stall",
+            "per-shard pool-queue wait seconds of the last sharded feed "
+            "(labels: group, shard) — busy high = shard imbalance, stall "
+            "high = not enough cores",
+        )
 
     def set_feed_threads(self, threads: int) -> None:
         """Resize every group directory's native walker pool. Output bits
@@ -243,21 +249,26 @@ class CachedEmbeddingTier:
 
     def _note_shard_walk(self, gname: str, d: CacheDirectory) -> None:
         """Publish the last feed's native-measured per-shard walk times:
-        one ``feed.shard`` span + one ``persia_tpu_feeder_shard_busy``
-        gauge sample per shard."""
+        one ``feed.shard`` span + one ``persia_tpu_feeder_shard_busy`` and
+        one ``persia_tpu_feeder_shard_stall`` gauge sample per shard."""
+        stall = d.shard_stall_ns().tolist()
         for s, ns in enumerate(d.shard_busy_ns().tolist()):
             self._m_shard_busy.set(ns * 1e-9, group=gname, shard=str(s))
-            record_span("feed.shard", ns * 1e-9, group=gname, shard=s)
+            self._m_shard_stall.set(stall[s] * 1e-9, group=gname, shard=str(s))
+            record_span("feed.shard", ns * 1e-9, group=gname, shard=s,
+                        stall_ns=stall[s])
 
     def feeder_shard_stats(self) -> Dict[str, Dict[str, List[int]]]:
-        """Per-group per-shard occupancy + last-feed walk ns (sharded mode;
-        empty when unsharded) — surfaced in stream stats and fence logs."""
+        """Per-group per-shard occupancy + last-feed walk/queue-wait ns
+        (sharded mode; empty when unsharded) — surfaced in stream stats and
+        fence logs."""
         if self.feed_shards is None:
             return {}
         return {
             g.name: {
                 "sizes": self.dirs[g.name].shard_sizes().tolist(),
                 "busy_ns": self.dirs[g.name].shard_busy_ns().tolist(),
+                "stall_ns": self.dirs[g.name].shard_stall_ns().tolist(),
             }
             for g in self.groups
         }

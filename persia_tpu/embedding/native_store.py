@@ -521,6 +521,19 @@ def native_available() -> bool:
         return False
 
 
+def store_backend_name(store) -> str:
+    """Human-readable backend of a lookup replica: ``native`` (C++ core,
+    carries a ctypes handle), ``numpy`` (the golden model), or ``remote``
+    (an RPC client proxying a server whose backend is its own business).
+    The serving/PS health surfaces report this so a mixed-backend fleet is
+    diagnosable from the outside."""
+    if getattr(store, "_h", None):
+        return "native"
+    if isinstance(store, EmbeddingStore):
+        return "numpy"
+    return "remote"
+
+
 def create_store(backend: str = "auto", **kwargs):
     """Factory: ``auto`` prefers the C++ core, ``native`` requires it,
     ``numpy`` forces the golden model."""

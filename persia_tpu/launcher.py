@@ -92,6 +92,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                      help="admission queue bound (full = 429)")
     srv.add_argument("--cache-rows", type=int, default=0,
                      help="hot-embedding LRU capacity (0 = no cache)")
+    srv.add_argument("--store", type=str,
+                     default=os.environ.get("PERSIA_STORE_BACKEND", "auto"),
+                     choices=["auto", "native", "numpy"],
+                     help="embedding store backend for replica-local "
+                          "lookups; auto resolves to native whenever the "
+                          "C++ core builds")
 
     # one-command local train-to-serve topology (persia_tpu/topology.py):
     # K demo trainers streaming incremental deltas + R serving replicas
@@ -236,6 +242,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "PERSIA_SERVE_MAX_WAIT_MS": args.max_wait_ms,
             "PERSIA_SERVE_QUEUE_DEPTH": args.queue_depth,
             "PERSIA_SERVE_CACHE_ROWS": args.cache_rows,
+            "PERSIA_STORE_BACKEND": args.store,
         })
 
     if args.role == "local":

@@ -283,6 +283,16 @@ class StoreClient:
     def model_manager_status(self) -> Dict:
         return proto.unpack_json(self._rpc.call("model_manager_status", idempotent=True))
 
+    def replica_info(self) -> Dict:
+        """Replica identity + the store backend actually serving it
+        (``native`` / ``numpy``) — the one-native-data-path fleet probe."""
+        return proto.unpack_json(self._rpc.call("replica_info", idempotent=True))
+
+    def healthz(self) -> Dict:
+        """Liveness + store-backend metadata (mirrors the serving-plane
+        /healthz shape)."""
+        return proto.unpack_json(self._rpc.call("healthz", idempotent=True))
+
     def shutdown(self) -> None:
         try:
             self._rpc.call("shutdown")

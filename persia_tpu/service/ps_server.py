@@ -42,6 +42,18 @@ class ParameterServerService:
         self.store = store
         self.replica_index = replica_index
         self.replica_size = replica_size
+        # which store implementation actually backs this replica — the
+        # native core carries a ctypes handle, the numpy golden model does
+        # not. Recorded in the flight ring and surfaced on healthz /
+        # replica_info so a mixed-backend fleet is diagnosable from the
+        # outside (the wire bytes are identical either way).
+        self.store_backend = "native" if getattr(store, "_h", None) else "numpy"
+        from persia_tpu.tracing import record_event
+
+        record_event(
+            "ps.store_backend", backend=self.store_backend,
+            replica_index=replica_index, replica_size=replica_size,
+        )
         # boot loads happen BEFORE this service exists (their status is
         # threaded in) — the native server's accept loop starts at
         # construction, so any load after this point races live probes
@@ -98,7 +110,14 @@ class ParameterServerService:
         s.register("load_from_dir", self._load_from_dir)
         s.register("model_manager_status", lambda p: proto.pack_json(self.status.get()))
         s.register("replica_info", lambda p: proto.pack_json(
-            {"replica_index": self.replica_index, "replica_size": self.replica_size}
+            {"replica_index": self.replica_index,
+             "replica_size": self.replica_size,
+             "store_backend": self.store_backend}
+        ))
+        s.register("healthz", lambda p: proto.pack_json(
+            {"status": "ok", "store_backend": self.store_backend,
+             "replica_index": self.replica_index,
+             "replica_size": self.replica_size}
         ))
         self.port = s.port
 
@@ -317,8 +336,10 @@ def main(argv: Optional[list] = None) -> None:
     ap.add_argument("--capacity", type=int, default=1 << 20)
     ap.add_argument("--num-internal-shards", type=int, default=16)
     ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--backend", type=str, default="auto",
-                    choices=["auto", "native", "numpy"])
+    ap.add_argument("--backend", "--store", dest="backend", type=str,
+                    default="auto", choices=["auto", "native", "numpy"],
+                    help="embedding store implementation; auto resolves to "
+                         "native whenever the C++ core builds")
     ap.add_argument("--global-config", type=str, default=None)
     ap.add_argument("--load-checkpoint", type=str, default=None,
                     help="Infer-mode boot checkpoint (ref: ps bin :109-117)")
@@ -405,7 +426,8 @@ def main(argv: Optional[list] = None) -> None:
     )
     svc.start()
     logger.info(
-        "parameter server %d/%d on port %d", replica_index, replica_size, svc.port
+        "parameter server %d/%d on port %d (store backend: %s)",
+        replica_index, replica_size, svc.port, svc.store_backend,
     )
     from persia_tpu.diagnostics import maybe_start_from_env
 

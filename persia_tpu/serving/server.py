@@ -53,6 +53,19 @@ def _npy_bytes(scores: np.ndarray) -> bytes:
     return buf.getvalue()
 
 
+def _worker_store_backend(worker) -> str:
+    """Backend of the worker's first lookup replica (``native`` / ``numpy``
+    / ``remote``) — replicas in one router share a construction path, so
+    the first one speaks for the replica set."""
+    try:
+        from persia_tpu.embedding.native_store import store_backend_name
+
+        replicas = worker.lookup_router._topo[0]
+        return store_backend_name(replicas[0]) if replicas else "none"
+    except Exception:  # noqa: BLE001 — health metadata is best-effort
+        return "unknown"
+
+
 class _HTTPServer(ThreadingHTTPServer):
     # stdlib default backlog is 5: a client fleet opening one TCP connection
     # per request overflows it at load and sees connection resets — admission
@@ -244,6 +257,13 @@ class ServingServer:
             attach_cache(infer_ctx.worker, capacity=cache_rows)
             if cache_rows > 0 else None
         )
+        # which store implementation backs this replica's embedding lookups
+        # (native C++ core / numpy golden model / remote RPC proxy) — the
+        # one-native-data-path health signal, surfaced on /healthz so a
+        # soak can assert every replica rides the intended backend
+        self.store_backend = _worker_store_backend(
+            getattr(infer_ctx, "worker", None)
+        )
         self.engine = InferenceEngine(infer_ctx, version=version)
         self.batcher = MicroBatcher(
             self.engine.predict,
@@ -351,6 +371,7 @@ class ServingServer:
             "model": self.engine.model_name(),
             "version": self.engine.version,
             "queue_depth": len(self.batcher._q),
+            "store_backend": self.store_backend,
         }
         if self.cache is not None:
             h["cache"] = self.cache.stats()

@@ -11,7 +11,10 @@
 # cache_feed_batch vs write-back ledger flushes, sketch observe vs
 # decay/stats/export, the ps journal ring, and concurrent ps
 # update/lookup/scrub/dump — the interleavings the production feeder,
-# write-back, fence, and RPC-worker threads actually produce.
+# write-back, fence, and RPC-worker threads actually produce. Round 17
+# adds the SIMD probe-wave feed under live scalar<->simd mode flips,
+# walker re-pinning (PERSIA_FEED_AFFINITY respawn path) and the per-shard
+# stall-gauge readers — same lock ranks, no new mutexes.
 #
 # TSan needs its runtime in the host python (LD_PRELOAD) and runs with
 # halt_on_error=1 + abort_on_error=1: the FIRST data race aborts the test

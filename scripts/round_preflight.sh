@@ -51,6 +51,13 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_native_feed.py -q
 # bit-invariance, fused-observe equivalence, sampling convergence
 # (~1s; the ctx-level reshard/kill-resume parity runs ride step 2)
 JAX_PLATFORMS=cpu python -m pytest tests/test_sharded_feeder.py -q
+# probe-layout goldens (ISSUE 17): SIMD tag walk bitwise-vs-scalar across
+# shard/thread counts and admit paths, mid-stream probe-mode flips,
+# fused-observe state parity across modes, affinity re-pin invariance
+# (~13s; the native-handoff subset rides step 1, the subprocess
+# native-fleet reshard run rides step 2)
+JAX_PLATFORMS=cpu python -m pytest tests/test_probe_layout.py -q \
+    -k "probe or affinity or env_knob or fused"
 # UBSan variant of the full parity surface (~10s incl. variant builds);
 # SANITIZE_ASAN rides the same script when PREFLIGHT_ASAN=1
 SANITIZE_ASAN="${PREFLIGHT_ASAN:-0}" bash scripts/sanitize_native.sh
@@ -114,6 +121,11 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q -m 'not slow' \
 # parsing/determinism; the multi-second fence_callback bit-transparency
 # stream runs ride the full suite in step 2
 JAX_PLATFORMS=cpu python -m pytest tests/test_autopilot.py -q -m 'not slow'
+# native-handoff fast subset (ISSUE 17): ps_export_range bytes
+# native-vs-numpy and the mixed-backend reshard journal-crc dedupe, both
+# in-proc; the subprocess native-fleet grow 2->4 rides the full suite
+JAX_PLATFORMS=cpu python -m pytest tests/test_probe_layout.py -q \
+    -k "export_range or mixed_backend"
 
 echo "== 1.5/5 telemetry plane (trace propagation + flight recorder) =="
 # the fast tracing/telemetry subset: span mechanics, RPC + gateway HTTP

@@ -1,0 +1,407 @@
+"""Round-17 one-native-data-path goldens.
+
+Two contracts pinned here:
+
+1. **Probe-layout parity** — the SIMD tag probe (cache-line-grouped tag
+   array, splitmix64 tags compared 8-at-a-time, probe-wave prefetch, LRU
+   splice deferred out of the probe loop) is BIT-identical to the scalar
+   slot walk: row LUT, miss order, eviction victims, hazard-ledger
+   restores, sketch state, snapshot and drain order — across shard counts
+   S∈{1,4,8}, thread counts t∈{1,2,4}, and seeded adversarial streams
+   (duplicate-heavy, eviction/tombstone-heavy, near-full directory). The
+   probe mode is a pure perf knob (``PERSIA_FEED_PROBE``); these goldens
+   are what licenses shipping it default-on.
+
+2. **Native-store fleet handoffs** — ``ps_export_range`` blob bytes are
+   identical to the numpy golden model's ``export_range`` for the same
+   logical state, so the handoff journal's crc32 dedupe holds across a
+   MIXED-backend fleet (a numpy source resumed against a native joiner
+   dedups, and vice versa); and a real subprocess reshard (grow 2->4)
+   runs with the native store as the fleet backend (``--store auto``),
+   every replica reporting ``store_backend: native`` on replica_info.
+"""
+
+import numpy as np
+import pytest
+
+hbm = pytest.importorskip("persia_tpu.embedding.hbm_cache")
+
+from persia_tpu import elastic, jobstate  # noqa: E402
+from persia_tpu.embedding.hashing import uniform_splits  # noqa: E402
+from persia_tpu.embedding.hbm_cache.directory import (  # noqa: E402
+    AFFINITY_MODES,
+    CacheDirectory,
+    PendingSignMap,
+    feed_affinity_from_env,
+    feed_probe_from_env,
+    group_salt,
+)
+from persia_tpu.embedding.native_store import (  # noqa: E402
+    create_store,
+    native_available,
+    store_backend_name,
+)
+from persia_tpu.embedding.optim import Adagrad  # noqa: E402
+from persia_tpu.embedding.store import EmbeddingStore  # noqa: E402
+
+SALT = group_salt("cache_probe17")
+DIM = 16
+OPT = Adagrad(lr=0.05).config
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="native PS core unavailable"
+)
+
+
+# --------------------------------------------------- adversarial sign streams
+
+
+def _stream_duplicate_heavy(rng, steps):
+    """zipf(1.05) over a tiny id space: most positions are repeats, so the
+    scratch-dedup fast path dominates and the wave's deferred-LRU buffer
+    sees many hits per wave."""
+    return [(rng.zipf(1.05, int(rng.integers(200, 1500))) % 97)
+            .astype(np.uint64) + 1 for _ in range(steps)]
+
+
+def _stream_eviction_heavy(rng, steps):
+    """Wide uniform id space over a small directory: near-every step evicts,
+    so backward-shift deletes keep punching tombstones through the tag
+    array (the layout's hardest coherence case). Batch distinct counts stay
+    under the per-shard capacity (cap/S) so no shard overflows."""
+    return [rng.integers(1, 1 << 48, size=int(rng.integers(60, 120)),
+                         dtype=np.uint64) for _ in range(steps)]
+
+
+def _stream_near_full(rng, steps, capacity):
+    """Batches cycling a pool ~2x capacity keep the directory pinned at
+    full occupancy, so probes run long chains through a maximally-loaded
+    table where the 8-wide group scan crosses occupied groups before the
+    first empty lane."""
+    out = []
+    pool = rng.integers(1, 1 << 32, size=capacity * 2, dtype=np.uint64)
+    for _ in range(steps):
+        k = int(rng.integers(capacity * 3 // 7, capacity * 4 // 7))
+        out.append(rng.choice(pool, size=k, replace=False))
+    return out
+
+
+def _run_stream(capacity, shards, threads, probe, batches, admit_touches=1):
+    """Feed a stream through a directory with a live hazard ledger and
+    return every observable output as bytes (order-exact)."""
+    d = CacheDirectory(capacity, admit_touches=admit_touches,
+                       shards=shards, feed_threads=threads,
+                       part_salt=SALT, probe=probe)
+    assert d.probe_mode == probe
+    pm = PendingSignMap()
+    trail = []
+    for step, signs in enumerate(batches):
+        out = d.feed_batch(signs, pm, salt=SALT)
+        trail.append(tuple(
+            x.tobytes() if hasattr(x, "tobytes") else x for x in out))
+        ev = out[3]
+        if len(ev):  # arm the ledger so later feeds hit restore entries
+            pm.insert_range(ev, base_src=step * 4096, token=step + 1,
+                            salt=SALT)
+        if step % 3 == 2 and len(ev):
+            pm.remove(ev[: len(ev) // 2], token=step + 1, salt=SALT)
+        trail.append(d.probe(signs[:64]).tobytes())
+    trail.append(tuple(a.tobytes() for a in d.snapshot()))
+    trail.append(tuple(a.tobytes() for a in d.drain()))
+    trail.append(len(pm))
+    return trail
+
+
+@pytest.mark.parametrize("shards,threads", [
+    (1, 1), (1, 2), (1, 4), (4, 1), (4, 2), (4, 4), (8, 1), (8, 2), (8, 4),
+])
+@pytest.mark.parametrize("stream", ["dup", "evict", "full"])
+def test_simd_probe_bit_identical_to_scalar(shards, threads, stream):
+    """THE round-17 golden: every observable output of the SIMD walk equals
+    the scalar walk bit-for-bit, at every shard/thread count, on each
+    adversarial stream."""
+    capacity = 256
+    mk = {
+        "dup": lambda r: _stream_duplicate_heavy(r, 10),
+        "evict": lambda r: _stream_eviction_heavy(r, 10),
+        "full": lambda r: _stream_near_full(r, 8, capacity),
+    }[stream]
+    batches = mk(np.random.default_rng(17))
+    scalar = _run_stream(capacity, shards, threads, 0, batches,
+                         admit_touches=2 if stream == "dup" else 1)
+    simd = _run_stream(capacity, shards, threads, 1, batches,
+                       admit_touches=2 if stream == "dup" else 1)
+    assert scalar == simd
+
+
+def test_simd_probe_unsharded_admit_paths():
+    """The legacy (unsharded) directory's admit / admit_positions / probe
+    surfaces are covered by the same tag layout — parity there too."""
+    rng = np.random.default_rng(5)
+    ds = CacheDirectory(128, probe=1)
+    dl = CacheDirectory(128, probe=0)
+    for _ in range(8):
+        raw = _stream_duplicate_heavy(rng, 1)[0]
+        a = ds.admit_positions(raw)
+        b = dl.admit_positions(raw)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+        uniq = np.unique(rng.integers(1, 1 << 40, 64, dtype=np.uint64))
+        ra = ds.admit(uniq)
+        rb = dl.admit(uniq)
+        for x, y in zip(ra, rb):
+            np.testing.assert_array_equal(x, y)
+        np.testing.assert_array_equal(ds.probe(raw), dl.probe(raw))
+    np.testing.assert_array_equal(ds.snapshot()[0], dl.snapshot()[0])
+
+
+def test_probe_mode_flip_mid_stream_is_seamless():
+    """The tag array is maintained by BOTH walks (insert/erase go through
+    tag_set regardless of mode), so flipping the knob mid-stream changes
+    nothing observable."""
+    rng = np.random.default_rng(11)
+    batches = _stream_eviction_heavy(rng, 12)
+    ref = _run_stream(200, 4, 2, 1, batches)
+    d = CacheDirectory(200, shards=4, feed_threads=2, part_salt=SALT, probe=1)
+    pm = PendingSignMap()
+    trail = []
+    for step, signs in enumerate(batches):
+        d.set_probe_mode(step % 2)  # alternate scalar/simd every feed
+        out = d.feed_batch(signs, pm, salt=SALT)
+        trail.append(tuple(
+            x.tobytes() if hasattr(x, "tobytes") else x for x in out))
+        ev = out[3]
+        if len(ev):
+            pm.insert_range(ev, base_src=step * 4096, token=step + 1,
+                            salt=SALT)
+        if step % 3 == 2 and len(ev):
+            pm.remove(ev[: len(ev) // 2], token=step + 1, salt=SALT)
+        trail.append(d.probe(signs[:64]).tobytes())
+    trail.append(tuple(a.tobytes() for a in d.snapshot()))
+    trail.append(tuple(a.tobytes() for a in d.drain()))
+    trail.append(len(pm))
+    assert trail == ref
+
+
+def test_fused_observe_parity_across_probe_modes():
+    """The fused sketch observe rides the admit scratch, which the wave
+    walk fills in the same first-seen order — exported sketch state must
+    match the scalar walk's exactly."""
+    from persia_tpu.embedding.tiering.native import NativeSketch
+
+    rng = np.random.default_rng(23)
+    states = []
+    for probe in (0, 1):
+        d = CacheDirectory(512, shards=4, part_salt=SALT, probe=probe)
+        sks = [NativeSketch(n_slots=4, topk=8) for _ in range(4)]
+        r2 = np.random.default_rng(99)
+        for _ in range(6):
+            signs = (r2.zipf(1.2, 512) % 4000).astype(np.uint64) + 1
+            d.feed_batch(signs, None, salt=SALT, sketches=sks,
+                         samples_per_slot=128, slot_base=0)
+        states.append(tuple(sk.export_bytes() for sk in sks))
+    assert states[0] == states[1]
+
+
+def test_env_knob_parsers(monkeypatch):
+    monkeypatch.delenv("PERSIA_FEED_PROBE", raising=False)
+    assert feed_probe_from_env() == 1
+    monkeypatch.setenv("PERSIA_FEED_PROBE", "scalar")
+    assert feed_probe_from_env() == 0
+    monkeypatch.setenv("PERSIA_FEED_PROBE", "simd")
+    assert feed_probe_from_env() == 1
+    monkeypatch.delenv("PERSIA_FEED_AFFINITY", raising=False)
+    assert feed_affinity_from_env() == 0
+    for name, code in AFFINITY_MODES.items():
+        monkeypatch.setenv("PERSIA_FEED_AFFINITY", name)
+        assert feed_affinity_from_env() == code
+    monkeypatch.setenv("PERSIA_FEED_AFFINITY", "bogus")
+    assert feed_affinity_from_env() == 0
+
+
+def test_affinity_pinning_preserves_outputs_and_stats():
+    """Pinning is pure placement: outputs are bit-identical under every
+    policy, the stall counter surface reads cleanly, and re-pinning
+    mid-stream (worker respawn) loses nothing."""
+    rng = np.random.default_rng(31)
+    batches = _stream_eviction_heavy(rng, 8)
+    ref = _run_stream(200, 4, 2, 1, batches)
+    for mode in (1, 2):
+        d = CacheDirectory(200, shards=4, feed_threads=2, part_salt=SALT,
+                           probe=1, affinity=mode)
+        assert d.feed_affinity == mode
+        pm = PendingSignMap()
+        trail = []
+        for step, signs in enumerate(batches):
+            if step == 4:
+                d.set_feed_affinity(3 - mode)  # live re-pin mid-stream
+            out = d.feed_batch(signs, pm, salt=SALT)
+            trail.append(tuple(
+                x.tobytes() if hasattr(x, "tobytes") else x for x in out))
+            ev = out[3]
+            if len(ev):
+                pm.insert_range(ev, base_src=step * 4096, token=step + 1,
+                                salt=SALT)
+            if step % 3 == 2 and len(ev):
+                pm.remove(ev[: len(ev) // 2], token=step + 1, salt=SALT)
+            trail.append(d.probe(signs[:64]).tobytes())
+        stall = d.shard_stall_ns()
+        assert stall.shape == (4,) and (stall >= 0).all()
+        trail.append(tuple(a.tobytes() for a in d.snapshot()))
+        trail.append(tuple(a.tobytes() for a in d.drain()))
+        trail.append(len(pm))
+        assert trail == ref
+
+
+# ------------------------------------------- native handoff wire byte-parity
+
+
+def _populate(store, signs):
+    store.register_optimizer(OPT)
+    store.lookup(signs, DIM, True)
+
+
+@needs_native
+def test_export_range_bytes_native_equals_numpy():
+    """Same logical state, byte-identical export blobs — the invariant the
+    handoff journal's crc32 dedupe rests on across a mixed-backend fleet."""
+    signs = np.arange(1, 301, dtype=np.uint64)
+    nat = create_store("native", capacity=1 << 14, num_internal_shards=2,
+                       seed=11)
+    num = create_store("numpy", capacity=1 << 14, num_internal_shards=2,
+                       seed=11)
+    assert store_backend_name(nat) == "native"
+    assert store_backend_name(num) == "numpy"
+    _populate(nat, signs)
+    _populate(num, signs)
+    splits = [int(x) for x in uniform_splits(4)]
+    ranges = list(zip([0] + splits, splits + [0]))[:4]
+    for lo, hi in ranges:
+        a, b = nat.export_range(lo, hi), num.export_range(lo, hi)
+        assert a == b, f"export bytes diverge on range [{lo:#x}, {hi:#x})"
+    assert sum(len(nat.export_range(lo, hi)) for lo, hi in ranges) > len(signs)
+
+
+@needs_native
+def test_mixed_backend_reshard_journal_dedupe(tmp_path):
+    """A numpy-fleet reshard killed mid-flight resumes over NATIVE joiners
+    holding the journal state — every replayed import dedups on the crc the
+    numpy source originally recorded (and the converse direction too)."""
+    signs = np.arange(1, 201, dtype=np.uint64)
+
+    def mk(backend):
+        return create_store(backend, capacity=1 << 14,
+                            num_internal_shards=2, seed=11)
+
+    class _Boom(RuntimeError):
+        pass
+
+    def crash_once_at(kind, op_index):
+        state = {"armed": True}
+
+        def hook(k, i, mv):
+            if state["armed"] and k == kind and i == op_index:
+                state["armed"] = False
+                raise _Boom(f"chaos at {kind}[{op_index}]")
+
+        return hook
+
+    def run(backends_src, backends_dst, js):
+        srcs = [mk(b) for b in backends_src]
+        for r, st in enumerate(srcs):
+            _populate(st, signs[signs % 2 == r])
+        dests = list(srcs) + [mk(b) for b in backends_dst]
+        plan = elastic.plan_reshard(
+            2, 4, None, [int(x) for x in uniform_splits(4)],
+            jobstate.make_journal_id(1, 0))
+        # crash after imports 0-1 landed, then resume over the SAME
+        # journal: the replayed ops must dedupe on the crc the first
+        # attempt recorded, across the backend seam
+        with pytest.raises(_Boom):
+            elastic.execute_reshard(plan, srcs, dests, js,
+                                    fault_hook=crash_once_at("import", 2))
+        stats = elastic.resume_reshard(js, srcs, dests)
+        state = {}
+        for st in dests:
+            blob = st.export_range(0, 0)
+            n = int.from_bytes(blob[:4], "little")
+            state[store_backend_name(st)] = state.get(
+                store_backend_name(st), 0) + n
+        return srcs, dests, plan, stats, state
+
+    # reference run all-numpy
+    _, _, _, ref_stats, ref_state = run(["numpy"] * 2, ["numpy"] * 2,
+                                        str(tmp_path / "js_ref"))
+    assert ref_stats["resumed"] and ref_stats["imports_deduped"] == 2
+    assert ref_stats["imports_applied"] == 4
+
+    # mixed fleet: numpy sources exporting to NATIVE joiners — ops 0-1
+    # (numpy blobs imported into native stores pre-crash) dedupe on resume
+    # because the native re-export round-trips byte-identical crcs
+    _, dests, _, stats, state = run(["numpy"] * 2, ["native"] * 2,
+                                    str(tmp_path / "js_mix"))
+    assert stats["imports_deduped"] == ref_stats["imports_deduped"]
+    assert stats["imports_applied"] == ref_stats["imports_applied"]
+    assert stats["moved_bytes"] == ref_stats["moved_bytes"]
+    assert stats["deletes_applied"] == ref_stats["deletes_applied"]
+    assert sum(state.values()) == sum(ref_state.values()) == len(signs)
+
+    # converse direction: native sources, numpy joiners, same wire stats
+    _, _, _, stats2, state2 = run(["native"] * 2, ["numpy"] * 2,
+                                  str(tmp_path / "js_mix2"))
+    assert stats2["imports_deduped"] == ref_stats["imports_deduped"]
+    assert stats2["imports_applied"] == ref_stats["imports_applied"]
+    assert stats2["moved_bytes"] == ref_stats["moved_bytes"]
+    assert sum(state2.values()) == len(signs)
+
+
+@needs_native
+def test_subprocess_reshard_native_fleet(tmp_path):
+    """The acceptance run: a REAL subprocess PS fleet on ``--store auto``
+    (resolving native), populated, grown 2->4 at a live handoff — every
+    replica reports ``store_backend: native`` on replica_info/healthz and
+    the post-reshard state equals the pre-reshard state."""
+    import struct as _struct
+
+    from persia_tpu.helper import ServiceCtx
+
+    def parse(blob):
+        out = {}
+        (n,) = _struct.unpack_from("<I", blob, 0)
+        off = 4
+        for _ in range(n):
+            sign, _dim, ln = _struct.unpack_from("<QII", blob, off)
+            off += 16
+            out[sign] = blob[off:off + ln * 4]
+            off += ln * 4
+        return out
+
+    def full_state(clients):
+        out = {}
+        for c in clients:
+            d = parse(c.export_range(0, 0))
+            assert not (set(d) & set(out))
+            out.update(d)
+        return out
+
+    signs = np.arange(1, 401, dtype=np.uint64)
+    with ServiceCtx(num_parameter_servers=2, num_embedding_workers=0,
+                    capacity=1 << 14, num_internal_shards=2) as ctx:
+        cs = ctx.ps_clients()
+        for c in cs:
+            info = c.replica_info()
+            assert info["store_backend"] == "native"
+            hz = c.healthz()
+            assert hz["status"] == "ok" and hz["store_backend"] == "native"
+            c.register_optimizer(OPT)
+        for r, c in enumerate(cs):
+            c.lookup(signs[signs % 2 == r], DIM, True)
+        before = full_state(cs)
+        assert len(before) == len(signs)
+
+        grow = ctx.reshard_ps(4, str(tmp_path / "js"))
+        assert ctx.n_ps == 4 and grow["imports_applied"] == 6
+        cs4 = ctx.ps_clients()
+        assert full_state(cs4) == before
+        for c in cs4:
+            assert c.replica_info()["store_backend"] == "native"

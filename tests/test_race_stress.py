@@ -121,6 +121,12 @@ def cache_lib():
     _sig(lib, "cache_sharded_probe", None, [_p, _u64p, _i64, _i64p])
     _sig(lib, "cache_sharded_shard_sizes", None, [_p, _i64p])
     _sig(lib, "cache_sharded_shard_busy_ns", None, [_p, _i64p])
+    # round 17: SIMD probe layout + affinity/stall surfaces
+    _sig(lib, "cache_sharded_shard_stall_ns", None, [_p, _i64p])
+    _sig(lib, "cache_sharded_set_probe_mode", None, [_p, _i64])
+    _sig(lib, "cache_sharded_probe_mode", _i64, [_p])
+    _sig(lib, "cache_sharded_set_affinity", None, [_p, _i64])
+    _sig(lib, "cache_sharded_affinity", _i64, [_p])
     _sig(lib, "cache_sharded_drain", _i64, [_p, _u64p, _i64p])
     _sig(lib, "cache_feed_batch_sharded", _i64, [
         _p, _p, _u64p, _i64, _i32p, _u64p, _i64p, _u64p, _i64p,
@@ -722,5 +728,170 @@ def test_sharded_feed_vs_probe_evict_sketch_decay(cache_lib):
     assert lib.cache_sharded_len(sc) <= cap
     for sk in sks:
         lib.sketch_destroy(sk)
+    lib.pending_map_destroy(pending)
+    lib.cache_sharded_destroy(sc)
+
+
+def test_probe_wave_feed_vs_mode_toggles_and_stall_readers(cache_lib):
+    """Round 17: the SIMD probe-wave walk under concurrent knob traffic.
+    One feeder drives ``cache_feed_batch_sharded`` in wave mode while a
+    TUNER thread flips ``cache_sharded_set_probe_mode`` scalar<->simd (per
+    shard under its mu — legal from any thread, unlike the pool-resizing
+    setters) and stats threads hammer the new per-shard STALL gauge plus
+    the probe/affinity getters alongside the round-14 reader set. The
+    feeder itself exercises the pool single-writer surfaces mid-run —
+    ``set_threads`` AND the round-17 ``set_affinity`` (both join/respawn
+    walkers, so only the feed caller may touch them). TSan judges that the
+    tag-array walk, the mode flag and the stall atomics never race; the
+    functional assertions pin occupancy and gauge sanity. No new mutexes
+    this round — everything above rides the existing FeedShard::mu /
+    pool_mu ranks (see scripts/lock_order.py)."""
+    lib = cache_lib
+    cap = 1 << 12
+    S = 4
+    salt = 0x17C0FFEE17C0FFEE
+    sc = lib.cache_create_sharded(cap, S, _u64(salt), 2)
+    pending = lib.pending_map_create()
+    assert sc and pending
+    lib.cache_sharded_set_probe_mode(sc, 1)
+    stop = threading.Event()
+    spans = []
+    spans_lock = threading.Lock()
+
+    def feeder():
+        rng = np.random.default_rng(SEED + 17)
+        rows = np.empty(BATCH, np.int32)
+        miss_s = np.empty(BATCH, np.uint64)
+        miss_r = np.empty(BATCH, np.int64)
+        ev_s = np.empty(cap, np.uint64)
+        ev_r = np.empty(cap, np.int64)
+        rest_src = np.empty(BATCH, np.int64)
+        rest_pos = np.empty(BATCH, np.int64)
+        n_unique = _i64(0)
+        n_evict = _i64(0)
+        n_restore = _i64(0)
+        drain_s = np.empty(cap, np.uint64)
+        drain_r = np.empty(cap, np.int64)
+        try:
+            for it in range(ITERS * 4):
+                if it % 16 == 8:
+                    # pool single-writer surfaces: resize AND re-pin the
+                    # walkers (set_affinity joins/respawns like
+                    # set_threads, so only the feed caller may call it)
+                    lib.cache_sharded_set_threads(sc, 1 + (it // 16) % S)
+                    lib.cache_sharded_set_affinity(sc, (it // 16) % 3)
+                hot = rng.integers(0, 512, BATCH // 2, dtype=np.uint64)
+                cold = rng.integers(it * 64, it * 64 + (1 << 14),
+                                    BATCH // 2, dtype=np.uint64)
+                signs = _u64arr(np.concatenate([hot, cold]))
+                n_miss = lib.cache_feed_batch_sharded(
+                    sc, pending, signs.ctypes.data_as(_u64p), BATCH,
+                    rows.ctypes.data_as(_i32p),
+                    miss_s.ctypes.data_as(_u64p), miss_r.ctypes.data_as(_i64p),
+                    ev_s.ctypes.data_as(_u64p), ev_r.ctypes.data_as(_i64p),
+                    ctypes.byref(n_unique), ctypes.byref(n_evict),
+                    rest_src.ctypes.data_as(_i64p),
+                    rest_pos.ctypes.data_as(_i64p),
+                    ctypes.byref(n_restore), _u64(salt),
+                    None, 0, 0, 0,
+                )
+                assert 0 <= n_miss <= BATCH
+                assert 0 <= n_restore.value <= n_miss
+                assert 0 < n_unique.value <= BATCH
+                ne = n_evict.value
+                if ne:
+                    evicted = _u64arr(ev_s[:ne] ^ np.uint64(salt))
+                    token = _u32(it & 0xFFFFFFFF)
+                    lib.pending_map_insert_range(
+                        pending, evicted.ctypes.data_as(_u64p), ne,
+                        it * cap, token,
+                    )
+                    with spans_lock:
+                        spans.append((evicted, token))
+                if it % 64 == 63:
+                    nd = lib.cache_sharded_drain(
+                        sc, drain_s.ctypes.data_as(_u64p),
+                        drain_r.ctypes.data_as(_i64p),
+                    )
+                    assert 0 <= nd <= cap
+        finally:
+            stop.set()
+
+    def tuner():
+        # probe-mode flips serialize with pass 1 on each shard's mu, so
+        # they are legal from OUTSIDE the feed caller — every walk sees a
+        # coherent mode and the tag array is maintained under both
+        i = 0
+        while not stop.is_set():
+            i += 1
+            lib.cache_sharded_set_probe_mode(sc, i & 1)
+            assert lib.cache_sharded_probe_mode(sc) in (0, 1)
+
+    def prober(tid):
+        def run():
+            rng = np.random.default_rng(SEED + 300 + tid)
+            rows = np.empty(256, np.int64)
+            sizes = np.empty(S, np.int64)
+            busy = np.empty(S, np.int64)
+            stall = np.empty(S, np.int64)
+            while not stop.is_set():
+                probe = _u64arr(
+                    rng.integers(0, 1 << 14, 256, dtype=np.uint64)
+                )
+                lib.cache_sharded_probe(
+                    sc, probe.ctypes.data_as(_u64p), 256,
+                    rows.ctypes.data_as(_i64p),
+                )
+                assert ((rows >= -1) & (rows < cap)).all()
+                lib.cache_sharded_shard_sizes(sc, sizes.ctypes.data_as(_i64p))
+                assert 0 <= sizes.sum() <= cap
+                lib.cache_sharded_shard_busy_ns(sc, busy.ctypes.data_as(_i64p))
+                assert (busy >= 0).all()
+                lib.cache_sharded_shard_stall_ns(
+                    sc, stall.ctypes.data_as(_i64p))
+                assert (stall >= 0).all()
+                assert 0 <= lib.cache_sharded_affinity(sc) <= 2
+                assert 1 <= lib.cache_sharded_threads(sc) <= S
+
+        return run
+
+    def writeback(tid):
+        def run():
+            rng = np.random.default_rng(SEED + 400 + tid)
+            tokens = np.empty(BATCH, np.uint32)
+            srcs = np.empty(BATCH, np.int64)
+            while not stop.is_set() or spans:
+                with spans_lock:
+                    span = spans.pop() if spans else None
+                if span is None:
+                    probe = _u64arr(
+                        rng.integers(0, 1 << 14, 64, dtype=np.uint64)
+                    )
+                    lib.pending_map_query(
+                        pending, probe.ctypes.data_as(_u64p), 64,
+                        tokens.ctypes.data_as(_u32p),
+                        srcs.ctypes.data_as(_i64p),
+                    )
+                    continue
+                signs, token = span
+                n = len(signs)
+                hits = lib.pending_map_query(
+                    pending, signs.ctypes.data_as(_u64p), n,
+                    tokens.ctypes.data_as(_u32p), srcs.ctypes.data_as(_i64p),
+                )
+                assert 0 <= hits <= n
+                lib.pending_map_remove(
+                    pending, signs.ctypes.data_as(_u64p), n, token
+                )
+
+        return run
+
+    _run_threads(
+        [feeder, tuner]
+        + [writeback(t) for t in range(2)]
+        + [prober(t) for t in range(2)]
+    )
+    assert lib.pending_map_size(pending) >= 0
+    assert lib.cache_sharded_len(sc) <= cap
     lib.pending_map_destroy(pending)
     lib.cache_sharded_destroy(sc)
