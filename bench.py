@@ -989,7 +989,9 @@ def _bench_kill_resume():
 def bench_chaos():
     """Chaos soak: the cached stream against REAL subprocess PS shards
     fronted by fault-injecting proxies (persia_tpu/chaos.py), with a
-    scripted mid-run kill + snapshot-replaying restart of one shard,
+    scripted mid-run SIGKILL of one shard that a RUNNING self-heal loop
+    (``kill_ps_autoheal`` + autopilot Healer promoting a warm standby —
+    no scripted restore) must recover from autonomously,
     plus a trainer kill-resume scenario recording recovery metrics
     (time-to-resume, steps replayed, journal hits). The record carries
     the chaos config, the injected-fault counts, breaker trips/states,
@@ -1050,19 +1052,42 @@ def bench_chaos():
     )
     with ServiceCtx(num_parameter_servers=2, num_embedding_workers=0,
                     seed=7) as svc:
+        svc.spawn_standby_ps()  # warm standby the healer promotes mid-soak
         plane = ChaosPlane(svc, cfg_chaos, schedule=[
-            ChaosAction(step=max(steps // 3, 1), op="kill_restart_ps",
-                        idx=0, restore=True),
+            # fence snapshot + SIGKILL with NO scripted restore: the
+            # running Healer (lease+probe detector -> two-phase journal ->
+            # promote the warm standby) is the only recovery path — the
+            # soak certifies the autonomous loop, not an operator script
+            ChaosAction(step=max(steps // 3, 1), op="kill_ps_autoheal",
+                        idx=0),
             # arm a seeded kill for the POST-STREAM reshard: the handoff op
             # it lands on comes from the chaos seed (reshard_fault_hook)
             ChaosAction(step=max(2 * steps // 3, 2), op="kill_during_reshard",
                         idx=1, handoff_op="import", op_index=-1),
         ])
+        healer = None
         try:
             ps = plane.ps_clients(policy=policy)
             for c in ps:
                 c.wait_ready()
             worker = EmbeddingWorker(emb_cfg, ps, policy=policy)
+            import tempfile as _tf
+
+            from persia_tpu.autopilot import enable_self_heal
+            from persia_tpu.service.failure_detector import DetectorConfig
+
+            # NOTE: the promoted slot is served by a DIRECT StoreClient
+            # (the standby's own address) — the dead shard's chaos proxy
+            # stays behind, so transport faults stop applying to that slot
+            # after the heal; fault_counts() still records what landed
+            healer = enable_self_heal(
+                svc, _tf.mkdtemp(prefix="bench_selfheal_"),
+                router=worker.lookup_router,
+                detector_config=DetectorConfig(
+                    miss_threshold=3, probe_timeout_s=0.5),
+                probe_timeout_s=0.5,
+            )
+            healer.start(interval_s=0.1)
             ctx = hbm.CachedTrainCtx(
                 model=DLRM(embedding_dim=EMB_DIM, bottom_mlp=(64, EMB_DIM),
                            top_mlp=(64,)),
@@ -1133,6 +1158,18 @@ def bench_chaos():
             if not data_faults_on:
                 assert np.isfinite(m["loss"])
             st = ctx.stream_stats() or {}
+            # the healer must not fight the reshard below (2->4->2 swaps
+            # every shard's process); stop it once the stream is drained
+            healer.stop()
+            healer.detector.close()
+            heal_rec = {
+                "heals": len(healer.mttr_s),
+                "mttr_s": [round(x, 4) for x in healer.mttr_s],
+                "pending_after": healer.pending() is not None,
+                "detector_false_positive_guard":
+                    healer.detector.false_positive_guard,
+            }
+            healer = None
             # elastic reshard under fire: the stream above is drained (the
             # fence), so grow the PS tier 2->4 with the armed seeded kill
             # landing mid-handoff, resume to completion, shrink back. The
@@ -1165,6 +1202,7 @@ def bench_chaos():
                 # trainer kill-resume recovery metrics (jobstate.py):
                 # time-to-resume, steps replayed, journal hits per mode
                 "kill_resume": _bench_kill_resume(),
+                "self_heal": heal_rec,
                 "reshard": reshard_rec,
                 "faults_injected": plane.fault_counts(),
                 "data_chaos": data_chaos.cfg.to_dict(),
@@ -1183,6 +1221,9 @@ def bench_chaos():
                 ),
             }
         finally:
+            if healer is not None:
+                healer.stop()
+                healer.detector.close()
             plane.stop()
 
 

@@ -1,0 +1,204 @@
+"""Self-healing fleet certification bench: MTTR under repeated PS death.
+
+Emits ONE JSON record (committed as BENCH_SELFHEAL.json) answering the
+questions the PR-18 tentpole exists for:
+
+1. **MTTR** — how long from SIGKILL of a PS shard to a promoted warm
+   standby serving again, fully autonomously (lease+probe
+   ``FailureDetector`` -> ``Healer`` two-phase journal ->
+   ``heal_promote``)?  K seeded kill/heal cycles, p50/p99 over the
+   detect->promoted->fresh durations the healer records itself.
+2. **Zero dropped requests** — a background lookup-load thread hammers
+   the sharded router the whole time; every call must return live rows
+   (the in-flight retry loop migrates to the promoted handle on
+   ``replace_replica``).  ``failed_requests`` and the degraded-sign set
+   must both end at zero.
+3. **Gray drain** — wall time of ``heal_drain_gray`` (snapshot the
+   still-answering replica, promote a fresh one, swap the router, then
+   retire the gray process) on a live shard.
+4. **No false positives** — a no-fault soak: N detector polls against a
+   healthy fleet must end with every verdict LIVE and the witness-rule
+   guard counter untouched.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+KILL_CYCLES = int(os.environ.get("SELFHEAL_KILLS", "5"))
+SOAK_POLLS = int(os.environ.get("SELFHEAL_SOAK_POLLS", "120"))
+N_SIGNS = 512
+DIM = 8
+SEED = 7
+
+
+def pct(xs, q):
+    return float(np.percentile(np.asarray(xs, np.float64), q)) if xs else None
+
+
+def main() -> int:
+    import tempfile
+
+    from persia_tpu.autopilot import enable_self_heal
+    from persia_tpu.embedding.worker import ShardedLookup
+    from persia_tpu.helper import ServiceCtx
+    from persia_tpu.service.clients import StoreClient
+    from persia_tpu.service.failure_detector import (
+        VERDICT_LIVE,
+        DetectorConfig,
+        FailureDetector,
+    )
+    from persia_tpu.service.resilience import ResiliencePolicy, RetryPolicy
+
+    rng = np.random.default_rng(SEED)
+    signs = np.arange(1, N_SIGNS + 1, dtype=np.uint64)
+    vals = rng.normal(size=(N_SIGNS, DIM)).astype(np.float32)
+
+    policy = ResiliencePolicy(
+        retry=RetryPolicy(max_attempts=4, base_s=0.02, max_s=0.3, seed=1),
+        breaker_failure_threshold=3, breaker_reset_s=0.3,
+        degrade_after_s=60.0,  # ride out every heal; degrading = failing
+        max_degraded_frac=1.0,
+    )
+
+    rec = {
+        "bench": "selfheal",
+        "workload": {
+            "n_ps": 2, "signs": N_SIGNS, "dim": DIM, "seed": SEED,
+            "kill_cycles": KILL_CYCLES, "soak_polls": SOAK_POLLS,
+        },
+    }
+
+    with ServiceCtx(num_parameter_servers=2, num_embedding_workers=0,
+                    backend="numpy", seed=SEED) as svc, \
+            tempfile.TemporaryDirectory() as state_dir:
+        ps = [StoreClient(a, policy=policy, timeout_s=10.0)
+              for a in svc.ps_addrs()]
+        for c in ps:
+            c.wait_ready()
+        router = ShardedLookup(ps, policy=policy)
+        router.set_embedding(signs, vals, dim=DIM)
+        ref = router.lookup(signs, DIM, train=False)
+        svc.snapshot_ps(0)
+        svc.snapshot_ps(1)
+
+        healer = enable_self_heal(
+            svc, state_dir, router=router,
+            detector_config=DetectorConfig(
+                miss_threshold=3, probe_timeout_s=0.5),
+            probe_timeout_s=0.5,
+        )
+        healer.start(interval_s=0.1)
+
+        stats = {"lookups": 0, "failed": 0, "mismatched": 0}
+        stop_load = threading.Event()
+
+        def load():
+            while not stop_load.is_set():
+                try:
+                    got = router.lookup(signs, DIM, train=False)
+                except Exception:
+                    stats["failed"] += 1
+                else:
+                    stats["lookups"] += 1
+                    if not np.array_equal(got, ref):
+                        stats["mismatched"] += 1
+                time.sleep(0.01)
+
+        loader = threading.Thread(target=load, daemon=True)
+        loader.start()
+
+        # ---- leg 1+2: K autonomous kill/heal cycles under live load ----
+        t_bench = time.time()
+        try:
+            for cycle in range(KILL_CYCLES):
+                svc.spawn_standby_ps()  # warm standby for this cycle
+                n0 = len(healer.mttr_s)
+                svc.kill_ps(1)
+                deadline = time.monotonic() + 60.0
+                while len(healer.mttr_s) <= n0:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"cycle {cycle}: no heal within 60s")
+                    time.sleep(0.02)
+                time.sleep(0.5)  # let the fleet settle between cycles
+            kill_wall_s = time.time() - t_bench
+
+            # ---- leg 3: drain-and-replace a live (gray-verdict) shard ----
+            t0 = time.monotonic()
+            svc.heal_drain_gray(0, router=router)
+            gray_drain_s = time.monotonic() - t0
+            time.sleep(0.5)
+        finally:
+            stop_load.set()
+            loader.join(timeout=10.0)
+            healer.stop()
+            healer.detector.close()
+
+        final = router.lookup(signs, DIM, train=False)
+        rec["mttr"] = {
+            "samples_s": [round(x, 4) for x in healer.mttr_s],
+            "p50_s": round(pct(healer.mttr_s, 50), 4),
+            "p99_s": round(pct(healer.mttr_s, 99), 4),
+            "heals": len(healer.mttr_s),
+            "wall_s": round(kill_wall_s, 3),
+        }
+        rec["load"] = {
+            "lookups": stats["lookups"],
+            "failed_requests": stats["failed"],
+            "value_mismatches": stats["mismatched"],
+            "degraded_signs_final": len(router._degraded_signs),
+            "final_rows_bitwise": bool(np.array_equal(final, ref)),
+        }
+        rec["gray_drain"] = {"mttr_s": round(gray_drain_s, 4)}
+        rec["journal"] = {"pending_after": healer.pending() is not None}
+
+        # ---- leg 4: no-fault soak — a fresh detector, healthy fleet ----
+        det = FailureDetector(
+            svc.ps_probes(timeout_s=0.5),
+            DetectorConfig(miss_threshold=3, probe_timeout_s=0.5),
+            lease_reader=svc.ps_lease_reader(),
+        )
+        try:
+            soak_verdicts = []
+            for _ in range(SOAK_POLLS):
+                soak_verdicts.append(det.poll_once())
+                time.sleep(0.01)
+            non_live = sum(
+                1 for vd in soak_verdicts for v in vd.values()
+                if v != VERDICT_LIVE
+            )
+            rec["soak"] = {
+                "polls": SOAK_POLLS,
+                "non_live_verdicts": non_live,
+                "false_positive_guard": det.false_positive_guard,
+            }
+        finally:
+            det.close()
+
+    ok = (
+        rec["mttr"]["heals"] == KILL_CYCLES
+        and rec["load"]["failed_requests"] == 0
+        and rec["load"]["degraded_signs_final"] == 0
+        and rec["load"]["final_rows_bitwise"]
+        and not rec["journal"]["pending_after"]
+        and rec["soak"]["false_positive_guard"] == 0
+    )
+    rec["ok"] = ok
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_SELFHEAL.json")
+    with open(out, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(json.dumps(rec, indent=1))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

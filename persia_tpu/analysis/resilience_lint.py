@@ -26,6 +26,15 @@ it IS the engine):
          that can fail forever while exporting nothing is invisible to
          alerting; every swallow-and-continue loop must count its
          failures (``counter.inc()``) so the failure rate is observable
+- RES006 a liveness decision from ONE failed probe: an ``except``
+         handler around a probe/health call that directly fires an
+         evict-class mutator (``quarantine``/``evict``/``deregister``/
+         ``remove_replica``/``replace_replica``/``kill_ps``/
+         ``mark_dead``), with no miss accounting (consecutive-miss
+         streaks, lease/verdict state, thresholds, breaker) anywhere in
+         the enclosing function. One dropped packet must never evict a
+         replica — eviction belongs downstream of an N-consecutive-miss
+         failure detector (service/failure_detector.py)
 """
 
 from __future__ import annotations
@@ -123,9 +132,55 @@ def _log_only_swallow(h: ast.ExceptHandler) -> bool:
     return True
 
 
+# RES006: probe calls whose failure must feed a counter, not a verdict
+_PROBE_TOKENS = ("healthz", "health(", "probe", "wait_ready", "ping(",
+                 "replica_info")
+# mutators that remove a replica from service — the "eviction class"
+_EVICT_TOKENS = ("quarantine", "evict", "deregister", "remove_replica",
+                 "replace_replica", "kill_ps", "mark_dead")
+# evidence the enclosing function keeps miss ACCOUNTING between probes —
+# any of these and the eviction is a thresholded decision, not a reflex
+_MISS_TOKENS = ("miss", "consecutive", "streak", "strikes", "lease",
+                "verdict", "threshold", "breaker", "fail_count", "failures")
+
+
+def _res006_findings(fn: ast.AST, path: str) -> List[Finding]:
+    """Single-probe evictions inside one function: an ``except`` handler
+    whose guarded try-body probes a replica and whose handler body fires
+    an evict-class mutator, in a function with no miss accounting."""
+    fn_src = _src(fn).lower()
+    if any(tok in fn_src for tok in _MISS_TOKENS):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Try):
+            continue
+        tried = " ".join(_src(s) for s in node.body).lower()
+        if not any(tok in tried for tok in _PROBE_TOKENS):
+            continue
+        for h in node.handlers:
+            hsrc = _src(h).lower()
+            hit = next((tok for tok in _EVICT_TOKENS if tok + "(" in hsrc
+                        or "." + tok in hsrc), None)
+            if hit is not None:
+                out.append(Finding(
+                    "RES006", path, h.lineno,
+                    f"liveness decision from a single failed probe — the "
+                    f"handler calls {hit}() directly; one dropped packet "
+                    "must never evict a replica. Count the miss and let an "
+                    "N-consecutive-miss detector "
+                    "(service/failure_detector.py) decide",
+                ))
+    return out
+
+
 def check_source(text: str, path: str) -> List[Finding]:
     findings: List[Finding] = []
     tree = ast.parse(text, filename=path)
+
+    for fn in ast.walk(tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            findings.extend(_res006_findings(fn, path))
 
     for node in ast.walk(tree):
         # RES001: constant sleep

@@ -12,9 +12,15 @@ behind one hysteresis/dwell-guarded policy engine:
 - :mod:`controller` — the :class:`Autopilot` loop: fence-driven on the
   training plane (``train_stream(fence_callback=pilot.on_fence)``),
   timer-driven on the serving plane, every decision two-phase-journaled
-  to jobstate so a SIGKILLed controller resumes its plan exactly-once.
+  to jobstate so a SIGKILLed controller resumes its plan exactly-once;
+- :mod:`heal` — the self-healing arc: the
+  :class:`~persia_tpu.service.failure_detector.FailureDetector`'s
+  lease/probe verdicts drive autonomous standby promotion for dead PS
+  shards, gray-replica drains, and fleet grow/shrink, under the same
+  two-phase journal (a SIGKILLed healer resumes its heal exactly-once).
 
-Soak evidence: ``benchmarks/autopilot_bench.py`` → ``BENCH_AUTOPILOT.json``.
+Soak evidence: ``benchmarks/autopilot_bench.py`` → ``BENCH_AUTOPILOT.json``
+and ``benchmarks/selfheal_bench.py`` → ``BENCH_SELFHEAL.json``.
 """
 
 from persia_tpu.autopilot.controller import (  # noqa: F401
@@ -24,7 +30,17 @@ from persia_tpu.autopilot.controller import (  # noqa: F401
     enable_autopilot,
     gateway_sensors,
 )
+from persia_tpu.autopilot.heal import (  # noqa: F401
+    ACTION_DRAIN_GRAY,
+    ACTION_PROMOTE,
+    ACTION_RESIZE,
+    HealConfig,
+    Healer,
+    HealPolicy,
+    enable_self_heal,
+)
 from persia_tpu.autopilot.policy import (  # noqa: F401
+    KIND_HEAL,
     KIND_REPLICATE,
     KIND_RESHARD,
     KIND_SCALE,

@@ -100,8 +100,13 @@ class Autopilot:
         resume_reshard: Optional[Callable] = None,
         scale_to: Optional[Callable] = None,
         serving_sensors: Optional[Callable] = None,
+        healer=None,
     ):
         self.policy = policy or PolicyEngine(PolicyConfig())
+        # an attached Healer (autopilot.heal) rides this controller's
+        # cadence: on_tick drives its sense->decide->heal round, resume()
+        # re-drives its planned-without-done heal before our own
+        self.healer = healer
         self.mgr = jobstate.coerce_manager(state_dir)
         self.profiler = profiler
         self.router = router
@@ -247,8 +252,13 @@ class Autopilot:
         ``--autopilot`` thread), independent of the training fence."""
         self.rounds += 1
         self._m_rounds.inc()
+        applied_heal: Dict[str, Dict] = {}
+        if self.healer is not None:
+            healed = self.healer.on_poll(step)
+            if healed is not None:
+                applied_heal["heal"] = healed
         if self._serving_sensors is None or self._scale_to is None:
-            return {}
+            return applied_heal
         sv = self._serving_sensors()
         self._m_serving.set(float(sv.get("replicas", 0)))
         record_event("autopilot.sense", step=step,
@@ -258,7 +268,7 @@ class Autopilot:
             float(sv.get("qps", 0.0)), int(sv.get("replicas", 0)),
             int(sv.get("quarantined", 0)),
         )
-        applied: Dict[str, Dict] = {}
+        applied: Dict[str, Dict] = applied_heal
         if d is not None:
             applied[KIND_SCALE] = self._drive(d, step)
         held = self.policy.suppressed - before
@@ -295,6 +305,10 @@ class Autopilot:
 
         Restores the manifest's policy state first, then commits ``done``.
         Returns the actuation result, or None when nothing was pending."""
+        if self.healer is not None:
+            # an interrupted HEAL outranks an interrupted optimization: a
+            # half-promoted standby is an availability hole
+            self.healer.resume()
         meta = self.pending()
         if meta is None:
             return None

@@ -1,0 +1,486 @@
+"""The HEAL actuator: verdicts in, exactly-once fleet repairs out.
+
+Closes the sense→decide→heal loop over the
+:class:`~persia_tpu.service.failure_detector.FailureDetector`'s verdicts
+under the SAME discipline as every other autopilot actuator:
+
+- **Guarded decisions** — DEAD heals fire immediately (the detector's
+  N-consecutive-miss rule IS the debounce; MTTR is the product), but every
+  fleet mutation is followed by a cooldown window of quiet polls so the
+  detector re-baselines against the new topology before the next decision;
+  GRAY drains additionally wait a min-dwell of stable verdicts (a replica
+  that flaps between gray and live must not be drained), and fleet resizes
+  ride the full hysteresis + dwell treatment. Held decisions count as
+  suppressed flaps, exported like the PolicyEngine's.
+- **Two-phase journal** — commit a ``planned`` manifest carrying the full
+  decision (victim, batch re-advance counts, target size) + policy state,
+  actuate, commit ``done``. The healer itself can be SIGKILLed mid-heal:
+  :meth:`Healer.resume` re-drives the newest planned-without-done decision
+  and converges exactly-once because every actuation is idempotent by
+  construction (snapshot replay into a fresh standby is deterministic,
+  coordinator registration is an upsert, ``reshard_ps`` resumes through
+  the journal-deduped elastic engine).
+- **MTTR is measured, not assumed** — each heal records
+  detect→promoted→fresh durations (``mttr_s``) into the result manifest, a
+  histogram metric, and :attr:`Healer.mttr_s` for the bench's percentiles.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import asdict, dataclass
+from typing import Callable, Dict, List, Optional
+
+from persia_tpu import jobstate
+from persia_tpu.logger import get_default_logger
+from persia_tpu.metrics import get_metrics
+from persia_tpu.tracing import record_event, span
+
+from persia_tpu.autopilot.policy import KIND_HEAL, Decision
+from persia_tpu.service.failure_detector import (
+    VERDICT_DEAD,
+    VERDICT_GRAY,
+)
+
+logger = get_default_logger("persia_tpu.autopilot.heal")
+
+ACTION_PROMOTE = "promote"
+ACTION_DRAIN_GRAY = "drain_gray"
+ACTION_RESIZE = "resize"
+
+
+@dataclass
+class HealConfig:
+    # quiet polls after ANY fleet mutation: the detector must re-baseline
+    # (fresh probes, empty latency windows) before the next decision
+    heal_cooldown_polls: int = 2
+    # a GRAY verdict must hold this many consecutive on_poll rounds
+    # before the drain fires (on top of the detector's gray_windows)
+    gray_min_dwell: int = 2
+    # --- fleet resize (grow/shrink via reshard_ps) ---
+    grow_lag_steps: float = 64.0  # freshness lag that demands capacity
+    grow_quarantine_pressure: int = 2  # quarantined replicas ditto
+    shrink_lag_frac: float = 0.25  # shrink only below this · grow_lag_steps
+    size_min: int = 1
+    size_max: int = 8
+    resize_min_dwell: int = 2  # target must persist this many rounds
+
+    def to_dict(self) -> Dict:
+        return asdict(self)
+
+
+class HealPolicy:
+    """Pure decision layer over one verdict snapshot + resize sensors.
+
+    At most ONE decision per round, priority DEAD > GRAY > resize: a dead
+    shard is an availability hole, a gray one a latency hole, a resize an
+    optimization — and healing the former usually changes the sensor
+    picture the latter would act on."""
+
+    def __init__(self, cfg: Optional[HealConfig] = None):
+        self.cfg = cfg or HealConfig()
+        self.suppressed = 0
+        self._cooldown = 0
+        self._gray_dwell: Dict[int, int] = {}
+        self._resize_target: Optional[int] = None
+        self._resize_dwell = 0
+
+    def decide(self, verdicts: Dict[int, str],
+               sensors: Optional[Dict] = None) -> Optional[Decision]:
+        c = self.cfg
+        dead = sorted(i for i, v in verdicts.items() if v == VERDICT_DEAD)
+        gray = sorted(i for i, v in verdicts.items() if v == VERDICT_GRAY)
+        # gray dwell clocks tick on verdicts, cooled down or not — a drain
+        # must not also re-wait its dwell because a promote just ran
+        for i in list(self._gray_dwell):
+            if i not in gray:
+                del self._gray_dwell[i]
+        for i in gray:
+            self._gray_dwell[i] = self._gray_dwell.get(i, 0) + 1
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            if dead or gray:
+                self.suppressed += 1
+            return None
+        if dead:
+            victim = dead[0]
+            self._cooldown = c.heal_cooldown_polls
+            return Decision(
+                KIND_HEAL,
+                f"replica {victim} DEAD (N-consecutive probe misses)",
+                {"action": ACTION_PROMOTE, "victim": int(victim)},
+            )
+        ready = [i for i in gray if self._gray_dwell.get(i, 0) >= c.gray_min_dwell]
+        if gray and not ready:
+            self.suppressed += 1  # dwell held a clearing drain back
+        if ready:
+            victim = ready[0]
+            self._cooldown = c.heal_cooldown_polls
+            self._gray_dwell.pop(victim, None)
+            return Decision(
+                KIND_HEAL,
+                f"replica {victim} GRAY for >= {c.gray_min_dwell} rounds",
+                {"action": ACTION_DRAIN_GRAY, "victim": int(victim)},
+            )
+        return self._decide_resize(sensors)
+
+    def _decide_resize(self, sensors: Optional[Dict]) -> Optional[Decision]:
+        c = self.cfg
+        if not sensors or "n_ps" not in sensors:
+            return None
+        n = int(sensors["n_ps"])
+        lag = float(sensors.get("freshness_lag", 0.0))
+        pressure = int(sensors.get("quarantine_pressure", 0))
+        if lag > c.grow_lag_steps or pressure >= c.grow_quarantine_pressure:
+            target = n + 1
+        elif (lag < c.shrink_lag_frac * c.grow_lag_steps and pressure == 0
+              and n > c.size_min):
+            target = n - 1
+        else:
+            target = n
+        target = min(max(target, c.size_min), c.size_max)
+        if target == n:
+            self._resize_target = None
+            self._resize_dwell = 0
+            return None
+        if self._resize_target != target:
+            # hysteresis dwell: a fresh target starts its clock; acting on
+            # the first breach round would flap on sensor noise
+            self._resize_target = target
+            self._resize_dwell = 1
+            self.suppressed += 1
+            return None
+        self._resize_dwell += 1
+        if self._resize_dwell <= c.resize_min_dwell:
+            self.suppressed += 1
+            return None
+        self._resize_dwell = 0
+        self._resize_target = None
+        self._cooldown = c.heal_cooldown_polls
+        return Decision(
+            KIND_HEAL,
+            f"fleet {n} -> {target} (lag {lag:.1f} steps, "
+            f"{pressure} quarantined)",
+            {"action": ACTION_RESIZE, "n_new": int(target), "from": int(n),
+             "freshness_lag": lag, "quarantine_pressure": pressure},
+        )
+
+    def export_state(self) -> Dict:
+        return {
+            "suppressed": int(self.suppressed),
+            "cooldown": int(self._cooldown),
+            "gray_dwell": {str(k): int(v) for k, v in self._gray_dwell.items()},
+            "resize_target": self._resize_target,
+            "resize_dwell": int(self._resize_dwell),
+        }
+
+    def load_state(self, state: Dict) -> None:
+        self.suppressed = int(state.get("suppressed", 0))
+        self._cooldown = int(state.get("cooldown", 0))
+        self._gray_dwell = {int(k): int(v) for k, v in
+                            (state.get("gray_dwell") or {}).items()}
+        rt = state.get("resize_target")
+        self._resize_target = None if rt is None else int(rt)
+        self._resize_dwell = int(state.get("resize_dwell", 0))
+
+
+class Healer:
+    """Two-phase journaled executor of :class:`HealPolicy` decisions.
+
+    Actuators are injected callables (same pattern as
+    :class:`~persia_tpu.autopilot.controller.Autopilot`):
+
+    - ``promote(victim, batch_advances) -> addr`` — fail a DEAD shard over
+      onto a warm standby (``ServiceCtx.heal_promote``).
+    - ``drain(victim, batch_advances) -> addr`` — live-replace a GRAY
+      replica (``ServiceCtx.heal_drain_gray``).
+    - ``resize(n_new) -> dict`` — grow/shrink the fleet
+      (``ServiceCtx.reshard_ps`` at a drained fence).
+    - ``sensors() -> dict`` — ``{"n_ps", "freshness_lag",
+      "quarantine_pressure"}`` for the resize policy.
+    - ``batch_advances() -> {group: count}`` — evaluated at PLAN time and
+      recorded in the decision manifest, so a resumed heal re-advances the
+      standby's optimizer clock from the SAME counts (bit-parity across
+      the healer's own death).
+
+    ``detector`` may be None for pure actuator tests; with one, every
+    ``on_poll`` round polls it, and a completed promote/drain resets the
+    victim's history with a fresh probe (``probe_factory(addr)``) so the
+    newcomer does not inherit the corpse's verdict."""
+
+    def __init__(
+        self,
+        state_dir,
+        *,
+        detector=None,
+        policy: Optional[HealPolicy] = None,
+        promote: Optional[Callable] = None,
+        drain: Optional[Callable] = None,
+        resize: Optional[Callable] = None,
+        sensors: Optional[Callable] = None,
+        batch_advances: Optional[Callable] = None,
+        probe_factory: Optional[Callable] = None,
+        fault_hook: Optional[Callable] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.mgr = jobstate.coerce_manager(state_dir)
+        self.detector = detector
+        self.policy = policy or HealPolicy()
+        self._promote = promote
+        self._drain = drain
+        self._resize = resize
+        self._sensors = sensors
+        self._batch_advances = batch_advances
+        self._probe_factory = probe_factory
+        self._fault_hook = fault_hook
+        self.clock = clock
+        self.rounds = 0
+        self.heals = 0
+        self.mttr_s: List[float] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        m = get_metrics()
+        self._m_decisions = m.counter(
+            "persia_tpu_heal_decisions", "heal decisions actuated, by action",
+        )
+        self._m_suppressed = m.counter(
+            "persia_tpu_heal_suppressed",
+            "heal decisions held by cooldown/dwell guards",
+        )
+        self._m_mttr = m.histogram(
+            "persia_tpu_heal_mttr_seconds",
+            "detect -> healed durations",
+            buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+        )
+        self._m_resumed = m.counter(
+            "persia_tpu_heal_resumed",
+            "planned heals re-driven after a healer crash",
+        )
+
+    # ----------------------------------------------------- two-phase drive
+
+    def _commit(self, phase: str, decision: Decision, step: int,
+                result: Optional[Dict] = None) -> None:
+        w = self.mgr.begin_epoch()
+        w.add_json("decision.json", decision.to_meta())
+        w.commit({
+            "healer": {
+                "phase": phase,
+                "step": int(step),
+                "decision": decision.to_meta(),
+                "policy_state": self.policy.export_state(),
+                "result": result or {},
+            },
+        })
+
+    def _actuate(self, decision: Decision) -> Dict:
+        p = decision.params
+        action = p["action"]
+        advances = {int(k): int(v) for k, v in
+                    (p.get("batch_advances") or {}).items()}
+        if action == ACTION_PROMOTE:
+            if self._promote is None:
+                raise RuntimeError("promote decision without an actuator")
+            addr = self._promote(int(p["victim"]), advances)
+            self._reprobe(int(p["victim"]), addr)
+            return {"addr": addr}
+        if action == ACTION_DRAIN_GRAY:
+            if self._drain is None:
+                raise RuntimeError("drain decision without an actuator")
+            addr = self._drain(int(p["victim"]), advances)
+            self._reprobe(int(p["victim"]), addr)
+            return {"addr": addr}
+        if action == ACTION_RESIZE:
+            if self._resize is None:
+                raise RuntimeError("resize decision without an actuator")
+            return dict(self._resize(int(p["n_new"])) or {})
+        raise ValueError(f"unknown heal action {action!r}")
+
+    def _reprobe(self, victim: int, addr) -> None:
+        """A fresh process answers at ``addr`` now: wipe the victim slot's
+        verdict history and point its probe at the newcomer."""
+        if self.detector is None:
+            return
+        probe = None
+        if self._probe_factory is not None and addr:
+            probe = self._probe_factory(addr)
+        self.detector.reset(victim, probe)
+
+    def _drive(self, decision: Decision, step: int,
+               detect_ts: Optional[float]) -> Dict:
+        record_event("heal.decide", step=step, action=decision.params["action"],
+                     reason=decision.reason,
+                     victim=decision.params.get("victim", -1))
+        logger.info("healer: %s @ step %d — %s",
+                    decision.params["action"], step, decision.reason)
+        self._commit("planned", decision, step)
+        if self._fault_hook is not None:
+            self._fault_hook("planned")
+        with span("heal.actuate", action=decision.params["action"], step=step):
+            result = self._actuate(decision)
+        if detect_ts is not None:
+            mttr = max(0.0, self.clock() - detect_ts)
+            result["mttr_s"] = mttr
+            self.mttr_s.append(mttr)
+            self._m_mttr.observe(mttr)
+        self._commit("done", decision, step, result)
+        self.heals += 1
+        self._m_decisions.inc(action=decision.params["action"])
+        return result
+
+    # --------------------------------------------------------------- loops
+
+    def on_poll(self, step: int = 0) -> Optional[Dict]:
+        """One sense→decide→heal round. Safe to call from a timer thread
+        or inline from a test; flap protection is the policy's
+        cooldown/dwell guards, not the call cadence."""
+        self.rounds += 1
+        if self.detector is None:
+            return None
+        verdicts = self.detector.poll_once()
+        sensors = self._sensors() if self._sensors is not None else None
+        before = self.policy.suppressed
+        decision = self.policy.decide(verdicts, sensors)
+        held = self.policy.suppressed - before
+        if held:
+            self._m_suppressed.inc(held)
+            record_event("heal.suppressed", step=step, held=held)
+        if decision is None:
+            return None
+        p = decision.params
+        if p["action"] in (ACTION_PROMOTE, ACTION_DRAIN_GRAY):
+            if self._batch_advances is not None:
+                p["batch_advances"] = {
+                    str(k): int(v)
+                    for k, v in (self._batch_advances() or {}).items()
+                }
+            detect_ts = self.detector.detected_at(int(p["victim"]))
+        else:
+            detect_ts = None
+        return self._drive(decision, step, detect_ts)
+
+    def start(self, interval_s: float = 0.5) -> "Healer":
+        """Background poll loop — the autonomous mode the flagship chaos
+        test runs in (no operator call). Decision flap protection lives in
+        the policy's cooldown/dwell guards (see HealPolicy.decide)."""
+
+        def run():
+            step = 0
+            while not self._stop.wait(interval_s):
+                step += 1
+                try:
+                    self.on_poll(step)
+                except Exception as e:
+                    # the healer must outlive a failed heal attempt — the
+                    # planned manifest keeps it resumable; count loudly
+                    get_metrics().counter(
+                        "persia_tpu_heal_errors",
+                        "heal rounds that raised (resume token persists)",
+                    ).inc()
+                    logger.warning("heal round failed: %s", e)
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="persia-healer")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    # -------------------------------------------------------------- resume
+
+    def pending(self) -> Optional[Dict]:
+        man = self.mgr.latest()
+        if man is None:
+            return None
+        meta = man.meta.get("healer")
+        if not meta or meta.get("phase") != "planned":
+            return None
+        return meta
+
+    def resume(self) -> Optional[Dict]:
+        """Re-drive a heal interrupted by SIGKILL, exactly-once: the
+        planned manifest carries the victim and the recorded batch
+        re-advance counts, and every actuation is idempotent (promote
+        replays the same snapshot + advances into a standby and upserts
+        the registration; resize resumes through the journal-deduped
+        elastic engine). A clean log returns None; a second resume after
+        completion is a no-op."""
+        meta = self.pending()
+        if meta is None:
+            return None
+        decision = Decision.from_meta(meta["decision"])
+        step = int(meta.get("step", 0))
+        self.policy.load_state(meta.get("policy_state", {}))
+        record_event("heal.resume", step=step,
+                     action=decision.params["action"])
+        logger.info("healer: resuming planned %s from step %d",
+                    decision.params["action"], step)
+        with span("heal.resume", action=decision.params["action"], step=step):
+            result = self._actuate(decision)
+        self._commit("done", decision, step, result)
+        self.heals += 1
+        self._m_resumed.inc()
+        self._m_decisions.inc(action=decision.params["action"])
+        return result
+
+
+# ------------------------------------------------------------------ wiring
+
+
+def enable_self_heal(
+    svc,
+    state_dir: str,
+    *,
+    router=None,
+    config: Optional[HealConfig] = None,
+    detector=None,
+    detector_config=None,
+    sensors: Optional[Callable] = None,
+    batch_advances: Optional[Callable] = None,
+    reshard_state_dir=None,
+    probe_timeout_s: float = 1.0,
+    fault_hook: Optional[Callable] = None,
+) -> Healer:
+    """Wire a Healer over a live ``ServiceCtx``: probes + leases feed a
+    FailureDetector, decisions journal under ``state_dir/heal``, resizes
+    run their elastic phase manifests under ``state_dir/reshard`` (or
+    ``reshard_state_dir``). The caller starts the loop
+    (``healer.start(interval_s)``) or drives ``on_poll`` from a fence."""
+    import os
+
+    from persia_tpu.service.failure_detector import (
+        FailureDetector,
+        make_probe,
+    )
+
+    if detector is None:
+        detector = FailureDetector(
+            svc.ps_probes(timeout_s=probe_timeout_s),
+            detector_config,
+            lease_reader=svc.ps_lease_reader(),
+        )
+    reshard_mgr = jobstate.coerce_manager(
+        reshard_state_dir if reshard_state_dir is not None
+        else os.path.join(str(state_dir), "reshard")
+    )
+    return Healer(
+        os.path.join(str(state_dir), "heal"),
+        detector=detector,
+        policy=HealPolicy(config),
+        promote=lambda victim, ba: svc.heal_promote(
+            victim, router=router, batch_advances=ba, fault_hook=fault_hook,
+        ),
+        drain=lambda victim, ba: svc.heal_drain_gray(
+            victim, router=router, batch_advances=ba, fault_hook=fault_hook,
+        ),
+        resize=lambda n_new: svc.reshard_ps(
+            n_new, reshard_mgr, router=router,
+        ),
+        sensors=sensors,
+        batch_advances=batch_advances,
+        probe_factory=lambda addr: make_probe(addr, timeout_s=probe_timeout_s),
+    )

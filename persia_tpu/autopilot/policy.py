@@ -34,6 +34,7 @@ from persia_tpu.embedding.tiering.shard_planner import ShardPlanner
 KIND_RESHARD = "reshard"
 KIND_REPLICATE = "replicate"
 KIND_SCALE = "scale"
+KIND_HEAL = "heal"  # decided by autopilot.heal.HealPolicy, not PolicyEngine
 
 
 @dataclass

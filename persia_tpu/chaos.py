@@ -125,6 +125,11 @@ class ChaosProxy:
         self.cfg = cfg or ChaosConfig()
         self.name = name or backend_addr
         self.blackhole = threading.Event()
+        # forced per-frame latency floor (gray-failure injection): unlike
+        # the probabilistic ``slow_prob`` this delays EVERY frame, turning
+        # the backend into a replica that still answers — just at p99 far
+        # above its peers. Float so tests can set sub-ms floors.
+        self._forced_latency_s = 0.0
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind(("127.0.0.1", 0))
@@ -139,7 +144,7 @@ class ChaosProxy:
         # fired; bench records it in the artifact)
         self.counts: Dict[str, int] = {
             "frames": 0, "refused": 0, "reset": 0, "slow": 0,
-            "corrupt": 0, "truncated": 0,
+            "corrupt": 0, "truncated": 0, "grayed": 0,
         }
         m = get_metrics()
         self._m_injected = m.counter(
@@ -190,6 +195,15 @@ class ChaosProxy:
             self._kill_live()
         else:
             self.blackhole.clear()
+
+    def set_latency(self, ms: float) -> None:
+        """Gray-failure injection: force a latency floor of ``ms`` onto
+        EVERY forwarded frame (0 restores transparency). The backend keeps
+        answering correctly — it just becomes a sustained latency outlier
+        against its peers, which is exactly the failure class a liveness
+        probe alone cannot see."""
+        self._forced_latency_s = max(float(ms), 0.0) / 1e3
+        record_event("chaos.set_latency", proxy=self.name, ms=float(ms))
 
     # ------------------------------------------------------------- pumping
 
@@ -281,6 +295,10 @@ class ChaosProxy:
                 self._close_pair(src, dst)
                 return
             try:
+                forced = self._forced_latency_s
+                if forced > 0.0:
+                    self._note_fault("grayed")
+                    time.sleep(forced)
                 r = rng.random()
                 if cfg.reset_prob and r < cfg.reset_prob:
                     # mid-frame cut: the peer sees a partial frame + EOF
@@ -831,7 +849,16 @@ class ChaosAction:
     """One scripted process/topology fault, fired when the driving loop
     reaches ``step``. ``op``: ``kill_ps`` | ``restart_ps`` |
     ``kill_restart_ps`` (kill + immediate same-port restart) |
+    ``kill_ps_autoheal`` (snapshot then SIGKILL, and deliberately NO
+    restart — the self-healing autopilot is expected to detect the death
+    and promote a standby on its own; the schedule just makes the hole) |
     ``blackhole`` / ``heal`` (partition one shard's proxy) |
+    ``gray_ps`` / ``ungray_ps`` (force/clear a per-frame latency floor of
+    ``latency_ms`` on one shard's proxy — the replica still answers, at
+    p99 far above its peers: the gray-failure injector) |
+    ``heartbeat_ghost`` (SIGKILL the shard but keep publishing its
+    heartbeat lease from this process — heartbeat-only death: the lease
+    plane says alive while the data plane is gone) |
     ``snapshot`` (record the shard's state for a later replaying
     restart).
 
@@ -862,6 +889,7 @@ class ChaosAction:
     # instead (same seed → same kill point, run to run).
     handoff_op: str = "import"  # "import" | "delete"
     op_index: int = 0
+    latency_ms: float = 250.0  # gray_ps forced per-frame latency floor
 
 
 class ChaosPlane:
@@ -894,6 +922,9 @@ class ChaosPlane:
         # kill_during_reshard arms land here; reshard_fault_hook consumes
         self._reshard_arms: List[ChaosAction] = []
         self._reshard_counts: Dict[str, int] = {"reshard_kills": 0}
+        # heartbeat_ghost publishers keep a dead shard's lease fresh until
+        # stop() exorcises them
+        self._ghosts: List["HeartbeatGhost"] = []
 
     def attach_trainer(self, proc) -> None:
         """Register the trainer subprocess the ``kill_trainer`` op targets
@@ -986,10 +1017,23 @@ class ChaosPlane:
                 self.svc.snapshot_ps(a.idx)
             self.svc.kill_ps(a.idx)
             self.svc.restart_ps(a.idx, restore=a.restore)
+        elif a.op == "kill_ps_autoheal":
+            # snapshot first so the healer's standby promotion has a fresh
+            # fence to boot-load from; then make the hole and WALK AWAY —
+            # recovery is the autopilot's job, not the schedule's
+            self.svc.snapshot_ps(a.idx)
+            self.svc.kill_ps(a.idx)
         elif a.op == "blackhole":
             self.proxies[a.idx].set_blackhole(True)
         elif a.op == "heal":
             self.proxies[a.idx].set_blackhole(False)
+        elif a.op == "gray_ps":
+            self.proxies[a.idx].set_latency(a.latency_ms)
+        elif a.op == "ungray_ps":
+            self.proxies[a.idx].set_latency(0.0)
+        elif a.op == "heartbeat_ghost":
+            self._ghosts.append(HeartbeatGhost.haunt(self.svc, a.idx))
+            self.svc.kill_ps(a.idx)
         elif a.op == "kill_during_reshard":
             self._reshard_arms.append(a)
         elif a.op == "kill_trainer":
@@ -1011,5 +1055,70 @@ class ChaosPlane:
             yield b
 
     def stop(self) -> None:
+        for g in self._ghosts:
+            g.stop()
+        self._ghosts = []
         for p in self.proxies:
             p.stop()
+
+
+# -------------------------------------------------- detector-facing chaos
+
+
+class HeartbeatGhost:
+    """Heartbeat-only death: keeps publishing a DEAD replica's lease.
+
+    Wraps a :class:`~persia_tpu.service.failure_detector.LeasePublisher`
+    bound to the victim's (role, index, addr) identity, run from the
+    chaos harness's own process. To the lease plane the replica looks
+    perfectly alive (seq keeps advancing); to the data plane it is gone.
+    A detector that trusts heartbeats over probes never evicts it — the
+    exact failure mode the verdict matrix's "fresh lease does not rescue
+    failing probes" rule exists for.
+    """
+
+    def __init__(self, coord, role: str, index: int, addr: str,
+                 interval_s: float = 0.2):
+        from persia_tpu.service.failure_detector import LeasePublisher
+
+        self._pub = LeasePublisher(
+            coord, role, index, addr, interval_s=interval_s
+        )
+        self._pub.start()
+        record_event("chaos.heartbeat_ghost", role=role, index=index)
+        logger.info("chaos: heartbeat ghost haunting %s/%d (%s)",
+                    role, index, addr)
+
+    @classmethod
+    def haunt(cls, svc, idx: int, interval_s: float = 0.2) -> "HeartbeatGhost":
+        """Possess PS ``idx`` of a ServiceCtx: publish its lease identity
+        from here. Call BEFORE (or right after) killing the process."""
+        return cls(svc.coord_client, "parameter_server", idx,
+                   svc.ps_addrs()[idx], interval_s=interval_s)
+
+    def stop(self) -> None:
+        self._pub.stop()
+
+
+def partition_view(probes: Dict[int, "object"], cut: Sequence[int]) -> Dict:
+    """Observer-side partial partition: wrap a detector probe dict so the
+    probes for replicas in ``cut`` raise (this OBSERVER cannot reach them;
+    the replicas themselves are fine and other observers still can). Feed
+    the wrapped dict to a FailureDetector to exercise the
+    majority-of-peers witness rule: an observer cut off from most of the
+    fleet must suspect ITSELF (withhold DEAD) rather than evict everyone
+    it cannot see."""
+    cut_set = set(int(i) for i in cut)
+
+    def _severed(idx: int, inner):
+        def probe() -> None:
+            raise OSError(f"chaos: partitioned from replica {idx}")
+
+        probe.addr = getattr(inner, "addr", "")  # type: ignore[attr-defined]
+        probe.close = getattr(inner, "close", lambda: None)  # type: ignore[attr-defined]
+        return probe
+
+    return {
+        idx: (_severed(idx, p) if idx in cut_set else p)
+        for idx, p in probes.items()
+    }

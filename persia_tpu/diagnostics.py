@@ -112,7 +112,41 @@ class StallDetector:
         if stalled:
             self.stall_count += 1
             dump_all_stacks(f"components stalled >{self.stall_after_s}s: {stalled}")
+        self._surface(stalled)
         return stalled
+
+    def _surface(self, stalled: list) -> None:
+        # silent-component detection feeds the observability plane, not
+        # just the log: a counter + gauge for alerting, and a flight
+        # event so the recorder's ring carries WHICH components went
+        # quiet. Lazy imports keep diagnostics importable before the
+        # metrics registry exists (it is started by binaries' main()).
+        try:
+            from persia_tpu.metrics import get_metrics
+
+            m = get_metrics()
+            m.gauge(
+                "persia_tpu_stalled_components",
+                "components currently silent past the stall threshold",
+            ).set(float(len(stalled)))
+            if stalled:
+                m.counter(
+                    "persia_tpu_stall_events",
+                    "stall-detector scans that found silent components",
+                ).inc()
+        except Exception:  # pragma: no cover - metrics plane optional
+            pass
+        if stalled:
+            try:
+                from persia_tpu.tracing import record_event
+
+                record_event(
+                    "diagnostics.stall",
+                    components=",".join(sorted(stalled)),
+                    stall_after_s=self.stall_after_s,
+                )
+            except Exception:  # pragma: no cover - tracing plane optional
+                pass
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):

@@ -253,6 +253,22 @@ def find_reshard_manifest(
     return None
 
 
+def prime_joiner(client, optimizer, batch_advances: Optional[Dict]) -> None:
+    """Bring a FRESH store onto the fleet's optimizer time-base before it
+    serves its first train lookup: register the optimizer (a store without
+    it re-initializes imported entries on entry-width mismatch), then
+    re-advance the per-group batch counters (Adam beta-power schedule) to
+    the fence. Single-sourced for every path that births a replica
+    mid-job — reshard joiners, resume-restored joiners, and standby
+    promotion: a parked standby that skips this applies Adam updates from
+    t=0 and silently diverges bitwise from the survivors."""
+    if optimizer is not None:
+        client.register_optimizer(optimizer)
+    for group, count in (batch_advances or {}).items():
+        for _ in range(int(count)):
+            client.advance_batch_state(int(group))
+
+
 # ------------------------------------------------------------------ execution
 
 FaultHook = Callable[[str, int, Move], None]

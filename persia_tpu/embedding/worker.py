@@ -526,22 +526,48 @@ class ShardedLookup:
             with self._deg_lock:
                 self._degraded_signs.difference_update(int(s) for s in mine)
 
+    def _slot_of(self, rep) -> Optional[int]:
+        """Identity-resolve ``rep``'s slot in the CURRENT topology (None
+        for a handle that is no longer — or never was — a member)."""
+        for i, r in enumerate(self._topo[0]):
+            if r is rep:
+                return i
+        return None
+
+    def _resolve_slot(self, slot: Optional[int], cur):
+        """The fresh handle now occupying ``slot``, or None if ``cur`` is
+        still it (or the slot is unknown)."""
+        if slot is None:
+            return None
+        reps = self._topo[0]
+        if slot < len(reps) and reps[slot] is not cur:
+            return reps[slot]
+        return None
+
     def _guarded(self, rep, fn, signs_for_fallback, fallback):
         """One replica call under the resilience policy: transport failures
         block-retry (riding breaker half-open probes via ``wait_ready``)
         while the ``degrade_after_s`` budget lasts, then either serve the
-        degraded ``fallback`` (recording the signs) or raise. Returns
-        ``(result, degraded)``."""
+        degraded ``fallback`` (recording the signs) or raise. ``fn`` takes
+        the replica handle to call, because the call is NOT pinned to the
+        handle it started on: each retry re-resolves the slot against the
+        current topology, so a call in flight when ``replace_replica``
+        promoted a standby migrates to the fresh process instead of
+        burning the whole degrade budget against the corpse (the
+        self-heal path's "no dropped in-flight requests" contract).
+        Returns ``(result, degraded)``."""
         pol = self.policy
+        cur = rep
         if pol is None or pol.degrade_after_s is None:
-            return self._with_recovery(rep, fn), False
+            return self._with_recovery(cur, lambda: fn(cur)), False
         from persia_tpu.service.rpc import _is_transportish
 
+        slot = self._slot_of(rep)
         t0 = time.monotonic()
         attempt = 0
         while True:
             try:
-                return self._with_recovery(rep, fn), False
+                return self._with_recovery(cur, lambda: fn(cur)), False
             except Exception as e:  # noqa: BLE001 — classify then decide
                 if not _is_transportish(e):
                     raise
@@ -550,13 +576,19 @@ class ShardedLookup:
                     if fallback is None:
                         raise
                     break
+                # a concurrent heal may have swapped this slot's handle —
+                # migrate and retry immediately (the fresh process answers)
+                swapped = self._resolve_slot(slot, cur)
+                if swapped is not None:
+                    cur = swapped
+                    continue
                 # wait for the shard to answer probes again (ping is
                 # breaker-exempt: its success re-closes the breaker), then
                 # retry the real call; if even the probe times out, back off
                 ready = False
                 try:
-                    if hasattr(rep, "wait_ready"):
-                        rep.wait_ready(
+                    if hasattr(cur, "wait_ready"):
+                        cur.wait_ready(
                             timeout_s=min(max(budget_left, 0.05), 1.0)
                         )
                         ready = True
@@ -766,7 +798,7 @@ class ShardedLookup:
 
                 flat, deg = self._guarded(
                     r0,
-                    lambda: r0.lookup_batched(all_keys, key_ofs, dims, train),
+                    lambda rep: rep.lookup_batched(all_keys, key_ofs, dims, train),
                     all_keys, fb,
                 )
                 if deg:
@@ -776,7 +808,7 @@ class ShardedLookup:
                 return _split_flat_rows(flat, key_ofs, dims)
             return self._concurrent_groups([
                 (lambda k=k, d=d: self._guarded(
-                    r0, lambda: r0.lookup(k, d, train), k,
+                    r0, lambda rep: rep.lookup(k, d, train), k,
                     lambda k=k, d=d: self._degraded_rows(k, d))[0])
                 for k, d in groups
             ])
@@ -790,7 +822,7 @@ class ShardedLookup:
             sub_keys = all_keys[pos]
             sub_ofs = np.searchsorted(pos, key_ofs).astype(np.int64)
 
-            def live():
+            def live(rep):
                 if hasattr(rep, "lookup_batched"):
                     flat = rep.lookup_batched(sub_keys, sub_ofs, dims, train)
                     return _split_flat_rows(flat, sub_ofs, dims)
@@ -896,8 +928,8 @@ class ShardedLookup:
                 if journal_id is not None and hasattr(r0, "update_batched_journaled"):
                     self._guarded_update(
                         r0,
-                        lambda: self._journaled_update_batched(
-                            r0, 0, journal_id, all_keys, key_ofs, dims, flat,
+                        lambda rep: self._journaled_update_batched(
+                            rep, 0, journal_id, all_keys, key_ofs, dims, flat,
                             opt_groups,
                         ),
                         len(all_keys),
@@ -905,13 +937,13 @@ class ShardedLookup:
                     return
                 self._guarded_update(
                     r0,
-                    lambda: r0.update_batched(all_keys, key_ofs, dims, flat, opt_groups),
+                    lambda rep: rep.update_batched(all_keys, key_ofs, dims, flat, opt_groups),
                     len(all_keys),
                 )
                 return
             self._concurrent_groups([
                 (lambda k=k, g=g, og=og: self._guarded_update(
-                    r0, lambda: r0.update_gradients(k, g, og), len(k)))
+                    r0, lambda rep, k=k, g=g, og=og: rep.update_gradients(k, g, og), len(k)))
                 for k, g, og in groups
             ])
             return
@@ -935,7 +967,7 @@ class ShardedLookup:
                 if journal_id is not None and hasattr(rep, "update_batched_journaled"):
                     self._guarded_update(
                         rep,
-                        lambda: self._journaled_update_batched(
+                        lambda rep: self._journaled_update_batched(
                             rep, ridx, journal_id, sub_keys, sub_ofs, dims,
                             flat, opt_groups,
                         ),
@@ -944,14 +976,14 @@ class ShardedLookup:
                     return
                 self._guarded_update(
                     rep,
-                    lambda: rep.update_batched(sub_keys, sub_ofs, dims, flat, opt_groups),
+                    lambda rep: rep.update_batched(sub_keys, sub_ofs, dims, flat, opt_groups),
                     len(sub_keys),
                 )
                 return
             self._concurrent_groups([
                 (lambda g=g: self._guarded_update(
                     rep,
-                    lambda: rep.update_gradients(
+                    lambda rep, g=g: rep.update_gradients(
                         sub_keys[sub_ofs[g]:sub_ofs[g + 1]], subs[g],
                         int(opt_groups[g]),
                     ),
@@ -972,7 +1004,7 @@ class ShardedLookup:
         if n == 1:
             r0 = self.replicas[0]
             vals, deg = self._guarded(
-                r0, lambda: r0.lookup(keys, dim, train), keys,
+                r0, lambda rep: rep.lookup(keys, dim, train), keys,
                 lambda: self._degraded_rows(keys, dim),
             )
             if deg:
@@ -986,7 +1018,7 @@ class ShardedLookup:
         def one(rep, idx):
             sub = keys[idx]
             return self._guarded(
-                rep, lambda: rep.lookup(sub, dim, train), sub,
+                rep, lambda rep: rep.lookup(sub, dim, train), sub,
                 lambda: self._degraded_rows(sub, dim),
             )
 
@@ -1015,13 +1047,13 @@ class ShardedLookup:
         if n == 1:
             r0 = self.replicas[0]
             return self._guarded(
-                r0, lambda: r0.checkout_entries(signs, dim), signs, None
+                r0, lambda rep: rep.checkout_entries(signs, dim), signs, None
             )[0]
         out: Optional[np.ndarray] = None
         sel = self._partition(signs)
         thunks = [
             (lambda rep=self.replicas[r], idx=idx: self._guarded(
-                rep, lambda: rep.checkout_entries(signs[idx], dim),
+                rep, lambda rep, idx=idx: rep.checkout_entries(signs[idx], dim),
                 signs[idx], None)[0])
             for r, idx in sel
         ]
@@ -1064,7 +1096,7 @@ class ShardedLookup:
             if getattr(r, "supports_probe_out", False):
                 res, deg = self._guarded(
                     r,
-                    lambda: r.probe_entries(
+                    lambda rep: rep.probe_entries(
                         signs, dim, vals_out=vals_out, warm_out=warm_out
                     ),
                     signs, fallback,
@@ -1075,7 +1107,7 @@ class ShardedLookup:
                     self._record_served(signs)
                 return res
             (warm, vals), deg = self._guarded(
-                r, lambda: r.probe_entries(signs, dim), signs, fallback
+                r, lambda rep: rep.probe_entries(signs, dim), signs, fallback
             )
             if deg:
                 self._check_abort(len(signs), len(signs))
@@ -1103,7 +1135,7 @@ class ShardedLookup:
             # degraded marker: (None, None) — the assembly leaves warm
             # False and vals zeroed for that replica's span (= cold)
             return self._guarded(
-                rep, lambda: rep.probe_entries(sub, dim), sub,
+                rep, lambda rep: rep.probe_entries(sub, dim), sub,
                 lambda: (None, None),
             )
 
@@ -1144,7 +1176,7 @@ class ShardedLookup:
             r0 = self.replicas[0]
             self._guarded_update(
                 r0,
-                lambda: r0.set_embedding(
+                lambda rep: rep.set_embedding(
                     signs, values, dim, commit_incremental=commit_incremental
                 ),
                 len(signs), counter=self._m_down_wb_dropped,
@@ -1153,7 +1185,7 @@ class ShardedLookup:
         self._concurrent([
             (lambda rep=self.replicas[r], idx=idx: self._guarded_update(
                 rep,
-                lambda: rep.set_embedding(
+                lambda rep, idx=idx: rep.set_embedding(
                     signs[idx], values[idx], dim,
                     commit_incremental=commit_incremental,
                 ),
@@ -1182,7 +1214,7 @@ class ShardedLookup:
         self.batch_advances[group] = self.batch_advances.get(group, 0) + 1
         self._concurrent([
             (lambda rep=r: self._guarded_update(
-                rep, lambda rep=rep: rep.advance_batch_state(group), 0))
+                rep, lambda rep: rep.advance_batch_state(group), 0))
             for r in self.replicas
         ])
 
@@ -1197,13 +1229,13 @@ class ShardedLookup:
         if n == 1:
             r0 = self.replicas[0]
             self._guarded_update(
-                r0, lambda: r0.update_gradients(keys, grads, group), len(keys)
+                r0, lambda rep: rep.update_gradients(keys, grads, group), len(keys)
             )
             return
         self._concurrent([
             (lambda rep=self.replicas[r], idx=idx: self._guarded_update(
                 rep,
-                lambda: rep.update_gradients(keys[idx], grads[idx], group),
+                lambda rep, idx=idx: rep.update_gradients(keys[idx], grads[idx], group),
                 len(keys[idx]),
             ))
             for r, idx in self._partition(keys)

@@ -320,22 +320,116 @@ class ServiceCtx:
         self._standbys.append((addr, p))
         return addr
 
-    def promote_standby(self, i: int, standby_addr: Optional[str] = None) -> str:
+    def promote_standby(self, i: int, standby_addr: Optional[str] = None,
+                        batch_advances: Optional[dict] = None) -> str:
         """Fail shard ``i`` over onto a standby: replay the last snapshot
         into it and upsert the coordinator registration so new clients
         resolve the standby's address. Callers holding an in-process
         router should also swap the replica handle
-        (``router.replace_replica(i, StoreClient(new_addr))``). Returns
-        the promoted address."""
+        (``router.replace_replica(i, StoreClient(new_addr))``).
+
+        ``batch_advances`` (``{group: count}``) re-advances the standby's
+        per-group optimizer batch counters to the fleet's fence — a parked
+        standby never saw a batch, so its Adam beta powers sit at t=0 while
+        the survivors advanced; shard snapshots carry entries, NOT the
+        batch-state clock (same contract as the elastic joiner path).
+        Returns the promoted address."""
+        from persia_tpu import elastic
+
+        proc = None
         if standby_addr is None:
             if not self._standbys:
                 raise RuntimeError("no standby spawned (spawn_standby_ps first)")
-            standby_addr, _ = self._standbys.pop(0)
+            standby_addr, proc = self._standbys.pop(0)
+        else:
+            for j, (a, p) in enumerate(self._standbys):
+                if a == standby_addr:
+                    proc = self._standbys.pop(j)[1]
+                    break
         c = StoreClient(standby_addr)
         c.wait_ready(timeout_s=self.startup_timeout_s)
         self._replay_snapshot(i, c)
+        # optimizer came from the snapshot replay; only the batch-state
+        # clock is left to catch up
+        elastic.prime_joiner(c, None, batch_advances)
         self.coord_client.register("parameter_server", i, standby_addr)
+        if proc is not None:
+            while len(self._ps_procs) <= i:
+                self._ps_procs.append(proc)
+            self._ps_procs[i] = proc
         return standby_addr
+
+    # ------------------------------------------------------- self-heal hooks
+
+    def heal_promote(self, i: int, *, router=None,
+                     batch_advances: Optional[dict] = None,
+                     fault_hook=None) -> str:
+        """Autonomous failover of a DEAD shard ``i``: promote a warm
+        standby (spawning one when none is parked), then swap the live
+        router handle so in-flight callers migrate without an operator.
+
+        Idempotent end to end — snapshot replay into a fresh standby is
+        deterministic, batch re-advance is replayed from the same counts,
+        and the coordinator registration is an upsert — so the healer's
+        two-phase journal may re-drive this after a mid-heal SIGKILL and
+        converge on a bit-identical fleet (a half-promoted orphan standby
+        is re-pointed away from and reaped at teardown). ``fault_hook``
+        (stage names ``"promoted"``/``"swapped"``) is the chaos plane's
+        mid-heal crash injection point."""
+        if not self._standbys:
+            self.spawn_standby_ps()
+        addr = self.promote_standby(i, batch_advances=batch_advances)
+        if fault_hook is not None:
+            fault_hook("promoted")
+        if router is not None:
+            router.replace_replica(i, StoreClient(addr))
+        if fault_hook is not None:
+            fault_hook("swapped")
+        logger.info("heal: promoted standby %s for dead ps %d", addr, i)
+        return addr
+
+    def heal_drain_gray(self, i: int, *, router=None,
+                        batch_advances: Optional[dict] = None,
+                        fault_hook=None) -> str:
+        """Replace a limping (GRAY) replica without dropping in-flight
+        requests: live-snapshot it (it still answers — that is what makes
+        it gray rather than dead), promote a standby from that fresh
+        snapshot, swap the router so NEW calls route to the standby while
+        calls already in flight finish on the old handle, then drain the
+        old process with a graceful shutdown RPC."""
+        old_addr = self.ps_addrs()[i]
+        old_proc = self._ps_procs[i] if i < len(self._ps_procs) else None
+        self.snapshot_ps(i)
+        if fault_hook is not None:
+            fault_hook("snapshotted")
+        if not self._standbys:
+            self.spawn_standby_ps()
+        addr = self.promote_standby(i, batch_advances=batch_advances)
+        if fault_hook is not None:
+            fault_hook("promoted")
+        if router is not None:
+            router.replace_replica(i, StoreClient(addr))
+        if fault_hook is not None:
+            fault_hook("swapped")
+        # drain, don't SIGKILL: the shutdown RPC lets handlers already on
+        # the old socket complete before the process exits
+        if old_proc is not None and old_proc.poll() is None:
+            self._expected_dead.add(old_proc.pid)
+            StoreClient(old_addr).shutdown()
+        logger.info("heal: drained gray ps %d (%s -> %s)", i, old_addr, addr)
+        return addr
+
+    def ps_probes(self, timeout_s: float = 1.0) -> dict:
+        """Per-replica one-attempt healthz probes for a FailureDetector."""
+        from persia_tpu.service.failure_detector import ps_fleet_probes
+
+        return ps_fleet_probes(self.ps_addrs(), timeout_s=timeout_s)
+
+    def ps_lease_reader(self):
+        """Lease scan over the PS fleet's coordinator kv leases."""
+        from persia_tpu.service.failure_detector import coordinator_lease_reader
+
+        return coordinator_lease_reader(self.coord_client, "parameter_server")
 
     # ------------------------------------------------------ elastic reshard
 
@@ -418,11 +512,7 @@ class ServiceCtx:
         # mismatch at the first train lookup (see _replay_snapshot), and
         # Adam joiners additionally re-advance beta powers to the fence
         for i in range(old_n, n_new):
-            if opt is not None:
-                dests[i].register_optimizer(opt)
-            for group, count in (batch_advances or {}).items():
-                for _ in range(int(count)):
-                    dests[i].advance_batch_state(int(group))
+            elastic.prime_joiner(dests[i], opt, batch_advances)
         self.n_ps = max(old_n, n_new)
 
         stats = elastic.execute_reshard(
@@ -494,14 +584,11 @@ class ServiceCtx:
                     # a joiner's journal died with it: restart FRESH, the
                     # replayed imports re-apply the identical blobs
                     self.restart_ps(i, restore=False)
-                    c = StoreClient(addrs[i])
-                    if opt_dict:
-                        c.register_optimizer(OptimizerConfig.from_dict(opt_dict))
-                    for group, count in (
-                        man.meta.get("batch_advances") or {}
-                    ).items():
-                        for _ in range(int(count)):
-                            c.advance_batch_state(int(group))
+                    elastic.prime_joiner(
+                        StoreClient(addrs[i]),
+                        OptimizerConfig.from_dict(opt_dict) if opt_dict else None,
+                        man.meta.get("batch_advances"),
+                    )
         else:  # "imported": only surviving replicas matter for the deletes
             for i in range(plan.new_n):
                 if dead(i):

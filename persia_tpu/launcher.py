@@ -139,6 +139,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                           "can arm the fence-driven PS side too")
     loc.add_argument("--autopilot-interval-s", type=float, default=2.0,
                      help="serving autopilot sense/decide cadence")
+    loc.add_argument("--self-heal", action="store_true",
+                     help="arm the self-healing PS control plane (needs "
+                          "--ps > 0): lease+probe failure detector feeding "
+                          "an autonomous standby-promotion healer "
+                          "(persia_tpu/autopilot/heal.py)")
+    loc.add_argument("--self-heal-interval-s", type=float, default=0.5,
+                     help="failure-detector poll cadence")
     loc.add_argument("--seed", type=int, default=7)
     loc.add_argument("--trace-dir", type=str, default=None,
                      help="arm fleet tracing: every role serves /metrics + "
@@ -271,6 +278,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             if args.autopilot:
                 topo.start_autopilot(interval_s=args.autopilot_interval_s)
                 print("autopilot armed (serving plane)", flush=True)
+            if args.self_heal:
+                if args.ps <= 0:
+                    print("--self-heal needs --ps > 0", file=sys.stderr)
+                    return 2
+                topo.start_self_heal(interval_s=args.self_heal_interval_s)
+                print("self-heal armed (PS plane)", flush=True)
             if args.reshard_ps > 0:
                 if args.ps <= 0:
                     print("--reshard-ps needs --ps > 0", file=sys.stderr)

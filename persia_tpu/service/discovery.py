@@ -31,6 +31,7 @@ class Coordinator:
         self.server.register("list", self._list)
         self.server.register("kv_put", self._kv_put)
         self.server.register("kv_get", self._kv_get)
+        self.server.register("kv_keys", self._kv_keys)
         self.port = self.server.port
 
     def start(self) -> "Coordinator":
@@ -72,6 +73,15 @@ class Coordinator:
     def _kv_get(self, payload: bytes) -> bytes:
         with self._lock:
             return self._kv.get(payload.decode(), b"")
+
+    def _kv_keys(self, payload: bytes) -> bytes:
+        # prefix listing for the failure detector's lease scan: one RPC
+        # returns every ``lease/...`` key instead of N point reads
+        prefix = payload.decode()
+        with self._lock:
+            return proto.pack_json(
+                sorted(k for k in self._kv if k.startswith(prefix))
+            )
 
 
 class CoordinatorClient:
@@ -125,6 +135,11 @@ class CoordinatorClient:
 
     def kv_get(self, key: str) -> bytes:
         return self._client.call("kv_get", key.encode(), idempotent=True)
+
+    def kv_keys(self, prefix: str) -> List[str]:
+        return proto.unpack_json(
+            self._client.call("kv_keys", prefix.encode(), idempotent=True)
+        )
 
     def close(self):
         self._client.close()

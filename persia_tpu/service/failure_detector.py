@@ -1,0 +1,499 @@
+"""Lease-based failure detection for the PS fleet.
+
+The reference assumes an operator notices a dead embedding parameter
+server; every recovery *mechanism* here (standby promotion, degraded-mode
+lookups, journaled replay) existed without *detection*. This module closes
+the sensing half of the self-healing loop:
+
+- **Leases** — every fleet process publishes a monotone-sequence heartbeat
+  lease through the coordinator kv (``lease/<role>/<index>``). A lease that
+  stops advancing is a *control-plane* signal only: the data plane stays
+  authoritative, so a replica whose heartbeat thread died but which still
+  answers probes is SUSPECT, never evicted (and the inverse — a ghost
+  heartbeat for a dead process — cannot keep it alive).
+- **N-consecutive-miss probing** — direct data-plane probes (``healthz``)
+  with a single attempt and no retry; ONE dropped probe never changes a
+  verdict. Only ``miss_threshold`` consecutive misses produce DEAD.
+- **Phi-style gray scoring** — a replica that answers but whose rolling
+  median latency sits ≫ the fleet median of its peers for
+  ``gray_windows`` consecutive polls is GRAY (limping: flaky NIC, swapping
+  host, half-partitioned). Gray replicas are drained, not SIGKILLed.
+- **Majority-of-peers witness rule** — a DEAD verdict is withheld when the
+  observer cannot reach a majority of the *other* replicas in the same
+  poll: the observer is then probably the partitioned party, and evicting
+  the whole fleet from one isolated vantage point is the classic
+  split-brain failure this rule exists to prevent.
+
+The detector is deliberately passive — it produces verdicts; acting on
+them (promotion, drain, resize) is ``persia_tpu/autopilot/heal.py``'s job
+under the two-phase journal discipline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, List, Optional
+
+from persia_tpu.logger import get_default_logger
+
+logger = get_default_logger("persia_tpu.service.failure_detector")
+
+LEASE_PREFIX = "lease/"
+
+VERDICT_LIVE = "live"
+VERDICT_SUSPECT = "suspect"
+VERDICT_DEAD = "dead"
+VERDICT_GRAY = "gray"
+
+
+def lease_key(role: str, index: int) -> str:
+    return f"{LEASE_PREFIX}{role}/{index}"
+
+
+def _metrics():
+    from persia_tpu.metrics import get_metrics
+
+    return get_metrics()
+
+
+def _record_event(kind: str, **attrs) -> None:
+    try:
+        from persia_tpu.tracing import record_event
+
+        record_event(kind, **attrs)
+    except Exception:  # pragma: no cover - tracing plane optional
+        pass
+
+
+class LeasePublisher:
+    """Background thread publishing a monotone-seq lease for one process.
+
+    Publish errors are swallowed (a flapping coordinator must not kill the
+    PS it is supposed to watch) but always counted — an un-metered publish
+    loop failing forever would silently demote this replica to lease-less.
+    Each beat also feeds :mod:`persia_tpu.diagnostics` so the in-process
+    stall detector sees the publisher itself.
+    """
+
+    def __init__(self, coord, role: str, index: int, addr: str,
+                 interval_s: float = 0.5):
+        self._coord = coord
+        self.role = role
+        self.index = int(index)
+        self.addr = addr
+        self.interval_s = float(interval_s)
+        self.seq = 0
+        self.publish_errors = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def publish_once(self) -> None:
+        self.seq += 1
+        payload = json.dumps({
+            "seq": self.seq,
+            "pid": os.getpid(),
+            "addr": self.addr,
+            "time_wall": time.time(),
+        }).encode()
+        self._coord.kv_put(lease_key(self.role, self.index), payload)
+
+    def _run(self) -> None:
+        from persia_tpu import diagnostics
+
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.publish_once()
+                diagnostics.heartbeat(f"lease:{self.role}/{self.index}")
+            except Exception as e:
+                self.publish_errors += 1
+                _metrics().counter(
+                    "persia_tpu_lease_publish_errors",
+                    "lease kv_put failures (coordinator unreachable)",
+                ).inc(1.0, role=self.role)
+                logger.debug("lease publish failed for %s/%d: %s",
+                             self.role, self.index, e)
+
+    def start(self) -> "LeasePublisher":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"persia-lease-{self.role}-{self.index}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def maybe_start_lease_publisher(coord, role: str, index: int,
+                                addr: str) -> Optional[LeasePublisher]:
+    """Env-gated publisher start for fleet binaries (default ON when a
+    coordinator is configured; ``PERSIA_LEASE=0`` opts out, e.g. the chaos
+    suite's heartbeat-only-death injector wants manual control)."""
+    if os.environ.get("PERSIA_LEASE", "1") not in ("1", "true"):
+        return None
+    interval = float(os.environ.get("PERSIA_LEASE_INTERVAL_S", "0.5"))
+    return LeasePublisher(coord, role, index, addr,
+                          interval_s=interval).start()
+
+
+@dataclass
+class DetectorConfig:
+    # probes: one dropped probe NEVER evicts — only miss_threshold
+    # consecutive misses produce DEAD
+    miss_threshold: int = 3
+    probe_timeout_s: float = 1.0
+    # leases: control-plane staleness bound; a stale lease alone is only
+    # ever SUSPECT (data plane authoritative)
+    lease_ttl_s: float = 3.0
+    # gray (limping) verdicts: replica rolling-median latency must exceed
+    # max(gray_factor × fleet-median-of-peers, gray_min_latency_s) for
+    # gray_windows CONSECUTIVE polls — a single latency spike is not gray
+    gray_factor: float = 4.0
+    gray_windows: int = 3
+    gray_min_latency_s: float = 0.05
+    window: int = 16
+    # partition witness: withhold DEAD unless the observer reached at
+    # least this fraction of the OTHER replicas in the same poll
+    min_peer_witness_frac: float = 0.5
+
+
+@dataclass
+class ReplicaHealth:
+    verdict: str = VERDICT_LIVE
+    miss_streak: int = 0
+    gray_streak: int = 0
+    last_latency_s: Optional[float] = None
+    median_latency_s: Optional[float] = None
+    lease_seq: Optional[int] = None
+    lease_fresh: Optional[bool] = None
+    since: float = 0.0  # clock() of the last verdict transition
+    latencies: Deque[float] = field(default_factory=lambda: deque(maxlen=16))
+
+
+class FailureDetector:
+    """Poll-driven verdict engine over a probe set + optional lease reader.
+
+    ``probes`` maps replica index → zero-arg callable returning the probe
+    latency in seconds (raising on failure). ``lease_reader`` (optional)
+    returns ``{index: {"seq": int, ...}}`` from the coordinator kv.
+    ``clock`` is injectable so tests drive lease aging deterministically.
+
+    Verdict matrix per replica each :meth:`poll_once`:
+
+    ==================  ===========  ==========================================
+    probe               lease        verdict
+    ==================  ===========  ==========================================
+    ok                  fresh/none   LIVE (or GRAY after a sustained outlier)
+    ok                  stale        SUSPECT — heartbeat-silent, never evicted
+    miss < threshold    any          SUSPECT
+    miss ≥ threshold    any          DEAD — unless the witness rule withholds
+                                     (observer reached < majority of peers →
+                                     SUSPECT: *I* am probably partitioned)
+    ==================  ===========  ==========================================
+
+    Note the heartbeat-only-death row is implicit: a FRESH lease does not
+    rescue a replica whose data plane stopped answering — probes dominate.
+    """
+
+    def __init__(self, probes: Dict[int, Callable[[], float]],
+                 cfg: Optional[DetectorConfig] = None,
+                 lease_reader: Optional[Callable[[], Dict[int, dict]]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = cfg or DetectorConfig()
+        self.clock = clock
+        self._probes = dict(probes)
+        self._lease_reader = lease_reader
+        self._health: Dict[int, ReplicaHealth] = {}
+        # lease bookkeeping: idx -> (last_seq, clock at last advance)
+        self._lease_seen: Dict[int, tuple] = {}
+        self._lock = threading.Lock()
+        self.polls = 0
+        self.false_positive_guard = 0  # DEADs withheld by the witness rule
+        for idx in self._probes:
+            self._health[idx] = self._fresh_health()
+
+    def _fresh_health(self) -> ReplicaHealth:
+        h = ReplicaHealth(since=self.clock())
+        h.latencies = deque(maxlen=self.cfg.window)
+        return h
+
+    # -- fleet membership (heal/resize paths) -------------------------------
+
+    def add(self, idx: int, probe: Callable[[], float]) -> None:
+        with self._lock:
+            self._probes[idx] = probe
+            self._health[idx] = self._fresh_health()
+            self._lease_seen.pop(idx, None)
+
+    def remove(self, idx: int) -> None:
+        with self._lock:
+            probe = self._probes.pop(idx, None)
+            self._health.pop(idx, None)
+            self._lease_seen.pop(idx, None)
+        close = getattr(probe, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:
+                pass
+
+    def reset(self, idx: int, probe: Optional[Callable[[], float]] = None) -> None:
+        """Forget a replica's history after a heal replaced the process
+        behind it — the newcomer must not inherit the corpse's verdict."""
+        with self._lock:
+            if probe is not None:
+                old = self._probes.get(idx)
+                self._probes[idx] = probe
+            else:
+                old = None
+            self._health[idx] = self._fresh_health()
+            self._lease_seen.pop(idx, None)
+        if old is not None and old is not probe:
+            close = getattr(old, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
+
+    # -- the poll ------------------------------------------------------------
+
+    def _read_leases(self) -> Dict[int, dict]:
+        if self._lease_reader is None:
+            return {}
+        try:
+            return self._lease_reader() or {}
+        except Exception as e:
+            _metrics().counter(
+                "persia_tpu_detector_lease_read_errors",
+                "lease scan failures (coordinator unreachable)",
+            ).inc()
+            logger.debug("lease scan failed: %s", e)
+            return {}
+
+    def poll_once(self) -> Dict[int, str]:
+        """Probe every replica once and re-derive all verdicts. Returns
+        ``{index: verdict}``. Thread-safe with add/remove/reset."""
+        with self._lock:
+            probes = dict(self._probes)
+        now = self.clock()
+        self.polls += 1
+        leases = self._read_leases()
+
+        probe_ok: Dict[int, bool] = {}
+        latency: Dict[int, float] = {}
+        for idx, probe in probes.items():
+            try:
+                latency[idx] = float(probe())
+                probe_ok[idx] = True
+            except Exception:
+                probe_ok[idx] = False
+                _metrics().counter(
+                    "persia_tpu_detector_probe_misses",
+                    "single probe failures (N of these make a DEAD verdict)",
+                ).inc(1.0, replica=str(idx))
+
+        with self._lock:
+            # pass 1: streaks + lease freshness + rolling latency windows
+            for idx in probes:
+                h = self._health.get(idx)
+                if h is None:
+                    h = self._health[idx] = self._fresh_health()
+                if probe_ok[idx]:
+                    h.miss_streak = 0
+                    h.last_latency_s = latency[idx]
+                    h.latencies.append(latency[idx])
+                    if h.latencies:
+                        h.median_latency_s = statistics.median(h.latencies)
+                else:
+                    h.miss_streak += 1
+                lease = leases.get(idx)
+                if lease is not None and "seq" in lease:
+                    seq = int(lease["seq"])
+                    h.lease_seq = seq
+                    prev = self._lease_seen.get(idx)
+                    if prev is None or seq > prev[0]:
+                        self._lease_seen[idx] = (seq, now)
+                seen = self._lease_seen.get(idx)
+                if seen is None:
+                    h.lease_fresh = None  # never leased → lease plane mute
+                else:
+                    h.lease_fresh = (now - seen[1]) <= self.cfg.lease_ttl_s
+
+            # pass 2: fleet latency baseline from the peers' medians
+            medians = {i: h.median_latency_s for i, h in self._health.items()
+                       if i in probes and h.median_latency_s is not None}
+
+            # witness: what fraction of OTHER replicas did this poll reach
+            verdicts: Dict[int, str] = {}
+            for idx in probes:
+                h = self._health[idx]
+                peers = [i for i in probes if i != idx]
+                if probe_ok[idx]:
+                    verdicts[idx] = self._verdict_alive(idx, h, medians, peers)
+                else:
+                    verdicts[idx] = self._verdict_missing(
+                        idx, h, probe_ok, peers)
+                self._transition(idx, h, verdicts[idx], now)
+            try:
+                g = _metrics().gauge(
+                    "persia_tpu_detector_verdicts",
+                    "replicas per verdict class",
+                )
+                for v in (VERDICT_LIVE, VERDICT_SUSPECT, VERDICT_DEAD,
+                          VERDICT_GRAY):
+                    g.set(float(sum(1 for x in verdicts.values() if x == v)),
+                          verdict=v)
+            except Exception:  # pragma: no cover - metrics plane optional
+                pass
+            return verdicts
+
+    def _verdict_alive(self, idx: int, h: ReplicaHealth,
+                       medians: Dict[int, float], peers: List[int]) -> str:
+        # heartbeat-silent: answers probes but the lease stopped advancing
+        # — the control plane lost this replica, the data plane did not.
+        # Surface, never evict.
+        if h.lease_fresh is False:
+            h.gray_streak = 0
+            return VERDICT_SUSPECT
+        peer_medians = [medians[i] for i in peers if i in medians]
+        mine = h.median_latency_s
+        if mine is not None and len(peer_medians) >= 2:
+            fleet = statistics.median(peer_medians)
+            bar = max(self.cfg.gray_factor * fleet, self.cfg.gray_min_latency_s)
+            if mine > bar:
+                h.gray_streak += 1
+            else:
+                h.gray_streak = 0
+        else:
+            h.gray_streak = 0
+        if h.gray_streak >= self.cfg.gray_windows:
+            return VERDICT_GRAY
+        return VERDICT_LIVE
+
+    def _verdict_missing(self, idx: int, h: ReplicaHealth,
+                         probe_ok: Dict[int, bool], peers: List[int]) -> str:
+        h.gray_streak = 0
+        if h.miss_streak < self.cfg.miss_threshold:
+            return VERDICT_SUSPECT
+        # NOTE a fresh lease does NOT rescue: probes are the data plane and
+        # the data plane is authoritative (heartbeat-only death).
+        if peers:
+            reached = sum(1 for i in peers if probe_ok.get(i))
+            if reached < self.cfg.min_peer_witness_frac * len(peers):
+                # the observer cannot see a majority of the fleet: *it* is
+                # probably the partitioned party. Withhold DEAD — a lone
+                # vantage point must not evict everyone else.
+                self.false_positive_guard += 1
+                return VERDICT_SUSPECT
+        return VERDICT_DEAD
+
+    def _transition(self, idx: int, h: ReplicaHealth, verdict: str,
+                    now: float) -> None:
+        if verdict == h.verdict:
+            return
+        prev, h.verdict, h.since = h.verdict, verdict, now
+        logger.info("replica %d verdict %s -> %s (miss=%d gray=%d lease=%s)",
+                    idx, prev, verdict, h.miss_streak, h.gray_streak,
+                    h.lease_fresh)
+        _record_event("detector.verdict", replica=idx, verdict=verdict,
+                      prev=prev, miss_streak=h.miss_streak,
+                      gray_streak=h.gray_streak)
+        try:
+            _metrics().counter(
+                "persia_tpu_detector_transitions",
+                "verdict transitions",
+            ).inc(1.0, verdict=verdict)
+        except Exception:  # pragma: no cover
+            pass
+
+    # -- introspection -------------------------------------------------------
+
+    def health(self) -> Dict[int, ReplicaHealth]:
+        with self._lock:
+            return dict(self._health)
+
+    def verdicts(self) -> Dict[int, str]:
+        with self._lock:
+            return {i: h.verdict for i, h in self._health.items()}
+
+    def detected_at(self, idx: int) -> Optional[float]:
+        """clock() timestamp of the replica's current verdict transition —
+        the healer's MTTR measurement starts here."""
+        with self._lock:
+            h = self._health.get(idx)
+            return None if h is None else h.since
+
+    def close(self) -> None:
+        with self._lock:
+            probes = list(self._probes.values())
+            self._probes.clear()
+        for p in probes:
+            close = getattr(p, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except OSError:
+                    pass
+
+
+# -- wiring helpers ----------------------------------------------------------
+
+
+def make_probe(addr: str, timeout_s: float = 1.0) -> Callable[[], float]:
+    """One-attempt ``healthz`` probe against a PS/worker RPC endpoint.
+
+    No retries and a breaker that never opens: the DETECTOR owns the
+    miss-streak accounting — a retrying probe would hide exactly the
+    misses the N-consecutive rule needs to count.
+    """
+    from persia_tpu.service import resilience
+    from persia_tpu.service.rpc import RpcClient
+
+    policy = resilience.ResiliencePolicy(
+        retry=resilience.RetryPolicy(max_attempts=1, base_s=0.0, jitter=0.0),
+        breaker_failure_threshold=1 << 30,
+    )
+    client = RpcClient(addr, timeout_s=timeout_s, policy=policy, pool_size=1)
+
+    def _probe() -> float:
+        t0 = time.perf_counter()
+        client.call("healthz", idempotent=False)
+        return time.perf_counter() - t0
+
+    _probe.addr = addr  # type: ignore[attr-defined]
+    _probe.close = client.close  # type: ignore[attr-defined]
+    return _probe
+
+
+def ps_fleet_probes(addrs: List[str],
+                    timeout_s: float = 1.0) -> Dict[int, Callable[[], float]]:
+    return {i: make_probe(a, timeout_s=timeout_s) for i, a in enumerate(addrs)}
+
+
+def coordinator_lease_reader(coord, role: str = "ps"
+                             ) -> Callable[[], Dict[int, dict]]:
+    """Lease scan via the coordinator kv's prefix listing."""
+    prefix = f"{LEASE_PREFIX}{role}/"
+
+    def _read() -> Dict[int, dict]:
+        out: Dict[int, dict] = {}
+        for key in coord.kv_keys(prefix):
+            raw = coord.kv_get(key)
+            if not raw:
+                continue
+            try:
+                out[int(key.rsplit("/", 1)[1])] = json.loads(raw.decode())
+            except (ValueError, KeyError, IndexError):
+                continue
+        return out
+
+    return _read

@@ -440,12 +440,26 @@ def main(argv: Optional[list] = None) -> None:
         IncrementalLoader(
             store, g.parameter_server.incremental_dir, skip_before_us=skip_before_us
         ).start()
+    lease = None
     if args.coordinator:
-        CoordinatorClient(args.coordinator).register(
-            "parameter_server", replica_index, f"{args.advertise_host}:{svc.port}"
+        coord = CoordinatorClient(args.coordinator)
+        addr = f"{args.advertise_host}:{svc.port}"
+        coord.register("parameter_server", replica_index, addr)
+        # heartbeat lease for the failure detector (monotone seq through
+        # the coordinator kv; each beat also feeds the in-process stall
+        # detector). Default on; PERSIA_LEASE=0 opts out (the chaos
+        # suite's heartbeat-only-death injector wants manual control).
+        from persia_tpu.service.failure_detector import (
+            maybe_start_lease_publisher,
+        )
+
+        lease = maybe_start_lease_publisher(
+            coord, "parameter_server", replica_index, addr
         )
     # server runs in its background thread; park until the 'shutdown' RPC
     svc.server._thread.join()
+    if lease is not None:
+        lease.stop()
     if inc_mgr is not None:
         # ship the final flush window before exit (the reference flushes on
         # drop); without this the last seconds of updates never reach serving

@@ -197,11 +197,20 @@ def main(argv: Optional[list] = None) -> None:
         "embedding worker %d/%d on port %d (%d parameter servers)",
         replica_index, replica_size, svc.port, len(ps_addrs),
     )
-    coord.register("embedding_worker", replica_index, f"{args.advertise_host}:{svc.port}")
+    worker_addr = f"{args.advertise_host}:{svc.port}"
+    coord.register("embedding_worker", replica_index, worker_addr)
     from persia_tpu.diagnostics import maybe_start_from_env
+    from persia_tpu.service.failure_detector import maybe_start_lease_publisher
 
     maybe_start_from_env()  # opt-in deadlock/stall detector (ref: lib.rs:494)
+    # heartbeat lease for the failure detector; each beat also feeds the
+    # stall detector above (PERSIA_LEASE=0 opts out)
+    lease = maybe_start_lease_publisher(
+        coord, "embedding_worker", replica_index, worker_addr
+    )
     svc.server._thread.join()
+    if lease is not None:
+        lease.stop()
 
 
 if __name__ == "__main__":

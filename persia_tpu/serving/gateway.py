@@ -409,9 +409,15 @@ class ReplicaGateway:
         self._pool.shutdown(wait=False)
 
     def _health_loop(self) -> None:
+        from persia_tpu import diagnostics
+
         while not self._stop.wait(self.health_interval_s):
             try:
                 self._probe_all()
+                # the prober is itself a liveness-critical component: beat
+                # the stall detector so a wedged sweep surfaces as a
+                # diagnostics.stall flight event instead of silent rot
+                diagnostics.heartbeat("gateway-health")
             except Exception as e:  # noqa: BLE001 — prober must survive
                 self._m_probe_errors.inc()
                 logger.warning("health probe sweep failed: %s", e)

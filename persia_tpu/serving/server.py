@@ -390,10 +390,19 @@ class ServingServer:
         if self._coordinator_addr:
             try:
                 from persia_tpu.service.discovery import CoordinatorClient
+                from persia_tpu.service.failure_detector import (
+                    maybe_start_lease_publisher,
+                )
 
                 self._coordinator_client = CoordinatorClient(self._coordinator_addr)
                 self._coordinator_client.register(
                     "inference", self.replica_index, f"127.0.0.1:{self.port}"
+                )
+                # heartbeat lease for the failure detector / the gateway's
+                # silent-replica diagnostics (PERSIA_LEASE=0 opts out)
+                self._lease = maybe_start_lease_publisher(
+                    self._coordinator_client, "inference",
+                    self.replica_index, f"127.0.0.1:{self.port}",
                 )
             except Exception as e:  # noqa: BLE001 — serve even if discovery is down
                 logger.warning("coordinator registration failed: %s", e)
@@ -402,6 +411,8 @@ class ServingServer:
         return self
 
     def stop(self) -> None:
+        if getattr(self, "_lease", None) is not None:
+            self._lease.stop()
         if self.rollover is not None:
             self.rollover.stop()
         self._httpd.shutdown()

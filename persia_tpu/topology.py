@@ -388,6 +388,7 @@ class LocalTopology:
         self.autopilot = None
         self._ap_stop = threading.Event()
         self._ap_thread: Optional[threading.Thread] = None
+        self.healer = None
         self._env = dict(os.environ, JAX_PLATFORMS="cpu")
         self._env["PYTHONPATH"] = (
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -635,6 +636,29 @@ class LocalTopology:
         self._ap_thread.start()
         return self.autopilot
 
+    def start_self_heal(self, interval_s: float = 0.5, **kw):
+        """Arm the self-healing control plane over the PS tier (needs
+        ``ps > 0``): a lease+probe :class:`FailureDetector` feeding a
+        :class:`~persia_tpu.autopilot.Healer` whose decisions journal
+        under ``base_dir/selfheal`` — a SIGKILLed PS is detected, a warm
+        standby promoted from the last fence snapshot, and the fleet
+        registration re-pointed, with no operator in the loop. Any heal
+        interrupted by a parent crash is re-driven by ``resume()`` on
+        re-arm. Extra ``**kw`` forwards to
+        :func:`~persia_tpu.autopilot.enable_self_heal` (router, configs,
+        sensors...)."""
+        from persia_tpu.autopilot import enable_self_heal
+
+        if self.svc is None:
+            raise RuntimeError("start_self_heal needs a PS tier (ps > 0)")
+        if self.healer is None:
+            state = os.path.join(self.base_dir, "selfheal")
+            os.makedirs(state, exist_ok=True)
+            self.healer = enable_self_heal(self.svc, state, **kw)
+            self.healer.resume()
+            self.healer.start(interval_s)
+        return self.healer
+
     def reshard_ps(self, n_new: int, **kw) -> Dict:
         """Live-reshard the PS tier to ``n_new`` replicas (needs ``ps > 0``):
         delegates to :meth:`ServiceCtx.reshard_ps` with a journal dir under
@@ -688,6 +712,9 @@ class LocalTopology:
             out["delta_channel"] = dict(self.delta_chaos.counts)
         if self.autopilot is not None:
             out["autopilot_rounds"] = self.autopilot.rounds
+        if self.healer is not None:
+            out["heal_verdicts"] = self.healer.detector.verdicts()
+            out["heal_mttr_s"] = list(self.healer.mttr_s)
         if self.svc is not None:
             out["n_ps"] = self.svc.n_ps
             if self.svc.ps_ring is not None:
@@ -813,6 +840,10 @@ class LocalTopology:
         return out
 
     def stop(self) -> None:
+        if self.healer is not None:
+            self.healer.stop()
+            self.healer.detector.close()
+            self.healer = None
         self._ap_stop.set()
         if self._ap_thread is not None:
             self._ap_thread.join(timeout=5)

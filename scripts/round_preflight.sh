@@ -126,6 +126,13 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_autopilot.py -q -m 'not slow'
 # in-proc; the subprocess native-fleet grow 2->4 rides the full suite
 JAX_PLATFORMS=cpu python -m pytest tests/test_probe_layout.py -q \
     -k "export_range or mixed_backend"
+# self-healing failover fast subset (ISSUE 18): the lease+probe
+# FailureDetector verdict matrix (one miss never evicts, partition
+# witness rule), HealPolicy dwell/cooldown, the Healer's exactly-once
+# journal resume, and the in-flight lookup migration across
+# replace_replica; the flagship SIGKILL-mid-stream autonomous-heal
+# bit-parity runs ride the full suite in step 2
+JAX_PLATFORMS=cpu python -m pytest tests/test_selfheal.py -q -m 'not slow'
 
 echo "== 1.5/5 telemetry plane (trace propagation + flight recorder) =="
 # the fast tracing/telemetry subset: span mechanics, RPC + gateway HTTP

@@ -1,0 +1,11 @@
+"""RES006 seed: a liveness decision made from ONE failed probe — the
+handler evicts the replica directly, with no miss accounting anywhere in
+the function; a single dropped packet takes a healthy shard out of
+service."""
+
+
+def watch_replica(client, fleet, idx):
+    try:
+        client.healthz()
+    except Exception:
+        fleet.remove_replica(idx)  # one packet loss = eviction

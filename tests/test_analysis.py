@@ -370,6 +370,7 @@ def test_jax004_sees_imported_jit_through_registry():
         ("res_adhoc_retry.py", "RES003"),
         ("res_manual_deadline.py", "RES004"),
         ("res_swallow_no_metric.py", "RES005"),
+        ("res_single_probe_evict.py", "RES006"),
     ],
 )
 def test_resilience_rule_fires(fixture, rule):
@@ -427,6 +428,40 @@ def test_res005_handler_with_state_change_is_allowed():
         "            bad += 1\n"
     )
     assert resilience_lint.check_source(src, "stateful.py") == []
+
+
+def test_res006_thresholded_eviction_is_allowed():
+    # miss accounting in the function makes the eviction a thresholded
+    # decision — an N-consecutive-miss detector, not a one-probe reflex
+    thresholded = (
+        "def watch_replica(client, fleet, idx, miss_streak):\n"
+        "    try:\n"
+        "        client.healthz()\n"
+        "        miss_streak[idx] = 0\n"
+        "    except Exception:\n"
+        "        miss_streak[idx] += 1\n"
+        "        if miss_streak[idx] >= 3:\n"
+        "            fleet.remove_replica(idx)\n"
+    )
+    assert resilience_lint.check_source(thresholded, "thresholded.py") == []
+    # a handler that only counts the miss never fires RES006
+    counting = (
+        "def poll(client, m_miss):\n"
+        "    try:\n"
+        "        client.healthz()\n"
+        "    except Exception:\n"
+        "        m_miss.inc()\n"
+    )
+    assert resilience_lint.check_source(counting, "counting.py") == []
+    # eviction without a probe in the try body is out of RES006's scope
+    no_probe = (
+        "def drop(fleet, idx, load):\n"
+        "    try:\n"
+        "        load()\n"
+        "    except Exception:\n"
+        "        fleet.remove_replica(idx)\n"
+    )
+    assert resilience_lint.check_source(no_probe, "no_probe.py") == []
 
 
 def test_resilience_policy_driven_loop_is_allowed():

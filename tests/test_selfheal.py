@@ -1,0 +1,813 @@
+"""Self-healing fleet: failure detector, heal policy, and chaos certification.
+
+Fast tests (tier-1): the verdict matrix of the lease+probe FailureDetector
+(one miss never evicts, N-consecutive-miss DEAD, sustained-outlier GRAY vs a
+single spike, heartbeat-only death, silent-heartbeat SUSPECT, the
+majority-of-peers partition witness rule in both directions), the lease
+publisher/reader roundtrip against a real Coordinator, the HealPolicy's
+cooldown/dwell/hysteresis guards, the Healer's two-phase journal with an
+exactly-once resume through a flaky actuator, and the stall-watchdog's
+metric surfacing.
+
+Slow tests: the flagship autonomous-self-heal chaos run (SIGKILL a PS shard
+mid-``train_stream`` with a running healer and NO operator call — the
+stream must complete bit-identical to a fault-free replay), the Adam
+batch-advance promotion-parity pin (satellite: a parked standby's optimizer
+clock), and a SIGKILL-the-healer-mid-promotion resume against a real fleet.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from persia_tpu.autopilot.heal import (
+    ACTION_PROMOTE,
+    ACTION_RESIZE,
+    HealConfig,
+    Healer,
+    HealPolicy,
+)
+from persia_tpu.service.failure_detector import (
+    VERDICT_DEAD,
+    VERDICT_GRAY,
+    VERDICT_LIVE,
+    VERDICT_SUSPECT,
+    DetectorConfig,
+    FailureDetector,
+    LeasePublisher,
+    coordinator_lease_reader,
+    lease_key,
+    make_probe,
+    maybe_start_lease_publisher,
+)
+
+
+# ------------------------------------------------------------ probe stubs
+
+
+class StubProbe:
+    """Controllable probe: flip ``ok``/``latency_s`` between polls."""
+
+    def __init__(self, latency_s: float = 0.001):
+        self.ok = True
+        self.latency_s = latency_s
+        self.addr = "stub:0"
+        self.calls = 0
+
+    def __call__(self) -> float:
+        self.calls += 1
+        if not self.ok:
+            raise OSError("probe refused")
+        return self.latency_s
+
+    def close(self) -> None:
+        pass
+
+
+def _fleet(n: int, **cfg_kw):
+    probes = {i: StubProbe() for i in range(n)}
+    det = FailureDetector(probes, DetectorConfig(**cfg_kw))
+    return probes, det
+
+
+# ------------------------------------------------------- verdict matrix
+
+
+def test_single_miss_is_suspect_never_dead():
+    probes, det = _fleet(3, miss_threshold=3)
+    assert det.poll_once() == {0: VERDICT_LIVE, 1: VERDICT_LIVE, 2: VERDICT_LIVE}
+    probes[1].ok = False
+    assert det.poll_once()[1] == VERDICT_SUSPECT  # ONE miss: suspect only
+    probes[1].ok = True
+    assert det.poll_once()[1] == VERDICT_LIVE  # recovery clears the streak
+    assert det.health()[1].miss_streak == 0
+
+
+def test_n_consecutive_misses_make_dead():
+    probes, det = _fleet(3, miss_threshold=3)
+    probes[1].ok = False
+    verdicts = [det.poll_once()[1] for _ in range(3)]
+    assert verdicts == [VERDICT_SUSPECT, VERDICT_SUSPECT, VERDICT_DEAD]
+    # the detection timestamp is the DEAD transition (MTTR starts here)
+    assert det.detected_at(1) is not None
+
+
+def test_interleaved_success_resets_the_streak():
+    probes, det = _fleet(3, miss_threshold=3)
+    for _ in range(4):  # miss, hit, miss, hit ... never accumulates
+        probes[1].ok = False
+        assert det.poll_once()[1] == VERDICT_SUSPECT
+        probes[1].ok = True
+        assert det.poll_once()[1] == VERDICT_LIVE
+
+
+def test_gray_needs_sustained_outlier_not_one_spike():
+    probes, det = _fleet(
+        3, gray_factor=4.0, gray_windows=3, gray_min_latency_s=0.01, window=4
+    )
+    for _ in range(4):  # healthy baseline fills the rolling windows
+        det.poll_once()
+    # one spike: the rolling median shifts briefly, but never for
+    # gray_windows consecutive polls — a spike is not a limp
+    probes[0].latency_s = 0.5
+    det.poll_once()
+    probes[0].latency_s = 0.001
+    for _ in range(6):
+        assert det.poll_once()[0] != VERDICT_GRAY
+    # sustained outlier: median sits above 4x fleet median for 3+ polls
+    probes[0].latency_s = 0.5
+    seen = [det.poll_once()[0] for _ in range(6)]
+    assert VERDICT_GRAY in seen
+    assert det.verdicts()[0] == VERDICT_GRAY
+    # and the limp clearing un-grays it
+    probes[0].latency_s = 0.001
+    for _ in range(8):
+        det.poll_once()
+    assert det.verdicts()[0] == VERDICT_LIVE
+
+
+def test_heartbeat_only_death_probes_dominate_fresh_lease():
+    """A ghost heartbeat (chaos ``heartbeat_ghost``) must not rescue a
+    replica whose data plane stopped answering."""
+    seq = {"n": 0}
+
+    def leases():
+        seq["n"] += 1  # the victim's lease keeps advancing forever
+        return {1: {"seq": seq["n"]}}
+
+    probes = {i: StubProbe() for i in range(3)}
+    det = FailureDetector(probes, DetectorConfig(miss_threshold=3),
+                          lease_reader=leases)
+    probes[1].ok = False
+    verdicts = [det.poll_once()[1] for _ in range(3)]
+    assert verdicts[-1] == VERDICT_DEAD
+    assert det.health()[1].lease_fresh is True  # the lease WAS fresh
+
+
+def test_silent_heartbeat_is_suspect_never_evicted():
+    """The inverse: probes answer, lease stops advancing — control-plane
+    loss only, the replica stays in service as SUSPECT."""
+    clock = {"t": 0.0}
+    lease_state = {"seq": 1, "advancing": False}
+
+    def leases():
+        if lease_state["advancing"]:
+            lease_state["seq"] += 1
+        return {0: {"seq": lease_state["seq"]}}
+
+    probes = {i: StubProbe() for i in range(3)}
+    det = FailureDetector(probes, DetectorConfig(lease_ttl_s=3.0),
+                          lease_reader=leases,
+                          clock=lambda: clock["t"])
+    assert det.poll_once()[0] == VERDICT_LIVE  # lease seen at t=0, fresh
+    clock["t"] = 10.0  # stale: no advance for > lease_ttl_s
+    for _ in range(5):
+        assert det.poll_once()[0] == VERDICT_SUSPECT  # never DEAD
+    lease_state["advancing"] = True  # heartbeat thread comes back
+    det.poll_once()
+    assert det.poll_once()[0] == VERDICT_LIVE
+
+
+def test_partition_witness_withholds_fleetwide_eviction():
+    """Satellite: an observer cut off from MOST of the fleet must suspect
+    itself, not evict everyone it cannot reach."""
+    from persia_tpu.chaos import partition_view
+
+    probes = {i: StubProbe() for i in range(4)}
+    cut = partition_view(probes, [1, 2, 3])  # observer sees only replica 0
+    det = FailureDetector(cut, DetectorConfig(miss_threshold=2))
+    for _ in range(5):
+        verdicts = det.poll_once()
+    # every unreachable replica is held at SUSPECT by the witness rule
+    assert verdicts[0] == VERDICT_LIVE
+    assert all(verdicts[i] == VERDICT_SUSPECT for i in (1, 2, 3))
+    assert det.false_positive_guard > 0  # the withholds were counted
+
+
+def test_partition_witness_allows_single_eviction():
+    """Converse direction: ONE unreachable replica in an otherwise
+    reachable fleet is a real death, not an observer partition."""
+    from persia_tpu.chaos import partition_view
+
+    probes = {i: StubProbe() for i in range(4)}
+    cut = partition_view(probes, [3])
+    det = FailureDetector(cut, DetectorConfig(miss_threshold=2))
+    det.poll_once()
+    verdicts = det.poll_once()
+    assert verdicts[3] == VERDICT_DEAD  # majority witnessed; evict
+    assert all(verdicts[i] == VERDICT_LIVE for i in (0, 1, 2))
+
+
+def test_detector_reset_forgets_the_corpse():
+    probes, det = _fleet(2, miss_threshold=2)
+    probes[0].ok = False
+    det.poll_once()
+    det.poll_once()
+    assert det.verdicts()[0] == VERDICT_DEAD
+    det.reset(0, StubProbe())  # a heal replaced the process behind slot 0
+    assert det.verdicts()[0] == VERDICT_LIVE
+    assert det.health()[0].miss_streak == 0
+    assert det.poll_once()[0] == VERDICT_LIVE
+
+
+# ----------------------------------------------------------- lease plane
+
+
+def test_lease_publisher_roundtrip_and_env_gate(monkeypatch):
+    from persia_tpu.service.discovery import Coordinator, CoordinatorClient
+
+    coord = Coordinator(port=0).start()
+    try:
+        cli = CoordinatorClient(f"127.0.0.1:{coord.port}")
+        pub = LeasePublisher(cli, "parameter_server", 0, "127.0.0.1:1234")
+        pub.publish_once()
+        pub.publish_once()
+        assert cli.kv_keys("lease/parameter_server/") == [
+            lease_key("parameter_server", 0)
+        ]
+        leases = coordinator_lease_reader(cli, "parameter_server")()
+        assert leases[0]["seq"] == 2
+        assert leases[0]["addr"] == "127.0.0.1:1234"
+        # a second publisher for another index lands beside it
+        LeasePublisher(cli, "parameter_server", 1, "127.0.0.1:9").publish_once()
+        assert set(coordinator_lease_reader(cli, "parameter_server")()) == {0, 1}
+        # env gate: PERSIA_LEASE=0 keeps the fleet binaries lease-less
+        monkeypatch.setenv("PERSIA_LEASE", "0")
+        assert maybe_start_lease_publisher(cli, "x", 0, "a") is None
+    finally:
+        coord.stop()
+
+
+def test_make_probe_is_single_attempt():
+    """The detector owns miss accounting: a probe must not retry (a retry
+    would hide exactly the misses the N-consecutive rule counts)."""
+    from persia_tpu.service.rpc import RpcServer
+
+    calls = {"n": 0}
+
+    def healthz(payload):
+        calls["n"] += 1
+        return b"ok"
+
+    srv = RpcServer(port=0)
+    srv.register("healthz", healthz)
+    srv.start()
+    probe = make_probe(f"127.0.0.1:{srv.port}", timeout_s=2.0)
+    try:
+        lat = probe()
+        assert lat > 0.0
+        assert calls["n"] == 1
+    finally:
+        probe.close()
+        srv.stop()
+    t0 = time.monotonic()
+    with pytest.raises(Exception):
+        make_probe(f"127.0.0.1:{srv.port}", timeout_s=1.0)()
+    assert time.monotonic() - t0 < 10.0  # one attempt, no backoff ladder
+
+
+# ------------------------------------------------------------ heal policy
+
+
+def test_heal_policy_dead_fires_immediately_then_cools_down():
+    pol = HealPolicy(HealConfig(heal_cooldown_polls=2))
+    d = pol.decide({0: VERDICT_LIVE, 1: VERDICT_DEAD})
+    assert d is not None and d.params["action"] == ACTION_PROMOTE
+    assert d.params["victim"] == 1
+    # cooldown: the detector re-baselines before the next mutation
+    assert pol.decide({0: VERDICT_LIVE, 1: VERDICT_DEAD}) is None
+    assert pol.decide({0: VERDICT_LIVE, 1: VERDICT_DEAD}) is None
+    assert pol.suppressed == 2
+    d2 = pol.decide({0: VERDICT_LIVE, 1: VERDICT_DEAD})
+    assert d2 is not None and d2.params["victim"] == 1
+
+
+def test_heal_policy_gray_drain_needs_dwell():
+    pol = HealPolicy(HealConfig(gray_min_dwell=2, heal_cooldown_polls=0))
+    assert pol.decide({0: VERDICT_GRAY, 1: VERDICT_LIVE}) is None  # dwell 1
+    d = pol.decide({0: VERDICT_GRAY, 1: VERDICT_LIVE})  # dwell 2: drain
+    assert d is not None and d.params["action"] == "drain_gray"
+    # a gray that clears mid-dwell never drains
+    pol2 = HealPolicy(HealConfig(gray_min_dwell=2, heal_cooldown_polls=0))
+    assert pol2.decide({0: VERDICT_GRAY}) is None
+    assert pol2.decide({0: VERDICT_LIVE}) is None  # dwell clock wiped
+    assert pol2.decide({0: VERDICT_GRAY}) is None  # starts over
+    assert pol2.suppressed >= 1
+
+
+def test_heal_policy_resize_dwell_and_hysteresis():
+    cfg = HealConfig(heal_cooldown_polls=0, grow_lag_steps=64.0,
+                     resize_min_dwell=2, size_min=1, size_max=4)
+    pol = HealPolicy(cfg)
+    live = {0: VERDICT_LIVE, 1: VERDICT_LIVE}
+    hot = {"n_ps": 2, "freshness_lag": 100.0, "quarantine_pressure": 0}
+    assert pol.decide(live, hot) is None  # round 1: target armed
+    assert pol.decide(live, hot) is None  # round 2: dwell
+    d = pol.decide(live, hot)  # round 3: fires
+    assert d is not None and d.params["action"] == ACTION_RESIZE
+    assert d.params["n_new"] == 3
+    # sensor noise that clears mid-dwell never resizes
+    pol2 = HealPolicy(cfg)
+    assert pol2.decide(live, hot) is None
+    calm = {"n_ps": 2, "freshness_lag": 1.0, "quarantine_pressure": 0}
+    assert pol2.decide(live, calm) is None  # shrink target replaces grow
+    assert pol2.decide(live, hot) is None  # and grow starts its clock over
+    # shrink respects size_min
+    pol3 = HealPolicy(cfg)
+    floor = {"n_ps": 1, "freshness_lag": 0.0, "quarantine_pressure": 0}
+    for _ in range(5):
+        assert pol3.decide(live, floor) is None
+
+
+def test_heal_policy_state_roundtrip():
+    pol = HealPolicy(HealConfig(heal_cooldown_polls=3))
+    pol.decide({0: VERDICT_DEAD})
+    state = pol.export_state()
+    pol2 = HealPolicy(HealConfig(heal_cooldown_polls=3))
+    pol2.load_state(state)
+    assert pol2.decide({0: VERDICT_DEAD}) is None  # cooldown carried over
+    assert pol2.suppressed == pol.suppressed + 1
+
+
+# ------------------------------------------------- healer two-phase journal
+
+
+class StubDetector:
+    def __init__(self, verdicts):
+        self._verdicts = dict(verdicts)
+        self.reset_calls = []
+
+    def poll_once(self):
+        return dict(self._verdicts)
+
+    def detected_at(self, idx):
+        return 0.0
+
+    def reset(self, idx, probe=None):
+        self.reset_calls.append(idx)
+        self._verdicts[idx] = VERDICT_LIVE
+
+
+def test_healer_resume_is_exactly_once(tmp_path):
+    """SIGKILL-the-healer-mid-promotion, in miniature: the first actuation
+    dies after the planned manifest committed; a FRESH healer re-drives
+    exactly that heal from the journal; a third pass is a no-op."""
+    state = str(tmp_path / "heal")
+    calls = []
+
+    def flaky_promote(victim, ba):
+        calls.append((victim, ba))
+        raise RuntimeError("healer SIGKILLed mid-promotion")
+
+    h1 = Healer(
+        state,
+        detector=StubDetector({0: VERDICT_LIVE, 1: VERDICT_DEAD}),
+        promote=flaky_promote,
+        batch_advances=lambda: {0: 3},
+    )
+    with pytest.raises(RuntimeError):
+        h1.on_poll(1)
+    assert calls == [(1, {0: 3})]  # planned counts recorded AT plan time
+    assert h1.pending() is not None  # planned-without-done survives
+
+    def good_promote(victim, ba):
+        calls.append((victim, ba))
+        return "127.0.0.1:999"
+
+    h2 = Healer(state, promote=good_promote)
+    result = h2.resume()
+    assert result is not None and result["addr"] == "127.0.0.1:999"
+    # the resumed heal re-advances from the SAME recorded counts
+    assert calls[-1] == (1, {0: 3})
+    assert h2.pending() is None
+    assert h2.resume() is None  # exactly-once: nothing left to re-drive
+    assert Healer(state, promote=good_promote).resume() is None
+    assert len(calls) == 2
+
+
+def test_healer_completed_heal_resets_detector(tmp_path):
+    det = StubDetector({0: VERDICT_DEAD, 1: VERDICT_LIVE})
+    h = Healer(
+        str(tmp_path / "heal"),
+        detector=det,
+        promote=lambda v, ba: "127.0.0.1:1000",
+        probe_factory=lambda addr: StubProbe(),
+    )
+    applied = h.on_poll(1)
+    assert applied is not None and applied["addr"] == "127.0.0.1:1000"
+    assert det.reset_calls == [0]  # newcomer must not inherit the verdict
+    assert len(h.mttr_s) == 1 and h.mttr_s[0] >= 0.0
+    assert h.pending() is None
+
+
+# --------------------------------------------------- stall watchdog wiring
+
+
+def test_stall_detector_surfaces_metric_and_gauge():
+    """Satellite: the orphaned diagnostics watchdog now exports what it
+    sees — a stalled component moves the gauge and bumps the counter."""
+    from persia_tpu import diagnostics
+    from persia_tpu.metrics import get_metrics
+
+    comp = "selfheal-test-component"
+    diagnostics.heartbeat(comp)
+    det = diagnostics.StallDetector(stall_after_s=0.0)
+    try:
+        time.sleep(0.01)
+        stalled = det.check_once()
+        assert comp in stalled
+        g = get_metrics().gauge(
+            "persia_tpu_stalled_components",
+            "components currently silent past the stall threshold",
+        )
+        assert g.get() >= 1.0
+        diagnostics.heartbeat(comp)  # beat again: healthy
+        det2 = diagnostics.StallDetector(stall_after_s=60.0)
+        still = det2.check_once()
+        assert comp not in still
+        # the gauge tracks the LAST scan, not a high-water mark
+        assert g.get() == float(len(still))
+    finally:
+        diagnostics.unregister(comp)
+
+
+# -------------------------------------------------------- chaos injectors
+
+
+def test_gray_proxy_latency_floor():
+    """``gray_ps`` turns a healthy backend into a sustained latency
+    outlier without breaking a single reply."""
+    from persia_tpu.chaos import ChaosProxy
+    from persia_tpu.service.rpc import RpcClient, RpcServer
+
+    srv = RpcServer(port=0)
+    srv.register("echo", lambda p: bytes(p))
+    srv.start()
+    proxy = ChaosProxy(f"127.0.0.1:{srv.port}")
+    try:
+        client = RpcClient(proxy.addr, timeout_s=5.0)
+        t0 = time.perf_counter()
+        assert client.call("echo", b"x") == b"x"
+        fast = time.perf_counter() - t0
+        proxy.set_latency(60.0)
+        t0 = time.perf_counter()
+        assert client.call("echo", b"x") == b"x"  # still answers, slowly
+        slow = time.perf_counter() - t0
+        assert slow >= 0.1  # >= 2 frames x 60 ms
+        assert slow > fast
+        assert proxy.counts["grayed"] >= 2
+        proxy.set_latency(0.0)  # ungray restores transparency
+        t0 = time.perf_counter()
+        assert client.call("echo", b"x") == b"x"
+        assert time.perf_counter() - t0 < 0.1
+    finally:
+        proxy.stop()
+        srv.stop()
+
+
+def test_inflight_lookup_migrates_on_replace_replica():
+    """Tentpole pin: a lookup already inside its retry loop when
+    ``replace_replica`` promotes a standby must MIGRATE to the fresh
+    handle and serve real rows — not burn the whole degrade budget
+    against the corpse and fall back to synthetic embeddings."""
+    import threading
+
+    from persia_tpu.embedding.worker import ShardedLookup
+    from persia_tpu.service.resilience import ResiliencePolicy, RetryPolicy
+
+    class DeadReplica:
+        endpoint = "dead:0"
+
+        def lookup(self, keys, dim, train):
+            raise ConnectionError("connection refused")
+
+        def wait_ready(self, timeout_s=None):
+            raise ConnectionError("still dead")
+
+    class LiveReplica:
+        endpoint = "live:0"
+
+        def __init__(self, rows):
+            self.rows = rows
+
+        def lookup(self, keys, dim, train):
+            return self.rows
+
+    rows = np.arange(32, dtype=np.float32).reshape(4, 8) + 1.0
+    pol = ResiliencePolicy(
+        retry=RetryPolicy(max_attempts=1, base_s=0.01, max_s=0.05, seed=0),
+        degrade_after_s=30.0,  # long enough that only migration saves us
+    )
+    router = ShardedLookup([DeadReplica()], policy=pol)
+    keys = np.arange(1, 5, dtype=np.uint64)
+    out = {}
+
+    def call():
+        out["rows"] = router.lookup(keys, 8, train=True)
+
+    th = threading.Thread(target=call, daemon=True)
+    th.start()
+    time.sleep(0.3)  # the call is retrying against the dead handle now
+    assert th.is_alive()
+    router.replace_replica(0, LiveReplica(rows))
+    th.join(timeout=10.0)
+    assert not th.is_alive(), "in-flight call never saw the swap"
+    np.testing.assert_array_equal(out["rows"], rows)
+    assert not router._degraded_signs  # served live, nothing degraded
+
+
+# ----------------------------------------------------- fleet (slow) tests
+
+
+@pytest.mark.slow
+def test_promote_standby_adam_batch_advance_bitwise():
+    """Satellite pin: shard snapshots carry entries, NOT the per-group
+    optimizer batch clock. A promoted standby must re-advance its Adam
+    beta powers to the fleet's fence (``batch_advances``) or its next
+    update diverges — both directions asserted bitwise."""
+    from persia_tpu.embedding.optim import Adam
+    from persia_tpu.helper import ServiceCtx
+    from persia_tpu.service.clients import StoreClient
+
+    K = 5
+    with ServiceCtx(num_parameter_servers=1, num_embedding_workers=0,
+                    backend="numpy", seed=7) as svc:
+        c = svc.ps_clients()[0]
+        c.wait_ready()
+        c.register_optimizer(Adam(lr=0.05).config)
+        rng = np.random.default_rng(3)
+        signs = np.arange(1, 33, dtype=np.uint64)
+        vals = rng.normal(size=(32, 8)).astype(np.float32)
+        # full Adam entries: [emb | m | v] — set_embedding stores rows raw,
+        # and update_gradients skips entries whose width lacks the state
+        full = np.concatenate(
+            [vals, np.zeros((32, 16), dtype=np.float32)], axis=1)
+        c.set_embedding(signs, full, dim=8)
+        for _ in range(K):  # the fleet's fence sits K batches in
+            c.advance_batch_state(0)
+        svc.snapshot_ps(0)  # entries + optimizer config; NO batch clock
+        grads = rng.normal(size=(32, 8)).astype(np.float32)
+
+        def read_entries(cli):
+            return [cli.get_embedding_entry(int(s)) for s in signs]
+
+        # reference: the surviving replica applies the next batch at t=K+1
+        c.update_gradients(signs, grads, group=0)
+        ref = read_entries(c)
+
+        # healed replica WITH the re-advance: bitwise identical
+        svc.spawn_standby_ps()
+        svc.kill_ps(0)
+        svc.promote_standby(0, batch_advances={0: K})
+        c2 = StoreClient(svc.ps_addrs()[0])
+        c2.wait_ready()
+        c2.update_gradients(signs, grads, group=0)
+        for a, b in zip(read_entries(c2), ref):
+            np.testing.assert_array_equal(a, b)
+
+        # regression guard: WITHOUT the re-advance the beta powers sit at
+        # t=1 and the very first update diverges
+        svc.spawn_standby_ps()
+        svc.kill_ps(0)
+        svc.promote_standby(0)
+        c3 = StoreClient(svc.ps_addrs()[0])
+        c3.wait_ready()
+        c3.update_gradients(signs, grads, group=0)
+        stale = read_entries(c3)
+        assert any(
+            not np.array_equal(a, b) for a, b in zip(stale, ref)
+        ), "promotion without batch_advances must diverge (else the " \
+           "satellite's premise no longer holds)"
+
+
+@pytest.mark.slow
+def test_selfheal_resume_mid_promotion_real_fleet(tmp_path):
+    """SIGKILL the HEALER mid-promotion against a real fleet: the planned
+    manifest survives, a fresh healer's ``resume()`` completes the SAME
+    heal exactly-once, and the restored rows serve bitwise."""
+    from persia_tpu.embedding.worker import ShardedLookup
+    from persia_tpu.helper import ServiceCtx
+
+    with ServiceCtx(num_parameter_servers=2, num_embedding_workers=0,
+                    backend="numpy", seed=7) as svc:
+        ps = svc.ps_clients()
+        for c in ps:
+            c.wait_ready()
+        router = ShardedLookup(ps)
+        rng = np.random.default_rng(0)
+        signs = np.arange(1, 200, dtype=np.uint64)
+        vals = rng.normal(size=(len(signs), 8)).astype(np.float32)
+        router.set_embedding(signs, vals, dim=8)
+        svc.snapshot_ps(0)
+        svc.snapshot_ps(1)
+        svc.spawn_standby_ps()
+        svc.kill_ps(1)
+
+        state = str(tmp_path / "heal")
+        det = FailureDetector(svc.ps_probes(timeout_s=0.5),
+                              DetectorConfig(miss_threshold=2))
+
+        def dying_hook(event):
+            if event == "promoted":  # after snapshot replay, BEFORE the
+                raise RuntimeError("chaos: healer dies mid-promotion")
+            # router swap — the nastiest point to die at
+
+        h1 = Healer(
+            state, detector=det,
+            promote=lambda v, ba: svc.heal_promote(
+                v, router=router, batch_advances=ba, fault_hook=dying_hook),
+            probe_factory=lambda a: make_probe(a, timeout_s=0.5),
+        )
+        with pytest.raises(RuntimeError):
+            for i in range(10):
+                h1.on_poll(i)
+        assert h1.pending() is not None
+        assert h1.pending()["decision"]["params"]["victim"] == 1
+
+        # a FRESH healer (the relaunched process) re-drives from the journal
+        h2 = Healer(
+            state, detector=det,
+            promote=lambda v, ba: svc.heal_promote(
+                v, router=router, batch_advances=ba),
+            probe_factory=lambda a: make_probe(a, timeout_s=0.5),
+        )
+        result = h2.resume()
+        assert result is not None
+        promoted = result["addr"]
+        assert svc.ps_addrs()[1] == promoted
+        assert h2.resume() is None  # exactly-once
+        got = router.lookup(signs, 8, train=False)
+        np.testing.assert_array_equal(got, vals)
+        det.close()
+
+
+@pytest.mark.slow
+def test_selfheal_stream_kill_autonomous_bitwise(tmp_path):
+    """THE flagship acceptance run: ``train_stream`` against real
+    subprocess PS shards loses shard 1 to a seeded ``kill_ps_autoheal``
+    mid-stream while a RUNNING healer thread — and NO operator call —
+    detects the death, promotes the warm standby from the fence snapshot,
+    and swaps the live router. The stream must complete, MTTR must be
+    recorded, and final PS entries + dense params must be BIT-IDENTICAL
+    to a fault-free in-process replay of the same seeds."""
+    import optax
+
+    from persia_tpu.autopilot import enable_self_heal
+    from persia_tpu.chaos import ChaosAction, ChaosConfig, ChaosPlane
+    from persia_tpu.config import EmbeddingConfig, SlotConfig
+    from persia_tpu.embedding import hbm_cache as hbm
+    from persia_tpu.embedding.hashing import add_index_prefix
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.embedding.store import EmbeddingStore
+    from persia_tpu.embedding.worker import EmbeddingWorker
+    from persia_tpu.helper import ServiceCtx
+    from persia_tpu.models import DNN
+    from persia_tpu.service.clients import StoreClient
+    from persia_tpu.service.resilience import ResiliencePolicy, RetryPolicy
+    from persia_tpu.testing import SyntheticClickDataset
+
+    VOCABS = (64, 32)
+    cfg = EmbeddingConfig(
+        slots_config={"cat_0": SlotConfig(dim=8), "cat_1": SlotConfig(dim=8)},
+        feature_index_prefix_bit=8,
+    )
+    ds = SyntheticClickDataset(num_samples=768, vocab_sizes=VOCABS, seed=9)
+
+    def make_ctx(worker):
+        return hbm.CachedTrainCtx(
+            model=DNN(dense_mlp_size=8, sparse_mlp_size=16, hidden_sizes=(32,)),
+            dense_optimizer=optax.adam(3e-3),
+            embedding_optimizer=Adagrad(lr=0.1),
+            worker=worker, embedding_config=cfg,
+            cache_rows=256,  # > the 96-sign space: eviction-free segments,
+            init_seed=7,     # so the kill loses no in-flight write-backs
+        ).__enter__()
+
+    def run(worker, plane=None, metrics=None, barrier=None):
+        ctx = make_ctx(worker)
+        cb = (lambda m: metrics.append(m)) if metrics is not None else None
+        seg1 = list(ds.batches(32))[:12]
+        seg2 = list(ds.batches(32))[12:24]
+        ctx.train_stream(seg1, on_metrics=cb)
+        ctx.flush()  # all rows land on the PS tier (both runs)
+        if plane is not None:
+            seg2 = plane.wrap_batches(seg2)
+        ctx.train_stream(seg2, on_metrics=cb)
+        if barrier is not None:
+            barrier()  # the heal must land before the final write-back
+        ctx.flush()
+        return ctx
+
+    policy = ResiliencePolicy(
+        retry=RetryPolicy(max_attempts=4, base_s=0.02, max_s=0.3, seed=1),
+        breaker_failure_threshold=3, breaker_reset_s=0.3,
+        degrade_after_s=60.0,  # ride out the heal; degrade only if stuck
+        max_degraded_frac=1.0,
+    )
+    chaos_metrics = []
+    with ServiceCtx(num_parameter_servers=2, num_embedding_workers=0,
+                    backend="numpy", seed=7) as svc:
+        svc.spawn_standby_ps()  # the WARM standby the healer will promote
+        plane = ChaosPlane(
+            svc, ChaosConfig(seed=11),
+            # fence snapshot + SIGKILL, and deliberately NO restore op:
+            # recovery is the healer's job, nobody else's
+            schedule=[ChaosAction(step=4, op="kill_ps_autoheal", idx=1)],
+        )
+        healer = None
+        try:
+            ps = [StoreClient(a, policy=policy, timeout_s=10.0)
+                  for a in svc.ps_addrs()]
+            for c in ps:
+                c.wait_ready()
+            worker = EmbeddingWorker(cfg, ps, policy=policy)
+            healer = enable_self_heal(
+                svc, str(tmp_path / "selfheal"),
+                router=worker.lookup_router,
+                detector_config=DetectorConfig(
+                    miss_threshold=3, probe_timeout_s=0.5),
+                probe_timeout_s=0.5,
+            )
+            healer.start(interval_s=0.1)  # autonomous from here on
+
+            def heal_landed():
+                deadline = time.monotonic() + 60.0
+                while not healer.mttr_s:
+                    assert time.monotonic() < deadline, "no heal within 60s"
+                    time.sleep(0.05)
+
+            chaos_ctx = run(worker, plane=plane, metrics=chaos_metrics,
+                            barrier=heal_landed)
+
+            assert all(a.fired for a in plane.schedule)
+            # the heal actually ran, autonomously, exactly once
+            assert len(healer.mttr_s) == 1
+            assert healer.mttr_s[0] > 0.0
+            assert healer.pending() is None  # two-phase journal closed
+            # the promoted standby took slot 1's registration
+            assert healer.detector.verdicts()[1] != VERDICT_DEAD
+            assert all("degraded_lookup_frac" in m for m in chaos_metrics)
+            assert all(m["degraded_lookup_frac"] == 0.0 for m in chaos_metrics)
+            assert not worker.lookup_router._degraded_signs
+
+            # read final PS state through CLEAN direct clients
+            remote_entries = {}
+            direct = [StoreClient(a) for a in svc.ps_addrs()]
+            for slot, vocab in zip(("cat_0", "cat_1"), VOCABS):
+                pre = cfg.slot(slot).index_prefix
+                for s in range(vocab):
+                    sign = int(add_index_prefix(
+                        np.array([s], np.uint64), pre, 8)[0])
+                    for c in direct:
+                        e = c.get_embedding_entry(sign)
+                        if e is not None:
+                            remote_entries[(slot, s)] = e
+                            break
+        finally:
+            if healer is not None:
+                healer.stop()
+                healer.detector.close()
+            plane.stop()
+
+    # ---- fault-free replay: identical seeds, in-process stores ----
+    clean_stores = [
+        EmbeddingStore(capacity=1 << 18, num_internal_shards=4, seed=7)
+        for _ in range(2)
+    ]
+    clean_metrics = []
+    clean_ctx = run(EmbeddingWorker(cfg, clean_stores), metrics=clean_metrics)
+
+    # losses agree step for step (the kill cost availability, not values)
+    np.testing.assert_allclose(
+        [m["loss"] for m in chaos_metrics],
+        [m["loss"] for m in clean_metrics], rtol=1e-6,
+    )
+    # dense params BIT-identical: the heal never perturbed the trajectory
+    import jax
+
+    chaos_leaves = jax.tree_util.tree_leaves(chaos_ctx.state.params)
+    clean_leaves = jax.tree_util.tree_leaves(clean_ctx.state.params)
+    assert len(chaos_leaves) == len(clean_leaves) > 0
+    for a, b in zip(chaos_leaves, clean_leaves):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # final PS entries BIT-identical for every sign — including every row
+    # of the shard that died and was healed without an operator
+    checked = 0
+    for slot, vocab in zip(("cat_0", "cat_1"), VOCABS):
+        pre = cfg.slot(slot).index_prefix
+        for s in range(vocab):
+            sign = int(add_index_prefix(np.array([s], np.uint64), pre, 8)[0])
+            clean = None
+            for st in clean_stores:
+                clean = st.get_embedding_entry(sign)
+                if clean is not None:
+                    break
+            healed = remote_entries.get((slot, s))
+            assert (clean is None) == (healed is None), (slot, s)
+            if clean is not None:
+                np.testing.assert_array_equal(healed, clean,
+                                              err_msg=str((slot, s)))
+                checked += 1
+    assert checked > 50
