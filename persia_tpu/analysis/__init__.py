@@ -43,6 +43,16 @@ any finding:
   (``reshard_ps`` / ``swap_topology`` / replica add-remove) with no
   hysteresis/dwell guard on the decision path — an unguarded control
   loop is a flap machine (:mod:`persia_tpu.analysis.control_lint`).
+- **Protocol verification** (PROTO001–PROTO006): the journaled two-phase
+  state machines extracted statically — interprocedural raw-write of
+  checkpoint artifacts, journal ids minted outside the registered
+  constructors (plus an exact bitmask prover of pairwise namespace
+  disjointness), committed phases with no resume() re-entry arm,
+  journal_record sites with no journal_probe on their path, topology
+  mutators reachable outside a drained-fence context, and crash
+  transitions missing from ``PROTO_COVERAGE.json``
+  (:mod:`persia_tpu.analysis.protocol` +
+  :mod:`persia_tpu.analysis.crashcheck`).
 
 Suppress a finding inline with ``# persia-lint: disable=RULE`` (or
 ``disable=all``) on the offending line; C sources use the same token in a
@@ -73,7 +83,8 @@ __all__ = [
     "NATIVE_LIBS",
 ]
 
-_PASS_PREFIXES = ("ABI", "CONC", "RES", "DUR", "OBS", "NUM", "JAX", "CTRL")
+_PASS_PREFIXES = ("ABI", "CONC", "RES", "DUR", "OBS", "NUM", "JAX", "CTRL",
+                  "PROTO")
 
 
 def run_all(
@@ -90,6 +101,7 @@ def run_all(
         jax_lint,
         numeric_lint,
         observability_lint,
+        protocol,
         resilience_lint,
     )
 
@@ -119,6 +131,10 @@ def run_all(
         findings.extend(numeric_lint.check(root, py_files))
     if any(w.startswith("CTRL") for w in wanted):
         findings.extend(control_lint.check(root, py_files))
+    if any(w.startswith("PROTO") for w in wanted):
+        p_findings, p_cov = protocol.check(root, py_files)
+        findings.extend(p_findings)
+        coverage["protocol"] = p_cov
     coverage["python_files_scanned"] = len(py_files)
     coverage["ctypes_files"] = [p for p in CTYPES_FILES
                                 if any(rel(f) == p for f in py_files)]

@@ -57,6 +57,12 @@ _RULE_DOC = {
     "JAX002": "branch on a traced argument inside jit (retrace/ConcretizationError)",
     "JAX003": "donated buffer read after the donating call",
     "JAX004": "benchmark timer window reads the clock without block_until_ready",
+    "PROTO001": "manifest/pointer artifact written raw through an interprocedural helper",
+    "PROTO002": "raw-minted journal id at a sink, or id-family namespace overlap",
+    "PROTO003": "committed phase value no resume arm ever compares against",
+    "PROTO004": "journal_record with no journal_probe on the apply path",
+    "PROTO005": "topology mutator reachable outside a drained-fence/resume context",
+    "PROTO006": "PROTO_COVERAGE.json missing/stale vs extracted crash transitions",
 }
 
 

@@ -1,0 +1,181 @@
+"""Crash-point registry: exhaustive SIGKILL-schedule enumeration in-process.
+
+The journaled two-phase protocols (jobstate fences, elastic reshard
+phases, autopilot drives, healer decisions, scrub records) all promise
+"SIGKILL anywhere resumes bit-identical" — but until PR 19 that promise
+was pinned by a handful of hand-seeded ``fault_hook`` kill points. This
+module closes the gap between the static protocol model
+(:mod:`persia_tpu.analysis.protocol`) and the chaos suite:
+
+- Production protocol code marks every manifest-commit and journal-record
+  boundary with :func:`reach` — a module-level no-op (one dict read) when
+  disarmed, so the hooks cost nothing on the hot path and need no test
+  plumbing threaded through call signatures.
+- A test records one uninterrupted protocol run under :func:`recording`
+  to enumerate the ordered ``(site, occurrence)`` crash points it passes.
+- For every enumerated point, the test re-runs the protocol fresh under
+  :func:`crash_at`, which raises :class:`SimulatedCrash` exactly there
+  (and disarms itself, so the resume path runs clean), then asserts the
+  resumed end state equals the uninterrupted run's.
+- :class:`Coverage` accumulates kills per site across matrices and
+  serializes ``PROTO_COVERAGE.json``; :func:`validate_coverage` diffs it
+  against the statically extracted site set, so a protocol arm added
+  without a kill schedule is a lint finding (PROTO006), not a silent gap.
+
+``SimulatedCrash`` derives from ``BaseException`` on purpose: a protocol
+that swallows it behind ``except Exception`` would be hiding a window
+where a real SIGKILL loses state, and the matrix must see that as a
+failure, not a pass. Pure stdlib — importable from jobstate/elastic
+without cycles or heavyweight deps.
+"""
+
+from __future__ import annotations
+
+import json
+from contextlib import contextmanager
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+class SimulatedCrash(BaseException):
+    """Raised at an armed crash point. BaseException so production
+    ``except Exception`` recovery paths cannot absorb the simulated kill."""
+
+
+class _State:
+    __slots__ = ("mode", "sites", "target", "counts")
+
+    def __init__(self) -> None:
+        self.mode: Optional[str] = None  # None | "record" | "crash"
+        self.sites: List[str] = []
+        self.target: Optional[Tuple[str, int]] = None
+        self.counts: Dict[str, int] = {}
+
+
+_STATE = _State()
+
+
+def reach(site: str) -> None:
+    """Mark a protocol transition boundary. Disarmed (the default, and
+    always in production) this is a single attribute read."""
+    mode = _STATE.mode
+    if mode is None:
+        return
+    if mode == "record":
+        _STATE.sites.append(site)
+        return
+    occ = _STATE.counts.get(site, 0)
+    _STATE.counts[site] = occ + 1
+    if (site, occ) == _STATE.target:
+        _STATE.mode = None  # disarm: the resume path must run uninterrupted
+        raise SimulatedCrash(f"simulated kill at {site}#{occ}")
+
+
+def disarm() -> None:
+    _STATE.mode = None
+    _STATE.target = None
+    _STATE.sites = []
+    _STATE.counts = {}
+
+
+@contextmanager
+def recording():
+    """Collect the ordered crash points one uninterrupted run passes.
+    Yields the live list (ordered, with repeats — occurrence numbering is
+    derived by :func:`enumerate_points`)."""
+    disarm()
+    _STATE.mode = "record"
+    try:
+        yield _STATE.sites
+    finally:
+        _STATE.mode = None
+
+
+@contextmanager
+def crash_at(site: str, occurrence: int = 0):
+    """Arm one crash point: the ``occurrence``-th time ``site`` is reached,
+    :class:`SimulatedCrash` raises and the registry disarms itself."""
+    disarm()
+    _STATE.target = (site, int(occurrence))
+    _STATE.mode = "crash"
+    try:
+        yield
+    finally:
+        disarm()
+
+
+def enumerate_points(sites: Iterable[str]) -> List[Tuple[str, int]]:
+    """Ordered (site, occurrence) pairs from a recording — the full crash
+    schedule of one protocol run."""
+    counts: Dict[str, int] = {}
+    out: List[Tuple[str, int]] = []
+    for s in sites:
+        k = counts.get(s, 0)
+        counts[s] = k + 1
+        out.append((s, k))
+    return out
+
+
+# ------------------------------------------------------------------ coverage
+
+
+class Coverage:
+    """Kill counts per site, accumulated across protocol matrices, and the
+    PROTO_COVERAGE.json (de)serializer the committed artifact uses."""
+
+    def __init__(self) -> None:
+        self.kills: Dict[str, int] = {}
+        self.matrices: Dict[str, Dict[str, int]] = {}
+
+    def add_kill(self, matrix: str, site: str) -> None:
+        self.kills[site] = self.kills.get(site, 0) + 1
+        per = self.matrices.setdefault(matrix, {})
+        per[site] = per.get(site, 0) + 1
+
+    def merge(self, other: "Coverage") -> None:
+        for site, n in other.kills.items():
+            self.kills[site] = self.kills.get(site, 0) + n
+        for matrix, per in other.matrices.items():
+            mine = self.matrices.setdefault(matrix, {})
+            for site, n in per.items():
+                mine[site] = mine.get(site, 0) + n
+
+    def to_json(self) -> Dict:
+        return {
+            "sites": {s: {"kills": n} for s, n in sorted(self.kills.items())},
+            "matrices": {
+                m: dict(sorted(per.items()))
+                for m, per in sorted(self.matrices.items())
+            },
+        }
+
+    def write(self, path: str) -> None:
+        with open(path, "w") as fh:
+            json.dump(self.to_json(), fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+
+def load_coverage(path: str) -> Dict:
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def validate_coverage(data: Dict, static_sites: Iterable[str]) -> List[str]:
+    """Problems in a PROTO_COVERAGE record vs the statically extracted
+    transition set: sites never killed, or absent from the record. A
+    recorded site the static pass no longer sees is also flagged — stale
+    coverage reads as proof of something that no longer exists."""
+    recorded = data.get("sites", {})
+    problems: List[str] = []
+    static = set(static_sites)
+    for site in sorted(static):
+        entry = recorded.get(site)
+        if entry is None:
+            problems.append(f"transition {site!r} has no crash coverage record")
+        elif int(entry.get("kills", 0)) < 1:
+            problems.append(f"transition {site!r} recorded but never killed")
+    for site in sorted(recorded):
+        if site not in static:
+            problems.append(
+                f"coverage records {site!r} but no reach() site declares it"
+            )
+    return problems

@@ -1,0 +1,865 @@
+"""persia-proto: static protocol extraction over the journaled state machines.
+
+The repo's exactly-once story rests on five journaled two-phase protocols
+(jobstate fences, elastic reshard phases, autopilot drives, healer
+decisions, scrub/replication records). This pass recovers their shape
+statically — manifest-write sites, phase-name string constants, journal
+record/probe sites, ``resume()`` re-entry arms, :func:`crashcheck.reach`
+crash points — and enforces the construction rules the protocols depend
+on:
+
+- **PROTO001** — a checkpoint-class artifact written through a helper
+  whose raw ``open(..., "w")`` hides behind a parameter. DUR001 is
+  lexical: it only fires when the artifact name appears in the ``open``
+  target expression itself, so ``_put(os.path.join(d, "MANIFEST.json"),
+  data)`` delegating to ``def _put(path, data): open(path, "wb")`` is
+  invisible to it. This rule propagates artifact-ness of arguments
+  through resolved call edges to raw-write helpers.
+- **PROTO002** — a journal id minted by raw bit arithmetic (shifts /
+  or-ing constants) at a journal sink instead of through the registered
+  constructors in ``jobstate.py``/``health/scrub.py`` — and, from the
+  namespace prover below, any two registered constructors whose bit
+  layouts can collide over their declared domains.
+- **PROTO003** — a phase string committed by a protocol's two-phase
+  writer with no matching re-entry arm in the corresponding ``resume()``
+  path: a phase the actuator can durably record but the resume path
+  silently falls through is a crash window that loses work (or worse,
+  skips it).
+- **PROTO004** — a ``journal_record`` apply site with no
+  ``journal_probe`` on its path (same function or a module-local
+  callee): recording without probing double-applies on replay.
+- **PROTO005** — a topology mutator (``reshard_ps`` / ``replace_replica``
+  / ``swap_topology`` / ``apply_migration``) reachable outside a
+  drained-fence / fence-callback / resume context.
+- **PROTO006** — a statically extracted crash transition (a
+  ``reach("...")`` site) absent from the committed ``PROTO_COVERAGE.json``
+  or recorded there with zero kills: the exhaustive crash matrix
+  (tests/test_protocol.py) must kill every transition at least once.
+
+**Journal-id namespace prover.** Every id constructor is compiled from
+its AST (pure ints, no imports) and bit-probed over its declared domain:
+``f(0)`` gives the fixed-one bits, single-bit probes give the varying
+bits, and an all-ones probe verifies the constructor is bit-affine (no
+carries) so the analysis is exact, not sampled. Two families are proven
+disjoint when some bit is fixed-one in one and fixed-zero in the other;
+the witness bit is part of the result (and pinned in tests). Declared
+domains: job_epoch < 2^24, fence/train step < 2^30 (step bits 30-31 are
+namespace tags: handoff 00, scrub 01, replication 1x), replica/op < 2^7.
+
+Pure stdlib (ast only) like every pass here; never lints ``analysis/``
+itself. Suppress with ``# persia-lint: disable=PROTO00x`` on the line.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from persia_tpu.analysis.common import Finding, REPO_ROOT, read_text, rel
+from persia_tpu.analysis.durability import _ARTIFACT_RE, _ATOMIC_RE, _WRITE_MODES
+
+# journal-consuming sinks: the id argument must come from a constructor
+_JOURNAL_SINKS = frozenset({
+    "journal_record", "journal_probe",
+    "import_range_journaled", "delete_range_journaled",
+})
+
+# the registered id constructors (jobstate.py + health/scrub.py); their
+# bodies are the one place raw bit arithmetic on ids is legal
+CONSTRUCTOR_NAMES = frozenset({
+    "make_journal_id", "journal_shard_id", "handoff_journal_id",
+    "replication_journal_id", "scrub_journal_id",
+})
+
+_MUTATORS = frozenset({
+    "reshard_ps", "replace_replica", "swap_topology", "apply_migration",
+})
+
+# Enclosing-function names that ARE a drained-fence / fence-callback
+# context by construction (each entry documented; grep confirms the
+# contract at the definition site):
+# - enable_autopilot / enable_self_heal: actuator lambdas wired there run
+#   only inside the controller/healer two-phase drive, which the stream
+#   fence (train_stream(fence_callback=...)) or the heal contract gates.
+# - heal_promote: ServiceCtx promotion — the router swap inside it is the
+#   atomic replacement step of a heal that the healer drives at its fence.
+# - _ring_swapper: builds the on_imported callback the elastic engine
+#   fires at the "imported" boundary, inside the reshard fence.
+FENCE_CONTEXTS = frozenset({
+    "enable_autopilot", "enable_self_heal", "heal_promote", "_ring_swapper",
+})
+
+# phases that terminate a protocol: a resume path never needs an arm for
+# a state that means "nothing left to do"
+TERMINAL_PHASES = frozenset({"done"})
+
+COVERAGE_FILE = "PROTO_COVERAGE.json"
+
+_U64 = (1 << 64) - 1
+
+
+# ------------------------------------------------------------ module scan
+
+
+@dataclass
+class _Func:
+    qual: str
+    name: str
+    lineno: int
+    end: int
+    args: List[str]
+    stack: Tuple[str, ...]  # enclosing function names, outermost first
+    src: str
+    calls: List["_Call"] = field(default_factory=list)
+    callee_names: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class _Call:
+    name: str  # simple callee name (attr for method calls)
+    node: ast.Call
+    line: int
+
+
+@dataclass
+class _RawWriter:
+    """A function that raw-writes (open w-mode / np.savez) to a target
+    naming one of its parameters, with no atomic machinery in scope."""
+
+    qual: str
+    path: str
+    pos: int  # self-adjusted positional index of the written parameter
+    line: int
+
+
+@dataclass
+class _PhaseWriter:
+    qual: str
+    name: str
+    pos: int  # self-adjusted positional index of the phase parameter
+    line: int
+
+
+@dataclass
+class _ModuleScan:
+    path: str
+    funcs: Dict[str, _Func] = field(default_factory=dict)
+    by_name: Dict[str, List[str]] = field(default_factory=dict)
+    raw_writers: List[_RawWriter] = field(default_factory=list)
+    phase_writers: List[_PhaseWriter] = field(default_factory=list)
+    # (writer simple name, phase string, line)
+    phase_sites: List[Tuple[str, str, int]] = field(default_factory=list)
+    reach_sites: List[Tuple[str, int]] = field(default_factory=list)
+    module_calls: List[_Call] = field(default_factory=list)
+
+
+def _self_offset(args: List[str]) -> int:
+    return 1 if args and args[0] in ("self", "cls") else 0
+
+
+def _src(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover
+        return ""
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _is_open_write(node: ast.Call) -> bool:
+    f = node.func
+    is_open = (isinstance(f, ast.Name) and f.id == "open") or (
+        isinstance(f, ast.Attribute) and f.attr == "open"
+        and isinstance(f.value, ast.Name) and f.value.id == "io"
+    )
+    if not is_open:
+        if isinstance(f, ast.Attribute) and f.attr in ("savez", "savez_compressed"):
+            return bool(node.args)
+        return False
+    mode: Optional[ast.expr] = node.args[1] if len(node.args) >= 2 else None
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    return (
+        bool(node.args)
+        and isinstance(mode, ast.Constant)
+        and isinstance(mode.value, str)
+        and mode.value in _WRITE_MODES
+    )
+
+
+def _scan_module(text: str, path: str) -> Optional[_ModuleScan]:
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError:
+        return None
+    scan = _ModuleScan(path=path)
+    lines = text.splitlines()
+
+    def segment(node) -> str:
+        end = getattr(node, "end_lineno", node.lineno) or node.lineno
+        return "\n".join(lines[node.lineno - 1:end])
+
+    def walk_func(node, cls_prefix: str, stack: Tuple[str, ...]) -> None:
+        qual = f"{cls_prefix}{node.name}"
+        args = [a.arg for a in node.args.posonlyargs + node.args.args]
+        fn = _Func(
+            qual=qual, name=node.name, lineno=node.lineno,
+            end=getattr(node, "end_lineno", node.lineno) or node.lineno,
+            args=args, stack=stack, src=segment(node),
+        )
+        scan.funcs[qual] = fn
+        scan.by_name.setdefault(node.name, []).append(qual)
+        body_stack = stack + (node.name,)
+        for sub in ast.walk(node):
+            if sub is node:
+                continue
+            if isinstance(sub, ast.Call):
+                nm = _call_name(sub)
+                if nm:
+                    fn.calls.append(_Call(nm, sub, sub.lineno))
+                    fn.callee_names.add(nm)
+        _collect_raw_writer(scan, fn)
+        _collect_phase_writer(scan, fn)
+        # nested defs get their own _Func entries (with the stack)
+        for sub in node.body:
+            _walk_stmt_defs(sub, cls_prefix, body_stack)
+
+    def _walk_stmt_defs(st, cls_prefix: str, stack: Tuple[str, ...]) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walk_func(st, cls_prefix, stack)
+            return
+        if isinstance(st, ast.ClassDef):
+            for sub in st.body:
+                _walk_stmt_defs(sub, f"{st.name}.", stack)
+            return
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.stmt):
+                _walk_stmt_defs(child, cls_prefix, stack)
+
+    for st in tree.body:
+        _walk_stmt_defs(st, "", ())
+
+    # module-level calls (outside any function) + reach sites everywhere
+    func_spans = [(f.lineno, f.end) for f in scan.funcs.values()]
+
+    def in_func(line: int) -> bool:
+        return any(lo <= line <= hi for lo, hi in func_spans)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        nm = _call_name(node)
+        if nm == "reach" and node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            scan.reach_sites.append((node.args[0].value, node.lineno))
+        if nm and not in_func(node.lineno):
+            scan.module_calls.append(_Call(nm, node, node.lineno))
+
+    # phase write sites: calls to a phase writer with a string constant
+    writer_by_name = {w.name: w for w in scan.phase_writers}
+    for fn in scan.funcs.values():
+        for call in fn.calls:
+            w = writer_by_name.get(call.name)
+            if w is None or w.pos >= len(call.node.args):
+                continue
+            arg = call.node.args[w.pos]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                scan.phase_sites.append((w.name, arg.value, call.line))
+    return scan
+
+
+def _collect_raw_writer(scan: _ModuleScan, fn: _Func) -> None:
+    if _ATOMIC_RE.search(fn.src):
+        return
+    off = _self_offset(fn.args)
+    for call in fn.calls:
+        if not _is_open_write(call.node):
+            continue
+        tsrc = _src(call.node.args[0])
+        for i, a in enumerate(fn.args[off:]):
+            # the parameter must appear in the target expression
+            if a in tsrc.replace(".", " ").replace("(", " ").replace(")", " ") \
+                    .replace(",", " ").replace("[", " ").replace("]", " ").split() \
+                    or tsrc == a:
+                scan.raw_writers.append(
+                    _RawWriter(fn.qual, scan.path, i, call.line)
+                )
+                return
+
+
+def _collect_phase_writer(scan: _ModuleScan, fn: _Func) -> None:
+    """A two-phase writer: a function whose body commits a dict carrying a
+    literal ``"phase"`` key whose value is one of its own parameters."""
+    off = _self_offset(fn.args)
+    for call in fn.calls:
+        for node in ast.walk(call.node):
+            if not isinstance(node, ast.Dict):
+                continue
+            for k, v in zip(node.keys, node.values):
+                if (
+                    isinstance(k, ast.Constant) and k.value == "phase"
+                    and isinstance(v, ast.Name) and v.id in fn.args[off:]
+                ):
+                    scan.phase_writers.append(_PhaseWriter(
+                        fn.qual, fn.name, fn.args.index(v.id) - off, fn.lineno,
+                    ))
+                    return
+
+
+# ------------------------------------------------------------------- rules
+
+
+def _rule_proto001(scans: List[_ModuleScan]) -> List[Finding]:
+    """Artifact-named argument flowing into a raw-write helper."""
+    writers: Dict[str, _RawWriter] = {}
+    ambiguous: Set[str] = set()
+    for scan in scans:
+        for w in scan.raw_writers:
+            simple = w.qual.rsplit(".", 1)[-1]
+            if simple in writers:
+                ambiguous.add(simple)
+            writers[simple] = w
+    findings: List[Finding] = []
+    for scan in scans:
+        all_calls = [(fn, c) for fn in scan.funcs.values() for c in fn.calls]
+        all_calls += [(None, c) for c in scan.module_calls]
+        for fn, call in all_calls:
+            w = writers.get(call.name)
+            if w is None or call.name in ambiguous:
+                continue
+            if fn is not None and fn.qual == w.qual and scan.path == w.path:
+                continue  # the writer's own recursive mention
+            if w.pos >= len(call.node.args):
+                continue
+            argsrc = _src(call.node.args[w.pos])
+            if not _ARTIFACT_RE.search(argsrc):
+                continue
+            if fn is not None and _ATOMIC_RE.search(fn.src):
+                continue  # caller participates in an atomic publish dance
+            findings.append(Finding(
+                "PROTO001", scan.path, call.line,
+                f"checkpoint artifact {argsrc!r} written through "
+                f"{call.name}() whose open() has no temp+fsync+rename — "
+                "interprocedural DUR001: the helper publishes a torn file "
+                "under the final name on crash (use "
+                "jobstate.fsync_write_bytes / storage.write_bytes)",
+            ))
+    return findings
+
+
+def _raw_mint(node: ast.expr) -> bool:
+    """True when the expression builds an id by raw bit arithmetic: a
+    shift, or or-ing an integer constant — with no registered constructor
+    call anywhere inside it."""
+    has_bits = False
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            nm = _call_name(sub)
+            if nm in CONSTRUCTOR_NAMES or nm.endswith("_journal_id"):
+                return False
+        if isinstance(sub, ast.BinOp):
+            if isinstance(sub.op, ast.LShift):
+                has_bits = True
+            elif isinstance(sub.op, ast.BitOr):
+                for side in (sub.left, sub.right):
+                    if isinstance(side, ast.Constant) and isinstance(side.value, int):
+                        has_bits = True
+    return has_bits
+
+
+def _rule_proto002(scan: _ModuleScan) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in scan.funcs.values():
+        if fn.name in CONSTRUCTOR_NAMES:
+            continue  # the registered constructors own the bit layout
+        # last-assignment map: name -> RHS exprs within this function
+        assigns: Dict[str, List[ast.expr]] = {}
+        body_calls = []
+        for call in fn.calls:
+            body_calls.append(call)
+        # re-walk for assignments (calls were collected already)
+        # fn.src re-parse is wasteful; use the stored call nodes' parents
+        # instead: walk assignments from the function's source segment
+        try:
+            seg = ast.parse(_dedent(fn.src))
+        except SyntaxError:
+            seg = None
+        if seg is not None:
+            for node in ast.walk(seg):
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            assigns.setdefault(tgt.id, []).append(node.value)
+                elif isinstance(node, ast.AugAssign) and isinstance(node.target, ast.Name):
+                    assigns.setdefault(node.target.id, []).append(node.value)
+        for call in fn.calls:
+            if call.name not in _JOURNAL_SINKS or not call.node.args:
+                continue
+            idarg = call.node.args[0]
+            raw = _raw_mint(idarg)
+            if not raw and isinstance(idarg, ast.Name):
+                raw = any(_raw_mint(r) for r in assigns.get(idarg.id, ()))
+            if raw:
+                findings.append(Finding(
+                    "PROTO002", scan.path, call.line,
+                    f"journal id reaching {call.name}() is minted by raw bit "
+                    "arithmetic — ids must come from the registered "
+                    "constructors in jobstate.py (make_journal_id / "
+                    "journal_shard_id / handoff_journal_id / "
+                    "replication_journal_id / scrub_journal_id) so the "
+                    "namespace prover can see the layout",
+                ))
+    return findings
+
+
+def _dedent(src: str) -> str:
+    import textwrap
+
+    return textwrap.dedent(src)
+
+
+def _rule_proto003(scan: _ModuleScan) -> List[Finding]:
+    if not scan.phase_sites:
+        return []
+    # resume-reachable closure over module-local simple-name call edges
+    roots = [q for q, f in scan.funcs.items() if f.name.startswith("resume")]
+    reachable: Set[str] = set()
+    work = list(roots)
+    while work:
+        q = work.pop()
+        if q in reachable:
+            continue
+        reachable.add(q)
+        for callee in scan.funcs[q].callee_names:
+            for target in scan.by_name.get(callee, ()):
+                if target not in reachable:
+                    work.append(target)
+    arms: Set[str] = set()
+    for q in reachable:
+        fn = scan.funcs[q]
+        try:
+            seg = ast.parse(_dedent(fn.src))
+        except SyntaxError:
+            continue
+        for node in ast.walk(seg):
+            if not isinstance(node, ast.Compare):
+                continue
+            involved = _src(node.left) + "".join(_src(c) for c in node.comparators)
+            if "phase" not in involved:
+                continue
+            for expr in [node.left] + list(node.comparators):
+                for sub in ast.walk(expr):
+                    if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                        arms.add(sub.value)
+    findings: List[Finding] = []
+    for writer, phase, line in scan.phase_sites:
+        if phase in TERMINAL_PHASES or phase in arms:
+            continue
+        findings.append(Finding(
+            "PROTO003", scan.path, line,
+            f"phase {phase!r} is committed by {writer}() but no resume path "
+            f"in this module compares against it (arms seen: "
+            f"{sorted(arms) or 'none'}) — a crash after this commit leaves "
+            "a durable state the re-entry logic silently falls through",
+        ))
+    return findings
+
+
+def _rule_proto004(scan: _ModuleScan) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in scan.funcs.values():
+        if fn.name == "journal_record":
+            continue  # the journal primitive itself
+        for call in fn.calls:
+            if call.name != "journal_record":
+                continue
+            if _probes_on_path(scan, fn.qual, set()):
+                continue
+            findings.append(Finding(
+                "PROTO004", scan.path, call.line,
+                "journal_record() with no journal_probe on its path — an "
+                "apply site that records without probing re-applies its "
+                "payload on every resume replay (exactly-once requires "
+                "probe-before-record)",
+            ))
+    return findings
+
+
+def _probes_on_path(scan: _ModuleScan, qual: str, seen: Set[str]) -> bool:
+    if qual in seen:
+        return False
+    seen.add(qual)
+    fn = scan.funcs[qual]
+    if "journal_probe" in fn.callee_names:
+        return True
+    for callee in fn.callee_names:
+        for target in scan.by_name.get(callee, ()):
+            if _probes_on_path(scan, target, seen):
+                return True
+    return False
+
+
+def _rule_proto005(scan: _ModuleScan) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def exempt(mutator: str, chain: Sequence[str]) -> bool:
+        for name in chain:
+            if name == mutator:
+                return True  # a delegating wrapper IS the guarded surface
+            if name.startswith("resume"):
+                return True  # re-entry arms run inside the recovery fence
+            if "fence" in name or "drain" in name:
+                return True
+            if name in FENCE_CONTEXTS:
+                return True
+        return False
+
+    for fn in scan.funcs.values():
+        chain = list(fn.stack) + [fn.name]
+        for call in fn.calls:
+            if call.name not in _MUTATORS:
+                continue
+            if exempt(call.name, chain):
+                continue
+            findings.append(Finding(
+                "PROTO005", scan.path, call.line,
+                f"topology mutator {call.name}() reachable outside a "
+                f"drained-fence / fence_callback / resume context (enclosing "
+                f"chain: {' -> '.join(chain)}) — topology may only change "
+                "inside the one window the stream fence guarantees quiescent",
+            ))
+    for call in scan.module_calls:
+        if call.name in _MUTATORS:
+            findings.append(Finding(
+                "PROTO005", scan.path, call.line,
+                f"topology mutator {call.name}() invoked at module scope — "
+                "topology may only change inside a drained-fence context",
+            ))
+    return findings
+
+
+# --------------------------------------------------- namespace prover
+
+
+@dataclass
+class BitPattern:
+    fixed_one: int
+    fixed_zero: int
+    affine: bool
+
+    @property
+    def varying(self) -> int:
+        return _U64 & ~(self.fixed_one | self.fixed_zero)
+
+
+def probe_bits(fn, widths: Sequence[int]) -> BitPattern:
+    """Exact bit analysis of a bit-routing constructor over its declared
+    domain: ``f(0)`` = fixed-one bits; single-bit probes accumulate the
+    varying mask; the all-ones probe certifies there are no carries (the
+    function is bit-affine), making the fixed masks exact, not sampled."""
+    zeros = [0] * len(widths)
+    base = fn(*zeros) & _U64
+    union = 0
+    for i, w in enumerate(widths):
+        for b in range(w):
+            args = list(zeros)
+            args[i] = 1 << b
+            union |= (fn(*args) ^ base) & _U64
+    maxes = [(1 << w) - 1 for w in widths]
+    affine = (fn(*maxes) & _U64) == (base | union)
+    return BitPattern(
+        fixed_one=base, fixed_zero=_U64 & ~(base | union), affine=affine,
+    )
+
+
+def disjoint_witness(a: BitPattern, b: BitPattern) -> Optional[int]:
+    """Lowest bit proving the two id spaces can never collide (fixed-one
+    in one, fixed-zero in the other), or None when no such bit exists."""
+    m = (a.fixed_one & b.fixed_zero) | (b.fixed_one & a.fixed_zero)
+    if m == 0:
+        return None
+    return (m & -m).bit_length() - 1
+
+
+# name-keyed declared domains (bit widths). Fence/train steps are < 2^30
+# BY CONTRACT: step bits 30-31 are namespace subspace tags (handoff 00,
+# scrub 01, replication 1x) — see jobstate.py / health/scrub.py.
+_DOMAIN_BITS = {
+    "job_epoch": 24, "epoch": 24, "step": 30,
+    "op": 7, "op_index": 7, "replica": 7, "replica_index": 7, "r": 7,
+}
+_DEFAULT_DOMAIN = 24
+
+# the four shipped id families over the compiled constructor namespace
+_FAMILIES: List[Tuple[str, Sequence[int]]] = [
+    ("gradient", (24, 30, 7)),
+    ("handoff", (24, 30, 7)),
+    ("replication", (24, 30, 7)),
+    ("scrub", (24, 30, 7)),
+]
+
+
+def _family_fns(ns: Dict) -> Dict[str, object]:
+    return {
+        "gradient": lambda e, s, r: ns["journal_shard_id"](
+            ns["make_journal_id"](e, s), r),
+        "handoff": lambda e, s, op: ns["handoff_journal_id"](
+            ns["make_journal_id"](e, s), op),
+        "replication": lambda e, s, op: ns["replication_journal_id"](e, s, op),
+        "scrub": lambda e, s, r: ns["scrub_journal_id"](e, s, r),
+    }
+
+
+_CONST_EXPR_NODES = (
+    ast.Constant, ast.BinOp, ast.UnaryOp, ast.Name,
+    ast.operator, ast.unaryop, ast.expr_context,
+)
+
+
+def _is_const_assign(node: ast.stmt) -> bool:
+    return (
+        isinstance(node, ast.Assign)
+        and len(node.targets) == 1
+        and isinstance(node.targets[0], ast.Name)
+        and all(isinstance(s, _CONST_EXPR_NODES) for s in ast.walk(node.value))
+    )
+
+
+def _compile_constructors(root: str) -> Tuple[Dict, Dict[str, Tuple[str, int]]]:
+    """exec the registered constructor FunctionDefs (plus the constant
+    assigns they reference) into one shared namespace. Returns (namespace,
+    {name: (repo-relative path, def line)})."""
+    ns: Dict = {}
+    where: Dict[str, Tuple[str, int]] = {}
+    for relpath in ("persia_tpu/jobstate.py", "persia_tpu/health/scrub.py"):
+        path = os.path.join(root, relpath)
+        if not os.path.exists(path):
+            continue
+        tree = ast.parse(read_text(path), filename=path)
+        picked: List[ast.stmt] = []
+        for node in tree.body:
+            if _is_const_assign(node):
+                picked.append(node)
+            elif isinstance(node, ast.FunctionDef) and node.name in CONSTRUCTOR_NAMES:
+                where[node.name] = (relpath, node.lineno)
+                picked.append(node)
+        mod = ast.Module(body=picked, type_ignores=[])
+        ast.fix_missing_locations(mod)
+        try:
+            exec(compile(mod, path, "exec"), ns)  # noqa: S102 - own repo source
+        except Exception:
+            continue
+    return ns, where
+
+
+def prove_namespaces(root: str = REPO_ROOT) -> Dict:
+    """Bit-prove pairwise disjointness of the shipped journal-id families.
+    Returns ``{"patterns": {family: BitPattern}, "pairs": {(a, b): witness
+    bit or None}, "where": {constructor: (path, line)}}``."""
+    ns, where = _compile_constructors(root)
+    fns = _family_fns(ns)
+    patterns: Dict[str, BitPattern] = {}
+    for fam, widths in _FAMILIES:
+        fn = fns[fam]
+        try:
+            patterns[fam] = probe_bits(fn, widths)
+        except Exception:
+            continue  # constructor missing under this root
+    pairs: Dict[Tuple[str, str], Optional[int]] = {}
+    fams = [f for f, _ in _FAMILIES if f in patterns]
+    for i, a in enumerate(fams):
+        for b in fams[i + 1:]:
+            pairs[(a, b)] = disjoint_witness(patterns[a], patterns[b])
+    return {"patterns": patterns, "pairs": pairs, "where": where}
+
+
+def _prover_findings(root: str) -> List[Finding]:
+    proof = prove_namespaces(root)
+    if not proof["patterns"]:
+        return []
+    findings: List[Finding] = []
+    for fam, pat in sorted(proof["patterns"].items()):
+        if not pat.affine:
+            findings.append(Finding(
+                "PROTO002", "persia_tpu/jobstate.py", 1,
+                f"journal-id family {fam!r} is not bit-affine over its "
+                "declared domain — the namespace prover cannot certify its "
+                "layout (avoid arithmetic with carries in id constructors)",
+            ))
+    for (a, b), witness in sorted(proof["pairs"].items()):
+        if witness is None:
+            findings.append(Finding(
+                "PROTO002", "persia_tpu/jobstate.py", 1,
+                f"journal-id namespaces {a!r} and {b!r} OVERLAP: no bit is "
+                "fixed-one in one and fixed-zero in the other over the "
+                "declared domains — a collision dedupes one protocol's op "
+                "against the other's record (crc mismatch => hard error at "
+                "the apply site)",
+            ))
+    return findings
+
+
+def _fixture_prover_findings(scan: _ModuleScan, text: str) -> List[Finding]:
+    """check_source path: prove any ``*_journal_id`` constructors defined
+    in this single module against each other (fixtures for the prover)."""
+    tree = ast.parse(text)
+    ctors: List[ast.FunctionDef] = [
+        n for n in tree.body
+        if isinstance(n, ast.FunctionDef) and n.name.endswith("_journal_id")
+    ]
+    if len(ctors) < 2:
+        return []
+    ns: Dict = {}
+    picked: List[ast.stmt] = [
+        n for n in tree.body if _is_const_assign(n)
+    ] + list(ctors)
+    mod = ast.Module(body=picked, type_ignores=[])
+    ast.fix_missing_locations(mod)
+    try:
+        exec(compile(mod, scan.path, "exec"), ns)  # noqa: S102 - test fixture
+    except Exception:
+        return []
+    pats: Dict[str, Tuple[BitPattern, int]] = {}
+    for c in ctors:
+        widths = [
+            _DOMAIN_BITS.get(a.arg, _DEFAULT_DOMAIN)
+            for a in c.args.posonlyargs + c.args.args
+        ]
+        try:
+            pats[c.name] = (probe_bits(ns[c.name], widths), c.lineno)
+        except Exception:
+            continue
+    names = sorted(pats)
+    findings: List[Finding] = []
+    for i, a in enumerate(names):
+        for b in names[i + 1:]:
+            if disjoint_witness(pats[a][0], pats[b][0]) is None:
+                findings.append(Finding(
+                    "PROTO002", scan.path, pats[b][1],
+                    f"journal-id namespaces {a!r} and {b!r} OVERLAP over "
+                    "their declared domains — no fixed bit separates them",
+                ))
+    return findings
+
+
+# ----------------------------------------------------------- reach sites
+
+
+def reach_sites(
+    root: str = REPO_ROOT, files: Optional[Sequence[str]] = None,
+) -> Dict[str, List[Tuple[str, int]]]:
+    """site name -> [(repo-relative path, line)] for every
+    ``reach("...")`` crash point in the tree — the statically extracted
+    transition set the crash matrices must cover 100%."""
+    from persia_tpu.analysis.common import python_files
+
+    paths = list(files) if files is not None else python_files(root)
+    out: Dict[str, List[Tuple[str, int]]] = {}
+    for p in paths:
+        abspath = p if os.path.isabs(p) else os.path.join(root, p)
+        if (os.sep + "analysis" + os.sep) in abspath:
+            continue
+        scan = _scan_module(read_text(abspath), rel(abspath))
+        if scan is None:
+            continue
+        for site, line in scan.reach_sites:
+            out.setdefault(site, []).append((rel(abspath), line))
+    return out
+
+
+def _coverage_findings(root: str, sites: Dict[str, List[Tuple[str, int]]]) -> List[Finding]:
+    from persia_tpu.analysis import crashcheck
+
+    cov_path = os.path.join(root, COVERAGE_FILE)
+    if not sites:
+        return []
+    if not os.path.exists(cov_path):
+        return [Finding(
+            "PROTO006", COVERAGE_FILE, 1,
+            f"{len(sites)} reach() crash transitions extracted but no "
+            f"{COVERAGE_FILE} committed — run the full crash matrix "
+            "(python tests/test_protocol.py --write-coverage)",
+        )]
+    try:
+        data = crashcheck.load_coverage(cov_path)
+    except (OSError, ValueError):
+        return [Finding("PROTO006", COVERAGE_FILE, 1,
+                        f"{COVERAGE_FILE} is unreadable or not JSON")]
+    return [
+        Finding("PROTO006", COVERAGE_FILE, 1,
+                p + " — every statically extracted transition must be "
+                "killed at least once by tests/test_protocol.py")
+        for p in crashcheck.validate_coverage(data, sites)
+    ]
+
+
+# --------------------------------------------------------------------- API
+
+
+def check_source(text: str, path: str) -> List[Finding]:
+    """Single-module entry point (fixtures): every rule evaluated with
+    module-local resolution only, plus the fixture namespace prover."""
+    scan = _scan_module(text, path)
+    if scan is None:
+        return []
+    findings = _rule_proto001([scan])
+    findings += _rule_proto002(scan)
+    findings += _rule_proto003(scan)
+    findings += _rule_proto004(scan)
+    findings += _rule_proto005(scan)
+    findings += _fixture_prover_findings(scan, text)
+    return findings
+
+
+def check(
+    root: str = REPO_ROOT, files: Optional[Sequence[str]] = None,
+) -> Tuple[List[Finding], Dict[str, object]]:
+    from persia_tpu.analysis.common import python_files
+
+    paths = list(files) if files is not None else python_files(root)
+    scans: List[_ModuleScan] = []
+    texts = 0
+    for p in paths:
+        abspath = p if os.path.isabs(p) else os.path.join(root, p)
+        if (os.sep + "analysis" + os.sep) in abspath:
+            continue  # the lint does not lint itself
+        scan = _scan_module(read_text(abspath), rel(abspath))
+        if scan is None:
+            continue
+        scans.append(scan)
+        texts += 1
+    findings = _rule_proto001(scans)
+    for scan in scans:
+        findings += _rule_proto002(scan)
+        findings += _rule_proto003(scan)
+        findings += _rule_proto004(scan)
+        findings += _rule_proto005(scan)
+    findings += _prover_findings(root)
+    sites = {}
+    for scan in scans:
+        for site, line in scan.reach_sites:
+            sites.setdefault(site, []).append((scan.path, line))
+    findings += _coverage_findings(root, sites)
+    proof = prove_namespaces(root)
+    coverage = {
+        "files": texts,
+        "phase_writers": sum(len(s.phase_writers) for s in scans),
+        "phase_sites": sum(len(s.phase_sites) for s in scans),
+        "reach_sites": len(sites),
+        "families_proven": sorted(proof["patterns"].keys()),
+        "pairs_disjoint": sum(
+            1 for w in proof["pairs"].values() if w is not None
+        ),
+        "pairs_total": len(proof["pairs"]),
+    }
+    return findings, coverage

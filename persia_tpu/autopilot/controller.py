@@ -41,6 +41,7 @@ from typing import Callable, Dict, Optional
 import numpy as np
 
 from persia_tpu import jobstate
+from persia_tpu.analysis.crashcheck import reach
 from persia_tpu.embedding.tiering.profiler import publish_sketch_metrics
 from persia_tpu.embedding.tiering.shard_planner import ShardPlanner
 from persia_tpu.logger import get_default_logger
@@ -208,9 +209,12 @@ class Autopilot:
                      })
         logger.info("autopilot: %s @ step %d — %s",
                     decision.kind, step, decision.reason)
+        reach("autopilot.phase.planned")
         self._commit("planned", decision, step)
+        reach("autopilot.actuate")
         with span("autopilot.actuate", kind=decision.kind, step=step):
             result = self._actuate(decision, step)
+        reach("autopilot.phase.done")
         self._commit("done", decision, step, result)
         self._m_decisions.inc(kind=decision.kind)
         return result

@@ -33,6 +33,7 @@ from dataclasses import asdict, dataclass
 from typing import Callable, Dict, List, Optional
 
 from persia_tpu import jobstate
+from persia_tpu.analysis.crashcheck import reach
 from persia_tpu.logger import get_default_logger
 from persia_tpu.metrics import get_metrics
 from persia_tpu.tracing import record_event, span
@@ -218,6 +219,7 @@ class Healer:
         promote: Optional[Callable] = None,
         drain: Optional[Callable] = None,
         resize: Optional[Callable] = None,
+        resume_resize: Optional[Callable] = None,
         sensors: Optional[Callable] = None,
         batch_advances: Optional[Callable] = None,
         probe_factory: Optional[Callable] = None,
@@ -230,6 +232,7 @@ class Healer:
         self._promote = promote
         self._drain = drain
         self._resize = resize
+        self._resume_resize = resume_resize
         self._sensors = sensors
         self._batch_advances = batch_advances
         self._probe_factory = probe_factory
@@ -314,9 +317,11 @@ class Healer:
                      victim=decision.params.get("victim", -1))
         logger.info("healer: %s @ step %d — %s",
                     decision.params["action"], step, decision.reason)
+        reach("heal.phase.planned")
         self._commit("planned", decision, step)
         if self._fault_hook is not None:
             self._fault_hook("planned")
+        reach("heal.actuate")
         with span("heal.actuate", action=decision.params["action"], step=step):
             result = self._actuate(decision)
         if detect_ts is not None:
@@ -324,6 +329,7 @@ class Healer:
             result["mttr_s"] = mttr
             self.mttr_s.append(mttr)
             self._m_mttr.observe(mttr)
+        reach("heal.phase.done")
         self._commit("done", decision, step, result)
         self.heals += 1
         self._m_decisions.inc(action=decision.params["action"])
@@ -408,7 +414,15 @@ class Healer:
         replays the same snapshot + advances into a standby and upserts
         the registration; resize resumes through the journal-deduped
         elastic engine). A clean log returns None; a second resume after
-        completion is a no-op."""
+        completion is a no-op.
+
+        An interrupted RESIZE re-enters through ``resume_resize``
+        (:func:`~persia_tpu.elastic.resume_reshard` under the recorded
+        phase manifest) — re-running a FRESH ``reshard_ps`` instead would
+        re-plan against a half-moved ring. Only when the kill landed
+        before the engine's first phase commit (resume_resize → None)
+        does the recorded decision re-actuate from scratch — same plan,
+        same journal ids, every op dedupes."""
         meta = self.pending()
         if meta is None:
             return None
@@ -420,7 +434,14 @@ class Healer:
         logger.info("healer: resuming planned %s from step %d",
                     decision.params["action"], step)
         with span("heal.resume", action=decision.params["action"], step=step):
-            result = self._actuate(decision)
+            if (decision.params["action"] == ACTION_RESIZE
+                    and self._resume_resize is not None):
+                result = self._resume_resize()
+                if result is None:  # killed before the engine's first phase
+                    result = self._actuate(decision)
+                result = dict(result)
+            else:
+                result = self._actuate(decision)
         self._commit("done", decision, step, result)
         self.heals += 1
         self._m_resumed.inc()
@@ -480,6 +501,7 @@ def enable_self_heal(
         resize=lambda n_new: svc.reshard_ps(
             n_new, reshard_mgr, router=router,
         ),
+        resume_resize=lambda: svc.resume_reshard(reshard_mgr, router=router),
         sensors=sensors,
         batch_advances=batch_advances,
         probe_factory=lambda addr: make_probe(addr, timeout_s=probe_timeout_s),

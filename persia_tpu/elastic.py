@@ -62,6 +62,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from persia_tpu import jobstate, tracing
+from persia_tpu.analysis.crashcheck import reach
 from persia_tpu.logger import get_default_logger
 from persia_tpu.metrics import get_metrics
 
@@ -282,6 +283,7 @@ def _run_imports(
         for idx, mv in enumerate(plan.moves):
             if fault_hook is not None:
                 fault_hook("import", idx, mv)
+            reach("elastic.op.import")
             blob = sources[mv.src].export_range(mv.lo, mv.hi)
             jid = jobstate.handoff_journal_id(plan.base_id, idx)
             crc = zlib.crc32(blob) & 0xFFFFFFFF
@@ -308,6 +310,7 @@ def _run_deletes(
         for i, mv in enumerate(deletes):
             if fault_hook is not None:
                 fault_hook("delete", i, mv)
+            reach("elastic.op.delete")
             jid = jobstate.handoff_journal_id(plan.base_id, len(plan.moves) + i)
             crc = jobstate.payload_crc(np.array([mv.lo, mv.hi], dtype=np.uint64))
             applied, removed = sources[mv.src].delete_range_journaled(
@@ -354,11 +357,14 @@ def _finish(
     any crash dedupes instead of double-applying."""
     if start_phase == "handoff":
         _run_imports(plan, sources, dests, stats, fault_hook)
+        reach("elastic.phase.imported")
         _commit_phase(mgr, plan, "imported", extra_meta,
                       capture=("dest", "dest_shards", dests))
     if on_imported is not None:
+        reach("elastic.swap")
         on_imported()
     _run_deletes(plan, sources, stats, fault_hook)
+    reach("elastic.phase.done")
     _commit_phase(mgr, plan, "done", extra_meta)
     _m_reshards.inc()
     logger.info(
@@ -405,6 +411,7 @@ def execute_reshard(
         )
     mgr = jobstate.coerce_manager(job_state)
     with tracing.span("reshard.fence", old_n=plan.old_n, new_n=plan.new_n):
+        reach("elastic.phase.handoff")
         _commit_phase(mgr, plan, "handoff", extra_meta,
                       capture=("source", "source_shards", sources))
     stats = _new_stats("handoff", resumed=False)
@@ -437,6 +444,13 @@ def resume_reshard(
             f"{len(sources)} sources / {len(dests)} dests"
         )
     phase = man.meta["phase"]
+    if phase not in ("handoff", "imported"):
+        # an unknown phase must be loud: falling through to _finish would
+        # run deletes-only and release source ranges that never imported
+        raise jobstate.ManifestError(
+            f"reshard manifest records unknown phase {phase!r} "
+            "(expected 'handoff' or 'imported')"
+        )
     extra = {"optimizer": man.meta["optimizer"]} if "optimizer" in man.meta else None
     tracing.record_event("reshard.resume", phase=phase,
                          old_n=plan.old_n, new_n=plan.new_n)

@@ -19,23 +19,33 @@ from typing import Optional
 
 import numpy as np
 
+from persia_tpu.analysis.crashcheck import reach
 from persia_tpu.jobstate import make_journal_id, payload_crc
 from persia_tpu.metrics import get_metrics
 from persia_tpu.tracing import record_event
 
 # Constant crc tag for scrub journal records: a probe hit with a
 # DIFFERENT crc under a scrub id means the id space collided with a
-# gradient record — loud error, never silent skip.
+# non-scrub record — loud error, never silent skip.
 SCRUB_CRC = payload_crc(np.frombuffer(b"health.scrub", dtype=np.uint8))
 
-# Scrub ids claim the top half of the low-byte (replica) space of
-# make_journal_id; gradient records use journal_shard_id(base, replica)
-# with small replica indices, so the two never collide in practice.
+# Scrub ids claim the 0x80 half of the low byte (like handoff ids) plus
+# step bit 30 as the scrub subspace tag: gradient ids keep low byte
+# < 0x80, handoff ids have step bit 30 = 0 and bit 31 = 0, replication
+# ids have step bit 31 = 1 — so all four id families are pairwise
+# disjoint by a fixed bit, and the namespace prover in
+# analysis/protocol.py certifies it. Fence/train steps stay < 2^30 by
+# the same contract that kept them < 2^31 for replication ids.
 _SCRUB_SUBID = 0x80
+_SCRUB_STEP_BIT = 1 << 30
 
 
 def scrub_journal_id(job_epoch: int, step: int, replica_index: int = 0) -> int:
-    return make_journal_id(job_epoch, step) | _SCRUB_SUBID | (replica_index & 0x7F)
+    return (
+        make_journal_id(job_epoch, (step & 0x3FFFFFFF) | _SCRUB_STEP_BIT)
+        | _SCRUB_SUBID
+        | (replica_index & 0x7F)
+    )
 
 
 def scrub_store(store, journal_id: Optional[int] = None, cap: int = 65536) -> dict:
@@ -57,6 +67,7 @@ def scrub_store(store, journal_id: Optional[int] = None, cap: int = 65536) -> di
             )
     repaired, signs = store.scan_nonfinite(cap=cap)
     if journal_id is not None:
+        reach("scrub.record")
         store.journal_record(journal_id, SCRUB_CRC)
     return {"repaired": int(repaired), "signs": list(signs), "skipped": False}
 

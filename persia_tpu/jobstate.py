@@ -47,6 +47,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from persia_tpu.analysis.crashcheck import reach
 from persia_tpu.logger import get_default_logger
 
 logger = get_default_logger("persia_tpu.jobstate")
@@ -119,8 +120,16 @@ def make_journal_id(job_epoch: int, step: int) -> int:
 
 
 def journal_shard_id(base_id: int, replica_index: int) -> int:
-    """Mix the PS replica index into a :func:`make_journal_id` base."""
-    return base_id | (replica_index & 0xFF)
+    """Mix the PS replica index into a :func:`make_journal_id` base.
+    Replica indices must stay below 0x80 — the 0x80 low-byte half belongs
+    to the handoff/replication/scrub namespaces (the namespace prover in
+    ``analysis/protocol.py`` certifies the split)."""
+    if not 0 <= replica_index < 0x80:
+        raise ValueError(
+            f"replica_index {replica_index} outside the gradient-id namespace "
+            "[0, 0x80) — the high low-byte half is reserved for handoff ids"
+        )
+    return base_id | replica_index
 
 
 def handoff_journal_id(base_id: int, op_index: int) -> int:
@@ -252,6 +261,7 @@ class EpochWriter:
     def add_blob(self, name: str, data: bytes) -> None:
         if self._committed:
             raise ManifestError("epoch already committed")
+        reach("jobstate.commit.component")
         fsync_write_bytes(os.path.join(self.dir, name), data)
         self._components[name] = {
             "bytes": len(data), "crc32": zlib.crc32(data) & 0xFFFFFFFF,
@@ -269,9 +279,11 @@ class EpochWriter:
         manifest["job_epoch"] = self.job_epoch
         manifest["components"] = self._components
         manifest.setdefault("datetime", time.strftime("%Y-%m-%dT%H:%M:%S"))
+        reach("jobstate.commit.manifest")
         fsync_write_bytes(
             os.path.join(self.dir, MANIFEST_NAME), json.dumps(manifest).encode()
         )
+        reach("jobstate.commit.pointer")
         fsync_write_bytes(
             os.path.join(self.root, LAST_GOOD),
             json.dumps(

@@ -288,7 +288,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 if args.ps <= 0:
                     print("--reshard-ps needs --ps > 0", file=sys.stderr)
                     return 2
-                stats = topo.reshard_ps(args.reshard_ps)
+                # operator CLI at job setup: the stream has not started, so
+                # the whole fleet is trivially drained here
+                stats = topo.reshard_ps(args.reshard_ps)  # persia-lint: disable=PROTO005
                 print(f"PS tier resharded {args.ps} -> {args.reshard_ps}: "
                       f"{_json.dumps({k: v for k, v in stats.items() if k != 'skew_splits'})}",
                       flush=True)

@@ -7,8 +7,12 @@
 #   bash scripts/round_preflight.sh
 #
 # 0. persia-verify (ABI drift + lexical AND interprocedural concurrency
-#    + JAX trace-discipline + resilience rules; fails on any finding not
-#    in scripts/lint_baseline.json when that file exists) + native cores
+#    + JAX trace-discipline + resilience rules + the PROTO protocol pass:
+#    journal-id namespace prover, two-phase/resume shape rules, and the
+#    PROTO_COVERAGE.json crash-matrix completeness contract; fails on any
+#    finding not in scripts/lint_baseline.json when that file exists)
+#    + the fast protocol crash matrices (fence / scrub / heal promotion,
+#    every reach() transition killed once + resumed) + native cores
 #    compile from source + the fused-feed ABI parity tests pass
 #    (a broken ctypes signature loads fine and silently corrupts — the
 #    lint catches the declaration drift, the golden parity tests catch
@@ -36,6 +40,14 @@ if [ -f scripts/lint_baseline.json ]; then
 else
     python -m persia_tpu.analysis
 fi
+# protocol layer (ISSUE 19): static extraction + prover units + the fast
+# crash matrices — jobstate fence, scrub record, healer promotion — every
+# extracted reach() transition killed once and the resumed end state
+# compared bit-for-bit against an uninterrupted run. The ~35-point
+# reshard and autopilot matrices ride the full suite in step 2; the
+# committed PROTO_COVERAGE.json (validated here via PROTO006 above and
+# test_committed_coverage_is_complete) proves ALL of them ran.
+JAX_PLATFORMS=cpu python -m pytest tests/test_protocol.py -q -m 'not slow'
 # force=True recompile of every core: the stamp cache must not mask a
 # toolchain or source breakage
 JAX_PLATFORMS=cpu python - <<'PY'

@@ -1,0 +1,29 @@
+"""PROTO003 fixture: the actuator durably commits a "planned" phase, but
+the resume path only knows "done" — a crash after the planned commit
+leaves a state resume silently falls through."""
+
+
+class Driver:
+    def __init__(self, mgr):
+        self.mgr = mgr
+
+    def _commit(self, phase, step):
+        w = self.mgr.begin_epoch()
+        w.commit({"proto": {"phase": phase, "step": step}})
+
+    def drive(self, step):
+        self._commit("planned", step)  # BAD: no resume arm for "planned"
+        self.actuate()
+        self._commit("done", step)  # fine: terminal
+
+    def actuate(self):
+        pass
+
+    def resume(self):
+        man = self.mgr.latest()
+        if man is None:
+            return None
+        meta = man.meta.get("proto") or {}
+        if meta.get("phase") == "done":
+            return None
+        return None  # falls through: "planned" never re-driven

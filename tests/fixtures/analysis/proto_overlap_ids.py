@@ -1,0 +1,13 @@
+"""Namespace-prover fixture: two id constructors whose bit layouts
+collide over their declared domains — op_journal_id forgot the namespace
+tag, so its op=0 id is bit-identical to a gradient id at the same
+(epoch, step). The prover must report the overlap as PROTO002."""
+
+
+def grad_journal_id(epoch, step):
+    return ((epoch & 0xFFFFFF) << 40) | ((step & 0x3FFFFFFF) << 8)
+
+
+def op_journal_id(epoch, step, op):
+    # BAD: no fixed tag bit separates this from grad_journal_id
+    return ((epoch & 0xFFFFFF) << 40) | ((step & 0x3FFFFFFF) << 8) | (op & 0x7F)

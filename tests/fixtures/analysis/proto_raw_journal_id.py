@@ -1,0 +1,20 @@
+"""PROTO002 fixture: a journal id minted by raw bit arithmetic at the
+sink instead of through the registered constructors."""
+
+
+def apply_bad(store, epoch, step, crc):
+    # BAD: hand-rolled layout — the namespace prover never sees it
+    jid = ((epoch & 0xFFFFFF) << 40) | ((step & 0xFFFFFFFF) << 8) | 0x80
+    if store.journal_probe(jid, crc) == 1:
+        return False
+    store.journal_record(jid, crc)
+    return True
+
+
+def apply_ok(store, epoch, step, crc):
+    # clean twin: id comes from a registered constructor
+    jid = make_journal_id(epoch, step)  # noqa: F821
+    if store.journal_probe(jid, crc) == 1:
+        return False
+    store.journal_record(jid, crc)
+    return True

@@ -1,0 +1,26 @@
+"""PROTO001 fixture: a checkpoint artifact written through a helper whose
+raw open() hides behind a parameter — invisible to DUR001's lexical check,
+caught by the interprocedural pass."""
+import os
+
+
+def _put(path, data):
+    with open(path, "wb") as fh:
+        fh.write(data)
+
+
+def save(d, data):
+    # BAD: the artifact name is in the CALLER's argument, the raw open()
+    # is in the helper — torn MANIFEST.json under the final name on crash
+    _put(os.path.join(d, "MANIFEST.json"), data)
+
+
+def save_ok(d, data):
+    # clean twin: the caller participates in the atomic publish dance
+    # (fsync_write_bytes handles temp + fsync + rename)
+    fsync_write_bytes(os.path.join(d, "manifest_meta.json"), data)  # noqa: F821
+
+
+def save_plain(d, data):
+    # not an artifact name: raw helper is fine for scratch files
+    _put(os.path.join(d, "scratch.log"), data)

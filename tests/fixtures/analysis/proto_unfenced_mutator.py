@@ -1,0 +1,21 @@
+"""PROTO005 fixture: a topology mutator reachable outside a
+drained-fence / fence_callback / resume context."""
+
+
+def grow_fleet(svc, n):
+    return svc.reshard_ps(n)  # BAD: no fence anywhere on the chain
+
+
+def on_fence_grow(svc, n):
+    # clean twin: runs inside the drained-fence window by name contract
+    return svc.reshard_ps(n)
+
+
+def resume_pending(svc, mgr):
+    # clean: resume paths re-enter under the recovery fence
+    return svc.reshard_ps(mgr.recorded_n())
+
+
+def drain_and_swap(svc, victim):
+    # clean: drain context
+    return svc.replace_replica(victim)

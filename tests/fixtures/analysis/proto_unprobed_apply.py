@@ -1,0 +1,27 @@
+"""PROTO004 fixture: a journaled apply site that records without probing
+— double-applies its payload on every resume replay."""
+
+
+def apply_bad(store, jid, crc, blob):
+    store.import_blob(blob)
+    store.journal_record(jid, crc)  # BAD: no journal_probe on the path
+
+
+def apply_ok(store, jid, crc, blob):
+    # clean twin: probe-before-record
+    if store.journal_probe(jid, crc) == 1:
+        return
+    store.import_blob(blob)
+    store.journal_record(jid, crc)
+
+
+def apply_helper_probed(store, jid, crc, blob):
+    # clean: the probe lives in a module-local callee on the path
+    if _already_applied(store, jid, crc):
+        return
+    store.import_blob(blob)
+    store.journal_record(jid, crc)
+
+
+def _already_applied(store, jid, crc):
+    return store.journal_probe(jid, crc) == 1

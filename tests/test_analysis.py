@@ -27,6 +27,7 @@ from persia_tpu.analysis import (
     cparse,
     interproc,
     jax_lint,
+    protocol,
     resilience_lint,
     run_all,
 )
@@ -844,3 +845,57 @@ def test_native_lock_ranks_match_cache_cpp():
     # the native plane sits below every Python lock: no shared names that
     # would make rank_of() ambiguous about which registry it answers from
     assert not set(NATIVE_LOCK_RANKS) & set(LOCK_RANKS)
+
+
+# ------------------------------------------------- protocol (PROTO001-006)
+
+
+@pytest.mark.parametrize(
+    "fixture, rule, line",
+    [
+        ("proto_raw_manifest_write.py", "PROTO001", 15),
+        ("proto_missing_resume_arm.py", "PROTO003", 15),
+        ("proto_unprobed_apply.py", "PROTO004", 7),
+        ("proto_unfenced_mutator.py", "PROTO005", 6),
+    ],
+)
+def test_protocol_rule_fires(fixture, rule, line):
+    findings = protocol.check_source(read_text(_fixture(fixture)), fixture)
+    assert [(f.rule, f.line) for f in findings] == [(rule, line)], findings
+
+
+def test_proto002_raw_mint_flags_every_sink():
+    """The same hand-shifted id reaches BOTH journal sinks — each sink is
+    its own replay hazard, so both lines fire."""
+    findings = protocol.check_source(
+        read_text(_fixture("proto_raw_journal_id.py")), "proto_raw_journal_id.py"
+    )
+    assert sorted((f.rule, f.line) for f in findings) == [
+        ("PROTO002", 8), ("PROTO002", 10)], findings
+    assert "journal id" in findings[0].message
+
+
+def test_proto002_fixture_prover_catches_overlap():
+    """Two constructors in one module with bit-identical reachable sets:
+    the in-module prover must produce the overlap finding, anchored on the
+    untagged constructor."""
+    findings = protocol.check_source(
+        read_text(_fixture("proto_overlap_ids.py")), "proto_overlap_ids.py"
+    )
+    assert [(f.rule, f.line) for f in findings] == [("PROTO002", 11)], findings
+    assert "OVERLAP" in findings[0].message
+
+
+def test_protocol_clean_fixture_is_silent():
+    assert protocol.check_source(
+        read_text(_fixture("proto_clean.py")), "proto_clean.py") == []
+
+
+def test_protocol_inline_suppression():
+    src = read_text(_fixture("proto_unfenced_mutator.py")).replace(
+        "return svc.reshard_ps(n)  # BAD: no fence anywhere on the chain",
+        "return svc.reshard_ps(n)  # persia-lint: disable=PROTO005",
+    )
+    raw = protocol.check_source(src, "supp.py")
+    assert {f.rule for f in raw} == {"PROTO005"}
+    assert apply_suppressions(raw, {"supp.py": src}) == []

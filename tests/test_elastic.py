@@ -576,3 +576,19 @@ def test_ctx_reshard_kill_resume_bit_parity(tmp_path):
                 assert _ctx_full_state(ctx.ps_clients()) == ref
             finally:
                 plane.stop()
+
+
+def test_resume_reshard_rejects_unknown_phase(tmp_path):
+    """Regression for the PROTO003 gap: a manifest recording a phase the
+    resume arms don't know must be LOUD. Silently falling through to the
+    finish path would run deletes-only and release source ranges whose
+    imports never happened."""
+    srcs, dests, plan = _setup()
+    js = str(tmp_path / "js")
+    mgr = jobstate.coerce_manager(js)
+    elastic._commit_phase(mgr, plan, "garbage")
+    with pytest.raises(jobstate.ManifestError, match="unknown phase"):
+        elastic.resume_reshard(js, srcs, dests)
+    # the known terminal phase still resumes to a clean no-op
+    elastic._commit_phase(mgr, plan, "done")
+    assert elastic.resume_reshard(js, srcs, dests) is None

@@ -77,10 +77,22 @@ def test_journal_id_packing():
     for epoch in (0, 1, 2, 1000):
         for step in (0, 1, 7, 1 << 20):
             base = jobstate.make_journal_id(epoch, step)
-            for shard in (0, 1, 255):
+            for shard in (0, 1, 127):
                 ids.add(jobstate.journal_shard_id(base, shard))
     assert len(ids) == 4 * 4 * 3  # all distinct
     assert all(0 <= i < (1 << 64) for i in ids)
+
+
+def test_journal_shard_id_rejects_handoff_namespace():
+    # the 0x80 low-byte half belongs to handoff/replication/scrub ids —
+    # a replica index that would cross into it must be a loud error
+    base = jobstate.make_journal_id(1, 1)
+    with pytest.raises(ValueError):
+        jobstate.journal_shard_id(base, 0x80)
+    with pytest.raises(ValueError):
+        jobstate.journal_shard_id(base, 255)
+    with pytest.raises(ValueError):
+        jobstate.journal_shard_id(base, -1)
 
 
 def test_payload_crc_deterministic():

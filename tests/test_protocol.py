@@ -1,0 +1,609 @@
+"""persia-proto (ISSUE 19): static protocol extraction + exhaustive
+crash-schedule verification of the journaled two-phase state machines.
+
+Three layers under test:
+
+- **Static extraction** (`analysis/protocol.py`): the PROTO rules are
+  clean on the real tree, the reach() transition set matches the shipped
+  protocols, and the committed ``PROTO_COVERAGE.json`` proves every
+  transition was killed at least once.
+- **Namespace prover**: the four shipped journal-id families (gradient,
+  handoff, replication, scrub) are bit-affine and pairwise disjoint, with
+  the exact separating-bit witnesses pinned; overlapping constructors are
+  detected.
+- **Crash matrices**: every ``reach()`` point enumerated from one
+  uninterrupted run of each protocol is killed once
+  (:class:`crashcheck.SimulatedCrash`), the protocol resumes, and the
+  resumed end state must equal the uninterrupted state. Fast subset:
+  jobstate fence, scrub record, healer promotion. Slow markers: the 2->4
+  reshard and the autopilot drive.
+
+``python tests/test_protocol.py --write-coverage`` runs ALL matrices
+(fast + slow) and writes the repo-root ``PROTO_COVERAGE.json`` the
+PROTO006 rule and :func:`test_committed_coverage_is_complete` validate.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from persia_tpu import elastic, jobstate
+from persia_tpu.analysis import crashcheck, protocol
+from persia_tpu.analysis.common import REPO_ROOT
+from persia_tpu.autopilot.controller import Autopilot
+from persia_tpu.autopilot.heal import ACTION_PROMOTE, ACTION_RESIZE, Healer
+from persia_tpu.autopilot.policy import KIND_HEAL, Decision, PolicyEngine
+from persia_tpu.embedding.hashing import uniform_splits
+from persia_tpu.embedding.optim import Adagrad
+from persia_tpu.embedding.store import EmbeddingStore
+from persia_tpu.health.scrub import SCRUB_CRC, scrub_journal_id, scrub_store
+from persia_tpu.service.failure_detector import VERDICT_DEAD, VERDICT_LIVE
+
+DIM = 16
+SIGNS = np.arange(1, 201, dtype=np.uint64)
+OPT = Adagrad(lr=0.05).config
+
+
+def _mk_store(seed=11):
+    return EmbeddingStore(capacity=1 << 14, num_internal_shards=2,
+                          optimizer=OPT, seed=seed)
+
+
+def _crashed(fn) -> bool:
+    try:
+        fn()
+    except crashcheck.SimulatedCrash:
+        return True
+    return False
+
+
+def _enumerate(run) -> list:
+    """Crash schedule of one uninterrupted protocol run."""
+    with crashcheck.recording() as sites:
+        run()
+    return crashcheck.enumerate_points(list(sites))
+
+
+# ========================================================== static extraction
+
+
+def test_reach_sites_match_shipped_protocols():
+    sites = protocol.reach_sites()
+    assert set(sites) == {
+        "jobstate.commit.component", "jobstate.commit.manifest",
+        "jobstate.commit.pointer",
+        "elastic.phase.handoff", "elastic.op.import",
+        "elastic.phase.imported", "elastic.swap", "elastic.op.delete",
+        "elastic.phase.done",
+        "autopilot.phase.planned", "autopilot.actuate",
+        "autopilot.phase.done",
+        "heal.phase.planned", "heal.actuate", "heal.phase.done",
+        "scrub.record",
+    }
+    # every site resolves to a real (path, line)
+    for site, locs in sites.items():
+        assert locs, site
+        for path, line in locs:
+            assert os.path.exists(os.path.join(REPO_ROOT, path))
+            assert line > 0
+
+
+def test_proto_rules_clean_on_real_tree():
+    """Satellite (a)+(b): the whole PROTO pass — rules, prover, coverage
+    contract — reports nothing on the shipped tree (with the one
+    documented inline suppression in launcher.py applied)."""
+    from persia_tpu.analysis import run_all
+
+    findings, cov = run_all(rules=["PROTO"])
+    assert findings == [], [str(f) for f in findings]
+    pcov = cov["protocol"]
+    assert pcov["reach_sites"] >= 16
+    assert pcov["phase_writers"] >= 2  # autopilot + healer _commit shapes
+    assert pcov["phase_sites"] >= 6
+    assert pcov["pairs_total"] == 6
+    assert pcov["pairs_disjoint"] == 6
+
+
+def test_committed_coverage_is_complete():
+    """Acceptance: PROTO_COVERAGE.json covers 100% of the statically
+    extracted transitions, including the manifest-committed-but-pointer-
+    unwritten window no seeded schedule (PR 15/16/18) ever killed."""
+    path = os.path.join(REPO_ROOT, "PROTO_COVERAGE.json")
+    assert os.path.exists(path), "run: python tests/test_protocol.py --write-coverage"
+    data = crashcheck.load_coverage(path)
+    problems = crashcheck.validate_coverage(data, protocol.reach_sites())
+    assert problems == []
+    # the previously-unkilled transitions vs the hand-seeded schedules
+    for newly in ("jobstate.commit.pointer", "elastic.phase.handoff",
+                  "scrub.record", "elastic.swap"):
+        assert data["sites"][newly]["kills"] >= 1, newly
+
+
+# ========================================================== namespace prover
+
+
+def test_probe_bits_exact_masks_and_affinity():
+    a = protocol.probe_bits(lambda e, s: (e << 40) | (s << 8), (24, 30))
+    assert a.affine and a.fixed_one == 0
+    assert a.fixed_zero & 0xFF == 0xFF  # low byte provably zero
+    # same layout plus a low-byte op with NO tag bit: collides with a
+    b = protocol.probe_bits(
+        lambda e, s, op: (e << 40) | (s << 8) | op, (24, 30, 7))
+    assert protocol.disjoint_witness(a, b) is None
+    # the 0x80 tag separates them, witness = bit 7
+    c = protocol.probe_bits(
+        lambda e, s, op: (e << 40) | (s << 8) | 0x80 | op, (24, 30, 7))
+    assert protocol.disjoint_witness(a, c) == 7
+    # carries break bit-affinity and the prover must refuse to certify
+    tri = protocol.probe_bits(lambda x: 3 * x, (8,))
+    assert not tri.affine
+
+
+def test_shipped_id_families_pairwise_disjoint():
+    """Satellite (c): the four shipped constructors proven disjoint with
+    the exact bit-interval witnesses pinned."""
+    proof = protocol.prove_namespaces()
+    assert set(proof["patterns"]) == {
+        "gradient", "handoff", "replication", "scrub"}
+    for fam, pat in proof["patterns"].items():
+        assert pat.affine, fam
+    assert proof["pairs"] == {
+        ("gradient", "handoff"): 7,       # handoff's 0x80 low-byte tag
+        ("gradient", "replication"): 7,
+        ("gradient", "scrub"): 7,
+        ("handoff", "replication"): 39,   # replication's step bit 31
+        ("handoff", "scrub"): 38,         # scrub's step bit 30
+        ("replication", "scrub"): 38,
+    }
+    # witness semantics: bit 7 is fixed-one for handoff, fixed-zero for
+    # gradient (replica indices < 0x80 by the journal_shard_id guard)
+    g, h = proof["patterns"]["gradient"], proof["patterns"]["handoff"]
+    assert (h.fixed_one >> 7) & 1 and (g.fixed_zero >> 7) & 1
+    s, r = proof["patterns"]["scrub"], proof["patterns"]["replication"]
+    assert (s.fixed_one >> 38) & 1 and (r.fixed_zero >> 38) & 1
+    assert (r.fixed_one >> 39) & 1 and (s.fixed_zero >> 39) & 1
+
+
+def test_scrub_ids_disjoint_from_handoff_ids():
+    """Regression for the real overlap the prover surfaced: scrub ids were
+    bit-identical in layout to handoff ids — a scrub at the same
+    (epoch, step) as a reshard op could dedupe against it (loud crc error
+    at best). Step bit 30 now tags the scrub subspace."""
+    for epoch, step in ((0, 0), (1, 7), (1000, 1 << 20)):
+        base = jobstate.make_journal_id(epoch, step)
+        handoff = {jobstate.handoff_journal_id(base, op) for op in range(128)}
+        scrub = {scrub_journal_id(epoch, step, r) for r in range(128)}
+        repl = {jobstate.replication_journal_id(epoch, step, op)
+                for op in range(128)}
+        assert len(scrub) == 128
+        assert not (scrub & handoff)
+        assert not (scrub & repl)
+        for jid in scrub:
+            assert (jid >> 38) & 1 and jid & 0x80
+
+
+# ====================================================== fence crash matrix
+
+
+def _prior_epoch(root):
+    w = jobstate.JobStateManager(root).begin_epoch()
+    w.add_blob("ps.bin", b"\x00" * 64)
+    w.commit({"step": 10})
+
+
+def _fence_capture(root):
+    w = jobstate.JobStateManager(root).begin_epoch()
+    w.add_blob("ps.bin", b"\x01" * 64)
+    w.add_blob("dense.bin", b"\x02" * 32)
+    w.commit({"step": 42})
+
+
+def _fence_state(root):
+    man = jobstate.JobStateManager(root).latest()
+    assert man is not None and man.meta["step"] == 42
+    return {
+        "step": man.meta["step"],
+        "components": man.meta["components"],
+        "blobs": {n: man.read_blob(n) for n in man.meta["components"]},
+    }
+
+
+def run_fence_matrix(base) -> crashcheck.Coverage:
+    ref_root = os.path.join(str(base), "ref")
+    _prior_epoch(ref_root)
+    _fence_capture(ref_root)
+    ref = _fence_state(ref_root)
+
+    rec_root = os.path.join(str(base), "rec")
+    _prior_epoch(rec_root)
+    points = _enumerate(lambda: _fence_capture(rec_root))
+    # 2 components + manifest + pointer
+    assert points == [
+        ("jobstate.commit.component", 0), ("jobstate.commit.component", 1),
+        ("jobstate.commit.manifest", 0), ("jobstate.commit.pointer", 0),
+    ]
+
+    cov = crashcheck.Coverage()
+    for k, (site, occ) in enumerate(points):
+        root = os.path.join(str(base), f"run{k}")
+        _prior_epoch(root)
+        with crashcheck.crash_at(site, occ):
+            assert _crashed(lambda: _fence_capture(root)), (site, occ)
+        cov.add_kill("fence", site)
+        # resume: the trainer restarts from the prior fence and retries
+        # the capture until the target step is durable. A pointer-crash
+        # leaves the new manifest orphaned behind a stale-but-valid
+        # LAST_GOOD — the retry must converge regardless.
+        man = jobstate.JobStateManager(root).latest()
+        assert man is not None  # the prior epoch always survives
+        if man.meta.get("step") != 42:
+            _fence_capture(root)
+        assert _fence_state(root) == ref
+    return cov
+
+
+def test_fence_crash_matrix(tmp_path):
+    cov = run_fence_matrix(tmp_path)
+    assert cov.kills["jobstate.commit.pointer"] == 1
+    assert cov.kills["jobstate.commit.component"] == 2
+
+
+# ====================================================== scrub crash matrix
+
+
+def _poison(store, signs):
+    for i, sign in enumerate(signs):
+        sign = int(sign)
+        entry = store.get_embedding_entry(sign).copy()
+        entry[0] = np.nan if i % 2 else np.inf
+        store.set_embedding(
+            np.array([sign], np.uint64), entry[None, :],
+            store.get_entry_dim(sign),
+        )
+
+
+def _scrubbed_store():
+    store = _mk_store(seed=9)
+    store.lookup(np.arange(1, 17, dtype=np.uint64), 8, True)
+    _poison(store, [3, 8, 12])
+    return store
+
+
+def run_scrub_matrix(base) -> crashcheck.Coverage:
+    jid = scrub_journal_id(1, 40, 0)
+    ref_store = _scrubbed_store()
+    scrub_store(ref_store, journal_id=jid)
+    ref_rows = {s: ref_store.get_embedding_entry(s).copy()
+                for s in (3, 8, 12)}
+
+    points = _enumerate(lambda: scrub_store(_scrubbed_store(), journal_id=jid))
+    assert points == [("scrub.record", 0)]
+
+    cov = crashcheck.Coverage()
+    for site, occ in points:
+        store = _scrubbed_store()
+        with crashcheck.crash_at(site, occ):
+            assert _crashed(lambda: scrub_store(store, journal_id=jid))
+        cov.add_kill("scrub", site)
+        # crashed between repair and record: the retried fence re-scans
+        # (nothing left non-finite) and records — exactly-once converges
+        res = scrub_store(store, journal_id=jid)
+        assert not res["skipped"] and res["repaired"] == 0
+        assert store.journal_probe(jid, SCRUB_CRC) == 1
+        for s, row in ref_rows.items():
+            np.testing.assert_array_equal(store.get_embedding_entry(s), row)
+        # and a third pass is a journaled no-op
+        assert scrub_store(store, journal_id=jid)["skipped"]
+    return cov
+
+
+def test_scrub_crash_matrix(tmp_path):
+    cov = run_scrub_matrix(tmp_path)
+    assert cov.kills == {"scrub.record": 1}
+
+
+# ============================================= healer promotion crash matrix
+
+
+class _Det:
+    def __init__(self, verdicts):
+        self._verdicts = dict(verdicts)
+        self.reset_calls = []
+
+    def poll_once(self):
+        return dict(self._verdicts)
+
+    def detected_at(self, idx):
+        return 0.0
+
+    def reset(self, idx, probe=None):
+        self.reset_calls.append(idx)
+        self._verdicts[idx] = VERDICT_LIVE
+
+
+def _mk_healer(state, calls):
+    return Healer(
+        state,
+        detector=_Det({0: VERDICT_LIVE, 1: VERDICT_DEAD}),
+        promote=lambda v, ba: calls.append((v, dict(ba or {}))) or f"addr:{v}",
+        batch_advances=lambda: {0: 3},
+        clock=lambda: 0.0,
+    )
+
+
+def _heal_final(state):
+    meta = jobstate.JobStateManager(state).latest().meta["healer"]
+    return {
+        "phase": meta["phase"],
+        "decision": meta["decision"],
+        "addr": meta["result"]["addr"],
+    }
+
+
+def run_heal_matrix(base) -> crashcheck.Coverage:
+    ref_calls: list = []
+    ref_state = os.path.join(str(base), "ref")
+    assert _mk_healer(ref_state, ref_calls).on_poll(1)["addr"] == "addr:1"
+    ref = _heal_final(ref_state)
+    assert ref["phase"] == "done" and ref_calls == [(1, {0: 3})]
+
+    rec_calls: list = []
+    rec_state = os.path.join(str(base), "rec")
+    points = _enumerate(lambda: _mk_healer(rec_state, rec_calls).on_poll(1))
+    # planned commit (heal site + component/manifest/pointer), actuate,
+    # done commit (heal site + component/manifest/pointer) = 9 points
+    assert len(points) == 9
+    assert ("heal.phase.planned", 0) in points
+    assert ("heal.actuate", 0) in points
+    assert ("jobstate.commit.pointer", 1) in points
+
+    cov = crashcheck.Coverage()
+    for k, (site, occ) in enumerate(points):
+        calls: list = []
+        state = os.path.join(str(base), f"run{k}")
+        h1 = _mk_healer(state, calls)
+        with crashcheck.crash_at(site, occ):
+            assert _crashed(lambda: h1.on_poll(1)), (site, occ)
+        cov.add_kill("heal", site)
+        # the healer process died; a FRESH one resumes from the journal.
+        # Killed before the planned manifest was durable → nothing pending
+        # → the sense loop re-decides (the victim is still dead).
+        h2 = _mk_healer(state, calls)
+        res = h2.resume()
+        if res is None:
+            res = h2.on_poll(1)
+        assert res is not None and res["addr"] == "addr:1"
+        final = _heal_final(state)
+        assert final["phase"] == "done"
+        assert final["decision"] == ref["decision"]
+        assert final["addr"] == ref["addr"]
+        # every actuation carried the SAME plan-time advance counts
+        assert calls and all(c == (1, {0: 3}) for c in calls)
+        assert h2.pending() is None and h2.resume() is None
+        assert 1 in h2.detector.reset_calls  # newcomer probe swapped in
+    return cov
+
+
+def test_heal_promotion_crash_matrix(tmp_path):
+    cov = run_heal_matrix(tmp_path)
+    assert cov.kills["heal.phase.planned"] == 1
+    assert cov.kills["heal.actuate"] == 1
+    assert cov.kills["heal.phase.done"] == 1
+    assert cov.kills["jobstate.commit.pointer"] == 2
+
+
+# ================================================ healer resize resume (fix)
+
+
+def test_healer_resize_resume_prefers_engine_manifest(tmp_path):
+    """Regression for the resume-arm gap PROTO extraction surfaced: an
+    interrupted RESIZE used to re-drive a FRESH ``reshard_ps`` instead of
+    re-entering the elastic engine's recorded phase manifest (the
+    Autopilot has done this since PR 16; the Healer did not)."""
+    calls = {"resumed": 0, "replanned": 0}
+
+    def resume_resize():
+        calls["resumed"] += 1
+        return {"resumed": True}
+
+    def resize(n_new):
+        calls["replanned"] += 1
+        return {"fresh": True}
+
+    h = Healer(str(tmp_path / "heal"), resize=resize,
+               resume_resize=resume_resize)
+    d = Decision(KIND_HEAL, "test", {"action": ACTION_RESIZE, "n_new": 4})
+    h._commit("planned", d, step=8)
+    assert h.resume() == {"resumed": True}
+    assert calls == {"resumed": 1, "replanned": 0}
+    assert h.pending() is None
+
+    # killed BEFORE the engine's first phase commit: resume_resize finds
+    # nothing and the recorded decision re-actuates verbatim
+    h._commit("planned", d, step=12)
+    h._resume_resize = lambda: None
+    assert h.resume() == {"fresh": True}
+    assert calls["replanned"] == 1
+
+
+def test_healer_promote_resume_does_not_touch_resize_arm(tmp_path):
+    calls = {"promote": 0, "resumed": 0}
+    h = Healer(
+        str(tmp_path / "heal"),
+        promote=lambda v, ba: calls.__setitem__("promote", calls["promote"] + 1)
+        or "addr:9",
+        resume_resize=lambda: calls.__setitem__("resumed", calls["resumed"] + 1)
+        or {"resumed": True},
+    )
+    d = Decision(KIND_HEAL, "t", {"action": ACTION_PROMOTE, "victim": 9})
+    h._commit("planned", d, step=3)
+    assert h.resume()["addr"] == "addr:9"
+    assert calls == {"promote": 1, "resumed": 0}
+
+
+# ================================================== reshard crash matrix
+
+
+def _reshard_setup():
+    srcs = [_mk_store(), _mk_store()]
+    for r, st in enumerate(srcs):
+        st.lookup(SIGNS[SIGNS % 2 == r], DIM, True)
+    dests = list(srcs) + [_mk_store(), _mk_store()]
+    plan = elastic.plan_reshard(
+        2, 4, None, [int(x) for x in uniform_splits(4)],
+        jobstate.make_journal_id(1, 0),
+    )
+    return srcs, dests, plan
+
+
+def _fleet_state(dests):
+    # export_range(0, 0) walks the whole ring, sign-sorted => comparable
+    return tuple(d.export_range(0, 0) for d in dests)
+
+
+def run_reshard_matrix(base) -> crashcheck.Coverage:
+    srcs, dests, plan = _reshard_setup()
+    stats = elastic.execute_reshard(
+        plan, srcs, dests, os.path.join(str(base), "ref"),
+        on_imported=lambda: None,
+    )
+    assert stats["imports_applied"] == 6 and stats["deletes_applied"] == 6
+    ref = _fleet_state(dests)
+
+    srcs, dests, plan = _reshard_setup()
+    points = _enumerate(lambda: elastic.execute_reshard(
+        plan, srcs, dests, os.path.join(str(base), "rec"),
+        on_imported=lambda: None,
+    ))
+    for site in ("elastic.phase.handoff", "elastic.op.import",
+                 "elastic.phase.imported", "elastic.swap",
+                 "elastic.op.delete", "elastic.phase.done"):
+        assert any(p[0] == site for p in points), site
+
+    cov = crashcheck.Coverage()
+    swaps = {"n": 0}
+
+    def on_imported():
+        swaps["n"] += 1
+
+    for k, (site, occ) in enumerate(points):
+        srcs, dests, plan = _reshard_setup()
+        js = os.path.join(str(base), f"run{k}")
+        with crashcheck.crash_at(site, occ):
+            assert _crashed(lambda: elastic.execute_reshard(
+                plan, srcs, dests, js, on_imported=on_imported)), (site, occ)
+        cov.add_kill("reshard", site)
+        # coordinator died; stores survive. Resume from the recorded
+        # phase — or, killed before the handoff manifest was durable,
+        # re-execute the SAME plan (same base_id => same journal ids).
+        stats = elastic.resume_reshard(js, srcs, dests,
+                                       on_imported=on_imported)
+        if stats is None:
+            man = elastic.find_reshard_manifest(jobstate.coerce_manager(js))
+            if man is None:
+                elastic.execute_reshard(plan, srcs, dests, js,
+                                        on_imported=on_imported)
+            else:
+                assert man.meta["phase"] == "done"
+        assert _fleet_state(dests) == ref, (site, occ)
+        assert elastic.resume_reshard(js, srcs, dests) is None
+    return cov
+
+
+@pytest.mark.slow
+def test_reshard_crash_matrix(tmp_path):
+    cov = run_reshard_matrix(tmp_path)
+    assert cov.kills["elastic.op.import"] == 6
+    assert cov.kills["elastic.op.delete"] == 6
+    assert cov.kills["elastic.swap"] == 1
+    assert cov.kills["elastic.phase.handoff"] == 1
+
+
+# ================================================= autopilot crash matrix
+
+
+def run_autopilot_matrix(base) -> crashcheck.Coverage:
+    calls: list = []
+
+    def reshard(n, splits, step):
+        calls.append((int(n), int(step)))
+        return {"n_shards": int(n)}
+
+    def mk(root):
+        return Autopilot(root, policy=PolicyEngine(), reshard=reshard)
+
+    d = Decision("reshard", "proto-matrix", {"n_shards": 4, "splits": [1, 2, 3]})
+
+    ref_root = os.path.join(str(base), "ref")
+    assert mk(ref_root)._drive(d, 8) == {"n_shards": 4}
+    ref_meta = jobstate.JobStateManager(ref_root).latest().meta["autopilot"]
+    assert ref_meta["phase"] == "done"
+
+    rec_root = os.path.join(str(base), "rec")
+    points = _enumerate(lambda: mk(rec_root)._drive(d, 8))
+    assert len(points) == 9  # two commits x 4 + the actuate window
+
+    cov = crashcheck.Coverage()
+    for k, (site, occ) in enumerate(points):
+        root = os.path.join(str(base), f"run{k}")
+        with crashcheck.crash_at(site, occ):
+            assert _crashed(lambda: mk(root)._drive(d, 8)), (site, occ)
+        cov.add_kill("autopilot", site)
+        p2 = mk(root)
+        if p2.resume() is None:  # killed before the planned manifest
+            p2._drive(d, 8)
+        meta = jobstate.JobStateManager(root).latest().meta["autopilot"]
+        assert meta["phase"] == "done"
+        assert meta["decision"] == ref_meta["decision"]
+        assert meta["result"] == ref_meta["result"]
+        assert p2.pending() is None and p2.resume() is None
+    return cov
+
+
+@pytest.mark.slow
+def test_autopilot_crash_matrix(tmp_path):
+    cov = run_autopilot_matrix(tmp_path)
+    assert cov.kills["autopilot.phase.planned"] == 1
+    assert cov.kills["autopilot.actuate"] == 1
+    assert cov.kills["autopilot.phase.done"] == 1
+    assert cov.kills["jobstate.commit.pointer"] == 2
+
+
+# ================================================= coverage artifact writer
+
+
+ALL_MATRICES = (
+    run_fence_matrix, run_scrub_matrix, run_heal_matrix,
+    run_reshard_matrix, run_autopilot_matrix,
+)
+
+
+def write_coverage(out_path=None) -> crashcheck.Coverage:
+    import tempfile
+
+    cov = crashcheck.Coverage()
+    with tempfile.TemporaryDirectory(prefix="proto_cov_") as base:
+        for fn in ALL_MATRICES:
+            cov.merge(fn(os.path.join(base, fn.__name__)))
+    problems = crashcheck.validate_coverage(
+        cov.to_json(), protocol.reach_sites())
+    if problems:
+        raise AssertionError("incomplete crash coverage:\n" + "\n".join(problems))
+    if out_path is not None:
+        cov.write(out_path)
+    return cov
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--write-coverage" in sys.argv:
+        out = os.path.join(REPO_ROOT, "PROTO_COVERAGE.json")
+        cov = write_coverage(out)
+        total = sum(cov.kills.values())
+        print(f"PROTO_COVERAGE.json: {len(cov.kills)} transitions, "
+              f"{total} kills across {len(cov.matrices)} matrices -> {out}")
+    else:
+        print(__doc__)
+        print("usage: python tests/test_protocol.py --write-coverage")
