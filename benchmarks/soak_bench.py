@@ -1,0 +1,649 @@
+"""Concurrent control-plane soak: four loops, one lease, chaos-certified.
+
+Emits ONE JSON record (committed as BENCH_SOAK.json) answering the
+question the PR-20 arbiter exists for: when the Autopilot, the Healer,
+the AutoTierController and the serving rollover all drive the SAME fleet
+at once — under kills, gray replicas, blackholes and a zipf load shift —
+does the single topology-actuation lease keep every mutation serialized,
+every request answered, and every preempted protocol rolled back
+exactly-once?
+
+Two legs:
+
+1. **concurrent soak** — a 2-shard subprocess PS fleet (``ServiceCtx``)
+   fronted by :class:`~persia_tpu.chaos.ChaosPlane` proxies. All four
+   control loops run live against one :class:`Arbiter`:
+
+   - the **Healer** polls a real ``FailureDetector`` (probes through the
+     chaos proxies) and heals autonomously: a *blackholed* proxy and a
+     SIGKILLed shard each promote a warm standby (HEAL-DEAD), a *gray*
+     shard (forced per-frame latency floor) is drained (HEAL-GRAY);
+   - the **Autopilot** senses a zipf-shifting :class:`LoadSchedule`
+     through its access sketch every fence and submits RESHARD intents;
+     the scripted 2→4 re-split is slowed at its import wave so the gray
+     window's HEAL-GRAY intent lands mid-handoff — the arbiter preempts,
+     the elastic engine rolls back through the journaled ABORT arm, and
+     a later 2→3 re-split completes cleanly on a fresh base id;
+   - the **AutoTierController** plans over its own sketch as the hot
+     slot alternates, migrating the cached/ps boundary at tier fences;
+   - the **serving rollover** watches a checkpoint dir and swaps the
+     engine handle on every published done-marker session.
+
+   A load thread hammers the sharded router the whole time with a fixed
+   sign set and bit-compares every reply against the seeded reference.
+   An independent :class:`MutationMonitor` wraps every topology actuator
+   (reshard, promote, drain, tier apply, engine swap) and measures
+   overlap directly — the soak certifies 0 failed requests, 0 value
+   mismatches, and 0 concurrent topology mutations WITHOUT trusting the
+   arbiter's own ``max_concurrent`` counter.
+
+2. **SIGKILL-mid-abort certification** — in-process fleets + crashcheck:
+   a post-import preemption's rollback is killed at every abort-arm
+   crash point (``aborting`` commit, each journaled ``abort_release``,
+   the terminal ``aborted`` commit), resumed, and the resumed fleet's
+   full store bytes must equal both the pristine ring and the fleet an
+   UNINTERRUPTED abort produces — bit-identical, with a second resume a
+   no-op.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STEPS = int(os.environ.get("SOAK_STEPS", "150"))
+STEP_S = float(os.environ.get("SOAK_STEP_S", "0.04"))
+FENCE_EVERY = 10         # autopilot + tiering fence cadence (steps)
+ROLLOVER_EVERY = 25      # serving checkpoint publish cadence (steps)
+STEP_BLACKHOLE = 30      # chaos: partition proxy 1 -> HEAL-DEAD promote
+STEP_KILL = 60           # chaos: SIGKILL shard 1 -> HEAL-DEAD promote
+STEP_PREEMPT = 90        # gray window: 2→4 reshard preempted by HEAL-GRAY
+STEP_RESHARD = 110       # clean 2→3 re-split on a fresh base id
+GRAY_LATENCY_MS = 160.0
+IMPORT_OP_DELAY_S = 1.0  # widens the abortable import wave for the gray
+N_SIGNS = 512
+DIM = 8
+SEED = 7
+LOAD_SPEC = os.environ.get(
+    "SOAK_LOAD", "seed=7,vocab=4096,a0=1.05,a1=1.5,ramp=10:120,rotate=40",
+)
+
+
+class MutationMonitor:
+    """Independent overlap measurement: every topology actuator is
+    wrapped so concurrent mutation is OBSERVED, not inferred from the
+    arbiter's bookkeeping."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active = 0
+        self.max_active = 0
+        self.overlaps = 0
+        self.calls = {}
+        self.done = {}
+
+    def wrap(self, name, fn):
+        def wrapped(*a, **kw):
+            with self._lock:
+                self._active += 1
+                self.calls[name] = self.calls.get(name, 0) + 1
+                if self._active > 1:
+                    self.overlaps += 1
+                self.max_active = max(self.max_active, self._active)
+            try:
+                return fn(*a, **kw)
+            finally:
+                with self._lock:
+                    self._active -= 1
+                    self.done[name] = self.done.get(name, 0) + 1
+
+        return wrapped
+
+    def snapshot(self):
+        with self._lock:
+            return {
+                "max_active": self.max_active,
+                "overlaps": self.overlaps,
+                "actuations": dict(self.calls),
+            }
+
+
+class _TierCtx:
+    """Tier-migration target for the soak: the controller's arbiter-leased
+    ``_apply`` calls ``apply_migration`` here; the sleep widens the
+    mutation window so the monitor would SEE an overlap if serialization
+    ever broke."""
+
+    def __init__(self, monitor):
+        self.moves = []
+        self._apply = monitor.wrap("apply_migration", self._apply_impl)
+
+    def _apply_impl(self, to_cached, to_ps):
+        time.sleep(0.05)
+        self.moves.append({"to_cached": list(to_cached), "to_ps": list(to_ps)})
+
+    def apply_migration(self, *, to_cached, to_ps):
+        self._apply(to_cached, to_ps)
+
+
+class _StubWorker:
+    """Rollover's sparse-load target: the soak certifies the CONTROL
+    plane (lease + swap), not flax deserialization, so the load half is
+    a no-op counter."""
+
+    def __init__(self):
+        self.loads = 0
+
+    def load(self, path):
+        self.loads += 1
+
+
+def _wait(cond, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while not cond():
+        if time.monotonic() > deadline:
+            raise RuntimeError(f"soak: timed out waiting for {what}")
+        time.sleep(0.02)
+
+
+def concurrent_soak(tmp):
+    from persia_tpu.autopilot import enable_self_heal
+    from persia_tpu.autopilot.arbiter import Arbiter
+    from persia_tpu.autopilot.controller import Autopilot
+    from persia_tpu.autopilot.policy import (
+        Decision,
+        KIND_RESHARD,
+        PolicyConfig,
+        PolicyEngine,
+    )
+    from persia_tpu.chaos import ChaosConfig, ChaosPlane, LoadSchedule, \
+        parse_load_spec
+    from persia_tpu.checkpoint import DONE_MARKER
+    from persia_tpu.config import EmbeddingConfig, SlotConfig
+    from persia_tpu.ctx import InferCtx
+    from persia_tpu.embedding.hashing import uniform_splits
+    from persia_tpu.embedding.tiering import (
+        AccessProfiler,
+        PlacementPlanner,
+        TIER_CACHED,
+        TIER_PS,
+    )
+    from persia_tpu.embedding.tiering.controller import AutoTierController
+    from persia_tpu.embedding.worker import ShardedLookup
+    from persia_tpu.helper import ServiceCtx
+    from persia_tpu.jobstate import JobStateManager
+    from persia_tpu.serving.engine import InferenceEngine
+    from persia_tpu.serving.rollover import ModelRollover
+    from persia_tpu.service.failure_detector import (
+        DetectorConfig,
+        FailureDetector,
+        make_probe,
+    )
+    from persia_tpu.service.resilience import ResiliencePolicy, RetryPolicy
+    from persia_tpu.autopilot.heal import HealConfig
+
+    sched = LoadSchedule(parse_load_spec(LOAD_SPEC))
+    rng = np.random.default_rng(SEED)
+    signs = np.arange(1, N_SIGNS + 1, dtype=np.uint64)
+    vals = rng.normal(size=(N_SIGNS, DIM)).astype(np.float32)
+
+    policy = ResiliencePolicy(
+        retry=RetryPolicy(max_attempts=8, base_s=0.05, multiplier=2.0,
+                          max_s=0.4, seed=3),
+        breaker_failure_threshold=4, breaker_reset_s=0.2,
+        degrade_after_s=120.0,  # ride out every heal; degrading = failing
+        max_degraded_frac=1.0,
+    )
+
+    rec = {"workload": {
+        "spec": LOAD_SPEC, "n_ps": 3, "signs": N_SIGNS, "dim": DIM,
+        "steps": STEPS, "step_s": STEP_S, "fence_every": FENCE_EVERY,
+        "chaos": {"blackhole_step": STEP_BLACKHOLE, "kill_step": STEP_KILL,
+                  "gray_preempt_step": STEP_PREEMPT,
+                  "reshard_step": STEP_RESHARD,
+                  "gray_latency_ms": GRAY_LATENCY_MS},
+    }}
+
+    with ServiceCtx(num_parameter_servers=3, num_embedding_workers=0,
+                    backend="numpy", seed=SEED) as svc:
+        plane = ChaosPlane(svc, ChaosConfig(seed=SEED))
+        monitor = MutationMonitor()
+        # independent overlap measurement: wrap the MECHANISM layer, so
+        # any path around the arbiter lease would still be seen
+        svc.reshard_ps = monitor.wrap("reshard_ps", svc.reshard_ps)
+        # the bench's own is-there-anything-to-resume probe (below) is a
+        # read-only verification, not an actuation — keep a raw handle
+        raw_resume = svc.resume_reshard
+        svc.resume_reshard = monitor.wrap("resume_reshard",
+                                          svc.resume_reshard)
+        svc.heal_promote = monitor.wrap("heal_promote", svc.heal_promote)
+        svc.heal_drain_gray = monitor.wrap("heal_drain_gray",
+                                           svc.heal_drain_gray)
+
+        splits0 = uniform_splits(3)
+        svc._publish_ring(splits0)  # operator ring publish at job setup
+        clients = plane.ps_clients(policy=policy, timeout_s=1.5)
+        for c in clients:
+            c.wait_ready()
+        router = ShardedLookup(clients, policy=policy, ring=splits0)
+        router.set_embedding(signs, vals, dim=DIM)
+        ref = router.lookup(signs, DIM, train=False)
+        for i in range(3):
+            svc.snapshot_ps(i)
+
+        arbiter = Arbiter(dwell_s=5.0)
+
+        # ---- loop 1: Healer (detector probes ride the chaos proxies) ----
+        detector = FailureDetector(
+            {i: make_probe(plane.ps_addrs()[i], timeout_s=1.0)
+             for i in range(3)},
+            # window=4: the rolling median crosses the gray bar within a
+            # few polls of the latency-floor injection (gray needs >= 2
+            # peer medians, hence the 3-shard fleet)
+            DetectorConfig(miss_threshold=3, probe_timeout_s=1.0,
+                           gray_factor=4.0, gray_windows=3,
+                           gray_min_latency_s=0.05, window=4),
+            lease_reader=svc.ps_lease_reader(),
+        )
+        healer = enable_self_heal(
+            svc, os.path.join(tmp, "heal_state"), router=router,
+            detector=detector,
+            config=HealConfig(heal_cooldown_polls=1, gray_min_dwell=1),
+            probe_timeout_s=1.0, arbiter=arbiter,
+        )
+        healer.start(interval_s=0.1)
+
+        # ---- loop 2: Autopilot (fence-driven; reshard through the svc) --
+        reshard_mgr = JobStateManager(os.path.join(tmp, "reshard"))
+        slow = {"delay_s": 0.0}
+
+        def import_hook(kind, idx, mv):
+            # the gray window arms this: a slow import wave keeps the
+            # scripted re-split inside its abortable phase long enough
+            # for the HEAL-GRAY preemption to land at an op boundary
+            if kind == "import" and slow["delay_s"]:
+                time.sleep(slow["delay_s"])
+
+        prof = AccessProfiler(["cat_0", "cat_1"], topk=32)
+        pilot = Autopilot(
+            os.path.join(tmp, "decisions"),
+            # organic reshard/replication thresholds parked out of reach:
+            # the soak scripts its RESHARD intents so the preemption
+            # window is deterministic, and replication copies would not
+            # survive a snapshot-restoring heal (bit-compare would lie)
+            policy=PolicyEngine(PolicyConfig(
+                skew_target=10.0, hot_mass_frac=1.0, hot_min_dwell=99)),
+            profiler=prof,
+            router=router,
+            reshard=lambda n, sp, st, abort_check=None: svc.reshard_ps(
+                n, reshard_mgr, step=st, splits=sp, router=router,
+                fault_hook=import_hook, abort_check=abort_check,
+            ),
+            resume_reshard=lambda: svc.resume_reshard(
+                reshard_mgr, router=router),
+            arbiter=arbiter,
+        )
+
+        # ---- loop 3: AutoTierController over its own sketch -------------
+        tier_prof = AccessProfiler(["tier_a", "tier_b"], topk=32)
+        tierer = AutoTierController(
+            tier_prof,
+            PlacementPlanner(cached_row_budget=48, cached_min_reuse=1.5,
+                             hysteresis=0.05, min_dwell=1),
+            {"tier_a": TIER_CACHED, "tier_b": TIER_PS},
+            decay=0.5, arbiter=arbiter,
+        )
+        tier_ctx = _TierCtx(monitor)
+
+        # ---- loop 4: serving rollover watching a checkpoint dir ---------
+        serving_ckpt = os.path.join(tmp, "serving_ckpt")
+        os.makedirs(serving_ckpt, exist_ok=True)
+        infer_cfg = EmbeddingConfig(
+            slots_config={"cat_0": SlotConfig(dim=4)},
+            feature_index_prefix_bit=8,
+        )
+        engine = InferenceEngine(
+            InferCtx(model=None, state=None, worker=_StubWorker(),
+                     embedding_config=infer_cfg))
+        engine.swap = monitor.wrap("engine_swap", engine.swap)
+        rollover = ModelRollover(engine, ckpt_dir=serving_ckpt,
+                                 poll_interval_s=0.1, arbiter=arbiter)
+        rollover.start()
+        published = {"n": 0}
+
+        def publish_rollover():
+            published["n"] += 1
+            marker = os.path.join(serving_ckpt, DONE_MARKER)
+            tmp_marker = marker + ".tmp"
+            with open(tmp_marker, "w") as f:
+                json.dump({"session": f"soak-{published['n']}",
+                           "time_us": published["n"]}, f)
+            os.replace(tmp_marker, marker)
+
+        # ---- the serving-load thread: every reply bit-compared ----------
+        stats = {"lookups": 0, "failed": 0, "mismatched": 0}
+        stop_load = threading.Event()
+
+        def load():
+            while not stop_load.is_set():
+                try:
+                    got = router.lookup(signs, DIM, train=False)
+                except Exception:  # noqa: BLE001 — any failure is the metric
+                    stats["failed"] += 1
+                else:
+                    stats["lookups"] += 1
+                    if not np.array_equal(got, ref):
+                        stats["mismatched"] += 1
+                time.sleep(0.01)
+
+        loader = threading.Thread(target=load, daemon=True)
+        loader.start()
+
+        preempt = {}
+        reshard_result = {}
+        t_bench = time.time()
+        try:
+            for step in range(STEPS):
+                # zipf-shifting traffic feeds the autopilot's sketch; the
+                # tier sketch sees an alternating hot slot so the planner
+                # has real boundary moves to make
+                for s in (0, 1):
+                    prof.observe_slot(f"cat_{s}",
+                                      sched.signs(step, 256, slot=s))
+                hot = "tier_a" if (step // (2 * FENCE_EVERY)) % 2 == 0 \
+                    else "tier_b"
+                cold = "tier_b" if hot == "tier_a" else "tier_a"
+                hot_signs = (np.arange(16, dtype=np.uint64) + 1)
+                tier_prof.observe_slot(hot, np.tile(hot_signs, 16))
+                tier_prof.observe_slot(
+                    cold, rng.integers(1, 1 << 20, 64).astype(np.uint64))
+
+                if step > 0 and step % FENCE_EVERY == 0:
+                    prof.decay(0.5)
+                    pilot.on_fence(step)
+                    tierer.on_fence(tier_ctx, step)
+                if step % ROLLOVER_EVERY == 0:
+                    publish_rollover()
+
+                if step == STEP_BLACKHOLE:
+                    svc.spawn_standby_ps()
+                    plane.proxies[1].set_blackhole(True)
+                    _wait(lambda: monitor.done.get("heal_promote", 0) >= 1,
+                          30.0, "blackhole heal")
+                elif step == STEP_KILL:
+                    svc.spawn_standby_ps()
+                    svc.kill_ps(2)
+                    _wait(lambda: monitor.done.get("heal_promote", 0) >= 2,
+                          30.0, "kill heal")
+                elif step == STEP_PREEMPT:
+                    slow["delay_s"] = IMPORT_OP_DELAY_S
+                    d = Decision(KIND_RESHARD, "soak-preempt-window", {
+                        "n_shards": 5,
+                        "splits": [int(x) for x in uniform_splits(5)],
+                    })
+                    out = {}
+                    t = threading.Thread(target=lambda: out.update(
+                        pilot._submit(d, step, direction="grow")))
+                    t.start()
+                    _wait(lambda: monitor.calls.get("reshard_ps", 0) >= 1,
+                          30.0, "scripted reshard to enter the lease")
+                    plane.proxies[0].set_latency(GRAY_LATENCY_MS)
+                    t.join(120.0)
+                    _wait(lambda: monitor.done.get("heal_drain_gray", 0) >= 1,
+                          30.0, "gray drain after the preempted reshard")
+                    plane.proxies[0].set_latency(0.0)
+                    slow["delay_s"] = 0.0
+                    preempt = {
+                        "reshard_aborted": bool(out.get("aborted")),
+                        "imports_rolled_back": int(
+                            out.get("aborts_applied", 0)),
+                        "resume_after_abort_noop":
+                            raw_resume(reshard_mgr) is None,
+                        "post_abort_replicas": len(router.replicas),
+                        "post_abort_bitwise": bool(np.array_equal(
+                            router.lookup(signs, DIM, train=False), ref)),
+                    }
+                elif step == STEP_RESHARD:
+                    d = Decision(KIND_RESHARD, "soak-clean-resplit", {
+                        "n_shards": 4,
+                        "splits": [int(x) for x in uniform_splits(4)],
+                    })
+                    r = pilot._submit(d, step, direction="grow")
+                    reshard_result = {
+                        "aborted": bool(r.get("aborted")),
+                        "suppressed": bool(r.get("suppressed")),
+                        "moved_bytes": int(r.get("moved_bytes", 0)),
+                        "replicas": len(router.replicas),
+                    }
+                time.sleep(STEP_S)
+            wall_s = time.time() - t_bench
+        finally:
+            stop_load.set()
+            loader.join(timeout=10.0)
+            healer.stop()
+            detector.close()
+            rollover.stop()
+            plane.stop()
+
+        final = router.lookup(signs, DIM, train=False)
+        rec["wall_s"] = round(wall_s, 3)
+        rec["load"] = {
+            "lookups": stats["lookups"],
+            "failed_requests": stats["failed"],
+            "value_mismatches": stats["mismatched"],
+            "degraded_signs_final": len(router._degraded_signs),
+            "final_rows_bitwise": bool(np.array_equal(final, ref)),
+            "final_replicas": len(router.replicas),
+        }
+        rec["mutations"] = monitor.snapshot()
+        rec["arbiter"] = arbiter.export_state()
+        rec["loops"] = {
+            "healer_heals": len(healer.mttr_s),
+            "autopilot_rounds": int(pilot.rounds),
+            "tier_migrations": len(tier_ctx.moves),
+            "rollovers_applied": published["n"],
+            "serving_version": engine.version,
+        }
+        rec["preemption"] = preempt
+        rec["clean_resplit"] = reshard_result
+    return rec
+
+
+# ---------------------------------------------- leg 2: SIGKILL mid-abort
+
+
+def _abort_fleet():
+    from persia_tpu.embedding.hashing import sign_to_range_shard, \
+        uniform_splits
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.embedding.store import EmbeddingStore
+
+    signs = np.arange(1, 201, dtype=np.uint64)
+    old = uniform_splits(2)
+    srcs = [EmbeddingStore(capacity=1 << 14, num_internal_shards=2,
+                           optimizer=Adagrad(lr=0.05).config, seed=11)
+            for _ in range(2)]
+    owner = sign_to_range_shard(signs, old)
+    for r, st in enumerate(srcs):
+        st.lookup(signs[owner == r], DIM, True)
+    dests = list(srcs) + [
+        EmbeddingStore(capacity=1 << 14, num_internal_shards=2,
+                       optimizer=Adagrad(lr=0.05).config, seed=11)
+        for _ in range(2)
+    ]
+    return (srcs, dests, [int(x) for x in old],
+            [int(x) for x in uniform_splits(4)])
+
+
+def _post_import_preempt():
+    polls = {"n": 0}
+
+    def check():
+        polls["n"] += 1
+        return polls["n"] > 1
+
+    return check
+
+
+def _fleet_bytes(dests):
+    return tuple(d.export_range(0, 0) for d in dests)
+
+
+def abort_resume_cert(tmp):
+    """Kill the journaled rollback at every abort-arm crash point; the
+    resumed fleet must be bit-identical to the uninterrupted abort's."""
+    from persia_tpu import elastic, jobstate
+    from persia_tpu.analysis import crashcheck
+
+    def mk_plan(old_s, new_s):
+        plan = elastic.plan_reshard(2, 4, old_s, new_s,
+                                    jobstate.make_journal_id(1, 0))
+        assert plan.abortable
+        return plan
+
+    # reference: the uninterrupted abort restores the pristine ring
+    srcs, dests, old_s, new_s = _abort_fleet()
+    pristine = _fleet_bytes(dests)
+    try:
+        elastic.execute_reshard(mk_plan(old_s, new_s), srcs, dests,
+                                os.path.join(tmp, "cert_ref"),
+                                abort_check=_post_import_preempt())
+        raise AssertionError("post-import preemption must abort")
+    except elastic.ReshardAborted as e:
+        ref_stats = e.stats
+    ref_bytes = _fleet_bytes(dests)
+
+    # crash schedule of the abort arm: record one run, keep abort sites
+    srcs, dests, old_s, new_s = _abort_fleet()
+    with crashcheck.recording() as sites:
+        try:
+            elastic.execute_reshard(mk_plan(old_s, new_s), srcs, dests,
+                                    os.path.join(tmp, "cert_rec"),
+                                    abort_check=_post_import_preempt())
+        except elastic.ReshardAborted:
+            pass
+    points = [(s, o) for s, o in crashcheck.enumerate_points(list(sites))
+              if "abort" in s]
+
+    runs = []
+    for k, (site, occ) in enumerate(points):
+        srcs, dests, old_s, new_s = _abort_fleet()
+        plan = mk_plan(old_s, new_s)
+        js = os.path.join(tmp, f"cert_{k}")
+        check = _post_import_preempt()
+        with crashcheck.crash_at(site, occ):
+            try:
+                elastic.execute_reshard(plan, srcs, dests, js,
+                                        abort_check=check)
+            except crashcheck.SimulatedCrash:
+                pass
+            except elastic.ReshardAborted:
+                pass
+        # SIGKILL landed: a fresh coordinator re-enters the rollback
+        try:
+            stats = elastic.resume_reshard(js, srcs, dests,
+                                           abort_check=lambda: True)
+        except elastic.ReshardAborted as e:
+            stats = e.stats
+        if stats is None:
+            # killed before the engine's first commit: the re-decided
+            # drive is preempted again (same plan, fresh attempt)
+            try:
+                elastic.execute_reshard(plan, srcs, dests, js,
+                                        abort_check=lambda: True)
+                raise AssertionError("re-executed preempted plan must abort")
+            except elastic.ReshardAborted as e:
+                stats = e.stats
+        got = _fleet_bytes(dests)
+        mgr = jobstate.coerce_manager(js)
+        runs.append({
+            "site": site, "occurrence": occ,
+            "aborted": bool(stats.get("aborted")),
+            "bit_identical": got == ref_bytes == pristine,
+            "terminal_phase": elastic.find_reshard_manifest(mgr)
+                .meta["phase"],
+            "second_resume_noop":
+                elastic.resume_reshard(js, srcs, dests) is None,
+        })
+    return {
+        "uninterrupted_abort": {
+            "imports_applied": int(ref_stats["imports_applied"]),
+            "aborts_applied": int(ref_stats["aborts_applied"]),
+            "restores_pristine": ref_bytes == pristine,
+        },
+        "kill_points": runs,
+        "all_bit_identical": all(r["bit_identical"] for r in runs),
+        "all_aborted": all(
+            r["aborted"] and r["terminal_phase"] == "aborted"
+            and r["second_resume_noop"] for r in runs),
+    }
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="soak_bench_")
+    rec = {"bench": "soak"}
+    rec.update(concurrent_soak(tmp))
+    rec["abort_cert"] = abort_resume_cert(tmp)
+
+    ok = True
+
+    def check(cond, msg):
+        nonlocal ok
+        if not cond:
+            print(f"FAIL: {msg}", file=sys.stderr)
+            ok = False
+
+    load = rec["load"]
+    mut = rec["mutations"]
+    arb = rec["arbiter"]
+    check(load["failed_requests"] == 0,
+          f"{load['failed_requests']} requests failed")
+    check(load["value_mismatches"] == 0,
+          f"{load['value_mismatches']} replies mismatched the reference")
+    check(load["degraded_signs_final"] == 0, "signs left degraded")
+    check(load["final_rows_bitwise"], "final rows not bit-identical")
+    check(mut["overlaps"] == 0 and mut["max_active"] == 1,
+          f"concurrent topology mutations observed: {mut}")
+    check(arb["max_concurrent"] == 1 and arb["active"] == 0,
+          f"arbiter concurrency violated: {arb}")
+    check(arb["preemptions"] >= 1 and arb["preempted_rollbacks"] >= 1,
+          "no preemption exercised")
+    check(rec["preemption"].get("reshard_aborted")
+          and rec["preemption"].get("post_abort_bitwise")
+          and rec["preemption"].get("resume_after_abort_noop"),
+          f"preempted reshard did not roll back cleanly: {rec['preemption']}")
+    check(not rec["clean_resplit"].get("aborted")
+          and rec["clean_resplit"].get("replicas") == 4,
+          f"post-abort clean re-split failed: {rec['clean_resplit']}")
+    check(mut["actuations"].get("heal_promote", 0) >= 2,
+          "healer never promoted over the blackholed/killed shards")
+    check(mut["actuations"].get("heal_drain_gray", 0) >= 1,
+          "gray shard never drained")
+    check(mut["actuations"].get("apply_migration", 0) >= 1,
+          "tier loop never migrated")
+    check(mut["actuations"].get("engine_swap", 0) >= 1
+          and rec["loops"]["serving_version"].startswith("soak-"),
+          "rollover loop never swapped a version")
+    cert = rec["abort_cert"]
+    check(len(cert["kill_points"]) >= 3, "abort crash schedule too small")
+    check(cert["all_bit_identical"] and cert["all_aborted"],
+          "SIGKILL-mid-abort resume not bit-identical")
+    rec["ok"] = ok
+
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_SOAK.json")
+    with open(out, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(json.dumps(rec, indent=1))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,19 +39,22 @@ any finding:
   scalars on the host (``.item()``, ``float(...)``, ``np.asarray``)
   with no finite guard in the function — a blind spot in the health
   escalation ladder (:mod:`persia_tpu.analysis.numeric_lint`).
-- **Control loops** (CTRL001): a loop mutating fleet topology
+- **Control loops** (CTRL001–CTRL002): a loop mutating fleet topology
   (``reshard_ps`` / ``swap_topology`` / replica add-remove) with no
   hysteresis/dwell guard on the decision path — an unguarded control
-  loop is a flap machine (:mod:`persia_tpu.analysis.control_lint`).
-- **Protocol verification** (PROTO001–PROTO006): the journaled two-phase
+  loop is a flap machine — and any direct topology actuation from
+  control-plane code that bypasses the arbiter's single actuation lease
+  (:mod:`persia_tpu.analysis.control_lint`).
+- **Protocol verification** (PROTO001–PROTO007): the journaled two-phase
   state machines extracted statically — interprocedural raw-write of
   checkpoint artifacts, journal ids minted outside the registered
   constructors (plus an exact bitmask prover of pairwise namespace
   disjointness), committed phases with no resume() re-entry arm,
   journal_record sites with no journal_probe on their path, topology
-  mutators reachable outside a drained-fence context, and crash
-  transitions missing from ``PROTO_COVERAGE.json``
-  (:mod:`persia_tpu.analysis.protocol` +
+  mutators reachable outside a drained-fence context, crash
+  transitions missing from ``PROTO_COVERAGE.json``, and abort arms
+  (journaled preemption) not wired into the crash matrices or never
+  killed (:mod:`persia_tpu.analysis.protocol` +
   :mod:`persia_tpu.analysis.crashcheck`).
 
 Suppress a finding inline with ``# persia-lint: disable=RULE`` (or

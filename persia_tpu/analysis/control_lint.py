@@ -13,11 +13,27 @@
          (:class:`persia_tpu.autopilot.PolicyEngine`,
          :class:`~persia_tpu.embedding.tiering.shard_planner.ShardPlanner`)
          or put the margin + dwell check next to the loop.
+- CTRL002 a DIRECT call to a topology actuator — ``reshard_ps`` /
+         ``heal_promote`` / ``heal_drain_gray`` / ``apply_migration`` /
+         ``replace_replica`` / ``swap_topology`` — from control-plane
+         code whose enclosing function shows no arbiter/lease evidence.
+         Since PR 20 the fleet holds ONE topology-actuation lease
+         (:mod:`persia_tpu.autopilot.arbiter`): four loops submit
+         intents and the arbiter serializes them, preempts in-flight
+         lower-priority protocols, and suppresses cross-loop flaps. A
+         call site that bypasses the lease reopens the
+         concurrent-mutation hole the arbiter closed. Files that
+         IMPLEMENT an actuator (helper.py, topology.py, the cache ctx,
+         the worker) are the mechanism layer below the lease and are
+         exempt wholesale — actuator-to-actuator delegation inside the
+         drained window is their job.
 
 Scope notes: only ``while`` loops are control loops here — a bounded
 ``for`` over a static membership list (gateway bootstrap, a probe sweep)
 applies a decision, it doesn't make one. A mutator call outside any loop
-is fine too (a one-shot reshard is an operator action). The guard search
+is fine too (a one-shot reshard is an operator action) for CTRL001;
+CTRL002 still wants the lease token (or an explicit inline disable, as
+the launcher's setup-time operator reshard carries). The guard search
 covers the whole enclosing function's source — comments and docstrings
 count, so an actuator whose guard genuinely lives one call up can say so
 (``# dwell/hysteresis guard in PolicyEngine.decide_*``) and the reader
@@ -46,6 +62,23 @@ _MUTATORS = (
 
 # evidence of a flap guard on the decision path
 _GUARD_TOKENS = ("hysteresis", "dwell", "cooldown")
+
+# actuators that must route through the control-plane arbiter's topology
+# lease (CTRL002) when called from control-plane code
+_LEASED_ACTUATORS = (
+    "reshard_ps",
+    "heal_promote",
+    "heal_drain_gray",
+    "apply_migration",
+    "replace_replica",
+    "swap_topology",
+)
+
+# evidence that the call site sits under (or wires up) the arbiter lease;
+# the lookbehind keeps "release"/"released" from counting as "lease"
+import re as _re
+
+_LEASE_RE = _re.compile(r"arbiter|(?<![a-z])lease")
 
 
 def _called_mutators(loop: ast.AST) -> List[ast.Call]:
@@ -113,6 +146,56 @@ def check_source(text: str, path: str) -> List[Finding]:
     return findings
 
 
+def check_source_lease(text: str, path: str) -> List[Finding]:
+    """Lint one file for CTRL002 (unleased topology actuation)."""
+    tree = ast.parse(text, filename=path)
+    defined = {
+        n.name for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    if defined & set(_LEASED_ACTUATORS):
+        # mechanism layer: this file IMPLEMENTS an actuator, so its
+        # internal delegation runs below the lease by construction
+        return []
+    findings: List[Finding] = []
+
+    def has_lease(chain: List[ast.AST]) -> bool:
+        # evidence anywhere in the enclosing-function CHAIN counts: the
+        # leased wrapper pattern puts the arbiter submit in the outer
+        # function and the actuator call in an inner closure
+        if not chain:
+            return _LEASE_RE.search(text.lower()) is not None
+        return any(
+            _LEASE_RE.search(_scope_source(text, fn).lower()) is not None
+            for fn in chain
+        )
+
+    def walk(node: ast.AST, chain: List[ast.AST]) -> None:
+        for child in ast.iter_child_nodes(node):
+            inner = (chain + [child] if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)) else chain)
+            if isinstance(child, ast.Call):
+                f = child.func
+                name = (f.attr if isinstance(f, ast.Attribute)
+                        else f.id if isinstance(f, ast.Name) else None)
+                if name in _LEASED_ACTUATORS and not has_lease(chain):
+                    where = (f"function {chain[-1].name!r}" if chain
+                             else "module scope")
+                    findings.append(Finding(
+                        "CTRL002", path, child.lineno,
+                        f"direct topology actuation ({name}) in {where} "
+                        f"with no arbiter lease on the call path — submit "
+                        f"an Intent through autopilot.arbiter.Arbiter.run "
+                        f"(or carry the lease evidence/token) so the "
+                        f"single-mutation + preemption + flap-suppression "
+                        f"guarantees hold",
+                    ))
+            walk(child, inner)
+
+    walk(tree, [])
+    return findings
+
+
 def check(root: str = REPO_ROOT,
           files: Optional[Sequence[str]] = None) -> List[Finding]:
     from persia_tpu.analysis.common import python_files
@@ -126,5 +209,7 @@ def check(root: str = REPO_ROOT,
         # tests exercise flap paths on purpose
         if base.startswith("test_") or rp.startswith("tests" + os.sep):
             continue
-        findings.extend(check_source(read_text(abspath), rp))
+        text = read_text(abspath)
+        findings.extend(check_source(text, rp))
+        findings.extend(check_source_lease(text, rp))
     return findings

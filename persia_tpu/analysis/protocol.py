@@ -35,6 +35,15 @@ on:
   ``reach("...")`` site) absent from the committed ``PROTO_COVERAGE.json``
   or recorded there with zero kills: the exhaustive crash matrix
   (tests/test_protocol.py) must kill every transition at least once.
+- **PROTO007** — an abort arm that escapes the crash matrices. Any
+  phase commit whose name starts with ``abort`` (the journaled
+  preemption arms: ``aborting``/``aborted``) must sit in a module that
+  also wires an ``abort`` crash site into :func:`crashcheck.reach`, and
+  every such abort site must be recorded in ``PROTO_COVERAGE.json``
+  with at least one kill. Preemption rollback releases partially
+  imported ring ranges exactly-once through the abort journal-id
+  family; an abort arm the matrices never SIGKILL is an unproven
+  rollback path.
 
 **Journal-id namespace prover.** Every id constructor is compiled from
 its AST (pure ints, no imports) and bit-probed over its declared domain:
@@ -44,7 +53,8 @@ carries) so the analysis is exact, not sampled. Two families are proven
 disjoint when some bit is fixed-one in one and fixed-zero in the other;
 the witness bit is part of the result (and pinned in tests). Declared
 domains: job_epoch < 2^24, fence/train step < 2^30 (step bits 30-31 are
-namespace tags: handoff 00, scrub 01, replication 1x), replica/op < 2^7.
+namespace tags: handoff 00, scrub 01, replication 10, abort 11),
+replica/op < 2^7.
 
 Pure stdlib (ast only) like every pass here; never lints ``analysis/``
 itself. Suppress with ``# persia-lint: disable=PROTO00x`` on the line.
@@ -70,7 +80,7 @@ _JOURNAL_SINKS = frozenset({
 # bodies are the one place raw bit arithmetic on ids is legal
 CONSTRUCTOR_NAMES = frozenset({
     "make_journal_id", "journal_shard_id", "handoff_journal_id",
-    "replication_journal_id", "scrub_journal_id",
+    "replication_journal_id", "scrub_journal_id", "abort_journal_id",
 })
 
 _MUTATORS = frozenset({
@@ -92,8 +102,10 @@ FENCE_CONTEXTS = frozenset({
 })
 
 # phases that terminate a protocol: a resume path never needs an arm for
-# a state that means "nothing left to do"
-TERMINAL_PHASES = frozenset({"done"})
+# a state that means "nothing left to do" — "done" (completed) and
+# "aborted" (preemption rollback fully released; terminal by the same
+# contract)
+TERMINAL_PHASES = frozenset({"done", "aborted"})
 
 COVERAGE_FILE = "PROTO_COVERAGE.json"
 
@@ -548,6 +560,63 @@ def _rule_proto005(scan: _ModuleScan) -> List[Finding]:
     return findings
 
 
+def _rule_proto007(scan: _ModuleScan) -> List[Finding]:
+    """Abort arms must be wired into crashcheck.reach: any module that
+    commits a phase starting with ``abort`` (the journaled preemption
+    arms) must also declare at least one ``abort`` reach site, or the
+    rollback's crash transitions escape the exhaustive kill matrices."""
+    abort_commits = [
+        (writer, phase, line)
+        for writer, phase, line in scan.phase_sites
+        if phase.startswith("abort")
+    ]
+    if not abort_commits:
+        return []
+    if any("abort" in site for site, _ in scan.reach_sites):
+        return []
+    return [
+        Finding(
+            "PROTO007", scan.path, line,
+            f"abort arm: phase {phase!r} is committed by {writer}() but "
+            "this module wires no abort crash site into crashcheck.reach — "
+            "the preemption rollback's transitions are invisible to the "
+            "exhaustive kill matrices (add reach(\"<proto>.phase.abort...\") "
+            "at the commit boundary)",
+        )
+        for writer, phase, line in abort_commits
+    ]
+
+
+def _abort_coverage_findings(
+    root: str, sites: Dict[str, List[Tuple[str, int]]],
+) -> List[Finding]:
+    """check()-level half of PROTO007: every abort reach site must carry
+    at least one recorded kill in the committed coverage artifact — an
+    abort transition the matrices never SIGKILL is an unproven rollback."""
+    from persia_tpu.analysis import crashcheck
+
+    abort_sites = sorted(s for s in sites if "abort" in s)
+    if not abort_sites:
+        return []
+    cov_path = os.path.join(root, COVERAGE_FILE)
+    try:
+        recorded = crashcheck.load_coverage(cov_path).get("sites", {})
+    except (OSError, ValueError):
+        recorded = {}  # missing/unreadable artifact: PROTO006 already fires
+    findings: List[Finding] = []
+    for site in abort_sites:
+        kills = int(recorded.get(site, {}).get("kills", 0))
+        if kills < 1:
+            findings.append(Finding(
+                "PROTO007", COVERAGE_FILE, 1,
+                f"abort transition {site!r} has no recorded kill — every "
+                "journaled preemption arm must be SIGKILLed at least once "
+                "by the crash matrices (python tests/test_protocol.py "
+                "--write-coverage after adding the schedule)",
+            ))
+    return findings
+
+
 # --------------------------------------------------- namespace prover
 
 
@@ -593,19 +662,20 @@ def disjoint_witness(a: BitPattern, b: BitPattern) -> Optional[int]:
 
 # name-keyed declared domains (bit widths). Fence/train steps are < 2^30
 # BY CONTRACT: step bits 30-31 are namespace subspace tags (handoff 00,
-# scrub 01, replication 1x) — see jobstate.py / health/scrub.py.
+# scrub 01, replication 10, abort 11) — see jobstate.py / health/scrub.py.
 _DOMAIN_BITS = {
     "job_epoch": 24, "epoch": 24, "step": 30,
     "op": 7, "op_index": 7, "replica": 7, "replica_index": 7, "r": 7,
 }
 _DEFAULT_DOMAIN = 24
 
-# the four shipped id families over the compiled constructor namespace
+# the five shipped id families over the compiled constructor namespace
 _FAMILIES: List[Tuple[str, Sequence[int]]] = [
     ("gradient", (24, 30, 7)),
     ("handoff", (24, 30, 7)),
     ("replication", (24, 30, 7)),
     ("scrub", (24, 30, 7)),
+    ("abort", (24, 30, 7)),
 ]
 
 
@@ -617,6 +687,7 @@ def _family_fns(ns: Dict) -> Dict[str, object]:
             ns["make_journal_id"](e, s), op),
         "replication": lambda e, s, op: ns["replication_journal_id"](e, s, op),
         "scrub": lambda e, s, r: ns["scrub_journal_id"](e, s, r),
+        "abort": lambda e, s, op: ns["abort_journal_id"](e, s, op),
     }
 
 
@@ -817,6 +888,7 @@ def check_source(text: str, path: str) -> List[Finding]:
     findings += _rule_proto003(scan)
     findings += _rule_proto004(scan)
     findings += _rule_proto005(scan)
+    findings += _rule_proto007(scan)
     findings += _fixture_prover_findings(scan, text)
     return findings
 
@@ -844,12 +916,14 @@ def check(
         findings += _rule_proto003(scan)
         findings += _rule_proto004(scan)
         findings += _rule_proto005(scan)
+        findings += _rule_proto007(scan)
     findings += _prover_findings(root)
     sites = {}
     for scan in scans:
         for site, line in scan.reach_sites:
             sites.setdefault(site, []).append((scan.path, line))
     findings += _coverage_findings(root, sites)
+    findings += _abort_coverage_findings(root, sites)
     proof = prove_namespaces(root)
     coverage = {
         "files": texts,

@@ -40,7 +40,7 @@ from typing import Callable, Dict, Optional
 
 import numpy as np
 
-from persia_tpu import jobstate
+from persia_tpu import elastic, jobstate
 from persia_tpu.analysis.crashcheck import reach
 from persia_tpu.embedding.tiering.profiler import publish_sketch_metrics
 from persia_tpu.embedding.tiering.shard_planner import ShardPlanner
@@ -56,11 +56,24 @@ from persia_tpu.autopilot.policy import (
     PolicyConfig,
     PolicyEngine,
 )
+from persia_tpu.autopilot import arbiter as arbitration
 from persia_tpu.autopilot.replicate import replicate_hot_signs
 
 logger = get_default_logger("persia_tpu.autopilot")
 
 AUTOPILOT_ENV = "PERSIA_AUTOPILOT"
+
+# decision kind -> (intent kind, flap-suppression key, preemptable).
+# Only the ring reshard is preemptable: it is the one actuation with a
+# journaled ABORT arm (elastic.py); replication and scale are short and
+# run to completion under the lease.
+_INTENTS = {
+    KIND_RESHARD: (arbitration.INTENT_RESHARD, "ps_topology", True),
+    KIND_REPLICATE: (arbitration.INTENT_REPLICATE, "", False),
+    KIND_SCALE: (arbitration.INTENT_SCALE, "serving_scale", False),
+}
+
+_accepts_abort = arbitration.accepts_abort
 
 
 def autopilot_enabled() -> bool:
@@ -102,8 +115,13 @@ class Autopilot:
         scale_to: Optional[Callable] = None,
         serving_sensors: Optional[Callable] = None,
         healer=None,
+        arbiter=None,
     ):
         self.policy = policy or PolicyEngine(PolicyConfig())
+        # when attached, every actuation routes through the control-plane
+        # arbiter's topology lease (serialization + preemption + cross-loop
+        # flap suppression); None keeps the direct-drive path for tests
+        self.arbiter = arbiter
         # an attached Healer (autopilot.heal) rides this controller's
         # cadence: on_tick drives its sense->decide->heal round, resume()
         # re-drives its planned-without-done heal before our own
@@ -140,6 +158,10 @@ class Autopilot:
             "persia_tpu_autopilot_resumed",
             "planned decisions re-driven after a controller crash",
         )
+        self._m_aborted = m.counter(
+            "persia_tpu_autopilot_aborted",
+            "actuations preempted mid-flight and rolled back",
+        )
 
     # --------------------------------------------------------------- sense
 
@@ -174,15 +196,20 @@ class Autopilot:
             },
         })
 
-    def _actuate(self, decision: Decision, step: int) -> Dict:
+    def _actuate(self, decision: Decision, step: int,
+                 abort_check: Optional[Callable] = None) -> Dict:
         p = decision.params
         if decision.kind == KIND_RESHARD:
             if self._reshard is None:
                 raise RuntimeError("reshard decision without an actuator")
+            kwargs = {}
+            if abort_check is not None and _accepts_abort(self._reshard):
+                kwargs["abort_check"] = abort_check
             return dict(self._reshard(
                 int(p["n_shards"]),
                 np.asarray(p["splits"], dtype=np.uint64),
                 int(step),
+                **kwargs,
             ) or {})
         if decision.kind == KIND_REPLICATE:
             if self.router is None:
@@ -199,9 +226,12 @@ class Autopilot:
             return {"achieved": int(self._scale_to(int(p["target"])))}
         raise ValueError(f"unknown decision kind {decision.kind!r}")
 
-    def _drive(self, decision: Decision, step: int) -> Dict:
-        """planned → actuate → done. A kill anywhere in between leaves the
-        planned manifest as the resume token."""
+    def _drive(self, decision: Decision, step: int,
+               abort_check: Optional[Callable] = None) -> Dict:
+        """planned → actuate → done (or → aborted, when a higher-priority
+        intent preempted the actuation mid-flight and the engine rolled it
+        back). A kill anywhere in between leaves the planned manifest as
+        the resume token."""
         record_event("autopilot.decide", step=step, decision=decision.kind,
                      reason=decision.reason, **{
                          k: v for k, v in decision.params.items()
@@ -212,11 +242,42 @@ class Autopilot:
         reach("autopilot.phase.planned")
         self._commit("planned", decision, step)
         reach("autopilot.actuate")
-        with span("autopilot.actuate", kind=decision.kind, step=step):
-            result = self._actuate(decision, step)
+        try:
+            with span("autopilot.actuate", kind=decision.kind, step=step):
+                result = self._actuate(decision, step, abort_check)
+        except elastic.ReshardAborted as e:
+            # the engine already released every imported range through the
+            # journaled ABORT arm; the terminal "aborted" commit closes
+            # this decision so resume() never re-drives it
+            result = dict(e.stats)
+            record_event("autopilot.aborted", step=step,
+                         decision=decision.kind)
+            logger.info("autopilot: %s @ step %d preempted and rolled back",
+                        decision.kind, step)
+            reach("autopilot.phase.aborted")
+            self._commit("aborted", decision, step, result)
+            self._m_aborted.inc()
+            return result
         reach("autopilot.phase.done")
         self._commit("done", decision, step, result)
         self._m_decisions.inc(kind=decision.kind)
+        return result
+
+    def _submit(self, decision: Decision, step: int,
+                direction: Optional[str] = None) -> Dict:
+        """Route one decision through the arbiter's topology lease when
+        attached, or drive it directly (stub/test wiring)."""
+        if self.arbiter is None:
+            return self._drive(decision, step)
+        kind, key, preemptable = _INTENTS[decision.kind]
+        result = self.arbiter.run(arbitration.Intent(
+            kind, "autopilot",
+            lambda abort_check: self._drive(decision, step, abort_check),
+            key=key, direction=direction, preemptable=preemptable,
+            label=decision.reason,
+        ))
+        if result.get("suppressed"):
+            self._m_suppressed.inc()
         return result
 
     # --------------------------------------------------------------- loops
@@ -237,14 +298,19 @@ class Autopilot:
             splits = self.router.ring if self.router is not None else None
             d = self.policy.decide_reshard(self.profiler, n, splits)
             if d is not None:
-                applied[KIND_RESHARD] = self._drive(d, gstep)
-                # the swap cleared the hot-read map — re-replicate now,
-                # onto the NEW owners' neighbours
-                self.policy.notify_topology_changed()
+                n_new = int(d.params["n_shards"])
+                r = self._submit(d, gstep,
+                                 direction="grow" if n_new > n
+                                 else "shrink" if n_new < n else None)
+                applied[KIND_RESHARD] = r
+                if not r.get("suppressed") and not r.get("aborted"):
+                    # the swap cleared the hot-read map — re-replicate now,
+                    # onto the NEW owners' neighbours
+                    self.policy.notify_topology_changed()
         if self.profiler is not None and self.router is not None:
             d = self.policy.decide_replicate(self.profiler)
             if d is not None:
-                applied[KIND_REPLICATE] = self._drive(d, gstep)
+                applied[KIND_REPLICATE] = self._submit(d, gstep)
         held = self.policy.suppressed - before
         if held:
             self._m_suppressed.inc(held)
@@ -274,7 +340,12 @@ class Autopilot:
         )
         applied: Dict[str, Dict] = applied_heal
         if d is not None:
-            applied[KIND_SCALE] = self._drive(d, step)
+            target = int(d.params["target"])
+            have = int(sv.get("replicas", 0))
+            applied[KIND_SCALE] = self._submit(
+                d, step, direction="grow" if target > have
+                else "shrink" if target < have else None,
+            )
         held = self.policy.suppressed - before
         if held:
             self._m_suppressed.inc(held)
@@ -330,9 +401,16 @@ class Autopilot:
                 result = dict(result)
             else:
                 result = self._actuate(decision, step)
-        self._commit("done", decision, step, result)
+        if result.get("aborted"):
+            # the kill landed mid-ABORT: the engine finished the rollback
+            # on resume, so this decision closes aborted, not done
+            reach("autopilot.phase.aborted")
+            self._commit("aborted", decision, step, result)
+            self._m_aborted.inc()
+        else:
+            self._commit("done", decision, step, result)
+            self._m_decisions.inc(kind=decision.kind)
         self._m_resumed.inc()
-        self._m_decisions.inc(kind=decision.kind)
         return result
 
 
@@ -364,6 +442,7 @@ def enable_autopilot(
     gateway=None,
     scale_to: Optional[Callable] = None,
     config: Optional[PolicyConfig] = None,
+    arbiter=None,
 ) -> Autopilot:
     """Wire an Autopilot over a live ``ServiceCtx`` topology: decisions
     journal to ``state_dir/decisions``, reshards run their phase manifests
@@ -378,8 +457,9 @@ def enable_autopilot(
         policy=PolicyEngine(config or PolicyConfig()),
         profiler=profiler,
         router=router,
-        reshard=lambda n, sp, st: svc.reshard_ps(
+        reshard=lambda n, sp, st, abort_check=None: svc.reshard_ps(
             n, reshard_mgr, step=st, splits=sp, router=router,
+            abort_check=abort_check,
         ),
         resume_reshard=lambda: svc.resume_reshard(
             reshard_mgr, router=router,
@@ -387,5 +467,6 @@ def enable_autopilot(
         scale_to=scale_to,
         serving_sensors=gateway_sensors(gateway) if gateway is not None
         else None,
+        arbiter=arbiter,
     )
     return pilot

@@ -32,12 +32,13 @@ import time
 from dataclasses import asdict, dataclass
 from typing import Callable, Dict, List, Optional
 
-from persia_tpu import jobstate
+from persia_tpu import elastic, jobstate
 from persia_tpu.analysis.crashcheck import reach
 from persia_tpu.logger import get_default_logger
 from persia_tpu.metrics import get_metrics
 from persia_tpu.tracing import record_event, span
 
+from persia_tpu.autopilot import arbiter as arbitration
 from persia_tpu.autopilot.policy import KIND_HEAL, Decision
 from persia_tpu.service.failure_detector import (
     VERDICT_DEAD,
@@ -225,8 +226,13 @@ class Healer:
         probe_factory: Optional[Callable] = None,
         fault_hook: Optional[Callable] = None,
         clock: Callable[[], float] = time.monotonic,
+        arbiter=None,
     ):
         self.mgr = jobstate.coerce_manager(state_dir)
+        # when attached, heals route through the control-plane arbiter:
+        # promote/drain outrank everything (and may preempt an in-flight
+        # reshard), a RESIZE is itself a preemptable reshard intent
+        self.arbiter = arbiter
         self.detector = detector
         self.policy = policy or HealPolicy()
         self._promote = promote
@@ -277,7 +283,8 @@ class Healer:
             },
         })
 
-    def _actuate(self, decision: Decision) -> Dict:
+    def _actuate(self, decision: Decision,
+                 abort_check: Optional[Callable] = None) -> Dict:
         p = decision.params
         action = p["action"]
         advances = {int(k): int(v) for k, v in
@@ -297,7 +304,10 @@ class Healer:
         if action == ACTION_RESIZE:
             if self._resize is None:
                 raise RuntimeError("resize decision without an actuator")
-            return dict(self._resize(int(p["n_new"])) or {})
+            kwargs = {}
+            if abort_check is not None and arbitration.accepts_abort(self._resize):
+                kwargs["abort_check"] = abort_check
+            return dict(self._resize(int(p["n_new"]), **kwargs) or {})
         raise ValueError(f"unknown heal action {action!r}")
 
     def _reprobe(self, victim: int, addr) -> None:
@@ -311,7 +321,8 @@ class Healer:
         self.detector.reset(victim, probe)
 
     def _drive(self, decision: Decision, step: int,
-               detect_ts: Optional[float]) -> Dict:
+               detect_ts: Optional[float],
+               abort_check: Optional[Callable] = None) -> Dict:
         record_event("heal.decide", step=step, action=decision.params["action"],
                      reason=decision.reason,
                      victim=decision.params.get("victim", -1))
@@ -322,8 +333,22 @@ class Healer:
         if self._fault_hook is not None:
             self._fault_hook("planned")
         reach("heal.actuate")
-        with span("heal.actuate", action=decision.params["action"], step=step):
-            result = self._actuate(decision)
+        try:
+            with span("heal.actuate", action=decision.params["action"],
+                      step=step):
+                result = self._actuate(decision, abort_check)
+        except elastic.ReshardAborted as e:
+            # a RESIZE preempted by a dead/gray heal: the elastic engine
+            # already rolled the ring back; close this decision aborted so
+            # resume() never re-drives it
+            result = dict(e.stats)
+            record_event("heal.aborted", step=step,
+                         action=decision.params["action"])
+            logger.info("healer: %s @ step %d preempted and rolled back",
+                        decision.params["action"], step)
+            reach("heal.phase.aborted")
+            self._commit("aborted", decision, step, result)
+            return result
         if detect_ts is not None:
             mttr = max(0.0, self.clock() - detect_ts)
             result["mttr_s"] = mttr
@@ -364,7 +389,41 @@ class Healer:
             detect_ts = self.detector.detected_at(int(p["victim"]))
         else:
             detect_ts = None
-        return self._drive(decision, step, detect_ts)
+        return self._submit(decision, step, detect_ts)
+
+    def _submit(self, decision: Decision, step: int,
+                detect_ts: Optional[float]) -> Dict:
+        """Route one heal through the arbiter's topology lease when
+        attached, or drive it directly. Promote/drain intents sit at the
+        top of the priority order and preempt an in-flight lower-priority
+        protocol; a RESIZE is itself a preemptable reshard intent."""
+        if self.arbiter is None:
+            return self._drive(decision, step, detect_ts)
+        action = decision.params["action"]
+        if action == ACTION_PROMOTE:
+            kind, key, direction, preemptable = (
+                arbitration.INTENT_HEAL_DEAD, "", None, False)
+        elif action == ACTION_DRAIN_GRAY:
+            kind, key, direction, preemptable = (
+                arbitration.INTENT_HEAL_GRAY, "", None, False)
+        else:
+            n_new = int(decision.params["n_new"])
+            n_from = int(decision.params.get("from", n_new))
+            kind, key, preemptable = (
+                arbitration.INTENT_RESHARD, "ps_topology", True)
+            direction = ("grow" if n_new > n_from
+                         else "shrink" if n_new < n_from else None)
+        result = self.arbiter.run(arbitration.Intent(
+            kind, "healer",
+            lambda abort_check: self._drive(
+                decision, step, detect_ts, abort_check),
+            key=key, direction=direction, preemptable=preemptable,
+            label=decision.reason,
+        ))
+        if result.get("suppressed"):
+            self.policy.suppressed += 1
+            self._m_suppressed.inc()
+        return result
 
     def start(self, interval_s: float = 0.5) -> "Healer":
         """Background poll loop — the autonomous mode the flagship chaos
@@ -442,6 +501,13 @@ class Healer:
                 result = dict(result)
             else:
                 result = self._actuate(decision)
+        if result.get("aborted"):
+            # the kill landed mid-ABORT: the engine finished the rollback
+            # on resume, so this heal closes aborted, not done
+            reach("heal.phase.aborted")
+            self._commit("aborted", decision, step, result)
+            self._m_resumed.inc()
+            return result
         self._commit("done", decision, step, result)
         self.heals += 1
         self._m_resumed.inc()
@@ -465,6 +531,7 @@ def enable_self_heal(
     reshard_state_dir=None,
     probe_timeout_s: float = 1.0,
     fault_hook: Optional[Callable] = None,
+    arbiter=None,
 ) -> Healer:
     """Wire a Healer over a live ``ServiceCtx``: probes + leases feed a
     FailureDetector, decisions journal under ``state_dir/heal``, resizes
@@ -498,11 +565,12 @@ def enable_self_heal(
         drain=lambda victim, ba: svc.heal_drain_gray(
             victim, router=router, batch_advances=ba, fault_hook=fault_hook,
         ),
-        resize=lambda n_new: svc.reshard_ps(
-            n_new, reshard_mgr, router=router,
+        resize=lambda n_new, abort_check=None: svc.reshard_ps(
+            n_new, reshard_mgr, router=router, abort_check=abort_check,
         ),
         resume_resize=lambda: svc.resume_reshard(reshard_mgr, router=router),
         sensors=sensors,
         batch_advances=batch_advances,
         probe_factory=lambda addr: make_probe(addr, timeout_s=probe_timeout_s),
+        arbiter=arbiter,
     )

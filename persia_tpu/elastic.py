@@ -36,6 +36,12 @@ coordinator, any    the phase-fenced manifests are durable; a new process
                     recorded phase — journal ids are recomputed from the
                     recorded ``base_id`` + deterministic move order, so
                     every already-applied op dedupes.
+coordinator,        the ``aborting`` manifest is durable before the first
+aborting            rollback release; :func:`resume_reshard` re-enters the
+                    abort arm, every already-released arc dedupes via its
+                    :func:`~persia_tpu.jobstate.abort_journal_id`, and the
+                    terminal ``aborted`` manifest commits — bit-identical
+                    to an uninterrupted abort.
 ==================  =========================================================
 
 Phase order is what makes the matrix closed: the ``handoff`` manifest
@@ -44,6 +50,21 @@ Phase order is what makes the matrix closed: the ``handoff`` manifest
 the first delete; the ``done`` manifest commits last. Until ``done``, the
 reshard is visibly incomplete and :func:`find_reshard_manifest` will hand
 it to the resume path.
+
+ABORT arm (PR 20): a higher-priority control-plane intent (a HEAL under
+the :mod:`persia_tpu.autopilot.arbiter` lease) may preempt an in-flight
+reshard at a phase boundary. ``execute_reshard(abort_check=...)`` polls
+the check after the ``handoff`` commit and again after the imports; the
+``imported`` commit is the point of no return — past it the router swap
+is the cheaper path and the protocol rolls FORWARD. An abort commits an
+``aborting`` manifest, releases every partially imported arc on its
+destination through journaled range deletes in the dedicated abort
+journal-id namespace (exactly-once under SIGKILL+resume), then commits
+the terminal ``aborted`` manifest and raises :class:`ReshardAborted`.
+Only ring→ring plans are abortable: under a modulo bootstrap the moved
+arcs overlap entries the destinations legitimately own, so a rollback
+range-delete would destroy live data — ``plan.abortable`` is False and
+the preemption request is ignored (the protocol runs to ``done``).
 
 The caller guarantees the FENCE invariant: the training stream is drained
 (no in-flight lookups/updates against the moving ranges) for the duration.
@@ -84,6 +105,25 @@ _m_deduped = _m.counter(
     "persia_tpu_reshard_ops_deduped",
     "handoff ops skipped because the apply-journal already held them (resume replay)",
 )
+_m_aborts = _m.counter(
+    "persia_tpu_reshard_aborts_total",
+    "resharding plans rolled back to the aborted phase by a preemption",
+)
+
+
+class ReshardAborted(RuntimeError):
+    """An in-flight reshard was preempted at a phase boundary and rolled
+    back. The rollback ran to the terminal ``aborted`` manifest before this
+    was raised — the fleet is back on the OLD ring with every partially
+    imported arc released. ``stats`` carries the run counters (including
+    the rollback's ``aborts_applied`` / ``aborts_deduped``)."""
+
+    def __init__(self, stats: Dict):
+        super().__init__(
+            f"reshard preempted and rolled back: {stats.get('aborts_applied', 0)}"
+            " arc release(s) applied"
+        )
+        self.stats = stats
 
 
 # ------------------------------------------------------------------- planning
@@ -109,6 +149,13 @@ class ReshardPlan:
     new_splits: List[int]
     base_id: int  # journal-id base; op k applies as handoff_journal_id(base, k)
     moves: List[Move]
+
+    @property
+    def abortable(self) -> bool:
+        """Only ring→ring plans can roll back: a modulo bootstrap's moved
+        arcs overlap entries the destinations legitimately hold, so the
+        abort arm's range releases would destroy live data."""
+        return self.old_splits is not None
 
     @property
     def deletes(self) -> List[Move]:
@@ -254,6 +301,24 @@ def find_reshard_manifest(
     return None
 
 
+def find_phase_manifest(
+    mgr: "jobstate.JobStateManager", phase: str, base_id: int,
+) -> Optional["jobstate.Manifest"]:
+    """Newest reshard manifest recording ``phase`` for the plan identified
+    by ``base_id``. The abort resume path needs this: the fence snapshots
+    live on the ``handoff`` manifest, but by the time a mid-abort SIGKILL
+    resumes, the NEWEST reshard manifest is the snapshot-less ``aborting``
+    one."""
+    for _e, d in reversed(mgr._epoch_dirs()):
+        m = mgr._load_manifest(d)
+        if (m is not None and m.meta.get("kind") == "reshard"
+                and m.meta.get("phase") == phase
+                and int(m.meta.get("reshard", {}).get("base_id", -1))
+                == int(base_id)):
+            return m
+    return None
+
+
 def prime_joiner(client, optimizer, batch_advances: Optional[Dict]) -> None:
     """Bring a FRESH store onto the fleet's optimizer time-base before it
     serves its first train lookup: register the optimizer (a store without
@@ -328,6 +393,56 @@ def _run_deletes(
             )
 
 
+def _run_abort(
+    plan: ReshardPlan, dests: Sequence, mgr: "jobstate.JobStateManager",
+    stats: Dict, start_phase: str, fault_hook: Optional[FaultHook],
+    extra_meta: Optional[Dict],
+) -> Dict:
+    """Roll an interrupted plan BACK: commit the ``aborting`` manifest,
+    release every (possibly) imported arc on its destination through a
+    journaled range delete in the abort journal-id namespace, then commit
+    the terminal ``aborted`` manifest. Pure replay like ``_finish`` — a
+    SIGKILL anywhere in here resumes through the ``aborting`` arm of
+    :func:`resume_reshard` and every already-released arc dedupes, so the
+    resumed end state is bit-identical to an uninterrupted abort."""
+    if start_phase != "aborting":
+        reach("elastic.phase.aborting")
+        _commit_phase(mgr, plan, "aborting", extra_meta)
+    epoch = plan.base_id >> 40
+    step = (plan.base_id >> 8) & 0xFFFFFFFF
+    with tracing.span("reshard.abort", moves=len(plan.moves)):
+        for idx, mv in enumerate(plan.moves):
+            if fault_hook is not None:
+                fault_hook("abort", idx, mv)
+            reach("elastic.op.abort_release")
+            jid = jobstate.abort_journal_id(epoch, step, idx)
+            crc = jobstate.payload_crc(np.array([mv.lo, mv.hi], dtype=np.uint64))
+            applied, removed = dests[mv.dst].delete_range_journaled(
+                jid, crc, mv.lo, mv.hi
+            )
+            if applied:
+                stats["aborts_applied"] += 1
+                stats["entries_removed"] += int(removed)
+            else:
+                stats["aborts_deduped"] += 1
+                _m_deduped.inc()
+            tracing.record_event(
+                "reshard.abort_release", op=idx, dst=mv.dst,
+                removed=int(removed), applied=bool(applied),
+            )
+    reach("elastic.phase.aborted")
+    _commit_phase(mgr, plan, "aborted", extra_meta)
+    _m_aborts.inc()
+    stats["aborted"] = True
+    logger.info(
+        "reshard %d->%d ABORTED: %d/%d arc releases applied/deduped, "
+        "%d entries released",
+        plan.old_n, plan.new_n, stats["aborts_applied"],
+        stats["aborts_deduped"], stats["entries_removed"],
+    )
+    return stats
+
+
 def _commit_phase(
     mgr: "jobstate.JobStateManager", plan: ReshardPlan, phase: str,
     extra: Optional[Dict] = None, capture: Optional[Tuple[str, str, Sequence]] = None,
@@ -351,12 +466,25 @@ def _finish(
     mgr: "jobstate.JobStateManager", stats: Dict, start_phase: str,
     fault_hook: Optional[FaultHook], on_imported: Optional[Callable[[], None]],
     extra_meta: Optional[Dict],
+    abort_check: Optional[Callable[[], bool]] = None,
 ) -> Dict:
     """Drive the plan from ``start_phase`` to ``done``. Everything in here
     is a pure replay: journal ids come from the plan, so re-entering after
-    any crash dedupes instead of double-applying."""
+    any crash dedupes instead of double-applying. ``abort_check`` is polled
+    at the phase boundaries BEFORE the ``imported`` commit (the point of no
+    return); True rolls the plan back and raises :class:`ReshardAborted`."""
+    def _preempted() -> bool:
+        return (abort_check is not None and plan.abortable
+                and bool(abort_check()))
+
     if start_phase == "handoff":
+        if _preempted():
+            raise ReshardAborted(_run_abort(
+                plan, dests, mgr, stats, start_phase, fault_hook, extra_meta))
         _run_imports(plan, sources, dests, stats, fault_hook)
+        if _preempted():
+            raise ReshardAborted(_run_abort(
+                plan, dests, mgr, stats, start_phase, fault_hook, extra_meta))
         reach("elastic.phase.imported")
         _commit_phase(mgr, plan, "imported", extra_meta,
                       capture=("dest", "dest_shards", dests))
@@ -381,8 +509,9 @@ def _new_stats(start_phase: str, resumed: bool) -> Dict:
     return {
         "imports_applied": 0, "imports_deduped": 0,
         "deletes_applied": 0, "deletes_deduped": 0,
+        "aborts_applied": 0, "aborts_deduped": 0,
         "moved_bytes": 0, "entries_removed": 0,
-        "start_phase": start_phase, "resumed": resumed,
+        "start_phase": start_phase, "resumed": resumed, "aborted": False,
     }
 
 
@@ -395,6 +524,7 @@ def execute_reshard(
     fault_hook: Optional[FaultHook] = None,
     on_imported: Optional[Callable[[], None]] = None,
     extra_meta: Optional[Dict] = None,
+    abort_check: Optional[Callable[[], bool]] = None,
 ) -> Dict:
     """Run a fresh plan end to end. ``sources``/``dests`` are store handles
     (StoreClient or in-process stores) indexed by OLD/NEW replica index —
@@ -403,7 +533,10 @@ def execute_reshard(
     fires before every handoff op (chaos injection); ``on_imported`` fires
     once at the imported boundary (where the router swaps rings);
     ``extra_meta`` (e.g. the optimizer config) rides on every phase
-    manifest so the resume path can rebuild dead replicas."""
+    manifest so the resume path can rebuild dead replicas. ``abort_check``
+    (the arbiter's preemption flag) is polled at the phase boundaries
+    before the ``imported`` commit — True rolls the plan back through the
+    journaled abort arm and raises :class:`ReshardAborted`."""
     if len(sources) != plan.old_n or len(dests) != plan.new_n:
         raise ValueError(
             f"plan is {plan.old_n}->{plan.new_n} but got "
@@ -416,7 +549,7 @@ def execute_reshard(
                       capture=("source", "source_shards", sources))
     stats = _new_stats("handoff", resumed=False)
     return _finish(plan, sources, dests, mgr, stats, "handoff",
-                   fault_hook, on_imported, extra_meta)
+                   fault_hook, on_imported, extra_meta, abort_check)
 
 
 def resume_reshard(
@@ -426,16 +559,23 @@ def resume_reshard(
     *,
     fault_hook: Optional[FaultHook] = None,
     on_imported: Optional[Callable[[], None]] = None,
+    abort_check: Optional[Callable[[], bool]] = None,
 ) -> Optional[Dict]:
     """Re-enter an interrupted reshard from its recorded phase. Returns the
-    run stats, or None when the newest reshard already reached ``done`` (or
-    none ever ran). The caller restores any DEAD replicas first — from
-    :func:`source_snapshot` / :func:`dest_snapshot` per the crash matrix —
-    and passes live handles here; this function only replays ops, and the
-    journal turns every already-applied one into a dedupe."""
+    run stats, or None when the newest reshard already reached ``done`` or
+    ``aborted`` (or none ever ran). ``abort_check`` carries a preemption
+    request that is STILL pending at resume time (the request itself is
+    arbiter memory, not manifest state — absent a live request, an
+    interrupted forward plan rolls forward). The caller restores any DEAD replicas
+    first — from :func:`source_snapshot` / :func:`dest_snapshot` per the
+    crash matrix — and passes live handles here; this function only replays
+    ops, and the journal turns every already-applied one into a dedupe. A
+    plan recorded in the ``aborting`` phase re-enters the ABORT arm and
+    runs it to the terminal ``aborted`` manifest (stats carry
+    ``aborted=True`` so the caller knows not to finalize the new ring)."""
     mgr = jobstate.coerce_manager(job_state)
     man = find_reshard_manifest(mgr)
-    if man is None or man.meta.get("phase") == "done":
+    if man is None or man.meta.get("phase") in ("done", "aborted"):
         return None
     plan = ReshardPlan.from_meta(man.meta)
     if len(sources) != plan.old_n or len(dests) != plan.new_n:
@@ -444,16 +584,18 @@ def resume_reshard(
             f"{len(sources)} sources / {len(dests)} dests"
         )
     phase = man.meta["phase"]
-    if phase not in ("handoff", "imported"):
+    if phase not in ("handoff", "imported", "aborting"):
         # an unknown phase must be loud: falling through to _finish would
         # run deletes-only and release source ranges that never imported
         raise jobstate.ManifestError(
             f"reshard manifest records unknown phase {phase!r} "
-            "(expected 'handoff' or 'imported')"
+            "(expected 'handoff', 'imported' or 'aborting')"
         )
     extra = {"optimizer": man.meta["optimizer"]} if "optimizer" in man.meta else None
     tracing.record_event("reshard.resume", phase=phase,
                          old_n=plan.old_n, new_n=plan.new_n)
     stats = _new_stats(phase, resumed=True)
+    if phase == "aborting":
+        return _run_abort(plan, dests, mgr, stats, phase, fault_hook, extra)
     return _finish(plan, sources, dests, mgr, stats, phase,
-                   fault_hook, on_imported, extra)
+                   fault_hook, on_imported, extra, abort_check)

@@ -171,8 +171,14 @@ def run_train_stream(
     invariants are identical to snapshot fences, and a no-op callback is
     bit-transparent to the stream (tests/test_autopilot.py pins this).
     With ``fence_callback`` set the fence cadence runs even without
-    ``job_state`` (no manifest is committed then). A callback exception
-    aborts the stream like any fence failure.
+    ``job_state`` (no manifest is committed then). A callback exception is
+    ISOLATED: the fence's own invariants already held before the callback
+    ran, so the error is counted
+    (``persia_tpu_stream_fence_callback_errors``), recorded as a
+    ``stream.fence_callback_error`` flight event, and training continues —
+    the callback's own journal (e.g. the autopilot's planned manifest)
+    keeps its interrupted work resumable. Fence-internal failures (drain,
+    ledger, manifest commit) still abort the stream.
 
     ``sentinel`` + ``skip_steps`` (persia_tpu/health): an armed
     :class:`~persia_tpu.health.sentinel.StreamSentinel` digests each
@@ -828,8 +834,35 @@ def run_train_stream(
                         # drained, rings verified empty, manifest (if any)
                         # committed — the callback may reshard the PS tier
                         # or swap routing before the stream resumes
-                        with span("stream.fence_callback", step=gstep):
-                            fence_callback(gstep)
+                        try:
+                            with span("stream.fence_callback", step=gstep):
+                                fence_callback(gstep)
+                        except Exception as cb_err:  # noqa: BLE001
+                            # a control-plane failure must not take the
+                            # training plane down with it: the fence's own
+                            # invariants (drain, ledger, manifest) already
+                            # held above, the callback's two-phase journal
+                            # keeps ITS work resumable, and nothing here
+                            # holds cv or leaves the ledger dirty — count
+                            # loudly and resume the stream. BaseException
+                            # (SimulatedCrash) still aborts like a kill.
+                            stats["fence_callback_errors"] = (
+                                stats.get("fence_callback_errors", 0) + 1
+                            )
+                            get_metrics().counter(
+                                "persia_tpu_stream_fence_callback_errors",
+                                "fence callbacks that raised (stream "
+                                "continued; callback journal holds the "
+                                "resume token)",
+                            ).inc()
+                            record_event(
+                                "stream.fence_callback_error", step=gstep,
+                                error=repr(cb_err),
+                            )
+                            logger.warning(
+                                "fence callback failed at step %d (stream "
+                                "continues): %s", gstep, cb_err,
+                            )
                 except BaseException as e:  # noqa: BLE001
                     errors.append(e)
         fence_done.set()

@@ -59,11 +59,17 @@ class AutoTierController:
         planner: PlacementPlanner,
         placements: Mapping[str, str],
         decay: float = 0.5,
+        arbiter=None,
     ):
         self.profiler = profiler
         self.planner = planner
         self.placements: Dict[str, str] = dict(placements)
         self.decay = float(decay)
+        # when attached, migrations route through the control-plane
+        # arbiter's topology lease as TIER intents (imported lazily at
+        # actuation time — a top-level autopilot import would cycle
+        # through the package __init__ back into this module)
+        self.arbiter = arbiter
         self.last_plan: Optional[TierPlan] = None
         m = get_metrics()
         self._m_migrations = m.counter(
@@ -102,11 +108,24 @@ class AutoTierController:
             s for s, (src, dst) in plan.migrations.items() if dst == TIER_PS
         )
         if to_cached or to_ps:
-            with span(
-                "tiering.migration", step=gstep,
-                to_cached=len(to_cached), to_ps=len(to_ps),
-            ):
-                ctx.apply_migration(to_cached=to_cached, to_ps=to_ps)
+            def _apply() -> Dict:
+                with span(
+                    "tiering.migration", step=gstep,
+                    to_cached=len(to_cached), to_ps=len(to_ps),
+                ):
+                    ctx.apply_migration(to_cached=to_cached, to_ps=to_ps)
+                return {}
+
+            if self.arbiter is not None:
+                from persia_tpu.autopilot import arbiter as arbitration
+
+                self.arbiter.run(arbitration.Intent(
+                    arbitration.INTENT_TIER, "tiering",
+                    lambda _abort_check: _apply(),
+                    label=f"{len(to_cached)}->cached {len(to_ps)}->ps",
+                ))
+            else:
+                _apply()
         self._m_migrations.inc(len(plan.migrations))
         record_event(
             "tiering.migrate", step=gstep,
@@ -141,6 +160,7 @@ def enable_auto_tier(
     fused_row_budget: int = 0,
     vocabs: Optional[Mapping[str, int]] = None,
     profiler_kwargs: Optional[Dict] = None,
+    arbiter=None,
 ) -> AutoTierController:
     """Wire auto-tiering onto a ``CachedTrainCtx``: build the profiler over
     every slot (cached groups in group order — their sketch indices stay
@@ -175,6 +195,7 @@ def enable_auto_tier(
     )
     placements = {s: TIER_CACHED for g in tier.groups for s in g.slots}
     placements.update({s: TIER_PS for s in tier.ps_slots})
-    ctrl = AutoTierController(profiler, planner, placements, decay=decay)
+    ctrl = AutoTierController(profiler, planner, placements, decay=decay,
+                              arbiter=arbiter)
     ctx.attach_auto_tier(ctrl)
     return ctrl

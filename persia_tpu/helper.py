@@ -468,6 +468,7 @@ class ServiceCtx:
         router=None,
         fault_hook=None,
         batch_advances=None,
+        abort_check=None,
     ) -> dict:
         """Live-reshard the PS tier to ``n_new`` replicas at a drained
         stream fence (the caller guarantees nothing is in flight). The new
@@ -479,7 +480,12 @@ class ServiceCtx:
         resumes via :meth:`resume_reshard` to a state bit-identical to an
         uninterrupted reshard. ``router`` (a ``ShardedLookup``) is swapped
         to the new ring at the imported boundary; ``fault_hook`` is the
-        chaos plane's injection point."""
+        chaos plane's injection point. ``abort_check`` (the arbiter's
+        preemption flag) lets a higher-priority intent roll the reshard
+        back at a phase boundary: the engine raises
+        ``elastic.ReshardAborted`` after the journaled rollback, and the
+        topology bookkeeping (grown joiners, replica count) is restored
+        to the old ring before the exception propagates."""
         from persia_tpu import elastic, jobstate
         from persia_tpu.embedding.hashing import uniform_splits
 
@@ -515,14 +521,19 @@ class ServiceCtx:
             elastic.prime_joiner(dests[i], opt, batch_advances)
         self.n_ps = max(old_n, n_new)
 
-        stats = elastic.execute_reshard(
-            plan, sources, dests, mgr,
-            fault_hook=fault_hook,
-            on_imported=self._ring_swapper(router, dests, splits),
-            extra_meta={"optimizer": opt_dict,
-                        "batch_advances": {str(k): int(v) for k, v in
-                                           (batch_advances or {}).items()}},
-        )
+        try:
+            stats = elastic.execute_reshard(
+                plan, sources, dests, mgr,
+                fault_hook=fault_hook,
+                on_imported=self._ring_swapper(router, dests, splits),
+                extra_meta={"optimizer": opt_dict,
+                            "batch_advances": {str(k): int(v) for k, v in
+                                               (batch_advances or {}).items()}},
+                abort_check=abort_check,
+            )
+        except elastic.ReshardAborted:
+            self._finalize_abort(plan)
+            raise
         self._finalize_reshard(plan, splits)
         stats["skew_splits"] = [int(x) for x in splits]
         return stats
@@ -548,20 +559,37 @@ class ServiceCtx:
         self.n_ps = plan.new_n
         self._publish_ring(splits)
 
-    def resume_reshard(self, job_state, *, router=None, fault_hook=None):
+    def _finalize_abort(self, plan) -> None:
+        """Post-``aborted`` topology bookkeeping: the fleet is back on the
+        OLD ring — joiners grown for the preempted plan are drained (their
+        imported arcs were released by the abort arm) and the replica
+        count restored. The ring was never republished, so there is
+        nothing to swap back."""
+        for i in range(plan.old_n, plan.new_n):
+            self.coord_client.deregister("parameter_server", i)
+            self.kill_ps(i)
+        self._ps_procs = self._ps_procs[: plan.old_n]
+        self.n_ps = plan.old_n
+
+    def resume_reshard(self, job_state, *, router=None, fault_hook=None,
+                       abort_check=None):
         """Re-enter a reshard interrupted by a SIGKILL — of a source PS, a
         dest PS, or the coordinating process itself. Restores dead replicas
         per the crash matrix (fence snapshot for sources mid-handoff, fresh
         + re-import for dests mid-handoff, post-import snapshot for dests
         mid-delete), then replays the recorded plan; every op the crashed
         run already applied dedupes against the PS apply-journal. Returns
-        the run stats, or None when there is nothing to resume."""
+        the run stats, or None when there is nothing to resume. A plan
+        recorded mid-abort (phase ``aborting``) re-enters the rollback arm
+        instead: dead survivors restore from the ``handoff`` manifest's
+        fence snapshots, the remaining arc releases replay (dedupe), and
+        the OLD topology is finalized."""
         from persia_tpu import elastic, jobstate
         from persia_tpu.embedding.optim import OptimizerConfig
 
         mgr = jobstate.coerce_manager(job_state)
         man = elastic.find_reshard_manifest(mgr)
-        if man is None or man.meta.get("phase") == "done":
+        if man is None or man.meta.get("phase") in ("done", "aborted"):
             return None
         plan = elastic.ReshardPlan.from_meta(man.meta)
         phase = man.meta["phase"]
@@ -572,7 +600,24 @@ class ServiceCtx:
         def dead(i: int) -> bool:
             return i >= len(self._ps_procs) or self._ps_procs[i].poll() is not None
 
-        if phase == "handoff":
+        if phase == "aborting":
+            # mid-rollback: survivors restore to their fence snapshot (the
+            # ``handoff`` manifest holds it); the replayed arc releases
+            # then apply as no-ops or dedupe either way. Joiners restart
+            # fresh only so the release RPCs land — _finalize_abort drains
+            # them right after.
+            hman = elastic.find_phase_manifest(mgr, "handoff", plan.base_id)
+            for i in range(plan.new_n):
+                if not dead(i):
+                    continue
+                if i < plan.old_n and hman is not None:
+                    self._ps_snapshots[i] = (
+                        elastic.source_snapshot(hman, i), opt_dict,
+                    )
+                    self.restart_ps(i, restore=True)
+                else:
+                    self.restart_ps(i, restore=False)
+        elif phase == "handoff":
             for i in range(plan.old_n):
                 if dead(i):
                     self._ps_snapshots[i] = (
@@ -603,12 +648,20 @@ class ServiceCtx:
             for i in range(plan.new_n)
         ]
         splits = plan.new_splits
-        stats = elastic.resume_reshard(
-            mgr, sources, dests, fault_hook=fault_hook,
-            on_imported=self._ring_swapper(router, dests, splits),
-        )
+        try:
+            stats = elastic.resume_reshard(
+                mgr, sources, dests, fault_hook=fault_hook,
+                on_imported=self._ring_swapper(router, dests, splits),
+                abort_check=abort_check,
+            )
+        except elastic.ReshardAborted:
+            self._finalize_abort(plan)
+            raise
         if stats is not None:
-            self._finalize_reshard(plan, splits)
+            if stats.get("aborted"):
+                self._finalize_abort(plan)
+            else:
+                self._finalize_reshard(plan, splits)
         return stats
 
     def _watch(self):

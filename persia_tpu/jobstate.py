@@ -150,7 +150,22 @@ def replication_journal_id(job_epoch: int, step: int, op_index: int) -> int:
     dedupe independently on a shared destination replica. ``op_index``
     numbers the hot signs of one refresh round (< 128)."""
     return handoff_journal_id(
-        make_journal_id(job_epoch, (step & 0x7FFFFFFF) | 0x80000000), op_index
+        make_journal_id(job_epoch, (step & 0x3FFFFFFF) | 0x80000000), op_index
+    )
+
+
+def abort_journal_id(job_epoch: int, step: int, op_index: int) -> int:
+    """Journal id for one reshard-ABORT rollback op (the journaled range
+    delete that releases a partially imported arc when a higher-priority
+    intent preempts an in-flight reshard; persia_tpu/elastic.py). Step
+    bits 30-31 are the namespace subspace tags — handoff ``00``, scrub
+    ``01``, replication ``10`` — and the abort family takes the last
+    combination, ``11``: a rollback delete at the same fence step dedupes
+    independently of the forward import it is undoing, which is what
+    makes the abort arm exactly-once under SIGKILL+resume. ``op_index``
+    numbers the rollback ops of one abort (< 128)."""
+    return handoff_journal_id(
+        make_journal_id(job_epoch, (step & 0x3FFFFFFF) | 0xC0000000), op_index
     )
 
 

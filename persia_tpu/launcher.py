@@ -289,8 +289,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     print("--reshard-ps needs --ps > 0", file=sys.stderr)
                     return 2
                 # operator CLI at job setup: the stream has not started, so
-                # the whole fleet is trivially drained here
-                stats = topo.reshard_ps(args.reshard_ps)  # persia-lint: disable=PROTO005
+                # the whole fleet is trivially drained here and no other
+                # control loop is live to contend for the arbiter lease
+                stats = topo.reshard_ps(args.reshard_ps)  # persia-lint: disable=PROTO005,CTRL002
                 print(f"PS tier resharded {args.ps} -> {args.reshard_ps}: "
                       f"{_json.dumps({k: v for k, v in stats.items() if k != 'skew_splits'})}",
                       flush=True)

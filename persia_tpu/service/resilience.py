@@ -170,6 +170,7 @@ class CircuitBreaker:
                 return True
             if self._state == _STATE_HALF_OPEN and not self._probe_inflight:
                 self._probe_inflight = True
+                self._record("breaker.probe")
                 return True
             return False
 
@@ -184,8 +185,10 @@ class CircuitBreaker:
             self._failures = 0
             self._probe_inflight = False
             if self._state != _STATE_CLOSED:
+                prior = self._state
                 self._state = _STATE_CLOSED
                 self._m_state.set(0, endpoint=self.endpoint)
+                self._record("breaker.close", prior_state=prior)
 
     def on_failure(self) -> None:
         with self._lock:
@@ -206,13 +209,17 @@ class CircuitBreaker:
                 self._open_until = time.monotonic() + self.reset_timeout_s
                 self._m_state.set(1, endpoint=self.endpoint)
 
-    def _record_trip(self, cause: str) -> None:
-        """Every closed→open transition lands in the flight recorder,
-        stamped with the trace that pushed the endpoint over (if any)."""
+    def _record(self, kind: str, **attrs) -> None:
+        """Every breaker state transition lands in the flight recorder —
+        trips, half-open probe grants, and re-closes — stamped with the
+        trace that drove it (if any)."""
         from persia_tpu import tracing
 
-        tracing.record_event("breaker.trip", endpoint=self.endpoint,
-                             cause=cause, trips=self.trips)
+        tracing.record_event(kind, endpoint=self.endpoint,
+                             trips=self.trips, **attrs)
+
+    def _record_trip(self, cause: str) -> None:
+        self._record("breaker.trip", cause=cause)
 
     def force_open(self) -> None:
         """Administrative open (the gateway's mark-down on a failed health

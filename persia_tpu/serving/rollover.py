@@ -61,8 +61,13 @@ class ModelRollover:
         poll_interval_s: float = 2.0,
         inc_scan_interval_s: Optional[float] = None,
         policy: Optional[ResiliencePolicy] = None,
+        arbiter=None,
     ):
         self.engine = engine
+        # when attached, the version swap routes through the control-plane
+        # arbiter's topology lease as a ROLLOVER intent — the load half
+        # (storage reads, deserialization) stays off-lease on this thread
+        self.arbiter = arbiter
         self.root = storage_path(ckpt_dir) if ckpt_dir is not None else None
         self.cache = cache
         self.poll_interval_s = poll_interval_s
@@ -216,8 +221,21 @@ class ModelRollover:
                 )
         self._seen_session = session
         self._m_version_ts.set(float(info.get("time_us", 0)))
-        self.engine.swap(clone_infer_ctx(self.engine.ctx, self._new_state),
-                         version=session)
+        new_ctx = clone_infer_ctx(self.engine.ctx, self._new_state)
+        if self.arbiter is not None:
+            from persia_tpu.autopilot import arbiter as arbitration
+
+            self.arbiter.run(arbitration.Intent(
+                arbitration.INTENT_ROLLOVER, "rollover",
+                # swap returns the PRIOR version string (truthy!) — wrap it,
+                # the arbiter coerces the execute result to a dict
+                lambda _abort_check: {
+                    "prior": self.engine.swap(new_ctx, version=session),
+                },
+                label=f"session {session}",
+            ))
+        else:
+            self.engine.swap(new_ctx, version=session)
 
     # --------------------------------------------------------------- thread
 

@@ -48,6 +48,12 @@ fi
 # committed PROTO_COVERAGE.json (validated here via PROTO006 above and
 # test_committed_coverage_is_complete) proves ALL of them ran.
 JAX_PLATFORMS=cpu python -m pytest tests/test_protocol.py -q -m 'not slow'
+# control-plane lease lint (ISSUE 20): CTRL002 pinned fixtures — the
+# unleased fixture must fire on every direct actuator call, the leased /
+# suppressed fixture must stay clean, and the mechanism layer (files
+# DEFINING an actuator) stays exempt. Keeps the arbiter's single
+# topology-actuation lease enforceable as a static contract.
+JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q -k "ctrl002 or ctrl_"
 # force=True recompile of every core: the stamp cache must not mask a
 # toolchain or source breakage
 JAX_PLATFORMS=cpu python - <<'PY'

@@ -1,0 +1,32 @@
+"""CTRL002 clean fixture: the same actuations, under the arbiter lease."""
+
+
+def leased_fence_hook(arbiter, svc, mgr, gstep):
+    # clean: the actuation is an Intent; the arbiter holds the single
+    # topology lease and runs it at the right priority
+    from persia_tpu.autopilot.arbiter import INTENT_RESHARD, Intent
+
+    return arbiter.run(Intent(
+        INTENT_RESHARD, "fixture",
+        lambda abort_check: svc.reshard_ps(
+            4, mgr, step=gstep, abort_check=abort_check),
+        key="ps_topology", preemptable=True,
+    ))
+
+
+def leased_wrapper(arbiter, ctx, to_cached, to_ps):
+    # clean: the leased-wrapper pattern — the outer function carries the
+    # arbiter evidence, the inner closure calls the actuator
+    def _apply(_abort_check):
+        ctx.apply_migration(to_cached=to_cached, to_ps=to_ps)
+        return {}
+
+    from persia_tpu.autopilot.arbiter import INTENT_TIER, Intent
+
+    return arbiter.run(Intent(INTENT_TIER, "fixture", _apply))
+
+
+def suppressed_operator_action(svc, mgr):
+    # clean only via the explicit inline disable (the launcher's
+    # setup-time reshard pattern: nothing else is live yet)
+    return svc.reshard_ps(4, mgr)  # persia-lint: disable=CTRL002

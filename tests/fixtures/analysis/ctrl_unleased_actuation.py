@@ -1,0 +1,18 @@
+"""CTRL002 fixture: direct topology actuation outside the arbiter lease."""
+
+
+def rogue_fence_hook(svc, mgr, gstep):
+    # fires: a control-plane hook calling the actuator directly instead of
+    # submitting an Intent — bypasses serialization/preemption/suppression
+    return svc.reshard_ps(4, mgr, step=gstep)
+
+
+def rogue_heal(svc, victim):
+    # fires: both heal actuators called straight off a verdict
+    svc.heal_promote(victim, {})
+    svc.heal_drain_gray(victim, {})
+
+
+def rogue_tier_move(ctx, to_cached, to_ps):
+    # fires: tier migration applied with no intent submitted
+    ctx.apply_migration(to_cached=to_cached, to_ps=to_ps)

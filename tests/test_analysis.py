@@ -628,6 +628,47 @@ def test_ctrl001_skips_test_files():
     assert findings == []
 
 
+def test_ctrl002_unleased_actuation_fires():
+    from persia_tpu.analysis import control_lint
+
+    findings = control_lint.check_source_lease(
+        read_text(_fixture("ctrl_unleased_actuation.py")),
+        "ctrl_unleased_actuation.py",
+    )
+    # the direct reshard, both heal actuators, and the tier move all fire
+    assert [f.rule for f in findings] == ["CTRL002"] * 4, findings
+    assert {"reshard_ps", "heal_promote", "heal_drain_gray",
+            "apply_migration"} == {
+        f.message.split("(")[1].split(")")[0] for f in findings
+    }
+
+
+def test_ctrl002_leased_and_suppressed_stay_clean():
+    from persia_tpu.analysis import control_lint
+    from persia_tpu.analysis.common import apply_suppressions as sup
+
+    src = read_text(_fixture("ctrl_leased_actuation.py"))
+    raw = control_lint.check_source_lease(src, "ctrl_leased_actuation.py")
+    # only the explicitly suppressed operator action remains raw — the
+    # intent submit and the leased-wrapper closure both carry evidence
+    assert [f.rule for f in raw] == ["CTRL002"], raw
+    assert sup(raw, {"ctrl_leased_actuation.py": src}) == []
+
+
+def test_ctrl002_mechanism_layer_is_exempt():
+    from persia_tpu.analysis import control_lint
+
+    # a file that IMPLEMENTS an actuator is the mechanism layer: its
+    # internal delegation (promote calling replace_replica, resume
+    # calling swap_topology) runs below the lease by construction
+    src = (
+        "def heal_promote(self, victim, advances):\n"
+        "    self.router.replace_replica(victim, object())\n"
+        "    return 'addr'\n"
+    )
+    assert control_lint.check_source_lease(src, "helperish.py") == []
+
+
 # ------------------------------------------------------------- clean tree
 
 

@@ -1,0 +1,344 @@
+"""Control-plane arbiter (persia_tpu/autopilot/arbiter.py).
+
+The arbiter holds the single topology-actuation lease. These tests pin
+its whole contract: strict serialization (max_concurrent stays 1 under
+contention), priority-ordered granting, journaled preemption of a
+preemptable holder by a strictly-higher-priority intent, cross-loop flap
+suppression inside the dwell window (and every carve-out: same source,
+HEAL priority, expired dwell, direction-less intents), the aborted-
+actuation exclusion from the flap ledger, the ``accepts_abort`` actuator
+probe, and the exported state/flight-recorder events the soak bench
+certifies against.
+"""
+
+import threading
+import time
+
+from persia_tpu import tracing
+from persia_tpu.autopilot.arbiter import (
+    INTENT_HEAL_DEAD,
+    INTENT_HEAL_GRAY,
+    INTENT_RESHARD,
+    INTENT_ROLLOVER,
+    INTENT_SCRUB,
+    INTENT_TIER,
+    PRIORITY,
+    Arbiter,
+    Intent,
+    accepts_abort,
+)
+
+
+def _intent(kind, source="test", execute=None, **kw):
+    return Intent(kind=kind, source=source,
+                  execute=execute or (lambda abort: {"ok": True}), **kw)
+
+
+# ---------------------------------------------------------------- priority
+
+
+def test_priority_table_matches_operator_doc():
+    # the README operator table promises this exact ordering; a silent
+    # renumbering would invert who preempts whom
+    assert PRIORITY[INTENT_HEAL_DEAD] < PRIORITY[INTENT_HEAL_GRAY]
+    assert PRIORITY[INTENT_HEAL_GRAY] < PRIORITY[INTENT_SCRUB]
+    assert PRIORITY[INTENT_SCRUB] < PRIORITY[INTENT_RESHARD]
+    assert PRIORITY[INTENT_RESHARD] < PRIORITY[INTENT_TIER]
+    assert PRIORITY[INTENT_TIER] < PRIORITY[INTENT_ROLLOVER]
+
+
+def test_queued_intents_grant_in_priority_order():
+    arb = Arbiter()
+    order = []
+    release = threading.Event()
+    queued = threading.Barrier(4)
+
+    def blocker(abort):
+        release.wait(5.0)
+        return {"ok": True}
+
+    t0 = threading.Thread(
+        target=arb.run, args=(_intent(INTENT_TIER, execute=blocker),))
+    t0.start()
+    while arb.export_state()["active"] != 1:
+        time.sleep(0.005)
+
+    def submit(kind):
+        def ex(abort):
+            order.append(kind)
+            return {"ok": True}
+        queued.wait(5.0)
+        arb.run(_intent(kind, execute=ex))
+
+    threads = [threading.Thread(target=submit, args=(k,))
+               for k in (INTENT_ROLLOVER, INTENT_RESHARD, INTENT_HEAL_DEAD)]
+    for t in threads:
+        t.start()
+    queued.wait(5.0)  # all three submitters past the barrier together
+    while arb.export_state()["queued"] != 3:
+        time.sleep(0.005)
+    release.set()
+    for t in threads:
+        t.join(5.0)
+    t0.join(5.0)
+    assert order == [INTENT_HEAL_DEAD, INTENT_RESHARD, INTENT_ROLLOVER]
+
+
+def test_lease_serializes_concurrent_intents():
+    arb = Arbiter()
+    active = []
+    lock = threading.Lock()
+
+    def ex(abort):
+        with lock:
+            active.append(1)
+            assert sum(active) == 1
+        time.sleep(0.01)
+        with lock:
+            active.pop()
+        return {"ok": True}
+
+    threads = [
+        threading.Thread(
+            target=arb.run,
+            args=(_intent(INTENT_TIER, source=f"s{i}", execute=ex),))
+        for i in range(6)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10.0)
+    st = arb.export_state()
+    assert st["grants"] == 6
+    assert st["max_concurrent"] == 1
+    assert st["active"] == 0 and st["queued"] == 0
+
+
+# -------------------------------------------------------------- preemption
+
+
+def test_higher_priority_intent_preempts_preemptable_holder():
+    arb = Arbiter()
+    holder_running = threading.Event()
+    saw_abort = threading.Event()
+
+    def slow_reshard(abort_check):
+        holder_running.set()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if abort_check():
+                saw_abort.set()
+                return {"aborted": True}
+            time.sleep(0.005)
+        return {"ok": True}
+
+    res = {}
+    t = threading.Thread(target=lambda: res.update(arb.run(_intent(
+        INTENT_RESHARD, source="autopilot", execute=slow_reshard,
+        key="ps_topology", direction="grow", preemptable=True))))
+    t.start()
+    assert holder_running.wait(5.0)
+    heal = arb.run(_intent(INTENT_HEAL_DEAD, source="healer"))
+    t.join(5.0)
+    assert saw_abort.is_set()
+    assert res == {"aborted": True}
+    assert heal == {"ok": True}
+    st = arb.export_state()
+    assert st["preemptions"] == 1
+    assert st["preempted_rollbacks"] == 1
+
+
+def test_equal_or_lower_priority_never_preempts():
+    arb = Arbiter()
+    holder_running = threading.Event()
+    release = threading.Event()
+    aborts = []
+
+    def holder(abort_check):
+        holder_running.set()
+        release.wait(5.0)
+        aborts.append(abort_check())
+        return {"ok": True}
+
+    t = threading.Thread(target=arb.run, args=(_intent(
+        INTENT_SCRUB, source="scrubber", execute=holder,
+        preemptable=True),))
+    t.start()
+    assert holder_running.wait(5.0)
+    t2 = threading.Thread(target=arb.run, args=(_intent(
+        INTENT_SCRUB, source="other"),))
+    t3 = threading.Thread(target=arb.run, args=(_intent(
+        INTENT_TIER, source="tierer"),))
+    t2.start()
+    t3.start()
+    while arb.export_state()["queued"] != 2:
+        time.sleep(0.005)
+    release.set()
+    for th in (t, t2, t3):
+        th.join(5.0)
+    assert aborts == [False]
+    assert arb.export_state()["preemptions"] == 0
+
+
+def test_non_preemptable_holder_is_not_flagged():
+    arb = Arbiter()
+    holder_running = threading.Event()
+    aborts = []
+
+    def holder(abort_check):
+        holder_running.set()
+        time.sleep(0.15)  # give the heal intent time to queue up
+        aborts.append(abort_check())
+        return {"ok": True}
+
+    t = threading.Thread(target=arb.run, args=(_intent(
+        INTENT_RESHARD, source="autopilot", execute=holder,
+        preemptable=False),))
+    t.start()
+    assert holder_running.wait(5.0)
+    arb.run(_intent(INTENT_HEAL_DEAD, source="healer"))
+    t.join(5.0)
+    assert aborts == [False]
+    assert arb.export_state()["preemptions"] == 0
+
+
+def test_aborted_actuation_stays_out_of_flap_ledger():
+    # a rolled-back grow must NOT suppress the next shrink: the fleet
+    # never actually grew
+    arb = Arbiter(dwell_s=300.0)
+    holder_running = threading.Event()
+
+    def preempted_grow(abort_check):
+        holder_running.set()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if abort_check():
+                return {"aborted": True}
+            time.sleep(0.005)
+        return {"ok": True}
+
+    t = threading.Thread(target=arb.run, args=(_intent(
+        INTENT_RESHARD, source="autopilot", execute=preempted_grow,
+        key="ps_topology", direction="grow", preemptable=True),))
+    t.start()
+    assert holder_running.wait(5.0)
+    arb.run(_intent(INTENT_HEAL_DEAD, source="healer"))
+    t.join(5.0)
+    out = arb.run(_intent(INTENT_RESHARD, source="healer",
+                          key="ps_topology", direction="shrink"))
+    assert out == {"ok": True}
+    assert arb.export_state()["suppressed_flaps"] == 0
+
+
+# ------------------------------------------------------- flap suppression
+
+
+def _fake_clock():
+    state = {"t": 1000.0}
+
+    def clock():
+        return state["t"]
+
+    return clock, state
+
+
+def test_opposite_direction_from_other_loop_is_suppressed():
+    clock, state = _fake_clock()
+    arb = Arbiter(dwell_s=30.0, clock=clock)
+    arb.run(_intent(INTENT_RESHARD, source="healer",
+                    key="ps_topology", direction="grow"))
+    state["t"] += 10.0
+    out = arb.run(_intent(INTENT_RESHARD, source="autopilot",
+                          key="ps_topology", direction="shrink"))
+    assert out["suppressed"] is True
+    assert out["undoes"] == "healer"
+    assert arb.export_state()["suppressed_flaps"] == 1
+    assert arb.export_state()["grants"] == 1
+
+
+def test_same_source_may_reverse_itself():
+    clock, _ = _fake_clock()
+    arb = Arbiter(dwell_s=30.0, clock=clock)
+    arb.run(_intent(INTENT_RESHARD, source="autopilot",
+                    key="ps_topology", direction="grow"))
+    out = arb.run(_intent(INTENT_RESHARD, source="autopilot",
+                          key="ps_topology", direction="shrink"))
+    assert out == {"ok": True}
+    assert arb.export_state()["suppressed_flaps"] == 0
+
+
+def test_heal_is_never_flap_suppressed():
+    clock, _ = _fake_clock()
+    arb = Arbiter(dwell_s=30.0, clock=clock)
+    arb.run(_intent(INTENT_RESHARD, source="autopilot",
+                    key="ps_topology", direction="grow"))
+    out = arb.run(_intent(INTENT_HEAL_GRAY, source="healer",
+                          key="ps_topology", direction="shrink"))
+    assert out == {"ok": True}
+    assert arb.export_state()["suppressed_flaps"] == 0
+
+
+def test_dwell_expiry_lifts_suppression():
+    clock, state = _fake_clock()
+    arb = Arbiter(dwell_s=30.0, clock=clock)
+    arb.run(_intent(INTENT_RESHARD, source="healer",
+                    key="ps_topology", direction="grow"))
+    state["t"] += 31.0
+    out = arb.run(_intent(INTENT_RESHARD, source="autopilot",
+                          key="ps_topology", direction="shrink"))
+    assert out == {"ok": True}
+    assert arb.export_state()["suppressed_flaps"] == 0
+
+
+def test_directionless_intents_are_never_suppressed():
+    clock, _ = _fake_clock()
+    arb = Arbiter(dwell_s=30.0, clock=clock)
+    arb.run(_intent(INTENT_RESHARD, source="healer",
+                    key="ps_topology", direction="grow"))
+    # a resplit at the same n carries no direction; a rollover has no key
+    assert arb.run(_intent(INTENT_RESHARD, source="autopilot",
+                           key="ps_topology")) == {"ok": True}
+    assert arb.run(_intent(INTENT_ROLLOVER, source="serving")) == {"ok": True}
+    assert arb.export_state()["suppressed_flaps"] == 0
+
+
+# ----------------------------------------------------- errors, events, misc
+
+
+def test_execute_exception_releases_lease_and_propagates():
+    arb = Arbiter()
+
+    def boom(abort):
+        raise RuntimeError("actuator died")
+
+    try:
+        arb.run(_intent(INTENT_TIER, execute=boom))
+        raise AssertionError("expected RuntimeError")
+    except RuntimeError:
+        pass
+    # lease released: the next intent runs immediately
+    assert arb.run(_intent(INTENT_TIER)) == {"ok": True}
+    st = arb.export_state()
+    assert st["active"] == 0 and st["grants"] == 2
+
+
+def test_grant_release_events_land_in_flight_recorder():
+    tracing.flight_clear()
+    arb = Arbiter()
+    arb.run(_intent(INTENT_TIER, source="tierer", label="fence-12"))
+    kinds = [e["kind"] for e in tracing.flight_snapshot()
+             if e["kind"].startswith("arbiter.")]
+    assert kinds == ["arbiter.grant", "arbiter.release"]
+    events = {e["kind"]: e["attrs"] for e in tracing.flight_snapshot()
+              if e["kind"].startswith("arbiter.")}
+    assert events["arbiter.grant"]["source"] == "tierer"
+    assert events["arbiter.grant"]["label"] == "fence-12"
+    assert events["arbiter.release"]["preempted"] == "False"
+    tracing.flight_clear()
+
+
+def test_accepts_abort_probe():
+    assert accepts_abort(lambda abort_check=None: None)
+    assert accepts_abort(lambda **kw: None)
+    assert not accepts_abort(lambda n_new: None)
+    assert not accepts_abort(lambda: None)

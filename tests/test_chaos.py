@@ -40,6 +40,99 @@ def test_chaos_spec_parse():
         parse_chaos_spec("warp=0.5")
 
 
+# --------------------------------------------- resilience primitives
+
+
+def test_retry_jitter_replays_deterministically_across_threads():
+    """The seeded-jitter contract under concurrency: two policies with the
+    same seed sleep the same sequence, and when N threads share ONE
+    policy the interleaving may permute which caller gets which draw but
+    the multiset of sleeps is the seeded sequence exactly — no draw
+    lost, duplicated, or torn by a race on the shared RNG."""
+    import threading
+
+    mk = lambda: RetryPolicy(  # noqa: E731 - four identical policies
+        max_attempts=4, base_s=0.01, multiplier=2.0, max_s=0.08,
+        jitter=0.5, seed=7)
+    n_threads, per_thread = 8, 8
+    n = n_threads * per_thread
+    ref_pol, replay_pol = mk(), mk()
+    ref = [ref_pol.backoff(1) for _ in range(n)]
+    assert [replay_pol.backoff(1) for _ in range(n)] == ref
+
+    pol = mk()
+    out: list = []
+    lock = threading.Lock()
+
+    def worker():
+        mine = [pol.backoff(1) for _ in range(per_thread)]
+        with lock:
+            out.extend(mine)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(out) == n
+    assert sorted(out) == sorted(ref)
+    # and every sleep respects the jitter envelope [d/2, d]
+    d = min(0.01 * 2.0, 0.08)
+    assert all(d * 0.5 <= s <= d for s in out)
+
+
+def test_open_breaker_fails_fast_without_consuming_deadline_budget():
+    """Deadline.cap composed with an OPEN breaker: the fail-fast path
+    must not burn the caller's time budget — no socket, no backoff
+    sleep. A later attempt (healthy peer) still gets nearly the whole
+    budget from cap()."""
+    from persia_tpu.service.resilience import CircuitBreaker, Deadline
+
+    b = CircuitBreaker("dead:1", failure_threshold=1, reset_timeout_s=30.0)
+    b.on_failure()
+    assert b.state == "open" and b.trips == 1
+
+    d = Deadline(0.5)
+    t0 = time.monotonic()
+    for _ in range(200):
+        assert not b.allow()  # fail-fast: no probe slot while open
+    assert time.monotonic() - t0 < 0.1
+    # the budget survived the open-circuit storm
+    assert d.cap(None) > 0.3
+    assert d.cap(10.0) > 0.3
+    assert d.cap(0.05) == pytest.approx(0.05)
+    assert not d.expired
+    d.check("healthy attempt")  # must not raise
+
+
+def test_breaker_transitions_land_in_flight_recorder():
+    """Satellite: trips, half-open probe grants, and re-closes are all
+    record_event spans the flight recorder captures."""
+    from persia_tpu import tracing
+    from persia_tpu.service.resilience import CircuitBreaker
+
+    tracing.flight_clear()
+    b = CircuitBreaker("ep:9", failure_threshold=2, reset_timeout_s=0.05)
+    b.on_failure()
+    b.on_failure()  # second consecutive failure trips closed->open
+    assert b.state == "open"
+    time.sleep(0.06)  # reset window elapses -> half-open
+    assert b.allow()  # consumes (and records) the one half-open probe
+    assert not b.allow()  # probe slot taken
+    b.on_success()  # probe succeeded: half_open -> closed
+    assert b.state == "closed"
+    kinds = [e["kind"] for e in tracing.flight_snapshot()
+             if e["kind"].startswith("breaker.")]
+    assert kinds == ["breaker.trip", "breaker.probe", "breaker.close"]
+    events = {e["kind"]: e["attrs"] for e in tracing.flight_snapshot()
+              if e["kind"].startswith("breaker.")}
+    assert events["breaker.trip"]["endpoint"] == "ep:9"
+    assert events["breaker.trip"]["cause"] == "failure"
+    assert events["breaker.probe"]["trips"] == "1"
+    assert events["breaker.close"]["prior_state"] == "half_open"
+    tracing.flight_clear()
+
+
 # ---------------------------------------------------------------- proxy
 
 

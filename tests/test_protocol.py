@@ -7,16 +7,19 @@ Three layers under test:
   clean on the real tree, the reach() transition set matches the shipped
   protocols, and the committed ``PROTO_COVERAGE.json`` proves every
   transition was killed at least once.
-- **Namespace prover**: the four shipped journal-id families (gradient,
-  handoff, replication, scrub) are bit-affine and pairwise disjoint, with
-  the exact separating-bit witnesses pinned; overlapping constructors are
-  detected.
+- **Namespace prover**: the five shipped journal-id families (gradient,
+  handoff, replication, scrub, abort) are bit-affine and pairwise
+  disjoint, with the exact separating-bit witnesses pinned; overlapping
+  constructors are detected.
 - **Crash matrices**: every ``reach()`` point enumerated from one
   uninterrupted run of each protocol is killed once
   (:class:`crashcheck.SimulatedCrash`), the protocol resumes, and the
   resumed end state must equal the uninterrupted state. Fast subset:
   jobstate fence, scrub record, healer promotion. Slow markers: the 2->4
-  reshard and the autopilot drive.
+  reshard, the autopilot drive, and the three preemption (abort-arm)
+  matrices — a preempted ring→ring reshard rolled back mid-flight, and
+  the autopilot/healer drives whose actuation the arbiter aborts
+  (PROTO007: every abort transition killed at least once).
 
 ``python tests/test_protocol.py --write-coverage`` runs ALL matrices
 (fast + slow) and writes the repo-root ``PROTO_COVERAGE.json`` the
@@ -34,7 +37,7 @@ from persia_tpu.analysis.common import REPO_ROOT
 from persia_tpu.autopilot.controller import Autopilot
 from persia_tpu.autopilot.heal import ACTION_PROMOTE, ACTION_RESIZE, Healer
 from persia_tpu.autopilot.policy import KIND_HEAL, Decision, PolicyEngine
-from persia_tpu.embedding.hashing import uniform_splits
+from persia_tpu.embedding.hashing import sign_to_range_shard, uniform_splits
 from persia_tpu.embedding.optim import Adagrad
 from persia_tpu.embedding.store import EmbeddingStore
 from persia_tpu.health.scrub import SCRUB_CRC, scrub_journal_id, scrub_store
@@ -76,9 +79,12 @@ def test_reach_sites_match_shipped_protocols():
         "elastic.phase.handoff", "elastic.op.import",
         "elastic.phase.imported", "elastic.swap", "elastic.op.delete",
         "elastic.phase.done",
+        "elastic.phase.aborting", "elastic.op.abort_release",
+        "elastic.phase.aborted",
         "autopilot.phase.planned", "autopilot.actuate",
-        "autopilot.phase.done",
+        "autopilot.phase.done", "autopilot.phase.aborted",
         "heal.phase.planned", "heal.actuate", "heal.phase.done",
+        "heal.phase.aborted",
         "scrub.record",
     }
     # every site resolves to a real (path, line)
@@ -98,11 +104,11 @@ def test_proto_rules_clean_on_real_tree():
     findings, cov = run_all(rules=["PROTO"])
     assert findings == [], [str(f) for f in findings]
     pcov = cov["protocol"]
-    assert pcov["reach_sites"] >= 16
+    assert pcov["reach_sites"] >= 21
     assert pcov["phase_writers"] >= 2  # autopilot + healer _commit shapes
-    assert pcov["phase_sites"] >= 6
-    assert pcov["pairs_total"] == 6
-    assert pcov["pairs_disjoint"] == 6
+    assert pcov["phase_sites"] >= 8
+    assert pcov["pairs_total"] == 10
+    assert pcov["pairs_disjoint"] == 10
 
 
 def test_committed_coverage_is_complete():
@@ -118,6 +124,11 @@ def test_committed_coverage_is_complete():
     for newly in ("jobstate.commit.pointer", "elastic.phase.handoff",
                   "scrub.record", "elastic.swap"):
         assert data["sites"][newly]["kills"] >= 1, newly
+    # PROTO007: every abort (preemption-rollback) transition is killed
+    for abort_site in ("elastic.phase.aborting", "elastic.op.abort_release",
+                       "elastic.phase.aborted", "autopilot.phase.aborted",
+                       "heal.phase.aborted"):
+        assert data["sites"][abort_site]["kills"] >= 1, abort_site
 
 
 # ========================================================== namespace prover
@@ -141,20 +152,24 @@ def test_probe_bits_exact_masks_and_affinity():
 
 
 def test_shipped_id_families_pairwise_disjoint():
-    """Satellite (c): the four shipped constructors proven disjoint with
+    """Satellite (c): the five shipped constructors proven disjoint with
     the exact bit-interval witnesses pinned."""
     proof = protocol.prove_namespaces()
     assert set(proof["patterns"]) == {
-        "gradient", "handoff", "replication", "scrub"}
+        "gradient", "handoff", "replication", "scrub", "abort"}
     for fam, pat in proof["patterns"].items():
         assert pat.affine, fam
     assert proof["pairs"] == {
         ("gradient", "handoff"): 7,       # handoff's 0x80 low-byte tag
         ("gradient", "replication"): 7,
         ("gradient", "scrub"): 7,
+        ("gradient", "abort"): 7,
         ("handoff", "replication"): 39,   # replication's step bit 31
         ("handoff", "scrub"): 38,         # scrub's step bit 30
+        ("handoff", "abort"): 38,         # abort tags BOTH step bits (11)
         ("replication", "scrub"): 38,
+        ("replication", "abort"): 38,     # replication keeps bit 30 zero
+        ("scrub", "abort"): 39,           # scrub keeps bit 31 zero
     }
     # witness semantics: bit 7 is fixed-one for handoff, fixed-zero for
     # gradient (replica indices < 0x80 by the journal_shard_id guard)
@@ -163,6 +178,11 @@ def test_shipped_id_families_pairwise_disjoint():
     s, r = proof["patterns"]["scrub"], proof["patterns"]["replication"]
     assert (s.fixed_one >> 38) & 1 and (r.fixed_zero >> 38) & 1
     assert (r.fixed_one >> 39) & 1 and (s.fixed_zero >> 39) & 1
+    # the abort family owns the 11 corner of the step-tag plane: both
+    # tag bits fixed-one, so every other family has a separating bit
+    a = proof["patterns"]["abort"]
+    assert (a.fixed_one >> 38) & 1 and (a.fixed_one >> 39) & 1
+    assert (a.fixed_one >> 7) & 1  # rides the handoff low-byte tag too
 
 
 def test_scrub_ids_disjoint_from_handoff_ids():
@@ -570,12 +590,295 @@ def test_autopilot_crash_matrix(tmp_path):
     assert cov.kills["jobstate.commit.pointer"] == 2
 
 
+# ===================================== preemption (abort-arm) crash matrices
+
+
+def _abort_setup():
+    """Ring→ring 2→4 fleet: abortable by construction (``plan.abortable``),
+    sources populated per their OWN ring arc so the rollback's range
+    releases restore exactly the pristine fleet."""
+    old = uniform_splits(2)
+    srcs = [_mk_store(), _mk_store()]
+    owner = sign_to_range_shard(SIGNS, old)
+    for r, st in enumerate(srcs):
+        st.lookup(SIGNS[owner == r], DIM, True)
+    dests = list(srcs) + [_mk_store(), _mk_store()]
+    return (srcs, dests, [int(x) for x in old],
+            [int(x) for x in uniform_splits(4)])
+
+
+def _mk_abort_plan(old_s, new_s, epoch, step=0):
+    plan = elastic.plan_reshard(2, 4, old_s, new_s,
+                                jobstate.make_journal_id(epoch, step))
+    assert plan.abortable
+    return plan
+
+
+def _post_import_preempt():
+    """Preemption flag that arrives while the import wave runs: the first
+    boundary poll passes, the second (post-import) aborts — so the
+    rollback has real imported arcs to release."""
+    polls = {"n": 0}
+
+    def check():
+        polls["n"] += 1
+        return polls["n"] > 1
+
+    return check
+
+
+def test_reshard_abort_rolls_back_to_pristine_ring(tmp_path):
+    """Fast smoke of the journaled ABORT arm: a post-import preemption
+    releases every imported arc and leaves the fleet bit-identical to the
+    pristine ring, under a terminal ``aborted`` manifest."""
+    srcs, dests, old_s, new_s = _abort_setup()
+    ref0 = _fleet_state(dests)
+    with pytest.raises(elastic.ReshardAborted) as ei:
+        elastic.execute_reshard(
+            _mk_abort_plan(old_s, new_s, 1), srcs, dests,
+            str(tmp_path / "js"), abort_check=_post_import_preempt())
+    stats = ei.value.stats
+    assert stats["aborted"] and stats["imports_applied"] > 0
+    assert stats["aborts_applied"] == len(_mk_abort_plan(old_s, new_s, 1).moves)
+    assert _fleet_state(dests) == ref0
+    mgr = jobstate.coerce_manager(str(tmp_path / "js"))
+    assert elastic.find_reshard_manifest(mgr).meta["phase"] == "aborted"
+    assert elastic.resume_reshard(str(tmp_path / "js"), srcs, dests) is None
+
+
+def run_abort_matrix(base) -> crashcheck.Coverage:
+    srcs, dests, old_s, new_s = _abort_setup()
+    ref0 = _fleet_state(dests)  # the pristine ring an abort must restore
+    with pytest.raises(elastic.ReshardAborted) as ei:
+        elastic.execute_reshard(
+            _mk_abort_plan(old_s, new_s, 1), srcs, dests,
+            os.path.join(str(base), "ref"), abort_check=_post_import_preempt())
+    assert ei.value.stats["aborted"]
+    assert _fleet_state(dests) == ref0
+
+    srcs, dests, old_s, new_s = _abort_setup()
+
+    def _rec():
+        with pytest.raises(elastic.ReshardAborted):
+            elastic.execute_reshard(
+                _mk_abort_plan(old_s, new_s, 1), srcs, dests,
+                os.path.join(str(base), "rec"),
+                abort_check=_post_import_preempt())
+
+    points = _enumerate(_rec)
+    for site in ("elastic.phase.handoff", "elastic.op.import",
+                 "elastic.phase.aborting", "elastic.op.abort_release",
+                 "elastic.phase.aborted"):
+        assert any(p[0] == site for p in points), site
+
+    cov = crashcheck.Coverage()
+    for k, (site, occ) in enumerate(points):
+        srcs, dests, old_s, new_s = _abort_setup()
+        plan = _mk_abort_plan(old_s, new_s, 1)
+        js = os.path.join(str(base), f"run{k}")
+        check = _post_import_preempt()
+
+        def _attempt():
+            try:
+                elastic.execute_reshard(plan, srcs, dests, js,
+                                        abort_check=check)
+            except elastic.ReshardAborted:
+                pass
+
+        with crashcheck.crash_at(site, occ):
+            assert _crashed(_attempt), (site, occ)
+        cov.add_kill("abort", site)
+        # the coordinator died mid-preemption; at restart the preempting
+        # intent is still queued, so the arbiter re-raises the abort and
+        # the check rides the resume. Killed before the handoff manifest
+        # was durable -> nothing recorded -> the preempted plan re-executes.
+        def _resume():
+            try:
+                return elastic.resume_reshard(js, srcs, dests,
+                                              abort_check=lambda: True)
+            except elastic.ReshardAborted as e:
+                return e.stats
+
+        stats = _resume()
+        if stats is None:
+            try:
+                elastic.execute_reshard(plan, srcs, dests, js,
+                                        abort_check=lambda: True)
+                raise AssertionError("re-executed preempted plan must abort")
+            except elastic.ReshardAborted as e:
+                stats = e.stats
+        assert stats["aborted"], (site, occ)
+        assert _fleet_state(dests) == ref0, (site, occ)
+        mgr = jobstate.coerce_manager(js)
+        assert elastic.find_reshard_manifest(mgr).meta["phase"] == "aborted"
+        assert elastic.resume_reshard(js, srcs, dests) is None
+    return cov
+
+
+@pytest.mark.slow
+def test_abort_crash_matrix(tmp_path):
+    cov = run_abort_matrix(tmp_path)
+    assert cov.kills["elastic.phase.aborting"] == 1
+    assert cov.kills["elastic.op.abort_release"] >= 2
+    assert cov.kills["elastic.phase.aborted"] == 1
+    assert cov.kills["elastic.op.import"] >= 2
+
+
+def _preempt_drive_harness(base, matrix, mk_loop, drive, final_meta):
+    """Shared kill-everything harness for the autopilot/healer preempted
+    drives. ``mk_loop(root, js, srcs, dests)`` builds the loop with an
+    elastic-backed actuator that mints a FRESH base id per invocation
+    (mimicking ``reshard_base_id`` over the advancing job epoch — a
+    re-plan after an abort must not reuse journal ids the released attempt
+    already recorded). ``drive(loop, abort_check)`` runs one preempted
+    decision; ``final_meta(root)`` reads the loop's manifest dict.
+
+    Two legitimate resume outcomes, both asserted bit-identical:
+
+    - ``aborted``: the kill landed where the abort arm was (or became)
+      durable, or before the planned manifest — the re-decided drive is
+      preempted again. Fleet == pristine ring.
+    - ``done``: the kill landed where the preemption request had not yet
+      reached a durable elastic phase — the request is arbiter memory,
+      not manifest state, so an interrupted forward plan rolls FORWARD.
+      Fleet == the completed 2→4 ring."""
+    # reference END states from uninterrupted runs
+    srcs, dests, _, _ = _abort_setup()
+    ref0 = _fleet_state(dests)
+    loop = mk_loop(os.path.join(str(base), "ref_abort"),
+                   os.path.join(str(base), "ref_abort_js"),
+                   srcs, dests, {"n": 0})
+    out = drive(loop, _post_import_preempt())
+    assert out.get("aborted") and _fleet_state(dests) == ref0
+    assert final_meta(os.path.join(str(base), "ref_abort"))["phase"] == "aborted"
+
+    srcs, dests, _, _ = _abort_setup()
+    loop = mk_loop(os.path.join(str(base), "ref_fwd"),
+                   os.path.join(str(base), "ref_fwd_js"),
+                   srcs, dests, {"n": 0})
+    out = drive(loop, None)
+    assert not out.get("aborted")
+    ref_fwd = _fleet_state(dests)
+    assert ref_fwd != ref0
+
+    srcs, dests, _, _ = _abort_setup()
+    loop = mk_loop(os.path.join(str(base), "rec"),
+                   os.path.join(str(base), "rec_js"), srcs, dests, {"n": 0})
+    points = _enumerate(lambda: drive(loop, _post_import_preempt()))
+
+    cov = crashcheck.Coverage()
+    for k, (site, occ) in enumerate(points):
+        srcs, dests, _, _ = _abort_setup()
+        root = os.path.join(str(base), f"run{k}")
+        js = os.path.join(str(base), f"run{k}_js")
+        ctr = {"n": 0}  # shared epoch counter across loop incarnations
+        loop = mk_loop(root, js, srcs, dests, ctr)
+        with crashcheck.crash_at(site, occ):
+            assert _crashed(lambda: drive(loop, _post_import_preempt())), \
+                (site, occ)
+        cov.add_kill(matrix, site)
+        # the loop process died; a FRESH one resumes from the journal.
+        # Nothing pending (killed before the planned pointer) -> the
+        # sense loop re-decides, and the preempting intent is still live.
+        loop2 = mk_loop(root, js, srcs, dests, ctr)
+        if loop2.resume() is None:
+            drive(loop2, _post_import_preempt())
+        meta = final_meta(root)
+        assert meta["phase"] in ("aborted", "done"), (site, occ)
+        want = ref0 if meta["phase"] == "aborted" else ref_fwd
+        assert _fleet_state(dests) == want, (site, occ)
+        assert loop2.pending() is None and loop2.resume() is None
+    return cov
+
+
+def _mk_fresh_reshard(js, srcs, dests, old_s, ctr):
+    """Elastic-backed actuator minting a fresh base id per invocation, as
+    ``reshard_base_id`` does over the advancing job epoch. A re-plan after
+    a terminal abort must NOT reuse journal ids the released attempt
+    recorded — the imports would dedupe into data loss."""
+    def reshard(n, splits, step, abort_check=None):
+        ctr["n"] += 1
+        plan = elastic.plan_reshard(
+            2, int(n), old_s, [int(x) for x in splits],
+            jobstate.make_journal_id(ctr["n"], int(step)))
+        assert plan.abortable
+        return elastic.execute_reshard(plan, srcs, dests, js,
+                                       abort_check=abort_check)
+
+    return reshard
+
+
+def run_autopilot_preempt_matrix(base) -> crashcheck.Coverage:
+    old_s = [int(x) for x in uniform_splits(2)]
+    new_s = [int(x) for x in uniform_splits(4)]
+    d = Decision("reshard", "preempt-matrix",
+                 {"n_shards": 4, "splits": new_s})
+
+    def mk_loop(root, js, srcs, dests, ctr):
+        return Autopilot(
+            root, policy=PolicyEngine(),
+            reshard=_mk_fresh_reshard(js, srcs, dests, old_s, ctr),
+            resume_reshard=lambda: elastic.resume_reshard(js, srcs, dests),
+        )
+
+    return _preempt_drive_harness(
+        base, "autopilot_preempt", mk_loop,
+        lambda loop, check: loop._drive(d, 8, abort_check=check),
+        _autopilot_meta,
+    )
+
+
+def run_heal_preempt_matrix(base) -> crashcheck.Coverage:
+    old_s = [int(x) for x in uniform_splits(2)]
+    new_s = [int(x) for x in uniform_splits(4)]
+    d = Decision(KIND_HEAL, "preempt-matrix",
+                 {"action": ACTION_RESIZE, "n_new": 4})
+
+    def mk_loop(root, js, srcs, dests, ctr):
+        fresh = _mk_fresh_reshard(js, srcs, dests, old_s, ctr)
+        return Healer(
+            root,
+            resize=lambda n_new, abort_check=None: fresh(
+                n_new, new_s, 0, abort_check=abort_check),
+            resume_resize=lambda: elastic.resume_reshard(js, srcs, dests),
+        )
+
+    return _preempt_drive_harness(
+        base, "heal_preempt", mk_loop,
+        lambda loop, check: loop._drive(d, 8, None, abort_check=check),
+        _healer_meta,
+    )
+
+
+def _autopilot_meta(root):
+    return jobstate.JobStateManager(root).latest().meta["autopilot"]
+
+
+def _healer_meta(root):
+    return jobstate.JobStateManager(root).latest().meta["healer"]
+
+
+@pytest.mark.slow
+def test_autopilot_preempt_crash_matrix(tmp_path):
+    cov = run_autopilot_preempt_matrix(tmp_path)
+    assert cov.kills["autopilot.phase.aborted"] == 1
+    assert cov.kills["elastic.phase.aborting"] >= 1
+
+
+@pytest.mark.slow
+def test_heal_preempt_crash_matrix(tmp_path):
+    cov = run_heal_preempt_matrix(tmp_path)
+    assert cov.kills["heal.phase.aborted"] == 1
+    assert cov.kills["elastic.phase.aborting"] >= 1
+
+
 # ================================================= coverage artifact writer
 
 
 ALL_MATRICES = (
     run_fence_matrix, run_scrub_matrix, run_heal_matrix,
     run_reshard_matrix, run_autopilot_matrix,
+    run_abort_matrix, run_autopilot_preempt_matrix, run_heal_preempt_matrix,
 )
 
 
